@@ -1,0 +1,23 @@
+"""consul-tpu: a TPU-native distributed-coordination simulation framework.
+
+A brand-new JAX/XLA framework with the capabilities of HashiCorp Consul's
+gossip core (reference: /root/reference): the SWIM failure detector,
+Lifeguard suspicion/awareness extensions, push-pull anti-entropy, gossip
+dissemination, and Vivaldi network coordinates — re-expressed as a pure,
+jit-compiled, time-stepped state machine over struct-of-arrays, sharded
+over a TPU device mesh.
+
+Layout:
+  config.py    — tick-based protocol configs (LAN/WAN/Local profiles with
+                 the reference's timing constants).
+  ops/         — pure math kernels: log-scaling laws, the SWIM merge
+                 semilattice, Vivaldi spring relaxation, RNG helpers.
+  models/      — the simulation state machines: SimState pytree, the SWIM
+                 step function, the serf event layer, cluster drivers.
+  parallel/    — device mesh construction, sharded step, WAN federation.
+  utils/       — convergence metrics, checkpointing, telemetry.
+"""
+
+__version__ = "0.1.0"
+
+from consul_tpu import config as config  # noqa: F401

@@ -1,0 +1,181 @@
+"""Protocol configuration for the simulated gossip fabric.
+
+The knob names and default values mirror the reference so published
+Serf/Consul timing defaults transfer 1:1:
+  - memberlist LAN/WAN/Local profiles:
+      reference vendor/github.com/hashicorp/memberlist/config.go:231-300
+  - Vivaldi tuning factors:
+      reference vendor/github.com/hashicorp/serf/coordinate/config.go:59-70
+
+Wall-clock intervals are mapped onto a single global tick cadence
+(``tick_ms``, default 200 ms = the LAN gossip interval): gossip fires every
+tick, probes every ``probe_interval_ms / tick_ms`` ticks, push-pull every
+``push_pull_interval_ms / tick_ms`` ticks scaled by ``push_pull_scale(n)``.
+Timers (probe ack deadlines, suspicion timers) become per-node deadline
+arrays compared against the global tick counter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+
+def _ticks(ms: float, tick_ms: float) -> int:
+    """Convert a wall-clock interval to whole ticks (minimum 1).
+
+    Rounds up so a quantized interval is never shorter than specified —
+    a probe timeout of 500 ms on a 200 ms tick must wait 3 ticks, not 2.
+    """
+    return max(1, math.ceil(ms / tick_ms))
+
+
+@dataclasses.dataclass(frozen=True)
+class GossipConfig:
+    """SWIM / gossip protocol knobs (reference memberlist/config.go).
+
+    All ``*_ms`` values are wall-clock milliseconds in the simulated
+    cluster's frame; the tick mapping derives integer tick counts.
+    """
+
+    # -- time base ---------------------------------------------------------
+    tick_ms: int = 200
+
+    # -- failure detector (reference config.go:241-249) --------------------
+    probe_interval_ms: int = 1000
+    probe_timeout_ms: int = 500
+    indirect_checks: int = 3
+    awareness_max: int = 8
+
+    # -- suspicion (Lifeguard; reference config.go:243-244) ----------------
+    suspicion_mult: int = 4
+    suspicion_max_timeout_mult: int = 6
+
+    # -- dissemination (reference config.go:242,251-253) -------------------
+    retransmit_mult: int = 4
+    gossip_interval_ms: int = 200
+    gossip_nodes: int = 3
+    gossip_to_the_dead_ms: int = 30_000
+
+    # -- anti-entropy (reference config.go:245) ----------------------------
+    push_pull_interval_ms: int = 30_000
+
+    # -- vectorization capacity knobs (no reference analogue; these bound
+    #    the fixed-shape replacements for Go's unbounded structures) -------
+    # Per-node broadcast queue slots (replaces the btree
+    # TransmitLimitedQueue, reference memberlist/queue.go:14-28).
+    queue_slots: int = 8
+    # Messages piggybacked per gossip send (models the 1400-byte UDP
+    # budget, reference memberlist/state.go:541 / config.go:265).
+    piggyback_msgs: int = 3
+
+    # ---------------------------------------------------------------------
+    @classmethod
+    def lan(cls, **overrides) -> "GossipConfig":
+        """Reference DefaultLANConfig (memberlist/config.go:231-267)."""
+        return cls(**overrides)
+
+    @classmethod
+    def wan(cls, **overrides) -> "GossipConfig":
+        """Reference DefaultWANConfig (memberlist/config.go:272-283)."""
+        kw = dict(
+            tick_ms=500,
+            suspicion_mult=6,
+            push_pull_interval_ms=60_000,
+            probe_timeout_ms=3_000,
+            probe_interval_ms=5_000,
+            gossip_nodes=4,
+            gossip_interval_ms=500,
+            gossip_to_the_dead_ms=60_000,
+        )
+        kw.update(overrides)
+        return cls(**kw)
+
+    @classmethod
+    def local(cls, **overrides) -> "GossipConfig":
+        """Reference DefaultLocalConfig (memberlist/config.go:288-300)."""
+        kw = dict(
+            tick_ms=100,
+            indirect_checks=1,
+            retransmit_mult=2,
+            suspicion_mult=3,
+            push_pull_interval_ms=15_000,
+            probe_timeout_ms=200,
+            probe_interval_ms=1000,
+            gossip_interval_ms=100,
+            gossip_to_the_dead_ms=15_000,
+        )
+        kw.update(overrides)
+        return cls(**kw)
+
+    # -- derived tick counts ----------------------------------------------
+    @property
+    def probe_period_ticks(self) -> int:
+        return _ticks(self.probe_interval_ms, self.tick_ms)
+
+    @property
+    def probe_timeout_ticks(self) -> int:
+        return _ticks(self.probe_timeout_ms, self.tick_ms)
+
+    @property
+    def gossip_period_ticks(self) -> int:
+        return _ticks(self.gossip_interval_ms, self.tick_ms)
+
+    @property
+    def gossip_to_the_dead_ticks(self) -> int:
+        return _ticks(self.gossip_to_the_dead_ms, self.tick_ms)
+
+    def push_pull_period_ticks(self, n: int) -> int:
+        """Push-pull cadence scaled by cluster size.
+
+        Mirrors pushPullScale (reference memberlist/util.go:89-97): the
+        interval multiplies by ceil(log2(n) - log2(32)) + 1 above 32 nodes.
+        """
+        from consul_tpu.ops import scaling
+
+        base = _ticks(self.push_pull_interval_ms, self.tick_ms)
+        return base * int(scaling.push_pull_scale(n))
+
+
+@dataclasses.dataclass(frozen=True)
+class VivaldiConfig:
+    """Vivaldi coordinate tuning (reference serf/coordinate/config.go:59-70)."""
+
+    dimensionality: int = 8
+    vivaldi_error_max: float = 1.5
+    vivaldi_ce: float = 0.25
+    vivaldi_cc: float = 0.25
+    adjustment_window_size: int = 20
+    height_min: float = 10.0e-6
+    latency_filter_size: int = 3
+    gravity_rho: float = 150.0
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Top-level simulation parameters for one simulated datacenter."""
+
+    n: int = 1024                      # number of simulated nodes
+    gossip: GossipConfig = dataclasses.field(default_factory=GossipConfig)
+    vivaldi: VivaldiConfig = dataclasses.field(default_factory=VivaldiConfig)
+
+    # Partial-view degree: each node maintains membership views of at most
+    # ``view_degree`` neighbors. 0 means the complete graph (each node
+    # views every other node, like a real memberlist member map — only
+    # feasible for small n; the >=100k configs must bound this).
+    view_degree: int = 0
+
+    # Ground-truth latency model: nodes are planted in a Vivaldi-style
+    # space; RTT(i,j) = euclidean distance + per-node access-link height,
+    # plus lognormal jitter. Units: milliseconds.
+    world_diameter_ms: float = 50.0    # spread of planted coordinates
+    world_dims: int = 3                # intrinsic dimensionality of truth
+    height_ms_min: float = 0.1
+    height_ms_max: float = 2.0
+    rtt_jitter_frac: float = 0.05      # lognormal sigma on each sample
+    packet_loss: float = 0.0           # iid drop probability per message
+
+    @property
+    def degree(self) -> int:
+        """Effective neighbor-table width K (N-1 for complete graph)."""
+        return self.n - 1 if self.view_degree == 0 else min(self.view_degree, self.n - 1)

@@ -1,0 +1,5 @@
+"""Pure math kernels: scaling laws, merge semilattice, Vivaldi, sampling."""
+
+from consul_tpu.ops import merge as merge  # noqa: F401
+from consul_tpu.ops import scaling as scaling  # noqa: F401
+from consul_tpu.ops import vivaldi as vivaldi  # noqa: F401

@@ -1,0 +1,96 @@
+"""The SWIM membership-state merge semilattice.
+
+The reference applies alive/suspect/dead messages serially with
+per-message precedence rules (reference memberlist/state.go:868-1240):
+
+  - alive(inc)   applies iff inc >  current inc          (state.go:991)
+  - suspect(inc) applies iff inc >= current inc and the current state is
+                 alive                                   (state.go:1086,1102)
+  - dead(inc)    applies iff inc >= current inc and the current state is
+                 not already dead                        (state.go:1174,1182)
+
+For a vectorized, order-free formulation we canonicalize this as a join
+semilattice over keys ``(incarnation, state priority)`` ordered
+lexicographically, with priority alive=0 < suspect=1 < dead=2 < left=3.
+Taking the max key over any batch of concurrent messages is associative,
+commutative, and idempotent, so batched scatter-max delivery reaches the
+same fixed point as any serial delivery order.
+
+Known canonicalization (documented divergence): the reference keeps a
+dead(inc=5) entry even when a suspect(inc=6) arrives ("ignore non-alive
+nodes", state.go:1102), whereas the lattice lets the higher incarnation
+win. The reference's own outcome there depends on message arrival order
+(dead(5) then suspect(6) keeps dead(5); the reverse order keeps
+suspect(6)), i.e. it has no order-free answer to preserve — and the
+suspicion timer re-kills the node either way, so the converged state is
+identical.
+
+Statuses also index the simulation's per-node ground truth; LEFT models
+serf's graceful departure (reference serf/serf.go:1073-…).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+ALIVE = 0
+SUSPECT = 1
+DEAD = 2
+LEFT = 3
+
+N_STATUS = 4
+_STATUS_BITS = 2
+
+# Keys are uint32: incarnation in the high 30 bits, priority in the low 2.
+# Incarnations only grow by refutation (one bump per suspect/dead message
+# about a live node), so 2^30 headroom is far beyond any simulated run.
+MAX_INCARNATION = (1 << 30) - 1
+
+
+def make_key(incarnation, status):
+    """Pack (incarnation, status) into a lexicographically ordered uint32."""
+    inc = jnp.asarray(incarnation, jnp.uint32)
+    st = jnp.asarray(status, jnp.uint32)
+    return (inc << _STATUS_BITS) | st
+
+
+def key_incarnation(key):
+    return jnp.asarray(key, jnp.uint32) >> _STATUS_BITS
+
+
+def key_status(key):
+    return (jnp.asarray(key, jnp.uint32) & (N_STATUS - 1)).astype(jnp.int8)
+
+
+def join(key_a, key_b):
+    """The semilattice join: pointwise max of packed keys."""
+    return jnp.maximum(jnp.asarray(key_a, jnp.uint32), jnp.asarray(key_b, jnp.uint32))
+
+
+def demote_dead_to_suspect(key):
+    """Map dead-state keys to suspect at the same incarnation.
+
+    Push-pull anti-entropy never kills directly: a remote claim that a
+    node is dead is downgraded to a suspicion so the node gets a chance to
+    refute (reference memberlist/state.go:1231-1237, mergeState). LEFT is
+    exempt: graceful departures are authoritative (serf handles them via
+    leave intents, not suspicion).
+    """
+    key = jnp.asarray(key, jnp.uint32)
+    st = key & (N_STATUS - 1)
+    return jnp.where(st == DEAD, (key & ~jnp.uint32(N_STATUS - 1)) | SUSPECT, key)
+
+
+def is_refutable(key, subject_is_self, own_incarnation):
+    """True where a key claims self is suspect/dead at a current-or-newer
+    incarnation — the condition under which a live node must refute by
+    bumping its incarnation and broadcasting alive (reference
+    memberlist/state.go:840-864 refute, :1107-1110, :1187-1192).
+    """
+    st = key_status(key)
+    inc = key_incarnation(key)
+    return (
+        subject_is_self
+        & ((st == SUSPECT) | (st == DEAD))
+        & (inc >= jnp.asarray(own_incarnation, jnp.uint32))
+    )

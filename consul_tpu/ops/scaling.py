@@ -1,0 +1,102 @@
+"""Cluster-size scaling laws of the SWIM/Lifeguard protocol.
+
+These are the log-scaling formulas the reference applies everywhere the
+protocol must stay stable as N grows (reference memberlist/util.go:62-97,
+memberlist/suspicion.go:86-97, lib/cluster.go:48-60). They are implemented
+as jnp-traceable functions of (possibly batched) array arguments so they
+can be evaluated per-node inside the jitted step function.
+
+All time quantities are in abstract *ticks* (callers convert via
+GossipConfig); the formulas are scale-free so the units cancel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def suspicion_timeout(suspicion_mult, n, probe_interval_ticks):
+    """Base (minimum) suspicion timeout for cluster size ``n``.
+
+    Mirrors suspicionTimeout (reference memberlist/util.go:64-69):
+    ``mult * max(1, log10(max(1, n))) * probe_interval``. The reference's
+    integer Duration math truncates the node scale to 1/1000ths; that
+    sub-0.1% effect is not reproduced in float32.
+    """
+    n = jnp.asarray(n, jnp.float32)
+    node_scale = jnp.maximum(1.0, jnp.log10(jnp.maximum(1.0, n)))
+    return suspicion_mult * node_scale * probe_interval_ticks
+
+
+def retransmit_limit(retransmit_mult, n):
+    """Per-message retransmission budget.
+
+    Mirrors retransmitLimit (reference memberlist/util.go:72-76):
+    ``mult * ceil(log10(n + 1))``.
+    """
+    n = jnp.asarray(n, jnp.float32)
+    # The epsilon guards against float32 log10 landing a hair above an
+    # integer (log10(10) evaluates to ~1.00001f) and ceil overshooting;
+    # true boundaries are >=0.04 away for any non-power-of-ten n.
+    scale = jnp.ceil(jnp.log10(n + 1.0) - 1e-3)
+    return (retransmit_mult * scale).astype(jnp.int32)
+
+
+def push_pull_scale(n):
+    """Multiplier on the push-pull interval above 32 nodes.
+
+    Mirrors pushPullScale (reference memberlist/util.go:89-97): 1 up to
+    32 nodes, then ``ceil(log2(n) - log2(32)) + 1`` (the 33rd node doubles
+    the interval, the 65th triples it).
+    """
+    n = jnp.asarray(n, jnp.float32)
+    # Same float32 epsilon guard as retransmit_limit: keep ceil from
+    # overshooting when log2 lands a hair above an integer.
+    mult = jnp.ceil(jnp.log2(jnp.maximum(n, 1.0)) - jnp.log2(32.0) - 1e-3) + 1.0
+    return jnp.where(n <= 32.0, 1, mult.astype(jnp.int32))
+
+
+def remaining_suspicion_time(n_confirms, k, elapsed, min_timeout, max_timeout):
+    """Remaining suspicion time after ``n_confirms`` independent confirmations.
+
+    Mirrors remainingSuspicionTime (reference memberlist/suspicion.go:86-97):
+    the timeout decays from ``max`` toward ``min`` along
+    ``log(n+1)/log(k+1)``, floored at ``min``, less time already elapsed.
+    May be <= 0, meaning the suspicion has expired. All times in ticks
+    (floats allowed); the reference's floor-to-milliseconds is not
+    reproduced since tick granularity subsumes it.
+    """
+    n_confirms = jnp.asarray(n_confirms, jnp.float32)
+    k = jnp.asarray(k, jnp.float32)
+    frac = jnp.where(
+        k > 0.0,
+        jnp.log(n_confirms + 1.0) / jnp.log(k + 1.0),
+        1.0,  # k <= 0: no confirmations expected, drive straight to min
+    )
+    raw = max_timeout - frac * (max_timeout - min_timeout)
+    return jnp.maximum(raw, min_timeout) - elapsed
+
+
+def suspicion_k(suspicion_mult, n):
+    """Confirmations needed to drive a suspicion timer to its minimum.
+
+    Mirrors the setup in suspectNode (reference memberlist/state.go:1124-1136):
+    ``k = suspicion_mult - 2``, zeroed when the cluster is too small to
+    provide that many independent confirmers (n - 2 < k).
+    """
+    n = jnp.asarray(n, jnp.int32)
+    k = jnp.asarray(suspicion_mult - 2, jnp.int32)
+    return jnp.where(n - 2 < k, 0, k)
+
+
+def rate_scaled_interval(rate_per_s, min_ticks, n, ticks_per_s):
+    """Interval targeting an aggregate cluster-wide action rate.
+
+    Mirrors RateScaledInterval (reference lib/cluster.go:51-60): spread N
+    actors so the whole cluster performs ``rate_per_s`` actions per second,
+    never below ``min_ticks``. Used for the coordinate-update send rate
+    (reference agent/agent.go:1896).
+    """
+    n = jnp.asarray(n, jnp.float32)
+    interval = ticks_per_s * n / rate_per_s
+    return jnp.maximum(interval, min_ticks)

@@ -1,0 +1,229 @@
+"""Vectorized Vivaldi network coordinates.
+
+Re-expresses the reference's per-observation serial update (reference
+serf/coordinate/client.go:145-234 and coordinate.go:104-203) as pure
+batched array functions: every node can absorb its probe-RTT observation
+of the tick in one fused elementwise pass. All distances/RTTs are in
+**seconds** (like the reference); all arrays are float32 (TPU-native;
+the reference uses float64 — tolerances in tests account for this).
+
+State per node: the Euclidean vector, the non-Euclidean height, the
+confidence error, the adjustment offset plus its sliding sample window,
+and a reset counter (mirroring ClientStats.Resets, client.go:47-51).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.config import VivaldiConfig
+
+ZERO_THRESHOLD = 1.0e-6
+# RTT observations above this are rejected (reference client.go:216-219).
+MAX_RTT_SECONDS = 10.0
+
+
+class VivaldiState(NamedTuple):
+    """Struct-of-arrays Vivaldi client state; leading dims are batch dims."""
+
+    vec: jax.Array         # [..., D] float32, Euclidean coordinate (seconds)
+    height: jax.Array      # [...]    float32, access-link height (seconds)
+    error: jax.Array       # [...]    float32, confidence (dimensionless)
+    adjustment: jax.Array  # [...]    float32, offset from window (seconds)
+    adj_samples: jax.Array # [..., W] float32, sliding adjustment window
+    adj_idx: jax.Array     # [...]    int32, next write slot in the window
+    resets: jax.Array      # [...]    int32, NaN/Inf reset count
+
+
+def new(cfg: VivaldiConfig, batch_shape=()) -> VivaldiState:
+    """Fresh origin coordinates (reference coordinate.go:54-61)."""
+    shape = tuple(batch_shape)
+    return VivaldiState(
+        vec=jnp.zeros(shape + (cfg.dimensionality,), jnp.float32),
+        height=jnp.full(shape, cfg.height_min, jnp.float32),
+        error=jnp.full(shape, cfg.vivaldi_error_max, jnp.float32),
+        adjustment=jnp.zeros(shape, jnp.float32),
+        adj_samples=jnp.zeros(shape + (cfg.adjustment_window_size,), jnp.float32),
+        adj_idx=jnp.zeros(shape, jnp.int32),
+        resets=jnp.zeros(shape, jnp.int32),
+    )
+
+
+def raw_distance(vec_a, height_a, vec_b, height_b):
+    """Vivaldi distance without adjustments (reference coordinate.go:137-139)."""
+    d = jnp.linalg.norm(vec_a - vec_b, axis=-1)
+    return d + height_a + height_b
+
+
+def distance(vec_a, height_a, adj_a, vec_b, height_b, adj_b):
+    """Full distance estimate including the adjustment offsets.
+
+    Mirrors DistanceTo (reference coordinate.go:121-132): the adjusted
+    distance is used only when it stays positive.
+    """
+    dist = raw_distance(vec_a, height_a, vec_b, height_b)
+    adjusted = dist + adj_a + adj_b
+    return jnp.where(adjusted > 0.0, adjusted, dist)
+
+
+def _unit_vector_at(vec_a, vec_b, key):
+    """Unit vector pointing at ``vec_a`` from ``vec_b`` plus the distance.
+
+    Mirrors unitVectorAt (reference coordinate.go:182-203): coincident
+    points get a random unit direction (reported magnitude 0) so height
+    updates are skipped for them.
+    """
+    d = vec_a - vec_b
+    mag = jnp.linalg.norm(d, axis=-1, keepdims=True)
+    rnd = jax.random.uniform(key, d.shape, jnp.float32, -0.5, 0.5)
+    rnd_mag = jnp.linalg.norm(rnd, axis=-1, keepdims=True)
+    # Fallback chain: real direction -> random direction -> e0.
+    e0 = jnp.zeros_like(d).at[..., 0].set(1.0)
+    use_real = mag > ZERO_THRESHOLD
+    use_rnd = rnd_mag > ZERO_THRESHOLD
+    unit = jnp.where(
+        use_real,
+        d / jnp.where(use_real, mag, 1.0),
+        jnp.where(use_rnd, rnd / jnp.where(use_rnd, rnd_mag, 1.0), e0),
+    )
+    return unit, jnp.where(use_real[..., 0], mag[..., 0], 0.0)
+
+
+def apply_force(cfg: VivaldiConfig, vec, height, force, other_vec, other_height, key):
+    """Apply a scalar force from the direction of ``other``.
+
+    Mirrors ApplyForce (reference coordinate.go:104-117): the vector moves
+    along the unit direction; the height blends both endpoints' heights
+    scaled by force/distance, clamped to ``height_min``, and is untouched
+    for coincident points.
+    """
+    unit, mag = _unit_vector_at(vec, other_vec, key)
+    new_vec = vec + unit * force[..., None]
+    moved = mag > ZERO_THRESHOLD
+    new_height = (height + other_height) * force / jnp.where(moved, mag, 1.0) + height
+    new_height = jnp.maximum(new_height, cfg.height_min)
+    return new_vec, jnp.where(moved, new_height, height)
+
+
+def update(
+    cfg: VivaldiConfig,
+    state: VivaldiState,
+    other_vec,
+    other_height,
+    other_error,
+    other_adjustment,
+    rtt_seconds,
+    key,
+) -> VivaldiState:
+    """One full observation update per batch element.
+
+    Mirrors Client.Update (reference client.go:202-234) minus the latency
+    median filter, which lives with the per-peer sample buffers in the
+    SWIM state (see ``latency_filter_push``): error-weighted Vivaldi force
+    (client.go:145-168), adjustment window (client.go:172-188), gravity
+    toward the origin (client.go:193-197), and NaN/Inf reset
+    (client.go:228-231). Like the reference's input gate (checkCoordinate
+    + the RTT range check, client.go:206-219), an invalid observation — a
+    non-finite peer coordinate or an RTT outside [0, 10 s] — is rejected
+    per batch element: that element's state passes through untouched.
+    """
+    k_viv, k_grav = jax.random.split(key)
+
+    rtt_in = jnp.asarray(rtt_seconds, jnp.float32)
+    obs_ok = (
+        jnp.all(jnp.isfinite(other_vec), axis=-1)
+        & jnp.isfinite(other_height) & jnp.isfinite(other_error)
+        & jnp.isfinite(other_adjustment)
+        & jnp.isfinite(rtt_in) & (rtt_in >= 0.0) & (rtt_in <= MAX_RTT_SECONDS)
+    )
+
+    # -- updateVivaldi (client.go:145-168) --------------------------------
+    dist = distance(
+        state.vec, state.height, state.adjustment,
+        other_vec, other_height, other_adjustment,
+    )
+    rtt = jnp.maximum(jnp.asarray(rtt_seconds, jnp.float32), ZERO_THRESHOLD)
+    wrongness = jnp.abs(dist - rtt) / rtt
+    total_error = jnp.maximum(state.error + other_error, ZERO_THRESHOLD)
+    weight = state.error / total_error
+    error = cfg.vivaldi_ce * weight * wrongness + state.error * (1.0 - cfg.vivaldi_ce * weight)
+    error = jnp.minimum(error, cfg.vivaldi_error_max)
+    force = cfg.vivaldi_cc * weight * (rtt - dist)
+    vec, height = apply_force(cfg, state.vec, state.height, force, other_vec, other_height, k_viv)
+
+    # -- updateAdjustment (client.go:172-188) -----------------------------
+    w = cfg.adjustment_window_size
+    if w:
+        raw = raw_distance(vec, height, other_vec, other_height)
+        sample = rtt - raw
+        adj_samples = _set_along_last(state.adj_samples, state.adj_idx, sample)
+        adj_idx = (state.adj_idx + 1) % w
+        adjustment = jnp.sum(adj_samples, axis=-1) / (2.0 * w)
+    else:
+        adj_samples, adj_idx, adjustment = state.adj_samples, state.adj_idx, state.adjustment
+
+    # -- updateGravity (client.go:193-197); origin has zero vec/adjustment,
+    #    height_min height, so the distance is the full estimate to origin.
+    origin_vec = jnp.zeros_like(vec)
+    origin_h = jnp.full_like(height, cfg.height_min)
+    dist_origin = distance(vec, height, adjustment, origin_vec, origin_h, jnp.zeros_like(adjustment))
+    g_force = -1.0 * (dist_origin / cfg.gravity_rho) ** 2.0
+    vec, height = apply_force(cfg, vec, height, g_force, origin_vec, origin_h, k_grav)
+
+    # -- validity reset (client.go:228-231) -------------------------------
+    finite = (
+        jnp.all(jnp.isfinite(vec), axis=-1)
+        & jnp.isfinite(height) & jnp.isfinite(error) & jnp.isfinite(adjustment)
+    )
+    fresh = new(cfg, batch_shape=state.height.shape)
+    updated = VivaldiState(
+        vec=jnp.where(finite[..., None], vec, fresh.vec),
+        height=jnp.where(finite, height, fresh.height),
+        error=jnp.where(finite, error, fresh.error),
+        adjustment=jnp.where(finite, adjustment, fresh.adjustment),
+        adj_samples=jnp.where(finite[..., None], adj_samples, fresh.adj_samples),
+        adj_idx=jnp.where(finite, adj_idx, fresh.adj_idx),
+        resets=state.resets + jnp.where(finite, 0, 1),
+    )
+    # Rejected observations leave the element's state untouched.
+    return jax.tree.map(
+        lambda new_leaf, old_leaf: jnp.where(
+            obs_ok.reshape(obs_ok.shape + (1,) * (new_leaf.ndim - obs_ok.ndim)),
+            new_leaf,
+            old_leaf,
+        ),
+        updated,
+        state,
+    )
+
+
+def latency_filter_push(buf, count, rtt_seconds):
+    """Insert an RTT sample into a per-peer ring buffer; return the median.
+
+    Mirrors latencyFilter (reference client.go:123-141): keep the last
+    ``S`` samples per peer and return the median, defined as
+    ``sorted[len/2]`` (the upper median for even counts). Absent samples
+    are padded with +inf before sorting so the index math matches the Go
+    slice semantics exactly.
+
+    buf: [..., S] float32, count: [...] int32 (total samples ever pushed).
+    """
+    s = buf.shape[-1]
+    buf = _set_along_last(buf, count % s, jnp.asarray(rtt_seconds, jnp.float32))
+    count = count + 1
+    filled = jnp.minimum(count, s)
+    slot = jnp.arange(s, dtype=jnp.int32)
+    padded = jnp.where(slot < filled[..., None], buf, jnp.inf)
+    med = jnp.take_along_axis(
+        jnp.sort(padded, axis=-1), (filled // 2)[..., None], axis=-1
+    )[..., 0]
+    return buf, count, med
+
+
+def _set_along_last(arr, idx, value):
+    """arr[..., idx] = value, batched over leading dims."""
+    onehot = jnp.arange(arr.shape[-1], dtype=jnp.int32) == idx[..., None]
+    return jnp.where(onehot, value[..., None], arr)

@@ -1,0 +1,26 @@
+"""Test harness: force an 8-device virtual CPU platform.
+
+Tests never require real TPU hardware; sharding tests run over a virtual
+8-device CPU mesh (mirroring how the reference tests multi-node behavior
+with in-process clusters rather than real networks, reference
+agent/testagent.go:44-129, agent/consul/helper_test.go).
+
+Note: this environment registers a remote-TPU PJRT plugin from
+sitecustomize and pins ``jax_platforms`` via ``jax.config`` (so the
+JAX_PLATFORMS env var alone is NOT enough to opt out). The config update
+below must run before the first JAX operation initializes a backend,
+which conftest import order guarantees.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)

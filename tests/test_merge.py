@@ -1,0 +1,76 @@
+"""Semilattice properties of the SWIM merge and fidelity to the reference's
+serial precedence rules (memberlist/state.go:868-1240)."""
+
+import numpy as np
+
+from consul_tpu.ops import merge
+
+
+def k(inc, st):
+    return int(np.asarray(merge.make_key(inc, st)))
+
+
+def test_key_roundtrip():
+    for inc in (0, 1, 7, 12345, merge.MAX_INCARNATION):
+        for st in (merge.ALIVE, merge.SUSPECT, merge.DEAD, merge.LEFT):
+            key = merge.make_key(inc, st)
+            assert int(np.asarray(merge.key_incarnation(key))) == inc
+            assert int(np.asarray(merge.key_status(key))) == st
+
+
+def test_reference_precedence_rules():
+    # alive applies iff strictly newer incarnation (state.go:991).
+    assert merge.join(k(5, merge.ALIVE), k(5, merge.ALIVE)) == k(5, merge.ALIVE)
+    assert merge.join(k(5, merge.SUSPECT), k(5, merge.ALIVE)) == k(5, merge.SUSPECT)
+    assert merge.join(k(5, merge.DEAD), k(6, merge.ALIVE)) == k(6, merge.ALIVE)
+    # suspect applies at equal-or-newer incarnation over alive (state.go:1086).
+    assert merge.join(k(5, merge.ALIVE), k(5, merge.SUSPECT)) == k(5, merge.SUSPECT)
+    assert merge.join(k(5, merge.ALIVE), k(4, merge.SUSPECT)) == k(5, merge.ALIVE)
+    # dead beats suspect and alive at the same incarnation (state.go:1174).
+    assert merge.join(k(5, merge.SUSPECT), k(5, merge.DEAD)) == k(5, merge.DEAD)
+    # refutation: alive at bumped incarnation beats suspect/dead.
+    assert merge.join(k(5, merge.DEAD), k(6, merge.ALIVE)) == k(6, merge.ALIVE)
+
+
+def test_semilattice_laws():
+    rng = np.random.default_rng(0)
+    incs = rng.integers(0, 50, size=64)
+    sts = rng.integers(0, 4, size=64)
+    keys = np.asarray(merge.make_key(incs, sts))
+    a, b, c = keys[:20], keys[20:40], keys[40:60]
+    # commutative / associative / idempotent
+    assert np.all(np.asarray(merge.join(a, b)) == np.asarray(merge.join(b, a)))
+    assert np.all(
+        np.asarray(merge.join(merge.join(a, b), c))
+        == np.asarray(merge.join(a, merge.join(b, c)))
+    )
+    assert np.all(np.asarray(merge.join(a, a)) == a)
+    # Batched max-join == any serial fold order.
+    total = keys[0]
+    for key in keys[1:]:
+        total = merge.join(total, key)
+    assert int(np.asarray(total)) == int(keys.max())
+
+
+def test_pushpull_demotes_dead_to_suspect():
+    # mergeState treats remote dead as suspect (state.go:1231-1237)...
+    key = merge.demote_dead_to_suspect(merge.make_key(7, merge.DEAD))
+    assert int(np.asarray(merge.key_status(key))) == merge.SUSPECT
+    assert int(np.asarray(merge.key_incarnation(key))) == 7
+    # ...but leaves alive/suspect/left untouched.
+    for st in (merge.ALIVE, merge.SUSPECT, merge.LEFT):
+        key = merge.demote_dead_to_suspect(merge.make_key(7, st))
+        assert int(np.asarray(merge.key_status(key))) == st
+
+
+def test_refutability():
+    own_inc = 5
+    self_mask = np.array([True, True, True, True, False])
+    keys = merge.make_key(
+        np.array([5, 4, 5, 6, 9]),
+        np.array([merge.SUSPECT, merge.SUSPECT, merge.ALIVE, merge.DEAD, merge.DEAD]),
+    )
+    out = np.asarray(merge.is_refutable(keys, self_mask, own_inc))
+    # suspect@5 about self: refute; suspect@4: stale, no; alive: no;
+    # dead@6: refute; dead@9 about another node: no.
+    assert list(out) == [True, False, False, True, False]

@@ -1,0 +1,94 @@
+"""Golden tests for the protocol scaling laws against hand-computed values
+from the reference formulas (memberlist/util.go:62-97, suspicion.go:86-97)."""
+
+import numpy as np
+
+from consul_tpu.ops import scaling
+
+
+def test_suspicion_timeout_matches_reference_formula():
+    # suspicionTimeout(mult=4, n, interval=1s): 4 * max(1, log10(max(1, n))) * 1s
+    # log10(1000) = 3 -> 12s; small n floors the scale at 1.
+    assert np.isclose(scaling.suspicion_timeout(4, 1000, 1.0), 12.0, atol=1e-3)
+    assert np.isclose(scaling.suspicion_timeout(4, 10, 1.0), 4.0, atol=1e-3)
+    for n in (0, 1, 5, 9):  # log10 < 1 floors to 1
+        assert np.isclose(scaling.suspicion_timeout(4, n, 1.0), 4.0)
+    assert np.isclose(scaling.suspicion_timeout(4, 20000, 1.0), 4 * 4.30103, atol=1e-2)
+    # WAN profile: mult=6. 10k nodes -> ~120s max (config.go:244 comment).
+    assert np.isclose(scaling.suspicion_timeout(6, 10000, 5.0), 6 * 4 * 5.0, atol=1e-2)
+
+
+def test_retransmit_limit_matches_reference_formula():
+    # retransmitLimit(mult, n): mult * ceil(log10(n + 1))
+    assert scaling.retransmit_limit(4, 0) == 0
+    assert scaling.retransmit_limit(4, 1) == 4   # ceil(log10(2)) = 1
+    assert scaling.retransmit_limit(4, 9) == 4   # ceil(log10(10)) = 1
+    assert scaling.retransmit_limit(4, 10) == 8  # ceil(log10(11)) = 2
+    assert scaling.retransmit_limit(4, 99) == 8
+    assert scaling.retransmit_limit(4, 999) == 12
+    assert scaling.retransmit_limit(3, 999_999) == 18
+    # Vectorized over n.
+    out = scaling.retransmit_limit(4, np.array([1, 10, 100]))
+    assert list(np.asarray(out)) == [4, 8, 12]
+
+
+def test_push_pull_scale_thresholds():
+    # No scaling through 32 nodes; 33rd doubles, 65th triples (util.go:20-25).
+    for n in (1, 16, 32):
+        assert scaling.push_pull_scale(n) == 1
+    assert scaling.push_pull_scale(33) == 2
+    assert scaling.push_pull_scale(64) == 2
+    assert scaling.push_pull_scale(65) == 3
+    assert scaling.push_pull_scale(128) == 3
+    assert scaling.push_pull_scale(129) == 4
+
+
+def test_remaining_suspicion_time_decay():
+    # k=3, min=2, max=30 (in ticks). n=0 -> full max; each confirmation
+    # moves timeout along log(n+1)/log(k+1) toward min.
+    f = scaling.remaining_suspicion_time
+    assert np.isclose(f(0, 3, 0.0, 2.0, 30.0), 30.0)
+    expected_n1 = 30.0 - (np.log(2) / np.log(4)) * 28.0  # = 16.0
+    assert np.isclose(f(1, 3, 0.0, 2.0, 30.0), expected_n1, atol=1e-5)
+    assert np.isclose(f(3, 3, 0.0, 2.0, 30.0), 2.0)   # k confirmations -> min
+    assert np.isclose(f(5, 3, 0.0, 2.0, 30.0), 2.0)   # floored at min
+    # Elapsed time subtracts; result may go negative (fire immediately).
+    assert np.isclose(f(3, 3, 10.0, 2.0, 30.0), -8.0)
+    # k=0: no confirmations expected, min from the start (suspicion.go:67-72).
+    assert np.isclose(f(0, 0, 0.0, 2.0, 30.0), 2.0)
+
+
+def test_suspicion_k_small_cluster_clamp():
+    # k = mult - 2, but 0 when n-2 < k (state.go:1128-1136).
+    assert scaling.suspicion_k(4, 1000) == 2
+    assert scaling.suspicion_k(4, 4) == 2
+    assert scaling.suspicion_k(4, 3) == 0
+    assert scaling.suspicion_k(6, 5) == 0
+    assert scaling.suspicion_k(6, 6) == 4
+
+
+def test_config_tick_quantization_never_shortens():
+    from consul_tpu.config import GossipConfig
+
+    lan = GossipConfig.lan()
+    # 500ms timeout on a 200ms tick must be 3 ticks (600ms), never 2.
+    assert lan.probe_timeout_ticks == 3
+    assert lan.probe_period_ticks == 5
+    # Host-side push-pull schedule delegates to the shared scaling law.
+    assert lan.push_pull_period_ticks(32) == 150
+    assert lan.push_pull_period_ticks(33) == 300
+    assert lan.push_pull_period_ticks(64) == 300
+    assert lan.push_pull_period_ticks(65) == 450
+
+
+def test_rate_scaled_interval():
+    # RateScaledInterval(rate, min, n) = max(min, n/rate seconds).
+    # Consul's coordinate loop uses rate=64/s, min=15s (agent/config defaults).
+    ticks_per_s = 5.0  # 200ms ticks
+    assert np.isclose(
+        scaling.rate_scaled_interval(64.0, 15 * 5.0, 960, ticks_per_s), 75.0
+    )
+    assert np.isclose(
+        scaling.rate_scaled_interval(64.0, 15 * 5.0, 100_000, ticks_per_s),
+        5.0 * 100_000 / 64.0,
+    )

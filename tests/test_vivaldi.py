@@ -1,0 +1,162 @@
+"""Vivaldi coordinate math: golden behaviors from the reference algorithm
+(serf/coordinate/coordinate.go, client.go) plus convergence properties."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.config import VivaldiConfig
+from consul_tpu.ops import vivaldi
+
+CFG = VivaldiConfig()
+
+
+def mk(vec, height=CFG.height_min, error=CFG.vivaldi_error_max, adjustment=0.0):
+    state = vivaldi.new(CFG)
+    vec = jnp.zeros(CFG.dimensionality).at[: len(vec)].set(jnp.asarray(vec, jnp.float32))
+    return state._replace(
+        vec=vec,
+        height=jnp.float32(height),
+        error=jnp.float32(error),
+        adjustment=jnp.float32(adjustment),
+    )
+
+
+def test_new_coordinate_is_origin():
+    s = vivaldi.new(CFG, batch_shape=(4,))
+    assert s.vec.shape == (4, CFG.dimensionality)
+    assert np.allclose(s.vec, 0.0)
+    assert np.allclose(s.height, CFG.height_min)
+    assert np.allclose(s.error, CFG.vivaldi_error_max)
+
+
+def test_raw_distance_includes_heights():
+    # dist = |a-b| + h_a + h_b (coordinate.go:137-139)
+    d = vivaldi.raw_distance(
+        jnp.array([3.0, 0.0]), jnp.float32(0.1), jnp.array([0.0, 4.0]), jnp.float32(0.2)
+    )
+    assert np.isclose(float(d), 5.0 + 0.3, atol=1e-6)
+
+
+def test_distance_adjustment_only_when_positive():
+    # adjusted distance used only if > 0 (coordinate.go:126-131)
+    args = (jnp.array([3.0, 0.0]), jnp.float32(0.0), jnp.array([0.0, 4.0]), jnp.float32(0.0))
+    d = vivaldi.distance(args[0], args[1], jnp.float32(0.5), args[2], args[3], jnp.float32(0.5))
+    assert np.isclose(float(d), 6.0, atol=1e-6)
+    d = vivaldi.distance(args[0], args[1], jnp.float32(-4.0), args[2], args[3], jnp.float32(-4.0))
+    assert np.isclose(float(d), 5.0, atol=1e-6)  # -3 rejected, raw kept
+
+
+def test_apply_force_moves_along_unit_vector():
+    key = jax.random.PRNGKey(0)
+    vec = jnp.zeros(CFG.dimensionality).at[0].set(1.0)
+    other = jnp.zeros(CFG.dimensionality)
+    new_vec, _ = vivaldi.apply_force(
+        CFG, vec, jnp.float32(CFG.height_min), jnp.float32(2.0), other,
+        jnp.float32(CFG.height_min), key,
+    )
+    assert np.isclose(float(new_vec[0]), 3.0, atol=1e-5)  # pushed away
+    new_vec, _ = vivaldi.apply_force(
+        CFG, vec, jnp.float32(CFG.height_min), jnp.float32(-0.5), other,
+        jnp.float32(CFG.height_min), key,
+    )
+    assert np.isclose(float(new_vec[0]), 0.5, atol=1e-5)  # pulled toward
+
+
+def test_apply_force_coincident_points_random_direction():
+    key = jax.random.PRNGKey(1)
+    vec = jnp.zeros(CFG.dimensionality)
+    new_vec, height = vivaldi.apply_force(
+        CFG, vec, jnp.float32(CFG.height_min), jnp.float32(1.0), vec,
+        jnp.float32(CFG.height_min), key,
+    )
+    # Moves by exactly |force| in some direction; height untouched (mag=0).
+    assert np.isclose(float(jnp.linalg.norm(new_vec)), 1.0, atol=1e-5)
+    assert np.isclose(float(height), CFG.height_min)
+
+
+def test_height_floor():
+    key = jax.random.PRNGKey(2)
+    vec = jnp.zeros(CFG.dimensionality).at[0].set(1.0)
+    _, height = vivaldi.apply_force(
+        CFG, vec, jnp.float32(0.5), jnp.float32(-10.0),
+        jnp.zeros(CFG.dimensionality), jnp.float32(0.5), key,
+    )
+    assert np.isclose(float(height), CFG.height_min)
+
+
+def test_update_converges_two_nodes():
+    # Two nodes 100ms apart pull their estimated distance toward the RTT.
+    key = jax.random.PRNGKey(3)
+    a, b = vivaldi.new(CFG), vivaldi.new(CFG)
+    rtt = jnp.float32(0.100)
+    for i in range(64):
+        key, ka, kb = jax.random.split(key, 3)
+        a_new = vivaldi.update(CFG, a, b.vec, b.height, b.error, b.adjustment, rtt, ka)
+        b_new = vivaldi.update(CFG, b, a.vec, a.height, a.error, a.adjustment, rtt, kb)
+        a, b = a_new, b_new
+    est = vivaldi.distance(a.vec, a.height, a.adjustment, b.vec, b.height, b.adjustment)
+    assert abs(float(est) - 0.100) < 0.010
+    assert float(a.error) < CFG.vivaldi_error_max / 2
+
+
+def test_update_reset_on_nonfinite():
+    key = jax.random.PRNGKey(4)
+    s = mk([np.inf, 0.0])
+    out = vivaldi.update(
+        CFG, s, jnp.zeros(CFG.dimensionality), jnp.float32(CFG.height_min),
+        jnp.float32(1.0), jnp.float32(0.0), jnp.float32(0.05), key,
+    )
+    assert np.all(np.isfinite(np.asarray(out.vec)))
+    assert int(out.resets) == 1
+    assert np.isclose(float(out.error), CFG.vivaldi_error_max)
+
+
+def test_update_rejects_invalid_observations():
+    # Like the reference input gate (client.go:206-219): a non-finite peer
+    # coordinate or out-of-range RTT leaves local state untouched.
+    key = jax.random.PRNGKey(6)
+    s = mk([1.0, 2.0], error=0.5)
+    bad_vec = jnp.full(CFG.dimensionality, jnp.nan)
+    out = vivaldi.update(
+        CFG, s, bad_vec, jnp.float32(CFG.height_min), jnp.float32(1.0),
+        jnp.float32(0.0), jnp.float32(0.05), key,
+    )
+    assert np.allclose(np.asarray(out.vec), np.asarray(s.vec))
+    assert int(out.resets) == 0
+    for bad_rtt in (-0.1, 11.0, np.nan):
+        out = vivaldi.update(
+            CFG, s, jnp.zeros(CFG.dimensionality), jnp.float32(CFG.height_min),
+            jnp.float32(1.0), jnp.float32(0.0), jnp.float32(bad_rtt), key,
+        )
+        assert np.allclose(np.asarray(out.vec), np.asarray(s.vec))
+        assert float(out.error) == 0.5
+
+
+def test_latency_filter_median_semantics():
+    # Median is sorted[len/2] like the Go slice logic (client.go:123-141).
+    buf = jnp.zeros((CFG.latency_filter_size,), jnp.float32)
+    cnt = jnp.int32(0)
+    buf, cnt, med = vivaldi.latency_filter_push(buf, cnt, 0.30)
+    assert np.isclose(float(med), 0.30)               # [0.30] -> idx 0
+    buf, cnt, med = vivaldi.latency_filter_push(buf, cnt, 0.10)
+    assert np.isclose(float(med), 0.30)               # [0.10 0.30] -> idx 1
+    buf, cnt, med = vivaldi.latency_filter_push(buf, cnt, 0.20)
+    assert np.isclose(float(med), 0.20)               # [0.10 0.20 0.30] -> idx 1
+    buf, cnt, med = vivaldi.latency_filter_push(buf, cnt, 0.90)
+    assert np.isclose(float(med), 0.20)               # window [0.90 0.10 0.20]... median 0.20
+    buf, cnt, med = vivaldi.latency_filter_push(buf, cnt, 0.95)
+    assert np.isclose(float(med), 0.90)               # [0.90 0.95 0.20] -> 0.90
+
+
+def test_batched_update_shapes():
+    key = jax.random.PRNGKey(5)
+    s = vivaldi.new(CFG, batch_shape=(16,))
+    other = vivaldi.new(CFG, batch_shape=(16,))
+    rtt = jnp.full((16,), 0.05, jnp.float32)
+    out = vivaldi.update(
+        CFG, s, other.vec, other.height, other.error, other.adjustment, rtt, key
+    )
+    assert out.vec.shape == (16, CFG.dimensionality)
+    assert out.adj_idx.shape == (16,)
+    assert np.all(np.asarray(out.adj_idx) == 1)
