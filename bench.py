@@ -1,0 +1,81 @@
+"""Benchmark: gossip throughput + convergence on one chip.
+
+Prints ONE JSON line:
+  {"metric": "gossip-rounds/sec/chip", "value": N, "unit": "rounds/s",
+   "vs_baseline": R, ...extras}
+
+The scenario is the framework's north-star workload (BASELINE.md): a
+formed LAN cluster, a mass failure injected, SWIM + Lifeguard + gossip +
+push-pull converging every surviving view, Vivaldi coordinates learning
+the ground-truth latency map throughout.
+
+``vs_baseline``: the reference publishes no gossip-throughput numbers
+(BASELINE.json ``published: {}``), so the baseline is the protocol's
+real-time cadence — a real memberlist cluster advances one gossip round
+per 200 ms (5 rounds/s, reference memberlist/config.go:252). The value
+is therefore the per-chip simulation speed-up over real time.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    n = int(os.environ.get("BENCH_N", "4096"))
+    kill_frac = float(os.environ.get("BENCH_KILL_FRAC", "0.05"))
+
+    import jax
+
+    # BENCH_PLATFORM=cpu runs the benchmark without the TPU (for local
+    # validation). Note this environment pins jax_platforms via
+    # jax.config in sitecustomize, so the env var must be applied here.
+    platform = os.environ.get("BENCH_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
+    import jax.numpy as jnp
+
+    from consul_tpu.config import SimConfig
+    from consul_tpu.models.cluster import Simulation
+
+    t_setup = time.perf_counter()
+    cfg = SimConfig(n=n)
+    sim = Simulation(cfg, seed=0)
+
+    # Throughput: pure simulation rate, no host round-trips.
+    rounds_per_s = sim.throughput(ticks=512, warmup=64)
+
+    # Convergence: kill a block of nodes, run until every surviving
+    # view agrees with ground truth.
+    n_kill = int(n * kill_frac)
+    sim.kill(jnp.arange(n) < n_kill)
+    t0 = time.perf_counter()
+    converged, ticks_used, trace = sim.run_until_converged(
+        max_ticks=2048, chunk=256
+    )
+    wall_s = time.perf_counter() - t0
+    rmse_ms = sim.rmse() * 1000.0
+
+    sim_seconds = ticks_used * cfg.gossip.tick_ms / 1000.0
+    result = {
+        "metric": "gossip-rounds/sec/chip",
+        "value": round(rounds_per_s, 1),
+        "unit": "rounds/s",
+        # Speed-up over the protocol's real-time cadence (5 rounds/s).
+        "vs_baseline": round(rounds_per_s / 5.0, 1),
+        "n_nodes": n,
+        "converged": bool(converged),
+        "kill_frac": kill_frac,
+        "detect_converge_wall_s": round(wall_s, 2),
+        "detect_converge_sim_s": round(sim_seconds, 1),
+        "vivaldi_rmse_ms": round(rmse_ms, 3),
+        "device": str(jax.devices()[0].platform),
+        "total_wall_s": round(time.perf_counter() - t_setup, 1),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

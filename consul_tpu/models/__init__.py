@@ -1,0 +1,1 @@
+"""Simulation state machines: SWIM membership, serf layer, cluster drivers."""

@@ -1,0 +1,151 @@
+"""Cluster simulation driver: scan-compiled runs + convergence detection.
+
+The host-side equivalent of the reference's test harness idioms — boot an
+in-process cluster, inject faults, poll until convergence with a deadline
+(reference sdk/testutil/retry/retry.go:89-166, testrpc/wait.go:14-62) —
+except the "cluster" is one jitted ``lax.scan`` over the SWIM step and
+polling is a device-side metrics trace.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.config import SimConfig
+from consul_tpu.models import state as sim_state
+from consul_tpu.models import swim
+from consul_tpu.ops import topology
+from consul_tpu.utils import metrics
+
+
+class TickTrace(NamedTuple):
+    """Per-tick metrics emitted by a scan chunk (host-fetched once per
+    chunk — batched device->host transfer, the coordinate-batching
+    precedent of reference agent/consul/coordinate_endpoint.go:42-53)."""
+
+    agreement: jax.Array       # [C] f32
+    false_positive: jax.Array  # [C] f32
+    undetected: jax.Array      # [C] f32
+    rmse: jax.Array            # [C] f32
+
+
+def _chunk_runner(cfg: SimConfig, nbrs, world, chunk: int, with_metrics: bool):
+    def body(state, tick_key):
+        state = swim.step(cfg, nbrs, world, state, tick_key)
+        if not with_metrics:
+            return state, ()
+        h = metrics.health(cfg, nbrs, state)
+        rmse = metrics.vivaldi_rmse(
+            cfg, world, state, jax.random.fold_in(tick_key, 1), samples=2048
+        )
+        return state, TickTrace(h.agreement, h.false_positive, h.undetected, rmse)
+
+    def run(state, base_key):
+        ticks = state.t + jnp.arange(chunk)
+        tick_keys = jax.vmap(lambda t: jax.random.fold_in(base_key, t))(ticks)
+        return jax.lax.scan(body, state, tick_keys)
+
+    return jax.jit(run, donate_argnums=(0,))
+
+
+@dataclasses.dataclass
+class Simulation:
+    """Owns the world, topology, and device state for one simulated DC."""
+
+    cfg: SimConfig
+    seed: int = 0
+
+    def __post_init__(self):
+        key = jax.random.PRNGKey(self.seed)
+        kw, kn, ks, kb = jax.random.split(key, 4)
+        self.world = topology.make_world(self.cfg, kw)
+        self.nbrs = topology.make_neighbors(self.cfg, kn)
+        self.state = sim_state.init(self.cfg, ks)
+        self.base_key = kb
+        self._runners = {}
+
+    # -- fault injection ------------------------------------------------
+    def kill(self, mask):
+        self.state = sim_state.kill(self.state, jnp.asarray(mask))
+
+    def revive(self, mask):
+        self.state = sim_state.revive(self.cfg, self.state, jnp.asarray(mask))
+
+    # -- execution ------------------------------------------------------
+    def _runner(self, chunk: int, with_metrics: bool):
+        k = (chunk, with_metrics)
+        if k not in self._runners:
+            self._runners[k] = _chunk_runner(
+                self.cfg, self.nbrs, self.world, chunk, with_metrics
+            )
+        return self._runners[k]
+
+    def run(self, ticks: int, chunk: int = 64, with_metrics: bool = True):
+        """Advance ``ticks`` ticks; returns the concatenated TickTrace
+        (or None when metrics are disabled for pure-throughput runs)."""
+        traces = []
+        remaining = ticks
+        while remaining > 0:
+            c = min(chunk, remaining)
+            self.state, trace = self._runner(c, with_metrics)(self.state, self.base_key)
+            if with_metrics:
+                traces.append(jax.tree.map(lambda x: x[:c], trace))
+            remaining -= c
+        if not with_metrics:
+            return None
+        return jax.tree.map(lambda *xs: jnp.concatenate(xs), *traces)
+
+    def run_until_converged(
+        self,
+        max_ticks: int,
+        chunk: int = 64,
+        rmse_target_s: Optional[float] = None,
+        require_agreement: float = 1.0,
+        stable_chunks: int = 1,
+    ):
+        """Run until membership agreement (and optionally Vivaldi RMSE)
+        hold for ``stable_chunks`` consecutive chunks. Returns
+        (converged: bool, ticks_used: int, last_trace).
+
+        The retry.Run-with-deadline idiom of the reference test suite.
+        """
+        used = 0
+        streak = 0
+        trace = None
+        while used < max_ticks:
+            c = min(chunk, max_ticks - used)
+            self.state, trace = self._runner(c, True)(self.state, self.base_key)
+            used += c
+            ok = float(trace.agreement[-1]) >= require_agreement
+            if ok and rmse_target_s is not None:
+                ok = float(trace.rmse[-1]) <= rmse_target_s
+            streak = streak + 1 if ok else 0
+            if streak >= stable_chunks:
+                return True, used, trace
+        return False, used, trace
+
+    def throughput(self, ticks: int = 256, warmup: int = 64) -> float:
+        """Measured gossip rounds (ticks) per wall-clock second."""
+        runner = self._runner(ticks, False)
+        warm = self._runner(warmup, False)
+        self.state, _ = warm(self.state, self.base_key)
+        jax.block_until_ready(self.state.view_key)
+        t0 = time.perf_counter()
+        self.state, _ = runner(self.state, self.base_key)
+        jax.block_until_ready(self.state.view_key)
+        return ticks / (time.perf_counter() - t0)
+
+    # -- inspection -----------------------------------------------------
+    def health(self) -> metrics.HealthMetrics:
+        return metrics.health(self.cfg, self.nbrs, self.state)
+
+    def rmse(self, seed: int = 99) -> float:
+        return float(
+            metrics.vivaldi_rmse(self.cfg, self.world, self.state, jax.random.PRNGKey(seed))
+        )

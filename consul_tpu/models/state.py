@@ -1,0 +1,146 @@
+"""SimState: the whole simulated cluster as one struct-of-arrays pytree.
+
+Every Go-side per-node data structure of the reference becomes an array
+over the node axis N (shardable across chips), and every goroutine/timer
+becomes a deadline array compared against the global tick counter:
+
+  reference structure                          -> array here
+  ------------------------------------------------------------------
+  nodeState map (memberlist/state.go)          -> view_key[N, K] packed
+                                                  (incarnation, status)
+  per-node probe ticker + shuffled node list   -> next_probe_tick[N],
+    (state.go:83-121, :492-513)                   probe_perm[N, K], probe_ptr[N]
+  outstanding probe + ack handler channels     -> pending_target[N],
+    (state.go:262-457, :759-790)                  pending_fail_tick[N]
+  suspicion time.AfterFunc timers + per-from   -> susp_start[N, K],
+    confirmation map (suspicion.go)               susp_seen[N, K] (32-bucket
+                                                  accuser hash bitmask)
+  TransmitLimitedQueue btree (queue.go)        -> q_subject/q_key/q_from/
+                                                  q_tx[N, B] fixed slots
+  awareness score (awareness.go)               -> awareness[N]
+  Vivaldi client + per-peer latency filter     -> viv (VivaldiState[N]),
+    (coordinate/client.go)                        lat_buf[N, K, S], lat_cnt[N, K]
+  node's own incarnation (state.go:840-864)    -> own_inc[N]
+
+``alive_truth``/``left`` are the fault-injection ground truth: whether
+the simulated process is actually up (the thing SWIM is trying to
+detect), not anyone's belief.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.config import SimConfig
+from consul_tpu.ops import merge, vivaldi
+
+
+class SimState(NamedTuple):
+    t: jax.Array              # [] int32, global tick counter
+    # -- ground truth (fault injection) -------------------------------
+    alive_truth: jax.Array    # [N] bool — process actually up
+    left: jax.Array           # [N] bool — gracefully departed
+    # -- own per-node protocol state ----------------------------------
+    own_inc: jax.Array        # [N] uint32
+    awareness: jax.Array      # [N] int32, 0..awareness_max-1
+    # -- probe scheduler ----------------------------------------------
+    probe_perm: jax.Array     # [N, K] int32, per-node shuffled probe order
+    probe_ptr: jax.Array      # [N] int32, cursor into probe_perm
+    next_probe_tick: jax.Array  # [N] int32
+    pending_target: jax.Array   # [N] int32 global id, -1 = no outstanding probe
+    pending_fail_tick: jax.Array  # [N] int32, when the probe window closes
+    # -- membership views ---------------------------------------------
+    view_key: jax.Array       # [N, K] uint32 packed (incarnation, status)
+    susp_start: jax.Array     # [N, K] int32, tick suspicion began, -1 = none
+    susp_seen: jax.Array      # [N, K] uint32, accuser-hash bitmask
+    # -- gossip broadcast queue ---------------------------------------
+    q_subject: jax.Array      # [N, B] int32, -1 = empty slot
+    q_key: jax.Array          # [N, B] uint32
+    q_from: jax.Array         # [N, B] int32 original accuser/source
+    q_tx: jax.Array           # [N, B] int32 transmits remaining
+    # -- Vivaldi ------------------------------------------------------
+    viv: vivaldi.VivaldiState  # batched [N]
+    lat_buf: jax.Array        # [N, K, S] float32 per-peer RTT samples
+    lat_cnt: jax.Array        # [N, K] int32 samples pushed
+
+
+def init(cfg: SimConfig, key) -> SimState:
+    """A formed cluster at steady state: every node knows every neighbor
+    as alive at incarnation 1, coordinates at the origin, queues empty.
+
+    (The reference reaches this state through the join/push-pull storm;
+    the join process itself is exercised separately via fault injection —
+    reviving killed ranges — and the serf intent layer.)
+    """
+    n, k_deg, b = cfg.n, cfg.degree, cfg.gossip.queue_slots
+    k_perm, k_stagger = jax.random.split(key)
+    # Per-node shuffled probe order over neighbor columns
+    # (reference shuffles the node list per wrap, state.go:492-513).
+    perm = jax.vmap(lambda k2: jax.random.permutation(k2, k_deg))(
+        jax.random.split(k_perm, n)
+    ).astype(jnp.int32)
+    probe_period = cfg.gossip.probe_period_ticks
+    return SimState(
+        t=jnp.int32(0),
+        alive_truth=jnp.ones((n,), bool),
+        left=jnp.zeros((n,), bool),
+        own_inc=jnp.ones((n,), jnp.uint32),
+        awareness=jnp.zeros((n,), jnp.int32),
+        probe_perm=perm,
+        probe_ptr=jnp.zeros((n,), jnp.int32),
+        # Random stagger keeps probes desynchronized, like the
+        # reference's randomized ticker start (state.go:104-121).
+        next_probe_tick=jax.random.randint(
+            k_stagger, (n,), 0, probe_period, jnp.int32
+        ),
+        pending_target=jnp.full((n,), -1, jnp.int32),
+        pending_fail_tick=jnp.zeros((n,), jnp.int32),
+        view_key=jnp.full((n, k_deg), int(merge.make_key(1, merge.ALIVE)), jnp.uint32),
+        susp_start=jnp.full((n, k_deg), -1, jnp.int32),
+        susp_seen=jnp.zeros((n, k_deg), jnp.uint32),
+        q_subject=jnp.full((n, b), -1, jnp.int32),
+        q_key=jnp.zeros((n, b), jnp.uint32),
+        q_from=jnp.full((n, b), -1, jnp.int32),
+        q_tx=jnp.zeros((n, b), jnp.int32),
+        viv=vivaldi.new(cfg.vivaldi, batch_shape=(n,)),
+        lat_buf=jnp.zeros((n, k_deg, cfg.vivaldi.latency_filter_size), jnp.float32),
+        lat_cnt=jnp.zeros((n, k_deg), jnp.int32),
+    )
+
+
+def kill(state: SimState, mask) -> SimState:
+    """Fault injection: hard-kill the masked nodes (they stop probing,
+    acking, and gossiping; their entries elsewhere decay via SWIM)."""
+    return state._replace(alive_truth=state.alive_truth & ~mask)
+
+
+def revive(cfg: SimConfig, state: SimState, mask) -> SimState:
+    """Fault injection: restart the masked nodes with a bumped
+    incarnation. Like a restarted agent's join (reference
+    memberlist.Create setAlive -> aliveNode bootstrap broadcast,
+    memberlist.go:206-228), the node announces itself by queueing an
+    alive broadcast at its new incarnation — without it, peers that
+    believe the node dead would never probe it again.
+    """
+    from consul_tpu.ops import scaling  # local import to avoid cycle
+
+    n = cfg.n
+    own_inc = jnp.where(mask, state.own_inc + 1, state.own_inc).astype(jnp.uint32)
+    rows = jnp.arange(n, dtype=jnp.int32)
+    slot0 = jnp.zeros_like(state.q_subject[..., 0], jnp.int32)[..., None] == jnp.arange(
+        state.q_subject.shape[-1], dtype=jnp.int32
+    )
+    write = mask[..., None] & slot0
+    with jax.ensure_compile_time_eval():
+        tx0 = int(scaling.retransmit_limit(cfg.gossip.retransmit_mult, n))
+    return state._replace(
+        alive_truth=state.alive_truth | mask,
+        own_inc=own_inc,
+        q_subject=jnp.where(write, rows[..., None], state.q_subject),
+        q_key=jnp.where(write, merge.make_key(own_inc, merge.ALIVE)[..., None], state.q_key),
+        q_from=jnp.where(write, rows[..., None], state.q_from),
+        q_tx=jnp.where(write, tx0, state.q_tx),
+    )

@@ -1,0 +1,567 @@
+"""The vectorized SWIM + Lifeguard step function.
+
+One call to :func:`step` advances every simulated node by one tick
+(default 200 ms of protocol time — the LAN gossip interval). Where the
+reference runs a goroutine per node with tickers and callback timers
+(reference memberlist/state.go:83-121 schedule, suspicion.go timers),
+this is a single pure function over struct-of-arrays, so XLA fuses the
+whole protocol round into a few kernels and the node axis shards across
+chips.
+
+Tick anatomy (mirroring one round of the reference's event loop):
+
+  1. **Suspicion expiry** — per-edge Lifeguard deadline check
+     (remainingSuspicionTime, suspicion.go:86-97); expired suspects are
+     declared dead locally (state.go:1141-1156) and the loudest few are
+     broadcast.
+  2. **Probe resolution** — probe windows that close this tick with no
+     ack mark the target suspect and broadcast (state.go:437-456).
+  3. **Probe launch** — nodes whose probe ticker fires pick the next
+     non-dead target in their shuffled order (state.go:193-235), send a
+     ping; a direct ack within the timeout feeds Vivaldi with the RTT
+     and the peer's coordinate payload (ping_delegate semantics,
+     state.go:342-347); otherwise indirect probes through k relays and
+     a TCP fallback are modeled (state.go:366-435), and total failure
+     opens a pending suspicion window.
+  4. **Gossip** — each live node piggybacks its queued broadcasts to
+     ``gossip_nodes`` random peers (state.go:517-567, net.go:631);
+     deliveries merge into receiver views via the (incarnation, status)
+     join semilattice; newly-learned facts are re-queued (the epidemic),
+     suspect messages about already-suspect entries register Lifeguard
+     confirmations (suspicion.go:103-129), and messages about the
+     receiver itself trigger refutation (state.go:840-864).
+  5. **Push-pull anti-entropy** — nodes on their staggered cadence pick
+     a random live peer and exchange full views both ways, with remote
+     dead claims demoted to suspicion (state.go:573-608, :1217-1240).
+  6. **Suspicion bookkeeping** — one reconciliation pass derives timer
+     starts/resets from the view delta of this tick.
+
+Documented vectorization divergences from the reference (each argued in
+SURVEY.md §7 "hard parts"): random gossip-peer sampling is
+with-replacement within a tick (vs rejection-sampled distinct peers,
+util.go:125-153); at most one Lifeguard confirmation bit registers per
+entry per tick (later gossip rounds deliver the rest); mass
+simultaneous expiries all apply locally but only the two most-overdue
+broadcast per node per tick; packet-size packing of the 1400-byte UDP
+budget is modeled by the ``piggyback_msgs`` cap, not enforced by bytes;
+gossip-to-the-dead is not modeled (dead processes cannot receive in the
+simulation's ground truth).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.config import SimConfig
+from consul_tpu.models.state import SimState
+from consul_tpu.ops import merge, scaling, topology, vivaldi
+from consul_tpu.ops.topology import World
+
+_NEG = jnp.int32(-1)
+
+
+def _statuses(view_key):
+    return merge.key_status(view_key)
+
+
+def _accuser_bit(node_id):
+    """32-bucket hash bitmask bit for a confirming accuser (dedup
+    approximation of the reference's per-from confirmation map,
+    suspicion.go:42-59; collisions undercount, which only lengthens
+    the timeout — the safe direction)."""
+    return (jnp.uint32(1) << (jnp.asarray(node_id, jnp.uint32) % 32)).astype(jnp.uint32)
+
+
+def _queue_push(cfg: SimConfig, state: SimState, mask, subject, key, src, tx0):
+    """Insert one broadcast per masked node into its transmit queue.
+
+    Slot choice mirrors TransmitLimitedQueue semantics (reference
+    memberlist/queue.go:182-242): a message about the same subject
+    invalidates/replaces the old one; otherwise take an empty slot;
+    otherwise evict the most-transmitted (lowest remaining) message.
+    """
+    b = cfg.gossip.queue_slots
+    same = state.q_subject == subject[:, None]
+    empty = (state.q_subject < 0) | (state.q_tx <= 0)
+    # Higher score wins the argmax slot choice.
+    score = (
+        jnp.where(same, 3_000_000, 0)
+        + jnp.where(empty, 2_000_000, 0)
+        + (1_000_000 - jnp.minimum(state.q_tx, 999_999))
+    )
+    slot = jnp.argmax(score, axis=1)
+    onehot = (jnp.arange(b, dtype=jnp.int32)[None, :] == slot[:, None]) & mask[:, None]
+    return state._replace(
+        q_subject=jnp.where(onehot, subject[:, None], state.q_subject),
+        q_key=jnp.where(onehot, key[:, None], state.q_key),
+        q_from=jnp.where(onehot, src[:, None], state.q_from),
+        q_tx=jnp.where(onehot, tx0, state.q_tx),
+    )
+
+
+def step(cfg: SimConfig, nbrs: jax.Array, world: World, state: SimState, key) -> SimState:
+    """Advance the whole cluster by one tick. Pure; jit/shard-map safe."""
+    n, k_deg = cfg.n, cfg.degree
+    g = cfg.gossip
+    t = state.t
+    rows = jnp.arange(n, dtype=jnp.int32)
+    keys = jax.random.split(key, 9)
+
+    view0 = state.view_key  # snapshot for end-of-tick suspicion bookkeeping
+    active = state.alive_truth & ~state.left
+
+    # Static protocol scalars (cluster-size scaling laws); evaluated at
+    # trace time — they depend only on the static cluster size.
+    with jax.ensure_compile_time_eval():
+        tx_limit = int(scaling.retransmit_limit(g.retransmit_mult, n))
+        susp_min = float(
+            scaling.suspicion_timeout(g.suspicion_mult, n, g.probe_period_ticks)
+        )
+        susp_max = g.suspicion_max_timeout_mult * susp_min
+        susp_k = int(scaling.suspicion_k(g.suspicion_mult, n))
+        pp_period = g.push_pull_period_ticks(n)
+
+    # ------------------------------------------------------------------
+    # 1. Suspicion expiry: per-edge deadline check.
+    # ------------------------------------------------------------------
+    statuses = _statuses(state.view_key)
+    is_suspect = (statuses == merge.SUSPECT) & (state.susp_start >= 0)
+    confirms = jnp.maximum(
+        _popcount(state.susp_seen).astype(jnp.int32) - 1, 0
+    )  # the original accuser is excluded (suspicion.go:58-59)
+    elapsed = (t - state.susp_start).astype(jnp.float32)
+    remaining = scaling.remaining_suspicion_time(
+        confirms, susp_k, elapsed, susp_min, susp_max
+    )
+    expired = is_suspect & (remaining <= 0.0) & active[:, None]
+    dead_key = merge.make_key(merge.key_incarnation(state.view_key), merge.DEAD)
+    view = jnp.where(expired, dead_key, state.view_key)
+    state = state._replace(view_key=view)
+
+    # Broadcast the two most-overdue expiries per node (the rest still
+    # applied locally above; peers' own timers + push-pull cover them).
+    overdue_rank = jnp.where(expired, remaining, jnp.inf)
+    for pick in range(2):
+        col = jnp.argmin(overdue_rank, axis=1).astype(jnp.int32)
+        has = jnp.take_along_axis(expired, col[:, None], axis=1)[:, 0] & active
+        subj = jnp.take_along_axis(nbrs, col[:, None], axis=1)[:, 0]
+        bkey = jnp.take_along_axis(dead_key, col[:, None], axis=1)[:, 0]
+        state = _queue_push(cfg, state, has, subj, bkey, rows, tx_limit)
+        overdue_rank = jnp.where(
+            jnp.arange(k_deg)[None, :] == col[:, None], jnp.inf, overdue_rank
+        )
+
+    # ------------------------------------------------------------------
+    # 2. Probe windows closing this tick with no ack -> suspect target.
+    # ------------------------------------------------------------------
+    failing = (state.pending_target >= 0) & (t >= state.pending_fail_tick) & active
+    ftarget = jnp.where(failing, state.pending_target, 0)
+    fcol = topology.subject_to_col(cfg, nbrs, rows, ftarget)
+    fvalid = failing & (fcol >= 0)
+    fcol_c = jnp.where(fvalid, fcol, 0)
+    fentry = jnp.take_along_axis(state.view_key, fcol_c[:, None], axis=1)[:, 0]
+    # suspectNode applies to alive entries at the known incarnation
+    # (state.go:1086-1122); for already-suspect entries the join is a
+    # no-op and only the accuser bit below registers (a confirmation).
+    fsus_key = merge.make_key(merge.key_incarnation(fentry), merge.SUSPECT)
+    fnew = merge.join(fentry, jnp.where(fvalid, fsus_key, jnp.uint32(0)))
+    view = _scatter_row_col_max(state.view_key, rows, fcol_c, jnp.where(fvalid, fnew, 0))
+    # The prober registers itself as an accuser: on an already-suspect
+    # entry this is a Lifeguard confirmation (timer.Confirm in
+    # suspectNode, state.go:1094-1099); on a fresh one the bookkeeping
+    # pass seeds the timer from it.
+    fail_oh = (jnp.arange(k_deg, dtype=jnp.int32)[None, :] == fcol_c[:, None]) & fvalid[:, None]
+    susp_seen = state.susp_seen | jnp.where(fail_oh, _accuser_bit(rows)[:, None], 0)
+    state = state._replace(
+        view_key=view,
+        susp_seen=susp_seen,
+        pending_target=jnp.where(failing, _NEG, state.pending_target),
+    )
+    state = _queue_push(cfg, state, fvalid, ftarget, fsus_key, rows, tx_limit)
+    # Failed probe cycle degrades local health (awareness.go; simplified
+    # from the nack-counting form, state.go:437-451).
+    awareness = jnp.clip(
+        state.awareness + jnp.where(failing, 1, 0), 0, g.awareness_max - 1
+    )
+    state = state._replace(awareness=awareness)
+
+    # ------------------------------------------------------------------
+    # 3. Probe launch.
+    # ------------------------------------------------------------------
+    probing = active & (t >= state.next_probe_tick)
+    statuses = _statuses(state.view_key)
+    # Next non-dead target in the shuffled order, looking ahead up to 3
+    # (the reference's skip loop, state.go:196-231).
+    cand_off = jnp.arange(3, dtype=jnp.int32)
+    cand_pos = (state.probe_ptr[:, None] + cand_off[None, :]) % k_deg
+    cand_col = jnp.take_along_axis(state.probe_perm, cand_pos, axis=1)
+    cand_status = jnp.take_along_axis(statuses, cand_col, axis=1)
+    cand_ok = (cand_status == merge.ALIVE) | (cand_status == merge.SUSPECT)
+    has_target = jnp.any(cand_ok, axis=1) & probing
+    first_ok = jnp.argmax(cand_ok, axis=1).astype(jnp.int32)
+    target_col = jnp.take_along_axis(cand_col, first_ok[:, None], axis=1)[:, 0]
+    target = jnp.take_along_axis(nbrs, target_col[:, None], axis=1)[:, 0]
+    advance = jnp.where(probing, jnp.where(has_target, first_ok + 1, 3), 0)
+
+    target_up = state.alive_truth[target] & ~state.left[target]
+    rtt_obs = topology.sample_rtt(cfg, world, rows, target, keys[0])
+    timeout_s = g.probe_timeout_ms / 1000.0
+    loss = jax.random.uniform(keys[1], (n, 5)) < cfg.packet_loss  # 5 legs modeled
+    direct_ok = has_target & target_up & (rtt_obs <= timeout_s) & ~loss[:, 0]
+    # Indirect probes via k random live relays + TCP fallback
+    # (state.go:366-435): with iid loss both directions per relay.
+    relay_col = jax.random.randint(keys[2], (n, g.indirect_checks), 0, k_deg)
+    relay = jnp.take_along_axis(nbrs, relay_col, axis=1)
+    relay_ok = (
+        state.alive_truth[relay]
+        & ~(jax.random.uniform(keys[3], relay.shape) < cfg.packet_loss)
+        & ~(jax.random.uniform(keys[4], relay.shape) < cfg.packet_loss)
+    )
+    indirect_ok = has_target & target_up & jnp.any(relay_ok, axis=1) & ~direct_ok
+    tcp_ok = has_target & target_up & ~loss[:, 1]
+    acked = direct_ok | indirect_ok | tcp_ok
+
+    # A ping to a suspect target carries a suspect message so it can
+    # refute immediately (compound ping+suspect, state.go:306-331).
+    target_status = jnp.take_along_axis(statuses, target_col[:, None], axis=1)[:, 0]
+    target_inc = merge.key_incarnation(
+        jnp.take_along_axis(state.view_key, target_col[:, None], axis=1)[:, 0]
+    )
+    poke_suspect = has_target & (target_status == merge.SUSPECT) & target_up & ~loss[:, 2]
+
+    # Probe bookkeeping: window for failures, ticker reschedule scaled
+    # by local health (awareness.ScaleTimeout, state.go:268).
+    pending_target = jnp.where(has_target & ~acked, target, state.pending_target)
+    pending_fail_tick = jnp.where(
+        has_target & ~acked, t + g.probe_period_ticks, state.pending_fail_tick
+    )
+    interval = g.probe_period_ticks * (state.awareness + 1)
+    next_probe = jnp.where(probing, t + interval, state.next_probe_tick)
+    awareness = jnp.clip(
+        state.awareness - jnp.where(acked, 1, 0), 0, g.awareness_max - 1
+    )
+    ptr = state.probe_ptr + advance
+    # Global reshuffle when the slowest cursor wraps (approximates the
+    # per-wrap shuffle of state.go:492-513).
+    wrapped = ptr >= k_deg
+    perm = jax.lax.cond(
+        jnp.any(wrapped),
+        lambda p: jax.vmap(jax.random.permutation, in_axes=(0, None))(
+            jax.random.split(keys[5], n), k_deg
+        ).astype(jnp.int32),
+        lambda p: p,
+        state.probe_perm,
+    )
+    probe_perm = jnp.where(wrapped[:, None], perm, state.probe_perm)
+    state = state._replace(
+        probe_ptr=jnp.where(wrapped, 0, ptr),
+        probe_perm=probe_perm,
+        next_probe_tick=next_probe,
+        pending_target=pending_target,
+        pending_fail_tick=pending_fail_tick,
+        awareness=awareness,
+    )
+
+    # Direct ack feeds Vivaldi: RTT through the per-peer median filter,
+    # peer coordinate as the ack payload (ping_delegate.go:28-90).
+    state = _vivaldi_observe(cfg, state, direct_ok, target, target_col, rtt_obs, keys[6])
+
+    # ------------------------------------------------------------------
+    # 4. Gossip fan-out and delivery.
+    # ------------------------------------------------------------------
+    state, refute_inc_gossip = _gossip_phase(
+        cfg, nbrs, state, active, poke_suspect, target, target_inc, tx_limit, keys[7]
+    )
+
+    # ------------------------------------------------------------------
+    # 5. Push-pull anti-entropy.
+    # ------------------------------------------------------------------
+    state, refute_inc_pp = _push_pull_phase(cfg, nbrs, state, active, pp_period, keys[8])
+
+    # ------------------------------------------------------------------
+    # Refutation: bump own incarnation past any accusation and broadcast
+    # alive (state.go:840-864). Costs health (awareness +1).
+    # ------------------------------------------------------------------
+    claim = jnp.maximum(refute_inc_gossip, refute_inc_pp)
+    refuting = (claim > 0) & active
+    own_inc = jnp.where(refuting, claim + 1, state.own_inc).astype(jnp.uint32)
+    state = state._replace(
+        own_inc=own_inc,
+        awareness=jnp.clip(
+            state.awareness + jnp.where(refuting, 1, 0), 0, g.awareness_max - 1
+        ),
+    )
+    state = _queue_push(
+        cfg, state, refuting, rows, merge.make_key(own_inc, merge.ALIVE), rows, tx_limit
+    )
+
+    # ------------------------------------------------------------------
+    # 6. Suspicion bookkeeping from this tick's view delta.
+    # ------------------------------------------------------------------
+    state = _reconcile_suspicion(state, view0, t)
+
+    return state._replace(t=t + 1)
+
+
+def _popcount(x):
+    return jax.lax.population_count(jnp.asarray(x, jnp.uint32))
+
+
+def _scatter_row_col_max(view, row_idx, col_idx, key_vals):
+    """view[row, col] = max(view[row, col], key) for one (col, key) per row."""
+    flat = view.reshape(-1)
+    idx = row_idx * view.shape[1] + col_idx
+    return flat.at[idx].max(key_vals).reshape(view.shape)
+
+
+def _vivaldi_observe(cfg, state: SimState, ok, peer, peer_col, rtt, key):
+    """Apply one probe-RTT observation per masked node (median filter +
+    full Vivaldi update against the peer's coordinate)."""
+    s = cfg.vivaldi.latency_filter_size
+    k_deg = cfg.degree
+    # Push the sample into the per-(node, peer) ring buffer where ok.
+    cnt = jnp.take_along_axis(state.lat_cnt, peer_col[:, None], axis=1)[:, 0]
+    slot = cnt % s
+    col_oh = jnp.arange(k_deg, dtype=jnp.int32)[None, :] == peer_col[:, None]
+    slot_oh = jnp.arange(s, dtype=jnp.int32)[None, :] == slot[:, None]
+    write = ok[:, None, None] & col_oh[:, :, None] & slot_oh[:, None, :]
+    lat_buf = jnp.where(write, rtt[:, None, None], state.lat_buf)
+    lat_cnt = jnp.where(ok[:, None] & col_oh, state.lat_cnt + 1, state.lat_cnt)
+    # Median over the filled window (client.go:123-141 semantics).
+    filled = jnp.minimum(jnp.where(ok, cnt + 1, 1), s)
+    row_buf = jnp.take_along_axis(
+        lat_buf, jnp.where(ok, peer_col, 0)[:, None, None].repeat(s, axis=2), axis=1
+    )[:, 0, :]
+    padded = jnp.where(jnp.arange(s)[None, :] < filled[:, None], row_buf, jnp.inf)
+    med = jnp.take_along_axis(
+        jnp.sort(padded, axis=1), (filled // 2)[:, None], axis=1
+    )[:, 0]
+    # Vivaldi update; rejected (rtt=-1) rows pass through untouched.
+    viv = state.viv
+    new_viv = vivaldi.update(
+        cfg.vivaldi,
+        viv,
+        viv.vec[peer],
+        viv.height[peer],
+        viv.error[peer],
+        viv.adjustment[peer],
+        jnp.where(ok, med, -1.0),
+        key,
+    )
+    return state._replace(viv=new_viv, lat_buf=lat_buf, lat_cnt=lat_cnt)
+
+
+def _gossip_phase(cfg, nbrs, state: SimState, active, poke_suspect, poke_target,
+                  poke_inc, tx_limit, key):
+    """Queue fan-out, delivery, view merge, rebroadcast, confirmations,
+    and refute-claim collection. Returns (state, refute_inc[N])."""
+    g = cfg.gossip
+    n, k_deg, b = cfg.n, cfg.degree, g.queue_slots
+    p, fan = g.piggyback_msgs, g.gossip_nodes
+    rows = jnp.arange(n, dtype=jnp.int32)
+    k_peer, k_loss = jax.random.split(key)
+
+    # Select the P most-retransmittable queue slots per node (the btree
+    # order: fewest past transmits first, queue.go:288-373).
+    order = jnp.argsort(-state.q_tx, axis=1)[:, :p]
+    m_subject = jnp.take_along_axis(state.q_subject, order, axis=1)
+    m_key = jnp.take_along_axis(state.q_key, order, axis=1)
+    m_from = jnp.take_along_axis(state.q_from, order, axis=1)
+    m_tx = jnp.take_along_axis(state.q_tx, order, axis=1)
+    m_valid = (m_subject >= 0) & (m_tx > 0) & active[:, None]
+
+    # Gossip peers: fan random neighbor columns whose view state is
+    # alive or suspect (kRandomNodes filter, state.go:521-535).
+    peer_col = jax.random.randint(k_peer, (n, fan), 0, k_deg)
+    peer = jnp.take_along_axis(nbrs, peer_col, axis=1)
+    peer_status = jnp.take_along_axis(_statuses(state.view_key), peer_col, axis=1)
+    peer_ok = (
+        ((peer_status == merge.ALIVE) | (peer_status == merge.SUSPECT))
+        & active[:, None]
+    )
+
+    # Flatten to M = N * fan * P messages (+ N compound ping-suspect pokes).
+    dst = jnp.repeat(peer[:, :, None], p, axis=2).reshape(-1)
+    subj = jnp.repeat(m_subject[:, None, :], fan, axis=1).reshape(-1)
+    mkey = jnp.repeat(m_key[:, None, :], fan, axis=1).reshape(-1)
+    mfrom = jnp.repeat(m_from[:, None, :], fan, axis=1).reshape(-1)
+    mok = (
+        jnp.repeat(peer_ok[:, :, None], p, axis=2)
+        & jnp.repeat(m_valid[:, None, :], fan, axis=1)
+    ).reshape(-1)
+    # The self-addressed suspect tacked onto pings of suspect targets.
+    dst = jnp.concatenate([dst, poke_target])
+    subj = jnp.concatenate([subj, poke_target])
+    mkey = jnp.concatenate([mkey, merge.make_key(poke_inc, merge.SUSPECT)])
+    mfrom = jnp.concatenate([mfrom, rows])
+    mok = jnp.concatenate([mok, poke_suspect])
+
+    drop = jax.random.uniform(k_loss, dst.shape) < cfg.packet_loss
+    mok = mok & ~drop & state.alive_truth[dst] & ~state.left[dst]
+
+    # Decrement transmit budgets by actual sends; retire exhausted slots.
+    sends = jnp.sum(peer_ok, axis=1)[:, None] * jnp.where(m_valid, 1, 0)
+    new_tx_sel = jnp.maximum(m_tx - sends, 0)
+    q_tx = _scatter_cols(state.q_tx, order, new_tx_sel)
+    q_subject = jnp.where(q_tx <= 0, -1, state.q_subject)
+    state = state._replace(q_tx=q_tx, q_subject=q_subject)
+
+    # Deliveries about the receiver itself are refutation fodder
+    # (state.go:1107-1110, :1187-1192), not view merges.
+    to_self = mok & (subj == dst)
+    refutable = to_self & merge.is_refutable(mkey, to_self, state.own_inc[dst])
+    refute_inc = (
+        jnp.zeros((n,), jnp.uint32)
+        .at[dst]
+        .max(jnp.where(refutable, merge.key_incarnation(mkey), 0))
+    )
+
+    # Merge the rest into receiver views (batched scatter-max join).
+    col = topology.subject_to_col(cfg, nbrs, dst, subj)
+    deliver = mok & (col >= 0)
+    col_c = jnp.where(deliver, col, 0)
+    flat_idx = jnp.where(deliver, dst * k_deg + col_c, 0)
+    scatter_key = jnp.where(deliver, mkey, jnp.uint32(0))
+    old_flat = state.view_key.reshape(-1)
+    new_flat = old_flat.at[flat_idx].max(scatter_key)
+    view_new = new_flat.reshape(n, k_deg)
+
+    # Lifeguard confirmations: a suspect message about an entry that is
+    # (still) suspect at that incarnation registers its accuser's hash
+    # bit; at most one new bit lands per entry per tick (divergence note
+    # in the module docstring).
+    post_key = new_flat[flat_idx]
+    confirm = (
+        deliver
+        & (merge.key_status(mkey) == merge.SUSPECT)
+        & (merge.key_status(post_key) == merge.SUSPECT)
+        & (merge.key_incarnation(mkey) >= merge.key_incarnation(post_key))
+    )
+    bits = jnp.where(confirm, _accuser_bit(mfrom), jnp.uint32(0))
+    tick_bits = (
+        jnp.zeros((n * k_deg,), jnp.uint32).at[flat_idx].max(bits).reshape(n, k_deg)
+    )
+
+    # Rebroadcast the strongest newly-learned fact per receiver
+    # (the epidemic re-queue of NotifyMsg, delegate rebroadcast path).
+    learned = deliver & (mkey > old_flat[flat_idx])
+    win_key = (
+        jnp.zeros((n,), jnp.uint32).at[dst].max(jnp.where(learned, mkey, 0))
+    )
+    is_win = learned & (mkey == win_key[dst]) & (win_key[dst] > 0)
+    midx = jnp.arange(dst.shape[0], dtype=jnp.int32)
+    win_idx = (
+        jnp.full((n,), midx.shape[0], jnp.int32)
+        .at[dst]
+        .min(jnp.where(is_win, midx, midx.shape[0]))
+    )
+    has_win = win_idx < midx.shape[0]
+    win_idx_c = jnp.where(has_win, win_idx, 0)
+    state = state._replace(view_key=view_new, susp_seen=state.susp_seen | tick_bits)
+    state = _queue_push(
+        cfg, state, has_win, subj[win_idx_c], mkey[win_idx_c], mfrom[win_idx_c], tx_limit
+    )
+    return state, refute_inc
+
+
+def _push_pull_phase(cfg, nbrs, state: SimState, active, pp_period, key):
+    """Full-state exchange with one random live partner, both directions
+    (sendAndReceiveState/mergeState, net.go:777-1070, state.go:573-608)."""
+    n, k_deg = cfg.n, cfg.degree
+    rows = jnp.arange(n, dtype=jnp.int32)
+    k_partner = key
+
+    stagger = jax.random.randint(
+        jax.random.PRNGKey(17), (n,), 0, pp_period, jnp.int32
+    )  # fixed per-node phase offset (deterministic across ticks)
+    due = active & ((state.t + stagger) % pp_period == 0)
+
+    pcol = jax.random.randint(k_partner, (n,), 0, k_deg)
+    partner = jnp.take_along_axis(nbrs, pcol[:, None], axis=1)[:, 0]
+    partner_ok = due & state.alive_truth[partner] & ~state.left[partner]
+
+    subjects = nbrs  # [N, K] global ids of my entries
+    # Remote's column for each of my subjects (and mine for theirs).
+    pcols = topology.subject_to_col(
+        cfg, nbrs, partner[:, None] * jnp.ones((1, k_deg), jnp.int32), subjects
+    )
+    valid = partner_ok[:, None] & (pcols >= 0)
+    pcols_c = jnp.where(valid, pcols, 0)
+    remote_entry = state.view_key[
+        jnp.where(partner_ok, partner, 0)[:, None], pcols_c
+    ]
+    # The partner's record of itself is its live own-state.
+    self_key = merge.make_key(state.own_inc, merge.ALIVE)
+    remote_entry = jnp.where(
+        subjects == partner[:, None], self_key[partner][:, None], remote_entry
+    )
+    # Remote dead claims arrive as suspicion (mergeState, state.go:1231-1237).
+    remote_entry = merge.demote_dead_to_suspect(remote_entry)
+    # My own entry in their state: refutation check, not a merge.
+    about_me = subjects == rows[:, None]  # never true (nbrs exclude self)
+
+    pull = jnp.where(valid & ~about_me, remote_entry, jnp.uint32(0))
+    view = merge.join(state.view_key, pull)
+
+    # Push direction: my entries (dead demoted likewise) scatter-join
+    # into the partner's view, plus my own alive record.
+    push_key = merge.demote_dead_to_suspect(state.view_key)
+    flat_idx = jnp.where(valid, partner[:, None] * k_deg + pcols_c, 0)
+    flat_val = jnp.where(valid, push_key, jnp.uint32(0))
+    my_col_at_partner = topology.subject_to_col(cfg, nbrs, partner, rows)
+    me_ok = partner_ok & (my_col_at_partner >= 0)
+    me_idx = jnp.where(me_ok, partner * k_deg + jnp.where(me_ok, my_col_at_partner, 0), 0)
+    view_flat = view.reshape(-1)
+    view_flat = view_flat.at[flat_idx.reshape(-1)].max(flat_val.reshape(-1))
+    view_flat = view_flat.at[me_idx].max(jnp.where(me_ok, self_key, jnp.uint32(0)))
+    view = view_flat.reshape(n, k_deg)
+
+    # Refute claims: the partner's view of ME, from the columns already
+    # resolved for the push direction.
+    their_view_of_me = state.view_key[
+        jnp.where(me_ok, partner, 0), jnp.where(me_ok, my_col_at_partner, 0)
+    ]
+    refut = me_ok & merge.is_refutable(their_view_of_me, me_ok, state.own_inc)
+    refute_inc = jnp.where(refut, merge.key_incarnation(their_view_of_me), 0).astype(
+        jnp.uint32
+    )
+
+    return state._replace(view_key=view), refute_inc
+
+
+def _reconcile_suspicion(state: SimState, view0, t):
+    """Derive suspicion-timer starts/resets from this tick's view delta:
+    entries entering suspect (or re-suspected at a higher incarnation)
+    start a timer now; entries leaving suspect clear it
+    (state.go:1000-1001, :1124-1158, :1178-1179)."""
+    st0, st1 = merge.key_status(view0), merge.key_status(state.view_key)
+    inc0, inc1 = merge.key_incarnation(view0), merge.key_incarnation(state.view_key)
+    now_suspect = st1 == merge.SUSPECT
+    fresh = now_suspect & (st0 != merge.SUSPECT)
+    re_inc = now_suspect & (st0 == merge.SUSPECT) & (inc1 > inc0)
+    restarted = fresh | re_inc
+    susp_start = jnp.where(
+        restarted, t, jnp.where(now_suspect, state.susp_start, -1)
+    )
+    susp_seen = jnp.where(now_suspect, state.susp_seen, jnp.uint32(0))
+    # A re-suspicion at a higher incarnation is a NEW timer: the old
+    # incarnation's accuser bits must not accelerate it (they may be
+    # mixed with this tick's, so reset to the starter placeholder —
+    # undercounting is the safe direction).
+    susp_seen = jnp.where(re_inc, jnp.uint32(1), susp_seen)
+    # Fresh suspicions keep this tick's accuser bits; seed a starter bit
+    # if none landed (e.g. local probe-failure path) so popcount-1
+    # counts confirmations beyond the first accuser.
+    susp_seen = jnp.where(
+        fresh & (susp_seen == 0), jnp.uint32(1), susp_seen
+    )
+    return state._replace(susp_start=susp_start, susp_seen=susp_seen)
+
+
+def _scatter_cols(arr, cols, vals):
+    """arr[i, cols[i, j]] = vals[i, j] for the selected columns."""
+    n, b = arr.shape
+    rows = jnp.arange(n, dtype=jnp.int32)[:, None] * b
+    flat = arr.reshape(-1).at[(rows + cols).reshape(-1)].set(vals.reshape(-1))
+    return flat.reshape(n, b)

@@ -1,0 +1,9 @@
+"""Device-mesh construction and sharded execution.
+
+The distribution story of the framework (SURVEY.md §2.5): the node axis
+is the one parallel axis that matters — sharded over chips with
+``jax.sharding``, cross-shard gossip rides XLA collectives over ICI, and
+multiple meshes federate over DCN for the multi-DC WAN topology.
+"""
+
+from consul_tpu.parallel import mesh as mesh  # noqa: F401

@@ -1,0 +1,55 @@
+"""Mesh + sharding specs for the simulated cluster.
+
+Replaces the reference's distributed communication backend (UDP/TCP
+transports, yamux RPC pools, NCCL-free Go networking — SURVEY.md §2.5)
+with the TPU-native equivalent: the node axis sharded over a device
+mesh; message scatter/gather between shards lowers to XLA collectives
+over ICI. A second, leading ``dc`` axis federates multiple simulated
+datacenters (the LAN/WAN split of reference agent/consul/server.go:223-230).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from consul_tpu.models.state import SimState
+
+NODE_AXIS = "nodes"
+DC_AXIS = "dc"
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None, n_dc: int = 1) -> Mesh:
+    """1-D node mesh, or 2-D (dc, nodes) when federating datacenters."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_dc == 1:
+        return Mesh(np.array(devices), (NODE_AXIS,))
+    assert len(devices) % n_dc == 0, "devices must divide evenly into DCs"
+    grid = np.array(devices).reshape(n_dc, -1)
+    return Mesh(grid, (DC_AXIS, NODE_AXIS))
+
+
+def state_sharding(state: SimState, mesh: Mesh) -> SimState:
+    """NamedSharding pytree for a SimState: every per-node array is
+    sharded on its node axis; scalars are replicated."""
+    n = state.alive_truth.shape[0]
+
+    def spec(leaf):
+        if leaf.ndim >= 1 and leaf.shape[0] == n:
+            return NamedSharding(mesh, P(NODE_AXIS, *([None] * (leaf.ndim - 1))))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(spec, state)
+
+
+def shard_state(state: SimState, mesh: Mesh) -> SimState:
+    """Place a host-built SimState onto the mesh."""
+    return jax.tree.map(jax.device_put, state, state_sharding(state, mesh))
+
+
+def data_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Sharding for auxiliary per-node arrays (nbrs, world tensors)."""
+    return NamedSharding(mesh, P(NODE_AXIS, *([None] * (ndim - 1))))

@@ -1,0 +1,1 @@
+"""Host-side utilities: convergence metrics, checkpointing, telemetry."""

@@ -1,0 +1,73 @@
+"""Convergence and accuracy metrics for the simulated cluster.
+
+These mirror what the reference's operators watch: membership agreement
+(serf's convergence simulator outputs, reference lib/serf.go:21-25
+comment), failure-detection latency, false-positive rate
+(memberlist.health gauges, awareness.go:50), and Vivaldi accuracy
+(serf.coordinate.adjustment-ms metrics, ping_delegate.go:71-81) — here
+measurable exactly because the simulation owns the ground truth.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.config import SimConfig
+from consul_tpu.models.state import SimState
+from consul_tpu.ops import merge, topology, vivaldi
+from consul_tpu.ops.topology import World
+
+
+class HealthMetrics(NamedTuple):
+    agreement: jax.Array        # [] f32 — fraction of live-observer edges
+                                # whose alive/dead belief matches truth
+    false_positive: jax.Array   # [] f32 — live nodes believed dead/suspect
+    undetected: jax.Array       # [] f32 — dead nodes still believed alive
+    live_nodes: jax.Array       # [] i32
+
+
+def health(cfg: SimConfig, nbrs, state: SimState) -> HealthMetrics:
+    """Membership-agreement metrics over every (live observer, neighbor) edge."""
+    active = state.alive_truth & ~state.left
+    st = merge.key_status(state.view_key)
+    subj_up = active[nbrs]                       # truth per edge subject
+    believed_up = st == merge.ALIVE
+    believed_down = (st == merge.DEAD) | (st == merge.LEFT)
+    obs = active[:, None] & jnp.ones_like(st, bool)
+    edges = jnp.maximum(jnp.sum(obs), 1)
+    # Suspect counts as "not yet wrong" for false positives but as
+    # disagreement for convergence (the reference's convergence window
+    # is until states settle, not merely until suspicion).
+    agree = obs & ((subj_up & believed_up) | (~subj_up & believed_down))
+    fp = obs & subj_up & believed_down
+    und = obs & ~subj_up & believed_up
+    return HealthMetrics(
+        agreement=jnp.sum(agree) / edges,
+        false_positive=jnp.sum(fp) / edges,
+        undetected=jnp.sum(und) / edges,
+        live_nodes=jnp.sum(active).astype(jnp.int32),
+    )
+
+
+def vivaldi_rmse(cfg: SimConfig, world: World, state: SimState, key, samples: int = 4096):
+    """RMSE of estimated vs true RTT over random live pairs, in seconds.
+
+    The north-star accuracy metric (BASELINE.md): how well the learned
+    coordinates predict the ground-truth latency model, the same
+    question `consul rtt` answers from real coordinates (reference
+    command/rtt/rtt.go, lib/rtt.go:13-19).
+    """
+    k1, k2 = jax.random.split(key)
+    i = jax.random.randint(k1, (samples,), 0, cfg.n)
+    j = jax.random.randint(k2, (samples,), 0, cfg.n)
+    ok = (i != j) & state.alive_truth[i] & state.alive_truth[j]
+    est = vivaldi.distance(
+        state.viv.vec[i], state.viv.height[i], state.viv.adjustment[i],
+        state.viv.vec[j], state.viv.height[j], state.viv.adjustment[j],
+    )
+    err = jnp.where(ok, est - topology.true_rtt(world, i, j), 0.0)
+    denom = jnp.maximum(jnp.sum(ok), 1)
+    return jnp.sqrt(jnp.sum(err * err) / denom)
