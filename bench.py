@@ -45,7 +45,7 @@ def main():
     sim = Simulation(cfg, seed=0)
 
     # Throughput: pure simulation rate, no host round-trips.
-    rounds_per_s = sim.throughput(ticks=512, warmup=64)
+    rounds_per_s = sim.throughput(ticks=512)
 
     # Convergence: kill a block of nodes, run until every surviving
     # view agrees with ground truth.

@@ -21,13 +21,16 @@ import dataclasses
 import math
 
 
-def _ticks(ms: float, tick_ms: float) -> int:
+def to_ticks(ms: float, tick_ms: float) -> int:
     """Convert a wall-clock interval to whole ticks (minimum 1).
 
     Rounds up so a quantized interval is never shorter than specified —
     a probe timeout of 500 ms on a 200 ms tick must wait 3 ticks, not 2.
     """
     return max(1, math.ceil(ms / tick_ms))
+
+
+_ticks = to_ticks  # internal alias used by the config properties below
 
 
 @dataclasses.dataclass(frozen=True)
@@ -138,6 +141,47 @@ class GossipConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class SerfConfig:
+    """Serf-layer knobs (reference serf/config.go:246-289, lib/serf.go).
+
+    The fixed-capacity ``*_slots``/``*_ring`` sizes replace Go's unbounded
+    per-node queues and buffers (eventBroadcasts / recent-event buffers,
+    reference serf/serf.go + delegate.go:19-282) with static shapes.
+    """
+
+    # Per-node user-event/query broadcast queue slots (replaces the
+    # serf event TransmitLimitedQueue, serf/serf.go eventBroadcasts).
+    event_queue_slots: int = 8
+    # Events piggybacked per gossip send (models the UDP byte budget
+    # split across the serf queues, serf/delegate.go GetBroadcasts).
+    piggyback_events: int = 2
+    # Recent-event dedup buffer per node, in **Lamport-time buckets**
+    # (reference buffers the last EventBuffer=512 ltimes keyed by
+    # ``ltime % size``, serf/serf.go:1258-1357 + config.go:158). Events
+    # older than the window are rejected as stale, never redelivered.
+    seen_ring: int = 16
+    # Distinct origins remembered per Lamport-time bucket (the reference
+    # keeps an unbounded per-ltime name list; this is the fixed-shape
+    # bound — >width concurrent same-ltime events per bucket drop).
+    seen_width: int = 4
+    # Query response timeout multiplier (reference serf/config.go
+    # QueryTimeoutMult=16; timeout = mult * log10(N+1) * gossip_interval,
+    # serf/serf.go DefaultQueryTimeout).
+    query_timeout_mult: int = 16
+    # Failed members are remembered (and eligible for reconnect) this
+    # long before being reaped from member lists (reference
+    # serf/config.go:277 ReconnectTimeout=24h).
+    reconnect_timeout_ms: int = 24 * 3600 * 1000
+    # Left members linger this long before reaping (reference
+    # serf/config.go TombstoneTimeout=24h).
+    tombstone_timeout_ms: int = 24 * 3600 * 1000
+    # A leaving node keeps gossiping this long so its leave intent
+    # propagates before it goes quiet (reference lib/serf.go:21-25
+    # LeavePropagateDelay=3s, sized for >99.99% of 100k nodes).
+    leave_propagate_delay_ms: int = 3000
+
+
+@dataclasses.dataclass(frozen=True)
 class VivaldiConfig:
     """Vivaldi coordinate tuning (reference serf/coordinate/config.go:59-70)."""
 
@@ -158,6 +202,7 @@ class SimConfig:
     n: int = 1024                      # number of simulated nodes
     gossip: GossipConfig = dataclasses.field(default_factory=GossipConfig)
     vivaldi: VivaldiConfig = dataclasses.field(default_factory=VivaldiConfig)
+    serf: SerfConfig = dataclasses.field(default_factory=SerfConfig)
 
     # Partial-view degree: each node maintains membership views of at most
     # ``view_degree`` neighbors. 0 means the complete graph (each node

@@ -1,0 +1,542 @@
+"""The vectorized serf layer: Lamport time, user events, queries, leaves.
+
+Serf sits on top of memberlist and adds cluster coordination semantics
+(reference serf/serf.go): three Lamport clocks, fire-and-forget **user
+events** disseminated epidemically with recent-event dedup, request/
+response **queries**, graceful **leave** intents, and **reap** of
+failed/left members after a timeout.
+
+Here the whole layer is arrays over the node axis, advanced by
+:func:`step` (which first advances the underlying SWIM membership tick):
+
+  reference structure                      -> array here
+  ----------------------------------------------------------------
+  3 LamportClocks (serf.go:57-60)          -> clock / event_clock /
+                                              query_clock  [N] uint32
+  eventBroadcasts TransmitLimitedQueue     -> ev_key/ev_origin/ev_tx
+    (serf.go, delegate.go GetBroadcasts)      [N, E] fixed slots
+  recentIntents / eventBuffer dedup        -> ltime-bucketed buffers
+    (serf.go:1860-1926, config EventBuffer)   *_bkt_lt[N,R] + *_bkt_key/
+                                              origin[N,R,O], bucket =
+                                              ltime % R (serf's own
+                                              indexing), O origins/ltime
+  query response channel + deadline        -> q_open_key/q_deadline/
+    (serf/query.go)                           q_resps  [N]
+  failedMembers/leftMembers reap lists     -> down_since[N, K] vs
+    (serf.go:1544-1610)                       reap timeouts (derived)
+
+Event/query payloads are modeled as an 8-bit name id; delivery is
+exactly-once per node via the ltime-bucketed dedup buffer plus a
+Lamport recency floor raised on bucket eviction (serf's LTime dedup +
+eventMinTime gates, serf.go:1258-1357) — an event either delivers once
+or, past the window, is rejected as stale; it is never double-applied.
+Fresh arrivals stage into the receiver's own broadcast queue (receive ≠
+deliver, see _event_phase) and deliver oldest-first at one per tick.
+Bounded-capacity divergences (vs Go's unbounded structures): intake 2
+arrivals/tick, queue eviction under pressure, ``seen_width`` concurrent
+same-ltime origins per bucket.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.config import SimConfig, to_ticks
+from consul_tpu.models import state as sim_state
+from consul_tpu.models import swim
+from consul_tpu.models.state import SimState
+from consul_tpu.ops import lamport, merge, scaling
+from consul_tpu.ops.topology import World
+
+# Event key packing: uint32 = (ltime << 9) | (name & 0xff) << 1 | is_query.
+_NAME_SHIFT = 1
+_LTIME_SHIFT = 9
+
+
+def make_event_key(ltime, name, is_query=False):
+    lt = jnp.asarray(ltime, jnp.uint32)
+    nm = jnp.asarray(name, jnp.uint32) & 0xFF
+    q = jnp.asarray(is_query, jnp.uint32)
+    return (lt << _LTIME_SHIFT) | (nm << _NAME_SHIFT) | q
+
+
+def event_ltime(key):
+    return jnp.asarray(key, jnp.uint32) >> _LTIME_SHIFT
+
+
+def event_is_query(key):
+    return (jnp.asarray(key, jnp.uint32) & 1) == 1
+
+
+class SerfState(NamedTuple):
+    swim: SimState
+    # -- Lamport clocks (serf.go:57-60) -------------------------------
+    clock: jax.Array         # [N] uint32 — membership intents
+    event_clock: jax.Array   # [N] uint32
+    query_clock: jax.Array   # [N] uint32
+    # -- user-event/query broadcast queue -----------------------------
+    ev_key: jax.Array        # [N, E] uint32, 0 = empty
+    ev_origin: jax.Array     # [N, E] int32
+    ev_tx: jax.Array         # [N, E] int32 transmits remaining
+    # -- recent-event dedup buffers (ltime-bucketed; see module doc) ---
+    ev_bkt_lt: jax.Array     # [N, R] uint32 ltime owning each bucket, 0=empty
+    ev_bkt_key: jax.Array    # [N, R, O] uint32 event keys at that ltime
+    ev_bkt_origin: jax.Array  # [N, R, O] int32
+    q_bkt_lt: jax.Array      # [N, R] uint32 (queries have their own
+    q_bkt_key: jax.Array     # [N, R, O]      clock domain, so their own
+    q_bkt_origin: jax.Array  # [N, R, O]      buffer, like serf's)
+    ev_delivered: jax.Array  # [N] int32 — distinct events delivered
+    # Minimum accepted Lamport times: events/queries below the floor are
+    # rejected rather than redelivered (eventMinTime/queryMinTime,
+    # reference serf/serf.go); the floor rises when a bucket is evicted
+    # by a newer ltime landing on it.
+    ev_floor: jax.Array      # [N] uint32
+    q_floor: jax.Array       # [N] uint32
+    # -- outstanding query (one per origin) ---------------------------
+    q_open_key: jax.Array    # [N] uint32, 0 = none
+    q_deadline: jax.Array    # [N] int32 tick
+    q_resps: jax.Array       # [N] int32 responses received
+    # -- pending graceful leaves --------------------------------------
+    leave_at: jax.Array      # [N] int32 tick the node goes quiet, -1 = none
+    # -- reap bookkeeping ---------------------------------------------
+    down_since: jax.Array    # [N, K] int32 tick entry went dead/left, -1
+
+
+def init(cfg: SimConfig, key) -> SerfState:
+    n, e = cfg.n, cfg.serf.event_queue_slots
+    r, o = cfg.serf.seen_ring, cfg.serf.seen_width
+    return SerfState(
+        swim=sim_state.init(cfg, key),
+        clock=jnp.ones((n,), jnp.uint32),
+        event_clock=jnp.ones((n,), jnp.uint32),
+        query_clock=jnp.ones((n,), jnp.uint32),
+        ev_key=jnp.zeros((n, e), jnp.uint32),
+        ev_origin=jnp.full((n, e), -1, jnp.int32),
+        ev_tx=jnp.zeros((n, e), jnp.int32),
+        ev_bkt_lt=jnp.zeros((n, r), jnp.uint32),
+        ev_bkt_key=jnp.zeros((n, r, o), jnp.uint32),
+        ev_bkt_origin=jnp.full((n, r, o), -1, jnp.int32),
+        q_bkt_lt=jnp.zeros((n, r), jnp.uint32),
+        q_bkt_key=jnp.zeros((n, r, o), jnp.uint32),
+        q_bkt_origin=jnp.full((n, r, o), -1, jnp.int32),
+        ev_delivered=jnp.zeros((n,), jnp.int32),
+        ev_floor=jnp.zeros((n,), jnp.uint32),
+        q_floor=jnp.zeros((n,), jnp.uint32),
+        q_open_key=jnp.zeros((n,), jnp.uint32),
+        q_deadline=jnp.zeros((n,), jnp.int32),
+        q_resps=jnp.zeros((n,), jnp.int32),
+        leave_at=jnp.full((n,), -1, jnp.int32),
+        down_since=jnp.full((n, cfg.degree), -1, jnp.int32),
+    )
+
+
+def query_timeout_ticks(cfg: SimConfig) -> int:
+    """Default query timeout (reference serf/serf.go DefaultQueryTimeout):
+    ``gossip_interval * QueryTimeoutMult * ceil(log10(N+1))``."""
+    scale = math.ceil(math.log10(cfg.n + 1))
+    return cfg.gossip.gossip_period_ticks * cfg.serf.query_timeout_mult * scale
+
+
+# ----------------------------------------------------------------------
+# Origination APIs (all jittable, mask-driven).
+# ----------------------------------------------------------------------
+
+def _equeue_push(cfg: SimConfig, s: SerfState, mask, key_, origin, tx0):
+    """Insert one event per masked node into its event queue — same slot
+    semantics as the SWIM broadcast queue (invalidate same subject,
+    else empty slot, else evict most-transmitted; queue.go:182-242)."""
+    same = (s.ev_key == key_[:, None]) & (s.ev_origin == origin[:, None])
+    # Unlike swim._queue_push, a spent (tx<=0) slot is NOT free here:
+    # retirement is explicit (ev_key=0 in _event_phase) because a spent
+    # entry may still be awaiting its local delivery turn.
+    empty = s.ev_key == 0
+    score = (
+        jnp.where(same, 3_000_000, 0)
+        + jnp.where(empty, 2_000_000, 0)
+        + (1_000_000 - jnp.minimum(s.ev_tx, 999_999))
+    )
+    slot = jnp.argmax(score, axis=1)
+    e = cfg.serf.event_queue_slots
+    onehot = (jnp.arange(e, dtype=jnp.int32)[None, :] == slot[:, None]) & mask[:, None]
+    return s._replace(
+        ev_key=jnp.where(onehot, key_[:, None], s.ev_key),
+        ev_origin=jnp.where(onehot, origin[:, None], s.ev_origin),
+        ev_tx=jnp.where(onehot, tx0, s.ev_tx),
+    )
+
+
+def _buf_lookup(cfg: SimConfig, bkt_lt, bkt_key, bkt_origin, floor, dst, key_, origin):
+    """Is (key, origin) a duplicate/stale for each receiver ``dst``?
+
+    Mirrors the reference's buffer check (serf/serf.go:1258-1357): the
+    bucket for ``ltime % R`` either records this ltime (then membership
+    of (key, origin) decides, with a full bucket dropping overflow), is
+    owned by a *newer* ltime (this message is outside the window), or
+    the ltime is below the floor — all three reject.
+    """
+    lt = event_ltime(key_)
+    b = (lt % jnp.uint32(cfg.serf.seen_ring)).astype(jnp.int32)
+    blt = bkt_lt[dst, b]                        # [M]
+    slot_key = bkt_key[dst, b]                  # [M, O]
+    slot_origin = bkt_origin[dst, b]            # [M, O]
+    in_bucket = (blt == lt) & jnp.any(
+        (slot_key == key_[:, None]) & (slot_origin == origin[:, None]), axis=1
+    )
+    bucket_full = (blt == lt) & jnp.all(slot_key != 0, axis=1)
+    return in_bucket | bucket_full | (blt > lt) | (lt < floor[dst])
+
+
+def _buf_apply(cfg: SimConfig, bkt_lt, bkt_key, bkt_origin, floor, mask, key_, origin):
+    """Record one (key, origin) per masked node in its ltime buffer.
+
+    A newer ltime landing on an occupied bucket evicts it and raises the
+    Lamport floor past the evicted ltime (eventMinTime semantics) so
+    evicted events are rejected as stale, never redelivered.
+    """
+    n, r, o = cfg.n, cfg.serf.seen_ring, cfg.serf.seen_width
+    rows = jnp.arange(n, dtype=jnp.int32)
+    lt = event_ltime(key_)
+    b = (lt % jnp.uint32(r)).astype(jnp.int32)
+    blt = bkt_lt[rows, b]
+    takeover = mask & (blt != lt)               # empty (0) or older ltime
+    evict = takeover & (blt > 0)
+    floor = jnp.where(evict, jnp.maximum(floor, blt + 1), floor)
+
+    b_oh = (jnp.arange(r, dtype=jnp.int32)[None, :] == b[:, None]) & mask[:, None]
+    bkt_lt = jnp.where(b_oh, lt[:, None], bkt_lt)
+    # Slot: 0 on takeover (clearing the rest), else first free slot.
+    cur_key = bkt_key[rows, b]                  # [N, O]
+    free = jnp.argmax(cur_key == 0, axis=1).astype(jnp.int32)
+    slot = jnp.where(takeover, 0, free)
+    s_oh = (jnp.arange(o, dtype=jnp.int32)[None, :] == slot[:, None])
+    new_slot_key = jnp.where(
+        s_oh, key_[:, None], jnp.where(takeover[:, None], 0, cur_key)
+    )
+    cur_origin = bkt_origin[rows, b]
+    new_slot_origin = jnp.where(
+        s_oh, origin[:, None], jnp.where(takeover[:, None], -1, cur_origin)
+    )
+    bkt_key = jnp.where(b_oh[:, :, None], new_slot_key[:, None, :], bkt_key)
+    bkt_origin = jnp.where(b_oh[:, :, None], new_slot_origin[:, None, :], bkt_origin)
+    return bkt_lt, bkt_key, bkt_origin, floor
+
+
+def _seen_append(cfg: SimConfig, s: SerfState, mask, key_, origin) -> SerfState:
+    """Deliver (key, origin) to the masked nodes: record it in the
+    matching (event vs query) ltime buffer and count the delivery."""
+    isq = event_is_query(key_) & mask
+    isev = ~event_is_query(key_) & mask
+    e_lt, e_key, e_org, e_floor = _buf_apply(
+        cfg, s.ev_bkt_lt, s.ev_bkt_key, s.ev_bkt_origin, s.ev_floor,
+        isev, key_, origin,
+    )
+    q_lt, q_key, q_org, q_floor = _buf_apply(
+        cfg, s.q_bkt_lt, s.q_bkt_key, s.q_bkt_origin, s.q_floor,
+        isq, key_, origin,
+    )
+    return s._replace(
+        ev_bkt_lt=e_lt, ev_bkt_key=e_key, ev_bkt_origin=e_org, ev_floor=e_floor,
+        q_bkt_lt=q_lt, q_bkt_key=q_key, q_bkt_origin=q_org, q_floor=q_floor,
+        # Counts *user events* only (queries are tallied via q_resps).
+        ev_delivered=s.ev_delivered + jnp.where(isev, 1, 0),
+    )
+
+
+def user_event(cfg: SimConfig, s: SerfState, mask, name: int) -> SerfState:
+    """Fire a user event named ``name`` from every masked node
+    (reference serf/serf.go:447-505 UserEvent: stamp with the event
+    clock, increment, deliver locally, queue for broadcast)."""
+    mask = jnp.asarray(mask, bool)
+    rows = jnp.arange(cfg.n, dtype=jnp.int32)
+    key_ = make_event_key(s.event_clock, name, False)
+    s = s._replace(event_clock=lamport.increment(s.event_clock, mask))
+    with jax.ensure_compile_time_eval():
+        tx0 = int(scaling.retransmit_limit(cfg.gossip.retransmit_mult, cfg.n))
+    s = _equeue_push(cfg, s, mask, key_, rows, tx0)
+    return _seen_append(cfg, s, mask, key_, rows)
+
+
+def query(cfg: SimConfig, s: SerfState, mask, name: int) -> SerfState:
+    """Open a query from every masked node (reference serf/serf.go:510-614
+    Query: stamp with the query clock, set the log-scaled deadline,
+    queue for broadcast; responses tallied in ``q_resps``)."""
+    mask = jnp.asarray(mask, bool)
+    rows = jnp.arange(cfg.n, dtype=jnp.int32)
+    key_ = make_event_key(s.query_clock, name, True)
+    s = s._replace(
+        query_clock=lamport.increment(s.query_clock, mask),
+        q_open_key=jnp.where(mask, key_, s.q_open_key),
+        q_deadline=jnp.where(
+            mask, s.swim.t + query_timeout_ticks(cfg), s.q_deadline
+        ),
+        q_resps=jnp.where(mask, 0, s.q_resps),
+    )
+    with jax.ensure_compile_time_eval():
+        tx0 = int(scaling.retransmit_limit(cfg.gossip.retransmit_mult, cfg.n))
+    s = _equeue_push(cfg, s, mask, key_, rows, tx0)
+    return _seen_append(cfg, s, mask, key_, rows)
+
+
+def leave(cfg: SimConfig, s: SerfState, mask) -> SerfState:
+    """Graceful departure of the masked nodes (reference serf/serf.go:675
+    Leave: broadcast a leave intent at the next membership Lamport time;
+    memberlist marks the member left rather than failed). The leaver
+    keeps gossiping for ``leave_propagate_delay`` so the intent spreads
+    (reference lib/serf.go:21-25), then goes quiet at ``leave_at``; its
+    LEFT record outranks DEAD in the merge lattice (see ops/merge.py)."""
+    mask = jnp.asarray(mask, bool)
+    rows = jnp.arange(cfg.n, dtype=jnp.int32)
+    sw = s.swim
+    left_key = merge.make_key(sw.own_inc, merge.LEFT)
+    with jax.ensure_compile_time_eval():
+        tx0 = int(scaling.retransmit_limit(cfg.gossip.retransmit_mult, cfg.n))
+    sw = swim._queue_push(cfg, sw, mask, rows, left_key, rows, tx0)
+    sw = sw._replace(leaving=sw.leaving | mask)
+    delay = to_ticks(cfg.serf.leave_propagate_delay_ms, cfg.gossip.tick_ms)
+    return s._replace(
+        swim=sw,
+        clock=lamport.increment(s.clock, mask),
+        leave_at=jnp.where(mask, sw.t + delay, s.leave_at),
+    )
+
+
+# ----------------------------------------------------------------------
+# The serf tick.
+# ----------------------------------------------------------------------
+
+def step(cfg: SimConfig, nbrs: jax.Array, world: World, s: SerfState, key) -> SerfState:
+    """One serf tick: SWIM membership tick, then event/query gossip,
+    response tally, query expiry, and reap bookkeeping."""
+    k_swim, k_ev = jax.random.split(key)
+    t = s.swim.t
+    sw = swim.step(cfg, nbrs, world, s.swim, k_swim)
+    # Pending graceful leaves whose propagate window closed go quiet now
+    # (serf.Leave sleeps LeavePropagateDelay then shuts memberlist down).
+    quiet = (s.leave_at >= 0) & (sw.t >= s.leave_at)
+    sw = sw._replace(left=sw.left | quiet)
+    s = s._replace(swim=sw, leave_at=jnp.where(quiet, -1, s.leave_at))
+    active = sw.alive_truth & ~sw.left
+
+    s = _event_phase(cfg, nbrs, s, active, k_ev)
+
+    # Query expiry: past-deadline queries close (serf/query.go Deadline).
+    expired = (s.q_open_key > 0) & (sw.t >= s.q_deadline)
+    s = s._replace(q_open_key=jnp.where(expired, 0, s.q_open_key))
+
+    # Reap bookkeeping: ticks since each view entry went down
+    # (failed/left member lists, serf.go:1544-1610).
+    st = merge.key_status(sw.view_key)
+    is_down = (st == merge.DEAD) | (st == merge.LEFT)
+    down_since = jnp.where(
+        is_down & (s.down_since < 0), t, jnp.where(is_down, s.down_since, -1)
+    )
+    return s._replace(down_since=down_since)
+
+
+def _lookup_any(cfg: SimConfig, s: SerfState, dst, key_, origin):
+    """Duplicate/stale check against the kind-matching buffer."""
+    seen_ev = _buf_lookup(
+        cfg, s.ev_bkt_lt, s.ev_bkt_key, s.ev_bkt_origin, s.ev_floor,
+        dst, key_, origin,
+    )
+    seen_q = _buf_lookup(
+        cfg, s.q_bkt_lt, s.q_bkt_key, s.q_bkt_origin, s.q_floor,
+        dst, key_, origin,
+    )
+    return jnp.where(event_is_query(key_), seen_q, seen_ev)
+
+
+def _event_phase(cfg: SimConfig, nbrs, s: SerfState, active, key) -> SerfState:
+    """Receive → queue → deliver pipeline for user events and queries.
+
+    Receiving and delivering are decoupled, as in the reference (every
+    arriving message is handled; rebroadcast rides the same queue,
+    serf/delegate.go NotifyMsg → rebroadcast): fresh arrivals are
+    *staged into the receiver's own event queue* (which doubles as the
+    rebroadcast buffer), and each node *delivers* from its queue — the
+    oldest not-yet-delivered entry per tick, keeping Lamport order for
+    the eviction floor. Without the staging queue, an event arriving in
+    a busy tick would be dropped and lost once the sender's retransmit
+    budget drained (the reference never loses an accepted packet).
+    Intake is capped at 2 stages/tick and delivery at 1/tick; queue
+    capacity pressure can evict (bounded-memory divergence, noted in
+    the module docstring).
+    """
+    n, k_deg = cfg.n, cfg.degree
+    pe, fan = cfg.serf.piggyback_events, cfg.gossip.gossip_nodes
+    e_slots = cfg.serf.event_queue_slots
+    rows = jnp.arange(n, dtype=jnp.int32)
+    k_peer, k_loss, k_resp = jax.random.split(key, 3)
+    sentinel = jnp.uint32(0xFFFFFFFF)
+    with jax.ensure_compile_time_eval():
+        tx_limit = int(scaling.retransmit_limit(cfg.gossip.retransmit_mult, n))
+
+    # ---- 1. Deliver: oldest not-yet-delivered entry of the own queue.
+    q_dst = jnp.repeat(rows, e_slots)
+    q_keys = s.ev_key.reshape(-1)
+    q_orig = s.ev_origin.reshape(-1)
+    q_fresh = (
+        (q_keys > 0)
+        & ~_lookup_any(cfg, s, q_dst, q_keys, q_orig)
+        & jnp.repeat(active, e_slots)
+    )
+    del_key = jnp.min(
+        jnp.where(q_fresh, q_keys, sentinel).reshape(n, e_slots), axis=1
+    )
+    has = del_key != sentinel
+    # The matching slot with the lowest index (ties share key+origin
+    # only if the queue holds a same-origin duplicate, which
+    # _equeue_push's same-subject replacement prevents).
+    slot_match = q_fresh.reshape(n, e_slots) & (
+        s.ev_key == del_key[:, None]
+    )
+    del_slot = jnp.argmax(slot_match, axis=1)
+    del_origin = jnp.take_along_axis(s.ev_origin, del_slot[:, None], axis=1)[:, 0]
+    wkey = jnp.where(has, del_key, 0)
+    worig = jnp.where(has, del_origin, 0)
+
+    s = _seen_append(cfg, s, has, wkey, worig)
+    lt = event_ltime(wkey)
+    isq = event_is_query(wkey) & has
+    isev = ~event_is_query(wkey) & has
+    s = s._replace(
+        event_clock=lamport.witness(s.event_clock, lt, isev),
+        query_clock=lamport.witness(s.query_clock, lt, isq),
+    )
+
+    # Query responses: the deliverer answers the origin directly (one
+    # response per node per query — exactly-once via the dedup buffer;
+    # serf/query.go respondTo). Direct packet: origin must be up, the
+    # packet must survive loss, and the query must still be open.
+    resp_drop = jax.random.uniform(k_resp, (n,)) < cfg.packet_loss
+    resp_ok = (
+        isq
+        & ~resp_drop
+        & (s.q_open_key[worig] == wkey)
+        & s.swim.alive_truth[worig]
+        & ~s.swim.left[worig]
+        & (worig != rows)  # origin's own delivery happened at submit
+    )
+    s = s._replace(q_resps=s.q_resps.at[worig].add(jnp.where(resp_ok, 1, 0)))
+
+    # ---- 2. Gossip out: most-retransmittable queue entries to fan peers.
+    order = jnp.argsort(-s.ev_tx, axis=1)[:, :pe]
+    m_key = jnp.take_along_axis(s.ev_key, order, axis=1)
+    m_origin = jnp.take_along_axis(s.ev_origin, order, axis=1)
+    m_tx = jnp.take_along_axis(s.ev_tx, order, axis=1)
+    m_valid = (m_key > 0) & (m_tx > 0) & active[:, None]
+
+    peer_col = jax.random.randint(k_peer, (n, fan), 0, k_deg)
+    peer = jnp.take_along_axis(nbrs, peer_col, axis=1)
+    peer_status = jnp.take_along_axis(
+        merge.key_status(s.swim.view_key), peer_col, axis=1
+    )
+    peer_ok = (
+        ((peer_status == merge.ALIVE) | (peer_status == merge.SUSPECT))
+        & active[:, None]
+    )
+
+    dst = jnp.repeat(peer[:, :, None], pe, axis=2).reshape(-1)
+    ekey = jnp.repeat(m_key[:, None, :], fan, axis=1).reshape(-1)
+    eorig = jnp.repeat(m_origin[:, None, :], fan, axis=1).reshape(-1)
+    mok = (
+        jnp.repeat(peer_ok[:, :, None], pe, axis=2)
+        & jnp.repeat(m_valid[:, None, :], fan, axis=1)
+    ).reshape(-1)
+    drop = jax.random.uniform(k_loss, dst.shape) < cfg.packet_loss
+    mok = mok & ~drop & s.swim.alive_truth[dst] & ~s.swim.left[dst]
+
+    # Decrement transmit budgets by actual sends. A slot retires when
+    # its budget is spent AND its payload was delivered locally (a spent
+    # undelivered entry must survive to be delivered from the queue).
+    sends = jnp.sum(peer_ok, axis=1)[:, None] * jnp.where(m_valid, 1, 0)
+    ev_tx = swim._scatter_cols(s.ev_tx, order, jnp.maximum(m_tx - sends, 0))
+    # Exactly the slot delivered this tick (same-key different-origin
+    # twins in other slots are still undelivered and must survive).
+    delivered_now = (
+        jnp.arange(e_slots, dtype=jnp.int32)[None, :] == del_slot[:, None]
+    ) & has[:, None]
+    still_fresh = q_fresh.reshape(n, e_slots) & ~delivered_now
+    retire = (ev_tx <= 0) & ~still_fresh
+    s = s._replace(ev_tx=ev_tx, ev_key=jnp.where(retire, 0, s.ev_key))
+
+    # ---- 3. Intake: stage up to 2 fresh arrivals into the own queue.
+    fresh = mok & ~_lookup_any(cfg, s, dst, ekey, eorig)
+    midx = jnp.arange(dst.shape[0], dtype=jnp.int32)
+    m_total = midx.shape[0]
+    for _ in range(2):
+        win_key = (
+            jnp.full((n,), sentinel, jnp.uint32)
+            .at[dst]
+            .min(jnp.where(fresh, ekey, sentinel))
+        )
+        is_win = fresh & (ekey == win_key[dst]) & (win_key[dst] != sentinel)
+        win_idx = (
+            jnp.full((n,), m_total, jnp.int32)
+            .at[dst]
+            .min(jnp.where(is_win, midx, m_total))
+        )
+        got = win_idx < m_total
+        wi = jnp.where(got, win_idx, 0)
+        s = _equeue_push(cfg, s, got, ekey[wi], eorig[wi], tx_limit)
+        # Mask this (key, origin) out for the next intake round.
+        taken = (ekey == ekey[wi][dst]) & (eorig == eorig[wi][dst]) & got[dst]
+        fresh = fresh & ~taken
+    return s
+
+
+# ----------------------------------------------------------------------
+# Inspection.
+# ----------------------------------------------------------------------
+
+def event_coverage(cfg: SimConfig, s: SerfState, key_, origin) -> jax.Array:
+    """Fraction of active nodes whose dedup buffer holds (key, origin) —
+    the "did the event reach everyone" question serf's convergence
+    simulator answers (lib/serf.go:21-25 comment)."""
+    active = s.swim.alive_truth & ~s.swim.left
+    key_ = jnp.asarray(key_, jnp.uint32)
+    bkt_key = jnp.where(event_is_query(key_), s.q_bkt_key, s.ev_bkt_key)
+    bkt_origin = jnp.where(event_is_query(key_), s.q_bkt_origin, s.ev_bkt_origin)
+    got = jnp.any(
+        (bkt_key == key_) & (bkt_origin == jnp.asarray(origin, jnp.int32)),
+        axis=(1, 2),
+    )
+    return jnp.sum(got & active) / jnp.maximum(jnp.sum(active), 1)
+
+
+class MemberCounts(NamedTuple):
+    alive: jax.Array    # [N] int32 — per-observer counts over its view
+    suspect: jax.Array
+    dead: jax.Array     # failed, not yet reaped
+    left: jax.Array     # gracefully left, not yet reaped
+    reaped: jax.Array   # removed from member lists
+
+
+def member_counts(cfg: SimConfig, s: SerfState) -> MemberCounts:
+    """Per-observer membership roll-up with reap semantics applied:
+    failed members vanish after ``reconnect_timeout``, left members
+    after ``tombstone_timeout`` (reference serf/serf.go:1544-1568 reap)."""
+    g = cfg.gossip
+    st = merge.key_status(s.swim.view_key)
+    t = s.swim.t
+    down_ticks = jnp.where(s.down_since >= 0, t - s.down_since, 0)
+    reconnect_ticks = to_ticks(cfg.serf.reconnect_timeout_ms, g.tick_ms)
+    tombstone_ticks = to_ticks(cfg.serf.tombstone_timeout_ms, g.tick_ms)
+    reaped = ((st == merge.DEAD) & (down_ticks > reconnect_ticks)) | (
+        (st == merge.LEFT) & (down_ticks > tombstone_ticks)
+    )
+
+    def count(mask):
+        return jnp.sum(mask & ~reaped, axis=1).astype(jnp.int32)
+
+    return MemberCounts(
+        alive=count(st == merge.ALIVE),
+        suspect=count(st == merge.SUSPECT),
+        dead=count(st == merge.DEAD),
+        left=count(st == merge.LEFT),
+        reaped=jnp.sum(reaped, axis=1).astype(jnp.int32),
+    )

@@ -43,6 +43,11 @@ class SimState(NamedTuple):
     # -- ground truth (fault injection) -------------------------------
     alive_truth: jax.Array    # [N] bool — process actually up
     left: jax.Array           # [N] bool — gracefully departed
+    leaving: jax.Array        # [N] bool — leave intent broadcast, still
+                              # gossiping out the propagate window; such a
+                              # node must NOT refute suspicions (serf
+                              # Leave sets a state that suppresses
+                              # refutation, serf/serf.go:675-…)
     # -- own per-node protocol state ----------------------------------
     own_inc: jax.Array        # [N] uint32
     awareness: jax.Array      # [N] int32, 0..awareness_max-1
@@ -87,6 +92,7 @@ def init(cfg: SimConfig, key) -> SimState:
         t=jnp.int32(0),
         alive_truth=jnp.ones((n,), bool),
         left=jnp.zeros((n,), bool),
+        leaving=jnp.zeros((n,), bool),
         own_inc=jnp.ones((n,), jnp.uint32),
         awareness=jnp.zeros((n,), jnp.int32),
         probe_perm=perm,
@@ -138,6 +144,8 @@ def revive(cfg: SimConfig, state: SimState, mask) -> SimState:
         tx0 = int(scaling.retransmit_limit(cfg.gossip.retransmit_mult, n))
     return state._replace(
         alive_truth=state.alive_truth | mask,
+        left=state.left & ~mask,
+        leaving=state.leaving & ~mask,
         own_inc=own_inc,
         q_subject=jnp.where(write, rows[..., None], state.q_subject),
         q_key=jnp.where(write, merge.make_key(own_inc, merge.ALIVE)[..., None], state.q_key),

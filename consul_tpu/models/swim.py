@@ -207,7 +207,7 @@ def step(cfg: SimConfig, nbrs: jax.Array, world: World, state: SimState, key) ->
     target_up = state.alive_truth[target] & ~state.left[target]
     rtt_obs = topology.sample_rtt(cfg, world, rows, target, keys[0])
     timeout_s = g.probe_timeout_ms / 1000.0
-    loss = jax.random.uniform(keys[1], (n, 5)) < cfg.packet_loss  # 5 legs modeled
+    loss = jax.random.uniform(keys[1], (n, 2)) < cfg.packet_loss  # direct, TCP legs
     direct_ok = has_target & target_up & (rtt_obs <= timeout_s) & ~loss[:, 0]
     # Indirect probes via k random live relays + TCP fallback
     # (state.go:366-435): with iid loss both directions per relay.
@@ -228,7 +228,9 @@ def step(cfg: SimConfig, nbrs: jax.Array, world: World, state: SimState, key) ->
     target_inc = merge.key_incarnation(
         jnp.take_along_axis(state.view_key, target_col[:, None], axis=1)[:, 0]
     )
-    poke_suspect = has_target & (target_status == merge.SUSPECT) & target_up & ~loss[:, 2]
+    # (Loss for the poke is applied once, by the shared gossip-delivery
+    # drop in _gossip_phase — not here, which would square it.)
+    poke_suspect = has_target & (target_status == merge.SUSPECT) & target_up
 
     # Probe bookkeeping: window for failures, ticker reschedule scaled
     # by local health (awareness.ScaleTimeout, state.go:268).
@@ -284,7 +286,10 @@ def step(cfg: SimConfig, nbrs: jax.Array, world: World, state: SimState, key) ->
     # alive (state.go:840-864). Costs health (awareness +1).
     # ------------------------------------------------------------------
     claim = jnp.maximum(refute_inc_gossip, refute_inc_pp)
-    refuting = (claim > 0) & active
+    # A node with a broadcast leave intent does not refute — refuting
+    # would outrank its own LEFT record in the merge lattice and convert
+    # the graceful departure into a detected failure.
+    refuting = (claim > 0) & active & ~state.leaving
     own_inc = jnp.where(refuting, claim + 1, state.own_inc).astype(jnp.uint32)
     state = state._replace(
         own_inc=own_inc,

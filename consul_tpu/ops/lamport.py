@@ -1,0 +1,45 @@
+"""Lamport clock operations, vectorized over the node axis.
+
+Serf keeps three cluster-wide Lamport clocks per node — membership,
+user-event, and query time (reference serf/serf.go:57-60) — with two
+operations (reference serf/lamport.go:10-45):
+
+  - ``Increment``: atomically advance the local clock and return the new
+    time (used when originating an intent/event/query).
+  - ``Witness(v)``: on observing a remote time ``v``, raise the local
+    clock to ``v + 1`` if it is behind (CAS loop in the reference; a pure
+    ``maximum`` here).
+
+In the vectorized framework the clock is an array ``clock[N]`` and both
+operations are elementwise, so a whole cluster's worth of clock traffic
+is two fused ops per tick.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def witness(clock, observed, mask=None):
+    """Raise ``clock`` to ``observed + 1`` where behind (and ``mask``).
+
+    Mirrors LamportClock.Witness (reference serf/lamport.go:29-45).
+    """
+    clock = jnp.asarray(clock, jnp.uint32)
+    bumped = jnp.maximum(clock, jnp.asarray(observed, jnp.uint32) + 1)
+    if mask is None:
+        return bumped
+    return jnp.where(mask, bumped, clock)
+
+
+def increment(clock, mask=None):
+    """Advance the clock by one where ``mask`` (everywhere when None).
+
+    Mirrors LamportClock.Increment (reference serf/lamport.go:23-26).
+    Returns the new clock; the originated message carries the *previous*
+    value (serf stamps with ``Time()`` then increments, serf.go:447-462).
+    """
+    clock = jnp.asarray(clock, jnp.uint32)
+    if mask is None:
+        return clock + 1
+    return jnp.where(mask, clock + 1, clock)

@@ -78,13 +78,25 @@ def make_neighbors(cfg: SimConfig, key) -> jax.Array:
         ring = (jnp.arange(n)[:, None] + 1 + jnp.arange(k_deg)[None, :]) % n
         return ring.astype(jnp.int32)
     # Sparse: sample K distinct non-self neighbors per row, sorted. Built
-    # host-side with numpy (one-time setup; rejection-free via permuted
-    # offsets, mirroring how kRandomNodes wants distinct targets,
-    # reference memberlist/util.go:125-153).
+    # host-side with numpy (one-time setup; distinct targets mirror
+    # kRandomNodes, reference memberlist/util.go:125-153). Fully
+    # vectorized — draw with replacement, then re-draw the few per-row
+    # collisions (expected ~K^2/2(N-1) per row) until none remain, so a
+    # 1M-row table builds in seconds rather than via 1M rng calls.
     rng = np.random.default_rng(int(jax.random.randint(key, (), 0, 2**31 - 1)))
-    offsets = np.empty((n, k_deg), dtype=np.int64)
-    for row in range(n):
-        offsets[row] = rng.choice(n - 1, size=k_deg, replace=False)
+    offsets = rng.integers(0, n - 1, size=(n, k_deg))
+    for _ in range(64):
+        offsets.sort(axis=1)
+        dup = np.zeros_like(offsets, dtype=bool)
+        dup[:, 1:] = offsets[:, 1:] == offsets[:, :-1]
+        n_dup = int(dup.sum())
+        if n_dup == 0:
+            break
+        offsets[dup] = rng.integers(0, n - 1, size=n_dup)
+    else:  # pragma: no cover - K close to N; fall back to exact per-row
+        for row in np.unique(np.nonzero(dup)[0]):
+            offsets[row] = rng.choice(n - 1, size=k_deg, replace=False)
+        offsets.sort(axis=1)
     nbrs = (np.arange(n)[:, None] + 1 + offsets) % n
     nbrs.sort(axis=1)
     return jnp.asarray(nbrs, jnp.int32)

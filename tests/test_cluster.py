@@ -26,7 +26,7 @@ def test_bench_scenario_miniature():
     assert converged, f"agreement={float(trace.agreement[-1])}"
     assert int(sim.health().live_nodes) == 44
     # Throughput path (no metrics) runs and returns a positive rate.
-    rate = sim.throughput(ticks=32, warmup=8)
+    rate = sim.throughput(ticks=32)
     assert rate > 0
 
 
