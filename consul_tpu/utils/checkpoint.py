@@ -1,0 +1,134 @@
+"""Checkpoint / resume for simulation state.
+
+The reference persists three independent things (SURVEY.md §5): the serf
+snapshot (append-only member-event log replayed on restart for fast
+rejoin, reference serf/snapshot.go:59-431), raft snapshots of every FSM
+table (reference agent/consul/fsm/fsm.go:134-152), and operator snapshot
+archives (reference snapshot/archive.go:99-170, tar+SHA256).
+
+The TPU-native equivalent collapses all of that into one mechanism: the
+entire cluster *is* a pytree of device arrays, so a checkpoint is a
+single batched device→host transfer written as one ``.npz`` archive with
+a manifest — and resume is reload + continue ticking. Integrity is
+guarded the way the operator archive does it: a SHA-256 digest over the
+payload stored alongside (reference snapshot/archive.go:143-170).
+
+Works on any pytree of arrays (SimState, SerfState, federation states);
+restore takes a template with the same structure (an ``init()`` result)
+so shapes/dtypes are validated before any tick runs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_MANIFEST = "__manifest__"
+FORMAT_VERSION = 1
+
+
+def _leaf_names(tree: Any) -> list[str]:
+    paths_and_leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [jax.tree_util.keystr(path) for path, _ in paths_and_leaves]
+
+
+def save(path: str, state: Any) -> str:
+    """Write ``state`` (any pytree of arrays) to ``path`` as an npz
+    archive with a JSON manifest + SHA-256 payload digest. Returns the
+    hex digest."""
+    names = _leaf_names(state)
+    leaves = jax.tree.leaves(state)
+    arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
+
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    payload = buf.getvalue()
+    digest = hashlib.sha256(payload).hexdigest()
+
+    manifest = {
+        "format_version": FORMAT_VERSION,
+        "n_leaves": len(leaves),
+        "names": names,
+        "shapes": [list(a.shape) for a in arrays.values()],
+        "dtypes": [str(a.dtype) for a in arrays.values()],
+        "sha256": digest,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        # Manifest first (length-prefixed JSON), then the npz payload —
+        # the same "metadata then stream" layout as the operator archive.
+        mjson = json.dumps(manifest).encode()
+        f.write(len(mjson).to_bytes(8, "little"))
+        f.write(mjson)
+        f.write(payload)
+    os.replace(tmp, path)  # atomic, like the snapshotter's rename
+    return digest
+
+
+def read_manifest(path: str) -> dict:
+    with open(path, "rb") as f:
+        mlen = int.from_bytes(f.read(8), "little")
+        return json.loads(f.read(mlen))
+
+
+def restore(path: str, template: Any, *, verify: bool = True) -> Any:
+    """Load a checkpoint into the structure of ``template`` (an
+    ``init()``-produced pytree). Shape/dtype mismatches and payload
+    corruption raise before any tick runs."""
+    with open(path, "rb") as f:
+        mlen = int.from_bytes(f.read(8), "little")
+        manifest = json.loads(f.read(mlen))
+        payload = f.read()
+
+    if manifest.get("format_version") != FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format {manifest.get('format_version')} != {FORMAT_VERSION}"
+        )
+    if verify:
+        digest = hashlib.sha256(payload).hexdigest()
+        if digest != manifest["sha256"]:
+            raise ValueError(
+                f"checkpoint payload digest mismatch: {digest[:12]}… != "
+                f"{manifest['sha256'][:12]}… (corrupt or truncated)"
+            )
+
+    t_leaves, treedef = jax.tree.flatten(template)
+    if len(t_leaves) != manifest["n_leaves"]:
+        raise ValueError(
+            f"checkpoint has {manifest['n_leaves']} leaves, template has "
+            f"{len(t_leaves)} — config/structure mismatch "
+            f"(saved names: {manifest['names'][:4]}…)"
+        )
+    t_names = _leaf_names(template)
+    if t_names != manifest["names"]:
+        diffs = [
+            f"{saved!r} vs template {now!r}"
+            for saved, now in zip(manifest["names"], t_names)
+            if saved != now
+        ]
+        raise ValueError(
+            "checkpoint field names do not match the template (fields "
+            f"renamed/reordered since the save?): {diffs[:3]}"
+        )
+    with np.load(io.BytesIO(payload)) as z:
+        new_leaves = []
+        for i, (tleaf, name) in enumerate(zip(t_leaves, manifest["names"])):
+            arr = z[f"leaf_{i}"]
+            tarr = jnp.asarray(tleaf)
+            if tuple(arr.shape) != tuple(tarr.shape) or str(arr.dtype) != str(
+                tarr.dtype
+            ):
+                raise ValueError(
+                    f"leaf {name}: checkpoint {arr.dtype}{list(arr.shape)} vs "
+                    f"template {tarr.dtype}{list(tarr.shape)} — was the "
+                    f"checkpoint written with a different SimConfig?"
+                )
+            new_leaves.append(jnp.asarray(arr))
+    return jax.tree.unflatten(treedef, new_leaves)

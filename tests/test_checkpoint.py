@@ -1,0 +1,89 @@
+"""Checkpoint/resume tests: roundtrip fidelity, resumed-trajectory
+determinism (same seed ⇒ identical trajectory, the TPU-side replacement
+for the reference's race-free restart guarantees, SURVEY.md §5), and
+corruption/mismatch detection (reference snapshot/archive.go SHA256
+verification)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from consul_tpu.config import SimConfig
+from consul_tpu.models import serf
+from consul_tpu.ops import topology
+from consul_tpu.utils import checkpoint
+
+
+@pytest.fixture(scope="module")
+def sim():
+    cfg = SimConfig(n=32)
+    key = jax.random.PRNGKey(5)
+    kw, kn, ks = jax.random.split(key, 3)
+    world = topology.make_world(cfg, kw)
+    nbrs = topology.make_neighbors(cfg, kn)
+    state = serf.init(cfg, ks)
+    step = jax.jit(lambda st, k: serf.step(cfg, nbrs, world, st, k))
+    return cfg, state, step
+
+
+def run(state, step, ticks, seed=0):
+    base = jax.random.PRNGKey(seed)
+    for i in range(ticks):
+        state = step(state, jax.random.fold_in(base, int(state.swim.t) + i))
+    return state
+
+
+def assert_trees_equal(a, b):
+    for pa, pb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert jnp.array_equal(pa, pb)
+
+
+def test_roundtrip_identity(sim, tmp_path):
+    cfg, state, step = sim
+    state = run(state, step, 5)
+    p = str(tmp_path / "ckpt.bin")
+    digest = checkpoint.save(p, state)
+    assert len(digest) == 64
+    restored = checkpoint.restore(p, serf.init(cfg, jax.random.PRNGKey(0)))
+    assert_trees_equal(state, restored)
+
+
+def test_resume_is_deterministic(sim, tmp_path):
+    cfg, state, step = sim
+    mid = run(state, step, 8)
+    p = str(tmp_path / "mid.bin")
+    checkpoint.save(p, mid)
+    # Path A: keep going in-process. Path B: restore and continue.
+    end_a = run(mid, step, 8)
+    end_b = run(checkpoint.restore(p, serf.init(cfg, jax.random.PRNGKey(0))), step, 8)
+    assert_trees_equal(end_a, end_b)
+
+
+def test_corruption_detected(sim, tmp_path):
+    cfg, state, _ = sim
+    p = str(tmp_path / "corrupt.bin")
+    checkpoint.save(p, state)
+    raw = bytearray(open(p, "rb").read())
+    raw[-7] ^= 0xFF  # flip a payload byte
+    open(p, "wb").write(bytes(raw))
+    with pytest.raises(ValueError, match="digest mismatch"):
+        checkpoint.restore(p, serf.init(cfg, jax.random.PRNGKey(0)))
+
+
+def test_config_mismatch_detected(sim, tmp_path):
+    cfg, state, _ = sim
+    p = str(tmp_path / "ckpt.bin")
+    checkpoint.save(p, state)
+    other = serf.init(SimConfig(n=16), jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="template"):
+        checkpoint.restore(p, other)
+
+
+def test_manifest_readable(sim, tmp_path):
+    cfg, state, _ = sim
+    p = str(tmp_path / "ckpt.bin")
+    checkpoint.save(p, state)
+    m = checkpoint.read_manifest(p)
+    assert m["format_version"] == checkpoint.FORMAT_VERSION
+    assert m["n_leaves"] == len(jax.tree.leaves(state))
+    assert any("view_key" in n for n in m["names"])
