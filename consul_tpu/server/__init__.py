@@ -1,0 +1,9 @@
+"""Server tier: consensus, replicated state, and RPC endpoints.
+
+The host-side control plane around the TPU data plane — the equivalent
+of the reference's ``agent/consul`` server core (SURVEY.md §2.2). The
+gossip/coordinate hot loops run as tensor programs (consul_tpu.models);
+this package holds the parts the reference keeps transactional and
+strongly consistent: the raft log, the FSM, the indexed state store
+with watch-based blocking queries, and the RPC endpoint objects.
+"""

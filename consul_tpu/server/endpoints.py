@@ -1,0 +1,384 @@
+"""RPC endpoint layer: the msgpack-RPC surface of the reference server.
+
+One ``Server`` owns a raft node + FSM + store and exposes the endpoint
+objects the reference registers (reference agent/consul/server_oss.go:
+4-18, *_endpoint.go): Catalog, Health, KVS, Session, Coordinate, Status,
+Txn. Calls go through :meth:`Server.rpc`, which forwards writes to the
+leader exactly like the reference's ``forward`` retry loop (reference
+agent/consul/rpc.go:231-292) — here an in-process hop through the
+server registry (the moral equivalent of the yamux conn pool).
+
+Reads support the blocking contract (``index``/``wait``) via the state
+store's watch machinery and the ``near=`` RTT sort (reference
+agent/consul/rpc.go:457-539, rtt.go:187-221).
+
+Coordinate.Update follows the reference's write-batching design
+(reference agent/consul/coordinate_endpoint.go:42-153): updates stage
+in a map keyed node:segment, validated and ACL-free here; a periodic
+flush applies at most ``update_max_batches × update_batch_size`` staged
+entries per period through raft, discarding the excess with a counter —
+the natural TPU shape is the same batch (SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+import math
+import uuid
+from typing import Any, Optional
+
+from consul_tpu.server import fsm as fsm_mod
+from consul_tpu.server import rtt
+from consul_tpu.server.fsm import FSM
+from consul_tpu.server.raft import NotLeader, RaftCluster, RaftNode
+from consul_tpu.server.state_store import StateStore
+
+# Reference defaults (agent/consul/config.go:519-521).
+COORDINATE_UPDATE_PERIOD_S = 5.0
+COORDINATE_UPDATE_BATCH_SIZE = 128
+COORDINATE_UPDATE_MAX_BATCHES = 5
+
+
+class Server:
+    """One server: raft participant + FSM + endpoint dispatch."""
+
+    def __init__(self, node_id: str, raft_node: RaftNode, fsm: FSM,
+                 registry: dict[str, "Server"],
+                 vivaldi_dimensionality: int = 8):
+        self.id = node_id
+        self.raft = raft_node
+        self.fsm = fsm
+        self.registry = registry
+        self.vivaldi_dimensionality = vivaldi_dimensionality
+        registry[node_id] = self
+        # Coordinate staging (coordinate_endpoint.go:42-53).
+        self._coord_updates: dict[str, dict] = {}
+        self.metrics = {"coordinate_updates_discarded": 0,
+                        "rpc_forwarded": 0}
+
+    @property
+    def store(self) -> StateStore:
+        return self.fsm.store
+
+    def is_leader(self) -> bool:
+        return self.raft.state == "leader" and not self.raft.stopped
+
+    # ------------------------------------------------------------------
+    # Dispatch + forwarding
+    # ------------------------------------------------------------------
+    def rpc(self, method: str, **args) -> Any:
+        """Invoke ``Endpoint.Method`` (e.g. ``"Catalog.Register"``),
+        forwarding writes to the leader when needed."""
+        endpoint, name = method.split(".", 1)
+        handler = getattr(self, f"_{endpoint.lower()}_{_snake(name)}", None)
+        if handler is None:
+            raise AttributeError(f"unknown RPC {method}")
+        return handler(**args)
+
+    def _raft_apply(self, command: dict) -> Any:
+        """Propose through the leader (forwarding like rpc.go:231-292);
+        the caller is responsible for stepping the cluster to commit —
+        RaftCluster.propose_and_commit does both in drivers/tests."""
+        if self.is_leader():
+            return self.raft.propose(command)
+        leader = self.raft.leader_id
+        # leader == self.id can only mean stale knowledge (we are not
+        # the leader per the check above) — never self-forward.
+        if leader is None or leader == self.id or leader not in self.registry:
+            raise NotLeader(None)
+        self.metrics["rpc_forwarded"] += 1
+        return self.registry[leader]._raft_apply(command)
+
+    def _blocking(self, tables, min_index, wait_s, fn):
+        if min_index:
+            idx, val = self.store.blocking_query(
+                tables, min_index, fn, timeout_s=wait_s
+            )
+        else:
+            idx, val = max(
+                self.store.tables[t].max_index for t in tables
+            ) or 1, fn()
+        return {"index": idx, "value": val}
+
+    # ------------------------------------------------------------------
+    # Status endpoint (reference agent/consul/status_endpoint.go)
+    # ------------------------------------------------------------------
+    def _status_leader(self) -> Optional[str]:
+        return self.raft.leader_id
+
+    def _status_peers(self) -> list[str]:
+        return sorted([self.raft.id, *self.raft.peers])
+
+    # ------------------------------------------------------------------
+    # Catalog endpoint (reference agent/consul/catalog_endpoint.go)
+    # ------------------------------------------------------------------
+    def _catalog_register(self, node: str, address: str = "",
+                          service: Optional[dict] = None,
+                          check: Optional[dict] = None,
+                          node_meta: Optional[dict] = None) -> int:
+        # Validate before proposing (the reference validates in the
+        # endpoint, catalog_endpoint.go Register) — a committed entry
+        # that fails to apply would diverge-or-skip on every replica.
+        if not node:
+            raise ValueError("node name required")
+        if check and check.get("status", "critical") not in (
+            "passing", "warning", "critical"
+        ):
+            raise ValueError(f"bad check status {check.get('status')!r}")
+        cmd = {"type": fsm_mod.REGISTER, "node": node, "address": address}
+        if service:
+            cmd["service"] = service
+        if check:
+            cmd["check"] = check
+        if node_meta:
+            cmd["node_meta"] = node_meta
+        return self._raft_apply(cmd)
+
+    def _catalog_deregister(self, node: str, service_id: Optional[str] = None,
+                            check_id: Optional[str] = None) -> int:
+        cmd = {"type": fsm_mod.DEREGISTER, "node": node}
+        if service_id:
+            cmd["service_id"] = service_id
+        if check_id:
+            cmd["check_id"] = check_id
+        return self._raft_apply(cmd)
+
+    def _catalog_list_nodes(self, min_index: int = 0, wait_s: float = 10.0,
+                            near: str = "") -> dict:
+        out = self._blocking(["nodes"], min_index, wait_s, self.store.nodes)
+        if near:
+            sets = rtt.coord_sets_from_store(self.store.coordinates())
+            out["value"] = rtt.sort_nodes_by_distance(sets, near, out["value"])
+        return out
+
+    def _catalog_list_services(self, min_index: int = 0,
+                               wait_s: float = 10.0) -> dict:
+        return self._blocking(["services"], min_index, wait_s,
+                              self.store.services)
+
+    def _catalog_service_nodes(self, service: str, tag: Optional[str] = None,
+                               min_index: int = 0, wait_s: float = 10.0,
+                               near: str = "") -> dict:
+        out = self._blocking(
+            ["services", "nodes"], min_index, wait_s,
+            lambda: self.store.service_nodes(service, tag),
+        )
+        if near:
+            sets = rtt.coord_sets_from_store(self.store.coordinates())
+            out["value"] = rtt.sort_nodes_by_distance(sets, near, out["value"])
+        return out
+
+    def _catalog_node_services(self, node: str) -> dict:
+        return {"index": self.store.index,
+                "value": self.store.node_services(node)}
+
+    # ------------------------------------------------------------------
+    # Health endpoint (reference agent/consul/health_endpoint.go)
+    # ------------------------------------------------------------------
+    def _health_service_nodes(self, service: str, passing_only: bool = False,
+                              min_index: int = 0, wait_s: float = 10.0,
+                              near: str = "") -> dict:
+        def fn():
+            rows = []
+            for svc in self.store.service_nodes(service):
+                checks = self.store.checks(node=svc["node"])
+                health = self.store.node_health(svc["node"])
+                if passing_only and health == "critical":
+                    continue
+                rows.append({"node": svc["node"], "service": svc,
+                             "checks": checks, "aggregate_status": health})
+            return rows
+
+        out = self._blocking(["services", "checks", "nodes"],
+                             min_index, wait_s, fn)
+        if near:
+            sets = rtt.coord_sets_from_store(self.store.coordinates())
+            out["value"] = rtt.sort_nodes_by_distance(sets, near, out["value"])
+        return out
+
+    def _health_node_checks(self, node: str, min_index: int = 0,
+                            wait_s: float = 10.0) -> dict:
+        return self._blocking(["checks"], min_index, wait_s,
+                              lambda: self.store.checks(node=node))
+
+    def _health_checks_in_state(self, state: str, min_index: int = 0,
+                                wait_s: float = 10.0) -> dict:
+        return self._blocking(["checks"], min_index, wait_s,
+                              lambda: self.store.checks(state=state))
+
+    # ------------------------------------------------------------------
+    # KVS endpoint (reference agent/consul/kvs_endpoint.go)
+    # ------------------------------------------------------------------
+    def _kvs_apply(self, op: str, key: str, value: bytes = b"",
+                   flags: int = 0, cas_index: Optional[int] = None,
+                   session: Optional[str] = None) -> int:
+        return self._raft_apply({
+            "type": fsm_mod.KV, "op": op, "key": key, "value": value,
+            "flags": flags, "cas_index": cas_index, "session": session,
+        })
+
+    def _kvs_get(self, key: str, min_index: int = 0,
+                 wait_s: float = 10.0) -> dict:
+        return self._blocking(["kv"], min_index, wait_s,
+                              lambda: self.store.kv_get(key))
+
+    def _kvs_list(self, prefix: str = "", min_index: int = 0,
+                  wait_s: float = 10.0) -> dict:
+        return self._blocking(["kv"], min_index, wait_s,
+                              lambda: self.store.kv_list(prefix))
+
+    # ------------------------------------------------------------------
+    # Session endpoint (reference agent/consul/session_endpoint.go)
+    # ------------------------------------------------------------------
+    def _session_apply(self, op: str, node: str = "", session_id: str = "",
+                       ttl_s: float = 0.0, behavior: str = "release",
+                       checks: Optional[list] = None) -> Any:
+        if op == "create":
+            session_id = session_id or str(uuid.uuid4())
+            self._raft_apply({
+                "type": fsm_mod.SESSION, "op": "create", "id": session_id,
+                "node": node, "ttl_s": ttl_s, "behavior": behavior,
+                "checks": checks,
+            })
+            return session_id
+        return self._raft_apply({"type": fsm_mod.SESSION, "op": "destroy",
+                                 "id": session_id})
+
+    def _session_list(self) -> dict:
+        return {"index": self.store.index, "value": self.store.session_list()}
+
+    # ------------------------------------------------------------------
+    # Txn endpoint (reference agent/consul/txn_endpoint.go)
+    # ------------------------------------------------------------------
+    def _txn_apply(self, ops: list[dict]) -> int:
+        return self._raft_apply({"type": fsm_mod.TXN, "ops": ops})
+
+    # ------------------------------------------------------------------
+    # Coordinate endpoint (reference agent/consul/coordinate_endpoint.go)
+    # ------------------------------------------------------------------
+    def _coordinate_update(self, node: str, coord: dict,
+                           segment: str = "") -> None:
+        """Stage one update; validation mirrors coordinate_endpoint.go:
+        122-146 (dimensionality + finite components)."""
+        vec = coord.get("vec", [])
+        if len(vec) != self.vivaldi_dimensionality:
+            raise ValueError(
+                f"coordinate dimensionality {len(vec)} != "
+                f"{self.vivaldi_dimensionality}"
+            )
+        comps = [*vec, coord.get("error", 0.0), coord.get("height", 0.0),
+                 coord.get("adjustment", 0.0)]
+        if not all(math.isfinite(c) for c in comps):
+            raise ValueError("coordinate has non-finite components")
+        if not self.is_leader():
+            leader = self.raft.leader_id
+            if leader and leader != self.id and leader in self.registry:
+                self.metrics["rpc_forwarded"] += 1
+                return self.registry[leader]._coordinate_update(
+                    node, coord, segment
+                )
+            raise NotLeader(None)
+        key = f"{node}:{segment}"
+        if key not in self._coord_updates and len(self._coord_updates) >= \
+                COORDINATE_UPDATE_BATCH_SIZE * COORDINATE_UPDATE_MAX_BATCHES:
+            # Rate limit: discard, like coordinate_endpoint.go:66-71.
+            self.metrics["coordinate_updates_discarded"] += 1
+            return None
+        self._coord_updates[key] = {"node": node, "segment": segment,
+                                    "coord": coord}
+        return None
+
+    def flush_coordinates(self) -> list[int]:
+        """Apply staged updates in raft batches of ``update_batch_size``
+        (the 5s background batchUpdate, coordinate_endpoint.go:42-111).
+        Called by the driver on its update period."""
+        if not self._coord_updates:
+            return []
+        staged = list(self._coord_updates.values())
+        self._coord_updates.clear()
+        indexes = []
+        for i in range(0, len(staged), COORDINATE_UPDATE_BATCH_SIZE):
+            batch = staged[i:i + COORDINATE_UPDATE_BATCH_SIZE]
+            indexes.append(self._raft_apply({
+                "type": fsm_mod.COORDINATE_BATCH_UPDATE, "updates": batch,
+            }))
+        return indexes
+
+    def _coordinate_list_nodes(self, min_index: int = 0,
+                               wait_s: float = 10.0) -> dict:
+        return self._blocking(["coordinates"], min_index, wait_s,
+                              self.store.coordinates)
+
+    def _coordinate_node(self, node: str, min_index: int = 0,
+                         wait_s: float = 10.0) -> dict:
+        def fn():
+            return [c for c in self.store.coordinates() if c["node"] == node]
+        return self._blocking(["coordinates"], min_index, wait_s, fn)
+
+
+def _snake(name: str) -> str:
+    out = []
+    for i, ch in enumerate(name):
+        if ch.isupper() and i > 0:
+            out.append("_")
+        out.append(ch.lower())
+    return "".join(out)
+
+
+class ServerCluster:
+    """In-process multi-server harness: n Servers over one deterministic
+    raft transport (the reference's in-process cluster test idiom,
+    agent/consul/helper_test.go + TestAgent)."""
+
+    def __init__(self, n: int = 3, seed: int = 0,
+                 snapshot_threshold: int = 4096,
+                 vivaldi_dimensionality: int = 8):
+        self.registry: dict[str, Server] = {}
+        fsms: dict[str, FSM] = {}
+
+        def apply_factory(node_id):
+            fsms[node_id] = FSM(StateStore())
+            return fsms[node_id].apply
+
+        self.raft = RaftCluster(
+            n, apply_factory, seed=seed,
+            snapshot_threshold=snapshot_threshold,
+            snapshot_factory=lambda nid: fsms[nid].snapshot,
+            restore_factory=lambda nid: fsms[nid].restore,
+        )
+        self.servers = [
+            Server(nid, self.raft.nodes[nid], fsms[nid], self.registry,
+                   vivaldi_dimensionality)
+            for nid in sorted(self.raft.nodes)
+        ]
+
+    def step(self, rounds: int = 1):
+        self.raft.step(rounds)
+
+    def wait_converged(self, max_rounds: int = 400) -> Server:
+        """Step until every running node agrees on the same leader (the
+        testrpc.WaitForLeader idiom, reference testrpc/wait.go:14-38)."""
+        return self.registry[self.raft.wait_converged(max_rounds).id]
+
+    def leader_server(self) -> Server:
+        return self.wait_converged()
+
+    def any_follower(self) -> Server:
+        led = self.wait_converged()
+        return next(s for s in self.servers if s.id != led.id)
+
+    def write(self, server: Server, method: str, **args) -> Any:
+        """Issue a write RPC and step raft until it commits AND every
+        running replica has applied it (the synchronous raftApply
+        contract of rpc.go:377, plus full replication so follower
+        reads — which are stale-by-design, like the reference's
+        default consistency mode — observe the write in tests)."""
+        out = server.rpc(method, **args)
+        if isinstance(out, int):
+            for _ in range(300):
+                self.step()
+                if all(n.last_applied >= out
+                       for n in self.raft.nodes.values() if not n.stopped):
+                    return out
+            raise TimeoutError(f"index {out} not fully applied")
+        self.step(5)
+        return out

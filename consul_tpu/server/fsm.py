@@ -1,0 +1,126 @@
+"""The replicated state machine: raft log entries -> state store writes.
+
+Mirrors the reference FSM (reference agent/consul/fsm/fsm.go:107-152):
+entries are typed commands dispatched to a handler per message type,
+applied with the raft log index so every replica lands on identical
+modify indexes; snapshot/restore round-trips every table including
+coordinates (reference fsm/snapshot*.go, commands_oss.go:218-230
+``applyCoordinateBatchUpdate``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from consul_tpu.server.state_store import StateStore
+
+# Message types (reference agent/structs/structs.go MessageType values).
+REGISTER = "register"
+DEREGISTER = "deregister"
+KV = "kv"
+SESSION = "session"
+COORDINATE_BATCH_UPDATE = "coordinate-batch-update"
+CONFIG_ENTRY = "config-entry"
+TXN = "txn"
+
+
+class FSM:
+    def __init__(self, store: StateStore | None = None):
+        self.store = store if store is not None else StateStore()
+
+    def apply(self, index: int, command: dict) -> Any:
+        """Apply one committed log entry at raft ``index``. Must be
+        deterministic: replicas apply the same sequence and converge."""
+        mtype = command["type"]
+        if mtype == REGISTER:
+            # One registration can carry node + service + check, like
+            # structs.RegisterRequest (fsm.go applyRegister).
+            r = command
+            self.store.ensure_node(r["node"], r.get("address", ""),
+                                   r.get("node_meta"), index=index)
+            if "service" in r:
+                s = r["service"]
+                self.store.ensure_service(
+                    r["node"], s.get("id", s["service"]), s["service"],
+                    s.get("port", 0), s.get("tags"), s.get("meta"), index=index,
+                )
+            if "check" in r:
+                c = r["check"]
+                self.store.ensure_check(
+                    r["node"], c["check_id"], c.get("status", "critical"),
+                    c.get("service_id", ""), c.get("output", ""), index=index,
+                )
+            return index
+        if mtype == DEREGISTER:
+            r = command
+            if "service_id" in r:
+                return self.store.delete_service(r["node"], r["service_id"],
+                                                 index=index)
+            if "check_id" in r:
+                return self.store.delete_check(r["node"], r["check_id"],
+                                               index=index)
+            return self.store.delete_node(r["node"], index=index)
+        if mtype == KV:
+            op = command["op"]
+            if op in ("set", "cas", "lock", "unlock"):
+                _, ok = self.store.kv_set(
+                    command["key"], command.get("value", b""),
+                    command.get("flags", 0),
+                    command.get("cas_index") if op == "cas" else None,
+                    command.get("session"), index=index,
+                )
+                return ok
+            if op in ("delete", "delete-tree", "delete-cas"):
+                _, ok = self.store.kv_delete(
+                    command["key"], op == "delete-tree",
+                    command.get("cas_index") if op == "delete-cas" else None,
+                    index=index,
+                )
+                return ok
+            raise ValueError(f"unknown KV op {op!r}")
+        if mtype == SESSION:
+            if command["op"] == "create":
+                self.store.session_create(
+                    command["id"], command["node"], command.get("ttl_s", 0.0),
+                    command.get("behavior", "release"), command.get("checks"),
+                    index=index,
+                )
+                return command["id"]
+            self.store.session_destroy(command["id"], index=index)
+            return True
+        if mtype == COORDINATE_BATCH_UPDATE:
+            return self.store.coordinate_batch_update(command["updates"],
+                                                      index=index)
+        if mtype == CONFIG_ENTRY:
+            if command.get("op") == "delete":
+                return self.store.config_delete(command["kind"],
+                                                command["name"], index=index)
+            return self.store.config_set(command["kind"], command["name"],
+                                         command["entry"], index=index)
+        if mtype == TXN:
+            # All-or-nothing batch (reference agent/consul/txn_endpoint.go):
+            # verify CAS preconditions up front, and roll the store back
+            # if any op fails mid-batch — a partial TXN must never leak.
+            for op in command["ops"]:
+                if op["type"] == KV and op["op"] in ("cas", "delete-cas"):
+                    e = self.store.kv_get(op["key"])
+                    cur = e["modify_index"] if e else 0
+                    if cur != op.get("cas_index", 0):
+                        return {"ok": False, "failed": op["key"]}
+            undo = self.store.snapshot()
+            results = []
+            try:
+                for op in command["ops"]:
+                    results.append(self.apply(index, op))
+            except Exception as e:  # noqa: BLE001
+                self.store.restore(undo)
+                return {"ok": False, "error": repr(e)}
+            return {"ok": True, "results": results}
+        raise ValueError(f"unknown message type {mtype!r}")
+
+    # Snapshot/restore delegate to the store (fsm.go:134,152).
+    def snapshot(self) -> dict:
+        return self.store.snapshot()
+
+    def restore(self, snap: dict) -> None:
+        self.store.restore(snap)

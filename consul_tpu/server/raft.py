@@ -1,0 +1,478 @@
+"""Raft-lite: deterministic in-process consensus for the server tier.
+
+The reference vendors hashicorp/raft (~8.7k LoC; reference
+vendor/github.com/hashicorp/raft) for leader election, log replication,
+and FSM snapshots, driven by wall-clock timers over TCP. This
+implementation keeps the protocol core — terms, randomized election
+timeouts, RequestVote/AppendEntries with the log-matching property,
+quorum commit, log compaction with InstallSnapshot — but is
+**tick-driven and deterministic**: timers are tick counters, randomness
+is a per-node seeded RNG, and messages flow through an in-memory
+transport with explicit partition control (the moral equivalent of the
+reference's inmem_transport.go used by dev mode and every raft test).
+
+Determinism is the point: the TPU framework's control plane must be
+replayable the same way the data plane is (same seed ⇒ same trajectory),
+so consensus tests never flake and fault injection (partitions, node
+stops) is scriptable — SURVEY.md §5 "race detection" TPU equivalent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import zlib
+from typing import Any, Callable, Optional
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+HEARTBEAT_TICKS = 2
+ELECTION_TICKS_MIN = 10
+ELECTION_TICKS_MAX = 20
+
+
+@dataclasses.dataclass
+class LogEntry:
+    term: int
+    index: int
+    command: Any
+
+
+@dataclasses.dataclass
+class Message:
+    mtype: str        # request_vote | vote_reply | append | append_reply | install_snapshot
+    src: str
+    dst: str
+    term: int
+    payload: dict
+
+
+class Transport:
+    """In-memory message bus with partition faults (reference raft
+    inmem_transport.go + test partitioning idioms)."""
+
+    def __init__(self):
+        self.nodes: dict[str, "RaftNode"] = {}
+        self.queues: dict[str, list[Message]] = {}
+        self.cut: set[tuple[str, str]] = set()
+
+    def register(self, node: "RaftNode"):
+        self.nodes[node.id] = node
+        self.queues[node.id] = []
+
+    def send(self, msg: Message):
+        if (msg.src, msg.dst) in self.cut or msg.dst not in self.queues:
+            return
+        self.queues[msg.dst].append(msg)
+
+    def partition(self, a: str, b: str):
+        self.cut.add((a, b))
+        self.cut.add((b, a))
+
+    def heal(self, a: Optional[str] = None, b: Optional[str] = None):
+        if a is None:
+            self.cut.clear()
+        else:
+            self.cut.discard((a, b))
+            self.cut.discard((b, a))
+
+    def pump(self):
+        """Deliver every queued message (messages sent during delivery
+        land next pump, keeping rounds deterministic)."""
+        for node_id in sorted(self.queues):
+            batch, self.queues[node_id] = self.queues[node_id], []
+            node = self.nodes[node_id]
+            for msg in batch:
+                if not node.stopped:
+                    node.handle(msg)
+
+
+class NotLeader(Exception):
+    def __init__(self, leader_hint: Optional[str]):
+        super().__init__(f"not leader (hint: {leader_hint})")
+        self.leader_hint = leader_hint
+
+
+class RaftNode:
+    """One consensus participant. ``apply_fn(index, command)`` receives
+    committed entries in order (the FSM boundary, fsm.go:107)."""
+
+    def __init__(self, node_id: str, peer_ids: list[str], transport: Transport,
+                 apply_fn: Callable[[int, Any], Any], seed: int = 0,
+                 snapshot_fn: Optional[Callable[[], dict]] = None,
+                 restore_fn: Optional[Callable[[dict], None]] = None,
+                 snapshot_threshold: int = 1024):
+        self.id = node_id
+        self.peers = [p for p in peer_ids if p != node_id]
+        self.transport = transport
+        self.apply_fn = apply_fn
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
+        self.snapshot_threshold = snapshot_threshold
+        # crc32, not hash(): str hashing is salted per process, which
+        # would break same-seed-same-trajectory across runs.
+        self.rng = random.Random((seed << 32) ^ zlib.crc32(node_id.encode()))
+
+        self.state = FOLLOWER
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.leader_id: Optional[str] = None
+        # Log with compaction: entries[0] corresponds to index base+1.
+        self.log: list[LogEntry] = []
+        self.log_base_index = 0   # index of the last compacted entry
+        self.log_base_term = 0
+        self.pending_snapshot: Optional[dict] = None
+        self.commit_index = 0
+        self.last_applied = 0
+        self.votes: set[str] = set()
+        self.next_index: dict[str, int] = {}
+        self.match_index: dict[str, int] = {}
+        self.apply_errors: list[tuple[int, str]] = []
+        self.stopped = False
+        self._reset_election_timer()
+        transport.register(self)
+
+    # ------------------------------------------------------------------
+    # Log helpers (with compaction offsets)
+    # ------------------------------------------------------------------
+    def last_log_index(self) -> int:
+        return self.log_base_index + len(self.log)
+
+    def last_log_term(self) -> int:
+        return self.log[-1].term if self.log else self.log_base_term
+
+    def entry_at(self, index: int) -> Optional[LogEntry]:
+        i = index - self.log_base_index - 1
+        return self.log[i] if 0 <= i < len(self.log) else None
+
+    def term_at(self, index: int) -> Optional[int]:
+        if index == self.log_base_index:
+            return self.log_base_term
+        e = self.entry_at(index)
+        return e.term if e else None
+
+    # ------------------------------------------------------------------
+    # Timers
+    # ------------------------------------------------------------------
+    def _reset_election_timer(self):
+        self.election_ticks = self.rng.randint(
+            ELECTION_TICKS_MIN, ELECTION_TICKS_MAX
+        )
+
+    def tick(self):
+        if self.stopped:
+            return
+        if self.state == LEADER:
+            self.heartbeat_ticks = getattr(self, "heartbeat_ticks", 0) - 1
+            if self.heartbeat_ticks <= 0:
+                self.heartbeat_ticks = HEARTBEAT_TICKS
+                self._broadcast_appends()
+            return
+        self.election_ticks -= 1
+        if self.election_ticks <= 0:
+            self._start_election()
+
+    # ------------------------------------------------------------------
+    # Election (raft §5.2)
+    # ------------------------------------------------------------------
+    def _start_election(self):
+        self.state = CANDIDATE
+        self.term += 1
+        self.voted_for = self.id
+        self.votes = {self.id}
+        self.leader_id = None
+        self._reset_election_timer()
+        for p in self.peers:
+            self.transport.send(Message(
+                "request_vote", self.id, p, self.term,
+                {"last_log_index": self.last_log_index(),
+                 "last_log_term": self.last_log_term()},
+            ))
+        self._maybe_win()
+
+    def _maybe_win(self):
+        if self.state == CANDIDATE and len(self.votes) * 2 > len(self.peers) + 1:
+            self.state = LEADER
+            self.leader_id = self.id
+            self.heartbeat_ticks = 0
+            nxt = self.last_log_index() + 1
+            self.next_index = {p: nxt for p in self.peers}
+            self.match_index = {p: 0 for p in self.peers}
+            # Commit a current-term no-op immediately so quorum-
+            # replicated entries from prior terms become committable
+            # (raft §5.4.2; hashicorp/raft's LogNoop on election).
+            self.log.append(LogEntry(self.term, nxt, {"type": "noop"}))
+            self._broadcast_appends()
+
+    # ------------------------------------------------------------------
+    # Replication (raft §5.3)
+    # ------------------------------------------------------------------
+    def propose(self, command: Any) -> int:
+        """Leader-only append; returns the entry's log index. Commit is
+        observed via apply_fn once a quorum replicates (raftApply
+        semantics, reference agent/consul/rpc.go:377)."""
+        if self.state != LEADER:
+            raise NotLeader(self.leader_id)
+        entry = LogEntry(self.term, self.last_log_index() + 1, command)
+        self.log.append(entry)
+        self._broadcast_appends()
+        return entry.index
+
+    def _broadcast_appends(self):
+        for p in self.peers:
+            self._send_append(p)
+
+    def _send_append(self, peer: str):
+        nxt = self.next_index.get(peer, self.last_log_index() + 1)
+        if nxt <= self.log_base_index:
+            # Peer is behind the compaction horizon: ship the snapshot
+            # (InstallSnapshot, raft §7 / reference raft/snapshot.go).
+            if self.pending_snapshot is not None:
+                self.transport.send(Message(
+                    "install_snapshot", self.id, peer, self.term,
+                    {"snapshot": self.pending_snapshot,
+                     "last_index": self.log_base_index,
+                     "last_term": self.log_base_term},
+                ))
+            return
+        prev_index = nxt - 1
+        prev_term = self.term_at(prev_index)
+        entries = [dataclasses.asdict(e) for e in
+                   self.log[prev_index - self.log_base_index:]]
+        self.transport.send(Message(
+            "append", self.id, peer, self.term,
+            {"prev_index": prev_index, "prev_term": prev_term,
+             "entries": entries, "commit_index": self.commit_index},
+        ))
+
+    # ------------------------------------------------------------------
+    # Message handling
+    # ------------------------------------------------------------------
+    def handle(self, msg: Message):
+        if msg.term > self.term:
+            self.term = msg.term
+            self.state = FOLLOWER
+            self.voted_for = None
+            # A deposed leader must not keep claiming itself; the new
+            # leader's identity arrives with its first AppendEntries.
+            self.leader_id = None
+        if msg.mtype == "request_vote":
+            self._on_request_vote(msg)
+        elif msg.mtype == "vote_reply":
+            self._on_vote_reply(msg)
+        elif msg.mtype == "append":
+            self._on_append(msg)
+        elif msg.mtype == "append_reply":
+            self._on_append_reply(msg)
+        elif msg.mtype == "install_snapshot":
+            self._on_install_snapshot(msg)
+
+    def _on_request_vote(self, msg: Message):
+        p = msg.payload
+        up_to_date = (p["last_log_term"], p["last_log_index"]) >= (
+            self.last_log_term(), self.last_log_index()
+        )
+        grant = (
+            msg.term >= self.term
+            and self.voted_for in (None, msg.src)
+            and up_to_date
+        )
+        if grant:
+            self.voted_for = msg.src
+            self._reset_election_timer()
+        self.transport.send(Message(
+            "vote_reply", self.id, msg.src, self.term, {"granted": grant}
+        ))
+
+    def _on_vote_reply(self, msg: Message):
+        if self.state == CANDIDATE and msg.term == self.term and \
+                msg.payload["granted"]:
+            self.votes.add(msg.src)
+            self._maybe_win()
+
+    def _on_append(self, msg: Message):
+        if msg.term < self.term:
+            self.transport.send(Message(
+                "append_reply", self.id, msg.src, self.term,
+                {"success": False, "match_index": 0},
+            ))
+            return
+        self.state = FOLLOWER
+        self.leader_id = msg.src
+        self._reset_election_timer()
+        p = msg.payload
+        if self.term_at(p["prev_index"]) != p["prev_term"]:
+            self.transport.send(Message(
+                "append_reply", self.id, msg.src, self.term,
+                {"success": False,
+                 "match_index": min(p["prev_index"] - 1, self.last_log_index())},
+            ))
+            return
+        # Append, truncating conflicts (log matching property).
+        for e in p["entries"]:
+            entry = LogEntry(**e)
+            existing = self.entry_at(entry.index)
+            if existing is not None and existing.term != entry.term:
+                del self.log[entry.index - self.log_base_index - 1:]
+                existing = None
+            if existing is None and entry.index == self.last_log_index() + 1:
+                self.log.append(entry)
+        match = p["prev_index"] + len(p["entries"])
+        if p["commit_index"] > self.commit_index:
+            self.commit_index = min(p["commit_index"], self.last_log_index())
+            self._apply_committed()
+        self.transport.send(Message(
+            "append_reply", self.id, msg.src, self.term,
+            {"success": True, "match_index": match},
+        ))
+
+    def _on_append_reply(self, msg: Message):
+        if self.state != LEADER or msg.term != self.term:
+            return
+        p = msg.payload
+        if p["success"]:
+            self.match_index[msg.src] = max(
+                self.match_index.get(msg.src, 0), p["match_index"]
+            )
+            self.next_index[msg.src] = self.match_index[msg.src] + 1
+            self._advance_commit()
+        else:
+            self.next_index[msg.src] = max(1, p["match_index"] + 1)
+            self._send_append(msg.src)
+
+    def _advance_commit(self):
+        """Commit = the highest index replicated on a quorum, current
+        term only (raft §5.4.2 safety rule)."""
+        for idx in range(self.last_log_index(), self.commit_index, -1):
+            if self.term_at(idx) != self.term:
+                break
+            replicas = 1 + sum(
+                1 for p in self.peers if self.match_index.get(p, 0) >= idx
+            )
+            if replicas * 2 > len(self.peers) + 1:
+                self.commit_index = idx
+                self._apply_committed()
+                break
+
+    def _apply_committed(self):
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            entry = self.entry_at(self.last_applied)
+            if entry is not None and entry.command != {"type": "noop"}:
+                try:
+                    self.apply_fn(entry.index, entry.command)
+                except Exception as e:  # noqa: BLE001
+                    # A bad committed entry must not kill the raft loop
+                    # (every replica would crash identically); record it
+                    # and keep applying — endpoint-side validation is
+                    # the real gate, this is the backstop.
+                    self.apply_errors.append((entry.index, repr(e)))
+        self._maybe_compact()
+
+    # ------------------------------------------------------------------
+    # Snapshots / compaction (raft §7)
+    # ------------------------------------------------------------------
+    def _maybe_compact(self):
+        if self.snapshot_fn is None or \
+                self.last_applied - self.log_base_index < self.snapshot_threshold:
+            return
+        self.pending_snapshot = self.snapshot_fn()
+        base_term = self.term_at(self.last_applied)
+        self.log = self.log[self.last_applied - self.log_base_index:]
+        self.log_base_index = self.last_applied
+        self.log_base_term = base_term
+
+    def _on_install_snapshot(self, msg: Message):
+        p = msg.payload
+        if msg.term < self.term or p["last_index"] <= self.last_applied:
+            return
+        self.state = FOLLOWER
+        self.leader_id = msg.src
+        self._reset_election_timer()
+        if self.restore_fn is not None:
+            self.restore_fn(p["snapshot"])
+        self.log = []
+        self.log_base_index = p["last_index"]
+        self.log_base_term = p["last_term"]
+        self.commit_index = p["last_index"]
+        self.last_applied = p["last_index"]
+        self.pending_snapshot = p["snapshot"]
+        self.transport.send(Message(
+            "append_reply", self.id, msg.src, self.term,
+            {"success": True, "match_index": p["last_index"]},
+        ))
+
+    # ------------------------------------------------------------------
+    def stop(self):
+        """Fault injection: crash-stop (the Shutdown() idiom of the
+        reference's leader tests)."""
+        self.stopped = True
+
+    def restart(self):
+        self.stopped = False
+        self.state = FOLLOWER
+        self._reset_election_timer()
+
+
+class RaftCluster:
+    """Test/driver harness: n nodes, one transport, lock-step rounds
+    (the in-process multi-server cluster pattern of reference
+    agent/consul/helper_test.go wantRaft/wantPeers)."""
+
+    def __init__(self, n: int, apply_factory: Callable[[str], Callable],
+                 seed: int = 0, snapshot_threshold: int = 1024,
+                 snapshot_factory=None, restore_factory=None):
+        self.transport = Transport()
+        ids = [f"srv{i}" for i in range(n)]
+        self.nodes = {}
+        for node_id in ids:
+            self.nodes[node_id] = RaftNode(
+                node_id, ids, self.transport, apply_factory(node_id),
+                seed=seed, snapshot_threshold=snapshot_threshold,
+                snapshot_fn=snapshot_factory(node_id) if snapshot_factory else None,
+                restore_fn=restore_factory(node_id) if restore_factory else None,
+            )
+
+    def step(self, rounds: int = 1):
+        for _ in range(rounds):
+            for node in self.nodes.values():
+                node.tick()
+            self.transport.pump()
+
+    def leader(self) -> Optional[RaftNode]:
+        leaders = [n for n in self.nodes.values()
+                   if n.state == LEADER and not n.stopped]
+        if not leaders:
+            return None
+        return max(leaders, key=lambda n: n.term)
+
+    def wait_leader(self, max_rounds: int = 400) -> RaftNode:
+        for _ in range(max_rounds):
+            led = self.leader()
+            if led is not None:
+                return led
+            self.step()
+        raise TimeoutError("no leader elected")
+
+    def wait_converged(self, max_rounds: int = 400) -> RaftNode:
+        """Step until every running node knows the same leader."""
+        for _ in range(max_rounds):
+            led = self.leader()
+            if led is not None and all(
+                n.leader_id == led.id
+                for n in self.nodes.values() if not n.stopped
+            ):
+                return led
+            self.step()
+        raise TimeoutError("leadership did not converge")
+
+    def propose_and_commit(self, command: Any, max_rounds: int = 200) -> int:
+        led = self.wait_leader()
+        idx = led.propose(command)
+        for _ in range(max_rounds):
+            self.step()
+            if led.commit_index >= idx:
+                return idx
+        raise TimeoutError(f"entry {idx} not committed")

@@ -7,31 +7,40 @@ table (reference agent/consul/fsm/fsm.go:134-152), and operator snapshot
 archives (reference snapshot/archive.go:99-170, tar+SHA256).
 
 The TPU-native equivalent collapses all of that into one mechanism: the
-entire cluster *is* a pytree of device arrays, so a checkpoint is a
-single batched device→host transfer written as one ``.npz`` archive with
-a manifest — and resume is reload + continue ticking. Integrity is
-guarded the way the operator archive does it: a SHA-256 digest over the
-payload stored alongside (reference snapshot/archive.go:143-170).
+entire cluster *is* a pytree of device arrays, so a checkpoint is one
+streamed file and resume is reload + continue ticking. Integrity is
+guarded the way the operator archive does it (reference
+snapshot/archive.go:143-170): a SHA-256 digest over the payload, stored
+in the manifest, verified on restore; the header itself is guarded by a
+magic number, a bounded length, and clean corruption errors.
+
+File layout (FORMAT_VERSION 2)::
+
+    b"CTPU"  | manifest_len (8 LE bytes) | manifest JSON | raw leaf bytes
+
+Leaves are written in pytree order as contiguous little-endian buffers;
+their names/shapes/dtypes live in the manifest, so restore validates
+the template *before* reading any array and streams one leaf at a time
+(peak extra memory = the largest leaf, not 3x the checkpoint).
 
 Works on any pytree of arrays (SimState, SerfState, federation states);
-restore takes a template with the same structure (an ``init()`` result)
-so shapes/dtypes are validated before any tick runs.
+restore takes a template with the same structure (an ``init()`` result).
 """
 
 from __future__ import annotations
 
 import hashlib
-import io
 import json
 import os
-from typing import Any
+from typing import Any, BinaryIO
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-_MANIFEST = "__manifest__"
-FORMAT_VERSION = 1
+MAGIC = b"CTPU"
+FORMAT_VERSION = 2
+_MAX_MANIFEST = 64 << 20
 
 
 def _leaf_names(tree: Any) -> list[str]:
@@ -39,96 +48,130 @@ def _leaf_names(tree: Any) -> list[str]:
     return [jax.tree_util.keystr(path) for path, _ in paths_and_leaves]
 
 
-def save(path: str, state: Any) -> str:
-    """Write ``state`` (any pytree of arrays) to ``path`` as an npz
-    archive with a JSON manifest + SHA-256 payload digest. Returns the
-    hex digest."""
-    names = _leaf_names(state)
-    leaves = jax.tree.leaves(state)
-    arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
+def _host_leaves(state: Any) -> list[np.ndarray]:
+    out = []
+    for leaf in jax.tree.leaves(state):
+        arr = np.asarray(leaf)
+        if not arr.flags.c_contiguous:
+            # ascontiguousarray promotes 0-d to 1-d; restore the shape.
+            arr = np.ascontiguousarray(arr).reshape(arr.shape)
+        out.append(arr)
+    return out
 
-    buf = io.BytesIO()
-    np.savez(buf, **arrays)
-    payload = buf.getvalue()
-    digest = hashlib.sha256(payload).hexdigest()
+
+def save(path: str, state: Any) -> str:
+    """Write ``state`` (any pytree of arrays) to ``path``. Returns the
+    payload's hex SHA-256 digest. Crash-safe: fsync before the atomic
+    rename, so a torn write can never replace a good checkpoint."""
+    names = _leaf_names(state)
+    leaves = _host_leaves(state)
+
+    # Pass 1: digest the payload (leaf-at-a-time; no full buffering).
+    h = hashlib.sha256()
+    for arr in leaves:
+        h.update(arr.data)
+    digest = h.hexdigest()
 
     manifest = {
         "format_version": FORMAT_VERSION,
         "n_leaves": len(leaves),
         "names": names,
-        "shapes": [list(a.shape) for a in arrays.values()],
-        "dtypes": [str(a.dtype) for a in arrays.values()],
+        "shapes": [list(a.shape) for a in leaves],
+        "dtypes": [str(a.dtype) for a in leaves],
         "sha256": digest,
     }
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        # Manifest first (length-prefixed JSON), then the npz payload —
-        # the same "metadata then stream" layout as the operator archive.
         mjson = json.dumps(manifest).encode()
+        f.write(MAGIC)
         f.write(len(mjson).to_bytes(8, "little"))
         f.write(mjson)
-        f.write(payload)
-    os.replace(tmp, path)  # atomic, like the snapshotter's rename
+        for arr in leaves:  # pass 2: stream the payload
+            f.write(arr.data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)  # atomic, like the serf snapshotter's rename
     return digest
+
+
+def _read_header(f: BinaryIO) -> dict:
+    """Shared header parser: magic + bounded length-prefixed JSON.
+    Raises a clean ValueError on any corruption in the header region."""
+    magic = f.read(len(MAGIC))
+    if magic != MAGIC:
+        raise ValueError(f"not a checkpoint (magic {magic!r} != {MAGIC!r})")
+    mlen = int.from_bytes(f.read(8), "little")
+    if not 0 < mlen <= _MAX_MANIFEST:
+        raise ValueError(f"corrupt checkpoint header (manifest length {mlen})")
+    try:
+        manifest = json.loads(f.read(mlen))
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise ValueError(f"corrupt checkpoint manifest: {e}") from e
+    if manifest.get("format_version") != FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format {manifest.get('format_version')} != "
+            f"{FORMAT_VERSION}"
+        )
+    if "sha256" not in manifest or "names" not in manifest:
+        raise ValueError("corrupt checkpoint manifest: missing fields")
+    return manifest
 
 
 def read_manifest(path: str) -> dict:
     with open(path, "rb") as f:
-        mlen = int.from_bytes(f.read(8), "little")
-        return json.loads(f.read(mlen))
+        return _read_header(f)
 
 
 def restore(path: str, template: Any, *, verify: bool = True) -> Any:
     """Load a checkpoint into the structure of ``template`` (an
-    ``init()``-produced pytree). Shape/dtype mismatches and payload
-    corruption raise before any tick runs."""
+    ``init()``-produced pytree). Structure/shape/dtype mismatches and
+    payload corruption raise before any tick runs."""
     with open(path, "rb") as f:
-        mlen = int.from_bytes(f.read(8), "little")
-        manifest = json.loads(f.read(mlen))
-        payload = f.read()
+        manifest = _read_header(f)
 
-    if manifest.get("format_version") != FORMAT_VERSION:
-        raise ValueError(
-            f"checkpoint format {manifest.get('format_version')} != {FORMAT_VERSION}"
-        )
-    if verify:
-        digest = hashlib.sha256(payload).hexdigest()
-        if digest != manifest["sha256"]:
+        t_leaves, treedef = jax.tree.flatten(template)
+        if len(t_leaves) != manifest["n_leaves"]:
             raise ValueError(
-                f"checkpoint payload digest mismatch: {digest[:12]}… != "
-                f"{manifest['sha256'][:12]}… (corrupt or truncated)"
+                f"checkpoint has {manifest['n_leaves']} leaves, template has "
+                f"{len(t_leaves)} — config/structure mismatch "
+                f"(saved names: {manifest['names'][:4]}…)"
             )
-
-    t_leaves, treedef = jax.tree.flatten(template)
-    if len(t_leaves) != manifest["n_leaves"]:
-        raise ValueError(
-            f"checkpoint has {manifest['n_leaves']} leaves, template has "
-            f"{len(t_leaves)} — config/structure mismatch "
-            f"(saved names: {manifest['names'][:4]}…)"
-        )
-    t_names = _leaf_names(template)
-    if t_names != manifest["names"]:
-        diffs = [
-            f"{saved!r} vs template {now!r}"
-            for saved, now in zip(manifest["names"], t_names)
-            if saved != now
-        ]
-        raise ValueError(
-            "checkpoint field names do not match the template (fields "
-            f"renamed/reordered since the save?): {diffs[:3]}"
-        )
-    with np.load(io.BytesIO(payload)) as z:
-        new_leaves = []
-        for i, (tleaf, name) in enumerate(zip(t_leaves, manifest["names"])):
-            arr = z[f"leaf_{i}"]
+        t_names = _leaf_names(template)
+        if t_names != manifest["names"]:
+            diffs = [
+                f"{saved!r} vs template {now!r}"
+                for saved, now in zip(manifest["names"], t_names)
+                if saved != now
+            ]
+            raise ValueError(
+                "checkpoint field names do not match the template (fields "
+                f"renamed/reordered since the save?): {diffs[:3]}"
+            )
+        for name, tleaf, shape, dtype in zip(
+            t_names, t_leaves, manifest["shapes"], manifest["dtypes"]
+        ):
             tarr = jnp.asarray(tleaf)
-            if tuple(arr.shape) != tuple(tarr.shape) or str(arr.dtype) != str(
-                tarr.dtype
-            ):
+            if tuple(shape) != tuple(tarr.shape) or dtype != str(tarr.dtype):
                 raise ValueError(
-                    f"leaf {name}: checkpoint {arr.dtype}{list(arr.shape)} vs "
+                    f"leaf {name}: checkpoint {dtype}{list(shape)} vs "
                     f"template {tarr.dtype}{list(tarr.shape)} — was the "
                     f"checkpoint written with a different SimConfig?"
                 )
-            new_leaves.append(jnp.asarray(arr))
-    return jax.tree.unflatten(treedef, new_leaves)
+
+        # Stream the payload one leaf at a time, hashing as we go.
+        h = hashlib.sha256()
+        arrays = []
+        for shape, dtype in zip(manifest["shapes"], manifest["dtypes"]):
+            nbytes = int(np.dtype(dtype).itemsize * int(np.prod(shape or [1])))
+            raw = f.read(nbytes)
+            if len(raw) != nbytes:
+                raise ValueError("checkpoint payload truncated")
+            h.update(raw)
+            arrays.append(np.frombuffer(raw, dtype=dtype).reshape(shape))
+
+    if verify and h.hexdigest() != manifest["sha256"]:
+        raise ValueError(
+            f"checkpoint payload digest mismatch: {h.hexdigest()[:12]}… != "
+            f"{manifest['sha256'][:12]}… (corrupt or truncated)"
+        )
+    return jax.tree.unflatten(treedef, [jnp.asarray(a) for a in arrays])

@@ -1,0 +1,194 @@
+"""RPC endpoint tests over the in-process 3-server cluster — the
+pattern of the reference's *_endpoint_test.go files (TestAgent +
+joinLAN + RPC assertions), incl. coordinate batching and ?near= sorting
+(reference agent/consul/coordinate_endpoint_test.go, rtt.go tests)."""
+
+import math
+
+import pytest
+
+from consul_tpu.server.endpoints import (
+    COORDINATE_UPDATE_BATCH_SIZE,
+    COORDINATE_UPDATE_MAX_BATCHES,
+    ServerCluster,
+)
+from consul_tpu.server.rtt import compute_distance, coord_sets_from_store
+
+
+def coord(vec, height=0.01, adjustment=0.0):
+    v = list(vec) + [0.0] * (8 - len(vec))
+    return {"vec": v, "error": 1.5, "height": height, "adjustment": adjustment}
+
+
+@pytest.fixture
+def cluster():
+    c = ServerCluster(3, seed=1)
+    c.wait_converged()
+    return c
+
+
+class TestCatalogHealth:
+    def test_register_via_follower_forwards(self, cluster):
+        follower = cluster.any_follower()
+        cluster.write(follower, "Catalog.Register", node="n1",
+                      address="10.0.0.1",
+                      service={"id": "web1", "service": "web", "port": 80},
+                      check={"check_id": "c1", "status": "passing",
+                             "service_id": "web1"})
+        assert follower.metrics["rpc_forwarded"] >= 1
+        # Replicated everywhere, readable from any server.
+        for s in cluster.servers:
+            out = s.rpc("Catalog.ListNodes")
+            assert [n["node"] for n in out["value"]] == ["n1"]
+        out = cluster.servers[0].rpc("Health.ServiceNodes", service="web")
+        assert out["value"][0]["aggregate_status"] == "passing"
+
+    def test_passing_only_filters_critical(self, cluster):
+        leader = cluster.leader_server()
+        for i, status in enumerate(["passing", "critical"]):
+            cluster.write(leader, "Catalog.Register", node=f"n{i}",
+                          address=f"10.0.0.{i}",
+                          service={"id": "web", "service": "web"},
+                          check={"check_id": "c", "status": status,
+                                 "service_id": "web"})
+        out = leader.rpc("Health.ServiceNodes", service="web",
+                         passing_only=True)
+        assert [r["node"] for r in out["value"]] == ["n0"]
+
+    def test_status_endpoint(self, cluster):
+        led = cluster.raft.wait_leader()
+        s = cluster.servers[0]
+        assert s.rpc("Status.Leader") == led.id
+        assert len(s.rpc("Status.Peers")) == 3
+
+
+class TestKVSession:
+    def test_kv_roundtrip_and_blocking_index(self, cluster):
+        leader = cluster.leader_server()
+        cluster.write(leader, "KVS.Apply", op="set", key="cfg/x", value=b"1")
+        out = leader.rpc("KVS.Get", key="cfg/x")
+        assert out["value"]["value"] == b"1"
+        idx = out["index"]
+        cluster.write(leader, "KVS.Apply", op="set", key="cfg/x", value=b"2")
+        out2 = leader.rpc("KVS.Get", key="cfg/x", min_index=idx, wait_s=5.0)
+        assert out2["value"]["value"] == b"2" and out2["index"] > idx
+
+    def test_session_lock_via_txn(self, cluster):
+        leader = cluster.leader_server()
+        cluster.write(leader, "Catalog.Register", node="n1", address="a")
+        sid = cluster.write(leader, "Session.Apply", op="create", node="n1")
+        cluster.write(leader, "KVS.Apply", op="lock", key="lead", value=b"me",
+                      session=sid)
+        assert leader.store.kv_get("lead")["session"] == sid
+        cluster.write(leader, "Session.Apply", op="destroy", session_id=sid)
+        assert leader.store.kv_get("lead")["session"] is None
+
+    def test_txn_atomicity(self, cluster):
+        leader = cluster.leader_server()
+        cluster.write(leader, "Txn.Apply", ops=[
+            {"type": "kv", "op": "set", "key": "a", "value": b"1"},
+            {"type": "kv", "op": "set", "key": "b", "value": b"2"},
+        ])
+        assert leader.store.kv_get("a")["value"] == b"1"
+        assert leader.store.kv_get("b")["value"] == b"2"
+
+
+class TestCoordinates:
+    def test_update_batches_through_raft(self, cluster):
+        leader = cluster.leader_server()
+        cluster.write(leader, "Catalog.Register", node="n1", address="a")
+        cluster.write(leader, "Catalog.Register", node="n2", address="b")
+        leader.rpc("Coordinate.Update", node="n1", coord=coord([1.0]))
+        leader.rpc("Coordinate.Update", node="n2", coord=coord([2.0]))
+        assert leader.store.coordinates() == []  # staged, not yet flushed
+        idxs = leader.flush_coordinates()
+        for _ in range(50):
+            cluster.step()
+        assert len(idxs) == 1
+        # Replicated to every server's store.
+        for s in cluster.servers:
+            assert len(s.store.coordinates()) == 2
+
+    def test_update_validates(self, cluster):
+        leader = cluster.leader_server()
+        with pytest.raises(ValueError, match="dimensionality"):
+            leader.rpc("Coordinate.Update", node="n", coord={"vec": [1.0]})
+        bad = coord([1.0])
+        bad["vec"][3] = math.nan
+        with pytest.raises(ValueError, match="non-finite"):
+            leader.rpc("Coordinate.Update", node="n", coord=bad)
+
+    def test_update_via_follower_forwards_to_leader(self, cluster):
+        leader = cluster.leader_server()
+        follower = cluster.any_follower()
+        cluster.write(leader, "Catalog.Register", node="n1", address="a")
+        follower.rpc("Coordinate.Update", node="n1", coord=coord([1.0]))
+        assert leader._coord_updates  # staged at the leader
+        leader.flush_coordinates()
+        cluster.step(30)
+        assert follower.store.coordinate_for("n1") is not None
+
+    def test_rate_limit_discards(self, cluster):
+        leader = cluster.leader_server()
+        cap = COORDINATE_UPDATE_BATCH_SIZE * COORDINATE_UPDATE_MAX_BATCHES
+        for i in range(cap + 10):
+            leader.rpc("Coordinate.Update", node=f"n{i}", coord=coord([i]))
+        assert leader.metrics["coordinate_updates_discarded"] == 10
+
+    def test_dedupe_by_node_segment(self, cluster):
+        leader = cluster.leader_server()
+        cluster.write(leader, "Catalog.Register", node="n1", address="a")
+        leader.rpc("Coordinate.Update", node="n1", coord=coord([1.0]))
+        leader.rpc("Coordinate.Update", node="n1", coord=coord([9.0]))
+        leader.flush_coordinates()
+        cluster.step(30)
+        coords = leader.store.coordinates()
+        assert len(coords) == 1 and coords[0]["coord"]["vec"][0] == 9.0
+
+
+class TestRTTSort:
+    def test_near_sorting(self, cluster):
+        leader = cluster.leader_server()
+        # Plant three nodes on a line: n0 at 0, n1 at 10ms, n2 at 20ms.
+        for i in range(3):
+            cluster.write(leader, "Catalog.Register", node=f"n{i}",
+                          address=f"10.0.0.{i}",
+                          service={"id": "web", "service": "web"})
+            leader.rpc("Coordinate.Update", node=f"n{i}",
+                       coord=coord([i * 0.010], height=0.0))
+        leader.flush_coordinates()
+        cluster.step(30)
+        out = leader.rpc("Catalog.ListNodes", near="n2")
+        assert [n["node"] for n in out["value"]] == ["n2", "n1", "n0"]
+        out = leader.rpc("Catalog.ServiceNodes", service="web", near="n0")
+        assert [n["node"] for n in out["value"]] == ["n0", "n1", "n2"]
+
+    def test_unknown_coordinate_sorts_last(self, cluster):
+        leader = cluster.leader_server()
+        for i in range(3):
+            cluster.write(leader, "Catalog.Register", node=f"n{i}",
+                          address=f"10.0.0.{i}")
+        # Only n0 and n2 have coordinates.
+        leader.rpc("Coordinate.Update", node="n0", coord=coord([0.0]))
+        leader.rpc("Coordinate.Update", node="n2", coord=coord([0.005]))
+        leader.flush_coordinates()
+        cluster.step(30)
+        out = leader.rpc("Catalog.ListNodes", near="n0")
+        assert [n["node"] for n in out["value"]] == ["n0", "n2", "n1"]
+
+    def test_compute_distance_semantics(self):
+        a = {"vec": [0.0, 0.0], "height": 0.001, "adjustment": 0.0}
+        b = {"vec": [0.003, 0.004], "height": 0.002, "adjustment": 0.0}
+        # 3-4-5 triangle: 5ms + heights 3ms = 8ms.
+        assert compute_distance(a, b) == pytest.approx(0.008)
+        assert compute_distance(a, None) == math.inf
+        assert compute_distance(a, {"vec": [1.0]}) == math.inf
+
+    def test_coord_sets_intersect_segments(self):
+        sets = coord_sets_from_store([
+            {"node": "a", "segment": "", "coord": {"vec": [0.0]}},
+            {"node": "a", "segment": "s1", "coord": {"vec": [1.0]}},
+            {"node": "b", "segment": "", "coord": {"vec": [2.0]}},
+        ])
+        assert set(sets["a"]) == {"", "s1"}
+        assert set(sets["b"]) == {""}

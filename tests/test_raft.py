@@ -1,0 +1,257 @@
+"""Raft-lite + FSM tests: election, replication, partitions, restart
+catch-up, snapshot install, determinism — the behaviors the reference's
+vendored raft guarantees and its leader tests exercise by killing and
+partitioning in-process servers (reference agent/consul/leader_test.go,
+vendor raft inmem_transport idioms)."""
+
+import pytest
+
+from consul_tpu.server import fsm as fsm_mod
+from consul_tpu.server.fsm import FSM
+from consul_tpu.server.raft import LEADER, NotLeader, RaftCluster
+from consul_tpu.server.state_store import StateStore
+
+
+def make_cluster(n=3, seed=0, snapshot_threshold=1024):
+    fsms = {}
+
+    def apply_factory(node_id):
+        fsms[node_id] = FSM(StateStore())
+        return fsms[node_id].apply
+
+    cluster = RaftCluster(
+        n, apply_factory, seed=seed, snapshot_threshold=snapshot_threshold,
+        snapshot_factory=lambda nid: fsms[nid].snapshot,
+        restore_factory=lambda nid: fsms[nid].restore,
+    )
+    return cluster, fsms
+
+
+def reg(node, addr="10.0.0.1"):
+    return {"type": fsm_mod.REGISTER, "node": node, "address": addr}
+
+
+class TestElection:
+    def test_single_leader_elected(self):
+        cluster, _ = make_cluster()
+        led = cluster.wait_converged()
+        assert sum(1 for n in cluster.nodes.values() if n.state == LEADER) == 1
+        assert all(n.leader_id == led.id for n in cluster.nodes.values())
+
+    def test_leader_failover(self):
+        cluster, _ = make_cluster()
+        led = cluster.wait_leader()
+        led.stop()
+        cluster.step(50)
+        new = cluster.leader()
+        assert new is not None and new.id != led.id
+        assert new.term > led.term
+
+    def test_minority_partition_cannot_commit(self):
+        cluster, _ = make_cluster(5)
+        old = cluster.wait_leader()
+        # Isolate the current leader: it may keep believing it leads
+        # (it cannot know), but it can never commit; the majority side
+        # elects a distinct leader that can.
+        for other in cluster.nodes:
+            if other != old.id:
+                cluster.transport.partition(old.id, other)
+        try:
+            stale = old.propose(reg("stale"))
+        except NotLeader:
+            stale = None
+        cluster.step(100)
+        if stale is not None:
+            assert old.commit_index < stale
+        majority = [n for n in cluster.nodes.values()
+                    if n.state == LEADER and n.id != old.id]
+        assert len(majority) == 1
+        idx = majority[0].propose(reg("fresh"))
+        cluster.step(30)
+        assert majority[0].commit_index >= idx
+
+    def test_non_leader_propose_raises_with_hint(self):
+        cluster, _ = make_cluster()
+        led = cluster.wait_converged()
+        follower = next(n for n in cluster.nodes.values() if n.id != led.id)
+        with pytest.raises(NotLeader) as e:
+            follower.propose({"x": 1})
+        assert e.value.leader_hint == led.id
+
+
+class TestReplication:
+    def test_commit_applies_on_all(self):
+        cluster, fsms = make_cluster()
+        cluster.propose_and_commit(reg("n1"))
+        cluster.step(10)
+        for f in fsms.values():
+            assert f.store.get_node("n1")["address"] == "10.0.0.1"
+
+    def test_identical_indexes_across_replicas(self):
+        cluster, fsms = make_cluster()
+        cluster.propose_and_commit(reg("n1"))
+        cluster.propose_and_commit(
+            {"type": fsm_mod.KV, "op": "set", "key": "k", "value": b"v"}
+        )
+        cluster.step(10)
+        idxs = {f.store.kv_get("k")["modify_index"] for f in fsms.values()}
+        assert len(idxs) == 1
+
+    def test_restarted_node_catches_up(self):
+        cluster, fsms = make_cluster()
+        led = cluster.wait_leader()
+        victim = next(n for n in cluster.nodes.values() if n.id != led.id)
+        victim.stop()
+        for i in range(5):
+            cluster.propose_and_commit(reg(f"n{i}"))
+        victim.restart()
+        cluster.step(30)
+        assert len(fsms[victim.id].store.nodes()) == 5
+
+    def test_partition_heals_and_converges(self):
+        cluster, fsms = make_cluster()
+        led = cluster.wait_leader()
+        other = next(n for n in cluster.nodes.values() if n.id != led.id)
+        cluster.transport.partition(led.id, other.id)
+        cluster.propose_and_commit(reg("nA"))
+        cluster.transport.heal()
+        cluster.step(30)
+        assert fsms[other.id].store.get_node("nA") is not None
+
+    def test_stale_leader_entries_discarded(self):
+        # A leader partitioned from the quorum keeps accepting proposes
+        # but can never commit them; after healing, its uncommitted
+        # entries are overwritten by the new leader's log.
+        cluster, fsms = make_cluster(3)
+        led = cluster.wait_leader()
+        for other in cluster.nodes:
+            if other != led.id:
+                cluster.transport.partition(led.id, other)
+        stale_idx = led.propose(reg("stale"))
+        cluster.step(60)
+        assert led.commit_index < stale_idx
+        new = cluster.leader() or cluster.wait_leader()
+        assert new.id != led.id
+        new.propose(reg("fresh"))
+        cluster.transport.heal()
+        cluster.step(60)
+        for f in fsms.values():
+            assert f.store.get_node("stale") is None
+            assert f.store.get_node("fresh") is not None
+
+
+class TestApplySafety:
+    def test_bad_committed_entry_does_not_kill_cluster(self):
+        # Endpoint validation is the gate; if a bad entry slips into the
+        # log anyway, the apply loop records it and keeps going.
+        cluster, fsms = make_cluster()
+        led = cluster.wait_converged()
+        idx = led.propose({"type": fsm_mod.REGISTER, "node": "n1",
+                           "address": "a",
+                           "check": {"check_id": "c", "status": "bogus"}})
+        cluster.step(30)
+        assert led.commit_index >= idx  # still committed
+        assert led.apply_errors and led.apply_errors[0][0] == idx
+        # Cluster still works afterwards.
+        cluster.propose_and_commit(reg("n2"))
+        cluster.step(10)
+        for f in fsms.values():
+            assert f.store.get_node("n2") is not None
+
+    def test_new_leader_noop_commits_prior_term_entries(self):
+        cluster, _ = make_cluster()
+        led = cluster.wait_converged()
+        led.stop()
+        cluster.step(60)
+        new = cluster.leader()
+        assert new is not None
+        # The election no-op commits without any client write.
+        for _ in range(30):
+            cluster.step()
+        assert new.commit_index >= new.last_log_index() > 0
+
+    def test_deposed_leader_clears_leader_id(self):
+        from consul_tpu.server.raft import Message
+
+        cluster, _ = make_cluster()
+        led = cluster.wait_converged()
+        led.handle(Message("request_vote", "srvX", led.id, led.term + 1,
+                           {"last_log_index": 10**6, "last_log_term": 10**6}))
+        assert led.state == "follower" and led.leader_id is None
+
+
+class TestSnapshot:
+    def test_compaction_and_install(self):
+        cluster, fsms = make_cluster(3, snapshot_threshold=8)
+        led = cluster.wait_leader()
+        victim = next(n for n in cluster.nodes.values() if n.id != led.id)
+        victim.stop()
+        for i in range(20):
+            cluster.propose_and_commit(reg(f"n{i}"))
+        led2 = cluster.leader()
+        assert led2.log_base_index > 0  # compacted
+        victim.restart()
+        cluster.step(60)
+        assert len(fsms[victim.id].store.nodes()) == 20
+        assert fsms[victim.id].store.get_node("n0") is not None
+
+
+class TestDeterminism:
+    def test_same_seed_same_trajectory(self):
+        def trajectory(seed):
+            cluster, _ = make_cluster(3, seed=seed)
+            led = cluster.wait_leader()
+            return (led.id, led.term,
+                    [n.term for n in cluster.nodes.values()])
+
+        assert trajectory(42) == trajectory(42)
+
+
+class TestFSM:
+    def test_txn_all_or_nothing(self):
+        f = FSM(StateStore())
+        f.apply(1, reg("n1"))
+        f.apply(2, {"type": fsm_mod.KV, "op": "set", "key": "a", "value": b"1"})
+        cur = f.store.kv_get("a")["modify_index"]
+        out = f.apply(3, {"type": fsm_mod.TXN, "ops": [
+            {"type": fsm_mod.KV, "op": "cas", "key": "a", "value": b"2",
+             "cas_index": cur + 999},
+            {"type": fsm_mod.KV, "op": "set", "key": "b", "value": b"x"},
+        ]})
+        assert out["ok"] is False
+        assert f.store.kv_get("b") is None  # nothing applied
+        out = f.apply(4, {"type": fsm_mod.TXN, "ops": [
+            {"type": fsm_mod.KV, "op": "cas", "key": "a", "value": b"2",
+             "cas_index": cur},
+            {"type": fsm_mod.KV, "op": "set", "key": "b", "value": b"x"},
+        ]})
+        assert out["ok"] is True
+        assert f.store.kv_get("a")["value"] == b"2"
+        assert f.store.kv_get("b")["value"] == b"x"
+
+    def test_txn_rolls_back_on_mid_batch_failure(self):
+        f = FSM(StateStore())
+        out = f.apply(1, {"type": fsm_mod.TXN, "ops": [
+            {"type": fsm_mod.KV, "op": "set", "key": "a", "value": b"1"},
+            {"type": fsm_mod.SESSION, "op": "create", "id": "s",
+             "node": "ghost"},  # fails: node not registered
+        ]})
+        assert out["ok"] is False
+        assert f.store.kv_get("a") is None  # rolled back
+
+    def test_register_full_payload(self):
+        f = FSM(StateStore())
+        f.apply(1, {"type": fsm_mod.REGISTER, "node": "n1", "address": "a",
+                    "service": {"id": "web1", "service": "web", "port": 80},
+                    "check": {"check_id": "c1", "status": "passing",
+                              "service_id": "web1"}})
+        assert f.store.service_nodes("web")[0]["port"] == 80
+        assert f.store.checks(node="n1")[0]["status"] == "passing"
+
+    def test_coordinate_batch(self):
+        f = FSM(StateStore())
+        f.apply(1, reg("n1"))
+        f.apply(2, {"type": fsm_mod.COORDINATE_BATCH_UPDATE, "updates": [
+            {"node": "n1", "coord": {"vec": [1.0, 2.0]}},
+        ]})
+        assert f.store.coordinate_for("n1")["coord"]["vec"] == [1.0, 2.0]

@@ -1,0 +1,190 @@
+"""State store tests: indexes, watches/blocking queries, cascading
+deletes, sessions, KV CAS/locks — mirroring the reference's state
+package unit tests (reference agent/consul/state/*_test.go)."""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu.server.state_store import StateStore
+
+
+@pytest.fixture
+def store():
+    s = StateStore()
+    s.ensure_node("n1", "10.0.0.1")
+    s.ensure_node("n2", "10.0.0.2")
+    return s
+
+
+class TestCatalog:
+    def test_indexes_monotonic(self, store):
+        i1 = store.ensure_service("n1", "web", "web", 80)
+        i2 = store.ensure_check("n1", "web-check", "passing", "web")
+        assert i2 > i1 > 0
+        assert store.tables["services"].max_index == i1
+
+    def test_service_nodes_with_address(self, store):
+        store.ensure_service("n1", "web", "web", 80, tags=["v1"])
+        store.ensure_service("n2", "web2", "web", 81)
+        rows = store.service_nodes("web")
+        assert {r["address"] for r in rows} == {"10.0.0.1", "10.0.0.2"}
+        assert [r["id"] for r in store.service_nodes("web", tag="v1")] == ["web"]
+
+    def test_unknown_node_service_rejected(self, store):
+        with pytest.raises(KeyError):
+            store.ensure_service("ghost", "s", "s")
+
+    def test_delete_node_cascades(self, store):
+        store.ensure_service("n1", "web", "web", 80)
+        store.ensure_check("n1", "c1", "passing", "web")
+        store.coordinate_batch_update([{"node": "n1", "coord": {"vec": [0.0]}}])
+        store.session_create("sess1", "n1")
+        store.delete_node("n1")
+        assert store.get_node("n1") is None
+        assert store.service_nodes("web") == []
+        assert store.checks(node="n1") == []
+        assert store.coordinate_for("n1") is None
+        assert store.session_get("sess1") is None
+
+    def test_node_health_worst_wins(self, store):
+        store.ensure_check("n1", "a", "passing")
+        store.ensure_check("n1", "b", "warning")
+        assert store.node_health("n1") == "warning"
+        store.ensure_check("n1", "c", "critical")
+        assert store.node_health("n1") == "critical"
+
+
+class TestKV:
+    def test_set_get_delete(self, store):
+        store.kv_set("a/b", b"v1", flags=7)
+        got = store.kv_get("a/b")
+        assert got["value"] == b"v1" and got["flags"] == 7
+        assert [r["key"] for r in store.kv_list("a/")] == ["a/b"]
+        store.kv_delete("a/b")
+        assert store.kv_get("a/b") is None
+
+    def test_cas(self, store):
+        idx, ok = store.kv_set("k", b"v1")
+        assert ok
+        _, ok = store.kv_set("k", b"v2", cas_index=idx + 999)
+        assert not ok
+        assert store.kv_get("k")["value"] == b"v1"
+        _, ok = store.kv_set("k", b"v2", cas_index=idx)
+        assert ok
+
+    def test_cas_create_only(self, store):
+        _, ok = store.kv_set("new", b"v", cas_index=0)
+        assert ok
+        _, ok = store.kv_set("new", b"v2", cas_index=0)
+        assert not ok
+
+    def test_recurse_delete(self, store):
+        for k in ("p/a", "p/b", "q/c"):
+            store.kv_set(k, b"v")
+        store.kv_delete("p/", recurse=True)
+        assert [r["key"] for r in store.kv_list()] == ["q/c"]
+
+    def test_lock_semantics(self, store):
+        # Acquire requires a live session; second session cannot steal
+        # (reference api lock recipe over state/kvs.go lock flags).
+        store.session_create("s1", "n1")
+        store.session_create("s2", "n2")
+        _, ok = store.kv_set("lock", b"x", session="s1")
+        assert ok
+        _, ok = store.kv_set("lock", b"y", session="s2")
+        assert not ok
+        # Destroying the holder releases the lock (behavior=release).
+        store.session_destroy("s1")
+        assert store.kv_get("lock")["session"] is None
+        _, ok = store.kv_set("lock", b"y", session="s2")
+        assert ok
+
+    def test_session_delete_behavior(self, store):
+        store.session_create("s1", "n1", behavior="delete")
+        store.kv_set("ephemeral", b"x", session="s1")
+        store.session_destroy("s1")
+        assert store.kv_get("ephemeral") is None
+
+
+class TestCoordinates:
+    def test_batch_update_skips_unknown(self, store):
+        idx = store.coordinate_batch_update([
+            {"node": "n1", "coord": {"vec": [1.0]}},
+            {"node": "ghost", "coord": {"vec": [2.0]}},
+        ])
+        assert idx > 0
+        assert store.coordinate_for("n1")["coord"]["vec"] == [1.0]
+        assert store.coordinate_for("ghost") is None
+
+    def test_segments_are_distinct(self, store):
+        store.coordinate_batch_update([
+            {"node": "n1", "segment": "", "coord": {"vec": [1.0]}},
+            {"node": "n1", "segment": "alpha", "coord": {"vec": [2.0]}},
+        ])
+        assert store.coordinate_for("n1")["coord"]["vec"] == [1.0]
+        assert store.coordinate_for("n1", "alpha")["coord"]["vec"] == [2.0]
+
+
+class TestBlockingQueries:
+    def test_immediate_when_index_newer(self, store):
+        idx, nodes = store.blocking_query(["nodes"], 0, store.nodes)
+        assert len(nodes) == 2 and idx > 0
+
+    def test_blocks_until_write(self, store):
+        start_idx = store.tables["nodes"].max_index
+        result = {}
+
+        def reader():
+            idx, nodes = store.blocking_query(
+                ["nodes"], start_idx, store.nodes, timeout_s=5.0
+            )
+            result["idx"], result["n"] = idx, len(nodes)
+
+        th = threading.Thread(target=reader)
+        th.start()
+        time.sleep(0.1)
+        assert "idx" not in result  # still blocked
+        store.ensure_node("n3", "10.0.0.3")
+        th.join(timeout=5)
+        assert result["n"] == 3 and result["idx"] > start_idx
+
+    def test_timeout_returns_unchanged(self, store):
+        t0 = time.monotonic()
+        idx, _ = store.blocking_query(
+            ["kv"], store.index + 100, lambda: None, timeout_s=0.15
+        )
+        assert 0.1 < time.monotonic() - t0 < 2.0
+
+    def test_unrelated_table_does_not_wake_early(self, store):
+        start_idx = store.tables["kv"].max_index
+        done = threading.Event()
+
+        def reader():
+            store.blocking_query(["kv"], max(start_idx, 1) if start_idx else 1,
+                                 lambda: None, timeout_s=1.0)
+            done.set()
+
+        th = threading.Thread(target=reader)
+        th.start()
+        time.sleep(0.05)
+        store.ensure_node("n9", "10.0.0.9")  # touches nodes, not kv
+        assert not done.wait(0.2)  # reader still blocked on kv
+        store.kv_set("wake", b"x")
+        assert done.wait(5)
+        th.join()
+
+
+class TestSnapshotRestore:
+    def test_roundtrip(self, store):
+        store.ensure_service("n1", "web", "web", 80)
+        store.kv_set("k", b"v")
+        store.coordinate_batch_update([{"node": "n1", "coord": {"vec": [3.0]}}])
+        snap = store.snapshot()
+        other = StateStore()
+        other.restore(snap)
+        assert other.index == store.index
+        assert other.get_node("n1")["address"] == "10.0.0.1"
+        assert other.kv_get("k")["value"] == b"v"
+        assert other.coordinate_for("n1")["coord"]["vec"] == [3.0]
