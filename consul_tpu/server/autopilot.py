@@ -1,0 +1,102 @@
+"""Autopilot: server health scoring and dead-server cleanup.
+
+Mirrors the reference autopilot subsystem (reference
+agent/consul/autopilot/autopilot.go, structs.go): each server gets a
+health verdict from its raft progress (leader contact recency, log
+lag, term agreement); unhealthy *failed* servers are removed from the
+raft configuration automatically, but only when removal cannot break
+quorum (``canRemoveServers`` autopilot.go) — the guard that makes the
+cleanup safe.
+
+Membership change here is the simplified single-op reconfiguration of
+raft-lite: the cluster driver removes the peer from every node's peer
+list and the transport (the reference pipes this through raft
+RemoveServer; the safety rule is the same).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from consul_tpu.server.raft import RaftCluster, RaftNode
+
+# Reference defaults (agent/consul/config.go AutopilotConfig /
+# autopilot/structs.go): contact threshold 200ms, max trailing logs 250.
+LAST_CONTACT_THRESHOLD_TICKS = 10
+MAX_TRAILING_LOGS = 250
+
+
+@dataclasses.dataclass
+class ServerHealth:
+    id: str
+    healthy: bool
+    voter: bool
+    last_contact_ticks: Optional[int]
+    trailing_logs: int
+    reason: str = ""
+
+
+def server_health(cluster: RaftCluster, node: RaftNode,
+                  leader: RaftNode) -> ServerHealth:
+    """Health verdict for one server from the leader's vantage point
+    (reference autopilot.go updateServerHealth / queryServerHealth)."""
+    if node.stopped:
+        return ServerHealth(node.id, False, True, None, 0, "not responding")
+    if node.id == leader.id:
+        return ServerHealth(node.id, True, True, 0, 0)
+    match = leader.match_index.get(node.id, 0)
+    trailing = leader.last_log_index() - match
+    if node.term != leader.term:
+        return ServerHealth(node.id, False, True, None, trailing,
+                            f"term {node.term} != leader term {leader.term}")
+    if trailing > MAX_TRAILING_LOGS:
+        return ServerHealth(node.id, False, True, None, trailing,
+                            f"trailing {trailing} logs")
+    return ServerHealth(node.id, True, True, 0, trailing)
+
+
+def cluster_health(cluster: RaftCluster) -> list[ServerHealth]:
+    leader = cluster.leader()
+    if leader is None:
+        return []
+    return [server_health(cluster, n, leader)
+            for n in cluster.nodes.values()]
+
+
+def can_remove_servers(n_peers: int, n_remove: int) -> bool:
+    """Quorum-preservation guard (reference autopilot.go
+    canRemoveServers): removal is allowed only while the remaining
+    voters still form a majority of the *original* configuration."""
+    remaining = n_peers - n_remove
+    return remaining >= (n_peers // 2) + 1
+
+
+def remove_server(cluster: RaftCluster, server_id: str) -> None:
+    """Apply the membership change: drop the server from every peer
+    list and the transport (raft-lite's out-of-band reconfiguration)."""
+    for node in cluster.nodes.values():
+        if server_id in node.peers:
+            node.peers.remove(server_id)
+        node.next_index.pop(server_id, None)
+        node.match_index.pop(server_id, None)
+    node = cluster.nodes.pop(server_id, None)
+    if node is not None:
+        node.stop()
+    cluster.transport.nodes.pop(server_id, None)
+    cluster.transport.queues.pop(server_id, None)
+
+
+def clean_dead_servers(cluster: RaftCluster) -> list[str]:
+    """Remove failed servers, quorum permitting (reference
+    autopilot.go pruneDeadServers). Returns removed ids."""
+    leader = cluster.leader()
+    if leader is None:
+        return []
+    dead = [h.id for h in cluster_health(cluster)
+            if not h.healthy and h.reason == "not responding"]
+    if not dead or not can_remove_servers(len(cluster.nodes), len(dead)):
+        return []
+    for sid in dead:
+        remove_server(cluster, sid)
+    return dead

@@ -182,7 +182,10 @@ class Server:
             for svc in self.store.service_nodes(service):
                 checks = self.store.checks(node=svc["node"])
                 health = self.store.node_health(svc["node"])
-                if passing_only and health == "critical":
+                # ?passing drops anything not fully passing, warnings
+                # included (reference health_endpoint.go filterNonPassing:
+                # check.Status != api.HealthPassing).
+                if passing_only and health != "passing":
                     continue
                 rows.append({"node": svc["node"], "service": svc,
                              "checks": checks, "aggregate_status": health})
@@ -233,6 +236,12 @@ class Server:
                        ttl_s: float = 0.0, behavior: str = "release",
                        checks: Optional[list] = None) -> Any:
         if op == "create":
+            # Validate before proposing (like the catalog endpoint): a
+            # committed entry must not fail on apply. The local store
+            # may be marginally stale on a follower; the FSM/raft
+            # apply-error backstop covers that residual race.
+            if self.store.get_node(node) is None:
+                raise KeyError(f"node {node!r} not registered")
             session_id = session_id or str(uuid.uuid4())
             self._raft_apply({
                 "type": fsm_mod.SESSION, "op": "create", "id": session_id,

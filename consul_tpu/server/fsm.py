@@ -23,6 +23,19 @@ COORDINATE_BATCH_UPDATE = "coordinate-batch-update"
 CONFIG_ENTRY = "config-entry"
 TXN = "txn"
 
+# Tables each op type can write (for scoped TXN undo logs). KV ops can
+# cascade into sessions? No — but session destroys cascade into kv, and
+# node deletes cascade widely; keep cascading types conservative.
+_TXN_TABLES: dict[str, set] = {
+    KV: {"kv"},
+    SESSION: {"sessions", "kv"},
+    COORDINATE_BATCH_UPDATE: {"coordinates"},
+    CONFIG_ENTRY: {"config_entries"},
+    REGISTER: {"nodes", "services", "checks"},
+    DEREGISTER: {"nodes", "services", "checks", "coordinates",
+                 "sessions", "kv"},
+}
+
 
 class FSM:
     def __init__(self, store: StateStore | None = None):
@@ -62,7 +75,12 @@ class FSM:
             return self.store.delete_node(r["node"], index=index)
         if mtype == KV:
             op = command["op"]
-            if op in ("set", "cas", "lock", "unlock"):
+            if op == "unlock":
+                _, ok = self.store.kv_unlock(command["key"],
+                                             command.get("session"),
+                                             index=index)
+                return ok
+            if op in ("set", "cas", "lock"):
                 _, ok = self.store.kv_set(
                     command["key"], command.get("value", b""),
                     command.get("flags", 0),
@@ -107,11 +125,24 @@ class FSM:
                     cur = e["modify_index"] if e else 0
                     if cur != op.get("cas_index", 0):
                         return {"ok": False, "failed": op["key"]}
-            undo = self.store.snapshot()
+            # Undo log covers only the tables this batch can touch —
+            # O(touched tables), not O(store) (the reference's memdb
+            # txn abort is similarly scoped to written radix nodes).
+            touched: set = set()
+            for op in command["ops"]:
+                touched |= _TXN_TABLES.get(op["type"], set(StateStore.TABLES))
+            undo = self.store.snapshot(tables=touched)
             results = []
             try:
                 for op in command["ops"]:
-                    results.append(self.apply(index, op))
+                    result = self.apply(index, op)
+                    # Ops that *return* failure (lock/unlock/CAS inside
+                    # the batch) abort the TXN just like ops that raise.
+                    if result is False:
+                        self.store.restore(undo)
+                        return {"ok": False,
+                                "failed": op.get("key", op["type"])}
+                    results.append(result)
             except Exception as e:  # noqa: BLE001
                 self.store.restore(undo)
                 return {"ok": False, "error": repr(e)}

@@ -205,6 +205,9 @@ class RaftNode:
             # (raft §5.4.2; hashicorp/raft's LogNoop on election).
             self.log.append(LogEntry(self.term, nxt, {"type": "noop"}))
             self._broadcast_appends()
+            # A single-node cluster is its own quorum (dev mode,
+            # reference raftInmem server.go:177) — commit immediately.
+            self._advance_commit()
 
     # ------------------------------------------------------------------
     # Replication (raft §5.3)
@@ -218,6 +221,7 @@ class RaftNode:
         entry = LogEntry(self.term, self.last_log_index() + 1, command)
         self.log.append(entry)
         self._broadcast_appends()
+        self._advance_commit()  # no-op unless we alone are a quorum
         return entry.index
 
     def _broadcast_appends(self):

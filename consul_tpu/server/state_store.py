@@ -304,6 +304,21 @@ class StateStore:
                    (e.value.get("session") if e else None)}
             return self._commit("kv", key, val, index=index), True
 
+    def kv_unlock(self, key: str, session: str,
+                  index: Optional[int] = None) -> tuple[int, bool]:
+        """Release a lock held by ``session`` (reference KVUnlock verb,
+        state/kvs.go kvsUnlockTxn: fails unless that session holds it)."""
+        with self._lock:
+            e = self.tables["kv"].rows.get(key)
+            if session is None or e is None or \
+                    e.value.get("session") != session:
+                return self.index, False
+            return (
+                self._commit("kv", key, e.value | {"session": None},
+                             index=index),
+                True,
+            )
+
     def kv_get(self, key: str) -> Optional[dict]:
         with self._lock:
             e = self.tables["kv"].rows.get(key)
@@ -453,17 +468,23 @@ class StateStore:
     # Snapshot / restore (reference fsm/snapshot*.go persists every
     # table including coordinates)
     # ------------------------------------------------------------------
-    def snapshot(self) -> dict:
+    def snapshot(self, tables: Optional[Iterable[str]] = None) -> dict:
+        """Deep-copy the named tables (all by default). A subset makes a
+        cheap undo log for transactions that touch few tables."""
+        names = list(tables) if tables is not None else list(self.TABLES)
         with self._lock:
             return {
                 "index": self.index,
                 "tables": {
-                    name: {k: dataclasses.asdict(e) for k, e in t.rows.items()}
-                    for name, t in self.tables.items()
+                    name: {k: dataclasses.asdict(e)
+                           for k, e in self.tables[name].rows.items()}
+                    for name in names
                 },
             }
 
     def restore(self, snap: dict) -> None:
+        """Restore the tables present in the snapshot (others are left
+        untouched, supporting partial undo)."""
         with self._lock:
             self.index = snap["index"]
             for name, rows in snap["tables"].items():

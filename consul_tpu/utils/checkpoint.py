@@ -112,8 +112,11 @@ def _read_header(f: BinaryIO) -> dict:
             f"checkpoint format {manifest.get('format_version')} != "
             f"{FORMAT_VERSION}"
         )
-    if "sha256" not in manifest or "names" not in manifest:
-        raise ValueError("corrupt checkpoint manifest: missing fields")
+    missing = {"sha256", "names", "n_leaves", "shapes", "dtypes"} - set(manifest)
+    if missing:
+        raise ValueError(
+            f"corrupt checkpoint manifest: missing fields {sorted(missing)}"
+        )
     return manifest
 
 

@@ -55,6 +55,22 @@ class TestCatalogHealth:
                          passing_only=True)
         assert [r["node"] for r in out["value"]] == ["n0"]
 
+    def test_passing_only_excludes_warning(self, cluster):
+        # ?passing drops warnings too (reference filterNonPassing).
+        leader = cluster.leader_server()
+        cluster.write(leader, "Catalog.Register", node="nw", address="a",
+                      service={"id": "web", "service": "web"},
+                      check={"check_id": "c", "status": "warning",
+                             "service_id": "web"})
+        out = leader.rpc("Health.ServiceNodes", service="web",
+                         passing_only=True)
+        assert [r["node"] for r in out["value"]] == []
+
+    def test_session_create_validates_node(self, cluster):
+        leader = cluster.leader_server()
+        with pytest.raises(KeyError, match="ghost"):
+            leader.rpc("Session.Apply", op="create", node="ghost")
+
     def test_status_endpoint(self, cluster):
         led = cluster.raft.wait_leader()
         s = cluster.servers[0]

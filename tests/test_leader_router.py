@@ -1,0 +1,181 @@
+"""Leader reconcile, session TTLs, autopilot, and WAN router tests
+(reference agent/consul/leader_test.go reconcile cases, autopilot
+pruning tests, agent/router/router_test.go distance sorting)."""
+
+import pytest
+
+from consul_tpu.server import autopilot
+from consul_tpu.server.endpoints import ServerCluster
+from consul_tpu.server.leader import (
+    SERF_HEALTH,
+    SessionTimers,
+    reconcile,
+    reconcile_member,
+)
+from consul_tpu.server.router import Router, flood_join
+
+
+@pytest.fixture
+def cluster():
+    c = ServerCluster(3, seed=3)
+    c.wait_converged()
+    return c
+
+
+def run_writes(cluster, fn):
+    """Run fn (which issues rpc writes) then step raft to apply."""
+    out = fn()
+    cluster.step(80)
+    return out
+
+
+class TestReconcile:
+    def test_alive_member_registered_with_serf_health(self, cluster):
+        leader = cluster.leader_server()
+        run_writes(cluster, lambda: reconcile(leader, [
+            {"name": "n1", "address": "10.0.0.1", "status": "alive"},
+        ]))
+        assert leader.store.get_node("n1")["address"] == "10.0.0.1"
+        checks = leader.store.checks(node="n1")
+        assert checks[0]["check_id"] == SERF_HEALTH
+        assert checks[0]["status"] == "passing"
+
+    def test_alive_noop_when_in_sync(self, cluster):
+        leader = cluster.leader_server()
+        run_writes(cluster, lambda: reconcile(leader, [
+            {"name": "n1", "address": "a", "status": "alive"},
+        ]))
+        assert reconcile_member(leader, "n1", "a", "alive") is None
+
+    def test_failed_member_marked_critical_not_removed(self, cluster):
+        leader = cluster.leader_server()
+        run_writes(cluster, lambda: reconcile(leader, [
+            {"name": "n1", "address": "a", "status": "alive"},
+        ]))
+        run_writes(cluster, lambda: reconcile(leader, [
+            {"name": "n1", "address": "a", "status": "failed"},
+        ]))
+        assert leader.store.get_node("n1") is not None
+        assert leader.store.checks(node="n1")[0]["status"] == "critical"
+
+    def test_left_member_deregistered(self, cluster):
+        leader = cluster.leader_server()
+        run_writes(cluster, lambda: reconcile(leader, [
+            {"name": "n1", "address": "a", "status": "alive"},
+        ]))
+        run_writes(cluster, lambda: reconcile(leader, [
+            {"name": "n1", "address": "a", "status": "left"},
+        ]))
+        assert leader.store.get_node("n1") is None
+
+    def test_failed_unknown_member_is_noop(self, cluster):
+        leader = cluster.leader_server()
+        assert reconcile_member(leader, "ghost", "a", "failed") is None
+
+    def test_follower_reconcile_is_noop(self, cluster):
+        follower = cluster.any_follower()
+        assert reconcile(follower, [
+            {"name": "n1", "address": "a", "status": "alive"},
+        ]) == []
+
+
+class TestSessionTTL:
+    def test_expire_after_2x_ttl(self, cluster):
+        leader = cluster.leader_server()
+        run_writes(cluster, lambda: leader.rpc(
+            "Catalog.Register", node="n1", address="a"))
+        sid = run_writes(cluster, lambda: leader.rpc(
+            "Session.Apply", op="create", node="n1", ttl_s=10.0))
+        timers = SessionTimers(leader, now=0.0)
+        assert timers.expire(now=19.0) == []          # within 2*ttl
+        assert timers.expire(now=21.0) == [sid]       # past 2*ttl
+        cluster.step(80)
+        assert leader.store.session_get(sid) is None
+
+    def test_renew_pushes_deadline(self, cluster):
+        leader = cluster.leader_server()
+        run_writes(cluster, lambda: leader.rpc(
+            "Catalog.Register", node="n1", address="a"))
+        sid = run_writes(cluster, lambda: leader.rpc(
+            "Session.Apply", op="create", node="n1", ttl_s=10.0))
+        timers = SessionTimers(leader, now=0.0)
+        timers.renew(sid, now=15.0)
+        assert timers.expire(now=30.0) == []
+        assert timers.expire(now=36.0) == [sid]
+
+
+class TestAutopilot:
+    def test_healthy_cluster(self, cluster):
+        healths = autopilot.cluster_health(cluster.raft)
+        assert len(healths) == 3 and all(h.healthy for h in healths)
+
+    def test_dead_server_pruned_with_quorum(self, cluster):
+        victim = cluster.any_follower()
+        cluster.raft.nodes[victim.id].stop()
+        cluster.step(30)
+        removed = autopilot.clean_dead_servers(cluster.raft)
+        assert removed == [victim.id]
+        assert len(cluster.raft.nodes) == 2
+        # Cluster still functional.
+        leader = cluster.leader_server()
+        cluster.write(leader, "KVS.Apply", op="set", key="k", value=b"v")
+        assert leader.store.kv_get("k")["value"] == b"v"
+
+    def test_no_prune_when_quorum_would_break(self, cluster):
+        # Stop two of three: removal would leave 1 < majority(3)=2.
+        leader = cluster.leader_server()
+        for s in cluster.servers:
+            if s.id != leader.id:
+                cluster.raft.nodes[s.id].stop()
+        assert autopilot.clean_dead_servers(cluster.raft) == []
+        assert len(cluster.raft.nodes) == 3
+
+    def test_can_remove_servers_rule(self):
+        assert autopilot.can_remove_servers(3, 1)
+        assert not autopilot.can_remove_servers(3, 2)
+        assert autopilot.can_remove_servers(5, 2)
+        assert not autopilot.can_remove_servers(5, 3)
+
+
+def wan_coord(x_ms):
+    return {"vec": [x_ms / 1000.0, 0.0], "height": 0.0, "adjustment": 0.0}
+
+
+class TestRouter:
+    def make_router(self):
+        r = Router("dc1")
+        # dc1 at 0ms, dc2 at 20ms, dc3 at 5ms.
+        for i, (dc, x) in enumerate([("dc1", 0), ("dc1", 1),
+                                     ("dc2", 20), ("dc2", 21),
+                                     ("dc3", 5)]):
+            r.add_server(f"s{i}.{dc}", dc, coord=wan_coord(x))
+        return r
+
+    def test_datacenters_by_distance(self):
+        r = self.make_router()
+        assert r.get_datacenters_by_distance() == ["dc1", "dc3", "dc2"]
+
+    def test_unknown_coords_sort_last(self):
+        r = self.make_router()
+        r.add_server("s9.dc4", "dc4")  # no coordinate
+        assert r.get_datacenters_by_distance()[-1] == "dc4"
+
+    def test_find_route_and_failover(self):
+        r = self.make_router()
+        first = r.find_route("dc2")
+        assert first in ("s2.dc2", "s3.dc2")
+        r.fail_server(first)
+        assert r.find_route("dc2") != first
+
+    def test_remove_last_server_removes_dc(self):
+        r = self.make_router()
+        r.remove_server("s4.dc3")
+        assert "dc3" not in r.datacenters()
+
+    def test_flood_join_idempotent(self):
+        r = Router("dc1")
+        added = flood_join(r, "dc1", ["a", "b"],
+                           coords={"a": wan_coord(0)})
+        assert added == 2
+        assert flood_join(r, "dc1", ["a", "b"]) == 0
+        assert r.get_datacenter_maps() == {"dc1": ["a", "b"]}

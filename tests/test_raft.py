@@ -196,6 +196,14 @@ class TestSnapshot:
         assert fsms[victim.id].store.get_node("n0") is not None
 
 
+class TestSingleNode:
+    def test_single_node_commits_alone(self):
+        # Dev mode: one server is its own quorum (reference raftInmem).
+        cluster, fsms = make_cluster(1)
+        cluster.propose_and_commit(reg("n1"))
+        assert fsms["srv0"].store.get_node("n1") is not None
+
+
 class TestDeterminism:
     def test_same_seed_same_trajectory(self):
         def trajectory(seed):
@@ -228,6 +236,26 @@ class TestFSM:
         assert out["ok"] is True
         assert f.store.kv_get("a")["value"] == b"2"
         assert f.store.kv_get("b")["value"] == b"x"
+
+    def test_txn_rolls_back_on_returned_failure(self):
+        # A lock op that *returns* False (not raises) also aborts.
+        f = FSM(StateStore())
+        out = f.apply(1, {"type": fsm_mod.TXN, "ops": [
+            {"type": fsm_mod.KV, "op": "set", "key": "a", "value": b"1"},
+            {"type": fsm_mod.KV, "op": "lock", "key": "b", "value": b"x",
+             "session": "no-such-session"},
+        ]})
+        assert out["ok"] is False and out["failed"] == "b"
+        assert f.store.kv_get("a") is None
+
+    def test_unlock_without_session_fails(self):
+        f = FSM(StateStore())
+        f.apply(1, {"type": fsm_mod.KV, "op": "set", "key": "k",
+                    "value": b"v"})
+        idx_before = f.store.kv_get("k")["modify_index"]
+        ok = f.apply(2, {"type": fsm_mod.KV, "op": "unlock", "key": "k"})
+        assert ok is False
+        assert f.store.kv_get("k")["modify_index"] == idx_before
 
     def test_txn_rolls_back_on_mid_batch_failure(self):
         f = FSM(StateStore())
