@@ -1,0 +1,9 @@
+"""Agent tier: the per-node runtime around the server core.
+
+The reference runs an agent on every node (reference agent/agent.go):
+local service/check registrations, anti-entropy sync into the catalog,
+health-check execution, the coordinate send loop, and a TTL/refresh
+cache of RPC results. This package is that runtime for the TPU
+framework; the heavy per-node protocol work (SWIM, gossip, Vivaldi)
+lives in the vectorized simulation, and agents bridge into it.
+"""

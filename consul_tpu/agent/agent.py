@@ -1,0 +1,129 @@
+"""The Agent: per-node runtime wiring local state, checks, cache, and
+the coordinate loop to the server tier.
+
+Mirrors the reference agent lifecycle (reference agent/agent.go:371-550
+Start sequence: local state → ae syncer → cache → delegate → checks →
+sendCoordinate): an Agent holds its registrations, runs its checks,
+anti-entropy-syncs into the catalog through its RPC route, and sends
+its Vivaldi coordinate on the rate-scaled cadence (reference
+agent/agent.go:1891-1940 sendCoordinate with
+``lib.RateScaledInterval(SyncCoordinateRateTarget, min, N)``).
+
+Agents are time-explicit: ``tick(now)`` drives checks, sync, and the
+coordinate send, so a driver can pump thousands of agents against the
+simulation clock deterministically (the TestAgent idiom, reference
+agent/testagent.go:44-129, without real sockets).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Optional
+
+from consul_tpu.agent.cache import Cache
+from consul_tpu.agent.checks import CheckRunner
+from consul_tpu.agent.local import LocalState, sync_stagger_s
+
+# Reference defaults (agent/config/default.go SyncCoordinateRateTarget
+# = 64 updates/s cluster-wide, SyncCoordinateIntervalMin = 15s).
+COORDINATE_RATE_TARGET_PER_S = 64.0
+COORDINATE_INTERVAL_MIN_S = 15.0
+
+
+def coordinate_interval_s(cluster_size: int) -> float:
+    """Rate-scaled coordinate send interval (reference
+    lib/cluster.go:51-60 RateScaledInterval, agent/agent.go:1896)."""
+    return max(cluster_size / COORDINATE_RATE_TARGET_PER_S,
+               COORDINATE_INTERVAL_MIN_S)
+
+
+class Agent:
+    def __init__(self, node: str, address: str, rpc: Callable[..., Any],
+                 coordinate_source: Optional[Callable[[], dict]] = None,
+                 cluster_size: int = 1, seed: int = 0):
+        """``rpc(method, **args)``: the agent's route to a server (in
+        client mode a Server picked from the connection pool; in server
+        mode the local Server) — reference agent.RPC via the delegate.
+        ``coordinate_source``: returns this node's current Vivaldi
+        coordinate (from the simulation's VivaldiState row, the
+        serf.GetCoordinate of reference agent/agent.go:1919)."""
+        self.node = node
+        self.address = address
+        self.rpc = rpc
+        self.coordinate_source = coordinate_source
+        self.rng = random.Random(seed)
+        self.local = LocalState(node, address)
+        self.checks = CheckRunner(self.local)
+        self.cache = Cache()
+        self.cluster_size = cluster_size
+
+        self._next_sync = 0.0  # first tick syncs immediately
+        self._next_coord = self.rng.uniform(
+            0, coordinate_interval_s(cluster_size)
+        )
+        self.metrics = {"syncs": 0, "sync_writes": 0, "coordinate_sends": 0,
+                        "sync_failures": 0}
+
+    # -- service/check registration API (reference agent endpoints
+    # /v1/agent/service/register etc.) ---------------------------------
+    def add_service(self, service_id: str, service: str, port: int = 0,
+                    tags: Optional[list] = None,
+                    check_ttl_s: Optional[float] = None, now: float = 0.0):
+        self.local.add_service(service_id, service, port, tags)
+        if check_ttl_s is not None:
+            self.checks.add_ttl(f"service:{service_id}", check_ttl_s,
+                                service_id=service_id, now=now)
+
+    def remove_service(self, service_id: str):
+        self.checks.remove(f"service:{service_id}")
+        self.local.remove_service(service_id)
+
+    # -- the periodic work ---------------------------------------------
+    def tick(self, now: float) -> dict:
+        """One agent pump: run checks, sync if due, send coordinate if
+        due. Returns which duties ran (for drivers/tests)."""
+        ran = {"sync": False, "coordinate": False}
+        self.checks.tick(now)
+        # Check status changes mark entries dirty; sync as scheduled or
+        # immediately when something is dirty (changes trigger
+        # SyncChanges promptly in the reference, local/state.go:505).
+        dirty = (
+            not self.local.node_in_sync
+            or any(not s.in_sync for s in self.local.services.values())
+            or any(not c.in_sync for c in self.local.checks.values())
+        )
+        if now >= self._next_sync or dirty:
+            try:
+                self.metrics["sync_writes"] += self.local.sync_changes(self.rpc)
+                self.metrics["syncs"] += 1
+                ran["sync"] = True
+            except Exception:  # noqa: BLE001 — server unreachable; retry soon
+                self.metrics["sync_failures"] += 1
+                self._next_sync = now + 1.0
+            else:
+                self._next_sync = now + sync_stagger_s(
+                    self.cluster_size, self.rng
+                )
+        if self.coordinate_source is not None and now >= self._next_coord:
+            try:
+                self.rpc("Coordinate.Update", node=self.node,
+                         coord=self.coordinate_source())
+                self.metrics["coordinate_sends"] += 1
+                ran["coordinate"] = True
+            except Exception:  # noqa: BLE001
+                pass
+            self._next_coord = now + coordinate_interval_s(self.cluster_size)
+        return ran
+
+    # -- reads through the cache (reference DNS/HTTP read path) --------
+    def cached_service_nodes(self, service: str, ttl_s: float = 3.0,
+                             refresh: bool = False) -> Any:
+        return self.cache.get(
+            f"service-nodes:{service}",
+            lambda idx, wait: self.rpc("Health.ServiceNodes", service=service,
+                                       min_index=idx, wait_s=wait),
+            ttl_s=ttl_s, refresh=refresh,
+        )
+
+    def close(self):
+        self.cache.close()

@@ -1,0 +1,98 @@
+"""Agent RPC cache: TTL expiry + background blocking refresh.
+
+Mirrors the reference agent cache (reference agent/cache/cache.go,
+1511 LoC): typed entries keyed by request, fetched through a registered
+type, served from memory with a TTL, and — for refresh-typed entries —
+kept warm by a background goroutine running blocking queries so reads
+are always fresh-ish and cheap. DNS/HTTP/proxycfg all read through it
+(reference agent/cache-types/).
+
+Here fetchers are callables returning ``{"index": i, "value": v}`` (the
+blocking-read convention of the endpoint layer); refresh runs on
+daemon threads issuing blocking queries with the last seen index.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional
+
+
+class CacheEntry:
+    def __init__(self, value: Any, index: int, expires_at: float):
+        self.value = value
+        self.index = index
+        self.expires_at = expires_at
+        self.hits = 0
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict[str, CacheEntry] = {}
+        self._refreshing: set[str] = set()
+        self.metrics = {"hits": 0, "misses": 0, "fetches": 0}
+        self._stop = threading.Event()
+
+    def get(self, key: str, fetch: Callable[[int, float], dict],
+            ttl_s: float = 3.0, refresh: bool = False,
+            now: Optional[float] = None) -> Any:
+        """Serve ``key`` from cache or fetch it. ``fetch(min_index,
+        wait_s)`` must return ``{"index": i, "value": v}``. With
+        ``refresh=True`` a background thread keeps the entry current via
+        blocking queries (reference cache.go refresh types)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and now < e.expires_at:
+                e.hits += 1
+                self.metrics["hits"] += 1
+                return e.value
+            self.metrics["misses"] += 1
+        out = fetch(0, 0.0)
+        with self._lock:
+            self.metrics["fetches"] += 1
+            self._entries[key] = CacheEntry(out["value"], out["index"],
+                                            now + ttl_s)
+            start_refresh = refresh and key not in self._refreshing
+            if start_refresh:
+                self._refreshing.add(key)
+        if start_refresh:
+            t = threading.Thread(
+                target=self._refresh_loop, args=(key, fetch, ttl_s),
+                daemon=True,
+            )
+            t.start()
+        return out["value"]
+
+    def _refresh_loop(self, key: str, fetch, ttl_s: float):
+        """Background blocking-query loop (reference cache.go
+        fetch/refresh goroutine): each round waits at the server for a
+        change past the last index, then replaces the entry."""
+        while not self._stop.is_set():
+            with self._lock:
+                e = self._entries.get(key)
+                if e is None:
+                    self._refreshing.discard(key)
+                    return
+                idx = e.index
+            try:
+                out = fetch(idx, 5.0)
+            except Exception:  # noqa: BLE001 — server away; retry with backoff
+                if self._stop.wait(0.2):
+                    return
+                continue
+            with self._lock:
+                cur = self._entries.get(key)
+                if cur is not None:
+                    cur.value = out["value"]
+                    cur.index = out["index"]
+                    cur.expires_at = time.monotonic() + ttl_s
+
+    def invalidate(self, key: str):
+        with self._lock:
+            self._entries.pop(key, None)
+
+    def close(self):
+        self._stop.set()

@@ -1,0 +1,113 @@
+"""Health check runners: TTL and monitor (callback) checks.
+
+The reference ships script/HTTP/TCP/TTL/gRPC/Docker/alias monitors
+(reference agent/checks/check.go, 1325 LoC) that all funnel into the
+same place: a status update on the agent's local state, which
+anti-entropy then syncs to the catalog. This module keeps the two
+shapes that exist in a simulation-first framework:
+
+  - :class:`CheckTTL` — the application heartbeats via
+    ``pass_/warn/fail``; silence past the TTL turns critical
+    (reference checks/check.go CheckTTL).
+  - :class:`CheckMonitor` — a callback probes something (a simulated
+    node's ground truth, a subprocess, an HTTP endpoint — any callable)
+    on an interval; its return value becomes the status (reference
+    CheckMonitor for scripts, the callable generalizes the rest).
+
+Both are time-explicit (``now`` parameters) so drivers and tests
+control the clock, like the rest of the framework.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from consul_tpu.agent.local import LocalState
+
+
+class CheckTTL:
+    def __init__(self, local: LocalState, check_id: str, ttl_s: float,
+                 now: float = 0.0):
+        self.local = local
+        self.check_id = check_id
+        self.ttl_s = ttl_s
+        self.deadline = now + ttl_s
+
+    def _update(self, status: str, output: str, now: float):
+        self.deadline = now + self.ttl_s
+        self.local.update_check(self.check_id, status, output)
+
+    def pass_(self, now: float, output: str = ""):
+        self._update("passing", output, now)
+
+    def warn(self, now: float, output: str = ""):
+        self._update("warning", output, now)
+
+    def fail(self, now: float, output: str = ""):
+        self._update("critical", output, now)
+
+    def tick(self, now: float):
+        """Expire: no heartbeat within the TTL means critical
+        (reference check.go CheckTTL ttl timer)."""
+        if now >= self.deadline:
+            self.local.update_check(
+                self.check_id, "critical",
+                f"TTL expired ({self.ttl_s}s without update)",
+            )
+
+
+class CheckMonitor:
+    def __init__(self, local: LocalState, check_id: str,
+                 probe: Callable[[], tuple[str, str]],
+                 interval_s: float, now: float = 0.0):
+        self.local = local
+        self.check_id = check_id
+        self.probe = probe
+        self.interval_s = interval_s
+        self.next_run = now  # first probe runs immediately
+
+    def tick(self, now: float):
+        if now < self.next_run:
+            return
+        self.next_run = now + self.interval_s
+        try:
+            status, output = self.probe()
+        except Exception as e:  # noqa: BLE001 — a crashing probe is critical
+            status, output = "critical", f"check raised: {e!r}"
+        if status not in ("passing", "warning", "critical"):
+            status, output = "critical", f"bad probe status {status!r}"
+        self.local.update_check(self.check_id, status, output)
+
+
+class CheckRunner:
+    """Owns all of an agent's checks and pumps them on the agent tick
+    (replacing the reference's goroutine-per-check model with the
+    framework's explicit time-step idiom)."""
+
+    def __init__(self, local: LocalState):
+        self.local = local
+        self.checks: dict[str, object] = {}
+
+    def add_ttl(self, check_id: str, ttl_s: float, service_id: str = "",
+                now: float = 0.0) -> CheckTTL:
+        self.local.add_check(check_id, "critical", service_id,
+                             "TTL check has not reported yet")
+        c = CheckTTL(self.local, check_id, ttl_s, now)
+        self.checks[check_id] = c
+        return c
+
+    def add_monitor(self, check_id: str, probe: Callable[[], tuple[str, str]],
+                    interval_s: float, service_id: str = "",
+                    now: float = 0.0) -> CheckMonitor:
+        self.local.add_check(check_id, "critical", service_id)
+        c = CheckMonitor(self.local, check_id, probe, interval_s, now)
+        self.checks[check_id] = c
+        return c
+
+    def remove(self, check_id: str):
+        self.checks.pop(check_id, None)
+        self.local.remove_check(check_id)
+
+    def tick(self, now: float):
+        for c in self.checks.values():
+            c.tick(now)

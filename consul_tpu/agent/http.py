@@ -1,0 +1,394 @@
+"""The HTTP API: the reference's REST surface over the agent.
+
+Mirrors the endpoint registry (reference agent/http_register.go:4-110,
+107 endpoints; the subset here covers the subsystems this framework
+implements) with the same wire conventions: JSON bodies, base64 KV
+values, ``?index=`` + ``?wait=`` blocking queries answered with
+``X-Consul-Index`` (reference agent/http.go parseWait/setIndex),
+``?near=`` RTT sorting, ``?recurse``/``?cas``/``?acquire``/``?release``
+KV semantics, and agent-local service/check registration.
+
+Served by a threading HTTP server so blocking queries long-poll without
+starving other requests (goroutine-per-conn equivalent).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+from urllib.parse import parse_qs, urlparse
+
+from consul_tpu.agent.agent import Agent
+from consul_tpu.server.endpoints import Server
+from consul_tpu.server.raft import NotLeader
+
+
+def _dur_to_s(s: str) -> float:
+    """Parse Go-style durations ('10s', '1m', '150ms')."""
+    s = s.strip()
+    if s.endswith("ms"):
+        return float(s[:-2]) / 1000.0
+    if s.endswith("s"):
+        return float(s[:-1])
+    if s.endswith("m"):
+        return float(s[:-1]) * 60.0
+    return float(s)
+
+
+class HTTPApi:
+    """Routes parsed requests to the agent + its RPC surface. Transport
+    free: the handler below serves it over a socket; tests may call
+    :meth:`handle` directly (the httptest idiom)."""
+
+    def __init__(self, agent: Agent, server: Optional[Server] = None,
+                 wait_write: Optional[Any] = None):
+        self.agent = agent
+        # server: for endpoints needing direct store access (snapshot) —
+        # present in server mode, None in pure client mode.
+        self.server = server
+        # wait_write(index): blocks until the raft entry is applied, so
+        # a write's HTTP response reflects the committed state (the
+        # synchronous raftApply contract, reference rpc.go:377). Driver
+        # clusters pump raft on a background thread and poll here.
+        self.wait_write = wait_write or (lambda idx: None)
+
+    # ------------------------------------------------------------------
+    def handle(self, method: str, path: str, query: dict[str, list[str]],
+               body: bytes) -> tuple[int, Any, dict[str, str]]:
+        """Returns (status, json-serializable body, extra headers)."""
+        q = {k: v[-1] for k, v in query.items()}
+        min_index = int(q.get("index", 0))
+        wait_s = _dur_to_s(q["wait"]) if "wait" in q else 10.0
+        near = q.get("near", "")
+        try:
+            return self._route(method, path, q, query, body,
+                               min_index, wait_s, near)
+        except NotLeader as e:
+            return 500, {"error": f"no leader: {e}"}, {}
+        except (ValueError, KeyError) as e:
+            return 400, {"error": str(e)}, {}
+        except Exception as e:  # noqa: BLE001 — never drop the connection
+            return 500, {"error": f"internal: {e!r}"}, {}
+
+    def _rpc_write(self, method: str, **args):
+        out = self.agent.rpc(method, **args)
+        if isinstance(out, int):
+            self.wait_write(out)
+        return out
+
+    def _route(self, method, path, q, query, body, min_index, wait_s, near):
+        parts = [p for p in path.split("/") if p]
+        if not parts or parts[0] != "v1":
+            return 404, {"error": "not found"}, {}
+        parts = parts[1:]
+        rpc = self.agent.rpc
+
+        # ---- status ---------------------------------------------------
+        if parts == ["status", "leader"]:
+            return 200, rpc("Status.Leader"), {}
+        if parts == ["status", "peers"]:
+            return 200, rpc("Status.Peers"), {}
+
+        # ---- catalog --------------------------------------------------
+        if parts == ["catalog", "nodes"]:
+            out = rpc("Catalog.ListNodes", min_index=min_index,
+                      wait_s=wait_s, near=near)
+            return 200, out["value"], {"X-Consul-Index": str(out["index"])}
+        if parts == ["catalog", "services"]:
+            out = rpc("Catalog.ListServices", min_index=min_index,
+                      wait_s=wait_s)
+            return 200, out["value"], {"X-Consul-Index": str(out["index"])}
+        if len(parts) == 3 and parts[:2] == ["catalog", "service"]:
+            out = rpc("Catalog.ServiceNodes", service=parts[2],
+                      tag=q.get("tag"), min_index=min_index, wait_s=wait_s,
+                      near=near)
+            return 200, out["value"], {"X-Consul-Index": str(out["index"])}
+        if len(parts) == 3 and parts[:2] == ["catalog", "node"]:
+            out = rpc("Catalog.NodeServices", node=parts[2])
+            return 200, out["value"], {"X-Consul-Index": str(out["index"])}
+        if parts == ["catalog", "register"] and method == "PUT":
+            req = json.loads(body)
+            idx = self._rpc_write(
+                "Catalog.Register", node=req["Node"],
+                address=req.get("Address", ""),
+                service=_lower_keys(req.get("Service")),
+                check=_check_from_api(req.get("Check")),
+            )
+            return 200, True, {"X-Consul-Index": str(idx)}
+        if parts == ["catalog", "deregister"] and method == "PUT":
+            req = json.loads(body)
+            self._rpc_write("Catalog.Deregister", node=req["Node"],
+                            service_id=req.get("ServiceID"),
+                            check_id=req.get("CheckID"))
+            return 200, True, {}
+
+        # ---- health ---------------------------------------------------
+        if len(parts) == 3 and parts[:2] == ["health", "service"]:
+            out = rpc("Health.ServiceNodes", service=parts[2],
+                      passing_only="passing" in q, min_index=min_index,
+                      wait_s=wait_s, near=near)
+            return 200, out["value"], {"X-Consul-Index": str(out["index"])}
+        if len(parts) == 3 and parts[:2] == ["health", "node"]:
+            out = rpc("Health.NodeChecks", node=parts[2],
+                      min_index=min_index, wait_s=wait_s)
+            return 200, out["value"], {"X-Consul-Index": str(out["index"])}
+        if len(parts) == 3 and parts[:2] == ["health", "state"]:
+            out = rpc("Health.ChecksInState", state=parts[2],
+                      min_index=min_index, wait_s=wait_s)
+            return 200, out["value"], {"X-Consul-Index": str(out["index"])}
+
+        # ---- kv -------------------------------------------------------
+        if parts[0] == "kv":
+            key = "/".join(parts[1:])
+            return self._kv(method, key, q, body, min_index, wait_s)
+
+        # ---- session --------------------------------------------------
+        if parts == ["session", "create"] and method == "PUT":
+            req = json.loads(body or b"{}")
+            ttl = _dur_to_s(req["TTL"]) if req.get("TTL") else 0.0
+            sid = self._rpc_write(
+                "Session.Apply", op="create",
+                node=req.get("Node", self.agent.node), ttl_s=ttl,
+                behavior=req.get("Behavior", "release"),
+                checks=req.get("Checks"),
+            )
+            return 200, {"ID": sid}, {}
+        if len(parts) == 3 and parts[:2] == ["session", "destroy"]:
+            self._rpc_write("Session.Apply", op="destroy",
+                            session_id=parts[2])
+            return 200, True, {}
+        if parts == ["session", "list"]:
+            out = rpc("Session.List")
+            return 200, out["value"], {"X-Consul-Index": str(out["index"])}
+
+        # ---- coordinates ----------------------------------------------
+        if parts == ["coordinate", "nodes"]:
+            out = rpc("Coordinate.ListNodes", min_index=min_index,
+                      wait_s=wait_s)
+            return 200, out["value"], {"X-Consul-Index": str(out["index"])}
+        if len(parts) == 3 and parts[:2] == ["coordinate", "node"]:
+            out = rpc("Coordinate.Node", node=parts[2])
+            return 200, out["value"], {"X-Consul-Index": str(out["index"])}
+
+        # ---- txn ------------------------------------------------------
+        if parts == ["txn"] and method == "PUT":
+            ops = []
+            for op in json.loads(body):
+                kv = op["KV"]
+                ops.append({
+                    "type": "kv", "op": kv["Verb"], "key": kv["Key"],
+                    "value": base64.b64decode(kv.get("Value", "")),
+                    "cas_index": kv.get("Index"),
+                    "session": kv.get("Session"),
+                })
+            self._rpc_write("Txn.Apply", ops=ops)
+            return 200, {"Results": []}, {}
+
+        # ---- operator snapshot (reference snapshot/, agent/consul/
+        # rpc.go:196 RPCSnapshot byte; CLI `consul snapshot`) -----------
+        if parts == ["snapshot"]:
+            if self.server is None:
+                return 500, {"error": "snapshot requires server mode"}, {}
+            if method == "GET":
+                return 200, _jsonify(self.server.store.snapshot()), {}
+            if method == "PUT":
+                snap = _unjsonify(json.loads(body))
+                # Restore is leader-driven in the reference (streams the
+                # archive through raft.Restore); raft-lite installs it
+                # directly into the store.
+                self.server.store.restore(snap)
+                return 200, True, {}
+
+        # ---- agent ----------------------------------------------------
+        if parts == ["agent", "self"]:
+            return 200, {"Config": {"NodeName": self.agent.node},
+                         "Member": {"Name": self.agent.node,
+                                    "Addr": self.agent.address}}, {}
+        if parts == ["agent", "metrics"]:
+            return 200, dict(self.agent.metrics), {}
+        if parts == ["agent", "service", "register"] and method == "PUT":
+            req = json.loads(body)
+            ttl = None
+            if req.get("Check", {}).get("TTL"):
+                ttl = _dur_to_s(req["Check"]["TTL"])
+            self.agent.add_service(
+                req.get("ID", req["Name"]), req["Name"],
+                req.get("Port", 0), req.get("Tags"), check_ttl_s=ttl,
+            )
+            self.agent.tick(_now())
+            return 200, True, {}
+        if len(parts) == 4 and parts[:3] == ["agent", "service", "deregister"]:
+            self.agent.remove_service(parts[3])
+            self.agent.tick(_now())
+            return 200, True, {}
+        if len(parts) == 4 and parts[0] == "agent" and parts[1] == "check" \
+                and parts[2] in ("pass", "warn", "fail"):
+            chk = self.agent.checks.checks.get(parts[3])
+            if chk is None:
+                return 404, {"error": f"unknown check {parts[3]}"}, {}
+            getattr(chk, {"pass": "pass_", "warn": "warn",
+                          "fail": "fail"}[parts[2]])(
+                _now(), q.get("note", "")
+            )
+            self.agent.tick(_now())
+            return 200, True, {}
+
+        return 404, {"error": f"no such endpoint {path}"}, {}
+
+    def _kv(self, method, key, q, body, min_index, wait_s):
+        rpc = self.agent.rpc
+        if method == "GET":
+            if "keys" in q:
+                out = rpc("KVS.List", prefix=key, min_index=min_index,
+                          wait_s=wait_s)
+                return 200, [r["key"] for r in out["value"]], {
+                    "X-Consul-Index": str(out["index"])}
+            if "recurse" in q:
+                out = rpc("KVS.List", prefix=key, min_index=min_index,
+                          wait_s=wait_s)
+                rows = out["value"]
+            else:
+                out = rpc("KVS.Get", key=key, min_index=min_index,
+                          wait_s=wait_s)
+                if out["value"] is None:
+                    return 404, None, {"X-Consul-Index": str(out["index"])}
+                rows = [out["value"] | {"key": key}]
+            return 200, [_kv_to_api(r) for r in rows], {
+                "X-Consul-Index": str(out["index"])}
+        if method == "PUT":
+            op, cas, session = "set", None, None
+            if "cas" in q:
+                op, cas = "cas", int(q["cas"])
+            if "acquire" in q:
+                op, session = "lock", q["acquire"]
+            if "release" in q:
+                op, session = "unlock", q["release"]
+            self._rpc_write("KVS.Apply", op=op, key=key, value=body,
+                            flags=int(q.get("flags", 0)), cas_index=cas,
+                            session=session)
+            # The API returns whether the op succeeded (CAS/locks).
+            cur = rpc("KVS.Get", key=key)["value"]
+            if op == "cas":
+                ok = cur is not None and cur["value"] == body
+            elif op == "lock":
+                ok = cur is not None and cur.get("session") == session
+            elif op == "unlock":
+                ok = cur is not None and cur.get("session") is None
+            else:
+                ok = True
+            return 200, ok, {}
+        if method == "DELETE":
+            cas = int(q["cas"]) if "cas" in q else None
+            self._rpc_write("KVS.Apply",
+                            op="delete-cas" if cas is not None else (
+                                "delete-tree" if "recurse" in q else "delete"),
+                            key=key, cas_index=cas)
+            return 200, True, {}
+        return 405, {"error": "method not allowed"}, {}
+
+
+def _kv_to_api(row: dict) -> dict:
+    val = row.get("value", b"")
+    return {
+        "Key": row["key"],
+        "Value": base64.b64encode(val).decode() if val else None,
+        "Flags": row.get("flags", 0),
+        "Session": row.get("session"),
+        "CreateIndex": row.get("create_index", row.get("modify_index", 0)),
+        "ModifyIndex": row.get("modify_index", 0),
+    }
+
+
+def _lower_keys(d: Optional[dict]) -> Optional[dict]:
+    if d is None:
+        return None
+    return {{"ID": "id", "Service": "service", "Port": "port",
+             "Tags": "tags", "Meta": "meta"}.get(k, k.lower()): v
+            for k, v in d.items()}
+
+
+def _check_from_api(d: Optional[dict]) -> Optional[dict]:
+    if d is None:
+        return None
+    return {"check_id": d.get("CheckID", d.get("Name", "check")),
+            "status": d.get("Status", "critical"),
+            "service_id": d.get("ServiceID", ""),
+            "output": d.get("Output", "")}
+
+
+def _now() -> float:
+    import time
+    return time.monotonic()
+
+
+def _jsonify(obj: Any) -> Any:
+    """Make a store snapshot JSON-safe: bytes become base64-tagged
+    dicts (KV values are raw bytes in the store)."""
+    if isinstance(obj, bytes):
+        return {"__b64__": base64.b64encode(obj).decode()}
+    if isinstance(obj, dict):
+        return {k: _jsonify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonify(v) for v in obj]
+    return obj
+
+
+def _unjsonify(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        if set(obj) == {"__b64__"}:
+            return base64.b64decode(obj["__b64__"])
+        return {k: _unjsonify(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unjsonify(v) for v in obj]
+    return obj
+
+
+# ----------------------------------------------------------------------
+# Socket server
+# ----------------------------------------------------------------------
+
+class _Handler(BaseHTTPRequestHandler):
+    api: HTTPApi  # class attribute injected by serve()
+
+    def _do(self, method: str):
+        parsed = urlparse(self.path)
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b""
+        status, payload, headers = self.api.handle(
+            method, parsed.path,
+            parse_qs(parsed.query, keep_blank_values=True), body
+        )
+        data = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 (stdlib naming)
+        self._do("GET")
+
+    def do_PUT(self):  # noqa: N802
+        self._do("PUT")
+
+    def do_DELETE(self):  # noqa: N802
+        self._do("DELETE")
+
+    def log_message(self, *args):  # silence per-request stderr noise
+        pass
+
+
+def serve(api: HTTPApi, host: str = "127.0.0.1", port: int = 0):
+    """Start the HTTP server on a background thread; returns
+    (server, bound_port). Port 0 picks a free port (the
+    randomPortsSource idiom of reference agent/testagent.go:376)."""
+    handler = type("BoundHandler", (_Handler,), {"api": api})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    th = threading.Thread(target=httpd.serve_forever, daemon=True)
+    th.start()
+    return httpd, httpd.server_address[1]

@@ -1,0 +1,169 @@
+"""Agent-local state + anti-entropy sync into the catalog.
+
+Mirrors the reference's local state (reference agent/local/state.go,
+1339 LoC): the agent owns its service/check registrations with per-entry
+``in_sync`` flags; anti-entropy diffs local vs remote catalog state
+(``updateSyncState`` :829) and pushes the difference (``SyncFull``
+:1003 / ``SyncChanges`` :1021) — remote entries the agent doesn't know
+are deregistered, local entries out of sync are re-registered.
+
+The syncer cadence logic (cluster-size-scaled stagger, retry on
+failure) mirrors ``ae.StateSyncer`` (reference agent/ae/ae.go:52-143).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Any, Callable, Optional
+
+SYNC_INTERVAL_S = 60.0       # reference ae.go DefaultSyncInterval
+SYNC_STAGGER_FRAC = 1 / 3    # reference ae.go staggerFn scaleFactor base
+
+
+@dataclasses.dataclass
+class LocalService:
+    id: str
+    service: str
+    port: int = 0
+    tags: list = dataclasses.field(default_factory=list)
+    meta: dict = dataclasses.field(default_factory=dict)
+    in_sync: bool = False
+
+
+@dataclasses.dataclass
+class LocalCheck:
+    check_id: str
+    status: str = "critical"
+    service_id: str = ""
+    output: str = ""
+    in_sync: bool = False
+
+
+class LocalState:
+    """The agent's own registrations; the source of truth that
+    anti-entropy imposes on the catalog."""
+
+    def __init__(self, node: str, address: str):
+        self.node = node
+        self.address = address
+        self.services: dict[str, LocalService] = {}
+        self.checks: dict[str, LocalCheck] = {}
+        self.node_in_sync = False
+
+    # -- registration API (reference agent/local/state.go AddService
+    # :214, AddCheck :356, Remove* — each marks the entry dirty) -------
+    def add_service(self, service_id: str, service: str, port: int = 0,
+                    tags: Optional[list] = None, meta: Optional[dict] = None):
+        self.services[service_id] = LocalService(
+            service_id, service, port, tags or [], meta or {}
+        )
+
+    def remove_service(self, service_id: str):
+        self.services.pop(service_id, None)
+        for cid in [c for c, chk in self.checks.items()
+                    if chk.service_id == service_id]:
+            del self.checks[cid]
+
+    def add_check(self, check_id: str, status: str = "critical",
+                  service_id: str = "", output: str = ""):
+        self.checks[check_id] = LocalCheck(check_id, status, service_id, output)
+
+    def remove_check(self, check_id: str):
+        self.checks.pop(check_id, None)
+
+    def update_check(self, check_id: str, status: str, output: str = ""):
+        """Check status changes mark the entry dirty so the next sync
+        pushes it (reference local/state.go UpdateCheck :505)."""
+        c = self.checks.get(check_id)
+        if c is None:
+            return
+        if c.status != status or c.output != output:
+            c.status, c.output, c.in_sync = status, output, False
+
+    # -- anti-entropy --------------------------------------------------
+    def update_sync_state(self, rpc: Callable[..., Any]):
+        """Diff local vs remote and mark out-of-sync entries
+        (reference local/state.go updateSyncState :829). Returns the
+        set of remote-only ids to deregister."""
+        remote_services = {
+            s["id"]: s for s in rpc("Catalog.NodeServices",
+                                    node=self.node)["value"]
+        }
+        remote_checks = {
+            c["check_id"]: c
+            for c in rpc("Health.NodeChecks", node=self.node)["value"]
+        }
+        for sid, svc in self.services.items():
+            r = remote_services.get(sid)
+            svc.in_sync = bool(
+                r and r["service"] == svc.service and r["port"] == svc.port
+                and r["tags"] == svc.tags
+            )
+        for cid, chk in self.checks.items():
+            r = remote_checks.get(cid)
+            chk.in_sync = bool(
+                r and r["status"] == chk.status and
+                r.get("output", "") == chk.output
+            )
+        extra_services = set(remote_services) - set(self.services)
+        # serfHealth is owned by the leader reconcile loop, never the
+        # agent (reference local/state.go:889 skips it).
+        extra_checks = {c for c in set(remote_checks) - set(self.checks)
+                        if c != "serfHealth"}
+        return extra_services, extra_checks
+
+    def sync_changes(self, rpc: Callable[..., Any]) -> int:
+        """Push every out-of-sync entry (reference SyncChanges :1021).
+        Returns the number of writes issued."""
+        writes = 0
+        extra_services, extra_checks = self.update_sync_state(rpc)
+        for sid in extra_services:
+            rpc("Catalog.Deregister", node=self.node, service_id=sid)
+            writes += 1
+        for cid in extra_checks:
+            rpc("Catalog.Deregister", node=self.node, check_id=cid)
+            writes += 1
+        if not self.node_in_sync:
+            rpc("Catalog.Register", node=self.node, address=self.address)
+            self.node_in_sync = True
+            writes += 1
+        for svc in self.services.values():
+            if not svc.in_sync:
+                rpc("Catalog.Register", node=self.node, address=self.address,
+                    service={"id": svc.id, "service": svc.service,
+                             "port": svc.port, "tags": svc.tags,
+                             "meta": svc.meta})
+                svc.in_sync = True
+                writes += 1
+        for chk in self.checks.values():
+            if not chk.in_sync:
+                rpc("Catalog.Register", node=self.node, address=self.address,
+                    check={"check_id": chk.check_id, "status": chk.status,
+                           "service_id": chk.service_id,
+                           "output": chk.output})
+                chk.in_sync = True
+                writes += 1
+        return writes
+
+    def sync_full(self, rpc: Callable[..., Any]) -> int:
+        """Mark everything dirty, then sync (reference SyncFull :1003)."""
+        self.node_in_sync = False
+        for svc in self.services.values():
+            svc.in_sync = False
+        for chk in self.checks.values():
+            chk.in_sync = False
+        return self.sync_changes(rpc)
+
+
+def sync_stagger_s(cluster_size: int, rng: random.Random,
+                   interval_s: float = SYNC_INTERVAL_S) -> float:
+    """Anti-entropy interval with cluster-size scaling + random stagger
+    (reference ae.go:92-…: the interval scales up by log-ish factors as
+    the cluster grows so aggregate sync load stays bounded)."""
+    scale = 1.0
+    if cluster_size > 128:
+        import math
+        scale = math.ceil(math.log2(cluster_size) - math.log2(128)) + 1.0
+    base = interval_s * scale
+    return base + rng.uniform(0, base * SYNC_STAGGER_FRAC)

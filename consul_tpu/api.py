@@ -1,0 +1,290 @@
+"""Python API client for the HTTP interface.
+
+The equivalent of the reference's Go client library (reference api/,
+9071 LoC: api.Client with KV/Catalog/Health/Session/Coordinate/Status/
+Agent handles, blocking-query QueryOptions, lock recipes). Speaks the
+same wire conventions as :mod:`consul_tpu.agent.http` — JSON, base64 KV
+values, ``X-Consul-Index`` — over stdlib ``http.client`` only.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+import urllib.parse
+import urllib.request
+from typing import Any, Optional
+
+
+class QueryMeta:
+    def __init__(self, index: int):
+        self.index = index
+
+
+class APIError(RuntimeError):
+    def __init__(self, status: int, body: Any):
+        super().__init__(f"HTTP {status}: {body}")
+        self.status = status
+        self.body = body
+
+
+class Client:
+    """``Client("127.0.0.1", 8500)`` — handles are attributes:
+    ``kv``, ``catalog``, ``health``, ``session``, ``coordinate``,
+    ``status``, ``agent`` (reference api/api.go NewClient)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8500):
+        self.base = f"http://{host}:{port}"
+        self.kv = KV(self)
+        self.catalog = Catalog(self)
+        self.health = Health(self)
+        self.session = Session(self)
+        self.coordinate = Coordinate(self)
+        self.status = Status(self)
+        self.agent = AgentAPI(self)
+
+    def _call(self, method: str, path: str, params: Optional[dict] = None,
+              body: Optional[bytes] = None) -> tuple[Any, QueryMeta, int]:
+        qs = urllib.parse.urlencode(
+            {k: v for k, v in (params or {}).items() if v is not None}
+        )
+        url = f"{self.base}{path}" + (f"?{qs}" if qs else "")
+        req = urllib.request.Request(url, data=body, method=method)
+        try:
+            with urllib.request.urlopen(req) as resp:
+                payload = json.loads(resp.read() or b"null")
+                idx = int(resp.headers.get("X-Consul-Index", 0))
+                return payload, QueryMeta(idx), resp.status
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            try:
+                payload = json.loads(payload or b"null")
+            except json.JSONDecodeError:
+                pass
+            if e.code == 404:
+                idx = int(e.headers.get("X-Consul-Index", 0))
+                return None, QueryMeta(idx), 404
+            raise APIError(e.code, payload) from e
+
+
+class KV:
+    def __init__(self, c: Client):
+        self.c = c
+
+    def get(self, key: str, index: int = 0, wait: str = "10s"):
+        params = {"index": index or None, "wait": wait if index else None}
+        out, meta, status = self.c._call("GET", f"/v1/kv/{key}", params)
+        if status == 404 or not out:
+            return None, meta
+        row = out[0]
+        value = base64.b64decode(row["Value"]) if row["Value"] else b""
+        return {**row, "Value": value}, meta
+
+    def put(self, key: str, value: bytes, cas: Optional[int] = None,
+            flags: int = 0, acquire: Optional[str] = None,
+            release: Optional[str] = None) -> bool:
+        params = {"cas": cas, "flags": flags or None,
+                  "acquire": acquire, "release": release}
+        out, _, _ = self.c._call("PUT", f"/v1/kv/{key}", params, value)
+        return bool(out)
+
+    def delete(self, key: str, recurse: bool = False) -> bool:
+        params = {"recurse": "" if recurse else None}
+        out, _, _ = self.c._call("DELETE", f"/v1/kv/{key}", params)
+        return bool(out)
+
+    def keys(self, prefix: str = "") -> list[str]:
+        out, _, _ = self.c._call("GET", f"/v1/kv/{prefix}", {"keys": ""})
+        return out or []
+
+    def list(self, prefix: str = "") -> list[dict]:
+        out, _, status = self.c._call("GET", f"/v1/kv/{prefix}",
+                                      {"recurse": ""})
+        if status == 404 or not out:
+            return []
+        return [{**r, "Value": base64.b64decode(r["Value"])
+                 if r["Value"] else b""} for r in out]
+
+
+class Catalog:
+    def __init__(self, c: Client):
+        self.c = c
+
+    def nodes(self, near: str = "", index: int = 0, wait: str = "10s"):
+        params = {"near": near or None, "index": index or None,
+                  "wait": wait if index else None}
+        out, meta, _ = self.c._call("GET", "/v1/catalog/nodes", params)
+        return out, meta
+
+    def services(self):
+        out, meta, _ = self.c._call("GET", "/v1/catalog/services")
+        return out, meta
+
+    def service(self, name: str, tag: Optional[str] = None, near: str = ""):
+        params = {"tag": tag, "near": near or None}
+        out, meta, _ = self.c._call("GET", f"/v1/catalog/service/{name}",
+                                    params)
+        return out, meta
+
+    def register(self, node: str, address: str,
+                 service: Optional[dict] = None,
+                 check: Optional[dict] = None) -> bool:
+        body = {"Node": node, "Address": address}
+        if service:
+            body["Service"] = service
+        if check:
+            body["Check"] = check
+        out, _, _ = self.c._call("PUT", "/v1/catalog/register", None,
+                                 json.dumps(body).encode())
+        return bool(out)
+
+    def deregister(self, node: str, service_id: Optional[str] = None) -> bool:
+        body = {"Node": node}
+        if service_id:
+            body["ServiceID"] = service_id
+        out, _, _ = self.c._call("PUT", "/v1/catalog/deregister", None,
+                                 json.dumps(body).encode())
+        return bool(out)
+
+
+class Health:
+    def __init__(self, c: Client):
+        self.c = c
+
+    def service(self, name: str, passing: bool = False, near: str = "",
+                index: int = 0, wait: str = "10s"):
+        params = {"passing": "" if passing else None, "near": near or None,
+                  "index": index or None, "wait": wait if index else None}
+        out, meta, _ = self.c._call("GET", f"/v1/health/service/{name}",
+                                    params)
+        return out, meta
+
+    def node(self, node: str):
+        out, meta, _ = self.c._call("GET", f"/v1/health/node/{node}")
+        return out, meta
+
+    def state(self, state: str = "any"):
+        out, meta, _ = self.c._call("GET", f"/v1/health/state/{state}")
+        return out, meta
+
+
+class Session:
+    def __init__(self, c: Client):
+        self.c = c
+
+    def create(self, node: Optional[str] = None, ttl: str = "",
+               behavior: str = "release") -> str:
+        body: dict = {"Behavior": behavior}
+        if node:
+            body["Node"] = node
+        if ttl:
+            body["TTL"] = ttl
+        out, _, _ = self.c._call("PUT", "/v1/session/create", None,
+                                 json.dumps(body).encode())
+        return out["ID"]
+
+    def destroy(self, session_id: str) -> bool:
+        out, _, _ = self.c._call("PUT", f"/v1/session/destroy/{session_id}")
+        return bool(out)
+
+    def list(self):
+        out, meta, _ = self.c._call("GET", "/v1/session/list")
+        return out, meta
+
+
+class Coordinate:
+    def __init__(self, c: Client):
+        self.c = c
+
+    def nodes(self):
+        out, meta, _ = self.c._call("GET", "/v1/coordinate/nodes")
+        return out, meta
+
+    def node(self, node: str):
+        out, meta, _ = self.c._call("GET", f"/v1/coordinate/node/{node}")
+        return out, meta
+
+
+class Status:
+    def __init__(self, c: Client):
+        self.c = c
+
+    def leader(self) -> str:
+        out, _, _ = self.c._call("GET", "/v1/status/leader")
+        return out
+
+    def peers(self) -> list[str]:
+        out, _, _ = self.c._call("GET", "/v1/status/peers")
+        return out
+
+
+class AgentAPI:
+    def __init__(self, c: Client):
+        self.c = c
+
+    def self_(self) -> dict:
+        out, _, _ = self.c._call("GET", "/v1/agent/self")
+        return out
+
+    def metrics(self) -> dict:
+        out, _, _ = self.c._call("GET", "/v1/agent/metrics")
+        return out
+
+    def service_register(self, name: str, service_id: str = "",
+                         port: int = 0, tags: Optional[list] = None,
+                         check_ttl: str = "") -> bool:
+        body: dict = {"Name": name, "Port": port}
+        if service_id:
+            body["ID"] = service_id
+        if tags:
+            body["Tags"] = tags
+        if check_ttl:
+            body["Check"] = {"TTL": check_ttl}
+        out, _, _ = self.c._call("PUT", "/v1/agent/service/register", None,
+                                 json.dumps(body).encode())
+        return bool(out)
+
+    def service_deregister(self, service_id: str) -> bool:
+        out, _, _ = self.c._call(
+            "PUT", f"/v1/agent/service/deregister/{service_id}")
+        return bool(out)
+
+    def check_pass(self, check_id: str, note: str = "") -> bool:
+        out, _, _ = self.c._call("PUT", f"/v1/agent/check/pass/{check_id}",
+                                 {"note": note or None})
+        return bool(out)
+
+    def check_fail(self, check_id: str, note: str = "") -> bool:
+        out, _, _ = self.c._call("PUT", f"/v1/agent/check/fail/{check_id}",
+                                 {"note": note or None})
+        return bool(out)
+
+
+class Lock:
+    """Leader-election lock recipe over KV acquire/release (reference
+    api/lock.go): create a session, spin on acquire, hold, release."""
+
+    def __init__(self, client: Client, key: str, node: Optional[str] = None):
+        self.client = client
+        self.key = key
+        self.node = node
+        self.session: Optional[str] = None
+
+    def acquire(self, value: bytes = b"", retries: int = 10,
+                backoff_s: float = 0.1) -> bool:
+        if self.session is None:
+            self.session = self.client.session.create(node=self.node)
+        for _ in range(retries):
+            if self.client.kv.put(self.key, value, acquire=self.session):
+                return True
+            time.sleep(backoff_s)
+        return False
+
+    def release(self) -> bool:
+        if self.session is None:
+            return False
+        ok = self.client.kv.put(self.key, b"", release=self.session)
+        self.client.session.destroy(self.session)
+        self.session = None
+        return ok

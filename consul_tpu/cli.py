@@ -1,0 +1,244 @@
+"""The command-line interface.
+
+Mirrors the reference CLI surface (reference command/, 30+ subcommands
+registered via command/registry.go:16-27) for the subsystems this
+framework implements:
+
+  members          catalog membership + serf health    (command/members)
+  rtt              coordinate distance between nodes   (command/rtt/rtt.go:40)
+  kv get|put|delete|list                               (command/kv)
+  catalog nodes|services                               (command/catalog)
+  info             agent + leadership info             (command/info)
+  services register|deregister                         (command/services)
+  sessions list                                        (command/acl… session)
+  snapshot save|restore                                (command/snapshot)
+
+All commands speak to a running agent's HTTP API (like the reference,
+which routes every subcommand through the api client), selected by
+``--http-addr`` / ``CONSUL_TPU_HTTP_ADDR``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from consul_tpu.api import Client
+from consul_tpu.server.rtt import compute_distance
+
+
+def make_client(args) -> Client:
+    host, _, port = args.http_addr.rpartition(":")
+    return Client(host or "127.0.0.1", int(port))
+
+
+def cmd_members(client: Client, args) -> int:
+    nodes, _ = client.catalog.nodes()
+    checks, _ = client.health.state("any")
+    by_node = {}
+    for c in checks:
+        if c["check_id"] == "serfHealth":
+            by_node[c["node"]] = c["status"]
+    print(f"{'Node':<20} {'Address':<16} Status")
+    for n in nodes:
+        status = {"passing": "alive", "critical": "failed"}.get(
+            by_node.get(n["node"], ""), "unknown")
+        print(f"{n['node']:<20} {n['address']:<16} {status}")
+    return 0
+
+
+def cmd_rtt(client: Client, args) -> int:
+    # reference command/rtt/rtt.go: estimate RTT between two nodes from
+    # their coordinates (LAN by default).
+    coords, _ = client.coordinate.nodes()
+    by_node = {c["node"]: c["coord"] for c in coords
+               if not c.get("segment")}
+    node2 = args.node2 or args.node1
+    a, b = by_node.get(args.node1), by_node.get(node2)
+    if a is None or b is None:
+        missing = args.node1 if a is None else node2
+        print(f"error: no coordinate for {missing!r}", file=sys.stderr)
+        return 1
+    d = compute_distance(a, b)
+    print(f"Estimated {args.node1} <-> {node2} rtt: {d * 1000:.3f} ms")
+    return 0
+
+
+def cmd_kv(client: Client, args) -> int:
+    if args.kv_cmd == "get":
+        row, _ = client.kv.get(args.key)
+        if row is None:
+            print(f"error: key {args.key!r} not found", file=sys.stderr)
+            return 1
+        sys.stdout.write(row["Value"].decode(errors="replace"))
+        if not row["Value"].endswith(b"\n"):
+            sys.stdout.write("\n")
+        return 0
+    if args.kv_cmd == "put":
+        value = args.value.encode() if args.value is not None else \
+            sys.stdin.buffer.read()
+        ok = client.kv.put(args.key, value,
+                           cas=args.cas, flags=args.flags)
+        if not ok:
+            print("error: put failed (CAS conflict?)", file=sys.stderr)
+            return 1
+        print(f"Success! Data written to: {args.key}")
+        return 0
+    if args.kv_cmd == "delete":
+        client.kv.delete(args.key, recurse=args.recurse)
+        print(f"Success! Deleted key{'s under' if args.recurse else ''}: "
+              f"{args.key}")
+        return 0
+    if args.kv_cmd == "list":
+        for k in client.kv.keys(args.key or ""):
+            print(k)
+        return 0
+    raise AssertionError(args.kv_cmd)
+
+
+def cmd_catalog(client: Client, args) -> int:
+    if args.catalog_cmd == "nodes":
+        nodes, _ = client.catalog.nodes(near=args.near or "")
+        print(f"{'Node':<20} Address")
+        for n in nodes:
+            print(f"{n['node']:<20} {n['address']}")
+        return 0
+    if args.catalog_cmd == "services":
+        services, _ = client.catalog.services()
+        for name, tags in sorted(services.items()):
+            print(name + (f"  [{', '.join(tags)}]" if tags else ""))
+        return 0
+    raise AssertionError(args.catalog_cmd)
+
+
+def cmd_info(client: Client, args) -> int:
+    self_info = client.agent.self_()
+    print(f"agent:\n\tnode = {self_info['Config']['NodeName']}")
+    print(f"consensus:\n\tleader = {client.status.leader()}")
+    print(f"\tpeers = {', '.join(client.status.peers())}")
+    return 0
+
+
+def cmd_services(client: Client, args) -> int:
+    if args.services_cmd == "register":
+        client.agent.service_register(
+            args.name, service_id=args.id or "", port=args.port,
+            tags=args.tag or [], check_ttl=args.ttl or "")
+        print(f"Registered service: {args.name}")
+        return 0
+    if args.services_cmd == "deregister":
+        client.agent.service_deregister(args.id or args.name)
+        print(f"Deregistered service: {args.id or args.name}")
+        return 0
+    raise AssertionError(args.services_cmd)
+
+
+def cmd_sessions(client: Client, args) -> int:
+    sessions, _ = client.session.list()
+    for s in sessions:
+        print(f"{s['id']}  node={s['node']}  ttl={s.get('ttl_s', 0)}s")
+    return 0
+
+
+def cmd_snapshot(client: Client, args) -> int:
+    if args.snapshot_cmd == "save":
+        snap, _, _ = client._call("GET", "/v1/snapshot")
+        with open(args.file, "w") as f:
+            json.dump(snap, f)
+        print(f"Saved snapshot (index {snap['index']}) to {args.file}")
+        return 0
+    if args.snapshot_cmd == "restore":
+        with open(args.file) as f:
+            body = f.read().encode()
+        client._call("PUT", "/v1/snapshot", None, body)
+        print(f"Restored snapshot from {args.file}")
+        return 0
+    raise AssertionError(args.snapshot_cmd)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="consul-tpu",
+        description="TPU-native Consul-capability framework CLI",
+    )
+    p.add_argument(
+        "--http-addr",
+        default=os.environ.get("CONSUL_TPU_HTTP_ADDR", "127.0.0.1:8500"),
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("members", help="cluster members + health")
+
+    rtt_p = sub.add_parser("rtt", help="estimate RTT between two nodes")
+    rtt_p.add_argument("node1")
+    rtt_p.add_argument("node2", nargs="?")
+
+    kv_p = sub.add_parser("kv", help="KV store operations")
+    kv_sub = kv_p.add_subparsers(dest="kv_cmd", required=True)
+    g = kv_sub.add_parser("get")
+    g.add_argument("key")
+    pu = kv_sub.add_parser("put")
+    pu.add_argument("key")
+    pu.add_argument("value", nargs="?")
+    pu.add_argument("--cas", type=int)
+    pu.add_argument("--flags", type=int, default=0)
+    d = kv_sub.add_parser("delete")
+    d.add_argument("key")
+    d.add_argument("--recurse", action="store_true")
+    ls = kv_sub.add_parser("list")
+    ls.add_argument("key", nargs="?")
+
+    cat_p = sub.add_parser("catalog", help="catalog queries")
+    cat_sub = cat_p.add_subparsers(dest="catalog_cmd", required=True)
+    cn = cat_sub.add_parser("nodes")
+    cn.add_argument("--near")
+    cat_sub.add_parser("services")
+
+    sub.add_parser("info", help="agent and consensus info")
+
+    svc_p = sub.add_parser("services", help="agent service registration")
+    svc_sub = svc_p.add_subparsers(dest="services_cmd", required=True)
+    sr = svc_sub.add_parser("register")
+    sr.add_argument("name")
+    sr.add_argument("--id")
+    sr.add_argument("--port", type=int, default=0)
+    sr.add_argument("--tag", action="append")
+    sr.add_argument("--ttl")
+    sd = svc_sub.add_parser("deregister")
+    sd.add_argument("name", nargs="?")
+    sd.add_argument("--id")
+
+    sub.add_parser("sessions", help="list sessions")
+
+    snap_p = sub.add_parser("snapshot", help="save/restore server state")
+    snap_sub = snap_p.add_subparsers(dest="snapshot_cmd", required=True)
+    ss = snap_sub.add_parser("save")
+    ss.add_argument("file")
+    sr2 = snap_sub.add_parser("restore")
+    sr2.add_argument("file")
+
+    return p
+
+
+COMMANDS = {
+    "members": cmd_members, "rtt": cmd_rtt, "kv": cmd_kv,
+    "catalog": cmd_catalog, "info": cmd_info, "services": cmd_services,
+    "sessions": cmd_sessions, "snapshot": cmd_snapshot,
+}
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    client = make_client(args)
+    try:
+        return COMMANDS[args.cmd](client, args)
+    except ConnectionError as e:
+        print(f"error contacting agent at {args.http_addr}: {e}",
+              file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

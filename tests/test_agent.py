@@ -1,0 +1,184 @@
+"""Agent tier tests: local-state anti-entropy, TTL/monitor checks, the
+coordinate loop, and the cache — the agent/local, agent/checks,
+agent/cache test surfaces of the reference (reference
+agent/local/state_test.go patterns: register locally, sync, assert
+catalog; perturb catalog, sync, assert repair)."""
+
+import time
+
+import pytest
+
+from consul_tpu.agent.agent import Agent, coordinate_interval_s
+from consul_tpu.agent.cache import Cache
+from consul_tpu.server.endpoints import ServerCluster
+
+
+@pytest.fixture
+def cluster():
+    c = ServerCluster(3, seed=5)
+    c.wait_converged()
+    return c
+
+
+def make_agent(cluster, name="a1", **kw):
+    leader = cluster.leader_server()
+
+    def rpc(method, **args):
+        out = leader.rpc(method, **args)
+        if isinstance(out, int):  # write: drive raft to application
+            cluster.step(60)
+        return out
+
+    return Agent(name, "10.1.0.1", rpc, **kw)
+
+
+class TestAntiEntropy:
+    def test_initial_sync_registers_everything(self, cluster):
+        agent = make_agent(cluster)
+        agent.add_service("web1", "web", 80)
+        agent.local.add_check("c1", "passing", "web1")
+        agent.tick(0.0)
+        leader = cluster.leader_server()
+        assert leader.store.get_node("a1")["address"] == "10.1.0.1"
+        assert leader.store.service_nodes("web")[0]["id"] == "web1"
+        assert leader.store.checks(node="a1")[0]["status"] == "passing"
+
+    def test_sync_is_idempotent(self, cluster):
+        agent = make_agent(cluster)
+        agent.add_service("web1", "web", 80)
+        agent.tick(0.0)
+        w = agent.metrics["sync_writes"]
+        agent.tick(1.0)  # nothing dirty, not yet due
+        assert agent.metrics["sync_writes"] == w
+
+    def test_catalog_drift_repaired(self, cluster):
+        # Anti-entropy removes remote entries the agent doesn't own and
+        # restores entries someone else deleted (local/state_test.go
+        # TestAgentAntiEntropy_Services pattern).
+        agent = make_agent(cluster)
+        agent.add_service("web1", "web", 80)
+        agent.tick(0.0)
+        leader = cluster.leader_server()
+        # Drift 1: a rogue service appears under this node.
+        cluster.write(leader, "Catalog.Register", node="a1",
+                      address="10.1.0.1",
+                      service={"id": "rogue", "service": "rogue"})
+        # Drift 2: our service vanishes.
+        cluster.write(leader, "Catalog.Deregister", node="a1",
+                      service_id="web1")
+        agent.local.services["web1"].in_sync = False  # force re-check
+        agent.tick(100.0)
+        ids = {s["id"] for s in leader.store.node_services("a1")}
+        assert ids == {"web1"}
+
+    def test_serf_health_not_touched_by_agent(self, cluster):
+        leader = cluster.leader_server()
+        agent = make_agent(cluster)
+        agent.tick(0.0)
+        cluster.write(leader, "Catalog.Register", node="a1",
+                      address="10.1.0.1",
+                      check={"check_id": "serfHealth", "status": "passing"})
+        agent.tick(100.0)
+        assert any(c["check_id"] == "serfHealth"
+                   for c in leader.store.checks(node="a1"))
+
+
+class TestChecks:
+    def test_ttl_lifecycle(self, cluster):
+        agent = make_agent(cluster)
+        agent.add_service("db1", "db", 5432, check_ttl_s=10.0)
+        agent.tick(0.0)
+        leader = cluster.leader_server()
+        assert leader.store.node_health("a1") == "critical"  # no heartbeat yet
+        ttl = agent.checks.checks["service:db1"]
+        ttl.pass_(now=1.0, output="ok")
+        agent.tick(1.0)
+        assert leader.store.node_health("a1") == "passing"
+        # Silence past the TTL turns critical again.
+        agent.tick(12.0)
+        assert leader.store.node_health("a1") == "critical"
+        out = leader.store.checks(node="a1")[0]["output"]
+        assert "TTL expired" in out
+
+    def test_monitor_probe(self, cluster):
+        agent = make_agent(cluster)
+        health = {"up": True}
+
+        def probe():
+            return ("passing", "ok") if health["up"] else ("critical", "down")
+
+        agent.checks.add_monitor("mon", probe, interval_s=5.0)
+        agent.tick(0.0)
+        leader = cluster.leader_server()
+        assert leader.store.checks(node="a1")[0]["status"] == "passing"
+        health["up"] = False
+        agent.tick(4.0)  # not due yet
+        assert leader.store.checks(node="a1")[0]["status"] == "passing"
+        agent.tick(5.0)
+        assert leader.store.checks(node="a1")[0]["status"] == "critical"
+
+    def test_crashing_probe_is_critical(self, cluster):
+        agent = make_agent(cluster)
+
+        def probe():
+            raise RuntimeError("boom")
+
+        agent.checks.add_monitor("mon", probe, interval_s=5.0)
+        agent.tick(0.0)
+        leader = cluster.leader_server()
+        c = leader.store.checks(node="a1")[0]
+        assert c["status"] == "critical" and "boom" in c["output"]
+
+
+class TestCoordinateLoop:
+    def test_rate_scaled_interval(self):
+        assert coordinate_interval_s(10) == 15.0           # floor
+        assert coordinate_interval_s(6400) == 100.0        # 6400/64
+
+    def test_send_and_flush(self, cluster):
+        coord = {"vec": [0.001] * 8, "error": 0.5, "height": 0.01,
+                 "adjustment": 0.0}
+        agent = make_agent(cluster, coordinate_source=lambda: coord)
+        agent._next_coord = 0.0
+        agent.tick(0.0)
+        assert agent.metrics["coordinate_sends"] == 1
+        leader = cluster.leader_server()
+        leader.flush_coordinates()
+        cluster.step(60)
+        assert leader.store.coordinate_for("a1")["coord"] == coord
+
+
+class TestCache:
+    def test_hit_then_expire(self):
+        cache = Cache()
+        calls = []
+
+        def fetch(idx, wait):
+            calls.append(idx)
+            return {"index": len(calls), "value": f"v{len(calls)}"}
+
+        assert cache.get("k", fetch, ttl_s=100.0, now=0.0) == "v1"
+        assert cache.get("k", fetch, ttl_s=100.0, now=1.0) == "v1"  # hit
+        assert len(calls) == 1
+        assert cache.get("k", fetch, ttl_s=100.0, now=200.0) == "v2"
+        assert cache.metrics["hits"] == 1
+
+    def test_background_refresh(self, cluster):
+        leader = cluster.leader_server()
+        cluster.write(leader, "Catalog.Register", node="n1", address="a",
+                      service={"id": "web", "service": "web"})
+        agent = make_agent(cluster, name="reader")
+        out = agent.cached_service_nodes("web", ttl_s=30.0, refresh=True)
+        assert len(out) == 1
+        # A new instance appears; the refresh thread's blocking query
+        # picks it up without an explicit re-fetch.
+        cluster.write(leader, "Catalog.Register", node="n2", address="b",
+                      service={"id": "web", "service": "web"})
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            got = agent.cached_service_nodes("web", ttl_s=30.0, refresh=True)
+            if len(got) == 2:
+                break
+            time.sleep(0.05)
+        assert len(got) == 2
+        agent.close()

@@ -1,0 +1,250 @@
+"""HTTP API + client + CLI tests over a real socket — the external
+harness layer of the reference (reference sdk/testutil/server.go forks
+a consul binary and tests api/ against it; here the server is
+in-process but the HTTP boundary is a real TCP socket on a free port,
+the randomPortsSource idiom of agent/testagent.go:376)."""
+
+import io
+import json
+import threading
+import time
+from contextlib import redirect_stdout
+
+import pytest
+
+from consul_tpu.agent.agent import Agent
+from consul_tpu.agent.http import HTTPApi, serve
+from consul_tpu.api import Client, Lock
+from consul_tpu.cli import main as cli_main
+from consul_tpu.server.endpoints import ServerCluster
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """ServerCluster + agent + HTTP server, with a background raft pump
+    (live deployments pump continuously; tests get the same)."""
+    cluster = ServerCluster(3, seed=11)
+    leader = cluster.wait_converged()
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    def pump():
+        while not stop.is_set():
+            with lock:
+                cluster.step()
+            time.sleep(0.002)
+
+    th = threading.Thread(target=pump, daemon=True)
+    th.start()
+
+    def rpc(method, **args):
+        with lock:
+            server = cluster.registry[cluster.raft.wait_converged().id]
+        return server.rpc(method, **args)
+
+    def wait_write(idx):
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            with lock:
+                led = cluster.raft.leader()
+                if led is not None and led.last_applied >= idx:
+                    return
+            time.sleep(0.002)
+
+    agent = Agent("web-agent", "10.9.0.1", rpc, cluster_size=3)
+    api = HTTPApi(agent, server=leader, wait_write=wait_write)
+    httpd, port = serve(api)
+    client = Client("127.0.0.1", port)
+    yield cluster, agent, client, port
+    stop.set()
+    httpd.shutdown()
+
+
+def wait_for(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestHTTP:
+    def test_status(self, stack):
+        _, _, client, _ = stack
+        assert client.status.leader() in client.status.peers()
+
+    def test_kv_roundtrip(self, stack):
+        _, _, client, _ = stack
+        assert client.kv.put("app/config", b"hello")
+        assert wait_for(lambda: client.kv.get("app/config")[0] is not None)
+        row, meta = client.kv.get("app/config")
+        assert row["Value"] == b"hello" and meta.index > 0
+        assert "app/config" in client.kv.keys("app/")
+        assert client.kv.delete("app/config")
+        assert wait_for(lambda: client.kv.get("app/config")[0] is None)
+
+    def test_kv_cas_through_api(self, stack):
+        _, _, client, _ = stack
+        client.kv.put("cas-key", b"v1")
+        assert wait_for(lambda: client.kv.get("cas-key")[0] is not None)
+        idx = client.kv.get("cas-key")[0]["ModifyIndex"]
+        assert client.kv.put("cas-key", b"v2", cas=idx)
+        assert not client.kv.put("cas-key", b"v3", cas=idx)  # stale
+
+    def test_catalog_register_and_query(self, stack):
+        _, _, client, _ = stack
+        client.catalog.register(
+            "db-node", "10.9.0.5",
+            service={"ID": "db1", "Service": "db", "Port": 5432},
+            check={"CheckID": "db-check", "Status": "passing",
+                   "ServiceID": "db1"},
+        )
+        assert wait_for(
+            lambda: any(n["node"] == "db-node"
+                        for n in client.catalog.nodes()[0])
+        )
+        svc, _ = client.catalog.service("db")
+        assert svc[0]["port"] == 5432
+        health, _ = client.health.service("db", passing=True)
+        assert health[0]["node"] == "db-node"
+
+    def test_blocking_query_over_http(self, stack):
+        _, _, client, _ = stack
+        client.kv.put("watch-me", b"v1")
+        assert wait_for(lambda: client.kv.get("watch-me")[0] is not None)
+        _, meta = client.kv.get("watch-me")
+        result = {}
+
+        def blocked_reader():
+            row, m2 = client.kv.get("watch-me", index=meta.index, wait="5s")
+            result["value"] = row["Value"]
+            result["index"] = m2.index
+
+        th = threading.Thread(target=blocked_reader)
+        th.start()
+        time.sleep(0.15)
+        assert "value" not in result  # still long-polling
+        client.kv.put("watch-me", b"v2")
+        th.join(timeout=5)
+        assert result["value"] == b"v2" and result["index"] > meta.index
+
+    def test_agent_service_register_with_ttl_check(self, stack):
+        _, agent, client, _ = stack
+        client.agent.service_register("cache", service_id="cache1",
+                                      port=6379, check_ttl="10s")
+        assert wait_for(
+            lambda: any(s["id"] == "cache1"
+                        for s in client.catalog.service("cache")[0])
+        )
+        # TTL check starts critical; pass it via the HTTP endpoint.
+        health, _ = client.health.service("cache", passing=True)
+        assert health == []
+        client.agent.check_pass("service:cache1", note="all good")
+        assert wait_for(
+            lambda: client.health.service("cache", passing=True)[0] != []
+        )
+
+    def test_session_lock_recipe(self, stack):
+        _, _, client, _ = stack
+        client.catalog.register("web-agent", "10.9.0.1")
+        assert wait_for(
+            lambda: any(n["node"] == "web-agent"
+                        for n in client.catalog.nodes()[0])
+        )
+        lock_a = Lock(client, "locks/leader", node="web-agent")
+        lock_b = Lock(client, "locks/leader", node="web-agent")
+        assert lock_a.acquire(b"holder-a")
+        assert not lock_b.acquire(b"holder-b", retries=2, backoff_s=0.02)
+        assert lock_a.release()
+        assert lock_b.acquire(b"holder-b")
+        lock_b.release()
+
+    def test_coordinates_over_http(self, stack):
+        cluster, _, client, _ = stack
+        client.catalog.register("coord-node", "10.9.0.7")
+        assert wait_for(
+            lambda: any(n["node"] == "coord-node"
+                        for n in client.catalog.nodes()[0])
+        )
+        leader = cluster.registry[cluster.raft.wait_converged().id]
+        leader.rpc("Coordinate.Update", node="coord-node",
+                   coord={"vec": [0.001] * 8, "error": 0.2,
+                          "height": 0.0001, "adjustment": 0.0})
+        leader.flush_coordinates()
+        assert wait_for(
+            lambda: any(c["node"] == "coord-node"
+                        for c in client.coordinate.nodes()[0])
+        )
+        out, _ = client.coordinate.node("coord-node")
+        assert out[0]["coord"]["vec"][0] == 0.001
+
+
+class TestCLI:
+    def run_cli(self, port, *argv):
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = cli_main(["--http-addr", f"127.0.0.1:{port}", *argv])
+        return rc, buf.getvalue()
+
+    def test_kv_put_get_list(self, stack):
+        _, _, client, port = stack
+        rc, out = self.run_cli(port, "kv", "put", "cli/key", "cli-value")
+        assert rc == 0 and "Success" in out
+        assert wait_for(lambda: client.kv.get("cli/key")[0] is not None)
+        rc, out = self.run_cli(port, "kv", "get", "cli/key")
+        assert rc == 0 and out.strip() == "cli-value"
+        rc, out = self.run_cli(port, "kv", "list", "cli/")
+        assert "cli/key" in out
+
+    def test_members_and_info(self, stack):
+        _, _, client, port = stack
+        client.catalog.register("m-node", "10.9.9.9",
+                                check={"CheckID": "serfHealth",
+                                       "Status": "passing"})
+        assert wait_for(
+            lambda: any(n["node"] == "m-node"
+                        for n in client.catalog.nodes()[0])
+        )
+        rc, out = self.run_cli(port, "members")
+        assert rc == 0 and "m-node" in out and "alive" in out
+        rc, out = self.run_cli(port, "info")
+        assert rc == 0 and "leader" in out
+
+    def test_rtt(self, stack):
+        cluster, _, client, port = stack
+        leader = cluster.registry[cluster.raft.wait_converged().id]
+        for name, x in [("rtt-a", 0.0), ("rtt-b", 0.012)]:
+            client.catalog.register(name, "10.0.0.1")
+            assert wait_for(
+                lambda n=name: any(r["node"] == n
+                                   for r in client.catalog.nodes()[0])
+            )
+            leader.rpc("Coordinate.Update", node=name,
+                       coord={"vec": [x] + [0.0] * 7, "error": 0.2,
+                              "height": 0.0, "adjustment": 0.0})
+        leader.flush_coordinates()
+        assert wait_for(
+            lambda: any(c["node"] == "rtt-b"
+                        for c in client.coordinate.nodes()[0])
+        )
+        rc, out = self.run_cli(port, "rtt", "rtt-a", "rtt-b")
+        assert rc == 0 and "12.000 ms" in out
+
+    def test_rtt_unknown_node(self, stack):
+        _, _, _, port = stack
+        rc, _ = self.run_cli(port, "rtt", "nope-1", "nope-2")
+        assert rc == 1
+
+    def test_snapshot_save_restore(self, stack, tmp_path):
+        _, _, client, port = stack
+        client.kv.put("snap/k", b"v")
+        assert wait_for(lambda: client.kv.get("snap/k")[0] is not None)
+        f = str(tmp_path / "snap.json")
+        rc, out = self.run_cli(port, "snapshot", "save", f)
+        assert rc == 0 and "Saved snapshot" in out
+        snap = json.load(open(f))
+        assert any("snap/k" in k for k in snap["tables"]["kv"])
+        rc, out = self.run_cli(port, "snapshot", "restore", f)
+        assert rc == 0
+        assert client.kv.get("snap/k")[0]["Value"] == b"v"
