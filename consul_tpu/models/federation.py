@@ -1,0 +1,233 @@
+"""Multi-datacenter federation: LAN pools + the WAN gossip pool.
+
+The reference federates datacenters with two gossip tiers (reference
+agent/consul/server.go:223-230: every server is in its DC's LAN serf
+pool *and* the global WAN pool, with slower WAN timing
+memberlist/config.go:272-281; LAN server lists flood into the WAN pool,
+flood.go): LAN pools detect node failures inside a DC, the WAN pool
+detects server/DC failures globally and carries the WAN coordinate
+space that drives cross-DC routing (agent/router).
+
+TPU-native shape (BASELINE config 5, SURVEY.md §7 phase 6):
+
+  - All DCs run the SAME vectorized SWIM program, stacked on a leading
+    ``dc`` axis and advanced with one ``vmap``-ped jitted step — on
+    hardware the (dc, nodes) axes map onto a 2-D device mesh, so DCs
+    are data-parallel shards and the node axis shards within each DC.
+  - The WAN pool is a second, smaller simulation over the union of
+    every DC's server subset (nodes ``0..servers_per_dc-1`` of each
+    DC), running the WAN timing profile. LAN ticks are the global
+    clock; WAN ticks fire on a Bresenham schedule so e.g. a 500 ms WAN
+    tick interleaves 200 ms LAN ticks as 3,2,3,2,…
+  - Ground truth: DC sites are planted far apart (inter-DC RTTs
+    dominate), servers sit near their site — so learned WAN Vivaldi
+    coordinates recover the inter-DC distance ordering used by
+    ``Router.get_datacenters_by_distance``.
+
+Fault injection spans both tiers: killing a node kills it in its LAN
+pool and, if it is a server, in the WAN pool too.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.models import state as sim_state
+from consul_tpu.models import swim
+from consul_tpu.models.state import SimState
+from consul_tpu.ops import merge, topology
+from consul_tpu.ops.topology import World
+from consul_tpu.utils import metrics
+
+
+@dataclasses.dataclass(frozen=True)
+class FederationConfig:
+    n_dc: int = 2
+    nodes_per_dc: int = 256
+    servers_per_dc: int = 3
+    # Intra-DC latency world (LAN profile defaults).
+    lan: SimConfig = dataclasses.field(default_factory=SimConfig)
+    # Inter-DC spread for the WAN ground truth (ms).
+    wan_diameter_ms: float = 120.0
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "lan", dataclasses.replace(self.lan, n=self.nodes_per_dc)
+        )
+
+    @property
+    def wan(self) -> SimConfig:
+        """The WAN pool's SimConfig: server subset, WAN gossip profile
+        (reference memberlist/config.go:272-281)."""
+        return dataclasses.replace(
+            self.lan,
+            n=self.n_dc * self.servers_per_dc,
+            gossip=GossipConfig.wan(),
+            world_diameter_ms=self.wan_diameter_ms,
+        )
+
+    @property
+    def n_wan(self) -> int:
+        return self.n_dc * self.servers_per_dc
+
+
+class FederationState(NamedTuple):
+    lan: SimState        # stacked [n_dc, ...] over the dc axis
+    wan: SimState        # flat [n_wan, ...]
+    wan_accum_ms: jax.Array  # [] int32 — Bresenham accumulator
+
+
+class Federation:
+    """Driver for one federated simulation (LAN pools + WAN pool)."""
+
+    def __init__(self, cfg: FederationConfig, seed: int = 0):
+        self.cfg = cfg
+        lan, wan = cfg.lan, cfg.wan
+        key = jax.random.PRNGKey(seed)
+        k_lan_w, k_lan_s, k_wan_w, k_wan_s, k_centers, self.base_key = \
+            jax.random.split(key, 6)
+
+        # LAN: identical dense topology in every DC; per-DC worlds/states.
+        self.lan_nbrs = topology.make_neighbors(lan, k_lan_s)
+        lan_keys = jax.random.split(k_lan_w, cfg.n_dc)
+        self.lan_world = jax.vmap(lambda k: topology.make_world(lan, k))(
+            lan_keys
+        )
+        init_keys = jax.random.split(k_lan_s, cfg.n_dc)
+        lan_state = jax.vmap(lambda k: sim_state.init(lan, k))(init_keys)
+
+        # WAN: servers planted near their DC site.
+        self.wan_nbrs = topology.make_neighbors(wan, k_wan_s)
+        centers = jax.random.uniform(
+            k_centers, (cfg.n_dc, lan.world_dims), jnp.float32,
+            0.0, cfg.wan_diameter_ms / 1000.0,
+        )
+        local = topology.make_world(wan, k_wan_w)
+        site = jnp.repeat(centers, cfg.servers_per_dc, axis=0)
+        wan_world = World(pos=site + 0.02 * local.pos, height=local.height)
+        self.wan_world = wan_world
+        wan_state = sim_state.init(wan, k_wan_s)
+
+        self.state = FederationState(
+            lan=lan_state, wan=wan_state, wan_accum_ms=jnp.int32(0)
+        )
+        self._step = self._build_step()
+
+    # ------------------------------------------------------------------
+    def _build_step(self):
+        cfg = self.cfg
+        lan_cfg, wan_cfg = cfg.lan, cfg.wan
+        lan_step = functools.partial(swim.step, lan_cfg, self.lan_nbrs)
+        wan_step = functools.partial(
+            swim.step, wan_cfg, self.wan_nbrs, self.wan_world
+        )
+
+        def step(state: FederationState, key) -> FederationState:
+            k_lan, k_wan = jax.random.split(key)
+            lan_keys = jax.random.split(k_lan, cfg.n_dc)
+            lan = jax.vmap(lan_step)(self.lan_world, state.lan, lan_keys)
+            # WAN servers that died in their LAN pool are dead on the
+            # WAN too (same process; reference: one serf agent in both
+            # pools). Ground truth flows LAN -> WAN.
+            s = cfg.servers_per_dc
+            server_alive = lan.alive_truth[:, :s].reshape(-1)
+            server_left = lan.left[:, :s].reshape(-1)
+            wan = state.wan._replace(
+                alive_truth=server_alive, left=server_left
+            )
+            # Bresenham: fire a WAN tick whenever accumulated LAN time
+            # crosses the WAN tick size.
+            accum = state.wan_accum_ms + lan_cfg.gossip.tick_ms
+            fire = accum >= wan_cfg.gossip.tick_ms
+            wan = jax.lax.cond(
+                fire, lambda w: wan_step(w, k_wan), lambda w: w, wan
+            )
+            accum = jnp.where(fire, accum - wan_cfg.gossip.tick_ms, accum)
+            return FederationState(lan=lan, wan=wan, wan_accum_ms=accum)
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def run(self, lan_ticks: int):
+        for _ in range(lan_ticks):
+            # Key derived from the current tick alone: unique per step
+            # across any sequence of run() calls (same idiom as the
+            # cluster driver), so fault-injection phases never replay
+            # randomness from earlier phases.
+            self.state = self._step(
+                self.state,
+                jax.random.fold_in(self.base_key, int(self.state.lan.t[0])),
+            )
+        return self.state
+
+    # ------------------------------------------------------------------
+    # Fault injection
+    # ------------------------------------------------------------------
+    def kill(self, dc: int, mask):
+        """Kill nodes in one DC (LAN + WAN if they are servers)."""
+        mask = jnp.asarray(mask, bool)
+        lan_alive = self.state.lan.alive_truth.at[dc].set(
+            self.state.lan.alive_truth[dc] & ~mask
+        )
+        s = self.cfg.servers_per_dc
+        wan_alive = self.state.wan.alive_truth.at[
+            dc * s:(dc + 1) * s
+        ].set(self.state.wan.alive_truth[dc * s:(dc + 1) * s] & ~mask[:s])
+        self.state = self.state._replace(
+            lan=self.state.lan._replace(alive_truth=lan_alive),
+            wan=self.state.wan._replace(alive_truth=wan_alive),
+        )
+
+    def kill_dc(self, dc: int):
+        self.kill(dc, jnp.ones((self.cfg.nodes_per_dc,), bool))
+
+    # ------------------------------------------------------------------
+    # Inspection
+    # ------------------------------------------------------------------
+    def lan_health(self, dc: int) -> metrics.HealthMetrics:
+        state_dc = jax.tree.map(lambda x: x[dc], self.state.lan)
+        return metrics.health(self.cfg.lan, self.lan_nbrs, state_dc)
+
+    def wan_health(self) -> metrics.HealthMetrics:
+        return metrics.health(self.cfg.wan, self.wan_nbrs, self.state.wan)
+
+    def wan_server_coord(self, dc: int, server: int) -> dict:
+        """A WAN server's learned Vivaldi coordinate in store/router
+        form (the WAN coordinate of reference agent/router sorting)."""
+        i = dc * self.cfg.servers_per_dc + server
+        viv = self.state.wan.viv
+        return {
+            "vec": [float(x) for x in viv.vec[i]],
+            "error": float(viv.error[i]),
+            "height": float(viv.height[i]),
+            "adjustment": float(viv.adjustment[i]),
+        }
+
+    def wan_members_seen_by(self, observer_dc: int,
+                            observer_server: int = 0) -> list[dict]:
+        """The WAN member list as one server sees it — feeds the router
+        the way serf WAN membership events do (reference
+        agent/router/serf_adapter.go)."""
+        i = observer_dc * self.cfg.servers_per_dc + observer_server
+        st = merge.key_status(self.state.wan.view_key)[i]
+        out = []
+        for col in range(self.cfg.wan.degree):
+            j = int(self.wan_nbrs[i, col])
+            dc, srv = divmod(j, self.cfg.servers_per_dc)
+            out.append({
+                "id": f"srv{srv}.dc{dc}", "dc": f"dc{dc}",
+                "status": ["alive", "suspect", "dead", "left"][int(st[col])],
+            })
+        return out
+
+    def true_dc_distance_order(self, from_dc: int) -> list[int]:
+        """Ground-truth DC ordering by site distance (for tests)."""
+        s = self.cfg.servers_per_dc
+        sites = self.wan_world.pos[::s]
+        d = jnp.linalg.norm(sites - sites[from_dc], axis=1)
+        return [int(i) for i in jnp.argsort(d)]

@@ -53,3 +53,29 @@ def shard_state(state: SimState, mesh: Mesh) -> SimState:
 def data_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
     """Sharding for auxiliary per-node arrays (nbrs, world tensors)."""
     return NamedSharding(mesh, P(NODE_AXIS, *([None] * (ndim - 1))))
+
+
+def federation_sharding(fed_state, mesh: Mesh):
+    """Sharding pytree for a FederationState over a 2-D (dc, nodes)
+    mesh: LAN leaves [n_dc, N, ...] shard on both axes (DCs are
+    data-parallel shards, nodes shard within a DC); WAN leaves
+    [n_wan, ...] shard on the node axis; scalars replicate."""
+    n_dc = fed_state.lan.alive_truth.shape[0]
+    n = fed_state.lan.alive_truth.shape[1]
+    n_wan = fed_state.wan.alive_truth.shape[0]
+
+    def spec(leaf):
+        if leaf.ndim >= 2 and leaf.shape[0] == n_dc and leaf.shape[1] == n:
+            return NamedSharding(
+                mesh, P(DC_AXIS, NODE_AXIS, *([None] * (leaf.ndim - 2)))
+            )
+        if leaf.ndim >= 1 and leaf.shape[0] == n_dc:
+            return NamedSharding(mesh, P(DC_AXIS, *([None] * (leaf.ndim - 1))))
+        if leaf.ndim >= 1 and leaf.shape[0] == n_wan and \
+                n_wan % mesh.shape[NODE_AXIS] == 0:
+            return NamedSharding(
+                mesh, P(NODE_AXIS, *([None] * (leaf.ndim - 1)))
+            )
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(spec, fed_state)

@@ -1,0 +1,22 @@
+"""Wire protocol: memberlist-compatible framing, compression, crypto.
+
+The reference's gossip messages are msgpack bodies behind a msgType
+byte, with compound batching, LZW compression, CRC32 integrity, and
+AES-GCM encryption (reference memberlist/net.go:46-59, util.go:157-275,
+security.go, keyring.go). This package implements that wire format so
+the framework can interoperate at the byte level — the seam SURVEY.md
+§7 phase 7 describes for bridging real agents into the simulated
+fabric. The LZW codec's hot path is native C++ (consul_tpu/wire/native)
+with a pure-Python fallback.
+"""
+
+from consul_tpu.wire.codec import (  # noqa: F401
+    MessageType,
+    decode_message,
+    decode_packet,
+    encode_message,
+    encode_packet,
+    make_compound,
+    split_compound,
+)
+from consul_tpu.wire.keyring import Keyring  # noqa: F401

@@ -1,0 +1,174 @@
+"""Message codec: msgType framing + msgpack bodies + packet pipeline.
+
+The reference frames every gossip message as ``[msgType byte | msgpack
+body]`` (memberlist/net.go:46-59 for the type ids, go-msgpack encodes
+structs as maps keyed by Go field name), batches small messages into
+compound messages (util.go:157-217: ``[compoundMsg | count | u16
+lengths... | bodies...]``), optionally LZW-compresses
+(util.go:221-275: a ``compress{Algo, Buf}`` body behind compressMsg),
+optionally prefixes a CRC32-IEEE (net.go hasCrcMsg), and optionally
+encrypts the whole packet (security.go, see keyring.py).
+
+:func:`encode_packet`/:func:`decode_packet` run that full pipeline in
+wire order — encrypt(crc(compress(compound(messages)))) — matching
+``rawSendMsgPacket``/``ingestPacket`` (net.go:631-700, :299-346).
+"""
+
+from __future__ import annotations
+
+import enum
+import zlib
+from typing import Optional
+
+import msgpack
+
+from consul_tpu.wire import lzw
+from consul_tpu.wire.keyring import Keyring
+
+
+class MessageType(enum.IntEnum):
+    """memberlist/net.go:45-60."""
+
+    PING = 0
+    INDIRECT_PING = 1
+    ACK_RESP = 2
+    SUSPECT = 3
+    ALIVE = 4
+    DEAD = 5
+    PUSH_PULL = 6
+    COMPOUND = 7
+    USER = 8
+    COMPRESS = 9
+    ENCRYPT = 10
+    NACK_RESP = 11
+    HAS_CRC = 12
+    ERR = 13
+
+
+LZW_ALGO = 0  # compressionType lzwAlgo (util.go:64)
+
+# Struct field names per message type (Go struct fields — go-msgpack
+# writes them as map keys; net.go:80-175).
+_FIELDS = {
+    MessageType.PING: ("SeqNo", "Node"),
+    MessageType.INDIRECT_PING: ("SeqNo", "Target", "Port", "Node", "Nack"),
+    MessageType.ACK_RESP: ("SeqNo", "Payload"),
+    MessageType.NACK_RESP: ("SeqNo",),
+    MessageType.ERR: ("Error",),
+    MessageType.SUSPECT: ("Incarnation", "Node", "From"),
+    MessageType.ALIVE: ("Incarnation", "Node", "Addr", "Port", "Meta", "Vsn"),
+    MessageType.DEAD: ("Incarnation", "Node", "From"),
+    MessageType.PUSH_PULL: ("Nodes", "UserStateLen", "Join"),
+    MessageType.COMPRESS: ("Algo", "Buf"),
+}
+
+
+def encode_message(mtype: MessageType, body: dict) -> bytes:
+    """``[msgType | msgpack(body)]`` (net.go encode :1098-1104)."""
+    allowed = _FIELDS.get(MessageType(mtype))
+    if allowed is not None:
+        unknown = set(body) - set(allowed)
+        if unknown:
+            raise ValueError(f"unknown fields for {mtype!r}: {sorted(unknown)}")
+    return bytes([mtype]) + msgpack.packb(body, use_bin_type=True)
+
+
+def decode_message(buf: bytes) -> tuple[MessageType, dict]:
+    if not buf:
+        raise ValueError("empty message")
+    return MessageType(buf[0]), msgpack.unpackb(buf[1:], raw=False)
+
+
+# ----------------------------------------------------------------------
+# Compound batching (util.go:157-217)
+# ----------------------------------------------------------------------
+
+def make_compound(msgs: list[bytes]) -> bytes:
+    if len(msgs) > 255:
+        raise ValueError("compound messages hold at most 255 parts")
+    out = bytearray([MessageType.COMPOUND, len(msgs)])
+    for m in msgs:
+        if len(m) > 0xFFFF:
+            raise ValueError("compound part exceeds u16 length")
+        out += len(m).to_bytes(2, "big")
+    for m in msgs:
+        out += m
+    return bytes(out)
+
+
+def split_compound(buf: bytes) -> list[bytes]:
+    """decodeCompoundMessage (util.go:181-217); ``buf`` excludes the
+    leading compoundMsg byte. Truncated parts raise."""
+    if not buf:
+        raise ValueError("missing compound length byte")
+    n_parts, buf = buf[0], buf[1:]
+    if len(buf) < n_parts * 2:
+        raise ValueError("truncated compound length slice")
+    lengths = [int.from_bytes(buf[i * 2:i * 2 + 2], "big")
+               for i in range(n_parts)]
+    buf = buf[n_parts * 2:]
+    parts = []
+    for ln in lengths:
+        if len(buf) < ln:
+            raise ValueError(
+                f"compound truncated ({len(parts)} of {n_parts} parts)"
+            )
+        parts.append(buf[:ln])
+        buf = buf[ln:]
+    return parts
+
+
+# ----------------------------------------------------------------------
+# Full packet pipeline (rawSendMsgPacket/ingestPacket order)
+# ----------------------------------------------------------------------
+
+def encode_packet(msgs: list[bytes], *, compress: bool = False,
+                  crc: bool = False,
+                  keyring: Optional[Keyring] = None) -> bytes:
+    """Sender pipeline (net.go:631-700): compound when multiple
+    messages, then compress, then CRC, then encrypt."""
+    pkt = msgs[0] if len(msgs) == 1 else make_compound(msgs)
+    if compress:
+        body = msgpack.packb(
+            {"Algo": LZW_ALGO, "Buf": lzw.compress(pkt)}, use_bin_type=True
+        )
+        pkt = bytes([MessageType.COMPRESS]) + body
+    if crc:
+        digest = zlib.crc32(pkt) & 0xFFFFFFFF
+        pkt = bytes([MessageType.HAS_CRC]) + digest.to_bytes(4, "big") + pkt
+    if keyring is not None and keyring.primary is not None:
+        pkt = bytes([MessageType.ENCRYPT]) + keyring.encrypt(pkt)
+    return pkt
+
+
+def decode_packet(pkt: bytes,
+                  keyring: Optional[Keyring] = None) -> list[tuple[MessageType, dict]]:
+    """Receiver pipeline (ingestPacket net.go:299-346 + handleCompound):
+    decrypt, verify CRC, decompress, split compounds, decode each body.
+    Returns (type, body) pairs in arrival order."""
+    if not pkt:
+        raise ValueError("empty packet")
+    if pkt[0] == MessageType.ENCRYPT:
+        if keyring is None:
+            raise ValueError("encrypted packet but no keyring installed")
+        pkt = keyring.decrypt(pkt[1:])
+    elif keyring is not None and keyring.primary is not None:
+        # GossipVerifyIncoming: plaintext rejected when encryption is on
+        # (config.go:157, net.go:312-320).
+        raise ValueError("plaintext packet rejected (encryption enabled)")
+    if pkt and pkt[0] == MessageType.HAS_CRC:
+        if len(pkt) < 5:
+            raise ValueError("truncated CRC header")
+        want = int.from_bytes(pkt[1:5], "big")
+        pkt = pkt[5:]
+        got = zlib.crc32(pkt) & 0xFFFFFFFF
+        if got != want:
+            raise ValueError(f"packet CRC mismatch ({got:#x} != {want:#x})")
+    if pkt and pkt[0] == MessageType.COMPRESS:
+        body = msgpack.unpackb(pkt[1:], raw=False)
+        if body["Algo"] != LZW_ALGO:
+            raise ValueError(f"unknown compression algo {body['Algo']}")
+        pkt = lzw.decompress(body["Buf"])
+    if pkt and pkt[0] == MessageType.COMPOUND:
+        return [decode_message(part) for part in split_compound(pkt[1:])]
+    return [decode_message(pkt)]

@@ -1,0 +1,81 @@
+"""Federation tests: per-DC isolation, WAN failure detection of a dead
+DC, learned WAN coordinates recovering inter-DC distances, and the
+bridge into the router — the multi-DC behaviors of the reference
+(LAN/WAN pools server.go:223-230, router distance sorting)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from consul_tpu.models.federation import Federation, FederationConfig
+from consul_tpu.server.router import Router
+
+
+@pytest.fixture(scope="module")
+def fed():
+    cfg = FederationConfig(n_dc=3, nodes_per_dc=48, servers_per_dc=3)
+    f = Federation(cfg, seed=4)
+    f.run(60)  # form both tiers
+    return f
+
+
+class TestFederation:
+    def test_all_pools_converge(self, fed):
+        for dc in range(fed.cfg.n_dc):
+            assert float(fed.lan_health(dc).agreement) == 1.0
+        assert float(fed.wan_health().agreement) == 1.0
+
+    def test_wan_ticks_slower_than_lan(self, fed):
+        # 500ms WAN ticks vs 200ms LAN ticks: wan.t ~= lan.t * 0.4.
+        lan_t = int(fed.state.lan.t[0])
+        wan_t = int(fed.state.wan.t)
+        assert 0 < wan_t < lan_t
+        assert abs(wan_t - lan_t * 0.4) <= 2
+
+    def test_lan_failure_stays_local(self, fed):
+        cfg = FederationConfig(n_dc=2, nodes_per_dc=48, servers_per_dc=3)
+        f = Federation(cfg, seed=5)
+        f.run(30)
+        # Kill a non-server node in dc0 (index >= servers_per_dc).
+        f.kill(0, jnp.arange(cfg.nodes_per_dc) == 10)
+        f.run(60)
+        h0, h1 = f.lan_health(0), f.lan_health(1)
+        assert float(h0.agreement) == 1.0      # dc0 detected it
+        assert int(h0.live_nodes) == cfg.nodes_per_dc - 1
+        assert int(h1.live_nodes) == cfg.nodes_per_dc  # dc1 untouched
+        assert float(f.wan_health().agreement) == 1.0  # servers all fine
+
+    def test_dead_dc_detected_on_wan(self, fed):
+        cfg = FederationConfig(n_dc=3, nodes_per_dc=32, servers_per_dc=3)
+        f = Federation(cfg, seed=6)
+        f.run(30)
+        f.kill_dc(2)
+        # WAN timing is slow by design (5s probes, suspicion
+        # 6*log10(n)*5s, config.go:272-281): give it ~2.5 sim-minutes.
+        f.run(750)
+        h = f.wan_health()
+        assert float(h.agreement) == 1.0
+        assert float(h.undetected) == 0.0
+        members = f.wan_members_seen_by(0)
+        dc2 = [m for m in members if m["dc"] == "dc2"]
+        assert dc2 and all(m["status"] == "dead" for m in dc2)
+
+    def test_learned_coordinates_order_dcs(self, fed):
+        # The WAN Vivaldi coordinates must reproduce the true site
+        # distance ordering (the basis of get_datacenters_by_distance).
+        router = Router("dc0")
+        for dc in range(fed.cfg.n_dc):
+            for s in range(fed.cfg.servers_per_dc):
+                router.add_server(f"srv{s}.dc{dc}", f"dc{dc}",
+                                  coord=fed.wan_server_coord(dc, s))
+        got = [int(d[2:]) for d in router.get_datacenters_by_distance()]
+        assert got == fed.true_dc_distance_order(0)
+
+    def test_router_fed_bridge(self, fed):
+        # WAN membership events feed the router; a dead DC's servers
+        # get failed over.
+        router = Router("dc0")
+        for m in fed.wan_members_seen_by(0):
+            router.add_server(m["id"], m["dc"])
+        assert set(router.datacenters()) == {"dc0", "dc1", "dc2"}
+        assert router.find_route("dc1") is not None

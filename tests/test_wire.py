@@ -1,0 +1,168 @@
+"""Wire protocol tests: LZW (native vs Python byte-equivalence +
+roundtrips across table resets), msgpack message codec, compound
+batching, CRC, encryption keyring rotation, and the full packet
+pipeline (reference memberlist/net.go, util.go, security.go tests)."""
+
+import os
+import random
+
+import pytest
+
+from consul_tpu.wire import (
+    Keyring,
+    MessageType,
+    decode_message,
+    decode_packet,
+    encode_message,
+    encode_packet,
+    make_compound,
+    split_compound,
+)
+from consul_tpu.wire import lzw
+
+
+def corpus():
+    rng = random.Random(7)
+    return [
+        b"",
+        b"a",
+        b"hello world " * 100,
+        bytes(range(256)) * 8,
+        bytes(rng.randrange(256) for _ in range(20_000)),  # forces resets
+        bytes(rng.randrange(4) for _ in range(50_000)),    # long matches
+    ]
+
+
+class TestLZW:
+    def test_python_roundtrip(self):
+        for data in corpus():
+            assert lzw.decompress_py(lzw.compress_py(data)) == data
+
+    @pytest.mark.skipif(not lzw.native_available(), reason="no g++")
+    def test_native_matches_python_bytes(self):
+        for data in corpus():
+            assert lzw.compress(data) == lzw.compress_py(data)
+
+    @pytest.mark.skipif(not lzw.native_available(), reason="no g++")
+    def test_cross_roundtrips(self):
+        for data in corpus():
+            assert lzw.decompress(lzw.compress_py(data)) == data
+            assert lzw.decompress_py(lzw.compress(data)) == data
+
+    def test_compresses_redundancy(self):
+        data = b"abc" * 10_000
+        assert len(lzw.compress(data)) < len(data) // 5
+
+    def test_corrupt_stream_raises(self):
+        blob = lzw.compress(b"hello hello hello")
+        with pytest.raises(ValueError):
+            lzw.decompress(blob[:-2] + b"\xff\xff")
+
+
+class TestMessages:
+    def test_ping_roundtrip(self):
+        raw = encode_message(MessageType.PING, {"SeqNo": 42, "Node": "n1"})
+        assert raw[0] == MessageType.PING
+        mtype, body = decode_message(raw)
+        assert mtype == MessageType.PING
+        assert body == {"SeqNo": 42, "Node": "n1"}
+
+    def test_alive_with_binary_fields(self):
+        body = {"Incarnation": 7, "Node": "n2", "Addr": bytes([10, 0, 0, 2]),
+                "Port": 8301, "Meta": b"\x01\x02", "Vsn": [1, 5, 2, 2, 5, 4]}
+        mtype, out = decode_message(encode_message(MessageType.ALIVE, body))
+        assert out == body
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(ValueError, match="unknown fields"):
+            encode_message(MessageType.PING, {"SeqNo": 1, "Bogus": 2})
+
+    def test_compound_roundtrip(self):
+        msgs = [encode_message(MessageType.SUSPECT,
+                               {"Incarnation": i, "Node": f"n{i}",
+                                "From": "me"})
+                for i in range(5)]
+        blob = make_compound(msgs)
+        assert blob[0] == MessageType.COMPOUND
+        assert split_compound(blob[1:]) == msgs
+
+    def test_compound_truncation_detected(self):
+        blob = make_compound([b"abcdef", b"ghijkl"])
+        with pytest.raises(ValueError, match="truncated"):
+            split_compound(blob[1:-3])
+
+
+class TestPacketPipeline:
+    MSGS = [
+        encode_message(MessageType.PING, {"SeqNo": 1, "Node": "a"}),
+        encode_message(MessageType.SUSPECT,
+                       {"Incarnation": 3, "Node": "b", "From": "a"}),
+    ]
+
+    def test_plain(self):
+        out = decode_packet(encode_packet(self.MSGS))
+        assert [m for m, _ in out] == [MessageType.PING, MessageType.SUSPECT]
+
+    def test_compressed_and_crc(self):
+        pkt = encode_packet(self.MSGS, compress=True, crc=True)
+        assert pkt[0] == MessageType.HAS_CRC
+        out = decode_packet(pkt)
+        assert out[0][1]["SeqNo"] == 1
+
+    def test_crc_detects_flip(self):
+        pkt = bytearray(encode_packet(self.MSGS, crc=True))
+        pkt[-1] ^= 0xFF
+        with pytest.raises(ValueError, match="CRC mismatch"):
+            decode_packet(bytes(pkt))
+
+    def test_encrypted_roundtrip(self):
+        ring = Keyring(primary=os.urandom(16))
+        pkt = encode_packet(self.MSGS, compress=True, keyring=ring)
+        assert pkt[0] == MessageType.ENCRYPT
+        out = decode_packet(pkt, keyring=ring)
+        assert out[1][1]["Node"] == "b"
+
+    def test_plaintext_rejected_when_encrypting(self):
+        ring = Keyring(primary=os.urandom(16))
+        pkt = encode_packet(self.MSGS)
+        with pytest.raises(ValueError, match="plaintext"):
+            decode_packet(pkt, keyring=ring)
+
+    def test_wrong_key_fails(self):
+        pkt = encode_packet(self.MSGS, keyring=Keyring(primary=os.urandom(16)))
+        with pytest.raises(ValueError, match="no installed key"):
+            decode_packet(pkt, keyring=Keyring(primary=os.urandom(16)))
+
+
+class TestKeyring:
+    def test_rotation_flow(self):
+        # install -> use -> remove (serf/keymanager.go rotation).
+        k1, k2 = os.urandom(16), os.urandom(32)
+        ring = Keyring(primary=k1)
+        pkt_old = ring.encrypt(b"payload")
+        ring.install(k2)
+        assert ring.decrypt(pkt_old) == b"payload"  # old key still works
+        ring.use(k2)
+        pkt_new = ring.encrypt(b"payload2")
+        assert ring.decrypt(pkt_old) == b"payload"   # non-primary decrypts
+        assert ring.decrypt(pkt_new) == b"payload2"
+        ring.remove(k1)
+        with pytest.raises(ValueError):
+            ring.decrypt(pkt_old)
+
+    def test_primary_cannot_be_removed(self):
+        k = os.urandom(16)
+        ring = Keyring(primary=k)
+        with pytest.raises(ValueError, match="primary"):
+            ring.remove(k)
+
+    def test_bad_key_size(self):
+        with pytest.raises(ValueError, match="key size"):
+            Keyring(primary=b"short")
+
+    def test_aad_binds_header(self):
+        ring = Keyring(primary=os.urandom(16))
+        pkt = ring.encrypt(b"msg", aad=b"header")
+        assert ring.decrypt(pkt, aad=b"header") == b"msg"
+        with pytest.raises(ValueError):
+            ring.decrypt(pkt, aad=b"tampered")
