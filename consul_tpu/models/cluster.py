@@ -35,12 +35,12 @@ class TickTrace(NamedTuple):
     rmse: jax.Array            # [C] f32
 
 
-def _chunk_runner(cfg: SimConfig, nbrs, world, chunk: int, with_metrics: bool):
+def _chunk_runner(cfg: SimConfig, topo, world, chunk: int, with_metrics: bool):
     def body(state, tick_key):
-        state = swim.step(cfg, nbrs, world, state, tick_key)
+        state = swim.step(cfg, topo, world, state, tick_key)
         if not with_metrics:
             return state, ()
-        h = metrics.health(cfg, nbrs, state)
+        h = metrics.health(cfg, topo, state)
         rmse = metrics.vivaldi_rmse(
             cfg, world, state, jax.random.fold_in(tick_key, 1), samples=2048
         )
@@ -65,7 +65,7 @@ class Simulation:
         key = jax.random.PRNGKey(self.seed)
         kw, kn, ks, kb = jax.random.split(key, 4)
         self.world = topology.make_world(self.cfg, kw)
-        self.nbrs = topology.make_neighbors(self.cfg, kn)
+        self.topo = topology.make_topology(self.cfg, kn)
         self.state = sim_state.init(self.cfg, ks)
         self.base_key = kb
         self._runners = {}
@@ -82,7 +82,7 @@ class Simulation:
         k = (chunk, with_metrics)
         if k not in self._runners:
             self._runners[k] = _chunk_runner(
-                self.cfg, self.nbrs, self.world, chunk, with_metrics
+                self.cfg, self.topo, self.world, chunk, with_metrics
             )
         return self._runners[k]
 
@@ -146,7 +146,7 @@ class Simulation:
 
     # -- inspection -----------------------------------------------------
     def health(self) -> metrics.HealthMetrics:
-        return metrics.health(self.cfg, self.nbrs, self.state)
+        return metrics.health(self.cfg, self.topo, self.state)
 
     def rmse(self, seed: int = 99) -> float:
         return float(

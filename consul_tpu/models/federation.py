@@ -93,17 +93,22 @@ class Federation:
         k_lan_w, k_lan_s, k_wan_w, k_wan_s, k_centers, self.base_key = \
             jax.random.split(key, 6)
 
-        # LAN: identical dense topology in every DC; per-DC worlds/states.
-        self.lan_nbrs = topology.make_neighbors(lan, k_lan_s)
+        # LAN: identical circulant topology in every DC; per-DC worlds/
+        # states. Distinct subkeys per use (round-1 advisor finding:
+        # topology and initial protocol state must not share a seed).
+        k_lan_t, k_lan_i, k_wan_t, k_wan_i = jax.random.split(
+            jax.random.fold_in(k_lan_s, 1), 4
+        )
+        self.lan_topo = topology.make_topology(lan, k_lan_t)
         lan_keys = jax.random.split(k_lan_w, cfg.n_dc)
         self.lan_world = jax.vmap(lambda k: topology.make_world(lan, k))(
             lan_keys
         )
-        init_keys = jax.random.split(k_lan_s, cfg.n_dc)
+        init_keys = jax.random.split(k_lan_i, cfg.n_dc)
         lan_state = jax.vmap(lambda k: sim_state.init(lan, k))(init_keys)
 
         # WAN: servers planted near their DC site.
-        self.wan_nbrs = topology.make_neighbors(wan, k_wan_s)
+        self.wan_topo = topology.make_topology(wan, k_wan_t)
         centers = jax.random.uniform(
             k_centers, (cfg.n_dc, lan.world_dims), jnp.float32,
             0.0, cfg.wan_diameter_ms / 1000.0,
@@ -112,20 +117,21 @@ class Federation:
         site = jnp.repeat(centers, cfg.servers_per_dc, axis=0)
         wan_world = World(pos=site + 0.02 * local.pos, height=local.height)
         self.wan_world = wan_world
-        wan_state = sim_state.init(wan, k_wan_s)
+        wan_state = sim_state.init(wan, k_wan_i)
 
         self.state = FederationState(
             lan=lan_state, wan=wan_state, wan_accum_ms=jnp.int32(0)
         )
         self._step = self._build_step()
+        self._runners = {}
 
     # ------------------------------------------------------------------
     def _build_step(self):
         cfg = self.cfg
         lan_cfg, wan_cfg = cfg.lan, cfg.wan
-        lan_step = functools.partial(swim.step, lan_cfg, self.lan_nbrs)
+        lan_step = functools.partial(swim.step, lan_cfg, self.lan_topo)
         wan_step = functools.partial(
-            swim.step, wan_cfg, self.wan_nbrs, self.wan_world
+            swim.step, wan_cfg, self.wan_topo, self.wan_world
         )
 
         def step(state: FederationState, key) -> FederationState:
@@ -153,16 +159,29 @@ class Federation:
 
         return jax.jit(step, donate_argnums=(0,))
 
-    def run(self, lan_ticks: int):
-        for _ in range(lan_ticks):
-            # Key derived from the current tick alone: unique per step
-            # across any sequence of run() calls (same idiom as the
-            # cluster driver), so fault-injection phases never replay
-            # randomness from earlier phases.
-            self.state = self._step(
-                self.state,
-                jax.random.fold_in(self.base_key, int(self.state.lan.t[0])),
-            )
+    def _runner(self, chunk: int):
+        """Scan-compiled multi-tick runner: the whole chunk executes
+        on-device with zero host round-trips (round-1 weakness #4 — the
+        per-tick ``int(t)`` host sync — removed; per-tick keys fold the
+        on-device tick counter, the cluster.py idiom)."""
+        if chunk not in self._runners:
+            step = self._step.__wrapped__  # un-jitted
+
+            def run(state, base_key):
+                def body(st, _):
+                    k = jax.random.fold_in(base_key, st.lan.t[0])
+                    return step(st, k), ()
+                return jax.lax.scan(body, state, jnp.arange(chunk))[0]
+
+            self._runners[chunk] = jax.jit(run, donate_argnums=(0,))
+        return self._runners[chunk]
+
+    def run(self, lan_ticks: int, chunk: int = 32):
+        remaining = lan_ticks
+        while remaining > 0:
+            c = min(chunk, remaining)
+            self.state = self._runner(c)(self.state, self.base_key)
+            remaining -= c
         return self.state
 
     # ------------------------------------------------------------------
@@ -191,10 +210,10 @@ class Federation:
     # ------------------------------------------------------------------
     def lan_health(self, dc: int) -> metrics.HealthMetrics:
         state_dc = jax.tree.map(lambda x: x[dc], self.state.lan)
-        return metrics.health(self.cfg.lan, self.lan_nbrs, state_dc)
+        return metrics.health(self.cfg.lan, self.lan_topo, state_dc)
 
     def wan_health(self) -> metrics.HealthMetrics:
-        return metrics.health(self.cfg.wan, self.wan_nbrs, self.state.wan)
+        return metrics.health(self.cfg.wan, self.wan_topo, self.state.wan)
 
     def wan_server_coord(self, dc: int, server: int) -> dict:
         """A WAN server's learned Vivaldi coordinate in store/router
@@ -215,9 +234,10 @@ class Federation:
         agent/router/serf_adapter.go)."""
         i = observer_dc * self.cfg.servers_per_dc + observer_server
         st = merge.key_status(self.state.wan.view_key)[i]
+        wan_nbrs = topology.nbrs_table(self.wan_topo)
         out = []
         for col in range(self.cfg.wan.degree):
-            j = int(self.wan_nbrs[i, col])
+            j = int(wan_nbrs[i, col])
             dc, srv = divmod(j, self.cfg.servers_per_dc)
             out.append({
                 "id": f"srv{srv}.dc{dc}", "dc": f"dc{dc}",

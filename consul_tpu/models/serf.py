@@ -284,18 +284,21 @@ def query(cfg: SimConfig, s: SerfState, mask, name: int) -> SerfState:
 def leave(cfg: SimConfig, s: SerfState, mask) -> SerfState:
     """Graceful departure of the masked nodes (reference serf/serf.go:675
     Leave: broadcast a leave intent at the next membership Lamport time;
-    memberlist marks the member left rather than failed). The leaver
-    keeps gossiping for ``leave_propagate_delay`` so the intent spreads
-    (reference lib/serf.go:21-25), then goes quiet at ``leave_at``; its
-    LEFT record outranks DEAD in the merge lattice (see ops/merge.py)."""
+    memberlist marks the member left rather than failed). The leaver's
+    own-fact flips to LEFT (models/state.py own_key) and its own-fact
+    broadcast re-arms, so the intent gossips out for
+    ``leave_propagate_delay`` (reference lib/serf.go:21-25) before the
+    node goes quiet at ``leave_at``; LEFT outranks DEAD in the merge
+    lattice (see ops/merge.py), so the departure never reads as a
+    failure once the intent lands."""
     mask = jnp.asarray(mask, bool)
-    rows = jnp.arange(cfg.n, dtype=jnp.int32)
     sw = s.swim
-    left_key = merge.make_key(sw.own_inc, merge.LEFT)
     with jax.ensure_compile_time_eval():
         tx0 = int(scaling.retransmit_limit(cfg.gossip.retransmit_mult, cfg.n))
-    sw = swim._queue_push(cfg, sw, mask, rows, left_key, rows, tx0)
-    sw = sw._replace(leaving=sw.leaving | mask)
+    sw = sw._replace(
+        leaving=sw.leaving | mask,
+        own_tx=jnp.where(mask, tx0, sw.own_tx),
+    )
     delay = to_ticks(cfg.serf.leave_propagate_delay_ms, cfg.gossip.tick_ms)
     return s._replace(
         swim=sw,
@@ -308,12 +311,12 @@ def leave(cfg: SimConfig, s: SerfState, mask) -> SerfState:
 # The serf tick.
 # ----------------------------------------------------------------------
 
-def step(cfg: SimConfig, nbrs: jax.Array, world: World, s: SerfState, key) -> SerfState:
+def step(cfg: SimConfig, topo, world: World, s: SerfState, key) -> SerfState:
     """One serf tick: SWIM membership tick, then event/query gossip,
     response tally, query expiry, and reap bookkeeping."""
     k_swim, k_ev = jax.random.split(key)
     t = s.swim.t
-    sw = swim.step(cfg, nbrs, world, s.swim, k_swim)
+    sw = swim.step(cfg, topo, world, s.swim, k_swim)
     # Pending graceful leaves whose propagate window closed go quiet now
     # (serf.Leave sleeps LeavePropagateDelay then shuts memberlist down).
     quiet = (s.leave_at >= 0) & (sw.t >= s.leave_at)
@@ -321,7 +324,7 @@ def step(cfg: SimConfig, nbrs: jax.Array, world: World, s: SerfState, key) -> Se
     s = s._replace(swim=sw, leave_at=jnp.where(quiet, -1, s.leave_at))
     active = sw.alive_truth & ~sw.left
 
-    s = _event_phase(cfg, nbrs, s, active, k_ev)
+    s = _event_phase(cfg, topo, s, active, k_ev)
 
     # Query expiry: past-deadline queries close (serf/query.go Deadline).
     expired = (s.q_open_key > 0) & (sw.t >= s.q_deadline)
@@ -350,7 +353,7 @@ def _lookup_any(cfg: SimConfig, s: SerfState, dst, key_, origin):
     return jnp.where(event_is_query(key_), seen_q, seen_ev)
 
 
-def _event_phase(cfg: SimConfig, nbrs, s: SerfState, active, key) -> SerfState:
+def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
     """Receive → queue → deliver pipeline for user events and queries.
 
     Receiving and delivering are decoupled, as in the reference (every
@@ -365,12 +368,18 @@ def _event_phase(cfg: SimConfig, nbrs, s: SerfState, active, key) -> SerfState:
     Intake is capped at 2 stages/tick and delivery at 1/tick; queue
     capacity pressure can evict (bounded-memory divergence, noted in
     the module docstring).
+
+    Delivery is receiver-side over per-tick shared displacements, like
+    the SWIM gossip plane (models/swim.py): each receiver *rolls in*
+    its senders' chosen events — no scatters. The only scatter left in
+    the serf layer is the per-tick [N] query-response tally add (the
+    response targets an arbitrary origin), outside the hot bench path.
     """
     n, k_deg = cfg.n, cfg.degree
     pe, fan = cfg.serf.piggyback_events, cfg.gossip.gossip_nodes
     e_slots = cfg.serf.event_queue_slots
     rows = jnp.arange(n, dtype=jnp.int32)
-    k_peer, k_loss, k_resp = jax.random.split(key, 3)
+    k_cols, k_loss, k_resp = jax.random.split(key, 3)
     sentinel = jnp.uint32(0xFFFFFFFF)
     with jax.ensure_compile_time_eval():
         tx_limit = int(scaling.retransmit_limit(cfg.gossip.retransmit_mult, n))
@@ -423,40 +432,26 @@ def _event_phase(cfg: SimConfig, nbrs, s: SerfState, active, key) -> SerfState:
     )
     s = s._replace(q_resps=s.q_resps.at[worig].add(jnp.where(resp_ok, 1, 0)))
 
-    # ---- 2. Gossip out: most-retransmittable queue entries to fan peers.
+    # ---- 2. Gossip out: most-retransmittable queue entries, sent along
+    # per-tick shared displacements (swim-plane divergence note).
     order = jnp.argsort(-s.ev_tx, axis=1)[:, :pe]
     m_key = jnp.take_along_axis(s.ev_key, order, axis=1)
     m_origin = jnp.take_along_axis(s.ev_origin, order, axis=1)
     m_tx = jnp.take_along_axis(s.ev_tx, order, axis=1)
     m_valid = (m_key > 0) & (m_tx > 0) & active[:, None]
 
-    peer_col = jax.random.randint(k_peer, (n, fan), 0, k_deg)
-    peer = jnp.take_along_axis(nbrs, peer_col, axis=1)
-    peer_status = jnp.take_along_axis(
-        merge.key_status(s.swim.view_key), peer_col, axis=1
-    )
+    jcols = jax.random.randint(k_cols, (fan,), 0, k_deg)
+    peer_status = merge.key_status(s.swim.view_key[:, jcols])   # [N, fan]
     peer_ok = (
         ((peer_status == merge.ALIVE) | (peer_status == merge.SUSPECT))
         & active[:, None]
     )
-
-    dst = jnp.repeat(peer[:, :, None], pe, axis=2).reshape(-1)
-    ekey = jnp.repeat(m_key[:, None, :], fan, axis=1).reshape(-1)
-    eorig = jnp.repeat(m_origin[:, None, :], fan, axis=1).reshape(-1)
-    mok = (
-        jnp.repeat(peer_ok[:, :, None], pe, axis=2)
-        & jnp.repeat(m_valid[:, None, :], fan, axis=1)
-    ).reshape(-1)
-    drop = jax.random.uniform(k_loss, dst.shape) < cfg.packet_loss
-    mok = mok & ~drop & s.swim.alive_truth[dst] & ~s.swim.left[dst]
 
     # Decrement transmit budgets by actual sends. A slot retires when
     # its budget is spent AND its payload was delivered locally (a spent
     # undelivered entry must survive to be delivered from the queue).
     sends = jnp.sum(peer_ok, axis=1)[:, None] * jnp.where(m_valid, 1, 0)
     ev_tx = swim._scatter_cols(s.ev_tx, order, jnp.maximum(m_tx - sends, 0))
-    # Exactly the slot delivered this tick (same-key different-origin
-    # twins in other slots are still undelivered and must survive).
     delivered_now = (
         jnp.arange(e_slots, dtype=jnp.int32)[None, :] == del_slot[:, None]
     ) & has[:, None]
@@ -464,27 +459,38 @@ def _event_phase(cfg: SimConfig, nbrs, s: SerfState, active, key) -> SerfState:
     retire = (ev_tx <= 0) & ~still_fresh
     s = s._replace(ev_tx=ev_tx, ev_key=jnp.where(retire, 0, s.ev_key))
 
-    # ---- 3. Intake: stage up to 2 fresh arrivals into the own queue.
-    fresh = mok & ~_lookup_any(cfg, s, dst, ekey, eorig)
-    midx = jnp.arange(dst.shape[0], dtype=jnp.int32)
-    m_total = midx.shape[0]
+    # ---- 3. Intake (receiver-side): roll in each displacement-sender's
+    # chosen events, then stage up to 2 fresh arrivals per receiver.
+    recv_up = s.swim.alive_truth & ~s.swim.left
+    drop = jax.random.uniform(k_loss, (n, fan)) < cfg.packet_loss
+    cand_key, cand_orig = [], []
+    for f in range(fan):
+        shift = topo.off[jcols[f]]
+        arrived = jnp.roll(peer_ok[:, f], shift) & ~drop[:, f] & recv_up
+        ok = arrived[:, None] & jnp.roll(m_valid, shift, axis=0)
+        cand_key.append(jnp.where(ok, jnp.roll(m_key, shift, axis=0), 0))
+        cand_orig.append(
+            jnp.where(ok, jnp.roll(m_origin, shift, axis=0), -1)
+        )
+    ckey = jnp.concatenate(cand_key, axis=1)       # [N, fan*PE]
+    corig = jnp.concatenate(cand_orig, axis=1)
+    m = ckey.shape[1]
+    fresh = (ckey > 0) & ~_lookup_any(
+        cfg, s,
+        jnp.repeat(rows, m).reshape(n, m).reshape(-1),
+        ckey.reshape(-1), corig.reshape(-1),
+    ).reshape(n, m)
     for _ in range(2):
-        win_key = (
-            jnp.full((n,), sentinel, jnp.uint32)
-            .at[dst]
-            .min(jnp.where(fresh, ekey, sentinel))
+        win_key = jnp.min(jnp.where(fresh, ckey, sentinel), axis=1)
+        got = win_key != sentinel
+        slot_i = jnp.argmax(fresh & (ckey == win_key[:, None]), axis=1)
+        win_orig = jnp.take_along_axis(corig, slot_i[:, None], axis=1)[:, 0]
+        s = _equeue_push(
+            cfg, s, got, jnp.where(got, win_key, 0),
+            jnp.where(got, win_orig, -1), tx_limit,
         )
-        is_win = fresh & (ekey == win_key[dst]) & (win_key[dst] != sentinel)
-        win_idx = (
-            jnp.full((n,), m_total, jnp.int32)
-            .at[dst]
-            .min(jnp.where(is_win, midx, m_total))
-        )
-        got = win_idx < m_total
-        wi = jnp.where(got, win_idx, 0)
-        s = _equeue_push(cfg, s, got, ekey[wi], eorig[wi], tx_limit)
-        # Mask this (key, origin) out for the next intake round.
-        taken = (ekey == ekey[wi][dst]) & (eorig == eorig[wi][dst]) & got[dst]
+        taken = (ckey == win_key[:, None]) & (corig == win_orig[:, None]) \
+            & got[:, None]
         fresh = fresh & ~taken
     return s
 

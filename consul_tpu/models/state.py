@@ -10,21 +10,38 @@ becomes a deadline array compared against the global tick counter:
                                                   (incarnation, status)
   per-node probe ticker + shuffled node list   -> next_probe_tick[N],
     (state.go:83-121, :492-513)                   probe_perm[N, K], probe_ptr[N]
-  outstanding probe + ack handler channels     -> pending_target[N],
-    (state.go:262-457, :759-790)                  pending_fail_tick[N]
+  outstanding probe + ack handler channels     -> pending_col[N],
+    (state.go:262-457, :759-790)                  pending_fail_tick[N],
+                                                  pending_nack_miss[N]
   suspicion time.AfterFunc timers + per-from   -> susp_start[N, K],
     confirmation map (suspicion.go)               susp_seen[N, K] (32-bucket
                                                   accuser hash bitmask)
-  TransmitLimitedQueue btree (queue.go)        -> q_subject/q_key/q_from/
-                                                  q_tx[N, B] fixed slots
+  TransmitLimitedQueue btree (queue.go)        -> tx_left[N, K] + own_tx[N]
+                                                  (see below)
   awareness score (awareness.go)               -> awareness[N]
   Vivaldi client + per-peer latency filter     -> viv (VivaldiState[N]),
     (coordinate/client.go)                        lat_buf[N, K, S], lat_cnt[N, K]
   node's own incarnation (state.go:840-864)    -> own_inc[N]
 
+**The broadcast queue is the view itself.** The reference's
+TransmitLimitedQueue holds (subject, message) pairs where the message is
+always the holder's current belief about the subject and a same-subject
+arrival invalidates the queued one (queue.go:182-242) — so a per-entry
+"remaining transmits" counter on the view, reset to the retransmit limit
+whenever the entry changes, is an exact vectorization of the queue:
+``tx_left[i, c]`` > 0 means node i still gossips its (c-column) belief.
+Facts about *oneself* (alive refutations, join announcements, leave
+intents) have no view column, so they ride a parallel own-fact channel:
+``own_tx[i]`` transmits of ``(own_inc[i], ALIVE-or-LEFT)``. Ordering
+fidelity: the queue serves fewest-transmits-first (queue.go:288-373) =
+highest ``tx_left`` first — a top-k, not a btree.
+
 ``alive_truth``/``left`` are the fault-injection ground truth: whether
 the simulated process is actually up (the thing SWIM is trying to
-detect), not anyone's belief.
+detect), not anyone's belief. ``external`` marks bridge-driven seats
+(see wire/bridge.py): the simulation answers probes *to* them from
+ground truth but never originates protocol traffic *for* them — a real
+agent behind the transport seam does that itself.
 """
 
 from __future__ import annotations
@@ -48,44 +65,54 @@ class SimState(NamedTuple):
                               # node must NOT refute suspicions (serf
                               # Leave sets a state that suppresses
                               # refutation, serf/serf.go:675-…)
+    external: jax.Array       # [N] bool — transport-bridge seats
     # -- own per-node protocol state ----------------------------------
     own_inc: jax.Array        # [N] uint32
+    own_tx: jax.Array         # [N] int32 — own-fact transmits remaining
     awareness: jax.Array      # [N] int32, 0..awareness_max-1
     # -- probe scheduler ----------------------------------------------
     probe_perm: jax.Array     # [N, K] int32, per-node shuffled probe order
     probe_ptr: jax.Array      # [N] int32, cursor into probe_perm
     next_probe_tick: jax.Array  # [N] int32
-    pending_target: jax.Array   # [N] int32 global id, -1 = no outstanding probe
+    pending_col: jax.Array      # [N] int32 target column, -1 = no
+                                # outstanding probe
     pending_fail_tick: jax.Array  # [N] int32, when the probe window closes
+    pending_nack_miss: jax.Array  # [N] int32 — indirect-probe nacks that
+                                  # went missing (Lifeguard NACK deltas,
+                                  # reference state.go:437-451)
     # -- membership views ---------------------------------------------
     view_key: jax.Array       # [N, K] uint32 packed (incarnation, status)
     susp_start: jax.Array     # [N, K] int32, tick suspicion began, -1 = none
     susp_seen: jax.Array      # [N, K] uint32, accuser-hash bitmask
-    # -- gossip broadcast queue ---------------------------------------
-    q_subject: jax.Array      # [N, B] int32, -1 = empty slot
-    q_key: jax.Array          # [N, B] uint32
-    q_from: jax.Array         # [N, B] int32 original accuser/source
-    q_tx: jax.Array           # [N, B] int32 transmits remaining
+    tx_left: jax.Array        # [N, K] int32 — gossip transmits remaining
     # -- Vivaldi ------------------------------------------------------
     viv: vivaldi.VivaldiState  # batched [N]
     lat_buf: jax.Array        # [N, K, S] float32 per-peer RTT samples
     lat_cnt: jax.Array        # [N, K] int32 samples pushed
 
 
+def own_key(state: SimState) -> jax.Array:
+    """Each node's own-fact broadcast payload: alive at its incarnation,
+    or a leave intent (LEFT outranks DEAD in the lattice, so a graceful
+    departure is never reported as a failure once the intent lands)."""
+    status = jnp.where(state.leaving | state.left, merge.LEFT, merge.ALIVE)
+    return merge.make_key(state.own_inc, status)
+
+
 def init(cfg: SimConfig, key) -> SimState:
     """A formed cluster at steady state: every node knows every neighbor
-    as alive at incarnation 1, coordinates at the origin, queues empty.
+    as alive at incarnation 1, coordinates at the origin, nothing queued.
 
     (The reference reaches this state through the join/push-pull storm;
     the join process itself is exercised separately via fault injection —
     reviving killed ranges — and the serf intent layer.)
     """
-    n, k_deg, b = cfg.n, cfg.degree, cfg.gossip.queue_slots
+    n, k_deg = cfg.n, cfg.degree
     k_perm, k_stagger = jax.random.split(key)
     # Per-node shuffled probe order over neighbor columns
     # (reference shuffles the node list per wrap, state.go:492-513).
-    perm = jax.vmap(lambda k2: jax.random.permutation(k2, k_deg))(
-        jax.random.split(k_perm, n)
+    perm = jnp.argsort(
+        jax.random.uniform(k_perm, (n, k_deg)), axis=1
     ).astype(jnp.int32)
     probe_period = cfg.gossip.probe_period_ticks
     return SimState(
@@ -93,7 +120,9 @@ def init(cfg: SimConfig, key) -> SimState:
         alive_truth=jnp.ones((n,), bool),
         left=jnp.zeros((n,), bool),
         leaving=jnp.zeros((n,), bool),
+        external=jnp.zeros((n,), bool),
         own_inc=jnp.ones((n,), jnp.uint32),
+        own_tx=jnp.zeros((n,), jnp.int32),
         awareness=jnp.zeros((n,), jnp.int32),
         probe_perm=perm,
         probe_ptr=jnp.zeros((n,), jnp.int32),
@@ -102,15 +131,13 @@ def init(cfg: SimConfig, key) -> SimState:
         next_probe_tick=jax.random.randint(
             k_stagger, (n,), 0, probe_period, jnp.int32
         ),
-        pending_target=jnp.full((n,), -1, jnp.int32),
+        pending_col=jnp.full((n,), -1, jnp.int32),
         pending_fail_tick=jnp.zeros((n,), jnp.int32),
+        pending_nack_miss=jnp.zeros((n,), jnp.int32),
         view_key=jnp.full((n, k_deg), int(merge.make_key(1, merge.ALIVE)), jnp.uint32),
         susp_start=jnp.full((n, k_deg), -1, jnp.int32),
         susp_seen=jnp.zeros((n, k_deg), jnp.uint32),
-        q_subject=jnp.full((n, b), -1, jnp.int32),
-        q_key=jnp.zeros((n, b), jnp.uint32),
-        q_from=jnp.full((n, b), -1, jnp.int32),
-        q_tx=jnp.zeros((n, b), jnp.int32),
+        tx_left=jnp.zeros((n, k_deg), jnp.int32),
         viv=vivaldi.new(cfg.vivaldi, batch_shape=(n,)),
         lat_buf=jnp.zeros((n, k_deg, cfg.vivaldi.latency_filter_size), jnp.float32),
         lat_cnt=jnp.zeros((n, k_deg), jnp.int32),
@@ -123,32 +150,41 @@ def kill(state: SimState, mask) -> SimState:
     return state._replace(alive_truth=state.alive_truth & ~mask)
 
 
-def revive(cfg: SimConfig, state: SimState, mask) -> SimState:
+def revive(cfg: SimConfig, state: SimState, mask, cold: bool = False) -> SimState:
     """Fault injection: restart the masked nodes with a bumped
     incarnation. Like a restarted agent's join (reference
     memberlist.Create setAlive -> aliveNode bootstrap broadcast,
-    memberlist.go:206-228), the node announces itself by queueing an
-    alive broadcast at its new incarnation — without it, peers that
-    believe the node dead would never probe it again.
+    memberlist.go:206-228), the node announces itself via its own-fact
+    channel at the new incarnation — without it, peers that believe the
+    node dead would never probe it again.
+
+    ``cold=True`` models a restart with no serf snapshot (reference
+    serf/snapshot.go, handleRejoin serf.go:1705): the node forgets its
+    member views — every entry drops to (0, DEAD), i.e. "never heard" —
+    and must relearn the cluster through push-pull, the reference's
+    join storm. Warm revive (default) keeps the pre-crash views, the
+    behavior a replayed snapshot buys.
     """
     from consul_tpu.ops import scaling  # local import to avoid cycle
 
-    n = cfg.n
     own_inc = jnp.where(mask, state.own_inc + 1, state.own_inc).astype(jnp.uint32)
-    rows = jnp.arange(n, dtype=jnp.int32)
-    slot0 = jnp.zeros_like(state.q_subject[..., 0], jnp.int32)[..., None] == jnp.arange(
-        state.q_subject.shape[-1], dtype=jnp.int32
-    )
-    write = mask[..., None] & slot0
     with jax.ensure_compile_time_eval():
-        tx0 = int(scaling.retransmit_limit(cfg.gossip.retransmit_mult, n))
-    return state._replace(
+        tx0 = int(scaling.retransmit_limit(cfg.gossip.retransmit_mult, cfg.n))
+    state = state._replace(
         alive_truth=state.alive_truth | mask,
         left=state.left & ~mask,
         leaving=state.leaving & ~mask,
         own_inc=own_inc,
-        q_subject=jnp.where(write, rows[..., None], state.q_subject),
-        q_key=jnp.where(write, merge.make_key(own_inc, merge.ALIVE)[..., None], state.q_key),
-        q_from=jnp.where(write, rows[..., None], state.q_from),
-        q_tx=jnp.where(write, tx0, state.q_tx),
+        own_tx=jnp.where(mask, tx0, state.own_tx),
     )
+    if cold:
+        unknown = merge.make_key(0, merge.DEAD)
+        m = mask[:, None]
+        state = state._replace(
+            view_key=jnp.where(m, unknown, state.view_key),
+            susp_start=jnp.where(m, -1, state.susp_start),
+            susp_seen=jnp.where(m, jnp.uint32(0), state.susp_seen),
+            tx_left=jnp.where(m, 0, state.tx_left),
+            lat_cnt=jnp.where(m, 0, state.lat_cnt),
+        )
+    return state

@@ -8,14 +8,32 @@ this is a single pure function over struct-of-arrays, so XLA fuses the
 whole protocol round into a few kernels and the node axis shards across
 chips.
 
+**TPU-first delivery plane (the round-2 redesign).** Every message
+exchange is formulated *receiver-side*: instead of senders scattering
+into receiver state (`scatter-max`, which XLA serializes on TPU), each
+receiver *gathers* from its senders. The circulant topology
+(ops/topology.py) makes this a dense re-indexing: the in-column-``j``
+sender of node ``r`` is ``r - off[j]``, so "fetch what my sender did"
+is ``jnp.roll(sender_array, off[j])``, and the column any gossiped
+subject lands in at the receiver is the static table
+``remap_row(topo, j)``. Measured on TPU v5e, per-row-indexed
+gathers/scatters run ~40x slower than dense compare-select work, so the
+step avoids them entirely: per-row column selection is one-hot
+compare-select (:func:`_take_cols`), per-row *node* indexing is a
+K-unrolled static-shift roll accumulation (:func:`_gather_by_col` — the
+offsets are trace-time constants), and cross-node delivery is rolls.
+The hot path contains no scatter and no per-row gather.
+
 Tick anatomy (mirroring one round of the reference's event loop):
 
   1. **Suspicion expiry** — per-edge Lifeguard deadline check
      (remainingSuspicionTime, suspicion.go:86-97); expired suspects are
-     declared dead locally (state.go:1141-1156) and the loudest few are
-     broadcast.
+     declared dead locally (state.go:1141-1156); the state change
+     re-arms the entry's retransmit budget, which *is* the broadcast.
   2. **Probe resolution** — probe windows that close this tick with no
-     ack mark the target suspect and broadcast (state.go:437-456).
+     ack mark the target suspect (state.go:437-456) and charge
+     awareness for the failed cycle plus every missing indirect-probe
+     nack (Lifeguard NACK deltas, state.go:437-451).
   3. **Probe launch** — nodes whose probe ticker fires pick the next
      non-dead target in their shuffled order (state.go:193-235), send a
      ping; a direct ack within the timeout feeds Vivaldi with the RTT
@@ -23,42 +41,54 @@ Tick anatomy (mirroring one round of the reference's event loop):
      state.go:342-347); otherwise indirect probes through k relays and
      a TCP fallback are modeled (state.go:366-435), and total failure
      opens a pending suspicion window.
-  4. **Gossip** — each live node piggybacks its queued broadcasts to
-     ``gossip_nodes`` random peers (state.go:517-567, net.go:631);
+  4. **Gossip** — each live node piggybacks its hottest broadcasts
+     (fewest-transmits-first, queue.go:288-373 = highest remaining
+     budget) to ``gossip_nodes`` peers (state.go:517-567, net.go:631);
      deliveries merge into receiver views via the (incarnation, status)
-     join semilattice; newly-learned facts are re-queued (the epidemic),
-     suspect messages about already-suspect entries register Lifeguard
-     confirmations (suspicion.go:103-129), and messages about the
-     receiver itself trigger refutation (state.go:840-864).
-  5. **Push-pull anti-entropy** — nodes on their staggered cadence pick
-     a random live peer and exchange full views both ways, with remote
-     dead claims demoted to suspicion (state.go:573-608, :1217-1240).
+     join semilattice; newly-learned facts re-arm their budget (the
+     epidemic); suspect messages about already-suspect entries register
+     Lifeguard confirmations (suspicion.go:103-129); and messages about
+     the receiver itself trigger refutation (state.go:840-864).
+  5. **Push-pull anti-entropy** — nodes on their staggered cadence
+     exchange full views with one partner, both ways, with remote dead
+     claims demoted to suspicion (state.go:573-608, :1217-1240).
   6. **Suspicion bookkeeping** — one reconciliation pass derives timer
-     starts/resets from the view delta of this tick.
+     starts/resets from the view delta of this tick, then re-arms the
+     retransmit budget of every entry that changed.
 
 Documented vectorization divergences from the reference (each argued in
-SURVEY.md §7 "hard parts"): random gossip-peer sampling is
-with-replacement within a tick (vs rejection-sampled distinct peers,
-util.go:125-153); at most one Lifeguard confirmation bit registers per
-entry per tick (later gossip rounds deliver the rest); mass
-simultaneous expiries all apply locally but only the two most-overdue
-broadcast per node per tick; packet-size packing of the 1400-byte UDP
-budget is modeled by the ``piggyback_msgs`` cap, not enforced by bytes;
-gossip-to-the-dead is not modeled (dead processes cannot receive in the
-simulation's ground truth).
+SURVEY.md §7 "hard parts"): the per-tick gossip peers and indirect-probe
+relays are the *same random displacement set for every node* (vs
+per-node rejection-sampled distinct peers, util.go:125-153) —
+displacements are i.i.d. across ticks, so the epidemic still spreads
+along O(log N) random generator sums; the within-tick displacement
+draws are with replacement; push-pull partners likewise share one
+displacement per tick (stagger spreads real pairs across ticks);
+Lifeguard confirmations ride the accumulated 32-bucket accuser bitmask
+of an entry rather than one accuser per message (collisions undercount,
+which only lengthens the timeout — the safe direction); packet-size
+packing of the 1400-byte UDP budget is modeled by the
+``piggyback_msgs`` cap, not enforced by bytes; gossip to the dead is
+not modeled (dead processes cannot receive in the simulation's ground
+truth).
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from consul_tpu.config import SimConfig
-from consul_tpu.models.state import SimState
+from consul_tpu.models.state import SimState, own_key as _own_key
 from consul_tpu.ops import merge, scaling, topology, vivaldi
-from consul_tpu.ops.topology import World
+from consul_tpu.ops.topology import Topology, World
 
 _NEG = jnp.int32(-1)
+
+# Above this degree the K-unrolled roll paths would bloat the program;
+# fall back to plain gathers (only the dense/small configurations).
+_ROLL_DEGREE_MAX = 256
 
 
 def _statuses(view_key):
@@ -73,43 +103,78 @@ def _accuser_bit(node_id):
     return (jnp.uint32(1) << (jnp.asarray(node_id, jnp.uint32) % 32)).astype(jnp.uint32)
 
 
-def _queue_push(cfg: SimConfig, state: SimState, mask, subject, key, src, tx0):
-    """Insert one broadcast per masked node into its transmit queue.
-
-    Slot choice mirrors TransmitLimitedQueue semantics (reference
-    memberlist/queue.go:182-242): a message about the same subject
-    invalidates/replaces the old one; otherwise take an empty slot;
-    otherwise evict the most-transmitted (lowest remaining) message.
-    """
-    b = cfg.gossip.queue_slots
-    same = state.q_subject == subject[:, None]
-    empty = (state.q_subject < 0) | (state.q_tx <= 0)
-    # Higher score wins the argmax slot choice.
-    score = (
-        jnp.where(same, 3_000_000, 0)
-        + jnp.where(empty, 2_000_000, 0)
-        + (1_000_000 - jnp.minimum(state.q_tx, 999_999))
-    )
-    slot = jnp.argmax(score, axis=1)
-    onehot = (jnp.arange(b, dtype=jnp.int32)[None, :] == slot[:, None]) & mask[:, None]
-    return state._replace(
-        q_subject=jnp.where(onehot, subject[:, None], state.q_subject),
-        q_key=jnp.where(onehot, key[:, None], state.q_key),
-        q_from=jnp.where(onehot, src[:, None], state.q_from),
-        q_tx=jnp.where(onehot, tx0, state.q_tx),
-    )
+def _popcount(x):
+    return jax.lax.population_count(jnp.asarray(x, jnp.uint32))
 
 
-def step(cfg: SimConfig, nbrs: jax.Array, world: World, state: SimState, key) -> SimState:
+# ----------------------------------------------------------------------
+# Gather-free primitives (see module docstring: per-row-indexed gathers
+# are ~40x slower than dense compare-select on TPU).
+# ----------------------------------------------------------------------
+
+def _take_cols(table: jax.Array, cols: jax.Array, fill=0):
+    """Per-row column selection, ``out[i, p] = table[i, cols[i, p]]``,
+    with out-of-range ``cols`` yielding ``fill``.
+
+    One-hot compare-select when K is small (per-row-indexed gathers
+    measure ~40x slower per element on TPU v5e); a plain gather when K
+    is large (dense mode), where the one-hot's K-fold blowup loses."""
+    k = table.shape[1]
+    ok = (cols >= 0) & (cols < k)
+    if k <= _ROLL_DEGREE_MAX:
+        oh = cols[:, None, :] == jnp.arange(k, dtype=jnp.int32)[None, :, None]
+        t = table.astype(jnp.int32) if table.dtype == jnp.bool_ else table
+        vals = jnp.sum(jnp.where(oh, t[:, :, None], 0), axis=1)
+        vals = jnp.where(ok, vals, fill)
+        return vals.astype(bool) if table.dtype == jnp.bool_ else \
+            vals.astype(table.dtype)
+    vals = jnp.take_along_axis(table, jnp.where(ok, cols, 0), axis=1)
+    return jnp.where(ok, vals, jnp.asarray(fill, table.dtype))
+
+
+def _take_col(table: jax.Array, col: jax.Array, fill=0):
+    """Single-column variant: ``out[i] = table[i, col[i]]``."""
+    return _take_cols(table, col[:, None], fill)[:, 0]
+
+
+def _vec_at(vec: jax.Array, idx: jax.Array):
+    """``vec[idx]`` for a table ``vec[K]`` and any-shaped in-range
+    ``idx`` — one-hot over K when small, gather otherwise."""
+    k = vec.shape[0]
+    if k <= _ROLL_DEGREE_MAX:
+        oh = idx[..., None] == jnp.arange(k, dtype=jnp.int32)
+        return jnp.sum(jnp.where(oh, vec, 0), axis=-1).astype(vec.dtype)
+    return vec[idx]
+
+
+def _gather_by_col(topo: Topology, packed: jax.Array, col: jax.Array,
+                   forward: bool = True):
+    """``packed[(i + off[col[i]]) % n]`` (forward) without a per-row
+    gather: K-unrolled static-shift rolls selected per row. The offsets
+    are trace-time constants, so every roll is a static slice+concat.
+    ``packed`` is [N, F]; ``col`` is [N] and must be in range where the
+    result is consumed."""
+    off_np = np.asarray(topo.off)
+    acc = jnp.zeros_like(packed)
+    for j in range(off_np.shape[0]):
+        shift = int(off_np[j])
+        rolled = jnp.roll(packed, -shift if forward else shift, axis=0)
+        acc = jnp.where((col == j)[:, None], rolled, acc)
+    return acc
+
+
+def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> SimState:
     """Advance the whole cluster by one tick. Pure; jit/shard-map safe."""
     n, k_deg = cfg.n, cfg.degree
     g = cfg.gossip
     t = state.t
     rows = jnp.arange(n, dtype=jnp.int32)
-    keys = jax.random.split(key, 9)
+    keys = jax.random.split(key, 10)
+    roll_mode = (not topo.dense) and k_deg <= _ROLL_DEGREE_MAX
 
-    view0 = state.view_key  # snapshot for end-of-tick suspicion bookkeeping
-    active = state.alive_truth & ~state.left
+    view0 = state.view_key  # snapshot for end-of-tick bookkeeping
+    seen0 = state.susp_seen
+    active = state.alive_truth & ~state.left & ~state.external
 
     # Static protocol scalars (cluster-size scaling laws); evaluated at
     # trace time — they depend only on the static cluster size.
@@ -123,7 +188,9 @@ def step(cfg: SimConfig, nbrs: jax.Array, world: World, state: SimState, key) ->
         pp_period = g.push_pull_period_ticks(n)
 
     # ------------------------------------------------------------------
-    # 1. Suspicion expiry: per-edge deadline check.
+    # 1. Suspicion expiry: per-edge deadline check. The local state
+    #    change (suspect -> dead) is itself the broadcast: the end-of-
+    #    tick budget re-arm queues it for gossip (state.go:1141-1156).
     # ------------------------------------------------------------------
     statuses = _statuses(state.view_key)
     is_suspect = (statuses == merge.SUSPECT) & (state.susp_start >= 0)
@@ -136,55 +203,38 @@ def step(cfg: SimConfig, nbrs: jax.Array, world: World, state: SimState, key) ->
     )
     expired = is_suspect & (remaining <= 0.0) & active[:, None]
     dead_key = merge.make_key(merge.key_incarnation(state.view_key), merge.DEAD)
-    view = jnp.where(expired, dead_key, state.view_key)
-    state = state._replace(view_key=view)
-
-    # Broadcast the two most-overdue expiries per node (the rest still
-    # applied locally above; peers' own timers + push-pull cover them).
-    overdue_rank = jnp.where(expired, remaining, jnp.inf)
-    for pick in range(2):
-        col = jnp.argmin(overdue_rank, axis=1).astype(jnp.int32)
-        has = jnp.take_along_axis(expired, col[:, None], axis=1)[:, 0] & active
-        subj = jnp.take_along_axis(nbrs, col[:, None], axis=1)[:, 0]
-        bkey = jnp.take_along_axis(dead_key, col[:, None], axis=1)[:, 0]
-        state = _queue_push(cfg, state, has, subj, bkey, rows, tx_limit)
-        overdue_rank = jnp.where(
-            jnp.arange(k_deg)[None, :] == col[:, None], jnp.inf, overdue_rank
-        )
+    state = state._replace(view_key=jnp.where(expired, dead_key, state.view_key))
 
     # ------------------------------------------------------------------
-    # 2. Probe windows closing this tick with no ack -> suspect target.
+    # 2. Probe windows closing this tick with no ack -> suspect target,
+    #    register self as accuser, charge awareness (+1 for the failed
+    #    cycle, +1 per missing nack; state.go:437-456, awareness.go).
     # ------------------------------------------------------------------
-    failing = (state.pending_target >= 0) & (t >= state.pending_fail_tick) & active
-    ftarget = jnp.where(failing, state.pending_target, 0)
-    fcol = topology.subject_to_col(cfg, nbrs, rows, ftarget)
-    fvalid = failing & (fcol >= 0)
-    fcol_c = jnp.where(fvalid, fcol, 0)
-    fentry = jnp.take_along_axis(state.view_key, fcol_c[:, None], axis=1)[:, 0]
+    failing = (state.pending_col >= 0) & (t >= state.pending_fail_tick) & active
+    fcol = jnp.where(failing, state.pending_col, 0)
+    fentry = _take_col(state.view_key, fcol)
     # suspectNode applies to alive entries at the known incarnation
     # (state.go:1086-1122); for already-suspect entries the join is a
     # no-op and only the accuser bit below registers (a confirmation).
     fsus_key = merge.make_key(merge.key_incarnation(fentry), merge.SUSPECT)
-    fnew = merge.join(fentry, jnp.where(fvalid, fsus_key, jnp.uint32(0)))
-    view = _scatter_row_col_max(state.view_key, rows, fcol_c, jnp.where(fvalid, fnew, 0))
-    # The prober registers itself as an accuser: on an already-suspect
-    # entry this is a Lifeguard confirmation (timer.Confirm in
-    # suspectNode, state.go:1094-1099); on a fresh one the bookkeeping
-    # pass seeds the timer from it.
-    fail_oh = (jnp.arange(k_deg, dtype=jnp.int32)[None, :] == fcol_c[:, None]) & fvalid[:, None]
+    fail_oh = (jnp.arange(k_deg, dtype=jnp.int32)[None, :] == fcol[:, None]) \
+        & failing[:, None]
+    view = jnp.where(
+        fail_oh, merge.join(state.view_key, fsus_key[:, None]), state.view_key
+    )
     susp_seen = state.susp_seen | jnp.where(fail_oh, _accuser_bit(rows)[:, None], 0)
+    awareness = jnp.clip(
+        state.awareness
+        + jnp.where(failing, 1 + state.pending_nack_miss, 0),
+        0, g.awareness_max - 1,
+    )
     state = state._replace(
         view_key=view,
         susp_seen=susp_seen,
-        pending_target=jnp.where(failing, _NEG, state.pending_target),
+        awareness=awareness,
+        pending_col=jnp.where(failing, _NEG, state.pending_col),
+        pending_nack_miss=jnp.where(failing, 0, state.pending_nack_miss),
     )
-    state = _queue_push(cfg, state, fvalid, ftarget, fsus_key, rows, tx_limit)
-    # Failed probe cycle degrades local health (awareness.go; simplified
-    # from the nack-counting form, state.go:437-451).
-    awareness = jnp.clip(
-        state.awareness + jnp.where(failing, 1, 0), 0, g.awareness_max - 1
-    )
-    state = state._replace(awareness=awareness)
 
     # ------------------------------------------------------------------
     # 3. Probe launch.
@@ -195,48 +245,101 @@ def step(cfg: SimConfig, nbrs: jax.Array, world: World, state: SimState, key) ->
     # (the reference's skip loop, state.go:196-231).
     cand_off = jnp.arange(3, dtype=jnp.int32)
     cand_pos = (state.probe_ptr[:, None] + cand_off[None, :]) % k_deg
-    cand_col = jnp.take_along_axis(state.probe_perm, cand_pos, axis=1)
-    cand_status = jnp.take_along_axis(statuses, cand_col, axis=1)
+    cand_col = _take_cols(state.probe_perm, cand_pos)
+    cand_status = _take_cols(statuses.astype(jnp.int32), cand_col, fill=merge.DEAD)
     cand_ok = (cand_status == merge.ALIVE) | (cand_status == merge.SUSPECT)
     has_target = jnp.any(cand_ok, axis=1) & probing
     first_ok = jnp.argmax(cand_ok, axis=1).astype(jnp.int32)
-    target_col = jnp.take_along_axis(cand_col, first_ok[:, None], axis=1)[:, 0]
-    target = jnp.take_along_axis(nbrs, target_col[:, None], axis=1)[:, 0]
+    target_col = _take_col(cand_col, first_ok)
     advance = jnp.where(probing, jnp.where(has_target, first_ok + 1, 3), 0)
 
-    target_up = state.alive_truth[target] & ~state.left[target]
-    rtt_obs = topology.sample_rtt(cfg, world, rows, target, keys[0])
+    # Target attributes, fetched without per-row gathers: pack what the
+    # prober needs to know about its target into [N, F] and select the
+    # per-row shift (see _gather_by_col).
+    viv = state.viv
+    if roll_mode:
+        packed = jnp.concatenate(
+            [
+                (state.alive_truth & ~state.left).astype(jnp.float32)[:, None],
+                world.pos,
+                world.height[:, None],
+                viv.vec,
+                viv.height[:, None],
+                viv.error[:, None],
+                viv.adjustment[:, None],
+            ],
+            axis=1,
+        )
+        tat = _gather_by_col(topo, packed, jnp.where(has_target, target_col, 0))
+        wd = world.pos.shape[1]
+        target_up = (tat[:, 0] > 0.5) & has_target
+        t_pos, t_h = tat[:, 1:1 + wd], tat[:, 1 + wd]
+        vd = viv.vec.shape[1]
+        t_vec = tat[:, 2 + wd:2 + wd + vd]
+        t_vh, t_verr, t_vadj = (
+            tat[:, 2 + wd + vd], tat[:, 3 + wd + vd], tat[:, 4 + wd + vd]
+        )
+        true_rtt = (
+            jnp.linalg.norm(world.pos - t_pos, axis=1) + world.height + t_h
+        )
+        jitter = (
+            jax.random.normal(keys[0], (n,), jnp.float32) * cfg.rtt_jitter_frac
+        )
+        rtt_obs = true_rtt * jnp.exp(jitter) if cfg.rtt_jitter_frac > 0 else true_rtt
+    else:
+        target = topology.neighbor_of(topo, rows, target_col)
+        target_up = state.alive_truth[target] & ~state.left[target] & has_target
+        rtt_obs = topology.sample_rtt(cfg, world, rows, target, keys[0])
+        t_vec, t_vh = viv.vec[target], viv.height[target]
+        t_verr, t_vadj = viv.error[target], viv.adjustment[target]
+
     timeout_s = g.probe_timeout_ms / 1000.0
     loss = jax.random.uniform(keys[1], (n, 2)) < cfg.packet_loss  # direct, TCP legs
     direct_ok = has_target & target_up & (rtt_obs <= timeout_s) & ~loss[:, 0]
-    # Indirect probes via k random live relays + TCP fallback
-    # (state.go:366-435): with iid loss both directions per relay.
-    relay_col = jax.random.randint(keys[2], (n, g.indirect_checks), 0, k_deg)
-    relay = jnp.take_along_axis(nbrs, relay_col, axis=1)
-    relay_ok = (
-        state.alive_truth[relay]
-        & ~(jax.random.uniform(keys[3], relay.shape) < cfg.packet_loss)
-        & ~(jax.random.uniform(keys[4], relay.shape) < cfg.packet_loss)
+    # Indirect probes via k relays + TCP fallback (state.go:366-435),
+    # relay displacements shared per tick like the gossip fan. Legs:
+    # prober->relay (a), relay<->target (b), nack return (c).
+    ic = g.indirect_checks
+    relay_jcols = jax.random.randint(keys[2], (ic,), 0, k_deg)
+    relay_ok_nodes = active  # relays must be live non-external members
+    relay_avail = jnp.stack(
+        [
+            jnp.roll(relay_ok_nodes, -topo.off[relay_jcols[i]])
+            for i in range(ic)
+        ],
+        axis=1,
     )
-    indirect_ok = has_target & target_up & jnp.any(relay_ok, axis=1) & ~direct_ok
+    loss_a = jax.random.uniform(keys[3], (n, ic)) < cfg.packet_loss
+    loss_b = jax.random.uniform(keys[4], (n, ic)) < cfg.packet_loss
+    loss_c = jax.random.uniform(keys[5], (n, ic)) < cfg.packet_loss
+    relay_reached = relay_avail & ~loss_a
+    relay_ok = relay_reached & target_up[:, None] & ~loss_b
+    indirect_ok = has_target & jnp.any(relay_ok, axis=1) & ~direct_ok
     tcp_ok = has_target & target_up & ~loss[:, 1]
     acked = direct_ok | indirect_ok | tcp_ok
+    # Nacks: a relay that got the request but could not reach the
+    # target replies nack (state.go:437-451). On a failed cycle every
+    # nack that never arrived is an awareness penalty.
+    nack_rcvd = relay_reached & ~(target_up[:, None] & ~loss_b) & ~loss_c
+    nack_miss = ic - jnp.sum(nack_rcvd, axis=1).astype(jnp.int32)
 
     # A ping to a suspect target carries a suspect message so it can
-    # refute immediately (compound ping+suspect, state.go:306-331).
-    target_status = jnp.take_along_axis(statuses, target_col[:, None], axis=1)[:, 0]
-    target_inc = merge.key_incarnation(
-        jnp.take_along_axis(state.view_key, target_col[:, None], axis=1)[:, 0]
-    )
-    # (Loss for the poke is applied once, by the shared gossip-delivery
-    # drop in _gossip_phase — not here, which would square it.)
-    poke_suspect = has_target & (target_status == merge.SUSPECT) & target_up
+    # refute immediately (compound ping+suspect, state.go:306-331);
+    # delivered receiver-side in the gossip phase below.
+    target_entry = _take_col(state.view_key, jnp.where(has_target, target_col, 0))
+    target_status = merge.key_status(jnp.where(has_target, target_entry, 0))
+    target_inc = merge.key_incarnation(target_entry)
+    poke_flag = has_target & (target_status == merge.SUSPECT) & ~loss[:, 0]
+    poke_col = jnp.where(has_target, target_col, _NEG)
 
     # Probe bookkeeping: window for failures, ticker reschedule scaled
     # by local health (awareness.ScaleTimeout, state.go:268).
-    pending_target = jnp.where(has_target & ~acked, target, state.pending_target)
+    pending_col = jnp.where(has_target & ~acked, target_col, state.pending_col)
     pending_fail_tick = jnp.where(
         has_target & ~acked, t + g.probe_period_ticks, state.pending_fail_tick
+    )
+    pending_nack_miss = jnp.where(
+        has_target & ~acked, nack_miss, state.pending_nack_miss
     )
     interval = g.probe_period_ticks * (state.awareness + 1)
     next_probe = jnp.where(probing, t + interval, state.next_probe_tick)
@@ -249,8 +352,8 @@ def step(cfg: SimConfig, nbrs: jax.Array, world: World, state: SimState, key) ->
     wrapped = ptr >= k_deg
     perm = jax.lax.cond(
         jnp.any(wrapped),
-        lambda p: jax.vmap(jax.random.permutation, in_axes=(0, None))(
-            jax.random.split(keys[5], n), k_deg
+        lambda p: jnp.argsort(
+            jax.random.uniform(keys[6], (n, k_deg)), axis=1
         ).astype(jnp.int32),
         lambda p: p,
         state.probe_perm,
@@ -260,32 +363,39 @@ def step(cfg: SimConfig, nbrs: jax.Array, world: World, state: SimState, key) ->
         probe_ptr=jnp.where(wrapped, 0, ptr),
         probe_perm=probe_perm,
         next_probe_tick=next_probe,
-        pending_target=pending_target,
+        pending_col=pending_col,
         pending_fail_tick=pending_fail_tick,
+        pending_nack_miss=pending_nack_miss,
         awareness=awareness,
     )
 
     # Direct ack feeds Vivaldi: RTT through the per-peer median filter,
     # peer coordinate as the ack payload (ping_delegate.go:28-90).
-    state = _vivaldi_observe(cfg, state, direct_ok, target, target_col, rtt_obs, keys[6])
-
-    # ------------------------------------------------------------------
-    # 4. Gossip fan-out and delivery.
-    # ------------------------------------------------------------------
-    state, refute_inc_gossip = _gossip_phase(
-        cfg, nbrs, state, active, poke_suspect, target, target_inc, tx_limit, keys[7]
+    state = _vivaldi_observe(
+        cfg, state, direct_ok, target_col, rtt_obs,
+        t_vec, t_vh, t_verr, t_vadj, keys[7],
     )
 
     # ------------------------------------------------------------------
-    # 5. Push-pull anti-entropy.
+    # 4. Gossip fan-out and delivery (receiver-side; no scatters).
     # ------------------------------------------------------------------
-    state, refute_inc_pp = _push_pull_phase(cfg, nbrs, state, active, pp_period, keys[8])
+    state, refute_gossip = _gossip_phase(
+        cfg, topo, state, active, keys[8], tx_limit
+    )
+    refute_poke = _poke_refutes(
+        cfg, topo, state, poke_flag, poke_col, target_inc
+    )
 
     # ------------------------------------------------------------------
-    # Refutation: bump own incarnation past any accusation and broadcast
-    # alive (state.go:840-864). Costs health (awareness +1).
+    # 5. Push-pull anti-entropy (receiver-side, both directions).
     # ------------------------------------------------------------------
-    claim = jnp.maximum(refute_inc_gossip, refute_inc_pp)
+    state, refute_pp = _push_pull_phase(cfg, topo, state, active, pp_period, keys[9])
+
+    # ------------------------------------------------------------------
+    # Refutation: bump own incarnation past any accusation and re-arm
+    # the own-fact broadcast (state.go:840-864). Costs health.
+    # ------------------------------------------------------------------
+    claim = jnp.maximum(jnp.maximum(refute_gossip, refute_poke), refute_pp)
     # A node with a broadcast leave intent does not refute — refuting
     # would outrank its own LEFT record in the merge lattice and convert
     # the graceful departure into a detected failure.
@@ -293,243 +403,270 @@ def step(cfg: SimConfig, nbrs: jax.Array, world: World, state: SimState, key) ->
     own_inc = jnp.where(refuting, claim + 1, state.own_inc).astype(jnp.uint32)
     state = state._replace(
         own_inc=own_inc,
+        own_tx=jnp.where(refuting, tx_limit, state.own_tx),
         awareness=jnp.clip(
             state.awareness + jnp.where(refuting, 1, 0), 0, g.awareness_max - 1
         ),
     )
-    state = _queue_push(
-        cfg, state, refuting, rows, merge.make_key(own_inc, merge.ALIVE), rows, tx_limit
-    )
 
     # ------------------------------------------------------------------
-    # 6. Suspicion bookkeeping from this tick's view delta.
+    # 6. Suspicion bookkeeping from this tick's view delta, then re-arm
+    #    the retransmit budget of every changed entry (the reference
+    #    queues a broadcast wherever state changed; new accuser bits on
+    #    a still-suspect entry also re-gossip, suspicion.go:103-129).
     # ------------------------------------------------------------------
     state = _reconcile_suspicion(state, view0, t)
+    changed = (state.view_key != view0) | ((state.susp_seen & ~seen0) != 0)
+    state = state._replace(
+        tx_left=jnp.where(changed & active[:, None], tx_limit, state.tx_left)
+    )
 
     return state._replace(t=t + 1)
 
 
-def _popcount(x):
-    return jax.lax.population_count(jnp.asarray(x, jnp.uint32))
-
-
-def _scatter_row_col_max(view, row_idx, col_idx, key_vals):
-    """view[row, col] = max(view[row, col], key) for one (col, key) per row."""
-    flat = view.reshape(-1)
-    idx = row_idx * view.shape[1] + col_idx
-    return flat.at[idx].max(key_vals).reshape(view.shape)
-
-
-def _vivaldi_observe(cfg, state: SimState, ok, peer, peer_col, rtt, key):
+def _vivaldi_observe(cfg, state: SimState, ok, peer_col, rtt,
+                     p_vec, p_h, p_err, p_adj, key):
     """Apply one probe-RTT observation per masked node (median filter +
-    full Vivaldi update against the peer's coordinate)."""
+    full Vivaldi update against the peer's coordinate payload)."""
     s = cfg.vivaldi.latency_filter_size
     k_deg = cfg.degree
+    col_c = jnp.where(ok, peer_col, 0)
     # Push the sample into the per-(node, peer) ring buffer where ok.
-    cnt = jnp.take_along_axis(state.lat_cnt, peer_col[:, None], axis=1)[:, 0]
+    cnt = _take_col(state.lat_cnt, col_c)
     slot = cnt % s
-    col_oh = jnp.arange(k_deg, dtype=jnp.int32)[None, :] == peer_col[:, None]
+    col_oh = jnp.arange(k_deg, dtype=jnp.int32)[None, :] == col_c[:, None]
     slot_oh = jnp.arange(s, dtype=jnp.int32)[None, :] == slot[:, None]
     write = ok[:, None, None] & col_oh[:, :, None] & slot_oh[:, None, :]
     lat_buf = jnp.where(write, rtt[:, None, None], state.lat_buf)
     lat_cnt = jnp.where(ok[:, None] & col_oh, state.lat_cnt + 1, state.lat_cnt)
     # Median over the filled window (client.go:123-141 semantics).
     filled = jnp.minimum(jnp.where(ok, cnt + 1, 1), s)
-    row_buf = jnp.take_along_axis(
-        lat_buf, jnp.where(ok, peer_col, 0)[:, None, None].repeat(s, axis=2), axis=1
-    )[:, 0, :]
+    row_buf = jnp.sum(
+        jnp.where(col_oh[:, :, None], lat_buf, 0.0), axis=1
+    )  # [N, S] — exclusive one-hot, no gather
     padded = jnp.where(jnp.arange(s)[None, :] < filled[:, None], row_buf, jnp.inf)
-    med = jnp.take_along_axis(
-        jnp.sort(padded, axis=1), (filled // 2)[:, None], axis=1
-    )[:, 0]
+    med = _take_col(jnp.sort(padded, axis=1), filled // 2)
     # Vivaldi update; rejected (rtt=-1) rows pass through untouched.
-    viv = state.viv
     new_viv = vivaldi.update(
-        cfg.vivaldi,
-        viv,
-        viv.vec[peer],
-        viv.height[peer],
-        viv.error[peer],
-        viv.adjustment[peer],
-        jnp.where(ok, med, -1.0),
-        key,
+        cfg.vivaldi, state.viv, p_vec, p_h, p_err, p_adj,
+        jnp.where(ok, med, -1.0), key,
     )
     return state._replace(viv=new_viv, lat_buf=lat_buf, lat_cnt=lat_cnt)
 
 
-def _gossip_phase(cfg, nbrs, state: SimState, active, poke_suspect, poke_target,
-                  poke_inc, tx_limit, key):
-    """Queue fan-out, delivery, view merge, rebroadcast, confirmations,
-    and refute-claim collection. Returns (state, refute_inc[N])."""
+def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit):
+    """Fan-out + receiver-side delivery + lattice merge + confirmations
+    + refute-claim collection. Returns (state, refute_inc[N]).
+
+    Senders pick their ``piggyback_msgs`` hottest view entries (highest
+    remaining budget = fewest past transmits, the TransmitLimitedQueue
+    order, queue.go:288-373) plus their own-fact, and send them to
+    ``gossip_nodes`` displacement-shared peers. Receivers gather."""
     g = cfg.gossip
-    n, k_deg, b = cfg.n, cfg.degree, g.queue_slots
+    n, k_deg = cfg.n, cfg.degree
     p, fan = g.piggyback_msgs, g.gossip_nodes
-    rows = jnp.arange(n, dtype=jnp.int32)
-    k_peer, k_loss = jax.random.split(key)
+    k_cols, k_drop = jax.random.split(key)
+    col_ids = jnp.arange(k_deg, dtype=jnp.int32)
 
-    # Select the P most-retransmittable queue slots per node (the btree
-    # order: fewest past transmits first, queue.go:288-373).
-    order = jnp.argsort(-state.q_tx, axis=1)[:, :p]
-    m_subject = jnp.take_along_axis(state.q_subject, order, axis=1)
-    m_key = jnp.take_along_axis(state.q_key, order, axis=1)
-    m_from = jnp.take_along_axis(state.q_from, order, axis=1)
-    m_tx = jnp.take_along_axis(state.q_tx, order, axis=1)
-    m_valid = (m_subject >= 0) & (m_tx > 0) & active[:, None]
+    # Shared per-tick gossip displacements (divergence note: module doc).
+    jcols = jax.random.randint(k_cols, (fan,), 0, k_deg)
 
-    # Gossip peers: fan random neighbor columns whose view state is
-    # alive or suspect (kRandomNodes filter, state.go:521-535).
-    peer_col = jax.random.randint(k_peer, (n, fan), 0, k_deg)
-    peer = jnp.take_along_axis(nbrs, peer_col, axis=1)
-    peer_status = jnp.take_along_axis(_statuses(state.view_key), peer_col, axis=1)
-    peer_ok = (
+    # Sender-side selection: top-P entries by remaining budget.
+    budget = jnp.where(active[:, None], state.tx_left, 0)
+    top_tx, scol = jax.lax.top_k(budget, p)          # [N, P]
+    svalid = top_tx > 0
+    skey = _take_cols(state.view_key, scol)
+    sbits = _take_cols(state.susp_seen, scol)
+    ownk = _own_key(state)
+    own_sendable = (state.own_tx > 0) & active
+
+    # Sendable per displacement: the sender's own belief of that peer
+    # must be alive/suspect (kRandomNodes filter, state.go:521-535).
+    peer_status = _statuses(state.view_key[:, jcols])   # [N, fan]
+    sendable = (
         ((peer_status == merge.ALIVE) | (peer_status == merge.SUSPECT))
         & active[:, None]
     )
+    n_sends = jnp.sum(sendable, axis=1).astype(jnp.int32)
 
-    # Flatten to M = N * fan * P messages (+ N compound ping-suspect pokes).
-    dst = jnp.repeat(peer[:, :, None], p, axis=2).reshape(-1)
-    subj = jnp.repeat(m_subject[:, None, :], fan, axis=1).reshape(-1)
-    mkey = jnp.repeat(m_key[:, None, :], fan, axis=1).reshape(-1)
-    mfrom = jnp.repeat(m_from[:, None, :], fan, axis=1).reshape(-1)
-    mok = (
-        jnp.repeat(peer_ok[:, :, None], p, axis=2)
-        & jnp.repeat(m_valid[:, None, :], fan, axis=1)
-    ).reshape(-1)
-    # The self-addressed suspect tacked onto pings of suspect targets.
-    dst = jnp.concatenate([dst, poke_target])
-    subj = jnp.concatenate([subj, poke_target])
-    mkey = jnp.concatenate([mkey, merge.make_key(poke_inc, merge.SUSPECT)])
-    mfrom = jnp.concatenate([mfrom, rows])
-    mok = jnp.concatenate([mok, poke_suspect])
-
-    drop = jax.random.uniform(k_loss, dst.shape) < cfg.packet_loss
-    mok = mok & ~drop & state.alive_truth[dst] & ~state.left[dst]
-
-    # Decrement transmit budgets by actual sends; retire exhausted slots.
-    sends = jnp.sum(peer_ok, axis=1)[:, None] * jnp.where(m_valid, 1, 0)
-    new_tx_sel = jnp.maximum(m_tx - sends, 0)
-    q_tx = _scatter_cols(state.q_tx, order, new_tx_sel)
-    q_subject = jnp.where(q_tx <= 0, -1, state.q_subject)
-    state = state._replace(q_tx=q_tx, q_subject=q_subject)
-
-    # Deliveries about the receiver itself are refutation fodder
-    # (state.go:1107-1110, :1187-1192), not view merges.
-    to_self = mok & (subj == dst)
-    refutable = to_self & merge.is_refutable(mkey, to_self, state.own_inc[dst])
-    refute_inc = (
-        jnp.zeros((n,), jnp.uint32)
-        .at[dst]
-        .max(jnp.where(refutable, merge.key_incarnation(mkey), 0))
+    # Budget decrements for actual transmits (queue.go GetBroadcasts).
+    sel_oh = jnp.any(
+        (scol[:, None, :] == col_ids[None, :, None]) & svalid[:, None, :],
+        axis=2,
     )
+    tx_left = jnp.maximum(
+        state.tx_left - jnp.where(sel_oh, n_sends[:, None], 0), 0
+    )
+    own_tx = jnp.where(
+        own_sendable, jnp.maximum(state.own_tx - n_sends, 0), state.own_tx
+    )
+    state = state._replace(tx_left=tx_left, own_tx=own_tx)
 
-    # Merge the rest into receiver views (batched scatter-max join).
-    col = topology.subject_to_col(cfg, nbrs, dst, subj)
-    deliver = mok & (col >= 0)
-    col_c = jnp.where(deliver, col, 0)
-    flat_idx = jnp.where(deliver, dst * k_deg + col_c, 0)
-    scatter_key = jnp.where(deliver, mkey, jnp.uint32(0))
-    old_flat = state.view_key.reshape(-1)
-    new_flat = old_flat.at[flat_idx].max(scatter_key)
-    view_new = new_flat.reshape(n, k_deg)
+    # Receiver-side delivery: one packet per (receiver, displacement).
+    recv_up = state.alive_truth & ~state.left
+    drop = jax.random.uniform(k_drop, (n, fan)) < cfg.packet_loss
+    view = state.view_key
+    refute_inc = jnp.zeros((n,), jnp.uint32)
+    seen_delta = jnp.zeros((n, k_deg), jnp.uint32)
+    cands = []
+    for f in range(fan):
+        j = jcols[f]
+        shift = topo.off[j]
+        arrived = (
+            jnp.roll(sendable[:, f], shift) & ~drop[:, f] & recv_up
+        )
+        s_scol = jnp.roll(scol, shift, axis=0)
+        s_skey = jnp.roll(skey, shift, axis=0)
+        s_sbits = jnp.roll(sbits, shift, axis=0)
+        fact_ok = arrived[:, None] & jnp.roll(svalid, shift, axis=0)
+        rr = topology.remap_row(topo, j)                # [K]
+        mycol = _vec_at(rr, s_scol)                     # [N, P]
+        about_me = mycol == topology.SELF
+        # Facts about the receiver are refutation fodder, not merges
+        # (state.go:1107-1110, :1187-1192).
+        refut = fact_ok & about_me & merge.is_refutable(
+            s_skey, about_me, state.own_inc[:, None]
+        )
+        refute_inc = jnp.maximum(
+            refute_inc,
+            jnp.max(jnp.where(refut, merge.key_incarnation(s_skey), 0), axis=1),
+        )
+        mergeable = fact_ok & (mycol >= 0)
+        mkey = jnp.where(mergeable, s_skey, jnp.uint32(0))
+        # The sender's own-fact rides the same packet, landing at the
+        # receiver column the sender itself occupies.
+        icol = topology.inv_col(topo, j)
+        own_ok = arrived & jnp.roll(own_sendable, shift)
+        own_val = jnp.where(own_ok, jnp.roll(ownk, shift), jnp.uint32(0))
+        # Merge: per-row one-hot max over the P facts + the own-fact.
+        oh = mycol[:, None, :] == col_ids[None, :, None]          # [N,K,P]
+        delta = jnp.max(jnp.where(oh, mkey[:, None, :], 0), axis=2)
+        delta = jnp.maximum(
+            delta, jnp.where(col_ids[None, :] == icol, own_val[:, None], 0)
+        )
+        view = merge.join(view, delta)
+        cands.append((mycol, mkey, s_sbits, mergeable))
 
-    # Lifeguard confirmations: a suspect message about an entry that is
-    # (still) suspect at that incarnation registers its accuser's hash
-    # bit; at most one new bit lands per entry per tick (divergence note
-    # in the module docstring).
-    post_key = new_flat[flat_idx]
-    confirm = (
-        deliver
-        & (merge.key_status(mkey) == merge.SUSPECT)
-        & (merge.key_status(post_key) == merge.SUSPECT)
-        & (merge.key_incarnation(mkey) >= merge.key_incarnation(post_key))
-    )
-    bits = jnp.where(confirm, _accuser_bit(mfrom), jnp.uint32(0))
-    tick_bits = (
-        jnp.zeros((n * k_deg,), jnp.uint32).at[flat_idx].max(bits).reshape(n, k_deg)
-    )
+    # Lifeguard confirmations against the post-merge view: a suspect
+    # fact at the (still-)current incarnation ORs its accumulated
+    # accuser bits into the entry (suspicion.go:103-129).
+    for mycol, mkey, bits, ok in cands:
+        col_c = jnp.clip(mycol, 0, k_deg - 1)
+        post = _take_cols(view, col_c)
+        conf = (
+            ok
+            & (merge.key_status(mkey) == merge.SUSPECT)
+            & (merge.key_status(post) == merge.SUSPECT)
+            & (merge.key_incarnation(mkey) >= merge.key_incarnation(post))
+        )
+        for pi in range(p):
+            oh = (col_c[:, pi:pi + 1] == col_ids[None, :]) & conf[:, pi:pi + 1]
+            seen_delta = seen_delta | jnp.where(oh, bits[:, pi:pi + 1], 0)
 
-    # Rebroadcast the strongest newly-learned fact per receiver
-    # (the epidemic re-queue of NotifyMsg, delegate rebroadcast path).
-    learned = deliver & (mkey > old_flat[flat_idx])
-    win_key = (
-        jnp.zeros((n,), jnp.uint32).at[dst].max(jnp.where(learned, mkey, 0))
-    )
-    is_win = learned & (mkey == win_key[dst]) & (win_key[dst] > 0)
-    midx = jnp.arange(dst.shape[0], dtype=jnp.int32)
-    win_idx = (
-        jnp.full((n,), midx.shape[0], jnp.int32)
-        .at[dst]
-        .min(jnp.where(is_win, midx, midx.shape[0]))
-    )
-    has_win = win_idx < midx.shape[0]
-    win_idx_c = jnp.where(has_win, win_idx, 0)
-    state = state._replace(view_key=view_new, susp_seen=state.susp_seen | tick_bits)
-    state = _queue_push(
-        cfg, state, has_win, subj[win_idx_c], mkey[win_idx_c], mfrom[win_idx_c], tx_limit
-    )
+    state = state._replace(view_key=view, susp_seen=state.susp_seen | seen_delta)
     return state, refute_inc
 
 
-def _push_pull_phase(cfg, nbrs, state: SimState, active, pp_period, key):
-    """Full-state exchange with one random live partner, both directions
-    (sendAndReceiveState/mergeState, net.go:777-1070, state.go:573-608)."""
+def _poke_refutes(cfg, topo: Topology, state: SimState, poke_flag, poke_col,
+                  poke_inc):
+    """Receiver-side check for compound ping+suspect pokes: was I probed
+    this tick by any in-neighbor that believes me suspect? Probes ride
+    per-node columns (not the shared displacements), so every in-column
+    is checked — K static-shift rolls (sparse) or one dense gather."""
+    n, k_deg = cfg.n, cfg.degree
+    up = state.alive_truth & ~state.left
+    if (not topo.dense) and k_deg <= _ROLL_DEGREE_MAX:
+        off_np = np.asarray(topo.off)
+        claim = jnp.zeros((n,), jnp.uint32)
+        poked_inc = jnp.where(poke_flag, poke_inc, 0).astype(jnp.uint32)
+        for j in range(k_deg):
+            shift = int(off_np[j])
+            contrib = jnp.roll(
+                jnp.where(poke_col == j, poked_inc, 0), shift
+            )
+            claim = jnp.maximum(claim, contrib)
+        refut = (claim >= state.own_inc) & up & (claim > 0)
+        return jnp.where(refut, claim, 0)
+    rows = jnp.arange(n, dtype=jnp.int32)
+    s_mat = (rows[:, None] - topo.off[None, :]) % n      # [N, K] senders
+    hit = (
+        (poke_col[s_mat] == jnp.arange(k_deg, dtype=jnp.int32)[None, :])
+        & poke_flag[s_mat]
+        & up[:, None]
+    )
+    inc = jnp.where(hit, poke_inc[s_mat], 0).astype(jnp.uint32)
+    refut = inc >= state.own_inc[:, None]
+    return jnp.max(jnp.where(refut & hit, inc, 0), axis=1)
+
+
+def _push_pull_phase(cfg, topo: Topology, state: SimState, active, pp_period, key):
+    """Full-state exchange, both directions, with one displacement-shared
+    partner per due node (sendAndReceiveState/mergeState,
+    net.go:777-1070, state.go:573-608). Receiver-side formulation: the
+    pull direction gathers the partner's view forward along the
+    displacement; the push direction gathers the initiator's view
+    backward; both remap columns through the static tables."""
     n, k_deg = cfg.n, cfg.degree
     rows = jnp.arange(n, dtype=jnp.int32)
-    k_partner = key
 
-    stagger = jax.random.randint(
-        jax.random.PRNGKey(17), (n,), 0, pp_period, jnp.int32
-    )  # fixed per-node phase offset (deterministic across ticks)
+    # Fixed per-node phase offset (Knuth-hash stagger; deterministic).
+    stagger = (rows * jnp.int32(-1640531527)) % pp_period
     due = active & ((state.t + stagger) % pp_period == 0)
 
-    pcol = jax.random.randint(k_partner, (n,), 0, k_deg)
-    partner = jnp.take_along_axis(nbrs, pcol[:, None], axis=1)[:, 0]
-    partner_ok = due & state.alive_truth[partner] & ~state.left[partner]
+    j = jax.random.randint(key, (), 0, k_deg)
+    shift = topo.off[j]
+    icol = topology.inv_col(topo, j)          # partner's/initiator's seat
+    rr = topology.remap_row(topo, j)          # [K] column remap
+    rr_c = jnp.clip(rr, 0, k_deg - 1)
 
-    subjects = nbrs  # [N, K] global ids of my entries
-    # Remote's column for each of my subjects (and mine for theirs).
-    pcols = topology.subject_to_col(
-        cfg, nbrs, partner[:, None] * jnp.ones((1, k_deg), jnp.int32), subjects
+    view0 = state.view_key                    # both directions exchange
+    ownk = _own_key(state)                    # the pre-exchange states
+    belief = _statuses(view0[:, j])
+    partner_up = jnp.roll(state.alive_truth & ~state.left, -shift)
+    init_ok = (
+        due & partner_up
+        & ((belief == merge.ALIVE) | (belief == merge.SUSPECT))
     )
-    valid = partner_ok[:, None] & (pcols >= 0)
-    pcols_c = jnp.where(valid, pcols, 0)
-    remote_entry = state.view_key[
-        jnp.where(partner_ok, partner, 0)[:, None], pcols_c
-    ]
-    # The partner's record of itself is its live own-state.
-    self_key = merge.make_key(state.own_inc, merge.ALIVE)
-    remote_entry = jnp.where(
-        subjects == partner[:, None], self_key[partner][:, None], remote_entry
+
+    # PULL: the initiator merges its partner's full state.
+    pv = jnp.roll(view0, -shift, axis=0)              # partner rows
+    ent = jnp.take(pv, rr_c, axis=1)
+    ent = jnp.where(rr[None, :] >= 0, ent, jnp.uint32(0))
+    ent = jnp.where(
+        jnp.arange(k_deg, dtype=jnp.int32)[None, :] == j,
+        jnp.roll(ownk, -shift)[:, None], ent,
     )
-    # Remote dead claims arrive as suspicion (mergeState, state.go:1231-1237).
-    remote_entry = merge.demote_dead_to_suspect(remote_entry)
-    # My own entry in their state: refutation check, not a merge.
-    about_me = subjects == rows[:, None]  # never true (nbrs exclude self)
+    pull = merge.demote_dead_to_suspect(ent)
+    view = merge.join(state.view_key, jnp.where(init_ok[:, None], pull, 0))
+    their_view_of_me = pv[:, icol]
+    refut1 = init_ok & merge.is_refutable(their_view_of_me, init_ok, state.own_inc)
+    refute_inc = jnp.where(
+        refut1, merge.key_incarnation(their_view_of_me), 0
+    ).astype(jnp.uint32)
 
-    pull = jnp.where(valid & ~about_me, remote_entry, jnp.uint32(0))
-    view = merge.join(state.view_key, pull)
-
-    # Push direction: my entries (dead demoted likewise) scatter-join
-    # into the partner's view, plus my own alive record.
-    push_key = merge.demote_dead_to_suspect(state.view_key)
-    flat_idx = jnp.where(valid, partner[:, None] * k_deg + pcols_c, 0)
-    flat_val = jnp.where(valid, push_key, jnp.uint32(0))
-    my_col_at_partner = topology.subject_to_col(cfg, nbrs, partner, rows)
-    me_ok = partner_ok & (my_col_at_partner >= 0)
-    me_idx = jnp.where(me_ok, partner * k_deg + jnp.where(me_ok, my_col_at_partner, 0), 0)
-    view_flat = view.reshape(-1)
-    view_flat = view_flat.at[flat_idx.reshape(-1)].max(flat_val.reshape(-1))
-    view_flat = view_flat.at[me_idx].max(jnp.where(me_ok, self_key, jnp.uint32(0)))
-    view = view_flat.reshape(n, k_deg)
-
-    # Refute claims: the partner's view of ME, from the columns already
-    # resolved for the push direction.
-    their_view_of_me = state.view_key[
-        jnp.where(me_ok, partner, 0), jnp.where(me_ok, my_col_at_partner, 0)
-    ]
-    refut = me_ok & merge.is_refutable(their_view_of_me, me_ok, state.own_inc)
-    refute_inc = jnp.where(refut, merge.key_incarnation(their_view_of_me), 0).astype(
-        jnp.uint32
+    # PUSH: node r receives the full state of s = r - off[j] iff s
+    # initiated toward r. The column algebra mirrors the pull with the
+    # roles swapped: local column c takes s's column holding the same
+    # subject, remapped through the inverse displacement.
+    s_ok = jnp.roll(init_ok, shift) & (state.alive_truth & ~state.left)
+    sv = jnp.roll(view0, shift, axis=0)               # initiator rows
+    rr2 = topology.remap_row(topo, icol)
+    rr2_c = jnp.clip(rr2, 0, k_deg - 1)
+    ent2 = jnp.take(sv, rr2_c, axis=1)
+    ent2 = jnp.where(rr2[None, :] >= 0, ent2, jnp.uint32(0))
+    ent2 = jnp.where(
+        jnp.arange(k_deg, dtype=jnp.int32)[None, :] == icol,
+        jnp.roll(ownk, shift)[:, None], ent2,
+    )
+    push = merge.demote_dead_to_suspect(ent2)
+    view = merge.join(view, jnp.where(s_ok[:, None], push, 0))
+    their_view_of_me2 = sv[:, j]
+    refut2 = s_ok & merge.is_refutable(their_view_of_me2, s_ok, state.own_inc)
+    refute_inc = jnp.maximum(
+        refute_inc,
+        jnp.where(refut2, merge.key_incarnation(their_view_of_me2), 0).astype(
+            jnp.uint32
+        ),
     )
 
     return state._replace(view_key=view), refute_inc
@@ -562,11 +699,3 @@ def _reconcile_suspicion(state: SimState, view0, t):
         fresh & (susp_seen == 0), jnp.uint32(1), susp_seen
     )
     return state._replace(susp_start=susp_start, susp_seen=susp_seen)
-
-
-def _scatter_cols(arr, cols, vals):
-    """arr[i, cols[i, j]] = vals[i, j] for the selected columns."""
-    n, b = arr.shape
-    rows = jnp.arange(n, dtype=jnp.int32)[:, None] * b
-    flat = arr.reshape(-1).at[(rows + cols).reshape(-1)].set(vals.reshape(-1))
-    return flat.reshape(n, b)

@@ -9,31 +9,50 @@ RTTs are the true distance with lognormal jitter; the same model feeds
 both the SWIM probe timing and the Vivaldi observations, so coordinate
 RMSE against ground truth is directly measurable.
 
-Membership views are bounded by a neighbor table ``nbrs[N, K]``:
+Membership views are bounded by a **symmetric circulant neighbor
+relation** shared by every node::
 
-  - **Dense / complete graph** (``SimConfig.view_degree == 0``): node i's
-    neighbors are all other nodes in ring order, ``nbrs[i, k] =
-    (i + 1 + k) mod N`` — column lookup is closed-form, no memory needed.
-    This matches the reference exactly, where every memberlist member
-    tracks every other.
-  - **Sparse partial view** (``view_degree = K``): each node tracks a
-    random K-subset (sorted per row for binary-search column lookup).
-    This is the documented divergence that makes >=100k-node simulation
-    feasible — a real 1M-node memberlist cluster would need 10^12 member
-    map entries across the fleet, which neither the reference nor any
-    simulator can hold. Gossip about nodes outside a receiver's view is
-    dropped, like HyParView-style partial-view protocols.
+    nbrs(i, c) = (i + off[c]) mod N,   off[K] sorted, distinct,
+                                       d in off  <=>  N-d in off
+
+  - **Dense / complete graph** (``SimConfig.view_degree == 0``):
+    ``off = [1..N-1]`` — every node tracks every other, exactly like a
+    real memberlist member map. All column maps are closed-form.
+  - **Sparse partial view** (``view_degree = K``): ``off`` is a random
+    K-subset closed under negation. Random circulant graphs are
+    expanders w.h.p., so epidemics spread in O(log N) rounds like the
+    reference's full-graph gossip; unlike per-row random subsets the
+    in-degree is *exactly* K for every node, so probe coverage is
+    uniform (no under-probed nodes). This is the documented divergence
+    that makes >=100k-node simulation feasible — a real 1M-node
+    memberlist cluster would need 10^12 member-map entries fleet-wide.
+
+Why circulant rather than per-row random (the TPU-first design move of
+this module): the relation is **translation-invariant**, so every
+"deliver to receiver" operation inverts into a dense gather *from* the
+sender at a fixed shift — ``x[(i - off[j]) mod N]`` — and the column any
+gossiped subject occupies at the receiver depends only on the (sender
+column, receiver in-column) pair, giving a static ``rcol[K, K]`` remap
+table. The whole message plane therefore compiles to gathers, rolls and
+table lookups — no scatters, which XLA serializes on TPU (the round-1
+scaling cliff).
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from consul_tpu.config import SimConfig
+
+# rcol sentinel: the subject of this (in-column, sender-column) pair is
+# the receiver itself (refutation fodder, never a view merge).
+SELF = -2
+# rcol sentinel: subject not in the receiver's partial view.
+ABSENT = -1
 
 
 class World(NamedTuple):
@@ -42,6 +61,137 @@ class World(NamedTuple):
     pos: jax.Array     # [N, world_dims] float32
     height: jax.Array  # [N] float32
 
+
+class Topology(NamedTuple):
+    """The shared circulant neighbor relation (see module docstring).
+
+    ``rcol``/``inv`` are None in dense mode, where both are closed-form:
+    the tables would be [N-1, N-1]. All helpers below branch on
+    ``dense`` (a static Python bool — Topology instances are closed
+    over by jitted steps, never traced).
+    """
+
+    n: int                       # static
+    dense: bool                  # static
+    off: jax.Array               # [K] int32, sorted
+    rcol: Optional[jax.Array]    # [K, K] int32: receiver column of the
+                                 # sender's column c when the sender sits
+                                 # at the receiver's in-column j; SELF
+                                 # when c == j; ABSENT when untracked
+    inv: Optional[jax.Array]     # [K] int32: column of (N - off[j]) —
+                                 # where the *sender itself* sits in the
+                                 # receiver's view (always present:
+                                 # the offset set is symmetric)
+
+    @property
+    def degree(self) -> int:
+        return self.off.shape[0]
+
+
+def make_topology(cfg: SimConfig, key) -> Topology:
+    """Build the offset table and static remap tables (host-side, once)."""
+    n, k_deg = cfg.n, cfg.degree
+    if cfg.view_degree == 0:
+        off = jnp.arange(1, n, dtype=jnp.int32)
+        return Topology(n=n, dense=True, off=off, rcol=None, inv=None)
+    if k_deg % 2 != 0:
+        raise ValueError("sparse view_degree must be even (symmetric offsets)")
+    rng = np.random.default_rng(int(jax.random.randint(key, (), 0, 2**31 - 1)))
+    # Sample K/2 distinct offsets from [1, N/2), then close under
+    # negation. Avoiding d == N-d (possible only at d = N/2) keeps the
+    # union size exactly K.
+    half = rng.choice(np.arange(1, (n + 1) // 2), size=k_deg // 2, replace=False)
+    off_np = np.sort(np.concatenate([half, n - half]).astype(np.int64))
+    # Static remap: rcol[j, c] = column of (off[c] - off[j]) mod n.
+    d = (off_np[None, :] - off_np[:, None]) % n          # [K, K]
+    col = np.searchsorted(off_np, d)
+    col = np.clip(col, 0, k_deg - 1)
+    found = off_np[col] == d
+    rcol = np.where(found, col, ABSENT)
+    rcol[np.arange(k_deg), np.arange(k_deg)] = SELF      # d == 0
+    inv = np.searchsorted(off_np, (n - off_np))          # always found
+    return Topology(
+        n=n,
+        dense=False,
+        off=jnp.asarray(off_np, jnp.int32),
+        rcol=jnp.asarray(rcol, jnp.int32),
+        inv=jnp.asarray(inv, jnp.int32),
+    )
+
+
+# ----------------------------------------------------------------------
+# Column algebra. j/c may be traced scalars or arrays.
+# ----------------------------------------------------------------------
+
+def neighbor_of(topo: Topology, row, col):
+    """Global id of ``row``'s neighbor at ``col``: (row + off[col]) mod N."""
+    return (row + topo.off[col]) % topo.n
+
+
+def nbrs_table(topo: Topology) -> jax.Array:
+    """Materialized [N, K] neighbor-id table (tests / host-side only)."""
+    rows = jnp.arange(topo.n, dtype=jnp.int32)
+    return (rows[:, None] + topo.off[None, :]) % topo.n
+
+
+def subject_to_col(topo: Topology, row, subject):
+    """Column of ``subject`` in ``row``'s view, or ABSENT, or SELF."""
+    d = (jnp.asarray(subject) - jnp.asarray(row)) % topo.n
+    if topo.dense:
+        return jnp.where(d == 0, SELF, d - 1).astype(jnp.int32)
+    col = jnp.searchsorted(topo.off, d.astype(jnp.int32)).astype(jnp.int32)
+    col_c = jnp.clip(col, 0, topo.degree - 1)
+    found = topo.off[col_c] == d
+    return jnp.where(d == 0, SELF, jnp.where(found, col_c, ABSENT))
+
+
+def remap_row(topo: Topology, j):
+    """``rcol[j]`` as a [K] vector for a (possibly traced) in-column j.
+
+    Entry c is the receiver's column for the sender's column-c subject
+    (SELF when c == j — that subject is the receiver itself).
+    """
+    if topo.dense:
+        k_deg = topo.degree
+        c = jnp.arange(k_deg, dtype=jnp.int32)
+        d = (c - j) % (k_deg + 1)  # off[c]-off[j] mod n ≡ (c-j) mod n; n=K+1
+        return jnp.where(c == j, SELF, (d - 1).astype(jnp.int32))
+    return topo.rcol[j]
+
+
+def inv_col(topo: Topology, j):
+    """Column where the sender itself sits in the receiver's view, given
+    the sender occupies the receiver's in-column j (i.e. receiver =
+    sender + off[j]): the column of offset N - off[j]."""
+    if topo.dense:
+        return jnp.int32(topo.n - 2) - jnp.asarray(j, jnp.int32)
+    return topo.inv[j]
+
+
+def gather_from_senders(topo: Topology, x: jax.Array, j):
+    """``x`` re-indexed so position r holds the value at r's in-column-j
+    sender, ``x[(r - off[j]) mod N]`` — the receiver-side inversion of
+    "sender s delivers to s + off[j]". Works for [N, ...] arrays."""
+    return jnp.roll(x, topo.off[j], axis=0)
+
+
+def gather_cols(topo: Topology, x: jax.Array) -> jax.Array:
+    """[N, K] view of a per-node array along the neighbor relation:
+    out[i, c] = x[(i + off[c]) mod N] (used by metrics/tests). Sparse
+    mode stacks K static rolls — TPU-cheap contiguous copies — instead
+    of an [N, K] per-row gather."""
+    if not topo.dense and topo.degree <= 256:
+        off_np = np.asarray(topo.off)
+        return jnp.stack(
+            [jnp.roll(x, -int(off_np[c])) for c in range(topo.degree)], axis=1
+        )
+    rows = jnp.arange(topo.n, dtype=jnp.int32)
+    return x[(rows[:, None] + topo.off[None, :]) % topo.n]
+
+
+# ----------------------------------------------------------------------
+# Ground-truth world.
+# ----------------------------------------------------------------------
 
 def make_world(cfg: SimConfig, key) -> World:
     k_pos, k_h = jax.random.split(key)
@@ -69,53 +219,3 @@ def sample_rtt(cfg: SimConfig, world: World, i, j, key):
         return base
     log_jitter = jax.random.normal(key, base.shape, jnp.float32) * cfg.rtt_jitter_frac
     return base * jnp.exp(log_jitter)
-
-
-def make_neighbors(cfg: SimConfig, key) -> jax.Array:
-    """Build the neighbor table ``nbrs[N, K]`` (see module docstring)."""
-    n, k_deg = cfg.n, cfg.degree
-    if cfg.view_degree == 0:
-        ring = (jnp.arange(n)[:, None] + 1 + jnp.arange(k_deg)[None, :]) % n
-        return ring.astype(jnp.int32)
-    # Sparse: sample K distinct non-self neighbors per row, sorted. Built
-    # host-side with numpy (one-time setup; distinct targets mirror
-    # kRandomNodes, reference memberlist/util.go:125-153). Fully
-    # vectorized — draw with replacement, then re-draw the few per-row
-    # collisions (expected ~K^2/2(N-1) per row) until none remain, so a
-    # 1M-row table builds in seconds rather than via 1M rng calls.
-    rng = np.random.default_rng(int(jax.random.randint(key, (), 0, 2**31 - 1)))
-    offsets = rng.integers(0, n - 1, size=(n, k_deg))
-    for _ in range(64):
-        offsets.sort(axis=1)
-        dup = np.zeros_like(offsets, dtype=bool)
-        dup[:, 1:] = offsets[:, 1:] == offsets[:, :-1]
-        n_dup = int(dup.sum())
-        if n_dup == 0:
-            break
-        offsets[dup] = rng.integers(0, n - 1, size=n_dup)
-    else:  # pragma: no cover - K close to N; fall back to exact per-row
-        for row in np.unique(np.nonzero(dup)[0]):
-            offsets[row] = rng.choice(n - 1, size=k_deg, replace=False)
-        offsets.sort(axis=1)
-    nbrs = (np.arange(n)[:, None] + 1 + offsets) % n
-    nbrs.sort(axis=1)
-    return jnp.asarray(nbrs, jnp.int32)
-
-
-def subject_to_col(cfg: SimConfig, nbrs: jax.Array, row, subject):
-    """Column of ``subject`` in ``row``'s neighbor table, or -1 if untracked.
-
-    Dense ring layout is closed-form; sparse rows are sorted, so a
-    batched binary search resolves each (row, subject) pair.
-    """
-    if cfg.view_degree == 0:
-        col = (subject - row - 1) % cfg.n
-        return jnp.where(col < cfg.degree, col, -1).astype(jnp.int32)
-    rows = nbrs[row]                      # [..., K] gather
-    # Rank-based lookup (K is small): in a sorted row, the number of
-    # entries below ``subject`` is its column if present.
-    subject = jnp.asarray(subject)
-    col = jnp.sum(rows < subject[..., None], axis=-1).astype(jnp.int32)
-    col = jnp.clip(col, 0, cfg.degree - 1)
-    found = jnp.take_along_axis(rows, col[..., None], axis=-1)[..., 0] == subject
-    return jnp.where(found, col, -1)

@@ -29,11 +29,11 @@ class HealthMetrics(NamedTuple):
     live_nodes: jax.Array       # [] i32
 
 
-def health(cfg: SimConfig, nbrs, state: SimState) -> HealthMetrics:
+def health(cfg: SimConfig, topo, state: SimState) -> HealthMetrics:
     """Membership-agreement metrics over every (live observer, neighbor) edge."""
     active = state.alive_truth & ~state.left
     st = merge.key_status(state.view_key)
-    subj_up = active[nbrs]                       # truth per edge subject
+    subj_up = topology.gather_cols(topo, active)  # truth per edge subject
     believed_up = st == merge.ALIVE
     believed_down = (st == merge.DEAD) | (st == merge.LEFT)
     obs = active[:, None] & jnp.ones_like(st, bool)

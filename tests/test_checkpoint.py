@@ -20,9 +20,9 @@ def sim():
     key = jax.random.PRNGKey(5)
     kw, kn, ks = jax.random.split(key, 3)
     world = topology.make_world(cfg, kw)
-    nbrs = topology.make_neighbors(cfg, kn)
+    topo = topology.make_topology(cfg, kn)
     state = serf.init(cfg, ks)
-    step = jax.jit(lambda st, k: serf.step(cfg, nbrs, world, st, k))
+    step = jax.jit(lambda st, k: serf.step(cfg, topo, world, st, k))
     return cfg, state, step
 
 

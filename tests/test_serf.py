@@ -18,10 +18,10 @@ def make_sim(n=48, **cfg_kw):
     key = jax.random.PRNGKey(7)
     kw, kn, ks = jax.random.split(key, 3)
     world = topology.make_world(cfg, kw)
-    nbrs = topology.make_neighbors(cfg, kn)
+    topo = topology.make_topology(cfg, kn)
     state = serf.init(cfg, ks)
-    step = jax.jit(lambda st, k: serf.step(cfg, nbrs, world, st, k))
-    return cfg, nbrs, world, state, step
+    step = jax.jit(lambda st, k: serf.step(cfg, topo, world, st, k))
+    return cfg, topo, world, state, step
 
 
 def run(state, step, ticks, seed=0):
@@ -137,14 +137,14 @@ class TestQueries:
 
 class TestLeaveAndReap:
     def test_graceful_leave_propagates_as_left(self):
-        cfg, nbrs, _, state, step = make_sim()
+        cfg, topo, _, state, step = make_sim()
         leaver = jnp.arange(cfg.n) == 2
         state = serf.leave(cfg, state, leaver)
         state = run(state, step, 40)
         # Every live node's view column for node 2 shows LEFT (not DEAD:
         # graceful departures are not failures, serf.go:675-…).
         col = topology.subject_to_col(
-            cfg, nbrs, jnp.arange(cfg.n), jnp.full((cfg.n,), 2)
+            topo, jnp.arange(cfg.n), jnp.full((cfg.n,), 2)
         )
         ok = col >= 0
         st = merge.key_status(state.swim.view_key)[

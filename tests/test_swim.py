@@ -25,13 +25,13 @@ def make_sim(n=N, seed=0, loss=0.0):
     key = jax.random.PRNGKey(seed)
     kw, kn, ks = jax.random.split(key, 3)
     world = topology.make_world(cfg, kw)
-    nbrs = topology.make_neighbors(cfg, kn)
+    topo = topology.make_topology(cfg, kn)
     st = sim_state.init(cfg, ks)
-    return cfg, world, nbrs, st
+    return cfg, world, topo, st
 
 
-def run(cfg, nbrs, world, st, ticks, seed=42):
-    stepf = jax.jit(functools.partial(swim.step, cfg, nbrs, world))
+def run(cfg, topo, world, st, ticks, seed=42):
+    stepf = jax.jit(functools.partial(swim.step, cfg, topo, world))
     base = jax.random.PRNGKey(seed)
     for _ in range(ticks):
         st = stepf(st, jax.random.fold_in(base, int(st.t)))
@@ -39,23 +39,23 @@ def run(cfg, nbrs, world, st, ticks, seed=42):
 
 
 def test_steady_state_no_false_positives():
-    cfg, world, nbrs, st = make_sim()
-    st = run(cfg, nbrs, world, st, 120)  # 24 simulated seconds
-    h = metrics.health(cfg, nbrs, st)
+    cfg, world, topo, st = make_sim()
+    st = run(cfg, topo, world, st, 120)  # 24 simulated seconds
+    h = metrics.health(cfg, topo, st)
     assert float(h.agreement) == 1.0
     assert float(h.false_positive) == 0.0
     assert int(st.t) == 120
 
 
 def test_failure_detection_converges():
-    cfg, world, nbrs, st = make_sim()
+    cfg, world, topo, st = make_sim()
     dead = jnp.arange(N) < 8  # kill 8 of 64
     st = sim_state.kill(st, dead)
     # Suspicion min timeout at n=64: 4 * log10(64)=1.8 * 5 ticks = 36
     # ticks; max = 6x. Probing + dissemination + expiry should settle
     # well within 60 simulated seconds (300 ticks).
-    st = run(cfg, nbrs, world, st, 300)
-    h = metrics.health(cfg, nbrs, st)
+    st = run(cfg, topo, world, st, 300)
+    h = metrics.health(cfg, topo, st)
     assert float(h.undetected) == 0.0, "dead nodes still believed alive"
     assert float(h.false_positive) == 0.0, "live nodes wrongly suspected/dead"
     assert float(h.agreement) == 1.0
@@ -63,18 +63,18 @@ def test_failure_detection_converges():
 
 
 def test_refutation_recovers_wrongly_suspected_node():
-    cfg, world, nbrs, st = make_sim()
+    cfg, world, topo, st = make_sim()
     # Plant a false suspicion of node 0 at its current incarnation in
     # every other node's view.
-    subj0 = nbrs == 0
+    subj0 = topology.nbrs_table(topo) == 0
     wrong = merge.make_key(st.own_inc[0], merge.SUSPECT)
     st = st._replace(
         view_key=jnp.where(subj0, wrong, st.view_key),
         susp_start=jnp.where(subj0, st.t, st.susp_start),
         susp_seen=jnp.where(subj0, jnp.uint32(1), st.susp_seen),
     )
-    st = run(cfg, nbrs, world, st, 200)
-    h = metrics.health(cfg, nbrs, st)
+    st = run(cfg, topo, world, st, 200)
+    h = metrics.health(cfg, topo, st)
     assert float(h.false_positive) == 0.0
     assert float(h.agreement) == 1.0
     # Node 0 must have refuted by bumping its incarnation.
@@ -82,18 +82,18 @@ def test_refutation_recovers_wrongly_suspected_node():
 
 
 def test_deterministic_trajectory():
-    cfg, world, nbrs, st0 = make_sim()
-    st_a = run(cfg, nbrs, world, st0, 40, seed=7)
-    st_b = run(cfg, nbrs, world, st0, 40, seed=7)
+    cfg, world, topo, st0 = make_sim()
+    st_a = run(cfg, topo, world, st0, 40, seed=7)
+    st_b = run(cfg, topo, world, st0, 40, seed=7)
     for leaf_a, leaf_b in zip(jax.tree.leaves(st_a), jax.tree.leaves(st_b)):
         np.testing.assert_array_equal(np.asarray(leaf_a), np.asarray(leaf_b))
 
 
 def test_vivaldi_converges_during_gossip():
-    cfg, world, nbrs, st = make_sim()
+    cfg, world, topo, st = make_sim()
     key = jax.random.PRNGKey(3)
     rmse0 = float(metrics.vivaldi_rmse(cfg, world, st, key))
-    st = run(cfg, nbrs, world, st, 400)
+    st = run(cfg, topo, world, st, 400)
     rmse1 = float(metrics.vivaldi_rmse(cfg, world, st, key))
     # From cold start (~world diameter error) to a small fraction of it.
     assert rmse1 < rmse0 / 3
@@ -101,23 +101,23 @@ def test_vivaldi_converges_during_gossip():
 
 
 def test_revive_rejoins_with_higher_incarnation():
-    cfg, world, nbrs, st = make_sim()
+    cfg, world, topo, st = make_sim()
     dead = jnp.arange(N) < 4
     st = sim_state.kill(st, dead)
-    st = run(cfg, nbrs, world, st, 300)
-    assert float(metrics.health(cfg, nbrs, st).undetected) == 0.0
+    st = run(cfg, topo, world, st, 300)
+    assert float(metrics.health(cfg, topo, st).undetected) == 0.0
     st = sim_state.revive(cfg, st, dead)
-    st = run(cfg, nbrs, world, st, 300)
-    h = metrics.health(cfg, nbrs, st)
+    st = run(cfg, topo, world, st, 300)
+    h = metrics.health(cfg, topo, st)
     assert float(h.agreement) == 1.0, "revived nodes not re-recognized alive"
     assert int(h.live_nodes) == N
 
 
 @pytest.mark.parametrize("loss", [0.02])
 def test_lossy_network_stays_converged(loss):
-    cfg, world, nbrs, st = make_sim(loss=loss)
-    st = run(cfg, nbrs, world, st, 200)
-    h = metrics.health(cfg, nbrs, st)
+    cfg, world, topo, st = make_sim(loss=loss)
+    st = run(cfg, topo, world, st, 200)
+    h = metrics.health(cfg, topo, st)
     # With 2% packet loss the TCP-fallback path must prevent lasting
     # false positives (the reference's rationale for it, state.go:391-400).
     assert float(h.false_positive) == 0.0
