@@ -145,6 +145,18 @@ def query_timeout_ticks(cfg: SimConfig) -> int:
 # Origination APIs (all jittable, mask-driven).
 # ----------------------------------------------------------------------
 
+def _scatter_cols(arr, cols, vals):
+    """``arr[i, cols[i, j]] = vals[i, j]`` without a scatter: one-hot
+    compare-select over the (small) slot axis, matching the no-scatter
+    style of the round-2 gossip plane.  ``cols`` rows must hold distinct
+    indices (argsort prefixes do)."""
+    slots = jnp.arange(arr.shape[1], dtype=jnp.int32)
+    onehot = cols[:, :, None] == slots[None, None, :]        # [N, P, S]
+    newv = jnp.sum(jnp.where(onehot, vals[:, :, None], 0), axis=1)
+    hit = jnp.any(onehot, axis=1)
+    return jnp.where(hit, newv.astype(arr.dtype), arr)
+
+
 def _equeue_push(cfg: SimConfig, s: SerfState, mask, key_, origin, tx0):
     """Insert one event per masked node into its event queue — same slot
     semantics as the SWIM broadcast queue (invalidate same subject,
@@ -451,7 +463,7 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
     # its budget is spent AND its payload was delivered locally (a spent
     # undelivered entry must survive to be delivered from the queue).
     sends = jnp.sum(peer_ok, axis=1)[:, None] * jnp.where(m_valid, 1, 0)
-    ev_tx = swim._scatter_cols(s.ev_tx, order, jnp.maximum(m_tx - sends, 0))
+    ev_tx = _scatter_cols(s.ev_tx, order, jnp.maximum(m_tx - sends, 0))
     delivered_now = (
         jnp.arange(e_slots, dtype=jnp.int32)[None, :] == del_slot[:, None]
     ) & has[:, None]
