@@ -1,13 +1,33 @@
-"""Benchmark: gossip throughput + convergence on one chip.
+"""Benchmark: gossip throughput + convergence, un-losable by design.
 
 Prints ONE JSON line:
   {"metric": "gossip-rounds/sec/chip", "value": N, "unit": "rounds/s",
    "vs_baseline": R, ...extras}
 
 The scenario is the framework's north-star workload (BASELINE.md): a
-formed LAN cluster, a mass failure injected, SWIM + Lifeguard + gossip +
-push-pull converging every surviving view, Vivaldi coordinates learning
-the ground-truth latency map throughout.
+formed LAN cluster on the sparse circulant view plane, a mass failure
+injected, SWIM + Lifeguard + gossip + push-pull converging every
+surviving view, Vivaldi coordinates learning the ground-truth latency
+map throughout.
+
+Hardening (this file must emit a result no matter what the backend
+does — the TPU tunnel in this environment can hang *inside backend
+initialization* indefinitely):
+
+  - The parent process never imports jax. Each backend (TPU, CPU) runs
+    in its own child subprocess (``BENCH_CHILD``) with a hard deadline;
+    a hung backend init is killed, not waited on.
+  - Children stream one JSON line per completed phase (setup /
+    throughput / convergence / rmse / sweep entries), so the parent
+    harvests whatever finished even when a child dies mid-run (OOM,
+    device fault, timeout).
+  - Every phase inside the child is try/except-wrapped; errors become
+    diagnostics in the output, never silence.
+  - The CPU fallback number is ALWAYS recorded alongside the TPU one,
+    so no round publishes nothing.
+  - Default shape is the sparse profile (view_degree=32) — dense
+    n=4096 (K=4095 views) is a deliberately heavy stress shape, not a
+    benchmark default.
 
 ``vs_baseline``: the reference publishes no gossip-throughput numbers
 (BASELINE.json ``published: {}``), so the baseline is the protocol's
@@ -18,63 +38,299 @@ is therefore the per-chip simulation speed-up over real time.
 
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import time
 
 
-def main():
-    n = int(os.environ.get("BENCH_N", "4096"))
-    kill_frac = float(os.environ.get("BENCH_KILL_FRAC", "0.05"))
+# ----------------------------------------------------------------------
+# Child: actually run the benchmark phases on one backend.
+# ----------------------------------------------------------------------
 
-    import jax
+def _emit(obj):
+    sys.stdout.write(json.dumps(obj) + "\n")
+    sys.stdout.flush()
 
-    # BENCH_PLATFORM=cpu runs the benchmark without the TPU (for local
-    # validation). Note this environment pins jax_platforms via
-    # jax.config in sitecustomize, so the env var must be applied here.
-    platform = os.environ.get("BENCH_PLATFORM")
-    if platform:
-        jax.config.update("jax_platforms", platform)
+
+def child(platform: str, deadline: float):
+    def left():
+        return deadline - time.monotonic()
+
+    t0 = time.monotonic()
+    try:
+        import jax
+
+        if platform != "default":
+            # Must land before the first backend touch; this environment
+            # pins jax_platforms via sitecustomize, so the env var alone
+            # is not enough.
+            jax.config.update("jax_platforms", platform)
+        devs = jax.devices()
+        _emit({
+            "phase": "setup",
+            "platform": devs[0].platform,
+            "device": str(devs[0]),
+            "jax": jax.__version__,
+            "init_s": round(time.monotonic() - t0, 1),
+        })
+    except Exception as e:  # backend init failed: nothing else can run
+        _emit({"phase": "error", "where": "setup", "error": repr(e)[:500]})
+        return 1
 
     import jax.numpy as jnp
 
     from consul_tpu.config import SimConfig
     from consul_tpu.models.cluster import Simulation
+    from consul_tpu.utils import metrics as obs
 
-    t_setup = time.perf_counter()
-    cfg = SimConfig(n=n)
-    sim = Simulation(cfg, seed=0)
+    n = int(os.environ.get("BENCH_N", "65536"))
+    view_degree = int(os.environ.get("BENCH_VIEW_DEGREE", "32"))
+    kill_frac = float(os.environ.get("BENCH_KILL_FRAC", "0.05"))
+    chunk = int(os.environ.get("BENCH_CHUNK", "128"))
+    profile = os.environ.get("BENCH_PROFILE", "")
 
-    # Throughput: pure simulation rate, no host round-trips.
-    rounds_per_s = sim.throughput(ticks=512)
+    def build(n_nodes):
+        cfg = SimConfig(n=n_nodes, view_degree=min(view_degree, n_nodes - 2))
+        return Simulation(cfg, seed=0)
 
-    # Convergence: kill a block of nodes, run until every surviving
-    # view agrees with ground truth.
-    n_kill = int(n * kill_frac)
-    sim.kill(jnp.arange(n) < n_kill)
-    t0 = time.perf_counter()
-    converged, ticks_used, trace = sim.run_until_converged(
-        max_ticks=2048, chunk=256
+    sim = None
+    try:
+        t = time.monotonic()
+        sim = build(n)
+        # Throughput: chunked scans (never one monolithic program), the
+        # same compiled program warmed once so XLA compilation stays out
+        # of the timed region.
+        runner_ticks = chunk
+        sim.run(runner_ticks, chunk=chunk, with_metrics=False)  # warm+compile
+        jax.block_until_ready(sim.state.view_key)
+        reps = 4
+        t1 = time.monotonic()
+        sim.run(runner_ticks * reps, chunk=chunk, with_metrics=False)
+        jax.block_until_ready(sim.state.view_key)
+        rounds_per_s = runner_ticks * reps / (time.monotonic() - t1)
+        _emit({
+            "phase": "throughput",
+            "n": n,
+            "view_degree": view_degree,
+            "rounds_per_s": round(rounds_per_s, 2),
+            "compile_s": round(t1 - t, 1),
+        })
+    except Exception as e:
+        _emit({"phase": "error", "where": "throughput", "error": repr(e)[:500]})
+
+    try:
+        if sim is not None and left() > 30:
+            if profile:
+                jax.profiler.start_trace(profile)
+            n_kill = int(n * kill_frac)
+            sim.kill(jnp.arange(sim.cfg.n) < n_kill)
+            t1 = time.monotonic()
+            converged, ticks_used, _ = sim.run_until_converged(
+                max_ticks=4096, chunk=chunk
+            )
+            wall = time.monotonic() - t1
+            if profile:
+                jax.profiler.stop_trace()
+            sim_s = ticks_used * sim.cfg.gossip.tick_ms / 1000.0
+            _emit({
+                "phase": "convergence",
+                "n": n,
+                "converged": bool(converged),
+                "kill_frac": kill_frac,
+                "wall_s": round(wall, 2),
+                "sim_s": round(sim_s, 1),
+                "ticks": int(ticks_used),
+            })
+    except Exception as e:
+        _emit({"phase": "error", "where": "convergence", "error": repr(e)[:500]})
+
+    try:
+        if sim is not None:
+            h = sim.health()
+            _emit({
+                "phase": "rmse",
+                "vivaldi_rmse_ms": round(sim.rmse() * 1000.0, 3),
+                "agreement": round(float(h.agreement), 4),
+                "false_positive": round(float(h.false_positive), 6),
+                "health_score_mean": round(
+                    float(jnp.mean(jnp.asarray(sim.state.awareness, jnp.float32))), 3
+                ),
+            })
+    except Exception as e:
+        _emit({"phase": "error", "where": "rmse", "error": repr(e)[:500]})
+
+    # Scaling sweep: throughput at each shape, each its own try/except,
+    # each gated on remaining deadline (SURVEY §7 phases 4-5 shapes).
+    sweep_env = os.environ.get("BENCH_SWEEP", "")
+    for s in [int(x) for x in sweep_env.split(",") if x.strip()]:
+        if left() < 120:
+            _emit({"phase": "sweep_skipped", "n": s, "reason": "deadline"})
+            continue
+        try:
+            t = time.monotonic()
+            ssim = build(s)
+            ssim.run(chunk, chunk=chunk, with_metrics=False)
+            jax.block_until_ready(ssim.state.view_key)
+            compile_s = time.monotonic() - t
+            t1 = time.monotonic()
+            ssim.run(chunk, chunk=chunk, with_metrics=False)
+            jax.block_until_ready(ssim.state.view_key)
+            rps = chunk / (time.monotonic() - t1)
+            _emit({
+                "phase": "sweep",
+                "n": s,
+                "rounds_per_s": round(rps, 2),
+                "compile_s": round(compile_s, 1),
+            })
+            del ssim
+        except Exception as e:
+            _emit({"phase": "error", "where": f"sweep:{s}", "error": repr(e)[:400]})
+    return 0
+
+
+# ----------------------------------------------------------------------
+# Parent: orchestrate children, merge, always print one line, rc=0.
+# ----------------------------------------------------------------------
+
+def _run_child(platform: str, timeout_s: float, extra_env=None):
+    """Run one backend child; harvest its per-phase JSON lines."""
+    env = dict(os.environ)
+    env["BENCH_CHILD"] = platform
+    env["BENCH_DEADLINE_S"] = str(timeout_s)
+    env.update(extra_env or {})
+    fd, out_path = tempfile.mkstemp(prefix=f"bench_{platform}_", suffix=".jsonl")
+    phases, status = [], "ok"
+    t0 = time.monotonic()
+    raw_tail = []
+    try:
+        with os.fdopen(fd, "w") as out:
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)],
+                stdout=out, stderr=subprocess.STDOUT, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            try:
+                proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                status = "timeout"
+                proc.kill()
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    pass
+        with open(out_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    phases.append(json.loads(line))
+                except ValueError:
+                    raw_tail.append(line[:200])
+    except OSError:
+        pass
+    finally:
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+    if status == "ok" and proc.returncode not in (0, None):
+        status = f"rc={proc.returncode}"
+    return {
+        "status": status,
+        "wall_s": round(time.monotonic() - t0, 1),
+        "phases": phases,
+        "log_tail": raw_tail[-3:],
+    }
+
+
+def _get(phases, name, key, default=None):
+    for p in phases:
+        if p.get("phase") == name and key in p:
+            return p[key]
+    return default
+
+
+def main():
+    platform_child = os.environ.get("BENCH_CHILD")
+    if platform_child:
+        deadline = time.monotonic() + float(
+            os.environ.get("BENCH_DEADLINE_S", "1200")
+        ) - 60.0
+        return child(platform_child, deadline)
+
+    tpu_timeout = float(os.environ.get("BENCH_TIMEOUT_TPU", "2100"))
+    cpu_timeout = float(os.environ.get("BENCH_TIMEOUT_CPU", "900"))
+    t_all = time.monotonic()
+
+    # TPU attempt: the default platform (the axon plugin), full sweep.
+    tpu = _run_child(
+        "default", tpu_timeout,
+        {"BENCH_SWEEP": os.environ.get("BENCH_SWEEP", "4096,262144,1048576")},
     )
-    wall_s = time.perf_counter() - t0
-    rmse_ms = sim.rmse() * 1000.0
+    tpu_ok = _get(tpu["phases"], "throughput", "rounds_per_s")
+    tpu_platform = _get(tpu["phases"], "setup", "platform", "")
 
-    sim_seconds = ticks_used * cfg.gossip.tick_ms / 1000.0
+    # If the "default" backend resolved to CPU (no TPU visible), the TPU
+    # child already produced the CPU number; don't run it twice.
+    cpu = None
+    if tpu_platform != "cpu":
+        cpu = _run_child(
+            "cpu", cpu_timeout,
+            {"BENCH_N": os.environ.get("BENCH_CPU_N", "4096"), "BENCH_SWEEP": ""},
+        )
+    cpu_ok = _get(cpu["phases"], "throughput", "rounds_per_s") if cpu else (
+        tpu_ok if tpu_platform == "cpu" else None
+    )
+
+    primary = tpu if (tpu_ok is not None and tpu_platform != "cpu") else (cpu or tpu)
+    value = _get(primary["phases"], "throughput", "rounds_per_s")
     result = {
         "metric": "gossip-rounds/sec/chip",
-        "value": round(rounds_per_s, 1),
+        "value": value if value is not None else 0.0,
         "unit": "rounds/s",
-        # Speed-up over the protocol's real-time cadence (5 rounds/s).
-        "vs_baseline": round(rounds_per_s / 5.0, 1),
-        "n_nodes": n,
-        "converged": bool(converged),
-        "kill_frac": kill_frac,
-        "detect_converge_wall_s": round(wall_s, 2),
-        "detect_converge_sim_s": round(sim_seconds, 1),
-        "vivaldi_rmse_ms": round(rmse_ms, 3),
-        "device": str(jax.devices()[0].platform),
-        "total_wall_s": round(time.perf_counter() - t_setup, 1),
+        # Speed-up over the protocol's real-time cadence (one gossip
+        # round per 200 ms, reference memberlist/config.go:252).
+        "vs_baseline": round(value / 5.0, 1) if value else 0.0,
+        "n_nodes": _get(primary["phases"], "throughput", "n"),
+        "view_degree": _get(primary["phases"], "throughput", "view_degree"),
+        "device": _get(primary["phases"], "setup", "platform", "none"),
+        "converged": _get(primary["phases"], "convergence", "converged"),
+        "detect_converge_wall_s": _get(primary["phases"], "convergence", "wall_s"),
+        "detect_converge_sim_s": _get(primary["phases"], "convergence", "sim_s"),
+        "vivaldi_rmse_ms": _get(primary["phases"], "rmse", "vivaldi_rmse_ms"),
+        "agreement": _get(primary["phases"], "rmse", "agreement"),
+        "sweep": [
+            {"n": p["n"], "rounds_per_s": p["rounds_per_s"],
+             "compile_s": p.get("compile_s")}
+            for p in (tpu["phases"] if tpu else [])
+            if p.get("phase") == "sweep"
+        ],
+        "cpu_fallback": {
+            "rounds_per_s": cpu_ok,
+            "n_nodes": _get(cpu["phases"], "throughput", "n") if cpu else None,
+            "converged": _get(cpu["phases"], "convergence", "converged") if cpu else None,
+            "wall_s": _get(cpu["phases"], "convergence", "wall_s") if cpu else None,
+            "vivaldi_rmse_ms": _get(cpu["phases"], "rmse", "vivaldi_rmse_ms") if cpu else None,
+        },
+        "backends": {
+            "tpu_attempt": {
+                "status": tpu["status"],
+                "platform": tpu_platform,
+                "wall_s": tpu["wall_s"],
+                "errors": [p for p in tpu["phases"] if p.get("phase") == "error"],
+            },
+            "cpu": None if cpu is None else {
+                "status": cpu["status"],
+                "wall_s": cpu["wall_s"],
+                "errors": [p for p in cpu["phases"] if p.get("phase") == "error"],
+            },
+        },
+        "total_wall_s": round(time.monotonic() - t_all, 1),
     }
     print(json.dumps(result))
+    return 0
 
 
 if __name__ == "__main__":

@@ -150,7 +150,13 @@ def kill(state: SimState, mask) -> SimState:
     return state._replace(alive_truth=state.alive_truth & ~mask)
 
 
-def revive(cfg: SimConfig, state: SimState, mask, cold: bool = False) -> SimState:
+def revive(
+    cfg: SimConfig,
+    state: SimState,
+    mask,
+    cold: bool = False,
+    join_seeds: int = 3,
+) -> SimState:
     """Fault injection: restart the masked nodes with a bumped
     incarnation. Like a restarted agent's join (reference
     memberlist.Create setAlive -> aliveNode bootstrap broadcast,
@@ -161,15 +167,27 @@ def revive(cfg: SimConfig, state: SimState, mask, cold: bool = False) -> SimStat
     ``cold=True`` models a restart with no serf snapshot (reference
     serf/snapshot.go, handleRejoin serf.go:1705): the node forgets its
     member views — every entry drops to (0, DEAD), i.e. "never heard" —
-    and must relearn the cluster through push-pull, the reference's
-    join storm. Warm revive (default) keeps the pre-crash views, the
-    behavior a replayed snapshot buys.
+    except for ``join_seeds`` seed entries believed ``(0, ALIVE)``,
+    modeling the join addresses a restarted agent is configured with
+    (reference memberlist.Join seeds push-pull toward known addresses,
+    memberlist.go:228 -> pushPullNode state.go:595). The seeds are what
+    make rejoin *possible*: every protocol action gates on believing
+    the peer alive/suspect, so a view of all-DEAD would deadlock the
+    node — it could never probe, gossip, or initiate push-pull, and
+    nothing would ever flow back. From the seeds it relearns the
+    cluster through the join storm (push-pull + epidemic). Warm revive
+    (default) keeps the pre-crash views, the behavior a replayed
+    snapshot buys.
     """
     from consul_tpu.ops import scaling  # local import to avoid cycle
 
     own_inc = jnp.where(mask, state.own_inc + 1, state.own_inc).astype(jnp.uint32)
     with jax.ensure_compile_time_eval():
         tx0 = int(scaling.retransmit_limit(cfg.gossip.retransmit_mult, cfg.n))
+    if cfg.view_degree:
+        # The rejoin announcement must cover all K trackers (one full
+        # displacement sweep; see swim._gossip_phase coverage note).
+        tx0 = max(tx0, cfg.degree)
     state = state._replace(
         alive_truth=state.alive_truth | mask,
         left=state.left & ~mask,
@@ -178,10 +196,17 @@ def revive(cfg: SimConfig, state: SimState, mask, cold: bool = False) -> SimStat
         own_tx=jnp.where(mask, tx0, state.own_tx),
     )
     if cold:
+        k_deg = state.view_key.shape[1]
+        # Seed columns spread across the offset table so a block-kill
+        # (contiguous rows) doesn't leave every seed pointing at another
+        # cold node at small offsets.
+        cols = jnp.arange(k_deg, dtype=jnp.int32)
+        seed_cols = (cols % max(1, k_deg // max(1, min(join_seeds, k_deg)))) == 0
         unknown = merge.make_key(0, merge.DEAD)
+        seeded = jnp.where(seed_cols, merge.make_key(0, merge.ALIVE), unknown)
         m = mask[:, None]
         state = state._replace(
-            view_key=jnp.where(m, unknown, state.view_key),
+            view_key=jnp.where(m, seeded[None, :], state.view_key),
             susp_start=jnp.where(m, -1, state.susp_start),
             susp_seen=jnp.where(m, jnp.uint32(0), state.susp_seen),
             tx_left=jnp.where(m, 0, state.tx_left),

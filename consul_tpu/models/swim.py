@@ -186,6 +186,11 @@ def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> 
         susp_max = g.suspicion_max_timeout_mult * susp_min
         susp_k = int(scaling.suspicion_k(g.suspicion_mult, n))
         pp_period = g.push_pull_period_ticks(n)
+        # A self-fact (refutation, rejoin announcement) must reach the
+        # node's K specific trackers — not any log(n) members of the
+        # full graph — so its budget covers at least one full
+        # displacement sweep in sparse mode (see _gossip_phase).
+        own_limit = tx_limit if topo.dense else max(tx_limit, k_deg)
 
     # ------------------------------------------------------------------
     # 1. Suspicion expiry: per-edge deadline check. The local state
@@ -240,14 +245,14 @@ def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> 
     # 3. Probe launch.
     # ------------------------------------------------------------------
     probing = active & (t >= state.next_probe_tick)
-    statuses = _statuses(state.view_key)
-    # Next non-dead target in the shuffled order, looking ahead up to 3
-    # (the reference's skip loop, state.go:196-231).
+    # Next contactable target in the shuffled order, looking ahead up to
+    # 3 (the reference's skip loop, state.go:196-231).
     cand_off = jnp.arange(3, dtype=jnp.int32)
     cand_pos = (state.probe_ptr[:, None] + cand_off[None, :]) % k_deg
     cand_col = _take_cols(state.probe_perm, cand_pos)
-    cand_status = _take_cols(statuses.astype(jnp.int32), cand_col, fill=merge.DEAD)
-    cand_ok = (cand_status == merge.ALIVE) | (cand_status == merge.SUSPECT)
+    cand_ok = _take_cols(
+        merge.is_contactable(state.view_key), cand_col, fill=False
+    )
     has_target = jnp.any(cand_ok, axis=1) & probing
     first_ok = jnp.argmax(cand_ok, axis=1).astype(jnp.int32)
     target_col = _take_col(cand_col, first_ok)
@@ -359,7 +364,31 @@ def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> 
         state.probe_perm,
     )
     probe_perm = jnp.where(wrapped[:, None], perm, state.probe_perm)
+    # A successful ack is first-hand evidence from the target itself:
+    # join (target_incarnation, ALIVE) at the target's column. This is
+    # the vectorized form of the refute reply reaching its prober — in
+    # the reference the refute is a broadcast (state.go:840-864) whose
+    # first hop through the full graph is effectively immediate; in a
+    # sparse view plane the prober must hear it on the ack path or a
+    # suspicion of a live, acking node could outlive its refutation.
+    if roll_mode:
+        t_inc = _gather_by_col(
+            topo, state.own_inc[:, None],
+            jnp.where(has_target, target_col, 0),
+        )[:, 0]
+    else:
+        t_inc = state.own_inc[target]
+    ack_oh = (
+        jnp.arange(k_deg, dtype=jnp.int32)[None, :]
+        == jnp.where(acked, target_col, _NEG)[:, None]
+    )
+    ack_key = merge.make_key(t_inc, merge.ALIVE)
+    view_acked = merge.join(
+        state.view_key, jnp.where(ack_oh, ack_key[:, None], jnp.uint32(0))
+    )
+
     state = state._replace(
+        view_key=view_acked,
         probe_ptr=jnp.where(wrapped, 0, ptr),
         probe_perm=probe_perm,
         next_probe_tick=next_probe,
@@ -403,7 +432,7 @@ def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> 
     own_inc = jnp.where(refuting, claim + 1, state.own_inc).astype(jnp.uint32)
     state = state._replace(
         own_inc=own_inc,
-        own_tx=jnp.where(refuting, tx_limit, state.own_tx),
+        own_tx=jnp.where(refuting, own_limit, state.own_tx),
         awareness=jnp.clip(
             state.awareness + jnp.where(refuting, 1, 0), 0, g.awareness_max - 1
         ),
@@ -469,7 +498,23 @@ def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit):
     col_ids = jnp.arange(k_deg, dtype=jnp.int32)
 
     # Shared per-tick gossip displacements (divergence note: module doc).
-    jcols = jax.random.randint(k_cols, (fan,), 0, k_deg)
+    # Dense mode draws them i.i.d. — any receiver can relay a fact to
+    # anyone, so coverage follows from the epidemic. Sparse mode SWEEPS
+    # the columns deterministically: a fact about subject x can only
+    # ever live in x's K trackers, and x's own-fact must reach those K
+    # *specific* nodes — i.i.d. draws are a coupon-collector that can
+    # strand a tracker in suspect/dead forever. The phase-free cycle
+    # guarantees that ANY ceil(K/fan) consecutive ticks serve every
+    # column at least once, so a budget >= K armed at an arbitrary tick
+    # (refutation, rejoin announcement) covers all K trackers before it
+    # runs out — a phase that changes between sweeps would break this
+    # for windows straddling the boundary.
+    if topo.dense:
+        jcols = jax.random.randint(k_cols, (fan,), 0, k_deg)
+    else:
+        sweep_len = -(-k_deg // fan)  # ceil
+        pos = (state.t % sweep_len) * fan
+        jcols = (pos + jnp.arange(fan, dtype=jnp.int32)) % k_deg
 
     # Sender-side selection: top-P entries by remaining budget.
     budget = jnp.where(active[:, None], state.tx_left, 0)
@@ -481,12 +526,9 @@ def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit):
     own_sendable = (state.own_tx > 0) & active
 
     # Sendable per displacement: the sender's own belief of that peer
-    # must be alive/suspect (kRandomNodes filter, state.go:521-535).
-    peer_status = _statuses(state.view_key[:, jcols])   # [N, fan]
-    sendable = (
-        ((peer_status == merge.ALIVE) | (peer_status == merge.SUSPECT))
-        & active[:, None]
-    )
+    # must be contactable — alive/suspect (kRandomNodes filter,
+    # state.go:521-535) or never-heard (join-address semantics).
+    sendable = merge.is_contactable(state.view_key[:, jcols]) & active[:, None]
     n_sends = jnp.sum(sendable, axis=1).astype(jnp.int32)
 
     # Budget decrements for actual transmits (queue.go GetBroadcasts).
@@ -621,12 +663,8 @@ def _push_pull_phase(cfg, topo: Topology, state: SimState, active, pp_period, ke
 
     view0 = state.view_key                    # both directions exchange
     ownk = _own_key(state)                    # the pre-exchange states
-    belief = _statuses(view0[:, j])
     partner_up = jnp.roll(state.alive_truth & ~state.left, -shift)
-    init_ok = (
-        due & partner_up
-        & ((belief == merge.ALIVE) | (belief == merge.SUSPECT))
-    )
+    init_ok = due & partner_up & merge.is_contactable(view0[:, j])
 
     # PULL: the initiator merges its partner's full state.
     pv = jnp.roll(view0, -shift, axis=0)              # partner rows

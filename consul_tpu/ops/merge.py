@@ -74,11 +74,38 @@ def demote_dead_to_suspect(key):
     node is dead is downgraded to a suspicion so the node gets a chance to
     refute (reference memberlist/state.go:1231-1237, mergeState). LEFT is
     exempt: graceful departures are authoritative (serf handles them via
-    leave intents, not suspicion).
+    leave intents, not suspicion). UNKNOWN (0, DEAD) is also exempt —
+    "never heard of the subject" is not a death report, and demoting it
+    would fabricate incarnation-0 suspicions (with live timers) out of a
+    partner's mere ignorance.
     """
     key = jnp.asarray(key, jnp.uint32)
     st = key & (N_STATUS - 1)
-    return jnp.where(st == DEAD, (key & ~jnp.uint32(N_STATUS - 1)) | SUSPECT, key)
+    demote = (st == DEAD) & (key != UNKNOWN)
+    return jnp.where(demote, (key & ~jnp.uint32(N_STATUS - 1)) | SUSPECT, key)
+
+
+# "Never heard of this node": the cold-join sentinel. Distinct from a
+# genuine death report, which always carries incarnation >= 1 (nodes are
+# born at incarnation 1). Joins below anything, so the first real fact
+# about the subject replaces it.
+UNKNOWN = (0 << _STATUS_BITS) | DEAD
+
+
+def is_contactable(key):
+    """True where the holder may initiate protocol traffic toward the
+    subject: believed alive or suspect (reference kRandomNodes excludes
+    dead/left members, memberlist/util.go:125-153) — or never heard of
+    at all. The UNKNOWN case models a configured join address (reference
+    memberlist.Join dials addresses it has no state for,
+    memberlist.go:228 -> pushPullNode state.go:595): a cold-rejoining
+    node must be able to announce to / pull from / ping neighbors it has
+    no information about, or it could never learn the cluster.
+    Genuinely dead entries (incarnation >= 1) stay excluded.
+    """
+    key = jnp.asarray(key, jnp.uint32)
+    st = key_status(key)
+    return (st == ALIVE) | (st == SUSPECT) | (key == UNKNOWN)
 
 
 def is_refutable(key, subject_is_self, own_incarnation):

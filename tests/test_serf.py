@@ -12,9 +12,13 @@ from consul_tpu.config import SerfConfig, SimConfig
 from consul_tpu.models import serf
 from consul_tpu.ops import lamport, merge, topology
 
+# Every scenario (except the pure Lamport math) runs in both view
+# modes: dense (complete graph) and the sparse circulant plane.
+pytestmark = pytest.mark.parametrize("vd", [0, 16], ids=["dense", "sparse16"])
 
-def make_sim(n=48, **cfg_kw):
-    cfg = SimConfig(n=n, **cfg_kw)
+
+def make_sim(n=48, vd=0, **cfg_kw):
+    cfg = SimConfig(n=n, view_degree=vd, **cfg_kw)
     key = jax.random.PRNGKey(7)
     kw, kn, ks = jax.random.split(key, 3)
     world = topology.make_world(cfg, kw)
@@ -32,22 +36,22 @@ def run(state, step, ticks, seed=0):
 
 
 class TestLamport:
-    def test_witness_behind(self):
+    def test_witness_behind(self, vd):
         # Observing a newer time jumps to observed+1 (serf/lamport.go:29-45).
         assert int(lamport.witness(jnp.uint32(3), jnp.uint32(10))) == 11
 
-    def test_witness_ahead_noop(self):
+    def test_witness_ahead_noop(self, vd):
         assert int(lamport.witness(jnp.uint32(20), jnp.uint32(10))) == 20
 
-    def test_increment_masked(self):
+    def test_increment_masked(self, vd):
         c = jnp.array([1, 5], jnp.uint32)
         out = lamport.increment(c, jnp.array([True, False]))
         assert out.tolist() == [2, 5]
 
 
 class TestUserEvents:
-    def test_event_reaches_every_node(self):
-        cfg, _, _, state, step = make_sim()
+    def test_event_reaches_every_node(self, vd):
+        cfg, _, _, state, step = make_sim(vd=vd)
         origin = jnp.arange(cfg.n) == 0
         key0 = serf.make_event_key(state.event_clock[0], 42, False)
         state = serf.user_event(cfg, state, origin, 42)
@@ -58,16 +62,16 @@ class TestUserEvents:
         state = run(state, step, 30)
         assert float(serf.event_coverage(cfg, state, key0, 0)) == 1.0
 
-    def test_exactly_once_delivery(self):
-        cfg, _, _, state, step = make_sim()
+    def test_exactly_once_delivery(self, vd):
+        cfg, _, _, state, step = make_sim(vd=vd)
         origin = jnp.arange(cfg.n) == 3
         state = serf.user_event(cfg, state, origin, 7)
         state = run(state, step, 40)
         # Every node delivered exactly one distinct event.
         assert state.ev_delivered.tolist() == [1] * cfg.n
 
-    def test_distinct_origins_are_distinct_events(self):
-        cfg, _, _, state, step = make_sim()
+    def test_distinct_origins_are_distinct_events(self, vd):
+        cfg, _, _, state, step = make_sim(vd=vd)
         # Two different nodes fire an identically-named event at the same
         # ltime: dedup keys (ltime, name, origin) keep them distinct.
         mask = (jnp.arange(cfg.n) == 0) | (jnp.arange(cfg.n) == 1)
@@ -75,10 +79,10 @@ class TestUserEvents:
         state = run(state, step, 40)
         assert state.ev_delivered.tolist() == [2] * cfg.n
 
-    def test_adequate_window_is_exactly_once(self):
+    def test_adequate_window_is_exactly_once(self, vd):
         # Ltime spread (8) within the dedup window (16 buckets): every
         # event delivers exactly once everywhere.
-        cfg, _, _, state, step = make_sim()
+        cfg, _, _, state, step = make_sim(vd=vd)
         origin = jnp.arange(cfg.n) == 0
         n_events = 8
         for name in range(n_events):
@@ -86,12 +90,12 @@ class TestUserEvents:
         state = run(state, step, 60)
         assert state.ev_delivered.tolist() == [n_events] * cfg.n
 
-    def test_window_overflow_never_double_delivers(self):
+    def test_window_overflow_never_double_delivers(self, vd):
         # Ltime spread (8) beyond a tiny window (4 buckets): bucket
         # eviction raises the Lamport floor, so stale events are
         # rejected — possibly dropped, never delivered twice
         # (eventMinTime semantics, serf.go:1258-1357).
-        cfg, _, _, state, step = make_sim(serf=SerfConfig(seen_ring=4))
+        cfg, _, _, state, step = make_sim(vd=vd, serf=SerfConfig(seen_ring=4))
         origin = jnp.arange(cfg.n) == 0
         n_events = 8
         for name in range(n_events):
@@ -101,17 +105,17 @@ class TestUserEvents:
         # Eviction actually happened somewhere (floor rose).
         assert int(jnp.max(state.ev_floor)) > 0
 
-    def test_concurrent_same_ltime_events_all_deliver(self):
+    def test_concurrent_same_ltime_events_all_deliver(self, vd):
         # 4 origins firing at the SAME Lamport time share one bucket
         # (width 4): all coexist, all deliver everywhere.
-        cfg, _, _, state, step = make_sim()
+        cfg, _, _, state, step = make_sim(vd=vd)
         mask = jnp.arange(cfg.n) < 4
         state = serf.user_event(cfg, state, mask, 9)
         state = run(state, step, 40)
         assert state.ev_delivered.tolist() == [4] * cfg.n
 
-    def test_event_clock_witnessed_cluster_wide(self):
-        cfg, _, _, state, step = make_sim()
+    def test_event_clock_witnessed_cluster_wide(self, vd):
+        cfg, _, _, state, step = make_sim(vd=vd)
         state = serf.user_event(cfg, state, jnp.arange(cfg.n) == 0, 1)
         state = run(state, step, 30)
         # Everyone witnessed ltime=1 -> clock >= 2 (lamport witness).
@@ -119,15 +123,15 @@ class TestUserEvents:
 
 
 class TestQueries:
-    def test_query_collects_responses_from_all(self):
-        cfg, _, _, state, step = make_sim()
+    def test_query_collects_responses_from_all(self, vd):
+        cfg, _, _, state, step = make_sim(vd=vd)
         origin = jnp.arange(cfg.n) == 5
         state = serf.query(cfg, state, origin, 17)
         state = run(state, step, 40)
         assert int(state.q_resps[5]) == cfg.n - 1
 
-    def test_query_closes_at_deadline(self):
-        cfg, _, _, state, step = make_sim(n=24)
+    def test_query_closes_at_deadline(self, vd):
+        cfg, _, _, state, step = make_sim(n=24, vd=vd)
         origin = jnp.arange(cfg.n) == 0
         state = serf.query(cfg, state, origin, 1)
         assert int(state.q_open_key[0]) != 0
@@ -136,8 +140,8 @@ class TestQueries:
 
 
 class TestLeaveAndReap:
-    def test_graceful_leave_propagates_as_left(self):
-        cfg, topo, _, state, step = make_sim()
+    def test_graceful_leave_propagates_as_left(self, vd):
+        cfg, topo, _, state, step = make_sim(vd=vd)
         leaver = jnp.arange(cfg.n) == 2
         state = serf.leave(cfg, state, leaver)
         state = run(state, step, 40)
@@ -153,11 +157,11 @@ class TestLeaveAndReap:
         observers = ok & state.swim.alive_truth & ~state.swim.left
         assert bool(jnp.all(jnp.where(observers, st == merge.LEFT, True)))
 
-    def test_reap_after_reconnect_timeout(self):
+    def test_reap_after_reconnect_timeout(self, vd):
         # Shrink the reap window so it fits in a short run (reference
         # default is 24h, serf/config.go:277).
         cfg, _, _, state, step = make_sim(
-            n=32, serf=SerfConfig(reconnect_timeout_ms=8_000)
+            n=32, vd=vd, serf=SerfConfig(reconnect_timeout_ms=8_000)
         )
         state.swim  # formed cluster
         state = state._replace(
@@ -172,8 +176,8 @@ class TestLeaveAndReap:
         assert int(jnp.sum(jnp.where(live, counts.reaped, 0))) > 0
         assert int(jnp.sum(jnp.where(live, counts.dead, 0))) == 0
 
-    def test_left_members_counted_separately(self):
-        cfg, _, _, state, step = make_sim()
+    def test_left_members_counted_separately(self, vd):
+        cfg, _, _, state, step = make_sim(vd=vd)
         state = serf.leave(cfg, state, jnp.arange(cfg.n) == 1)
         state = run(state, step, 40)
         counts = serf.member_counts(cfg, state)

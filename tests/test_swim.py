@@ -1,7 +1,12 @@
 """Integration tests of the vectorized SWIM step: steady-state stability,
 failure detection, refutation, and determinism — the convergence-assertion
 style of the reference's in-process cluster tests (agent/consul/helper_test.go
-wantPeers, sdk/testutil/retry)."""
+wantPeers, sdk/testutil/retry).
+
+Every scenario runs in BOTH view modes: dense (view_degree=0, the
+complete-graph member map of a real memberlist cluster) and sparse
+(view_degree=16, the circulant partial-view plane that makes the >=100k
+shapes feasible — ops/topology.py)."""
 
 import functools
 
@@ -19,9 +24,15 @@ from consul_tpu.utils import metrics
 N = 64
 
 
-@functools.lru_cache(maxsize=4)
-def make_sim(n=N, seed=0, loss=0.0):
-    cfg = SimConfig(n=n, packet_loss=loss, gossip=GossipConfig.lan())
+@pytest.fixture(params=[0, 16], ids=["dense", "sparse16"])
+def vd(request):
+    return request.param
+
+
+@functools.lru_cache(maxsize=8)
+def make_sim(n=N, seed=0, loss=0.0, vd=0):
+    cfg = SimConfig(n=n, packet_loss=loss, view_degree=vd,
+                    gossip=GossipConfig.lan())
     key = jax.random.PRNGKey(seed)
     kw, kn, ks = jax.random.split(key, 3)
     world = topology.make_world(cfg, kw)
@@ -38,8 +49,8 @@ def run(cfg, topo, world, st, ticks, seed=42):
     return st
 
 
-def test_steady_state_no_false_positives():
-    cfg, world, topo, st = make_sim()
+def test_steady_state_no_false_positives(vd):
+    cfg, world, topo, st = make_sim(vd=vd)
     st = run(cfg, topo, world, st, 120)  # 24 simulated seconds
     h = metrics.health(cfg, topo, st)
     assert float(h.agreement) == 1.0
@@ -47,8 +58,8 @@ def test_steady_state_no_false_positives():
     assert int(st.t) == 120
 
 
-def test_failure_detection_converges():
-    cfg, world, topo, st = make_sim()
+def test_failure_detection_converges(vd):
+    cfg, world, topo, st = make_sim(vd=vd)
     dead = jnp.arange(N) < 8  # kill 8 of 64
     st = sim_state.kill(st, dead)
     # Suspicion min timeout at n=64: 4 * log10(64)=1.8 * 5 ticks = 36
@@ -62,8 +73,8 @@ def test_failure_detection_converges():
     assert int(h.live_nodes) == N - 8
 
 
-def test_refutation_recovers_wrongly_suspected_node():
-    cfg, world, topo, st = make_sim()
+def test_refutation_recovers_wrongly_suspected_node(vd):
+    cfg, world, topo, st = make_sim(vd=vd)
     # Plant a false suspicion of node 0 at its current incarnation in
     # every other node's view.
     subj0 = topology.nbrs_table(topo) == 0
@@ -81,16 +92,16 @@ def test_refutation_recovers_wrongly_suspected_node():
     assert int(st.own_inc[0]) > 1
 
 
-def test_deterministic_trajectory():
-    cfg, world, topo, st0 = make_sim()
+def test_deterministic_trajectory(vd):
+    cfg, world, topo, st0 = make_sim(vd=vd)
     st_a = run(cfg, topo, world, st0, 40, seed=7)
     st_b = run(cfg, topo, world, st0, 40, seed=7)
     for leaf_a, leaf_b in zip(jax.tree.leaves(st_a), jax.tree.leaves(st_b)):
         np.testing.assert_array_equal(np.asarray(leaf_a), np.asarray(leaf_b))
 
 
-def test_vivaldi_converges_during_gossip():
-    cfg, world, topo, st = make_sim()
+def test_vivaldi_converges_during_gossip(vd):
+    cfg, world, topo, st = make_sim(vd=vd)
     key = jax.random.PRNGKey(3)
     rmse0 = float(metrics.vivaldi_rmse(cfg, world, st, key))
     st = run(cfg, topo, world, st, 400)
@@ -100,8 +111,8 @@ def test_vivaldi_converges_during_gossip():
     assert rmse1 < 0.020  # 20ms on a ~50ms-diameter world
 
 
-def test_revive_rejoins_with_higher_incarnation():
-    cfg, world, topo, st = make_sim()
+def test_revive_rejoins_with_higher_incarnation(vd):
+    cfg, world, topo, st = make_sim(vd=vd)
     dead = jnp.arange(N) < 4
     st = sim_state.kill(st, dead)
     st = run(cfg, topo, world, st, 300)
@@ -113,9 +124,49 @@ def test_revive_rejoins_with_higher_incarnation():
     assert int(h.live_nodes) == N
 
 
+def test_cold_revive_rejoins_from_seeds(vd):
+    """A cold restart (no serf snapshot) wipes the node's views down to
+    the configured join seeds; the join storm (own-fact announcement +
+    push-pull from seeds) must relearn the full cluster (reference
+    memberlist.Join memberlist.go:228 -> pushPullNode state.go:595;
+    serf handleRejoin serf.go:1705 is the warm path tested above)."""
+    # Short push-pull interval so the join storm fits a short test run.
+    cfg = SimConfig(
+        n=N, view_degree=vd,
+        gossip=GossipConfig.lan(push_pull_interval_ms=3_000),
+    )
+    key = jax.random.PRNGKey(0)
+    kw, kn, ks = jax.random.split(key, 3)
+    world = topology.make_world(cfg, kw)
+    topo = topology.make_topology(cfg, kn)
+    st = sim_state.init(cfg, ks)
+
+    dead = jnp.arange(N) < 4
+    st = sim_state.kill(st, dead)
+    st = run(cfg, topo, world, st, 300)
+    assert float(metrics.health(cfg, topo, st).undetected) == 0.0
+
+    st = sim_state.revive(cfg, st, dead, cold=True)
+    # Immediately after a cold revive the node's view is seeds-only.
+    k_deg = st.view_key.shape[1]
+    alive_beliefs = int(
+        jnp.sum(merge.key_status(st.view_key[0]) == merge.ALIVE)
+    )
+    assert alive_beliefs < k_deg, "cold revive must wipe most of the view"
+
+    st = run(cfg, topo, world, st, 600)
+    h = metrics.health(cfg, topo, st)
+    assert float(h.agreement) == 1.0, "cold-revived nodes failed to rejoin"
+    assert int(h.live_nodes) == N
+    # The cold node relearned its whole view, not just the seeds.
+    assert int(
+        jnp.sum(merge.key_status(st.view_key[0]) == merge.ALIVE)
+    ) == k_deg
+
+
 @pytest.mark.parametrize("loss", [0.02])
-def test_lossy_network_stays_converged(loss):
-    cfg, world, topo, st = make_sim(loss=loss)
+def test_lossy_network_stays_converged(loss, vd):
+    cfg, world, topo, st = make_sim(loss=loss, vd=vd)
     st = run(cfg, topo, world, st, 200)
     h = metrics.health(cfg, topo, st)
     # With 2% packet loss the TCP-fallback path must prevent lasting
