@@ -74,10 +74,27 @@ class HTTPApi:
             return 500, {"error": f"internal: {e!r}"}, {}
 
     def _rpc_write(self, method: str, **args):
+        """Propose a write and wait for it to apply locally; returns
+        ``(raft_index, fsm_result)`` — the synchronous raftApply
+        contract (reference rpc.go:377-447: the HTTP layer receives the
+        FSM's response, e.g. a CAS verdict, not an inference from a
+        racy re-read). Methods that return a non-index value directly
+        (e.g. a pre-assigned session id) come back as ``(None, out)``."""
         out = self.agent.rpc(method, **args)
         if isinstance(out, int):
             self.wait_write(out)
-        return out
+            res = self.agent.rpc("Status.ApplyResult", index=out)
+            if not res.get("found"):
+                # The entry committed but its verdict is unreachable
+                # (applied-before-wait, evicted ring entry): surface an
+                # error rather than fabricate a false/true verdict —
+                # the reference's lost-future equivalent is an RPC
+                # error, never a wrong answer.
+                raise RuntimeError(
+                    f"apply result for raft index {out} unavailable"
+                )
+            return out, res["result"]
+        return None, out
 
     def _route(self, method, path, q, query, body, min_index, wait_s, near):
         parts = [p for p in path.split("/") if p]
@@ -111,7 +128,7 @@ class HTTPApi:
             return 200, out["value"], {"X-Consul-Index": str(out["index"])}
         if parts == ["catalog", "register"] and method == "PUT":
             req = json.loads(body)
-            idx = self._rpc_write(
+            idx, _ = self._rpc_write(
                 "Catalog.Register", node=req["Node"],
                 address=req.get("Address", ""),
                 service=_lower_keys(req.get("Service")),
@@ -149,7 +166,7 @@ class HTTPApi:
         if parts == ["session", "create"] and method == "PUT":
             req = json.loads(body or b"{}")
             ttl = _dur_to_s(req["TTL"]) if req.get("TTL") else 0.0
-            sid = self._rpc_write(
+            _, sid = self._rpc_write(
                 "Session.Apply", op="create",
                 node=req.get("Node", self.agent.node), ttl_s=ttl,
                 behavior=req.get("Behavior", "release"),
@@ -184,8 +201,13 @@ class HTTPApi:
                     "cas_index": kv.get("Index"),
                     "session": kv.get("Session"),
                 })
-            self._rpc_write("Txn.Apply", ops=ops)
-            return 200, {"Results": []}, {}
+            _, result = self._rpc_write("Txn.Apply", ops=ops)
+            if isinstance(result, dict) and result.get("ok"):
+                return 200, {"Results": result.get("results", [])}, {}
+            # Rolled-back transaction: 409 with the failing op, like the
+            # reference txn endpoint (agent/txn_endpoint.go).
+            err = (result or {}).get("failed") or (result or {}).get("error")
+            return 409, {"Results": [], "Errors": [{"What": str(err)}]}, {}
 
         # ---- operator snapshot (reference snapshot/, agent/consul/
         # rpc.go:196 RPCSnapshot byte; CLI `consul snapshot`) -----------
@@ -266,27 +288,21 @@ class HTTPApi:
                 op, session = "lock", q["acquire"]
             if "release" in q:
                 op, session = "unlock", q["release"]
-            self._rpc_write("KVS.Apply", op=op, key=key, value=body,
-                            flags=int(q.get("flags", 0)), cas_index=cas,
-                            session=session)
-            # The API returns whether the op succeeded (CAS/locks).
-            cur = rpc("KVS.Get", key=key)["value"]
-            if op == "cas":
-                ok = cur is not None and cur["value"] == body
-            elif op == "lock":
-                ok = cur is not None and cur.get("session") == session
-            elif op == "unlock":
-                ok = cur is not None and cur.get("session") is None
-            else:
-                ok = True
-            return 200, ok, {}
+            _, ok = self._rpc_write("KVS.Apply", op=op, key=key, value=body,
+                                    flags=int(q.get("flags", 0)), cas_index=cas,
+                                    session=session)
+            # ok is the FSM's own verdict for this exact log entry
+            # (CAS/lock success), not an inference from a re-read that a
+            # concurrent writer could have changed.
+            return 200, bool(ok), {}
         if method == "DELETE":
             cas = int(q["cas"]) if "cas" in q else None
-            self._rpc_write("KVS.Apply",
-                            op="delete-cas" if cas is not None else (
-                                "delete-tree" if "recurse" in q else "delete"),
-                            key=key, cas_index=cas)
-            return 200, True, {}
+            _, ok = self._rpc_write(
+                "KVS.Apply",
+                op="delete-cas" if cas is not None else (
+                    "delete-tree" if "recurse" in q else "delete"),
+                key=key, cas_index=cas)
+            return 200, bool(ok), {}
         return 405, {"error": "method not allowed"}, {}
 
 

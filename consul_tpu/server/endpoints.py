@@ -105,6 +105,24 @@ class Server:
     def _status_leader(self) -> Optional[str]:
         return self.raft.leader_id
 
+    def _status_apply_result(self, index: int) -> dict:
+        """FSM response for a committed log index (the resolved value of
+        the reference's raftApply future, rpc.go:377-447). Returns
+        ``{"found": bool, "result": ...}`` — found distinguishes a
+        genuine FSM verdict (which may itself be falsy, e.g. a lost
+        CAS) from an unavailable one. Checked locally first; a miss
+        (e.g. this replica caught up via InstallSnapshot, or the ring
+        evicted it) falls through to the leader, which applied the
+        entry from its own log."""
+        if index in self.raft.apply_results:
+            return {"found": True, "result": self.raft.apply_results[index]}
+        leader = self.raft.leader_id
+        if leader is not None and leader != self.id and leader in self.registry:
+            lr = self.registry[leader].raft.apply_results
+            if index in lr:
+                return {"found": True, "result": lr[index]}
+        return {"found": False, "result": None}
+
     def _status_peers(self) -> list[str]:
         return sorted([self.raft.id, *self.raft.peers])
 

@@ -116,37 +116,42 @@ class FSM:
             return self.store.config_set(command["kind"], command["name"],
                                          command["entry"], index=index)
         if mtype == TXN:
-            # All-or-nothing batch (reference agent/consul/txn_endpoint.go):
-            # verify CAS preconditions up front, and roll the store back
-            # if any op fails mid-batch — a partial TXN must never leak.
-            for op in command["ops"]:
-                if op["type"] == KV and op["op"] in ("cas", "delete-cas"):
-                    e = self.store.kv_get(op["key"])
-                    cur = e["modify_index"] if e else 0
-                    if cur != op.get("cas_index", 0):
-                        return {"ok": False, "failed": op["key"]}
-            # Undo log covers only the tables this batch can touch —
-            # O(touched tables), not O(store) (the reference's memdb
-            # txn abort is similarly scoped to written radix nodes).
-            touched: set = set()
-            for op in command["ops"]:
-                touched |= _TXN_TABLES.get(op["type"], set(StateStore.TABLES))
-            undo = self.store.snapshot(tables=touched)
-            results = []
-            try:
+            # All-or-nothing batch (reference agent/consul/txn_endpoint.go)
+            # applied inside one store transaction: the store lock is
+            # held across verify + apply + (possible) rollback, so a
+            # concurrent reader can never observe a partial or
+            # later-rolled-back batch — the reference's single-commit
+            # memdb Txn visibility.
+            with self.store.transaction():
                 for op in command["ops"]:
-                    result = self.apply(index, op)
-                    # Ops that *return* failure (lock/unlock/CAS inside
-                    # the batch) abort the TXN just like ops that raise.
-                    if result is False:
-                        self.store.restore(undo)
-                        return {"ok": False,
-                                "failed": op.get("key", op["type"])}
-                    results.append(result)
-            except Exception as e:  # noqa: BLE001
-                self.store.restore(undo)
-                return {"ok": False, "error": repr(e)}
-            return {"ok": True, "results": results}
+                    if op["type"] == KV and op["op"] in ("cas", "delete-cas"):
+                        e = self.store.kv_get(op["key"])
+                        cur = e["modify_index"] if e else 0
+                        if cur != op.get("cas_index", 0):
+                            return {"ok": False, "failed": op["key"]}
+                # Undo log covers only the tables this batch can touch —
+                # O(touched tables), not O(store) (the reference's memdb
+                # txn abort is similarly scoped to written radix nodes).
+                touched: set = set()
+                for op in command["ops"]:
+                    touched |= _TXN_TABLES.get(op["type"], set(StateStore.TABLES))
+                undo = self.store.snapshot(tables=touched)
+                results = []
+                try:
+                    for op in command["ops"]:
+                        result = self.apply(index, op)
+                        # Ops that *return* failure (lock/unlock/CAS
+                        # inside the batch) abort the TXN just like ops
+                        # that raise.
+                        if result is False:
+                            self.store.restore(undo)
+                            return {"ok": False,
+                                    "failed": op.get("key", op["type"])}
+                        results.append(result)
+                except Exception as e:  # noqa: BLE001
+                    self.store.restore(undo)
+                    return {"ok": False, "error": repr(e)}
+                return {"ok": True, "results": results}
         raise ValueError(f"unknown message type {mtype!r}")
 
     # Snapshot/restore delegate to the store (fsm.go:134,152).

@@ -74,6 +74,19 @@ def reconcile(server: Server, members: Iterable[dict]) -> list[int]:
                                m["status"])
         if idx is not None:
             indexes.append(idx)
+    # Catalog nodes that serf has reaped entirely (absent from the
+    # member list) but that still linger in the catalog: deregister.
+    # Identified by their serfHealth check, so externally-registered
+    # nodes (no agent, no serf check) are never touched (reference
+    # reconcileReaped leader.go:992-1060).
+    for check in server.store.checks():
+        if check["check_id"] != SERF_HEALTH:
+            continue
+        if check["node"] in seen:
+            continue
+        idx = reconcile_member(server, check["node"], "", "reap")
+        if idx is not None:
+            indexes.append(idx)
     return indexes
 
 

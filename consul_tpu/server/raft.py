@@ -130,6 +130,12 @@ class RaftNode:
         self.next_index: dict[str, int] = {}
         self.match_index: dict[str, int] = {}
         self.apply_errors: list[tuple[int, str]] = []
+        # FSM responses by log index — the raftApply future's resolved
+        # value (reference rpc.go:377-447: the caller gets the FSM's
+        # return, e.g. a CAS verdict). Bounded ring; every replica holds
+        # the results of its own recent applies.
+        self.apply_results: dict[int, Any] = {}
+        self.apply_results_cap = 4096
         self.stopped = False
         self._reset_election_timer()
         transport.register(self)
@@ -366,13 +372,17 @@ class RaftNode:
             entry = self.entry_at(self.last_applied)
             if entry is not None and entry.command != {"type": "noop"}:
                 try:
-                    self.apply_fn(entry.index, entry.command)
+                    result = self.apply_fn(entry.index, entry.command)
                 except Exception as e:  # noqa: BLE001
                     # A bad committed entry must not kill the raft loop
                     # (every replica would crash identically); record it
                     # and keep applying — endpoint-side validation is
                     # the real gate, this is the backstop.
                     self.apply_errors.append((entry.index, repr(e)))
+                    result = {"error": repr(e)}
+                self.apply_results[entry.index] = result
+                while len(self.apply_results) > self.apply_results_cap:
+                    self.apply_results.pop(next(iter(self.apply_results)))
         self._maybe_compact()
 
     # ------------------------------------------------------------------

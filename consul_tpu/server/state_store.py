@@ -20,6 +20,7 @@ run an in-memory raft (reference agent/consul/server.go:177).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import fnmatch
 import threading
@@ -71,6 +72,22 @@ class StateStore:
         self._cond = threading.Condition(self._lock)
         self.index = 0
         self.tables = {name: Table(name) for name in self.TABLES}
+
+    @contextlib.contextmanager
+    def transaction(self):
+        """Hold the store lock across a multi-op batch.
+
+        Every read path acquires the same lock, so a concurrent reader
+        (including a blocking query re-run) can never observe a
+        half-applied — possibly later rolled-back — batch, and never a
+        non-monotonic index: the visibility contract of the reference's
+        single-commit memdb transaction (reference
+        agent/consul/state/state_store.go Txn.Commit; blocked readers in
+        ``blocking_query`` sit in ``Condition.wait``, which releases the
+        underlying lock, so holding it here cannot deadlock them).
+        """
+        with self._lock:
+            yield
 
     # ------------------------------------------------------------------
     # Core commit path

@@ -23,7 +23,8 @@ from typing import Optional
 import msgpack
 
 from consul_tpu.wire import lzw
-from consul_tpu.wire.keyring import Keyring
+from consul_tpu.wire.keyring import (NONCE_SIZE, TAG_SIZE, VERSION_SIZE,
+                                     Keyring)
 
 
 class MessageType(enum.IntEnum):
@@ -125,8 +126,12 @@ def split_compound(buf: bytes) -> list[bytes]:
 def encode_packet(msgs: list[bytes], *, compress: bool = False,
                   crc: bool = False,
                   keyring: Optional[Keyring] = None) -> bytes:
-    """Sender pipeline (net.go:631-700): compound when multiple
-    messages, then compress, then CRC, then encrypt."""
+    """Sender pipeline (net.go:631-714 rawSendMsgPacket): compound when
+    multiple messages, then compress, then CRC, then encrypt. The
+    encrypted payload is sent RAW — no msgType prefix, no AAD
+    (net.go:697-708); the receiver knows to decrypt from its own
+    config, not from the bytes. (The encryptMsg byte exists only on the
+    *stream* path — see :func:`encode_stream_frame`.)"""
     pkt = msgs[0] if len(msgs) == 1 else make_compound(msgs)
     if compress:
         body = msgpack.packb(
@@ -137,25 +142,31 @@ def encode_packet(msgs: list[bytes], *, compress: bool = False,
         digest = zlib.crc32(pkt) & 0xFFFFFFFF
         pkt = bytes([MessageType.HAS_CRC]) + digest.to_bytes(4, "big") + pkt
     if keyring is not None and keyring.primary is not None:
-        pkt = bytes([MessageType.ENCRYPT]) + keyring.encrypt(pkt)
+        pkt = keyring.encrypt(pkt)
     return pkt
 
 
 def decode_packet(pkt: bytes,
-                  keyring: Optional[Keyring] = None) -> list[tuple[MessageType, dict]]:
-    """Receiver pipeline (ingestPacket net.go:299-346 + handleCompound):
-    decrypt, verify CRC, decompress, split compounds, decode each body.
-    Returns (type, body) pairs in arrival order."""
+                  keyring: Optional[Keyring] = None, *,
+                  verify_incoming: bool = True) -> list[tuple[MessageType, dict]]:
+    """Receiver pipeline (ingestPacket net.go:310-346 + handleCompound):
+    decrypt (by config — the packet carries no encryption marker), verify
+    CRC, decompress, split compounds, decode each body. Returns
+    (type, body) pairs in arrival order.
+
+    ``verify_incoming=False`` mirrors GossipVerifyIncoming=false
+    (net.go:315-321): a payload no key decrypts is processed as
+    plaintext instead of rejected (the key-rotation upgrade window).
+    """
     if not pkt:
         raise ValueError("empty packet")
-    if pkt[0] == MessageType.ENCRYPT:
-        if keyring is None:
-            raise ValueError("encrypted packet but no keyring installed")
-        pkt = keyring.decrypt(pkt[1:])
-    elif keyring is not None and keyring.primary is not None:
-        # GossipVerifyIncoming: plaintext rejected when encryption is on
-        # (config.go:157, net.go:312-320).
-        raise ValueError("plaintext packet rejected (encryption enabled)")
+    if keyring is not None and keyring.primary is not None:
+        try:
+            pkt = keyring.decrypt(pkt)
+        except ValueError:
+            if verify_incoming:
+                raise
+            # fall through: treat as plaintext
     if pkt and pkt[0] == MessageType.HAS_CRC:
         if len(pkt) < 5:
             raise ValueError("truncated CRC header")
@@ -172,3 +183,52 @@ def decode_packet(pkt: bytes,
     if pkt and pkt[0] == MessageType.COMPOUND:
         return [decode_message(part) for part in split_compound(pkt[1:])]
     return [decode_message(pkt)]
+
+
+# ----------------------------------------------------------------------
+# Stream (push-pull / TCP) encryption framing. Unlike the packet path,
+# streams DO carry an explicit encryptMsg header:
+#   [encryptMsg byte | u32 big-endian ciphertext length | ciphertext]
+# with the 5 header bytes as AAD (net.go:878-900 encryptLocalState,
+# :946-976 readRemoteState).
+# ----------------------------------------------------------------------
+
+def encode_stream_frame(buf: bytes, keyring: Optional[Keyring]) -> bytes:
+    """encryptLocalState (net.go:878-900); plaintext passthrough when
+    encryption is off (sendLocalState writes the raw stream)."""
+    if keyring is None or keyring.primary is None:
+        return buf
+    # AES-GCM ciphertext length is deterministic (version + nonce +
+    # plaintext + tag — the reference's encryptedLength, security.go),
+    # so the header the AAD commits to is computable up front.
+    ct_len = VERSION_SIZE + NONCE_SIZE + len(buf) + TAG_SIZE
+    header = bytes([MessageType.ENCRYPT]) + ct_len.to_bytes(4, "big")
+    ct = keyring.encrypt(buf, aad=header)
+    assert len(ct) == ct_len
+    return header + ct
+
+
+def decode_stream_frame(frame: bytes, keyring: Optional[Keyring]) -> bytes:
+    """decryptRemoteState (net.go:903-976): enforce the encryption
+    expectation both ways, verify the header as AAD, decrypt."""
+    encrypted = bool(frame) and frame[0] == MessageType.ENCRYPT
+    enabled = keyring is not None and keyring.primary is not None
+    if encrypted and not enabled:
+        raise ValueError(
+            "remote state is encrypted and encryption is not configured"
+        )
+    if not encrypted:
+        if enabled:
+            raise ValueError(
+                "encryption is configured but remote state is not encrypted"
+            )
+        return frame
+    if len(frame) < 5:
+        raise ValueError("truncated stream encryption header")
+    want_len = int.from_bytes(frame[1:5], "big")
+    ct = frame[5:]
+    if len(ct) != want_len:
+        raise ValueError(
+            f"stream ciphertext length {len(ct)} != header {want_len}"
+        )
+    return keyring.decrypt(ct, aad=frame[:5])

@@ -180,6 +180,61 @@ class TestHTTP:
         assert out[0]["coord"]["vec"][0] == 0.001
 
 
+class TestKVWriteVerdicts:
+    """The HTTP layer must report the FSM's own verdict for the exact
+    committed entry (raftApply future contract, reference
+    rpc.go:377-447) — not an inference from a racy re-read."""
+
+    def test_cas_failure_with_identical_value_reports_false(self, stack):
+        # A re-read-based inference cannot distinguish "my CAS lost"
+        # from "the stored value happens to equal my payload".
+        _, _, client, _ = stack
+        assert client.kv.put("verdict/cas", b"same") is True
+        row, _ = client.kv.get("verdict/cas")
+        idx = row["ModifyIndex"]
+        assert client.kv.put("verdict/cas", b"same", cas=idx + 999) is False
+        assert client.kv.put("verdict/cas", b"same", cas=idx) is True
+
+    def test_acquire_by_wrong_session_reports_false(self, stack):
+        _, agent, client, _ = stack
+        client.catalog.register(agent.node, "10.9.0.1")
+        s1 = client.session.create(node=agent.node)
+        s2 = client.session.create(node=agent.node)
+        assert client.kv.put("verdict/lock", b"", acquire=s1) is True
+        assert client.kv.put("verdict/lock", b"", acquire=s2) is False
+        # Releasing with the non-holder fails; with the holder succeeds.
+        assert client.kv.put("verdict/lock", b"", release=s2) is False
+        assert client.kv.put("verdict/lock", b"", release=s1) is True
+
+    def test_delete_cas_verdict(self, stack):
+        _, _, client, _ = stack
+        client.kv.put("verdict/del", b"v")
+        row, _ = client.kv.get("verdict/del")
+        out, _, _ = client._call(
+            "DELETE", "/v1/kv/verdict/del",
+            {"cas": row["ModifyIndex"] + 5})
+        assert out is False
+        out, _, _ = client._call(
+            "DELETE", "/v1/kv/verdict/del", {"cas": row["ModifyIndex"]})
+        assert out is True
+
+    def test_txn_result_surfaced(self, stack):
+        import base64
+
+        from consul_tpu.api import APIError
+        _, _, client, _ = stack
+        ops = [{"KV": {"Verb": "set", "Key": "verdict/t1",
+                       "Value": base64.b64encode(b"a").decode()}},
+               {"KV": {"Verb": "cas", "Key": "verdict/t2", "Index": 999,
+                       "Value": base64.b64encode(b"b").decode()}}]
+        with pytest.raises(APIError) as e:
+            client._call("PUT", "/v1/txn", {}, json.dumps(ops).encode())
+        assert e.value.status == 409
+        # Rolled back: op 1's write must not be visible.
+        row, _ = client.kv.get("verdict/t1")
+        assert row is None
+
+
 class TestCLI:
     def run_cli(self, port, *argv):
         buf = io.StringIO()

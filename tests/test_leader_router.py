@@ -72,6 +72,35 @@ class TestReconcile:
         leader = cluster.leader_server()
         assert reconcile_member(leader, "ghost", "a", "failed") is None
 
+    def test_vanished_member_reaped_from_catalog(self, cluster):
+        """A catalog node absent from the member list entirely (serf
+        reaped it, e.g. while this server was not leader) must be
+        deregistered — identified by its serfHealth check (reference
+        reconcileReaped leader.go:992-1060)."""
+        leader = cluster.leader_server()
+        run_writes(cluster, lambda: reconcile(leader, [
+            {"name": "n1", "address": "a", "status": "alive"},
+            {"name": "n2", "address": "b", "status": "alive"},
+        ]))
+        # n2 vanishes from the member list without a left/reap event.
+        run_writes(cluster, lambda: reconcile(leader, [
+            {"name": "n1", "address": "a", "status": "alive"},
+        ]))
+        assert leader.store.get_node("n1") is not None
+        assert leader.store.get_node("n2") is None
+
+    def test_externally_registered_node_not_reaped(self, cluster):
+        """Nodes registered without an agent (no serfHealth check) are
+        never touched by the reap sweep (reference reconcileReaped
+        skips non-serf checks, leader.go:999-1002)."""
+        leader = cluster.leader_server()
+        run_writes(cluster, lambda: leader.rpc(
+            "Catalog.Register", node="ext-db", address="10.1.1.1"))
+        run_writes(cluster, lambda: reconcile(leader, [
+            {"name": "n1", "address": "a", "status": "alive"},
+        ]))
+        assert leader.store.get_node("ext-db") is not None
+
     def test_follower_reconcile_is_noop(self, cluster):
         follower = cluster.any_follower()
         assert reconcile(follower, [

@@ -188,3 +188,80 @@ class TestSnapshotRestore:
         assert other.get_node("n1")["address"] == "10.0.0.1"
         assert other.kv_get("k")["value"] == b"v"
         assert other.coordinate_for("n1")["coord"]["vec"] == [3.0]
+
+
+class TestTxnVisibility:
+    def test_reader_never_observes_rolled_back_txn(self):
+        """A concurrent reader must never see a half-applied (and here
+        later rolled-back) transaction — the single-commit visibility
+        of the reference's memdb Txn (fsm.py holds the store lock
+        across the batch). The writer thread is slowed inside the
+        batch to hand a non-atomic implementation every chance to
+        leak."""
+        from consul_tpu.server import fsm as fsm_mod
+
+        fsm = fsm_mod.FSM()
+        store = fsm.store
+        in_txn = threading.Event()
+        orig_kv_set = StateStore.kv_set
+
+        def slow_kv_set(self, *a, **kw):
+            out = orig_kv_set(self, *a, **kw)
+            in_txn.set()
+            time.sleep(0.05)  # window for the reader to interleave
+            return out
+
+        observed = []
+
+        def reader():
+            in_txn.wait(5)
+            observed.append(store.kv_get("txn-a"))
+
+        th = threading.Thread(target=reader)
+        th.start()
+        try:
+            StateStore.kv_set = slow_kv_set
+            # Op 1 writes txn-a; op 2 fails (lock with unknown session)
+            # -> whole batch rolls back.
+            result = fsm.apply(1, {
+                "type": fsm_mod.TXN, "ops": [
+                    {"type": fsm_mod.KV, "op": "set", "key": "txn-a",
+                     "value": b"partial"},
+                    {"type": fsm_mod.KV, "op": "lock", "key": "txn-b",
+                     "value": b"x", "session": "no-such-session"},
+                ],
+            })
+        finally:
+            StateStore.kv_set = orig_kv_set
+        th.join(5)
+        assert result["ok"] is False
+        assert store.kv_get("txn-a") is None
+        # The reader ran during the txn window yet saw nothing partial.
+        assert observed == [None]
+
+    def test_blocked_reader_not_deadlocked_by_txn(self):
+        """Holding the store lock across a TXN must not deadlock
+        blocking queries: Condition.wait releases the lock."""
+        from consul_tpu.server import fsm as fsm_mod
+
+        fsm = fsm_mod.FSM()
+        store = fsm.store
+        got = []
+
+        def blocked_reader():
+            got.append(store.blocking_query(
+                ["kv"], 1, lambda: store.kv_get("bq-k"), timeout_s=5.0))
+
+        th = threading.Thread(target=blocked_reader)
+        th.start()
+        time.sleep(0.05)
+        result = fsm.apply(2, {
+            "type": fsm_mod.TXN, "ops": [
+                {"type": fsm_mod.KV, "op": "set", "key": "bq-k",
+                 "value": b"v"},
+            ],
+        })
+        th.join(5)
+        assert result["ok"] is True
+        assert not th.is_alive()
+        assert got and got[0][1]["value"] == b"v"

@@ -118,20 +118,73 @@ class TestPacketPipeline:
     def test_encrypted_roundtrip(self):
         ring = Keyring(primary=os.urandom(16))
         pkt = encode_packet(self.MSGS, compress=True, keyring=ring)
-        assert pkt[0] == MessageType.ENCRYPT
+        # The packet path sends the RAW encrypted payload — no
+        # encryptMsg prefix byte (net.go:697-714; a real memberlist
+        # agent would fail to decrypt a prefixed packet). Byte 0 is the
+        # encryption version (1), not a message type.
+        assert pkt[0] != MessageType.ENCRYPT
+        assert pkt[0] == 1  # encryption version byte
         out = decode_packet(pkt, keyring=ring)
         assert out[1][1]["Node"] == "b"
 
     def test_plaintext_rejected_when_encrypting(self):
         ring = Keyring(primary=os.urandom(16))
         pkt = encode_packet(self.MSGS)
-        with pytest.raises(ValueError, match="plaintext"):
+        with pytest.raises(ValueError, match="no installed key"):
             decode_packet(pkt, keyring=ring)
+
+    def test_plaintext_accepted_without_verify_incoming(self):
+        # GossipVerifyIncoming=false (net.go:315-321): an undecryptable
+        # payload is processed as plaintext — the rotation window.
+        ring = Keyring(primary=os.urandom(16))
+        pkt = encode_packet(self.MSGS)
+        out = decode_packet(pkt, keyring=ring, verify_incoming=False)
+        assert out[0][1]["SeqNo"] == 1
 
     def test_wrong_key_fails(self):
         pkt = encode_packet(self.MSGS, keyring=Keyring(primary=os.urandom(16)))
         with pytest.raises(ValueError, match="no installed key"):
             decode_packet(pkt, keyring=Keyring(primary=os.urandom(16)))
+
+
+class TestStreamFraming:
+    """Stream (push-pull/TCP) encryption framing: [encryptMsg | u32 len
+    | ciphertext] with the header as AAD (net.go:878-900, :946-976) —
+    distinct from the packet path, which has no marker byte."""
+
+    def test_roundtrip(self):
+        from consul_tpu.wire.codec import (decode_stream_frame,
+                                           encode_stream_frame)
+        ring = Keyring(primary=os.urandom(32))
+        frame = encode_stream_frame(b"push-pull-state", ring)
+        assert frame[0] == MessageType.ENCRYPT
+        assert int.from_bytes(frame[1:5], "big") == len(frame) - 5
+        assert decode_stream_frame(frame, ring) == b"push-pull-state"
+
+    def test_plaintext_passthrough(self):
+        from consul_tpu.wire.codec import (decode_stream_frame,
+                                           encode_stream_frame)
+        assert encode_stream_frame(b"x", None) == b"x"
+        assert decode_stream_frame(b"x", None) == b"x"
+
+    def test_expectation_enforced_both_ways(self):
+        from consul_tpu.wire.codec import (decode_stream_frame,
+                                           encode_stream_frame)
+        ring = Keyring(primary=os.urandom(16))
+        frame = encode_stream_frame(b"s", ring)
+        with pytest.raises(ValueError, match="not configured"):
+            decode_stream_frame(frame, None)
+        with pytest.raises(ValueError, match="not encrypted"):
+            decode_stream_frame(b"plain", ring)
+
+    def test_header_tamper_detected(self):
+        from consul_tpu.wire.codec import (decode_stream_frame,
+                                           encode_stream_frame)
+        ring = Keyring(primary=os.urandom(16))
+        frame = bytearray(encode_stream_frame(b"s" * 100, ring))
+        frame[2] ^= 0x01  # flip a length byte (bound as AAD)
+        with pytest.raises(ValueError):
+            decode_stream_frame(bytes(frame), ring)
 
 
 class TestKeyring:
