@@ -85,6 +85,21 @@ def demote_dead_to_suspect(key):
     return jnp.where(demote, (key & ~jnp.uint32(N_STATUS - 1)) | SUSPECT, key)
 
 
+# Host-side scalar versions of the key algebra (plain ints, no device
+# dispatch) — for the transport bridge and other per-fact host loops.
+
+def make_key_int(incarnation: int, status: int) -> int:
+    return (int(incarnation) << _STATUS_BITS) | int(status)
+
+
+def key_incarnation_int(key: int) -> int:
+    return int(key) >> _STATUS_BITS
+
+
+def key_status_int(key: int) -> int:
+    return int(key) & (N_STATUS - 1)
+
+
 # "Never heard of this node": the cold-join sentinel. Distinct from a
 # genuine death report, which always carries incarnation >= 1 (nodes are
 # born at incarnation 1). Joins below anything, so the first real fact

@@ -487,11 +487,19 @@ class StateStore:
     # ------------------------------------------------------------------
     def snapshot(self, tables: Optional[Iterable[str]] = None) -> dict:
         """Deep-copy the named tables (all by default). A subset makes a
-        cheap undo log for transactions that touch few tables."""
+        cheap undo log for transactions that touch few tables.
+
+        ``table_indexes`` records each table's max_index at snapshot
+        time: a deletion leaves no surviving row carrying the index, so
+        recomputing from rows on restore would regress the visibility
+        index (long-pollers would see X-Consul-Index go backwards)."""
         names = list(tables) if tables is not None else list(self.TABLES)
         with self._lock:
             return {
                 "index": self.index,
+                "table_indexes": {
+                    name: self.tables[name].max_index for name in names
+                },
                 "tables": {
                     name: {k: dataclasses.asdict(e)
                            for k, e in self.tables[name].rows.items()}
@@ -504,10 +512,11 @@ class StateStore:
         untouched, supporting partial undo)."""
         with self._lock:
             self.index = snap["index"]
+            recorded = snap.get("table_indexes", {})
             for name, rows in snap["tables"].items():
                 t = self.tables[name]
                 t.rows = {k: Entry(**e) for k, e in rows.items()}
-                t.max_index = max(
+                t.max_index = recorded.get(name) if name in recorded else max(
                     [e.modify_index for e in t.rows.values()], default=0
                 )
             self._cond.notify_all()

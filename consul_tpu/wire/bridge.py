@@ -1,0 +1,574 @@
+"""The tpu-sim transport seam: real agents on the simulated fabric.
+
+This is the BASELINE-named integration boundary: the reference's
+``memberlist.Transport`` is a six-method interface (reference
+vendor/github.com/hashicorp/memberlist/transport.go:27-65) behind which
+an in-process mock network already exists (mock_transport.go:12-121) —
+the model for this module. A *real* (non-simulated) agent gets a
+:class:`BridgeTransport` whose methods mirror that interface:
+
+    final_advertise_addr(ip, port)   FinalAdvertiseAddr
+    write_to(buf, addr) -> ts        WriteTo (best-effort packets)
+    packet_ch                        PacketCh (queue of Packet)
+    dial_timeout(addr, timeout)      DialTimeout (reliable streams)
+    stream_ch                        StreamCh (queue of Stream)
+    shutdown()                       Shutdown
+
+and whose wire format is memberlist's own: msgType-framed msgpack
+bodies, compound batching, optional stream encryption — all via
+wire/codec.py. The agent literally joins the simulated cluster: its
+packets merge into sim views, sim nodes probe it, its liveness is
+decided by whether it answers, and its Vivaldi coordinate converges
+against the sim's planted latency model.
+
+Seat semantics. Each attached agent claims a **seat** (a node index)
+in the simulated world; ``SimState.external[seat]`` is set so the
+simulation answers probes *to* the seat from ground truth but never
+originates protocol traffic *for* it (models/state.py) — the real
+agent does that itself through this bridge. Concretely, per
+:meth:`PacketBridge.step` (host-side, once per tick — the batched
+host<->device boundary of SURVEY §7, precedent: the reference's 5 s
+coordinate batching, agent/consul/coordinate_endpoint.go:42-53):
+
+  - inbound agent packets are decoded and staged: membership facts
+    join into the receiving seat's device view row (and the agent's
+    own-alive announcements bump ``own_inc[seat]``), so the sim
+    epidemic spreads them;
+  - the agent's announced coordinate is written into the seat's device
+    Vivaldi row, so sim probes of the seat feed on its real coordinate;
+  - sim-side probes of the agent are emitted as real ping packets from
+    neighbor addresses; unanswered probes eventually flip the seat's
+    ground truth to dead, so the sim detects a crashed agent
+    organically (no special-casing);
+  - neighbor gossip is emitted to the agent as compound
+    alive/suspect/dead messages, and push-pull streams answer with the
+    seat's neighborhood state (pushPullHeader/pushNodeState schema,
+    net.go:145-168).
+
+Time and RTT. The bridge runs on **simulated time** (tick *
+tick_ms/1000 seconds). ``write_to`` returns the send timestamp and
+reply packets carry ``timestamp = send + model_rtt`` — exactly the
+Transport contract's RTT mechanism (transport.go:36-43: the timestamps
+exist "to help make accurate RTT measurements during probes"), with
+the RTT drawn from the same planted-world latency model the simulation
+itself uses, so the agent's Vivaldi solves the same geometry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+from typing import Optional
+
+import jax.numpy as jnp
+import msgpack
+import numpy as np
+
+from consul_tpu.ops import merge, topology, vivaldi
+from consul_tpu.wire import codec
+from consul_tpu.wire.codec import MessageType
+from consul_tpu.wire.keyring import Keyring
+
+# memberlist nodeStateType values (state.go:754-760); distinct from the
+# sim's merge-lattice codes, translated at the seam.
+WIRE_ALIVE = 0
+WIRE_SUSPECT = 1
+WIRE_DEAD = 2
+WIRE_LEFT = 3
+
+_TO_WIRE = {merge.ALIVE: WIRE_ALIVE, merge.SUSPECT: WIRE_SUSPECT,
+            merge.DEAD: WIRE_DEAD, merge.LEFT: WIRE_LEFT}
+_FROM_WIRE = {v: k for k, v in _TO_WIRE.items()}
+
+# Protocol version vector (pushNodeState.Vsn, net.go:166: [pmin, pmax,
+# pcur, dmin, dmax, dcur]).
+VSN = [1, 5, 1, 2, 5, 4]
+
+
+def _node_state(seat: int, incarnation: int, state: int) -> dict:
+    """One pushNodeState body (net.go:158-168)."""
+    return {
+        "Name": seat_name(seat), "Addr": seat_name(seat).encode(),
+        "Port": 7946, "Meta": b"", "Incarnation": incarnation,
+        "State": state, "Vsn": list(VSN),
+    }
+
+
+def seat_name(i: int) -> str:
+    return f"sim-{i}"
+
+
+def seat_addr(i: int) -> str:
+    return f"{seat_name(i)}:7946"
+
+
+def addr_to_seat(addr: str) -> int:
+    host = addr.split(":", 1)[0]
+    if not host.startswith("sim-"):
+        raise ValueError(f"not a sim address: {addr!r}")
+    return int(host[4:])
+
+
+def encode_coordinate(vec, height, error, adjustment) -> bytes:
+    """Ping-ack coordinate payload (serf/ping_delegate.go:28-45 encodes
+    the serf coordinate.Coordinate struct as the ack payload)."""
+    return msgpack.packb({
+        "Vec": [float(x) for x in vec], "Error": float(error),
+        "Adjustment": float(adjustment), "Height": float(height),
+    }, use_bin_type=True)
+
+
+def decode_coordinate(payload: bytes) -> Optional[dict]:
+    if not payload:
+        return None
+    return msgpack.unpackb(payload, raw=False)
+
+
+@dataclasses.dataclass
+class Packet:
+    """transport.go:10-22."""
+    buf: bytes
+    from_addr: str
+    timestamp: float  # simulated seconds
+
+
+class Stream:
+    """In-memory reliable bidirectional message stream — the net.Pipe of
+    mock_transport.go:117-120. ``send``/``recv`` move whole frames (the
+    codec's stream framing handles encryption)."""
+
+    def __init__(self):
+        self._a: queue.Queue = queue.Queue()
+        self._b: queue.Queue = queue.Queue()
+        self.closed = False
+
+    def peer(self) -> "Stream":
+        p = Stream.__new__(Stream)
+        p._a, p._b = self._b, self._a
+        p.closed = False
+        return p
+
+    def send(self, frame: bytes):
+        self._a.put(frame)
+
+    def recv(self, timeout: float = 1.0) -> bytes:
+        return self._b.get(timeout=timeout)
+
+    def close(self):
+        self.closed = True
+
+
+class BridgeTransport:
+    """The agent-facing six-method Transport (transport.go:27-65)."""
+
+    def __init__(self, bridge: "PacketBridge", seat: int):
+        self._bridge = bridge
+        self.seat = seat
+        self.addr = seat_addr(seat)
+        self.packet_ch: queue.Queue = queue.Queue()
+        self.stream_ch: queue.Queue = queue.Queue()
+        self.down = False
+
+    def final_advertise_addr(self, ip: str = "", port: int = 0):
+        """FinalAdvertiseAddr: the seat's simulated address wins over
+        any user-configured value (net_transport.go would consult the
+        bound socket here)."""
+        return seat_name(self.seat), 7946
+
+    def write_to(self, buf: bytes, addr: str) -> float:
+        """Best-effort packet send; returns the transmit timestamp (in
+        simulated seconds) for RTT measurement."""
+        if self.down:
+            raise RuntimeError("transport is shut down")
+        now = self._bridge.now()
+        self._bridge._inbound(self.seat, buf, addr, now)
+        return now
+
+    def dial_timeout(self, addr: str, timeout: float = 1.0) -> Stream:
+        if self.down:
+            raise RuntimeError("transport is shut down")
+        return self._bridge._dial(self.seat, addr)
+
+    def shutdown(self):
+        """The agent's process is gone: its seat stops answering and
+        the simulated cluster is left to detect the failure (the
+        reference cluster likewise only learns via SWIM)."""
+        self.down = True
+        self._bridge._agent_down(self.seat)
+
+
+class PacketBridge:
+    """Wires external agents into a running :class:`Simulation`.
+
+    One instance per simulated DC; drive it with ``bridge.step()``
+    after every ``sim`` tick (or use :meth:`run`)."""
+
+    def __init__(self, sim, keyring: Optional[Keyring] = None,
+                 probe_miss_limit: int = 2):
+        self.sim = sim
+        self.keyring = keyring
+        self.probe_miss_limit = probe_miss_limit
+        self.transports: dict[int, BridgeTransport] = {}
+        # Per-seat probe bookkeeping (host-side ints, sim-time ticks).
+        self._next_probe: dict[int, int] = {}
+        self._pending: dict[int, tuple[int, int]] = {}  # seat -> (seq, deadline)
+        self._misses: dict[int, int] = {}
+        self._seq = 0
+        # Staged device writes, applied once per step.
+        self._stage_view: list[tuple[int, int, int]] = []  # (row, col, key)
+        self._stage_inc: dict[int, int] = {}
+        self._stage_coord: dict[int, dict] = {}
+        self._stage_alive: dict[int, bool] = {}
+
+    # ------------------------------------------------------------------
+    # Attachment
+    # ------------------------------------------------------------------
+    def attach(self, seat: int) -> BridgeTransport:
+        """Claim ``seat`` for an external agent. The seat's ground truth
+        becomes alive (the process exists) and ``external`` is set so
+        the sim stops originating protocol traffic for it."""
+        if seat in self.transports:
+            raise ValueError(f"seat {seat} already attached")
+        st = self.sim.state
+        mask = np.zeros(self.sim.cfg.n, bool)
+        mask[seat] = True
+        m = jnp.asarray(mask)
+        self.sim.state = st._replace(
+            external=st.external | m,
+            alive_truth=st.alive_truth | m,
+            left=st.left & ~m,
+        )
+        t = BridgeTransport(self, seat)
+        self.transports[seat] = t
+        self._next_probe[seat] = int(self.sim.state.t) + 1
+        self._misses[seat] = 0
+        return t
+
+    def now(self) -> float:
+        g = self.sim.cfg.gossip
+        return float(int(self.sim.state.t)) * g.tick_ms / 1000.0
+
+    def _model_rtt(self, a: int, b: int) -> float:
+        return float(topology.true_rtt(self.sim.world, a, b))
+
+    # ------------------------------------------------------------------
+    # Inbound: agent -> sim
+    # ------------------------------------------------------------------
+    def _inbound(self, from_seat: int, buf: bytes, addr: str, sent: float):
+        try:
+            to_seat = addr_to_seat(addr)
+        except ValueError:
+            return  # not a sim address: dropped on the floor
+        rtt = self._model_rtt(from_seat, to_seat)
+        if to_seat in self.transports:
+            # Agent-to-agent traffic: a real transport delivers the raw
+            # packet to the peer's PacketCh (mock_transport.go WriteTo);
+            # the bridge must not answer on a live agent's behalf.
+            self._deliver(to_seat, buf, seat_addr(from_seat), sent + rtt)
+            return
+        try:
+            msgs = codec.decode_packet(buf, keyring=self.keyring)
+        except ValueError:
+            return  # undecodable packet: best-effort transport drops it
+        for mtype, body in msgs:
+            try:
+                self._handle_msg(from_seat, to_seat, mtype, body, sent, rtt)
+            except (ValueError, KeyError, TypeError):
+                # Malformed-but-decodable message (bad field, non-sim
+                # target, missing SeqNo): best-effort packets drop, they
+                # never propagate into the agent's send path.
+                continue
+
+    def _handle_msg(self, from_seat, to_seat, mtype, body, sent, rtt):
+        if mtype == MessageType.PING:
+            # Answer on behalf of the sim node, ack payload = its
+            # coordinate (ping_delegate.go:28-45); the ack's timestamp
+            # carries the model RTT (see module docstring).
+            if not bool(self.sim.state.alive_truth[to_seat]) or \
+                    bool(self.sim.state.left[to_seat]):
+                return
+            v = self.sim.state.viv
+            payload = encode_coordinate(
+                np.asarray(v.vec[to_seat]), float(v.height[to_seat]),
+                float(v.error[to_seat]), float(v.adjustment[to_seat]),
+            )
+            ack = codec.encode_message(
+                MessageType.ACK_RESP,
+                {"SeqNo": body["SeqNo"], "Payload": payload},
+            )
+            self._deliver(from_seat, codec.encode_packet([ack]),
+                          seat_addr(to_seat), sent + rtt)
+        elif mtype == MessageType.ACK_RESP:
+            # The agent answered a sim-side probe: alive, and its
+            # payload refreshes the seat's device coordinate.
+            pend = self._pending.get(from_seat)
+            if pend is not None and body["SeqNo"] == pend[0]:
+                del self._pending[from_seat]
+                self._misses[from_seat] = 0
+            coord = decode_coordinate(body.get("Payload", b""))
+            if coord is not None:
+                self._stage_coord[from_seat] = coord
+        elif mtype in (MessageType.ALIVE, MessageType.SUSPECT,
+                       MessageType.DEAD):
+            status = {MessageType.ALIVE: merge.ALIVE,
+                      MessageType.SUSPECT: merge.SUSPECT,
+                      MessageType.DEAD: merge.DEAD}[mtype]
+            self._merge_fact(to_seat, body["Node"],
+                             body["Incarnation"], status)
+            # Mirror the fact into the sender's own seat row too: the
+            # agent would not gossip what it does not believe, and the
+            # seat's device view row is what sim-initiated push-pulls
+            # read as "the agent's state".
+            self._merge_fact(from_seat, body["Node"],
+                             body["Incarnation"], status)
+        elif mtype == MessageType.INDIRECT_PING:
+            # Relay: target reachability from ground truth; ack or nack
+            # back to the requester (net.go handleIndirectPing:491).
+            target = addr_to_seat(bytes(body["Target"]).decode()
+                                  if isinstance(body["Target"], (bytes, bytearray))
+                                  else str(body["Target"]))
+            up = bool(self.sim.state.alive_truth[target]) and \
+                not bool(self.sim.state.left[target])
+            rtt2 = self._model_rtt(to_seat, target)
+            if up:
+                ack = codec.encode_message(
+                    MessageType.ACK_RESP, {"SeqNo": body["SeqNo"],
+                                           "Payload": b""})
+                self._deliver(from_seat, codec.encode_packet([ack]),
+                              seat_addr(to_seat), sent + rtt + 2 * rtt2)
+            elif body.get("Nack"):
+                nack = codec.encode_message(
+                    MessageType.NACK_RESP, {"SeqNo": body["SeqNo"]})
+                self._deliver(from_seat, codec.encode_packet([nack]),
+                              seat_addr(to_seat), sent + rtt + rtt2)
+
+    def _subject_col(self, row: int, subject: int) -> int:
+        """Host-side column lookup (no device chatter per fact)."""
+        topo = self.sim.topo
+        d = (subject - row) % self.sim.cfg.n
+        if d == 0:
+            return topology.SELF
+        if topo.dense:
+            return d - 1
+        off = np.asarray(topo.off)
+        c = int(np.searchsorted(off, d))
+        if c < off.shape[0] and off[c] == d:
+            return c
+        return topology.ABSENT
+
+    def _merge_fact(self, to_seat: int, node: str, inc: int, status: int):
+        """Stage a membership fact into the receiving seat's view row
+        (the receiver-side delivery of a gossiped message)."""
+        try:
+            subject = addr_to_seat(node)
+        except ValueError:
+            return  # fact about a node outside the simulated world
+        if subject in self.transports and status == merge.ALIVE and \
+                node == seat_name(subject):
+            # An agent's own-alive announcement refreshes its seat's
+            # incarnation (aliveNode on self, state.go:868-…).
+            self._stage_inc[subject] = max(
+                self._stage_inc.get(subject, 0), int(inc))
+        col = self._subject_col(to_seat, subject)
+        if col < 0:
+            return  # receiver does not track the subject (partial view)
+        self._stage_view.append(
+            (to_seat, col, merge.make_key_int(inc, status)))
+
+    # ------------------------------------------------------------------
+    # Streams: push-pull (net.go:777-1070)
+    # ------------------------------------------------------------------
+    def _dial(self, from_seat: int, addr: str) -> Stream:
+        to_seat = addr_to_seat(addr)
+        s = Stream()
+        peer = s.peer()
+        # The sim side of the stream is serviced synchronously at the
+        # next step() (streams are "more expensive ... infrequent",
+        # transport.go:50-54).
+        self._pending_streams = getattr(self, "_pending_streams", [])
+        self._pending_streams.append((from_seat, to_seat, peer))
+        return s
+
+    def _serve_stream(self, from_seat: int, to_seat: int, stream: Stream):
+        """Answer one push-pull exchange on the sim side: read the
+        agent's state, stage its merge, reply with the seat's
+        neighborhood state (sendLocalState/mergeRemoteState)."""
+        try:
+            frame = stream.recv(timeout=0.1)
+        except queue.Empty:
+            return
+        try:
+            buf = codec.decode_stream_frame(frame, self.keyring)
+            _, remote, _ = codec.decode_push_pull(buf)
+        except ValueError:
+            return
+        for nstate in remote:
+            self._merge_fact(
+                to_seat, nstate["Name"], nstate["Incarnation"],
+                _FROM_WIRE.get(nstate["State"], merge.SUSPECT),
+            )
+        # Reply: the dialed seat's own fact, plus the *caller's*
+        # neighborhood. The reference replies with its full member map
+        # (net.go:824-860); the sparse plane's equivalent of "the part
+        # of the map the newcomer needs" is the caller's own view row —
+        # the seats it will track, which by offset symmetry are exactly
+        # the seats that track *it*, i.e. the audience its join
+        # announcement must reach. Statuses come from the seat
+        # directory (ground truth + incarnation), the converged
+        # cluster's answer.
+        st = self.sim.state
+        states = [self._push_node_state(to_seat)]
+        topo = self.sim.topo
+        off = np.asarray(topo.off)
+        n = self.sim.cfg.n
+        incs = np.asarray(st.own_inc)
+        up = np.asarray(st.alive_truth & ~st.left)
+        for c in range(topo.degree):
+            j = (from_seat + int(off[c])) % n
+            states.append(_node_state(
+                j, int(incs[j]), WIRE_ALIVE if up[j] else WIRE_DEAD))
+        reply = codec.encode_push_pull(states)
+        stream.send(codec.encode_stream_frame(reply, self.keyring))
+
+    def _push_node_state(self, seat: int) -> dict:
+        st = self.sim.state
+        return _node_state(
+            seat, int(st.own_inc[seat]),
+            WIRE_ALIVE if bool(st.alive_truth[seat]) else WIRE_DEAD)
+
+    # ------------------------------------------------------------------
+    # Outbound: sim -> agent
+    # ------------------------------------------------------------------
+    def _deliver(self, seat: int, buf: bytes, from_addr: str, ts: float):
+        t = self.transports.get(seat)
+        if t is not None and not t.down:
+            t.packet_ch.put(Packet(buf, from_addr, ts))
+
+    def _agent_down(self, seat: int):
+        self._stage_alive[seat] = False
+
+    def _emit_probes_and_gossip(self):
+        """Sim-side traffic toward each attached agent: probes on the
+        seat's probe cadence from a rotating in-neighbor, with the
+        neighbor's hottest facts piggybacked (gossip rides probe
+        packets, net.go:631 piggyback)."""
+        g = self.sim.cfg.gossip
+        t_now = int(self.sim.state.t)
+        topo = self.sim.topo
+        n = self.sim.cfg.n
+        off = np.asarray(topo.off)
+        for seat, tr in list(self.transports.items()):
+            if tr.down:
+                continue
+            # Missed-probe bookkeeping -> seat ground-truth death.
+            pend = self._pending.get(seat)
+            if pend is not None and t_now >= pend[1]:
+                del self._pending[seat]
+                self._misses[seat] = self._misses.get(seat, 0) + 1
+                if self._misses[seat] >= self.probe_miss_limit:
+                    self._stage_alive[seat] = False
+            if t_now < self._next_probe[seat] or pend is not None:
+                continue
+            self._next_probe[seat] = t_now + g.probe_period_ticks
+            # Rotate through in-neighbors as probe sources.
+            c = (t_now // g.probe_period_ticks) % topo.degree
+            src = (seat - int(off[c])) % n
+            if not bool(self.sim.state.alive_truth[src]):
+                continue
+            self._seq += 1
+            self._pending[seat] = (self._seq, t_now + g.probe_timeout_ticks)
+            msgs = [codec.encode_message(
+                MessageType.PING,
+                {"SeqNo": self._seq, "Node": seat_name(seat)})]
+            # Piggyback the source's hottest facts as gossip.
+            src_view = np.asarray(self.sim.state.view_key[src])
+            src_tx = np.asarray(self.sim.state.tx_left[src])
+            hot = np.argsort(-src_tx)[:g.piggyback_msgs]
+            for c2 in hot:
+                if src_tx[c2] <= 0:
+                    continue
+                subj = (src + int(off[c2])) % n
+                key = int(src_view[c2])
+                status = merge.key_status_int(key)
+                mt = {merge.ALIVE: MessageType.ALIVE,
+                      merge.SUSPECT: MessageType.SUSPECT,
+                      merge.DEAD: MessageType.DEAD,
+                      merge.LEFT: MessageType.DEAD}[status]
+                body = {"Incarnation": merge.key_incarnation_int(key),
+                        "Node": seat_name(subj)}
+                if mt != MessageType.ALIVE:
+                    body["From"] = seat_name(src)
+                else:
+                    body.update({"Addr": seat_name(subj).encode(),
+                                 "Port": 7946, "Meta": b"",
+                                 "Vsn": list(VSN)})
+                msgs.append(codec.encode_message(mt, body))
+            rtt = self._model_rtt(src, seat)
+            self._deliver(seat, codec.encode_packet(msgs),
+                          seat_addr(src), self.now() + rtt)
+
+    # ------------------------------------------------------------------
+    # The per-tick host boundary
+    # ------------------------------------------------------------------
+    def step(self):
+        """Process staged traffic both ways; call after each sim tick."""
+        for from_seat, to_seat, stream in getattr(self, "_pending_streams", []):
+            self._serve_stream(from_seat, to_seat, stream)
+        self._pending_streams = []
+        self._emit_probes_and_gossip()
+        self._apply_staged()
+
+    def _apply_staged(self):
+        st = self.sim.state
+        if self._stage_view:
+            rows = jnp.asarray([r for r, _, _ in self._stage_view], jnp.int32)
+            cols = jnp.asarray([c for _, c, _ in self._stage_view], jnp.int32)
+            keys = jnp.asarray([k for _, _, k in self._stage_view], jnp.uint32)
+            old = st.view_key[rows, cols]
+            # Entries the join actually raised re-arm their gossip
+            # budget, exactly as an in-sim delivery would (swim.step's
+            # end-of-tick changed-detection can't see writes staged
+            # between ticks, so the bridge is responsible for queueing
+            # the rebroadcast — queue.go:182-242 semantics).
+            from consul_tpu.ops import scaling
+            tx0 = int(scaling.retransmit_limit(
+                self.sim.cfg.gossip.retransmit_mult, self.sim.cfg.n))
+            changed = keys > old
+            st = st._replace(
+                view_key=st.view_key.at[rows, cols].max(keys),
+                tx_left=st.tx_left.at[rows, cols].max(
+                    jnp.where(changed, tx0, 0)),
+            )
+            self._stage_view = []
+        if self._stage_inc:
+            rows = jnp.asarray(list(self._stage_inc.keys()), jnp.int32)
+            incs = jnp.asarray(list(self._stage_inc.values()), jnp.uint32)
+            st = st._replace(own_inc=st.own_inc.at[rows].max(incs))
+            self._stage_inc = {}
+        if self._stage_coord:
+            v = st.viv
+            vec, h = v.vec, v.height
+            err, adj = v.error, v.adjustment
+            for seat, c in self._stage_coord.items():
+                vec = vec.at[seat].set(jnp.asarray(c["Vec"], jnp.float32))
+                h = h.at[seat].set(c["Height"])
+                err = err.at[seat].set(c["Error"])
+                adj = adj.at[seat].set(c["Adjustment"])
+            st = st._replace(viv=v._replace(vec=vec, height=h,
+                                            error=err, adjustment=adj))
+            self._stage_coord = {}
+        if self._stage_alive:
+            alive = st.alive_truth
+            for seat, up in self._stage_alive.items():
+                alive = alive.at[seat].set(up)
+            st = st._replace(alive_truth=alive)
+            self._stage_alive = {}
+        self.sim.state = st
+
+    def run(self, ticks: int):
+        """Advance sim + bridge together, one tick at a time (the
+        external seam forces tick-granular host sync; pure-sim runs use
+        the chunked scan path in models/cluster.py instead)."""
+        for _ in range(ticks):
+            self.sim.run(1, chunk=1, with_metrics=False)
+            self.step()

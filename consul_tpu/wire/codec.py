@@ -186,6 +186,46 @@ def decode_packet(pkt: bytes,
 
 
 # ----------------------------------------------------------------------
+# Push-pull stream payload (net.go:818-860 sendLocalState): the
+# pushPullMsg type byte, a pushPullHeader, then one pushNodeState body
+# per node, then the raw user state — a *sequence* of msgpack objects,
+# not a single nested document.
+# ----------------------------------------------------------------------
+
+def encode_push_pull(states: list[dict], user_state: bytes = b"",
+                     join: bool = False) -> bytes:
+    out = bytearray([MessageType.PUSH_PULL])
+    out += msgpack.packb(
+        {"Nodes": len(states), "UserStateLen": len(user_state),
+         "Join": join}, use_bin_type=True)
+    for s in states:
+        out += msgpack.packb(s, use_bin_type=True)
+    out += user_state
+    return bytes(out)
+
+
+def decode_push_pull(buf: bytes) -> tuple[dict, list[dict], bytes]:
+    """readRemoteState (net.go:995-1035): returns (header, states,
+    user_state). Any malformation — truncation, wrong shapes, bad
+    msgpack — raises ValueError, so stream handlers need one guard."""
+    if not buf or buf[0] != MessageType.PUSH_PULL:
+        raise ValueError("not a pushPull stream")
+    try:
+        unpacker = msgpack.Unpacker(raw=False)
+        unpacker.feed(buf[1:])
+        header = unpacker.unpack()
+        states = [unpacker.unpack() for _ in range(int(header["Nodes"]))]
+        tail = bytes(buf[1 + unpacker.tell():])
+        if len(tail) < header["UserStateLen"]:
+            raise ValueError("truncated push-pull user state")
+        return header, states, tail[:header["UserStateLen"]]
+    except ValueError:
+        raise
+    except (msgpack.exceptions.UnpackException, TypeError, KeyError) as e:
+        raise ValueError(f"malformed push-pull stream: {e!r}") from e
+
+
+# ----------------------------------------------------------------------
 # Stream (push-pull / TCP) encryption framing. Unlike the packet path,
 # streams DO carry an explicit encryptMsg header:
 #   [encryptMsg byte | u32 big-endian ciphertext length | ciphertext]

@@ -1,0 +1,297 @@
+"""Transport-seam integration: a real (non-simulated, serial Python)
+agent joins a 1k-node simulated cluster through the PacketBridge,
+speaking memberlist's own wire format — msgType-framed msgpack packets
+and push-pull streams — through the six-method transport surface
+(reference transport.go:27-65, modeled on mock_transport.go:12-121).
+
+The agent is deliberately NOT built from the simulation's vectorized
+code: it is a tiny serial memberlist client (its own member map, its
+own scalar Vivaldi state) so the seam is exercised from the outside,
+the way a Go agent would use it."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consul_tpu.config import SimConfig
+from consul_tpu.models import state as sim_state
+from consul_tpu.models.cluster import Simulation
+from consul_tpu.ops import merge, topology, vivaldi
+from consul_tpu.utils import metrics
+from consul_tpu.wire import bridge as bridge_mod
+from consul_tpu.wire import codec
+from consul_tpu.wire.bridge import PacketBridge, seat_addr, seat_name
+from consul_tpu.wire.codec import MessageType
+
+
+class MiniAgent:
+    """A serial memberlist-style client: answers pings, probes members,
+    learns membership from gossip + push-pull, updates a scalar Vivaldi
+    coordinate from probe RTTs (the reference agent's behavior at the
+    scale of one process)."""
+
+    def __init__(self, transport, cfg: SimConfig, incarnation: int = 1,
+                 seed: int = 0):
+        self.t = transport
+        self.cfg = cfg
+        self.name, _ = transport.final_advertise_addr()
+        self.inc = incarnation
+        self.members: dict[str, tuple[int, int]] = {}  # name -> (inc, state)
+        self.viv = vivaldi.new(cfg.vivaldi, batch_shape=())
+        self.pending: dict[int, tuple[float, str]] = {}
+        self.seq = 0
+        self.rng = np.random.default_rng(seed)
+        self.key = jax.random.PRNGKey(seed)
+        self.rtt_log: list[tuple[str, float]] = []
+
+    # -- membership ----------------------------------------------------
+    def _merge(self, name: str, inc: int, state: int):
+        if name == self.name:
+            if state != bridge_mod.WIRE_ALIVE and inc >= self.inc:
+                self.inc = inc + 1  # refute (state.go:840-864)
+            return
+        cur = self.members.get(name)
+        if cur is None or (inc, state) > cur:
+            self.members[name] = (inc, state)
+
+    def alive_members(self):
+        return [n for n, (_, s) in self.members.items()
+                if s == bridge_mod.WIRE_ALIVE]
+
+    # -- join (memberlist.Join -> pushPullNode) ------------------------
+    def start_join(self, addr: str):
+        self._join_stream = self.t.dial_timeout(addr)
+        my_state = {
+            "Name": self.name, "Addr": self.name.encode(), "Port": 7946,
+            "Meta": b"", "Incarnation": self.inc,
+            "State": bridge_mod.WIRE_ALIVE, "Vsn": [1, 5, 1, 2, 5, 4],
+        }
+        self._join_stream.send(codec.encode_stream_frame(
+            codec.encode_push_pull([my_state], join=True), None))
+
+    def finish_join(self):
+        frame = self._join_stream.recv(timeout=2.0)
+        _, states, _ = codec.decode_push_pull(
+            codec.decode_stream_frame(frame, None))
+        for s in states:
+            self._merge(s["Name"], s["Incarnation"], s["State"])
+
+    # -- one protocol tick --------------------------------------------
+    def tick(self, now: float):
+        # Drain incoming packets.
+        while not self.t.packet_ch.empty():
+            pkt = self.t.packet_ch.get()
+            for mtype, body in codec.decode_packet(pkt.buf):
+                self._handle(mtype, body, pkt)
+        # Garbage-collect expired probes.
+        timeout_s = self.cfg.gossip.probe_timeout_ms / 1000.0
+        for seq in [s for s, (ts, _) in self.pending.items()
+                    if now - ts > 4 * timeout_s]:
+            del self.pending[seq]
+        # Probe one random alive member per probe interval.
+        period_s = self.cfg.gossip.probe_interval_ms / 1000.0
+        if not hasattr(self, "_next_probe"):
+            self._next_probe = now
+        if now >= self._next_probe:
+            self._next_probe = now + period_s
+            alive = self.alive_members()
+            if alive:
+                peer = alive[self.rng.integers(len(alive))]
+                self.seq += 1
+                ping = codec.encode_message(
+                    MessageType.PING, {"SeqNo": self.seq, "Node": peer})
+                ts = self.t.write_to(codec.encode_packet([ping]),
+                                     peer + ":7946")
+                self.pending[self.seq] = (ts, peer)
+            # Gossip own aliveness to a few random members (the join
+            # announcement's continued dissemination).
+            for _ in range(self.cfg.gossip.gossip_nodes):
+                targets = alive or []
+                if not targets:
+                    break
+                tgt = targets[self.rng.integers(len(targets))]
+                alive_msg = codec.encode_message(MessageType.ALIVE, {
+                    "Incarnation": self.inc, "Node": self.name,
+                    "Addr": self.name.encode(), "Port": 7946,
+                    "Meta": b"", "Vsn": [1, 5, 1, 2, 5, 4],
+                })
+                self.t.write_to(codec.encode_packet([alive_msg]),
+                                tgt + ":7946")
+
+    def _handle(self, mtype, body, pkt):
+        if mtype == MessageType.PING:
+            payload = bridge_mod.encode_coordinate(
+                np.asarray(self.viv.vec), float(self.viv.height),
+                float(self.viv.error), float(self.viv.adjustment))
+            ack = codec.encode_message(
+                MessageType.ACK_RESP,
+                {"SeqNo": body["SeqNo"], "Payload": payload})
+            self.t.write_to(codec.encode_packet([ack]), pkt.from_addr)
+        elif mtype == MessageType.ACK_RESP:
+            pend = self.pending.pop(body["SeqNo"], None)
+            if pend is None:
+                return
+            sent_ts, peer = pend
+            rtt = pkt.timestamp - sent_ts
+            coord = bridge_mod.decode_coordinate(body.get("Payload", b""))
+            if coord is None or rtt <= 0:
+                return
+            self.rtt_log.append((peer, rtt))
+            self.key, sub = jax.random.split(self.key)
+            self.viv = vivaldi.update(
+                self.cfg.vivaldi, self.viv,
+                jnp.asarray(coord["Vec"], jnp.float32),
+                jnp.float32(coord["Height"]), jnp.float32(coord["Error"]),
+                jnp.float32(coord["Adjustment"]), jnp.float32(rtt), sub)
+        elif mtype == MessageType.ALIVE:
+            self._merge(body["Node"], body["Incarnation"],
+                        bridge_mod.WIRE_ALIVE)
+        elif mtype == MessageType.SUSPECT:
+            self._merge(body["Node"], body["Incarnation"],
+                        bridge_mod.WIRE_SUSPECT)
+        elif mtype == MessageType.DEAD:
+            self._merge(body["Node"], body["Incarnation"],
+                        bridge_mod.WIRE_DEAD)
+
+
+# ----------------------------------------------------------------------
+# Helpers
+# ----------------------------------------------------------------------
+
+N = 1024
+SEAT = 500
+
+
+def in_neighbor_beliefs(sim, seat):
+    """Each in-neighbor's belief about ``seat``: list of (inc, status)."""
+    topo = sim.topo
+    off = np.asarray(topo.off)
+    view = np.asarray(sim.state.view_key)
+    out = []
+    for j in range(topo.degree):
+        r = (seat - int(off[j])) % sim.cfg.n
+        # seat sits at column c of r's view where r + off[c] == seat.
+        c = int(np.searchsorted(off, (seat - r) % sim.cfg.n))
+        key = int(view[r, c])
+        out.append((r, key >> 2, key & 3))
+    return out
+
+
+@pytest.fixture(scope="module")
+def joined_world():
+    """A 1k sparse cluster that detected seat 500's death, then an
+    external agent attached at that seat rejoining through the bridge."""
+    cfg = SimConfig(n=N, view_degree=32)
+    sim = Simulation(cfg, seed=5)
+    sim.run(64, with_metrics=False)
+    sim.kill(jnp.arange(N) == SEAT)
+    ok, _, _ = sim.run_until_converged(max_ticks=1024, chunk=128)
+    assert ok, "cluster failed to detect the seat's death"
+
+    br = PacketBridge(sim)
+    tr = br.attach(SEAT)
+    agent = MiniAgent(tr, cfg, incarnation=2, seed=3)
+    agent.start_join(seat_addr((SEAT + 1) % N))
+    sim.run(1, chunk=1, with_metrics=False)
+    br.step()
+    agent.finish_join()
+    for _ in range(400):
+        sim.run(1, chunk=1, with_metrics=False)
+        br.step()
+        agent.tick(br.now())
+    return cfg, sim, br, tr, agent
+
+
+class TestBridgeJoin:
+    def test_agent_learned_membership(self, joined_world):
+        cfg, sim, br, tr, agent = joined_world
+        # Push-pull taught it the dialed seat's whole neighborhood.
+        assert len(agent.alive_members()) >= sim.topo.degree
+
+    def test_agent_alive_in_sim_views(self, joined_world):
+        cfg, sim, br, tr, agent = joined_world
+        beliefs = in_neighbor_beliefs(sim, SEAT)
+        live = [b for b in beliefs if bool(sim.state.alive_truth[b[0]])]
+        assert live, "no live in-neighbors"
+        assert all(st == merge.ALIVE and inc >= 2 for _, inc, st in live), \
+            f"agent not believed alive everywhere: {beliefs}"
+
+    def test_cluster_stays_healthy_with_external_seat(self, joined_world):
+        cfg, sim, br, tr, agent = joined_world
+        h = metrics.health(cfg, sim.topo, sim.state)
+        assert float(h.false_positive) == 0.0
+        assert float(h.undetected) == 0.0
+
+    def test_agent_vivaldi_converges(self, joined_world):
+        cfg, sim, br, tr, agent = joined_world
+        assert len(agent.rtt_log) >= 30, "agent observed too few RTTs"
+        # The agent's estimated distance to each probed peer must track
+        # the planted ground truth (the north-star RMSE, at one node's
+        # scale).
+        errs = []
+        for peer, _ in agent.rtt_log[-40:]:
+            j = int(peer.split("-")[1])
+            est = float(vivaldi.distance(
+                agent.viv.vec, agent.viv.height, agent.viv.adjustment,
+                sim.state.viv.vec[j], sim.state.viv.height[j],
+                sim.state.viv.adjustment[j]))
+            true = float(topology.true_rtt(sim.world, SEAT, j))
+            errs.append(est - true)
+        rmse = float(np.sqrt(np.mean(np.square(errs))))
+        assert rmse < 0.015, f"agent coordinate RMSE {rmse*1000:.1f} ms"
+
+    def test_agent_coordinate_mirrored_into_sim(self, joined_world):
+        cfg, sim, br, tr, agent = joined_world
+        # The seat's device Vivaldi row tracks the agent's announced
+        # coordinate (so sim probes of the seat feed on it). The mirror
+        # lags by up to a probe period, so compare with a small
+        # tolerance, and make sure it is not still the origin.
+        mirror = np.asarray(sim.state.viv.vec[SEAT])
+        mine = np.asarray(agent.viv.vec)
+        assert np.linalg.norm(mine) > 0, "agent never moved its coordinate"
+        assert np.linalg.norm(mirror) > 0, "coordinate never mirrored"
+        assert np.linalg.norm(mirror - mine) < 0.005  # within 5 ms drift
+
+    def test_shutdown_detected_as_failure(self, joined_world):
+        cfg, sim, br, tr, agent = joined_world
+        tr.shutdown()
+        for _ in range(8):
+            sim.run(1, chunk=1, with_metrics=False)
+            br.step()
+        assert not bool(sim.state.alive_truth[SEAT])
+        ok, _, _ = sim.run_until_converged(max_ticks=1024, chunk=128)
+        assert ok
+        beliefs = in_neighbor_beliefs(sim, SEAT)
+        live = [b for b in beliefs if bool(sim.state.alive_truth[b[0]])]
+        assert all(st in (merge.DEAD, merge.LEFT) for _, _, st in live)
+
+
+class TestWireDetails:
+    def test_packet_bridge_drops_garbage(self):
+        cfg = SimConfig(n=64, view_degree=16)
+        sim = Simulation(cfg, seed=1)
+        br = PacketBridge(sim)
+        tr = br.attach(3)
+        tr.write_to(b"\xff\xfe garbage", seat_addr(5))
+        tr.write_to(b"", seat_addr(5))
+        br.step()  # must not raise
+
+    def test_shutdown_transport_refuses_io(self):
+        cfg = SimConfig(n=64, view_degree=16)
+        sim = Simulation(cfg, seed=1)
+        br = PacketBridge(sim)
+        tr = br.attach(3)
+        tr.shutdown()
+        with pytest.raises(RuntimeError):
+            tr.write_to(b"x", seat_addr(5))
+        with pytest.raises(RuntimeError):
+            tr.dial_timeout(seat_addr(5))
+
+    def test_attach_twice_rejected(self):
+        cfg = SimConfig(n=64, view_degree=16)
+        sim = Simulation(cfg, seed=1)
+        br = PacketBridge(sim)
+        br.attach(3)
+        with pytest.raises(ValueError):
+            br.attach(3)

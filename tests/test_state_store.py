@@ -265,3 +265,27 @@ class TestTxnVisibility:
         assert result["ok"] is True
         assert not th.is_alive()
         assert got and got[0][1]["value"] == b"v"
+
+    def test_rolled_back_txn_never_regresses_indexes(self):
+        """Rollback must not lower the visibility index: a deletion
+        leaves no surviving row carrying the table's max index, so a
+        rows-recompute on restore would send X-Consul-Index backwards
+        for long-pollers."""
+        from consul_tpu.server import fsm as fsm_mod
+
+        fsm = fsm_mod.FSM()
+        store = fsm.store
+        fsm.apply(5, {"type": fsm_mod.KV, "op": "set", "key": "k",
+                      "value": b"v"})
+        fsm.apply(10, {"type": fsm_mod.KV, "op": "delete", "key": "k"})
+        assert store.tables["kv"].max_index == 10
+        result = fsm.apply(11, {
+            "type": fsm_mod.TXN, "ops": [
+                {"type": fsm_mod.KV, "op": "set", "key": "a", "value": b"x"},
+                {"type": fsm_mod.KV, "op": "lock", "key": "b",
+                 "value": b"y", "session": "nope"},
+            ],
+        })
+        assert result["ok"] is False
+        assert store.tables["kv"].max_index == 10, "index went backwards"
+        assert store.index >= 10
