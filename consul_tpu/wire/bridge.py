@@ -90,7 +90,7 @@ def _node_state(seat: int, incarnation: int, state: int) -> dict:
     return {
         "Name": seat_name(seat), "Addr": seat_name(seat).encode(),
         "Port": 7946, "Meta": b"", "Incarnation": incarnation,
-        "State": state, "Vsn": list(VSN),
+        "State": state, "Vsn": bytes(VSN),
     }
 
 
@@ -112,16 +112,21 @@ def addr_to_seat(addr: str) -> int:
 def encode_coordinate(vec, height, error, adjustment) -> bytes:
     """Ping-ack coordinate payload (serf/ping_delegate.go:28-45 encodes
     the serf coordinate.Coordinate struct as the ack payload)."""
-    return msgpack.packb({
+    return codec._pack_go({
         "Vec": [float(x) for x in vec], "Error": float(error),
         "Adjustment": float(adjustment), "Height": float(height),
-    }, use_bin_type=True)
+    })
 
 
 def decode_coordinate(payload: bytes) -> Optional[dict]:
     if not payload:
         return None
-    return msgpack.unpackb(payload, raw=False)
+    if isinstance(payload, str):
+        # decode_message maps legacy-raw to str via surrogateescape;
+        # recover the original payload bytes the same way.
+        payload = payload.encode("utf-8", "surrogateescape")
+    return msgpack.unpackb(payload, raw=False,
+                           unicode_errors="surrogateescape")
 
 
 @dataclasses.dataclass
@@ -219,6 +224,11 @@ class PacketBridge:
         self._stage_inc: dict[int, int] = {}
         self._stage_coord: dict[int, dict] = {}
         self._stage_alive: dict[int, bool] = {}
+        # Streams dialed but not yet answered: (from, to, stream,
+        # deadline_tick).
+        self._pending_streams: list = []
+        # Host-side copy of the offset table (no per-fact transfers).
+        self._off = np.asarray(sim.topo.off)
 
     # ------------------------------------------------------------------
     # Attachment
@@ -251,14 +261,24 @@ class PacketBridge:
     def _model_rtt(self, a: int, b: int) -> float:
         return float(topology.true_rtt(self.sim.world, a, b))
 
+    def _seat_of(self, addr_or_name: str) -> int:
+        """Parse and range-check a sim address; raises ValueError for
+        seats outside the world (a real probe of a nonexistent address
+        times out — it must never alias onto a live node via gather
+        clamping or modulo wrap)."""
+        seat = addr_to_seat(addr_or_name)
+        if not 0 <= seat < self.sim.cfg.n:
+            raise ValueError(f"seat {seat} outside world of {self.sim.cfg.n}")
+        return seat
+
     # ------------------------------------------------------------------
     # Inbound: agent -> sim
     # ------------------------------------------------------------------
     def _inbound(self, from_seat: int, buf: bytes, addr: str, sent: float):
         try:
-            to_seat = addr_to_seat(addr)
+            to_seat = self._seat_of(addr)
         except ValueError:
-            return  # not a sim address: dropped on the floor
+            return  # not a sim address / out of range: dropped
         rtt = self._model_rtt(from_seat, to_seat)
         if to_seat in self.transports:
             # Agent-to-agent traffic: a real transport delivers the raw
@@ -324,9 +344,10 @@ class PacketBridge:
         elif mtype == MessageType.INDIRECT_PING:
             # Relay: target reachability from ground truth; ack or nack
             # back to the requester (net.go handleIndirectPing:491).
-            target = addr_to_seat(bytes(body["Target"]).decode()
-                                  if isinstance(body["Target"], (bytes, bytearray))
-                                  else str(body["Target"]))
+            raw_t = body["Target"]
+            target = self._seat_of(
+                codec.as_bytes(raw_t).decode("utf-8", "surrogateescape")
+                if not isinstance(raw_t, str) else raw_t)
             up = bool(self.sim.state.alive_truth[target]) and \
                 not bool(self.sim.state.left[target])
             rtt2 = self._model_rtt(to_seat, target)
@@ -335,7 +356,7 @@ class PacketBridge:
                     MessageType.ACK_RESP, {"SeqNo": body["SeqNo"],
                                            "Payload": b""})
                 self._deliver(from_seat, codec.encode_packet([ack]),
-                              seat_addr(to_seat), sent + rtt + 2 * rtt2)
+                              seat_addr(to_seat), sent + rtt + rtt2)
             elif body.get("Nack"):
                 nack = codec.encode_message(
                     MessageType.NACK_RESP, {"SeqNo": body["SeqNo"]})
@@ -350,7 +371,7 @@ class PacketBridge:
             return topology.SELF
         if topo.dense:
             return d - 1
-        off = np.asarray(topo.off)
+        off = self._off
         c = int(np.searchsorted(off, d))
         if c < off.shape[0] and off[c] == d:
             return c
@@ -360,7 +381,7 @@ class PacketBridge:
         """Stage a membership fact into the receiving seat's view row
         (the receiver-side delivery of a gossiped message)."""
         try:
-            subject = addr_to_seat(node)
+            subject = self._seat_of(node)
         except ValueError:
             return  # fact about a node outside the simulated world
         if subject in self.transports and status == merge.ALIVE and \
@@ -379,29 +400,38 @@ class PacketBridge:
     # Streams: push-pull (net.go:777-1070)
     # ------------------------------------------------------------------
     def _dial(self, from_seat: int, addr: str) -> Stream:
-        to_seat = addr_to_seat(addr)
+        to_seat = self._seat_of(addr)
         s = Stream()
         peer = s.peer()
-        # The sim side of the stream is serviced synchronously at the
-        # next step() (streams are "more expensive ... infrequent",
-        # transport.go:50-54).
-        self._pending_streams = getattr(self, "_pending_streams", [])
-        self._pending_streams.append((from_seat, to_seat, peer))
+        if to_seat in self.transports:
+            # Dialing another attached agent: the stream goes to that
+            # agent's StreamCh — the bridge never answers on a live
+            # agent's behalf (same invariant as the packet path).
+            self.transports[to_seat].stream_ch.put(peer)
+            return s
+        # The sim side of the stream is serviced at step() once the
+        # caller's frame arrives (streams are "more expensive ...
+        # infrequent", transport.go:50-54); unanswered dials expire
+        # after a generous window.
+        deadline = int(self.sim.state.t) + 50
+        self._pending_streams.append((from_seat, to_seat, peer, deadline))
         return s
 
     def _serve_stream(self, from_seat: int, to_seat: int, stream: Stream):
         """Answer one push-pull exchange on the sim side: read the
         agent's state, stage its merge, reply with the seat's
-        neighborhood state (sendLocalState/mergeRemoteState)."""
+        neighborhood state (sendLocalState/mergeRemoteState).
+        Returns True when the exchange completed (or was malformed),
+        False when the caller's frame has not arrived yet."""
         try:
-            frame = stream.recv(timeout=0.1)
+            frame = stream.recv(timeout=0)
         except queue.Empty:
-            return
+            return False
         try:
             buf = codec.decode_stream_frame(frame, self.keyring)
             _, remote, _ = codec.decode_push_pull(buf)
         except ValueError:
-            return
+            return True  # malformed: consumed, no reply
         for nstate in remote:
             self._merge_fact(
                 to_seat, nstate["Name"], nstate["Incarnation"],
@@ -419,7 +449,7 @@ class PacketBridge:
         st = self.sim.state
         states = [self._push_node_state(to_seat)]
         topo = self.sim.topo
-        off = np.asarray(topo.off)
+        off = self._off
         n = self.sim.cfg.n
         incs = np.asarray(st.own_inc)
         up = np.asarray(st.alive_truth & ~st.left)
@@ -429,12 +459,17 @@ class PacketBridge:
                 j, int(incs[j]), WIRE_ALIVE if up[j] else WIRE_DEAD))
         reply = codec.encode_push_pull(states)
         stream.send(codec.encode_stream_frame(reply, self.keyring))
+        return True
 
     def _push_node_state(self, seat: int) -> dict:
         st = self.sim.state
-        return _node_state(
-            seat, int(st.own_inc[seat]),
-            WIRE_ALIVE if bool(st.alive_truth[seat]) else WIRE_DEAD)
+        if bool(st.left[seat]):
+            wire = WIRE_LEFT
+        elif bool(st.alive_truth[seat]):
+            wire = WIRE_ALIVE
+        else:
+            wire = WIRE_DEAD
+        return _node_state(seat, int(st.own_inc[seat]), wire)
 
     # ------------------------------------------------------------------
     # Outbound: sim -> agent
@@ -456,7 +491,7 @@ class PacketBridge:
         t_now = int(self.sim.state.t)
         topo = self.sim.topo
         n = self.sim.cfg.n
-        off = np.asarray(topo.off)
+        off = self._off
         for seat, tr in list(self.transports.items()):
             if tr.down:
                 continue
@@ -501,7 +536,7 @@ class PacketBridge:
                 else:
                     body.update({"Addr": seat_name(subj).encode(),
                                  "Port": 7946, "Meta": b"",
-                                 "Vsn": list(VSN)})
+                                 "Vsn": bytes(VSN)})
                 msgs.append(codec.encode_message(mt, body))
             rtt = self._model_rtt(src, seat)
             self._deliver(seat, codec.encode_packet(msgs),
@@ -512,9 +547,13 @@ class PacketBridge:
     # ------------------------------------------------------------------
     def step(self):
         """Process staged traffic both ways; call after each sim tick."""
-        for from_seat, to_seat, stream in getattr(self, "_pending_streams", []):
-            self._serve_stream(from_seat, to_seat, stream)
-        self._pending_streams = []
+        t_now = int(self.sim.state.t)
+        still = []
+        for from_seat, to_seat, stream, deadline in self._pending_streams:
+            if not self._serve_stream(from_seat, to_seat, stream) \
+                    and t_now < deadline:
+                still.append((from_seat, to_seat, stream, deadline))
+        self._pending_streams = still
         self._emit_probes_and_gossip()
         self._apply_staged()
 

@@ -64,20 +64,47 @@ _FIELDS = {
 }
 
 
+def _pack_go(obj) -> bytes:
+    """msgpack bytes in hashicorp/go-msgpack's default encoding
+    (``codec.MsgpackHandle{}``, WriteExt=false): struct maps carry
+    their keys in ALPHABETICAL order (the codec sorts struct fields by
+    encoded name — go-msgpack codec/helper.go sfi "sorted. Used when
+    enc/dec struct to map"), and strings/bytes use the legacy raw
+    family (fixraw / raw16 / raw32 — no str8, no bin), which
+    ``use_bin_type=False`` reproduces exactly."""
+    if isinstance(obj, dict):
+        obj = {k: obj[k] for k in sorted(obj)}
+    return msgpack.packb(obj, use_bin_type=False)
+
+
 def encode_message(mtype: MessageType, body: dict) -> bytes:
-    """``[msgType | msgpack(body)]`` (net.go encode :1098-1104)."""
+    """``[msgType | msgpack(body)]`` (net.go encode :1098-1104),
+    byte-compatible with go-msgpack framing (see :func:`_pack_go`)."""
     allowed = _FIELDS.get(MessageType(mtype))
     if allowed is not None:
         unknown = set(body) - set(allowed)
         if unknown:
             raise ValueError(f"unknown fields for {mtype!r}: {sorted(unknown)}")
-    return bytes([mtype]) + msgpack.packb(body, use_bin_type=True)
+    return bytes([mtype]) + _pack_go(body)
+
+
+def as_bytes(field) -> bytes:
+    """Recover raw bytes from a decoded legacy-raw field: the decoder
+    maps old-format raw to str via surrogateescape (see
+    :func:`decode_message`); the same handler inverts losslessly."""
+    if isinstance(field, str):
+        return field.encode("utf-8", "surrogateescape")
+    return bytes(field)
 
 
 def decode_message(buf: bytes) -> tuple[MessageType, dict]:
     if not buf:
         raise ValueError("empty message")
-    return MessageType(buf[0]), msgpack.unpackb(buf[1:], raw=False)
+    # Legacy-raw fields (Addr, Meta, Payload) hold arbitrary bytes that
+    # are not necessarily UTF-8; surrogateescape keeps them lossless
+    # (re-encode with the same handler to recover the bytes).
+    return MessageType(buf[0]), msgpack.unpackb(
+        buf[1:], raw=False, unicode_errors="surrogateescape")
 
 
 # ----------------------------------------------------------------------
@@ -134,9 +161,7 @@ def encode_packet(msgs: list[bytes], *, compress: bool = False,
     *stream* path — see :func:`encode_stream_frame`.)"""
     pkt = msgs[0] if len(msgs) == 1 else make_compound(msgs)
     if compress:
-        body = msgpack.packb(
-            {"Algo": LZW_ALGO, "Buf": lzw.compress(pkt)}, use_bin_type=True
-        )
+        body = _pack_go({"Algo": LZW_ALGO, "Buf": lzw.compress(pkt)})
         pkt = bytes([MessageType.COMPRESS]) + body
     if crc:
         digest = zlib.crc32(pkt) & 0xFFFFFFFF
@@ -176,10 +201,11 @@ def decode_packet(pkt: bytes,
         if got != want:
             raise ValueError(f"packet CRC mismatch ({got:#x} != {want:#x})")
     if pkt and pkt[0] == MessageType.COMPRESS:
-        body = msgpack.unpackb(pkt[1:], raw=False)
+        body = msgpack.unpackb(pkt[1:], raw=False,
+                               unicode_errors="surrogateescape")
         if body["Algo"] != LZW_ALGO:
             raise ValueError(f"unknown compression algo {body['Algo']}")
-        pkt = lzw.decompress(body["Buf"])
+        pkt = lzw.decompress(as_bytes(body["Buf"]))
     if pkt and pkt[0] == MessageType.COMPOUND:
         return [decode_message(part) for part in split_compound(pkt[1:])]
     return [decode_message(pkt)]
@@ -195,11 +221,11 @@ def decode_packet(pkt: bytes,
 def encode_push_pull(states: list[dict], user_state: bytes = b"",
                      join: bool = False) -> bytes:
     out = bytearray([MessageType.PUSH_PULL])
-    out += msgpack.packb(
+    out += _pack_go(
         {"Nodes": len(states), "UserStateLen": len(user_state),
-         "Join": join}, use_bin_type=True)
+         "Join": join})
     for s in states:
-        out += msgpack.packb(s, use_bin_type=True)
+        out += _pack_go(s)
     out += user_state
     return bytes(out)
 
@@ -211,7 +237,8 @@ def decode_push_pull(buf: bytes) -> tuple[dict, list[dict], bytes]:
     if not buf or buf[0] != MessageType.PUSH_PULL:
         raise ValueError("not a pushPull stream")
     try:
-        unpacker = msgpack.Unpacker(raw=False)
+        unpacker = msgpack.Unpacker(raw=False,
+                                    unicode_errors="surrogateescape")
         unpacker.feed(buf[1:])
         header = unpacker.unpack()
         states = [unpacker.unpack() for _ in range(int(header["Nodes"]))]

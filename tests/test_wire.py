@@ -68,10 +68,17 @@ class TestMessages:
         assert body == {"SeqNo": 42, "Node": "n1"}
 
     def test_alive_with_binary_fields(self):
+        # Binary fields ride the legacy raw family (go-msgpack
+        # WriteExt=false has no bin type), so the decoder surfaces them
+        # as surrogateescape str; as_bytes() recovers them losslessly —
+        # including non-UTF-8 contents like raw IPs.
+        from consul_tpu.wire.codec import as_bytes
         body = {"Incarnation": 7, "Node": "n2", "Addr": bytes([10, 0, 0, 2]),
-                "Port": 8301, "Meta": b"\x01\x02", "Vsn": [1, 5, 2, 2, 5, 4]}
+                "Port": 8301, "Meta": b"\xff\x02", "Vsn": [1, 5, 2, 2, 5, 4]}
         mtype, out = decode_message(encode_message(MessageType.ALIVE, body))
-        assert out == body
+        assert as_bytes(out["Addr"]) == body["Addr"]
+        assert as_bytes(out["Meta"]) == body["Meta"]
+        assert out["Incarnation"] == 7 and out["Port"] == 8301
 
     def test_unknown_field_rejected(self):
         with pytest.raises(ValueError, match="unknown fields"):
@@ -219,3 +226,121 @@ class TestKeyring:
         assert ring.decrypt(pkt, aad=b"header") == b"msg"
         with pytest.raises(ValueError):
             ring.decrypt(pkt, aad=b"tampered")
+
+
+class TestGoldenFixtures:
+    """Byte-for-byte fixtures derived BY HAND from the reference wire
+    spec — go-msgpack default handle (codec.MsgpackHandle{}): struct
+    fields as a map in alphabetical key order, legacy raw string family
+    (fixraw < 32, raw16 >= 32), minimal integers — framed per
+    net.go:46-59 / util.go:157-217. These pin the exact bytes a real
+    memberlist agent would emit/accept, independent of our encoder."""
+
+    def test_ping_bytes(self):
+        # ping{SeqNo: 1, Node: "a"} -> keys sorted: Node, SeqNo
+        want = bytes([
+            0x00,                    # pingMsg
+            0x82,                    # fixmap(2)
+            0xA4]) + b"Node" + bytes([0xA1]) + b"a" + \
+            bytes([0xA5]) + b"SeqNo" + bytes([0x01])
+        got = encode_message(MessageType.PING, {"SeqNo": 1, "Node": "a"})
+        assert got == want, f"{got.hex()} != {want.hex()}"
+
+    def test_ack_bytes_with_payload(self):
+        # ackResp{SeqNo: 300, Payload: 0xDEAD} -> keys: Payload, SeqNo;
+        # 300 needs uint16 (0xcd); bytes -> legacy fixraw.
+        want = bytes([
+            0x02, 0x82,
+            0xA7]) + b"Payload" + bytes([0xA2, 0xDE, 0xAD]) + \
+            bytes([0xA5]) + b"SeqNo" + bytes([0xCD, 0x01, 0x2C])
+        got = encode_message(MessageType.ACK_RESP,
+                             {"SeqNo": 300, "Payload": b"\xde\xad"})
+        assert got == want, f"{got.hex()} != {want.hex()}"
+
+    def test_suspect_bytes(self):
+        # suspect{Incarnation: 7, Node: "b", From: "a"} -> From,
+        # Incarnation, Node.
+        want = bytes([0x03, 0x83,
+                      0xA4]) + b"From" + bytes([0xA1]) + b"a" + \
+            bytes([0xAB]) + b"Incarnation" + bytes([0x07]) + \
+            bytes([0xA4]) + b"Node" + bytes([0xA1]) + b"b"
+        got = encode_message(
+            MessageType.SUSPECT,
+            {"Incarnation": 7, "Node": "b", "From": "a"})
+        assert got == want, f"{got.hex()} != {want.hex()}"
+
+    def test_alive_bytes(self):
+        # alive{Incarnation: 2, Node: "n", Addr: [10,0,0,1], Port: 7946,
+        # Meta: "", Vsn: [1,5,1,2,5,4]} -> Addr, Incarnation, Meta,
+        # Node, Port, Vsn. Addr/Meta/Vsn are []byte in Go -> legacy raw.
+        want = bytes([0x04, 0x86,
+                      0xA4]) + b"Addr" + bytes([0xA4, 10, 0, 0, 1]) + \
+            bytes([0xAB]) + b"Incarnation" + bytes([0x02]) + \
+            bytes([0xA4]) + b"Meta" + bytes([0xA0]) + \
+            bytes([0xA4]) + b"Node" + bytes([0xA1]) + b"n" + \
+            bytes([0xA4]) + b"Port" + bytes([0xCD, 0x1F, 0x0A]) + \
+            bytes([0xA3]) + b"Vsn" + bytes([0xA6, 1, 5, 1, 2, 5, 4])
+        got = encode_message(MessageType.ALIVE, {
+            "Incarnation": 2, "Node": "n", "Addr": bytes([10, 0, 0, 1]),
+            "Port": 7946, "Meta": b"", "Vsn": bytes([1, 5, 1, 2, 5, 4]),
+        })
+        assert got == want, f"{got.hex()} != {want.hex()}"
+
+    def test_compound_bytes(self):
+        # [compoundMsg | count | u16 big-endian lengths | bodies]
+        # (util.go:157-217).
+        p1 = encode_message(MessageType.PING, {"SeqNo": 1, "Node": "a"})
+        p2 = encode_message(MessageType.NACK_RESP, {"SeqNo": 2})
+        want = bytes([0x07, 0x02]) + \
+            len(p1).to_bytes(2, "big") + len(p2).to_bytes(2, "big") + p1 + p2
+        assert make_compound([p1, p2]) == want
+        assert split_compound(want[1:]) == [p1, p2]
+
+    def test_nack_bytes(self):
+        want = bytes([0x0B, 0x81, 0xA5]) + b"SeqNo" + bytes([0x05])
+        assert encode_message(MessageType.NACK_RESP, {"SeqNo": 5}) == want
+
+    def test_crc_framing_bytes(self):
+        # [hasCrcMsg | crc32-IEEE big-endian | body] (net.go:329-339).
+        import zlib as _z
+        body = encode_message(MessageType.NACK_RESP, {"SeqNo": 5})
+        pkt = encode_packet([body], crc=True)
+        assert pkt[0] == 0x0C
+        assert pkt[1:5] == (_z.crc32(body) & 0xFFFFFFFF).to_bytes(4, "big")
+        assert pkt[5:] == body
+
+    def test_compress_envelope_bytes(self):
+        # compress{Algo: 0, Buf: lzw(...)}: keys Algo, Buf; envelope
+        # byte 0x09 (util.go:221-243). The LZW bytes themselves are
+        # covered by TestLZW's cross-checks.
+        body = encode_message(MessageType.NACK_RESP, {"SeqNo": 5})
+        pkt = encode_packet([body], compress=True)
+        assert pkt[0] == 0x09
+        assert pkt[1] == 0x82                       # fixmap(2)
+        assert pkt[2:7] == bytes([0xA4]) + b"Algo"  # first key
+        assert pkt[7] == 0x00                       # lzwAlgo
+        assert pkt[8:12] == bytes([0xA3]) + b"Buf"
+        assert decode_packet(pkt)[0][1]["SeqNo"] == 5
+
+    def test_long_string_uses_raw16_not_str8(self):
+        # go-msgpack with WriteExt=false has no str8: a 100-char name
+        # must use raw16 (0xda) (codec/msgpack.go:241 gate).
+        name = "x" * 100
+        got = encode_message(MessageType.NACK_RESP | 0, {"SeqNo": 1})
+        from consul_tpu.wire.codec import _pack_go
+        packed = _pack_go({"Node": name, "SeqNo": 1})
+        i = packed.index(b"Node") + 4
+        assert packed[i] == 0xDA, f"str8/bin leaked: {packed[i]:#x}"
+
+    def test_encrypted_packet_layout(self):
+        # [vsn=1 | nonce(12) | ciphertext+tag(16)], no prefix byte, no
+        # AAD (security.go:90-116 encryptPayload, net.go:697-708).
+        ring = Keyring(primary=bytes(range(16)))
+        body = encode_message(MessageType.NACK_RESP, {"SeqNo": 5})
+        pkt = encode_packet([body], keyring=ring)
+        assert pkt[0] == 1
+        assert len(pkt) == 1 + 12 + len(body) + 16
+        # Independent decrypt with the raw key proves the layout.
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        plain = AESGCM(bytes(range(16))).decrypt(pkt[1:13], pkt[13:], None)
+        assert plain == body
