@@ -1,0 +1,242 @@
+"""Serf gossip snapshot: append-only member-event log + replay rejoin.
+
+Mirrors the reference Snapshotter (reference serf/snapshot.go:59-431):
+each agent streams its membership events and Lamport clock values to an
+append-only file in the reference's exact line format —
+
+    alive: <name> <addr>\\n        (:328)
+    not-alive: <name>\\n           (:336)
+    clock: <n>\\n                  (:349)
+    event-clock: <n>\\n            (:360)
+    query-clock: <n>\\n            (:370)
+    leave\\n                       (:274)
+
+compacts the file once it outgrows ``min_compact_size`` (rewrite as the
+current alive set + clock floors, :431-479, default 128 KiB), and on
+restart replays it to recover the previously-known alive nodes
+(``PreviousNode``) and clock floors, which seed a *warm* rejoin
+(handleRejoin, serf.go:1705) instead of the blind join-address storm a
+cold restart needs.
+
+TPU mapping: a real serf agent snapshots the event stream it observes;
+here the observer is one **monitored seat** of the simulated world, and
+its event stream is derived from its device view row on chunk
+boundaries — one batched device→host diff per observe() call, the same
+host-boundary budget as the coordinate batching precedent (SURVEY §7).
+``rejoin`` is then ``state.revive`` upgraded with replayed knowledge:
+view entries toward the recorded alive nodes start as contactable
+``(0, ALIVE)`` join seeds (many seeds ⇒ probes/push-pull/gossip reopen
+across the whole neighborhood immediately), and the node's Lamport
+clocks are witnessed forward to the recorded floors so stale events are
+never re-delivered (the eventMinTime guarantee, serf.go:1258-1357).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.config import SimConfig
+from consul_tpu.models import serf as serf_mod
+from consul_tpu.models import state as sim_state
+from consul_tpu.ops import lamport, merge
+
+
+def _seat_name(i: int) -> str:
+    return f"sim-{i}"
+
+
+class Snapshotter:
+    """Append-only event log for one monitored seat of the simulation."""
+
+    def __init__(self, path: str, node: int,
+                 min_compact_size: int = 128 * 1024,
+                 rejoin_after_leave: bool = False):
+        self.path = path
+        self.node = node
+        self.min_compact_size = min_compact_size
+        self.rejoin_after_leave = rejoin_after_leave
+        # Prime the transition state from the existing file — the
+        # reference replays on open (NewSnapshotter -> replay) so a
+        # reopened log keeps appending *transitions*, never re-appends
+        # the world, and compaction can never regress clock floors.
+        prior = replay(path, rejoin_after_leave=True)
+        self._last_alive: dict[str, str] = dict(prior.alive)
+        self._clocks = {"clock": prior.clock,
+                        "event-clock": prior.event_clock,
+                        "query-clock": prior.query_clock}
+        self._off_np = None  # host offset table, cached on first observe
+        self._fh = open(path, "a", encoding="utf-8")
+        self.offset = self._fh.tell()
+
+    # -- recording -----------------------------------------------------
+    def _append(self, line: str):
+        self._fh.write(line)
+        self._fh.flush()
+        self.offset += len(line.encode())
+        if self.offset > self.min_compact_size:
+            self.compact()
+
+    def observe(self, cfg: SimConfig, topo, serf_state) -> None:
+        """Record the monitored seat's membership transitions + clock
+        advances since the last call. One batched device→host fetch per
+        call — call on chunk boundaries.
+
+        The transition state (``_last_alive``/``_clocks``) is mutated
+        entry-by-entry *before* each append: ``_append`` can trigger
+        compaction at any point, and compaction writes the current
+        transition state — writing it stale would discard the very
+        transitions just logged (the reference mutates then appends in
+        the same per-event order, snapshot.go:322-370)."""
+        s = serf_state
+        if self._off_np is None:
+            self._off_np = np.asarray(topo.off)
+        off = self._off_np
+        nd = self.node
+        # One fused device gather: view row + the three clock scalars.
+        fetched = np.asarray(jnp.concatenate([
+            s.swim.view_key[nd].astype(jnp.uint32),
+            jnp.stack([s.clock[nd], s.event_clock[nd], s.query_clock[nd]]),
+        ]))
+        row, clocks = fetched[:off.shape[0]], fetched[off.shape[0]:]
+        statuses = row & (merge.N_STATUS - 1)
+        n = cfg.n
+        now_alive = {}
+        for c in range(off.shape[0]):
+            j = (nd + int(off[c])) % n
+            if statuses[c] == merge.ALIVE:
+                now_alive[_seat_name(j)] = f"{_seat_name(j)}:7946"
+        for name, addr in now_alive.items():
+            if name not in self._last_alive:
+                self._last_alive[name] = addr
+                self._append(f"alive: {name} {addr}\n")
+        for name in list(self._last_alive):
+            if name not in now_alive:
+                del self._last_alive[name]
+                self._append(f"not-alive: {name}\n")
+        for key, v in zip(("clock", "event-clock", "query-clock"),
+                          (int(clocks[0]), int(clocks[1]), int(clocks[2]))):
+            if v > self._clocks[key]:
+                self._clocks[key] = v
+                self._append(f"{key}: {v}\n")
+
+    def leave(self):
+        """Record an intentional departure: replay then starts from
+        scratch unless rejoin_after_leave (snapshot.go:271-279)."""
+        self._append("leave\n")
+
+    def close(self):
+        self._fh.close()
+
+    # -- compaction (snapshot.go:431-479) ------------------------------
+    def compact(self):
+        tmp = self.path + ".compact"
+        with open(tmp, "w", encoding="utf-8") as out:
+            for name, addr in sorted(self._last_alive.items()):
+                out.write(f"alive: {name} {addr}\n")
+            out.write(f"clock: {self._clocks['clock']}\n")
+            out.write(f"event-clock: {self._clocks['event-clock']}\n")
+            out.write(f"query-clock: {self._clocks['query-clock']}\n")
+        self._fh.close()
+        os.replace(tmp, self.path)
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self.offset = self._fh.tell()
+
+
+class Replay:
+    """Recovered state from a snapshot file (snapshot.go replay loop
+    :481-431 region: alive/not-alive/clock/leave lines)."""
+
+    def __init__(self, alive: dict[str, str], clock: int, event_clock: int,
+                 query_clock: int, left: bool):
+        self.alive = alive
+        self.clock = clock
+        self.event_clock = event_clock
+        self.query_clock = query_clock
+        self.left = left
+
+    @property
+    def previous_nodes(self) -> list[tuple[str, str]]:
+        return sorted(self.alive.items())
+
+
+def replay(path: str, rejoin_after_leave: bool = False) -> Replay:
+    alive: dict[str, str] = {}
+    clocks = {"clock": 0, "event-clock": 0, "query-clock": 0}
+    left = False
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if line.startswith("alive: "):
+                    parts = line[len("alive: "):].rsplit(" ", 1)
+                    if len(parts) == 2:
+                        alive[parts[0]] = parts[1]
+                elif line.startswith("not-alive: "):
+                    alive.pop(line[len("not-alive: "):], None)
+                elif line == "leave":
+                    left = True
+                    if not rejoin_after_leave:
+                        alive.clear()
+                        clocks = dict.fromkeys(clocks, 0)
+                else:
+                    for key in clocks:
+                        if line.startswith(key + ": "):
+                            try:
+                                clocks[key] = max(clocks[key],
+                                                  int(line[len(key) + 2:]))
+                            except ValueError:
+                                pass  # torn tail line (crash mid-append)
+                            break
+    return Replay(alive, clocks["clock"], clocks["event-clock"],
+                  clocks["query-clock"], left)
+
+
+def rejoin(cfg: SimConfig, topo, serf_state, node: int, rep: Replay):
+    """Warm restart of ``node`` from a replayed snapshot: revive with
+    join seeds at every previously-known-alive neighbor (not the cold
+    path's blind handful), and witness the Lamport clocks forward to
+    the recorded floors (handleRejoin serf.go:1705 + the clock recovery
+    of snapshot.go)."""
+    s = serf_state
+    n = cfg.n
+    mask_np = np.zeros(n, bool)
+    mask_np[node] = True
+    mask = jnp.asarray(mask_np)
+    off = np.asarray(topo.off)
+    seed_cols = []
+    known = set(rep.alive)
+    for c in range(off.shape[0]):
+        j = (node + int(off[c])) % n
+        if _seat_name(j) in known:
+            seed_cols.append(c)
+    if not seed_cols:
+        # Empty replay (fresh file, or a recorded leave without
+        # rejoin_after_leave): nothing to seed from — fall back to the
+        # configured join addresses, exactly like the reference, whose
+        # restart without a usable snapshot is a plain Join()
+        # (memberlist.go:228). Zero seeds would deadlock the node
+        # (revive docstring).
+        return s._replace(
+            swim=sim_state.revive(cfg, s.swim, mask, cold=True))
+    # Cold wipe (the process restarted; its memory is the file), then
+    # seed (0, ALIVE) toward every replayed alive node in the view.
+    new_swim = sim_state.revive(cfg, s.swim, mask, cold=True, join_seeds=0)
+    row = np.full(off.shape[0], merge.UNKNOWN, np.uint32)
+    row[np.asarray(seed_cols)] = merge.make_key_int(0, merge.ALIVE)
+    new_swim = new_swim._replace(
+        view_key=new_swim.view_key.at[node].set(jnp.asarray(row)))
+    # Clock floors: stale events (ltime <= floor) must never redeliver.
+    def witness(arr, floor):
+        return lamport.witness(arr, jnp.uint32(floor), mask)
+
+    return s._replace(
+        swim=new_swim,
+        clock=witness(s.clock, rep.clock),
+        event_clock=witness(s.event_clock, rep.event_clock),
+        query_clock=witness(s.query_clock, rep.query_clock),
+        ev_floor=s.ev_floor.at[node].max(jnp.uint32(rep.event_clock)),
+    )

@@ -201,9 +201,15 @@ def revive(
         # (contiguous rows) doesn't leave every seed pointing at another
         # cold node at small offsets.
         cols = jnp.arange(k_deg, dtype=jnp.int32)
-        seed_cols = (cols % max(1, k_deg // max(1, min(join_seeds, k_deg)))) == 0
         unknown = merge.make_key(0, merge.DEAD)
-        seeded = jnp.where(seed_cols, merge.make_key(0, merge.ALIVE), unknown)
+        if join_seeds <= 0:
+            # No configured join addresses (snapshot.rejoin seeds its
+            # own from the replayed alive set).
+            seeded = jnp.full((k_deg,), unknown, jnp.uint32)
+        else:
+            stride = max(1, k_deg // min(join_seeds, k_deg))
+            seeded = jnp.where((cols % stride) == 0,
+                               merge.make_key(0, merge.ALIVE), unknown)
         m = mask[:, None]
         state = state._replace(
             view_key=jnp.where(m, seeded[None, :], state.view_key),

@@ -69,8 +69,7 @@ def serial_fixed_point(msgs, order, init=(1, ALIVE)):
 
 
 def lattice_fixed_point(msgs, init=(1, ALIVE)):
-    key = merge.make_key_int(*reversed(init)) if False else \
-        merge.make_key_int(init[0], init[1])
+    key = merge.make_key_int(*init)
     for kind, inc in msgs:
         key = max(key, merge.make_key_int(inc, kind))
     return merge.key_incarnation_int(key), merge.key_status_int(key)

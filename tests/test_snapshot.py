@@ -1,0 +1,223 @@
+"""Serf gossip-snapshot tests: reference line format, transition-only
+appends, compaction, leave semantics, crash-torn tails, and the payoff —
+a warm (snapshot-replayed) rejoin re-converging measurably faster than a
+cold restart (reference serf/snapshot.go:59-431, handleRejoin
+serf.go:1705)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.models import serf as serf_mod
+from consul_tpu.models import snapshot as snap_mod
+from consul_tpu.models import state as sim_state
+from consul_tpu.ops import merge, topology
+
+N = 64
+NODE = 10
+
+
+def make_world(vd=16, push_pull_ms=6_000):
+    cfg = SimConfig(n=N, view_degree=vd,
+                    gossip=GossipConfig.lan(push_pull_interval_ms=push_pull_ms))
+    key = jax.random.PRNGKey(2)
+    kw, kn, ks = jax.random.split(key, 3)
+    world = topology.make_world(cfg, kw)
+    topo = topology.make_topology(cfg, kn)
+    state = serf_mod.init(cfg, ks)
+    step = jax.jit(lambda st, k: serf_mod.step(cfg, topo, world, st, k))
+    return cfg, topo, world, state, step
+
+
+def run(state, step, ticks, seed=0, every=None, cb=None):
+    base = jax.random.PRNGKey(seed)
+    for i in range(ticks):
+        state = step(state, jax.random.fold_in(base, i))
+        if cb is not None and every and (i + 1) % every == 0:
+            cb(state)
+    return state
+
+
+class TestFormatAndReplay:
+    def test_reference_line_format(self, tmp_path):
+        cfg, topo, world, state, step = make_world()
+        p = str(tmp_path / "serf.snapshot")
+        snap = snap_mod.Snapshotter(p, NODE)
+        snap.observe(cfg, topo, state)
+        lines = open(p).read().splitlines()
+        assert any(l.startswith("alive: sim-") and l.endswith(":7946")
+                   for l in lines)
+        assert "clock: 1" in lines
+        assert "event-clock: 1" in lines
+        assert "query-clock: 1" in lines
+        snap.close()
+
+    def test_appends_only_transitions(self, tmp_path):
+        cfg, topo, world, state, step = make_world()
+        p = str(tmp_path / "s")
+        snap = snap_mod.Snapshotter(p, NODE)
+        snap.observe(cfg, topo, state)
+        size1 = snap.offset
+        snap.observe(cfg, topo, state)  # nothing changed
+        assert snap.offset == size1
+        snap.close()
+
+    def test_death_recorded_and_replayed(self, tmp_path):
+        cfg, topo, world, state, step = make_world()
+        p = str(tmp_path / "s")
+        snap = snap_mod.Snapshotter(p, NODE)
+        snap.observe(cfg, topo, state)
+        victim = int(topology.nbrs_table(topo)[NODE, 0])
+        state = state._replace(
+            swim=sim_state.kill(state.swim, jnp.arange(N) == victim))
+        state = run(state, step, 250)
+        snap.observe(cfg, topo, state)
+        rep = snap_mod.replay(p)
+        assert f"sim-{victim}" not in rep.alive
+        assert len(rep.alive) == topo.degree - 1
+        assert rep.clock >= 1
+        snap.close()
+
+    def test_leave_clears_unless_rejoin_after_leave(self, tmp_path):
+        cfg, topo, world, state, step = make_world()
+        p = str(tmp_path / "s")
+        snap = snap_mod.Snapshotter(p, NODE)
+        snap.observe(cfg, topo, state)
+        snap.leave()
+        snap.close()
+        assert snap_mod.replay(p).alive == {}
+        assert snap_mod.replay(p, rejoin_after_leave=True).alive != {}
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        p = str(tmp_path / "s")
+        with open(p, "w") as f:
+            f.write("alive: sim-3 sim-3:7946\nclock: 9\nclock: 1")  # torn
+        rep = snap_mod.replay(p)
+        assert rep.alive == {"sim-3": "sim-3:7946"}
+        assert rep.clock == 9  # floors never regress on a torn line
+
+    def test_compaction_bounds_file(self, tmp_path):
+        cfg, topo, world, state, step = make_world()
+        p = str(tmp_path / "s")
+        snap = snap_mod.Snapshotter(p, NODE, min_compact_size=600)
+        # Oscillate a neighbor's believed status to force append churn.
+        for i in range(60):
+            snap._last_alive.pop("sim-11", None) if i % 2 else \
+                snap._last_alive.update({"sim-11": "sim-11:7946"})
+            snap._append("alive: sim-11 sim-11:7946\n" if i % 2 == 0
+                         else "not-alive: sim-11\n")
+        assert snap.offset <= 600 + 40, "compaction never triggered"
+        rep = snap_mod.replay(p)
+        assert isinstance(rep.alive, dict)
+        snap.close()
+
+
+class TestWarmRejoin:
+    def test_warm_rejoin_faster_than_cold(self, tmp_path):
+        """The whole point of the snapshot: a restart that replays its
+        member log re-converges (full agreement) faster than a cold
+        restart that only knows a handful of join addresses."""
+        cfg, topo, world, state0, step = make_world()
+        p = str(tmp_path / "serf.snapshot")
+        snap = snap_mod.Snapshotter(p, NODE)
+        state0 = run(state0, step, 40)
+        snap.observe(cfg, topo, state0)
+        snap.close()
+
+        mask = jnp.arange(N) == NODE
+        # Crash the node and let the cluster notice.
+        crashed = state0._replace(swim=sim_state.kill(state0.swim, mask))
+        crashed = run(crashed, step, 200, seed=1)
+
+        def ticks_to_full_view(st, limit=400, seed=9):
+            base = jax.random.PRNGKey(seed)
+            for i in range(limit):
+                st = step(st, jax.random.fold_in(base, i))
+                row = np.asarray(st.swim.view_key[NODE])
+                if np.all((row & 3) == merge.ALIVE) and \
+                        bool(np.asarray(st.swim.alive_truth).all()):
+                    return i + 1
+            return limit + 1
+
+        # Cold restart: 3 blind join seeds.
+        cold = crashed._replace(
+            swim=sim_state.revive(cfg, crashed.swim, mask, cold=True))
+        cold_ticks = ticks_to_full_view(cold)
+
+        # Warm restart: replayed snapshot seeds the whole neighborhood.
+        rep = snap_mod.replay(p)
+        assert len(rep.alive) == topo.degree
+        warm = snap_mod.rejoin(cfg, topo, crashed, NODE, rep)
+        warm_ticks = ticks_to_full_view(warm)
+
+        assert warm_ticks < cold_ticks, (warm_ticks, cold_ticks)
+        # And the warm node's clocks resumed past the recorded floors.
+        assert int(warm.clock[NODE]) >= rep.clock
+
+    def test_rejoin_seeds_only_replayed_alive(self, tmp_path):
+        cfg, topo, world, state, step = make_world()
+        p = str(tmp_path / "s")
+        snap = snap_mod.Snapshotter(p, NODE)
+        snap.observe(cfg, topo, state)
+        snap.close()
+        rep = snap_mod.replay(p)
+        # Drop one name from the replay: its column must stay UNKNOWN.
+        dropped = next(iter(sorted(rep.alive)))
+        del rep.alive[dropped]
+        out = snap_mod.rejoin(cfg, topo, state, NODE, rep)
+        row = np.asarray(out.swim.view_key[NODE])
+        nbrs = np.asarray(topology.nbrs_table(topo)[NODE])
+        d_idx = int(dropped.split("-")[1])
+        col = int(np.where(nbrs == d_idx)[0][0])
+        assert row[col] == merge.UNKNOWN
+        seeded = (row == merge.make_key_int(0, merge.ALIVE)).sum()
+        assert seeded == topo.degree - 1
+
+
+class TestReviewRegressions:
+    def test_compaction_mid_observe_keeps_new_lines(self, tmp_path):
+        """Compaction can fire inside observe(); the rewrite must carry
+        the transitions just logged, not a stale snapshot of them."""
+        cfg, topo, world, state, step = make_world()
+        p = str(tmp_path / "s")
+        snap = snap_mod.Snapshotter(p, NODE, min_compact_size=60)
+        snap.observe(cfg, topo, state)  # certainly compacts mid-loop
+        rep = snap_mod.replay(p)
+        assert len(rep.alive) == topo.degree, rep.alive
+        snap.close()
+
+    def test_reopen_primes_from_file(self, tmp_path):
+        """A reopened snapshot continues from the file's state: no
+        re-append of the world, and deaths that happened while the
+        process was down are retracted on the first observe."""
+        cfg, topo, world, state, step = make_world()
+        p = str(tmp_path / "s")
+        snap = snap_mod.Snapshotter(p, NODE)
+        snap.observe(cfg, topo, state)
+        size1 = snap.offset
+        snap.close()
+
+        victim = int(topology.nbrs_table(topo)[NODE, 0])
+        state = state._replace(
+            swim=sim_state.kill(state.swim, jnp.arange(N) == victim))
+        state = run(state, step, 250)
+
+        snap2 = snap_mod.Snapshotter(p, NODE)
+        assert snap2._last_alive, "reopen must prime from the file"
+        snap2.observe(cfg, topo, state)
+        # Only the death transition appended, not the whole world.
+        assert snap2.offset - size1 < 80
+        assert f"sim-{victim}" not in snap_mod.replay(p).alive
+        snap2.close()
+
+    def test_rejoin_empty_replay_falls_back_to_cold_seeds(self, tmp_path):
+        cfg, topo, world, state, step = make_world()
+        rep = snap_mod.replay(str(tmp_path / "missing"))
+        out = snap_mod.rejoin(cfg, topo, state, NODE, rep)
+        row = np.asarray(out.swim.view_key[NODE])
+        # Must have contactable seeds — zero seeds would deadlock.
+        assert (row == merge.make_key_int(0, merge.ALIVE)).sum() >= 1

@@ -326,7 +326,6 @@ class TestGoldenFixtures:
         # go-msgpack with WriteExt=false has no str8: a 100-char name
         # must use raw16 (0xda) (codec/msgpack.go:241 gate).
         name = "x" * 100
-        got = encode_message(MessageType.NACK_RESP | 0, {"SeqNo": 1})
         from consul_tpu.wire.codec import _pack_go
         packed = _pack_go({"Node": name, "SeqNo": 1})
         i = packed.index(b"Node") + 4
