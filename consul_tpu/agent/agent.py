@@ -63,6 +63,10 @@ class Agent:
         )
         self.metrics = {"syncs": 0, "sync_writes": 0, "coordinate_sends": 0,
                         "sync_failures": 0}
+        # go-metrics sink served at /v1/agent/metrics (reference
+        # lib/telemetry.go always attaches an InmemSink).
+        from consul_tpu.utils import telemetry
+        self.sink = telemetry.Sink()
 
     # -- service/check registration API (reference agent endpoints
     # /v1/agent/service/register etc.) ---------------------------------

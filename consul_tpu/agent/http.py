@@ -230,7 +230,12 @@ class HTTPApi:
                          "Member": {"Name": self.agent.node,
                                     "Addr": self.agent.address}}, {}
         if parts == ["agent", "metrics"]:
-            return 200, dict(self.agent.metrics), {}
+            # go-metrics DisplayMetrics shape (reference
+            # http_register.go:39 -> lib/telemetry.go InmemSink), with
+            # the agent's own duty counters folded in as gauges.
+            for k, v in self.agent.metrics.items():
+                self.agent.sink.set_gauge(f"consul.agent.{k}", v)
+            return 200, self.agent.sink.snapshot(), {}
         if parts == ["agent", "service", "register"] and method == "PUT":
             req = json.loads(body)
             ttl = None

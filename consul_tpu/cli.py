@@ -158,6 +158,17 @@ def cmd_snapshot(client: Client, args) -> int:
     raise AssertionError(args.snapshot_cmd)
 
 
+def cmd_debug(client: Client, args) -> int:
+    """Capture a debug bundle over the HTTP API (reference
+    command/debug/debug.go captureStatic)."""
+    from consul_tpu.utils import debug as debug_mod
+
+    files = debug_mod.capture_static(client)
+    path = debug_mod.write_bundle(args.output, files)
+    print(f"Saved debug bundle ({len(files)} captures) to {path}")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="consul-tpu",
@@ -219,13 +230,16 @@ def build_parser() -> argparse.ArgumentParser:
     sr2 = snap_sub.add_parser("restore")
     sr2.add_argument("file")
 
+    dbg = sub.add_parser("debug", help="capture a debug bundle")
+    dbg.add_argument("--output", default="consul-tpu-debug.tar.gz")
+
     return p
 
 
 COMMANDS = {
     "members": cmd_members, "rtt": cmd_rtt, "kv": cmd_kv,
     "catalog": cmd_catalog, "info": cmd_info, "services": cmd_services,
-    "sessions": cmd_sessions, "snapshot": cmd_snapshot,
+    "sessions": cmd_sessions, "snapshot": cmd_snapshot, "debug": cmd_debug,
 }
 
 

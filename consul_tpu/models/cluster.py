@@ -21,7 +21,7 @@ from consul_tpu.config import SimConfig
 from consul_tpu.models import state as sim_state
 from consul_tpu.models import swim
 from consul_tpu.ops import topology
-from consul_tpu.utils import metrics
+from consul_tpu.utils import metrics, telemetry
 
 
 class TickTrace(NamedTuple):
@@ -69,6 +69,10 @@ class Simulation:
         self.state = sim_state.init(self.cfg, ks)
         self.base_key = kb
         self._runners = {}
+        # Reference-named metrics recorded on chunk boundaries
+        # (telemetry.emit_sim_metrics); served by /v1/agent/metrics and
+        # the debug bundle.
+        self.sink = telemetry.Sink()
 
     # -- fault injection ------------------------------------------------
     def kill(self, mask):
@@ -93,13 +97,35 @@ class Simulation:
         remaining = ticks
         while remaining > 0:
             c = min(chunk, remaining)
+            t0 = time.perf_counter()
             self.state, trace = self._runner(c, with_metrics)(self.state, self.base_key)
             if with_metrics:
+                # Block before reading the clock: the jitted runner
+                # returns on async dispatch, not completion.
+                jax.block_until_ready(trace)
                 traces.append(trace)
+                self._record_chunk(trace, c, time.perf_counter() - t0)
             remaining -= c
         if not with_metrics:
             return None
         return jax.tree.map(lambda *xs: jnp.concatenate(xs), *traces)
+
+    def _record_chunk(self, trace: TickTrace, ticks: int, wall_s: float):
+        """Fold one chunk's trace into the telemetry sink under the
+        reference metric names (the batched host-boundary equivalent of
+        the reference's per-operation instrumentation)."""
+        h = metrics.HealthMetrics(
+            agreement=trace.agreement[-1],
+            false_positive=trace.false_positive[-1],
+            undetected=trace.undetected[-1],
+            live_nodes=jnp.int32(0),
+        )
+        telemetry.emit_sim_metrics(
+            self.state, self.sink,
+            health=h, rmse_s=float(trace.rmse[-1]),
+            rounds_per_sec=ticks / wall_s if wall_s > 0 else None,
+            chunk_wall_s=wall_s, chunk_ticks=ticks,
+        )
 
     def run_until_converged(
         self,
@@ -120,7 +146,10 @@ class Simulation:
         trace = None
         while used < max_ticks:
             c = min(chunk, max_ticks - used)
+            t0 = time.perf_counter()
             self.state, trace = self._runner(c, True)(self.state, self.base_key)
+            jax.block_until_ready(trace)
+            self._record_chunk(trace, c, time.perf_counter() - t0)
             used += c
             ok = float(trace.agreement[-1]) >= require_agreement
             if ok and rmse_target_s is not None:

@@ -1,0 +1,112 @@
+"""Debug bundle: the ``consul debug`` capture, TPU-side.
+
+The reference CLI bundles metrics, host info, agent self-description,
+heap/cpu profiles, and logs into a tarball (reference
+command/debug/debug.go: captureStatic :299, captureDynamic :353;
+pprof endpoints agent/http.go:304-309). The TPU equivalents:
+
+  - static capture: agent self + members + coordinates + the
+    go-metrics snapshot, fetched over the same HTTP API the reference
+    uses (:func:`capture_static`);
+  - dynamic capture: instead of pprof, a ``jax.profiler`` trace of the
+    simulation's step program (:func:`capture_sim` with
+    ``profile_ticks`` > 0) — the XLA-level truth about where the step
+    spends its time, viewable in TensorBoard/Perfetto;
+  - :func:`write_bundle` packs everything into one ``.tar.gz``.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import platform
+import tarfile
+import time
+from typing import Optional
+
+
+def _host_info() -> dict:
+    """agent/debug/host.go:20-31 equivalent (no gopsutil here)."""
+    info = {
+        "Hostname": platform.node(),
+        "OS": platform.system(),
+        "Platform": platform.platform(),
+        "Python": platform.python_version(),
+        "CollectionTime": int(time.time() * 1e9),
+    }
+    try:
+        import jax
+        info["Jax"] = jax.__version__
+        info["Devices"] = [str(d) for d in jax.devices()]
+    except Exception as e:  # noqa: BLE001 — host info must never fail
+        info["JaxError"] = repr(e)
+    return info
+
+
+def capture_static(client) -> dict[str, dict]:
+    """Fetch the static capture set over the HTTP API (the reference's
+    captureStatic: self, metrics, members — debug.go:299-351)."""
+    out: dict[str, dict] = {"host.json": _host_info()}
+
+    def grab(name, fn):
+        try:
+            out[name] = fn()
+        except Exception as e:  # noqa: BLE001 — partial bundles beat none
+            out[name] = {"error": repr(e)}
+
+    grab("self.json", lambda: client._call("GET", "/v1/agent/self", {})[0])
+    grab("metrics.json",
+         lambda: client._call("GET", "/v1/agent/metrics", {})[0])
+    grab("members.json", lambda: client.catalog.nodes()[0])
+    grab("coordinates.json", lambda: client.coordinate.nodes()[0])
+    return out
+
+
+def capture_sim(sim, profile_ticks: int = 0,
+                trace_dir: Optional[str] = None) -> dict[str, dict]:
+    """Capture a running simulation: config, health, telemetry — and,
+    when ``profile_ticks`` > 0, a jax.profiler trace of that many ticks
+    written under ``trace_dir`` (the pprof-profile equivalent)."""
+    import dataclasses
+
+    import jax
+
+    from consul_tpu.utils import metrics as m
+
+    out: dict[str, dict] = {"host.json": _host_info()}
+    out["config.json"] = dataclasses.asdict(sim.cfg)
+    h = m.health(sim.cfg, sim.topo, sim.state)
+    out["health.json"] = {
+        "agreement": float(h.agreement),
+        "false_positive": float(h.false_positive),
+        "undetected": float(h.undetected),
+        "live_nodes": int(h.live_nodes),
+        "vivaldi_rmse_ms": float(sim.rmse()) * 1000.0,
+        "tick": int(sim.state.t),
+    }
+    out["metrics.json"] = sim.sink.snapshot()
+    if profile_ticks > 0 and trace_dir:
+        with jax.profiler.trace(trace_dir):
+            sim.run(profile_ticks, with_metrics=False)
+            jax.block_until_ready(sim.state.view_key)
+        out["profile.json"] = {"trace_dir": trace_dir,
+                               "ticks": profile_ticks}
+    return out
+
+
+def write_bundle(path: str, files: dict[str, dict],
+                 extra_dirs: Optional[list[str]] = None) -> str:
+    """Pack captures (+ optional trace directories) into a .tar.gz —
+    the debug.go tarball (:553-...)."""
+    with tarfile.open(path, "w:gz") as tar:
+        for name, payload in files.items():
+            blob = json.dumps(payload, indent=2, default=str).encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            info.mtime = int(time.time())
+            tar.addfile(info, io.BytesIO(blob))
+        for d in extra_dirs or []:
+            if os.path.isdir(d):
+                tar.add(d, arcname=os.path.basename(d.rstrip("/")))
+    return path

@@ -1,0 +1,148 @@
+"""Telemetry: a go-metrics-style in-memory sink with reference metric
+names, feeding ``/v1/agent/metrics`` and the debug bundle.
+
+The reference fans go-metrics out to statsite/statsd/prometheus sinks
+and ALWAYS attaches an in-memory sink exposed at ``/v1/agent/metrics``
+(reference lib/telemetry.go, agent/http_register.go:39). This module is
+that in-memory sink — gauges, counters, and samples with the reference
+API shape (SetGauge/IncrCounter/AddSample/MeasureSince) and the
+reference JSON schema on snapshot (armon/go-metrics InmemSink
+DisplayMetrics: Timestamp/Gauges/Counters/Samples with
+Count/Sum/Min/Max/Mean aggregates).
+
+:func:`emit_sim_metrics` translates one simulation chunk boundary into
+the metric names the reference's gossip stack emits — the TPU fold of
+per-operation instrumentation onto the batched host boundary:
+
+    memberlist.health.score      awareness gauge (awareness.go:50);
+                                 the sim emits mean/max over all nodes
+    memberlist.gossip            per-round wall time (state.go:518)
+    serf.coordinate.adjustment-ms  |adjustment| sample in ms
+                                   (ping_delegate.go:71-81)
+    serf.coordinate.resets       Vivaldi NaN/Inf reset counter
+                                 (client.go:228-231; the reference's
+                                 serf.coordinate.rejected counts the
+                                 same defensive path)
+    sim.*                        the north-star convergence metrics
+                                 (agreement / false-positive /
+                                 undetected / rmse-ms / rounds-per-sec)
+
+External sinks (statsd and friends) need sockets this framework does
+not own; ``Sink.snapshot()`` returns the same JSON any consumer would
+forward.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class _Aggregate:
+    __slots__ = ("count", "total", "min", "max")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def add(self, v: float):
+        self.count += 1
+        self.total += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+    def view(self, name: str) -> dict:
+        mean = self.total / self.count if self.count else 0.0
+        return {"Name": name, "Count": self.count, "Sum": self.total,
+                "Min": self.min if self.count else 0.0,
+                "Max": self.max if self.count else 0.0, "Mean": mean}
+
+
+class Sink:
+    """In-memory metrics sink (armon/go-metrics InmemSink contract)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._gauges: dict[str, float] = {}
+        self._counters: dict[str, _Aggregate] = {}
+        self._samples: dict[str, _Aggregate] = {}
+
+    # go-metrics API surface (names are dotted, like the wire form).
+    def set_gauge(self, name: str, value: float):
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def incr_counter(self, name: str, n: float = 1.0):
+        with self._lock:
+            self._counters.setdefault(name, _Aggregate()).add(float(n))
+
+    def add_sample(self, name: str, value: float):
+        with self._lock:
+            self._samples.setdefault(name, _Aggregate()).add(float(value))
+
+    def measure_since(self, name: str, t0: float):
+        """MeasureSince: elapsed milliseconds sample (go-metrics)."""
+        self.add_sample(name, (time.perf_counter() - t0) * 1000.0)
+
+    def snapshot(self) -> dict:
+        """The /v1/agent/metrics JSON shape (go-metrics
+        DisplayMetrics)."""
+        with self._lock:
+            return {
+                "Timestamp": time.strftime("%Y-%m-%d %H:%M:%S +0000 UTC",
+                                           time.gmtime()),
+                "Gauges": [{"Name": k, "Value": v}
+                           for k, v in sorted(self._gauges.items())],
+                "Counters": [agg.view(k) for k, agg in
+                             sorted(self._counters.items())],
+                "Samples": [agg.view(k) for k, agg in
+                            sorted(self._samples.items())],
+            }
+
+
+def emit_sim_metrics(state, sink: Sink,
+                     health=None, rmse_s: Optional[float] = None,
+                     rounds_per_sec: Optional[float] = None,
+                     chunk_wall_s: Optional[float] = None,
+                     chunk_ticks: Optional[int] = None):
+    """Record one chunk boundary's worth of reference-named metrics.
+
+    One batched device→host fetch for the scalar reductions; the
+    optional ``health``/``rmse_s`` reuse values the caller already
+    computed (utils/metrics.py) rather than recomputing."""
+    aw = state.awareness
+    live = state.alive_truth & ~state.left
+    live_f = live.astype(jnp.float32)
+    scalars = np.asarray(jnp.stack([
+        jnp.sum(jnp.where(live, aw, 0)).astype(jnp.float32),
+        jnp.max(jnp.where(live, aw, 0)).astype(jnp.float32),
+        jnp.sum(live_f),
+        jnp.sum(jnp.abs(state.viv.adjustment) * live_f) * 1000.0,
+        jnp.sum(state.viv.resets).astype(jnp.float32),
+    ]))
+    n_live = float(scalars[2])
+    denom = max(n_live, 1.0)  # divide-by-zero clamp only
+    sink.set_gauge("memberlist.health.score", float(scalars[0]) / denom)
+    sink.set_gauge("memberlist.health.score.max", float(scalars[1]))
+    sink.set_gauge("serf.members.alive", n_live)
+    sink.add_sample("serf.coordinate.adjustment-ms",
+                    float(scalars[3]) / denom)
+    sink.set_gauge("serf.coordinate.resets", float(scalars[4]))
+    if chunk_wall_s is not None and chunk_ticks:
+        # Per-gossip-round wall time (memberlist.gossip MeasureSince).
+        sink.add_sample("memberlist.gossip",
+                        chunk_wall_s * 1000.0 / chunk_ticks)
+    if rounds_per_sec is not None:
+        sink.set_gauge("sim.gossip_rounds_per_sec", rounds_per_sec)
+    if health is not None:
+        sink.set_gauge("sim.agreement", float(health.agreement))
+        sink.set_gauge("sim.false_positive", float(health.false_positive))
+        sink.set_gauge("sim.undetected", float(health.undetected))
+    if rmse_s is not None:
+        sink.set_gauge("sim.vivaldi_rmse_ms", rmse_s * 1000.0)

@@ -303,3 +303,24 @@ class TestCLI:
         rc, out = self.run_cli(port, "snapshot", "restore", f)
         assert rc == 0
         assert client.kv.get("snap/k")[0]["Value"] == b"v"
+
+    def test_debug_bundle(self, stack, tmp_path):
+        import tarfile
+        _, _, _, port = stack
+        out_path = str(tmp_path / "dbg.tar.gz")
+        rc, out = self.run_cli(port, "debug", "--output", out_path)
+        assert rc == 0 and "Saved debug bundle" in out
+        with tarfile.open(out_path) as tar:
+            names = set(tar.getnames())
+            assert {"host.json", "self.json", "metrics.json",
+                    "members.json"} <= names
+            metrics = json.loads(tar.extractfile("metrics.json").read())
+            assert "Gauges" in metrics
+
+    def test_agent_metrics_endpoint(self, stack):
+        _, agent, client, _ = stack
+        agent.sink.set_gauge("memberlist.health.score", 0.0)
+        out, _, _ = client._call("GET", "/v1/agent/metrics", {})
+        names = {g["Name"] for g in out["Gauges"]}
+        assert "memberlist.health.score" in names
+        assert any(n.startswith("consul.agent.") for n in names)

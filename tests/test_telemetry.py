@@ -1,0 +1,99 @@
+"""Observability tests: go-metrics sink shape, reference metric names
+emitted on chunk boundaries, /v1/agent/metrics, the debug bundle, and a
+jax.profiler trace capture (reference lib/telemetry.go,
+awareness.go:50, ping_delegate.go:71-81, command/debug/debug.go)."""
+
+import json
+import tarfile
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from consul_tpu.config import SimConfig
+from consul_tpu.models.cluster import Simulation
+from consul_tpu.utils import debug as debug_mod
+from consul_tpu.utils import telemetry
+
+
+class TestSink:
+    def test_display_metrics_shape(self):
+        s = telemetry.Sink()
+        s.set_gauge("memberlist.health.score", 0.5)
+        s.incr_counter("memberlist.msg.alive", 3)
+        s.add_sample("serf.coordinate.adjustment-ms", 1.5)
+        s.add_sample("serf.coordinate.adjustment-ms", 2.5)
+        snap = s.snapshot()
+        assert set(snap) == {"Timestamp", "Gauges", "Counters", "Samples"}
+        assert snap["Gauges"] == [
+            {"Name": "memberlist.health.score", "Value": 0.5}]
+        [c] = snap["Counters"]
+        assert c["Name"] == "memberlist.msg.alive" and c["Sum"] == 3
+        [sm] = snap["Samples"]
+        assert sm["Count"] == 2 and sm["Mean"] == 2.0
+        assert sm["Min"] == 1.5 and sm["Max"] == 2.5
+
+    def test_measure_since(self):
+        s = telemetry.Sink()
+        t0 = time.perf_counter()
+        s.measure_since("memberlist.gossip", t0)
+        [sm] = s.snapshot()["Samples"]
+        assert sm["Name"] == "memberlist.gossip" and sm["Count"] == 1
+
+
+class TestSimEmission:
+    def test_reference_names_recorded_during_run(self):
+        sim = Simulation(SimConfig(n=64, view_degree=16), seed=0)
+        sim.run(64, chunk=32, with_metrics=True)
+        snap = sim.sink.snapshot()
+        gauges = {g["Name"] for g in snap["Gauges"]}
+        assert "memberlist.health.score" in gauges
+        assert "serf.members.alive" in gauges
+        assert "sim.agreement" in gauges
+        assert "sim.vivaldi_rmse_ms" in gauges
+        assert "sim.gossip_rounds_per_sec" in gauges
+        samples = {s["Name"] for s in snap["Samples"]}
+        assert "serf.coordinate.adjustment-ms" in samples
+        assert "memberlist.gossip" in samples
+
+    def test_health_score_rises_under_degradation(self):
+        # A node whose probes keep failing accrues awareness — the
+        # memberlist.health.score gauge must reflect it.
+        cfg = SimConfig(n=64, view_degree=16, packet_loss=0.6)
+        sim = Simulation(cfg, seed=1)
+        sim.run(128, chunk=64, with_metrics=True)
+        score = {g["Name"]: g["Value"]
+                 for g in sim.sink.snapshot()["Gauges"]}
+        assert score["memberlist.health.score.max"] >= 1.0
+
+
+class TestDebugBundle:
+    def test_capture_sim_and_bundle(self, tmp_path):
+        sim = Simulation(SimConfig(n=64, view_degree=16), seed=0)
+        sim.run(32, chunk=32, with_metrics=True)
+        files = debug_mod.capture_sim(sim)
+        assert files["health.json"]["agreement"] == 1.0
+        assert files["config.json"]["n"] == 64
+        assert files["metrics.json"]["Gauges"]
+        path = debug_mod.write_bundle(str(tmp_path / "b.tar.gz"), files)
+        with tarfile.open(path) as tar:
+            names = tar.getnames()
+            assert {"host.json", "config.json", "health.json",
+                    "metrics.json"} <= set(names)
+            blob = tar.extractfile("health.json").read()
+            assert json.loads(blob)["live_nodes"] == 64
+
+    def test_profiler_trace_capture(self, tmp_path):
+        sim = Simulation(SimConfig(n=64, view_degree=16), seed=0)
+        trace_dir = str(tmp_path / "trace")
+        files = debug_mod.capture_sim(sim, profile_ticks=4,
+                                      trace_dir=trace_dir)
+        assert files["profile.json"]["ticks"] == 4
+        import os
+        found = [os.path.join(dp, f) for dp, _, fs in os.walk(trace_dir)
+                 for f in fs]
+        assert found, "profiler trace produced no files"
+        path = debug_mod.write_bundle(
+            str(tmp_path / "b.tar.gz"), files, extra_dirs=[trace_dir])
+        with tarfile.open(path) as tar:
+            assert any(n.startswith("trace") for n in tar.getnames())
