@@ -168,6 +168,10 @@ class SerfConfig:
     # QueryTimeoutMult=16; timeout = mult * log10(N+1) * gossip_interval,
     # serf/serf.go DefaultQueryTimeout).
     query_timeout_mult: int = 16
+    # Duplicate query responses relayed through this many other members
+    # for redundancy under packet loss (reference QueryParam.RelayFactor,
+    # serf/query.go:31-33, relayResponse serf.go:244-...; default 0).
+    query_relay_factor: int = 0
     # Failed members are remembered (and eligible for reconnect) this
     # long before being reaped from member lists (reference
     # serf/config.go:277 ReconnectTimeout=24h).

@@ -69,6 +69,7 @@ class Simulation:
         self.state = sim_state.init(self.cfg, ks)
         self.base_key = kb
         self._runners = {}
+        self._warmed: set = set()
         # Reference-named metrics recorded on chunk boundaries
         # (telemetry.emit_sim_metrics); served by /v1/agent/metrics and
         # the debug bundle.
@@ -104,13 +105,21 @@ class Simulation:
                 # returns on async dispatch, not completion.
                 jax.block_until_ready(trace)
                 traces.append(trace)
-                self._record_chunk(trace, c, time.perf_counter() - t0)
+                # The first run of each program shape compiles; its
+                # wall time would poison the timing aggregates forever
+                # (throughput() warms for the same reason).
+                if (c, with_metrics) in self._warmed:
+                    self._record_chunk(trace, c, time.perf_counter() - t0)
+                else:
+                    self._warmed.add((c, with_metrics))
+                    self._record_chunk(trace, c, None)
             remaining -= c
         if not with_metrics:
             return None
         return jax.tree.map(lambda *xs: jnp.concatenate(xs), *traces)
 
-    def _record_chunk(self, trace: TickTrace, ticks: int, wall_s: float):
+    def _record_chunk(self, trace: TickTrace, ticks: int,
+                      wall_s: Optional[float]):
         """Fold one chunk's trace into the telemetry sink under the
         reference metric names (the batched host-boundary equivalent of
         the reference's per-operation instrumentation)."""
@@ -123,7 +132,7 @@ class Simulation:
         telemetry.emit_sim_metrics(
             self.state, self.sink,
             health=h, rmse_s=float(trace.rmse[-1]),
-            rounds_per_sec=ticks / wall_s if wall_s > 0 else None,
+            rounds_per_sec=(ticks / wall_s if wall_s else None),
             chunk_wall_s=wall_s, chunk_ticks=ticks,
         )
 
@@ -149,7 +158,11 @@ class Simulation:
             t0 = time.perf_counter()
             self.state, trace = self._runner(c, True)(self.state, self.base_key)
             jax.block_until_ready(trace)
-            self._record_chunk(trace, c, time.perf_counter() - t0)
+            if (c, True) in self._warmed:
+                self._record_chunk(trace, c, time.perf_counter() - t0)
+            else:
+                self._warmed.add((c, True))
+                self._record_chunk(trace, c, None)
             used += c
             ok = float(trace.agreement[-1]) >= require_agreement
             if ok and rmse_target_s is not None:

@@ -433,10 +433,30 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
     # response per node per query — exactly-once via the dedup buffer;
     # serf/query.go respondTo). Direct packet: origin must be up, the
     # packet must survive loss, and the query must still be open.
+    # With ``query_relay_factor`` > 0, each responder also relays
+    # duplicate copies through that many random members
+    # (serf.go relayResponse :244, QueryParam.RelayFactor): a copy
+    # arrives if its relay is up and BOTH legs survive loss, so the
+    # response lands unless the direct packet and every relayed copy
+    # drop. The tally counts each responder once (duplicates are deduped
+    # by the origin in the reference; q_resps is that deduped count).
     resp_drop = jax.random.uniform(k_resp, (n,)) < cfg.packet_loss
+    arrived = ~resp_drop
+    rf = cfg.serf.query_relay_factor
+    if rf > 0 and cfg.packet_loss > 0.0:
+        k_relay = jax.random.fold_in(k_resp, 1)
+        k_rl1, k_rl2, k_rcol = jax.random.split(k_relay, 3)
+        loss1 = jax.random.uniform(k_rl1, (n, rf)) < cfg.packet_loss
+        loss2 = jax.random.uniform(k_rl2, (n, rf)) < cfg.packet_loss
+        rcols = jax.random.randint(k_rcol, (rf,), 0, k_deg)
+        relay_up = jnp.stack(
+            [jnp.roll(active, -topo.off[rcols[i]]) for i in range(rf)],
+            axis=1,
+        )
+        arrived = arrived | jnp.any(relay_up & ~loss1 & ~loss2, axis=1)
     resp_ok = (
         isq
-        & ~resp_drop
+        & arrived
         & (s.q_open_key[worig] == wkey)
         & s.swim.alive_truth[worig]
         & ~s.swim.left[worig]

@@ -55,9 +55,8 @@ def capture_static(client) -> dict[str, dict]:
         except Exception as e:  # noqa: BLE001 — partial bundles beat none
             out[name] = {"error": repr(e)}
 
-    grab("self.json", lambda: client._call("GET", "/v1/agent/self", {})[0])
-    grab("metrics.json",
-         lambda: client._call("GET", "/v1/agent/metrics", {})[0])
+    grab("self.json", client.agent.self_)
+    grab("metrics.json", client.agent.metrics)
     grab("members.json", lambda: client.catalog.nodes()[0])
     grab("coordinates.json", lambda: client.coordinate.nodes()[0])
     return out

@@ -38,8 +38,9 @@ import threading
 import time
 from typing import Optional
 
-import jax.numpy as jnp
-import numpy as np
+# jax/numpy are imported lazily inside emit_sim_metrics: the Sink class
+# is pure Python and agents (which only need the sink) must not pay for
+# JAX import/backend init at startup.
 
 
 class _Aggregate:
@@ -116,6 +117,9 @@ def emit_sim_metrics(state, sink: Sink,
     One batched device→host fetch for the scalar reductions; the
     optional ``health``/``rmse_s`` reuse values the caller already
     computed (utils/metrics.py) rather than recomputing."""
+    import jax.numpy as jnp
+    import numpy as np
+
     aw = state.awareness
     live = state.alive_truth & ~state.left
     live_f = live.astype(jnp.float32)

@@ -129,6 +129,11 @@ def decode_coordinate(payload: bytes) -> Optional[dict]:
                            unicode_errors="surrogateescape")
 
 
+class NameConflict(ValueError):
+    """A live member already holds this name and the cluster majority
+    agrees (reference serf.go:1413-1486 name-conflict resolution)."""
+
+
 @dataclasses.dataclass
 class Packet:
     """transport.go:10-22."""
@@ -233,13 +238,45 @@ class PacketBridge:
     # ------------------------------------------------------------------
     # Attachment
     # ------------------------------------------------------------------
-    def attach(self, seat: int) -> BridgeTransport:
+    def attach(self, seat: int, replace: bool = False) -> BridgeTransport:
         """Claim ``seat`` for an external agent. The seat's ground truth
         becomes alive (the process exists) and ``external`` is set so
-        the sim stops originating protocol traffic for it."""
+        the sim stops originating protocol traffic for it.
+
+        Claiming a seat whose name is *currently held by a live in-sim
+        member* is a name conflict; it resolves the reference's way
+        (serf.go:1413-1486 handleNodeConflict -> resolveNodeConflict:
+        query the cluster, majority keeps the name, the minority
+        claimant shuts down): the seat's trackers vote with their
+        current beliefs, and a majority-alive verdict rejects the
+        newcomer with :class:`NameConflict`. A majority believing the
+        seat dead/left means the cluster has moved on — the newcomer
+        wins the name (the restarted-agent takeover case).
+        ``replace=True`` skips the vote: an explicit operator takeover
+        of a simulated member's seat."""
         if seat in self.transports:
             raise ValueError(f"seat {seat} already attached")
         st = self.sim.state
+        if not replace and bool(st.alive_truth[seat]) \
+                and not bool(st.external[seat]) and not bool(st.left[seat]):
+            votes_alive = 0
+            n = self.sim.cfg.n
+            view = np.asarray(st.view_key)
+            up = np.asarray(st.alive_truth & ~st.left)
+            voters = 0
+            for j in range(self._off.shape[0]):
+                r = (seat - int(self._off[j])) % n
+                if not up[r]:
+                    continue
+                # seat sits at column j of r's view: r + off[j] == seat.
+                voters += 1
+                if merge.key_status_int(int(view[r, j])) == merge.ALIVE:
+                    votes_alive += 1
+            if voters and votes_alive * 2 > voters:
+                raise NameConflict(
+                    f"seat {seat} is held by a live member "
+                    f"({votes_alive}/{voters} trackers vote alive)"
+                )
         mask = np.zeros(self.sim.cfg.n, bool)
         mask[seat] = True
         m = jnp.asarray(mask)

@@ -272,7 +272,7 @@ class TestWireDetails:
         cfg = SimConfig(n=64, view_degree=16)
         sim = Simulation(cfg, seed=1)
         br = PacketBridge(sim)
-        tr = br.attach(3)
+        tr = br.attach(3, replace=True)
         tr.write_to(b"\xff\xfe garbage", seat_addr(5))
         tr.write_to(b"", seat_addr(5))
         br.step()  # must not raise
@@ -281,7 +281,7 @@ class TestWireDetails:
         cfg = SimConfig(n=64, view_degree=16)
         sim = Simulation(cfg, seed=1)
         br = PacketBridge(sim)
-        tr = br.attach(3)
+        tr = br.attach(3, replace=True)
         tr.shutdown()
         with pytest.raises(RuntimeError):
             tr.write_to(b"x", seat_addr(5))
@@ -292,6 +292,26 @@ class TestWireDetails:
         cfg = SimConfig(n=64, view_degree=16)
         sim = Simulation(cfg, seed=1)
         br = PacketBridge(sim)
-        br.attach(3)
+        br.attach(3, replace=True)
         with pytest.raises(ValueError):
-            br.attach(3)
+            br.attach(3, replace=True)
+
+    def test_name_conflict_majority_rejects(self):
+        """Attaching to a live member's seat without replace loses the
+        conflict vote (serf.go:1413-1486): the trackers believe the
+        holder alive."""
+        from consul_tpu.wire.bridge import NameConflict
+        cfg = SimConfig(n=64, view_degree=16)
+        sim = Simulation(cfg, seed=1)
+        br = PacketBridge(sim)
+        with pytest.raises(NameConflict):
+            br.attach(7)
+
+    def test_name_conflict_dead_holder_allows_takeover(self):
+        cfg = SimConfig(n=64, view_degree=16)
+        sim = Simulation(cfg, seed=1)
+        sim.kill(jnp.arange(64) == 7)
+        ok, _, _ = sim.run_until_converged(max_ticks=1024, chunk=64)
+        assert ok
+        br = PacketBridge(sim)
+        br.attach(7)  # majority believes the holder dead: no conflict
