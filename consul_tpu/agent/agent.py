@@ -18,6 +18,8 @@ agent/testagent.go:44-129, without real sockets).
 from __future__ import annotations
 
 import random
+import threading
+import uuid
 from typing import Any, Callable, Optional
 
 from consul_tpu.agent.cache import Cache
@@ -67,6 +69,15 @@ class Agent:
         # lib/telemetry.go always attaches an InmemSink).
         from consul_tpu.utils import telemetry
         self.sink = telemetry.Sink()
+        # User-event buffer for /v1/event/fire + /v1/event/list
+        # (reference agent/event_endpoint.go; the agent retains the most
+        # recent 256 events, agent/user_event.go eventBuf). fire_hook
+        # lets a driver forward fired events into the simulated serf
+        # event plane (models/serf.user_event).
+        self.events: list[dict] = []
+        self.event_seq = 0
+        self.fire_hook: Optional[Callable[[str, bytes], None]] = None
+        self._event_cond = threading.Condition()
 
     # -- service/check registration API (reference agent endpoints
     # /v1/agent/service/register etc.) ---------------------------------
@@ -81,6 +92,51 @@ class Agent:
     def remove_service(self, service_id: str):
         self.checks.remove(f"service:{service_id}")
         self.local.remove_service(service_id)
+
+    # -- user events (reference agent/event_endpoint.go) ----------------
+    def fire_event(self, name: str, payload: bytes = b"") -> dict:
+        """Fire a user event: buffer it (last 256 retained, reference
+        agent/user_event.go) and forward to the gossip plane when a
+        driver attached one."""
+        with self._event_cond:
+            self.event_seq += 1
+            ev = {"ID": str(uuid.uuid4()), "Name": name,
+                  "Payload": payload, "LTime": self.event_seq}
+            self.events.append(ev)
+            del self.events[:-256]
+            self._event_cond.notify_all()
+        if self.fire_hook is not None:
+            self.fire_hook(name, payload)
+        return ev
+
+    def event_list(self, name: str = "", min_index: int = 0,
+                   wait_s: float = 0.0) -> tuple[int, list[dict]]:
+        """List buffered events, optionally filtered by name, with
+        blocking-query semantics over the event sequence (the reference
+        event endpoint supports ?index long-polling on an event hash)."""
+        import time as _time
+
+        deadline = _time.monotonic() + wait_s
+
+        def filtered():
+            return [e for e in self.events
+                    if not name or e["Name"] == name]
+
+        def index_of(evs):
+            # Per-FILTER watch index (the reference long-polls a hash of
+            # the filtered events): +1 past the newest matching LTime,
+            # so unrelated events never wake a name-scoped watcher.
+            return (evs[-1]["LTime"] if evs else 0) + 1
+
+        with self._event_cond:
+            evs = filtered()
+            while min_index and index_of(evs) <= min_index:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+                self._event_cond.wait(remaining)
+                evs = filtered()
+            return index_of(evs), evs
 
     # -- the periodic work ---------------------------------------------
     def tick(self, now: float) -> dict:

@@ -59,17 +59,22 @@ class CheckTTL:
 class CheckMonitor:
     def __init__(self, local: LocalState, check_id: str,
                  probe: Callable[[], tuple[str, str]],
-                 interval_s: float, now: float = 0.0):
+                 interval_s: float, now: float = 0.0,
+                 background: bool = False):
+        """``background=True`` runs each probe on its own thread and
+        posts the result when it completes — the reference runs every
+        check in a goroutine (checks/check.go) precisely so a slow
+        HTTP/TCP target cannot stall the agent; synchronous mode stays
+        the default for deterministic in-process probes."""
         self.local = local
         self.check_id = check_id
         self.probe = probe
         self.interval_s = interval_s
         self.next_run = now  # first probe runs immediately
+        self.background = background
+        self._in_flight = False
 
-    def tick(self, now: float):
-        if now < self.next_run:
-            return
-        self.next_run = now + self.interval_s
+    def _run_probe(self):
         try:
             status, output = self.probe()
         except Exception as e:  # noqa: BLE001 — a crashing probe is critical
@@ -77,6 +82,53 @@ class CheckMonitor:
         if status not in ("passing", "warning", "critical"):
             status, output = "critical", f"bad probe status {status!r}"
         self.local.update_check(self.check_id, status, output)
+        self._in_flight = False
+
+    def tick(self, now: float):
+        if now < self.next_run or self._in_flight:
+            return
+        self.next_run = now + self.interval_s
+        if self.background:
+            import threading
+
+            self._in_flight = True
+            threading.Thread(target=self._run_probe, daemon=True).start()
+        else:
+            self._run_probe()
+
+
+def http_probe(url: str, timeout_s: float = 10.0,
+               method: str = "GET") -> tuple[str, str]:
+    """One HTTP check probe (reference agent/checks/check.go CheckHTTP):
+    2xx -> passing, 429 -> warning, anything else (or a transport
+    error) -> critical; the body is the check output."""
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(url, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            body = resp.read(4096).decode(errors="replace")
+            return "passing", f"HTTP {method} {url}: {resp.status}  " + body
+    except urllib.error.HTTPError as e:
+        body = (e.read(4096) or b"").decode(errors="replace")
+        if e.code == 429:  # Too Many Requests (check.go:329-333)
+            return "warning", f"HTTP {method} {url}: {e.code}  " + body
+        return "critical", f"HTTP {method} {url}: {e.code}  " + body
+    except OSError as e:
+        return "critical", f"HTTP {method} {url} failed: {e}"
+
+
+def tcp_probe(host: str, port: int, timeout_s: float = 10.0) -> tuple[str, str]:
+    """One TCP check probe (reference CheckTCP): a completed connect is
+    passing; refusal/timeouts are critical."""
+    import socket
+
+    try:
+        with socket.create_connection((host, port), timeout=timeout_s):
+            return "passing", f"TCP connect {host}:{port}: Success"
+    except OSError as e:
+        return "critical", f"TCP connect {host}:{port} failed: {e}"
 
 
 class CheckRunner:
@@ -98,11 +150,32 @@ class CheckRunner:
 
     def add_monitor(self, check_id: str, probe: Callable[[], tuple[str, str]],
                     interval_s: float, service_id: str = "",
-                    now: float = 0.0) -> CheckMonitor:
+                    now: float = 0.0, background: bool = False) -> CheckMonitor:
         self.local.add_check(check_id, "critical", service_id)
-        c = CheckMonitor(self.local, check_id, probe, interval_s, now)
+        c = CheckMonitor(self.local, check_id, probe, interval_s, now,
+                         background)
         self.checks[check_id] = c
         return c
+
+    def add_http(self, check_id: str, url: str, interval_s: float,
+                 timeout_s: float = 10.0, service_id: str = "",
+                 now: float = 0.0, background: bool = True) -> CheckMonitor:
+        """HTTP check (reference CheckHTTP): a monitor over http_probe,
+        backgrounded by default so a hung endpoint never stalls the
+        agent tick."""
+        return self.add_monitor(
+            check_id, lambda: http_probe(url, timeout_s), interval_s,
+            service_id, now, background)
+
+    def add_tcp(self, check_id: str, host: str, port: int,
+                interval_s: float, timeout_s: float = 10.0,
+                service_id: str = "", now: float = 0.0,
+                background: bool = True) -> CheckMonitor:
+        """TCP check (reference CheckTCP): a monitor over tcp_probe,
+        backgrounded by default."""
+        return self.add_monitor(
+            check_id, lambda: tcp_probe(host, port, timeout_s), interval_s,
+            service_id, now, background)
 
     def remove(self, check_id: str):
         self.checks.pop(check_id, None)

@@ -152,6 +152,10 @@ class HTTPApi:
             out = rpc("Health.NodeChecks", node=parts[2],
                       min_index=min_index, wait_s=wait_s)
             return 200, out["value"], {"X-Consul-Index": str(out["index"])}
+        if len(parts) == 3 and parts[:2] == ["health", "checks"]:
+            out = rpc("Health.ServiceChecks", service=parts[2],
+                      min_index=min_index, wait_s=wait_s)
+            return 200, out["value"], {"X-Consul-Index": str(out["index"])}
         if len(parts) == 3 and parts[:2] == ["health", "state"]:
             out = rpc("Health.ChecksInState", state=parts[2],
                       min_index=min_index, wait_s=wait_s)
@@ -225,6 +229,20 @@ class HTTPApi:
                 return 200, True, {}
 
         # ---- agent ----------------------------------------------------
+        # ---- user events (reference agent/event_endpoint.go) ----------
+        if len(parts) == 3 and parts[:2] == ["event", "fire"] and \
+                method == "PUT":
+            ev = self.agent.fire_event(parts[2], body or b"")
+            return 200, {"ID": ev["ID"], "Name": ev["Name"],
+                         "LTime": ev["LTime"]}, {}
+        if parts == ["event", "list"]:
+            idx, evs = self.agent.event_list(
+                q.get("name", ""), min_index, wait_s if min_index else 0.0)
+            out = [{"ID": e["ID"], "Name": e["Name"], "LTime": e["LTime"],
+                    "Payload": base64.b64encode(e["Payload"]).decode()
+                    if e["Payload"] else None} for e in evs]
+            return 200, out, {"X-Consul-Index": str(idx)}
+
         if parts == ["agent", "self"]:
             return 200, {"Config": {"NodeName": self.agent.node},
                          "Member": {"Name": self.agent.node,

@@ -288,3 +288,105 @@ class Lock:
         self.client.session.destroy(self.session)
         self.session = None
         return ok
+
+
+class WatchPlan:
+    """Watch-plan engine (reference api/watch/plan.go over the typed
+    watch functions of api/watch/funcs.go:18-30): one blocking query
+    re-run in a loop; the handler fires whenever X-Consul-Index moves.
+
+    Types and their parameters:
+
+      key        key=...            one KV entry        (funcs.go keyWatch)
+      keyprefix  prefix=...         KV prefix listing   (keyPrefixWatch)
+      services   —                  catalog service map (servicesWatch)
+      nodes      —                  catalog node list   (nodesWatch)
+      service    service=[, tag=]   one service's nodes (serviceWatch)
+      checks     [state=|service=]  health checks       (checksWatch)
+      event      [name=]            agent user events   (eventWatch)
+
+    ``handler(index, result)`` is the WatchPlan Handler contract. Drive
+    it explicitly with :meth:`run_once` (tests, schedulers) or loop it
+    on a thread with :meth:`run` / :meth:`stop`.
+    """
+
+    TYPES = ("key", "keyprefix", "services", "nodes", "service",
+             "checks", "event")
+
+    def __init__(self, client: Client, wtype: str, handler, **params):
+        if wtype not in self.TYPES:
+            raise ValueError(f"unsupported watch type {wtype!r}")
+        self.client = client
+        self.type = wtype
+        self.handler = handler
+        self.params = params
+        self.index = 0
+        self._stop = False
+
+    def _query(self, wait: str):
+        c, p = self.client, self.params
+        idx = {"index": self.index or None,
+               "wait": wait if self.index else None}
+        if self.type == "key":
+            row, meta = c.kv.get(p["key"], index=self.index,
+                                 wait=wait if self.index else "10s")
+            return meta.index, row
+        if self.type == "keyprefix":
+            out, meta, _ = c._call(
+                "GET", f"/v1/kv/{p.get('prefix', '')}",
+                {"recurse": "", **idx})
+            return meta.index, out or []
+        if self.type == "services":
+            out, meta, _ = c._call("GET", "/v1/catalog/services", idx)
+            return meta.index, out
+        if self.type == "nodes":
+            out, meta, _ = c._call("GET", "/v1/catalog/nodes", idx)
+            return meta.index, out
+        if self.type == "service":
+            out, meta, _ = c._call(
+                "GET", f"/v1/catalog/service/{p['service']}",
+                {"tag": p.get("tag"), **idx})
+            return meta.index, out
+        if self.type == "checks":
+            if p.get("service"):
+                path = f"/v1/health/checks/{p['service']}"
+            else:
+                path = f"/v1/health/state/{p.get('state', 'any')}"
+            out, meta, _ = c._call("GET", path, idx)
+            return meta.index, out
+        if self.type == "event":
+            out, meta, _ = c._call(
+                "GET", "/v1/event/list", {"name": p.get("name"), **idx})
+            return meta.index, out
+        raise AssertionError(self.type)
+
+    def run_once(self, wait: str = "10s") -> bool:
+        """One blocking-query round; returns True when the handler
+        fired (the index moved)."""
+        new_index, result = self._query(wait)
+        if new_index == self.index:
+            return False
+        # Reset on index regression, like the reference plan loop
+        # (plan.go: an index that goes backwards restarts from 0).
+        self.index = new_index if new_index > self.index else 0
+        if self.handler is not None and self.index:
+            self.handler(self.index, result)
+        return True
+
+    def run(self, wait: str = "10s", max_rounds: Optional[int] = None):
+        """Loop run_once until stop() (reference plan.Run)."""
+        rounds = 0
+        while not self._stop:
+            self.run_once(wait)
+            rounds += 1
+            if max_rounds is not None and rounds >= max_rounds:
+                break
+
+    def stop(self):
+        self._stop = True
+
+
+def watch(client: Client, wtype: str, handler=None, **params) -> WatchPlan:
+    """Factory matching api/watch.Parse + Plan: ``watch(client, "key",
+    handler, key="config/db")``."""
+    return WatchPlan(client, wtype, handler, **params)

@@ -105,24 +105,25 @@ class Simulation:
                 # returns on async dispatch, not completion.
                 jax.block_until_ready(trace)
                 traces.append(trace)
-                # The first run of each program shape compiles; its
-                # wall time would poison the timing aggregates forever
-                # (throughput() warms for the same reason).
-                if (c, with_metrics) in self._warmed:
-                    self._record_chunk(trace, c, time.perf_counter() - t0)
-                else:
-                    self._warmed.add((c, with_metrics))
-                    self._record_chunk(trace, c, None)
+                self._record_chunk(trace, c, t0)
             remaining -= c
         if not with_metrics:
             return None
         return jax.tree.map(lambda *xs: jnp.concatenate(xs), *traces)
 
-    def _record_chunk(self, trace: TickTrace, ticks: int,
-                      wall_s: Optional[float]):
+    def _record_chunk(self, trace: TickTrace, ticks: int, t0: float):
         """Fold one chunk's trace into the telemetry sink under the
         reference metric names (the batched host-boundary equivalent of
-        the reference's per-operation instrumentation)."""
+        the reference's per-operation instrumentation). The first run
+        of each program shape compiles; its wall time would poison the
+        timing aggregates forever, so it is recorded without timing
+        (throughput() warms for the same reason)."""
+        key = (ticks, True)
+        if key in self._warmed:
+            wall_s: Optional[float] = time.perf_counter() - t0
+        else:
+            self._warmed.add(key)
+            wall_s = None
         h = metrics.HealthMetrics(
             agreement=trace.agreement[-1],
             false_positive=trace.false_positive[-1],
@@ -158,11 +159,7 @@ class Simulation:
             t0 = time.perf_counter()
             self.state, trace = self._runner(c, True)(self.state, self.base_key)
             jax.block_until_ready(trace)
-            if (c, True) in self._warmed:
-                self._record_chunk(trace, c, time.perf_counter() - t0)
-            else:
-                self._warmed.add((c, True))
-                self._record_chunk(trace, c, None)
+            self._record_chunk(trace, c, t0)
             used += c
             ok = float(trace.agreement[-1]) >= require_agreement
             if ok and rmse_target_s is not None:

@@ -226,6 +226,13 @@ class Server:
         return self._blocking(["checks"], min_index, wait_s,
                               lambda: self.store.checks(state=state))
 
+    def _health_service_checks(self, service: str, min_index: int = 0,
+                               wait_s: float = 10.0) -> dict:
+        """Checks for one service (reference /v1/health/checks/:service,
+        health_endpoint.go ServiceChecks)."""
+        return self._blocking(["checks"], min_index, wait_s,
+                              lambda: self.store.checks(service=service))
+
     # ------------------------------------------------------------------
     # KVS endpoint (reference agent/consul/kvs_endpoint.go)
     # ------------------------------------------------------------------
@@ -358,7 +365,8 @@ class ServerCluster:
 
     def __init__(self, n: int = 3, seed: int = 0,
                  snapshot_threshold: int = 4096,
-                 vivaldi_dimensionality: int = 8):
+                 vivaldi_dimensionality: int = 8,
+                 bootstrap_expect: int = 0):
         self.registry: dict[str, Server] = {}
         fsms: dict[str, FSM] = {}
 
@@ -377,6 +385,43 @@ class ServerCluster:
                    vivaldi_dimensionality)
             for nid in sorted(self.raft.nodes)
         ]
+        # bootstrap-expect (reference server_serf.go:236 maybeBootstrap):
+        # with a non-zero expectation, raft stays dormant — no elections,
+        # no log — until maybe_bootstrap() has seen that many server
+        # members (via serf tags) all agreeing on the expectation.
+        self.bootstrap_expect = bootstrap_expect
+        self.bootstrapped = bootstrap_expect == 0
+        if not self.bootstrapped:
+            for node in self.raft.nodes.values():
+                node.stopped = True
+
+    def maybe_bootstrap(self, members: list[dict]) -> bool:
+        """Feed serf member observations (dicts with ``name`` and a
+        ``tags`` map: role/expect, reference server_serf.go:33-113).
+        Bootstraps raft once ``bootstrap_expect`` servers are known and
+        every one of them advertises the same expectation
+        (server_serf.go:236-330 maybeBootstrap; mismatched expect values
+        log and wait, they never bootstrap a wrong-size quorum)."""
+        if self.bootstrapped:
+            return True
+        servers = [m for m in members
+                   if m.get("tags", {}).get("role") == "consul"]
+        expects = set()
+        for m in servers:
+            try:
+                expects.add(int(m["tags"].get("expect", 0)))
+            except (TypeError, ValueError):
+                # Malformed gossip tag: skip the member, never crash
+                # the serf-event loop (maybeBootstrap logs-and-skips).
+                return False
+        if len(servers) < self.bootstrap_expect:
+            return False
+        if expects != {self.bootstrap_expect}:
+            return False  # conflicting -bootstrap-expect: refuse
+        for node in self.raft.nodes.values():
+            node.stopped = False
+        self.bootstrapped = True
+        return True
 
     def step(self, rounds: int = 1):
         self.raft.step(rounds)

@@ -256,12 +256,19 @@ class StateStore:
                      index: Optional[int] = None) -> int:
         if status not in ("passing", "warning", "critical"):
             raise ValueError(f"bad check status {status!r}")
-        return self._commit(
-            "checks", f"{node}/{check_id}",
-            {"node": node, "check_id": check_id, "status": status,
-             "service_id": service_id, "output": output},
-            index=index,
-        )
+        # Resolve the service NAME too: /v1/health/checks/:service
+        # filters by name (reference health_endpoint.go ServiceChecks),
+        # while registrations carry only the id.
+        with self._lock:
+            svc = self.tables["services"].rows.get(f"{node}/{service_id}")
+            service_name = svc.value["service"] if svc else ""
+            return self._commit(
+                "checks", f"{node}/{check_id}",
+                {"node": node, "check_id": check_id, "status": status,
+                 "service_id": service_id, "service_name": service_name,
+                 "output": output},
+                index=index,
+            )
 
     def delete_check(self, node: str, check_id: str,
                      index: Optional[int] = None) -> int:
@@ -279,7 +286,8 @@ class StateStore:
                 v = e.value
                 if node is not None and v["node"] != node:
                     continue
-                if service is not None and v["service_id"] != service:
+                if service is not None and v["service_id"] != service \
+                        and v.get("service_name") != service:
                     continue
                 if state is not None and state != "any" and v["status"] != state:
                     continue

@@ -1,0 +1,270 @@
+"""Agent/API-layer gap closures: watch plans, HTTP/TCP check runners,
+the user-event endpoint, and bootstrap-expect (reference
+api/watch/funcs.go:18-30 + plan.go, agent/checks/check.go CheckHTTP/
+CheckTCP, agent/event_endpoint.go, agent/consul/server_serf.go:236)."""
+
+import http.server
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from consul_tpu.agent.agent import Agent
+from consul_tpu.agent.checks import http_probe, tcp_probe
+from consul_tpu.agent.http import HTTPApi, serve
+from consul_tpu.agent.local import LocalState
+from consul_tpu.api import Client, WatchPlan, watch
+from consul_tpu.server.endpoints import ServerCluster
+
+
+@pytest.fixture(scope="module")
+def stack():
+    cluster = ServerCluster(3, seed=21)
+    leader = cluster.wait_converged()
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    def pump():
+        while not stop.is_set():
+            with lock:
+                cluster.step()
+            time.sleep(0.002)
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    def rpc(method, **args):
+        with lock:
+            server = cluster.registry[cluster.raft.wait_converged().id]
+        return server.rpc(method, **args)
+
+    def wait_write(idx):
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            with lock:
+                led = cluster.raft.leader()
+                if led is not None and led.last_applied >= idx:
+                    return
+            time.sleep(0.002)
+
+    agent = Agent("watch-agent", "10.0.0.1", rpc, cluster_size=3)
+    api = HTTPApi(agent, server=leader, wait_write=wait_write)
+    httpd, port = serve(api)
+    client = Client("127.0.0.1", port)
+    yield cluster, agent, client
+    stop.set()
+    httpd.shutdown()
+
+
+def fire_and_collect(plan, mutate, rounds=8, wait="2s"):
+    """Prime the plan (first round always fires), mutate, then poll."""
+    plan.run_once(wait="10ms")  # initial snapshot
+    mutate()
+    for _ in range(rounds):
+        if plan.run_once(wait=wait):
+            return True
+    return False
+
+
+class TestWatchPlans:
+    def test_key_watch(self, stack):
+        _, _, client = stack
+        got = []
+        plan = watch(client, "key", lambda i, r: got.append(r),
+                     key="watch/key1")
+        assert fire_and_collect(
+            plan, lambda: client.kv.put("watch/key1", b"v1"))
+        assert got and got[-1]["Value"] == b"v1"
+
+    def test_keyprefix_watch(self, stack):
+        _, _, client = stack
+        got = []
+        plan = watch(client, "keyprefix", lambda i, r: got.append(r),
+                     prefix="wp/")
+        assert fire_and_collect(
+            plan, lambda: (client.kv.put("wp/a", b"1"),
+                           client.kv.put("wp/b", b"2")))
+        assert {r["Key"] for r in got[-1]} >= {"wp/a", "wp/b"}
+
+    def test_service_and_services_watch(self, stack):
+        _, _, client = stack
+        got_svc, got_all = [], []
+        p1 = watch(client, "service", lambda i, r: got_svc.append(r),
+                   service="web")
+        p2 = watch(client, "services", lambda i, r: got_all.append(r))
+        mut = lambda: client.catalog.register(
+            "wnode", "10.0.0.9",
+            service={"ID": "web1", "Service": "web", "Port": 80})
+        assert fire_and_collect(p1, mut)
+        p2.run_once(wait="10ms")
+        assert any(s["id"] == "web1" for s in got_svc[-1])
+        assert "web" in (got_all[-1] if got_all else
+                         client.catalog.services()[0])
+
+    def test_nodes_watch(self, stack):
+        _, _, client = stack
+        got = []
+        plan = watch(client, "nodes", lambda i, r: got.append(r))
+        assert fire_and_collect(
+            plan, lambda: client.catalog.register("fresh-node", "10.0.0.77"))
+        assert any(n["node"] == "fresh-node" for n in got[-1])
+
+    def test_checks_watch(self, stack):
+        _, _, client = stack
+        got = []
+        plan = watch(client, "checks", lambda i, r: got.append(r),
+                     state="critical")
+        assert fire_and_collect(
+            plan, lambda: client.catalog.register(
+                "cnode", "10.0.0.8",
+                check={"CheckID": "c1", "Status": "critical"}))
+        assert any(c["check_id"] == "c1" for c in got[-1])
+
+    def test_event_watch(self, stack):
+        _, _, client = stack
+        got = []
+        plan = watch(client, "event", lambda i, r: got.append(r),
+                     name="deploy")
+        assert fire_and_collect(
+            plan,
+            lambda: client._call("PUT", "/v1/event/fire/deploy", {},
+                                 b"v2.0"))
+        assert got[-1] and got[-1][-1]["Name"] == "deploy"
+
+    def test_unsupported_type_rejected(self, stack):
+        _, _, client = stack
+        with pytest.raises(ValueError, match="unsupported watch type"):
+            WatchPlan(client, "connect_roots", None)
+
+    def test_handler_not_fired_without_change(self, stack):
+        _, _, client = stack
+        fired = []
+        plan = watch(client, "key", lambda i, r: fired.append(i),
+                     key="watch/static")
+        client.kv.put("watch/static", b"x")
+        plan.run_once(wait="10ms")
+        n = len(fired)
+        assert plan.run_once(wait="100ms") is False  # no change: no fire
+        assert len(fired) == n
+
+
+class TestEventEndpoint:
+    def test_fire_and_list(self, stack):
+        _, _, client = stack
+        out, _, _ = client._call("PUT", "/v1/event/fire/restart", {},
+                                 b"now")
+        assert out["Name"] == "restart" and out["ID"]
+        evs, meta, _ = client._call("GET", "/v1/event/list",
+                                    {"name": "restart"})
+        assert evs and evs[-1]["Name"] == "restart"
+        import base64
+        assert base64.b64decode(evs[-1]["Payload"]) == b"now"
+
+    def test_fire_hook_forwards(self, stack):
+        _, agent, client = stack
+        seen = []
+        agent.fire_hook = lambda name, payload: seen.append((name, payload))
+        client._call("PUT", "/v1/event/fire/hooked", {}, b"p")
+        assert seen == [("hooked", b"p")]
+        agent.fire_hook = None
+
+
+class TestCheckProbes:
+    @pytest.fixture(scope="class")
+    def web(self):
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                code = int(self.path.rsplit("/", 1)[-1])
+                self.send_response(code)
+                self.end_headers()
+                self.wfile.write(b"body")
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        yield f"http://127.0.0.1:{httpd.server_port}"
+        httpd.shutdown()
+
+    def test_http_statuses(self, web):
+        assert http_probe(f"{web}/200")[0] == "passing"
+        assert http_probe(f"{web}/429")[0] == "warning"
+        assert http_probe(f"{web}/500")[0] == "critical"
+
+    def test_http_unreachable_critical(self):
+        status, out = http_probe("http://127.0.0.1:1/x", timeout_s=0.3)
+        assert status == "critical"
+
+    def test_tcp_probe(self, web):
+        port = int(web.rsplit(":", 1)[1])
+        assert tcp_probe("127.0.0.1", port)[0] == "passing"
+        # A port nothing listens on.
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        free = s.getsockname()[1]
+        s.close()
+        assert tcp_probe("127.0.0.1", free, timeout_s=0.3)[0] == "critical"
+
+    def test_runner_integration(self, web):
+        from consul_tpu.agent.checks import CheckRunner
+        local = LocalState("n1", "addr")
+        runner = CheckRunner(local)
+        runner.add_http("web-ok", f"{web}/200", interval_s=1.0,
+                        background=False)
+        runner.add_http("web-bad", f"{web}/503", interval_s=1.0,
+                        background=False)
+        runner.tick(0.0)
+        assert local.checks["web-ok"].status == "passing"
+        assert local.checks["web-bad"].status == "critical"
+
+    def test_background_probe_does_not_stall_tick(self, web):
+        from consul_tpu.agent.checks import CheckRunner
+        local = LocalState("n1", "addr")
+        runner = CheckRunner(local)
+        # A target that can never answer, with a long timeout: the tick
+        # must return immediately anyway (the goroutine-per-check model).
+        runner.add_http("hung", "http://10.255.255.1:9/x", interval_s=1.0,
+                        timeout_s=5.0)
+        t0 = time.monotonic()
+        runner.tick(0.0)
+        assert time.monotonic() - t0 < 0.5, "tick blocked on the probe"
+        # The backgrounded result eventually lands.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if local.checks["hung"].output.startswith("HTTP"):
+                break
+            time.sleep(0.1)
+
+
+class TestBootstrapExpect:
+    def member(self, name, expect=3):
+        return {"name": name, "tags": {"role": "consul",
+                                       "expect": str(expect)}}
+
+    def test_no_leader_until_expect_met(self):
+        c = ServerCluster(3, seed=4, bootstrap_expect=3)
+        c.step(300)
+        assert c.raft.leader() is None, "elected before expectation met"
+        assert not c.maybe_bootstrap([self.member("s0"), self.member("s1")])
+        c.step(300)
+        assert c.raft.leader() is None
+        assert c.maybe_bootstrap(
+            [self.member(f"s{i}") for i in range(3)])
+        leader = c.wait_converged()
+        assert leader is not None
+
+    def test_conflicting_expectations_refuse(self):
+        c = ServerCluster(3, seed=5, bootstrap_expect=3)
+        members = [self.member("s0", 3), self.member("s1", 3),
+                   self.member("s2", 5)]
+        assert not c.maybe_bootstrap(members)
+        c.step(200)
+        assert c.raft.leader() is None
+
+    def test_non_server_members_dont_count(self):
+        c = ServerCluster(3, seed=6, bootstrap_expect=3)
+        members = [self.member("s0"), self.member("s1"),
+                   {"name": "client-1", "tags": {"role": "node"}}]
+        assert not c.maybe_bootstrap(members)
