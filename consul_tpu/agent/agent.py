@@ -78,6 +78,10 @@ class Agent:
         self.event_seq = 0
         self.fire_hook: Optional[Callable[[str, bytes], None]] = None
         self._event_cond = threading.Condition()
+        # ForceLeave route into the gossip plane (reference
+        # agent/agent.go ForceLeave -> serf.RemoveFailedNode; the driver
+        # wires this to models/serf.leave on the failed seat).
+        self.force_leave_hook: Optional[Callable[[str], bool]] = None
 
     # -- service/check registration API (reference agent endpoints
     # /v1/agent/service/register etc.) ---------------------------------
@@ -137,6 +141,13 @@ class Agent:
                 self._event_cond.wait(remaining)
                 evs = filtered()
             return index_of(evs), evs
+
+    def force_leave(self, node: str) -> bool:
+        """Transition a failed member to left (reference ForceLeave):
+        forwarded through the driver hook; True when it acted."""
+        if self.force_leave_hook is None:
+            return False
+        return bool(self.force_leave_hook(node))
 
     # -- the periodic work ---------------------------------------------
     def tick(self, now: float) -> dict:

@@ -243,6 +243,12 @@ class HTTPApi:
                     if e["Payload"] else None} for e in evs]
             return 200, out, {"X-Consul-Index": str(idx)}
 
+        if len(parts) == 3 and parts[:2] == ["agent", "force-leave"] and \
+                method == "PUT":
+            # ForceLeave (reference agent/agent.go ForceLeave ->
+            # serf.RemoveFailedNode): route through the driver hook
+            # into the gossip plane; without one it is a no-op.
+            return 200, self.agent.force_leave(parts[2]), {}
         if parts == ["agent", "self"]:
             return 200, {"Config": {"NodeName": self.agent.node},
                          "Member": {"Name": self.agent.node,

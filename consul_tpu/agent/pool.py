@@ -1,0 +1,105 @@
+"""Client-side server pool: routing, rebalancing, failure marking.
+
+The reference multiplexes agent→server RPC over a yamux conn pool and
+keeps the server list shuffled and rebalanced so load spreads and a dead
+server is cycled away from (reference agent/pool/pool.go:122-533;
+agent/router/manager.go:297 RebalanceServers, failed-server rotation
+manager.go NotifyFailedServer). Real sockets don't exist in this
+framework — the pool's *routing policy* does: which server an agent's
+next RPC goes to, how failures rotate it out, and when the list
+reshuffles.
+
+``ServerPool`` wraps a name→rpc-callable map (in-process Server objects
+or bridge-backed remotes alike):
+
+  - round-robin over a shuffled list (manager.go cycles the list head);
+  - ``rpc()`` tries up to ``len(servers)`` entries, rotating past
+    failures (pool.go's redial-next behavior) and raising the last
+    error when all fail;
+  - ``notify_failed`` moves a server to the tail immediately
+    (manager.go NotifyFailedServer);
+  - ``rebalance`` reshuffles on the reference's cadence
+    (manager.go:297, default 2 min scaled by cluster size).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable
+
+REBALANCE_INTERVAL_S = 120.0  # router/manager.go clientRPCMinReuseDuration
+
+
+class NoServersError(ConnectionError):
+    """Every pooled server failed the call (pool.go exhausted)."""
+
+
+class ServerPool:
+    def __init__(self, servers: dict[str, Callable[..., Any]],
+                 seed: int = 0,
+                 rebalance_interval_s: float = REBALANCE_INTERVAL_S):
+        if not servers:
+            raise ValueError("server pool requires at least one server")
+        self._rpcs = dict(servers)
+        self._order = list(servers)
+        self._rng = random.Random(seed)
+        self._rng.shuffle(self._order)
+        self._interval = rebalance_interval_s
+        self._next_rebalance = self._interval
+        self.metrics = {"rpc_calls": 0, "rpc_failures": 0, "rebalances": 0}
+
+    @property
+    def servers(self) -> list[str]:
+        return list(self._order)
+
+    def current(self) -> str:
+        return self._order[0]
+
+    def add(self, name: str, rpc: Callable[..., Any]):
+        if name not in self._rpcs:
+            self._rpcs[name] = rpc
+            # New servers join at a random position (manager.go AddServer
+            # reshuffle-on-change keeps load spread).
+            self._order.insert(self._rng.randrange(len(self._order) + 1), name)
+
+    def remove(self, name: str):
+        """Refuses to drop the last server: an empty pool can route
+        nothing, and the constructor's invariant holds for current()."""
+        if name in self._order and len(self._order) == 1:
+            raise ValueError("cannot remove the last pooled server")
+        self._rpcs.pop(name, None)
+        if name in self._order:
+            self._order.remove(name)
+
+    def notify_failed(self, name: str):
+        """Rotate a failed server to the tail (manager.go
+        NotifyFailedServer) so the next call tries someone else."""
+        if name in self._order:
+            self._order.remove(name)
+            self._order.append(name)
+
+    def rebalance(self, now: float) -> bool:
+        """Reshuffle on the cadence (manager.go RebalanceServers)."""
+        if now < self._next_rebalance:
+            return False
+        self._next_rebalance = now + self._interval
+        self._rng.shuffle(self._order)
+        self.metrics["rebalances"] += 1
+        return True
+
+    def rpc(self, method: str, **args) -> Any:
+        """Issue one RPC through the pool: try the head, rotate past
+        failures, raise NoServersError after a full cycle."""
+        self.metrics["rpc_calls"] += 1
+        last_err: Exception | None = None
+        for _ in range(len(self._order)):
+            name = self._order[0]
+            try:
+                return self._rpcs[name](method, **args)
+            except Exception as e:  # noqa: BLE001 — any failure rotates
+                self.metrics["rpc_failures"] += 1
+                last_err = e
+                self.notify_failed(name)
+        raise NoServersError(
+            f"all {len(self._order)} pooled servers failed {method}"
+        ) from last_err

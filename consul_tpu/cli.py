@@ -158,6 +158,74 @@ def cmd_snapshot(client: Client, args) -> int:
     raise AssertionError(args.snapshot_cmd)
 
 
+def cmd_event(client: Client, args) -> int:
+    """User events (reference command/event: fire via the agent)."""
+    if args.event_cmd == "fire":
+        payload = (args.payload or "").encode()
+        out, _, _ = client._call("PUT", f"/v1/event/fire/{args.name}",
+                                 {}, payload)
+        print(f"Event ID: {out['ID']}")
+        return 0
+    out, _, _ = client._call("GET", "/v1/event/list",
+                             {"name": args.name or None})
+    for e in out:
+        print(f"{e['LTime']:>6}  {e['Name']}  {e['ID']}")
+    return 0
+
+
+def cmd_watch(client: Client, args) -> int:
+    """One-shot or looped watch (reference command/watch over
+    api/watch): prints the JSON result each time the index moves."""
+    from consul_tpu.api import watch as make_watch
+
+    params = {}
+    for kv in args.param or []:
+        k, _, v = kv.partition("=")
+        params[k] = v
+    required = {"key": ["key"], "service": ["service"]}.get(args.type, [])
+    missing = [r for r in required if r not in params]
+    if missing:
+        print(f"watch --type {args.type} requires --param "
+              + " ".join(f"{m}=..." for m in missing), file=sys.stderr)
+        return 1
+    fired = {"n": 0}
+
+    def handler(index, result):
+        fired["n"] += 1
+        print(json.dumps({"Index": index, "Result": result}, default=str))
+
+    plan = make_watch(client, args.type, handler, **params)
+    rounds = args.rounds if args.rounds else (1 if args.once else 0)
+    if rounds:
+        for _ in range(rounds):
+            plan.run_once(wait=args.wait)
+    else:  # pragma: no cover — interactive loop
+        plan.run(wait=args.wait)
+    return 0 if fired["n"] else 1
+
+
+def cmd_force_leave(client: Client, args) -> int:
+    """Force a failed member out (reference command/forceleave →
+    agent ForceLeave → serf.RemoveFailedNode)."""
+    out, _, _ = client._call("PUT", f"/v1/agent/force-leave/{args.node}", {})
+    print(f"Force-leave {args.node}: {'ok' if out else 'no-op'}")
+    return 0
+
+
+def cmd_operator(client: Client, args) -> int:
+    """Operator subcommands (reference command/operator raft)."""
+    if args.operator_cmd == "raft" and args.raft_cmd == "list-peers":
+        leader = client.status.leader()
+        if not leader:
+            print("error: no cluster leader", file=sys.stderr)
+            return 1
+        for p in client.status.peers():
+            role = "leader" if p == leader else "follower"
+            print(f"{p:<12} {role}")
+        return 0
+    raise AssertionError(args.operator_cmd)
+
+
 def cmd_debug(client: Client, args) -> int:
     """Capture a debug bundle over the HTTP API (reference
     command/debug/debug.go captureStatic)."""
@@ -233,6 +301,33 @@ def build_parser() -> argparse.ArgumentParser:
     dbg = sub.add_parser("debug", help="capture a debug bundle")
     dbg.add_argument("--output", default="consul-tpu-debug.tar.gz")
 
+    ev_p = sub.add_parser("event", help="fire or list user events")
+    ev_sub = ev_p.add_subparsers(dest="event_cmd", required=True)
+    ef = ev_sub.add_parser("fire")
+    ef.add_argument("name")
+    ef.add_argument("payload", nargs="?")
+    el = ev_sub.add_parser("list")
+    el.add_argument("name", nargs="?")
+
+    w_p = sub.add_parser("watch", help="watch a view for changes")
+    w_p.add_argument("--type", required=True,
+                     choices=("key", "keyprefix", "services", "nodes",
+                              "service", "checks", "event"))
+    w_p.add_argument("--param", action="append",
+                     help="watch parameter key=value (e.g. key=config/db)")
+    w_p.add_argument("--once", action="store_true")
+    w_p.add_argument("--rounds", type=int, default=0)
+    w_p.add_argument("--wait", default="10s")
+
+    fl = sub.add_parser("force-leave", help="force a failed member out")
+    fl.add_argument("node")
+
+    op_p = sub.add_parser("operator", help="operator tooling")
+    op_sub = op_p.add_subparsers(dest="operator_cmd", required=True)
+    raft_p = op_sub.add_parser("raft")
+    raft_sub = raft_p.add_subparsers(dest="raft_cmd", required=True)
+    raft_sub.add_parser("list-peers")
+
     return p
 
 
@@ -240,6 +335,8 @@ COMMANDS = {
     "members": cmd_members, "rtt": cmd_rtt, "kv": cmd_kv,
     "catalog": cmd_catalog, "info": cmd_info, "services": cmd_services,
     "sessions": cmd_sessions, "snapshot": cmd_snapshot, "debug": cmd_debug,
+    "event": cmd_event, "watch": cmd_watch, "force-leave": cmd_force_leave,
+    "operator": cmd_operator,
 }
 
 

@@ -1,0 +1,181 @@
+"""Layered configuration: files + environment + overrides → SimConfig.
+
+Mirrors the reference config system's shape (reference agent/config/:
+multi-source HCL/JSON files + env + CLI flags merged by ``Builder`` into
+one validated, immutable ``RuntimeConfig``; runtime reload via SIGHUP
+re-applies only a safe subset — agent/agent.go ReloadConfig). Here:
+
+  - sources: JSON config files (merged in order, later wins), then
+    ``CONSUL_TPU_*`` environment variables, then explicit overrides —
+    the same later-source-wins layering as the reference builder;
+  - keys use the dataclass field paths of config.py with ``.``
+    separators (``gossip.probe_interval_ms``, ``n``, ``view_degree``);
+    env vars upper-case them with ``__`` separators
+    (``CONSUL_TPU_GOSSIP__PROBE_INTERVAL_MS=500``);
+  - unknown keys fail loudly (the reference rejects unknown fields);
+  - :func:`diff_reload` classifies a proposed new config against the
+    running one: XLA bakes most simulation knobs into the compiled
+    step at trace time, so anything that changes the compiled program
+    is restart-only — the classification makes that explicit instead
+    of silently ignoring the change (the reference's reload likewise
+    applies only its safe subset and warns about the rest).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Iterable, Mapping, Optional
+
+from consul_tpu.config import GossipConfig, SerfConfig, SimConfig, VivaldiConfig
+
+ENV_PREFIX = "CONSUL_TPU_"
+
+# Fields a running system can apply without recompiling the step
+# program. Everything else is baked into traced constants or array
+# shapes (tick cadences, view degree, capacities) and needs a restart.
+SAFE_RELOAD = frozenset({
+    "world_diameter_ms", "height_ms_min", "height_ms_max",
+    "rtt_jitter_frac", "packet_loss",
+    "serf.reconnect_timeout_ms", "serf.tombstone_timeout_ms",
+})
+
+_SECTIONS = {"gossip": GossipConfig, "vivaldi": VivaldiConfig,
+             "serf": SerfConfig}
+
+
+def _flatten(d: Mapping, prefix: str = "") -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    for k, v in d.items():
+        path = f"{prefix}{k}"
+        if isinstance(v, Mapping):
+            out.update(_flatten(v, path + "."))
+        else:
+            out[path] = v
+    return out
+
+
+def _known_paths() -> dict[str, type]:
+    paths: dict[str, type] = {}
+    for f in dataclasses.fields(SimConfig):
+        if f.name in _SECTIONS:
+            for sf in dataclasses.fields(_SECTIONS[f.name]):
+                paths[f"{f.name}.{sf.name}"] = sf.type
+        else:
+            paths[f.name] = f.type
+    return paths
+
+
+def _coerce(path: str, value: Any, known: Mapping[str, type]) -> Any:
+    """Env values arrive as strings; coerce by target field type."""
+    if not isinstance(value, str):
+        return value
+    ftype = str(known.get(path, ""))
+    if "bool" in ftype:
+        return value.lower() in ("1", "true", "yes", "on")
+    if "int" in ftype:
+        return int(value)
+    if "float" in ftype:
+        return float(value)
+    return value
+
+
+def env_overrides(env: Optional[Mapping[str, str]] = None) -> dict[str, Any]:
+    """CONSUL_TPU_GOSSIP__PROBE_INTERVAL_MS=500 → gossip.probe_interval_ms."""
+    env = os.environ if env is None else env
+    known = _known_paths()
+    out = {}
+    for k, v in env.items():
+        if not k.startswith(ENV_PREFIX):
+            continue
+        path = k[len(ENV_PREFIX):].lower().replace("__", ".")
+        if path in known:
+            out[path] = _coerce(path, v, known)
+    return out
+
+
+def load(paths: Iterable[str] = (),
+         env: Optional[Mapping[str, str]] = None,
+         overrides: Optional[Mapping[str, Any]] = None) -> SimConfig:
+    """Build one validated SimConfig from layered sources (the
+    config.Builder pipeline: files in order, then env, then explicit
+    overrides — later wins)."""
+    flat: dict[str, Any] = {}
+    for p in paths:
+        with open(p, encoding="utf-8") as f:
+            try:
+                doc = json.load(f)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"config file {p}: {e}") from e
+        if not isinstance(doc, dict):
+            raise ValueError(f"config file {p}: top level must be an object")
+        flat.update(_flatten(doc))
+    flat.update(env_overrides(env))
+    for k, v in (overrides or {}).items():
+        flat[k] = v
+
+    known = _known_paths()
+    unknown = sorted(set(flat) - set(known))
+    if unknown:
+        raise ValueError(f"unknown config keys: {unknown}")
+
+    sections: dict[str, dict] = {name: {} for name in _SECTIONS}
+    top: dict[str, Any] = {}
+    for path, value in flat.items():
+        value = _coerce(path, value, known)
+        if "." in path:
+            sec, field = path.split(".", 1)
+            sections[sec][field] = value
+        else:
+            top[path] = value
+    kwargs: dict[str, Any] = dict(top)
+    for name, cls in _SECTIONS.items():
+        if sections[name]:
+            kwargs[name] = cls(**sections[name])
+    return SimConfig(**kwargs)
+
+
+def to_flat(cfg: SimConfig) -> dict[str, Any]:
+    return _flatten(dataclasses.asdict(cfg))
+
+
+def diff_reload(old: SimConfig, new: SimConfig) -> dict[str, list[str]]:
+    """Classify a proposed reload (the SIGHUP path): which changed keys
+    apply live and which require a restart (recompile). Returns
+    {"safe": [...], "restart": [...]} — empty lists mean no change."""
+    a, b = to_flat(old), to_flat(new)
+    changed = sorted(k for k in a if a[k] != b.get(k))
+    return {
+        "safe": [k for k in changed if k in SAFE_RELOAD],
+        "restart": [k for k in changed if k not in SAFE_RELOAD],
+    }
+
+
+def apply_safe(sim, new: SimConfig) -> list[str]:
+    """Apply the safe subset of a reload to a running Simulation
+    (models/cluster.py): rebuild cfg with only SAFE_RELOAD changes so
+    compiled programs stay valid; returns the applied keys."""
+    d = diff_reload(sim.cfg, new)
+    if not d["safe"]:
+        return []
+    flat_new = to_flat(new)
+    merged = to_flat(sim.cfg)
+    for k in d["safe"]:
+        merged[k] = flat_new[k]
+    nested: dict[str, Any] = {}
+    for path, v in merged.items():
+        cur = nested
+        parts = path.split(".")
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[parts[-1]] = v
+    kwargs = dict(nested)
+    for name, cls in _SECTIONS.items():
+        kwargs[name] = cls(**nested[name])
+    sim.cfg = SimConfig(**kwargs)
+    # Changed knobs that feed traced constants (loss, jitter) take
+    # effect on the next runner compilation; invalidate the cache.
+    sim._runners.clear()
+    sim._warmed.clear()
+    return d["safe"]

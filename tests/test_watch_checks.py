@@ -268,3 +268,51 @@ class TestBootstrapExpect:
         members = [self.member("s0"), self.member("s1"),
                    {"name": "client-1", "tags": {"role": "node"}}]
         assert not c.maybe_bootstrap(members)
+
+
+class TestNewCLI:
+    """CLI surface for the new subcommands (reference command/event,
+    command/watch, command/forceleave, command/operator)."""
+
+    def run_cli(self, client, *argv):
+        import io
+        from contextlib import redirect_stdout
+
+        from consul_tpu.cli import main as cli_main
+        host, port = client.base.replace("http://", "").split(":")
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = cli_main(["--http-addr", f"{host}:{port}", *argv])
+        return rc, buf.getvalue()
+
+    def test_event_fire_and_list(self, stack):
+        _, _, client = stack
+        rc, out = self.run_cli(client, "event", "fire", "cli-deploy", "v9")
+        assert rc == 0 and "Event ID:" in out
+        rc, out = self.run_cli(client, "event", "list", "cli-deploy")
+        assert rc == 0 and "cli-deploy" in out
+
+    def test_watch_once(self, stack):
+        _, _, client = stack
+        client.kv.put("cliwatch/a", b"1")
+        rc, out = self.run_cli(
+            client, "watch", "--type", "key",
+            "--param", "key=cliwatch/a", "--once", "--wait", "100ms")
+        assert rc == 0
+        assert json.loads(out.strip())["Result"]["Key"] == "cliwatch/a"
+
+    def test_operator_raft_list_peers(self, stack):
+        _, _, client = stack
+        rc, out = self.run_cli(client, "operator", "raft", "list-peers")
+        assert rc == 0 and "leader" in out and out.count("\n") == 3
+
+    def test_force_leave_via_hook(self, stack):
+        _, agent, client = stack
+        seen = []
+        agent.force_leave_hook = lambda node: (seen.append(node), True)[1]
+        rc, out = self.run_cli(client, "force-leave", "sim-40")
+        assert rc == 0 and "ok" in out
+        assert seen == ["sim-40"]
+        agent.force_leave_hook = None
+        rc, out = self.run_cli(client, "force-leave", "sim-41")
+        assert "no-op" in out
