@@ -428,12 +428,23 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
 
-def serve(api: HTTPApi, host: str = "127.0.0.1", port: int = 0):
+def serve(api: HTTPApi, host: str = "127.0.0.1", port: int = 0,
+          tls=None):
     """Start the HTTP server on a background thread; returns
     (server, bound_port). Port 0 picks a free port (the
-    randomPortsSource idiom of reference agent/testagent.go:376)."""
+    randomPortsSource idiom of reference agent/testagent.go:376).
+    ``tls``: a utils/tls.Configurator makes this an HTTPS listener
+    (the reference's ports.https + tlsutil IncomingHTTPSConfig)."""
     handler = type("BoundHandler", (_Handler,), {"api": api})
     httpd = ThreadingHTTPServer((host, port), handler)
+    if tls is not None:
+        # Defer the handshake off the accept loop: with
+        # do_handshake_on_connect=False the TLS handshake happens on
+        # first IO in the per-connection handler thread, so one stalled
+        # client can never block accept() for everyone else.
+        httpd.socket = tls.incoming_ctx().wrap_socket(
+            httpd.socket, server_side=True,
+            do_handshake_on_connect=False)
     th = threading.Thread(target=httpd.serve_forever, daemon=True)
     th.start()
     return httpd, httpd.server_address[1]

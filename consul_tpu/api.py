@@ -34,8 +34,14 @@ class Client:
     ``kv``, ``catalog``, ``health``, ``session``, ``coordinate``,
     ``status``, ``agent`` (reference api/api.go NewClient)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 8500):
-        self.base = f"http://{host}:{port}"
+    def __init__(self, host: str = "127.0.0.1", port: int = 8500,
+                 scheme: str = "http", ssl_context=None):
+        """``scheme="https"`` with an ``ssl_context`` (e.g.
+        ``utils.tls.Configurator.outgoing_ctx()``) speaks TLS to the
+        agent — the reference client's HttpClient with TLSConfig
+        (api/api.go SetupTLSConfig)."""
+        self.base = f"{scheme}://{host}:{port}"
+        self.ssl_context = ssl_context
         self.kv = KV(self)
         self.catalog = Catalog(self)
         self.health = Health(self)
@@ -52,7 +58,7 @@ class Client:
         url = f"{self.base}{path}" + (f"?{qs}" if qs else "")
         req = urllib.request.Request(url, data=body, method=method)
         try:
-            with urllib.request.urlopen(req) as resp:
+            with urllib.request.urlopen(req, context=self.ssl_context) as resp:
                 payload = json.loads(resp.read() or b"null")
                 idx = int(resp.headers.get("X-Consul-Index", 0))
                 return payload, QueryMeta(idx), resp.status

@@ -36,9 +36,12 @@ ENV_PREFIX = "CONSUL_TPU_"
 # program. Everything else is baked into traced constants or array
 # shapes (tick cadences, view degree, capacities) and needs a restart.
 SAFE_RELOAD = frozenset({
-    "world_diameter_ms", "height_ms_min", "height_ms_max",
+    # Traced constants re-read at the next runner compilation.
     "rtt_jitter_frac", "packet_loss",
     "serf.reconnect_timeout_ms", "serf.tombstone_timeout_ms",
+    # World-shape knobs (world_diameter_ms, height_*) are NOT here:
+    # the planted ground-truth world is built once at Simulation
+    # construction, so changing them requires a restart.
 })
 
 _SECTIONS = {"gossip": GossipConfig, "vivaldi": VivaldiConfig,

@@ -1,0 +1,137 @@
+"""TLS for the HTTP/RPC boundary: contexts + a development CA.
+
+The reference's ``tlsutil.Configurator`` builds hot-reloadable TLS
+configs for RPC/HTTP/gossip from CA + cert/key material, with
+``VerifyIncoming``/``VerifyOutgoing`` gates (reference tlsutil/config.go),
+and auto-encrypt provisions client certs from the server CA (reference
+agent/consul/auto_encrypt*.go). This module is that surface at the
+size this framework needs:
+
+  - :class:`Configurator` — owns cert/key/CA paths, builds server and
+    client ``ssl.SSLContext`` objects, and hot-reloads material in
+    place (``update``), so running listeners pick up rotated certs on
+    the next handshake — the reference's reload contract;
+  - :func:`dev_ca` — a self-signed CA + server certificate generator
+    (the ``consul tls cert create`` developer flow), built on the
+    ``cryptography`` package the keyring already uses.
+
+Gossip-layer encryption is separate and symmetric (wire/keyring.py),
+exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+import ssl
+from typing import Optional
+
+
+def _san(hostname: str):
+    """IP SAN when the hostname parses as an address (v4 or v6), DNS
+    SAN otherwise."""
+    from cryptography import x509
+
+    try:
+        return x509.IPAddress(ipaddress.ip_address(hostname))
+    except ValueError:
+        return x509.DNSName(hostname)
+
+
+def dev_ca(dir_path: str, hostname: str = "127.0.0.1") -> dict[str, str]:
+    """Generate a CA plus a server cert/key signed by it (the
+    ``consul tls ca create`` / ``tls cert create`` developer flow).
+    Returns paths: {ca, cert, key}."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    os.makedirs(dir_path, exist_ok=True)
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    def name(cn):
+        return x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+
+    ca_key = ec.generate_private_key(ec.SECP256R1())
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(name("consul-tpu dev CA"))
+        .issuer_name(name("consul-tpu dev CA"))
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=0),
+                       critical=True)
+        .sign(ca_key, hashes.SHA256())
+    )
+    srv_key = ec.generate_private_key(ec.SECP256R1())
+    srv_cert = (
+        x509.CertificateBuilder()
+        .subject_name(name(hostname))
+        .issuer_name(ca_cert.subject)
+        .public_key(srv_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(
+            x509.SubjectAlternativeName([_san(hostname)]),
+            critical=False,
+        )
+        .sign(ca_key, hashes.SHA256())
+    )
+    paths = {"ca": os.path.join(dir_path, "ca.pem"),
+             "cert": os.path.join(dir_path, "server.pem"),
+             "key": os.path.join(dir_path, "server.key")}
+    with open(paths["ca"], "wb") as f:
+        f.write(ca_cert.public_bytes(serialization.Encoding.PEM))
+    with open(paths["cert"], "wb") as f:
+        f.write(srv_cert.public_bytes(serialization.Encoding.PEM))
+    with open(paths["key"], "wb") as f:
+        f.write(srv_key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption()))
+    return paths
+
+
+class Configurator:
+    """tlsutil.Configurator: build server/client contexts from the
+    current material; ``update`` swaps material in place so running
+    listeners serve the new cert on the next handshake."""
+
+    def __init__(self, cert: str, key: str, ca: Optional[str] = None,
+                 verify_incoming: bool = False):
+        if verify_incoming and not ca:
+            # The reference treats VerifyIncoming without a CA as a hard
+            # config error — never a silent security downgrade
+            # (tlsutil/config.go).
+            raise ValueError("verify_incoming requires a CA file")
+        self.ca = ca
+        self.verify_incoming = verify_incoming
+        self._server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        self.update(cert, key)
+
+    def update(self, cert: str, key: str):
+        """Hot-reload cert material (tlsutil reload contract): the
+        existing server context object — already attached to running
+        listeners — loads the new chain."""
+        self.cert, self.key = cert, key
+        self._server_ctx.load_cert_chain(cert, key)
+        if self.ca and self.verify_incoming:
+            self._server_ctx.load_verify_locations(self.ca)
+            self._server_ctx.verify_mode = ssl.CERT_REQUIRED
+
+    def incoming_ctx(self) -> ssl.SSLContext:
+        """Server-side context (IncomingHTTPSConfig)."""
+        return self._server_ctx
+
+    def outgoing_ctx(self) -> ssl.SSLContext:
+        """Client-side context verifying against the CA
+        (OutgoingRPCConfig with VerifyOutgoing)."""
+        ctx = ssl.create_default_context(
+            cafile=self.ca) if self.ca else ssl.create_default_context()
+        ctx.check_hostname = False  # names are node ids, not DNS names
+        return ctx
