@@ -1,0 +1,170 @@
+"""Remote exec: KV-coordinated command execution across agents.
+
+Mirrors the reference flow (reference agent/remote_exec.go +
+command/exec): the submitter creates a session, writes the job spec at
+``_rexec/<session>/job``, and fires a ``_rexec`` user event whose
+payload names the KV prefix + session. Every participating agent that
+sees the event reads the spec, acknowledges at
+``_rexec/<session>/<node>/ack``, runs the command, streams output
+chunks under ``.../out/<seq>``, and records the exit code at
+``.../exit``. The submitter collects results by watching the prefix
+until the agents it heard from have all exited (or the deadline
+passes), then destroys the session (its delete behavior GCs the
+session-held job key) and delete-trees the response keys — the
+command/exec cleanup path.
+
+Commands here are **callables** (the framework's CheckMonitor
+convention: a callable generalizes the reference's shell-out), so
+simulated fleets can execute anything host-side without forking
+processes; a subprocess runner is one ``lambda`` away.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+from typing import Callable, Optional
+
+from consul_tpu.api import Client, watch
+
+PREFIX = "_rexec"
+EVENT = "_rexec"
+
+
+def submit(client: Client, node: str, command: str,
+           wait_s: float = 5.0, quiesce_s: float = 0.3,
+           target: str = "") -> dict:
+    """Fire a remote-exec job and collect results (the ``consul exec``
+    submitter, command/exec + remote_exec.go flow). Returns
+    {node: {"ack": bool, "output": bytes, "exit": int}}.
+
+    ``target`` names one node to execute on (the reference event
+    payload's node filter); empty targets every worker, and collection
+    then ends only after a ``quiesce_s`` window with no new responders
+    (the reference's ExecWait quiescence, never first-subset-done —
+    a fast responder must not cut off a slower one's results).
+    ``node`` holds the coordination session (the submitter's agent)."""
+    session = client.session.create(node=node, behavior="delete")
+    key_prefix = f"{PREFIX}/{session}"
+    spec = {"Command": command, "Wait": wait_s}
+    if not client.kv.put(f"{key_prefix}/job", json.dumps(spec).encode(),
+                         acquire=session):
+        client.session.destroy(session)
+        raise RuntimeError("remote exec: failed to acquire job key")
+    payload = json.dumps({"Prefix": PREFIX, "Session": session,
+                          "Node": target}).encode()
+    client._call("PUT", f"/v1/event/fire/{EVENT}", {}, payload)
+
+    deadline = time.monotonic() + wait_s
+    results: dict[str, dict] = {}
+    rows_box: dict = {"rows": []}
+    plan = watch(client, "keyprefix",
+                 lambda i, rows: rows_box.update(rows=rows),
+                 prefix=key_prefix + "/")
+    plan.run_once(wait="10ms")  # initial snapshot
+    last_change = time.monotonic()
+    prev_state: tuple = ()
+    while time.monotonic() < deadline:
+        # Blocking keyprefix watch instead of busy polling: run_once
+        # long-polls on the prefix index (api.WatchPlan "keyprefix").
+        plan.run_once(wait="200ms")
+        results = {}
+        acked, exited = set(), set()
+        for r in rows_box["rows"]:
+            tail = r["Key"][len(key_prefix) + 1:]
+            parts = tail.split("/")
+            if len(parts) < 2:
+                continue
+            # Raw API rows carry base64 values (the watch plan speaks
+            # the wire shape, unlike kv.list's decoded convenience).
+            value = base64.b64decode(r["Value"]) if r.get("Value") else b""
+            rnode = parts[0]
+            rec = results.setdefault(
+                rnode, {"ack": False, "output": b"", "exit": None})
+            if parts[1] == "ack":
+                rec["ack"] = True
+                acked.add(rnode)
+            elif parts[1] == "exit":
+                rec["exit"] = int(value)
+                exited.add(rnode)
+            elif parts[1] == "out":
+                rec.setdefault("_chunks", {})[int(parts[2])] = value
+        state = (tuple(sorted(acked)), tuple(sorted(exited)))
+        if state != prev_state:
+            prev_state = state
+            last_change = time.monotonic()
+        if target:
+            if target in exited:
+                break
+        elif acked and acked == exited and \
+                time.monotonic() - last_change >= quiesce_s:
+            break
+    for rec in results.values():
+        chunks = rec.pop("_chunks", {})
+        rec["output"] = b"".join(v for _, v in sorted(chunks.items()))
+    # Cleanup: the session's delete behavior GCs the held job key; the
+    # responders' ack/out/exit keys were written sessionless, so the
+    # submitter delete-trees them (command/exec cleanup).
+    client.session.destroy(session)
+    client.kv.delete(key_prefix + "/", recurse=True)
+    return results
+
+
+class ExecWorker:
+    """Agent-side responder (remote_exec.go handleRemoteExec): watches
+    for ``_rexec`` events, runs the command, uploads ack/out/exit."""
+
+    def __init__(self, client: Client, node: str,
+                 runner: Optional[Callable[[str], tuple[int, bytes]]] = None,
+                 chunk_size: int = 4 * 1024):
+        self.client = client
+        self.node = node
+        # Default runner: a no-op echo (deployments supply their own;
+        # the reference shells out via exec.Command).
+        self.runner = runner or (lambda cmd: (0, cmd.encode()))
+        self.chunk_size = chunk_size
+        self._plan = watch(client, "event", self._on_events, name=EVENT)
+        self._seen: dict[str, None] = {}  # insertion-ordered, bounded
+
+    def poll(self, wait: str = "50ms") -> bool:
+        """One watch round (drivers pump this on their schedule)."""
+        return self._plan.run_once(wait=wait)
+
+    def _on_events(self, index, events):
+        for e in events:
+            payload = e.get("Payload")
+            if not payload:
+                continue
+            try:
+                body = json.loads(base64.b64decode(payload))
+                session = body["Session"]
+            except (ValueError, KeyError, TypeError):
+                continue  # malformed event (wrong shape too): not ours
+            if not isinstance(session, str) or session in self._seen:
+                continue
+            tgt = body.get("Node", "")
+            if tgt and tgt != self.node:
+                continue  # the event names someone else
+            self._seen[session] = None
+            while len(self._seen) > 1024:  # bounded memory
+                self._seen.pop(next(iter(self._seen)))
+            self._execute(body.get("Prefix", PREFIX), session)
+
+    def _execute(self, prefix: str, session: str):
+        base = f"{prefix}/{session}"
+        row, _ = self.client.kv.get(f"{base}/job")
+        if row is None:
+            return  # job already GC'd (late event delivery)
+        try:
+            spec = json.loads(row["Value"])
+        except ValueError:
+            return
+        me = f"{base}/{self.node}"
+        self.client.kv.put(f"{me}/ack", b"")
+        code, out = self.runner(spec.get("Command", ""))
+        for seq in range(0, max(len(out), 1), self.chunk_size):
+            chunk = out[seq:seq + self.chunk_size]
+            if chunk or seq == 0:
+                self.client.kv.put(f"{me}/out/{seq:05d}", chunk)
+        self.client.kv.put(f"{me}/exit", str(int(code)).encode())

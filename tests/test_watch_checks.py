@@ -316,3 +316,103 @@ class TestNewCLI:
         agent.force_leave_hook = None
         rc, out = self.run_cli(client, "force-leave", "sim-41")
         assert "no-op" in out
+
+
+class TestRemoteExec:
+    """The consul-exec flow: session + KV job spec + _rexec event +
+    per-node ack/out/exit + session-GC (reference agent/remote_exec.go,
+    command/exec)."""
+
+    def test_submit_execute_collect(self, stack):
+        from consul_tpu import rexec
+        _, agent, client = stack
+        client.catalog.register("exec-node", "10.0.0.50")
+
+        worker = rexec.ExecWorker(
+            client, "exec-node",
+            runner=lambda cmd: (0, f"ran:{cmd}".encode()))
+        worker.poll()  # prime the event watch index
+
+        done = {}
+
+        def run_submit():
+            done["res"] = rexec.submit(client, "exec-node", "uptime",
+                                       wait_s=6.0)
+
+        th = threading.Thread(target=run_submit)
+        th.start()
+        deadline = time.monotonic() + 6.0
+        while time.monotonic() < deadline and "res" not in done:
+            worker.poll(wait="100ms")
+            time.sleep(0.02)
+        th.join(8)
+        res = done["res"]
+        assert res["exec-node"]["ack"] is True
+        assert res["exec-node"]["exit"] == 0
+        assert res["exec-node"]["output"] == b"ran:uptime"
+        # Session destruction GC'd the job subtree (delete behavior).
+        assert client.kv.list(rexec.PREFIX + "/") == []
+
+    def test_large_output_chunked(self, stack):
+        from consul_tpu import rexec
+        _, _, client = stack
+        client.catalog.register("exec-big", "10.0.0.51")
+        big = bytes(range(256)) * 40  # > 2 chunks at 4 KiB
+        worker = rexec.ExecWorker(client, "exec-big",
+                                  runner=lambda cmd: (3, big))
+        worker.poll()
+        done = {}
+        th = threading.Thread(
+            target=lambda: done.update(
+                res=rexec.submit(client, "exec-big", "dump", wait_s=6.0)))
+        th.start()
+        deadline = time.monotonic() + 6.0
+        while time.monotonic() < deadline and "res" not in done:
+            worker.poll(wait="100ms")
+            time.sleep(0.02)
+        th.join(8)
+        rec = done["res"]["exec-big"]
+        assert rec["exit"] == 3
+        assert rec["output"] == big
+
+    def test_worker_ignores_malformed_event(self, stack):
+        from consul_tpu import rexec
+        _, _, client = stack
+        worker = rexec.ExecWorker(client, "exec-x")
+        worker.poll()
+        client._call("PUT", f"/v1/event/fire/{rexec.EVENT}", {},
+                     b"not json")
+        worker.poll(wait="200ms")  # must not raise
+
+    def test_target_filter_runs_on_named_node_only(self, stack):
+        from consul_tpu import rexec
+        _, _, client = stack
+        client.catalog.register("exec-t1", "10.0.0.52")
+        ran = []
+        w1 = rexec.ExecWorker(client, "exec-t1",
+                              runner=lambda c: (ran.append("t1"), (0, b"1"))[1])
+        w2 = rexec.ExecWorker(client, "exec-t2",
+                              runner=lambda c: (ran.append("t2"), (0, b"2"))[1])
+        w1.poll(); w2.poll()
+        done = {}
+        th = threading.Thread(target=lambda: done.update(
+            res=rexec.submit(client, "exec-t1", "job", wait_s=6.0,
+                             target="exec-t1")))
+        th.start()
+        deadline = time.monotonic() + 6.0
+        while time.monotonic() < deadline and "res" not in done:
+            w1.poll(wait="100ms"); w2.poll(wait="100ms")
+            time.sleep(0.02)
+        th.join(8)
+        assert set(done["res"]) == {"exec-t1"}
+        assert ran == ["t1"], "the non-targeted worker must not execute"
+
+    def test_worker_ignores_non_dict_json_payload(self, stack):
+        from consul_tpu import rexec
+        _, _, client = stack
+        worker = rexec.ExecWorker(client, "exec-y")
+        worker.poll()
+        client._call("PUT", f"/v1/event/fire/{rexec.EVENT}", {},
+                     b'["a list"]')
+        client._call("PUT", f"/v1/event/fire/{rexec.EVENT}", {}, b'3')
+        worker.poll(wait="200ms")  # must not raise
