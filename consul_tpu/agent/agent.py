@@ -82,6 +82,9 @@ class Agent:
         # agent/agent.go ForceLeave -> serf.RemoveFailedNode; the driver
         # wires this to models/serf.leave on the failed seat).
         self.force_leave_hook: Optional[Callable[[str], bool]] = None
+        # Log monitor tap for /v1/agent/monitor (utils/logger.setup
+        # returns one; None until logging is configured).
+        self.monitor = None
 
     # -- service/check registration API (reference agent endpoints
     # /v1/agent/service/register etc.) ---------------------------------

@@ -249,6 +249,19 @@ class HTTPApi:
             # serf.RemoveFailedNode): route through the driver hook
             # into the gossip plane; without one it is a no-op.
             return 200, self.agent.force_leave(parts[2]), {}
+        if parts == ["agent", "monitor"]:
+            # Log streaming (reference /v1/agent/monitor,
+            # http_register.go:38): long-poll the monitor tap with
+            # ?index= + ?loglevel= (the reference streams; the
+            # blocking-query shape fits this framework's HTTP model).
+            if self.agent.monitor is None:
+                return 500, {"error": "no monitor handler configured"}, {}
+            seq, lines = self.agent.monitor.tail(
+                min_index, wait_s if min_index else 0.0,
+                q.get("loglevel", ""))
+            # The raw sequence IS the cursor; flooring it would skip
+            # the first line for clients that connect before any logs.
+            return 200, lines, {"X-Consul-Index": str(seq)}
         if parts == ["agent", "self"]:
             return 200, {"Config": {"NodeName": self.agent.node},
                          "Member": {"Name": self.agent.node,

@@ -160,6 +160,8 @@ class ExecWorker:
             spec = json.loads(row["Value"])
         except ValueError:
             return
+        if not isinstance(spec, dict):
+            return  # wrong-shape job spec: same hardening as the event
         me = f"{base}/{self.node}"
         self.client.kv.put(f"{me}/ack", b"")
         code, out = self.runner(spec.get("Command", ""))

@@ -1,0 +1,154 @@
+"""Serf→server plumbing: tags, member translation, the LAN event loop.
+
+The reference wires gossip into the control plane in three pieces this
+module reproduces (reference agent/consul/server_serf.go):
+
+  - ``setupSerf`` stamps every server's serf member with tags — role,
+    dc, id, port, expect, protocol versions (:33-113) — which is how
+    servers find each other inside a mixed client/server member list
+    (:func:`build_tags` / :func:`parse_tags`, the metadata.IsConsulServer
+    contract);
+  - ``lanEventHandler`` (:131) consumes serf member events and funnels
+    them to ``maybeBootstrap`` (:236, bootstrap-expect) and — via
+    ``reconcileCh`` — the leader's serf↔catalog reconciliation
+    (:class:`LanEventHandler`);
+  - the member list itself; here it comes from the *simulated* gossip
+    plane: :func:`members_from_sim` reads one observer seat's view row
+    (one batched device→host fetch) and translates each subject into
+    the reconcile shape with serf's reap semantics applied — the bridge
+    from the eventually-consistent data plane into the raft-backed
+    catalog, closing the loop the reference closes through
+    serf.Members().
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import numpy as np
+
+from consul_tpu.config import SimConfig, to_ticks
+from consul_tpu.models import coalesce
+from consul_tpu.ops import merge
+from consul_tpu.server.leader import reconcile
+
+VSN_TAGS = {"vsn": "2", "vsn_min": "1", "vsn_max": "3",
+            "raft_vsn": "3", "wan_join_port": "8302"}
+
+
+def build_tags(node_id: str, dc: str = "dc1", server: bool = True,
+               expect: int = 0, port: int = 8300,
+               segment: str = "") -> dict[str, str]:
+    """The setupSerf tag map (server_serf.go:33-113)."""
+    tags = {"id": node_id, "dc": dc, "segment": segment, **VSN_TAGS}
+    if server:
+        tags["role"] = "consul"
+        tags["port"] = str(port)
+        if expect:
+            tags["expect"] = str(expect)
+    else:
+        tags["role"] = "node"
+    return tags
+
+
+def parse_tags(member: dict) -> Optional[dict]:
+    """metadata.IsConsulServer: a member's tags parsed into server
+    attributes, or None for non-server members (clients)."""
+    tags = member.get("tags", {})
+    if tags.get("role") != "consul":
+        return None
+    try:
+        return {
+            "id": tags.get("id", member.get("name", "")),
+            "dc": tags.get("dc", ""),
+            "port": int(tags.get("port", 8300)),
+            "expect": int(tags.get("expect", 0)),
+        }
+    except (TypeError, ValueError):
+        return None  # malformed gossip tags never crash the handler
+
+
+def members_from_sim(cfg: SimConfig, topo, serf_state, observer: int,
+                     name_fn=None) -> list[dict]:
+    """Translate one observer seat's membership view into reconcile's
+    member-dict shape, with reap semantics (serf.go:1544-1568): dead
+    past reconnect-timeout and left past tombstone-timeout report as
+    "reap" so the catalog sweep deregisters them."""
+    name_fn = name_fn or (lambda i: f"sim-{i}")
+    s = serf_state
+    g = cfg.gossip
+    row = np.asarray(s.swim.view_key[observer])
+    down = np.asarray(s.down_since[observer])
+    t = int(s.swim.t)
+    off = np.asarray(topo.off)
+    reconnect = to_ticks(cfg.serf.reconnect_timeout_ms, g.tick_ms)
+    tombstone = to_ticks(cfg.serf.tombstone_timeout_ms, g.tick_ms)
+    n = cfg.n
+    # The local node is always in its own member list (serf.Members()
+    # includes self) — without it the reconcile reap sweep would
+    # deregister the live observer.
+    out = [{"name": name_fn(observer), "address": name_fn(observer),
+            "status": "alive"}]
+    for c in range(row.shape[0]):
+        key = int(row[c])
+        st = merge.key_status_int(key)
+        if key == merge.UNKNOWN:
+            continue  # never-heard subjects are not members yet
+        down_ticks = (t - int(down[c])) if down[c] >= 0 else 0
+        if st == merge.ALIVE or st == merge.SUSPECT:
+            status = "alive"   # suspicion is not yet failure (leader
+            #                    reconcile acts on serf's final states)
+        elif st == merge.DEAD:
+            status = "reap" if down_ticks > reconnect else "failed"
+        else:  # LEFT
+            status = "reap" if down_ticks > tombstone else "left"
+        subject = (observer + int(off[c])) % n
+        out.append({"name": name_fn(subject),
+                    "address": name_fn(subject), "status": status})
+    return out
+
+
+class LanEventHandler:
+    """lanEventHandler (server_serf.go:131): consume member events,
+    maintain the member map, feed bootstrap-expect and the leader's
+    reconcile. Accepts the coalescer's Event stream, so bursts arrive
+    already collapsed (serf wires the coalescer in front of the
+    handler)."""
+
+    def __init__(self, server, cluster=None):
+        self.server = server
+        self.cluster = cluster   # ServerCluster for maybe_bootstrap
+        self.members: dict[str, dict] = {}
+
+    def handle_events(self, events: Iterable[coalesce.Event]) -> list[int]:
+        """Apply a batch of member events; returns reconcile indexes."""
+        for e in events:
+            if e.type == coalesce.MEMBER_JOIN:
+                m = self.members.setdefault(
+                    e.name, {"name": e.name, "tags": {}})
+                m["status"] = "alive"
+                if isinstance(e.payload, dict):
+                    m["tags"] = e.payload
+            elif e.type == coalesce.MEMBER_FAILED:
+                self.members.setdefault(e.name, {"name": e.name})[
+                    "status"] = "failed"
+            elif e.type == coalesce.MEMBER_LEAVE:
+                self.members.setdefault(e.name, {"name": e.name})[
+                    "status"] = "left"
+            elif e.type == coalesce.MEMBER_REAP:
+                self.members.pop(e.name, None)
+        member_list = list(self.members.values())
+        if self.cluster is not None and not self.cluster.bootstrapped:
+            self.cluster.maybe_bootstrap(member_list)
+        if self.server.is_leader():
+            return reconcile(self.server, [
+                {"name": m["name"],
+                 # Never clobber a known catalog address with "": the
+                 # alive path re-registers when addresses differ.
+                 "address": m.get("address")
+                 or (self.server.store.get_node(m["name"]) or {}).get(
+                     "address", ""),
+                 "status": m.get("status", "alive")}
+                for m in member_list
+            ])
+        return []

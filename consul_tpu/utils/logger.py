@@ -1,0 +1,172 @@
+"""Logging infrastructure: gated writer, rotating logfile, monitor tap.
+
+Mirrors the reference logger package (reference logger/): a **gated
+writer** that buffers all output until the logging system is fully
+configured, then flushes and passes through (logger/gated_writer.go —
+exists so early startup lines are not lost or misrouted); a size-based
+**rotating logfile** (logger/logfile.go); and **log streaming** for
+``/v1/agent/monitor`` (agent/http_register.go:38 + logger/log_writer.go:
+a ring of recent lines plus live tailing for attached watchers).
+
+Built over stdlib ``logging`` — handlers, not a parallel framework.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import os
+import threading
+from typing import Optional
+
+LOGGER_NAME = "consul_tpu"
+
+
+class GatedWriter(io.TextIOBase):
+    """Buffer writes until flushed open (logger/gated_writer.go): early
+    startup output is retained, then replayed into the real stream the
+    moment configuration completes."""
+
+    def __init__(self, target):
+        self.target = target
+        self._buf: list[str] = []
+        self._open = False
+        self._lock = threading.Lock()
+
+    def write(self, s: str) -> int:
+        with self._lock:
+            if self._open:
+                return self.target.write(s)
+            self._buf.append(s)
+            return len(s)
+
+    def flush_open(self):
+        """Release the gate: replay the buffer, pass through from now."""
+        with self._lock:
+            for s in self._buf:
+                self.target.write(s)
+            self._buf.clear()
+            self._open = True
+
+    def flush(self):
+        if self._open:
+            self.target.flush()
+
+
+class RotatingFileHandler(logging.Handler):
+    """Size-rotated logfile (logger/logfile.go: rotate at max_bytes,
+    keep ``backups`` rotated files)."""
+
+    def __init__(self, path: str, max_bytes: int = 1 << 20,
+                 backups: int = 3):
+        super().__init__()
+        self.path = path
+        self.max_bytes = max_bytes
+        self.backups = backups
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def emit(self, record: logging.LogRecord):
+        line = self.format(record) + "\n"
+        self._fh.write(line)
+        self._fh.flush()
+        if self._fh.tell() >= self.max_bytes:
+            self.rotate()
+
+    def rotate(self):
+        self._fh.close()
+        for i in range(self.backups - 1, 0, -1):
+            src, dst = f"{self.path}.{i}", f"{self.path}.{i + 1}"
+            if os.path.exists(src):
+                os.replace(src, dst)
+        if self.backups > 0:
+            os.replace(self.path, f"{self.path}.1")
+        else:
+            os.unlink(self.path)
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    def close(self):
+        self._fh.close()
+        super().close()
+
+
+class MonitorHandler(logging.Handler):
+    """The /v1/agent/monitor tap (logger/log_writer.go): a bounded ring
+    of recent lines plus a condition for live long-polling."""
+
+    def __init__(self, capacity: int = 512):
+        super().__init__()
+        self.capacity = capacity
+        self._lines: list[tuple[int, str]] = []
+        self._seq = 0
+        self._cond = threading.Condition()
+
+    def emit(self, record: logging.LogRecord):
+        with self._cond:
+            self._seq += 1
+            self._lines.append((self._seq, self.format(record)))
+            del self._lines[:-self.capacity]
+            self._cond.notify_all()
+
+    _LEVELS = ("DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL")
+    _ALIASES = {"TRACE": "DEBUG", "WARN": "WARNING", "ERR": "ERROR"}
+
+    def tail(self, min_seq: int = 0, wait_s: float = 0.0,
+             level: str = "") -> tuple[int, list[str]]:
+        """Lines after ``min_seq`` (blocking up to ``wait_s`` for new
+        ones), filtered at-or-above ``level`` — the monitor endpoint's
+        ?loglevel semantics, accepting consul-conventional names
+        (warn/err) as well as Python's."""
+        import time
+
+        deadline = time.monotonic() + wait_s
+        with self._cond:
+            while self._seq <= min_seq:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            out = [line for seq, line in self._lines if seq > min_seq]
+            if level:
+                name = self._ALIASES.get(level.upper(), level.upper())
+                if name in self._LEVELS:
+                    allowed = self._LEVELS[self._LEVELS.index(name):]
+                    out = [l for l in out
+                           if any(f"[{a}]" in l for a in allowed)]
+            return self._seq, out
+
+
+_FORMAT = "%(asctime)s [%(levelname)s] %(name)s: %(message)s"
+
+
+def setup(level: str = "INFO", log_file: Optional[str] = None,
+          max_bytes: int = 1 << 20, backups: int = 3,
+          stream=None, monitor_capacity: int = 512):
+    """Configure the framework logger (the logger/ setup flow): a gated
+    stream writer (released once handlers are attached), optional
+    rotating file, and the monitor tap. Returns (logger, monitor,
+    gate)."""
+    log = logging.getLogger(LOGGER_NAME)
+    log.setLevel(level.upper())
+    for h in list(log.handlers):
+        log.removeHandler(h)
+        h.close()  # reconfigure must not leak file descriptors
+    fmt = logging.Formatter(_FORMAT)
+
+    import sys
+
+    gate = GatedWriter(stream if stream is not None else sys.stderr)
+    sh = logging.StreamHandler(gate)
+    sh.setFormatter(fmt)
+    log.addHandler(sh)
+
+    if log_file:
+        fh = RotatingFileHandler(log_file, max_bytes, backups)
+        fh.setFormatter(fmt)
+        log.addHandler(fh)
+
+    monitor = MonitorHandler(monitor_capacity)
+    monitor.setFormatter(fmt)
+    log.addHandler(monitor)
+
+    gate.flush_open()
+    return log, monitor, gate

@@ -1,0 +1,133 @@
+"""Serf→server plumbing: tags, the LAN event loop, and the full
+data-plane→catalog slice — a simulated gossip cluster detecting a death
+that a leader then reconciles into the raft-backed catalog (reference
+agent/consul/server_serf.go:33-113 setupSerf tags, :131 lanEventHandler,
+:236 maybeBootstrap; leader.go reconcile)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from consul_tpu.config import SerfConfig, SimConfig
+from consul_tpu.models import coalesce
+from consul_tpu.models import serf as serf_mod
+from consul_tpu.models import state as sim_state
+from consul_tpu.ops import topology
+from consul_tpu.server.endpoints import ServerCluster
+from consul_tpu.server.serf_plumbing import (LanEventHandler, build_tags,
+                                             members_from_sim, parse_tags)
+
+
+class TestTags:
+    def test_server_tags_roundtrip(self):
+        tags = build_tags("s1", dc="dc2", expect=3, port=8305)
+        info = parse_tags({"name": "s1", "tags": tags})
+        assert info == {"id": "s1", "dc": "dc2", "port": 8305, "expect": 3}
+
+    def test_client_member_is_not_server(self):
+        tags = build_tags("c1", server=False)
+        assert parse_tags({"name": "c1", "tags": tags}) is None
+
+    def test_malformed_tags_never_crash(self):
+        assert parse_tags({"tags": {"role": "consul", "port": "x"}}) is None
+        assert parse_tags({}) is None
+
+
+class TestLanEventHandler:
+    def make(self):
+        c = ServerCluster(3, seed=41)
+        leader = c.wait_converged()
+        return c, leader
+
+    def run_writes(self, c, fn):
+        out = fn()
+        c.step(80)
+        return out
+
+    def test_join_fail_reap_to_catalog(self):
+        c, leader = self.make()
+        h = LanEventHandler(leader, c)
+        self.run_writes(c, lambda: h.handle_events([
+            coalesce.Event(coalesce.MEMBER_JOIN, name="n1"),
+            coalesce.Event(coalesce.MEMBER_JOIN, name="n2"),
+        ]))
+        assert leader.store.get_node("n1") is not None
+        self.run_writes(c, lambda: h.handle_events([
+            coalesce.Event(coalesce.MEMBER_FAILED, name="n1"),
+        ]))
+        checks = {ch["node"]: ch for ch in leader.store.checks()}
+        assert checks["n1"]["status"] == "critical"
+        assert checks["n2"]["status"] == "passing"
+        # Reap removes the member entirely -> catalog sweep deregisters.
+        self.run_writes(c, lambda: h.handle_events([
+            coalesce.Event(coalesce.MEMBER_REAP, name="n1"),
+        ]))
+        assert leader.store.get_node("n1") is None
+        assert leader.store.get_node("n2") is not None
+
+    def test_bootstrap_expect_via_member_events(self):
+        c = ServerCluster(3, seed=42, bootstrap_expect=3)
+        h = LanEventHandler(c.servers[0], c)
+        for i in range(2):
+            h.handle_events([coalesce.Event(
+                coalesce.MEMBER_JOIN, name=f"s{i}",
+                payload=build_tags(f"s{i}", expect=3))])
+        c.step(200)
+        assert c.raft.leader() is None
+        h.handle_events([coalesce.Event(
+            coalesce.MEMBER_JOIN, name="s2",
+            payload=build_tags("s2", expect=3))])
+        assert c.bootstrapped
+        assert c.wait_converged() is not None
+
+
+class TestSimToCatalogSlice:
+    def test_detected_death_reconciled_into_catalog(self):
+        """The whole loop: the vectorized gossip plane detects a death;
+        the observer's view feeds the leader; the catalog records the
+        critical serfHealth — SURVEY's coordinate-slice idiom applied
+        to membership."""
+        cfg = SimConfig(n=48, view_degree=16)
+        key = jax.random.PRNGKey(3)
+        kw, kn, ks = jax.random.split(key, 3)
+        world = topology.make_world(cfg, kw)
+        topo = topology.make_topology(cfg, kn)
+        state = serf_mod.init(cfg, ks)
+        step = jax.jit(lambda st, k: serf_mod.step(cfg, topo, world, st, k))
+
+        victim = int(topology.nbrs_table(topo)[0, 3])
+        state = state._replace(
+            swim=sim_state.kill(state.swim, jnp.arange(cfg.n) == victim))
+        base = jax.random.PRNGKey(9)
+        for i in range(300):
+            state = step(state, jax.random.fold_in(base, i))
+
+        members = members_from_sim(cfg, topo, state, observer=0)
+        by_name = {m["name"]: m for m in members}
+        assert by_name[f"sim-{victim}"]["status"] == "failed"
+        assert by_name["sim-0"]["status"] == "alive"  # self included
+        # degree - 1 live neighbors + the observer itself.
+        assert sum(m["status"] == "alive" for m in members) == topo.degree
+
+        c = ServerCluster(3, seed=43)
+        leader = c.wait_converged()
+        h = LanEventHandler(leader, c)
+        # The cluster formed before the death: every member joined the
+        # catalog first (a failed event for a catalog-unknown member is
+        # deliberately ignored, reference handleFailedMember
+        # leader.go: "does not exist in the catalog").
+        h.handle_events([coalesce.Event(coalesce.MEMBER_JOIN, name=m["name"])
+                         for m in members])
+        c.step(120)
+        # Now the sim-detected states arrive (the death included).
+        events = [coalesce.Event(
+            coalesce.MEMBER_JOIN if m["status"] == "alive"
+            else coalesce.MEMBER_FAILED, name=m["name"]) for m in members]
+        h.handle_events(events)
+        c.step(120)
+        h.handle_events([])  # leader retries reconcile after commit
+        c.step(120)
+        checks = {ch["node"]: ch["status"] for ch in leader.store.checks()}
+        assert checks[f"sim-{victim}"] == "critical"
+        alive_names = [m["name"] for m in members if m["status"] == "alive"]
+        assert all(checks.get(n) == "passing" for n in alive_names)
