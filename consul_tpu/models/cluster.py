@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from consul_tpu.config import SimConfig
+from consul_tpu.models import serf as serf_mod
 from consul_tpu.models import state as sim_state
 from consul_tpu.models import swim
 from consul_tpu.ops import topology
@@ -131,7 +132,7 @@ class Simulation:
             live_nodes=jnp.int32(0),
         )
         telemetry.emit_sim_metrics(
-            self.state, self.sink,
+            self.swim_state, self.sink,
             health=h, rmse_s=float(trace.rmse[-1]),
             rounds_per_sec=(ticks / wall_s if wall_s else None),
             chunk_wall_s=wall_s, chunk_ticks=ticks,
@@ -177,17 +178,105 @@ class Simulation:
         """
         runner = self._runner(ticks, False)
         self.state, _ = runner(self.state, self.base_key)
-        jax.block_until_ready(self.state.view_key)
+        jax.block_until_ready(self.swim_state.view_key)
         t0 = time.perf_counter()
         self.state, _ = runner(self.state, self.base_key)
-        jax.block_until_ready(self.state.view_key)
+        jax.block_until_ready(self.swim_state.view_key)
         return ticks / (time.perf_counter() - t0)
 
     # -- inspection -----------------------------------------------------
     def health(self) -> metrics.HealthMetrics:
-        return metrics.health(self.cfg, self.topo, self.state)
+        return metrics.health(self.cfg, self.topo, self.swim_state)
 
     def rmse(self, seed: int = 99) -> float:
-        return float(
-            metrics.vivaldi_rmse(self.cfg, self.world, self.state, jax.random.PRNGKey(seed))
-        )
+        return float(metrics.vivaldi_rmse(
+            self.cfg, self.world, self.swim_state, jax.random.PRNGKey(seed)))
+
+    # -- uniform SWIM-state accessors (the transport bridge and other
+    # host components work on the SWIM plane regardless of whether the
+    # driver runs bare SWIM or the full serf stack) --------------------
+    @property
+    def swim_state(self) -> sim_state.SimState:
+        return self.state
+
+    def set_swim_state(self, st: sim_state.SimState):
+        self.state = st
+
+    @property
+    def serf_state(self):
+        return None  # bare-SWIM driver has no serf plane
+
+
+@dataclasses.dataclass
+class SerfSimulation(Simulation):
+    """The full-stack driver: serf.step (SWIM + events + queries +
+    reap) instead of the bare SWIM step. Same chunked-scan execution,
+    metrics, and telemetry; adds the serf-layer verbs."""
+
+    def __post_init__(self):
+        key = jax.random.PRNGKey(self.seed)
+        kw, kn, ks, kb = jax.random.split(key, 4)
+        self.world = topology.make_world(self.cfg, kw)
+        self.topo = topology.make_topology(self.cfg, kn)
+        self.state = serf_mod.init(self.cfg, ks)
+        self.base_key = kb
+        self._runners = {}
+        self._warmed = set()
+        self.sink = telemetry.Sink()
+
+    def _runner(self, chunk: int, with_metrics: bool):
+        k = (chunk, with_metrics)
+        if k not in self._runners:
+            cfg, topo, world = self.cfg, self.topo, self.world
+
+            def body(state, tick_key):
+                state = serf_mod.step(cfg, topo, world, state, tick_key)
+                if not with_metrics:
+                    return state, ()
+                h = metrics.health(cfg, topo, state.swim)
+                rmse = metrics.vivaldi_rmse(
+                    cfg, world, state.swim,
+                    jax.random.fold_in(tick_key, 1), samples=2048)
+                return state, TickTrace(h.agreement, h.false_positive,
+                                        h.undetected, rmse)
+
+            def run(state, base_key):
+                ticks = state.swim.t + jnp.arange(chunk)
+                tick_keys = jax.vmap(
+                    lambda t: jax.random.fold_in(base_key, t))(ticks)
+                return jax.lax.scan(body, state, tick_keys)
+
+            self._runners[k] = jax.jit(run, donate_argnums=(0,))
+        return self._runners[k]
+
+    # -- serf verbs -----------------------------------------------------
+    def user_event(self, mask, name: int):
+        self.state = serf_mod.user_event(self.cfg, self.state,
+                                         jnp.asarray(mask), name)
+
+    def query(self, mask, name: int):
+        self.state = serf_mod.query(self.cfg, self.state,
+                                    jnp.asarray(mask), name)
+
+    def leave(self, mask):
+        self.state = serf_mod.leave(self.cfg, self.state, jnp.asarray(mask))
+
+    def kill(self, mask):
+        self.state = self.state._replace(
+            swim=sim_state.kill(self.state.swim, jnp.asarray(mask)))
+
+    def revive(self, mask):
+        self.state = self.state._replace(
+            swim=sim_state.revive(self.cfg, self.state.swim,
+                                  jnp.asarray(mask)))
+
+    @property
+    def swim_state(self) -> sim_state.SimState:
+        return self.state.swim
+
+    def set_swim_state(self, st: sim_state.SimState):
+        self.state = self.state._replace(swim=st)
+
+    @property
+    def serf_state(self):
+        return self.state

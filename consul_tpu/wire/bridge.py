@@ -58,6 +58,7 @@ from __future__ import annotations
 
 import dataclasses
 import queue
+import zlib
 from typing import Optional
 
 import jax.numpy as jnp
@@ -234,6 +235,19 @@ class PacketBridge:
         self._pending_streams: list = []
         # Host-side copy of the offset table (no per-fact transfers).
         self._off = np.asarray(sim.topo.off)
+        # Serf user events (needs a serf-level driver, cluster.
+        # SerfSimulation): fired-event staging, the string<->int name
+        # registry (the sim's event plane keys names as 8-bit ints —
+        # models/serf.py make_event_key; a documented narrowing), and
+        # per-agent delivered-event dedup for the outbound feed.
+        self._stage_fired: list[tuple[int, int]] = []   # (seat, name_int)
+        self._event_names: dict[int, str] = {}
+        # (first-name, colliding-name) pairs for operators to inspect.
+        self.collisions: list[tuple[str, str]] = []
+        # Bounded per-agent delivered-key dedup (insertion-ordered; the
+        # sim's own retention is ltime-bucketed, so old keys can never
+        # redeliver once evicted here either).
+        self._delivered_events: dict[int, dict] = {}
 
     # ------------------------------------------------------------------
     # Attachment
@@ -256,7 +270,7 @@ class PacketBridge:
         of a simulated member's seat."""
         if seat in self.transports:
             raise ValueError(f"seat {seat} already attached")
-        st = self.sim.state
+        st = self.sim.swim_state
         if not replace and bool(st.alive_truth[seat]) \
                 and not bool(st.external[seat]) and not bool(st.left[seat]):
             votes_alive = 0
@@ -280,20 +294,20 @@ class PacketBridge:
         mask = np.zeros(self.sim.cfg.n, bool)
         mask[seat] = True
         m = jnp.asarray(mask)
-        self.sim.state = st._replace(
+        self.sim.set_swim_state(st._replace(
             external=st.external | m,
             alive_truth=st.alive_truth | m,
             left=st.left & ~m,
-        )
+        ))
         t = BridgeTransport(self, seat)
         self.transports[seat] = t
-        self._next_probe[seat] = int(self.sim.state.t) + 1
+        self._next_probe[seat] = int(self.sim.swim_state.t) + 1
         self._misses[seat] = 0
         return t
 
     def now(self) -> float:
         g = self.sim.cfg.gossip
-        return float(int(self.sim.state.t)) * g.tick_ms / 1000.0
+        return float(int(self.sim.swim_state.t)) * g.tick_ms / 1000.0
 
     def _model_rtt(self, a: int, b: int) -> float:
         return float(topology.true_rtt(self.sim.world, a, b))
@@ -341,10 +355,11 @@ class PacketBridge:
             # Answer on behalf of the sim node, ack payload = its
             # coordinate (ping_delegate.go:28-45); the ack's timestamp
             # carries the model RTT (see module docstring).
-            if not bool(self.sim.state.alive_truth[to_seat]) or \
-                    bool(self.sim.state.left[to_seat]):
+            st = self.sim.swim_state
+            if not bool(st.alive_truth[to_seat]) or \
+                    bool(st.left[to_seat]):
                 return
-            v = self.sim.state.viv
+            v = st.viv
             payload = encode_coordinate(
                 np.asarray(v.vec[to_seat]), float(v.height[to_seat]),
                 float(v.error[to_seat]), float(v.adjustment[to_seat]),
@@ -378,6 +393,22 @@ class PacketBridge:
             # read as "the agent's state".
             self._merge_fact(from_seat, body["Node"],
                              body["Incarnation"], status)
+        elif mtype == MessageType.USER:
+            # Serf envelope (serf rides memberlist user messages).
+            stype, sbody = codec.decode_serf_message(body.get("Raw", b""))
+            if stype == codec.SERF_USER_EVENT and \
+                    self.sim.serf_state is not None:
+                name = str(sbody.get("Name", ""))
+                name_int = zlib.crc32(name.encode()) & 0xFF
+                prior = self._event_names.get(name_int)
+                if prior is not None and prior != name:
+                    # 8-bit name-space collision (documented narrowing):
+                    # first name wins the registry; the collision is
+                    # surfaced instead of silently relabeling events.
+                    self.collisions.append((prior, name))
+                else:
+                    self._event_names[name_int] = name
+                self._stage_fired.append((from_seat, name_int))
         elif mtype == MessageType.INDIRECT_PING:
             # Relay: target reachability from ground truth; ack or nack
             # back to the requester (net.go handleIndirectPing:491).
@@ -385,8 +416,9 @@ class PacketBridge:
             target = self._seat_of(
                 codec.as_bytes(raw_t).decode("utf-8", "surrogateescape")
                 if not isinstance(raw_t, str) else raw_t)
-            up = bool(self.sim.state.alive_truth[target]) and \
-                not bool(self.sim.state.left[target])
+            stt = self.sim.swim_state
+            up = bool(stt.alive_truth[target]) and \
+                not bool(stt.left[target])
             rtt2 = self._model_rtt(to_seat, target)
             if up:
                 ack = codec.encode_message(
@@ -450,7 +482,7 @@ class PacketBridge:
         # caller's frame arrives (streams are "more expensive ...
         # infrequent", transport.go:50-54); unanswered dials expire
         # after a generous window.
-        deadline = int(self.sim.state.t) + 50
+        deadline = int(self.sim.swim_state.t) + 50
         self._pending_streams.append((from_seat, to_seat, peer, deadline))
         return s
 
@@ -483,7 +515,7 @@ class PacketBridge:
         # announcement must reach. Statuses come from the seat
         # directory (ground truth + incarnation), the converged
         # cluster's answer.
-        st = self.sim.state
+        st = self.sim.swim_state
         states = [self._push_node_state(to_seat)]
         topo = self.sim.topo
         off = self._off
@@ -499,7 +531,7 @@ class PacketBridge:
         return True
 
     def _push_node_state(self, seat: int) -> dict:
-        st = self.sim.state
+        st = self.sim.swim_state
         if bool(st.left[seat]):
             wire = WIRE_LEFT
         elif bool(st.alive_truth[seat]):
@@ -525,7 +557,7 @@ class PacketBridge:
         neighbor's hottest facts piggybacked (gossip rides probe
         packets, net.go:631 piggyback)."""
         g = self.sim.cfg.gossip
-        t_now = int(self.sim.state.t)
+        t_now = int(self.sim.swim_state.t)
         topo = self.sim.topo
         n = self.sim.cfg.n
         off = self._off
@@ -545,7 +577,7 @@ class PacketBridge:
             # Rotate through in-neighbors as probe sources.
             c = (t_now // g.probe_period_ticks) % topo.degree
             src = (seat - int(off[c])) % n
-            if not bool(self.sim.state.alive_truth[src]):
+            if not bool(self.sim.swim_state.alive_truth[src]):
                 continue
             self._seq += 1
             self._pending[seat] = (self._seq, t_now + g.probe_timeout_ticks)
@@ -553,8 +585,8 @@ class PacketBridge:
                 MessageType.PING,
                 {"SeqNo": self._seq, "Node": seat_name(seat)})]
             # Piggyback the source's hottest facts as gossip.
-            src_view = np.asarray(self.sim.state.view_key[src])
-            src_tx = np.asarray(self.sim.state.tx_left[src])
+            src_view = np.asarray(self.sim.swim_state.view_key[src])
+            src_tx = np.asarray(self.sim.swim_state.tx_left[src])
             hot = np.argsort(-src_tx)[:g.piggyback_msgs]
             for c2 in hot:
                 if src_tx[c2] <= 0:
@@ -579,12 +611,53 @@ class PacketBridge:
             self._deliver(seat, codec.encode_packet(msgs),
                           seat_addr(src), self.now() + rtt)
 
+    def _emit_events(self, t_now: int):
+        """The serf delegate's event feed for attached agents
+        (GetBroadcasts piggyback, serf/delegate.go:19-282): every tick,
+        scan a rotating in-neighbor's event queue and deliver any event
+        the agent has not seen — the rotation visits all K in-neighbors
+        within K ticks, well inside the dedup buffer's retention, so an
+        epidemic that reached ANY in-neighbor reaches the agent."""
+        s = self.sim.serf_state
+        n = self.sim.cfg.n
+        k_deg = self._off.shape[0]
+        up = np.asarray(self.sim.swim_state.alive_truth &
+                        ~self.sim.swim_state.left)
+        for seat, tr in self.transports.items():
+            if tr.down:
+                continue
+            src = (seat - int(self._off[t_now % k_deg])) % n
+            if not up[src]:
+                continue  # dead members never source event traffic
+            keys = np.asarray(s.ev_key[src])
+            seen = self._delivered_events.setdefault(seat, {})
+            out = []
+            for slot in range(keys.shape[0]):
+                key = int(keys[slot])
+                if key == 0 or key in seen or (key & 1):
+                    continue  # empty, already delivered, or a query
+                seen[key] = None
+                while len(seen) > 4096:
+                    seen.pop(next(iter(seen)))
+                name_int = (key >> 1) & 0xFF
+                out.append(codec.encode_serf_message(
+                    codec.SERF_USER_EVENT, {
+                        "LTime": key >> 9,
+                        "Name": self._event_names.get(
+                            name_int, f"evt-{name_int}"),
+                        "Payload": b"", "CC": True,
+                    }))
+            if out:
+                rtt = self._model_rtt(src, seat)
+                self._deliver(seat, codec.encode_packet(out),
+                              seat_addr(src), self.now() + rtt)
+
     # ------------------------------------------------------------------
     # The per-tick host boundary
     # ------------------------------------------------------------------
     def step(self):
         """Process staged traffic both ways; call after each sim tick."""
-        t_now = int(self.sim.state.t)
+        t_now = int(self.sim.swim_state.t)
         still = []
         for from_seat, to_seat, stream, deadline in self._pending_streams:
             if not self._serve_stream(from_seat, to_seat, stream) \
@@ -592,10 +665,12 @@ class PacketBridge:
                 still.append((from_seat, to_seat, stream, deadline))
         self._pending_streams = still
         self._emit_probes_and_gossip()
+        if self.sim.serf_state is not None:
+            self._emit_events(t_now)
         self._apply_staged()
 
     def _apply_staged(self):
-        st = self.sim.state
+        st = self.sim.swim_state
         if self._stage_view:
             rows = jnp.asarray([r for r, _, _ in self._stage_view], jnp.int32)
             cols = jnp.asarray([c for _, c, _ in self._stage_view], jnp.int32)
@@ -639,7 +714,23 @@ class PacketBridge:
                 alive = alive.at[seat].set(up)
             st = st._replace(alive_truth=alive)
             self._stage_alive = {}
-        self.sim.state = st
+        self.sim.set_swim_state(st)
+        if self._stage_fired and self.sim.serf_state is not None:
+            # Fire the agents' user events into the sim event plane
+            # (serf.UserEvent from the external seats' queues; the
+            # event plane broadcasts them like any member's).
+            from consul_tpu.models import serf as serf_mod
+
+            n = self.sim.cfg.n
+            by_name: dict[int, np.ndarray] = {}
+            for seat, name_int in self._stage_fired:
+                m = by_name.setdefault(name_int, np.zeros(n, bool))
+                m[seat] = True
+            for name_int, mask in by_name.items():
+                self.sim.state = serf_mod.user_event(
+                    self.sim.cfg, self.sim.serf_state,
+                    jnp.asarray(mask), name_int)
+            self._stage_fired = []
 
     def run(self, ticks: int):
         """Advance sim + bridge together, one tick at a time (the

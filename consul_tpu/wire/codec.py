@@ -100,11 +100,54 @@ def as_bytes(field) -> bytes:
 def decode_message(buf: bytes) -> tuple[MessageType, dict]:
     if not buf:
         raise ValueError("empty message")
+    if buf[0] == MessageType.USER:
+        # User messages are opaque to memberlist ([userMsg | raw],
+        # net.go handleUser hands the raw bytes to the delegate);
+        # serf's envelope inside is decoded by the consumer
+        # (decode_serf_message).
+        return MessageType.USER, {"Raw": buf[1:]}
     # Legacy-raw fields (Addr, Meta, Payload) hold arbitrary bytes that
     # are not necessarily UTF-8; surrogateescape keeps them lossless
     # (re-encode with the same handler to recover the bytes).
     return MessageType(buf[0]), msgpack.unpackb(
         buf[1:], raw=False, unicode_errors="surrogateescape")
+
+
+# ----------------------------------------------------------------------
+# Serf envelope: serf rides memberlist user messages as
+# [userMsg | serf messageType byte | msgpack body]
+# (serf/delegate.go NotifyMsg dispatches on the first byte;
+# serf/messages.go:10-25 type ids).
+# ----------------------------------------------------------------------
+
+SERF_LEAVE = 0
+SERF_JOIN = 1
+SERF_PUSH_PULL = 2
+SERF_USER_EVENT = 3
+SERF_QUERY = 4
+SERF_QUERY_RESPONSE = 5
+
+
+def encode_serf_message(serf_type: int, body: dict) -> bytes:
+    """One serf message ready for Transport.WriteTo: the memberlist
+    user envelope around the serf type byte + go-msgpack body."""
+    return bytes([MessageType.USER, serf_type]) + _pack_go(body)
+
+
+def decode_serf_message(raw) -> tuple[int, dict]:
+    """Inverse of :func:`encode_serf_message` given a USER message's
+    Raw bytes (str via surrogateescape accepted)."""
+    raw = as_bytes(raw)
+    if not raw:
+        raise ValueError("empty serf message")
+    try:
+        body = msgpack.unpackb(raw[1:], raw=False,
+                               unicode_errors="surrogateescape")
+    except msgpack.exceptions.UnpackException as e:
+        raise ValueError(f"malformed serf message: {e!r}") from e
+    if not isinstance(body, dict):
+        raise ValueError("serf message body must be a map")
+    return raw[0], body
 
 
 # ----------------------------------------------------------------------
