@@ -36,19 +36,24 @@ class TickTrace(NamedTuple):
     rmse: jax.Array            # [C] f32
 
 
-def _chunk_runner(cfg: SimConfig, topo, world, chunk: int, with_metrics: bool):
+def _chunk_runner(cfg: SimConfig, topo, world, chunk: int, with_metrics: bool,
+                  step_fn=swim.step, swim_of=lambda st: st):
+    """One compiled chunk program. ``step_fn`` is the per-tick step
+    (bare SWIM or the full serf stack); ``swim_of`` projects the SWIM
+    plane out of the step's state for metrics."""
     def body(state, tick_key):
-        state = swim.step(cfg, topo, world, state, tick_key)
+        state = step_fn(cfg, topo, world, state, tick_key)
         if not with_metrics:
             return state, ()
-        h = metrics.health(cfg, topo, state)
+        sw = swim_of(state)
+        h = metrics.health(cfg, topo, sw)
         rmse = metrics.vivaldi_rmse(
-            cfg, world, state, jax.random.fold_in(tick_key, 1), samples=2048
+            cfg, world, sw, jax.random.fold_in(tick_key, 1), samples=2048
         )
         return state, TickTrace(h.agreement, h.false_positive, h.undetected, rmse)
 
     def run(state, base_key):
-        ticks = state.t + jnp.arange(chunk)
+        ticks = swim_of(state).t + jnp.arange(chunk)
         tick_keys = jax.vmap(lambda t: jax.random.fold_in(base_key, t))(ticks)
         return jax.lax.scan(body, state, tick_keys)
 
@@ -62,12 +67,19 @@ class Simulation:
     cfg: SimConfig
     seed: int = 0
 
+    # Driver hooks (SerfSimulation overrides these two).
+    _step_fn = staticmethod(swim.step)
+    _swim_of = staticmethod(lambda st: st)
+
+    def _init_state(self, key):
+        return sim_state.init(self.cfg, key)
+
     def __post_init__(self):
         key = jax.random.PRNGKey(self.seed)
         kw, kn, ks, kb = jax.random.split(key, 4)
         self.world = topology.make_world(self.cfg, kw)
         self.topo = topology.make_topology(self.cfg, kn)
-        self.state = sim_state.init(self.cfg, ks)
+        self.state = self._init_state(ks)
         self.base_key = kb
         self._runners = {}
         self._warmed: set = set()
@@ -88,7 +100,8 @@ class Simulation:
         k = (chunk, with_metrics)
         if k not in self._runners:
             self._runners[k] = _chunk_runner(
-                self.cfg, self.topo, self.world, chunk, with_metrics
+                self.cfg, self.topo, self.world, chunk, with_metrics,
+                step_fn=type(self)._step_fn, swim_of=type(self)._swim_of,
             )
         return self._runners[k]
 
@@ -211,43 +224,14 @@ class Simulation:
 class SerfSimulation(Simulation):
     """The full-stack driver: serf.step (SWIM + events + queries +
     reap) instead of the bare SWIM step. Same chunked-scan execution,
-    metrics, and telemetry; adds the serf-layer verbs."""
+    metrics, and telemetry via the base driver's hooks; adds the
+    serf-layer verbs."""
 
-    def __post_init__(self):
-        key = jax.random.PRNGKey(self.seed)
-        kw, kn, ks, kb = jax.random.split(key, 4)
-        self.world = topology.make_world(self.cfg, kw)
-        self.topo = topology.make_topology(self.cfg, kn)
-        self.state = serf_mod.init(self.cfg, ks)
-        self.base_key = kb
-        self._runners = {}
-        self._warmed = set()
-        self.sink = telemetry.Sink()
+    _step_fn = staticmethod(serf_mod.step)
+    _swim_of = staticmethod(lambda st: st.swim)
 
-    def _runner(self, chunk: int, with_metrics: bool):
-        k = (chunk, with_metrics)
-        if k not in self._runners:
-            cfg, topo, world = self.cfg, self.topo, self.world
-
-            def body(state, tick_key):
-                state = serf_mod.step(cfg, topo, world, state, tick_key)
-                if not with_metrics:
-                    return state, ()
-                h = metrics.health(cfg, topo, state.swim)
-                rmse = metrics.vivaldi_rmse(
-                    cfg, world, state.swim,
-                    jax.random.fold_in(tick_key, 1), samples=2048)
-                return state, TickTrace(h.agreement, h.false_positive,
-                                        h.undetected, rmse)
-
-            def run(state, base_key):
-                ticks = state.swim.t + jnp.arange(chunk)
-                tick_keys = jax.vmap(
-                    lambda t: jax.random.fold_in(base_key, t))(ticks)
-                return jax.lax.scan(body, state, tick_keys)
-
-            self._runners[k] = jax.jit(run, donate_argnums=(0,))
-        return self._runners[k]
+    def _init_state(self, key):
+        return serf_mod.init(self.cfg, key)
 
     # -- serf verbs -----------------------------------------------------
     def user_event(self, mask, name: int):

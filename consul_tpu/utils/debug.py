@@ -75,20 +75,21 @@ def capture_sim(sim, profile_ticks: int = 0,
 
     out: dict[str, dict] = {"host.json": _host_info()}
     out["config.json"] = dataclasses.asdict(sim.cfg)
-    h = m.health(sim.cfg, sim.topo, sim.state)
+    swim_st = sim.swim_state  # works for bare-SWIM and serf drivers
+    h = m.health(sim.cfg, sim.topo, swim_st)
     out["health.json"] = {
         "agreement": float(h.agreement),
         "false_positive": float(h.false_positive),
         "undetected": float(h.undetected),
         "live_nodes": int(h.live_nodes),
         "vivaldi_rmse_ms": float(sim.rmse()) * 1000.0,
-        "tick": int(sim.state.t),
+        "tick": int(swim_st.t),
     }
     out["metrics.json"] = sim.sink.snapshot()
     if profile_ticks > 0 and trace_dir:
         with jax.profiler.trace(trace_dir):
             sim.run(profile_ticks, with_metrics=False)
-            jax.block_until_ready(sim.state.view_key)
+            jax.block_until_ready(sim.swim_state.view_key)
         out["profile.json"] = {"trace_dir": trace_dir,
                                "ticks": profile_ticks}
     return out

@@ -244,6 +244,11 @@ class PacketBridge:
         self._event_names: dict[int, str] = {}
         # (first-name, colliding-name) pairs for operators to inspect.
         self.collisions: list[tuple[str, str]] = []
+        # The sim plane stores only packed keys; payloads ride this
+        # host-side registry (latest per name slot) across the seam.
+        self._event_payloads: dict[int, bytes] = {}
+        # (name_int, ltime) pairs already fired or echoed (bounded).
+        self._known_events: dict[tuple, None] = {}
         # Bounded per-agent delivered-key dedup (insertion-ordered; the
         # sim's own retention is ltime-bucketed, so old keys can never
         # redeliver once evicted here either).
@@ -408,6 +413,20 @@ class PacketBridge:
                     self.collisions.append((prior, name))
                 else:
                     self._event_names[name_int] = name
+                # Dedup across retransmissions AND the bridge's own
+                # outbound echoes: a serf agent retransmits each event
+                # several times and re-gossips what it receives; only
+                # the first (name, ltime) sighting fires into the sim,
+                # or one event would re-fire at fresh Lamport times
+                # forever (an unbounded feedback loop).
+                ek = (name_int, int(sbody.get("LTime", 0)))
+                if ek in self._known_events:
+                    return
+                self._known_events[ek] = None
+                while len(self._known_events) > 8192:
+                    self._known_events.pop(next(iter(self._known_events)))
+                payload = codec.as_bytes(sbody.get("Payload", b"") or b"")
+                self._event_payloads[name_int] = payload
                 self._stage_fired.append((from_seat, name_int))
         elif mtype == MessageType.INDIRECT_PING:
             # Relay: target reachability from ground truth; ack or nack
@@ -640,12 +659,17 @@ class PacketBridge:
                 while len(seen) > 4096:
                     seen.pop(next(iter(seen)))
                 name_int = (key >> 1) & 0xFF
+                # Mark the echo as known so the agent's re-gossip of it
+                # cannot re-fire into the sim.
+                self._known_events[(name_int, key >> 9)] = None
                 out.append(codec.encode_serf_message(
                     codec.SERF_USER_EVENT, {
                         "LTime": key >> 9,
                         "Name": self._event_names.get(
                             name_int, f"evt-{name_int}"),
-                        "Payload": b"", "CC": True,
+                        "Payload": self._event_payloads.get(
+                            name_int, b""),
+                        "CC": True,
                     }))
             if out:
                 rtt = self._model_rtt(src, seat)
