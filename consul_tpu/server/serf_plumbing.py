@@ -108,6 +108,44 @@ def members_from_sim(cfg: SimConfig, topo, serf_state, observer: int,
     return out
 
 
+def sync_coordinates(sim, server, seats: Iterable[int],
+                     name_fn=None, flush_every: int = 512) -> int:
+    """The agent coordinate loop for simulated seats (reference
+    agent/agent.go:1891-1940 sendCoordinate -> Coordinate.Update):
+    read the named seats' Vivaldi rows in one fused device->host fetch
+    and stage them on the server's coordinate endpoint — the
+    write-batching design of coordinate_endpoint.go:42-153 maps
+    directly onto the tensor batch (SURVEY §2.5). Large seat sets are
+    flushed every ``flush_every`` updates so the endpoint's rate
+    limiter (batch_size x max_batches pending) never silently discards
+    any; the returned count is therefore exactly what landed. A final
+    ``server.flush_coordinates()`` commits the tail."""
+    import jax
+
+    name_fn = name_fn or (lambda i: f"sim-{i}")
+    seats = list(seats)
+    if not seats:
+        return 0
+    viv = sim.swim_state.viv
+    idx = np.asarray(seats, dtype=np.int64)
+    vecs, heights, errors, adjs = jax.device_get(
+        (viv.vec[idx], viv.height[idx], viv.error[idx],
+         viv.adjustment[idx]))
+    staged = 0
+    for i, seat in enumerate(seats):
+        server.rpc(
+            "Coordinate.Update", node=name_fn(seat),
+            coord={"vec": [float(x) for x in vecs[i]],
+                   "height": float(heights[i]),
+                   "error": float(errors[i]),
+                   "adjustment": float(adjs[i])},
+        )
+        staged += 1
+        if staged % flush_every == 0:
+            server.flush_coordinates()
+    return staged
+
+
 class LanEventHandler:
     """lanEventHandler (server_serf.go:131): consume member events,
     maintain the member map, feed bootstrap-expect and the leader's

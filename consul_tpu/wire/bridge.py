@@ -406,10 +406,12 @@ class PacketBridge:
                 name = str(sbody.get("Name", ""))
                 name_int = zlib.crc32(name.encode()) & 0xFF
                 prior = self._event_names.get(name_int)
-                if prior is not None and prior != name:
+                collided = prior is not None and prior != name
+                if collided:
                     # 8-bit name-space collision (documented narrowing):
-                    # first name wins the registry; the collision is
-                    # surfaced instead of silently relabeling events.
+                    # first name wins the registry — Name AND Payload —
+                    # and the collision is surfaced instead of silently
+                    # relabeling or cross-contaminating events.
                     self.collisions.append((prior, name))
                 else:
                     self._event_names[name_int] = name
@@ -425,8 +427,9 @@ class PacketBridge:
                 self._known_events[ek] = None
                 while len(self._known_events) > 8192:
                     self._known_events.pop(next(iter(self._known_events)))
-                payload = codec.as_bytes(sbody.get("Payload", b"") or b"")
-                self._event_payloads[name_int] = payload
+                if not collided:
+                    self._event_payloads[name_int] = codec.as_bytes(
+                        sbody.get("Payload", b"") or b"")
                 self._stage_fired.append((from_seat, name_int))
         elif mtype == MessageType.INDIRECT_PING:
             # Relay: target reachability from ground truth; ack or nack
@@ -660,8 +663,11 @@ class PacketBridge:
                     seen.pop(next(iter(seen)))
                 name_int = (key >> 1) & 0xFF
                 # Mark the echo as known so the agent's re-gossip of it
-                # cannot re-fire into the sim.
+                # cannot re-fire into the sim (bounded here too — this
+                # insert site sees one entry per sim-originated event).
                 self._known_events[(name_int, key >> 9)] = None
+                while len(self._known_events) > 8192:
+                    self._known_events.pop(next(iter(self._known_events)))
                 out.append(codec.encode_serf_message(
                     codec.SERF_USER_EVENT, {
                         "LTime": key >> 9,

@@ -131,3 +131,56 @@ class TestSimToCatalogSlice:
         assert checks[f"sim-{victim}"] == "critical"
         alive_names = [m["name"] for m in members if m["status"] == "alive"]
         assert all(checks.get(n) == "passing" for n in alive_names)
+
+
+class TestCoordinateSlice:
+    def test_sim_coordinates_to_catalog_near_sort(self):
+        """SURVEY §3.3 end to end: the sim's learned Vivaldi coordinates
+        flow through Coordinate.Update batching into the raft-backed
+        store, and ?near=/rtt reads then reflect the planted geometry
+        (agent/agent.go:1891 sendCoordinate -> coordinate_endpoint.go
+        batch -> state store -> rtt.go sorting)."""
+        import numpy as np
+
+        from consul_tpu.models.cluster import Simulation
+        from consul_tpu.ops import topology as topo_mod
+        from consul_tpu.server.rtt import compute_distance
+        from consul_tpu.server.serf_plumbing import sync_coordinates
+
+        cfg = SimConfig(n=64, view_degree=16)
+        sim = Simulation(cfg, seed=2)
+        sim.run(400, chunk=100, with_metrics=False)  # learn the geometry
+
+        c = ServerCluster(3, seed=44)
+        leader = c.wait_converged()
+        seats = list(range(0, 64, 8))  # 8 observed agents
+        for s in seats:
+            leader.rpc("Catalog.Register", node=f"sim-{s}",
+                       address=f"sim-{s}")
+        c.step(120)
+        staged = sync_coordinates(sim, leader, seats)
+        assert staged == len(seats)
+        assert leader.flush_coordinates()
+        c.step(120)
+
+        # Every staged coordinate is readable.
+        coords = {r["node"]: r["coord"]
+                  for r in leader.store.coordinates()}
+        assert set(coords) == {f"sim-{s}" for s in seats}
+
+        # Estimated RTTs from stored coordinates track planted truth.
+        errs = []
+        for a in seats[1:]:
+            est = compute_distance(coords["sim-0"], coords[f"sim-{a}"])
+            true = float(topo_mod.true_rtt(sim.world, 0, a))
+            errs.append(est - true)
+        rmse = float(np.sqrt(np.mean(np.square(errs))))
+        assert rmse < 0.015, f"stored-coordinate RMSE {rmse*1000:.1f} ms"
+
+        # ?near= ordering approximates the true-RTT ordering: the
+        # nearest stored node to sim-0 must be among the true top-3.
+        out = leader.rpc("Catalog.ListNodes", near="sim-0")
+        ranked = [r["node"] for r in out["value"] if r["node"] != "sim-0"]
+        true_rank = sorted(
+            seats[1:], key=lambda a: float(topo_mod.true_rtt(sim.world, 0, a)))
+        assert ranked[0] in {f"sim-{a}" for a in true_rank[:3]}
