@@ -183,6 +183,28 @@ def child(platform: str, deadline: float):
                 "rounds_per_s": round(rps, 2),
                 "compile_s": round(compile_s, 1),
             })
+            # The north star (BASELINE.json): converge a 1M-node LAN —
+            # mass failure to full agreement — in < 60 s wall-clock.
+            # Only attempted when the measured rate could plausibly get
+            # there within the remaining deadline (a CPU backend at
+            # ~0.03 rounds/s skips; a TPU window records it).
+            if s >= 1_000_000 and rps * min(left() - 60, 600) > 512:
+                n_kill = int(s * kill_frac)
+                ssim.kill(jnp.arange(s) < n_kill)
+                t2 = time.monotonic()
+                converged, ticks_used, _ = ssim.run_until_converged(
+                    max_ticks=4096, chunk=chunk)
+                wall = time.monotonic() - t2
+                _emit({
+                    "phase": "northstar",
+                    "n": s,
+                    "converged": bool(converged),
+                    "kill_frac": kill_frac,
+                    "wall_s": round(wall, 2),
+                    "ticks": int(ticks_used),
+                    "target_wall_s": 60.0,
+                    "met": bool(converged) and wall < 60.0,
+                })
             del ssim
         except Exception as e:
             _emit({"phase": "error", "where": f"sweep:{s}", "error": repr(e)[:400]})
@@ -313,6 +335,9 @@ def main():
             for p in (tpu["phases"] if tpu else [])
             if p.get("phase") == "sweep"
         ],
+        "northstar_1m": next(
+            (p for p in (tpu["phases"] if tpu else [])
+             if p.get("phase") == "northstar"), None),
         "cpu_fallback": {
             "rounds_per_s": cpu_ok,
             "n_nodes": _get(cpu["phases"], "throughput", "n"),

@@ -44,3 +44,32 @@ def test_dryrun_multichip_8():
     import __graft_entry__
 
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_sparse_4k_mass_failure_converges():
+    """A mid-scale sparse world (the bench's profile family, well above
+    the old n<=256 test ceiling): 4096 nodes, K=32, 5% mass failure to
+    full agreement with accurate coordinates."""
+    sim = Simulation(SimConfig(n=4096, view_degree=32), seed=3)
+    sim.run(128, chunk=128, with_metrics=False)
+    assert float(sim.health().agreement) == 1.0
+    sim.kill(jnp.arange(4096) < 204)
+    converged, ticks, trace = sim.run_until_converged(
+        max_ticks=2048, chunk=128)
+    assert converged, f"agreement={float(trace.agreement[-1])}"
+    assert int(sim.health().live_nodes) == 4096 - 204
+    assert float(sim.health().false_positive) == 0.0
+    assert sim.rmse() < 0.015
+
+
+def test_serf_simulation_driver_full_stack():
+    """SerfSimulation: events + membership over the same driver."""
+    from consul_tpu.models.cluster import SerfSimulation
+    sim = SerfSimulation(SimConfig(n=64, view_degree=16), seed=4)
+    sim.user_event(jnp.arange(64) == 0, name=5)
+    sim.run(48, chunk=16, with_metrics=False)
+    assert int(jnp.min(sim.state.ev_delivered)) >= 1
+    sim.kill(jnp.arange(64) < 4)
+    ok, _, _ = sim.run_until_converged(max_ticks=1024, chunk=64)
+    assert ok
+    assert int(sim.health().live_nodes) == 60
