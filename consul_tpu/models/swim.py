@@ -83,6 +83,7 @@ from consul_tpu.config import SimConfig
 from consul_tpu.models.state import SimState, own_key as _own_key
 from consul_tpu.ops import merge, scaling, topology, vivaldi
 from consul_tpu.ops.topology import Topology, World
+from consul_tpu.parallel import collective as coll
 
 _NEG = jnp.int32(-1)
 
@@ -158,7 +159,7 @@ def _gather_by_col(topo: Topology, packed: jax.Array, col: jax.Array,
     acc = jnp.zeros_like(packed)
     for j in range(off_np.shape[0]):
         shift = int(off_np[j])
-        rolled = jnp.roll(packed, -shift if forward else shift, axis=0)
+        rolled = coll.roll(packed, -shift if forward else shift)
         acc = jnp.where((col == j)[:, None], rolled, acc)
     return acc
 
@@ -168,9 +169,14 @@ def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> 
     n, k_deg = cfg.n, cfg.degree
     g = cfg.gossip
     t = state.t
-    rows = jnp.arange(n, dtype=jnp.int32)
+    rows = coll.rows(n)
     keys = jax.random.split(key, 10)
     roll_mode = (not topo.dense) and k_deg <= _ROLL_DEGREE_MAX
+    if coll.current() is not None and not roll_mode:
+        raise ValueError(
+            "sharded execution requires the sparse circulant plane "
+            "(view_degree in (0, 256]); dense mode uses node-axis gathers"
+        )
 
     view0 = state.view_key  # snapshot for end-of-tick bookkeeping
     seen0 = state.susp_seen
@@ -287,9 +293,7 @@ def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> 
         true_rtt = (
             jnp.linalg.norm(world.pos - t_pos, axis=1) + world.height + t_h
         )
-        jitter = (
-            jax.random.normal(keys[0], (n,), jnp.float32) * cfg.rtt_jitter_frac
-        )
+        jitter = coll.normal_rows(keys[0], n) * cfg.rtt_jitter_frac
         rtt_obs = true_rtt * jnp.exp(jitter) if cfg.rtt_jitter_frac > 0 else true_rtt
     else:
         target = topology.neighbor_of(topo, rows, target_col)
@@ -299,7 +303,7 @@ def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> 
         t_verr, t_vadj = viv.error[target], viv.adjustment[target]
 
     timeout_s = g.probe_timeout_ms / 1000.0
-    loss = jax.random.uniform(keys[1], (n, 2)) < cfg.packet_loss  # direct, TCP legs
+    loss = coll.uniform_rows(keys[1], n, (2,)) < cfg.packet_loss  # direct, TCP legs
     direct_ok = has_target & target_up & (rtt_obs <= timeout_s) & ~loss[:, 0]
     # Indirect probes via k relays + TCP fallback (state.go:366-435),
     # relay displacements shared per tick like the gossip fan. Legs:
@@ -309,14 +313,14 @@ def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> 
     relay_ok_nodes = active  # relays must be live non-external members
     relay_avail = jnp.stack(
         [
-            jnp.roll(relay_ok_nodes, -topo.off[relay_jcols[i]])
+            coll.roll(relay_ok_nodes, -topo.off[relay_jcols[i]])
             for i in range(ic)
         ],
         axis=1,
     )
-    loss_a = jax.random.uniform(keys[3], (n, ic)) < cfg.packet_loss
-    loss_b = jax.random.uniform(keys[4], (n, ic)) < cfg.packet_loss
-    loss_c = jax.random.uniform(keys[5], (n, ic)) < cfg.packet_loss
+    loss_a = coll.uniform_rows(keys[3], n, (ic,)) < cfg.packet_loss
+    loss_b = coll.uniform_rows(keys[4], n, (ic,)) < cfg.packet_loss
+    loss_c = coll.uniform_rows(keys[5], n, (ic,)) < cfg.packet_loss
     relay_reached = relay_avail & ~loss_a
     relay_ok = relay_reached & target_up[:, None] & ~loss_b
     indirect_ok = has_target & jnp.any(relay_ok, axis=1) & ~direct_ok
@@ -356,9 +360,9 @@ def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> 
     # per-wrap shuffle of state.go:492-513).
     wrapped = ptr >= k_deg
     perm = jax.lax.cond(
-        jnp.any(wrapped),
+        coll.any_rows(wrapped),
         lambda p: jnp.argsort(
-            jax.random.uniform(keys[6], (n, k_deg)), axis=1
+            coll.uniform_rows(keys[6], n, (k_deg,)), axis=1
         ).astype(jnp.int32),
         lambda p: p,
         state.probe_perm,
@@ -475,10 +479,19 @@ def _vivaldi_observe(cfg, state: SimState, ok, peer_col, rtt,
     )  # [N, S] — exclusive one-hot, no gather
     padded = jnp.where(jnp.arange(s)[None, :] < filled[:, None], row_buf, jnp.inf)
     med = _take_col(jnp.sort(padded, axis=1), filled // 2)
-    # Vivaldi update; rejected (rtt=-1) rows pass through untouched.
+    # Vivaldi update; rejected (rtt=-1) rows pass through untouched. The
+    # coincident-point fallback directions are drawn here — this layer
+    # knows the rows are a (possibly sharded) node block, ops/vivaldi
+    # does not — splitting the key exactly as update() would.
+    k_viv, k_grav = jax.random.split(key)
+    vd = state.viv.vec.shape[1]
+    fallback = (
+        coll.uniform_rows(k_viv, cfg.n, (vd,), -0.5, 0.5),
+        coll.uniform_rows(k_grav, cfg.n, (vd,), -0.5, 0.5),
+    )
     new_viv = vivaldi.update(
         cfg.vivaldi, state.viv, p_vec, p_h, p_err, p_adj,
-        jnp.where(ok, med, -1.0), key,
+        jnp.where(ok, med, -1.0), key, fallback_rnd=fallback,
     )
     return state._replace(viv=new_viv, lat_buf=lat_buf, lat_cnt=lat_cnt)
 
@@ -493,6 +506,7 @@ def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit):
     ``gossip_nodes`` displacement-shared peers. Receivers gather."""
     g = cfg.gossip
     n, k_deg = cfg.n, cfg.degree
+    ln = coll.local_n(n)
     p, fan = g.piggyback_msgs, g.gossip_nodes
     k_cols, k_drop = jax.random.split(key)
     col_ids = jnp.arange(k_deg, dtype=jnp.int32)
@@ -545,22 +559,36 @@ def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit):
     state = state._replace(tx_left=tx_left, own_tx=own_tx)
 
     # Receiver-side delivery: one packet per (receiver, displacement).
+    # The whole sender payload is packed into one uint32 array so each
+    # displacement costs a single roll — under shard_map one ppermute
+    # exchange instead of seven (the literal "one packet per hop").
     recv_up = state.alive_truth & ~state.left
-    drop = jax.random.uniform(k_drop, (n, fan)) < cfg.packet_loss
+    drop = coll.uniform_rows(k_drop, n, (fan,)) < cfg.packet_loss
     view = state.view_key
-    refute_inc = jnp.zeros((n,), jnp.uint32)
-    seen_delta = jnp.zeros((n, k_deg), jnp.uint32)
+    refute_inc = jnp.zeros((ln,), jnp.uint32)
+    seen_delta = jnp.zeros((ln, k_deg), jnp.uint32)
+    payload = jnp.concatenate(
+        [
+            scol.astype(jnp.uint32),                  # [:, 0:P]
+            skey,                                     # [:, P:2P]
+            sbits,                                    # [:, 2P:3P]
+            svalid.astype(jnp.uint32),                # [:, 3P:4P]
+            sendable.astype(jnp.uint32),              # [:, 4P:4P+fan]
+            own_sendable.astype(jnp.uint32)[:, None], # [:, 4P+fan]
+            ownk[:, None],                            # [:, 4P+fan+1]
+        ],
+        axis=1,
+    )
     cands = []
     for f in range(fan):
         j = jcols[f]
         shift = topo.off[j]
-        arrived = (
-            jnp.roll(sendable[:, f], shift) & ~drop[:, f] & recv_up
-        )
-        s_scol = jnp.roll(scol, shift, axis=0)
-        s_skey = jnp.roll(skey, shift, axis=0)
-        s_sbits = jnp.roll(sbits, shift, axis=0)
-        fact_ok = arrived[:, None] & jnp.roll(svalid, shift, axis=0)
+        pkt = coll.roll(payload, shift)
+        arrived = (pkt[:, 4 * p + f] != 0) & ~drop[:, f] & recv_up
+        s_scol = pkt[:, :p].astype(jnp.int32)
+        s_skey = pkt[:, p:2 * p]
+        s_sbits = pkt[:, 2 * p:3 * p]
+        fact_ok = arrived[:, None] & (pkt[:, 3 * p:4 * p] != 0)
         rr = topology.remap_row(topo, j)                # [K]
         mycol = _vec_at(rr, s_scol)                     # [N, P]
         about_me = mycol == topology.SELF
@@ -578,8 +606,8 @@ def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit):
         # The sender's own-fact rides the same packet, landing at the
         # receiver column the sender itself occupies.
         icol = topology.inv_col(topo, j)
-        own_ok = arrived & jnp.roll(own_sendable, shift)
-        own_val = jnp.where(own_ok, jnp.roll(ownk, shift), jnp.uint32(0))
+        own_ok = arrived & (pkt[:, 4 * p + fan] != 0)
+        own_val = jnp.where(own_ok, pkt[:, 4 * p + fan + 1], jnp.uint32(0))
         # Merge: per-row one-hot max over the P facts + the own-fact.
         oh = mycol[:, None, :] == col_ids[None, :, None]          # [N,K,P]
         delta = jnp.max(jnp.where(oh, mkey[:, None, :], 0), axis=2)
@@ -619,11 +647,11 @@ def _poke_refutes(cfg, topo: Topology, state: SimState, poke_flag, poke_col,
     up = state.alive_truth & ~state.left
     if (not topo.dense) and k_deg <= _ROLL_DEGREE_MAX:
         off_np = np.asarray(topo.off)
-        claim = jnp.zeros((n,), jnp.uint32)
+        claim = jnp.zeros((coll.local_n(n),), jnp.uint32)
         poked_inc = jnp.where(poke_flag, poke_inc, 0).astype(jnp.uint32)
         for j in range(k_deg):
             shift = int(off_np[j])
-            contrib = jnp.roll(
+            contrib = coll.roll(
                 jnp.where(poke_col == j, poked_inc, 0), shift
             )
             claim = jnp.maximum(claim, contrib)
@@ -649,7 +677,7 @@ def _push_pull_phase(cfg, topo: Topology, state: SimState, active, pp_period, ke
     displacement; the push direction gathers the initiator's view
     backward; both remap columns through the static tables."""
     n, k_deg = cfg.n, cfg.degree
-    rows = jnp.arange(n, dtype=jnp.int32)
+    rows = coll.rows(n)
 
     # Fixed per-node phase offset (Knuth-hash stagger; deterministic).
     stagger = (rows * jnp.int32(-1640531527)) % pp_period
@@ -663,16 +691,25 @@ def _push_pull_phase(cfg, topo: Topology, state: SimState, active, pp_period, ke
 
     view0 = state.view_key                    # both directions exchange
     ownk = _own_key(state)                    # the pre-exchange states
-    partner_up = jnp.roll(state.alive_truth & ~state.left, -shift)
+    # One packed roll per direction (one ppermute exchange under
+    # shard_map): view + own-fact + liveness ride the same packet.
+    up = state.alive_truth & ~state.left
+    fwd = coll.roll(
+        jnp.concatenate(
+            [view0, ownk[:, None], up.astype(jnp.uint32)[:, None]], axis=1
+        ),
+        -shift,
+    )
+    partner_up = fwd[:, k_deg + 1] != 0
     init_ok = due & partner_up & merge.is_contactable(view0[:, j])
 
     # PULL: the initiator merges its partner's full state.
-    pv = jnp.roll(view0, -shift, axis=0)              # partner rows
+    pv = fwd[:, :k_deg]                               # partner rows
     ent = jnp.take(pv, rr_c, axis=1)
     ent = jnp.where(rr[None, :] >= 0, ent, jnp.uint32(0))
     ent = jnp.where(
         jnp.arange(k_deg, dtype=jnp.int32)[None, :] == j,
-        jnp.roll(ownk, -shift)[:, None], ent,
+        fwd[:, k_deg][:, None], ent,
     )
     pull = merge.demote_dead_to_suspect(ent)
     view = merge.join(state.view_key, jnp.where(init_ok[:, None], pull, 0))
@@ -686,15 +723,21 @@ def _push_pull_phase(cfg, topo: Topology, state: SimState, active, pp_period, ke
     # initiated toward r. The column algebra mirrors the pull with the
     # roles swapped: local column c takes s's column holding the same
     # subject, remapped through the inverse displacement.
-    s_ok = jnp.roll(init_ok, shift) & (state.alive_truth & ~state.left)
-    sv = jnp.roll(view0, shift, axis=0)               # initiator rows
+    bwd = coll.roll(
+        jnp.concatenate(
+            [view0, ownk[:, None], init_ok.astype(jnp.uint32)[:, None]], axis=1
+        ),
+        shift,
+    )
+    s_ok = (bwd[:, k_deg + 1] != 0) & up
+    sv = bwd[:, :k_deg]                               # initiator rows
     rr2 = topology.remap_row(topo, icol)
     rr2_c = jnp.clip(rr2, 0, k_deg - 1)
     ent2 = jnp.take(sv, rr2_c, axis=1)
     ent2 = jnp.where(rr2[None, :] >= 0, ent2, jnp.uint32(0))
     ent2 = jnp.where(
         jnp.arange(k_deg, dtype=jnp.int32)[None, :] == icol,
-        jnp.roll(ownk, shift)[:, None], ent2,
+        bwd[:, k_deg][:, None], ent2,
     )
     push = merge.demote_dead_to_suspect(ent2)
     view = merge.join(view, jnp.where(s_ok[:, None], push, 0))

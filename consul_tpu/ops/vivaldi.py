@@ -69,16 +69,19 @@ def distance(vec_a, height_a, adj_a, vec_b, height_b, adj_b):
     return jnp.where(adjusted > 0.0, adjusted, dist)
 
 
-def _unit_vector_at(vec_a, vec_b, key):
+def _unit_vector_at(vec_a, vec_b, key, rnd=None):
     """Unit vector pointing at ``vec_a`` from ``vec_b`` plus the distance.
 
     Mirrors unitVectorAt (reference coordinate.go:182-203): coincident
     points get a random unit direction (reported magnitude 0) so height
-    updates are skipped for them.
+    updates are skipped for them. A caller that knows the batch's row
+    identities (a sharded node block) passes the fallback directions in
+    via ``rnd``; this module stays sharding-agnostic.
     """
     d = vec_a - vec_b
     mag = jnp.linalg.norm(d, axis=-1, keepdims=True)
-    rnd = jax.random.uniform(key, d.shape, jnp.float32, -0.5, 0.5)
+    if rnd is None:
+        rnd = jax.random.uniform(key, d.shape, jnp.float32, -0.5, 0.5)
     rnd_mag = jnp.linalg.norm(rnd, axis=-1, keepdims=True)
     # Fallback chain: real direction -> random direction -> e0.
     e0 = jnp.zeros_like(d).at[..., 0].set(1.0)
@@ -92,7 +95,8 @@ def _unit_vector_at(vec_a, vec_b, key):
     return unit, jnp.where(use_real[..., 0], mag[..., 0], 0.0)
 
 
-def apply_force(cfg: VivaldiConfig, vec, height, force, other_vec, other_height, key):
+def apply_force(cfg: VivaldiConfig, vec, height, force, other_vec, other_height,
+                key, rnd=None):
     """Apply a scalar force from the direction of ``other``.
 
     Mirrors ApplyForce (reference coordinate.go:104-117): the vector moves
@@ -100,7 +104,7 @@ def apply_force(cfg: VivaldiConfig, vec, height, force, other_vec, other_height,
     scaled by force/distance, clamped to ``height_min``, and is untouched
     for coincident points.
     """
-    unit, mag = _unit_vector_at(vec, other_vec, key)
+    unit, mag = _unit_vector_at(vec, other_vec, key, rnd)
     new_vec = vec + unit * force[..., None]
     moved = mag > ZERO_THRESHOLD
     new_height = (height + other_height) * force / jnp.where(moved, mag, 1.0) + height
@@ -117,6 +121,7 @@ def update(
     other_adjustment,
     rtt_seconds,
     key,
+    fallback_rnd=None,
 ) -> VivaldiState:
     """One full observation update per batch element.
 
@@ -129,8 +134,13 @@ def update(
     + the RTT range check, client.go:206-219), an invalid observation — a
     non-finite peer coordinate or an RTT outside [0, 10 s] — is rejected
     per batch element: that element's state passes through untouched.
+    ``fallback_rnd``, when given, is a pair of [..., dims] uniform(-0.5,
+    0.5) draws used as the coincident-point fallback directions of the
+    two apply_force calls (see _unit_vector_at) in place of draws from
+    ``key`` — how the sharded node-block caller keeps the global stream.
     """
     k_viv, k_grav = jax.random.split(key)
+    rnd_viv, rnd_grav = fallback_rnd if fallback_rnd is not None else (None, None)
 
     rtt_in = jnp.asarray(rtt_seconds, jnp.float32)
     obs_ok = (
@@ -152,7 +162,10 @@ def update(
     error = cfg.vivaldi_ce * weight * wrongness + state.error * (1.0 - cfg.vivaldi_ce * weight)
     error = jnp.minimum(error, cfg.vivaldi_error_max)
     force = cfg.vivaldi_cc * weight * (rtt - dist)
-    vec, height = apply_force(cfg, state.vec, state.height, force, other_vec, other_height, k_viv)
+    vec, height = apply_force(
+        cfg, state.vec, state.height, force, other_vec, other_height,
+        k_viv, rnd_viv,
+    )
 
     # -- updateAdjustment (client.go:172-188) -----------------------------
     w = cfg.adjustment_window_size
@@ -171,7 +184,9 @@ def update(
     origin_h = jnp.full_like(height, cfg.height_min)
     dist_origin = distance(vec, height, adjustment, origin_vec, origin_h, jnp.zeros_like(adjustment))
     g_force = -1.0 * (dist_origin / cfg.gravity_rho) ** 2.0
-    vec, height = apply_force(cfg, vec, height, g_force, origin_vec, origin_h, k_grav)
+    vec, height = apply_force(
+        cfg, vec, height, g_force, origin_vec, origin_h, k_grav, rnd_grav
+    )
 
     # -- validity reset (client.go:228-231) -------------------------------
     finite = (

@@ -1,0 +1,200 @@
+"""Explicit ICI collectives for the node-sharded simulation step.
+
+The reference's distributed communication backend is UDP/TCP sockets
+behind ``memberlist.Transport`` (reference
+vendor/github.com/hashicorp/memberlist/transport.go:27-65) plus a
+yamux-multiplexed RPC pool (reference agent/pool/pool.go:122-533). The
+TPU equivalent (SURVEY.md §2.5) is XLA collectives over ICI. This module
+is that backend, stated explicitly: every cross-node message exchange in
+the simulation is a circulant **roll** along the node axis
+(ops/topology.py), and under ``shard_map`` a roll of the node-sharded
+array decomposes into at most two ``lax.ppermute`` block transfers
+around the device ring (static shift) or a log2(D) conditional-hop
+ppermute ladder (traced shift) — the all-neighbor exchange rides ICI
+links point-to-point, never a host round-trip and never an all-gather.
+
+Design: the step functions (models/swim.py) are written against the
+row-axis primitives below. Outside any context they degrade to exactly
+the single-device expressions (``jnp.roll``, ``jnp.arange``, plain
+``jax.random`` draws), so single-chip behavior is untouched. Inside
+:func:`node_axis` — entered by the ``shard_map`` wrapper in
+parallel/shard_step.py — the same calls emit ppermute/psum collectives
+over the named mesh axis.
+
+Exactness: per-row random draws generate the **global** array from the
+replicated key and statically slice the local block, so a sharded step
+is bit-identical to the unsharded step (tested in
+tests/test_shardmap.py). The redundant generation is O(N·tail) work per
+device per draw — the worst case is the probe-order reshuffle's [N, K]
+draw (models/swim.py), ~128 MB transient at n=1M/K=32, regenerated on
+nearly every tick at scale because some cursor always wraps. If that
+ever shows up in a multichip profile, switch the draws to per-row
+``fold_in(key, global_row_id)`` streams (shard-count-invariant, each
+shard generates only its block) — that keeps a sharded/unsharded
+equivalence test but changes the single-device trajectory, so re-pin
+any golden numbers when doing it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class NodeAxisCtx(NamedTuple):
+    axis_name: str   # shard_map mesh axis carrying the node dimension
+    n_shards: int    # devices along that axis
+    n_global: int    # global node count (block = n_global // n_shards)
+
+
+_CTX: contextvars.ContextVar[Optional[NodeAxisCtx]] = contextvars.ContextVar(
+    "consul_tpu_node_axis", default=None
+)
+
+
+def current() -> Optional[NodeAxisCtx]:
+    return _CTX.get()
+
+
+@contextlib.contextmanager
+def node_axis(axis_name: str, n_shards: int, n_global: int):
+    """Declare that per-node arrays inside this context are shard_map
+    blocks of ``n_global // n_shards`` rows along ``axis_name``."""
+    if n_global % n_shards != 0:
+        raise ValueError(f"n_global={n_global} not divisible by {n_shards}")
+    tok = _CTX.set(NodeAxisCtx(axis_name, n_shards, n_global))
+    try:
+        yield
+    finally:
+        _CTX.reset(tok)
+
+
+def _block(ctx: NodeAxisCtx) -> int:
+    return ctx.n_global // ctx.n_shards
+
+
+def _perm(ctx: NodeAxisCtx, amt: int):
+    """ppermute pairs moving each block ``amt`` seats up the ring: the
+    block of device s lands on device (s + amt) mod D, i.e. device d
+    receives block d - amt."""
+    d = ctx.n_shards
+    return [(s, (s + amt) % d) for s in range(d)]
+
+
+def local_n(n: int) -> int:
+    """Local row count for a global node count ``n``."""
+    ctx = _CTX.get()
+    return n if ctx is None else n // ctx.n_shards
+
+
+def rows(n: int) -> jax.Array:
+    """Global row ids of the rows this program instance holds."""
+    ctx = _CTX.get()
+    if ctx is None:
+        return jnp.arange(n, dtype=jnp.int32)
+    b = n // ctx.n_shards
+    base = jax.lax.axis_index(ctx.axis_name).astype(jnp.int32) * b
+    return base + jnp.arange(b, dtype=jnp.int32)
+
+
+def _slice_rows(ctx: NodeAxisCtx, x: jax.Array) -> jax.Array:
+    """Local block of a globally-shaped per-row array."""
+    b = _block(ctx)
+    start = jax.lax.axis_index(ctx.axis_name).astype(jnp.int32) * b
+    return jax.lax.dynamic_slice_in_dim(x, start, b, axis=0)
+
+
+def roll(x: jax.Array, shift) -> jax.Array:
+    """Global circular roll along the node axis (axis 0):
+    ``out[g] = x[(g - shift) mod N]`` in global row coordinates.
+
+    Single-device: ``jnp.roll``. Sharded, static shift: at most two
+    ppermutes moving exactly B rows total (the two slices of the rolled
+    block live on at most two source devices). Sharded, traced shift:
+    conditional ppermute ladder over the bits of the block displacement
+    plus one neighbor transfer for the intra-block remainder."""
+    ctx = _CTX.get()
+    if ctx is None:
+        return jnp.roll(x, shift, axis=0)
+    b = _block(ctx)
+    n = ctx.n_global
+    squeeze = x.dtype == jnp.bool_
+    if squeeze:  # ppermute bools as uint8 for backend safety
+        x = x.astype(jnp.uint8)
+    if isinstance(shift, jax.core.Tracer):
+        out = _roll_dynamic(ctx, x, jnp.asarray(shift) % n, b)
+    else:
+        out = _roll_static(ctx, x, int(shift) % n, b)
+    return out.astype(jnp.bool_) if squeeze else out
+
+
+def _roll_static(ctx: NodeAxisCtx, x: jax.Array, s: int, b: int) -> jax.Array:
+    if s == 0:
+        return x
+    q, r = divmod(s, b)
+    ax = ctx.axis_name
+    if r == 0:
+        return jax.lax.ppermute(x, ax, _perm(ctx, q))
+    # out rows [0, r) come from block d-q-1 rows [b-r, b);
+    # out rows [r, b) come from block d-q rows [0, b-r).
+    head_src = x[b - r:]
+    tail_src = x[:b - r]
+    head = jax.lax.ppermute(head_src, ax, _perm(ctx, (q + 1) % ctx.n_shards)) \
+        if (q + 1) % ctx.n_shards != 0 else head_src
+    tail = jax.lax.ppermute(tail_src, ax, _perm(ctx, q)) if q != 0 else tail_src
+    return jnp.concatenate([head, tail], axis=0)
+
+
+def _roll_dynamic(ctx: NodeAxisCtx, x: jax.Array, s: jax.Array, b: int) -> jax.Array:
+    ax = ctx.axis_name
+    q = (s // b).astype(jnp.int32)
+    r = (s % b).astype(jnp.int32)
+    # Block rotation by traced q: conditional hops over its bits. Every
+    # ppermute executes unconditionally (collectives must be uniform
+    # across the SPMD program); the hop is selected with a where.
+    y = x
+    amt, bit = 1, 0
+    while amt < ctx.n_shards:
+        hopped = jax.lax.ppermute(y, ax, _perm(ctx, amt))
+        take = ((q >> bit) & 1) == 1
+        y = jnp.where(_bcast(take, y.ndim), hopped, y)
+        amt <<= 1
+        bit += 1
+    # y = block_{d-q}. Neighbor block d-q-1 for the intra-block seam.
+    z = jax.lax.ppermute(y, ax, _perm(ctx, 1))
+    full = jnp.concatenate([z, y], axis=0)          # rows of blocks d-q-1, d-q
+    return jax.lax.dynamic_slice_in_dim(full, b - r, b, axis=0)
+
+
+def _bcast(pred: jax.Array, ndim: int) -> jax.Array:
+    return pred.reshape((1,) * ndim) if ndim else pred
+
+
+def any_rows(x: jax.Array) -> jax.Array:
+    """``jnp.any`` over the full (global) node axis."""
+    ctx = _CTX.get()
+    local = jnp.any(x)
+    if ctx is None:
+        return local
+    return jax.lax.psum(local.astype(jnp.int32), ctx.axis_name) > 0
+
+
+# ----------------------------------------------------------------------
+# Per-row randomness with sharding-exact semantics: generate the global
+# array from the (replicated) key, slice the local block.
+# ----------------------------------------------------------------------
+
+def uniform_rows(key, n: int, tail=(), minval=0.0, maxval=1.0, dtype=jnp.float32):
+    ctx = _CTX.get()
+    full = jax.random.uniform(key, (n, *tail), dtype, minval, maxval)
+    return full if ctx is None else _slice_rows(ctx, full)
+
+
+def normal_rows(key, n: int, tail=(), dtype=jnp.float32):
+    ctx = _CTX.get()
+    full = jax.random.normal(key, (n, *tail), dtype)
+    return full if ctx is None else _slice_rows(ctx, full)

@@ -32,17 +32,20 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None, n_dc: int = 1) -> 
     return Mesh(grid, (DC_AXIS, NODE_AXIS))
 
 
+def node_spec(leaf, n: int) -> P:
+    """The one node-axis partition rule: leaves whose leading dim is the
+    node count shard on it, everything else replicates. Shared by the
+    auto-SPMD path (here) and the shard_map path (parallel/shard_step.py)."""
+    if leaf.ndim >= 1 and leaf.shape[0] == n:
+        return P(NODE_AXIS, *([None] * (leaf.ndim - 1)))
+    return P()
+
+
 def state_sharding(state: SimState, mesh: Mesh) -> SimState:
     """NamedSharding pytree for a SimState: every per-node array is
     sharded on its node axis; scalars are replicated."""
     n = state.alive_truth.shape[0]
-
-    def spec(leaf):
-        if leaf.ndim >= 1 and leaf.shape[0] == n:
-            return NamedSharding(mesh, P(NODE_AXIS, *([None] * (leaf.ndim - 1))))
-        return NamedSharding(mesh, P())
-
-    return jax.tree.map(spec, state)
+    return jax.tree.map(lambda l: NamedSharding(mesh, node_spec(l, n)), state)
 
 
 def shard_state(state: SimState, mesh: Mesh) -> SimState:

@@ -1,0 +1,82 @@
+"""shard_map execution of the SWIM step with explicit ICI collectives.
+
+Two ways to run the simulation on a device mesh:
+
+  1. ``jit`` with sharding annotations (parallel/mesh.py) — XLA's SPMD
+     partitioner chooses the collectives. This is the default path and
+     what the federation dryrun uses.
+  2. This module: the step runs under ``jax.shard_map`` with the node
+     axis split into explicit per-device blocks, and every cross-node
+     exchange — the circulant rolls that carry probes, gossip packets
+     and push-pull state (models/swim.py) — is an explicit
+     ``lax.ppermute`` neighbor transfer around the device ring
+     (parallel/collective.py). This is the framework's hand-written
+     distributed communication backend, the ICI analogue of the
+     reference's UDP/TCP transport (reference
+     vendor/github.com/hashicorp/memberlist/transport.go:27-65): rolls
+     whose shift is a trace-time constant move exactly one block's rows
+     point-to-point; traced shifts take a log2(D) conditional ppermute
+     ladder. No all-gathers, no host round-trips.
+
+A sharded step matches the unsharded step for the same (state, key):
+per-row randomness is generated from the global stream and sliced per
+shard (collective.uniform_rows), so the **discrete protocol state**
+(views, incarnations, suspicion timers, probe cursors) is bit-identical
+and the float coordinate state matches to compiler-rounding tolerance
+(different XLA fusions round differently by ~1 ulp). Tested in
+tests/test_shardmap.py — the sharding analogue of the determinism tests
+that replace the reference's race detector (SURVEY.md §5).
+
+Requires the sparse circulant plane (``view_degree > 0``): dense mode
+indexes the node axis with per-row gathers that have no block-local
+form. Sparse is the production >=100k-node configuration anyway.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from consul_tpu.config import SimConfig
+from consul_tpu.models import swim
+from consul_tpu.ops.topology import Topology, World
+from consul_tpu.parallel import collective as coll
+from consul_tpu.parallel.mesh import NODE_AXIS, node_spec
+
+
+def make_sharded_step(cfg: SimConfig, topo: Topology, mesh: Mesh):
+    """Build ``step(world, state, key) -> state`` running under shard_map
+    over ``mesh``'s node axis with explicit ppermute collectives. The
+    returned function is jitted with donated state buffers; place inputs
+    with :func:`place` first for zero-copy."""
+    n_shards = mesh.shape[NODE_AXIS]
+    if cfg.n % n_shards != 0:
+        raise ValueError(f"n={cfg.n} must divide over {n_shards} shards")
+    if cfg.view_degree == 0:
+        raise ValueError("sharded step requires the sparse circulant plane")
+
+    world_spec = World(pos=P(NODE_AXIS, None), height=P(NODE_AXIS))
+
+    def local_step(world_local, state_local, key):
+        with coll.node_axis(NODE_AXIS, n_shards, cfg.n):
+            return swim.step(cfg, topo, world_local, state_local, key)
+
+    def global_step(world_g, state_g, key):
+        specs = jax.tree.map(lambda l: node_spec(l, cfg.n), state_g)
+        inner = jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(world_spec, specs, P()),
+            out_specs=specs,
+            check_vma=False,
+        )
+        return inner(world_g, state_g, key)
+
+    return jax.jit(global_step, donate_argnums=(1,))
+
+
+def place(mesh: Mesh, tree, n: int):
+    """Shard a pytree's node-axis leaves over the mesh (others replicate)."""
+    return jax.tree.map(
+        lambda l: jax.device_put(l, NamedSharding(mesh, node_spec(l, n))), tree
+    )

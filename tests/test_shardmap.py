@@ -1,0 +1,222 @@
+"""Explicit-collective (shard_map + ppermute) execution tests.
+
+Runs on the 8-device virtual CPU mesh (tests/conftest.py). Validates the
+framework's hand-written ICI communication backend
+(parallel/collective.py, parallel/shard_step.py): the circulant-roll
+message plane decomposed into ppermute neighbor transfers, and the full
+SWIM step under shard_map agreeing with the single-device step.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from consul_tpu.config import SimConfig
+from consul_tpu.models import state as sim_state
+from consul_tpu.models import swim
+from consul_tpu.ops import topology
+from consul_tpu.parallel import collective as coll
+from consul_tpu.parallel import mesh as pmesh
+from consul_tpu.parallel import shard_step
+
+N_DEV = 8
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:N_DEV]), (pmesh.NODE_AXIS,))
+
+
+SHIFTS = [0, 1, 7, 8, 9, 32, 63, -3, -17, 100]
+
+
+class TestRingRoll:
+    """collective.roll == jnp.roll in global row coordinates."""
+
+    @pytest.mark.parametrize("shift", SHIFTS)
+    def test_static_shift(self, shift):
+        mesh = _mesh()
+        n = 64
+        x = jnp.arange(n, dtype=jnp.int32)
+
+        def f(xl):
+            with coll.node_axis(pmesh.NODE_AXIS, N_DEV, n):
+                return coll.roll(xl, shift)
+
+        got = jax.jit(
+            jax.shard_map(
+                f, mesh=mesh, in_specs=P(pmesh.NODE_AXIS),
+                out_specs=P(pmesh.NODE_AXIS),
+            )
+        )(x)
+        np.testing.assert_array_equal(np.asarray(got), np.roll(np.asarray(x), shift))
+
+    @pytest.mark.parametrize("shift", SHIFTS)
+    def test_traced_shift(self, shift):
+        mesh = _mesh()
+        n = 64
+        x = jnp.arange(n, dtype=jnp.int32)
+
+        def f(xl, s):
+            with coll.node_axis(pmesh.NODE_AXIS, N_DEV, n):
+                return coll.roll(xl, s)
+
+        got = jax.jit(
+            jax.shard_map(
+                f, mesh=mesh, in_specs=(P(pmesh.NODE_AXIS), P()),
+                out_specs=P(pmesh.NODE_AXIS),
+            )
+        )(x, jnp.int32(shift))
+        np.testing.assert_array_equal(np.asarray(got), np.roll(np.asarray(x), shift))
+
+    @pytest.mark.parametrize("traced", [False, True])
+    def test_2d_and_bool(self, traced):
+        mesh = _mesh()
+        n = 64
+        x2 = jnp.stack([jnp.arange(n), jnp.arange(n) * 10], axis=1)
+        b = jnp.arange(n) % 3 == 0
+        for arr, spec in [(x2, P(pmesh.NODE_AXIS, None)), (b, P(pmesh.NODE_AXIS))]:
+            for shift in (5, 13):
+                def f(xl, s):
+                    with coll.node_axis(pmesh.NODE_AXIS, N_DEV, n):
+                        return coll.roll(xl, s if traced else shift)
+
+                got = jax.jit(
+                    jax.shard_map(
+                        f, mesh=mesh, in_specs=(spec, P()), out_specs=spec
+                    )
+                )(arr, jnp.int32(shift))
+                np.testing.assert_array_equal(
+                    np.asarray(got), np.roll(np.asarray(arr), shift, axis=0)
+                )
+                assert got.dtype == arr.dtype
+
+    def test_rows_and_any(self):
+        mesh = _mesh()
+        n = 64
+
+        def f(flag):
+            with coll.node_axis(pmesh.NODE_AXIS, N_DEV, n):
+                return coll.rows(n), coll.any_rows(flag) & True
+
+        flag = jnp.zeros(n, bool).at[37].set(True)
+        rows, anyv = jax.jit(
+            jax.shard_map(
+                f, mesh=mesh, in_specs=P(pmesh.NODE_AXIS),
+                out_specs=(P(pmesh.NODE_AXIS), P()),
+                check_vma=False,
+            )
+        )(flag)
+        np.testing.assert_array_equal(np.asarray(rows), np.arange(n))
+        assert bool(anyv)
+        assert not bool(
+            jax.jit(
+                jax.shard_map(
+                    f, mesh=mesh, in_specs=P(pmesh.NODE_AXIS),
+                    out_specs=(P(pmesh.NODE_AXIS), P()),
+                    check_vma=False,
+                )
+            )(jnp.zeros(n, bool))[1]
+        )
+
+    def test_uniform_rows_match_global_stream(self):
+        mesh = _mesh()
+        n = 64
+        key = jax.random.PRNGKey(3)
+
+        def f():
+            with coll.node_axis(pmesh.NODE_AXIS, N_DEV, n):
+                return coll.uniform_rows(key, n, (4,))
+
+        got = jax.jit(
+            jax.shard_map(
+                f, mesh=mesh, in_specs=(), out_specs=P(pmesh.NODE_AXIS, None)
+            )
+        )()
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(jax.random.uniform(key, (n, 4)))
+        )
+
+
+class TestShardedStep:
+    """Full SWIM step under shard_map vs the single-device step."""
+
+    def _build(self, n=256, view_degree=16):
+        cfg = SimConfig(n=n, view_degree=view_degree)
+        key = jax.random.PRNGKey(0)
+        kw, kn, ks = jax.random.split(key, 3)
+        world = topology.make_world(cfg, kw)
+        topo = topology.make_topology(cfg, kn)
+        st = sim_state.init(cfg, ks)
+        return cfg, topo, world, st
+
+    def test_matches_unsharded_trajectory(self):
+        cfg, topo, world, st0 = self._build()
+        mesh = _mesh()
+        sstep = shard_step.make_sharded_step(cfg, topo, mesh)
+        ustep = jax.jit(functools.partial(swim.step, cfg, topo, world))
+
+        su = st0
+        ss = shard_step.place(mesh, st0, cfg.n)
+        wg = shard_step.place(mesh, world, cfg.n)
+        for t in range(30):
+            k = jax.random.fold_in(jax.random.PRNGKey(7), t)
+            su = ustep(su, k)
+            ss = sstep(wg, ss, k)
+
+        float_leaves = 0
+        for name, a, b in zip(su._fields, su, ss):
+            for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+                x, y = np.asarray(x), np.asarray(y)
+                if np.issubdtype(x.dtype, np.floating):
+                    # Different XLA fusions round float math differently
+                    # (~1 ulp); discrete protocol state must be exact.
+                    np.testing.assert_allclose(
+                        x, y, rtol=1e-4, atol=1e-6, err_msg=name
+                    )
+                    float_leaves += 1
+                else:
+                    np.testing.assert_array_equal(x, y, err_msg=name)
+        assert float_leaves > 0
+
+    def test_sharded_convergence_after_kill(self):
+        """Kill a block of nodes; the sharded-step cluster must detect
+        and re-converge exactly like the protocol demands."""
+        cfg, topo, world, st0 = self._build()
+        mesh = _mesh()
+        sstep = shard_step.make_sharded_step(cfg, topo, mesh)
+
+        ss = shard_step.place(mesh, st0, cfg.n)
+        wg = shard_step.place(mesh, world, cfg.n)
+        for t in range(40):
+            ss = sstep(wg, ss, jax.random.fold_in(jax.random.PRNGKey(1), t))
+        dead_mask = jnp.arange(cfg.n) < 12
+        ss = shard_step.place(mesh, sim_state.kill(ss, dead_mask), cfg.n)
+        # Suspicion at n=256: min 4*log10(256)*5 = 48 ticks, max 6x =
+        # 289; plus probe-cycle detection latency (K=16 targets x 5-tick
+        # period). 640 ticks = 128 simulated seconds covers the
+        # un-accelerated worst case with margin.
+        for t in range(640):
+            ss = sstep(wg, ss, jax.random.fold_in(jax.random.PRNGKey(2), t + 100))
+
+        from consul_tpu.ops import merge
+        st = merge.key_status(ss.view_key)
+        alive = np.asarray(ss.alive_truth)
+        statuses = np.asarray(st)
+        nbrs = np.asarray(topology.nbrs_table(topo))
+        # Every surviving observer sees every dead tracked peer as dead,
+        # and no live tracked peer as dead/suspect (no false positives).
+        for i in np.nonzero(alive)[0][:64]:
+            for c, j in enumerate(nbrs[i]):
+                if not alive[j]:
+                    assert statuses[i, c] == merge.DEAD, (i, c, j)
+                else:
+                    assert statuses[i, c] in (merge.ALIVE,), (i, c, j)
+
+    def test_dense_mode_rejected(self):
+        cfg, topo, world, st0 = self._build(view_degree=0)
+        with pytest.raises(ValueError):
+            shard_step.make_sharded_step(cfg, topo, _mesh())
