@@ -164,6 +164,16 @@ class SerfConfig:
     # keeps an unbounded per-ltime name list; this is the fixed-shape
     # bound — >width concurrent same-ltime events per bucket drop).
     seen_width: int = 4
+    # Dynamic queue-depth limit knobs (reference serf/serf.go:1612-1648
+    # getQueueMax/checkQueueDepth; Consul raises MinQueueDepth to 4096,
+    # reference lib/serf.go:26-28). The scaled limit max(2N, min) bounds
+    # *host-side* queues (wire/bridge.py seam buffers); the warning
+    # threshold feeds the serf.queue.* telemetry samples.
+    min_queue_depth: int = 4096
+    max_queue_depth: int = 0
+    queue_depth_warning: int = 128
+    # QueueCheckInterval=30s (serf/config.go) at the 200 ms LAN tick.
+    queue_check_interval_ticks: int = 150
     # Query response timeout multiplier (reference serf/config.go
     # QueryTimeoutMult=16; timeout = mult * log10(N+1) * gossip_interval,
     # serf/serf.go DefaultQueryTimeout).

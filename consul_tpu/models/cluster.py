@@ -149,6 +149,8 @@ class Simulation:
             health=h, rmse_s=float(trace.rmse[-1]),
             rounds_per_sec=(ticks / wall_s if wall_s else None),
             chunk_wall_s=wall_s, chunk_ticks=ticks,
+            serf_state=self.serf_state,
+            queue_depth_warning=self.cfg.serf.queue_depth_warning,
         )
 
     def run_until_converged(

@@ -100,3 +100,21 @@ def rate_scaled_interval(rate_per_s, min_ticks, n, ticks_per_s):
     n = jnp.asarray(n, jnp.float32)
     interval = ticks_per_s * n / rate_per_s
     return jnp.maximum(interval, min_ticks)
+
+
+def queue_max_depth(max_queue_depth, min_queue_depth, n_members):
+    """Dynamic serf broadcast-queue depth limit.
+
+    Mirrors getQueueMax (reference serf/serf.go:1612-1624): the static
+    ``MaxQueueDepth`` unless ``MinQueueDepth`` is set, in which case the
+    limit scales with the cluster — ``max(2 * n_members,
+    min_queue_depth)`` (Consul sets MinQueueDepth=4096, reference
+    lib/serf.go:26-28). Host-side helper (plain ints): the limit guards
+    host-side queues (wire/bridge.py seam buffers); the in-sim event
+    queue's fixed ``event_queue_slots`` capacity is its own, tighter,
+    always-enforced bound.
+    """
+    m = int(max_queue_depth)
+    if min_queue_depth > 0:
+        m = max(2 * int(n_members), int(min_queue_depth))
+    return m

@@ -111,7 +111,9 @@ def emit_sim_metrics(state, sink: Sink,
                      health=None, rmse_s: Optional[float] = None,
                      rounds_per_sec: Optional[float] = None,
                      chunk_wall_s: Optional[float] = None,
-                     chunk_ticks: Optional[int] = None):
+                     chunk_ticks: Optional[int] = None,
+                     serf_state=None,
+                     queue_depth_warning: int = 0):
     """Record one chunk boundary's worth of reference-named metrics.
 
     One batched device→host fetch for the scalar reductions; the
@@ -150,3 +152,21 @@ def emit_sim_metrics(state, sink: Sink,
         sink.set_gauge("sim.undetected", float(health.undetected))
     if rmse_s is not None:
         sink.set_gauge("sim.vivaldi_rmse_ms", rmse_s * 1000.0)
+    if serf_state is not None:
+        # serf.queue.Event sample (checkQueueDepth, serf/serf.go:
+        # 1627-1648): per-live-node occupied broadcast-queue slots. The
+        # reference samples one node's queue length every 30 s; the sim
+        # folds the whole cluster into mean + max at the chunk boundary.
+        occ = jnp.sum((serf_state.ev_key != 0) & live[:, None], axis=1)
+        qs = np.asarray(jnp.stack([
+            jnp.sum(occ).astype(jnp.float32), jnp.max(occ).astype(jnp.float32)
+        ]))
+        sink.add_sample("serf.queue.Event", float(qs[0]) / denom)
+        sink.set_gauge("serf.queue.Event.max", float(qs[1]))
+        if queue_depth_warning and qs[1] >= queue_depth_warning:
+            import logging
+
+            from consul_tpu.utils.logger import LOGGER_NAME
+            logging.getLogger(LOGGER_NAME + ".serf").warning(
+                "serf: Event queue depth: %d", int(qs[1])
+            )

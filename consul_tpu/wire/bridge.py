@@ -65,7 +65,7 @@ import jax.numpy as jnp
 import msgpack
 import numpy as np
 
-from consul_tpu.ops import merge, topology, vivaldi
+from consul_tpu.ops import merge, scaling, topology, vivaldi
 from consul_tpu.wire import codec
 from consul_tpu.wire.codec import MessageType
 from consul_tpu.wire.keyring import Keyring
@@ -253,6 +253,14 @@ class PacketBridge:
         # sim's own retention is ltime-bucketed, so old keys can never
         # redeliver once evicted here either).
         self._delivered_events: dict[int, dict] = {}
+        # Host-side queue bound: the reference's dynamic depth limit
+        # max(2N, MinQueueDepth) (getQueueMax, serf/serf.go:1612-1624)
+        # guards these unbounded-in-Go structures; 2x for _known_events
+        # which holds two insert sites' worth.
+        scfg = sim.cfg.serf
+        self._queue_max = scaling.queue_max_depth(
+            scfg.max_queue_depth, scfg.min_queue_depth, sim.cfg.n
+        )
 
     # ------------------------------------------------------------------
     # Attachment
@@ -425,7 +433,7 @@ class PacketBridge:
                 if ek in self._known_events:
                     return
                 self._known_events[ek] = None
-                while len(self._known_events) > 8192:
+                while len(self._known_events) > 2 * self._queue_max:
                     self._known_events.pop(next(iter(self._known_events)))
                 if not collided:
                     self._event_payloads[name_int] = codec.as_bytes(
@@ -659,14 +667,14 @@ class PacketBridge:
                 if key == 0 or key in seen or (key & 1):
                     continue  # empty, already delivered, or a query
                 seen[key] = None
-                while len(seen) > 4096:
+                while len(seen) > self._queue_max:
                     seen.pop(next(iter(seen)))
                 name_int = (key >> 1) & 0xFF
                 # Mark the echo as known so the agent's re-gossip of it
                 # cannot re-fire into the sim (bounded here too — this
                 # insert site sees one entry per sim-originated event).
                 self._known_events[(name_int, key >> 9)] = None
-                while len(self._known_events) > 8192:
+                while len(self._known_events) > 2 * self._queue_max:
                     self._known_events.pop(next(iter(self._known_events)))
                 out.append(codec.encode_serf_message(
                     codec.SERF_USER_EVENT, {

@@ -92,3 +92,19 @@ def test_rate_scaled_interval():
         scaling.rate_scaled_interval(64.0, 15 * 5.0, 100_000, ticks_per_s),
         5.0 * 100_000 / 64.0,
     )
+
+
+def test_queue_max_depth():
+    # getQueueMax semantics (serf/serf.go:1612-1624): MaxQueueDepth
+    # wins only when MinQueueDepth is unset; otherwise max(2N, min).
+    assert scaling.queue_max_depth(0, 4096, 100) == 4096
+    assert scaling.queue_max_depth(0, 4096, 2048) == 4096
+    assert scaling.queue_max_depth(0, 4096, 2049) == 4098
+    assert scaling.queue_max_depth(0, 4096, 100_000) == 200_000
+    # Static MaxQueueDepth applies when min is disabled.
+    assert scaling.queue_max_depth(1024, 0, 100_000) == 1024
+    # Consul's defaults (lib/serf.go:26-28): min raised to 4096.
+    from consul_tpu.config import SimConfig
+    cfg = SimConfig(n=64)
+    assert cfg.serf.min_queue_depth == 4096
+    assert cfg.serf.queue_depth_warning == 128

@@ -56,6 +56,26 @@ class TestSimEmission:
         assert "serf.coordinate.adjustment-ms" in samples
         assert "memberlist.gossip" in samples
 
+    def test_serf_queue_depth_sample(self):
+        # checkQueueDepth telemetry (serf/serf.go:1627-1648): the full-
+        # stack driver samples per-node event-queue occupancy, non-zero
+        # while a fired user event's epidemic is in flight.
+        import jax.numpy as jnp
+
+        from consul_tpu.models import serf as serf_mod
+        from consul_tpu.models.cluster import SerfSimulation
+
+        sim = SerfSimulation(SimConfig(n=64, view_degree=16), seed=0)
+        sim.run(32, chunk=16, with_metrics=False)
+        mask = jnp.zeros(64, bool).at[5].set(True)
+        sim.state = serf_mod.user_event(sim.cfg, sim.serf_state, mask, 3)
+        for _ in range(4):
+            sim.run(2, chunk=2, with_metrics=True)
+        snap = sim.sink.snapshot()
+        ev = [s for s in snap["Samples"] if s["Name"] == "serf.queue.Event"]
+        assert ev and ev[0]["Max"] > 0.0
+        assert "serf.queue.Event.max" in {g["Name"] for g in snap["Gauges"]}
+
     def test_health_score_rises_under_degradation(self):
         # A node whose probes keep failing accrues awareness — the
         # memberlist.health.score gauge must reflect it.
