@@ -171,9 +171,10 @@ class SerfConfig:
     # threshold feeds the serf.queue.* telemetry samples.
     min_queue_depth: int = 4096
     max_queue_depth: int = 0
+    # The reference warns when one node's queue holds 128 messages; the
+    # sim's per-node capacity is event_queue_slots, so the effective
+    # warning level is min(this, event_queue_slots) — a full queue warns.
     queue_depth_warning: int = 128
-    # QueueCheckInterval=30s (serf/config.go) at the 200 ms LAN tick.
-    queue_check_interval_ticks: int = 150
     # Query response timeout multiplier (reference serf/config.go
     # QueryTimeoutMult=16; timeout = mult * log10(N+1) * gossip_interval,
     # serf/serf.go DefaultQueryTimeout).

@@ -55,6 +55,16 @@ class FederationConfig:
     lan: SimConfig = dataclasses.field(default_factory=SimConfig)
     # Inter-DC spread for the WAN ground truth (ms).
     wan_diameter_ms: float = 120.0
+    # Inter-mesh (DCN) partitioning: this instance owns the ``n_dc``
+    # datacenters starting at global index ``dc_offset`` out of
+    # ``n_dc_total`` — its WAN pool replica spans ALL DCs' servers, but
+    # LAN ground truth flows into only the owned rows
+    # (parallel/dcn.py). Defaults = single-mesh: own everything. The
+    # None sentinel is kept un-materialized so a later
+    # ``dataclasses.replace(cfg, n_dc=...)`` tracks the new total
+    # (read via :attr:`dc_total`).
+    n_dc_total: Optional[int] = None
+    dc_offset: int = 0
 
     def __post_init__(self):
         object.__setattr__(
@@ -62,19 +72,23 @@ class FederationConfig:
         )
 
     @property
+    def dc_total(self) -> int:
+        return self.n_dc_total if self.n_dc_total is not None else self.n_dc
+
+    @property
     def wan(self) -> SimConfig:
         """The WAN pool's SimConfig: server subset, WAN gossip profile
         (reference memberlist/config.go:272-281)."""
         return dataclasses.replace(
             self.lan,
-            n=self.n_dc * self.servers_per_dc,
+            n=self.dc_total * self.servers_per_dc,
             gossip=GossipConfig.wan(),
             world_diameter_ms=self.wan_diameter_ms,
         )
 
     @property
     def n_wan(self) -> int:
-        return self.n_dc * self.servers_per_dc
+        return self.dc_total * self.servers_per_dc
 
 
 class FederationState(NamedTuple):
@@ -100,17 +114,22 @@ class Federation:
             jax.random.fold_in(k_lan_s, 1), 4
         )
         self.lan_topo = topology.make_topology(lan, k_lan_t)
-        lan_keys = jax.random.split(k_lan_w, cfg.n_dc)
+        # Key streams are laid out over the GLOBAL DC index so a
+        # partitioned (DCN) island plants the same worlds its DCs would
+        # have in the equivalent single-mesh federation.
+        dcs = slice(cfg.dc_offset, cfg.dc_offset + cfg.n_dc)
+        lan_keys = jax.random.split(k_lan_w, cfg.dc_total)[dcs]
         self.lan_world = jax.vmap(lambda k: topology.make_world(lan, k))(
             lan_keys
         )
-        init_keys = jax.random.split(k_lan_i, cfg.n_dc)
+        init_keys = jax.random.split(k_lan_i, cfg.dc_total)[dcs]
         lan_state = jax.vmap(lambda k: sim_state.init(lan, k))(init_keys)
 
-        # WAN: servers planted near their DC site.
+        # WAN: servers planted near their DC site (all DCs — the WAN
+        # pool replica is global even when this instance owns a slice).
         self.wan_topo = topology.make_topology(wan, k_wan_t)
         centers = jax.random.uniform(
-            k_centers, (cfg.n_dc, lan.world_dims), jnp.float32,
+            k_centers, (cfg.dc_total, lan.world_dims), jnp.float32,
             0.0, cfg.wan_diameter_ms / 1000.0,
         )
         local = topology.make_world(wan, k_wan_w)
@@ -140,12 +159,17 @@ class Federation:
             lan = jax.vmap(lan_step)(self.lan_world, state.lan, lan_keys)
             # WAN servers that died in their LAN pool are dead on the
             # WAN too (same process; reference: one serf agent in both
-            # pools). Ground truth flows LAN -> WAN.
+            # pools). Ground truth flows LAN -> WAN, into the OWNED rows
+            # only — other islands' rows keep their last-synced truth.
             s = cfg.servers_per_dc
+            off = cfg.dc_offset * s
             server_alive = lan.alive_truth[:, :s].reshape(-1)
             server_left = lan.left[:, :s].reshape(-1)
             wan = state.wan._replace(
-                alive_truth=server_alive, left=server_left
+                alive_truth=state.wan.alive_truth.at[
+                    off:off + server_alive.shape[0]].set(server_alive),
+                left=state.wan.left.at[
+                    off:off + server_left.shape[0]].set(server_left),
             )
             # Bresenham: fire a WAN tick whenever accumulated LAN time
             # crosses the WAN tick size.
@@ -188,15 +212,17 @@ class Federation:
     # Fault injection
     # ------------------------------------------------------------------
     def kill(self, dc: int, mask):
-        """Kill nodes in one DC (LAN + WAN if they are servers)."""
+        """Kill nodes in one locally-owned DC (LAN + WAN if servers);
+        ``dc`` is the local index within this instance's slice."""
         mask = jnp.asarray(mask, bool)
         lan_alive = self.state.lan.alive_truth.at[dc].set(
             self.state.lan.alive_truth[dc] & ~mask
         )
         s = self.cfg.servers_per_dc
+        g = (self.cfg.dc_offset + dc) * s
         wan_alive = self.state.wan.alive_truth.at[
-            dc * s:(dc + 1) * s
-        ].set(self.state.wan.alive_truth[dc * s:(dc + 1) * s] & ~mask[:s])
+            g:g + s
+        ].set(self.state.wan.alive_truth[g:g + s] & ~mask[:s])
         self.state = self.state._replace(
             lan=self.state.lan._replace(alive_truth=lan_alive),
             wan=self.state.wan._replace(alive_truth=wan_alive),

@@ -1,0 +1,168 @@
+"""Inter-mesh (DCN) federation: the WAN tier across device meshes.
+
+The reference federates datacenters over real WAN links: every server
+joins the global WAN serf pool, and cross-DC traffic rides UDP/TCP
+between hosts (reference agent/consul/server.go:223-230, flood.go).
+Intra-mesh, this framework's equivalent is ICI collectives
+(parallel/collective.py). This module is the remaining tier of the
+SURVEY §2.5 communication-backend mapping: **host-mediated DCN exchange
+between meshes** — multiple islands, each a mesh (in production: a
+pod/host group; here: a device subset or just a separate jit program),
+each running its own LAN pools plus a full **replica of the WAN pool**,
+reconciled at superstep boundaries through the host.
+
+Why replicas + periodic reconciliation is the honest design (not a
+shortcut): the WAN pool's state IS gossip state — per-observer views in
+a join-semilattice (ops/merge.py). Between syncs, each island's replica
+evolves only the rows it can see locally; at a sync, every island
+receives every other island's **owned rows wholesale** (full per-node
+protocol state: views, incarnations, budgets, coordinates). That is
+exactly a push-pull anti-entropy exchange (reference
+memberlist/state.go:573-608) executed at the DCN tier, and the
+dissemination of the received facts back into the island's own rows
+happens in-protocol, by the replica's subsequent WAN gossip ticks. The
+sync period is therefore the modeled DCN latency: a fact crosses
+islands in one superstep, then spreads in-replica at gossip speed —
+the same two-timescale behavior as the reference's LAN/WAN split.
+
+Ownership: island k owns the WAN rows of the servers in its DCs
+(``FederationConfig.dc_offset``/``n_dc``); LAN ground truth flows into
+owned rows only (models/federation.py), so a server's liveness is
+always authored by the island that simulates its datacenter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.models.federation import Federation, FederationConfig
+from consul_tpu.ops import merge
+
+
+class DcnFederation:
+    """Driver for a federation partitioned over ``n_islands`` meshes.
+
+    ``cfg`` describes the WHOLE federation (its ``n_dc`` is the global
+    DC count); DCs are partitioned contiguously across islands. Pass
+    ``meshes`` (one per island) to shard each island's state over its
+    own device subset; default leaves placement to JAX (correctness
+    path — the CPU test harness).
+    """
+
+    def __init__(self, cfg: FederationConfig, n_islands: int = 2,
+                 seed: int = 0, meshes: Optional[Sequence] = None):
+        if cfg.n_dc % n_islands != 0:
+            raise ValueError(
+                f"n_dc={cfg.n_dc} must divide into {n_islands} islands"
+            )
+        per = cfg.n_dc // n_islands
+        self.cfg = cfg
+        self.n_islands = n_islands
+        self.islands: list[Federation] = []
+        for k in range(n_islands):
+            icfg = dataclasses.replace(
+                cfg, n_dc=per, n_dc_total=cfg.n_dc, dc_offset=k * per
+            )
+            # Same seed everywhere: the WAN plant (sites, topology) must
+            # be identical across replicas; LAN worlds differ because
+            # the key stream is indexed by global DC (federation.py).
+            isl = Federation(icfg, seed=seed)
+            # De-correlate per-tick protocol randomness between islands
+            # (each replica is its own gossip universe between syncs).
+            isl.base_key = jax.random.fold_in(isl.base_key, k)
+            self.islands.append(isl)
+        self.meshes = list(meshes) if meshes is not None else None
+        if self.meshes is not None:
+            from consul_tpu.parallel import mesh as pmesh
+            for isl, m in zip(self.islands, self.meshes):
+                shardings = pmesh.federation_sharding(isl.state, m)
+                isl.state = jax.tree.map(jax.device_put, isl.state, shardings)
+        s = cfg.servers_per_dc
+        self._owner = jnp.repeat(
+            jnp.arange(n_islands, dtype=jnp.int32), per * s
+        )  # [n_wan] owning island of each WAN row
+
+    # ------------------------------------------------------------------
+    def sync(self):
+        """One DCN reconciliation: every island's replica takes every
+        other island's owned WAN rows wholesale (see module docstring).
+        One device->host pull and one host->device push per island —
+        the batched host-boundary discipline of SURVEY §7."""
+        # The DCN hop: replicas live on disjoint device sets, so the
+        # exchange goes through the host — one pull per island, one
+        # numpy-side merge, one push per island.
+        import numpy as np
+
+        wans = [jax.device_get(isl.state.wan) for isl in self.islands]
+        owner = np.asarray(self._owner)
+
+        def select(*leaves):
+            if leaves[0].ndim >= 1 and leaves[0].shape[0] == owner.shape[0]:
+                sel = owner.reshape((-1,) + (1,) * (leaves[0].ndim - 1))
+                out = leaves[0]
+                for k in range(1, len(leaves)):
+                    out = np.where(sel == k, leaves[k], out)
+                return out
+            return leaves[0]  # scalars (t, accum): lockstep-equal
+
+        merged = jax.tree.map(select, *wans)
+        for i, isl in enumerate(self.islands):
+            if self.meshes is not None:
+                from consul_tpu.parallel import mesh as pmesh
+                wan_shard = pmesh.federation_sharding(
+                    isl.state, self.meshes[i]
+                ).wan
+                wan = jax.tree.map(jax.device_put, merged, wan_shard)
+            else:
+                # device_put per island: fresh buffers, so the donating
+                # per-island runners never alias across replicas.
+                wan = jax.tree.map(
+                    lambda x: jax.device_put(jnp.asarray(x)), merged
+                )
+            isl.state = isl.state._replace(wan=wan)
+
+    def run(self, lan_ticks: int, sync_every: int = 16, chunk: int = 16):
+        """Advance all islands ``lan_ticks`` LAN ticks, reconciling the
+        WAN tier every ``sync_every`` ticks (the DCN cadence; 16 ticks =
+        3.2 s of protocol time at the 200 ms LAN tick)."""
+        remaining = lan_ticks
+        while remaining > 0:
+            c = min(sync_every, remaining)
+            for isl in self.islands:
+                isl.run(c, chunk=min(chunk, c))
+            self.sync()
+            remaining -= c
+
+    # ------------------------------------------------------------------
+    def island_of_dc(self, dc: int) -> tuple[Federation, int]:
+        """(owning island, local dc index) for a global DC index."""
+        per = self.cfg.n_dc // self.n_islands
+        return self.islands[dc // per], dc % per
+
+    def kill(self, dc: int, mask):
+        isl, local = self.island_of_dc(dc)
+        isl.kill(local, mask)
+
+    def wan_status_seen_by(self, observer_dc: int, subject_dc: int,
+                           observer_server: int = 0) -> list[str]:
+        """How ``observer_dc``'s first server sees ``subject_dc``'s
+        servers, read from the OBSERVER's island replica — the
+        cross-island convergence probe."""
+        isl, _ = self.island_of_dc(observer_dc)
+        cfg = self.cfg
+        s = cfg.servers_per_dc
+        i = observer_dc * s + observer_server
+        from consul_tpu.ops import topology as topo_mod
+        nbrs = topo_mod.nbrs_table(isl.wan_topo)
+        st = merge.key_status(isl.state.wan.view_key)
+        names = ["alive", "suspect", "dead", "left"]
+        out = {}
+        for col in range(isl.cfg.wan.degree):
+            j = int(nbrs[i, col])
+            if j // s == subject_dc:
+                out[j % s] = names[int(st[i, col])]
+        return [out.get(k, "untracked") for k in range(s)]

@@ -125,13 +125,18 @@ def emit_sim_metrics(state, sink: Sink,
     aw = state.awareness
     live = state.alive_truth & ~state.left
     live_f = live.astype(jnp.float32)
-    scalars = np.asarray(jnp.stack([
+    parts = [
         jnp.sum(jnp.where(live, aw, 0)).astype(jnp.float32),
         jnp.max(jnp.where(live, aw, 0)).astype(jnp.float32),
         jnp.sum(live_f),
         jnp.sum(jnp.abs(state.viv.adjustment) * live_f) * 1000.0,
         jnp.sum(state.viv.resets).astype(jnp.float32),
-    ]))
+    ]
+    if serf_state is not None:
+        occ = jnp.sum((serf_state.ev_key != 0) & live[:, None], axis=1)
+        parts += [jnp.sum(occ).astype(jnp.float32),
+                  jnp.max(occ).astype(jnp.float32)]
+    scalars = np.asarray(jnp.stack(parts))
     n_live = float(scalars[2])
     denom = max(n_live, 1.0)  # divide-by-zero clamp only
     sink.set_gauge("memberlist.health.score", float(scalars[0]) / denom)
@@ -156,17 +161,19 @@ def emit_sim_metrics(state, sink: Sink,
         # serf.queue.Event sample (checkQueueDepth, serf/serf.go:
         # 1627-1648): per-live-node occupied broadcast-queue slots. The
         # reference samples one node's queue length every 30 s; the sim
-        # folds the whole cluster into mean + max at the chunk boundary.
-        occ = jnp.sum((serf_state.ev_key != 0) & live[:, None], axis=1)
-        qs = np.asarray(jnp.stack([
-            jnp.sum(occ).astype(jnp.float32), jnp.max(occ).astype(jnp.float32)
-        ]))
-        sink.add_sample("serf.queue.Event", float(qs[0]) / denom)
-        sink.set_gauge("serf.queue.Event.max", float(qs[1]))
-        if queue_depth_warning and qs[1] >= queue_depth_warning:
+        # folds the whole cluster into mean + max at the chunk boundary,
+        # and a FULL per-node queue is the warning condition (the
+        # reference's 128-message level folded onto the sim's
+        # event_queue_slots capacity).
+        q_sum, q_max = float(scalars[5]), float(scalars[6])
+        sink.add_sample("serf.queue.Event", q_sum / denom)
+        sink.set_gauge("serf.queue.Event.max", q_max)
+        warn_at = min(queue_depth_warning, serf_state.ev_key.shape[1]) \
+            if queue_depth_warning else 0
+        if warn_at and q_max >= warn_at:
             import logging
 
             from consul_tpu.utils.logger import LOGGER_NAME
             logging.getLogger(LOGGER_NAME + ".serf").warning(
-                "serf: Event queue depth: %d", int(qs[1])
+                "serf: Event queue depth: %d", int(q_max)
             )
