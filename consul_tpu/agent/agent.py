@@ -85,6 +85,10 @@ class Agent:
         # Log monitor tap for /v1/agent/monitor (utils/logger.setup
         # returns one; None until logging is configured).
         self.monitor = None
+        # Cluster keyring manager for /v1/operator/keyring (a driver
+        # attaches a wire/keymanager.KeyManager when gossip encryption
+        # is on; None = encryption off, endpoint returns an error).
+        self.key_manager = None
 
     # -- service/check registration API (reference agent endpoints
     # /v1/agent/service/register etc.) ---------------------------------
@@ -151,6 +155,53 @@ class Agent:
         if self.force_leave_hook is None:
             return False
         return bool(self.force_leave_hook(node))
+
+    # -- maintenance mode (reference agent/agent.go EnableNodeMaintenance
+    # / EnableServiceMaintenance): a synthetic critical check that flows
+    # through anti-entropy into the catalog, so ?passing= discovery and
+    # DNS-equivalent filtering exclude the node/service. --------------
+    NODE_MAINT_CHECK_ID = "_node_maintenance"
+    SERVICE_MAINT_PREFIX = "_service_maintenance:"
+    _DEFAULT_NODE_REASON = (
+        "Maintenance mode is enabled for this node, "
+        "but no reason was provided. This is a default message."
+    )
+    _DEFAULT_SERVICE_REASON = (
+        "Maintenance mode is enabled for this service, "
+        "but no reason was provided. This is a default message."
+    )
+
+    def enable_node_maintenance(self, reason: str = ""):
+        self.local.add_check(
+            self.NODE_MAINT_CHECK_ID, status="critical",
+            output=reason or self._DEFAULT_NODE_REASON,
+        )
+
+    def disable_node_maintenance(self):
+        self.local.remove_check(self.NODE_MAINT_CHECK_ID)
+
+    def in_node_maintenance(self) -> bool:
+        return self.NODE_MAINT_CHECK_ID in self.local.checks
+
+    def enable_service_maintenance(self, service_id: str,
+                                   reason: str = "") -> bool:
+        if service_id not in self.local.services:
+            return False
+        self.local.add_check(
+            self.SERVICE_MAINT_PREFIX + service_id, status="critical",
+            service_id=service_id,
+            output=reason or self._DEFAULT_SERVICE_REASON,
+        )
+        return True
+
+    def disable_service_maintenance(self, service_id: str) -> bool:
+        """Idempotent like the reference DisableServiceMaintenance:
+        errors only for an unknown service; disabling a service that is
+        not in maintenance is a no-op success."""
+        if service_id not in self.local.services:
+            return False
+        self.local.remove_check(self.SERVICE_MAINT_PREFIX + service_id)
+        return True
 
     # -- the periodic work ---------------------------------------------
     def tick(self, now: float) -> dict:

@@ -177,6 +177,41 @@ class CheckRunner:
             check_id, lambda: tcp_probe(host, port, timeout_s), interval_s,
             service_id, now, background)
 
+    def add_alias(self, check_id: str, rpc, target_node: str,
+                  target_service_id: str = "", interval_s: float = 1.0,
+                  service_id: str = "", now: float = 0.0,
+                  background: bool = True) -> CheckMonitor:
+        """Alias check (reference agent/checks/alias.go CheckAlias):
+        mirrors the health of another node (or one service on it) into
+        a local check. Worst-status-wins over the aliased checks; a
+        node with no checks at all is passing (alias.go:150-158); an
+        unreachable catalog is critical. The reference watches the
+        remote health via blocking query; the tick-driven monitor polls
+        the same RPC on its interval."""
+        def probe() -> tuple[str, str]:
+            try:
+                out = rpc("Health.NodeChecks", node=target_node)
+                rows = out["value"] if isinstance(out, dict) else out
+            except Exception as e:  # noqa: BLE001 — check boundary
+                return "critical", f"alias target query failed: {e}"
+            if target_service_id:
+                rows = [r for r in rows
+                        if r.get("service_id") == target_service_id]
+            # No checks on the target -> passing (alias.go:150-158).
+            worst = "passing"
+            order = {"passing": 0, "warning": 1, "critical": 2}
+            for r in rows:
+                st = r.get("status", "critical")
+                if order.get(st, 2) > order[worst]:
+                    worst = st
+            return worst, (
+                "All checks passing." if worst == "passing"
+                else f"Aliased check(s) {worst} ({len(rows)} watched)."
+            )
+
+        return self.add_monitor(check_id, probe, interval_s, service_id,
+                                now, background)
+
     def remove(self, check_id: str):
         self.checks.pop(check_id, None)
         self.local.remove_check(check_id)

@@ -288,6 +288,53 @@ class HTTPApi:
             self.agent.remove_service(parts[3])
             self.agent.tick(_now())
             return 200, True, {}
+        if parts == ["agent", "maintenance"] and method == "PUT":
+            # Reference agent/agent_endpoint.go AgentNodeMaintenance.
+            if q.get("enable", "") in ("true", "1"):
+                self.agent.enable_node_maintenance(q.get("reason", ""))
+            else:
+                self.agent.disable_node_maintenance()
+            return 200, True, {}
+
+        if len(parts) == 4 and parts[:3] == ["agent", "service",
+                                             "maintenance"] \
+                and method == "PUT":
+            enable = q.get("enable", "") in ("true", "1")
+            ok = (self.agent.enable_service_maintenance(
+                      parts[3], q.get("reason", ""))
+                  if enable else
+                  self.agent.disable_service_maintenance(parts[3]))
+            if not ok:
+                return 404, {"error": f"unknown service {parts[3]}"}, {}
+            return 200, True, {}
+
+        if parts == ["operator", "keyring"]:
+            # Reference operator/keyring (agent/operator_endpoint.go):
+            # GET=list, POST=install, PUT=use, DELETE=remove, each a
+            # cluster-wide serf query through the KeyManager.
+            km = getattr(self.agent, "key_manager", None)
+            if km is None:
+                return 500, {"error": "keyring not enabled "
+                             "(gossip encryption is off)"}, {}
+            if method == "GET":
+                r = km.list_keys()
+                return 200, [{
+                    "Keys": r.keys, "NumNodes": r.num_nodes,
+                    "NumResp": r.num_resp, "NumErr": r.num_err,
+                    "Messages": r.messages,
+                }], {}
+            req = json.loads(body or b"{}")
+            key_b = base64.b64decode(req.get("Key", ""))
+            op = {"POST": km.install_key, "PUT": km.use_key,
+                  "DELETE": km.remove_key}.get(method)
+            if op is None:
+                return 405, {"error": "method not allowed"}, {}
+            r = op(key_b)
+            if not r.ok:
+                return 500, {"error": "; ".join(
+                    f"{n}: {m}" for n, m in r.messages.items())}, {}
+            return 200, True, {}
+
         if len(parts) == 4 and parts[0] == "agent" and parts[1] == "check" \
                 and parts[2] in ("pass", "warn", "fail"):
             chk = self.agent.checks.checks.get(parts[3])
@@ -433,6 +480,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_PUT(self):  # noqa: N802
         self._do("PUT")
+
+    def do_POST(self):  # noqa: N802
+        self._do("POST")
 
     def do_DELETE(self):  # noqa: N802
         self._do("DELETE")

@@ -49,6 +49,7 @@ class Client:
         self.coordinate = Coordinate(self)
         self.status = Status(self)
         self.agent = AgentAPI(self)
+        self.operator = Operator(self)
 
     def _call(self, method: str, path: str, params: Optional[dict] = None,
               body: Optional[bytes] = None) -> tuple[Any, QueryMeta, int]:
@@ -265,6 +266,48 @@ class AgentAPI:
         out, _, _ = self.c._call("PUT", f"/v1/agent/check/fail/{check_id}",
                                  {"note": note or None})
         return bool(out)
+
+    def maintenance(self, enable: bool, reason: str = "") -> bool:
+        """Node maintenance mode (reference api/agent.go EnableNodeMaintenance)."""
+        out, _, _ = self.c._call(
+            "PUT", "/v1/agent/maintenance",
+            {"enable": "true" if enable else "false",
+             "reason": reason or None})
+        return bool(out)
+
+    def service_maintenance(self, service_id: str, enable: bool,
+                            reason: str = "") -> bool:
+        out, _, _ = self.c._call(
+            "PUT", f"/v1/agent/service/maintenance/{service_id}",
+            {"enable": "true" if enable else "false",
+             "reason": reason or None})
+        return bool(out)
+
+
+class Operator:
+    """Operator endpoints (reference api/operator_keyring.go)."""
+
+    def __init__(self, c: Client):
+        self.c = c
+
+    def keyring_list(self) -> list[dict]:
+        out, _, _ = self.c._call("GET", "/v1/operator/keyring")
+        return out
+
+    def _keyring_op(self, method: str, key_b64: str) -> bool:
+        out, _, _ = self.c._call(
+            method, "/v1/operator/keyring", None,
+            json.dumps({"Key": key_b64}).encode())
+        return bool(out)
+
+    def keyring_install(self, key_b64: str) -> bool:
+        return self._keyring_op("POST", key_b64)
+
+    def keyring_use(self, key_b64: str) -> bool:
+        return self._keyring_op("PUT", key_b64)
+
+    def keyring_remove(self, key_b64: str) -> bool:
+        return self._keyring_op("DELETE", key_b64)
 
 
 class Lock:

@@ -12,6 +12,13 @@ framework implements:
   services register|deregister                         (command/services)
   sessions list                                        (command/acl… session)
   snapshot save|restore                                (command/snapshot)
+  event fire|list / watch / force-leave / operator raft / debug
+  maint            node/service maintenance mode       (command/maint)
+  keyring          gossip key install/use/remove/list  (command/keyring)
+  monitor          stream agent logs                   (command/monitor)
+  validate         config file validation              (command/validate)
+  lock             run a command under a KV lock       (command/lock)
+  exec             remote execution via KV + events    (command/exec)
 
 All commands speak to a running agent's HTTP API (like the reference,
 which routes every subcommand through the api client), selected by
@@ -226,6 +233,112 @@ def cmd_operator(client: Client, args) -> int:
     raise AssertionError(args.operator_cmd)
 
 
+def cmd_maint(client: Client, args) -> int:
+    """Maintenance mode toggle (reference command/maint)."""
+    enable = not args.disable
+    if args.service:
+        ok = client.agent.service_maintenance(
+            args.service, enable, args.reason or "")
+        what = f"service {args.service}"
+    else:
+        ok = client.agent.maintenance(enable, args.reason or "")
+        what = "node"
+    verb = "enabled" if enable else "disabled"
+    print(f"Maintenance mode {verb} for {what}" if ok else "error")
+    return 0 if ok else 1
+
+
+def cmd_keyring(client: Client, args) -> int:
+    """Cluster gossip-keyring management (reference command/keyring →
+    operator keyring serf queries)."""
+    try:
+        if args.list:
+            for pool in client.operator.keyring_list():
+                for key, holders in sorted(pool.get("Keys", {}).items()):
+                    print(f"  {key} [{holders}/{pool.get('NumNodes', '?')}]")
+            return 0
+        if args.install:
+            ok = client.operator.keyring_install(args.install)
+        elif args.use:
+            ok = client.operator.keyring_use(args.use)
+        elif args.remove:
+            ok = client.operator.keyring_remove(args.remove)
+        else:
+            print("one of -list/-install/-use/-remove required",
+                  file=sys.stderr)
+            return 1
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print("ok" if ok else "error")
+    return 0 if ok else 1
+
+
+def cmd_monitor(client: Client, args) -> int:
+    """Stream agent logs (reference command/monitor →
+    /v1/agent/monitor long-poll loop)."""
+    index = 0
+    rounds = 0
+    while args.rounds == 0 or rounds < args.rounds:
+        out, meta, _ = client._call(
+            "GET", "/v1/agent/monitor",
+            {"index": index or None, "wait": args.wait})
+        for line in out or []:
+            print(line)
+        # Never regress to a non-blocking cursor: an idle tap at seq 0
+        # must long-poll (?index=1), not busy-spin the HTTP loop.
+        index = max(meta.index, 1)
+        rounds += 1
+    return 0
+
+
+def cmd_validate(client: Client, args) -> int:
+    """Validate a config file (reference command/validate)."""
+    from consul_tpu import config_loader
+
+    try:
+        config_loader.load([args.path])
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print(f"Config validation failed: {e}", file=sys.stderr)
+        return 1
+    print(f"Configuration file {args.path} is valid!")
+    return 0
+
+
+def cmd_lock(client: Client, args) -> int:
+    """Run a shell command under a KV lock (reference command/lock:
+    acquire, exec child, release)."""
+    import subprocess
+
+    from consul_tpu.api import Lock
+
+    lock = Lock(client, args.prefix)
+    if not lock.acquire(retries=args.retries):
+        print("lock acquisition failed", file=sys.stderr)
+        return 1
+    try:
+        return subprocess.call(args.command, shell=True)
+    finally:
+        lock.release()
+
+
+def cmd_exec(client: Client, args) -> int:
+    """Remote execution over KV + events (reference command/exec →
+    agent/remote_exec.go semantics via rexec.py)."""
+    from consul_tpu import rexec
+
+    result = rexec.submit(client, args.node, args.command,
+                          wait_s=args.timeout, target=args.target or "")
+    for node, r in sorted(result.items()):
+        out = r.get("output", b"")
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        print(f"{node}: {out.rstrip()} (exit {r.get('exit')})")
+    print(f"{len(result)} node(s) responded")
+    return 0 if result and all(
+        r.get("exit") == 0 for r in result.values()) else 1
+
+
 def cmd_debug(client: Client, args) -> int:
     """Capture a debug bundle over the HTTP API (reference
     command/debug/debug.go captureStatic)."""
@@ -328,6 +441,38 @@ def build_parser() -> argparse.ArgumentParser:
     raft_sub = raft_p.add_subparsers(dest="raft_cmd", required=True)
     raft_sub.add_parser("list-peers")
 
+    mt = sub.add_parser("maint", help="toggle maintenance mode")
+    mt.add_argument("-disable", action="store_true")
+    mt.add_argument("-reason", default="")
+    mt.add_argument("-service", default="")
+
+    kr = sub.add_parser("keyring", help="gossip keyring management")
+    kr.add_argument("-list", action="store_true")
+    kr.add_argument("-install", default="")
+    kr.add_argument("-use", default="")
+    kr.add_argument("-remove", default="")
+
+    mon = sub.add_parser("monitor", help="stream agent logs")
+    mon.add_argument("--rounds", type=int, default=1,
+                     help="long-poll rounds (0 = forever)")
+    mon.add_argument("--wait", default="10s")
+
+    va = sub.add_parser("validate", help="validate a config file")
+    va.add_argument("path")
+
+    lk = sub.add_parser("lock", help="run a command under a KV lock")
+    lk.add_argument("prefix")
+    lk.add_argument("command")
+    lk.add_argument("--retries", type=int, default=10)
+
+    ex = sub.add_parser("exec", help="remote execution via KV + events")
+    ex.add_argument("command")
+    ex.add_argument("--node", default="",
+                    help="coordinating node (the submitting agent)")
+    ex.add_argument("--target", default="",
+                    help="only this node executes (default: all workers)")
+    ex.add_argument("--timeout", type=float, default=5.0)
+
     return p
 
 
@@ -336,7 +481,9 @@ COMMANDS = {
     "catalog": cmd_catalog, "info": cmd_info, "services": cmd_services,
     "sessions": cmd_sessions, "snapshot": cmd_snapshot, "debug": cmd_debug,
     "event": cmd_event, "watch": cmd_watch, "force-leave": cmd_force_leave,
-    "operator": cmd_operator,
+    "operator": cmd_operator, "maint": cmd_maint, "keyring": cmd_keyring,
+    "monitor": cmd_monitor, "validate": cmd_validate, "lock": cmd_lock,
+    "exec": cmd_exec,
 }
 
 

@@ -40,7 +40,6 @@ import jax
 import jax.numpy as jnp
 
 from consul_tpu.models.federation import Federation, FederationConfig
-from consul_tpu.ops import merge
 
 
 class DcnFederation:
@@ -76,6 +75,10 @@ class DcnFederation:
             isl.base_key = jax.random.fold_in(isl.base_key, k)
             self.islands.append(isl)
         self.meshes = list(meshes) if meshes is not None else None
+        if self.meshes is not None and len(self.meshes) != n_islands:
+            raise ValueError(
+                f"{len(self.meshes)} meshes for {n_islands} islands"
+            )
         if self.meshes is not None:
             from consul_tpu.parallel import mesh as pmesh
             for isl, m in zip(self.islands, self.meshes):
@@ -149,20 +152,15 @@ class DcnFederation:
 
     def wan_status_seen_by(self, observer_dc: int, subject_dc: int,
                            observer_server: int = 0) -> list[str]:
-        """How ``observer_dc``'s first server sees ``subject_dc``'s
-        servers, read from the OBSERVER's island replica — the
-        cross-island convergence probe."""
+        """How ``observer_dc``'s server sees ``subject_dc``'s servers,
+        read from the OBSERVER's island replica — the cross-island
+        convergence probe. Columns the observer's partial view does not
+        track report "untracked"."""
         isl, _ = self.island_of_dc(observer_dc)
-        cfg = self.cfg
-        s = cfg.servers_per_dc
-        i = observer_dc * s + observer_server
-        from consul_tpu.ops import topology as topo_mod
-        nbrs = topo_mod.nbrs_table(isl.wan_topo)
-        st = merge.key_status(isl.state.wan.view_key)
-        names = ["alive", "suspect", "dead", "left"]
+        s = self.cfg.servers_per_dc
         out = {}
-        for col in range(isl.cfg.wan.degree):
-            j = int(nbrs[i, col])
-            if j // s == subject_dc:
-                out[j % s] = names[int(st[i, col])]
+        for m in isl.wan_members_seen_by(observer_dc, observer_server):
+            if m["dc"] == f"dc{subject_dc}":
+                srv = int(m["id"].split(".")[0][3:])
+                out[srv] = m["status"]
         return [out.get(k, "untracked") for k in range(s)]

@@ -258,17 +258,15 @@ class PacketBridge:
         # guards these unbounded-in-Go structures; 2x for _known_events
         # which holds two insert sites' worth.
         scfg = sim.cfg.serf
-        # Floor at the Consul MinQueueDepth: a computed limit of 0
-        # (min_queue_depth=0 with the unlimited max_queue_depth=0
-        # default) must mean "unscaled", not "evict everything" — an
+        # A computed limit of 0 (min_queue_depth=0 with the unlimited
+        # max_queue_depth=0 default) must mean "unbounded" here — an
         # empty dedup dict would re-deliver every event each tick and
-        # feed the agent-echo loop this buffer exists to break.
-        self._queue_max = max(
-            scaling.queue_max_depth(
-                scfg.max_queue_depth, scfg.min_queue_depth, sim.cfg.n
-            ),
-            4096,
-        )
+        # feed the agent-echo loop this buffer exists to break — so 0
+        # falls back to the Consul MinQueueDepth floor. A deliberately
+        # small nonzero configured cap is respected.
+        self._queue_max = scaling.queue_max_depth(
+            scfg.max_queue_depth, scfg.min_queue_depth, sim.cfg.n
+        ) or 4096
 
     # ------------------------------------------------------------------
     # Attachment

@@ -324,3 +324,131 @@ class TestCLI:
         names = {g["Name"] for g in out["Gauges"]}
         assert "memberlist.health.score" in names
         assert any(n.startswith("consul.agent.") for n in names)
+
+
+class TestMaintenance:
+    """Node/service maintenance mode (reference agent/agent.go
+    EnableNodeMaintenance / EnableServiceMaintenance + command/maint)."""
+
+    def test_node_maintenance_roundtrip(self, stack):
+        _, agent, client, _ = stack
+        assert client.agent.maintenance(True, "upgrading kernel")
+        assert agent.in_node_maintenance()
+        chk = agent.local.checks[Agent.NODE_MAINT_CHECK_ID]
+        assert chk.status == "critical"
+        assert "upgrading kernel" in chk.output
+        assert client.agent.maintenance(False)
+        assert not agent.in_node_maintenance()
+
+    def test_node_maintenance_default_reason(self, stack):
+        _, agent, client, _ = stack
+        assert client.agent.maintenance(True)
+        chk = agent.local.checks[Agent.NODE_MAINT_CHECK_ID]
+        assert "default message" in chk.output
+        client.agent.maintenance(False)
+
+    def test_service_maintenance(self, stack):
+        _, agent, client, _ = stack
+        assert client.agent.service_register("pay", service_id="pay1")
+        try:
+            assert client.agent.service_maintenance("pay1", True, "deploy")
+            cid = Agent.SERVICE_MAINT_PREFIX + "pay1"
+            assert agent.local.checks[cid].service_id == "pay1"
+            assert client.agent.service_maintenance("pay1", False)
+            assert cid not in agent.local.checks
+        finally:
+            client.agent.service_deregister("pay1")
+
+    def test_service_maintenance_unknown_service(self, stack):
+        _, _, client, _ = stack
+        assert not client.agent.service_maintenance("nope", True)
+
+    def test_maint_cli(self, stack):
+        _, agent, _, port = stack
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = cli_main(["--http-addr", f"127.0.0.1:{port}",
+                           "maint", "-reason", "cli test"])
+        assert rc == 0 and "enabled" in buf.getvalue()
+        assert agent.in_node_maintenance()
+        with redirect_stdout(io.StringIO()):
+            assert cli_main(["--http-addr", f"127.0.0.1:{port}",
+                             "maint", "-disable"]) == 0
+        assert not agent.in_node_maintenance()
+
+
+class TestKeyringHTTP:
+    """/v1/operator/keyring over the KeyManager (reference
+    agent/operator_endpoint.go + serf/keymanager.go)."""
+
+    def test_disabled_without_key_manager(self, stack):
+        _, agent, client, _ = stack
+        assert agent.key_manager is None
+        from consul_tpu.api import APIError
+        with pytest.raises(APIError):
+            client.operator.keyring_list()
+
+    def test_keyring_ops_roundtrip(self, stack):
+        import base64
+        import os as _os
+
+        from consul_tpu.wire.keymanager import KeyManager
+        from consul_tpu.wire.keyring import Keyring
+
+        _, agent, client, port = stack
+        k0 = _os.urandom(16)
+        members = {f"m{i}": Keyring(primary=k0) for i in range(3)}
+        agent.key_manager = KeyManager(members)
+        try:
+            pools = client.operator.keyring_list()
+            k0_b64 = base64.b64encode(k0).decode()
+            assert pools[0]["Keys"][k0_b64] == 3
+            k1_b64 = base64.b64encode(_os.urandom(32)).decode()
+            assert client.operator.keyring_install(k1_b64)
+            assert client.operator.keyring_use(k1_b64)
+            assert client.operator.keyring_remove(k0_b64)
+            pools = client.operator.keyring_list()
+            assert list(pools[0]["Keys"]) == [k1_b64]
+            # keyring CLI: list through the same endpoint.
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                rc = cli_main(["--http-addr", f"127.0.0.1:{port}",
+                               "keyring", "-list"])
+            assert rc == 0 and k1_b64 in buf.getvalue()
+        finally:
+            agent.key_manager = None
+
+
+class TestValidateCli:
+    def test_validate(self, stack, tmp_path):
+        _, _, _, port = stack
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps({"n": 64, "view_degree": 8}))
+        with redirect_stdout(io.StringIO()):
+            assert cli_main(["--http-addr", f"127.0.0.1:{port}",
+                             "validate", str(good)]) == 0
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"no_such_knob": 1}))
+        with redirect_stdout(io.StringIO()):
+            assert cli_main(["--http-addr", f"127.0.0.1:{port}",
+                             "validate", str(bad)]) == 1
+
+
+class TestLockCli:
+    def test_lock_runs_command_and_releases(self, stack):
+        _, _, client, port = stack
+        with redirect_stdout(io.StringIO()):
+            rc = cli_main(["--http-addr", f"127.0.0.1:{port}",
+                           "lock", "svc/leader", "exit 0"])
+        assert rc == 0
+        # Lock released: the key is free to acquire again immediately.
+        lock = Lock(client, "svc/leader")
+        assert lock.acquire(retries=2)
+        lock.release()
+
+    def test_lock_propagates_child_exit_code(self, stack):
+        _, _, _, port = stack
+        with redirect_stdout(io.StringIO()):
+            rc = cli_main(["--http-addr", f"127.0.0.1:{port}",
+                           "lock", "svc/leader", "exit 3"])
+        assert rc == 3

@@ -416,3 +416,57 @@ class TestRemoteExec:
                      b'["a list"]')
         client._call("PUT", f"/v1/event/fire/{rexec.EVENT}", {}, b'3')
         worker.poll(wait="200ms")  # must not raise
+
+
+class TestAliasCheck:
+    """Alias checks (reference agent/checks/alias.go): mirror another
+    node's (or service's) health into a local check."""
+
+    def _runner(self):
+        from consul_tpu.agent.checks import CheckRunner
+        from consul_tpu.agent.local import LocalState
+        local = LocalState("n1", "addr")
+        return local, CheckRunner(local)
+
+    def test_alias_mirrors_target_health(self):
+        remote = {"rows": []}
+
+        def rpc(method, **kw):
+            assert method == "Health.NodeChecks" and kw["node"] == "db-1"
+            return {"index": 1, "value": list(remote["rows"])}
+
+        local, runner = self._runner()
+        runner.add_alias("alias-db", rpc, "db-1", interval_s=1.0,
+                         background=False)
+        runner.tick(0.0)
+        # No checks on the target -> passing (alias.go:150-158).
+        assert local.checks["alias-db"].status == "passing"
+        remote["rows"] = [{"check_id": "x", "status": "warning"},
+                         {"check_id": "y", "status": "critical"}]
+        runner.tick(1.0)  # worst status wins
+        assert local.checks["alias-db"].status == "critical"
+        remote["rows"] = [{"check_id": "x", "status": "passing"}]
+        runner.tick(2.0)
+        assert local.checks["alias-db"].status == "passing"
+
+    def test_alias_service_filter_and_rpc_failure(self):
+        rows = [
+            {"check_id": "a", "status": "critical", "service_id": "web1"},
+            {"check_id": "b", "status": "passing", "service_id": "api1"},
+        ]
+        calls = {"fail": False}
+
+        def rpc(method, **kw):
+            if calls["fail"]:
+                raise ConnectionError("no leader")
+            return {"index": 1, "value": rows}
+
+        local, runner = self._runner()
+        runner.add_alias("alias-api", rpc, "db-1",
+                         target_service_id="api1", interval_s=1.0,
+                         background=False)
+        runner.tick(0.0)  # only api1's checks count
+        assert local.checks["alias-api"].status == "passing"
+        calls["fail"] = True  # unreachable catalog -> critical
+        runner.tick(1.0)
+        assert local.checks["alias-api"].status == "critical"
