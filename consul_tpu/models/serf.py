@@ -50,6 +50,7 @@ from consul_tpu.models import state as sim_state
 from consul_tpu.models import swim
 from consul_tpu.models.state import SimState
 from consul_tpu.ops import lamport, merge, scaling
+from consul_tpu.parallel import collective as coll
 from consul_tpu.ops.topology import World
 
 # Event key packing: uint32 = (ltime << 9) | (name & 0xff) << 1 | is_query.
@@ -209,8 +210,9 @@ def _buf_apply(cfg: SimConfig, bkt_lt, bkt_key, bkt_origin, floor, mask, key_, o
     Lamport floor past the evicted ltime (eventMinTime semantics) so
     evicted events are rejected as stale, never redelivered.
     """
-    n, r, o = cfg.n, cfg.serf.seen_ring, cfg.serf.seen_width
-    rows = jnp.arange(n, dtype=jnp.int32)
+    r, o = cfg.serf.seen_ring, cfg.serf.seen_width
+    # Local row indexing (works on a shard_map block as-is).
+    rows = jnp.arange(bkt_lt.shape[0], dtype=jnp.int32)
     lt = event_ltime(key_)
     b = (lt % jnp.uint32(r)).astype(jnp.int32)
     blt = bkt_lt[rows, b]
@@ -390,14 +392,16 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
     n, k_deg = cfg.n, cfg.degree
     pe, fan = cfg.serf.piggyback_events, cfg.gossip.gossip_nodes
     e_slots = cfg.serf.event_queue_slots
-    rows = jnp.arange(n, dtype=jnp.int32)
+    ln = coll.local_n(n)
+    lrows = jnp.arange(ln, dtype=jnp.int32)   # local indices (buffers)
+    grows = coll.rows(n)                      # global ids (identity)
     k_cols, k_loss, k_resp = jax.random.split(key, 3)
     sentinel = jnp.uint32(0xFFFFFFFF)
     with jax.ensure_compile_time_eval():
         tx_limit = int(scaling.retransmit_limit(cfg.gossip.retransmit_mult, n))
 
     # ---- 1. Deliver: oldest not-yet-delivered entry of the own queue.
-    q_dst = jnp.repeat(rows, e_slots)
+    q_dst = jnp.repeat(lrows, e_slots)
     q_keys = s.ev_key.reshape(-1)
     q_orig = s.ev_origin.reshape(-1)
     q_fresh = (
@@ -406,13 +410,13 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
         & jnp.repeat(active, e_slots)
     )
     del_key = jnp.min(
-        jnp.where(q_fresh, q_keys, sentinel).reshape(n, e_slots), axis=1
+        jnp.where(q_fresh, q_keys, sentinel).reshape(ln, e_slots), axis=1
     )
     has = del_key != sentinel
     # The matching slot with the lowest index (ties share key+origin
     # only if the queue holds a same-origin duplicate, which
     # _equeue_push's same-subject replacement prevents).
-    slot_match = q_fresh.reshape(n, e_slots) & (
+    slot_match = q_fresh.reshape(ln, e_slots) & (
         s.ev_key == del_key[:, None]
     )
     del_slot = jnp.argmax(slot_match, axis=1)
@@ -440,29 +444,37 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
     # response lands unless the direct packet and every relayed copy
     # drop. The tally counts each responder once (duplicates are deduped
     # by the origin in the reference; q_resps is that deduped count).
-    resp_drop = jax.random.uniform(k_resp, (n,)) < cfg.packet_loss
+    resp_drop = coll.uniform_rows(k_resp, n) < cfg.packet_loss
     arrived = ~resp_drop
     rf = cfg.serf.query_relay_factor
     if rf > 0 and cfg.packet_loss > 0.0:
         k_relay = jax.random.fold_in(k_resp, 1)
         k_rl1, k_rl2, k_rcol = jax.random.split(k_relay, 3)
-        loss1 = jax.random.uniform(k_rl1, (n, rf)) < cfg.packet_loss
-        loss2 = jax.random.uniform(k_rl2, (n, rf)) < cfg.packet_loss
+        loss1 = coll.uniform_rows(k_rl1, n, (rf,)) < cfg.packet_loss
+        loss2 = coll.uniform_rows(k_rl2, n, (rf,)) < cfg.packet_loss
         rcols = jax.random.randint(k_rcol, (rf,), 0, k_deg)
         relay_up = jnp.stack(
-            [jnp.roll(active, -topo.off[rcols[i]]) for i in range(rf)],
+            [coll.roll(active, -topo.off[rcols[i]]) for i in range(rf)],
             axis=1,
         )
         arrived = arrived | jnp.any(relay_up & ~loss1 & ~loss2, axis=1)
+    # The origin is an arbitrary global row: its liveness and open-query
+    # key come from the globally-visible copies, and the tally is a
+    # row-addressed all-to-all delivery (the one non-roll exchange of
+    # the serf plane; under sharding: all_gather + reduce-scatter). The
+    # liveness pair folds into one gathered bool to keep it at two [N]
+    # collectives per tick.
+    q_open_g = coll.all_rows(s.q_open_key)
+    up_g = coll.all_rows(s.swim.alive_truth & ~s.swim.left)
     resp_ok = (
         isq
         & arrived
-        & (s.q_open_key[worig] == wkey)
-        & s.swim.alive_truth[worig]
-        & ~s.swim.left[worig]
-        & (worig != rows)  # origin's own delivery happened at submit
+        & (q_open_g[worig] == wkey)
+        & up_g[worig]
+        & (worig != grows)  # origin's own delivery happened at submit
     )
-    s = s._replace(q_resps=s.q_resps.at[worig].add(jnp.where(resp_ok, 1, 0)))
+    s = s._replace(q_resps=s.q_resps + coll.sum_scatter_rows(
+        worig, jnp.where(resp_ok, 1, 0).astype(s.q_resps.dtype), n))
 
     # ---- 2. Gossip out: most-retransmittable queue entries, sent along
     # per-tick shared displacements (swim-plane divergence note).
@@ -487,31 +499,43 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
     delivered_now = (
         jnp.arange(e_slots, dtype=jnp.int32)[None, :] == del_slot[:, None]
     ) & has[:, None]
-    still_fresh = q_fresh.reshape(n, e_slots) & ~delivered_now
+    still_fresh = q_fresh.reshape(ln, e_slots) & ~delivered_now
     retire = (ev_tx <= 0) & ~still_fresh
     s = s._replace(ev_tx=ev_tx, ev_key=jnp.where(retire, 0, s.ev_key))
 
     # ---- 3. Intake (receiver-side): roll in each displacement-sender's
     # chosen events, then stage up to 2 fresh arrivals per receiver.
+    # The sender payload is packed so each displacement is ONE roll
+    # (one ppermute exchange under shard_map), as in the SWIM plane.
     recv_up = s.swim.alive_truth & ~s.swim.left
-    drop = jax.random.uniform(k_loss, (n, fan)) < cfg.packet_loss
+    drop = coll.uniform_rows(k_loss, n, (fan,)) < cfg.packet_loss
+    payload = jnp.concatenate(
+        [
+            m_key,                                  # [:, 0:PE]
+            m_origin.astype(jnp.uint32),            # [:, PE:2PE]
+            m_valid.astype(jnp.uint32),             # [:, 2PE:3PE]
+            peer_ok.astype(jnp.uint32),             # [:, 3PE:3PE+fan]
+        ],
+        axis=1,
+    )
     cand_key, cand_orig = [], []
     for f in range(fan):
         shift = topo.off[jcols[f]]
-        arrived = jnp.roll(peer_ok[:, f], shift) & ~drop[:, f] & recv_up
-        ok = arrived[:, None] & jnp.roll(m_valid, shift, axis=0)
-        cand_key.append(jnp.where(ok, jnp.roll(m_key, shift, axis=0), 0))
+        pkt = coll.roll(payload, shift)
+        arrived = (pkt[:, 3 * pe + f] != 0) & ~drop[:, f] & recv_up
+        ok = arrived[:, None] & (pkt[:, 2 * pe:3 * pe] != 0)
+        cand_key.append(jnp.where(ok, pkt[:, :pe], 0))
         cand_orig.append(
-            jnp.where(ok, jnp.roll(m_origin, shift, axis=0), -1)
+            jnp.where(ok, pkt[:, pe:2 * pe].astype(jnp.int32), -1)
         )
     ckey = jnp.concatenate(cand_key, axis=1)       # [N, fan*PE]
     corig = jnp.concatenate(cand_orig, axis=1)
     m = ckey.shape[1]
     fresh = (ckey > 0) & ~_lookup_any(
         cfg, s,
-        jnp.repeat(rows, m).reshape(n, m).reshape(-1),
+        jnp.repeat(lrows, m).reshape(ln, m).reshape(-1),
         ckey.reshape(-1), corig.reshape(-1),
-    ).reshape(n, m)
+    ).reshape(ln, m)
     for _ in range(2):
         win_key = jnp.min(jnp.where(fresh, ckey, sentinel), axis=1)
         got = win_key != sentinel

@@ -6,12 +6,16 @@ vendor/github.com/hashicorp/memberlist/transport.go:27-65) plus a
 yamux-multiplexed RPC pool (reference agent/pool/pool.go:122-533). The
 TPU equivalent (SURVEY.md §2.5) is XLA collectives over ICI. This module
 is that backend, stated explicitly: every cross-node message exchange in
-the simulation is a circulant **roll** along the node axis
+the SWIM plane is a circulant **roll** along the node axis
 (ops/topology.py), and under ``shard_map`` a roll of the node-sharded
 array decomposes into at most two ``lax.ppermute`` block transfers
 around the device ring (static shift) or a log2(D) conditional-hop
 ppermute ladder (traced shift) — the all-neighbor exchange rides ICI
-links point-to-point, never a host round-trip and never an all-gather.
+links point-to-point, never a host round-trip. The serf event plane
+adds the two row-addressed exchanges rolls cannot express — reading an
+arbitrary global row (:func:`all_rows`, one [N] all-gather) and
+delivering to one (:func:`sum_scatter_rows`, a reduce-scatter) — both
+O(N)-bytes collectives, still no host round-trips.
 
 Design: the step functions (models/swim.py) are written against the
 row-axis primitives below. Outside any context they degrade to exactly
@@ -181,6 +185,36 @@ def any_rows(x: jax.Array) -> jax.Array:
     if ctx is None:
         return local
     return jax.lax.psum(local.astype(jnp.int32), ctx.axis_name) > 0
+
+
+def all_rows(x: jax.Array) -> jax.Array:
+    """The full global per-row array, visible on every shard — for
+    gathers by arbitrary global row id (e.g. a query's origin). One
+    all-gather of a [N]-sized array; identity when unsharded."""
+    ctx = _CTX.get()
+    if ctx is None:
+        return x
+    squeeze = x.dtype == jnp.bool_
+    g = jax.lax.all_gather(
+        x.astype(jnp.uint8) if squeeze else x, ctx.axis_name, tiled=True
+    )
+    return g.astype(jnp.bool_) if squeeze else g
+
+
+def sum_scatter_rows(idx: jax.Array, vals: jax.Array, n: int) -> jax.Array:
+    """Scatter-add ``vals`` at global row ids ``idx`` and return each
+    row's received total (this shard's block under sharding): the
+    all-to-all row-addressed delivery (e.g. query-response tallies).
+    Each shard accumulates into a global-sized buffer; a reduce-scatter
+    (psum_scatter) folds the shards and hands each device exactly its
+    block — half the bandwidth of a full psum + slice."""
+    ctx = _CTX.get()
+    full = jnp.zeros((n,), vals.dtype).at[idx].add(vals)
+    if ctx is None:
+        return full
+    return jax.lax.psum_scatter(
+        full, ctx.axis_name, scatter_dimension=0, tiled=True
+    )
 
 
 # ----------------------------------------------------------------------

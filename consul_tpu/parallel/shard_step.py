@@ -16,7 +16,9 @@ Two ways to run the simulation on a device mesh:
      vendor/github.com/hashicorp/memberlist/transport.go:27-65): rolls
      whose shift is a trace-time constant move exactly one block's rows
      point-to-point; traced shifts take a log2(D) conditional ppermute
-     ladder. No all-gathers, no host round-trips.
+     ladder. The serf event plane's two row-addressed exchanges ride an
+     [N] all-gather and a reduce-scatter (collective.all_rows /
+     sum_scatter_rows). No host round-trips anywhere.
 
 A sharded step matches the unsharded step for the same (state, key):
 per-row randomness is generated from the global stream and sliced per
@@ -44,11 +46,9 @@ from consul_tpu.parallel import collective as coll
 from consul_tpu.parallel.mesh import NODE_AXIS, node_spec
 
 
-def make_sharded_step(cfg: SimConfig, topo: Topology, mesh: Mesh):
-    """Build ``step(world, state, key) -> state`` running under shard_map
-    over ``mesh``'s node axis with explicit ppermute collectives. The
-    returned function is jitted with donated state buffers; place inputs
-    with :func:`place` first for zero-copy."""
+def _make_sharded(step_fn, cfg: SimConfig, topo: Topology, mesh: Mesh):
+    """Shared builder: jit(shard_map(step_fn)) over the node axis with
+    the collective context installed and state buffers donated."""
     n_shards = mesh.shape[NODE_AXIS]
     if cfg.n % n_shards != 0:
         raise ValueError(f"n={cfg.n} must divide over {n_shards} shards")
@@ -59,7 +59,7 @@ def make_sharded_step(cfg: SimConfig, topo: Topology, mesh: Mesh):
 
     def local_step(world_local, state_local, key):
         with coll.node_axis(NODE_AXIS, n_shards, cfg.n):
-            return swim.step(cfg, topo, world_local, state_local, key)
+            return step_fn(cfg, topo, world_local, state_local, key)
 
     def global_step(world_g, state_g, key):
         specs = jax.tree.map(lambda l: node_spec(l, cfg.n), state_g)
@@ -73,6 +73,25 @@ def make_sharded_step(cfg: SimConfig, topo: Topology, mesh: Mesh):
         return inner(world_g, state_g, key)
 
     return jax.jit(global_step, donate_argnums=(1,))
+
+
+def make_sharded_step(cfg: SimConfig, topo: Topology, mesh: Mesh):
+    """Build ``step(world, state, key) -> state`` running under shard_map
+    over ``mesh``'s node axis with explicit ppermute collectives. The
+    returned function is jitted with donated state buffers; place inputs
+    with :func:`place` first for zero-copy."""
+    return _make_sharded(swim.step, cfg, topo, mesh)
+
+
+def make_sharded_serf_step(cfg: SimConfig, topo: Topology, mesh: Mesh):
+    """The FULL serf step (SWIM + events/queries/reap) under shard_map.
+    Beyond the SWIM plane's rolls, the event plane adds the two
+    row-addressed exchanges: origin-attribute reads via all_gather and
+    the query-response tally via reduce-scatter
+    (collective.all_rows / sum_scatter_rows)."""
+    from consul_tpu.models import serf
+
+    return _make_sharded(serf.step, cfg, topo, mesh)
 
 
 def place(mesh: Mesh, tree, n: int):
