@@ -89,6 +89,18 @@ class Agent:
         # attaches a wire/keymanager.KeyManager when gossip encryption
         # is on; None = encryption off, endpoint returns an error).
         self.key_manager = None
+        # Config reload for /v1/agent/reload (reference agent
+        # ReloadConfig via SIGHUP or the endpoint): a driver wires this
+        # to config_loader.apply_safe on its Simulation; returns the
+        # list of applied knob paths.
+        self.reload_hook: Optional[Callable[[], list]] = None
+
+    def reload(self) -> Optional[list]:
+        """Re-read config sources and apply the safe subset; None when
+        no driver wired a reload path."""
+        if self.reload_hook is None:
+            return None
+        return list(self.reload_hook())
 
     # -- service/check registration API (reference agent endpoints
     # /v1/agent/service/register etc.) ---------------------------------

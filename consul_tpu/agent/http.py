@@ -288,6 +288,14 @@ class HTTPApi:
             self.agent.remove_service(parts[3])
             self.agent.tick(_now())
             return 200, True, {}
+        if parts == ["agent", "reload"] and method == "PUT":
+            # Reference /v1/agent/reload (http_register.go): re-read
+            # config sources, apply the safe subset, report what moved.
+            applied = self.agent.reload()
+            if applied is None:
+                return 500, {"error": "reload not wired on this agent"}, {}
+            return 200, {"Applied": applied}, {}
+
         if parts == ["agent", "maintenance"] and method == "PUT":
             # Reference agent/agent_endpoint.go AgentNodeMaintenance.
             if q.get("enable", "") in ("true", "1"):

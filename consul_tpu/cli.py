@@ -339,6 +339,19 @@ def cmd_exec(client: Client, args) -> int:
         r.get("exit") == 0 for r in result.values()) else 1
 
 
+def cmd_reload(client: Client, args) -> int:
+    """Trigger a config reload (reference command/reload)."""
+    try:
+        out, _, _ = client._call("PUT", "/v1/agent/reload")
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print("Configuration reload triggered"
+          + (f" (applied: {', '.join(out['Applied'])})"
+             if out.get("Applied") else " (no safe-reloadable changes)"))
+    return 0
+
+
 def cmd_debug(client: Client, args) -> int:
     """Capture a debug bundle over the HTTP API (reference
     command/debug/debug.go captureStatic)."""
@@ -473,6 +486,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="only this node executes (default: all workers)")
     ex.add_argument("--timeout", type=float, default=5.0)
 
+    sub.add_parser("reload", help="trigger a config reload")
+
     return p
 
 
@@ -483,7 +498,7 @@ COMMANDS = {
     "event": cmd_event, "watch": cmd_watch, "force-leave": cmd_force_leave,
     "operator": cmd_operator, "maint": cmd_maint, "keyring": cmd_keyring,
     "monitor": cmd_monitor, "validate": cmd_validate, "lock": cmd_lock,
-    "exec": cmd_exec,
+    "exec": cmd_exec, "reload": cmd_reload,
 }
 
 

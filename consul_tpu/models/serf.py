@@ -509,20 +509,25 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
     # (one ppermute exchange under shard_map), as in the SWIM plane.
     recv_up = s.swim.alive_truth & ~s.swim.left
     drop = coll.uniform_rows(k_loss, n, (fan,)) < cfg.packet_loss
-    payload = jnp.concatenate(
+    base = jnp.concatenate(
         [
             m_key,                                  # [:, 0:PE]
             m_origin.astype(jnp.uint32),            # [:, PE:2PE]
             m_valid.astype(jnp.uint32),             # [:, 2PE:3PE]
-            peer_ok.astype(jnp.uint32),             # [:, 3PE:3PE+fan]
         ],
         axis=1,
     )
     cand_key, cand_orig = [], []
     for f in range(fan):
         shift = topo.off[jcols[f]]
-        pkt = coll.roll(payload, shift)
-        arrived = (pkt[:, 3 * pe + f] != 0) & ~drop[:, f] & recv_up
+        # Only this displacement's peer_ok column rides its packet.
+        pkt = coll.roll(
+            jnp.concatenate(
+                [base, peer_ok[:, f:f + 1].astype(jnp.uint32)], axis=1
+            ),
+            shift,
+        )
+        arrived = (pkt[:, 3 * pe] != 0) & ~drop[:, f] & recv_up
         ok = arrived[:, None] & (pkt[:, 2 * pe:3 * pe] != 0)
         cand_key.append(jnp.where(ok, pkt[:, :pe], 0))
         cand_orig.append(

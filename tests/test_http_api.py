@@ -452,3 +452,23 @@ class TestLockCli:
             rc = cli_main(["--http-addr", f"127.0.0.1:{port}",
                            "lock", "svc/leader", "exit 3"])
         assert rc == 3
+
+
+class TestReload:
+    def test_reload_endpoint_and_cli(self, stack):
+        _, agent, client, port = stack
+        from consul_tpu.api import APIError
+        with pytest.raises(APIError):  # no driver wired a reload path
+            client._call("PUT", "/v1/agent/reload")
+        calls = []
+        agent.reload_hook = lambda: calls.append(1) or ["gossip.tick_ms"]
+        try:
+            out, _, _ = client._call("PUT", "/v1/agent/reload")
+            assert out == {"Applied": ["gossip.tick_ms"]}
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                rc = cli_main(["--http-addr", f"127.0.0.1:{port}", "reload"])
+            assert rc == 0 and "gossip.tick_ms" in buf.getvalue()
+            assert len(calls) == 2
+        finally:
+            agent.reload_hook = None
