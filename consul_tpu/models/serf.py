@@ -505,34 +505,21 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
 
     # ---- 3. Intake (receiver-side): roll in each displacement-sender's
     # chosen events, then stage up to 2 fresh arrivals per receiver.
-    # The sender payload is packed so each displacement is ONE roll
-    # (one ppermute exchange under shard_map), as in the SWIM plane.
+    # One exchange per displacement via coll.roll_many (separate fused
+    # rolls single-chip; one packed ppermute sharded), as in the SWIM
+    # plane.
     recv_up = s.swim.alive_truth & ~s.swim.left
     drop = coll.uniform_rows(k_loss, n, (fan,)) < cfg.packet_loss
-    base = jnp.concatenate(
-        [
-            m_key,                                  # [:, 0:PE]
-            m_origin.astype(jnp.uint32),            # [:, PE:2PE]
-            m_valid.astype(jnp.uint32),             # [:, 2PE:3PE]
-        ],
-        axis=1,
-    )
     cand_key, cand_orig = [], []
     for f in range(fan):
         shift = topo.off[jcols[f]]
-        # Only this displacement's peer_ok column rides its packet.
-        pkt = coll.roll(
-            jnp.concatenate(
-                [base, peer_ok[:, f:f + 1].astype(jnp.uint32)], axis=1
-            ),
-            shift,
+        s_key, s_orig, s_valid, s_peer = coll.roll_many(
+            [m_key, m_origin, m_valid, peer_ok[:, f]], shift
         )
-        arrived = (pkt[:, 3 * pe] != 0) & ~drop[:, f] & recv_up
-        ok = arrived[:, None] & (pkt[:, 2 * pe:3 * pe] != 0)
-        cand_key.append(jnp.where(ok, pkt[:, :pe], 0))
-        cand_orig.append(
-            jnp.where(ok, pkt[:, pe:2 * pe].astype(jnp.int32), -1)
-        )
+        arrived = s_peer & ~drop[:, f] & recv_up
+        ok = arrived[:, None] & s_valid
+        cand_key.append(jnp.where(ok, s_key, 0))
+        cand_orig.append(jnp.where(ok, s_orig, -1))
     ckey = jnp.concatenate(cand_key, axis=1)       # [N, fan*PE]
     corig = jnp.concatenate(cand_orig, axis=1)
     m = ckey.shape[1]

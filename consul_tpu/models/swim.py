@@ -558,37 +558,26 @@ def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit):
     )
     state = state._replace(tx_left=tx_left, own_tx=own_tx)
 
-    # Receiver-side delivery: one packet per (receiver, displacement).
-    # The whole sender payload is packed into one uint32 array so each
-    # displacement costs a single roll — under shard_map one ppermute
-    # exchange instead of seven (the literal "one packet per hop").
+    # Receiver-side delivery: one packet per (receiver, displacement) —
+    # the sender payload rides one exchange per hop (coll.roll_many:
+    # separate fused rolls single-chip, one packed ppermute sharded).
     recv_up = state.alive_truth & ~state.left
     drop = coll.uniform_rows(k_drop, n, (fan,)) < cfg.packet_loss
     view = state.view_key
     refute_inc = jnp.zeros((ln,), jnp.uint32)
     seen_delta = jnp.zeros((ln, k_deg), jnp.uint32)
-    payload = jnp.concatenate(
-        [
-            scol.astype(jnp.uint32),                  # [:, 0:P]
-            skey,                                     # [:, P:2P]
-            sbits,                                    # [:, 2P:3P]
-            svalid.astype(jnp.uint32),                # [:, 3P:4P]
-            sendable.astype(jnp.uint32),              # [:, 4P:4P+fan]
-            own_sendable.astype(jnp.uint32)[:, None], # [:, 4P+fan]
-            ownk[:, None],                            # [:, 4P+fan+1]
-        ],
-        axis=1,
-    )
     cands = []
     for f in range(fan):
         j = jcols[f]
         shift = topo.off[j]
-        pkt = coll.roll(payload, shift)
-        arrived = (pkt[:, 4 * p + f] != 0) & ~drop[:, f] & recv_up
-        s_scol = pkt[:, :p].astype(jnp.int32)
-        s_skey = pkt[:, p:2 * p]
-        s_sbits = pkt[:, 2 * p:3 * p]
-        fact_ok = arrived[:, None] & (pkt[:, 3 * p:4 * p] != 0)
+        (s_send, s_scol, s_skey, s_sbits, s_svalid, s_own_ok,
+         s_ownk) = coll.roll_many(
+            [sendable[:, f], scol, skey, sbits, svalid, own_sendable,
+             ownk],
+            shift,
+        )
+        arrived = s_send & ~drop[:, f] & recv_up
+        fact_ok = arrived[:, None] & s_svalid
         rr = topology.remap_row(topo, j)                # [K]
         mycol = _vec_at(rr, s_scol)                     # [N, P]
         about_me = mycol == topology.SELF
@@ -606,8 +595,8 @@ def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit):
         # The sender's own-fact rides the same packet, landing at the
         # receiver column the sender itself occupies.
         icol = topology.inv_col(topo, j)
-        own_ok = arrived & (pkt[:, 4 * p + fan] != 0)
-        own_val = jnp.where(own_ok, pkt[:, 4 * p + fan + 1], jnp.uint32(0))
+        own_ok = arrived & s_own_ok
+        own_val = jnp.where(own_ok, s_ownk, jnp.uint32(0))
         # Merge: per-row one-hot max over the P facts + the own-fact.
         oh = mycol[:, None, :] == col_ids[None, :, None]          # [N,K,P]
         delta = jnp.max(jnp.where(oh, mkey[:, None, :], 0), axis=2)
@@ -691,25 +680,19 @@ def _push_pull_phase(cfg, topo: Topology, state: SimState, active, pp_period, ke
 
     view0 = state.view_key                    # both directions exchange
     ownk = _own_key(state)                    # the pre-exchange states
-    # One packed roll per direction (one ppermute exchange under
-    # shard_map): view + own-fact + liveness ride the same packet.
+    # One exchange per direction: view + own-fact + liveness ride the
+    # same hop (coll.roll_many).
     up = state.alive_truth & ~state.left
-    fwd = coll.roll(
-        jnp.concatenate(
-            [view0, ownk[:, None], up.astype(jnp.uint32)[:, None]], axis=1
-        ),
-        -shift,
-    )
-    partner_up = fwd[:, k_deg + 1] != 0
+    pv, fwd_ownk, partner_up = coll.roll_many([view0, ownk, up], -shift)
     init_ok = due & partner_up & merge.is_contactable(view0[:, j])
 
-    # PULL: the initiator merges its partner's full state.
-    pv = fwd[:, :k_deg]                               # partner rows
+    # PULL: the initiator merges its partner's full state (pv holds the
+    # partner rows).
     ent = jnp.take(pv, rr_c, axis=1)
     ent = jnp.where(rr[None, :] >= 0, ent, jnp.uint32(0))
     ent = jnp.where(
         jnp.arange(k_deg, dtype=jnp.int32)[None, :] == j,
-        fwd[:, k_deg][:, None], ent,
+        fwd_ownk[:, None], ent,
     )
     pull = merge.demote_dead_to_suspect(ent)
     view = merge.join(state.view_key, jnp.where(init_ok[:, None], pull, 0))
@@ -723,21 +706,15 @@ def _push_pull_phase(cfg, topo: Topology, state: SimState, active, pp_period, ke
     # initiated toward r. The column algebra mirrors the pull with the
     # roles swapped: local column c takes s's column holding the same
     # subject, remapped through the inverse displacement.
-    bwd = coll.roll(
-        jnp.concatenate(
-            [view0, ownk[:, None], init_ok.astype(jnp.uint32)[:, None]], axis=1
-        ),
-        shift,
-    )
-    s_ok = (bwd[:, k_deg + 1] != 0) & up
-    sv = bwd[:, :k_deg]                               # initiator rows
+    sv, bwd_ownk, bwd_init = coll.roll_many([view0, ownk, init_ok], shift)
+    s_ok = bwd_init & up                              # sv: initiator rows
     rr2 = topology.remap_row(topo, icol)
     rr2_c = jnp.clip(rr2, 0, k_deg - 1)
     ent2 = jnp.take(sv, rr2_c, axis=1)
     ent2 = jnp.where(rr2[None, :] >= 0, ent2, jnp.uint32(0))
     ent2 = jnp.where(
         jnp.arange(k_deg, dtype=jnp.int32)[None, :] == icol,
-        bwd[:, k_deg][:, None], ent2,
+        bwd_ownk[:, None], ent2,
     )
     push = merge.demote_dead_to_suspect(ent2)
     view = merge.join(view, jnp.where(s_ok[:, None], push, 0))

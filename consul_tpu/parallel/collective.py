@@ -178,6 +178,35 @@ def _bcast(pred: jax.Array, ndim: int) -> jax.Array:
     return pred.reshape((1,) * ndim) if ndim else pred
 
 
+def roll_many(arrays, shift):
+    """Roll several same-row-count arrays by one shared shift along the
+    node axis. Unsharded: one ``jnp.roll`` per array — XLA fuses the
+    static slices, and no packed copy is materialized (packing costs
+    ~10% single-chip throughput at >=262k nodes). Sharded: the arrays
+    pack into one uint32 payload so the whole exchange is a single
+    ppermute per hop, then unpack. Supports bool/int32/uint32 leaves of
+    rank 1 or 2; int32 round-trips by bit-pattern (negatives survive)."""
+    ctx = _CTX.get()
+    if ctx is None:
+        return [jnp.roll(a, shift, axis=0) for a in arrays]
+    cols = []
+    for a in arrays:
+        a2 = a[:, None] if a.ndim == 1 else a
+        cols.append(a2.astype(jnp.uint32))
+    packed = roll(jnp.concatenate(cols, axis=1), shift)
+    out, at = [], 0
+    for a in arrays:
+        w = 1 if a.ndim == 1 else a.shape[1]
+        piece = packed[:, at:at + w]
+        at += w
+        if a.dtype == jnp.bool_:
+            piece = piece != 0
+        else:
+            piece = piece.astype(a.dtype)
+        out.append(piece[:, 0] if a.ndim == 1 else piece)
+    return out
+
+
 def any_rows(x: jax.Array) -> jax.Array:
     """``jnp.any`` over the full (global) node axis."""
     ctx = _CTX.get()
