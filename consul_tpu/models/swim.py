@@ -16,13 +16,20 @@ receiver *gathers* from its senders. The circulant topology
 sender of node ``r`` is ``r - off[j]``, so "fetch what my sender did"
 is ``jnp.roll(sender_array, off[j])``, and the column any gossiped
 subject lands in at the receiver is the static table
-``remap_row(topo, j)``. Measured on TPU v5e, per-row-indexed
-gathers/scatters run ~40x slower than dense compare-select work, so the
-step avoids them entirely: per-row column selection is one-hot
-compare-select (:func:`_take_cols`), per-row *node* indexing is a
-K-unrolled static-shift roll accumulation (:func:`_gather_by_col` — the
-offsets are trace-time constants), and cross-node delivery is rolls.
-The hot path contains no scatter and no per-row gather.
+``remap_row(topo, j)``. The step therefore avoids per-row-indexed
+gathers entirely: per-row column selection is one-hot compare-select
+(:func:`_take_cols`), per-row *node* indexing is a K-unrolled
+static-shift roll accumulation (:func:`_gather_by_col` — the offsets
+are trace-time constants), and cross-node delivery is rolls. The hot
+path contains no scatter and no per-row gather.
+
+Measured (TPU v5 lite, 2026-07-30, n=262144/K=32, whole-step A/B —
+BASELINE.md "formulation validation"): swapping :func:`_take_cols` for
+``take_along_axis`` drops the step from 141 to 11.3 rounds/s (12x) —
+the native gather wins an isolated microbenchmark but destroys XLA's
+fusion of the merge chain in context; swapping :func:`_gather_by_col`
+for a cross-row gather drops it to 72.8 (2x). Re-run the A/B before
+believing any "gathers are fine now" microbenchmark.
 
 Tick anatomy (mirroring one round of the reference's event loop):
 
