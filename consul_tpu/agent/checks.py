@@ -134,7 +134,16 @@ def tcp_probe(host: str, port: int, timeout_s: float = 10.0) -> tuple[str, str]:
 class CheckRunner:
     """Owns all of an agent's checks and pumps them on the agent tick
     (replacing the reference's goroutine-per-check model with the
-    framework's explicit time-step idiom)."""
+    framework's explicit time-step idiom).
+
+    Runner inventory vs the reference (agent/checks/): TTL, monitor
+    (script-check equivalent: any Python callable), HTTP, TCP, and
+    alias are implemented. gRPC (grpc.go) and Docker (docker.go)
+    runners are deliberately absent: neither the grpc package nor a
+    container runtime exists in this build environment, and a runner
+    that cannot execute would be dead code — both fit the
+    ``add_monitor`` extension point (a probe returning (status,
+    output)) when their dependencies exist."""
 
     def __init__(self, local: LocalState):
         self.local = local
