@@ -90,9 +90,9 @@ def child(platform: str, deadline: float):
     chunk = int(os.environ.get("BENCH_CHUNK", "128"))
     profile = os.environ.get("BENCH_PROFILE", "")
 
-    def build(n_nodes):
+    def build(n_nodes, cls=Simulation):
         cfg = SimConfig(n=n_nodes, view_degree=min(view_degree, n_nodes - 2))
-        return Simulation(cfg, seed=0)
+        return cls(cfg, seed=0)
 
     sim = None
     try:
@@ -159,6 +159,30 @@ def child(platform: str, deadline: float):
             })
     except Exception as e:
         _emit({"phase": "error", "where": "rmse", "error": repr(e)[:500]})
+    finally:
+        sim = None  # free the headline sim before the serf build below
+
+    # Full-stack serf throughput: the SWIM plane PLUS the user-event/
+    # query plane (models/serf.py) with a live epidemic in flight.
+    try:
+        if left() > 120:
+            from consul_tpu.models.cluster import SerfSimulation
+
+            ssim = build(n, cls=SerfSimulation)
+            ssim.run(chunk, chunk=chunk, with_metrics=False)
+            ssim.user_event(jnp.arange(n) < 8, 1)
+            jax.block_until_ready(ssim.state.ev_key)
+            t1 = time.monotonic()
+            ssim.run(chunk * 2, chunk=chunk, with_metrics=False)
+            jax.block_until_ready(ssim.state.ev_key)
+            _emit({
+                "phase": "serf_throughput",
+                "n": n,
+                "rounds_per_s": round(chunk * 2 / (time.monotonic() - t1), 2),
+            })
+            del ssim
+    except Exception as e:
+        _emit({"phase": "error", "where": "serf", "error": repr(e)[:500]})
 
     # Scaling sweep: throughput at each shape, each its own try/except,
     # each gated on remaining deadline (SURVEY §7 phases 4-5 shapes).
@@ -340,6 +364,8 @@ def main():
         "detect_converge_sim_s": _get(primary["phases"], "convergence", "sim_s"),
         "vivaldi_rmse_ms": _get(primary["phases"], "rmse", "vivaldi_rmse_ms"),
         "agreement": _get(primary["phases"], "rmse", "agreement"),
+        "serf_rounds_per_s": _get(
+            primary["phases"], "serf_throughput", "rounds_per_s"),
         "sweep": [
             {"n": p["n"], "rounds_per_s": p["rounds_per_s"],
              "compile_s": p.get("compile_s")}
