@@ -182,25 +182,40 @@ def _equeue_push(cfg: SimConfig, s: SerfState, mask, key_, origin, tx0):
     )
 
 
-def _buf_lookup(cfg: SimConfig, bkt_lt, bkt_key, bkt_origin, floor, dst, key_, origin):
-    """Is (key, origin) a duplicate/stale for each receiver ``dst``?
+def _buf_lookup(cfg: SimConfig, bkt_lt, bkt_key, bkt_origin, floor, key_, origin):
+    """Is (key, origin) a duplicate/stale for its row's buffer? ``key_``
+    and ``origin`` are [N, E] — E candidates per row, each checked
+    against that row's own buffer.
 
     Mirrors the reference's buffer check (serf/serf.go:1258-1357): the
     bucket for ``ltime % R`` either records this ltime (then membership
     of (key, origin) decides, with a full bucket dropping overflow), is
     owned by a *newer* ltime (this message is outside the window), or
     the ltime is below the floor — all three reject.
+
+    One-hot over the (small) ring axis instead of per-row-indexed
+    gathers — on TPU the gather formulation costs ~90x at the step
+    level (BASELINE.md formulation validation; same lesson as
+    swim._take_cols).
     """
-    lt = event_ltime(key_)
-    b = (lt % jnp.uint32(cfg.serf.seen_ring)).astype(jnp.int32)
-    blt = bkt_lt[dst, b]                        # [M]
-    slot_key = bkt_key[dst, b]                  # [M, O]
-    slot_origin = bkt_origin[dst, b]            # [M, O]
-    in_bucket = (blt == lt) & jnp.any(
-        (slot_key == key_[:, None]) & (slot_origin == origin[:, None]), axis=1
+    r = cfg.serf.seen_ring
+    lt = event_ltime(key_)                      # [N, E]
+    b = (lt % jnp.uint32(r)).astype(jnp.int32)
+    b_oh = b[:, :, None] == jnp.arange(r, dtype=jnp.int32)[None, None, :]
+    blt = swim._take_cols(bkt_lt, b)            # [N, E]
+    # [N, E, O]: the addressed bucket's slots, selected over R.
+    slot_key = jnp.sum(
+        jnp.where(b_oh[:, :, :, None], bkt_key[:, None, :, :], 0), axis=2
     )
-    bucket_full = (blt == lt) & jnp.all(slot_key != 0, axis=1)
-    return in_bucket | bucket_full | (blt > lt) | (lt < floor[dst])
+    slot_origin = jnp.sum(
+        jnp.where(b_oh[:, :, :, None], bkt_origin[:, None, :, :], 0), axis=2
+    )
+    in_bucket = (blt == lt) & jnp.any(
+        (slot_key == key_[:, :, None]) & (slot_origin == origin[:, :, None]),
+        axis=2,
+    )
+    bucket_full = (blt == lt) & jnp.all(slot_key != 0, axis=2)
+    return in_bucket | bucket_full | (blt > lt) | (lt < floor[:, None])
 
 
 def _buf_apply(cfg: SimConfig, bkt_lt, bkt_key, bkt_origin, floor, mask, key_, origin):
@@ -211,26 +226,31 @@ def _buf_apply(cfg: SimConfig, bkt_lt, bkt_key, bkt_origin, floor, mask, key_, o
     evicted events are rejected as stale, never redelivered.
     """
     r, o = cfg.serf.seen_ring, cfg.serf.seen_width
-    # Local row indexing (works on a shard_map block as-is).
-    rows = jnp.arange(bkt_lt.shape[0], dtype=jnp.int32)
     lt = event_ltime(key_)
     b = (lt % jnp.uint32(r)).astype(jnp.int32)
-    blt = bkt_lt[rows, b]
+    # One-hot bucket select over the small ring axis (no per-row
+    # gathers — see _buf_lookup).
+    b_sel = jnp.arange(r, dtype=jnp.int32)[None, :] == b[:, None]  # [N, R]
+    blt = swim._take_col(bkt_lt, b)
     takeover = mask & (blt != lt)               # empty (0) or older ltime
     evict = takeover & (blt > 0)
     floor = jnp.where(evict, jnp.maximum(floor, blt + 1), floor)
 
-    b_oh = (jnp.arange(r, dtype=jnp.int32)[None, :] == b[:, None]) & mask[:, None]
+    b_oh = b_sel & mask[:, None]
     bkt_lt = jnp.where(b_oh, lt[:, None], bkt_lt)
     # Slot: 0 on takeover (clearing the rest), else first free slot.
-    cur_key = bkt_key[rows, b]                  # [N, O]
+    cur_key = jnp.sum(
+        jnp.where(b_sel[:, :, None], bkt_key, 0), axis=1
+    )                                           # [N, O]
     free = jnp.argmax(cur_key == 0, axis=1).astype(jnp.int32)
     slot = jnp.where(takeover, 0, free)
     s_oh = (jnp.arange(o, dtype=jnp.int32)[None, :] == slot[:, None])
     new_slot_key = jnp.where(
         s_oh, key_[:, None], jnp.where(takeover[:, None], 0, cur_key)
     )
-    cur_origin = bkt_origin[rows, b]
+    cur_origin = jnp.sum(
+        jnp.where(b_sel[:, :, None], bkt_origin, 0), axis=1
+    )
     new_slot_origin = jnp.where(
         s_oh, origin[:, None], jnp.where(takeover[:, None], -1, cur_origin)
     )
@@ -354,15 +374,16 @@ def step(cfg: SimConfig, topo, world: World, s: SerfState, key) -> SerfState:
     return s._replace(down_since=down_since)
 
 
-def _lookup_any(cfg: SimConfig, s: SerfState, dst, key_, origin):
-    """Duplicate/stale check against the kind-matching buffer."""
+def _lookup_any(cfg: SimConfig, s: SerfState, key_, origin):
+    """Duplicate/stale check against the kind-matching buffer; ``key_``
+    and ``origin`` are [N, E] candidates per row."""
     seen_ev = _buf_lookup(
         cfg, s.ev_bkt_lt, s.ev_bkt_key, s.ev_bkt_origin, s.ev_floor,
-        dst, key_, origin,
+        key_, origin,
     )
     seen_q = _buf_lookup(
         cfg, s.q_bkt_lt, s.q_bkt_key, s.q_bkt_origin, s.q_floor,
-        dst, key_, origin,
+        key_, origin,
     )
     return jnp.where(event_is_query(key_), seen_q, seen_ev)
 
@@ -387,13 +408,11 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
     the SWIM gossip plane (models/swim.py): each receiver *rolls in*
     its senders' chosen events — no scatters. The only scatter left in
     the serf layer is the per-tick [N] query-response tally add (the
-    response targets an arbitrary origin), outside the hot bench path.
+    response targets an arbitrary origin — coll.sum_scatter_rows).
     """
     n, k_deg = cfg.n, cfg.degree
     pe, fan = cfg.serf.piggyback_events, cfg.gossip.gossip_nodes
     e_slots = cfg.serf.event_queue_slots
-    ln = coll.local_n(n)
-    lrows = jnp.arange(ln, dtype=jnp.int32)   # local indices (buffers)
     grows = coll.rows(n)                      # global ids (identity)
     k_cols, k_loss, k_resp = jax.random.split(key, 3)
     sentinel = jnp.uint32(0xFFFFFFFF)
@@ -401,26 +420,19 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
         tx_limit = int(scaling.retransmit_limit(cfg.gossip.retransmit_mult, n))
 
     # ---- 1. Deliver: oldest not-yet-delivered entry of the own queue.
-    q_dst = jnp.repeat(lrows, e_slots)
-    q_keys = s.ev_key.reshape(-1)
-    q_orig = s.ev_origin.reshape(-1)
     q_fresh = (
-        (q_keys > 0)
-        & ~_lookup_any(cfg, s, q_dst, q_keys, q_orig)
-        & jnp.repeat(active, e_slots)
-    )
-    del_key = jnp.min(
-        jnp.where(q_fresh, q_keys, sentinel).reshape(ln, e_slots), axis=1
-    )
+        (s.ev_key > 0)
+        & ~_lookup_any(cfg, s, s.ev_key, s.ev_origin)
+        & active[:, None]
+    )                                           # [N, E]
+    del_key = jnp.min(jnp.where(q_fresh, s.ev_key, sentinel), axis=1)
     has = del_key != sentinel
     # The matching slot with the lowest index (ties share key+origin
     # only if the queue holds a same-origin duplicate, which
     # _equeue_push's same-subject replacement prevents).
-    slot_match = q_fresh.reshape(ln, e_slots) & (
-        s.ev_key == del_key[:, None]
-    )
+    slot_match = q_fresh & (s.ev_key == del_key[:, None])
     del_slot = jnp.argmax(slot_match, axis=1)
-    del_origin = jnp.take_along_axis(s.ev_origin, del_slot[:, None], axis=1)[:, 0]
+    del_origin = swim._take_col(s.ev_origin, del_slot)
     wkey = jnp.where(has, del_key, 0)
     worig = jnp.where(has, del_origin, 0)
 
@@ -478,10 +490,11 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
 
     # ---- 2. Gossip out: most-retransmittable queue entries, sent along
     # per-tick shared displacements (swim-plane divergence note).
-    order = jnp.argsort(-s.ev_tx, axis=1)[:, :pe]
-    m_key = jnp.take_along_axis(s.ev_key, order, axis=1)
-    m_origin = jnp.take_along_axis(s.ev_origin, order, axis=1)
-    m_tx = jnp.take_along_axis(s.ev_tx, order, axis=1)
+    # top_k + one-hot column selects (the no-gather style; argsort +
+    # take_along_axis gathers are the TPU cliff — BASELINE.md).
+    m_tx, order = jax.lax.top_k(s.ev_tx, pe)
+    m_key = swim._take_cols(s.ev_key, order)
+    m_origin = swim._take_cols(s.ev_origin, order)
     m_valid = (m_key > 0) & (m_tx > 0) & active[:, None]
 
     jcols = jax.random.randint(k_cols, (fan,), 0, k_deg)
@@ -499,7 +512,7 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
     delivered_now = (
         jnp.arange(e_slots, dtype=jnp.int32)[None, :] == del_slot[:, None]
     ) & has[:, None]
-    still_fresh = q_fresh.reshape(ln, e_slots) & ~delivered_now
+    still_fresh = q_fresh & ~delivered_now
     retire = (ev_tx <= 0) & ~still_fresh
     s = s._replace(ev_tx=ev_tx, ev_key=jnp.where(retire, 0, s.ev_key))
 
@@ -522,17 +535,12 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
         cand_orig.append(jnp.where(ok, s_orig, -1))
     ckey = jnp.concatenate(cand_key, axis=1)       # [N, fan*PE]
     corig = jnp.concatenate(cand_orig, axis=1)
-    m = ckey.shape[1]
-    fresh = (ckey > 0) & ~_lookup_any(
-        cfg, s,
-        jnp.repeat(lrows, m).reshape(ln, m).reshape(-1),
-        ckey.reshape(-1), corig.reshape(-1),
-    ).reshape(ln, m)
+    fresh = (ckey > 0) & ~_lookup_any(cfg, s, ckey, corig)
     for _ in range(2):
         win_key = jnp.min(jnp.where(fresh, ckey, sentinel), axis=1)
         got = win_key != sentinel
         slot_i = jnp.argmax(fresh & (ckey == win_key[:, None]), axis=1)
-        win_orig = jnp.take_along_axis(corig, slot_i[:, None], axis=1)[:, 0]
+        win_orig = swim._take_col(corig, slot_i)
         s = _equeue_push(
             cfg, s, got, jnp.where(got, win_key, 0),
             jnp.where(got, win_orig, -1), tx_limit,
