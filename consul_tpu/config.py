@@ -237,5 +237,11 @@ class SimConfig:
 
     @property
     def degree(self) -> int:
-        """Effective neighbor-table width K (N-1 for complete graph)."""
-        return self.n - 1 if self.view_degree == 0 else min(self.view_degree, self.n - 1)
+        """Effective neighbor-table width K (N-1 for complete graph).
+        A configured partial view at least as wide as the cluster falls
+        back to the complete graph — a 20-server WAN pool under the
+        LAN's view_degree=32 tracks everyone, like the reference's
+        member map would."""
+        if self.view_degree == 0 or self.view_degree >= self.n - 1:
+            return self.n - 1
+        return self.view_degree

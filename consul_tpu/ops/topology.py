@@ -91,7 +91,7 @@ class Topology(NamedTuple):
 def make_topology(cfg: SimConfig, key) -> Topology:
     """Build the offset table and static remap tables (host-side, once)."""
     n, k_deg = cfg.n, cfg.degree
-    if cfg.view_degree == 0:
+    if k_deg == n - 1:  # complete graph (view_degree 0 or >= n-1)
         off = jnp.arange(1, n, dtype=jnp.int32)
         return Topology(n=n, dense=True, off=off, rcol=None, inv=None)
     if k_deg % 2 != 0:
