@@ -250,8 +250,15 @@ def child(platform: str, deadline: float):
 # Parent: orchestrate children, merge, always print one line, rc=0.
 # ----------------------------------------------------------------------
 
-def _run_child(platform: str, timeout_s: float, extra_env=None):
-    """Run one backend child; harvest its per-phase JSON lines."""
+def _run_child(platform: str, timeout_s: float, extra_env=None,
+               init_window_s: float = 300.0):
+    """Run one backend child; harvest its per-phase JSON lines.
+
+    ``init_window_s``: a child that has not emitted its ``setup`` phase
+    by then is killed early — a healthy backend initializes in seconds,
+    while a wedged TPU relay hangs *inside* ``jax.devices()``
+    indefinitely; waiting out the full budget on it could push the
+    whole bench past an outer harness timeout and lose the output."""
     env = dict(os.environ)
     env["BENCH_CHILD"] = platform
     env["BENCH_DEADLINE_S"] = str(timeout_s)
@@ -260,6 +267,14 @@ def _run_child(platform: str, timeout_s: float, extra_env=None):
     phases, status = [], "ok"
     t0 = time.monotonic()
     raw_tail = []
+
+    def _setup_seen():
+        try:
+            with open(out_path) as f:
+                return any('"phase": "setup"' in ln for ln in f)
+        except OSError:
+            return False
+
     try:
         with os.fdopen(fd, "w") as out:
             proc = subprocess.Popen(
@@ -267,8 +282,28 @@ def _run_child(platform: str, timeout_s: float, extra_env=None):
                 stdout=out, stderr=subprocess.STDOUT, env=env,
                 cwd=os.path.dirname(os.path.abspath(__file__)),
             )
+            deadline = t0 + timeout_s
+            setup_ok = False
             try:
-                proc.wait(timeout=timeout_s)
+                while True:
+                    step = min(10.0, max(0.1, deadline - time.monotonic()))
+                    try:
+                        proc.wait(timeout=step)
+                        break
+                    except subprocess.TimeoutExpired:
+                        pass
+                    now = time.monotonic()
+                    if now >= deadline:
+                        raise subprocess.TimeoutExpired(proc.args, timeout_s)
+                    setup_ok = setup_ok or _setup_seen()
+                    if now - t0 > init_window_s and not setup_ok:
+                        status = "backend-init-hang"
+                        proc.kill()
+                        try:
+                            proc.wait(timeout=30)
+                        except subprocess.TimeoutExpired:
+                            pass  # keep the init-hang diagnosis
+                        break
             except subprocess.TimeoutExpired:
                 status = "timeout"
                 proc.kill()
