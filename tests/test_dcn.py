@@ -127,3 +127,64 @@ class TestDcnOnMeshes:
         np.testing.assert_array_equal(
             np.asarray(w0.view_key), np.asarray(w1.view_key)
         )
+
+
+class TestDcnRouterIntegration:
+    """The server tier consuming cross-island membership: a Router fed
+    from a REMOTE island's WAN replica (the reference's WAN-serf ->
+    router adapter, agent/router/serf_adapter.go, operating across the
+    DCN seam)."""
+
+    def test_dead_dc_fails_over_across_islands(self):
+        from consul_tpu.server.router import Router
+
+        cfg = _cfg()
+        fed = DcnFederation(cfg, n_islands=2, seed=0)
+        fed.run(64, sync_every=16)
+        fed.kill(0, jnp.ones(cfg.nodes_per_dc, bool))  # whole DC 0 dies
+        fed.run(1400, sync_every=16)
+
+        # dc3 lives on island 1; its replica feeds its router. Failed
+        # members cycle to the back of the rotation (FailServer), reaped
+        # members drop out (RemoveServer) — the two serf->router adapter
+        # paths of reference agent/router/serf_adapter.go.
+        isl, _ = fed.island_of_dc(3)
+        router = Router("dc3")
+        members = isl.wan_members_seen_by(3)
+        dead_ids = {m["id"] for m in members
+                    if m["dc"] == "dc0" and m["status"] == "dead"}
+        assert dead_ids  # the observer tracked and detected dc0 deaths
+        for m in members:
+            router.add_server(m["id"], m["dc"])
+            if m["status"] in ("dead", "left"):
+                router.fail_server(m["id"])
+        # Surviving DCs stay routable throughout.
+        assert router.find_route("dc1") is not None
+        assert router.find_route("dc2") is not None
+        # After the reap sweep removes the dead members, dc0 has no
+        # route at all.
+        for sid in dead_ids:
+            router.remove_server(sid)
+        tracked0 = [m for m in members if m["dc"] == "dc0"]
+        if all(m["status"] == "dead" for m in tracked0):
+            assert router.find_route("dc0") is None
+        assert router.find_route("dc1") is not None
+
+    def test_remote_coordinates_order_dcs_across_islands(self):
+        from consul_tpu.server.router import Router
+
+        cfg = _cfg()
+        fed = DcnFederation(cfg, n_islands=2, seed=0)
+        fed.run(512, sync_every=16)
+        # Island 1's replica holds island 0's learned coordinates
+        # (crossed the seam); the distance ordering they induce must
+        # match the shared ground-truth plant.
+        isl, _ = fed.island_of_dc(3)
+        router = Router("dc3")
+        for dc in range(cfg.n_dc):
+            for s in range(cfg.servers_per_dc):
+                router.add_server(
+                    f"srv{s}.dc{dc}", f"dc{dc}",
+                    coord=isl.wan_server_coord(dc, s))
+        got = [int(d[2:]) for d in router.get_datacenters_by_distance()]
+        assert got == isl.true_dc_distance_order(3)
