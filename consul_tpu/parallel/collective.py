@@ -186,6 +186,16 @@ def roll_many(arrays, shift):
     pack into one uint32 payload so the whole exchange is a single
     ppermute per hop, then unpack. Supports bool/int32/uint32 leaves of
     rank 1 or 2; int32 round-trips by bit-pattern (negatives survive)."""
+    # Packing goes through astype(uint32), which is a VALUE conversion:
+    # float dtypes would be silently rounded and 64-bit ints truncated,
+    # but only on the sharded path — a divergence invisible single-chip.
+    # Fail loudly instead, for any caller, in both contexts.
+    for a in arrays:
+        if a.dtype not in (jnp.bool_, jnp.int32, jnp.uint32):
+            raise TypeError(
+                f"roll_many supports bool/int32/uint32 leaves, got {a.dtype}"
+                " — pack other dtypes by bit-pattern first"
+            )
     ctx = _CTX.get()
     if ctx is None:
         return [jnp.roll(a, shift, axis=0) for a in arrays]

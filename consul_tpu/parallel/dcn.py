@@ -103,16 +103,30 @@ class DcnFederation:
         wans = [jax.device_get(isl.state.wan) for isl in self.islands]
         owner = np.asarray(self._owner)
 
-        def select(*leaves):
-            if leaves[0].ndim >= 1 and leaves[0].shape[0] == owner.shape[0]:
-                sel = owner.reshape((-1,) + (1,) * (leaves[0].ndim - 1))
-                out = leaves[0]
-                for k in range(1, len(leaves)):
-                    out = np.where(sel == k, leaves[k], out)
-                return out
-            return leaves[0]  # scalars (t, accum): lockstep-equal
+        # Per-field dispatch by NAME, not by a leading-dim shape test: a
+        # [K, ...] leaf whose K coincidentally equals n_wan must never be
+        # row-merged. SimState's one non-per-row field is the tick
+        # counter ``t`` (models/state.py:58-91); every other field —
+        # including every nested viv leaf — is [n_wan, ...], which the
+        # assert pins against future drift.
+        scalar_fields = {"t"}
 
-        merged = jax.tree.map(select, *wans)
+        def select(*leaves):
+            assert leaves[0].shape[0] == owner.shape[0], (
+                f"per-row WAN leaf with leading dim {leaves[0].shape}"
+            )
+            sel = owner.reshape((-1,) + (1,) * (leaves[0].ndim - 1))
+            out = leaves[0]
+            for k in range(1, len(leaves)):
+                out = np.where(sel == k, leaves[k], out)
+            return out
+
+        merged = type(wans[0])(**{
+            name: (getattr(wans[0], name) if name in scalar_fields
+                   else jax.tree.map(
+                       select, *[getattr(w, name) for w in wans]))
+            for name in type(wans[0])._fields
+        })
         for i, isl in enumerate(self.islands):
             if self.meshes is not None:
                 from consul_tpu.parallel import mesh as pmesh

@@ -1,0 +1,157 @@
+"""Single-flight lock for the one real TPU chip.
+
+Two JAX processes touching the TPU backend at once deadlock — and in
+this environment killing the second client can wedge the device relay
+for *everyone* (observed repeatedly; see BASELINE.md provenance notes).
+So every process that may initialize the TPU backend takes this lock
+first: the benchmark driver (bench.py), ad-hoc measurement scripts,
+anything. The lock is advisory but is the only thing standing between
+a working tunnel and a wedged one, so honor it.
+
+Design: a lockfile containing JSON ``{"pid": ..., "started": ...,
+"what": ...}`` created with O_EXCL. A lock whose owner pid is gone is
+stale and is broken atomically (rename-away then unlink, so two
+breakers cannot both win). No jax imports here — the module must be
+importable by the bench parent, which never touches jax.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import time
+
+# Outside the repo so a `git clean`/checkout never deletes a live lock.
+LOCK_PATH = os.environ.get("CONSUL_TPU_LOCK", "/tmp/consul_tpu_device.lock")
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def _read(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def holder(path: str = LOCK_PATH):
+    """The live holder's info dict, or None if unheld/stale."""
+    info = _read(path)
+    if info is None:
+        return None
+    pid = info.get("pid")
+    if isinstance(pid, int) and _pid_alive(pid):
+        return info
+    return None
+
+
+_UNPARSEABLE_GRACE_S = 30.0
+
+
+def _break_stale(path: str) -> bool:
+    """Atomically remove a stale lockfile. Returns True if removed.
+
+    An unparseable lockfile is treated as LIVE within a grace window
+    (it may be another acquirer's moment-of-creation) and stale only
+    after it — never steal a lock that might just be young.
+    """
+    info = _read(path)
+    if info is None:
+        try:
+            age = time.time() - os.path.getmtime(path)
+        except OSError:
+            return not os.path.exists(path)  # vanished: gone is gone
+        if age < _UNPARSEABLE_GRACE_S:
+            return False
+    else:
+        pid = info.get("pid")
+        if isinstance(pid, int) and _pid_alive(pid):
+            return False
+    tomb = f"{path}.stale.{os.getpid()}"
+    try:
+        os.rename(path, tomb)  # only one breaker wins the rename
+    except OSError:
+        return not os.path.exists(path)
+    try:
+        os.unlink(tomb)
+    except OSError:
+        pass
+    return True
+
+
+def try_acquire(what: str = "?", wait_s: float = 0.0,
+                path: str = LOCK_PATH) -> str:
+    """Take the lock: "acquired", "busy", or "error:<detail>".
+
+    ``wait_s``: how long to poll for a live holder to finish. Stale
+    locks are broken immediately regardless. The lockfile is created
+    complete via link-into-place, so no acquirer ever observes an
+    empty lock and mistakes it for stale.
+    """
+    deadline = time.monotonic() + wait_s
+    tmp = f"{path}.new.{os.getpid()}"
+    while True:
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"pid": os.getpid(), "started": time.time(),
+                           "what": what}, f)
+            try:
+                os.link(tmp, path)  # atomic: fails if the lock exists
+                return "acquired"
+            except FileExistsError:
+                pass
+            finally:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+        except OSError as e:
+            return f"error:{e!r}"
+        if _break_stale(path):
+            continue
+        if time.monotonic() >= deadline:
+            return "busy"
+        time.sleep(min(5.0, max(0.1, deadline - time.monotonic())))
+
+
+def acquire(what: str = "?", wait_s: float = 0.0, path: str = LOCK_PATH):
+    """Bool convenience wrapper over :func:`try_acquire`."""
+    return try_acquire(what, wait_s, path) == "acquired"
+
+
+def release(path: str = LOCK_PATH) -> None:
+    info = _read(path)
+    if info and info.get("pid") == os.getpid():
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+class held:
+    """Context manager: ``with held("bench"):`` — raises RuntimeError
+    with the holder's info if the lock cannot be taken in time."""
+
+    def __init__(self, what: str = "?", wait_s: float = 0.0,
+                 path: str = LOCK_PATH):
+        self.what, self.wait_s, self.path = what, wait_s, path
+
+    def __enter__(self):
+        if not acquire(self.what, self.wait_s, self.path):
+            raise RuntimeError(
+                f"TPU lock busy: {holder(self.path)!r} (path {self.path})")
+        return self
+
+    def __exit__(self, *exc):
+        release(self.path)
+        return False
