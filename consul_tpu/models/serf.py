@@ -30,6 +30,11 @@ exactly-once per node via the ltime-bucketed dedup buffer plus a
 Lamport recency floor raised on bucket eviction (serf's LTime dedup +
 eventMinTime gates, serf.go:1258-1357) — an event either delivers once
 or, past the window, is rejected as stale; it is never double-applied.
+Dedup identity is a 32-bit avalanche signature of (event key, origin)
+(:func:`_sig`): a collision spuriously dedups a fresh event at
+~2^-31 per (candidate, slot) pair — the same order of modeled loss as
+the buffer-overflow drop above, and half the state/compare traffic of
+carrying the (key, origin) pair per slot.
 Fresh arrivals stage into the receiver's own broadcast queue (receive ≠
 deliver, see _event_phase) and deliver oldest-first at one per tick.
 Bounded-capacity divergences (vs Go's unbounded structures): intake 2
@@ -85,11 +90,10 @@ class SerfState(NamedTuple):
     ev_tx: jax.Array         # [N, E] int32 transmits remaining
     # -- recent-event dedup buffers (ltime-bucketed; see module doc) ---
     ev_bkt_lt: jax.Array     # [N, R] uint32 ltime owning each bucket, 0=empty
-    ev_bkt_key: jax.Array    # [N, R, O] uint32 event keys at that ltime
-    ev_bkt_origin: jax.Array  # [N, R, O] int32
+    ev_bkt_sig: jax.Array    # [N, R, O] uint32 (key, origin) sigs, 0=empty
     q_bkt_lt: jax.Array      # [N, R] uint32 (queries have their own
-    q_bkt_key: jax.Array     # [N, R, O]      clock domain, so their own
-    q_bkt_origin: jax.Array  # [N, R, O]      buffer, like serf's)
+    q_bkt_sig: jax.Array     # [N, R, O]      clock domain, so their own
+                             #                buffer, like serf's)
     ev_delivered: jax.Array  # [N] int32 — distinct events delivered
     # Minimum accepted Lamport times: events/queries below the floor are
     # rejected rather than redelivered (eventMinTime/queryMinTime,
@@ -119,11 +123,9 @@ def init(cfg: SimConfig, key) -> SerfState:
         ev_origin=jnp.full((n, e), -1, jnp.int32),
         ev_tx=jnp.zeros((n, e), jnp.int32),
         ev_bkt_lt=jnp.zeros((n, r), jnp.uint32),
-        ev_bkt_key=jnp.zeros((n, r, o), jnp.uint32),
-        ev_bkt_origin=jnp.full((n, r, o), -1, jnp.int32),
+        ev_bkt_sig=jnp.zeros((n, r, o), jnp.uint32),
         q_bkt_lt=jnp.zeros((n, r), jnp.uint32),
-        q_bkt_key=jnp.zeros((n, r, o), jnp.uint32),
-        q_bkt_origin=jnp.full((n, r, o), -1, jnp.int32),
+        q_bkt_sig=jnp.zeros((n, r, o), jnp.uint32),
         ev_delivered=jnp.zeros((n,), jnp.int32),
         ev_floor=jnp.zeros((n,), jnp.uint32),
         q_floor=jnp.zeros((n,), jnp.uint32),
@@ -182,7 +184,24 @@ def _equeue_push(cfg: SimConfig, s: SerfState, mask, key_, origin, tx0):
     )
 
 
-def _buf_lookup(cfg: SimConfig, bkt_lt, bkt_key, bkt_origin, floor, key_, origin):
+def _sig(key_, origin):
+    """32-bit dedup identity of (event key, origin): a murmur3-finalizer
+    avalanche of the pair, forced nonzero (0 = empty slot). A collision
+    spuriously dedups at ~2^-31 per (candidate, slot) compare — the
+    module docstring's modeled-loss bound."""
+    h = jnp.asarray(key_, jnp.uint32) ^ (
+        jnp.asarray(origin, jnp.int32).astype(jnp.uint32)
+        * jnp.uint32(0x9E3779B9)
+    )
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h | jnp.uint32(1)
+
+
+def _buf_lookup(cfg: SimConfig, bkt_lt, bkt_sig, floor, key_, origin):
     """Is (key, origin) a duplicate/stale for its row's buffer? ``key_``
     and ``origin`` are [N, E] — E candidates per row, each checked
     against that row's own buffer.
@@ -193,37 +212,37 @@ def _buf_lookup(cfg: SimConfig, bkt_lt, bkt_key, bkt_origin, floor, key_, origin
     owned by a *newer* ltime (this message is outside the window), or
     the ltime is below the floor — all three reject.
 
-    One-hot over the (small) ring axis instead of per-row-indexed
-    gathers — on TPU the gather formulation costs ~90x at the step
-    level (BASELINE.md formulation validation; same lesson as
-    swim._take_cols).
+    Cost shape (the serf plane's hottest path — this went through two
+    rounds of on-chip whole-step A/Bs, BASELINE.md): membership is ONE
+    [N, E, R·O] bool compare of the candidate sig against every slot —
+    valid without addressing the bucket because ``_buf_apply``'s
+    takeover-clearing keeps every live slot's ltime equal to its
+    bucket's, so a sig equality already implies the right bucket. The
+    only per-candidate bucket selects left are over the [N, R] bucket
+    ltimes and a precomputed [N, R] fullness bit (one-hot via
+    swim._take_cols — per-row-indexed gathers are the 90x TPU cliff).
+    No [N, E, R, O]-shaped intermediate survives.
     """
     r = cfg.serf.seen_ring
     lt = event_ltime(key_)                      # [N, E]
     b = (lt % jnp.uint32(r)).astype(jnp.int32)
-    b_oh = b[:, :, None] == jnp.arange(r, dtype=jnp.int32)[None, None, :]
     blt = swim._take_cols(bkt_lt, b)            # [N, E]
-    # [N, E, O]: the addressed bucket's slots, selected over R.
-    slot_key = jnp.sum(
-        jnp.where(b_oh[:, :, :, None], bkt_key[:, None, :, :], 0), axis=2
+    full = swim._take_cols(jnp.all(bkt_sig != 0, axis=2), b)   # [N, E]
+    flat = bkt_sig.reshape(bkt_sig.shape[0], -1)               # [N, R*O]
+    hit = jnp.any(
+        flat[:, None, :] == _sig(key_, origin)[:, :, None], axis=2
     )
-    slot_origin = jnp.sum(
-        jnp.where(b_oh[:, :, :, None], bkt_origin[:, None, :, :], 0), axis=2
-    )
-    in_bucket = (blt == lt) & jnp.any(
-        (slot_key == key_[:, :, None]) & (slot_origin == origin[:, :, None]),
-        axis=2,
-    )
-    bucket_full = (blt == lt) & jnp.all(slot_key != 0, axis=2)
-    return in_bucket | bucket_full | (blt > lt) | (lt < floor[:, None])
+    return hit | (full & (blt == lt)) | (blt > lt) | (lt < floor[:, None])
 
 
-def _buf_apply(cfg: SimConfig, bkt_lt, bkt_key, bkt_origin, floor, mask, key_, origin):
+def _buf_apply(cfg: SimConfig, bkt_lt, bkt_sig, floor, mask, key_, origin):
     """Record one (key, origin) per masked node in its ltime buffer.
 
     A newer ltime landing on an occupied bucket evicts it and raises the
     Lamport floor past the evicted ltime (eventMinTime semantics) so
-    evicted events are rejected as stale, never redelivered.
+    evicted events are rejected as stale, never redelivered. Takeover
+    clears every other slot of the bucket — the invariant
+    ``_buf_lookup``'s flat membership compare relies on.
     """
     r, o = cfg.serf.seen_ring, cfg.serf.seen_width
     lt = event_ltime(key_)
@@ -239,24 +258,18 @@ def _buf_apply(cfg: SimConfig, bkt_lt, bkt_key, bkt_origin, floor, mask, key_, o
     b_oh = b_sel & mask[:, None]
     bkt_lt = jnp.where(b_oh, lt[:, None], bkt_lt)
     # Slot: 0 on takeover (clearing the rest), else first free slot.
-    cur_key = jnp.sum(
-        jnp.where(b_sel[:, :, None], bkt_key, 0), axis=1
+    cur_sig = jnp.sum(
+        jnp.where(b_sel[:, :, None], bkt_sig, 0), axis=1
     )                                           # [N, O]
-    free = jnp.argmax(cur_key == 0, axis=1).astype(jnp.int32)
+    free = jnp.argmax(cur_sig == 0, axis=1).astype(jnp.int32)
     slot = jnp.where(takeover, 0, free)
     s_oh = (jnp.arange(o, dtype=jnp.int32)[None, :] == slot[:, None])
-    new_slot_key = jnp.where(
-        s_oh, key_[:, None], jnp.where(takeover[:, None], 0, cur_key)
+    new_slot_sig = jnp.where(
+        s_oh, _sig(key_, origin)[:, None],
+        jnp.where(takeover[:, None], 0, cur_sig),
     )
-    cur_origin = jnp.sum(
-        jnp.where(b_sel[:, :, None], bkt_origin, 0), axis=1
-    )
-    new_slot_origin = jnp.where(
-        s_oh, origin[:, None], jnp.where(takeover[:, None], -1, cur_origin)
-    )
-    bkt_key = jnp.where(b_oh[:, :, None], new_slot_key[:, None, :], bkt_key)
-    bkt_origin = jnp.where(b_oh[:, :, None], new_slot_origin[:, None, :], bkt_origin)
-    return bkt_lt, bkt_key, bkt_origin, floor
+    bkt_sig = jnp.where(b_oh[:, :, None], new_slot_sig[:, None, :], bkt_sig)
+    return bkt_lt, bkt_sig, floor
 
 
 def _seen_append(cfg: SimConfig, s: SerfState, mask, key_, origin) -> SerfState:
@@ -264,17 +277,15 @@ def _seen_append(cfg: SimConfig, s: SerfState, mask, key_, origin) -> SerfState:
     matching (event vs query) ltime buffer and count the delivery."""
     isq = event_is_query(key_) & mask
     isev = ~event_is_query(key_) & mask
-    e_lt, e_key, e_org, e_floor = _buf_apply(
-        cfg, s.ev_bkt_lt, s.ev_bkt_key, s.ev_bkt_origin, s.ev_floor,
-        isev, key_, origin,
+    e_lt, e_sig, e_floor = _buf_apply(
+        cfg, s.ev_bkt_lt, s.ev_bkt_sig, s.ev_floor, isev, key_, origin,
     )
-    q_lt, q_key, q_org, q_floor = _buf_apply(
-        cfg, s.q_bkt_lt, s.q_bkt_key, s.q_bkt_origin, s.q_floor,
-        isq, key_, origin,
+    q_lt, q_sig, q_floor = _buf_apply(
+        cfg, s.q_bkt_lt, s.q_bkt_sig, s.q_floor, isq, key_, origin,
     )
     return s._replace(
-        ev_bkt_lt=e_lt, ev_bkt_key=e_key, ev_bkt_origin=e_org, ev_floor=e_floor,
-        q_bkt_lt=q_lt, q_bkt_key=q_key, q_bkt_origin=q_org, q_floor=q_floor,
+        ev_bkt_lt=e_lt, ev_bkt_sig=e_sig, ev_floor=e_floor,
+        q_bkt_lt=q_lt, q_bkt_sig=q_sig, q_floor=q_floor,
         # Counts *user events* only (queries are tallied via q_resps).
         ev_delivered=s.ev_delivered + jnp.where(isev, 1, 0),
     )
@@ -378,12 +389,10 @@ def _lookup_any(cfg: SimConfig, s: SerfState, key_, origin):
     """Duplicate/stale check against the kind-matching buffer; ``key_``
     and ``origin`` are [N, E] candidates per row."""
     seen_ev = _buf_lookup(
-        cfg, s.ev_bkt_lt, s.ev_bkt_key, s.ev_bkt_origin, s.ev_floor,
-        key_, origin,
+        cfg, s.ev_bkt_lt, s.ev_bkt_sig, s.ev_floor, key_, origin,
     )
     seen_q = _buf_lookup(
-        cfg, s.q_bkt_lt, s.q_bkt_key, s.q_bkt_origin, s.q_floor,
-        key_, origin,
+        cfg, s.q_bkt_lt, s.q_bkt_sig, s.q_floor, key_, origin,
     )
     return jnp.where(event_is_query(key_), seen_q, seen_ev)
 
@@ -490,9 +499,23 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
 
     # ---- 2. Gossip out: most-retransmittable queue entries, sent along
     # per-tick shared displacements (swim-plane divergence note).
-    # top_k + one-hot column selects (the no-gather style; argsort +
+    # Static argmax peeling instead of lax.top_k (sort-lowered on TPU)
+    # — pe is tiny and the peel is pure compare-select; selection is
+    # identical to top_k's (max value, lowest index on ties). One-hot
+    # column selects throughout (the no-gather style; argsort +
     # take_along_axis gathers are the TPU cliff — BASELINE.md).
-    m_tx, order = jax.lax.top_k(s.ev_tx, pe)
+    peel_tx, m_tx_l, order_l = s.ev_tx, [], []
+    slots_i = jnp.arange(e_slots, dtype=jnp.int32)
+    for _ in range(pe):
+        best = jnp.argmax(peel_tx, axis=1).astype(jnp.int32)
+        m_tx_l.append(jnp.max(peel_tx, axis=1))
+        order_l.append(best)
+        peel_tx = jnp.where(
+            slots_i[None, :] == best[:, None], jnp.iinfo(jnp.int32).min,
+            peel_tx,
+        )
+    m_tx = jnp.stack(m_tx_l, axis=1)
+    order = jnp.stack(order_l, axis=1)
     m_key = swim._take_cols(s.ev_key, order)
     m_origin = swim._take_cols(s.ev_origin, order)
     m_valid = (m_key > 0) & (m_tx > 0) & active[:, None]
@@ -561,12 +584,8 @@ def event_coverage(cfg: SimConfig, s: SerfState, key_, origin) -> jax.Array:
     simulator answers (lib/serf.go:21-25 comment)."""
     active = s.swim.alive_truth & ~s.swim.left
     key_ = jnp.asarray(key_, jnp.uint32)
-    bkt_key = jnp.where(event_is_query(key_), s.q_bkt_key, s.ev_bkt_key)
-    bkt_origin = jnp.where(event_is_query(key_), s.q_bkt_origin, s.ev_bkt_origin)
-    got = jnp.any(
-        (bkt_key == key_) & (bkt_origin == jnp.asarray(origin, jnp.int32)),
-        axis=(1, 2),
-    )
+    bkt_sig = jnp.where(event_is_query(key_), s.q_bkt_sig, s.ev_bkt_sig)
+    got = jnp.any(bkt_sig == _sig(key_, origin), axis=(1, 2))
     return jnp.sum(got & active) / jnp.maximum(jnp.sum(active), 1)
 
 
