@@ -1,0 +1,174 @@
+"""Pin the multichip communication budget without multichip hardware.
+
+ICI throughput cannot be measured in this environment (one real chip),
+but the communication *cost model* can be frozen at compile time: lower
+the D=8 shard_map SWIM and serf steps (virtual CPU devices), parse the
+optimized HLO, and assert the collective census — op kinds, counts, and
+byte volumes. An accidental O(N) collective (a stray all-gather of a
+[N, K] table, an all-to-all, an unpacked per-leaf exchange) fails here
+long before real multi-chip hardware would reveal it as an ICI-bound
+regression.
+
+The budget being defended (parallel/collective.py, SURVEY §2.5):
+
+  - SWIM plane: rolls only — ``lax.ppermute`` hops moving O(N/D)-row
+    blocks. Traced-shift rolls cost a log2(D)+1 conditional-hop ladder
+    (3 + 1 seam transfer at D=8), so permute *count* is
+    4 x (number of traced rolls), a trace-time constant.
+  - Serf event plane: + one *packed* roll per gossip fan displacement
+    (roll_many: the [key, origin, valid, peer] payload rides ONE
+    ppermute per hop, not four), + exactly two [N] all-gathers (the
+    query-origin attribute reads: q_open_key u32 and the folded
+    liveness bool) + exactly one reduce-scatter (the query-response
+    tally, [N/D] rows out per device).
+  - The only all-reduce is the scalar convergence psum (4 bytes).
+
+Counts are pinned by equality: a legitimate protocol change that adds
+or removes an exchange should update the constants HERE, consciously,
+with the new cost model in the commit message.
+"""
+
+import collections
+import re
+
+import jax
+import pytest
+
+from consul_tpu.config import SimConfig
+from consul_tpu.models import serf, state as sim_state
+from consul_tpu.ops import topology
+from consul_tpu.parallel import shard_step
+from consul_tpu.parallel.mesh import NODE_AXIS, make_mesh
+
+N = 4096
+DEGREE = 16
+
+_DTYPE_BYTES = {
+    "pred": 1, "u8": 1, "s8": 1, "u16": 2, "s16": 2, "bf16": 2, "f16": 2,
+    "u32": 4, "s32": 4, "f32": 4, "u64": 8, "s64": 8, "f64": 8,
+}
+
+# One HLO result-shape + collective-op head, e.g.
+#   %x = u32[512,7]{1,0} collective-permute(%y), ...
+_COLLECTIVE_RE = re.compile(
+    r"= \(?([a-z0-9]+)\[([\d,]*)\][^ ]* "
+    r"([a-z\-]*(?:collective-permute|all-gather|all-reduce|reduce-scatter|"
+    r"all-to-all)[a-z\-]*)\("
+)
+
+
+def census(hlo_text):
+    """(counts, bytes) per collective kind from optimized HLO text.
+
+    Async pairs (``*-start``/``*-done``) would double-count; fold the
+    suffixed forms onto their base op and skip the ``-done`` halves.
+    """
+    counts = collections.Counter()
+    volume = collections.Counter()
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        dtype, dims, kind = m.groups()
+        if kind.endswith("-done"):
+            continue
+        kind = kind.removesuffix("-start")
+        elems = 1
+        for d in dims.split(","):
+            if d:
+                elems *= int(d)
+        counts[kind] += 1
+        volume[kind] += elems * _DTYPE_BYTES.get(dtype, 4)
+    return counts, volume
+
+
+@pytest.fixture(scope="module")
+def compiled():
+    cfg = SimConfig(n=N, view_degree=DEGREE)
+    key = jax.random.PRNGKey(0)
+    kw, kn, ks = jax.random.split(key, 3)
+    world = topology.make_world(cfg, kw)
+    topo = topology.make_topology(cfg, kn)
+    mesh = make_mesh()
+    d = mesh.shape[NODE_AXIS]
+    assert d == 8, "budget pins assume the 8-device virtual mesh"
+    wg = shard_step.place(mesh, world, cfg.n)
+
+    def lower(make, st):
+        fn = make(cfg, topo, mesh)
+        return fn.lower(
+            wg, shard_step.place(mesh, st, cfg.n), key
+        ).compile().as_text()
+
+    swim_hlo = lower(shard_step.make_sharded_step, sim_state.init(cfg, ks))
+    serf_hlo = lower(shard_step.make_sharded_serf_step, serf.init(cfg, ks))
+    return cfg, d, census(swim_hlo), census(serf_hlo)
+
+
+# Every traced roll lowers to a log2(8)+1 = 4-hop ppermute ladder.
+LADDER = 4
+# Traced rolls per SWIM tick (probe/ack/indirect legs, gossip fan,
+# push-pull exchange — models/swim.py), measured at this config and
+# stable across shapes: 114 permute ops = 28.5 ladders' worth of hops
+# (some rolls are static single-hop).
+SWIM_PERMUTES = 114
+# The serf event plane adds gossip_nodes=3 packed event exchanges
+# (roll_many -> ONE ladder each), nothing else.
+SERF_EXTRA_PERMUTES = 3 * LADDER
+# Upper bound on the average payload a single permute hop may carry,
+# bytes per block row. Measured: SWIM 19.8, serf extra 28 (the packed
+# [2xkey, 2xorigin, 2xvalid, peer] u32 columns). A new wide payload or
+# an unpacked per-leaf exchange blows through this.
+PERMUTE_ROW_BYTES_MAX = 32
+
+
+class TestSwimBudget:
+    def test_only_expected_collective_kinds(self, compiled):
+        _, _, (counts, _), _ = compiled
+        assert set(counts) <= {"collective-permute", "all-reduce"}, counts
+
+    def test_permute_count_pinned(self, compiled):
+        _, _, (counts, _), _ = compiled
+        assert counts["collective-permute"] == SWIM_PERMUTES, counts
+
+    def test_permute_bytes_bounded(self, compiled):
+        cfg, d, (counts, volume), _ = compiled
+        block = cfg.n // d
+        assert volume["collective-permute"] <= (
+            counts["collective-permute"] * block * PERMUTE_ROW_BYTES_MAX
+        ), volume
+
+    def test_allreduce_is_scalar_only(self, compiled):
+        _, _, (counts, volume), _ = compiled
+        assert volume.get("all-reduce", 0) <= 8 * counts.get("all-reduce", 1)
+
+
+class TestSerfBudget:
+    def test_only_expected_collective_kinds(self, compiled):
+        _, _, _, (counts, _) = compiled
+        assert set(counts) <= {
+            "collective-permute", "all-reduce", "all-gather", "reduce-scatter"
+        }, counts
+
+    def test_event_plane_rides_packed_rolls(self, compiled):
+        _, _, (sc, _), (counts, _) = compiled
+        extra = counts["collective-permute"] - sc["collective-permute"]
+        assert extra == SERF_EXTRA_PERMUTES, (
+            f"event plane grew to {extra} extra permute hops — an unpacked "
+            "leaf exchange? (roll_many packs the payload into one roll)"
+        )
+
+    def test_exactly_two_row_addressed_gathers(self, compiled):
+        cfg, _, _, (counts, volume) = compiled
+        assert counts["all-gather"] == 2, counts
+        # q_open_key u32[N] + folded liveness u8[N]: 5 bytes/node total.
+        assert volume["all-gather"] == 5 * cfg.n, volume
+
+    def test_exactly_one_reduce_scatter(self, compiled):
+        cfg, d, _, (counts, volume) = compiled
+        assert counts["reduce-scatter"] == 1, counts
+        assert volume["reduce-scatter"] == 4 * cfg.n // d, volume
+
+    def test_permute_bytes_bounded(self, compiled):
+        cfg, d, _, (counts, volume) = compiled
+        block = cfg.n // d
+        assert volume["collective-permute"] <= (
+            counts["collective-permute"] * block * PERMUTE_ROW_BYTES_MAX
+        ), volume
