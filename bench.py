@@ -391,8 +391,11 @@ def _latest_tpu_session():
             continue
         if "tpu" not in str(d.get("device", "")).lower() or not d.get("value"):
             continue
-        rec = d.get("recorded_at")
-        t = (1, float(rec)) if rec else (0, os.path.getmtime(p))
+        try:
+            rec = float(d.get("recorded_at"))
+        except (TypeError, ValueError):
+            rec = None
+        t = (1, rec) if rec else (0, os.path.getmtime(p))
         if t > best_t:
             best, best_path, best_t = d, p, t
     when = best_t[1] if best is not None and best_t[0] == 1 else None
@@ -540,6 +543,7 @@ def main():
                 "platform": tpu_platform,
                 "wall_s": tpu["wall_s"],
                 "errors": [p for p in tpu["phases"] if p.get("phase") == "error"],
+                **{k: tpu[k] for k in ("holder", "lock_error") if k in tpu},
             },
             "cpu": {
                 "status": cpu["status"],
@@ -549,9 +553,18 @@ def main():
         },
         "total_wall_s": round(time.monotonic() - t_all, 1),
     }
-    if "tpu" in str(result.get("device", "")).lower() and result["value"]:
+    on_tpu = "tpu" in str(result.get("device", "")).lower() and result["value"]
+    # Replay only when the TPU *window* actually died (init-hang /
+    # timeout / busy / child crash, or a chip run that produced no
+    # number) — a healthy CPU-platform run on a machine with no TPU is
+    # an honest result, not a dead window, and must not be overwritten
+    # by a stale committed artifact.
+    window_dead = tpu["status"] != "ok" or (
+        "tpu" in tpu_platform.lower() and tpu_ok is None
+    )
+    if on_tpu:
         _save_tpu_session(result)
-    elif os.environ.get("BENCH_NO_REPLAY", "") != "1":
+    elif window_dead and os.environ.get("BENCH_NO_REPLAY", "") != "1":
         result = _maybe_replay(result)
     print(json.dumps(result))
     return 0

@@ -82,6 +82,22 @@ def _break_stale(path: str) -> bool:
         os.rename(path, tomb)  # only one breaker wins the rename
     except OSError:
         return not os.path.exists(path)
+    # Check-then-rename race: between our read and the rename, another
+    # breaker may have removed the stale lock AND a new holder acquired
+    # — in which case we just renamed a LIVE lock. Verify the tomb holds
+    # what we judged stale; if not, put it back and report failure.
+    entombed = _read(tomb)
+    same = (entombed == info) or (
+        entombed is not None and info is not None
+        and entombed.get("pid") == info.get("pid")
+        and entombed.get("started") == info.get("started")
+    )
+    if not same:
+        try:
+            os.rename(tomb, path)
+        except OSError:
+            pass  # the live holder will re-create or error loudly
+        return False
     try:
         os.unlink(tomb)
     except OSError:
