@@ -38,22 +38,46 @@ COORDINATE_UPDATE_BATCH_SIZE = 128
 COORDINATE_UPDATE_MAX_BATCHES = 5
 
 
+class NoPathToDatacenter(Exception):
+    """No live route to the target DC (reference rpc.go:315
+    'No path to datacenter')."""
+
+    def __init__(self, dc: str, why: str = "no live server"):
+        super().__init__(f"no path to datacenter {dc!r}: {why}")
+        self.dc = dc
+
+
 class Server:
     """One server: raft participant + FSM + endpoint dispatch."""
 
     def __init__(self, node_id: str, raft_node: RaftNode, fsm: FSM,
                  registry: dict[str, "Server"],
-                 vivaldi_dimensionality: int = 8):
+                 vivaldi_dimensionality: int = 8, dc: str = "dc1"):
         self.id = node_id
         self.raft = raft_node
         self.fsm = fsm
         self.registry = registry
         self.vivaldi_dimensionality = vivaldi_dimensionality
+        self.dc = dc
+        # Cross-DC plumbing, populated by federate()/join_wan (reference
+        # WAN serf membership feeding agent/router/serf_adapter.go).
+        self.router = None                  # server/router.Router
+        self.wan_registry = None            # "<id>.<dc>" -> Server
         registry[node_id] = self
         # Coordinate staging (coordinate_endpoint.go:42-53).
         self._coord_updates: dict[str, dict] = {}
         self.metrics = {"coordinate_updates_discarded": 0,
-                        "rpc_forwarded": 0}
+                        "rpc_forwarded": 0, "rpc_cross_dc": 0}
+
+    @property
+    def wan_id(self) -> str:
+        """WAN member name, ``<node>.<dc>`` (reference serf WAN naming,
+        agent/consul/server_serf.go:33-113)."""
+        return f"{self.id}.{self.dc}"
+
+    def join_wan(self, router, wan_registry: dict[str, "Server"]) -> None:
+        self.router = router
+        self.wan_registry = wan_registry
 
     @property
     def store(self) -> StateStore:
@@ -65,14 +89,57 @@ class Server:
     # ------------------------------------------------------------------
     # Dispatch + forwarding
     # ------------------------------------------------------------------
-    def rpc(self, method: str, **args) -> Any:
+    def rpc(self, method: str, dc: Optional[str] = None, **args) -> Any:
         """Invoke ``Endpoint.Method`` (e.g. ``"Catalog.Register"``),
-        forwarding writes to the leader when needed."""
+        forwarding writes to the leader when needed. A non-local ``dc``
+        routes the call to that datacenter through the WAN router
+        (reference rpc.go:315-337 forwardDC) — the reference's everyday
+        ``?dc=`` path."""
+        if dc and dc != self.dc:
+            return self._forward_dc(method, dc, args)
         endpoint, name = method.split(".", 1)
         handler = getattr(self, f"_{endpoint.lower()}_{_snake(name)}", None)
         if handler is None:
             raise AttributeError(f"unknown RPC {method}")
         return handler(**args)
+
+    def _forward_dc(self, method: str, dc: str, args: dict) -> Any:
+        """Route to a server of ``dc`` via Router.find_route, rotating
+        past down servers (reference rpc.go:315-337: FindRoute +
+        NotifyFailedServer on connect failure, retrying the next
+        server in the manager's rotation)."""
+        if self.router is None or self.wan_registry is None:
+            raise NoPathToDatacenter(dc, "not WAN-joined")
+        managers = self.router.get_datacenter_maps()
+        for _ in range(max(1, len(managers.get(dc, ())))):
+            sid = self.router.find_route(dc)
+            if sid is None:
+                break
+            target = self.wan_registry.get(sid)
+            if target is None or target.raft.stopped:
+                # Connection failure: rotate this server to the end and
+                # try the next one (manager.go NotifyFailedServer).
+                self.router.fail_server(sid)
+                continue
+            self.metrics["rpc_cross_dc"] += 1
+            return target.rpc(method, **args)
+        raise NoPathToDatacenter(dc)
+
+    def global_rpc(self, method: str, **args) -> dict[str, Any]:
+        """Fan the call out to every known datacenter, local included
+        (reference rpc.go:340-365 globalRPC). Returns dc -> result;
+        a DC with no live route reports its error string."""
+        out = {self.dc: self.rpc(method, **args)}
+        if self.router is None:
+            return out
+        for dc in self.router.datacenters():
+            if dc == self.dc:
+                continue
+            try:
+                out[dc] = self.rpc(method, dc=dc, **args)
+            except NoPathToDatacenter as e:
+                out[dc] = {"error": str(e)}
+        return out
 
     def _raft_apply(self, command: dict) -> Any:
         """Propose through the leader (forwarding like rpc.go:231-292);
@@ -366,7 +433,8 @@ class ServerCluster:
     def __init__(self, n: int = 3, seed: int = 0,
                  snapshot_threshold: int = 4096,
                  vivaldi_dimensionality: int = 8,
-                 bootstrap_expect: int = 0):
+                 bootstrap_expect: int = 0,
+                 data_dir: str = "", dc: str = "dc1"):
         self.registry: dict[str, Server] = {}
         fsms: dict[str, FSM] = {}
 
@@ -374,15 +442,29 @@ class ServerCluster:
             fsms[node_id] = FSM(StateStore())
             return fsms[node_id].apply
 
+        # data_dir makes consensus state durable (reference -data-dir,
+        # raft-boltdb at server.go:558): each node persists under
+        # <data_dir>/raft/<node_id> and a process restart with the same
+        # dir resumes term/vote/log/snapshot from disk.
+        store_factory = None
+        if data_dir:
+            import os
+
+            from consul_tpu.server.raft_store import DurableRaftStore
+            store_factory = lambda nid: DurableRaftStore(  # noqa: E731
+                os.path.join(data_dir, "raft", nid))
+
         self.raft = RaftCluster(
             n, apply_factory, seed=seed,
             snapshot_threshold=snapshot_threshold,
             snapshot_factory=lambda nid: fsms[nid].snapshot,
             restore_factory=lambda nid: fsms[nid].restore,
+            store_factory=store_factory,
         )
+        self.dc = dc
         self.servers = [
             Server(nid, self.raft.nodes[nid], fsms[nid], self.registry,
-                   vivaldi_dimensionality)
+                   vivaldi_dimensionality, dc=dc)
             for nid in sorted(self.raft.nodes)
         ]
         # bootstrap-expect (reference server_serf.go:236 maybeBootstrap):
@@ -454,3 +536,30 @@ class ServerCluster:
             raise TimeoutError(f"index {out} not fully applied")
         self.step(5)
         return out
+
+
+def federate(*clusters: "ServerCluster", seed: int = 0):
+    """Wire single-DC ServerClusters into one WAN: every server gets a
+    Router seeded with every cluster's server list and a shared
+    ``wan_id -> Server`` registry — the in-process equivalent of WAN
+    serf membership + flood join feeding each server's router
+    (reference agent/consul/flood.go:27-66, agent/router/serf_adapter.go;
+    the registry plays the yamux connection pool's role).
+
+    Returns the shared WAN registry."""
+    from consul_tpu.server.router import Router, flood_join
+
+    dcs = [c.dc for c in clusters]
+    if len(set(dcs)) != len(dcs):
+        raise ValueError(f"duplicate datacenter names: {dcs}")
+    wan_registry: dict[str, Server] = {
+        s.wan_id: s for c in clusters for s in c.servers
+    }
+    for c in clusters:
+        for s in c.servers:
+            router = Router(local_dc=c.dc, seed=seed)
+            for other in clusters:
+                flood_join(router, other.dc,
+                           [o.wan_id for o in other.servers])
+            s.join_wan(router, wan_registry)
+    return wan_registry

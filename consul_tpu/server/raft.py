@@ -103,9 +103,20 @@ class RaftNode:
                  apply_fn: Callable[[int, Any], Any], seed: int = 0,
                  snapshot_fn: Optional[Callable[[], dict]] = None,
                  restore_fn: Optional[Callable[[dict], None]] = None,
-                 snapshot_threshold: int = 1024):
+                 snapshot_threshold: int = 1024,
+                 store=None, voter: bool = True,
+                 voters: Optional[set] = None):
         self.id = node_id
         self.peers = [p for p in peer_ids if p != node_id]
+        # Voter configuration (reference raft Voter vs Nonvoter
+        # suffrage): non-voters replicate the log but neither start
+        # elections nor count toward any quorum. Like remove_server,
+        # membership/suffrage changes are raft-lite's out-of-band
+        # reconfiguration — managed by autopilot, not log entries.
+        self.voter = voter
+        self.voters: set = set(voters) if voters is not None else set(peer_ids)
+        if voter:
+            self.voters.add(node_id)
         self.transport = transport
         self.apply_fn = apply_fn
         self.snapshot_fn = snapshot_fn
@@ -137,8 +148,58 @@ class RaftNode:
         self.apply_results: dict[int, Any] = {}
         self.apply_results_cap = 4096
         self.stopped = False
+        # Stats surface for autopilot's StatsFetcher (stats_fetcher.go).
+        self.ticks = 0
+        self.last_contact_tick = 0
+        # Durable storage (server/raft_store.py — the raft-boltdb role,
+        # reference bolt_store.go:1-305 at server.go:558-600). When a
+        # populated store is handed in, this IS a crash-restart: term,
+        # vote, log, and snapshot come back from disk and the FSM is
+        # rebuilt from snapshot + committed-log replay once a leader
+        # re-establishes the commit index.
+        self.store = store
+        rec = store.load() if store is not None else None
+        if rec is not None:
+            self.term = rec["term"]
+            self.voted_for = rec["voted_for"]
+            if rec.get("suffrage") is not None:
+                self.voter = rec["suffrage"]["voter"]
+                self.voters = set(rec["suffrage"]["voters"])
+            self.log = [LogEntry(**e) for e in rec["entries"]]
+            self.log_base_index = rec["base_index"]
+            self.log_base_term = rec["base_term"]
+            self.pending_snapshot = rec["snapshot"]
+            if rec["snapshot"] is not None and self.restore_fn is not None:
+                self.restore_fn(rec["snapshot"])
+            # Commit index is NOT persisted (hashicorp/raft doesn't
+            # either): entries beyond the snapshot re-commit via the
+            # next leader's AppendEntries commit_index.
+            self.commit_index = self.log_base_index
+            self.last_applied = self.log_base_index
+        elif store is not None:
+            store.set_stable(
+                self.term, self.voted_for,
+                {"voter": self.voter, "voters": sorted(self.voters)},
+            )
         self._reset_election_timer()
         transport.register(self)
+
+    def _persist_stable(self):
+        if self.store is not None:
+            self.store.set_stable(
+                self.term, self.voted_for,
+                {"voter": self.voter, "voters": sorted(self.voters)},
+            )
+
+    def _persist_append(self, entries: list[LogEntry]):
+        if self.store is not None:
+            self.store.append([dataclasses.asdict(e) for e in entries])
+
+    def _persist_log_rewrite(self):
+        if self.store is not None:
+            self.store.rewrite_log(
+                [dataclasses.asdict(e) for e in self.log]
+            )
 
     # ------------------------------------------------------------------
     # Log helpers (with compaction offsets)
@@ -170,12 +231,15 @@ class RaftNode:
     def tick(self):
         if self.stopped:
             return
+        self.ticks += 1
         if self.state == LEADER:
             self.heartbeat_ticks = getattr(self, "heartbeat_ticks", 0) - 1
             if self.heartbeat_ticks <= 0:
                 self.heartbeat_ticks = HEARTBEAT_TICKS
                 self._broadcast_appends()
             return
+        if not self.voter:
+            return  # non-voters never campaign
         self.election_ticks -= 1
         if self.election_ticks <= 0:
             self._start_election()
@@ -187,6 +251,7 @@ class RaftNode:
         self.state = CANDIDATE
         self.term += 1
         self.voted_for = self.id
+        self._persist_stable()
         self.votes = {self.id}
         self.leader_id = None
         self._reset_election_timer()
@@ -199,7 +264,8 @@ class RaftNode:
         self._maybe_win()
 
     def _maybe_win(self):
-        if self.state == CANDIDATE and len(self.votes) * 2 > len(self.peers) + 1:
+        votes = len(self.votes & self.voters)
+        if self.state == CANDIDATE and votes * 2 > len(self.voters):
             self.state = LEADER
             self.leader_id = self.id
             self.heartbeat_ticks = 0
@@ -210,6 +276,7 @@ class RaftNode:
             # replicated entries from prior terms become committable
             # (raft §5.4.2; hashicorp/raft's LogNoop on election).
             self.log.append(LogEntry(self.term, nxt, {"type": "noop"}))
+            self._persist_append(self.log[-1:])
             self._broadcast_appends()
             # A single-node cluster is its own quorum (dev mode,
             # reference raftInmem server.go:177) — commit immediately.
@@ -226,6 +293,7 @@ class RaftNode:
             raise NotLeader(self.leader_id)
         entry = LogEntry(self.term, self.last_log_index() + 1, command)
         self.log.append(entry)
+        self._persist_append([entry])
         self._broadcast_appends()
         self._advance_commit()  # no-op unless we alone are a quorum
         return entry.index
@@ -265,6 +333,7 @@ class RaftNode:
             self.term = msg.term
             self.state = FOLLOWER
             self.voted_for = None
+            self._persist_stable()
             # A deposed leader must not keep claiming itself; the new
             # leader's identity arrives with its first AppendEntries.
             self.leader_id = None
@@ -291,6 +360,11 @@ class RaftNode:
         )
         if grant:
             self.voted_for = msg.src
+            # The vote must be durable before the grant leaves this
+            # node (a re-vote in the same term after restart would let
+            # two leaders win); transport defers delivery to the next
+            # pump, so persisting here precedes the send.
+            self._persist_stable()
             self._reset_election_timer()
         self.transport.send(Message(
             "vote_reply", self.id, msg.src, self.term, {"granted": grant}
@@ -311,6 +385,7 @@ class RaftNode:
             return
         self.state = FOLLOWER
         self.leader_id = msg.src
+        self.last_contact_tick = self.ticks
         self._reset_election_timer()
         p = msg.payload
         if self.term_at(p["prev_index"]) != p["prev_term"]:
@@ -321,14 +396,21 @@ class RaftNode:
             ))
             return
         # Append, truncating conflicts (log matching property).
+        added, truncated = [], False
         for e in p["entries"]:
             entry = LogEntry(**e)
             existing = self.entry_at(entry.index)
             if existing is not None and existing.term != entry.term:
                 del self.log[entry.index - self.log_base_index - 1:]
                 existing = None
+                truncated = True
             if existing is None and entry.index == self.last_log_index() + 1:
                 self.log.append(entry)
+                added.append(entry)
+        if truncated:
+            self._persist_log_rewrite()  # conflict suffix must not revive
+        elif added:
+            self._persist_append(added)
         match = p["prev_index"] + len(p["entries"])
         if p["commit_index"] > self.commit_index:
             self.commit_index = min(p["commit_index"], self.last_log_index())
@@ -358,10 +440,11 @@ class RaftNode:
         for idx in range(self.last_log_index(), self.commit_index, -1):
             if self.term_at(idx) != self.term:
                 break
-            replicas = 1 + sum(
-                1 for p in self.peers if self.match_index.get(p, 0) >= idx
+            replicas = (1 if self.id in self.voters else 0) + sum(
+                1 for p in self.peers
+                if p in self.voters and self.match_index.get(p, 0) >= idx
             )
-            if replicas * 2 > len(self.peers) + 1:
+            if replicas * 2 > len(self.voters):
                 self.commit_index = idx
                 self._apply_committed()
                 break
@@ -397,6 +480,12 @@ class RaftNode:
         self.log = self.log[self.last_applied - self.log_base_index:]
         self.log_base_index = self.last_applied
         self.log_base_term = base_term
+        if self.store is not None:
+            self.store.save_snapshot(
+                self.pending_snapshot, self.log_base_index,
+                self.log_base_term,
+            )
+            self._persist_log_rewrite()
 
     def _on_install_snapshot(self, msg: Message):
         p = msg.payload
@@ -404,6 +493,7 @@ class RaftNode:
             return
         self.state = FOLLOWER
         self.leader_id = msg.src
+        self.last_contact_tick = self.ticks
         self._reset_election_timer()
         if self.restore_fn is not None:
             self.restore_fn(p["snapshot"])
@@ -413,6 +503,11 @@ class RaftNode:
         self.commit_index = p["last_index"]
         self.last_applied = p["last_index"]
         self.pending_snapshot = p["snapshot"]
+        if self.store is not None:
+            self.store.save_snapshot(
+                p["snapshot"], p["last_index"], p["last_term"]
+            )
+            self._persist_log_rewrite()
         self.transport.send(Message(
             "append_reply", self.id, msg.src, self.term,
             {"success": True, "match_index": p["last_index"]},
@@ -437,17 +532,84 @@ class RaftCluster:
 
     def __init__(self, n: int, apply_factory: Callable[[str], Callable],
                  seed: int = 0, snapshot_threshold: int = 1024,
-                 snapshot_factory=None, restore_factory=None):
+                 snapshot_factory=None, restore_factory=None,
+                 store_factory=None):
         self.transport = Transport()
         ids = [f"srv{i}" for i in range(n)]
         self.nodes = {}
+        self._factories = (apply_factory, snapshot_factory, restore_factory,
+                           store_factory)
+        self._seed = seed
+        self._snapshot_threshold = snapshot_threshold
         for node_id in ids:
-            self.nodes[node_id] = RaftNode(
-                node_id, ids, self.transport, apply_factory(node_id),
-                seed=seed, snapshot_threshold=snapshot_threshold,
-                snapshot_fn=snapshot_factory(node_id) if snapshot_factory else None,
-                restore_fn=restore_factory(node_id) if restore_factory else None,
-            )
+            self.nodes[node_id] = self._make_node(node_id, ids)
+
+    def _make_node(self, node_id: str, ids: list[str]) -> RaftNode:
+        apply_f, snap_f, restore_f, store_f = self._factories
+        return RaftNode(
+            node_id, ids, self.transport, apply_f(node_id),
+            seed=self._seed, snapshot_threshold=self._snapshot_threshold,
+            snapshot_fn=snap_f(node_id) if snap_f else None,
+            restore_fn=restore_f(node_id) if restore_f else None,
+            store=store_f(node_id) if store_f else None,
+        )
+
+    def add_nonvoter(self, node_id: str) -> RaftNode:
+        """Join a fresh server as a non-voter (reference raft
+        AddNonvoter; Consul servers join staging before autopilot
+        promotes them). It replicates from the leader but counts
+        toward no quorum until promoted."""
+        if node_id in self.nodes:
+            raise ValueError(f"{node_id} already a member")
+        voters = next(iter(self.nodes.values())).voters
+        ids = sorted({node_id, *self.nodes})
+        apply_f, snap_f, restore_f, store_f = self._factories
+        node = RaftNode(
+            node_id, ids, self.transport, apply_f(node_id),
+            seed=self._seed, snapshot_threshold=self._snapshot_threshold,
+            snapshot_fn=snap_f(node_id) if snap_f else None,
+            restore_fn=restore_f(node_id) if restore_f else None,
+            store=store_f(node_id) if store_f else None,
+            voter=False, voters=set(voters),
+        )
+        self.nodes[node_id] = node
+        node._persist_stable()  # records voter=False before any crash
+        for other in self.nodes.values():
+            if other.id != node_id and node_id not in other.peers:
+                other.peers.append(node_id)
+        return node
+
+    def promote(self, node_id: str) -> None:
+        """Grant suffrage (reference raft AddVoter on promotion,
+        autopilot.go:256-320): flips the shared voter configuration on
+        every member — raft-lite's out-of-band reconfiguration,
+        persisted per node so a crash cannot roll suffrage back."""
+        self.nodes[node_id].voter = True
+        for node in self.nodes.values():
+            node.voters.add(node_id)
+            node._persist_stable()
+
+    def crash(self, node_id: str):
+        """Hard-kill: the in-memory RaftNode object is discarded (its
+        volatile state is gone for good), pending inbox dropped. Only
+        what its DurableRaftStore wrote can come back."""
+        node = self.nodes.pop(node_id)
+        node.stopped = True
+        if node.store is not None:
+            node.store.close()
+        del self.transport.nodes[node_id]
+        del self.transport.queues[node_id]
+
+    def restart_from_disk(self, node_id: str) -> RaftNode:
+        """Recover a crashed node purely from its store directory —
+        requires a ``store_factory`` (crash-restart of a store-less
+        node would be an amnesiac rejoining under an old identity)."""
+        if self._factories[3] is None:
+            raise ValueError("restart_from_disk requires store_factory")
+        ids = sorted({node_id, *self.nodes})
+        node = self._make_node(node_id, ids)
+        self.nodes[node_id] = node
+        return node
 
     def step(self, rounds: int = 1):
         for _ in range(rounds):

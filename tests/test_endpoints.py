@@ -208,3 +208,21 @@ class TestRTTSort:
         ])
         assert set(sets["a"]) == {"", "s1"}
         assert set(sets["b"]) == {""}
+
+
+class TestServerDurability:
+    def test_cluster_kv_survives_cold_restart(self, tmp_path):
+        from consul_tpu.server.endpoints import ServerCluster
+
+        c = ServerCluster(n=3, data_dir=str(tmp_path))
+        led = c.wait_converged()
+        led.rpc("KVS.Apply", op="set", key="boot", value=b"v1")
+        c.step(10)
+        for nid in list(c.raft.nodes):
+            c.raft.crash(nid)
+
+        c2 = ServerCluster(n=3, data_dir=str(tmp_path))
+        led2 = c2.wait_converged()
+        c2.step(10)
+        out = led2.rpc("KVS.Get", key="boot")
+        assert out["value"]["value"] == b"v1"
