@@ -22,9 +22,30 @@ from typing import Optional
 from consul_tpu.server.raft import RaftCluster, RaftNode
 
 # Reference defaults (agent/consul/config.go AutopilotConfig /
-# autopilot/structs.go): contact threshold 200ms, max trailing logs 250.
+# autopilot/structs.go): contact threshold 200ms, max trailing logs 250,
+# server stabilization time 10s before a non-voter earns suffrage.
 LAST_CONTACT_THRESHOLD_TICKS = 10
 MAX_TRAILING_LOGS = 250
+SERVER_STABILIZATION_TICKS = 30
+
+
+def fetch_stats(cluster: RaftCluster) -> dict[str, Optional[dict]]:
+    """StatsFetcher (reference agent/consul/stats_fetcher.go:1-90): poll
+    every server's raft stats ahead of the health evaluation. A stopped
+    server doesn't answer (None) — the reference's fetch timeout."""
+    out: dict[str, Optional[dict]] = {}
+    for nid, node in cluster.nodes.items():
+        if node.stopped:
+            out[nid] = None
+        else:
+            out[nid] = {
+                "last_index": node.last_log_index(),
+                "term": node.term,
+                "contact_age": node.ticks - node.last_contact_tick,
+                "voter": node.voter,
+                "is_leader": node.state == "leader",
+            }
+    return out
 
 
 @dataclasses.dataclass
@@ -38,29 +59,39 @@ class ServerHealth:
 
 
 def server_health(cluster: RaftCluster, node: RaftNode,
-                  leader: RaftNode) -> ServerHealth:
-    """Health verdict for one server from the leader's vantage point
-    (reference autopilot.go updateServerHealth / queryServerHealth)."""
-    if node.stopped:
-        return ServerHealth(node.id, False, True, None, 0, "not responding")
+                  leader: RaftNode,
+                  stats: Optional[dict] = None) -> ServerHealth:
+    """Health verdict for one server from the leader's vantage point,
+    scored from *fetched stats* (reference autopilot.go
+    updateServerHealth consuming the StatsFetcher's ServerStats:
+    last-index lag, term agreement, last leader contact)."""
+    st = (stats or fetch_stats(cluster)).get(node.id)
+    if st is None:
+        return ServerHealth(node.id, False, node.voter, None, 0,
+                            "not responding")
     if node.id == leader.id:
         return ServerHealth(node.id, True, True, 0, 0)
-    match = leader.match_index.get(node.id, 0)
-    trailing = leader.last_log_index() - match
-    if node.term != leader.term:
-        return ServerHealth(node.id, False, True, None, trailing,
-                            f"term {node.term} != leader term {leader.term}")
+    trailing = leader.last_log_index() - st["last_index"]
+    if st["term"] != leader.term:
+        return ServerHealth(node.id, False, node.voter, None, trailing,
+                            f"term {st['term']} != leader term {leader.term}")
     if trailing > MAX_TRAILING_LOGS:
-        return ServerHealth(node.id, False, True, None, trailing,
+        return ServerHealth(node.id, False, node.voter, None, trailing,
                             f"trailing {trailing} logs")
-    return ServerHealth(node.id, True, True, 0, trailing)
+    if st["contact_age"] > LAST_CONTACT_THRESHOLD_TICKS:
+        return ServerHealth(node.id, False, node.voter,
+                            st["contact_age"], trailing,
+                            f"no leader contact for {st['contact_age']} ticks")
+    return ServerHealth(node.id, True, node.voter,
+                        st["contact_age"], trailing)
 
 
 def cluster_health(cluster: RaftCluster) -> list[ServerHealth]:
     leader = cluster.leader()
     if leader is None:
         return []
-    return [server_health(cluster, n, leader)
+    stats = fetch_stats(cluster)
+    return [server_health(cluster, n, leader, stats)
             for n in cluster.nodes.values()]
 
 
@@ -78,6 +109,8 @@ def remove_server(cluster: RaftCluster, server_id: str) -> None:
     for node in cluster.nodes.values():
         if server_id in node.peers:
             node.peers.remove(server_id)
+        node.voters.discard(server_id)
+        node._persist_stable()  # shrunk voter config must survive crash
         node.next_index.pop(server_id, None)
         node.match_index.pop(server_id, None)
     node = cluster.nodes.pop(server_id, None)
@@ -87,16 +120,72 @@ def remove_server(cluster: RaftCluster, server_id: str) -> None:
     cluster.transport.queues.pop(server_id, None)
 
 
-def clean_dead_servers(cluster: RaftCluster) -> list[str]:
+def clean_dead_servers(cluster: RaftCluster, healths=None) -> list[str]:
     """Remove failed servers, quorum permitting (reference
-    autopilot.go pruneDeadServers). Returns removed ids."""
-    leader = cluster.leader()
-    if leader is None:
+    autopilot.go pruneDeadServers). Returns removed ids. Pass
+    ``healths`` to reuse an evaluation already done this tick."""
+    if healths is None:
+        healths = cluster_health(cluster)
+    elif cluster.leader() is None:
         return []
-    dead = [h.id for h in cluster_health(cluster)
+    dead = [h.id for h in healths
             if not h.healthy and h.reason == "not responding"]
     if not dead or not can_remove_servers(len(cluster.nodes), len(dead)):
         return []
     for sid in dead:
         remove_server(cluster, sid)
     return dead
+
+
+class Autopilot:
+    """The periodic autopilot loop with state: dead-server cleanup plus
+    **non-voter promotion after a stabilization window** (reference
+    agent/consul/autopilot/autopilot.go:256-320 promoteStableServers:
+    a non-voter must be continuously healthy for ServerStabilizationTime
+    before it earns suffrage; any unhealthy observation resets its
+    clock)."""
+
+    def __init__(self, cluster: RaftCluster,
+                 stabilization_ticks: int = SERVER_STABILIZATION_TICKS,
+                 cleanup_dead_servers: bool = True):
+        self.cluster = cluster
+        self.stabilization_ticks = stabilization_ticks
+        self.cleanup_dead_servers = cleanup_dead_servers
+        self._ticks = 0
+        self._healthy_since: dict[str, int] = {}
+        self.promoted: list[str] = []
+        self.removed: list[str] = []
+
+    def run(self) -> None:
+        """One autopilot pass (the leader's periodic serverHealthLoop,
+        reference autopilot.go:73-120). Call at the cluster-step cadence."""
+        self._ticks += 1
+        leader = self.cluster.leader()
+        if leader is None:
+            return
+        stats = fetch_stats(self.cluster)
+        healths = {
+            h.id: h for h in (
+                server_health(self.cluster, n, leader, stats)
+                for n in self.cluster.nodes.values()
+            )
+        }
+        # Stabilization bookkeeping for non-voters.
+        for nid, h in healths.items():
+            if h.voter:
+                self._healthy_since.pop(nid, None)
+                continue
+            if not h.healthy:
+                self._healthy_since.pop(nid, None)  # clock resets
+                continue
+            self._healthy_since.setdefault(nid, self._ticks)
+        # Promote every non-voter that has been stable long enough.
+        for nid, since in list(self._healthy_since.items()):
+            if self._ticks - since >= self.stabilization_ticks:
+                self.cluster.promote(nid)
+                self.promoted.append(nid)
+                del self._healthy_since[nid]
+        if self.cleanup_dead_servers:
+            self.removed.extend(
+                clean_dead_servers(self.cluster, list(healths.values()))
+            )

@@ -208,3 +208,99 @@ class TestRouter:
         assert added == 2
         assert flood_join(r, "dc1", ["a", "b"]) == 0
         assert r.get_datacenter_maps() == {"dc1": ["a", "b"]}
+
+
+class TestAutopilotPromotion:
+    """Non-voter promotion after stabilization (reference
+    agent/consul/autopilot/autopilot.go:256-320 promoteStableServers +
+    stats_fetcher.go server stats)."""
+
+    def _with_nonvoter(self, cluster):
+        node = cluster.raft.add_nonvoter("srv3")
+        cluster.step(30)  # let it catch up from the leader
+        return node
+
+    def test_stats_fetcher_reports_all_servers(self, cluster):
+        self._with_nonvoter(cluster)
+        stats = autopilot.fetch_stats(cluster.raft)
+        assert set(stats) == {"srv0", "srv1", "srv2", "srv3"}
+        assert stats["srv3"]["voter"] is False
+        led = cluster.raft.leader()
+        assert stats["srv3"]["last_index"] == led.last_log_index()
+
+    def test_nonvoter_replicates_but_no_suffrage(self, cluster):
+        node = self._with_nonvoter(cluster)
+        led = cluster.raft.leader()
+        assert node.last_log_index() == led.last_log_index()
+        assert "srv3" not in led.voters
+        # Its replication does not advance commit: a 4-member cluster
+        # with 3 voters still needs 2 voters.
+        assert len(led.voters) == 3
+
+    def test_promote_after_stable(self, cluster):
+        self._with_nonvoter(cluster)
+        ap = autopilot.Autopilot(cluster.raft, stabilization_ticks=5)
+        for _ in range(8):
+            cluster.step()
+            ap.run()
+        assert ap.promoted == ["srv3"]
+        led = cluster.raft.leader()
+        assert "srv3" in led.voters
+        assert cluster.raft.nodes["srv3"].voter is True
+
+    def test_no_promote_while_lagging(self, cluster):
+        node = self._with_nonvoter(cluster)
+        ap = autopilot.Autopilot(cluster.raft, stabilization_ticks=5)
+        # Cut the non-voter off from the leader: its stats stop moving
+        # while the leader's log grows past MAX_TRAILING_LOGS.
+        led = cluster.raft.leader()
+        cluster.raft.transport.partition(led.id, "srv3")
+        for i in range(autopilot.MAX_TRAILING_LOGS + 5):
+            led.propose({"type": "noop2", "i": i})
+        for _ in range(10):
+            cluster.step()
+            ap.run()
+        assert ap.promoted == []
+        assert "srv3" not in cluster.raft.leader().voters
+
+    def test_promotion_clock_resets_on_unhealthy(self, cluster):
+        # Stabilization must outlast the contact-loss detection window
+        # (the partition only reads as unhealthy once contact_age
+        # exceeds the threshold), so use a long window.
+        thresh = autopilot.LAST_CONTACT_THRESHOLD_TICKS
+        ap = autopilot.Autopilot(cluster.raft,
+                                 stabilization_ticks=2 * thresh + 5)
+        self._with_nonvoter(cluster)
+        led = cluster.raft.leader()
+        for _ in range(4):
+            cluster.step()
+            ap.run()
+        assert "srv3" in ap._healthy_since
+        # Interrupt health mid-window: contact loss resets the clock.
+        cluster.raft.transport.partition(led.id, "srv3")
+        for _ in range(thresh + 4):
+            cluster.step()
+            ap.run()
+        assert ap.promoted == []
+        assert "srv3" not in ap._healthy_since  # the clock reset
+        cluster.raft.transport.heal()
+        for _ in range(2 * thresh + 8):
+            cluster.step()
+            ap.run()
+        assert ap.promoted == ["srv3"]
+
+    def test_promoted_voter_counts_for_quorum(self, cluster):
+        self._with_nonvoter(cluster)
+        ap = autopilot.Autopilot(cluster.raft, stabilization_ticks=3)
+        for _ in range(6):
+            cluster.step()
+            ap.run()
+        assert ap.promoted == ["srv3"]
+        # 4 voters now: majority is 3. Stop one old voter; commits
+        # still require and get 3 of 4.
+        victim = next(s for s in cluster.servers
+                      if s.id != cluster.raft.leader().id)
+        cluster.raft.nodes[victim.id].stop()
+        led_srv = cluster.leader_server()
+        cluster.write(led_srv, "KVS.Apply", op="set", key="q", value=b"4")
+        assert led_srv.store.kv_get("q")["value"] == b"4"
