@@ -93,10 +93,17 @@ def _break_stale(path: str) -> bool:
         and entombed.get("started") == info.get("started")
     )
     if not same:
+        # Put the live lock back WITHOUT clobbering: link fails with
+        # EEXIST if yet another acquirer has taken the path meanwhile
+        # (renaming over it would hand two processes the lock).
         try:
-            os.rename(tomb, path)
+            os.link(tomb, path)
         except OSError:
-            pass  # the live holder will re-create or error loudly
+            pass  # someone holds the path; the entombed holder loses
+        try:
+            os.unlink(tomb)
+        except OSError:
+            pass
         return False
     try:
         os.unlink(tomb)

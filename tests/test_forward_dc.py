@@ -1,0 +1,90 @@
+"""Cross-DC RPC forwarding (reference agent/consul/rpc.go:315-365:
+``forwardDC`` via Router.FindRoute + ``globalRPC`` fan-out): a ``dc=``
+query against one datacenter answers from another, with rotation past
+down servers, exactly the reference's everyday multi-DC read path."""
+
+import pytest
+
+from consul_tpu.server.endpoints import (
+    NoPathToDatacenter, ServerCluster, federate,
+)
+
+
+@pytest.fixture
+def two_dcs():
+    c1 = ServerCluster(n=3, dc="dc1")
+    c2 = ServerCluster(n=3, dc="dc2", seed=1)
+    federate(c1, c2)
+    c1.wait_converged()
+    c2.wait_converged()
+    return c1, c2
+
+
+class TestForwardDC:
+    def test_kv_query_answers_from_remote_dc(self, two_dcs):
+        c1, c2 = two_dcs
+        c2.write(c2.leader_server(), "KVS.Apply",
+                 op="set", key="remote-k", value=b"from-dc2")
+        out = c1.servers[0].rpc("KVS.Get", key="remote-k", dc="dc2")
+        assert out["value"]["value"] == b"from-dc2"
+        assert c1.servers[0].metrics["rpc_cross_dc"] == 1
+        # And the local DC genuinely does not have the key.
+        local = c1.servers[0].rpc("KVS.Get", key="remote-k")
+        assert local["value"] is None
+
+    def test_catalog_query_remote_dc(self, two_dcs):
+        c1, c2 = two_dcs
+        c2.write(c2.leader_server(), "Catalog.Register",
+                 node="web-1", address="10.2.0.1",
+                 service={"id": "web", "service": "web", "port": 80})
+        out = c1.servers[0].rpc("Catalog.ServiceNodes",
+                                service="web", dc="dc2")
+        assert [n["node"] for n in out["value"]] == ["web-1"]
+
+    def test_local_dc_value_is_not_forwarded(self, two_dcs):
+        c1, _ = two_dcs
+        # dc= naming the local DC short-circuits to local dispatch
+        # (reference forward: args.Datacenter == s.config.Datacenter).
+        out = c1.servers[0].rpc("Status.Peers", dc="dc1")
+        assert len(out) == 3
+        assert c1.servers[0].metrics["rpc_cross_dc"] == 0
+
+    def test_failover_rotates_past_down_server(self, two_dcs):
+        c1, c2 = two_dcs
+        c2.write(c2.leader_server(), "KVS.Apply",
+                 op="set", key="k", value=b"v")
+        src = c1.servers[0]
+        # Kill whichever dc2 server the router would pick first.
+        first = src.router.find_route("dc2")
+        victim = src.wan_registry[first]
+        victim.raft.stopped = True
+        out = src.rpc("KVS.Get", key="k", dc="dc2")
+        assert out["value"]["value"] == b"v"
+        # The failed server was rotated to the end of the manager list.
+        assert src.router.get_datacenter_maps()["dc2"][-1] == first
+
+    def test_no_path_when_whole_dc_down(self, two_dcs):
+        c1, c2 = two_dcs
+        for s in c2.servers:
+            s.raft.stopped = True
+        with pytest.raises(NoPathToDatacenter):
+            c1.servers[0].rpc("KVS.Get", key="k", dc="dc2")
+
+    def test_unknown_dc_raises(self, two_dcs):
+        c1, _ = two_dcs
+        with pytest.raises(NoPathToDatacenter):
+            c1.servers[0].rpc("KVS.Get", key="k", dc="dc9")
+
+    def test_global_rpc_fans_out_to_all_dcs(self, two_dcs):
+        c1, c2 = two_dcs
+        out = c1.servers[0].global_rpc("Status.Peers")
+        assert set(out) == {"dc1", "dc2"}
+        assert len(out["dc1"]) == 3 and len(out["dc2"]) == 3
+
+    def test_global_rpc_reports_dead_dc_error(self, two_dcs):
+        c1, c2 = two_dcs
+        for s in c2.servers:
+            s.raft.stopped = True
+        out = c1.servers[0].global_rpc("Status.Peers")
+        assert len(out["dc1"]) == 3
+        assert "no path to datacenter" in out["dc2"]["error"]
