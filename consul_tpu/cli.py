@@ -339,6 +339,46 @@ def cmd_exec(client: Client, args) -> int:
         r.get("exit") == 0 for r in result.values()) else 1
 
 
+def cmd_config(client: Client, args) -> int:
+    """reference command/config: write/read/list/delete centralized
+    config entries through /v1/config."""
+    if args.config_cmd == "write":
+        if args.file == "-":
+            doc = json.load(sys.stdin)
+        else:
+            with open(args.file, encoding="utf-8") as f:
+                doc = json.load(f)
+        try:
+            kind, name = doc.pop("Kind"), doc.pop("Name")
+        except KeyError as e:
+            print(f"config write: entry is missing required field {e}",
+                  file=sys.stderr)
+            return 1
+        ok = client.config.set(kind, name, doc, cas=args.cas)
+        if not ok:
+            print("config write failed (cas mismatch)", file=sys.stderr)
+            return 1
+        print(f"Config entry written: {kind}/{name}")
+    elif args.config_cmd == "read":
+        entry, _ = client.config.get(args.kind, args.name)
+        if entry is None:
+            print(f"config entry {args.kind}/{args.name} not found",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps(entry, indent=2))
+    elif args.config_cmd == "list":
+        entries, _ = client.config.list(args.kind)
+        for e in entries:
+            print(f"{e['Kind']}/{e['Name']}")
+    elif args.config_cmd == "delete":
+        ok = client.config.delete(args.kind, args.name, cas=args.cas)
+        if not ok:
+            print("config delete failed (cas mismatch)", file=sys.stderr)
+            return 1
+        print(f"Config entry deleted: {args.kind}/{args.name}")
+    return 0
+
+
 def cmd_reload(client: Client, args) -> int:
     """Trigger a config reload (reference command/reload)."""
     try:
@@ -373,6 +413,15 @@ def build_parser() -> argparse.ArgumentParser:
         default=os.environ.get("CONSUL_TPU_HTTP_ADDR", "127.0.0.1:8500"),
     )
     sub = p.add_subparsers(dest="cmd", required=True)
+
+    ag = sub.add_parser(
+        "agent", help="boot an agent (+in-process servers) from config")
+    ag.add_argument("--config-file", default=None)
+    ag.add_argument("--node", default=None, help="override node_name")
+    ag.add_argument("--server", action="store_true", default=None)
+    ag.add_argument("--http-port", type=int, default=None,
+                    help="override http.port (0 = pick a free port)")
+    ag.add_argument("--data-dir", default=None)
 
     sub.add_parser("members", help="cluster members + health")
 
@@ -488,6 +537,21 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("reload", help="trigger a config reload")
 
+    cfg_p = sub.add_parser("config", help="centralized config entries")
+    cfg_sub = cfg_p.add_subparsers(dest="config_cmd", required=True)
+    cw = cfg_sub.add_parser("write")
+    cw.add_argument("file", help="JSON file with Kind/Name (or - for stdin)")
+    cw.add_argument("--cas", type=int, default=None)
+    cr = cfg_sub.add_parser("read")
+    cr.add_argument("-kind", "--kind", required=True)
+    cr.add_argument("-name", "--name", required=True)
+    cl = cfg_sub.add_parser("list")
+    cl.add_argument("-kind", "--kind", default="*")
+    cd = cfg_sub.add_parser("delete")
+    cd.add_argument("-kind", "--kind", required=True)
+    cd.add_argument("-name", "--name", required=True)
+    cd.add_argument("--cas", type=int, default=None)
+
     return p
 
 
@@ -498,12 +562,31 @@ COMMANDS = {
     "event": cmd_event, "watch": cmd_watch, "force-leave": cmd_force_leave,
     "operator": cmd_operator, "maint": cmd_maint, "keyring": cmd_keyring,
     "monitor": cmd_monitor, "validate": cmd_validate, "lock": cmd_lock,
-    "exec": cmd_exec, "reload": cmd_reload,
+    "exec": cmd_exec, "reload": cmd_reload, "config": cmd_config,
 }
+
+
+def cmd_agent(args) -> int:
+    """Boot-from-config (reference command/agent/agent.go, main.go:19) —
+    the one subcommand that IS an agent rather than talking to one."""
+    from consul_tpu.agent import boot
+
+    overrides = {}
+    if args.node is not None:
+        overrides["node_name"] = args.node
+    if args.server:
+        overrides["server"] = True
+    if args.data_dir is not None:
+        overrides["data_dir"] = args.data_dir
+    if args.http_port is not None:
+        overrides["http"] = {"host": "127.0.0.1", "port": args.http_port}
+    return boot.run(args.config_file, overrides)
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.cmd == "agent":
+        return cmd_agent(args)
     client = make_client(args)
     try:
         return COMMANDS[args.cmd](client, args)

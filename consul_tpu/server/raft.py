@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import random
+import threading
 import zlib
 from typing import Any, Callable, Optional
 
@@ -57,6 +58,14 @@ class Transport:
         self.nodes: dict[str, "RaftNode"] = {}
         self.queues: dict[str, list[Message]] = {}
         self.cut: set[tuple[str, str]] = set()
+        # One lock for the whole cluster's mutation surface: tick/pump
+        # from a driver thread and propose from HTTP handler threads
+        # interleave in live deployments (agent/boot.py) — entry points
+        # take this lock so raft-lite is thread-safe without changing
+        # its deterministic single-threaded behavior (RLock: in-process
+        # forwarding re-enters propose). Blocking *reads* never touch
+        # it; they park on the state store's own condition instead.
+        self.lock = threading.RLock()
 
     def register(self, node: "RaftNode"):
         self.nodes[node.id] = node
@@ -81,12 +90,13 @@ class Transport:
     def pump(self):
         """Deliver every queued message (messages sent during delivery
         land next pump, keeping rounds deterministic)."""
-        for node_id in sorted(self.queues):
-            batch, self.queues[node_id] = self.queues[node_id], []
-            node = self.nodes[node_id]
-            for msg in batch:
-                if not node.stopped:
-                    node.handle(msg)
+        with self.lock:
+            for node_id in sorted(self.queues):
+                batch, self.queues[node_id] = self.queues[node_id], []
+                node = self.nodes[node_id]
+                for msg in batch:
+                    if not node.stopped:
+                        node.handle(msg)
 
 
 class NotLeader(Exception):
@@ -231,6 +241,10 @@ class RaftNode:
     def tick(self):
         if self.stopped:
             return
+        with self.transport.lock:
+            self._tick_locked()
+
+    def _tick_locked(self):
         self.ticks += 1
         if self.state == LEADER:
             self.heartbeat_ticks = getattr(self, "heartbeat_ticks", 0) - 1
@@ -289,14 +303,15 @@ class RaftNode:
         """Leader-only append; returns the entry's log index. Commit is
         observed via apply_fn once a quorum replicates (raftApply
         semantics, reference agent/consul/rpc.go:377)."""
-        if self.state != LEADER:
-            raise NotLeader(self.leader_id)
-        entry = LogEntry(self.term, self.last_log_index() + 1, command)
-        self.log.append(entry)
-        self._persist_append([entry])
-        self._broadcast_appends()
-        self._advance_commit()  # no-op unless we alone are a quorum
-        return entry.index
+        with self.transport.lock:
+            if self.state != LEADER:
+                raise NotLeader(self.leader_id)
+            entry = LogEntry(self.term, self.last_log_index() + 1, command)
+            self.log.append(entry)
+            self._persist_append([entry])
+            self._broadcast_appends()
+            self._advance_commit()  # no-op unless we alone are a quorum
+            return entry.index
 
     def _broadcast_appends(self):
         for p in self.peers:

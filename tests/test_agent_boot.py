@@ -1,0 +1,116 @@
+"""``consul-tpu agent`` end-to-end: boot from a config file in a real
+subprocess, drive it with the real CLI over HTTP, shut it down with
+SIGTERM (the external-binary harness layer of the reference,
+sdk/testutil/server.go:1-70 forking a consul binary with a JSON config
+and free ports)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from consul_tpu.agent import boot
+
+
+@pytest.fixture(scope="module")
+def booted(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("agent")
+    cfg = tmp / "agent.json"
+    cfg.write_text(json.dumps({
+        "node_name": "boot-1",
+        "n_servers": 3,
+        "data_dir": str(tmp / "data"),
+        "http": {"host": "127.0.0.1", "port": 0},
+    }))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "consul_tpu.cli", "agent",
+         "--config-file", str(cfg)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+    )
+    ready = json.loads(proc.stdout.readline())
+    assert ready["ready"] is True
+    yield proc, ready, env
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=15)
+
+
+def run_cli(env, port, *args):
+    return subprocess.run(
+        [sys.executable, "-m", "consul_tpu.cli",
+         "--http-addr", f"127.0.0.1:{port}", *args],
+        capture_output=True, text=True, env=env, timeout=30,
+    )
+
+
+class TestAgentBoot:
+    def test_ready_line_reports_shape(self, booted):
+        _, ready, _ = booted
+        assert ready["node"] == "boot-1"
+        assert ready["servers"] == 3
+        assert ready["http_port"] > 0
+
+    def test_kv_put_get_roundtrip(self, booted):
+        _, ready, env = booted
+        port = ready["http_port"]
+        assert run_cli(env, port, "kv", "put", "k", "v1").returncode == 0
+        out = run_cli(env, port, "kv", "get", "k")
+        assert out.returncode == 0 and out.stdout.strip() == "v1"
+
+    def test_members_shows_self_alive(self, booted):
+        _, ready, env = booted
+        out = run_cli(env, ready["http_port"], "members")
+        assert out.returncode == 0
+        assert "boot-1" in out.stdout and "alive" in out.stdout
+
+    def test_info_reports_leader_and_peers(self, booted):
+        _, ready, env = booted
+        out = run_cli(env, ready["http_port"], "info")
+        assert out.returncode == 0
+        assert "leader = srv" in out.stdout
+        assert "srv0, srv1, srv2" in out.stdout
+
+    def test_sigterm_clean_exit(self, tmp_path):
+        cfg = tmp_path / "a.json"
+        cfg.write_text(json.dumps({
+            "node_name": "short-lived", "n_servers": 1,
+            "http": {"host": "127.0.0.1", "port": 0},
+        }))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "consul_tpu.cli", "agent",
+             "--config-file", str(cfg)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        json.loads(proc.stdout.readline())
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=15) == 0
+
+
+class TestLoadConfig:
+    def test_defaults(self):
+        cfg = boot.load_config(None)
+        assert cfg["n_servers"] == 1 and cfg["server"] is True
+
+    def test_unknown_key_rejected(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text('{"node_nam": "typo"}')
+        with pytest.raises(ValueError, match="unknown agent config keys"):
+            boot.load_config(str(p))
+
+    def test_client_mode_rejected(self, tmp_path):
+        p = tmp_path / "client.json"
+        p.write_text('{"server": false}')
+        with pytest.raises(ValueError, match="not bootable standalone"):
+            boot.load_config(str(p))
+
+    def test_sim_section_validated(self, tmp_path):
+        p = tmp_path / "sim.json"
+        p.write_text('{"sim": {"gossip": {"not_a_knob": 3}}}')
+        with pytest.raises(ValueError, match="unknown config keys"):
+            boot.load_config(str(p))
