@@ -58,6 +58,7 @@ class Agent:
         self.checks = CheckRunner(self.local)
         self.cache = Cache()
         self.cluster_size = cluster_size
+        self._register_cache_types()
 
         self._next_sync = 0.0  # first tick syncs immediately
         self._next_coord = self.rng.uniform(
@@ -94,6 +95,38 @@ class Agent:
         # to config_loader.apply_safe on its Simulation; returns the
         # list of applied knob paths.
         self.reload_hook: Optional[Callable[[], list]] = None
+
+    def _register_cache_types(self):
+        """The typed cache entries this agent serves (reference
+        agent/cache-types/: health_services.go, catalog_services.go,
+        the coordinate reads) — each maps a request to a blocking RPC
+        fetcher; refresh keeps them warm in the background so any
+        number of readers cost the store one watch."""
+
+        def health_services(service: str, passing_only: bool = False):
+            def fetch(min_index: int, wait_s: float) -> dict:
+                return self.rpc(
+                    "Health.ServiceNodes", service=service,
+                    passing_only=passing_only,
+                    min_index=min_index, wait_s=wait_s,
+                )
+            return fetch
+
+        def catalog_services():
+            def fetch(min_index: int, wait_s: float) -> dict:
+                return self.rpc("Catalog.ListServices",
+                                min_index=min_index, wait_s=wait_s)
+            return fetch
+
+        def coordinate_nodes():
+            def fetch(min_index: int, wait_s: float) -> dict:
+                return self.rpc("Coordinate.ListNodes",
+                                min_index=min_index, wait_s=wait_s)
+            return fetch
+
+        self.cache.register_type("health-services", health_services)
+        self.cache.register_type("catalog-services", catalog_services)
+        self.cache.register_type("coordinate-nodes", coordinate_nodes)
 
     def reload(self) -> Optional[list]:
         """Re-read config sources and apply the safe subset; None when

@@ -1,22 +1,41 @@
-"""Agent RPC cache: TTL expiry + background blocking refresh.
+"""Agent RPC cache: typed entries, TTL expiry, background blocking
+refresh, and shared blocking reads.
 
 Mirrors the reference agent cache (reference agent/cache/cache.go,
 1511 LoC): typed entries keyed by request, fetched through a registered
 type, served from memory with a TTL, and — for refresh-typed entries —
 kept warm by a background goroutine running blocking queries so reads
 are always fresh-ish and cheap. DNS/HTTP/proxycfg all read through it
-(reference agent/cache-types/).
+(reference agent/cache-types/, e.g. health_services.go).
 
-Here fetchers are callables returning ``{"index": i, "value": v}`` (the
+The scalability trick being reproduced (reference cache.go Get with
+MinIndex + the refresh goroutine): N HTTP long-pollers of the same
+request do NOT open N store watches — they all park on the one cache
+entry, which a SINGLE background blocking query keeps current; every
+index advance wakes all parked watchers at once. ``get_blocking`` is
+that path; the per-entry fetch counter is what tests assert on.
+
+Fetchers are callables returning ``{"index": i, "value": v}`` (the
 blocking-read convention of the endpoint layer); refresh runs on
 daemon threads issuing blocking queries with the last seen index.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 import time
-from typing import Any, Callable, Optional
+from typing import Any, Callable, NamedTuple, Optional
+
+
+class CacheType(NamedTuple):
+    """A registered entry type (reference agent/cache-types/*): how to
+    fetch this kind of request and its freshness policy."""
+
+    name: str
+    fetch_factory: Callable[..., Callable[[int, float], dict]]
+    ttl_s: float
+    refresh: bool
 
 
 class CacheEntry:
@@ -25,6 +44,8 @@ class CacheEntry:
         self.index = index
         self.expires_at = expires_at
         self.hits = 0
+        self.fetches = 0  # store round-trips made on behalf of this key
+        self.changed = threading.Condition()
 
 
 class Cache:
@@ -32,8 +53,51 @@ class Cache:
         self._lock = threading.Lock()
         self._entries: dict[str, CacheEntry] = {}
         self._refreshing: set[str] = set()
+        self._types: dict[str, CacheType] = {}
         self.metrics = {"hits": 0, "misses": 0, "fetches": 0}
         self._stop = threading.Event()
+
+    # -- typed entries (reference cache.go RegisterType + cache-types/) --
+    def register_type(self, name: str, fetch_factory, ttl_s: float = 3.0,
+                      refresh: bool = True) -> None:
+        """``fetch_factory(**req)`` returns the fetcher for one concrete
+        request of this type — e.g. the health-services type maps
+        ``service="web"`` to a blocking Health.ServiceNodes call
+        (reference agent/cache-types/health_services.go)."""
+        self._types[name] = CacheType(name, fetch_factory, ttl_s, refresh)
+
+    @staticmethod
+    def _key(name: str, req: dict) -> str:
+        return name + ":" + json.dumps(req, sort_keys=True, default=str)
+
+    def get_typed(self, name: str, now: Optional[float] = None, **req):
+        t = self._types[name]
+        return self.get(self._key(name, req), t.fetch_factory(**req),
+                        ttl_s=t.ttl_s, refresh=t.refresh, now=now)
+
+    def get_blocking(self, name: str, min_index: int = 0,
+                     wait_s: float = 10.0, **req) -> dict:
+        """Blocking read THROUGH the cache: park until the entry's index
+        passes ``min_index`` (or timeout), without opening a per-caller
+        store watch — all callers of the same request share the one
+        background refresh query. Returns ``{"index", "value"}``."""
+        t = self._types[name]
+        key = self._key(name, req)
+        with self._lock:
+            hit = key in self._entries
+        # Ensure the entry + its refresh loop exist (first caller pays
+        # the initial fetch; everyone after rides the warm entry).
+        self.get(key, t.fetch_factory(**req), ttl_s=t.ttl_s, refresh=True)
+        deadline = time.monotonic() + wait_s
+        with self._lock:
+            e = self._entries[key]
+        with e.changed:
+            while e.index <= min_index and min_index > 0:
+                left = deadline - time.monotonic()
+                if left <= 0 or self._stop.is_set():
+                    break
+                e.changed.wait(timeout=min(left, 1.0))
+            return {"index": e.index, "value": e.value, "hit": hit}
 
     def get(self, key: str, fetch: Callable[[int, float], dict],
             ttl_s: float = 3.0, refresh: bool = False,
@@ -45,7 +109,14 @@ class Cache:
         now = time.monotonic() if now is None else now
         with self._lock:
             e = self._entries.get(key)
-            if e is not None and now < e.expires_at:
+            # Refresh-typed entries never TTL-expire (reference cache.go
+            # exempts refresh types): the background loop IS their
+            # freshness, and its blocking re-arm (5 s) outlasts short
+            # TTLs — expiring mid-re-arm would hand every concurrent
+            # caller its own synchronous store fetch, exactly the load
+            # the cache exists to absorb.
+            if e is not None and (now < e.expires_at
+                                  or key in self._refreshing):
                 e.hits += 1
                 self.metrics["hits"] += 1
                 return e.value
@@ -53,11 +124,18 @@ class Cache:
         out = fetch(0, 0.0)
         with self._lock:
             self.metrics["fetches"] += 1
-            self._entries[key] = CacheEntry(out["value"], out["index"],
-                                            now + ttl_s)
+            e = self._entries.get(key)
+            if e is None:
+                e = self._entries[key] = CacheEntry(
+                    out["value"], out["index"], now + ttl_s)
+            e.fetches += 1
             start_refresh = refresh and key not in self._refreshing
             if start_refresh:
                 self._refreshing.add(key)
+        # Update in place + notify: parked get_blocking watchers hold a
+        # reference to THIS entry's condition — replacing the object
+        # would orphan them.
+        self._store(e, out, ttl_s)
         if start_refresh:
             t = threading.Thread(
                 target=self._refresh_loop, args=(key, fetch, ttl_s),
@@ -65,6 +143,14 @@ class Cache:
             )
             t.start()
         return out["value"]
+
+    @staticmethod
+    def _store(e: CacheEntry, out: dict, ttl_s: float):
+        with e.changed:
+            e.value = out["value"]
+            e.index = out["index"]
+            e.expires_at = time.monotonic() + ttl_s
+            e.changed.notify_all()
 
     def _refresh_loop(self, key: str, fetch, ttl_s: float):
         """Background blocking-query loop (reference cache.go
@@ -85,10 +171,20 @@ class Cache:
                 continue
             with self._lock:
                 cur = self._entries.get(key)
+                self.metrics["fetches"] += 1
                 if cur is not None:
-                    cur.value = out["value"]
-                    cur.index = out["index"]
-                    cur.expires_at = time.monotonic() + ttl_s
+                    cur.fetches += 1
+            if cur is not None:
+                # In-place + notify — wakes every parked watcher of
+                # this entry at once (the N-watchers-one-watch shape).
+                self._store(cur, out, ttl_s)
+
+    def fetch_count(self, name: str, **req) -> int:
+        """Store round-trips made for one typed request — the number
+        tests pin to prove N watchers share one watch."""
+        with self._lock:
+            e = self._entries.get(self._key(name, req))
+            return 0 if e is None else e.fetches
 
     def invalidate(self, key: str):
         with self._lock:

@@ -142,8 +142,53 @@ class HTTPApi:
                             check_id=req.get("CheckID"))
             return 200, True, {}
 
+        # ---- config entries (reference agent/config_endpoint.go) ------
+        if parts == ["config"] and method == "PUT":
+            req = json.loads(body)
+            kind, name = req.pop("Kind"), req.pop("Name")
+            cas = int(q["cas"]) if "cas" in q else None
+            idx, ok = self._rpc_write(
+                "ConfigEntry.Apply", kind=kind, name=name, entry=req,
+                cas_index=cas)
+            return 200, bool(ok), {"X-Consul-Index": str(idx)}
+        if len(parts) == 2 and parts[0] == "config" and method == "GET":
+            out = rpc("ConfigEntry.List", kind=parts[1],
+                      min_index=min_index, wait_s=wait_s)
+            return 200, [_config_to_api(e) for e in out["value"]], {
+                "X-Consul-Index": str(out["index"])}
+        if len(parts) == 3 and parts[0] == "config" and method == "GET":
+            out = rpc("ConfigEntry.Get", kind=parts[1], name=parts[2],
+                      min_index=min_index, wait_s=wait_s)
+            if out["value"] is None:
+                return 404, {"error": "config entry not found"}, {
+                    "X-Consul-Index": str(out["index"])}
+            return 200, _config_to_api(out["value"]), {
+                "X-Consul-Index": str(out["index"])}
+        if len(parts) == 3 and parts[0] == "config" and method == "DELETE":
+            cas = int(q["cas"]) if "cas" in q else None
+            idx, ok = self._rpc_write(
+                "ConfigEntry.Delete", kind=parts[1], name=parts[2],
+                cas_index=cas)
+            return 200, bool(ok), {"X-Consul-Index": str(idx)}
+
         # ---- health ---------------------------------------------------
         if len(parts) == 3 and parts[:2] == ["health", "service"]:
+            # near= needs a per-request RTT sort the shared cache entry
+            # cannot hold — fall through to the direct path rather than
+            # silently returning unsorted results.
+            if "cached" in q and not near:
+                # Serve through the agent cache's typed entry: any
+                # number of ?cached long-pollers share ONE background
+                # store watch (reference HTTP ?cached + agent/cache
+                # health-services type, cache.go Get MinIndex path).
+                out = self.agent.cache.get_blocking(
+                    "health-services", min_index=min_index, wait_s=wait_s,
+                    service=parts[2], passing_only="passing" in q,
+                )
+                return 200, out["value"], {
+                    "X-Consul-Index": str(out["index"]),
+                    "X-Cache": "HIT" if out["hit"] else "MISS",
+                }
             out = rpc("Health.ServiceNodes", service=parts[2],
                       passing_only="passing" in q, min_index=min_index,
                       wait_s=wait_s, near=near)
@@ -187,6 +232,14 @@ class HTTPApi:
 
         # ---- coordinates ----------------------------------------------
         if parts == ["coordinate", "nodes"]:
+            if "cached" in q:
+                out = self.agent.cache.get_blocking(
+                    "coordinate-nodes", min_index=min_index, wait_s=wait_s,
+                )
+                return 200, out["value"], {
+                    "X-Consul-Index": str(out["index"]),
+                    "X-Cache": "HIT" if out["hit"] else "MISS",
+                }
             out = rpc("Coordinate.ListNodes", min_index=min_index,
                       wait_s=wait_s)
             return 200, out["value"], {"X-Consul-Index": str(out["index"])}
@@ -412,6 +465,18 @@ def _kv_to_api(row: dict) -> dict:
         "Session": row.get("session"),
         "CreateIndex": row.get("create_index", row.get("modify_index", 0)),
         "ModifyIndex": row.get("modify_index", 0),
+    }
+
+
+def _config_to_api(meta: dict) -> dict:
+    """Store meta row -> API shape (reference config entries marshal
+    Kind/Name at the top level beside the entry's own fields)."""
+    return {
+        "Kind": meta["kind"],
+        "Name": meta["name"],
+        **meta["entry"],
+        "CreateIndex": meta["create_index"],
+        "ModifyIndex": meta["modify_index"],
     }
 
 

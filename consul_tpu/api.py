@@ -50,6 +50,7 @@ class Client:
         self.status = Status(self)
         self.agent = AgentAPI(self)
         self.operator = Operator(self)
+        self.config = ConfigEntries(self)
 
     def _call(self, method: str, path: str, params: Optional[dict] = None,
               body: Optional[bytes] = None) -> tuple[Any, QueryMeta, int]:
@@ -284,6 +285,43 @@ class AgentAPI:
         return bool(out)
 
 
+class ConfigEntries:
+    """Config-entry endpoints (reference api/config_entry.go:
+    ConfigEntries.Set/CAS/Get/List/Delete over /v1/config)."""
+
+    def __init__(self, c: Client):
+        self.c = c
+
+    def set(self, kind: str, name: str, entry: dict,
+            cas: Optional[int] = None) -> bool:
+        body = {"Kind": kind, "Name": name, **entry}
+        out, _, _ = self.c._call(
+            "PUT", "/v1/config",
+            {"cas": cas if cas is not None else None},
+            json.dumps(body).encode())
+        return bool(out)
+
+    def get(self, kind: str, name: str, index: int = 0,
+            wait: str = "10s"):
+        out, meta, status = self.c._call(
+            "GET", f"/v1/config/{kind}/{name}",
+            {"index": index or None, "wait": wait if index else None})
+        return (None if status == 404 else out), meta
+
+    def list(self, kind: str = "*", index: int = 0, wait: str = "10s"):
+        out, meta, _ = self.c._call(
+            "GET", f"/v1/config/{kind}",
+            {"index": index or None, "wait": wait if index else None})
+        return out, meta
+
+    def delete(self, kind: str, name: str,
+               cas: Optional[int] = None) -> bool:
+        out, _, _ = self.c._call(
+            "DELETE", f"/v1/config/{kind}/{name}",
+            {"cas": cas if cas is not None else None})
+        return bool(out)
+
+
 class Operator:
     """Operator endpoints (reference api/operator_keyring.go)."""
 
@@ -392,6 +430,18 @@ class WatchPlan:
             out, meta, _ = c._call("GET", "/v1/catalog/nodes", idx)
             return meta.index, out
         if self.type == "service":
+            # cached=True rides the agent cache's typed health-services
+            # entry (?cached): N watch plans of one service share a
+            # single agent-side store watch (reference serviceWatch hits
+            # /v1/health/service, funcs.go:18-30 + HTTP ?cached). NOTE:
+            # the cached result is HEALTH-shaped rows (node + service +
+            # checks), not catalog rows; a tag filter has no cached
+            # entry, so it falls back to the direct catalog path.
+            if p.get("cached") and not p.get("tag"):
+                out, meta, _ = c._call(
+                    "GET", f"/v1/health/service/{p['service']}",
+                    {"cached": "", **idx})
+                return meta.index, out
             out, meta, _ = c._call(
                 "GET", f"/v1/catalog/service/{p['service']}",
                 {"tag": p.get("tag"), **idx})

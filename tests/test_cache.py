@@ -1,0 +1,133 @@
+"""Agent cache: typed entries, background refresh, and the
+N-watchers-one-watch contract (reference agent/cache/cache.go Get with
+MinIndex + refresh goroutine; agent/cache-types/health_services.go).
+The store here is a fake with a condition variable so tests control
+exactly when the watched index advances — and count every store
+round-trip."""
+
+import threading
+import time
+
+from consul_tpu.agent.cache import Cache
+
+
+class FakeStore:
+    """A blocking-read source that counts its watches."""
+
+    def __init__(self):
+        self.index = 1
+        self.value = "v1"
+        self.cond = threading.Condition()
+        self.fetches = 0
+        self.blocking_waits = 0
+
+    def set(self, value):
+        with self.cond:
+            self.index += 1
+            self.value = value
+            self.cond.notify_all()
+
+    def fetcher(self, **_req):
+        def fetch(min_index, wait_s):
+            with self.cond:
+                self.fetches += 1
+                if min_index:
+                    self.blocking_waits += 1
+                    deadline = time.monotonic() + wait_s
+                    while self.index <= min_index:
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            break
+                        self.cond.wait(left)
+                return {"index": self.index, "value": self.value}
+        return fetch
+
+
+def wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestTypedEntries:
+    def test_get_typed_serves_and_caches(self):
+        store, cache = FakeStore(), Cache()
+        cache.register_type("t", store.fetcher, ttl_s=30.0, refresh=False)
+        assert cache.get_typed("t", q=1) == "v1"
+        assert cache.get_typed("t", q=1) == "v1"
+        assert store.fetches == 1  # second read was a cache hit
+        cache.close()
+
+    def test_distinct_requests_distinct_entries(self):
+        store, cache = FakeStore(), Cache()
+        cache.register_type("t", store.fetcher, ttl_s=30.0, refresh=False)
+        cache.get_typed("t", q=1)
+        cache.get_typed("t", q=2)
+        assert store.fetches == 2
+        cache.close()
+
+    def test_refresh_keeps_entry_current(self):
+        store, cache = FakeStore(), Cache()
+        cache.register_type("t", store.fetcher, ttl_s=30.0, refresh=True)
+        assert cache.get_typed("t") == "v1"
+        store.set("v2")
+        assert wait_for(lambda: cache.get_typed("t") == "v2")
+        cache.close()
+
+
+class TestSharedBlocking:
+    def test_n_watchers_share_one_store_watch(self):
+        """The headline contract: 8 blocked readers of the same request
+        cost the store ONE blocking watch, and all wake on the change."""
+        store, cache = FakeStore(), Cache()
+        cache.register_type("t", store.fetcher, ttl_s=30.0, refresh=True)
+        first = cache.get_blocking("t", min_index=0, wait_s=1.0)
+        assert first == {"index": 1, "value": "v1", "hit": False}
+
+        results = []
+        threads = [
+            threading.Thread(
+                target=lambda: results.append(
+                    cache.get_blocking("t", min_index=1, wait_s=5.0)))
+            for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        # All 8 are parked on the cache entry; the store sees only the
+        # single background refresh loop waiting.
+        assert wait_for(lambda: store.blocking_waits >= 1)
+        time.sleep(0.1)
+        watches_before = store.blocking_waits
+        store.set("v2")
+        for t in threads:
+            t.join(timeout=5.0)
+        assert len(results) == 8
+        assert all(r == {"index": 2, "value": "v2", "hit": True}
+                   for r in results)
+        # The store served the change through at most the refresh
+        # loop's own re-arms — not one watch per reader.
+        assert store.blocking_waits <= watches_before + 1 < 8
+        assert cache.fetch_count("t") < 8
+        cache.close()
+
+    def test_blocking_returns_immediately_when_index_passed(self):
+        store, cache = FakeStore(), Cache()
+        cache.register_type("t", store.fetcher, ttl_s=30.0, refresh=True)
+        cache.get_blocking("t", min_index=0, wait_s=1.0)
+        store.set("v2")
+        assert wait_for(lambda: cache.fetch_count("t") >= 2)
+        t0 = time.monotonic()
+        out = cache.get_blocking("t", min_index=1, wait_s=5.0)
+        assert time.monotonic() - t0 < 1.0
+        assert out["index"] == 2
+        cache.close()
+
+    def test_blocking_times_out_with_current_value(self):
+        store, cache = FakeStore(), Cache()
+        cache.register_type("t", store.fetcher, ttl_s=30.0, refresh=True)
+        out = cache.get_blocking("t", min_index=1, wait_s=0.3)
+        assert out == {"index": 1, "value": "v1", "hit": False}
+        cache.close()

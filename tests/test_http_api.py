@@ -472,3 +472,106 @@ class TestReload:
             assert len(calls) == 2
         finally:
             agent.reload_hook = None
+
+
+class TestCachedReads:
+    """?cached routes through the agent cache's typed entries
+    (reference HTTP ?cached + agent/cache-types/health_services.go):
+    concurrent long-pollers share one agent-side store watch."""
+
+    def test_cached_health_service_blocking_pollers_share_watch(self, stack):
+        cluster, agent, client, port = stack
+        client.catalog.register("cweb-1", "10.0.9.1",
+                                service={"id": "cweb", "service": "cweb",
+                                         "port": 80})
+        out, meta, status = client._call(
+            "GET", "/v1/health/service/cweb", {"cached": ""})
+        assert status == 200
+        idx = meta.index
+        assert [n["node"] for n in out] == ["cweb-1"]
+
+        results = []
+
+        def poll():
+            o, m, _ = client._call(
+                "GET", "/v1/health/service/cweb",
+                {"cached": "", "index": idx, "wait": "5s"})
+            results.append((m.index, [n["node"] for n in o]))
+
+        threads = [threading.Thread(target=poll) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        client.catalog.register("cweb-2", "10.0.9.2",
+                                service={"id": "cweb", "service": "cweb",
+                                         "port": 80})
+        for t in threads:
+            t.join(timeout=10.0)
+        assert len(results) == 4
+        assert all(set(nodes) == {"cweb-1", "cweb-2"} for _, nodes in results)
+        # 4 pollers, but the store-facing fetch count stayed at the
+        # refresh loop's own cadence — not one watch per poller.
+        assert agent.cache.fetch_count(
+            "health-services", service="cweb", passing_only=False) <= 3
+
+    def test_watchplan_cached_service(self, stack):
+        cluster, agent, client, port = stack
+        from consul_tpu import api as api_mod
+
+        client.catalog.register("wsvc-1", "10.0.9.5",
+                                service={"id": "wsvc", "service": "wsvc",
+                                         "port": 1})
+        fired = []
+        plan = api_mod.watch(client, "service",
+                             lambda i, r: fired.append((i, r)),
+                             service="wsvc", cached=True)
+        assert plan.run_once() is True
+        assert [n["node"] for n in fired[-1][1]] == ["wsvc-1"]
+
+
+class TestConfigHTTP:
+    """/v1/config surface + api client + CLI (reference
+    agent/config_endpoint.go, api/config_entry.go, command/config)."""
+
+    def test_set_get_list_delete(self, stack):
+        _, _, client, _ = stack
+        assert client.config.set("service-defaults", "chttp",
+                                 {"protocol": "http"})
+        entry, meta = client.config.get("service-defaults", "chttp")
+        assert entry["Kind"] == "service-defaults"
+        assert entry["Name"] == "chttp"
+        assert entry["protocol"] == "http"
+        assert entry["ModifyIndex"] == meta.index
+        entries, _ = client.config.list("service-defaults")
+        assert "chttp" in [e["Name"] for e in entries]
+        assert client.config.delete("service-defaults", "chttp")
+        entry, _ = client.config.get("service-defaults", "chttp")
+        assert entry is None
+
+    def test_cas_verdict_over_http(self, stack):
+        _, _, client, _ = stack
+        assert client.config.set("k2", "n", {"v": 1}, cas=0)
+        assert client.config.set("k2", "n", {"v": 2}, cas=0) is False
+        entry, _ = client.config.get("k2", "n")
+        assert entry["v"] == 1
+        assert client.config.set("k2", "n", {"v": 3},
+                                 cas=entry["ModifyIndex"])
+
+    def test_cli_config_roundtrip(self, stack, tmp_path):
+        _, _, client, port = stack
+        f = tmp_path / "entry.json"
+        f.write_text(json.dumps({"Kind": "proxy-defaults", "Name": "global",
+                                 "config": {"mode": "direct"}}))
+        argv = ["--http-addr", f"127.0.0.1:{port}"]
+        assert cli_main(argv + ["config", "write", str(f)]) == 0
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert cli_main(argv + ["config", "read", "-kind",
+                                    "proxy-defaults", "-name", "global"]) == 0
+        assert json.loads(buf.getvalue())["config"] == {"mode": "direct"}
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert cli_main(argv + ["config", "list"]) == 0
+        assert "proxy-defaults/global" in buf.getvalue()
+        assert cli_main(argv + ["config", "delete", "-kind",
+                                "proxy-defaults", "-name", "global"]) == 0
