@@ -354,6 +354,42 @@ class Server:
         return self._raft_apply({"type": fsm_mod.TXN, "ops": ops})
 
     # ------------------------------------------------------------------
+    # ConfigEntry endpoint (reference agent/consul/config_endpoint.go:
+    # Apply w/ optional CAS, Get, List, Delete — blocking reads over the
+    # config_entries table)
+    # ------------------------------------------------------------------
+    def _configentry_apply(self, kind: str, name: str, entry: dict,
+                           cas_index: Optional[int] = None) -> int:
+        cmd = {"type": fsm_mod.CONFIG_ENTRY, "kind": kind, "name": name,
+               "entry": entry,
+               "op": "set" if cas_index is None else "cas"}
+        if cas_index is not None:
+            cmd["cas_index"] = cas_index
+        return self._raft_apply(cmd)
+
+    def _configentry_delete(self, kind: str, name: str,
+                            cas_index: Optional[int] = None) -> int:
+        cmd = {"type": fsm_mod.CONFIG_ENTRY, "kind": kind, "name": name,
+               "op": "delete" if cas_index is None else "delete-cas"}
+        if cas_index is not None:
+            cmd["cas_index"] = cas_index
+        return self._raft_apply(cmd)
+
+    def _configentry_get(self, kind: str, name: str, min_index: int = 0,
+                         wait_s: float = 10.0) -> dict:
+        return self._blocking(
+            ["config_entries"], min_index, wait_s,
+            lambda: self.store.config_get_meta(kind, name),
+        )
+
+    def _configentry_list(self, kind: str = "*", min_index: int = 0,
+                          wait_s: float = 10.0) -> dict:
+        return self._blocking(
+            ["config_entries"], min_index, wait_s,
+            lambda: self.store.config_list_meta(kind),
+        )
+
+    # ------------------------------------------------------------------
     # Coordinate endpoint (reference agent/consul/coordinate_endpoint.go)
     # ------------------------------------------------------------------
     def _coordinate_update(self, node: str, coord: dict,

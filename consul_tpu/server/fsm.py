@@ -110,11 +110,19 @@ class FSM:
             return self.store.coordinate_batch_update(command["updates"],
                                                       index=index)
         if mtype == CONFIG_ENTRY:
-            if command.get("op") == "delete":
-                return self.store.config_delete(command["kind"],
-                                                command["name"], index=index)
-            return self.store.config_set(command["kind"], command["name"],
-                                         command["entry"], index=index)
+            # Ops mirror reference ConfigEntryRequest (structs/config_
+            # entry.go: Upsert/UpsertCAS/Delete[CAS]); CAS evaluates
+            # deterministically at apply time and returns the verdict.
+            cas = command.get("cas_index")
+            if command.get("op") in ("delete", "delete-cas"):
+                _, ok = self.store.config_delete(
+                    command["kind"], command["name"],
+                    cas_index=cas, index=index)
+                return ok
+            _, ok = self.store.config_set(
+                command["kind"], command["name"], command["entry"],
+                cas_index=cas, index=index)
+            return ok
         if mtype == TXN:
             # All-or-nothing batch (reference agent/consul/txn_endpoint.go)
             # applied inside one store transaction: the store lock is

@@ -469,25 +469,62 @@ class StateStore:
     # Config entries (reference state/config_entry.go)
     # ------------------------------------------------------------------
     def config_set(self, kind: str, name: str, entry: dict,
-                   index: Optional[int] = None) -> int:
-        return self._commit("config_entries", f"{kind}/{name}", entry,
-                            index=index)
+                   cas_index: Optional[int] = None,
+                   index: Optional[int] = None) -> tuple[int, bool]:
+        """Upsert, optionally check-and-set on the entry's modify index
+        (reference EnsureConfigEntryCAS, state/config_entry.go; 0 =
+        only-if-absent). CAS failure does not bump the index."""
+        with self._lock:
+            if cas_index is not None:
+                e = self.tables["config_entries"].rows.get(f"{kind}/{name}")
+                if (e.modify_index if e else 0) != cas_index:
+                    return self.index, False
+            return self._commit("config_entries", f"{kind}/{name}", entry,
+                                index=index), True
 
     def config_delete(self, kind: str, name: str,
-                      index: Optional[int] = None) -> int:
-        return self._commit("config_entries", f"{kind}/{name}", None,
-                            delete=True, index=index)
+                      cas_index: Optional[int] = None,
+                      index: Optional[int] = None) -> tuple[int, bool]:
+        with self._lock:
+            if cas_index is not None:
+                e = self.tables["config_entries"].rows.get(f"{kind}/{name}")
+                if (e.modify_index if e else 0) != cas_index:
+                    return self.index, False
+            return self._commit("config_entries", f"{kind}/{name}", None,
+                                delete=True, index=index), True
 
     def config_get(self, kind: str, name: str) -> Optional[dict]:
         with self._lock:
             e = self.tables["config_entries"].rows.get(f"{kind}/{name}")
             return None if e is None else e.value
 
+    def config_get_meta(self, kind: str, name: str) -> Optional[dict]:
+        """Entry plus its raft indexes — what the ConfigEntry endpoints
+        return so clients can CAS (reference structs RaftIndex)."""
+        with self._lock:
+            e = self.tables["config_entries"].rows.get(f"{kind}/{name}")
+            if e is None:
+                return None
+            return {"kind": kind, "name": name, "entry": e.value,
+                    "create_index": e.create_index,
+                    "modify_index": e.modify_index}
+
     def config_list(self, kind: str = "*") -> list[tuple[str, dict]]:
         with self._lock:
             return [(k, e.value) for k, e in
                     sorted(self.tables["config_entries"].rows.items())
                     if fnmatch.fnmatch(k.split("/", 1)[0], kind)]
+
+    def config_list_meta(self, kind: str = "*") -> list[dict]:
+        with self._lock:
+            return [
+                {"kind": k.split("/", 1)[0], "name": k.split("/", 1)[1],
+                 "entry": e.value, "create_index": e.create_index,
+                 "modify_index": e.modify_index}
+                for k, e in
+                sorted(self.tables["config_entries"].rows.items())
+                if fnmatch.fnmatch(k.split("/", 1)[0], kind)
+            ]
 
     # ------------------------------------------------------------------
     # Snapshot / restore (reference fsm/snapshot*.go persists every

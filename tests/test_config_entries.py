@@ -1,0 +1,106 @@
+"""ConfigEntry RPC surface over the existing store table (reference
+agent/consul/config_endpoint.go: Apply w/ CAS, Get, List, Delete;
+agent/config_endpoint.go HTTP routes): raft-replicated writes,
+blocking reads, CAS verdicts from the FSM."""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu.server.endpoints import ServerCluster
+
+
+@pytest.fixture
+def cluster():
+    c = ServerCluster(3, seed=7)
+    c.wait_converged()
+    return c
+
+
+PROXY_DEFAULTS = {"config": {"protocol": "http"}}
+
+
+class TestConfigEntryRPC:
+    def test_apply_get_roundtrip(self, cluster):
+        led = cluster.leader_server()
+        cluster.write(led, "ConfigEntry.Apply", kind="proxy-defaults",
+                      name="global", entry=PROXY_DEFAULTS)
+        out = led.rpc("ConfigEntry.Get", kind="proxy-defaults",
+                      name="global")
+        assert out["value"]["entry"] == PROXY_DEFAULTS
+        assert out["value"]["modify_index"] > 0
+        # Replicated: a follower serves the same read.
+        fol = cluster.any_follower()
+        assert fol.rpc("ConfigEntry.Get", kind="proxy-defaults",
+                       name="global")["value"]["entry"] == PROXY_DEFAULTS
+
+    def test_get_absent_is_none(self, cluster):
+        led = cluster.leader_server()
+        assert led.rpc("ConfigEntry.Get", kind="proxy-defaults",
+                       name="nope")["value"] is None
+
+    def test_list_filters_by_kind(self, cluster):
+        led = cluster.leader_server()
+        cluster.write(led, "ConfigEntry.Apply", kind="proxy-defaults",
+                      name="global", entry=PROXY_DEFAULTS)
+        cluster.write(led, "ConfigEntry.Apply", kind="service-defaults",
+                      name="web", entry={"protocol": "grpc"})
+        cluster.write(led, "ConfigEntry.Apply", kind="service-defaults",
+                      name="db", entry={"protocol": "tcp"})
+        all_out = led.rpc("ConfigEntry.List")["value"]
+        assert [(e["kind"], e["name"]) for e in all_out] == [
+            ("proxy-defaults", "global"), ("service-defaults", "db"),
+            ("service-defaults", "web")]
+        svc = led.rpc("ConfigEntry.List", kind="service-defaults")["value"]
+        assert {e["name"] for e in svc} == {"web", "db"}
+
+    def test_cas_set_semantics(self, cluster):
+        led = cluster.leader_server()
+        # cas=0: only-if-absent — first wins, second loses.
+        idx = cluster.write(led, "ConfigEntry.Apply", kind="k", name="n",
+                            entry={"v": 1}, cas_index=0)
+        verdict = led.rpc("Status.ApplyResult", index=idx)
+        assert verdict == {"found": True, "result": True}
+        idx2 = cluster.write(led, "ConfigEntry.Apply", kind="k", name="n",
+                             entry={"v": 2}, cas_index=0)
+        assert led.rpc("Status.ApplyResult",
+                       index=idx2)["result"] is False
+        assert led.store.config_get("k", "n") == {"v": 1}
+        # cas at the current modify index wins.
+        cur = led.store.config_get_meta("k", "n")["modify_index"]
+        idx3 = cluster.write(led, "ConfigEntry.Apply", kind="k", name="n",
+                             entry={"v": 3}, cas_index=cur)
+        assert led.rpc("Status.ApplyResult", index=idx3)["result"] is True
+        assert led.store.config_get("k", "n") == {"v": 3}
+
+    def test_delete_and_cas_delete(self, cluster):
+        led = cluster.leader_server()
+        cluster.write(led, "ConfigEntry.Apply", kind="k", name="n",
+                      entry={"v": 1})
+        idx = cluster.write(led, "ConfigEntry.Delete", kind="k", name="n",
+                            cas_index=99999)  # wrong index: refused
+        assert led.rpc("Status.ApplyResult", index=idx)["result"] is False
+        assert led.store.config_get("k", "n") is not None
+        cluster.write(led, "ConfigEntry.Delete", kind="k", name="n")
+        assert led.store.config_get("k", "n") is None
+
+    def test_blocking_list_wakes_on_write(self, cluster):
+        led = cluster.leader_server()
+        cluster.write(led, "ConfigEntry.Apply", kind="k", name="a",
+                      entry={"v": 1})
+        idx = led.rpc("ConfigEntry.List")["index"]
+        got = {}
+
+        def block():
+            got["out"] = led.rpc("ConfigEntry.List", min_index=idx,
+                                 wait_s=5.0)
+
+        th = threading.Thread(target=block)
+        th.start()
+        time.sleep(0.1)
+        cluster.write(led, "ConfigEntry.Apply", kind="k", name="b",
+                      entry={"v": 2})
+        th.join(timeout=5.0)
+        assert {e["name"] for e in got["out"]["value"]} == {"a", "b"}
+        assert got["out"]["index"] > idx
