@@ -179,12 +179,12 @@ def child(platform: str, deadline: float):
     finally:
         sim = None  # free the headline sim before the serf build below
 
+    from consul_tpu.models.cluster import SerfSimulation
+
     # Full-stack serf throughput: the SWIM plane PLUS the user-event/
     # query plane (models/serf.py) with a live epidemic in flight.
     try:
         if left() > 120:
-            from consul_tpu.models.cluster import SerfSimulation
-
             ssim = build(n, cls=SerfSimulation)
             ssim.run(chunk, chunk=chunk, with_metrics=False)
             ssim.user_event(jnp.arange(n) < 8, 1)
@@ -203,6 +203,31 @@ def child(platform: str, deadline: float):
 
     # Scaling sweep: throughput at each shape, each its own try/except,
     # each gated on remaining deadline (SURVEY §7 phases 4-5 shapes).
+    def northstar(sim, s, rps, phase_name):
+        """The 1M mass-kill convergence attempt (BASELINE.json): warm
+        the metrics-on runner OUTSIDE the timed region, bound the run
+        by the measured rate (``rps``) and remaining deadline so a
+        marginal backend emits a (failed) result, never a SIGKILL."""
+        sim.run(chunk, chunk=chunk, with_metrics=True)
+        sim.kill(jnp.arange(s) < int(s * kill_frac))
+        budget_ticks = int(rps * max(left() - 90, 60))
+        max_ticks = max(chunk, min(4096, budget_ticks))
+        t0_ns = time.monotonic()
+        converged, ticks_used, _ = sim.run_until_converged(
+            max_ticks=max_ticks, chunk=chunk)
+        wall = time.monotonic() - t0_ns
+        _emit({
+            "phase": phase_name,
+            "n": s,
+            "converged": bool(converged),
+            "kill_frac": kill_frac,
+            "wall_s": round(wall, 2),
+            "ticks": int(ticks_used),
+            "max_ticks": int(max_ticks),
+            "target_wall_s": 60.0,
+            "met": bool(converged) and wall < 60.0,
+        })
+
     sweep_env = os.environ.get("BENCH_SWEEP", "")
     for s in [int(x) for x in sweep_env.split(",") if x.strip()]:
         if left() < 120:
@@ -230,34 +255,34 @@ def child(platform: str, deadline: float):
             # there within the remaining deadline (a CPU backend at
             # ~0.03 rounds/s skips; a TPU window records it).
             if s >= 1_000_000 and rps * min(left() - 120, 600) > 512:
-                # Warm the metrics-on runner BEFORE the timed region
-                # (it is a different compiled program than the sweep's
-                # metrics-off one; its 1M-shape compile must not count
-                # against the 60 s target).
-                ssim.run(chunk, chunk=chunk, with_metrics=True)
-                n_kill = int(s * kill_frac)
-                ssim.kill(jnp.arange(s) < n_kill)
-                # Bound the attempt by the measured rate and remaining
-                # deadline so a marginal backend still emits a (failed)
-                # result instead of being SIGKILLed mid-run.
-                budget_ticks = int(rps * max(left() - 90, 60))
-                max_ticks = max(chunk, min(4096, budget_ticks))
-                t2 = time.monotonic()
-                converged, ticks_used, _ = ssim.run_until_converged(
-                    max_ticks=max_ticks, chunk=chunk)
-                wall = time.monotonic() - t2
-                _emit({
-                    "phase": "northstar",
-                    "n": s,
-                    "converged": bool(converged),
-                    "kill_frac": kill_frac,
-                    "wall_s": round(wall, 2),
-                    "ticks": int(ticks_used),
-                    "max_ticks": int(max_ticks),
-                    "target_wall_s": 60.0,
-                    "met": bool(converged) and wall < 60.0,
-                })
+                northstar(ssim, s, rps, "northstar")
             del ssim
+            # Full-serf numbers at scale (round-3 verdict items 2/10:
+            # the event plane live is the product's real step; record
+            # its throughput beside SWIM-only at the big shapes, and at
+            # 1M attempt the FULL-STACK north star — mass-kill to
+            # agreement with the event plane running throughout).
+            serf_min = int(os.environ.get("BENCH_SERF_SWEEP_MIN", "262144"))
+            if s >= serf_min and left() > 240:
+                t3 = time.monotonic()
+                fsim = build(s, cls=SerfSimulation)
+                fsim.run(chunk, chunk=chunk, with_metrics=False)
+                fsim.user_event(jnp.arange(s) < 8, 1)
+                jax.block_until_ready(fsim.state.ev_key)
+                serf_compile = time.monotonic() - t3
+                t4 = time.monotonic()
+                fsim.run(chunk, chunk=chunk, with_metrics=False)
+                jax.block_until_ready(fsim.state.ev_key)
+                srps = chunk / (time.monotonic() - t4)
+                _emit({
+                    "phase": "serf_sweep",
+                    "n": s,
+                    "rounds_per_s": round(srps, 2),
+                    "compile_s": round(serf_compile, 1),
+                })
+                if s >= 1_000_000 and srps * min(left() - 120, 600) > 512:
+                    northstar(fsim, s, srps, "northstar_serf")
+                del fsim
         except Exception as e:
             _emit({"phase": "error", "where": f"sweep:{s}", "error": repr(e)[:400]})
     return 0
@@ -527,9 +552,18 @@ def main():
             for p in (tpu["phases"] if tpu else [])
             if p.get("phase") == "sweep"
         ],
+        "serf_sweep": [
+            {"n": p["n"], "rounds_per_s": p["rounds_per_s"],
+             "compile_s": p.get("compile_s")}
+            for p in (tpu["phases"] if tpu else [])
+            if p.get("phase") == "serf_sweep"
+        ],
         "northstar_1m": next(
             (p for p in (tpu["phases"] if tpu else [])
              if p.get("phase") == "northstar"), None),
+        "northstar_1m_serf": next(
+            (p for p in (tpu["phases"] if tpu else [])
+             if p.get("phase") == "northstar_serf"), None),
         "cpu_fallback": {
             "rounds_per_s": cpu_ok,
             "n_nodes": _get(cpu["phases"], "throughput", "n"),
