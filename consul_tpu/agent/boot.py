@@ -61,11 +61,7 @@ _DEFAULTS = {
 def load_config(path: Optional[str], overrides: Optional[dict] = None) -> dict:
     cfg = dict(_DEFAULTS)
     if path:
-        with open(path, encoding="utf-8") as f:
-            try:
-                doc = json.load(f)
-            except json.JSONDecodeError as e:
-                raise ValueError(f"config file {path}: {e}") from e
+        doc = config_loader._read_config_file(path)
         if not isinstance(doc, dict):
             raise ValueError(f"config file {path}: top level must be an object")
         unknown = sorted(set(doc) - set(_DEFAULTS))

@@ -98,6 +98,24 @@ def env_overrides(env: Optional[Mapping[str, str]] = None) -> dict[str, Any]:
     return out
 
 
+def _read_config_file(p: str) -> Any:
+    """One config file, JSON or HCL by extension (the reference's
+    builder sniffs the format the same way, agent/config/builder.go
+    format detection; .hcl via utils/hcl.py)."""
+    if p.endswith((".hcl", ".tf")):
+        from consul_tpu.utils import hcl
+
+        try:
+            return hcl.load(p)
+        except hcl.HCLError as e:
+            raise ValueError(f"config file {p}: {e}") from e
+    with open(p, encoding="utf-8") as f:
+        try:
+            return json.load(f)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"config file {p}: {e}") from e
+
+
 def load(paths: Iterable[str] = (),
          env: Optional[Mapping[str, str]] = None,
          overrides: Optional[Mapping[str, Any]] = None) -> SimConfig:
@@ -106,11 +124,7 @@ def load(paths: Iterable[str] = (),
     overrides — later wins)."""
     flat: dict[str, Any] = {}
     for p in paths:
-        with open(p, encoding="utf-8") as f:
-            try:
-                doc = json.load(f)
-            except json.JSONDecodeError as e:
-                raise ValueError(f"config file {p}: {e}") from e
+        doc = _read_config_file(p)
         if not isinstance(doc, dict):
             raise ValueError(f"config file {p}: top level must be an object")
         flat.update(_flatten(doc))

@@ -1,0 +1,98 @@
+"""HCL1-subset parser tests (reference agent/config/builder.go accepts
+HCL beside JSON; vendored hashicorp/hcl decode semantics): assignments,
+blocks, labeled blocks, repeated-key merging, comments — the shapes
+real Consul config files use."""
+
+import pytest
+
+from consul_tpu.utils import hcl
+
+
+class TestValues:
+    def test_assignments(self):
+        assert hcl.parse('node_name = "web-1"\nbootstrap_expect = 3\n'
+                         'server = true\nratio = 0.25') == {
+            "node_name": "web-1", "bootstrap_expect": 3,
+            "server": True, "ratio": 0.25}
+
+    def test_lists_and_nested_objects(self):
+        out = hcl.parse('''
+            retry_join = ["10.0.0.1", "10.0.0.2"]
+            meta = { rack = "r1", tier = 2 }
+        ''')
+        assert out["retry_join"] == ["10.0.0.1", "10.0.0.2"]
+        assert out["meta"] == {"rack": "r1", "tier": 2}
+
+    def test_string_escapes(self):
+        assert hcl.parse(r'x = "a\"b\n\\c"') == {"x": 'a"b\n\\c'}
+
+    def test_comments_all_three_styles(self):
+        out = hcl.parse('''
+            # hash comment
+            a = 1  // line comment
+            /* block
+               comment */ b = 2
+        ''')
+        assert out == {"a": 1, "b": 2}
+
+
+class TestBlocks:
+    def test_block_is_object(self):
+        out = hcl.parse('ports {\n  http = 8501\n  dns = -1\n}')
+        assert out == {"ports": {"http": 8501, "dns": -1}}
+
+    def test_labeled_block_chains_keys(self):
+        out = hcl.parse('service "web" {\n  port = 80\n}')
+        assert out == {"service": {"web": {"port": 80}}}
+
+    def test_repeated_blocks_deep_merge(self):
+        out = hcl.parse('''
+            telemetry { statsd_address = "s:1" }
+            telemetry { disable_hostname = true }
+            service "web" { port = 80 }
+            service "db" { port = 5432 }
+        ''')
+        assert out["telemetry"] == {"statsd_address": "s:1",
+                                    "disable_hostname": True}
+        assert out["service"] == {"web": {"port": 80},
+                                  "db": {"port": 5432}}
+
+    def test_repeated_scalar_collects_list(self):
+        assert hcl.parse('a = 1\na = 2\na = 3') == {"a": [1, 2, 3]}
+
+
+class TestErrors:
+    def test_unclosed_block(self):
+        with pytest.raises(hcl.HCLError, match="EOF"):
+            hcl.parse('ports {\n http = 1\n')
+
+    def test_bare_identifier_value(self):
+        with pytest.raises(hcl.HCLError, match="bare identifier"):
+            hcl.parse('a = oops')
+
+    def test_label_without_block(self):
+        with pytest.raises(hcl.HCLError, match="must open a block"):
+            hcl.parse('service "web" = 1')
+
+
+class TestLoaderIntegration:
+    def test_config_loader_reads_hcl(self, tmp_path):
+        from consul_tpu import config_loader
+
+        p = tmp_path / "gossip.hcl"
+        p.write_text('gossip {\n  tick_ms = 100\n}\nn = 512\n'
+                     'view_degree = 16\n')
+        cfg = config_loader.load(paths=[str(p)])
+        assert cfg.n == 512
+        assert cfg.gossip.tick_ms == 100
+
+    def test_agent_boot_reads_hcl(self, tmp_path):
+        from consul_tpu.agent import boot
+
+        p = tmp_path / "agent.hcl"
+        p.write_text('node_name = "hcl-node"\nserver = true\n'
+                     'http {\n  port = 0\n}\n')
+        cfg = boot.load_config(str(p))
+        assert cfg["node_name"] == "hcl-node"
+        assert cfg["http"]["port"] == 0
+        assert cfg["http"]["host"] == "127.0.0.1"  # default preserved
