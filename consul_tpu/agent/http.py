@@ -82,8 +82,12 @@ class HTTPApi:
         (e.g. a pre-assigned session id) come back as ``(None, out)``."""
         out = self.agent.rpc(method, **args)
         if isinstance(out, int):
-            self.wait_write(out)
-            res = self.agent.rpc("Status.ApplyResult", index=out)
+            # wait_write may return the found ApplyResult itself (the
+            # client-mode pool does, saving a wire round trip); a None
+            # return means "applied, fetch the verdict yourself".
+            res = self.wait_write(out)
+            if not isinstance(res, dict) or not res.get("found"):
+                res = self.agent.rpc("Status.ApplyResult", index=out)
             if not res.get("found"):
                 # The entry committed but its verdict is unreachable
                 # (applied-before-wait, evicted ring entry): surface an

@@ -25,6 +25,7 @@ or bridge-backed remotes alike):
 from __future__ import annotations
 
 import random
+import threading
 from typing import Any, Callable
 
 REBALANCE_INTERVAL_S = 120.0  # router/manager.go clientRPCMinReuseDuration
@@ -46,60 +47,88 @@ class ServerPool:
         self._rng.shuffle(self._order)
         self._interval = rebalance_interval_s
         self._next_rebalance = self._interval
+        # The pool is shared by concurrently-executing HTTP handler
+        # threads in a live client agent (agent/boot.py); an RLock
+        # keeps the rotation list consistent under racing rpc() calls.
+        self._lock = threading.RLock()
         self.metrics = {"rpc_calls": 0, "rpc_failures": 0, "rebalances": 0}
 
     @property
     def servers(self) -> list[str]:
-        return list(self._order)
+        with self._lock:
+            return list(self._order)
 
     def current(self) -> str:
-        return self._order[0]
+        with self._lock:
+            return self._order[0]
 
     def add(self, name: str, rpc: Callable[..., Any]):
-        if name not in self._rpcs:
-            self._rpcs[name] = rpc
-            # New servers join at a random position (manager.go AddServer
-            # reshuffle-on-change keeps load spread).
-            self._order.insert(self._rng.randrange(len(self._order) + 1), name)
+        with self._lock:
+            if name not in self._rpcs:
+                self._rpcs[name] = rpc
+                # New servers join at a random position (manager.go
+                # AddServer reshuffle-on-change keeps load spread).
+                self._order.insert(
+                    self._rng.randrange(len(self._order) + 1), name)
 
     def remove(self, name: str):
         """Refuses to drop the last server: an empty pool can route
         nothing, and the constructor's invariant holds for current()."""
-        if name in self._order and len(self._order) == 1:
-            raise ValueError("cannot remove the last pooled server")
-        self._rpcs.pop(name, None)
-        if name in self._order:
-            self._order.remove(name)
+        with self._lock:
+            if name in self._order and len(self._order) == 1:
+                raise ValueError("cannot remove the last pooled server")
+            self._rpcs.pop(name, None)
+            if name in self._order:
+                self._order.remove(name)
 
     def notify_failed(self, name: str):
         """Rotate a failed server to the tail (manager.go
         NotifyFailedServer) so the next call tries someone else."""
-        if name in self._order:
-            self._order.remove(name)
-            self._order.append(name)
+        with self._lock:
+            if name in self._order:
+                self._order.remove(name)
+                self._order.append(name)
 
     def rebalance(self, now: float) -> bool:
         """Reshuffle on the cadence (manager.go RebalanceServers)."""
-        if now < self._next_rebalance:
-            return False
-        self._next_rebalance = now + self._interval
-        self._rng.shuffle(self._order)
-        self.metrics["rebalances"] += 1
-        return True
+        with self._lock:
+            if now < self._next_rebalance:
+                return False
+            self._next_rebalance = now + self._interval
+            self._rng.shuffle(self._order)
+            self.metrics["rebalances"] += 1
+            return True
 
     def rpc(self, method: str, **args) -> Any:
         """Issue one RPC through the pool: try the head, rotate past
-        failures, raise NoServersError after a full cycle."""
+        CONNECTION failures (pool.go redials the next server), raise
+        NoServersError after a full cycle. Application-level errors
+        (validation, unknown RPC) propagate immediately — re-sending a
+        doomed request to every server would mark them all failed for
+        nothing."""
         self.metrics["rpc_calls"] += 1
         last_err: Exception | None = None
-        for _ in range(len(self._order)):
-            name = self._order[0]
+        with self._lock:
+            n = len(self._order)
+        for _ in range(n):
+            with self._lock:
+                name = self._order[0]
+                fn = self._rpcs[name]
             try:
-                return self._rpcs[name](method, **args)
-            except Exception as e:  # noqa: BLE001 — any failure rotates
+                return fn(method, **args)
+            except ConnectionError as e:
+                self.metrics["rpc_failures"] += 1
+                last_err = e
+                self.notify_failed(name)
+            except Exception as e:  # noqa: BLE001
+                # NotLeader rotates too (another server may route
+                # better, the reference forward loop's retry); real
+                # application errors propagate to the caller.
+                if type(e).__name__ != "NotLeader":
+                    raise
                 self.metrics["rpc_failures"] += 1
                 last_err = e
                 self.notify_failed(name)
         raise NoServersError(
-            f"all {len(self._order)} pooled servers failed {method}"
+            f"all {n} pooled servers failed {method}"
         ) from last_err

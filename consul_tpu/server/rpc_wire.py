@@ -1,0 +1,253 @@
+"""Inter-process RPC wire: msgpack-RPC over TCP with first-byte demux.
+
+The reference's agent↔server and server↔server RPC rides a
+yamux-multiplexed TCP pool speaking msgpack-RPC, selected by a
+first-byte protocol marker on each fresh connection (reference
+agent/pool/pool.go:122-533, agent/pool/conn.go:3-30, dispatch at
+agent/consul/rpc.go:81-133). This module is that tier for the
+framework — the piece that makes a *separate-process* client agent
+real rather than an in-process import:
+
+  - A server process runs one listener. The first byte of every
+    connection picks the protocol; RPC_CONSUL is implemented here
+    (the gossip bytes ride the PacketBridge seam, not this port).
+  - Requests are length-prefixed msgpack envelopes
+    ``{"seq", "method", "args"}`` answered by ``{"seq", "ok"}`` or a
+    typed error — each request is served on its own thread, so
+    pipelined blocking queries on one connection proceed concurrently,
+    the role yamux streams play in the reference.
+  - The client keeps one connection, pipelines by seq, reconnects on
+    failure, and surfaces typed errors (NotLeader, NoPathToDatacenter)
+    as the same exceptions the in-process path raises — so
+    agent/pool.py's ServerPool routing policy works unchanged over
+    real sockets.
+
+bytes round-trip natively (use_bin_type msgpack), so KV values and
+payloads cross the wire intact.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Any, Callable, Optional
+
+import msgpack
+
+from consul_tpu.server.endpoints import NoPathToDatacenter
+from consul_tpu.server.raft import NotLeader
+
+RPC_CONSUL = 0x00   # conn.go RPCConsul role: the msgpack-RPC stream
+_MAX_FRAME = 64 << 20
+
+
+class RpcWireError(ConnectionError):
+    pass
+
+
+def _send_frame(sock: socket.socket, obj: dict, lock: threading.Lock):
+    raw = msgpack.packb(obj, use_bin_type=True, default=_default)
+    with lock:
+        sock.sendall(struct.pack(">I", len(raw)) + raw)
+
+
+def _default(o):
+    if isinstance(o, (set, frozenset, tuple)):
+        return list(o)
+    raise TypeError(f"unserializable RPC value: {type(o)!r}")
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise RpcWireError("connection closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> dict:
+    (length,) = struct.unpack(">I", _recv_exact(sock, 4))
+    if length > _MAX_FRAME:
+        raise RpcWireError(f"oversized RPC frame ({length} bytes)")
+    return msgpack.unpackb(_recv_exact(sock, length), raw=False)
+
+
+# ----------------------------------------------------------------------
+# Server side
+# ----------------------------------------------------------------------
+
+class RpcListener:
+    """One TCP listener serving RPC_CONSUL connections against
+    ``rpc_fn(method, **args)`` (a Server.rpc or a leader-routing
+    closure). Unknown first bytes are dropped, like the reference's
+    demux rejecting unregistered protocol versions."""
+
+    def __init__(self, rpc_fn: Callable[..., Any],
+                 host: str = "127.0.0.1", port: int = 0):
+        self.rpc_fn = rpc_fn
+        self._sock = socket.create_server((host, port))
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        wlock = threading.Lock()
+        try:
+            proto = _recv_exact(conn, 1)[0]
+            if proto != RPC_CONSUL:
+                return  # unknown protocol byte: hang up
+            while not self._stop.is_set():
+                req = _recv_frame(conn)
+                threading.Thread(
+                    target=self._serve_one, args=(conn, wlock, req),
+                    daemon=True,
+                ).start()
+        except (RpcWireError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _serve_one(self, conn, wlock, req):
+        seq = req.get("seq", 0)
+        try:
+            out = self.rpc_fn(req["method"], **req.get("args", {}))
+            resp = {"seq": seq, "ok": out}
+        except NotLeader as e:
+            resp = {"seq": seq, "err_type": "not_leader",
+                    "leader": e.leader_hint}
+        except NoPathToDatacenter as e:
+            resp = {"seq": seq, "err_type": "no_path", "dc": e.dc,
+                    "err": str(e)}
+        except (ValueError, KeyError, TypeError, AttributeError) as e:
+            # Application-level errors stay typed across the wire so a
+            # client agent's HTTP tier maps them to 400s exactly like
+            # server mode (and the pool does NOT rotate on them).
+            resp = {"seq": seq, "err_type": "app",
+                    "app_class": type(e).__name__, "err": str(e)[:500]}
+        except Exception as e:  # noqa: BLE001 — errors cross the wire
+            resp = {"seq": seq, "err": repr(e)[:500]}
+        try:
+            _send_frame(conn, resp, wlock)
+        except (OSError, RpcWireError):
+            pass  # client went away mid-call
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ----------------------------------------------------------------------
+# Client side
+# ----------------------------------------------------------------------
+
+class RpcClient:
+    """One pooled connection to a server's RPC port: pipelined seq-
+    matched calls, lazy connect, reconnect-on-failure. The per-server
+    callable shape (``call(method, **args)``) matches what
+    agent/pool.ServerPool expects, so the reference's routing policy
+    (shuffle, rotate-past-failure, rebalance) composes directly."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 30.0):
+        self.addr = (host, int(port))
+        self.timeout_s = timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._wlock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._pending: dict[int, dict] = {}
+        self._seq = 0
+
+    def _connect(self):
+        with self._state_lock:
+            if self._sock is not None:
+                return
+            sock = socket.create_connection(self.addr, timeout=10.0)
+            sock.settimeout(None)
+            sock.sendall(bytes([RPC_CONSUL]))
+            self._sock = sock
+            threading.Thread(target=self._read_loop, args=(sock,),
+                             daemon=True).start()
+
+    def _read_loop(self, sock):
+        try:
+            while True:
+                resp = _recv_frame(sock)
+                with self._state_lock:
+                    slot = self._pending.get(resp.get("seq"))
+                if slot is not None:
+                    slot["resp"] = resp
+                    slot["done"].set()
+        except (RpcWireError, OSError):
+            with self._state_lock:
+                if self._sock is sock:
+                    self._sock = None
+                pending, self._pending = self._pending, {}
+            for slot in pending.values():
+                slot["resp"] = None  # connection died under the call
+                slot["done"].set()
+
+    def call(self, method: str, **args) -> Any:
+        self._connect()
+        with self._state_lock:
+            self._seq += 1
+            seq = self._seq
+            slot = {"done": threading.Event(), "resp": None}
+            self._pending[seq] = slot
+            sock = self._sock
+        try:
+            _send_frame(sock, {"seq": seq, "method": method, "args": args},
+                        self._wlock)
+        except (OSError, AttributeError) as e:
+            with self._state_lock:
+                self._pending.pop(seq, None)
+                self._sock = None
+            raise RpcWireError(f"send failed: {e}") from e
+        # Blocking queries legitimately park server-side for their
+        # requested wait; the wire timeout must outlast it or a long
+        # ?wait= long-poll would read as a dead server.
+        timeout = max(self.timeout_s, float(args.get("wait_s", 0)) + 15.0)
+        if not slot["done"].wait(timeout):
+            with self._state_lock:
+                self._pending.pop(seq, None)
+            raise RpcWireError(f"RPC {method} timed out")
+        resp = slot["resp"]
+        if resp is None:
+            raise RpcWireError("connection lost mid-call")
+        if "ok" in resp:
+            return resp["ok"]
+        if resp.get("err_type") == "not_leader":
+            raise NotLeader(resp.get("leader"))
+        if resp.get("err_type") == "no_path":
+            raise NoPathToDatacenter(resp.get("dc", "?"))
+        if resp.get("err_type") == "app":
+            cls = {"ValueError": ValueError, "KeyError": KeyError,
+                   "TypeError": TypeError,
+                   "AttributeError": AttributeError}.get(
+                resp.get("app_class", ""), ValueError)
+            raise cls(resp.get("err", "remote application error"))
+        raise RpcWireError(resp.get("err", "unknown RPC error"))
+
+    def close(self):
+        with self._state_lock:
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass

@@ -103,10 +103,16 @@ class TestLoadConfig:
         with pytest.raises(ValueError, match="unknown agent config keys"):
             boot.load_config(str(p))
 
-    def test_client_mode_rejected(self, tmp_path):
+    def test_client_mode_requires_join_addresses(self, tmp_path):
         p = tmp_path / "client.json"
         p.write_text('{"server": false}')
-        with pytest.raises(ValueError, match="not bootable standalone"):
+        with pytest.raises(ValueError, match="requires retry_join_rpc"):
+            boot.load_config(str(p))
+
+    def test_malformed_join_address_rejected(self, tmp_path):
+        p = tmp_path / "client.json"
+        p.write_text('{"server": false, "retry_join_rpc": ["10.0.0.1"]}')
+        with pytest.raises(ValueError, match="not host:port"):
             boot.load_config(str(p))
 
     def test_sim_section_validated(self, tmp_path):

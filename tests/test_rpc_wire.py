@@ -1,0 +1,183 @@
+"""Inter-process RPC wire tests (reference agent/pool/pool.go msgpack-
+RPC + conn.go first-byte demux): in-process socket roundtrips, pipelined
+blocking queries, typed errors — then the real thing: a server agent
+process and a CLIENT agent process joined over the RPC port, driven by
+the CLI end to end."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from consul_tpu.server.endpoints import ServerCluster
+from consul_tpu.server.rpc_wire import RpcClient, RpcListener, RpcWireError
+
+
+@pytest.fixture
+def wired():
+    """A pumped 3-server cluster behind a real RPC socket."""
+    cluster = ServerCluster(3, seed=21)
+    cluster.wait_converged()
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            cluster.step()
+            time.sleep(0.002)
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    def rpc(method, **args):
+        led = cluster.raft.wait_converged()
+        return cluster.registry[led.id].rpc(method, **args)
+
+    listener = RpcListener(rpc)
+    client = RpcClient("127.0.0.1", listener.port)
+    yield cluster, client
+    stop.set()
+    client.close()
+    listener.close()
+
+
+class TestWire:
+    def test_kv_roundtrip_bytes_intact(self, wired):
+        _, client = wired
+        idx = client.call("KVS.Apply", op="set", key="w",
+                          value=b"\x00\xffbin")
+        assert isinstance(idx, int)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            out = client.call("KVS.Get", key="w")
+            if out["value"] is not None:
+                break
+            time.sleep(0.01)
+        assert out["value"]["value"] == b"\x00\xffbin"
+
+    def test_pipelined_blocking_read_wakes_on_write(self, wired):
+        """Two in-flight calls on ONE connection: the blocking read
+        parks server-side while the write proceeds — the yamux-streams
+        role, served by per-request threads."""
+        _, client = wired
+        client.call("KVS.Apply", op="set", key="p", value=b"v0")
+        time.sleep(0.2)
+        out = client.call("KVS.Get", key="p")
+        idx = out["index"]
+        got = {}
+
+        def blocked():
+            t0 = time.monotonic()
+            got["out"] = client.call("KVS.Get", key="p", min_index=idx,
+                                     wait_s=8.0)
+            got["dt"] = time.monotonic() - t0
+
+        th = threading.Thread(target=blocked)
+        th.start()
+        time.sleep(0.3)
+        client.call("KVS.Apply", op="set", key="p", value=b"v1")
+        th.join(timeout=10.0)
+        assert got["out"]["value"]["value"] == b"v1"
+        assert got["dt"] < 4.0
+
+    def test_unknown_rpc_raises_typed_app_error(self, wired):
+        """Application errors stay TYPED across the wire (so the HTTP
+        tier maps them to 400s and the pool does not rotate)."""
+        _, client = wired
+        with pytest.raises(AttributeError, match="unknown RPC"):
+            client.call("Nope.Nothing")
+
+    def test_validation_error_crosses_typed(self, wired):
+        _, client = wired
+        with pytest.raises((ValueError, TypeError)):
+            client.call("KVS.Apply", op="set")  # missing key
+
+    def test_unknown_protocol_byte_hangs_up(self, wired):
+        import socket as socket_mod
+
+        cluster, client = wired
+        # Reach into the listener for its port via a fresh client addr.
+        host, port = client.addr
+        s = socket_mod.create_connection((host, port))
+        s.sendall(b"\x7f")  # not RPC_CONSUL
+        s.settimeout(2.0)
+        assert s.recv(1) == b""  # server hung up
+        s.close()
+
+
+class TestClientAgentProcess:
+    """The agent story made real: one server process, one client-mode
+    agent process joined over the RPC wire, CLI talking to the CLIENT's
+    HTTP port (reference client agents forwarding RPC to servers,
+    client.go RPC via the conn pool)."""
+
+    @pytest.fixture(scope="class")
+    def duo(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("duo")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        scfg = tmp / "server.json"
+        scfg.write_text(json.dumps({
+            "node_name": "srv-agent", "n_servers": 3,
+            "http": {"host": "127.0.0.1", "port": 0}, "rpc_port": 0,
+        }))
+        server = subprocess.Popen(
+            [sys.executable, "-m", "consul_tpu.cli", "agent",
+             "--config-file", str(scfg)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        sready = json.loads(server.stdout.readline())
+
+        ccfg = tmp / "client.json"
+        ccfg.write_text(json.dumps({
+            "node_name": "cli-agent", "server": False,
+            "retry_join_rpc": [f"127.0.0.1:{sready['rpc_port']}"],
+            "http": {"host": "127.0.0.1", "port": 0},
+        }))
+        client = subprocess.Popen(
+            [sys.executable, "-m", "consul_tpu.cli", "agent",
+             "--config-file", str(ccfg)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        cready = json.loads(client.stdout.readline())
+        yield sready, cready, env
+        for p in (client, server):
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+                p.wait(timeout=15)
+
+    def _cli(self, env, port, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "consul_tpu.cli",
+             "--http-addr", f"127.0.0.1:{port}", *args],
+            capture_output=True, text=True, env=env, timeout=30)
+
+    def test_ready_lines(self, duo):
+        sready, cready, _ = duo
+        assert sready["mode"] == "server" and sready["rpc_port"] > 0
+        assert cready["mode"] == "client" and cready["rpc_port"] is None
+
+    def test_write_via_client_visible_via_server(self, duo):
+        sready, cready, env = duo
+        r = self._cli(env, cready["http_port"], "kv", "put", "xk", "xv")
+        assert r.returncode == 0, r.stderr
+        out = self._cli(env, sready["http_port"], "kv", "get", "xk")
+        assert out.returncode == 0 and out.stdout.strip() == "xv"
+
+    def test_client_agent_antientropy_registers_itself(self, duo):
+        sready, _, env = duo
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            out = self._cli(env, sready["http_port"], "members")
+            if "cli-agent" in out.stdout:
+                break
+            time.sleep(0.5)
+        assert "cli-agent" in out.stdout, out.stdout
+
+    def test_info_via_client_reports_server_consensus(self, duo):
+        _, cready, env = duo
+        out = self._cli(env, cready["http_port"], "info")
+        assert out.returncode == 0
+        assert "leader = srv" in out.stdout
