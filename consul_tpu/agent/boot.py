@@ -290,11 +290,14 @@ def run(config_file: Optional[str], overrides: Optional[dict] = None) -> int:
     """CLI entry: boot, announce readiness, serve until signalled."""
     try:
         cfg = load_config(config_file, overrides)
+        cfg["_config_path"] = config_file
+        # Construction can fail on environment problems too (an
+        # unassignable bind_addr for the RPC listener, a busy port, an
+        # unwritable data_dir) — all exit cleanly, never a traceback.
+        rt = AgentRuntime(cfg)
     except (OSError, ValueError) as e:
         print(f"agent: {e}", file=sys.stderr)
         return 1
-    cfg["_config_path"] = config_file
-    rt = AgentRuntime(cfg)
     rt.install_signals()
     port = rt.start()
     print(json.dumps({

@@ -106,27 +106,24 @@ class ServerPool:
         (validation, unknown RPC) propagate immediately — re-sending a
         doomed request to every server would mark them all failed for
         nothing."""
-        self.metrics["rpc_calls"] += 1
-        last_err: Exception | None = None
+        from consul_tpu.server.raft import NotLeader
+
         with self._lock:
+            self.metrics["rpc_calls"] += 1
             n = len(self._order)
+        last_err: Exception | None = None
         for _ in range(n):
             with self._lock:
                 name = self._order[0]
                 fn = self._rpcs[name]
             try:
                 return fn(method, **args)
-            except ConnectionError as e:
-                self.metrics["rpc_failures"] += 1
-                last_err = e
-                self.notify_failed(name)
-            except Exception as e:  # noqa: BLE001
-                # NotLeader rotates too (another server may route
-                # better, the reference forward loop's retry); real
-                # application errors propagate to the caller.
-                if type(e).__name__ != "NotLeader":
-                    raise
-                self.metrics["rpc_failures"] += 1
+            except (ConnectionError, NotLeader) as e:
+                # Connection failures rotate (pool.go redials the next
+                # server); NotLeader rotates too (the forward loop's
+                # retry). Application errors propagate above.
+                with self._lock:
+                    self.metrics["rpc_failures"] += 1
                 last_err = e
                 self.notify_failed(name)
         raise NoServersError(

@@ -21,7 +21,8 @@ Here the whole layer is arrays over the node axis, advanced by
                                               ltime % R (serf's own
                                               indexing), O origins/ltime
   query response channel + deadline        -> q_open_key/q_deadline/
-    (serf/query.go)                           q_resps  [N]
+    (serf/query.go acks + responses)          q_resps/q_acks [N] +
+                                              q_responder[N] handler mask
   failedMembers/leftMembers reap lists     -> down_since[N, K] vs
     (serf.go:1544-1610)                       reap timeouts (derived)
 
@@ -105,6 +106,14 @@ class SerfState(NamedTuple):
     q_open_key: jax.Array    # [N] uint32, 0 = none
     q_deadline: jax.Array    # [N] int32 tick
     q_resps: jax.Array       # [N] int32 responses received
+    q_acks: jax.Array        # [N] int32 delivery acks received (the
+                             # reference's QueryParam.RequestAck stream,
+                             # serf/query.go acks channel — counted
+                             # separately from answers)
+    # Which nodes ANSWER queries they receive (handler registration,
+    # reference serf query handlers; all-true by default — every member
+    # acks delivery, only responders send a response).
+    q_responder: jax.Array   # [N] bool
     # -- pending graceful leaves --------------------------------------
     leave_at: jax.Array      # [N] int32 tick the node goes quiet, -1 = none
     # -- reap bookkeeping ---------------------------------------------
@@ -132,6 +141,8 @@ def init(cfg: SimConfig, key) -> SerfState:
         q_open_key=jnp.zeros((n,), jnp.uint32),
         q_deadline=jnp.zeros((n,), jnp.int32),
         q_resps=jnp.zeros((n,), jnp.int32),
+        q_acks=jnp.zeros((n,), jnp.int32),
+        q_responder=jnp.ones((n,), bool),
         leave_at=jnp.full((n,), -1, jnp.int32),
         down_since=jnp.full((n, cfg.degree), -1, jnp.int32),
     )
@@ -319,6 +330,7 @@ def query(cfg: SimConfig, s: SerfState, mask, name: int) -> SerfState:
             mask, s.swim.t + query_timeout_ticks(cfg), s.q_deadline
         ),
         q_resps=jnp.where(mask, 0, s.q_resps),
+        q_acks=jnp.where(mask, 0, s.q_acks),
     )
     with jax.ensure_compile_time_eval():
         tx0 = int(scaling.retransmit_limit(cfg.gossip.retransmit_mult, cfg.n))
@@ -487,15 +499,29 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
     # collectives per tick.
     q_open_g = coll.all_rows(s.q_open_key)
     up_g = coll.all_rows(s.swim.alive_truth & ~s.swim.left)
-    resp_ok = (
+    landed = (
         isq
         & arrived
         & (q_open_g[worig] == wkey)
         & up_g[worig]
         & (worig != grows)  # origin's own delivery happened at submit
+        # External (bridge) seats never ack/answer on-device: their
+        # REAL agent does, over the wire, and the bridge tallies that
+        # one — counting the seat's row too would double-count every
+        # attached agent (wire/bridge.py _stage_qtally).
+        & ~s.swim.external
     )
-    s = s._replace(q_resps=s.q_resps + coll.sum_scatter_rows(
-        worig, jnp.where(resp_ok, 1, 0).astype(s.q_resps.dtype), n))
+    # Ack vs response (serf/query.go acks/responses channels): every
+    # delivering member acks; only registered responders answer. Two
+    # [N] tallies, two reduce-scatters under sharding (the collective
+    # budget test pins this count).
+    resp_ok = landed & s.q_responder
+    s = s._replace(
+        q_resps=s.q_resps + coll.sum_scatter_rows(
+            worig, jnp.where(resp_ok, 1, 0).astype(s.q_resps.dtype), n),
+        q_acks=s.q_acks + coll.sum_scatter_rows(
+            worig, jnp.where(landed, 1, 0).astype(s.q_acks.dtype), n),
+    )
 
     # ---- 2. Gossip out: most-retransmittable queue entries, sent along
     # per-tick shared displacements (swim-plane divergence note).

@@ -241,6 +241,20 @@ class PacketBridge:
         # models/serf.py make_event_key; a documented narrowing), and
         # per-agent delivered-event dedup for the outbound feed.
         self._stage_fired: list[tuple[int, int]] = []   # (seat, name_int)
+        # Serf queries across the seam (serf/query.go): agent-fired
+        # queries stage into the device plane; agent responses to
+        # sim-origin queries tally into q_acks/q_resps; and the tracker
+        # keeps the per-responder names + payload bytes the device
+        # plane's counts cannot carry (the reference's QueryResponse
+        # acks/responses channels, host-side).
+        self._stage_query: list[tuple[int, int]] = []   # (seat, name_int)
+        self._stage_qtally: list[tuple[int, bool]] = []  # (origin, is_resp)
+        self._known_queries: dict[tuple, None] = {}     # (name_int, ltime)
+        self._query_names: dict[int, str] = {}
+        self._query_payloads: dict[int, bytes] = {}
+        # (ltime, name_int) -> {"acks": [member], "responses":
+        #   {member: payload}, "origin_seat": int|None}
+        self.query_tracker: dict[tuple[int, int], dict] = {}
         self._event_names: dict[int, str] = {}
         # (first-name, colliding-name) pairs for operators to inspect.
         self.collisions: list[tuple[str, str]] = []
@@ -369,6 +383,36 @@ class PacketBridge:
                 # never propagate into the agent's send path.
                 continue
 
+    def _bounded_insert(self, d: dict, key, value=None, mult: int = 2):
+        """Insert with the host-side queue bound (getQueueMax
+        discipline, serf/serf.go:1612-1624): oldest entries evict."""
+        d[key] = value
+        while len(d) > mult * self._queue_max:
+            d.pop(next(iter(d)))
+
+    def _register_name(self, registry: dict, payloads: dict,
+                       name: str, payload: bytes) -> tuple[int, bool]:
+        """8-bit name-space registration shared by the event and query
+        planes (the sim keys names as ints — a documented narrowing):
+        first name wins a slot, Name AND Payload; collisions surface in
+        ``self.collisions`` instead of silently relabeling."""
+        name_int = zlib.crc32(name.encode()) & 0xFF
+        prior = registry.get(name_int)
+        collided = prior is not None and prior != name
+        if collided:
+            self.collisions.append((prior, name))
+        else:
+            registry[name_int] = name
+            payloads[name_int] = payload
+        return name_int, collided
+
+    def _track_query(self, lt: int, name_int: int) -> dict:
+        rec = self.query_tracker.get((lt, name_int))
+        if rec is None:
+            rec = {"acks": [], "responses": {}, "origin_seat": None}
+            self._bounded_insert(self.query_tracker, (lt, name_int), rec)
+        return rec
+
     def _handle_msg(self, from_seat, to_seat, mtype, body, sent, rtt):
         if mtype == MessageType.PING:
             # Answer on behalf of the sim node, ack payload = its
@@ -417,34 +461,59 @@ class PacketBridge:
             stype, sbody = codec.decode_serf_message(body.get("Raw", b""))
             if stype == codec.SERF_USER_EVENT and \
                     self.sim.serf_state is not None:
-                name = str(sbody.get("Name", ""))
-                name_int = zlib.crc32(name.encode()) & 0xFF
-                prior = self._event_names.get(name_int)
-                collided = prior is not None and prior != name
-                if collided:
-                    # 8-bit name-space collision (documented narrowing):
-                    # first name wins the registry — Name AND Payload —
-                    # and the collision is surfaced instead of silently
-                    # relabeling or cross-contaminating events.
-                    self.collisions.append((prior, name))
-                else:
-                    self._event_names[name_int] = name
                 # Dedup across retransmissions AND the bridge's own
                 # outbound echoes: a serf agent retransmits each event
                 # several times and re-gossips what it receives; only
                 # the first (name, ltime) sighting fires into the sim,
                 # or one event would re-fire at fresh Lamport times
                 # forever (an unbounded feedback loop).
+                name_int, _ = self._register_name(
+                    self._event_names, self._event_payloads,
+                    str(sbody.get("Name", "")),
+                    codec.as_bytes(sbody.get("Payload", b"") or b""))
                 ek = (name_int, int(sbody.get("LTime", 0)))
                 if ek in self._known_events:
                     return
-                self._known_events[ek] = None
-                while len(self._known_events) > 2 * self._queue_max:
-                    self._known_events.pop(next(iter(self._known_events)))
-                if not collided:
-                    self._event_payloads[name_int] = codec.as_bytes(
-                        sbody.get("Payload", b"") or b"")
+                self._bounded_insert(self._known_events, ek)
                 self._stage_fired.append((from_seat, name_int))
+            elif stype == codec.SERF_QUERY and \
+                    self.sim.serf_state is not None:
+                # An attached agent fires a query (messageQueryType,
+                # serf/messages.go): stage it into the device plane so
+                # the epidemic carries it; dedup retransmissions like
+                # user events.
+                name_int, _ = self._register_name(
+                    self._query_names, self._query_payloads,
+                    str(sbody.get("Name", "")),
+                    codec.as_bytes(sbody.get("Payload", b"") or b""))
+                qk = (name_int, int(sbody.get("LTime", 0)))
+                if qk in self._known_queries:
+                    return
+                self._bounded_insert(self._known_queries, qk)
+                self._stage_query.append((from_seat, name_int))
+            elif stype == codec.SERF_QUERY_RESPONSE and \
+                    self.sim.serf_state is not None:
+                # An agent answers a sim-origin query addressed to the
+                # origin's seat (messageQueryResponseType; Flags bit 0
+                # marks a delivery ack, serf/query.go queryFlagAck).
+                # Tally into the device counters and keep the
+                # per-responder name + payload host-side.
+                qid = int(sbody.get("ID", 0))
+                s = self.sim.serf_state
+                if int(s.q_open_key[to_seat]) != qid:
+                    return  # closed or stale: drop, like the reference
+                lt, name_int = qid >> 9, (qid >> 1) & 0xFF
+                frm = str(sbody.get("From", "")) or seat_name(from_seat)
+                rec = self._track_query(lt, name_int)
+                rec["origin_seat"] = to_seat
+                if int(sbody.get("Flags", 0)) & 1:
+                    if frm not in rec["acks"]:
+                        rec["acks"].append(frm)
+                        self._stage_qtally.append((to_seat, False))
+                elif frm not in rec["responses"]:
+                    rec["responses"][frm] = codec.as_bytes(
+                        sbody.get("Payload", b"") or b"")
+                    self._stage_qtally.append((to_seat, True))
         elif mtype == MessageType.INDIRECT_PING:
             # Relay: target reachability from ground truth; ack or nack
             # back to the requester (net.go handleIndirectPing:491).
@@ -666,22 +735,51 @@ class PacketBridge:
             if not up[src]:
                 continue  # dead members never source event traffic
             keys = np.asarray(s.ev_key[src])
+            origins = np.asarray(s.ev_origin[src])
             seen = self._delivered_events.setdefault(seat, {})
             out = []
             for slot in range(keys.shape[0]):
                 key = int(keys[slot])
-                if key == 0 or key in seen or (key & 1):
-                    continue  # empty, already delivered, or a query
+                if key == 0 or key in seen:
+                    continue  # empty or already delivered
                 seen[key] = None
                 while len(seen) > self._queue_max:
                     seen.pop(next(iter(seen)))
                 name_int = (key >> 1) & 0xFF
+                if key & 1:
+                    # Query envelope (messageQueryType): the agent can
+                    # respond with messageQueryResponse to the origin's
+                    # address; Flags bit 0 requests a delivery ack.
+                    self._bounded_insert(
+                        self._known_queries, (name_int, key >> 9))
+                    from consul_tpu.models import serf as serf_mod
+
+                    origin = int(origins[slot]) % n
+                    timeout_ticks = serf_mod.query_timeout_ticks(
+                        self.sim.cfg)
+                    out.append(codec.encode_serf_message(
+                        codec.SERF_QUERY, {
+                            "LTime": key >> 9,
+                            "ID": key,
+                            "Addr": seat_name(origin).encode(),
+                            "Port": 7946,
+                            "Filters": [],
+                            "Flags": 1,  # queryFlagAck
+                            "RelayFactor": 0,
+                            "Timeout": int(
+                                timeout_ticks
+                                * self.sim.cfg.gossip.tick_ms * 1e6),
+                            "Name": self._query_names.get(
+                                name_int, f"query-{name_int}"),
+                            "Payload": self._query_payloads.get(
+                                name_int, b""),
+                        }))
+                    continue
                 # Mark the echo as known so the agent's re-gossip of it
                 # cannot re-fire into the sim (bounded here too — this
                 # insert site sees one entry per sim-originated event).
-                self._known_events[(name_int, key >> 9)] = None
-                while len(self._known_events) > 2 * self._queue_max:
-                    self._known_events.pop(next(iter(self._known_events)))
+                self._bounded_insert(
+                    self._known_events, (name_int, key >> 9))
                 out.append(codec.encode_serf_message(
                     codec.SERF_USER_EVENT, {
                         "LTime": key >> 9,
@@ -775,6 +873,65 @@ class PacketBridge:
                     self.sim.cfg, self.sim.serf_state,
                     jnp.asarray(mask), name_int)
             self._stage_fired = []
+        if self._stage_query and self.sim.serf_state is not None:
+            # Agent-fired queries enter the device plane (serf.query);
+            # the tracker learns the device-assigned key so responses
+            # and the per-responder record stay correlated.
+            from consul_tpu.models import serf as serf_mod
+
+            n = self.sim.cfg.n
+            for seat, name_int in self._stage_query:
+                mask = np.zeros(n, bool)
+                mask[seat] = True
+                self.sim.state = serf_mod.query(
+                    self.sim.cfg, self.sim.serf_state,
+                    jnp.asarray(mask), name_int)
+                key = int(self.sim.serf_state.q_open_key[seat])
+                self._track_query(key >> 9, name_int)["origin_seat"] = seat
+            self._stage_query = []
+        if self._stage_qtally and self.sim.serf_state is not None:
+            # Agent responses/acks to sim-origin queries land in the
+            # device counters (one batched .at[].add per kind).
+            s = self.sim.serf_state
+            acks = [o for o, is_resp in self._stage_qtally if not is_resp]
+            resps = [o for o, is_resp in self._stage_qtally if is_resp]
+            if acks:
+                s = s._replace(q_acks=s.q_acks.at[
+                    jnp.asarray(acks, jnp.int32)].add(1))
+            if resps:
+                s = s._replace(q_resps=s.q_resps.at[
+                    jnp.asarray(resps, jnp.int32)].add(1))
+            self.sim.state = s
+            self._stage_qtally = []
+
+    def query_status(self, origin_row: int) -> Optional[dict]:
+        """The consumer-facing view of a query fired by ``origin_row``
+        (seat or sim node): the device plane's exactly-once aggregate
+        counts plus the per-responder names and payload bytes collected
+        from attached agents — the QueryResponse acks/responses
+        channels a `consul exec`-style consumer reads (serf/query.go).
+        None when the node has no open or tracked query."""
+        s = self.sim.serf_state
+        if s is None:
+            return None
+        key = int(s.q_open_key[origin_row])
+        rec = None
+        if key:
+            rec = self.query_tracker.get((key >> 9, (key >> 1) & 0xFF))
+        else:  # closed: the freshest tracker entry for this origin
+            for k in reversed(list(self.query_tracker)):
+                if self.query_tracker[k].get("origin_seat") == origin_row:
+                    rec = self.query_tracker[k]
+                    break
+            if rec is None:
+                return None
+        return {
+            "open": bool(key),
+            "acks_total": int(s.q_acks[origin_row]),
+            "responses_total": int(s.q_resps[origin_row]),
+            "agent_acks": list((rec or {}).get("acks", [])),
+            "agent_responses": dict((rec or {}).get("responses", {})),
+        }
 
     def run(self, ticks: int):
         """Advance sim + bridge together, one tick at a time (the

@@ -102,3 +102,112 @@ class TestSimToAgent:
                 stype, sbody = codec.decode_serf_message(body["Raw"])
                 got.append(sbody["Name"])
         assert "rolling-restart" in got
+
+
+class TestQueriesAcrossTheSeam:
+    """Serf queries crossing the transport seam (serf/query.go +
+    messages.go messageQuery/messageQueryResponse): sim-origin queries
+    reach agents as real envelopes, agent responses tally into the
+    device counters with per-responder payloads host-side, and
+    agent-fired queries disseminate through the device plane."""
+
+    def test_sim_query_reaches_agent_as_envelope(self, serf_world):
+        sim, br, tr = serf_world
+        sim.query(jnp.arange(N) == 0, name=5)
+        got = []
+        for mtype, body in pump(sim, br, tr, 60):
+            if mtype == MessageType.USER:
+                stype, sbody = codec.decode_serf_message(body["Raw"])
+                if stype == codec.SERF_QUERY:
+                    got.append(sbody)
+                    break
+        assert got, "query envelope never reached the agent"
+        q = got[0]
+        assert q["ID"] == int(sim.state.q_open_key[0])
+        assert q["Flags"] & 1  # ack requested
+        assert codec.as_bytes(q["Addr"]).decode().startswith("sim-")
+
+    def test_agent_response_tallies_and_tracks_payload(self, serf_world):
+        sim, br, tr = serf_world
+        sim.query(jnp.arange(N) == 0, name=5)
+        qid = int(sim.state.q_open_key[0])
+        # The agent acks delivery, then answers with a payload.
+        for flags, payload in ((1, b""), (0, b"answer-bytes")):
+            msg = codec.encode_serf_message(codec.SERF_QUERY_RESPONSE, {
+                "LTime": qid >> 9, "ID": qid, "From": "agent-x",
+                "Flags": flags, "Payload": payload})
+            tr.write_to(codec.encode_packet([msg]), seat_addr(0))
+        base_acks = int(sim.state.q_acks[0])
+        base_resps = int(sim.state.q_resps[0])
+        sim.run(1, chunk=1, with_metrics=False)
+        br.step()
+        st = br.query_status(0)
+        assert st["acks_total"] >= base_acks + 1
+        assert st["responses_total"] >= base_resps + 1
+        assert st["agent_acks"] == ["agent-x"]
+        assert st["agent_responses"] == {"agent-x": b"answer-bytes"}
+
+    def test_duplicate_agent_response_not_double_counted(self, serf_world):
+        sim, br, tr = serf_world
+        sim.query(jnp.arange(N) == 0, name=5)
+        qid = int(sim.state.q_open_key[0])
+        msg = codec.encode_serf_message(codec.SERF_QUERY_RESPONSE, {
+            "LTime": qid >> 9, "ID": qid, "From": "agent-x",
+            "Flags": 0, "Payload": b"a"})
+        tr.write_to(codec.encode_packet([msg]), seat_addr(0))
+        tr.write_to(codec.encode_packet([msg]), seat_addr(0))
+        sim.run(1, chunk=1, with_metrics=False)
+        br.step()
+        st = br.query_status(0)
+        assert list(st["agent_responses"]) == ["agent-x"]
+
+    def test_stale_response_to_closed_query_dropped(self, serf_world):
+        sim, br, tr = serf_world
+        msg = codec.encode_serf_message(codec.SERF_QUERY_RESPONSE, {
+            "LTime": 1, "ID": 0x999, "From": "agent-x",
+            "Flags": 0, "Payload": b"late"})
+        tr.write_to(codec.encode_packet([msg]), seat_addr(3))
+        sim.run(1, chunk=1, with_metrics=False)
+        br.step()  # must not raise, must not tally
+        assert int(sim.state.q_resps[3]) == 0
+
+    def test_agent_fired_query_disseminates_in_sim(self, serf_world):
+        sim, br, tr = serf_world
+        msg = codec.encode_serf_message(codec.SERF_QUERY, {
+            "LTime": 1, "ID": 7, "Addr": b"", "Port": 7946,
+            "Filters": [], "Flags": 0, "RelayFactor": 0,
+            "Timeout": 0, "Name": "who-has", "Payload": b"key7"})
+        tr.write_to(codec.encode_packet([msg]), seat_addr((SEAT + 1) % N))
+        for _ in pump(sim, br, tr, 50):
+            pass
+        # The seat's query opened on the device plane and collected
+        # responses from the sim members (deduped count).
+        st = br.query_status(SEAT)
+        assert st is not None
+        assert st["responses_total"] > N // 2
+        # The host tracker knows the seat fired it.
+        assert any(rec.get("origin_seat") == SEAT
+                   for rec in br.query_tracker.values())
+
+    def test_attached_seat_not_double_counted(self, serf_world):
+        """The device plane must NOT answer for an external seat (the
+        real agent answers over the wire): with one attached agent the
+        on-device tallies stop at N-2 (origin and the external seat
+        excluded), and the agent's wire response adds exactly one."""
+        sim, br, tr = serf_world
+        sim.query(jnp.arange(N) == 0, name=11)
+        qid = int(sim.state.q_open_key[0])
+        for _ in pump(sim, br, tr, 60):
+            pass
+        assert int(sim.state.q_acks[0]) == N - 2
+        assert int(sim.state.q_resps[0]) == N - 2
+        if int(sim.state.q_open_key[0]) == qid:  # still open: answer
+            msg = codec.encode_serf_message(codec.SERF_QUERY_RESPONSE, {
+                "LTime": qid >> 9, "ID": qid, "From": "the-agent",
+                "Flags": 0, "Payload": b"mine"})
+            tr.write_to(codec.encode_packet([msg]), seat_addr(0))
+            sim.run(1, chunk=1, with_metrics=False)
+            br.step()
+            assert int(sim.state.q_resps[0]) == N - 1
+            assert br.query_status(0)["agent_responses"] == {
+                "the-agent": b"mine"}

@@ -161,10 +161,13 @@ class TestSerfBudget:
         # q_open_key u32[N] + folded liveness u8[N]: 5 bytes/node total.
         assert volume["all-gather"] == 5 * cfg.n, volume
 
-    def test_exactly_one_reduce_scatter(self, compiled):
+    def test_exactly_two_reduce_scatters(self, compiled):
+        # The query ack and response tallies (serf/query.go acks vs
+        # responses channels) are two [N] scatter-adds -> two [N/D]
+        # reduce-scatters per tick.
         cfg, d, _, (counts, volume) = compiled
-        assert counts["reduce-scatter"] == 1, counts
-        assert volume["reduce-scatter"] == 4 * cfg.n // d, volume
+        assert counts["reduce-scatter"] == 2, counts
+        assert volume["reduce-scatter"] == 2 * 4 * cfg.n // d, volume
 
     def test_permute_bytes_bounded(self, compiled):
         cfg, d, _, (counts, volume) = compiled

@@ -138,6 +138,30 @@ class TestQueries:
         state = run(state, step, serf.query_timeout_ticks(cfg) + 2)
         assert int(state.q_open_key[0]) == 0
 
+    def test_acks_counted_beside_responses(self, vd):
+        # Every delivering member acks; with all nodes registered as
+        # responders the two tallies match (serf/query.go acks vs
+        # responses channels).
+        cfg, _, _, state, step = make_sim(vd=vd)
+        origin = jnp.arange(cfg.n) == 3
+        state = serf.query(cfg, state, origin, 17)
+        state = run(state, step, 40)
+        assert int(state.q_acks[3]) == cfg.n - 1
+        assert int(state.q_resps[3]) == cfg.n - 1
+
+    def test_non_responders_ack_but_do_not_answer(self, vd):
+        # Handler registration (q_responder): members without a handler
+        # still ack delivery but send no response.
+        cfg, _, _, state, step = make_sim(vd=vd)
+        half = jnp.arange(cfg.n) < cfg.n // 2
+        state = state._replace(q_responder=half)
+        origin = jnp.arange(cfg.n) == 1
+        state = serf.query(cfg, state, origin, 9)
+        state = run(state, step, 40)
+        assert int(state.q_acks[1]) == cfg.n - 1
+        # node 1 is itself in the responder half; it never self-counts.
+        assert int(state.q_resps[1]) == cfg.n // 2 - 1
+
 
 class TestLeaveAndReap:
     def test_graceful_leave_propagates_as_left(self, vd):
