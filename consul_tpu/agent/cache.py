@@ -135,7 +135,7 @@ class Cache:
         # Update in place + notify: parked get_blocking watchers hold a
         # reference to THIS entry's condition — replacing the object
         # would orphan them.
-        self._store(e, out, ttl_s)
+        self._store(e, out, ttl_s, now)
         if start_refresh:
             t = threading.Thread(
                 target=self._refresh_loop, args=(key, fetch, ttl_s),
@@ -145,11 +145,14 @@ class Cache:
         return out["value"]
 
     @staticmethod
-    def _store(e: CacheEntry, out: dict, ttl_s: float):
+    def _store(e: CacheEntry, out: dict, ttl_s: float,
+               now: Optional[float] = None):
+        # ``now`` honors a caller-driven clock (tests, deterministic
+        # drivers); the refresh loop passes None for real time.
         with e.changed:
             e.value = out["value"]
             e.index = out["index"]
-            e.expires_at = time.monotonic() + ttl_s
+            e.expires_at = (time.monotonic() if now is None else now) + ttl_s
             e.changed.notify_all()
 
     def _refresh_loop(self, key: str, fetch, ttl_s: float):
