@@ -37,6 +37,7 @@ analogue of "Consul agent running!" (command/agent/agent.go).
 from __future__ import annotations
 
 import json
+import os
 import signal
 import sys
 import threading
@@ -64,8 +65,60 @@ _DEFAULTS = {
     # join, "host:port" (reference -retry-join, resolved against the
     # RPC tier rather than gossip — the gossip seam is the bridge).
     "retry_join_rpc": [],
+    # TLS on the RPC wire (reference conn.go RPCTLS + tlsutil):
+    # server mode {"cert":..., "key":..., "ca":..., "require_tls": bool,
+    # "verify_incoming": bool} — require_tls refuses plaintext
+    # connections; verify_incoming additionally demands a client cert
+    # signed by the CA (the reference's VerifyIncoming, which is both).
+    # Client mode {"ca":..., ["cert":..., "key":...]} turns on the
+    # outgoing upgrade (cert/key only needed against verify_incoming
+    # servers).
+    "tls": None,
     "sim": None,
 }
+
+_TLS_KEYS = {"cert", "key", "ca", "require_tls", "verify_incoming"}
+
+
+def _validate_tls(cfg: dict):
+    """Eager config-time validation (load_config contract: a typo'd
+    key or missing material fails at boot, not as a handshake error
+    at first RPC)."""
+    t = cfg.get("tls")
+    if not t:
+        return
+    if not isinstance(t, dict):
+        raise ValueError("tls: must be an object")
+    unknown = sorted(set(t) - _TLS_KEYS)
+    if unknown:
+        raise ValueError(f"unknown tls config keys: {unknown}")
+    if cfg["server"]:
+        for k in ("cert", "key"):
+            if not t.get(k):
+                raise ValueError(f"tls.{k} is required in server mode")
+    elif not t.get("ca"):
+        raise ValueError(
+            "tls.ca is required in client mode — falling back to the "
+            "system trust store would never verify a cluster CA")
+    for k in ("cert", "key", "ca"):
+        if t.get(k) and not os.path.exists(t[k]):
+            raise ValueError(f"tls.{k}: no such file: {t[k]}")
+
+
+def _tls_for(cfg: dict, *, server: bool):
+    """Build the wire-TLS object from the agent config: a Configurator
+    (server mode, owns cert material) or a client SSLContext
+    (OutgoingRPCConfig with VerifyOutgoing)."""
+    t = cfg.get("tls")
+    if not t:
+        return None, False
+    if server:
+        from consul_tpu.utils.tls import Configurator
+        conf = Configurator(t["cert"], t["key"], ca=t.get("ca"),
+                            verify_incoming=bool(t.get("verify_incoming")))
+        return conf, bool(t.get("require_tls"))
+    from consul_tpu.utils.tls import client_ctx
+    return client_ctx(t["ca"], cert=t.get("cert"), key=t.get("key")), False
 
 
 def load_config(path: Optional[str], overrides: Optional[dict] = None) -> dict:
@@ -91,6 +144,7 @@ def load_config(path: Optional[str], overrides: Optional[dict] = None) -> dict:
         if not host or not port.isdigit():
             raise ValueError(
                 f"retry_join_rpc entry {addr!r} is not host:port")
+    _validate_tls(cfg)
     if cfg["sim"] is not None:
         # Validate the gossip tunables through the layered loader.
         config_loader.load(overrides=config_loader._flatten(cfg["sim"]))
@@ -159,8 +213,17 @@ class AgentRuntime:
         # client agents in OTHER processes dial this and speak
         # server/rpc_wire.py's msgpack-RPC.
         from consul_tpu.server.rpc_wire import RpcListener
+        tls, require_tls = _tls_for(cfg, server=True)
+
+        def _leader_store():
+            led = self.cluster.raft.leader() or self.cluster.raft.wait_converged()
+            return self.cluster.registry[led.id].store
+
         self.rpc_listener = RpcListener(
-            rpc, host=cfg["bind_addr"], port=int(cfg["rpc_port"]))
+            rpc, host=cfg["bind_addr"], port=int(cfg["rpc_port"]),
+            tls=tls, require_tls=require_tls,
+            snapshot_fn=lambda: _leader_store().snapshot(),
+            restore_fn=lambda snap: _leader_store().restore(snap))
         self.rpc_port = self.rpc_listener.port
         api_server = self.cluster.registry[
             self.cluster.raft.wait_converged().id]
@@ -174,10 +237,11 @@ class AgentRuntime:
         from consul_tpu.agent.pool import ServerPool
         from consul_tpu.server.rpc_wire import RpcClient, RpcWireError
 
+        tls, _ = _tls_for(self.cfg, server=False)
         clients = {}
         for addr in self.cfg["retry_join_rpc"]:
             host, _, port = str(addr).rpartition(":")
-            c = RpcClient(host or "127.0.0.1", int(port))
+            c = RpcClient(host or "127.0.0.1", int(port), tls=tls)
             clients[addr] = c.call
         pool = ServerPool(clients)
         self._pool = pool

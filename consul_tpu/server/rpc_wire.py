@@ -9,18 +9,38 @@ framework — the piece that makes a *separate-process* client agent
 real rather than an in-process import:
 
   - A server process runs one listener. The first byte of every
-    connection picks the protocol; RPC_CONSUL is implemented here
-    (the gossip bytes ride the PacketBridge seam, not this port).
+    connection picks the protocol. Three roles are implemented with
+    the reference's byte values (conn.go:3-30):
+      RPC_CONSUL  (0x00) — the msgpack-RPC request stream;
+      RPC_TLS     (0x03) — TLS upgrade: handshake, then read the
+                  *inner* first byte and dispatch again (the
+                  reference wraps the conn and re-reads the role,
+                  pool.go:307-315);
+      RPC_SNAPSHOT(0x05) — one-shot state snapshot save/restore
+                  (reference snapshot/snapshot.go:29,145 streamed
+                  over rpc.go:196's RPCSnapshot byte), so a client
+                  agent on the wire tier can save/restore without an
+                  HTTP listener.
+    The gossip bytes ride the PacketBridge seam, not this port.
   - Requests are length-prefixed msgpack envelopes
     ``{"seq", "method", "args"}`` answered by ``{"seq", "ok"}`` or a
     typed error — each request is served on its own thread, so
     pipelined blocking queries on one connection proceed concurrently,
-    the role yamux streams play in the reference.
+    the role yamux streams play in the reference. In-flight requests
+    per connection are CAPPED (yamux's stream window): beyond
+    ``max_inflight`` the server answers a typed ``busy`` error
+    immediately instead of spawning a thread, so a runaway or
+    malicious client cannot exhaust server threads.
   - The client keeps one connection, pipelines by seq, reconnects on
     failure, and surfaces typed errors (NotLeader, NoPathToDatacenter)
     as the same exceptions the in-process path raises — so
     agent/pool.py's ServerPool routing policy works unchanged over
-    real sockets.
+    real sockets. Unclassified *remote* errors raise
+    :class:`RpcRemoteError` (NOT a ConnectionError), so an
+    application bug on a healthy server does not make the pool rotate
+    it out as failed; ``busy`` raises :class:`RpcBusyError` (a
+    ConnectionError) because routing to a less-loaded server is the
+    right response to saturation.
 
 bytes round-trip natively (use_bin_type msgpack), so KV values and
 payloads cross the wire intact.
@@ -29,6 +49,7 @@ payloads cross the wire intact.
 from __future__ import annotations
 
 import socket
+import ssl
 import struct
 import threading
 from typing import Any, Callable, Optional
@@ -38,12 +59,30 @@ import msgpack
 from consul_tpu.server.endpoints import NoPathToDatacenter
 from consul_tpu.server.raft import NotLeader
 
-RPC_CONSUL = 0x00   # conn.go RPCConsul role: the msgpack-RPC stream
+# First-byte connection roles, byte values per reference
+# agent/pool/conn.go:3-30.
+RPC_CONSUL = 0x00
+RPC_TLS = 0x03
+RPC_SNAPSHOT = 0x05
 _MAX_FRAME = 64 << 20
+DEFAULT_MAX_INFLIGHT = 64  # yamux default stream window role
 
 
 class RpcWireError(ConnectionError):
     pass
+
+
+class RpcBusyError(ConnectionError):
+    """Server refused the request: per-connection in-flight cap hit.
+    A ConnectionError on purpose — the pool should rotate to a
+    less-loaded server, the same way yamux backpressure pushes load
+    elsewhere."""
+
+
+class RpcRemoteError(RuntimeError):
+    """The server hit an unclassified error serving the request. NOT a
+    ConnectionError: the server is healthy and reachable, so the pool
+    must not rotate it out as failed over an application bug."""
 
 
 def _send_frame(sock: socket.socket, obj: dict, lock: threading.Lock):
@@ -80,14 +119,38 @@ def _recv_frame(sock: socket.socket) -> dict:
 # ----------------------------------------------------------------------
 
 class RpcListener:
-    """One TCP listener serving RPC_CONSUL connections against
+    """One TCP listener demuxing connections by first byte against
     ``rpc_fn(method, **args)`` (a Server.rpc or a leader-routing
     closure). Unknown first bytes are dropped, like the reference's
-    demux rejecting unregistered protocol versions."""
+    demux rejecting unregistered protocol versions.
+
+    ``tls`` (a utils/tls.Configurator) enables the RPC_TLS upgrade
+    path; ``require_tls`` additionally refuses plaintext RPC_CONSUL
+    (during migration a server accepts both, conn.go RPCTLS +
+    pool.go:307-315). Client-certificate verification is the
+    Configurator's ``verify_incoming`` knob — require_tls alone
+    encrypts but does not authenticate peers; the reference's
+    VerifyIncoming is both together (tlsutil/config.go).
+    ``snapshot_fn``/``restore_fn`` serve the RPC_SNAPSHOT role.
+    """
 
     def __init__(self, rpc_fn: Callable[..., Any],
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 tls=None, require_tls: bool = False,
+                 max_inflight: int = DEFAULT_MAX_INFLIGHT,
+                 snapshot_fn: Optional[Callable[[], Any]] = None,
+                 restore_fn: Optional[Callable[[Any], Any]] = None):
+        if require_tls and tls is None:
+            raise ValueError("require_tls needs a TLS configurator")
         self.rpc_fn = rpc_fn
+        self.tls = tls
+        self.require_tls = require_tls
+        self.max_inflight = int(max_inflight)
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
+        self.metrics = {"busy_rejections": 0, "peak_inflight": 0,
+                        "tls_conns": 0, "plain_conns": 0}
+        self._mlock = threading.Lock()
         self._sock = socket.create_server((host, port))
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
@@ -104,24 +167,74 @@ class RpcListener:
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
-    def _serve_conn(self, conn: socket.socket):
-        wlock = threading.Lock()
+    def _serve_conn(self, conn: socket.socket, *, inside_tls=False):
         try:
             proto = _recv_exact(conn, 1)[0]
+            if proto == RPC_TLS and self.tls is not None and not inside_tls:
+                # TLS upgrade: handshake, then the client writes the
+                # real role byte inside the channel (pool.go:307-315).
+                conn.settimeout(10.0)
+                tconn = self.tls.incoming_ctx().wrap_socket(
+                    conn, server_side=True)
+                tconn.settimeout(None)
+                with self._mlock:
+                    self.metrics["tls_conns"] += 1
+                self._serve_conn(tconn, inside_tls=True)
+                return
+            if proto == RPC_SNAPSHOT:
+                if not inside_tls and self.require_tls:
+                    return
+                self._serve_snapshot(conn)
+                return
             if proto != RPC_CONSUL:
                 return  # unknown protocol byte: hang up
-            while not self._stop.is_set():
-                req = _recv_frame(conn)
-                threading.Thread(
-                    target=self._serve_one, args=(conn, wlock, req),
-                    daemon=True,
-                ).start()
-        except (RpcWireError, OSError):
+            if not inside_tls:
+                if self.require_tls:
+                    return  # plaintext refused (VerifyIncoming)
+                with self._mlock:
+                    self.metrics["plain_conns"] += 1
+            self._serve_rpc_stream(conn)
+        except (RpcWireError, OSError, ssl.SSLError):
             pass
         finally:
             conn.close()
 
-    def _serve_one(self, conn, wlock, req):
+    def _serve_rpc_stream(self, conn: socket.socket):
+        wlock = threading.Lock()
+        inflight = [0]
+        ilock = threading.Lock()
+        while not self._stop.is_set():
+            req = _recv_frame(conn)
+            with ilock:
+                admitted = inflight[0] < self.max_inflight
+                if admitted:
+                    inflight[0] += 1
+                    with self._mlock:
+                        self.metrics["peak_inflight"] = max(
+                            self.metrics["peak_inflight"], inflight[0])
+            if not admitted:
+                # Cap hit: answer busy INLINE, no thread spawned — the
+                # yamux stream-window refusal. The send happens OUTSIDE
+                # ilock: a client that stops draining its socket blocks
+                # this sendall, and workers finishing their requests
+                # must still be able to decrement the in-flight count.
+                with self._mlock:
+                    self.metrics["busy_rejections"] += 1
+                busy = {"seq": req.get("seq", 0), "err_type": "busy",
+                        "err": f"server busy: >{self.max_inflight} "
+                               "in-flight requests on connection"}
+                try:
+                    _send_frame(conn, busy, wlock)
+                except (OSError, RpcWireError):
+                    return
+                continue
+            threading.Thread(
+                target=self._serve_one,
+                args=(conn, wlock, req, inflight, ilock),
+                daemon=True,
+            ).start()
+
+    def _serve_one(self, conn, wlock, req, inflight, ilock):
         seq = req.get("seq", 0)
         try:
             out = self.rpc_fn(req["method"], **req.get("args", {}))
@@ -140,10 +253,39 @@ class RpcListener:
                     "app_class": type(e).__name__, "err": str(e)[:500]}
         except Exception as e:  # noqa: BLE001 — errors cross the wire
             resp = {"seq": seq, "err": repr(e)[:500]}
+        finally:
+            with ilock:
+                inflight[0] -= 1
         try:
             _send_frame(conn, resp, wlock)
         except (OSError, RpcWireError):
             pass  # client went away mid-call
+
+    def _serve_snapshot(self, conn: socket.socket):
+        """One-shot snapshot exchange (rpc.go:196 RPCSnapshot): a
+        single ``{"op": "save"}`` or ``{"op": "restore", "data": snap}``
+        frame, one reply, hang up."""
+        wlock = threading.Lock()
+        req = _recv_frame(conn)
+        op = req.get("op")
+        try:
+            if op == "save":
+                if self.snapshot_fn is None:
+                    raise ValueError("snapshot not served on this listener")
+                resp = {"ok": self.snapshot_fn()}
+            elif op == "restore":
+                if self.restore_fn is None:
+                    raise ValueError("restore not served on this listener")
+                self.restore_fn(req.get("data"))
+                resp = {"ok": True}
+            else:
+                raise ValueError(f"unknown snapshot op {op!r}")
+        except Exception as e:  # noqa: BLE001 — errors cross the wire
+            resp = {"err": repr(e)[:500]}
+        try:
+            _send_frame(conn, resp, wlock)
+        except (OSError, RpcWireError):
+            pass
 
     def close(self):
         self._stop.set()
@@ -157,16 +299,38 @@ class RpcListener:
 # Client side
 # ----------------------------------------------------------------------
 
+def _dial(addr, tls, role: int) -> socket.socket:
+    """Dial the RPC port in the given role, upgrading to TLS first
+    when a configurator is supplied (write RPC_TLS plaintext →
+    handshake → write the real role inside, pool.go:307-315)."""
+    sock = socket.create_connection(addr, timeout=10.0)
+    try:
+        if tls is not None:
+            sock.sendall(bytes([RPC_TLS]))
+            ctx = tls.outgoing_ctx() if hasattr(tls, "outgoing_ctx") else tls
+            sock = ctx.wrap_socket(sock, server_hostname=addr[0])
+        sock.sendall(bytes([role]))
+        sock.settimeout(None)
+        return sock
+    except (OSError, ssl.SSLError):
+        sock.close()
+        raise
+
+
 class RpcClient:
     """One pooled connection to a server's RPC port: pipelined seq-
     matched calls, lazy connect, reconnect-on-failure. The per-server
     callable shape (``call(method, **args)``) matches what
     agent/pool.ServerPool expects, so the reference's routing policy
-    (shuffle, rotate-past-failure, rebalance) composes directly."""
+    (shuffle, rotate-past-failure, rebalance) composes directly.
+    ``tls`` (utils/tls.Configurator or SSLContext) turns on the
+    RPC_TLS upgrade for every connection."""
 
-    def __init__(self, host: str, port: int, timeout_s: float = 30.0):
+    def __init__(self, host: str, port: int, timeout_s: float = 30.0,
+                 tls=None):
         self.addr = (host, int(port))
         self.timeout_s = timeout_s
+        self.tls = tls
         self._sock: Optional[socket.socket] = None
         self._wlock = threading.Lock()
         self._state_lock = threading.Lock()
@@ -177,9 +341,16 @@ class RpcClient:
         with self._state_lock:
             if self._sock is not None:
                 return
-            sock = socket.create_connection(self.addr, timeout=10.0)
-            sock.settimeout(None)
-            sock.sendall(bytes([RPC_CONSUL]))
+            try:
+                sock = _dial(self.addr, self.tls, RPC_CONSUL)
+            except ssl.SSLError as e:
+                raise RpcWireError(f"TLS handshake failed: {e}") from e
+            except OSError as e:
+                # TimeoutError and friends are OSError but NOT
+                # ConnectionError — normalize so the pool's
+                # rotate-past-failure policy sees a blackholed server
+                # the same as a refused one.
+                raise RpcWireError(f"dial failed: {e}") from e
             self._sock = sock
             threading.Thread(target=self._read_loop, args=(sock,),
                              daemon=True).start()
@@ -193,7 +364,7 @@ class RpcClient:
                 if slot is not None:
                     slot["resp"] = resp
                     slot["done"].set()
-        except (RpcWireError, OSError):
+        except (RpcWireError, OSError, ssl.SSLError):
             with self._state_lock:
                 if self._sock is sock:
                     self._sock = None
@@ -235,13 +406,15 @@ class RpcClient:
             raise NotLeader(resp.get("leader"))
         if resp.get("err_type") == "no_path":
             raise NoPathToDatacenter(resp.get("dc", "?"))
+        if resp.get("err_type") == "busy":
+            raise RpcBusyError(resp.get("err", "server busy"))
         if resp.get("err_type") == "app":
             cls = {"ValueError": ValueError, "KeyError": KeyError,
                    "TypeError": TypeError,
                    "AttributeError": AttributeError}.get(
                 resp.get("app_class", ""), ValueError)
             raise cls(resp.get("err", "remote application error"))
-        raise RpcWireError(resp.get("err", "unknown RPC error"))
+        raise RpcRemoteError(resp.get("err", "unknown RPC error"))
 
     def close(self):
         with self._state_lock:
@@ -251,3 +424,35 @@ class RpcClient:
                 sock.close()
             except OSError:
                 pass
+
+
+# ----------------------------------------------------------------------
+# Snapshot role client (one-shot per connection, snapshot/snapshot.go)
+# ----------------------------------------------------------------------
+
+def snapshot_save(host: str, port: int, tls=None) -> Any:
+    """Pull the server's state snapshot over the RPC port."""
+    return _snapshot_exchange((host, int(port)), tls, {"op": "save"})
+
+
+def snapshot_restore(host: str, port: int, snap: Any, tls=None) -> bool:
+    """Push a snapshot to the server over the RPC port."""
+    return _snapshot_exchange((host, int(port)), tls,
+                              {"op": "restore", "data": snap})
+
+
+def _snapshot_exchange(addr, tls, req: dict) -> Any:
+    try:
+        sock = _dial(addr, tls, RPC_SNAPSHOT)
+    except ssl.SSLError as e:
+        raise RpcWireError(f"TLS handshake failed: {e}") from e
+    except OSError as e:
+        raise RpcWireError(f"dial failed: {e}") from e
+    try:
+        _send_frame(sock, req, threading.Lock())
+        resp = _recv_frame(sock)
+    finally:
+        sock.close()
+    if "ok" in resp:
+        return resp["ok"]
+    raise RpcRemoteError(resp.get("err", "snapshot RPC failed"))

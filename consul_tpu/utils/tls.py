@@ -130,8 +130,20 @@ class Configurator:
 
     def outgoing_ctx(self) -> ssl.SSLContext:
         """Client-side context verifying against the CA
-        (OutgoingRPCConfig with VerifyOutgoing)."""
-        ctx = ssl.create_default_context(
-            cafile=self.ca) if self.ca else ssl.create_default_context()
-        ctx.check_hostname = False  # names are node ids, not DNS names
-        return ctx
+        (OutgoingRPCConfig with VerifyOutgoing); presents this node's
+        own cert so a VerifyIncoming peer accepts us."""
+        return client_ctx(self.ca, cert=self.cert, key=self.key)
+
+
+def client_ctx(ca: Optional[str], cert: Optional[str] = None,
+               key: Optional[str] = None) -> ssl.SSLContext:
+    """One shared recipe for outgoing RPC/HTTPS contexts (tlsutil
+    OutgoingRPCConfig): verify the server against ``ca``, optionally
+    present a client cert for VerifyIncoming servers. Hostname checks
+    stay off — names are node ids, not DNS names."""
+    ctx = ssl.create_default_context(cafile=ca) if ca \
+        else ssl.create_default_context()
+    ctx.check_hostname = False
+    if cert:
+        ctx.load_cert_chain(cert, key)
+    return ctx

@@ -15,7 +15,15 @@ import time
 import pytest
 
 from consul_tpu.server.endpoints import ServerCluster
-from consul_tpu.server.rpc_wire import RpcClient, RpcListener, RpcWireError
+from consul_tpu.server.rpc_wire import (
+    RpcBusyError,
+    RpcClient,
+    RpcListener,
+    RpcRemoteError,
+    RpcWireError,
+    snapshot_restore,
+    snapshot_save,
+)
 
 
 @pytest.fixture
@@ -108,6 +116,222 @@ class TestWire:
         s.close()
 
 
+class TestBackpressure:
+    """The per-connection in-flight cap (yamux stream-window role,
+    reference agent/pool/pool.go:122-533): beyond the cap the server
+    answers a typed busy error inline instead of spawning a thread."""
+
+    def test_flood_bounded_workers_and_busy_errors(self):
+        gate = threading.Event()
+
+        def slow_rpc(method, **args):
+            gate.wait(10.0)
+            return "done"
+
+        listener = RpcListener(slow_rpc, max_inflight=4)
+        client = RpcClient("127.0.0.1", listener.port, timeout_s=15.0)
+        results, errors = [], []
+
+        def call():
+            try:
+                results.append(client.call("Slow.Op"))
+            except RpcBusyError as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=call) for _ in range(12)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                listener.metrics["busy_rejections"] + \
+                listener.metrics["peak_inflight"] < 12:
+            time.sleep(0.02)
+        gate.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        # The cap bounded concurrent workers; the overflow got typed
+        # busy errors; every admitted request completed.
+        assert listener.metrics["peak_inflight"] <= 4
+        assert len(errors) == 12 - len(results) and errors
+        assert all(r == "done" for r in results)
+        client.close()
+        listener.close()
+
+    def test_busy_is_connection_error_remote_is_not(self):
+        """RpcBusyError rotates the pool (saturation → route away);
+        RpcRemoteError must NOT (healthy server, application bug)."""
+        assert issubclass(RpcBusyError, ConnectionError)
+        assert not issubclass(RpcRemoteError, ConnectionError)
+
+    def test_unclassified_remote_error_does_not_rotate_pool(self):
+        """An rpc_fn raising an unexpected error class reaches the
+        client as RpcRemoteError, and a ServerPool keeps the server at
+        the head (no failure rotation on app bugs)."""
+        from consul_tpu.agent.pool import ServerPool
+
+        def buggy(method, **args):
+            raise OSError("disk exploded server-side")  # not app-typed
+
+        listener = RpcListener(buggy)
+        client = RpcClient("127.0.0.1", listener.port)
+        pool = ServerPool({"s1": client.call, "s2": client.call})
+        head = pool.current()
+        with pytest.raises(RpcRemoteError, match="disk exploded"):
+            pool.rpc("Anything.Goes")
+        assert pool.current() == head  # no rotation
+        client.close()
+        listener.close()
+
+    def test_long_polls_unaffected_under_cap(self, wired):
+        """A blocking query parked server-side still wakes on write
+        while the connection serves other calls (cap default 64)."""
+        _, client = wired
+        client.call("KVS.Apply", op="set", key="bp", value=b"v0")
+        time.sleep(0.2)
+        idx = client.call("KVS.Get", key="bp")["index"]
+        got = {}
+
+        def blocked():
+            got["out"] = client.call("KVS.Get", key="bp", min_index=idx,
+                                     wait_s=8.0)
+
+        th = threading.Thread(target=blocked)
+        th.start()
+        time.sleep(0.2)
+        client.call("KVS.Apply", op="set", key="bp", value=b"v1")
+        th.join(timeout=10.0)
+        assert got["out"]["value"]["value"] == b"v1"
+
+
+class TestTLSWire:
+    """RPCTLS first-byte upgrade (reference agent/pool/conn.go:3-30,
+    pool.go:307-315, tlsutil/config.go): handshake then re-read the
+    inner role byte; server accepts both during migration unless
+    require_tls."""
+
+    @pytest.fixture(scope="class")
+    def tls_material(self, tmp_path_factory):
+        from consul_tpu.utils.tls import Configurator, dev_ca
+        paths = dev_ca(str(tmp_path_factory.mktemp("wire_tls")))
+        return Configurator(paths["cert"], paths["key"], ca=paths["ca"])
+
+    @pytest.fixture
+    def tls_wired(self, tls_material):
+        cluster = ServerCluster(3, seed=23)
+        cluster.wait_converged()
+        stop = threading.Event()
+
+        def pump():
+            while not stop.is_set():
+                cluster.step()
+                time.sleep(0.002)
+
+        threading.Thread(target=pump, daemon=True).start()
+
+        def rpc(method, **args):
+            led = cluster.raft.wait_converged()
+            return cluster.registry[led.id].rpc(method, **args)
+
+        def store():
+            return cluster.registry[cluster.raft.wait_converged().id].store
+
+        listener = RpcListener(
+            rpc, tls=tls_material,
+            snapshot_fn=lambda: store().snapshot(),
+            restore_fn=lambda s: store().restore(s))
+        yield cluster, listener, tls_material
+        stop.set()
+        listener.close()
+
+    def test_tls_roundtrip(self, tls_wired):
+        _, listener, conf = tls_wired
+        client = RpcClient("127.0.0.1", listener.port, tls=conf)
+        idx = client.call("KVS.Apply", op="set", key="t", value=b"\x01tls")
+        assert isinstance(idx, int)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            out = client.call("KVS.Get", key="t")
+            if out["value"] is not None:
+                break
+            time.sleep(0.01)
+        assert out["value"]["value"] == b"\x01tls"
+        assert listener.metrics["tls_conns"] == 1
+        client.close()
+
+    def test_migration_plaintext_still_accepted(self, tls_wired):
+        _, listener, _ = tls_wired
+        client = RpcClient("127.0.0.1", listener.port)  # no TLS
+        assert client.call("Status.Leader")
+        assert listener.metrics["plain_conns"] >= 1
+        client.close()
+
+    def test_require_tls_refuses_plaintext(self, tls_material):
+        listener = RpcListener(lambda m, **a: "ok", tls=tls_material,
+                               require_tls=True)
+        plain = RpcClient("127.0.0.1", listener.port)
+        with pytest.raises((RpcWireError, ConnectionError)):
+            plain.call("Status.Leader")
+        plain.close()
+        secure = RpcClient("127.0.0.1", listener.port, tls=tls_material)
+        assert secure.call("Status.Leader") == "ok"
+        secure.close()
+        listener.close()
+
+    def test_verify_incoming_demands_client_cert(self, tmp_path):
+        """verify_incoming (reference tlsutil VerifyIncoming): an
+        anonymous TLS client is refused at handshake; one presenting a
+        CA-signed cert gets through."""
+        from consul_tpu.utils.tls import Configurator, client_ctx, dev_ca
+
+        paths = dev_ca(str(tmp_path / "mtls"))
+        conf = Configurator(paths["cert"], paths["key"], ca=paths["ca"],
+                            verify_incoming=True)
+        listener = RpcListener(lambda m, **a: "ok", tls=conf,
+                               require_tls=True)
+        anon = RpcClient("127.0.0.1", listener.port,
+                         tls=client_ctx(paths["ca"]))
+        with pytest.raises((RpcWireError, ConnectionError)):
+            anon.call("Status.Leader")
+        anon.close()
+        # The dev server cert is CA-signed, so it serves as a client
+        # cert here (auto-encrypt hands agents certs from the same CA).
+        withcert = RpcClient(
+            "127.0.0.1", listener.port,
+            tls=client_ctx(paths["ca"], cert=paths["cert"],
+                           key=paths["key"]))
+        assert withcert.call("Status.Leader") == "ok"
+        withcert.close()
+        listener.close()
+
+    def test_snapshot_over_wire_and_tls(self, tls_wired):
+        """RPC_SNAPSHOT role (reference rpc.go:196, snapshot/
+        snapshot.go:29,145): save over TLS, restore into a fresh
+        cluster over the wire."""
+        cluster, listener, conf = tls_wired
+        client = RpcClient("127.0.0.1", listener.port, tls=conf)
+        client.call("KVS.Apply", op="set", key="snapk", value=b"snapv")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if client.call("KVS.Get", key="snapk")["value"] is not None:
+                break
+            time.sleep(0.01)
+        snap = snapshot_save("127.0.0.1", listener.port, tls=conf)
+        assert snap["tables"]["kv"]["snapk"]["value"]["value"] == b"snapv"
+        client.close()
+
+        other = ServerCluster(1, seed=29)
+        other.wait_converged()
+        led = other.raft.wait_converged()
+        lst2 = RpcListener(
+            lambda m, **a: other.registry[led.id].rpc(m, **a),
+            snapshot_fn=lambda: other.registry[led.id].store.snapshot(),
+            restore_fn=lambda s: other.registry[led.id].store.restore(s))
+        assert snapshot_restore("127.0.0.1", lst2.port, snap) is True
+        got = other.registry[led.id].store.kv_get("snapk")
+        assert got["value"] == b"snapv"
+        lst2.close()
+
+
 class TestClientAgentProcess:
     """The agent story made real: one server process, one client-mode
     agent process joined over the RPC wire, CLI talking to the CLIENT's
@@ -181,3 +405,69 @@ class TestClientAgentProcess:
         out = self._cli(env, cready["http_port"], "info")
         assert out.returncode == 0
         assert "leader = srv" in out.stdout
+
+
+class TestClientAgentProcessTLS:
+    """The same three-process story with the RPC port encrypted and
+    plaintext REFUSED (reference tlsutil VerifyIncoming on the RPC
+    port, conn.go RPCTLS)."""
+
+    @pytest.fixture(scope="class")
+    def tls_duo(self, tmp_path_factory):
+        from consul_tpu.utils.tls import dev_ca
+
+        tmp = tmp_path_factory.mktemp("tls_duo")
+        paths = dev_ca(str(tmp / "ca"))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        scfg = tmp / "server.json"
+        scfg.write_text(json.dumps({
+            "node_name": "tls-srv", "n_servers": 3,
+            "http": {"host": "127.0.0.1", "port": 0}, "rpc_port": 0,
+            "tls": {"cert": paths["cert"], "key": paths["key"],
+                    "ca": paths["ca"], "require_tls": True},
+        }))
+        server = subprocess.Popen(
+            [sys.executable, "-m", "consul_tpu.cli", "agent",
+             "--config-file", str(scfg)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        sready = json.loads(server.stdout.readline())
+
+        ccfg = tmp / "client.json"
+        ccfg.write_text(json.dumps({
+            "node_name": "tls-cli", "server": False,
+            "retry_join_rpc": [f"127.0.0.1:{sready['rpc_port']}"],
+            "http": {"host": "127.0.0.1", "port": 0},
+            "tls": {"ca": paths["ca"]},
+        }))
+        client = subprocess.Popen(
+            [sys.executable, "-m", "consul_tpu.cli", "agent",
+             "--config-file", str(ccfg)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        cready = json.loads(client.stdout.readline())
+        yield sready, cready, env
+        for p in (client, server):
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+                p.wait(timeout=15)
+
+    def _cli(self, env, port, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "consul_tpu.cli",
+             "--http-addr", f"127.0.0.1:{port}", *args],
+            capture_output=True, text=True, env=env, timeout=30)
+
+    def test_write_rides_tls_end_to_end(self, tls_duo):
+        sready, cready, env = tls_duo
+        r = self._cli(env, cready["http_port"], "kv", "put", "tk", "tv")
+        assert r.returncode == 0, r.stderr
+        out = self._cli(env, sready["http_port"], "kv", "get", "tk")
+        assert out.returncode == 0 and out.stdout.strip() == "tv"
+
+    def test_plaintext_client_refused(self, tls_duo):
+        sready, _, _ = tls_duo
+        plain = RpcClient("127.0.0.1", sready["rpc_port"])  # no TLS
+        with pytest.raises((RpcWireError, ConnectionError)):
+            plain.call("Status.Leader")
+        plain.close()
