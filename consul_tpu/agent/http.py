@@ -373,6 +373,42 @@ class HTTPApi:
                 return 404, {"error": f"unknown service {parts[3]}"}, {}
             return 200, True, {}
 
+        # ---- operator raft / autopilot (reference operator_raft_
+        # endpoint.go, operator_autopilot_endpoint.go; routes
+        # http_register.go /v1/operator/*) ------------------------------
+        if parts == ["operator", "raft", "configuration"]:
+            return 200, rpc("Operator.RaftGetConfiguration"), {}
+        if parts == ["operator", "raft", "peer"] and method == "DELETE":
+            if "id" not in q:
+                return 400, {"error": "?id= required"}, {}
+            _, _ = self._rpc_write("Operator.RaftRemovePeer", id=q["id"])
+            return 200, True, {}
+        if parts == ["operator", "autopilot", "configuration"]:
+            if method == "GET":
+                return 200, rpc("Operator.AutopilotGetConfiguration"), {}
+            if method == "PUT":
+                cas = int(q["cas"]) if "cas" in q else None
+                _, ok = self._rpc_write(
+                    "Operator.AutopilotSetConfiguration",
+                    config=json.loads(body or b"{}"), cas_index=cas)
+                # ?cas returns the verdict like the reference (a bare
+                # set returns true).
+                return 200, bool(ok), {}
+
+        # ---- internal (reference internal_endpoint.go NodeInfo/
+        # NodeDump via /v1/internal/ui/*) --------------------------------
+        if parts == ["internal", "ui", "nodes"]:
+            out = rpc("Internal.NodeDump", min_index=min_index,
+                      wait_s=wait_s)
+            return 200, out["value"], {"X-Consul-Index": str(out["index"])}
+        if len(parts) == 4 and parts[:3] == ["internal", "ui", "node"]:
+            out = rpc("Internal.NodeInfo", node=parts[3],
+                      min_index=min_index, wait_s=wait_s)
+            rows = out["value"]
+            if not rows:
+                return 404, {"error": f"unknown node {parts[3]}"}, {}
+            return 200, rows[0], {"X-Consul-Index": str(out["index"])}
+
         if parts == ["operator", "keyring"]:
             # Reference operator/keyring (agent/operator_endpoint.go):
             # GET=list, POST=install, PUT=use, DELETE=remove, each a

@@ -51,6 +51,7 @@ class Client:
         self.agent = AgentAPI(self)
         self.operator = Operator(self)
         self.config = ConfigEntries(self)
+        self.internal = Internal(self)
 
     def _call(self, method: str, path: str, params: Optional[dict] = None,
               body: Optional[bytes] = None) -> tuple[Any, QueryMeta, int]:
@@ -346,6 +347,46 @@ class Operator:
 
     def keyring_remove(self, key_b64: str) -> bool:
         return self._keyring_op("DELETE", key_b64)
+
+    # Raft + autopilot operator surface (reference api/operator_raft.go,
+    # api/operator_autopilot.go).
+    def raft_get_configuration(self) -> dict:
+        out, _, _ = self.c._call("GET", "/v1/operator/raft/configuration")
+        return out
+
+    def raft_remove_peer(self, id: str) -> bool:
+        out, _, _ = self.c._call(
+            "DELETE", "/v1/operator/raft/peer", {"id": id})
+        return bool(out)
+
+    def autopilot_get_configuration(self) -> dict:
+        out, _, _ = self.c._call(
+            "GET", "/v1/operator/autopilot/configuration")
+        return out
+
+    def autopilot_set_configuration(self, config: dict,
+                                    cas: Optional[int] = None) -> bool:
+        out, _, _ = self.c._call(
+            "PUT", "/v1/operator/autopilot/configuration",
+            {"cas": cas} if cas is not None else None,
+            json.dumps(config).encode())
+        return bool(out)
+
+
+class Internal:
+    """The combined node+services+checks dump (reference
+    internal_endpoint.go NodeInfo/NodeDump via /v1/internal/ui/*)."""
+
+    def __init__(self, c: Client):
+        self.c = c
+
+    def node_dump(self):
+        out, meta, _ = self.c._call("GET", "/v1/internal/ui/nodes")
+        return out, meta
+
+    def node_info(self, node: str):
+        out, meta, _ = self.c._call("GET", f"/v1/internal/ui/node/{node}")
+        return out, meta
 
 
 class Lock:

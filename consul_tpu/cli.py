@@ -32,7 +32,7 @@ import json
 import os
 import sys
 
-from consul_tpu.api import Client
+from consul_tpu.api import APIError, Client
 from consul_tpu.server.rtt import compute_distance
 
 
@@ -220,16 +220,49 @@ def cmd_force_leave(client: Client, args) -> int:
 
 
 def cmd_operator(client: Client, args) -> int:
-    """Operator subcommands (reference command/operator raft)."""
+    """Operator subcommands (reference command/operator raft,
+    command/operator autopilot)."""
     if args.operator_cmd == "raft" and args.raft_cmd == "list-peers":
-        leader = client.status.leader()
-        if not leader:
+        cfg = client.operator.raft_get_configuration()
+        if not any(s["leader"] for s in cfg["servers"]):
             print("error: no cluster leader", file=sys.stderr)
             return 1
-        for p in client.status.peers():
-            role = "leader" if p == leader else "follower"
-            print(f"{p:<12} {role}")
+        for s in cfg["servers"]:
+            role = "leader" if s["leader"] else (
+                "follower" if s["voter"] else "non-voter")
+            print(f"{s['id']:<12} {s['address']:<16} {role}")
         return 0
+    if args.operator_cmd == "raft" and args.raft_cmd == "remove-peer":
+        try:
+            client.operator.raft_remove_peer(args.id)
+        except APIError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(f"Removed peer with id {args.id!r}")
+        return 0
+    if args.operator_cmd == "autopilot" and args.autopilot_cmd == "get-config":
+        cfg = client.operator.autopilot_get_configuration()
+        for k in sorted(cfg):
+            print(f"{k} = {cfg[k]}")
+        return 0
+    if args.operator_cmd == "autopilot" and args.autopilot_cmd == "set-config":
+        cfg = client.operator.autopilot_get_configuration()
+        # Read-modify-write under CAS (reference operator autopilot
+        # set-config uses AutopilotCASConfiguration): a concurrent
+        # set-config loses loudly instead of silently reverting fields.
+        cas = cfg.pop("modify_index", 0)
+        if args.cleanup_dead_servers is not None:
+            cfg["cleanup_dead_servers"] = \
+                args.cleanup_dead_servers == "true"
+        if args.server_stabilization_ticks is not None:
+            cfg["server_stabilization_ticks"] = \
+                args.server_stabilization_ticks
+        if args.max_trailing_logs is not None:
+            cfg["max_trailing_logs"] = args.max_trailing_logs
+        ok = client.operator.autopilot_set_configuration(cfg, cas=cas)
+        print("Configuration updated!" if ok else "error: CAS failed "
+              "(config changed concurrently — retry)")
+        return 0 if ok else 1
     raise AssertionError(args.operator_cmd)
 
 
@@ -502,6 +535,16 @@ def build_parser() -> argparse.ArgumentParser:
     raft_p = op_sub.add_parser("raft")
     raft_sub = raft_p.add_subparsers(dest="raft_cmd", required=True)
     raft_sub.add_parser("list-peers")
+    rp = raft_sub.add_parser("remove-peer")
+    rp.add_argument("-id", required=True)
+    ap_p = op_sub.add_parser("autopilot")
+    ap_sub = ap_p.add_subparsers(dest="autopilot_cmd", required=True)
+    ap_sub.add_parser("get-config")
+    sc = ap_sub.add_parser("set-config")
+    sc.add_argument("-cleanup-dead-servers", choices=["true", "false"],
+                    default=None)
+    sc.add_argument("-server-stabilization-ticks", type=int, default=None)
+    sc.add_argument("-max-trailing-logs", type=int, default=None)
 
     mt = sub.add_parser("maint", help="toggle maintenance mode")
     mt.add_argument("-disable", action="store_true")

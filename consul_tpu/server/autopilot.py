@@ -28,6 +28,17 @@ LAST_CONTACT_THRESHOLD_TICKS = 10
 MAX_TRAILING_LOGS = 250
 SERVER_STABILIZATION_TICKS = 30
 
+# The operator-settable subset (reference autopilot/structs.go Config;
+# operator_autopilot_endpoint.go get/set). Stored raft-replicated in
+# the state store's autopilot table; the Autopilot loop re-reads it
+# each pass when wired with config_fn.
+DEFAULT_AUTOPILOT_CONFIG = {
+    "cleanup_dead_servers": True,
+    "last_contact_threshold_ticks": LAST_CONTACT_THRESHOLD_TICKS,
+    "max_trailing_logs": MAX_TRAILING_LOGS,
+    "server_stabilization_ticks": SERVER_STABILIZATION_TICKS,
+}
+
 
 def fetch_stats(cluster: RaftCluster) -> dict[str, Optional[dict]]:
     """StatsFetcher (reference agent/consul/stats_fetcher.go:1-90): poll
@@ -60,11 +71,15 @@ class ServerHealth:
 
 def server_health(cluster: RaftCluster, node: RaftNode,
                   leader: RaftNode,
-                  stats: Optional[dict] = None) -> ServerHealth:
+                  stats: Optional[dict] = None,
+                  max_trailing: int = MAX_TRAILING_LOGS,
+                  contact_threshold: int = LAST_CONTACT_THRESHOLD_TICKS,
+                  ) -> ServerHealth:
     """Health verdict for one server from the leader's vantage point,
     scored from *fetched stats* (reference autopilot.go
     updateServerHealth consuming the StatsFetcher's ServerStats:
-    last-index lag, term agreement, last leader contact)."""
+    last-index lag, term agreement, last leader contact). The
+    thresholds are the operator-settable autopilot knobs."""
     st = (stats or fetch_stats(cluster)).get(node.id)
     if st is None:
         return ServerHealth(node.id, False, node.voter, None, 0,
@@ -75,10 +90,10 @@ def server_health(cluster: RaftCluster, node: RaftNode,
     if st["term"] != leader.term:
         return ServerHealth(node.id, False, node.voter, None, trailing,
                             f"term {st['term']} != leader term {leader.term}")
-    if trailing > MAX_TRAILING_LOGS:
+    if trailing > max_trailing:
         return ServerHealth(node.id, False, node.voter, None, trailing,
                             f"trailing {trailing} logs")
-    if st["contact_age"] > LAST_CONTACT_THRESHOLD_TICKS:
+    if st["contact_age"] > contact_threshold:
         return ServerHealth(node.id, False, node.voter,
                             st["contact_age"], trailing,
                             f"no leader contact for {st['contact_age']} ticks")
@@ -104,15 +119,25 @@ def can_remove_servers(n_peers: int, n_remove: int) -> bool:
 
 
 def remove_server(cluster: RaftCluster, server_id: str) -> None:
-    """Apply the membership change: drop the server from every peer
-    list and the transport (raft-lite's out-of-band reconfiguration)."""
-    for node in cluster.nodes.values():
-        if server_id in node.peers:
-            node.peers.remove(server_id)
-        node.voters.discard(server_id)
-        node._persist_stable()  # shrunk voter config must survive crash
-        node.next_index.pop(server_id, None)
-        node.match_index.pop(server_id, None)
+    """Apply the membership change as a replicated configuration entry
+    (reference raft RemoveServer appends a LogConfiguration entry):
+    every member — including one crashed mid-change, which recovers
+    the entry from its persisted log — drops the server from its peer
+    list and voter set at append time. The transport-level cleanup
+    (queues, node object) stays a cluster-harness concern."""
+    from consul_tpu.server.raft import RAFT_CONFIG
+
+    if server_id not in cluster.nodes:
+        return
+    led = cluster.wait_leader()
+    led.propose({"type": RAFT_CONFIG, "op": "remove", "id": server_id})
+    for _ in range(400):
+        live = [n for n in cluster.nodes.values()
+                if not n.stopped and n.id != server_id]
+        if all(server_id not in n.voters and server_id not in n.peers
+               for n in live):
+            break
+        cluster.step()
     node = cluster.nodes.pop(server_id, None)
     if node is not None:
         node.stop()
@@ -147,10 +172,18 @@ class Autopilot:
 
     def __init__(self, cluster: RaftCluster,
                  stabilization_ticks: int = SERVER_STABILIZATION_TICKS,
-                 cleanup_dead_servers: bool = True):
+                 cleanup_dead_servers: bool = True,
+                 config_fn=None):
         self.cluster = cluster
         self.stabilization_ticks = stabilization_ticks
         self.cleanup_dead_servers = cleanup_dead_servers
+        # Live operator configuration (reference autopilot reads the
+        # raft-replicated config each pass): a callable returning the
+        # current config dict, e.g. a Server's
+        # Operator.AutopilotGetConfiguration.
+        self.config_fn = config_fn
+        self.max_trailing_logs = MAX_TRAILING_LOGS
+        self.last_contact_threshold_ticks = LAST_CONTACT_THRESHOLD_TICKS
         self._ticks = 0
         self._healthy_since: dict[str, int] = {}
         self.promoted: list[str] = []
@@ -160,13 +193,28 @@ class Autopilot:
         """One autopilot pass (the leader's periodic serverHealthLoop,
         reference autopilot.go:73-120). Call at the cluster-step cadence."""
         self._ticks += 1
+        if self.config_fn is not None:
+            cfg = self.config_fn()
+            self.stabilization_ticks = int(
+                cfg.get("server_stabilization_ticks",
+                        self.stabilization_ticks))
+            self.cleanup_dead_servers = bool(
+                cfg.get("cleanup_dead_servers", self.cleanup_dead_servers))
+            self.max_trailing_logs = int(
+                cfg.get("max_trailing_logs", self.max_trailing_logs))
+            self.last_contact_threshold_ticks = int(
+                cfg.get("last_contact_threshold_ticks",
+                        self.last_contact_threshold_ticks))
         leader = self.cluster.leader()
         if leader is None:
             return
         stats = fetch_stats(self.cluster)
         healths = {
             h.id: h for h in (
-                server_health(self.cluster, n, leader, stats)
+                server_health(
+                    self.cluster, n, leader, stats,
+                    max_trailing=self.max_trailing_logs,
+                    contact_threshold=self.last_contact_threshold_ticks)
                 for n in self.cluster.nodes.values()
             )
         }

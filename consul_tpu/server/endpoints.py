@@ -390,6 +390,92 @@ class Server:
         )
 
     # ------------------------------------------------------------------
+    # Operator endpoint (reference agent/consul/operator_raft_endpoint.go
+    # :1-89 RaftGetConfiguration/RaftRemovePeerByAddress,
+    # operator_autopilot_endpoint.go:1-76 get/set autopilot config)
+    # ------------------------------------------------------------------
+    def _operator_raft_get_configuration(self) -> dict:
+        """The raft membership as this server's raft layer sees it:
+        id/address/leader/voter per server (reference
+        operator_raft_endpoint.go:1-50 resolving serf members against
+        raft.GetConfiguration)."""
+        n = self.raft
+        servers = []
+        for sid in sorted({n.id, *n.peers}):
+            servers.append({
+                "id": sid, "node": sid, "address": sid,
+                "leader": sid == n.leader_id,
+                "voter": sid in n.voters,
+            })
+        return {"index": n.commit_index, "servers": servers}
+
+    def _operator_raft_remove_peer(self, id: str) -> int:
+        """Kick a peer out of the raft configuration (reference
+        operator_raft_endpoint.go:52-89 RaftRemovePeerByAddress — the
+        stuck-server escape hatch). Rides the replicated configuration
+        entry; quorum-guarded like autopilot's cleanup."""
+        from consul_tpu.server.autopilot import can_remove_servers
+        from consul_tpu.server.raft import RAFT_CONFIG
+
+        n = self.raft
+        if id not in {n.id, *n.peers}:
+            raise ValueError(f"id {id!r} is not a raft peer")
+        if id in n.voters and not can_remove_servers(len(n.voters), 1):
+            raise ValueError(
+                f"removing {id!r} would leave fewer than a quorum of "
+                f"the {len(n.voters)}-voter configuration")
+        return self._raft_apply({"type": RAFT_CONFIG, "op": "remove",
+                                 "id": id})
+
+    def _operator_autopilot_get_configuration(self) -> dict:
+        from consul_tpu.server.autopilot import DEFAULT_AUTOPILOT_CONFIG
+        stored = self.store.autopilot_get()
+        return dict(DEFAULT_AUTOPILOT_CONFIG, **(stored or {}))
+
+    def _operator_autopilot_set_configuration(
+            self, config: dict, cas_index: Optional[int] = None) -> int:
+        from consul_tpu.server.autopilot import DEFAULT_AUTOPILOT_CONFIG
+        # modify_index is part of what GET returns (the struct's raft
+        # index, like the reference Config.ModifyIndex) — accept the
+        # round-trip, it is not a settable field.
+        config = {k: v for k, v in config.items() if k != "modify_index"}
+        unknown = sorted(set(config) - set(DEFAULT_AUTOPILOT_CONFIG))
+        if unknown:
+            raise ValueError(f"unknown autopilot config keys: {unknown}")
+        cmd = {"type": fsm_mod.AUTOPILOT,
+               "config": dict(DEFAULT_AUTOPILOT_CONFIG, **config)}
+        if cas_index is not None:
+            cmd["cas_index"] = cas_index
+        return self._raft_apply(cmd)
+
+    # ------------------------------------------------------------------
+    # Internal endpoint (reference agent/consul/internal_endpoint.go:
+    # 1-100 NodeInfo/NodeDump — the combined node+services+checks view
+    # the UI and `consul debug` read)
+    # ------------------------------------------------------------------
+    def _node_dump_row(self, nd: dict) -> dict:
+        name = nd["node"]
+        return {"node": name, "address": nd.get("address", ""),
+                "meta": nd.get("meta", {}),
+                "services": self.store.node_services(name),
+                "checks": self.store.checks(node=name)}
+
+    def _internal_node_info(self, node: str, min_index: int = 0,
+                            wait_s: float = 10.0) -> dict:
+        def fn():
+            nd = self.store.get_node(node)
+            return [] if nd is None else [self._node_dump_row(nd)]
+        return self._blocking(["nodes", "services", "checks"],
+                              min_index, wait_s, fn)
+
+    def _internal_node_dump(self, min_index: int = 0,
+                            wait_s: float = 10.0) -> dict:
+        return self._blocking(
+            ["nodes", "services", "checks"], min_index, wait_s,
+            lambda: [self._node_dump_row(nd) for nd in
+                     sorted(self.store.nodes(), key=lambda d: d["node"])])
+
+    # ------------------------------------------------------------------
     # Coordinate endpoint (reference agent/consul/coordinate_endpoint.go)
     # ------------------------------------------------------------------
     def _coordinate_update(self, node: str, coord: dict,

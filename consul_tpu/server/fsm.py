@@ -21,6 +21,7 @@ KV = "kv"
 SESSION = "session"
 COORDINATE_BATCH_UPDATE = "coordinate-batch-update"
 CONFIG_ENTRY = "config-entry"
+AUTOPILOT = "autopilot"
 TXN = "txn"
 
 # Tables each op type can write (for scoped TXN undo logs). KV ops can
@@ -122,6 +123,14 @@ class FSM:
             _, ok = self.store.config_set(
                 command["kind"], command["name"], command["entry"],
                 cas_index=cas, index=index)
+            return ok
+        if mtype == AUTOPILOT:
+            # Operator autopilot configuration (reference
+            # fsm applyAutopilotUpdate, operator_autopilot_endpoint.go):
+            # CAS evaluated deterministically at apply time.
+            _, ok = self.store.autopilot_set(
+                command["config"], cas_index=command.get("cas_index"),
+                index=index)
             return ok
         if mtype == TXN:
             # All-or-nothing batch (reference agent/consul/txn_endpoint.go)

@@ -29,6 +29,26 @@ FOLLOWER = "follower"
 CANDIDATE = "candidate"
 LEADER = "leader"
 
+# Membership/suffrage changes ride the log as configuration entries
+# (reference raft configuration LogConfiguration entries): command
+# ``{"type": RAFT_CONFIG, "op": promote|demote|add_nonvoter|remove,
+# "id": node_id}``. They apply when APPENDED, not when committed
+# (hashicorp raft's configurations.latest semantics), so the voter set
+# a node computes quorum from always reflects the latest change it has
+# seen — and a node crashed mid-change recovers it from its own
+# persisted log (or from catch-up replication) instead of restarting
+# with a stale out-of-band voter set, which could yield two disjoint
+# majorities. Divergence, documented: a truncated uncommitted config
+# entry is not rolled back (at most one change is in flight through
+# the cluster-level APIs, so the conflict window does not arise).
+RAFT_CONFIG = "raft_config"
+
+
+def _config_cmd(command: Any) -> Optional[dict]:
+    if isinstance(command, dict) and command.get("type") == RAFT_CONFIG:
+        return command
+    return None
+
 HEARTBEAT_TICKS = 2
 ELECTION_TICKS_MIN = 10
 ELECTION_TICKS_MAX = 20
@@ -178,6 +198,14 @@ class RaftNode:
             self.log = [LogEntry(**e) for e in rec["entries"]]
             self.log_base_index = rec["base_index"]
             self.log_base_term = rec["base_term"]
+            # Re-apply configuration entries from the recovered log:
+            # suffrage persisted in the stable store already reflects
+            # them (persist happens at apply), but replay covers a
+            # crash between log append and the stable write.
+            for e in self.log:
+                cfg = _config_cmd(e.command)
+                if cfg is not None:
+                    self._apply_config(cfg)
             self.pending_snapshot = rec["snapshot"]
             if rec["snapshot"] is not None and self.restore_fn is not None:
                 self.restore_fn(rec["snapshot"])
@@ -193,6 +221,45 @@ class RaftNode:
             )
         self._reset_election_timer()
         transport.register(self)
+
+    def _apply_config(self, cmd: dict):
+        """Apply one configuration entry to this node's view of the
+        membership (reference raft appendConfigurationEntry →
+        configurations.latest). Idempotent; persisted immediately so a
+        crash cannot roll suffrage back."""
+        op, sid = cmd["op"], cmd["id"]
+        if op == "promote":
+            self.voters.add(sid)
+            if sid == self.id:
+                self.voter = True
+        elif op == "demote":
+            self.voters.discard(sid)
+            if sid == self.id:
+                self.voter = False
+        elif op == "add_nonvoter":
+            if sid != self.id and sid not in self.peers:
+                self.peers.append(sid)
+        elif op == "remove":
+            self.voters.discard(sid)
+            if sid == self.id:
+                if self.state == LEADER:
+                    # A leader removing itself stays on just long
+                    # enough to commit and answer the entry (hashicorp
+                    # raft removes the leader only after the config
+                    # entry commits); the halt happens at commit in
+                    # _apply_committed.
+                    pass
+                else:
+                    # A removed server halts (Consul shuts it down via
+                    # serf leave after RemoveServer).
+                    self.stopped = True
+            elif sid in self.peers:
+                self.peers.remove(sid)
+            self.next_index.pop(sid, None)
+            self.match_index.pop(sid, None)
+        else:
+            raise ValueError(f"unknown raft_config op {op!r}")
+        self._persist_stable()
 
     def _persist_stable(self):
         if self.store is not None:
@@ -310,6 +377,12 @@ class RaftNode:
             self.log.append(entry)
             self._persist_append([entry])
             self._broadcast_appends()
+            # Configuration entries take effect at append (after the
+            # broadcast, so a leader proposing its own removal still
+            # ships the entry before halting).
+            cfg = _config_cmd(command)
+            if cfg is not None:
+                self._apply_config(cfg)
             self._advance_commit()  # no-op unless we alone are a quorum
             return entry.index
 
@@ -327,7 +400,13 @@ class RaftNode:
                     "install_snapshot", self.id, peer, self.term,
                     {"snapshot": self.pending_snapshot,
                      "last_index": self.log_base_index,
-                     "last_term": self.log_base_term},
+                     "last_term": self.log_base_term,
+                     # Config entries behind the compaction horizon are
+                     # gone from the log; the current membership rides
+                     # the snapshot (reference raft snapshots embed the
+                     # configuration).
+                     "voters": sorted(self.voters),
+                     "members": sorted({self.id, *self.peers})},
                 ))
             return
         prev_index = nxt - 1
@@ -344,6 +423,16 @@ class RaftNode:
     # Message handling
     # ------------------------------------------------------------------
     def handle(self, msg: Message):
+        if msg.mtype == "request_vote" and msg.src not in self.voters:
+            # A server outside the voter configuration cannot start an
+            # election we honor (hashicorp raft ignores RequestVote
+            # from non-members) — a removed-but-alive server must not
+            # inflate terms or win votes. Reply without a term bump.
+            self.transport.send(Message(
+                "vote_reply", self.id, msg.src, self.term,
+                {"granted": False},
+            ))
+            return
         if msg.term > self.term:
             self.term = msg.term
             self.state = FOLLOWER
@@ -426,6 +515,10 @@ class RaftNode:
             self._persist_log_rewrite()  # conflict suffix must not revive
         elif added:
             self._persist_append(added)
+        for e in added:
+            cfg = _config_cmd(e.command)
+            if cfg is not None:
+                self._apply_config(cfg)  # config applies at append
         match = p["prev_index"] + len(p["entries"])
         if p["commit_index"] > self.commit_index:
             self.commit_index = min(p["commit_index"], self.last_log_index())
@@ -468,7 +561,17 @@ class RaftNode:
         while self.last_applied < self.commit_index:
             self.last_applied += 1
             entry = self.entry_at(self.last_applied)
-            if entry is not None and entry.command != {"type": "noop"}:
+            if entry is None or entry.command == {"type": "noop"}:
+                continue
+            cfg = _config_cmd(entry.command)
+            if cfg is not None:
+                # Configuration entries applied at append; at commit
+                # they only resolve the raftApply future — and complete
+                # a leader's deferred self-removal.
+                result = {"ok": True, "op": cfg.get("op")}
+                if cfg["op"] == "remove" and cfg["id"] == self.id:
+                    self.stopped = True
+            else:
                 try:
                     result = self.apply_fn(entry.index, entry.command)
                 except Exception as e:  # noqa: BLE001
@@ -478,9 +581,9 @@ class RaftNode:
                     # the real gate, this is the backstop.
                     self.apply_errors.append((entry.index, repr(e)))
                     result = {"error": repr(e)}
-                self.apply_results[entry.index] = result
-                while len(self.apply_results) > self.apply_results_cap:
-                    self.apply_results.pop(next(iter(self.apply_results)))
+            self.apply_results[entry.index] = result
+            while len(self.apply_results) > self.apply_results_cap:
+                self.apply_results.pop(next(iter(self.apply_results)))
         self._maybe_compact()
 
     # ------------------------------------------------------------------
@@ -518,6 +621,12 @@ class RaftNode:
         self.commit_index = p["last_index"]
         self.last_applied = p["last_index"]
         self.pending_snapshot = p["snapshot"]
+        if "voters" in p:
+            self.voters = set(p["voters"])
+            self.voter = self.id in self.voters
+            self.peers = [m for m in p.get("members", [self.id, *self.peers])
+                          if m != self.id]
+            self._persist_stable()
         if self.store is not None:
             self.store.save_snapshot(
                 p["snapshot"], p["last_index"], p["last_term"]
@@ -592,17 +701,37 @@ class RaftCluster:
         for other in self.nodes.values():
             if other.id != node_id and node_id not in other.peers:
                 other.peers.append(node_id)
+        # Record the membership in the log too, so a member crashed
+        # right now still learns of the new peer on restart/replay.
+        led = self.leader()
+        if led is not None:
+            led.propose({"type": RAFT_CONFIG, "op": "add_nonvoter",
+                         "id": node_id})
         return node
 
     def promote(self, node_id: str) -> None:
         """Grant suffrage (reference raft AddVoter on promotion,
-        autopilot.go:256-320): flips the shared voter configuration on
-        every member — raft-lite's out-of-band reconfiguration,
-        persisted per node so a crash cannot roll suffrage back."""
-        self.nodes[node_id].voter = True
-        for node in self.nodes.values():
-            node.voters.add(node_id)
-            node._persist_stable()
+        autopilot.go:256-320) — a replicated configuration entry: the
+        change reaches every member, including ones crashed mid-change,
+        through the log rather than out-of-band mutation (the
+        split-brain a stale restarted voter set could otherwise
+        cause). Synchronous: steps until every running member has
+        adopted the new configuration."""
+        if node_id not in self.nodes:
+            raise ValueError(f"unknown server {node_id!r}")
+        led = self.wait_leader()
+        idx = led.propose({"type": RAFT_CONFIG, "op": "promote",
+                           "id": node_id})
+        target = self.nodes[node_id]
+        for _ in range(400):
+            # Wait for commit + the target's own adoption; a
+            # partitioned *other* member catches up later via normal
+            # replication — best-effort after the cap, like
+            # remove_server, never an exception that would kill an
+            # autopilot loop.
+            if led.commit_index >= idx and node_id in target.voters:
+                return
+            self.step()
 
     def crash(self, node_id: str):
         """Hard-kill: the in-memory RaftNode object is discarded (its

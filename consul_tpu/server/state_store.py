@@ -65,6 +65,7 @@ class StateStore:
         "sessions",       # session id -> {node, ttl, behavior, checks}
         "coordinates",    # node[:segment] -> coordinate dict
         "config_entries",  # kind/name -> entry
+        "autopilot",      # "config" -> operator autopilot configuration
     )
 
     def __init__(self):
@@ -492,6 +493,26 @@ class StateStore:
                     return self.index, False
             return self._commit("config_entries", f"{kind}/{name}", None,
                                 delete=True, index=index), True
+
+    def autopilot_set(self, config: dict, cas_index: Optional[int] = None,
+                      index: Optional[int] = None) -> tuple[int, bool]:
+        """Operator autopilot configuration (reference
+        state/autopilot.go AutopilotCASConfig: CAS on the modify
+        index, 0 = only-if-absent)."""
+        with self._lock:
+            if cas_index is not None:
+                e = self.tables["autopilot"].rows.get("config")
+                if (e.modify_index if e else 0) != cas_index:
+                    return self.index, False
+            return self._commit("autopilot", "config", config,
+                                index=index), True
+
+    def autopilot_get(self) -> Optional[dict]:
+        with self._lock:
+            e = self.tables["autopilot"].rows.get("config")
+            if e is None:
+                return None
+            return dict(e.value, modify_index=e.modify_index)
 
     def config_get(self, kind: str, name: str) -> Optional[dict]:
         with self._lock:

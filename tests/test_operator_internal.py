@@ -1,0 +1,357 @@
+"""Operator + Internal endpoint families (reference
+agent/consul/operator_raft_endpoint.go:1-89 RaftGetConfiguration /
+RaftRemovePeerByAddress, operator_autopilot_endpoint.go:1-76 autopilot
+get/set, internal_endpoint.go:1-100 NodeInfo/NodeDump): the day-2
+operator surface over the raft mechanics that already existed."""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu.server import autopilot
+from consul_tpu.server.endpoints import ServerCluster
+
+
+@pytest.fixture
+def cluster():
+    c = ServerCluster(3, seed=11)
+    c.wait_converged()
+    return c
+
+
+class TestOperatorRaft:
+    def test_get_configuration_lists_members(self, cluster):
+        led = cluster.leader_server()
+        cfg = led.rpc("Operator.RaftGetConfiguration")
+        assert [s["id"] for s in cfg["servers"]] == ["srv0", "srv1", "srv2"]
+        assert sum(s["leader"] for s in cfg["servers"]) == 1
+        assert all(s["voter"] for s in cfg["servers"])
+        lead_row = next(s for s in cfg["servers"] if s["leader"])
+        assert lead_row["id"] == led.id
+
+    def test_configuration_from_follower_view(self, cluster):
+        fol = cluster.any_follower()
+        cfg = fol.rpc("Operator.RaftGetConfiguration")
+        assert len(cfg["servers"]) == 3
+        assert next(s for s in cfg["servers"] if s["leader"])["id"] == \
+            cluster.leader_server().id
+
+    def test_remove_live_follower_converges(self, cluster):
+        """The VERDICT acceptance case: kick a live follower out via
+        the operator surface; the change replicates as a raft config
+        entry and the two survivors keep committing."""
+        led = cluster.leader_server()
+        victim = cluster.any_follower()
+        idx = led.rpc("Operator.RaftRemovePeer", id=victim.id)
+        for _ in range(200):
+            cluster.step()
+            if victim.raft.stopped and led.raft.commit_index >= idx:
+                break
+        # The victim applied its own removal and halted.
+        assert victim.raft.stopped
+        assert victim.id not in led.raft.voters
+        assert victim.id not in led.raft.peers
+        # Cluster of two keeps working (quorum 2 of 2).
+        cluster.write(led, "KVS.Apply", op="set", key="after", value=b"x")
+        assert led.store.kv_get("after")["value"] == b"x"
+        cfg = led.rpc("Operator.RaftGetConfiguration")
+        assert victim.id not in [s["id"] for s in cfg["servers"]]
+
+    def test_remove_leader_itself_answers_then_halts(self, cluster):
+        """Removing the leader is allowed (reference RaftRemovePeer):
+        the leader stays on just long enough to COMMIT and answer the
+        entry, then halts; the survivors elect a successor."""
+        led = cluster.leader_server()
+        idx = led.rpc("Operator.RaftRemovePeer", id=led.id)
+        for _ in range(300):
+            cluster.step()
+            if led.raft.stopped:
+                break
+        assert led.raft.stopped
+        # The entry committed on the ex-leader, so its apply result
+        # resolved (no 'apply result unavailable' for a success).
+        res = led.raft.apply_results.get(idx)
+        assert res == {"ok": True, "op": "remove"}
+        new_led = cluster.raft.wait_converged()
+        assert new_led.id != led.id
+        assert led.id not in new_led.voters
+        cluster.write(cluster.registry[new_led.id], "KVS.Apply",
+                      op="set", key="post-leader-removal", value=b"y")
+
+    def test_remove_unknown_peer_is_an_error(self, cluster):
+        led = cluster.leader_server()
+        with pytest.raises(ValueError, match="not a raft peer"):
+            led.rpc("Operator.RaftRemovePeer", id="srv9")
+
+    def test_remove_guard_refuses_quorum_break(self, cluster):
+        """Sequential removals stop when the survivors would no longer
+        be a quorum of the current configuration (reference autopilot
+        canRemoveServers guard applied to the operator path)."""
+        led = cluster.leader_server()
+        victim = cluster.any_follower()
+        led.rpc("Operator.RaftRemovePeer", id=victim.id)
+        cluster.step(50)
+        second = next(s for s in cluster.servers
+                      if s.id not in (led.id, victim.id))
+        with pytest.raises(ValueError, match="quorum"):
+            led.rpc("Operator.RaftRemovePeer", id=second.id)
+
+    def test_remove_forwards_from_follower(self, cluster):
+        """The endpoint rides _raft_apply, so a follower accepts the
+        call and forwards to the leader (rpc.go:231 forward)."""
+        led = cluster.leader_server()
+        fol = cluster.any_follower()
+        other = next(s for s in cluster.servers
+                     if s.id not in (led.id, fol.id))
+        fol.rpc("Operator.RaftRemovePeer", id=other.id)
+        for _ in range(200):
+            cluster.step()
+            if other.raft.stopped:
+                break
+        assert other.id not in led.raft.voters
+
+
+class TestOperatorAutopilot:
+    def test_get_returns_defaults_when_unset(self, cluster):
+        led = cluster.leader_server()
+        cfg = led.rpc("Operator.AutopilotGetConfiguration")
+        assert cfg == autopilot.DEFAULT_AUTOPILOT_CONFIG
+
+    def test_set_replicates_and_cas(self, cluster):
+        led = cluster.leader_server()
+        cluster.write(led, "Operator.AutopilotSetConfiguration",
+                      config={"cleanup_dead_servers": False})
+        # Every replica serves the stored config (raft-replicated).
+        for s in cluster.servers:
+            got = s.rpc("Operator.AutopilotGetConfiguration")
+            assert got["cleanup_dead_servers"] is False
+        # CAS on the stored modify index: stale index loses.
+        stored = led.store.autopilot_get()
+        out = cluster.write(led, "Operator.AutopilotSetConfiguration",
+                            config={"max_trailing_logs": 99},
+                            cas_index=stored["modify_index"])
+        res = led.rpc("Status.ApplyResult", index=out)
+        assert res["found"] and res["result"] is True
+        out2 = cluster.write(led, "Operator.AutopilotSetConfiguration",
+                             config={"max_trailing_logs": 7},
+                             cas_index=stored["modify_index"])  # stale
+        res2 = led.rpc("Status.ApplyResult", index=out2)
+        assert res2["found"] and res2["result"] is False
+        assert led.rpc("Operator.AutopilotGetConfiguration")[
+            "max_trailing_logs"] == 99
+
+    def test_get_put_roundtrip_accepts_modify_index(self, cluster):
+        """The standard CAS flow — GET the config, PUT it back — must
+        not be rejected over the modify_index the GET included."""
+        led = cluster.leader_server()
+        cluster.write(led, "Operator.AutopilotSetConfiguration",
+                      config={"cleanup_dead_servers": False})
+        got = led.rpc("Operator.AutopilotGetConfiguration")
+        assert "modify_index" in got
+        out = cluster.write(led, "Operator.AutopilotSetConfiguration",
+                            config=got, cas_index=got["modify_index"])
+        res = led.rpc("Status.ApplyResult", index=out)
+        assert res["found"] and res["result"] is True
+
+    def test_operator_knobs_drive_health_scoring(self, cluster):
+        """max_trailing_logs set via the operator surface changes the
+        health verdicts the autopilot loop computes (the knob is live,
+        not just stored)."""
+        led = cluster.leader_server()
+        ap = autopilot.Autopilot(
+            cluster.raft,
+            config_fn=lambda: led.rpc("Operator.AutopilotGetConfiguration"))
+        cluster.write(led, "Operator.AutopilotSetConfiguration",
+                      config={"max_trailing_logs": 0,
+                              "cleanup_dead_servers": False})
+        ap.run()
+        assert ap.max_trailing_logs == 0
+        assert ap.last_contact_threshold_ticks == \
+            autopilot.LAST_CONTACT_THRESHOLD_TICKS
+
+    def test_unknown_keys_rejected(self, cluster):
+        led = cluster.leader_server()
+        with pytest.raises(ValueError, match="unknown autopilot"):
+            led.rpc("Operator.AutopilotSetConfiguration",
+                    config={"redundancy_zones": True})
+
+    def test_autopilot_loop_reads_live_config(self, cluster):
+        """The Autopilot loop re-reads the operator config each pass
+        (config_fn wiring): flipping cleanup_dead_servers off stops
+        dead-server pruning."""
+        led = cluster.leader_server()
+        ap = autopilot.Autopilot(
+            cluster.raft,
+            config_fn=lambda: led.rpc("Operator.AutopilotGetConfiguration"))
+        cluster.write(led, "Operator.AutopilotSetConfiguration",
+                      config={"cleanup_dead_servers": False})
+        victim = cluster.any_follower()
+        victim.raft.stop()
+        for _ in range(60):
+            cluster.step()
+            ap.run()
+        assert ap.removed == [] and victim.id in cluster.raft.nodes
+        assert ap.cleanup_dead_servers is False
+
+
+class TestInternal:
+    def test_node_dump_aggregates(self, cluster):
+        led = cluster.leader_server()
+        cluster.write(led, "Catalog.Register", node="n1", address="10.0.0.1",
+                      service={"service": "web", "port": 80},
+                      check={"check_id": "web-up", "status": "passing",
+                             "service_id": "web"})
+        cluster.write(led, "Catalog.Register", node="n2", address="10.0.0.2")
+        out = led.rpc("Internal.NodeDump")
+        rows = out["value"]
+        assert [r["node"] for r in rows] == ["n1", "n2"]
+        n1 = rows[0]
+        assert n1["address"] == "10.0.0.1"
+        assert [s["service"] for s in n1["services"]] == ["web"]
+        assert [c["check_id"] for c in n1["checks"]] == ["web-up"]
+        assert rows[1]["services"] == [] and rows[1]["checks"] == []
+
+    def test_node_info_single(self, cluster):
+        led = cluster.leader_server()
+        cluster.write(led, "Catalog.Register", node="n1", address="a",
+                      service={"service": "db", "port": 5432})
+        out = led.rpc("Internal.NodeInfo", node="n1")
+        assert len(out["value"]) == 1
+        assert out["value"][0]["services"][0]["service"] == "db"
+        assert led.rpc("Internal.NodeInfo", node="ghost")["value"] == []
+
+    def test_node_dump_blocks_until_change(self, cluster):
+        led = cluster.leader_server()
+        cluster.write(led, "Catalog.Register", node="n1", address="a")
+        idx = led.rpc("Internal.NodeDump")["index"]
+        got = {}
+
+        def blocked():
+            t0 = time.monotonic()
+            got["out"] = led.rpc("Internal.NodeDump", min_index=idx,
+                                 wait_s=8.0)
+            got["dt"] = time.monotonic() - t0
+
+        th = threading.Thread(target=blocked)
+        th.start()
+        time.sleep(0.2)
+        stop = threading.Event()
+
+        def pump():
+            while not stop.is_set():
+                cluster.step()
+                time.sleep(0.002)
+
+        pt = threading.Thread(target=pump, daemon=True)
+        pt.start()
+        led.rpc("Catalog.Register", node="n2", address="b")
+        th.join(timeout=10.0)
+        stop.set()
+        assert got["dt"] < 5.0
+        assert [r["node"] for r in got["out"]["value"]] == ["n1", "n2"]
+
+
+class TestHTTPAndCLISurface:
+    """The /v1/operator/raft/*, /v1/operator/autopilot/*, and
+    /v1/internal/ui/* routes (reference http_register.go) plus the
+    operator CLI verbs, over a live HTTP agent."""
+
+    @pytest.fixture
+    def served(self):
+        from consul_tpu.agent.agent import Agent
+        from consul_tpu.agent.http import HTTPApi, serve
+
+        cluster = ServerCluster(3, seed=13)
+        cluster.wait_converged()
+        stop = threading.Event()
+
+        def pump():
+            while not stop.is_set():
+                cluster.step()
+                time.sleep(0.002)
+
+        threading.Thread(target=pump, daemon=True).start()
+
+        def rpc(method, **args):
+            led = cluster.raft.wait_converged()
+            return cluster.registry[led.id].rpc(method, **args)
+
+        def wait_write(idx):
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                led = cluster.raft.leader()
+                if led is not None and led.last_applied >= idx:
+                    return
+                time.sleep(0.002)
+
+        agent = Agent("op-agent", "127.0.0.1", rpc, cluster_size=3)
+        api = HTTPApi(agent, server=cluster.leader_server(),
+                      wait_write=wait_write)
+        httpd, port = serve(api, "127.0.0.1", 0)
+        yield cluster, port
+        stop.set()
+        httpd.shutdown()
+
+    def test_http_raft_configuration_and_remove(self, served):
+        from consul_tpu.api import Client
+
+        cluster, port = served
+        client = Client("127.0.0.1", port)
+        cfg = client.operator.raft_get_configuration()
+        assert len(cfg["servers"]) == 3
+        victim = next(s["id"] for s in cfg["servers"] if not s["leader"])
+        assert client.operator.raft_remove_peer(victim) is True
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            cfg = client.operator.raft_get_configuration()
+            if len(cfg["servers"]) == 2:
+                break
+            time.sleep(0.05)
+        assert victim not in [s["id"] for s in cfg["servers"]]
+
+    def test_http_autopilot_roundtrip(self, served):
+        from consul_tpu.api import Client
+
+        _, port = served
+        client = Client("127.0.0.1", port)
+        cfg = client.operator.autopilot_get_configuration()
+        assert cfg["cleanup_dead_servers"] is True
+        assert client.operator.autopilot_set_configuration(
+            {"server_stabilization_ticks": 77}) is True
+        got = client.operator.autopilot_get_configuration()
+        assert got["server_stabilization_ticks"] == 77
+
+    def test_http_internal_ui_nodes(self, served):
+        from consul_tpu.api import Client
+
+        cluster, port = served
+        client = Client("127.0.0.1", port)
+        led = cluster.leader_server()
+        led.rpc("Catalog.Register", node="web-1", address="10.1.1.1",
+                service={"service": "web", "port": 80})
+        deadline = time.monotonic() + 5
+        rows = []
+        while time.monotonic() < deadline:
+            rows, _ = client.internal.node_dump()
+            if rows:
+                break
+            time.sleep(0.05)
+        assert rows and rows[0]["node"] == "web-1"
+        info, _ = client.internal.node_info("web-1")
+        assert info["services"][0]["service"] == "web"
+
+    def test_cli_operator_verbs(self, served, capsys):
+        from consul_tpu.cli import main as cli_main
+
+        _, port = served
+        addr = ["--http-addr", f"127.0.0.1:{port}"]
+        assert cli_main([*addr, "operator", "raft", "list-peers"]) == 0
+        out = capsys.readouterr().out
+        assert out.count("\n") == 3 and "leader" in out
+        assert cli_main([*addr, "operator", "autopilot", "get-config"]) == 0
+        assert "cleanup_dead_servers = True" in capsys.readouterr().out
+        assert cli_main([*addr, "operator", "autopilot", "set-config",
+                         "-max-trailing-logs", "123"]) == 0
+        assert cli_main([*addr, "operator", "autopilot", "get-config"]) == 0
+        assert "max_trailing_logs = 123" in capsys.readouterr().out

@@ -175,9 +175,23 @@ class TestApplySafety:
 
         cluster, _ = make_cluster()
         led = cluster.wait_converged()
-        led.handle(Message("request_vote", "srvX", led.id, led.term + 1,
+        rival = next(p for p in led.peers)
+        led.handle(Message("request_vote", rival, led.id, led.term + 1,
                            {"last_log_index": 10**6, "last_log_term": 10**6}))
         assert led.state == "follower" and led.leader_id is None
+
+    def test_non_member_request_vote_ignored(self):
+        """A server outside the voter configuration must not inflate
+        terms or depose leaders (hashicorp raft ignores RequestVote
+        from non-members — the removed-but-alive server case)."""
+        from consul_tpu.server.raft import Message
+
+        cluster, _ = make_cluster()
+        led = cluster.wait_converged()
+        term = led.term
+        led.handle(Message("request_vote", "srvX", led.id, led.term + 5,
+                           {"last_log_index": 10**6, "last_log_term": 10**6}))
+        assert led.state == "leader" and led.term == term
 
 
 class TestSnapshot:
@@ -401,6 +415,33 @@ class TestDurability:
         # applied.
         assert fsms[lid].store.get_node("orphan") is None
         assert all(f.store.get_node("orphan") is None for f in fsms.values())
+
+    def test_suffrage_change_reaches_node_crashed_during_change(self, tmp_path):
+        """The split-brain scenario config-entry replication exists to
+        prevent: srv2 crashes, the cluster promotes a 4th voter, srv2
+        restarts with its stale 3-voter persisted set — the promote
+        rides the LOG, so catch-up replication corrects srv2's voter
+        configuration instead of leaving two disjoint quorum views."""
+        cluster, _ = self._durable_cluster(tmp_path)
+        cluster.wait_leader()
+        cluster.add_nonvoter("srv3")
+        cluster.step(30)
+        cluster.crash("srv2")
+        cluster.step(30)  # leadership settles among srv0/srv1
+        cluster.promote("srv3")
+        led = cluster.leader()
+        assert "srv3" in led.voters and len(led.voters) == 4
+        node = cluster.restart_from_disk("srv2")
+        # Fresh from disk: stale 3-voter view (crashed before the change).
+        assert "srv3" not in node.voters
+        cluster.step(80)
+        # Catch-up replication delivered the config entry.
+        assert "srv3" in node.voters and len(node.voters) == 4
+        # And the cluster commits with the 4-voter quorum everywhere.
+        idx = cluster.propose_and_commit(reg("post-change"))
+        cluster.step(20)
+        assert all(n.last_applied >= idx
+                   for n in cluster.nodes.values() if not n.stopped)
 
     def test_nonvoter_suffrage_survives_crash_restart(self, tmp_path):
         """A crashed non-voter must come back as a non-voter (suffrage
