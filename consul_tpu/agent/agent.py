@@ -95,6 +95,20 @@ class Agent:
         # to config_loader.apply_safe on its Simulation; returns the
         # list of applied knob paths.
         self.reload_hook: Optional[Callable[[], list]] = None
+        # Post-boot join (reference /v1/agent/join + `consul join`):
+        # a client-mode boot wires this to add a server RPC address to
+        # the connection pool at runtime; None = not joinable this way
+        # (server mode federates via bridge/federate()).
+        self.join_hook: Optional[Callable[[str], bool]] = None
+
+    def join(self, address: str) -> bool:
+        """Join this agent to a server set (reference agent.JoinLAN,
+        agent/agent.go; here the wire-tier re-aim of retry_join_rpc)."""
+        if self.join_hook is None:
+            raise ValueError(
+                "join is a client-mode verb (a server federates via "
+                "the bridge/WAN configuration)")
+        return bool(self.join_hook(address))
 
     def _register_cache_types(self):
         """The typed cache entries this agent serves (reference

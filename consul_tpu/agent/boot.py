@@ -134,21 +134,25 @@ def load_config(path: Optional[str], overrides: Optional[dict] = None) -> dict:
         cfg.update(doc)
         cfg["http"] = http
     cfg.update(overrides or {})
-    if not cfg["server"] and not cfg["retry_join_rpc"]:
-        raise ValueError(
-            "server: false requires retry_join_rpc addresses — a client "
-            "agent is only an agent if it can reach a server's RPC port"
-        )
+    # Client mode with NO retry_join_rpc boots solo: every RPC fails
+    # with NoServersError until a post-boot `consul-tpu join`
+    # (/v1/agent/join) routes it onto a server set.
     for addr in cfg["retry_join_rpc"]:
-        host, _, port = str(addr).rpartition(":")
-        if not host or not port.isdigit():
-            raise ValueError(
-                f"retry_join_rpc entry {addr!r} is not host:port")
+        _parse_hostport(addr, field="retry_join_rpc entry")
     _validate_tls(cfg)
     if cfg["sim"] is not None:
         # Validate the gossip tunables through the layered loader.
         config_loader.load(overrides=config_loader._flatten(cfg["sim"]))
     return cfg
+
+
+def _parse_hostport(addr: str, field: str = "address") -> tuple[str, int]:
+    """One shared host:port parse for config validation, dialing, and
+    the join verb — identical acceptance everywhere."""
+    host, _, port = str(addr).rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"{field} {addr!r} is not host:port")
+    return host, int(port)
 
 
 class AgentRuntime:
@@ -173,6 +177,7 @@ class AgentRuntime:
             cluster_size=int(cfg["n_servers"]),
         )
         self.agent.reload_hook = self._reload
+        self.agent.join_hook = getattr(self, "_join", None)
         self.api = HTTPApi(self.agent, server=api_server,
                            wait_write=wait_write)
         self.httpd = None
@@ -238,13 +243,34 @@ class AgentRuntime:
         from consul_tpu.server.rpc_wire import RpcClient, RpcWireError
 
         tls, _ = _tls_for(self.cfg, server=False)
-        clients = {}
-        for addr in self.cfg["retry_join_rpc"]:
-            host, _, port = str(addr).rpartition(":")
-            c = RpcClient(host or "127.0.0.1", int(port), tls=tls)
-            clients[addr] = c.call
-        pool = ServerPool(clients)
+
+        def dial(addr: str):
+            host, port = _parse_hostport(addr)
+            return RpcClient(host, port, tls=tls).call
+
+        pool = ServerPool({addr: dial(addr)
+                           for addr in self.cfg["retry_join_rpc"]})
         self._pool = pool
+
+        def join(addr: str) -> bool:
+            """The /v1/agent/join verb: aim this client at another
+            server's RPC address at runtime (reference agent.JoinLAN;
+            here the pool gains a member, reference AddServer). The
+            target is PROBED first — `consul join` errors on an
+            unreachable address rather than polluting the pool with a
+            dead entry every rebalance would rotate back to the head."""
+            host, port = _parse_hostport(addr, field="join address")
+            probe = RpcClient(host, port, timeout_s=5.0, tls=tls)
+            try:
+                probe.call("Status.Leader")
+            except (ConnectionError, OSError) as e:
+                probe.close()
+                raise ValueError(
+                    f"join {addr}: server unreachable ({e})") from e
+            pool.add(addr, probe.call)
+            return True
+
+        self._join = join
 
         def rpc(method, **args):
             return pool.rpc(method, **args)
@@ -290,10 +316,17 @@ class AgentRuntime:
         """Continuous raft/timer advance (the goroutine tickers of
         reference agent/consul/server.go collapse into one pump)."""
         while not self._stop.is_set():
-            self.cluster.step()
-            led = self.cluster.raft.leader()
-            if led is not None and led.id in self.cluster.registry:
-                self.cluster.registry[led.id].flush_coordinates()
+            try:
+                self.cluster.step()
+                led = self.cluster.raft.leader()
+                if led is not None and led.id in self.cluster.registry:
+                    self.cluster.registry[led.id].flush_coordinates()
+            except Exception as e:  # noqa: BLE001
+                # A pump death would leave the agent serving HTTP with
+                # raft frozen (writes hang with no diagnostic) — log
+                # and keep pumping; consensus state is unharmed.
+                print(f"agent: raft pump error: {e!r}", file=sys.stderr)
+                time.sleep(0.1)
             time.sleep(0.002)
 
     def _reload(self) -> list:

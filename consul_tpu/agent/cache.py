@@ -83,14 +83,33 @@ class Cache:
         background refresh query. Returns ``{"index", "value"}``."""
         t = self._types[name]
         key = self._key(name, req)
+        if not t.refresh:
+            # A non-refresh type has no background loop to advance the
+            # entry — a parked read would only ever time out. Serve the
+            # blocking read directly (the reference requires refresh
+            # cache-types for background blocking support).
+            out = t.fetch_factory(**req)(min_index, wait_s)
+            return {"index": out["index"], "value": out["value"],
+                    "hit": False}
         with self._lock:
             hit = key in self._entries
-        # Ensure the entry + its refresh loop exist (first caller pays
-        # the initial fetch; everyone after rides the warm entry).
-        self.get(key, t.fetch_factory(**req), ttl_s=t.ttl_s, refresh=True)
         deadline = time.monotonic() + wait_s
-        with self._lock:
-            e = self._entries[key]
+        e = None
+        for _ in range(2):
+            # Ensure the entry + its refresh loop exist (first caller
+            # pays the initial fetch; everyone after rides the warm
+            # entry). A concurrent invalidate() can drop the entry
+            # between get() and the read — re-create, never KeyError.
+            self.get(key, t.fetch_factory(**req), ttl_s=t.ttl_s,
+                     refresh=True)
+            with self._lock:
+                e = self._entries.get(key)
+            if e is not None:
+                break
+        if e is None:
+            out = t.fetch_factory(**req)(min_index, wait_s)
+            return {"index": out["index"], "value": out["value"],
+                    "hit": False}
         with e.changed:
             while e.index <= min_index and min_index > 0:
                 left = deadline - time.monotonic()

@@ -300,6 +300,12 @@ class HTTPApi:
                     if e["Payload"] else None} for e in evs]
             return 200, out, {"X-Consul-Index": str(idx)}
 
+        if len(parts) == 3 and parts[:2] == ["agent", "join"] and \
+                method == "PUT":
+            # Post-boot join (reference /v1/agent/join/:address,
+            # http_register.go): route a running client agent onto a
+            # server's RPC address.
+            return 200, self.agent.join(parts[2]), {}
         if len(parts) == 3 and parts[:2] == ["agent", "force-leave"] and \
                 method == "PUT":
             # ForceLeave (reference agent/agent.go ForceLeave ->

@@ -39,8 +39,11 @@ class ServerPool:
     def __init__(self, servers: dict[str, Callable[..., Any]],
                  seed: int = 0,
                  rebalance_interval_s: float = REBALANCE_INTERVAL_S):
-        if not servers:
-            raise ValueError("server pool requires at least one server")
+        # An EMPTY pool is legal: a client agent may boot solo and be
+        # routed onto servers later via the join verb (/v1/agent/join);
+        # until then every rpc() raises NoServersError. A populated
+        # pool still refuses remove() down to zero — an operator
+        # detaching the last server is almost certainly a mistake.
         self._rpcs = dict(servers)
         self._order = list(servers)
         self._rng = random.Random(seed)
@@ -60,6 +63,8 @@ class ServerPool:
 
     def current(self) -> str:
         with self._lock:
+            if not self._order:
+                raise NoServersError("pool is empty (not joined yet)")
             return self._order[0]
 
     def add(self, name: str, rpc: Callable[..., Any]):
@@ -72,8 +77,9 @@ class ServerPool:
                     self._rng.randrange(len(self._order) + 1), name)
 
     def remove(self, name: str):
-        """Refuses to drop the last server: an empty pool can route
-        nothing, and the constructor's invariant holds for current()."""
+        """Refuses to drop the last server: constructed-empty (pre-
+        join) is legal, but REMOVING down to empty is an operator
+        mistake — a joined agent would silently lose all routing."""
         with self._lock:
             if name in self._order and len(self._order) == 1:
                 raise ValueError("cannot remove the last pooled server")
@@ -111,6 +117,8 @@ class ServerPool:
         with self._lock:
             self.metrics["rpc_calls"] += 1
             n = len(self._order)
+        if n == 0:
+            raise NoServersError("pool is empty (not joined yet)")
         last_err: Exception | None = None
         for _ in range(n):
             with self._lock:

@@ -240,6 +240,12 @@ class AgentAPI:
         out, _, _ = self.c._call("GET", "/v1/agent/metrics")
         return out
 
+    def join(self, address: str) -> bool:
+        """Route a running agent onto a server set (reference
+        api/agent.go Join → /v1/agent/join/:address)."""
+        out, _, _ = self.c._call("PUT", f"/v1/agent/join/{address}")
+        return bool(out)
+
     def service_register(self, name: str, service_id: str = "",
                          port: int = 0, tags: Optional[list] = None,
                          check_ttl: str = "") -> bool:

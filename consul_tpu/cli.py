@@ -211,6 +211,20 @@ def cmd_watch(client: Client, args) -> int:
     return 0 if fired["n"] else 1
 
 
+def cmd_join(client: Client, args) -> int:
+    """Join the addressed agent to a server set (reference
+    command/join; here the wire-tier verb re-aiming a client agent's
+    connection pool at runtime)."""
+    try:
+        ok = client.agent.join(args.address)
+    except APIError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(f"Successfully joined {args.address}" if ok
+          else f"error: join {args.address} failed")
+    return 0 if ok else 1
+
+
 def cmd_force_leave(client: Client, args) -> int:
     """Force a failed member out (reference command/forceleave →
     agent ForceLeave → serf.RemoveFailedNode)."""
@@ -527,6 +541,9 @@ def build_parser() -> argparse.ArgumentParser:
     w_p.add_argument("--rounds", type=int, default=0)
     w_p.add_argument("--wait", default="10s")
 
+    jn = sub.add_parser("join", help="join the agent to a server set")
+    jn.add_argument("address", help="server RPC address host:port")
+
     fl = sub.add_parser("force-leave", help="force a failed member out")
     fl.add_argument("node")
 
@@ -602,7 +619,8 @@ COMMANDS = {
     "members": cmd_members, "rtt": cmd_rtt, "kv": cmd_kv,
     "catalog": cmd_catalog, "info": cmd_info, "services": cmd_services,
     "sessions": cmd_sessions, "snapshot": cmd_snapshot, "debug": cmd_debug,
-    "event": cmd_event, "watch": cmd_watch, "force-leave": cmd_force_leave,
+    "event": cmd_event, "watch": cmd_watch, "join": cmd_join,
+    "force-leave": cmd_force_leave,
     "operator": cmd_operator, "maint": cmd_maint, "keyring": cmd_keyring,
     "monitor": cmd_monitor, "validate": cmd_validate, "lock": cmd_lock,
     "exec": cmd_exec, "reload": cmd_reload, "config": cmd_config,

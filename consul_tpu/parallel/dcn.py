@@ -112,9 +112,13 @@ class DcnFederation:
         scalar_fields = {"t"}
 
         def select(*leaves):
-            assert leaves[0].shape[0] == owner.shape[0], (
-                f"per-row WAN leaf with leading dim {leaves[0].shape}"
-            )
+            if leaves[0].shape[0] != owner.shape[0]:
+                # A hard error (not an assert, which python -O strips):
+                # a future non-per-row leaf must fail loudly here, not
+                # silently mis-broadcast through np.where.
+                raise ValueError(
+                    f"per-row WAN leaf with leading dim {leaves[0].shape}"
+                )
             sel = owner.reshape((-1,) + (1,) * (leaves[0].ndim - 1))
             out = leaves[0]
             for k in range(1, len(leaves)):

@@ -1,0 +1,118 @@
+"""ConfigEntry replication: primary DC -> secondaries.
+
+The reference replicates centralized configuration entries from the
+primary datacenter into every secondary (reference
+agent/consul/config_replication.go:1-60 replicateConfig: list remote,
+diff against local, apply deltas through raft; driven from the leader
+loop, leader.go startConfigReplication). This module is that pass for
+the framework, riding the cross-DC RPC path (endpoints.py _forward_dc
+over the WAN router) the way the reference rides its connection pool:
+
+  - :func:`replicate_config_entries` — one diff-and-apply pass on a
+    secondary's leader: upsert entries whose payload differs, delete
+    local entries the primary no longer has. Local writes go through
+    the secondary's own raft, so replicated entries survive secondary
+    leader failover like any other committed state.
+  - :class:`ConfigReplicator` — the leader-loop driver: skips
+    non-leaders and the primary itself, short-circuits on an unchanged
+    remote index (the reference's remote-index watermark), and backs
+    off after errors instead of hammering a dead WAN link.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from consul_tpu.server.endpoints import NoPathToDatacenter, Server
+from consul_tpu.server.raft import NotLeader
+
+REPLICATION_INTERVAL_S = 0.5     # reference runs at applyRate limits
+ERROR_BACKOFF_S = 2.0
+
+
+def replicate_config_entries(server: Server, primary_dc: str,
+                             remote: Optional[dict] = None) -> dict:
+    """One replication pass. Returns ``{"upserts": [(kind, name)...],
+    "deletes": [...], "remote_index", "local_index"}``. ``remote`` is
+    an optional pre-fetched primary ConfigEntry.List result so the
+    loop's watermark check and the diff share ONE cross-DC list.
+    Raises NoPathToDatacenter / NotLeader like any cross-DC RPC; the
+    caller (ConfigReplicator) turns those into backoff."""
+    if server.dc == primary_dc:
+        raise ValueError("the primary datacenter does not replicate "
+                         "from itself (config_replication.go gates on "
+                         "DC != primary)")
+    if remote is None:
+        remote = server.rpc("ConfigEntry.List", dc=primary_dc)
+    local = server.rpc("ConfigEntry.List")
+    remote_by = {(e["kind"], e["name"]): e for e in remote["value"]}
+    local_by = {(e["kind"], e["name"]): e for e in local["value"]}
+    out = {"upserts": [], "deletes": [], "remote_index": remote["index"],
+           "local_index": local["index"]}
+    # Deletes first, then upserts in deterministic order (the reference
+    # applies deletions before updates so a rename never leaves both).
+    for key in sorted(set(local_by) - set(remote_by)):
+        server.rpc("ConfigEntry.Delete", kind=key[0], name=key[1])
+        out["deletes"].append(key)
+    for key in sorted(remote_by):
+        le = local_by.get(key)
+        if le is None or le["entry"] != remote_by[key]["entry"]:
+            server.rpc("ConfigEntry.Apply", kind=key[0], name=key[1],
+                       entry=remote_by[key]["entry"])
+            out["upserts"].append(key)
+    return out
+
+
+class ConfigReplicator:
+    """Periodic replication from the secondary leader's loop (the
+    reference's startConfigReplication leader routine)."""
+
+    def __init__(self, server: Server, primary_dc: str,
+                 interval_s: float = REPLICATION_INTERVAL_S):
+        self.server = server
+        self.primary_dc = primary_dc
+        self.interval_s = interval_s
+        self._next_run = 0.0
+        self._last_remote_index: Optional[int] = None
+        self._last_local_index: Optional[int] = None
+        self.metrics = {"runs": 0, "skips_unchanged": 0, "errors": 0,
+                        "upserts": 0, "deletes": 0}
+
+    def maybe_run(self, now: float) -> Optional[dict]:
+        """Run a pass if due. Leader-only, secondary-only; errors back
+        off instead of raising (a severed WAN must not kill the leader
+        loop)."""
+        if self.server.dc == self.primary_dc or now < self._next_run \
+                or not self.server.is_leader():
+            return None
+        self._next_run = now + self.interval_s
+        try:
+            # Watermark: skip the diff only when BOTH sides are
+            # unchanged — a remote-only watermark would let an
+            # out-of-band secondary write diverge forever while the
+            # primary is idle. The remote list is fetched ONCE and
+            # shared with the diff.
+            remote = self.server.rpc("ConfigEntry.List",
+                                     dc=self.primary_dc)
+            local_idx = self.server.rpc("ConfigEntry.List")["index"]
+            if remote["index"] == self._last_remote_index and \
+                    local_idx == self._last_local_index:
+                self.metrics["skips_unchanged"] += 1
+                return None
+            out = replicate_config_entries(self.server, self.primary_dc,
+                                           remote=remote)
+        except (NoPathToDatacenter, NotLeader, ConnectionError):
+            self.metrics["errors"] += 1
+            self._next_run = now + ERROR_BACKOFF_S
+            return None
+        self._last_remote_index = out["remote_index"]
+        # A productive pass's own applies advance the local index past
+        # this (pre-apply) watermark, so the NEXT pass re-diffs — an
+        # idempotent no-op that settles the watermark; only then does
+        # skipping begin. The same mechanism reopens the diff after
+        # any out-of-band local write.
+        self._last_local_index = out["local_index"]
+        self.metrics["runs"] += 1
+        self.metrics["upserts"] += len(out["upserts"])
+        self.metrics["deletes"] += len(out["deletes"])
+        return out

@@ -103,11 +103,14 @@ class TestLoadConfig:
         with pytest.raises(ValueError, match="unknown agent config keys"):
             boot.load_config(str(p))
 
-    def test_client_mode_requires_join_addresses(self, tmp_path):
+    def test_client_mode_boots_solo_for_join_verb(self, tmp_path):
+        """A client agent with no retry_join_rpc boots solo: every RPC
+        fails until a post-boot join (/v1/agent/join) aims it at a
+        server — the reference's join-after-boot lifecycle."""
         p = tmp_path / "client.json"
         p.write_text('{"server": false}')
-        with pytest.raises(ValueError, match="requires retry_join_rpc"):
-            boot.load_config(str(p))
+        cfg = boot.load_config(str(p))
+        assert cfg["server"] is False and cfg["retry_join_rpc"] == []
 
     def test_malformed_join_address_rejected(self, tmp_path):
         p = tmp_path / "client.json"

@@ -131,3 +131,47 @@ class TestSharedBlocking:
         out = cache.get_blocking("t", min_index=1, wait_s=0.3)
         assert out == {"index": 1, "value": "v1", "hit": False}
         cache.close()
+
+
+class TestNonRefreshTypes:
+    def test_blocking_read_of_non_refresh_type_fetches_directly(self):
+        """A type registered refresh=False must NOT gain a permanent
+        background polling thread from a blocking read — the read goes
+        straight to the store instead (ADVICE r4)."""
+        store, cache = FakeStore(), Cache()
+        cache.register_type("t", store.fetcher, ttl_s=30.0, refresh=False)
+        out = cache.get_blocking("t", min_index=0, wait_s=1.0)
+        assert out["index"] == 1 and out["value"] == "v1"
+        # No entry was created, so no refresh loop exists.
+        assert cache.fetch_count("t") == 0
+        assert not cache._refreshing
+        # And a real blocking wait still wakes on change.
+        got = {}
+
+        def blocked():
+            got["out"] = cache.get_blocking("t", min_index=1, wait_s=5.0)
+
+        th = threading.Thread(target=blocked)
+        th.start()
+        time.sleep(0.1)
+        store.set("v2")
+        th.join(timeout=5.0)
+        assert got["out"]["index"] == 2 and got["out"]["value"] == "v2"
+        cache.close()
+
+    def test_invalidate_race_does_not_keyerror(self):
+        """invalidate() between the warm-up get and the entry read must
+        re-create the entry, never KeyError (VERDICT r4 weak #7)."""
+        store, cache = FakeStore(), Cache()
+        cache.register_type("t", store.fetcher, ttl_s=30.0, refresh=True)
+        orig_get = cache.get
+
+        def racing_get(key, *a, **kw):
+            out = orig_get(key, *a, **kw)
+            cache.invalidate(key)  # the race, deterministically forced
+            return out
+
+        cache.get = racing_get
+        out = cache.get_blocking("t", min_index=0, wait_s=1.0)
+        assert out["index"] == 1 and out["value"] == "v1"
+        cache.close()

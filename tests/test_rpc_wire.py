@@ -407,6 +407,86 @@ class TestClientAgentProcess:
         assert "leader = srv" in out.stdout
 
 
+class TestJoinVerb:
+    """The reference's most famous verb (`consul join`,
+    /v1/agent/join): boot a client agent SOLO, join it to a cluster at
+    runtime, and `members` shows it."""
+
+    @pytest.fixture(scope="class")
+    def solo_then_joined(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("join")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        scfg = tmp / "server.json"
+        scfg.write_text(json.dumps({
+            "node_name": "join-srv", "n_servers": 3,
+            "http": {"host": "127.0.0.1", "port": 0}, "rpc_port": 0,
+        }))
+        server = subprocess.Popen(
+            [sys.executable, "-m", "consul_tpu.cli", "agent",
+             "--config-file", str(scfg)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        sready = json.loads(server.stdout.readline())
+        ccfg = tmp / "client.json"
+        ccfg.write_text(json.dumps({
+            "node_name": "join-cli", "server": False,
+            "http": {"host": "127.0.0.1", "port": 0},
+        }))
+        client = subprocess.Popen(
+            [sys.executable, "-m", "consul_tpu.cli", "agent",
+             "--config-file", str(ccfg)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        cready = json.loads(client.stdout.readline())
+        yield sready, cready, env
+        for p in (client, server):
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+                p.wait(timeout=15)
+
+    def _cli(self, env, port, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "consul_tpu.cli",
+             "--http-addr", f"127.0.0.1:{port}", *args],
+            capture_output=True, text=True, env=env, timeout=30)
+
+    def test_solo_client_fails_rpc_then_join_succeeds(self, solo_then_joined):
+        sready, cready, env = solo_then_joined
+        # Solo: reads through the client fail (no servers joined).
+        r = self._cli(env, cready["http_port"], "kv", "get", "nope")
+        assert r.returncode != 0
+        # Join to the server's RPC port.
+        r = self._cli(env, cready["http_port"], "join",
+                      f"127.0.0.1:{sready['rpc_port']}")
+        assert r.returncode == 0, r.stderr
+        assert "Successfully joined" in r.stdout
+        # Now writes ride the wire.
+        r = self._cli(env, cready["http_port"], "kv", "put", "jk", "jv")
+        assert r.returncode == 0, r.stderr
+        out = self._cli(env, sready["http_port"], "kv", "get", "jk")
+        assert out.stdout.strip() == "jv"
+        # And anti-entropy registers the client: members shows it.
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            out = self._cli(env, sready["http_port"], "members")
+            if "join-cli" in out.stdout:
+                break
+            time.sleep(0.5)
+        assert "join-cli" in out.stdout, out.stdout
+
+    def test_join_malformed_address_rejected(self, solo_then_joined):
+        _, cready, env = solo_then_joined
+        r = self._cli(env, cready["http_port"], "join", "not-an-addr")
+        assert r.returncode == 1
+        assert "error" in r.stderr.lower() or "error" in r.stdout.lower()
+
+    def test_join_on_server_mode_is_an_error(self, solo_then_joined):
+        sready, _, env = solo_then_joined
+        r = self._cli(env, sready["http_port"], "join", "127.0.0.1:9999")
+        assert r.returncode == 1
+        assert "client-mode" in (r.stderr + r.stdout)
+
+
 class TestClientAgentProcessTLS:
     """The same three-process story with the RPC port encrypted and
     plaintext REFUSED (reference tlsutil VerifyIncoming on the RPC
