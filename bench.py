@@ -204,29 +204,8 @@ def child(platform: str, deadline: float):
     # Scaling sweep: throughput at each shape, each its own try/except,
     # each gated on remaining deadline (SURVEY §7 phases 4-5 shapes).
     def northstar(sim, s, rps, phase_name):
-        """The 1M mass-kill convergence attempt (BASELINE.json): warm
-        the metrics-on runner OUTSIDE the timed region, bound the run
-        by the measured rate (``rps``) and remaining deadline so a
-        marginal backend emits a (failed) result, never a SIGKILL."""
-        sim.run(chunk, chunk=chunk, with_metrics=True)
-        sim.kill(jnp.arange(s) < int(s * kill_frac))
-        budget_ticks = int(rps * max(left() - 90, 60))
-        max_ticks = max(chunk, min(4096, budget_ticks))
-        t0_ns = time.monotonic()
-        converged, ticks_used, _ = sim.run_until_converged(
-            max_ticks=max_ticks, chunk=chunk)
-        wall = time.monotonic() - t0_ns
-        _emit({
-            "phase": phase_name,
-            "n": s,
-            "converged": bool(converged),
-            "kill_frac": kill_frac,
-            "wall_s": round(wall, 2),
-            "ticks": int(ticks_used),
-            "max_ticks": int(max_ticks),
-            "target_wall_s": 60.0,
-            "met": bool(converged) and wall < 60.0,
-        })
+        run_northstar(sim, s, rps, phase_name, chunk=chunk,
+                      kill_frac=kill_frac, left=left, emit=_emit)
 
     sweep_env = os.environ.get("BENCH_SWEEP", "")
     for s in [int(x) for x in sweep_env.split(",") if x.strip()]:
@@ -286,6 +265,101 @@ def child(platform: str, deadline: float):
         except Exception as e:
             _emit({"phase": "error", "where": f"sweep:{s}", "error": repr(e)[:400]})
     return 0
+
+
+_CKPT_DIR = os.path.join(_HERE, ".bench_ckpt")
+
+
+def run_northstar(sim, s, rps, phase_name, *, chunk, kill_frac, left, emit,
+                  ckpt_every_ticks: int = 512, ckpt_dir: str = _CKPT_DIR):
+    """The 1M mass-kill convergence attempt (BASELINE.json): warm the
+    metrics-on runner OUTSIDE the timed region, bound the run by the
+    measured rate (``rps``) and remaining deadline so a marginal
+    backend emits a (failed) result, never a SIGKILL.
+
+    Mid-run checkpoint/resume (SURVEY §5: device arrays -> host
+    container each K steps; the serf snapshot rejoin-fast precedent,
+    reference serf/snapshot.go:59-431): the sim state is snapshotted
+    every ``ckpt_every_ticks`` through utils/checkpoint (digest-
+    verified, atomic-rename), so a tunnel loss mid-northstar costs at
+    most one slice — the next bench run RESUMES from the checkpoint
+    (provenance in the emitted phase: ``resumed_from_tick``) instead
+    of restarting a ~50 s run from zero. Only a CONVERGED attempt
+    retires its checkpoint; a budget-exhausted unconverged one keeps
+    it so the next run continues the same trajectory."""
+    import jax.numpy as jnp
+
+    from consul_tpu.utils import checkpoint as ckpt_mod
+
+    sim.run(chunk, chunk=chunk, with_metrics=True)  # warm, untimed
+    os.makedirs(ckpt_dir, exist_ok=True)
+    ck_path = os.path.join(ckpt_dir, f"{phase_name}_{s}.ckpt")
+    meta_path = ck_path + ".meta.json"
+    resumed_tick = 0
+    if os.path.exists(ck_path) and os.path.exists(meta_path):
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+            # The kill fraction is part of the trajectory's identity: a
+            # resume under a different BENCH_KILL_FRAC would continue
+            # the OLD kill while publishing the new one as provenance.
+            if meta.get("n") == s and meta.get("phase") == phase_name \
+                    and meta.get("kill_frac") == kill_frac:
+                sim.state = ckpt_mod.restore(ck_path, sim.state)
+                resumed_tick = int(meta["ticks_done"])
+        except Exception as e:  # noqa: BLE001 — a bad ckpt restarts clean
+            emit({"phase": f"{phase_name}_ckpt_error",
+                  "error": repr(e)[:200]})
+            resumed_tick = 0
+    if resumed_tick == 0:
+        # Fresh attempt: inject the mass failure. A resumed state
+        # already carries it (checkpoints are taken post-kill).
+        sim.kill(jnp.arange(s) < int(s * kill_frac))
+    budget_ticks = int(rps * max(left() - 90, 60))
+    max_ticks = max(chunk, min(4096, budget_ticks))
+    ticks_done = resumed_tick
+    converged = False
+    t0_ns = time.monotonic()
+    while ticks_done - resumed_tick < max_ticks and not converged:
+        slice_t = min(max(ckpt_every_ticks, chunk),
+                      max_ticks - (ticks_done - resumed_tick))
+        converged, used, _ = sim.run_until_converged(
+            max_ticks=slice_t, chunk=chunk)
+        ticks_done += used
+        if not converged:
+            try:
+                ckpt_mod.save(ck_path, sim.state)
+                with open(meta_path, "w") as f:
+                    json.dump({"phase": phase_name, "n": s,
+                               "kill_frac": kill_frac,
+                               "ticks_done": ticks_done,
+                               "saved_at": time.time()}, f)
+            except OSError:
+                pass  # checkpointing must never fail the attempt
+    wall = time.monotonic() - t0_ns
+    if converged:
+        # Only a COMPLETED attempt retires its checkpoint; an
+        # unconverged budget-exhausted one keeps it so the next bench
+        # run (or round) continues the same trajectory.
+        for p in (ck_path, meta_path):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+    emit({
+        "phase": phase_name,
+        "n": s,
+        "converged": bool(converged),
+        "kill_frac": kill_frac,
+        "wall_s": round(wall, 2),
+        "ticks": int(ticks_done),
+        "max_ticks": int(max_ticks),
+        "resumed_from_tick": int(resumed_tick),
+        "target_wall_s": 60.0,
+        # A resumed attempt's wall covers only the post-resume slice;
+        # the <60s verdict is only meaningful for uninterrupted runs.
+        "met": bool(converged) and wall < 60.0 and resumed_tick == 0,
+    })
 
 
 # ----------------------------------------------------------------------

@@ -260,6 +260,12 @@ class AgentRuntime:
             unreachable address rather than polluting the pool with a
             dead entry every rebalance would rotate back to the head."""
             host, port = _parse_hostport(addr, field="join address")
+            if addr in pool.servers:
+                # Idempotent like `consul join` of a current member —
+                # and no probe client is created for it (pool.add would
+                # silently no-op, leaking the probe's socket + reader
+                # thread on every repeat join).
+                return True
             probe = RpcClient(host, port, timeout_s=5.0, tls=tls)
             try:
                 probe.call("Status.Leader")

@@ -179,6 +179,11 @@ class SerfConfig:
     # QueryTimeoutMult=16; timeout = mult * log10(N+1) * gossip_interval,
     # serf/serf.go DefaultQueryTimeout).
     query_timeout_mult: int = 16
+    # Concurrent outstanding queries per origin (the reference keeps
+    # per-query QueryResponse state, serf/query.go — unbounded; this is
+    # the fixed-shape bound. A query opened past the cap evicts the
+    # origin's oldest-deadline slot).
+    query_slots: int = 4
     # Duplicate query responses relayed through this many other members
     # for redundancy under packet loss (reference QueryParam.RelayFactor,
     # serf/query.go:31-33, relayResponse serf.go:244-...; default 0).

@@ -102,11 +102,13 @@ class SerfState(NamedTuple):
     # by a newer ltime landing on it.
     ev_floor: jax.Array      # [N] uint32
     q_floor: jax.Array       # [N] uint32
-    # -- outstanding query (one per origin) ---------------------------
-    q_open_key: jax.Array    # [N] uint32, 0 = none
-    q_deadline: jax.Array    # [N] int32 tick
-    q_resps: jax.Array       # [N] int32 responses received
-    q_acks: jax.Array        # [N] int32 delivery acks received (the
+    # -- outstanding queries ([N, Q] slot axis: Q concurrent queries
+    # per origin, reference serf/query.go per-query QueryResponse
+    # state; a query past the cap evicts the oldest-deadline slot) ----
+    q_open_key: jax.Array    # [N, Q] uint32, 0 = none
+    q_deadline: jax.Array    # [N, Q] int32 tick
+    q_resps: jax.Array       # [N, Q] int32 responses received
+    q_acks: jax.Array        # [N, Q] int32 delivery acks received (the
                              # reference's QueryParam.RequestAck stream,
                              # serf/query.go acks channel — counted
                              # separately from answers)
@@ -138,10 +140,10 @@ def init(cfg: SimConfig, key) -> SerfState:
         ev_delivered=jnp.zeros((n,), jnp.int32),
         ev_floor=jnp.zeros((n,), jnp.uint32),
         q_floor=jnp.zeros((n,), jnp.uint32),
-        q_open_key=jnp.zeros((n,), jnp.uint32),
-        q_deadline=jnp.zeros((n,), jnp.int32),
-        q_resps=jnp.zeros((n,), jnp.int32),
-        q_acks=jnp.zeros((n,), jnp.int32),
+        q_open_key=jnp.zeros((n, cfg.serf.query_slots), jnp.uint32),
+        q_deadline=jnp.zeros((n, cfg.serf.query_slots), jnp.int32),
+        q_resps=jnp.zeros((n, cfg.serf.query_slots), jnp.int32),
+        q_acks=jnp.zeros((n, cfg.serf.query_slots), jnp.int32),
         q_responder=jnp.ones((n,), bool),
         leave_at=jnp.full((n,), -1, jnp.int32),
         down_since=jnp.full((n, cfg.degree), -1, jnp.int32),
@@ -319,18 +321,29 @@ def user_event(cfg: SimConfig, s: SerfState, mask, name: int) -> SerfState:
 def query(cfg: SimConfig, s: SerfState, mask, name: int) -> SerfState:
     """Open a query from every masked node (reference serf/serf.go:510-614
     Query: stamp with the query clock, set the log-scaled deadline,
-    queue for broadcast; responses tallied in ``q_resps``)."""
+    queue for broadcast; responses tallied in ``q_resps``). The query
+    takes a free slot of the origin's [Q] slot axis — concurrent
+    queries from one origin each keep their own deadline and tallies
+    (serf/query.go per-query QueryResponse state); past the cap the
+    oldest-deadline slot is evicted."""
     mask = jnp.asarray(mask, bool)
     rows = jnp.arange(cfg.n, dtype=jnp.int32)
+    q = cfg.serf.query_slots
     key_ = make_event_key(s.query_clock, name, True)
+    # Slot pick: any free slot (0) wins, else the earliest deadline.
+    free = s.q_open_key == 0
+    score = jnp.where(free, jnp.iinfo(jnp.int32).max, -s.q_deadline)
+    slot = jnp.argmax(score, axis=1)
+    oh = (jnp.arange(q, dtype=jnp.int32)[None, :] == slot[:, None]) \
+        & mask[:, None]
     s = s._replace(
         query_clock=lamport.increment(s.query_clock, mask),
-        q_open_key=jnp.where(mask, key_, s.q_open_key),
+        q_open_key=jnp.where(oh, key_[:, None], s.q_open_key),
         q_deadline=jnp.where(
-            mask, s.swim.t + query_timeout_ticks(cfg), s.q_deadline
+            oh, s.swim.t + query_timeout_ticks(cfg), s.q_deadline
         ),
-        q_resps=jnp.where(mask, 0, s.q_resps),
-        q_acks=jnp.where(mask, 0, s.q_acks),
+        q_resps=jnp.where(oh, 0, s.q_resps),
+        q_acks=jnp.where(oh, 0, s.q_acks),
     )
     with jax.ensure_compile_time_eval():
         tx0 = int(scaling.retransmit_limit(cfg.gossip.retransmit_mult, cfg.n))
@@ -383,7 +396,8 @@ def step(cfg: SimConfig, topo, world: World, s: SerfState, key) -> SerfState:
 
     s = _event_phase(cfg, topo, s, active, k_ev)
 
-    # Query expiry: past-deadline queries close (serf/query.go Deadline).
+    # Query expiry: past-deadline slots close (serf/query.go Deadline),
+    # elementwise over the [N, Q] slot axis.
     expired = (s.q_open_key > 0) & (sw.t >= s.q_deadline)
     s = s._replace(q_open_key=jnp.where(expired, 0, s.q_open_key))
 
@@ -492,17 +506,18 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
         )
         arrived = arrived | jnp.any(relay_up & ~loss1 & ~loss2, axis=1)
     # The origin is an arbitrary global row: its liveness and open-query
-    # key come from the globally-visible copies, and the tally is a
+    # keys come from the globally-visible copies, and the tally is a
     # row-addressed all-to-all delivery (the one non-roll exchange of
     # the serf plane; under sharding: all_gather + reduce-scatter). The
-    # liveness pair folds into one gathered bool to keep it at two [N]
-    # collectives per tick.
-    q_open_g = coll.all_rows(s.q_open_key)
+    # response lands in the [Q] slot whose open key matches the query
+    # being answered — concurrent queries from one origin tally
+    # independently (serf/query.go per-query QueryResponse state).
+    q_open_g = coll.all_rows(s.q_open_key)             # [N, Q]
     up_g = coll.all_rows(s.swim.alive_truth & ~s.swim.left)
+    slot_hit = q_open_g[worig] == wkey[:, None]        # [N, Q]
     landed = (
         isq
         & arrived
-        & (q_open_g[worig] == wkey)
         & up_g[worig]
         & (worig != grows)  # origin's own delivery happened at submit
         # External (bridge) seats never ack/answer on-device: their
@@ -513,14 +528,15 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
     )
     # Ack vs response (serf/query.go acks/responses channels): every
     # delivering member acks; only registered responders answer. Two
-    # [N] tallies, two reduce-scatters under sharding (the collective
-    # budget test pins this count).
-    resp_ok = landed & s.q_responder
+    # [N, Q] tallies, two reduce-scatters under sharding (the
+    # collective budget test pins this count and the Q-wide payload).
+    landed_slot = landed[:, None] & slot_hit
+    resp_slot = landed_slot & s.q_responder[:, None]
     s = s._replace(
         q_resps=s.q_resps + coll.sum_scatter_rows(
-            worig, jnp.where(resp_ok, 1, 0).astype(s.q_resps.dtype), n),
+            worig, jnp.where(resp_slot, 1, 0).astype(s.q_resps.dtype), n),
         q_acks=s.q_acks + coll.sum_scatter_rows(
-            worig, jnp.where(landed, 1, 0).astype(s.q_acks.dtype), n),
+            worig, jnp.where(landed_slot, 1, 0).astype(s.q_acks.dtype), n),
     )
 
     # ---- 2. Gossip out: most-retransmittable queue entries, sent along
@@ -603,6 +619,26 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
 # ----------------------------------------------------------------------
 # Inspection.
 # ----------------------------------------------------------------------
+
+def query_slot(s: SerfState, row: int, key: int) -> int:
+    """Host-side: which [Q] slot of ``row`` holds the open query
+    ``key``; -1 when closed or stale (the bridge's drop-stale gate,
+    serf/query.go checking the query is still registered)."""
+    import numpy as np
+    slots = np.asarray(s.q_open_key[row])
+    hits = np.nonzero(slots == np.uint32(key))[0]
+    return int(hits[0]) if hits.size else -1
+
+
+def newest_query_slot(s: SerfState, row: int) -> int:
+    """Host-side: the origin's most recently opened slot (highest
+    Lamport time); -1 when none open."""
+    import numpy as np
+    slots = np.asarray(s.q_open_key[row])
+    if not (slots != 0).any():
+        return -1
+    lts = np.where(slots != 0, slots >> _LTIME_SHIFT, 0)
+    return int(np.argmax(lts))
 
 def event_coverage(cfg: SimConfig, s: SerfState, key_, origin) -> jax.Array:
     """Fraction of active nodes whose dedup buffer holds (key, origin) —

@@ -178,12 +178,13 @@ def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> 
     t = state.t
     rows = coll.rows(n)
     keys = jax.random.split(key, 10)
+    # Dense (or very-high-degree) mode runs the gather formulation:
+    # probe-target attributes are read by global row id through
+    # coll.take_rows — a plain gather single-chip, an all-gather +
+    # local gather under shard_map (dense is a <=few-k-node shape, so
+    # the gathered tables are KBs; the gossip/push-pull planes ride
+    # the same rolls as sparse mode either way).
     roll_mode = (not topo.dense) and k_deg <= _ROLL_DEGREE_MAX
-    if coll.current() is not None and not roll_mode:
-        raise ValueError(
-            "sharded execution requires the sparse circulant plane "
-            "(view_degree in (0, 256]); dense mode uses node-axis gathers"
-        )
 
     view0 = state.view_key  # snapshot for end-of-tick bookkeeping
     seen0 = state.susp_seen
@@ -297,17 +298,24 @@ def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> 
         t_vh, t_verr, t_vadj = (
             tat[:, 2 + wd + vd], tat[:, 3 + wd + vd], tat[:, 4 + wd + vd]
         )
-        true_rtt = (
-            jnp.linalg.norm(world.pos - t_pos, axis=1) + world.height + t_h
-        )
-        jitter = coll.normal_rows(keys[0], n) * cfg.rtt_jitter_frac
-        rtt_obs = true_rtt * jnp.exp(jitter) if cfg.rtt_jitter_frac > 0 else true_rtt
     else:
         target = topology.neighbor_of(topo, rows, target_col)
-        target_up = state.alive_truth[target] & ~state.left[target] & has_target
-        rtt_obs = topology.sample_rtt(cfg, world, rows, target, keys[0])
-        t_vec, t_vh = viv.vec[target], viv.height[target]
-        t_verr, t_vadj = viv.error[target], viv.adjustment[target]
+        target_up = coll.take_rows(
+            state.alive_truth & ~state.left, target) & has_target
+        t_pos = coll.take_rows(world.pos, target)
+        t_h = coll.take_rows(world.height, target)
+        t_vec = coll.take_rows(viv.vec, target)
+        t_vh = coll.take_rows(viv.height, target)
+        t_verr = coll.take_rows(viv.error, target)
+        t_vadj = coll.take_rows(viv.adjustment, target)
+    # The RTT model lives ONCE, shared by both target-attribute paths
+    # (ops/topology.true_rtt semantics, jitter drawn shard-aware): a
+    # latency-model change cannot diverge roll vs gather mode.
+    true_rtt = (
+        jnp.linalg.norm(world.pos - t_pos, axis=1) + world.height + t_h
+    )
+    jitter = coll.normal_rows(keys[0], n) * cfg.rtt_jitter_frac
+    rtt_obs = true_rtt * jnp.exp(jitter) if cfg.rtt_jitter_frac > 0 else true_rtt
 
     timeout_s = g.probe_timeout_ms / 1000.0
     loss = coll.uniform_rows(keys[1], n, (2,)) < cfg.packet_loss  # direct, TCP legs
@@ -388,7 +396,7 @@ def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> 
             jnp.where(has_target, target_col, 0),
         )[:, 0]
     else:
-        t_inc = state.own_inc[target]
+        t_inc = coll.take_rows(state.own_inc, target)
     ack_oh = (
         jnp.arange(k_deg, dtype=jnp.int32)[None, :]
         == jnp.where(acked, target_col, _NEG)[:, None]
@@ -653,14 +661,17 @@ def _poke_refutes(cfg, topo: Topology, state: SimState, poke_flag, poke_col,
             claim = jnp.maximum(claim, contrib)
         refut = (claim >= state.own_inc) & up & (claim > 0)
         return jnp.where(refut, claim, 0)
-    rows = jnp.arange(n, dtype=jnp.int32)
-    s_mat = (rows[:, None] - topo.off[None, :]) % n      # [N, K] senders
+    rows = coll.rows(n)
+    s_mat = (rows[:, None] - topo.off[None, :]) % n      # [B, K] senders
+    g_col = coll.all_rows(poke_col)
+    g_flag = coll.all_rows(poke_flag)
+    g_inc = coll.all_rows(poke_inc)
     hit = (
-        (poke_col[s_mat] == jnp.arange(k_deg, dtype=jnp.int32)[None, :])
-        & poke_flag[s_mat]
+        (g_col[s_mat] == jnp.arange(k_deg, dtype=jnp.int32)[None, :])
+        & g_flag[s_mat]
         & up[:, None]
     )
-    inc = jnp.where(hit, poke_inc[s_mat], 0).astype(jnp.uint32)
+    inc = jnp.where(hit, g_inc[s_mat], 0).astype(jnp.uint32)
     refut = inc >= state.own_inc[:, None]
     return jnp.max(jnp.where(refut & hit, inc, 0), axis=1)
 

@@ -240,15 +240,25 @@ def all_rows(x: jax.Array) -> jax.Array:
     return g.astype(jnp.bool_) if squeeze else g
 
 
+def take_rows(x: jax.Array, gidx: jax.Array) -> jax.Array:
+    """``x`` indexed by GLOBAL row ids: ``all_rows(x)[gidx]`` — a plain
+    gather single-chip, one all-gather + local gather sharded. Dense
+    mode's row-addressed reads (probe-target attributes, poke checks)
+    ride this; at dense scale (n <= a few k) the gathered table is a
+    few KB per device, so the cost is noise."""
+    return all_rows(x)[gidx]
+
+
 def sum_scatter_rows(idx: jax.Array, vals: jax.Array, n: int) -> jax.Array:
     """Scatter-add ``vals`` at global row ids ``idx`` and return each
     row's received total (this shard's block under sharding): the
     all-to-all row-addressed delivery (e.g. query-response tallies).
+    ``vals`` may carry trailing axes ([rows, Q] tallies land per-slot).
     Each shard accumulates into a global-sized buffer; a reduce-scatter
     (psum_scatter) folds the shards and hands each device exactly its
     block — half the bandwidth of a full psum + slice."""
     ctx = _CTX.get()
-    full = jnp.zeros((n,), vals.dtype).at[idx].add(vals)
+    full = jnp.zeros((n,) + vals.shape[1:], vals.dtype).at[idx].add(vals)
     if ctx is None:
         return full
     return jax.lax.psum_scatter(

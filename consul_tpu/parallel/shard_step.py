@@ -29,9 +29,11 @@ and the float coordinate state matches to compiler-rounding tolerance
 tests/test_shardmap.py — the sharding analogue of the determinism tests
 that replace the reference's race detector (SURVEY.md §5).
 
-Requires the sparse circulant plane (``view_degree > 0``): dense mode
-indexes the node axis with per-row gathers that have no block-local
-form. Sparse is the production >=100k-node configuration anyway.
+Both topology planes shard: the sparse circulant plane (the production
+>=100k configuration) rides static-shift rolls; dense mode's
+row-addressed probe reads ride ``collective.take_rows`` (one
+all-gather + local gather — dense is a <=few-k-node shape, so the
+gathered tables are KBs per device).
 """
 
 from __future__ import annotations
@@ -52,8 +54,6 @@ def _make_sharded(step_fn, cfg: SimConfig, topo: Topology, mesh: Mesh):
     n_shards = mesh.shape[NODE_AXIS]
     if cfg.n % n_shards != 0:
         raise ValueError(f"n={cfg.n} must divide over {n_shards} shards")
-    if cfg.view_degree == 0:
-        raise ValueError("sharded step requires the sparse circulant plane")
 
     world_spec = World(pos=P(NODE_AXIS, None), height=P(NODE_AXIS))
 

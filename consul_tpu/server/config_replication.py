@@ -31,11 +31,12 @@ ERROR_BACKOFF_S = 2.0
 
 
 def replicate_config_entries(server: Server, primary_dc: str,
-                             remote: Optional[dict] = None) -> dict:
+                             remote: Optional[dict] = None,
+                             local: Optional[dict] = None) -> dict:
     """One replication pass. Returns ``{"upserts": [(kind, name)...],
-    "deletes": [...], "remote_index", "local_index"}``. ``remote`` is
-    an optional pre-fetched primary ConfigEntry.List result so the
-    loop's watermark check and the diff share ONE cross-DC list.
+    "deletes": [...], "remote_index", "local_index"}``. ``remote`` /
+    ``local`` are optional pre-fetched ConfigEntry.List results so the
+    loop's watermark check and the diff share ONE list per side.
     Raises NoPathToDatacenter / NotLeader like any cross-DC RPC; the
     caller (ConfigReplicator) turns those into backoff."""
     if server.dc == primary_dc:
@@ -44,7 +45,8 @@ def replicate_config_entries(server: Server, primary_dc: str,
                          "DC != primary)")
     if remote is None:
         remote = server.rpc("ConfigEntry.List", dc=primary_dc)
-    local = server.rpc("ConfigEntry.List")
+    if local is None:
+        local = server.rpc("ConfigEntry.List")
     remote_by = {(e["kind"], e["name"]): e for e in remote["value"]}
     local_by = {(e["kind"], e["name"]): e for e in local["value"]}
     out = {"upserts": [], "deletes": [], "remote_index": remote["index"],
@@ -94,13 +96,13 @@ class ConfigReplicator:
             # shared with the diff.
             remote = self.server.rpc("ConfigEntry.List",
                                      dc=self.primary_dc)
-            local_idx = self.server.rpc("ConfigEntry.List")["index"]
+            local = self.server.rpc("ConfigEntry.List")
             if remote["index"] == self._last_remote_index and \
-                    local_idx == self._last_local_index:
+                    local["index"] == self._last_local_index:
                 self.metrics["skips_unchanged"] += 1
                 return None
             out = replicate_config_entries(self.server, self.primary_dc,
-                                           remote=remote)
+                                           remote=remote, local=local)
         except (NoPathToDatacenter, NotLeader, ConnectionError):
             self.metrics["errors"] += 1
             self._next_run = now + ERROR_BACKOFF_S

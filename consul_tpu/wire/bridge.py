@@ -498,22 +498,26 @@ class PacketBridge:
                 # marks a delivery ack, serf/query.go queryFlagAck).
                 # Tally into the device counters and keep the
                 # per-responder name + payload host-side.
+                from consul_tpu.models import serf as serf_mod
+
                 qid = int(sbody.get("ID", 0))
                 s = self.sim.serf_state
-                if int(s.q_open_key[to_seat]) != qid:
+                slot = serf_mod.query_slot(s, to_seat, qid)
+                if slot < 0:
                     return  # closed or stale: drop, like the reference
                 lt, name_int = qid >> 9, (qid >> 1) & 0xFF
                 frm = str(sbody.get("From", "")) or seat_name(from_seat)
                 rec = self._track_query(lt, name_int)
                 rec["origin_seat"] = to_seat
+                rec["slot"] = slot
                 if int(sbody.get("Flags", 0)) & 1:
                     if frm not in rec["acks"]:
                         rec["acks"].append(frm)
-                        self._stage_qtally.append((to_seat, False))
+                        self._stage_qtally.append((to_seat, slot, False))
                 elif frm not in rec["responses"]:
                     rec["responses"][frm] = codec.as_bytes(
                         sbody.get("Payload", b"") or b"")
-                    self._stage_qtally.append((to_seat, True))
+                    self._stage_qtally.append((to_seat, slot, True))
         elif mtype == MessageType.INDIRECT_PING:
             # Relay: target reachability from ground truth; ack or nack
             # back to the requester (net.go handleIndirectPing:491).
@@ -666,6 +670,10 @@ class PacketBridge:
         topo = self.sim.topo
         n = self.sim.cfg.n
         off = self._off
+        # One host transfer per tick, not one per candidate probe
+        # source (the re-source scan below is per-seat x degree).
+        alive_np = np.asarray(self.sim.swim_state.alive_truth)
+        ext_np = np.asarray(self.sim.swim_state.external)
         for seat, tr in list(self.transports.items()):
             if tr.down:
                 continue
@@ -679,11 +687,25 @@ class PacketBridge:
             if t_now < self._next_probe[seat] or pend is not None:
                 continue
             self._next_probe[seat] = t_now + g.probe_period_ticks
-            # Rotate through in-neighbors as probe sources.
-            c = (t_now // g.probe_period_ticks) % topo.degree
-            src = (seat - int(off[c])) % n
-            if not bool(self.sim.swim_state.alive_truth[src]):
-                continue
+            # Rotate through in-neighbors as probe sources. An EXTERNAL
+            # in-neighbor never sources a synthesized probe: its real
+            # agent owns its own probing, and an ack addressed back to
+            # that seat would land in the other agent's packet queue
+            # instead of completing this probe (seen at fleet scale —
+            # adjacent attached seats starving each other's liveness).
+            # Fall through to the next live non-external in-neighbor
+            # rather than skipping the round, so a seat whose rotation
+            # lands on attached neighbors still gets probed (its dead
+            # agent must still be detectable).
+            c0 = (t_now // g.probe_period_ticks) % topo.degree
+            src = None
+            for d in range(topo.degree):
+                cand = (seat - int(off[(c0 + d) % topo.degree])) % n
+                if bool(alive_np[cand]) and not bool(ext_np[cand]):
+                    src = cand
+                    break
+            if src is None:
+                continue  # no live sim in-neighbor this tick
             self._seq += 1
             self._pending[seat] = (self._seq, t_now + g.probe_timeout_ticks)
             msgs = [codec.encode_message(
@@ -886,49 +908,79 @@ class PacketBridge:
                 self.sim.state = serf_mod.query(
                     self.sim.cfg, self.sim.serf_state,
                     jnp.asarray(mask), name_int)
-                key = int(self.sim.serf_state.q_open_key[seat])
-                self._track_query(key >> 9, name_int)["origin_seat"] = seat
+                slot = serf_mod.newest_query_slot(
+                    self.sim.serf_state, seat)
+                key = int(self.sim.serf_state.q_open_key[seat, slot])
+                rec = self._track_query(key >> 9, name_int)
+                rec["origin_seat"] = seat
+                rec["slot"] = slot
             self._stage_query = []
         if self._stage_qtally and self.sim.serf_state is not None:
             # Agent responses/acks to sim-origin queries land in the
-            # device counters (one batched .at[].add per kind).
+            # device counters at (origin row, query slot) — one batched
+            # .at[].add per kind.
             s = self.sim.serf_state
-            acks = [o for o, is_resp in self._stage_qtally if not is_resp]
-            resps = [o for o, is_resp in self._stage_qtally if is_resp]
+            acks = [(o, sl) for o, sl, is_resp in self._stage_qtally
+                    if not is_resp]
+            resps = [(o, sl) for o, sl, is_resp in self._stage_qtally
+                     if is_resp]
             if acks:
+                r, c = zip(*acks)
                 s = s._replace(q_acks=s.q_acks.at[
-                    jnp.asarray(acks, jnp.int32)].add(1))
+                    jnp.asarray(r, jnp.int32), jnp.asarray(c, jnp.int32)
+                ].add(1))
             if resps:
+                r, c = zip(*resps)
                 s = s._replace(q_resps=s.q_resps.at[
-                    jnp.asarray(resps, jnp.int32)].add(1))
+                    jnp.asarray(r, jnp.int32), jnp.asarray(c, jnp.int32)
+                ].add(1))
             self.sim.state = s
             self._stage_qtally = []
 
-    def query_status(self, origin_row: int) -> Optional[dict]:
+    def query_status(self, origin_row: int,
+                     qid: Optional[int] = None) -> Optional[dict]:
         """The consumer-facing view of a query fired by ``origin_row``
         (seat or sim node): the device plane's exactly-once aggregate
         counts plus the per-responder names and payload bytes collected
         from attached agents — the QueryResponse acks/responses
         channels a `consul exec`-style consumer reads (serf/query.go).
-        None when the node has no open or tracked query."""
+        ``qid`` selects one of the origin's concurrent queries (the
+        [N, Q] slot axis); default is the most recently opened. None
+        when the node has no open or tracked query."""
+        from consul_tpu.models import serf as serf_mod
+
         s = self.sim.serf_state
         if s is None:
             return None
-        key = int(s.q_open_key[origin_row])
+        if qid is not None:
+            slot = serf_mod.query_slot(s, origin_row, qid)
+            key = qid if slot >= 0 else 0
+        else:
+            slot = serf_mod.newest_query_slot(s, origin_row)
+            key = int(s.q_open_key[origin_row, slot]) if slot >= 0 else 0
         rec = None
         if key:
             rec = self.query_tracker.get((key >> 9, (key >> 1) & 0xFF))
         else:  # closed: the freshest tracker entry for this origin
-            for k in reversed(list(self.query_tracker)):
-                if self.query_tracker[k].get("origin_seat") == origin_row:
-                    rec = self.query_tracker[k]
-                    break
-            if rec is None:
-                return None
+            if qid is not None:
+                rec = self.query_tracker.get((qid >> 9, (qid >> 1) & 0xFF))
+                if rec is None or rec.get("origin_seat") != origin_row:
+                    return None
+            else:
+                for k in reversed(list(self.query_tracker)):
+                    if self.query_tracker[k].get("origin_seat") == \
+                            origin_row:
+                        rec = self.query_tracker[k]
+                        break
+                if rec is None:
+                    return None
+            # The slot the closed query last owned still holds its
+            # final tallies (until reuse).
+            slot = rec.get("slot", 0)
         return {
             "open": bool(key),
-            "acks_total": int(s.q_acks[origin_row]),
-            "responses_total": int(s.q_resps[origin_row]),
+            "acks_total": int(s.q_acks[origin_row, slot]),
+            "responses_total": int(s.q_resps[origin_row, slot]),
             "agent_acks": list((rec or {}).get("acks", [])),
             "agent_responses": dict((rec or {}).get("responses", {})),
         }

@@ -1,0 +1,106 @@
+"""Mid-run northstar checkpoint/resume (SURVEY §5 checkpoint/resume;
+reference serf/snapshot.go:59-431 rejoin-fast precedent): an
+interrupted convergence attempt resumes from the freshest digest-
+verified snapshot instead of restarting from zero."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import pytest
+
+import bench
+from consul_tpu.config import SimConfig
+from consul_tpu.models.cluster import Simulation
+
+
+def _sim(n=256):
+    return Simulation(SimConfig(n=n, view_degree=16), seed=0)
+
+
+class TestNorthstarCheckpoint:
+    def test_interrupted_run_resumes_with_provenance(self, tmp_path):
+        n, chunk = 256, 32
+        ckpt_dir = str(tmp_path / "ck")
+        phases = []
+
+        # Attempt 1: a tiny budget ends the run unconverged mid-flight
+        # — the checkpoint survives, exactly as it would after a
+        # SIGKILL between slices.
+        sim = _sim(n)
+        bench.run_northstar(
+            sim, n, rps=1.0, phase_name="northstar", chunk=chunk,
+            kill_frac=0.05, left=lambda: 91.0, emit=phases.append,
+            ckpt_every_ticks=chunk, ckpt_dir=ckpt_dir)
+        first = phases[-1]
+        assert first["converged"] is False
+        assert first["resumed_from_tick"] == 0
+        ck = os.path.join(ckpt_dir, f"northstar_{n}.ckpt")
+        assert os.path.exists(ck) and os.path.exists(ck + ".meta.json")
+        with open(ck + ".meta.json") as f:
+            assert json.load(f)["ticks_done"] == first["ticks"]
+
+        # Attempt 2 (a fresh bench run): resumes from the checkpoint —
+        # the mass-kill is NOT re-injected, progress counts from the
+        # recorded tick — and converges.
+        sim2 = _sim(n)
+        bench.run_northstar(
+            sim2, n, rps=100.0, phase_name="northstar", chunk=chunk,
+            kill_frac=0.05, left=lambda: 200.0, emit=phases.append,
+            ckpt_every_ticks=chunk, ckpt_dir=ckpt_dir)
+        second = phases[-1]
+        assert second["resumed_from_tick"] == first["ticks"]
+        assert second["converged"] is True
+        assert second["ticks"] > second["resumed_from_tick"]
+        # A converged attempt retires its checkpoint.
+        assert not os.path.exists(ck)
+        # The resumed state really carried the kill: survivors agree
+        # the killed rows are gone (convergence was on the resumed
+        # trajectory, not a fresh unkilled cluster).
+        assert float(sim2.health().agreement) == 1.0
+
+    def test_mismatched_checkpoint_is_ignored(self, tmp_path):
+        """A checkpoint for another shape/phase never poisons a run:
+        it restarts clean."""
+        n, chunk = 128, 32
+        ckpt_dir = str(tmp_path / "ck")
+        os.makedirs(ckpt_dir)
+        ck = os.path.join(ckpt_dir, f"northstar_{n}.ckpt")
+        with open(ck, "wb") as f:
+            f.write(b"garbage")
+        with open(ck + ".meta.json", "w") as f:
+            json.dump({"phase": "northstar", "n": n, "kill_frac": 0.05,
+                       "ticks_done": 999}, f)
+        phases = []
+        sim = _sim(n)
+        bench.run_northstar(
+            sim, n, rps=100.0, phase_name="northstar", chunk=chunk,
+            kill_frac=0.05, left=lambda: 200.0, emit=phases.append,
+            ckpt_every_ticks=chunk, ckpt_dir=ckpt_dir)
+        final = phases[-1]
+        assert final["resumed_from_tick"] == 0
+        assert any(p.get("phase") == "northstar_ckpt_error"
+                   for p in phases)
+        assert final["converged"] is True
+
+    def test_kill_frac_mismatch_restarts_clean(self, tmp_path):
+        """A checkpoint from a run with a DIFFERENT kill fraction must
+        not be resumed — the trajectory identity includes the injected
+        failure, or the published kill_frac would be a lie."""
+        n, chunk = 256, 32
+        ckpt_dir = str(tmp_path / "ck")
+        phases = []
+        sim = _sim(n)
+        bench.run_northstar(
+            sim, n, rps=1.0, phase_name="northstar", chunk=chunk,
+            kill_frac=0.05, left=lambda: 91.0, emit=phases.append,
+            ckpt_every_ticks=chunk, ckpt_dir=ckpt_dir)
+        assert phases[-1]["converged"] is False  # checkpoint on disk
+        sim2 = _sim(n)
+        bench.run_northstar(
+            sim2, n, rps=100.0, phase_name="northstar", chunk=chunk,
+            kill_frac=0.10, left=lambda: 200.0, emit=phases.append,
+            ckpt_every_ticks=chunk, ckpt_dir=ckpt_dir)
+        final = phases[-1]
+        assert final["resumed_from_tick"] == 0
+        assert final["kill_frac"] == 0.10 and final["converged"] is True

@@ -1,0 +1,165 @@
+"""Bridge at scale: many REAL agents on one simulated fabric
+(reference memberlist/mock_transport.go:12-121 scaled to the
+agent/testagent.go many-agents idiom): 32 external seats, each a live
+minimal serf-delegate client answering its own probes, events and
+queries crossing the seam both ways, and the bridge overhead per tick
+measured against the agent count."""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consul_tpu.config import SimConfig
+from consul_tpu.models.cluster import SerfSimulation
+from consul_tpu.wire import codec
+from consul_tpu.wire.bridge import PacketBridge, seat_addr
+from consul_tpu.wire.codec import MessageType
+
+N = 256
+N_AGENTS = 32
+SEATS = list(range(64, 64 + N_AGENTS))
+
+
+class MiniAgent:
+    """The smallest real serf-delegate client: acks its probes, acks +
+    answers queries, remembers events it saw (testagent.go's role)."""
+
+    def __init__(self, name: str, transport):
+        self.name = name
+        self.tr = transport
+        self.events_seen: list[str] = []
+        self.queries_answered: list[int] = []
+
+    def pump(self):
+        while not self.tr.packet_ch.empty():
+            pkt = self.tr.packet_ch.get()
+            try:
+                msgs = codec.decode_packet(pkt.buf)
+            except Exception:  # noqa: BLE001 — hostile bytes: drop
+                continue
+            for mtype, body in msgs:
+                if mtype == MessageType.PING:
+                    ack = codec.encode_message(
+                        MessageType.ACK_RESP,
+                        {"SeqNo": body["SeqNo"], "Payload": b""})
+                    self.tr.write_to(codec.encode_packet([ack]),
+                                     pkt.from_addr)
+                elif mtype == MessageType.USER and "Raw" in body:
+                    stype, sbody = codec.decode_serf_message(body["Raw"])
+                    if stype == codec.SERF_USER_EVENT:
+                        self.events_seen.append(str(sbody.get("Name")))
+                    elif stype == codec.SERF_QUERY:
+                        qid = int(sbody.get("ID", 0))
+                        if qid in self.queries_answered:
+                            continue
+                        self.queries_answered.append(qid)
+                        origin = codec.as_bytes(
+                            sbody.get("Addr", b"")).decode()
+                        for flags, payload in ((1, b""),
+                                               (0, self.name.encode())):
+                            resp = codec.encode_serf_message(
+                                codec.SERF_QUERY_RESPONSE,
+                                {"LTime": sbody.get("LTime", 0),
+                                 "ID": qid, "From": self.name,
+                                 "Flags": flags, "Payload": payload})
+                            self.tr.write_to(
+                                codec.encode_packet([resp]), origin)
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    sim = SerfSimulation(SimConfig(n=N, view_degree=16), seed=9)
+    sim.run(8, chunk=8, with_metrics=False)
+    br = PacketBridge(sim)
+    agents = [MiniAgent(f"agent-{s}", br.attach(s, replace=True))
+              for s in SEATS]
+    return sim, br, agents
+
+
+def run_fleet(sim, br, agents, ticks):
+    for _ in range(ticks):
+        sim.run(1, chunk=1, with_metrics=False)
+        br.step()
+        for a in agents:
+            a.pump()
+
+
+class TestFleetScale:
+    def test_all_agents_stay_alive_under_organic_probing(self, fleet):
+        sim, br, agents = fleet
+        run_fleet(sim, br, agents, 120)
+        # Every seat answered its probes: no agent seat ever read as
+        # dead by the surviving sim majority.
+        from consul_tpu.ops import merge
+        statuses = np.asarray(merge.key_status(sim.state.swim.view_key))
+        alive = np.asarray(sim.state.swim.alive_truth)
+        assert alive[SEATS].all()
+        # Sample sim observers tracking agent seats: none sees DEAD.
+        from consul_tpu.ops import topology
+        nbrs = np.asarray(topology.nbrs_table(sim.topo))
+        seen_dead = 0
+        for i in np.nonzero(alive)[0][:64]:
+            for c, j in enumerate(nbrs[i]):
+                if j in SEATS and statuses[i, c] == merge.DEAD:
+                    seen_dead += 1
+        assert seen_dead == 0
+
+    def test_agent_event_reaches_sim_and_other_agents(self, fleet):
+        sim, br, agents = fleet
+        ev = codec.encode_serf_message(codec.SERF_USER_EVENT, {
+            "LTime": 50, "Name": "fleet-deploy", "Payload": b"x",
+            "CC": True})
+        agents[0].tr.write_to(codec.encode_packet([ev]),
+                              seat_addr(0))
+        delivered0 = np.asarray(sim.state.ev_delivered).copy()
+        run_fleet(sim, br, agents, 60)
+        delivered = np.asarray(sim.state.ev_delivered)
+        active = np.array(sim.state.swim.alive_truth)
+        for s in SEATS:
+            active[s] = False  # external seats deliver agent-side
+        assert (delivered - delivered0)[active].min() >= 1
+        # The OTHER agents heard it over the wire.
+        heard = sum("fleet-deploy" in a.events_seen
+                    or any("fleet" in e for e in a.events_seen)
+                    for a in agents[1:])
+        assert heard >= (N_AGENTS - 1) * 3 // 4, heard
+
+    def test_sim_query_collects_fleet_answers(self, fleet):
+        sim, br, agents = fleet
+        sim.query(jnp.arange(N) == 0, name=31)
+        run_fleet(sim, br, agents, 80)
+        st = br.query_status(0)
+        assert st is not None
+        # On-device members answered on-device; the 32 agents answered
+        # over the wire; together (nearly) the whole cluster.
+        assert st["responses_total"] >= N - N_AGENTS - 2
+        assert len(st["agent_responses"]) >= N_AGENTS * 3 // 4
+
+    def test_bridge_overhead_scales_reasonably(self, fleet):
+        """Per-tick wall time with the 32-agent fleet attached stays
+        within an order of magnitude of the agentless bridge — the
+        seam cost is per-packet host work, not a per-agent rescan of
+        the device state."""
+        sim, br, agents = fleet
+        run_fleet(sim, br, agents, 5)  # warm
+        t0 = time.monotonic()
+        run_fleet(sim, br, agents, 30)
+        with_fleet = (time.monotonic() - t0) / 30
+
+        sim2 = SerfSimulation(SimConfig(n=N, view_degree=16), seed=9)
+        sim2.run(8, chunk=8, with_metrics=False)
+        br2 = PacketBridge(sim2)
+        for _ in range(5):
+            sim2.run(1, chunk=1, with_metrics=False)
+            br2.step()
+        t0 = time.monotonic()
+        for _ in range(30):
+            sim2.run(1, chunk=1, with_metrics=False)
+            br2.step()
+        bare = (time.monotonic() - t0) / 30
+        ratio = with_fleet / max(bare, 1e-9)
+        print(f"bridge per-tick: bare={bare * 1e3:.2f}ms "
+              f"fleet(32)={with_fleet * 1e3:.2f}ms ratio={ratio:.2f}x")
+        assert ratio < 10.0, (bare, with_fleet)

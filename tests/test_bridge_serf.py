@@ -123,22 +123,22 @@ class TestQueriesAcrossTheSeam:
                     break
         assert got, "query envelope never reached the agent"
         q = got[0]
-        assert q["ID"] == int(sim.state.q_open_key[0])
+        assert q["ID"] == int(sim.state.q_open_key[0, 0])
         assert q["Flags"] & 1  # ack requested
         assert codec.as_bytes(q["Addr"]).decode().startswith("sim-")
 
     def test_agent_response_tallies_and_tracks_payload(self, serf_world):
         sim, br, tr = serf_world
         sim.query(jnp.arange(N) == 0, name=5)
-        qid = int(sim.state.q_open_key[0])
+        qid = int(sim.state.q_open_key[0, 0])
         # The agent acks delivery, then answers with a payload.
         for flags, payload in ((1, b""), (0, b"answer-bytes")):
             msg = codec.encode_serf_message(codec.SERF_QUERY_RESPONSE, {
                 "LTime": qid >> 9, "ID": qid, "From": "agent-x",
                 "Flags": flags, "Payload": payload})
             tr.write_to(codec.encode_packet([msg]), seat_addr(0))
-        base_acks = int(sim.state.q_acks[0])
-        base_resps = int(sim.state.q_resps[0])
+        base_acks = int(sim.state.q_acks[0, 0])
+        base_resps = int(sim.state.q_resps[0, 0])
         sim.run(1, chunk=1, with_metrics=False)
         br.step()
         st = br.query_status(0)
@@ -150,7 +150,7 @@ class TestQueriesAcrossTheSeam:
     def test_duplicate_agent_response_not_double_counted(self, serf_world):
         sim, br, tr = serf_world
         sim.query(jnp.arange(N) == 0, name=5)
-        qid = int(sim.state.q_open_key[0])
+        qid = int(sim.state.q_open_key[0, 0])
         msg = codec.encode_serf_message(codec.SERF_QUERY_RESPONSE, {
             "LTime": qid >> 9, "ID": qid, "From": "agent-x",
             "Flags": 0, "Payload": b"a"})
@@ -169,7 +169,7 @@ class TestQueriesAcrossTheSeam:
         tr.write_to(codec.encode_packet([msg]), seat_addr(3))
         sim.run(1, chunk=1, with_metrics=False)
         br.step()  # must not raise, must not tally
-        assert int(sim.state.q_resps[3]) == 0
+        assert int(sim.state.q_resps[3, 0]) == 0
 
     def test_agent_fired_query_disseminates_in_sim(self, serf_world):
         sim, br, tr = serf_world
@@ -196,18 +196,18 @@ class TestQueriesAcrossTheSeam:
         excluded), and the agent's wire response adds exactly one."""
         sim, br, tr = serf_world
         sim.query(jnp.arange(N) == 0, name=11)
-        qid = int(sim.state.q_open_key[0])
+        qid = int(sim.state.q_open_key[0, 0])
         for _ in pump(sim, br, tr, 60):
             pass
-        assert int(sim.state.q_acks[0]) == N - 2
-        assert int(sim.state.q_resps[0]) == N - 2
-        if int(sim.state.q_open_key[0]) == qid:  # still open: answer
+        assert int(sim.state.q_acks[0, 0]) == N - 2
+        assert int(sim.state.q_resps[0, 0]) == N - 2
+        if int(sim.state.q_open_key[0, 0]) == qid:  # still open: answer
             msg = codec.encode_serf_message(codec.SERF_QUERY_RESPONSE, {
                 "LTime": qid >> 9, "ID": qid, "From": "the-agent",
                 "Flags": 0, "Payload": b"mine"})
             tr.write_to(codec.encode_packet([msg]), seat_addr(0))
             sim.run(1, chunk=1, with_metrics=False)
             br.step()
-            assert int(sim.state.q_resps[0]) == N - 1
+            assert int(sim.state.q_resps[0, 0]) == N - 1
             assert br.query_status(0)["agent_responses"] == {
                 "the-agent": b"mine"}

@@ -17,10 +17,11 @@ The budget being defended (parallel/collective.py, SURVEY §2.5):
     4 x (number of traced rolls), a trace-time constant.
   - Serf event plane: + one *packed* roll per gossip fan displacement
     (roll_many: the [key, origin, valid, peer] payload rides ONE
-    ppermute per hop, not four), + exactly two [N] all-gathers (the
-    query-origin attribute reads: q_open_key u32 and the folded
-    liveness bool) + exactly one reduce-scatter (the query-response
-    tally, [N/D] rows out per device).
+    ppermute per hop, not four), + exactly two all-gathers (the
+    query-origin attribute reads: q_open_key u32[N, Q] — Q=4
+    concurrent query slots per origin — and the folded liveness bool)
+    + exactly two reduce-scatters (the [N, Q] ack and response
+    tallies, [N/D, Q] rows out per device).
   - The only all-reduce is the scalar convergence psum (4 bytes).
 
 Counts are pinned by equality: a legitimate protocol change that adds
@@ -157,17 +158,20 @@ class TestSerfBudget:
 
     def test_exactly_two_row_addressed_gathers(self, compiled):
         cfg, _, _, (counts, volume) = compiled
+        q = cfg.serf.query_slots
         assert counts["all-gather"] == 2, counts
-        # q_open_key u32[N] + folded liveness u8[N]: 5 bytes/node total.
-        assert volume["all-gather"] == 5 * cfg.n, volume
+        # q_open_key u32[N, Q] (4Q bytes/node — the concurrent-query
+        # slot axis) + folded liveness u8[N]: 4Q+1 bytes/node total.
+        assert volume["all-gather"] == (4 * q + 1) * cfg.n, volume
 
     def test_exactly_two_reduce_scatters(self, compiled):
         # The query ack and response tallies (serf/query.go acks vs
-        # responses channels) are two [N] scatter-adds -> two [N/D]
-        # reduce-scatters per tick.
+        # responses channels) are two [N, Q] scatter-adds -> two
+        # [N/D, Q] reduce-scatters per tick.
         cfg, d, _, (counts, volume) = compiled
+        q = cfg.serf.query_slots
         assert counts["reduce-scatter"] == 2, counts
-        assert volume["reduce-scatter"] == 2 * 4 * cfg.n // d, volume
+        assert volume["reduce-scatter"] == 2 * 4 * q * cfg.n // d, volume
 
     def test_permute_bytes_bounded(self, compiled):
         cfg, d, _, (counts, volume) = compiled

@@ -101,7 +101,7 @@ class TestQueryRelay:
         base = jax.random.PRNGKey(seed + 1)
         for i in range(serf_mod.query_timeout_ticks(cfg) - 1):
             state = step(state, jax.random.fold_in(base, i))
-        return int(state.q_resps[0]), n
+        return int(state.q_resps[0, 0]), n
 
     def test_relay_recovers_lost_responses(self):
         """RelayFactor exists to survive response loss (query.go:31-33):

@@ -128,15 +128,15 @@ class TestQueries:
         origin = jnp.arange(cfg.n) == 5
         state = serf.query(cfg, state, origin, 17)
         state = run(state, step, 40)
-        assert int(state.q_resps[5]) == cfg.n - 1
+        assert int(state.q_resps[5, 0]) == cfg.n - 1
 
     def test_query_closes_at_deadline(self, vd):
         cfg, _, _, state, step = make_sim(n=24, vd=vd)
         origin = jnp.arange(cfg.n) == 0
         state = serf.query(cfg, state, origin, 1)
-        assert int(state.q_open_key[0]) != 0
+        assert int(state.q_open_key[0, 0]) != 0
         state = run(state, step, serf.query_timeout_ticks(cfg) + 2)
-        assert int(state.q_open_key[0]) == 0
+        assert int(state.q_open_key[0, 0]) == 0
 
     def test_acks_counted_beside_responses(self, vd):
         # Every delivering member acks; with all nodes registered as
@@ -146,8 +146,46 @@ class TestQueries:
         origin = jnp.arange(cfg.n) == 3
         state = serf.query(cfg, state, origin, 17)
         state = run(state, step, 40)
-        assert int(state.q_acks[3]) == cfg.n - 1
-        assert int(state.q_resps[3]) == cfg.n - 1
+        assert int(state.q_acks[3, 0]) == cfg.n - 1
+        assert int(state.q_resps[3, 0]) == cfg.n - 1
+
+    def test_two_overlapping_queries_tally_independently(self, vd):
+        """Concurrent queries from ONE origin (reference serf/query.go
+        per-query QueryResponse state): each keeps its own slot,
+        deadline, and tallies — the second does not close the first."""
+        cfg, _, _, state, step = make_sim(vd=vd)
+        origin = jnp.arange(cfg.n) == 5
+        state = serf.query(cfg, state, origin, 17)
+        k1 = int(state.q_open_key[5, 0])
+        state = run(state, step, 3)
+        state = serf.query(cfg, state, origin, 23)
+        # Both open, in different slots, with distinct keys.
+        k2 = int(state.q_open_key[5, 1])
+        assert k1 != 0 and k2 != 0 and k1 != k2
+        assert serf.query_slot(state, 5, k1) == 0
+        assert serf.query_slot(state, 5, k2) == 1
+        state = run(state, step, 40)
+        # Every other member answered BOTH queries, each into its own
+        # slot.
+        assert int(state.q_resps[5, 0]) == cfg.n - 1
+        assert int(state.q_resps[5, 1]) == cfg.n - 1
+        assert int(state.q_acks[5, 0]) == cfg.n - 1
+        assert int(state.q_acks[5, 1]) == cfg.n - 1
+
+    def test_query_past_cap_evicts_oldest_deadline(self, vd):
+        cfg, _, _, state, step = make_sim(vd=vd)
+        origin = jnp.arange(cfg.n) == 2
+        keys = []
+        for name in range(cfg.serf.query_slots + 1):
+            state = serf.query(cfg, state, origin, name)
+            slot = serf.newest_query_slot(state, 2)
+            keys.append(int(state.q_open_key[2, slot]))
+        # The cap held: Q slots, the oldest was evicted, the newest
+        # Q queries are all open.
+        open_keys = {int(k) for k in state.q_open_key[2].tolist() if k}
+        assert len(open_keys) == cfg.serf.query_slots
+        assert keys[0] not in open_keys
+        assert set(keys[1:]) == open_keys
 
     def test_non_responders_ack_but_do_not_answer(self, vd):
         # Handler registration (q_responder): members without a handler
@@ -158,9 +196,9 @@ class TestQueries:
         origin = jnp.arange(cfg.n) == 1
         state = serf.query(cfg, state, origin, 9)
         state = run(state, step, 40)
-        assert int(state.q_acks[1]) == cfg.n - 1
+        assert int(state.q_acks[1, 0]) == cfg.n - 1
         # node 1 is itself in the responder half; it never self-counts.
-        assert int(state.q_resps[1]) == cfg.n // 2 - 1
+        assert int(state.q_resps[1, 0]) == cfg.n // 2 - 1
 
 
 class TestLeaveAndReap:
