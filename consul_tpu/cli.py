@@ -12,7 +12,10 @@ framework implements:
   services register|deregister                         (command/services)
   sessions list                                        (command/acl… session)
   snapshot save|restore                                (command/snapshot)
-  event fire|list / watch / force-leave / operator raft / debug
+  join             route a client agent onto servers   (command/join)
+  event fire|list / watch / force-leave / debug
+  operator raft list-peers|remove-peer                 (command/operator)
+  operator autopilot get-config|set-config
   maint            node/service maintenance mode       (command/maint)
   keyring          gossip key install/use/remove/list  (command/keyring)
   monitor          stream agent logs                   (command/monitor)

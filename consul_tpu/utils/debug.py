@@ -59,6 +59,13 @@ def capture_static(client) -> dict[str, dict]:
     grab("metrics.json", client.agent.metrics)
     grab("members.json", lambda: client.catalog.nodes()[0])
     grab("coordinates.json", lambda: client.coordinate.nodes()[0])
+    # The combined node+services+checks dump the reference's debug and
+    # UI read (internal_endpoint.go NodeDump via /v1/internal/ui/nodes).
+    grab("node-dump.json", lambda: client.internal.node_dump()[0])
+    # Day-2 raft/autopilot views (operator_raft_endpoint.go).
+    grab("raft-configuration.json", client.operator.raft_get_configuration)
+    grab("autopilot-config.json",
+         client.operator.autopilot_get_configuration)
     return out
 
 

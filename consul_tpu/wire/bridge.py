@@ -58,7 +58,6 @@ from __future__ import annotations
 
 import dataclasses
 import queue
-import zlib
 from typing import Optional
 
 import jax.numpy as jnp
@@ -249,14 +248,18 @@ class PacketBridge:
         # acks/responses channels, host-side).
         self._stage_query: list[tuple[int, int]] = []   # (seat, name_int)
         self._stage_qtally: list[tuple[int, bool]] = []  # (origin, is_resp)
-        self._known_queries: dict[tuple, None] = {}     # (name_int, ltime)
+        self._known_queries: dict[tuple, None] = {}     # (name, ltime)
         self._query_names: dict[int, str] = {}
+        self._query_name_ids: dict[str, int] = {}   # reverse map (O(1))
         self._query_payloads: dict[int, bytes] = {}
         # (ltime, name_int) -> {"acks": [member], "responses":
         #   {member: payload}, "origin_seat": int|None}
         self.query_tracker: dict[tuple[int, int], dict] = {}
         self._event_names: dict[int, str] = {}
-        # (first-name, colliding-name) pairs for operators to inspect.
+        self._event_name_ids: dict[str, int] = {}   # reverse map (O(1))
+        # (evicted-name, newly-registered-name) pairs, recorded when a
+        # new name takes over a least-recently-used id under full
+        # occupancy — the NEW name holds the id from then on.
         self.collisions: list[tuple[str, str]] = []
         # The sim plane stores only packed keys; payloads ride this
         # host-side registry (latest per name slot) across the seam.
@@ -390,21 +393,41 @@ class PacketBridge:
         while len(d) > mult * self._queue_max:
             d.pop(next(iter(d)))
 
-    def _register_name(self, registry: dict, payloads: dict,
+    def _register_name(self, registry: dict, rev: dict, payloads: dict,
                        name: str, payload: bytes) -> tuple[int, bool]:
         """8-bit name-space registration shared by the event and query
-        planes (the sim keys names as ints — a documented narrowing):
-        first name wins a slot, Name AND Payload; collisions surface in
-        ``self.collisions`` instead of silently relabeling."""
-        name_int = zlib.crc32(name.encode()) & 0xFF
-        prior = registry.get(name_int)
-        collided = prior is not None and prior != name
-        if collided:
-            self.collisions.append((prior, name))
-        else:
-            registry[name_int] = name
+        planes (the sim keys names as ints — a documented narrowing).
+        Ids are DYNAMICALLY allocated: a known name keeps its id (an
+        O(1) reverse-map hit, LRU-touched so recency tracks USE, and
+        its payload refreshes — latest fire wins, as before); a new
+        name takes the lowest free id; with all 256 ids held, the
+        least-recently-USED name is evicted (recorded in
+        ``self.collisions``). This uses the full id space — the
+        previous crc32 hashing collided at the ~20-name birthday
+        bound. Host-side dedup (`_known_events`/`_known_queries`) keys
+        on the true NAME, not the id, so an evicted name's lingering
+        retransmissions re-register under a fresh id without re-firing
+        already-seen Lamport times. Residual narrowing: the device
+        plane still sees at most 256 distinct names concurrently."""
+        name_int = rev.get(name)
+        if name_int is not None:
             payloads[name_int] = payload
-        return name_int, collided
+            del registry[name_int]       # LRU touch: re-insert at tail
+            registry[name_int] = name
+            return name_int, False
+        evicted_now = False
+        if len(registry) < 256:
+            name_int = next(i for i in range(256) if i not in registry)
+        else:
+            name_int, evicted = next(iter(registry.items()))
+            del registry[name_int]
+            del rev[evicted]
+            self.collisions.append((evicted, name))
+            evicted_now = True
+        registry[name_int] = name
+        rev[name] = name_int
+        payloads[name_int] = payload
+        return name_int, evicted_now
 
     def _track_query(self, lt: int, name_int: int) -> dict:
         rec = self.query_tracker.get((lt, name_int))
@@ -467,11 +490,15 @@ class PacketBridge:
                 # the first (name, ltime) sighting fires into the sim,
                 # or one event would re-fire at fresh Lamport times
                 # forever (an unbounded feedback loop).
+                ev_name = str(sbody.get("Name", ""))
                 name_int, _ = self._register_name(
-                    self._event_names, self._event_payloads,
-                    str(sbody.get("Name", "")),
+                    self._event_names, self._event_name_ids,
+                    self._event_payloads, ev_name,
                     codec.as_bytes(sbody.get("Payload", b"") or b""))
-                ek = (name_int, int(sbody.get("LTime", 0)))
+                # Dedup keys on the true NAME (not the 8-bit id): an
+                # id reassigned after eviction must never alias another
+                # name's Lamport times.
+                ek = (ev_name, int(sbody.get("LTime", 0)))
                 if ek in self._known_events:
                     return
                 self._bounded_insert(self._known_events, ek)
@@ -482,11 +509,12 @@ class PacketBridge:
                 # serf/messages.go): stage it into the device plane so
                 # the epidemic carries it; dedup retransmissions like
                 # user events.
+                q_name = str(sbody.get("Name", ""))
                 name_int, _ = self._register_name(
-                    self._query_names, self._query_payloads,
-                    str(sbody.get("Name", "")),
+                    self._query_names, self._query_name_ids,
+                    self._query_payloads, q_name,
                     codec.as_bytes(sbody.get("Payload", b"") or b""))
-                qk = (name_int, int(sbody.get("LTime", 0)))
+                qk = (q_name, int(sbody.get("LTime", 0)))
                 if qk in self._known_queries:
                     return
                 self._bounded_insert(self._known_queries, qk)
@@ -772,8 +800,10 @@ class PacketBridge:
                     # Query envelope (messageQueryType): the agent can
                     # respond with messageQueryResponse to the origin's
                     # address; Flags bit 0 requests a delivery ack.
+                    q_name = self._query_names.get(
+                        name_int, f"query-{name_int}")
                     self._bounded_insert(
-                        self._known_queries, (name_int, key >> 9))
+                        self._known_queries, (q_name, key >> 9))
                     from consul_tpu.models import serf as serf_mod
 
                     origin = int(origins[slot]) % n
@@ -791,8 +821,7 @@ class PacketBridge:
                             "Timeout": int(
                                 timeout_ticks
                                 * self.sim.cfg.gossip.tick_ms * 1e6),
-                            "Name": self._query_names.get(
-                                name_int, f"query-{name_int}"),
+                            "Name": q_name,
                             "Payload": self._query_payloads.get(
                                 name_int, b""),
                         }))
@@ -800,13 +829,14 @@ class PacketBridge:
                 # Mark the echo as known so the agent's re-gossip of it
                 # cannot re-fire into the sim (bounded here too — this
                 # insert site sees one entry per sim-originated event).
+                ev_name = self._event_names.get(
+                    name_int, f"evt-{name_int}")
                 self._bounded_insert(
-                    self._known_events, (name_int, key >> 9))
+                    self._known_events, (ev_name, key >> 9))
                 out.append(codec.encode_serf_message(
                     codec.SERF_USER_EVENT, {
                         "LTime": key >> 9,
-                        "Name": self._event_names.get(
-                            name_int, f"evt-{name_int}"),
+                        "Name": ev_name,
                         "Payload": self._event_payloads.get(
                             name_int, b""),
                         "CC": True,

@@ -211,3 +211,55 @@ class TestQueriesAcrossTheSeam:
             assert int(sim.state.q_resps[0, 0]) == N - 1
             assert br.query_status(0)["agent_responses"] == {
                 "the-agent": b"mine"}
+
+
+class TestNameRegistry:
+    """Dynamic 8-bit name allocation (the sim keys names as ints):
+    full id space used, LRU eviction only past 256 concurrent names,
+    and dedup keyed on the true NAME so eviction can never re-fire an
+    already-seen event."""
+
+    def test_full_id_space_then_lru_eviction(self, serf_world):
+        _, br, _ = serf_world
+        ids = [br._register_name(br._event_names, br._event_name_ids,
+                                 br._event_payloads, f"n{i}", b"")[0]
+               for i in range(256)]
+        assert sorted(ids) == list(range(256))
+        assert br.collisions == []
+        # Touch n0 (LRU refresh), then overflow: n1 (now oldest) evicts.
+        br._register_name(br._event_names, br._event_name_ids,
+                          br._event_payloads, "n0", b"")
+        new_id, evicted = br._register_name(
+            br._event_names, br._event_name_ids, br._event_payloads,
+            "overflow", b"")
+        assert evicted is True
+        assert br.collisions == [("n1", "overflow")]
+        assert br._event_name_ids["overflow"] == new_id
+        assert "n1" not in br._event_name_ids
+
+    def test_evicted_name_cannot_refire_same_ltime(self, serf_world):
+        """An evicted name re-registers under a FRESH id; its lingering
+        retransmission at an already-seen Lamport time must still
+        dedup (keys are (name, ltime), not (id, ltime))."""
+        sim, br, tr = serf_world
+        msg = codec.encode_serf_message(codec.SERF_USER_EVENT, {
+            "LTime": 70, "Name": "victim", "Payload": b"x", "CC": True})
+        tr.write_to(codec.encode_packet([msg]), seat_addr(0))
+        br.step()
+        fired_before = ("victim", 70) in br._known_events
+        assert fired_before
+        old_id = br._event_name_ids["victim"]
+        # Force eviction of "victim" by flooding 256 fresh names.
+        for i in range(256):
+            br._register_name(br._event_names, br._event_name_ids,
+                              br._event_payloads, f"flood-{i}", b"")
+        assert "victim" not in br._event_name_ids
+        staged_before = list(br._stage_fired)
+        # The stale retransmission arrives; it re-registers under some
+        # id but must NOT stage a second fire.
+        tr.write_to(codec.encode_packet([msg]), seat_addr(0))
+        br.step()
+        assert br._stage_fired == [] or br._stage_fired == staged_before
+        assert ("victim", 70) in br._known_events
+        new_id = br._event_name_ids["victim"]
+        assert isinstance(old_id, int) and isinstance(new_id, int)

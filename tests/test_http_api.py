@@ -313,9 +313,14 @@ class TestCLI:
         with tarfile.open(out_path) as tar:
             names = set(tar.getnames())
             assert {"host.json", "self.json", "metrics.json",
-                    "members.json"} <= names
+                    "members.json", "node-dump.json",
+                    "raft-configuration.json",
+                    "autopilot-config.json"} <= names
             metrics = json.loads(tar.extractfile("metrics.json").read())
             assert "Gauges" in metrics
+            raft_cfg = json.loads(
+                tar.extractfile("raft-configuration.json").read())
+            assert raft_cfg.get("servers"), raft_cfg
 
     def test_agent_metrics_endpoint(self, stack):
         _, agent, client, _ = stack
