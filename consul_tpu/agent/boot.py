@@ -320,13 +320,31 @@ class AgentRuntime:
 
     def _pump(self):
         """Continuous raft/timer advance (the goroutine tickers of
-        reference agent/consul/server.go collapse into one pump)."""
+        reference agent/consul/server.go collapse into one pump),
+        including leader duties: coordinate flush and session TTL
+        expiry (reference leader.go initializeSessionTimers — timers
+        rebuild from the store when leadership moves)."""
+        timers_for = None  # leader id the current timers belong to
+        next_ttl_pass = 0.0
         while not self._stop.is_set():
             try:
                 self.cluster.step()
                 led = self.cluster.raft.leader()
                 if led is not None and led.id in self.cluster.registry:
-                    self.cluster.registry[led.id].flush_coordinates()
+                    srv = self.cluster.registry[led.id]
+                    srv.flush_coordinates()
+                    if timers_for != led.id:
+                        from consul_tpu.server.leader import SessionTimers
+                        if timers_for is not None and \
+                                timers_for in self.cluster.registry:
+                            self.cluster.registry[
+                                timers_for].session_timers = None
+                        srv.session_timers = SessionTimers(srv)
+                        timers_for = led.id
+                    now = time.monotonic()
+                    if now >= next_ttl_pass:  # ~10 Hz, not per 2ms step
+                        next_ttl_pass = now + 0.1
+                        srv.session_timers.tick(now)
             except Exception as e:  # noqa: BLE001
                 # A pump death would leave the agent serving HTTP with
                 # raft frozen (writes hang with no diagnostic) — log

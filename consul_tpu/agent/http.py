@@ -118,6 +118,10 @@ class HTTPApi:
             out = rpc("Catalog.ListNodes", min_index=min_index,
                       wait_s=wait_s, near=near)
             return 200, out["value"], {"X-Consul-Index": str(out["index"])}
+        if parts == ["catalog", "datacenters"]:
+            # Sorted by WAN coordinate distance (reference
+            # /v1/catalog/datacenters, catalog_endpoint.go).
+            return 200, rpc("Catalog.ListDatacenters"), {}
         if parts == ["catalog", "services"]:
             out = rpc("Catalog.ListServices", min_index=min_index,
                       wait_s=wait_s)
@@ -219,13 +223,27 @@ class HTTPApi:
         if parts == ["session", "create"] and method == "PUT":
             req = json.loads(body or b"{}")
             ttl = _dur_to_s(req["TTL"]) if req.get("TTL") else 0.0
-            _, sid = self._rpc_write(
+            _, created = self._rpc_write(
                 "Session.Apply", op="create",
                 node=req.get("Node", self.agent.node), ttl_s=ttl,
                 behavior=req.get("Behavior", "release"),
                 checks=req.get("Checks"),
             )
-            return 200, {"ID": sid}, {}
+            # The create carries its raft index; wait for the apply so
+            # an immediate renew/acquire from the same client cannot
+            # race the commit — and CONFIRM it, like the int path: an
+            # unconfirmed apply must not answer 200 with a session id
+            # the store may never hold (e.g. proposal lost to a leader
+            # change in client mode).
+            res = self.wait_write(created["index"])
+            if not isinstance(res, dict) or not res.get("found"):
+                res = self.agent.rpc("Status.ApplyResult",
+                                     index=created["index"])
+            if not res.get("found"):
+                raise RuntimeError(
+                    f"session create at raft index {created['index']} "
+                    "unconfirmed")
+            return 200, {"ID": created["id"]}, {}
         if len(parts) == 3 and parts[:2] == ["session", "destroy"]:
             self._rpc_write("Session.Apply", op="destroy",
                             session_id=parts[2])
@@ -233,8 +251,21 @@ class HTTPApi:
         if parts == ["session", "list"]:
             out = rpc("Session.List")
             return 200, out["value"], {"X-Consul-Index": str(out["index"])}
+        if len(parts) == 3 and parts[:2] == ["session", "renew"] and \
+                method == "PUT":
+            # Reset the TTL deadline (reference /v1/session/renew/:id,
+            # session_endpoint.go Renew). 404 on unknown sessions.
+            try:
+                s = rpc("Session.Renew", session_id=parts[2])
+            except KeyError:
+                return 404, {"error": f"unknown session {parts[2]}"}, {}
+            return 200, [s], {}
 
         # ---- coordinates ----------------------------------------------
+        if parts == ["coordinate", "datacenters"]:
+            # Per-DC WAN server coordinates (reference
+            # /v1/coordinate/datacenters, coordinate_endpoint.go:159).
+            return 200, rpc("Coordinate.ListDatacenters"), {}
         if parts == ["coordinate", "nodes"]:
             if "cached" in q:
                 out = self.agent.cache.get_blocking(

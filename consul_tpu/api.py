@@ -130,6 +130,12 @@ class Catalog:
         out, meta, _ = self.c._call("GET", "/v1/catalog/services")
         return out, meta
 
+    def datacenters(self) -> list[str]:
+        """Known DCs sorted by WAN distance (reference
+        api/catalog.go Datacenters)."""
+        out, _, _ = self.c._call("GET", "/v1/catalog/datacenters")
+        return out
+
     def service(self, name: str, tag: Optional[str] = None, near: str = ""):
         params = {"tag": tag, "near": near or None}
         out, meta, _ = self.c._call("GET", f"/v1/catalog/service/{name}",
@@ -197,6 +203,12 @@ class Session:
         out, _, _ = self.c._call("PUT", f"/v1/session/destroy/{session_id}")
         return bool(out)
 
+    def renew(self, session_id: str) -> dict:
+        """Reset the session's TTL deadline (reference api/session.go
+        Renew)."""
+        out, _, _ = self.c._call("PUT", f"/v1/session/renew/{session_id}")
+        return out[0] if isinstance(out, list) and out else out
+
     def list(self):
         out, meta, _ = self.c._call("GET", "/v1/session/list")
         return out, meta
@@ -213,6 +225,12 @@ class Coordinate:
     def node(self, node: str):
         out, meta, _ = self.c._call("GET", f"/v1/coordinate/node/{node}")
         return out, meta
+
+    def datacenters(self) -> list[dict]:
+        """Per-DC WAN server coordinates (reference
+        api/coordinate.go Datacenters)."""
+        out, _, _ = self.c._call("GET", "/v1/coordinate/datacenters")
+        return out
 
 
 class Status:

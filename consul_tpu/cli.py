@@ -120,6 +120,10 @@ def cmd_catalog(client: Client, args) -> int:
         for name, tags in sorted(services.items()):
             print(name + (f"  [{', '.join(tags)}]" if tags else ""))
         return 0
+    if args.catalog_cmd == "datacenters":
+        for dc in client.catalog.datacenters():
+            print(dc)
+        return 0
     raise AssertionError(args.catalog_cmd)
 
 
@@ -499,6 +503,7 @@ def build_parser() -> argparse.ArgumentParser:
     cn = cat_sub.add_parser("nodes")
     cn.add_argument("--near")
     cat_sub.add_parser("services")
+    cat_sub.add_parser("datacenters")
 
     sub.add_parser("info", help="agent and consensus info")
 

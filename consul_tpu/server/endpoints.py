@@ -66,6 +66,9 @@ class Server:
         registry[node_id] = self
         # Coordinate staging (coordinate_endpoint.go:42-53).
         self._coord_updates: dict[str, dict] = {}
+        # Leader-side session TTL timers (leader.SessionTimers),
+        # attached by the runtime pump while this server leads.
+        self.session_timers = None
         self.metrics = {"coordinate_updates_discarded": 0,
                         "rpc_forwarded": 0, "rpc_cross_dc": 0}
 
@@ -256,6 +259,15 @@ class Server:
         return {"index": self.store.index,
                 "value": self.store.node_services(node)}
 
+    def _catalog_list_datacenters(self) -> list[str]:
+        """Known datacenters sorted by WAN coordinate distance from
+        this one (reference catalog_endpoint.go ListDatacenters via
+        router.GetDatacentersByDistance, router.go:395). A non-
+        federated server knows only itself."""
+        if self.router is None:
+            return [self.dc]
+        return self.router.get_datacenters_by_distance()
+
     # ------------------------------------------------------------------
     # Health endpoint (reference agent/consul/health_endpoint.go)
     # ------------------------------------------------------------------
@@ -335,17 +347,41 @@ class Server:
             if self.store.get_node(node) is None:
                 raise KeyError(f"node {node!r} not registered")
             session_id = session_id or str(uuid.uuid4())
-            self._raft_apply({
+            idx = self._raft_apply({
                 "type": fsm_mod.SESSION, "op": "create", "id": session_id,
                 "node": node, "ttl_s": ttl_s, "behavior": behavior,
                 "checks": checks,
             })
-            return session_id
+            # Both the pre-assigned id AND the raft index: callers that
+            # answer synchronously (the HTTP tier) must wait for the
+            # apply, or an immediate follow-up (renew, acquire) races
+            # the commit and reads no-such-session.
+            return {"id": session_id, "index": idx}
         return self._raft_apply({"type": fsm_mod.SESSION, "op": "destroy",
                                  "id": session_id})
 
     def _session_list(self) -> dict:
         return {"index": self.store.index, "value": self.store.session_list()}
+
+    def _session_renew(self, session_id: str) -> dict:
+        """Reset a TTL session's destroy deadline and return the
+        session (reference session_endpoint.go Renew →
+        resetSessionTimer). The timer itself is leader-side state
+        (leader.SessionTimers, attached by the runtime's pump); a
+        renew of an unknown session is an error like the reference."""
+        s = self.store.session_get(session_id)
+        if s is None:
+            raise KeyError(f"unknown session {session_id!r}")
+        if self.session_timers is not None:
+            self.session_timers.renew(session_id)
+        elif not self.is_leader():
+            # Timers live with the leader; forward so the renew lands
+            # where the deadline is tracked (rpc.go:231 forward).
+            leader = self.raft.leader_id
+            if leader and leader != self.id and leader in self.registry:
+                self.metrics["rpc_forwarded"] += 1
+                return self.registry[leader]._session_renew(session_id)
+        return s
 
     # ------------------------------------------------------------------
     # Txn endpoint (reference agent/consul/txn_endpoint.go)
@@ -536,6 +572,24 @@ class Server:
         def fn():
             return [c for c in self.store.coordinates() if c["node"] == node]
         return self._blocking(["coordinates"], min_index, wait_s, fn)
+
+    def _coordinate_list_datacenters(self) -> list[dict]:
+        """WAN coordinates of every datacenter's servers (reference
+        coordinate_endpoint.go:159-176 ListDatacenters reading the
+        router's area maps). Like Catalog.ListDatacenters, a
+        non-federated server still reports its own DC (the WAN serf
+        always contains self)."""
+        if self.router is None:
+            return [{"datacenter": self.dc, "area_id": "wan",
+                     "coordinates": []}]
+        out = []
+        for dc, sids in sorted(self.router.get_datacenter_maps().items()):
+            coords = [{"node": sid, "coord": self.router.coords[sid]}
+                      for sid in sids if sid in self.router.coords]
+            out.append({"datacenter": dc,
+                        "area_id": type(self.router).LOCAL_AREA,
+                        "coordinates": coords})
+        return out
 
 
 def _snake(name: str) -> str:

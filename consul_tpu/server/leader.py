@@ -13,6 +13,7 @@ strongly-consistent catalog — the same boundary the reference draws.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Iterable, Optional
 
@@ -101,6 +102,9 @@ class SessionTimers:
     def __init__(self, server: Server, now: Optional[float] = None):
         self.server = server
         self.deadlines: dict[str, float] = {}
+        # Renews arrive on HTTP handler threads while the agent pump
+        # runs tick() — the deadline map is shared mutable state.
+        self._lock = threading.Lock()
         now = time.monotonic() if now is None else now
         for s in server.store.session_list():
             if s.get("ttl_s", 0) > 0:
@@ -111,14 +115,40 @@ class SessionTimers:
         if s is None or s.get("ttl_s", 0) <= 0:
             return
         now = time.monotonic() if now is None else now
-        self.deadlines[session_id] = now + s["ttl_s"] * self.TTL_MULTIPLIER
+        with self._lock:
+            self.deadlines[session_id] = now + s["ttl_s"] * self.TTL_MULTIPLIER
 
     def expire(self, now: Optional[float] = None) -> list[str]:
         """Destroy sessions past their deadline; returns their ids."""
         now = time.monotonic() if now is None else now
-        expired = [sid for sid, dl in self.deadlines.items() if dl <= now]
+        expired = []
+        with self._lock:
+            for sid in [s for s, dl in self.deadlines.items() if dl <= now]:
+                # Re-check under the lock: a renew that raced in since
+                # the scan keeps the session (its client got a 200).
+                if self.deadlines.get(sid, now + 1) <= now:
+                    del self.deadlines[sid]
+                    expired.append(sid)
         for sid in expired:
-            del self.deadlines[sid]
             if self.server.store.session_get(sid) is not None:
                 self.server.rpc("Session.Apply", op="destroy", session_id=sid)
         return expired
+
+    def tick(self, now: Optional[float] = None) -> list[str]:
+        """One leader-loop pass: start tracking TTL sessions created
+        since the last pass (the reference arms a timer at session
+        apply, session_ttl.go resetSessionTimer — here a scan picks
+        them up), then expire. Returns expired ids."""
+        now = time.monotonic() if now is None else now
+        sessions = self.server.store.session_list()
+        live = {s["id"] for s in sessions}
+        with self._lock:
+            for s in sessions:
+                if s.get("ttl_s", 0) > 0 and s["id"] not in self.deadlines:
+                    self.deadlines[s["id"]] = \
+                        now + s["ttl_s"] * self.TTL_MULTIPLIER
+            # Deadlines for sessions destroyed through other paths
+            # (explicit destroy, node dereg cascade) retire silently.
+            for sid in [x for x in self.deadlines if x not in live]:
+                del self.deadlines[sid]
+        return self.expire(now)

@@ -123,3 +123,58 @@ class TestLoadConfig:
         p.write_text('{"sim": {"gossip": {"not_a_knob": 3}}}')
         with pytest.raises(ValueError, match="unknown config keys"):
             boot.load_config(str(p))
+
+
+class TestSessionTTLLive:
+    """Session TTLs are LIVE in a booted agent (the leader pump runs
+    SessionTimers.expire; reference leader.go session TTL timers): an
+    unrenewed TTL session is destroyed ~2*TTL after creation; renews
+    (/v1/session/renew) keep it alive."""
+
+    def test_ttl_expiry_and_renew(self, booted, tmp_path):
+        import time
+        import urllib.error
+        import urllib.request
+
+        _, ready, env = booted
+        port = ready["http_port"]
+
+        def req(method, path, body=None):
+            r = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}", method=method,
+                data=body)
+            try:
+                with urllib.request.urlopen(r, timeout=10) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                print("HTTP", e.code, path, e.read().decode()[:200])
+                raise
+
+        req("PUT", "/v1/catalog/register",
+            json.dumps({"Node": "ttl-n", "Address": "a"}).encode())
+        sid = req("PUT", "/v1/session/create",
+                  json.dumps({"Node": "ttl-n", "TTL": "600ms"}).encode()
+                  )["ID"]
+        # Renew for ~1.5s (past 2*TTL): the session must survive.
+        deadline = time.monotonic() + 1.5
+        while time.monotonic() < deadline:
+            out = req("PUT", f"/v1/session/renew/{sid}")
+            assert out[0]["id"] == sid
+            time.sleep(0.25)
+        assert any(s["id"] == sid for s in req("GET", "/v1/session/list"))
+        # Stop renewing: destroyed within ~2*TTL (+ margin).
+        deadline = time.monotonic() + 6.0
+        while time.monotonic() < deadline:
+            if not any(s["id"] == sid
+                       for s in req("GET", "/v1/session/list")):
+                break
+            time.sleep(0.2)
+        assert not any(s["id"] == sid
+                       for s in req("GET", "/v1/session/list"))
+        # Renewing the expired session 404s.
+        try:
+            req("PUT", f"/v1/session/renew/{sid}")
+            raised = False
+        except urllib.error.HTTPError as e:
+            raised = e.code == 404
+        assert raised

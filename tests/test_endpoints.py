@@ -92,7 +92,8 @@ class TestKVSession:
     def test_session_lock_via_txn(self, cluster):
         leader = cluster.leader_server()
         cluster.write(leader, "Catalog.Register", node="n1", address="a")
-        sid = cluster.write(leader, "Session.Apply", op="create", node="n1")
+        sid = cluster.write(leader, "Session.Apply", op="create",
+                            node="n1")["id"]
         cluster.write(leader, "KVS.Apply", op="lock", key="lead", value=b"me",
                       session=sid)
         assert leader.store.kv_get("lead")["session"] == sid

@@ -20,6 +20,36 @@ def two_dcs():
     return c1, c2
 
 
+class TestDatacenterListings:
+    def test_catalog_list_datacenters_sorted(self, two_dcs):
+        c1, c2 = two_dcs
+        dcs = c1.servers[0].rpc("Catalog.ListDatacenters")
+        assert set(dcs) == {"dc1", "dc2"}
+        assert c2.servers[0].rpc("Catalog.ListDatacenters")[0] in dcs
+        # A non-federated server knows only itself.
+        from consul_tpu.server.endpoints import ServerCluster
+        solo = ServerCluster(1, seed=3, dc="dcX")
+        solo.wait_converged()
+        assert solo.servers[0].rpc("Catalog.ListDatacenters") == ["dcX"]
+        # Coordinate.ListDatacenters agrees (never an empty list while
+        # the catalog names the local DC).
+        assert solo.servers[0].rpc("Coordinate.ListDatacenters") == [
+            {"datacenter": "dcX", "area_id": "wan", "coordinates": []}]
+
+    def test_coordinate_list_datacenters(self, two_dcs):
+        c1, _ = two_dcs
+        src = c1.servers[0]
+        # Plant a WAN coordinate for one dc2 server so the map carries
+        # it (router.update_coordinate — the serf WAN ping path).
+        sid = src.router.get_datacenter_maps()["dc2"][0]
+        src.router.update_coordinate(sid, {"vec": [0.01] * 8,
+                                           "height": 0.001})
+        out = src.rpc("Coordinate.ListDatacenters")
+        assert [d["datacenter"] for d in out] == ["dc1", "dc2"]
+        dc2 = next(d for d in out if d["datacenter"] == "dc2")
+        assert any(c["node"] == sid for c in dc2["coordinates"])
+
+
 class TestForwardDC:
     def test_kv_query_answers_from_remote_dc(self, two_dcs):
         c1, c2 = two_dcs

@@ -114,7 +114,7 @@ class TestSessionTTL:
         run_writes(cluster, lambda: leader.rpc(
             "Catalog.Register", node="n1", address="a"))
         sid = run_writes(cluster, lambda: leader.rpc(
-            "Session.Apply", op="create", node="n1", ttl_s=10.0))
+            "Session.Apply", op="create", node="n1", ttl_s=10.0))["id"]
         timers = SessionTimers(leader, now=0.0)
         assert timers.expire(now=19.0) == []          # within 2*ttl
         assert timers.expire(now=21.0) == [sid]       # past 2*ttl
@@ -126,11 +126,31 @@ class TestSessionTTL:
         run_writes(cluster, lambda: leader.rpc(
             "Catalog.Register", node="n1", address="a"))
         sid = run_writes(cluster, lambda: leader.rpc(
-            "Session.Apply", op="create", node="n1", ttl_s=10.0))
+            "Session.Apply", op="create", node="n1", ttl_s=10.0))["id"]
         timers = SessionTimers(leader, now=0.0)
         timers.renew(sid, now=15.0)
         assert timers.expire(now=30.0) == []
         assert timers.expire(now=36.0) == [sid]
+
+    def test_session_renew_rpc(self, cluster):
+        """The Session.Renew endpoint (reference session_endpoint.go
+        Renew): resets the attached timers' deadline, returns the
+        session, errors on unknown ids, forwards to where the timers
+        live."""
+        leader = cluster.leader_server()
+        run_writes(cluster, lambda: leader.rpc(
+            "Catalog.Register", node="n1", address="a"))
+        sid = run_writes(cluster, lambda: leader.rpc(
+            "Session.Apply", op="create", node="n1", ttl_s=10.0))["id"]
+        timers = SessionTimers(leader, now=0.0)
+        leader.session_timers = timers
+        # Renew through a FOLLOWER: forwards to the leader's timers.
+        fol = cluster.any_follower()
+        s = fol.rpc("Session.Renew", session_id=sid)
+        assert s["id"] == sid
+        assert timers.deadlines[sid] > 20.0  # pushed past the initial
+        with pytest.raises(KeyError, match="unknown session"):
+            leader.rpc("Session.Renew", session_id="nope")
 
 
 class TestAutopilot:
