@@ -15,8 +15,10 @@ starving other requests (goroutine-per-conn equivalent).
 from __future__ import annotations
 
 import base64
+import functools
 import json
 import threading
+import time as _time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 from urllib.parse import parse_qs, urlparse
@@ -73,14 +75,28 @@ class HTTPApi:
         except Exception as e:  # noqa: BLE001 — never drop the connection
             return 500, {"error": f"internal: {e!r}"}, {}
 
-    def _rpc_write(self, method: str, **args):
+    def _rpc_write(self, method: str, dc: str | None = None, **args):
         """Propose a write and wait for it to apply locally; returns
         ``(raft_index, fsm_result)`` — the synchronous raftApply
         contract (reference rpc.go:377-447: the HTTP layer receives the
         FSM's response, e.g. a CAS verdict, not an inference from a
         racy re-read). Methods that return a non-index value directly
-        (e.g. a pre-assigned session id) come back as ``(None, out)``."""
+        (e.g. a pre-assigned session id) come back as ``(None, out)``.
+        With ``dc`` the write rides the cross-DC forward (rpc.go:315
+        forwardDC) and the apply is confirmed against the REMOTE DC's
+        ApplyResult — the local wait would poll the wrong raft."""
+        if dc:
+            args["dc"] = dc
         out = self.agent.rpc(method, **args)
+        if isinstance(out, int) and dc:
+            deadline = _time.monotonic() + 5.0
+            while _time.monotonic() < deadline:
+                res = self.agent.rpc("Status.ApplyResult", index=out, dc=dc)
+                if res.get("found"):
+                    return out, res["result"]
+                _time.sleep(0.01)
+            raise RuntimeError(
+                f"apply result for raft index {out} in {dc} unavailable")
         if isinstance(out, int):
             # wait_write may return the found ApplyResult itself (the
             # client-mode pool does, saving a wire round trip); a None
@@ -105,7 +121,15 @@ class HTTPApi:
         if not parts or parts[0] != "v1":
             return 404, {"error": "not found"}, {}
         parts = parts[1:]
-        rpc = self.agent.rpc
+        # ?dc= routes the whole request through the WAN (reference
+        # http.go parseDC -> QueryOptions.Datacenter; every endpoint
+        # forwards, rpc.go:315). Reads and writes alike.
+        dc = q.get("dc") or None
+        if dc:
+            rpc = functools.partial(self.agent.rpc, dc=dc)
+        else:
+            rpc = self.agent.rpc
+        rpc_write = functools.partial(self._rpc_write, dc=dc)
 
         # ---- status ---------------------------------------------------
         if parts == ["status", "leader"]:
@@ -136,7 +160,7 @@ class HTTPApi:
             return 200, out["value"], {"X-Consul-Index": str(out["index"])}
         if parts == ["catalog", "register"] and method == "PUT":
             req = json.loads(body)
-            idx, _ = self._rpc_write(
+            idx, _ = rpc_write(
                 "Catalog.Register", node=req["Node"],
                 address=req.get("Address", ""),
                 service=_lower_keys(req.get("Service")),
@@ -145,9 +169,9 @@ class HTTPApi:
             return 200, True, {"X-Consul-Index": str(idx)}
         if parts == ["catalog", "deregister"] and method == "PUT":
             req = json.loads(body)
-            self._rpc_write("Catalog.Deregister", node=req["Node"],
-                            service_id=req.get("ServiceID"),
-                            check_id=req.get("CheckID"))
+            rpc_write("Catalog.Deregister", node=req["Node"],
+                      service_id=req.get("ServiceID"),
+                      check_id=req.get("CheckID"))
             return 200, True, {}
 
         # ---- config entries (reference agent/config_endpoint.go) ------
@@ -155,7 +179,7 @@ class HTTPApi:
             req = json.loads(body)
             kind, name = req.pop("Kind"), req.pop("Name")
             cas = int(q["cas"]) if "cas" in q else None
-            idx, ok = self._rpc_write(
+            idx, ok = rpc_write(
                 "ConfigEntry.Apply", kind=kind, name=name, entry=req,
                 cas_index=cas)
             return 200, bool(ok), {"X-Consul-Index": str(idx)}
@@ -174,7 +198,7 @@ class HTTPApi:
                 "X-Consul-Index": str(out["index"])}
         if len(parts) == 3 and parts[0] == "config" and method == "DELETE":
             cas = int(q["cas"]) if "cas" in q else None
-            idx, ok = self._rpc_write(
+            idx, ok = rpc_write(
                 "ConfigEntry.Delete", kind=parts[1], name=parts[2],
                 cas_index=cas)
             return 200, bool(ok), {"X-Consul-Index": str(idx)}
@@ -182,9 +206,11 @@ class HTTPApi:
         # ---- health ---------------------------------------------------
         if len(parts) == 3 and parts[:2] == ["health", "service"]:
             # near= needs a per-request RTT sort the shared cache entry
-            # cannot hold — fall through to the direct path rather than
-            # silently returning unsorted results.
-            if "cached" in q and not near:
+            # cannot hold, and the cache holds LOCAL-DC data only (the
+            # reference keys cache entries by Datacenter) — both fall
+            # through to the direct (dc-forwarding) path rather than
+            # silently answering with the wrong data.
+            if "cached" in q and not near and not dc:
                 # Serve through the agent cache's typed entry: any
                 # number of ?cached long-pollers share ONE background
                 # store watch (reference HTTP ?cached + agent/cache
@@ -217,13 +243,14 @@ class HTTPApi:
         # ---- kv -------------------------------------------------------
         if parts[0] == "kv":
             key = "/".join(parts[1:])
-            return self._kv(method, key, q, body, min_index, wait_s)
+            return self._kv(method, key, q, body, min_index, wait_s,
+                            rpc, rpc_write)
 
         # ---- session --------------------------------------------------
         if parts == ["session", "create"] and method == "PUT":
             req = json.loads(body or b"{}")
             ttl = _dur_to_s(req["TTL"]) if req.get("TTL") else 0.0
-            _, created = self._rpc_write(
+            _, created = rpc_write(
                 "Session.Apply", op="create",
                 node=req.get("Node", self.agent.node), ttl_s=ttl,
                 behavior=req.get("Behavior", "release"),
@@ -234,7 +261,22 @@ class HTTPApi:
             # race the commit — and CONFIRM it, like the int path: an
             # unconfirmed apply must not answer 200 with a session id
             # the store may never hold (e.g. proposal lost to a leader
-            # change in client mode).
+            # change in client mode). With ?dc= the index belongs to
+            # the REMOTE raft: confirm there (the dc-aware rpc), never
+            # against the local log.
+            if dc:
+                deadline = _time.monotonic() + 5.0
+                while _time.monotonic() < deadline:
+                    res = rpc("Status.ApplyResult",
+                              index=created["index"])
+                    if res.get("found"):
+                        break
+                    _time.sleep(0.01)
+                else:
+                    raise RuntimeError(
+                        f"session create at raft index "
+                        f"{created['index']} in {dc} unconfirmed")
+                return 200, {"ID": created["id"]}, {}
             res = self.wait_write(created["index"])
             if not isinstance(res, dict) or not res.get("found"):
                 res = self.agent.rpc("Status.ApplyResult",
@@ -245,8 +287,8 @@ class HTTPApi:
                     "unconfirmed")
             return 200, {"ID": created["id"]}, {}
         if len(parts) == 3 and parts[:2] == ["session", "destroy"]:
-            self._rpc_write("Session.Apply", op="destroy",
-                            session_id=parts[2])
+            rpc_write("Session.Apply", op="destroy",
+                      session_id=parts[2])
             return 200, True, {}
         if parts == ["session", "list"]:
             out = rpc("Session.List")
@@ -267,7 +309,7 @@ class HTTPApi:
             # /v1/coordinate/datacenters, coordinate_endpoint.go:159).
             return 200, rpc("Coordinate.ListDatacenters"), {}
         if parts == ["coordinate", "nodes"]:
-            if "cached" in q:
+            if "cached" in q and not dc:
                 out = self.agent.cache.get_blocking(
                     "coordinate-nodes", min_index=min_index, wait_s=wait_s,
                 )
@@ -293,7 +335,7 @@ class HTTPApi:
                     "cas_index": kv.get("Index"),
                     "session": kv.get("Session"),
                 })
-            _, result = self._rpc_write("Txn.Apply", ops=ops)
+            _, result = rpc_write("Txn.Apply", ops=ops)
             if isinstance(result, dict) and result.get("ok"):
                 return 200, {"Results": result.get("results", [])}, {}
             # Rolled-back transaction: 409 with the failing op, like the
@@ -418,14 +460,14 @@ class HTTPApi:
         if parts == ["operator", "raft", "peer"] and method == "DELETE":
             if "id" not in q:
                 return 400, {"error": "?id= required"}, {}
-            _, _ = self._rpc_write("Operator.RaftRemovePeer", id=q["id"])
+            _, _ = rpc_write("Operator.RaftRemovePeer", id=q["id"])
             return 200, True, {}
         if parts == ["operator", "autopilot", "configuration"]:
             if method == "GET":
                 return 200, rpc("Operator.AutopilotGetConfiguration"), {}
             if method == "PUT":
                 cas = int(q["cas"]) if "cas" in q else None
-                _, ok = self._rpc_write(
+                _, ok = rpc_write(
                     "Operator.AutopilotSetConfiguration",
                     config=json.loads(body or b"{}"), cas_index=cas)
                 # ?cas returns the verdict like the reference (a bare
@@ -487,8 +529,8 @@ class HTTPApi:
 
         return 404, {"error": f"no such endpoint {path}"}, {}
 
-    def _kv(self, method, key, q, body, min_index, wait_s):
-        rpc = self.agent.rpc
+    def _kv(self, method, key, q, body, min_index, wait_s,
+            rpc, rpc_write):
         if method == "GET":
             if "keys" in q:
                 out = rpc("KVS.List", prefix=key, min_index=min_index,
@@ -515,16 +557,16 @@ class HTTPApi:
                 op, session = "lock", q["acquire"]
             if "release" in q:
                 op, session = "unlock", q["release"]
-            _, ok = self._rpc_write("KVS.Apply", op=op, key=key, value=body,
-                                    flags=int(q.get("flags", 0)), cas_index=cas,
-                                    session=session)
+            _, ok = rpc_write("KVS.Apply", op=op, key=key, value=body,
+                              flags=int(q.get("flags", 0)), cas_index=cas,
+                              session=session)
             # ok is the FSM's own verdict for this exact log entry
             # (CAS/lock success), not an inference from a re-read that a
             # concurrent writer could have changed.
             return 200, bool(ok), {}
         if method == "DELETE":
             cas = int(q["cas"]) if "cas" in q else None
-            _, ok = self._rpc_write(
+            _, ok = rpc_write(
                 "KVS.Apply",
                 op="delete-cas" if cas is not None else (
                     "delete-tree" if "recurse" in q else "delete"),

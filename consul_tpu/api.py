@@ -81,8 +81,10 @@ class KV:
     def __init__(self, c: Client):
         self.c = c
 
-    def get(self, key: str, index: int = 0, wait: str = "10s"):
-        params = {"index": index or None, "wait": wait if index else None}
+    def get(self, key: str, index: int = 0, wait: str = "10s",
+            dc: Optional[str] = None):
+        params = {"index": index or None, "wait": wait if index else None,
+                  "dc": dc}
         out, meta, status = self.c._call("GET", f"/v1/kv/{key}", params)
         if status == 404 or not out:
             return None, meta
@@ -92,9 +94,10 @@ class KV:
 
     def put(self, key: str, value: bytes, cas: Optional[int] = None,
             flags: int = 0, acquire: Optional[str] = None,
-            release: Optional[str] = None) -> bool:
+            release: Optional[str] = None,
+            dc: Optional[str] = None) -> bool:
         params = {"cas": cas, "flags": flags or None,
-                  "acquire": acquire, "release": release}
+                  "acquire": acquire, "release": release, "dc": dc}
         out, _, _ = self.c._call("PUT", f"/v1/kv/{key}", params, value)
         return bool(out)
 
@@ -120,9 +123,10 @@ class Catalog:
     def __init__(self, c: Client):
         self.c = c
 
-    def nodes(self, near: str = "", index: int = 0, wait: str = "10s"):
+    def nodes(self, near: str = "", index: int = 0, wait: str = "10s",
+              dc: Optional[str] = None):
         params = {"near": near or None, "index": index or None,
-                  "wait": wait if index else None}
+                  "wait": wait if index else None, "dc": dc}
         out, meta, _ = self.c._call("GET", "/v1/catalog/nodes", params)
         return out, meta
 
@@ -136,8 +140,9 @@ class Catalog:
         out, _, _ = self.c._call("GET", "/v1/catalog/datacenters")
         return out
 
-    def service(self, name: str, tag: Optional[str] = None, near: str = ""):
-        params = {"tag": tag, "near": near or None}
+    def service(self, name: str, tag: Optional[str] = None, near: str = "",
+                dc: Optional[str] = None):
+        params = {"tag": tag, "near": near or None, "dc": dc}
         out, meta, _ = self.c._call("GET", f"/v1/catalog/service/{name}",
                                     params)
         return out, meta

@@ -118,3 +118,114 @@ class TestForwardDC:
         out = c1.servers[0].global_rpc("Status.Peers")
         assert len(out["dc1"]) == 3
         assert "no path to datacenter" in out["dc2"]["error"]
+
+
+class TestHTTPCrossDC:
+    """?dc= on the HTTP surface (reference http.go parseDC →
+    QueryOptions.Datacenter): reads AND writes against a remote
+    datacenter ride forwardDC, with the write's apply confirmed in the
+    REMOTE DC's raft."""
+
+    @pytest.fixture
+    def served_two_dcs(self, two_dcs):
+        import threading
+        import time
+
+        from consul_tpu.agent.agent import Agent
+        from consul_tpu.agent.http import HTTPApi
+
+        c1, c2 = two_dcs
+        stop = threading.Event()
+
+        def pump():
+            while not stop.is_set():
+                c1.step()
+                c2.step()
+                time.sleep(0.002)
+
+        threading.Thread(target=pump, daemon=True).start()
+
+        def rpc(method, **args):
+            led = c1.raft.wait_converged()
+            return c1.registry[led.id].rpc(method, **args)
+
+        def wait_write(idx):
+            import time as t
+            deadline = t.monotonic() + 5.0
+            while t.monotonic() < deadline:
+                led = c1.raft.leader()
+                if led is not None and led.last_applied >= idx:
+                    return
+                t.sleep(0.002)
+
+        agent = Agent("dc1-agent", "127.0.0.1", rpc, cluster_size=3)
+        api = HTTPApi(agent, server=c1.leader_server(),
+                      wait_write=wait_write)
+        yield c1, c2, api
+        stop.set()
+
+    def test_kv_write_and_read_remote_dc(self, served_two_dcs):
+        import base64
+
+        c1, c2, api = served_two_dcs
+        st, out, _ = api.handle("PUT", "/v1/kv/xdc",
+                                {"dc": ["dc2"]}, b"remote-v")
+        assert st == 200 and out is True
+        # The write landed in dc2's raft, not dc1's.
+        assert c2.leader_server().store.kv_get("xdc")["value"] == b"remote-v"
+        assert c1.leader_server().store.kv_get("xdc") is None
+        st, out, _ = api.handle("GET", "/v1/kv/xdc", {"dc": ["dc2"]}, b"")
+        assert st == 200
+        assert base64.b64decode(out[0]["Value"]) == b"remote-v"
+        # Without ?dc= the local DC answers: not found.
+        st, _, _ = api.handle("GET", "/v1/kv/xdc", {}, b"")
+        assert st == 404
+
+    def test_catalog_read_remote_dc(self, served_two_dcs):
+        _, c2, api = served_two_dcs
+        c2.write(c2.leader_server(), "Catalog.Register",
+                 node="web-dc2", address="10.2.0.9",
+                 service={"service": "web", "port": 80})
+        st, out, _ = api.handle("GET", "/v1/catalog/service/web",
+                                {"dc": ["dc2"]}, b"")
+        assert st == 200 and [n["node"] for n in out] == ["web-dc2"]
+
+    def test_unknown_dc_is_an_error(self, served_two_dcs):
+        _, _, api = served_two_dcs
+        st, out, _ = api.handle("GET", "/v1/kv/x", {"dc": ["dc9"]}, b"")
+        assert st == 500 and "no path to datacenter" in str(out)
+
+    def test_session_create_remote_dc_confirms_remotely(self, served_two_dcs):
+        """A ?dc= session create confirms its apply against the REMOTE
+        raft (the created index belongs to dc2's log, not dc1's)."""
+        import json as _json
+
+        c1, c2, api = served_two_dcs
+        c2.write(c2.leader_server(), "Catalog.Register",
+                 node="n-dc2", address="a")
+        st, out, _ = api.handle(
+            "PUT", "/v1/session/create", {"dc": ["dc2"]},
+            _json.dumps({"Node": "n-dc2"}).encode())
+        assert st == 200, out
+        sid = out["ID"]
+        # The session lives in dc2's store, not dc1's.
+        assert c2.leader_server().store.session_get(sid) is not None
+        assert c1.leader_server().store.session_get(sid) is None
+
+    def test_cached_ignored_with_dc(self, served_two_dcs):
+        """&cached serves LOCAL-DC cache entries only; with ?dc= the
+        request falls through to the forwarded path instead of
+        answering from the wrong datacenter's cache."""
+        _, c2, api = served_two_dcs
+        c2.write(c2.leader_server(), "Catalog.Register",
+                 node="web-c", address="10.2.0.7",
+                 service={"service": "webc", "port": 80},
+                 check={"check_id": "up", "status": "passing",
+                        "service_id": "webc"})
+        st, out, hdrs = api.handle(
+            "GET", "/v1/health/service/webc",
+            {"dc": ["dc2"], "cached": [""]}, b"")
+        assert st == 200
+        assert [r["node"] for r in out] == ["web-c"]
+        assert "X-Cache" not in hdrs  # not served from the local cache
+
