@@ -179,7 +179,8 @@ class AgentRuntime:
         self.agent.reload_hook = self._reload
         self.agent.join_hook = getattr(self, "_join", None)
         self.api = HTTPApi(self.agent, server=api_server,
-                           wait_write=wait_write)
+                           wait_write=wait_write,
+                           datacenter=cfg["datacenter"])
         self.httpd = None
         self.http_port = None
 

@@ -46,8 +46,13 @@ class HTTPApi:
     :meth:`handle` directly (the httptest idiom)."""
 
     def __init__(self, agent: Agent, server: Optional[Server] = None,
-                 wait_write: Optional[Any] = None):
+                 wait_write: Optional[Any] = None,
+                 datacenter: Optional[str] = None):
         self.agent = agent
+        # This agent's own datacenter: ?dc= naming it resolves to the
+        # plain local path (reference parseDC treats the local DC as
+        # no-op), keeping the shared cache entries usable.
+        self.datacenter = datacenter
         # server: for endpoints needing direct store access (snapshot) —
         # present in server mode, None in pure client mode.
         self.server = server
@@ -89,14 +94,7 @@ class HTTPApi:
             args["dc"] = dc
         out = self.agent.rpc(method, **args)
         if isinstance(out, int) and dc:
-            deadline = _time.monotonic() + 5.0
-            while _time.monotonic() < deadline:
-                res = self.agent.rpc("Status.ApplyResult", index=out, dc=dc)
-                if res.get("found"):
-                    return out, res["result"]
-                _time.sleep(0.01)
-            raise RuntimeError(
-                f"apply result for raft index {out} in {dc} unavailable")
+            return out, self._confirm_dc_apply(out, dc)
         if isinstance(out, int):
             # wait_write may return the found ApplyResult itself (the
             # client-mode pool does, saving a wire round trip); a None
@@ -116,15 +114,37 @@ class HTTPApi:
             return out, res["result"]
         return None, out
 
+    def _confirm_dc_apply(self, index: int, dc: str):
+        """Poll the REMOTE DC's ApplyResult for a forwarded write's
+        verdict — the local raft's indexes are meaningless for it."""
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline:
+            res = self.agent.rpc("Status.ApplyResult", index=index, dc=dc)
+            if res.get("found"):
+                return res["result"]
+            _time.sleep(0.01)
+        raise RuntimeError(
+            f"apply result for raft index {index} in {dc} unavailable")
+
     def _route(self, method, path, q, query, body, min_index, wait_s, near):
         parts = [p for p in path.split("/") if p]
         if not parts or parts[0] != "v1":
             return 404, {"error": "not found"}, {}
         parts = parts[1:]
-        # ?dc= routes the whole request through the WAN (reference
-        # http.go parseDC -> QueryOptions.Datacenter; every endpoint
-        # forwards, rpc.go:315). Reads and writes alike.
+        # ?dc= routes the request through the WAN (reference http.go
+        # parseDC -> QueryOptions.Datacenter; rpc.go:315 forwardDC).
+        # Reads and writes alike. Naming the LOCAL DC is a no-op (the
+        # cache stays usable); agent-local endpoints (and snapshot/
+        # event, which this framework serves agent-side) never forward
+        # and say so instead of silently answering locally.
         dc = q.get("dc") or None
+        if dc and dc == self.datacenter:
+            dc = None
+        if dc and (parts[0] in ("agent", "event") or parts == ["snapshot"]):
+            return 400, {"error":
+                         f"?dc= is not supported on /v1/{parts[0]}: this "
+                         "endpoint is agent-local and does not forward — "
+                         "address an agent in that datacenter"}, {}
         if dc:
             rpc = functools.partial(self.agent.rpc, dc=dc)
         else:
@@ -265,17 +285,7 @@ class HTTPApi:
             # the REMOTE raft: confirm there (the dc-aware rpc), never
             # against the local log.
             if dc:
-                deadline = _time.monotonic() + 5.0
-                while _time.monotonic() < deadline:
-                    res = rpc("Status.ApplyResult",
-                              index=created["index"])
-                    if res.get("found"):
-                        break
-                    _time.sleep(0.01)
-                else:
-                    raise RuntimeError(
-                        f"session create at raft index "
-                        f"{created['index']} in {dc} unconfirmed")
+                self._confirm_dc_apply(created["index"], dc)
                 return 200, {"ID": created["id"]}, {}
             res = self.wait_write(created["index"])
             if not isinstance(res, dict) or not res.get("found"):
@@ -402,6 +412,23 @@ class HTTPApi:
             return 200, {"Config": {"NodeName": self.agent.node},
                          "Member": {"Name": self.agent.node,
                                     "Addr": self.agent.address}}, {}
+        if parts == ["agent", "services"]:
+            # The agent's LOCAL registrations (reference
+            # /v1/agent/services, agent_endpoint.go AgentServices —
+            # local state, not a catalog query).
+            return 200, {
+                s.id: {"ID": s.id, "Service": s.service, "Port": s.port,
+                       "Tags": list(s.tags), "Meta": dict(s.meta)}
+                for s in self.agent.local.services.values()
+            }, {}
+        if parts == ["agent", "checks"]:
+            # Reference /v1/agent/checks (agent_endpoint.go AgentChecks).
+            return 200, {
+                c.check_id: {"CheckID": c.check_id, "Status": c.status,
+                             "ServiceID": c.service_id,
+                             "Output": c.output}
+                for c in self.agent.local.checks.values()
+            }, {}
         if parts == ["agent", "metrics"]:
             # go-metrics DisplayMetrics shape (reference
             # http_register.go:39 -> lib/telemetry.go InmemSink), with

@@ -269,6 +269,18 @@ class AgentAPI:
         out, _, _ = self.c._call("PUT", f"/v1/agent/join/{address}")
         return bool(out)
 
+    def services(self) -> dict:
+        """The agent's LOCAL service registrations (reference
+        api/agent.go Services)."""
+        out, _, _ = self.c._call("GET", "/v1/agent/services")
+        return out
+
+    def checks(self) -> dict:
+        """The agent's LOCAL check states (reference api/agent.go
+        Checks)."""
+        out, _, _ = self.c._call("GET", "/v1/agent/checks")
+        return out
+
     def service_register(self, name: str, service_id: str = "",
                          port: int = 0, tags: Optional[list] = None,
                          check_ttl: str = "") -> bool:

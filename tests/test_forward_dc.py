@@ -229,3 +229,14 @@ class TestHTTPCrossDC:
         assert [r["node"] for r in out] == ["web-c"]
         assert "X-Cache" not in hdrs  # not served from the local cache
 
+
+    def test_non_forwarding_endpoints_reject_remote_dc(self, served_two_dcs):
+        """Agent-local endpoints (and snapshot/event) do not forward;
+        a remote ?dc= is an explicit 400, never a silent local answer
+        (a dc2 snapshot restore must not overwrite dc1's store)."""
+        _, _, api = served_two_dcs
+        for method, path in (("PUT", "/v1/snapshot"),
+                             ("PUT", "/v1/event/fire/deploy"),
+                             ("GET", "/v1/agent/services")):
+            st, out, _ = api.handle(method, path, {"dc": ["dc2"]}, b"{}")
+            assert st == 400 and "does not forward" in str(out), (path, out)

@@ -145,6 +145,22 @@ class TestHTTP:
             lambda: client.health.service("cache", passing=True)[0] != []
         )
 
+    def test_agent_local_services_and_checks_listings(self, stack):
+        """/v1/agent/services and /v1/agent/checks list the agent's
+        LOCAL state (reference agent_endpoint.go AgentServices/
+        AgentChecks — not a catalog query)."""
+        _, agent, client, _ = stack
+        client.agent.service_register("inv", service_id="inv1",
+                                      port=9000, check_ttl="10s")
+        svcs = client.agent.services()
+        assert svcs["inv1"] == {"ID": "inv1", "Service": "inv",
+                                "Port": 9000, "Tags": [], "Meta": {}}
+        checks = client.agent.checks()
+        assert checks["service:inv1"]["Status"] == "critical"
+        assert checks["service:inv1"]["ServiceID"] == "inv1"
+        client.agent.check_pass("service:inv1", note="ok")
+        assert client.agent.checks()["service:inv1"]["Status"] == "passing"
+
     def test_session_lock_recipe(self, stack):
         _, _, client, _ = stack
         client.catalog.register("web-agent", "10.9.0.1")
