@@ -95,6 +95,13 @@ class Agent:
         # to config_loader.apply_safe on its Simulation; returns the
         # list of applied knob paths.
         self.reload_hook: Optional[Callable[[], list]] = None
+        # Graceful leave (reference agent.Leave, agent/agent.go:
+        # serf.Leave + catalog deregistration). left stops the duty
+        # cycle so anti-entropy cannot re-register the node after the
+        # deregister; leave_hook lets a runtime turn the leave into a
+        # process shutdown (boot wires it to the stop flag).
+        self.left = False
+        self.leave_hook: Optional[Callable[[], None]] = None
         # Post-boot join (reference /v1/agent/join + `consul join`):
         # a client-mode boot wires this to add a server RPC address to
         # the connection pool at runtime; None = not joinable this way
@@ -215,6 +222,32 @@ class Agent:
             return False
         return bool(self.force_leave_hook(node))
 
+    def leave(self) -> bool:
+        """Graceful leave (reference agent.Leave, agent/agent.go:1387:
+        serf leave broadcast + catalog deregistration before shutdown).
+        Sets ``left`` FIRST so a concurrent tick cannot re-register the
+        node between the deregister and the flag. The gossip plane must
+        hear the leave too — otherwise the leader's serf reconcile sees
+        an alive member with no catalog entry and registers it right
+        back — so the force-leave hook (the route into models/serf
+        .leave) is applied to OURSELVES before the deregister, the
+        self-targeted serf Leave broadcast of the reference. Both
+        effects are best-effort: leaving while the servers are down
+        still leaves."""
+        self.left = True
+        if self.force_leave_hook is not None:
+            try:
+                self.force_leave_hook(self.node)
+            except Exception:  # noqa: BLE001 — gossip plane gone
+                pass
+        try:
+            self.rpc("Catalog.Deregister", node=self.node)
+        except Exception:  # noqa: BLE001 — unreachable server
+            pass
+        if self.leave_hook is not None:
+            self.leave_hook()
+        return True
+
     # -- maintenance mode (reference agent/agent.go EnableNodeMaintenance
     # / EnableServiceMaintenance): a synthetic critical check that flows
     # through anti-entropy into the catalog, so ?passing= discovery and
@@ -267,6 +300,11 @@ class Agent:
         """One agent pump: run checks, sync if due, send coordinate if
         due. Returns which duties ran (for drivers/tests)."""
         ran = {"sync": False, "coordinate": False}
+        if self.left:
+            # A left agent runs no duties: syncing would re-register
+            # the node leave() just deregistered (reference: Leave
+            # stops the state syncer before deregistering).
+            return ran
         self.checks.tick(now)
         # Check status changes mark entries dirty; sync as scheduled or
         # immediately when something is dirty (changes trigger

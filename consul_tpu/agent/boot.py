@@ -178,6 +178,10 @@ class AgentRuntime:
         )
         self.agent.reload_hook = self._reload
         self.agent.join_hook = getattr(self, "_join", None)
+        # /v1/agent/leave: answer 200, then the main loop shuts down
+        # (setting the stop flag here, not calling shutdown(), keeps
+        # the HTTP response from racing its own listener teardown).
+        self.agent.leave_hook = self._stop.set
         self.api = HTTPApi(self.agent, server=api_server,
                            wait_write=wait_write,
                            datacenter=cfg["datacenter"])

@@ -126,6 +126,18 @@ class HTTPApi:
         raise RuntimeError(
             f"apply result for raft index {index} in {dc} unavailable")
 
+    def _local_service_health(self, service_ids: list[str]) -> str:
+        """Worst status over the named local services' checks plus the
+        node-level ones (reference agent/agent.go AgentLocalBlockingQuery
+        health rollup for /v1/agent/health/service/*)."""
+        worst = "passing"
+        for c in self.agent.local.checks.values():
+            if c.service_id and c.service_id not in service_ids:
+                continue
+            if _severity(c.status) > _severity(worst):
+                worst = c.status
+        return worst
+
     def _route(self, method, path, q, query, body, min_index, wait_s, near):
         parts = [p for p in path.split("/") if p]
         if not parts or parts[0] != "v1":
@@ -312,6 +324,16 @@ class HTTPApi:
             except KeyError:
                 return 404, {"error": f"unknown session {parts[2]}"}, {}
             return 200, [s], {}
+        if len(parts) == 3 and parts[:2] == ["session", "info"]:
+            # Reference /v1/session/info/:id (session_endpoint.go Get):
+            # a list — empty for an unknown id, never a 404.
+            out = rpc("Session.Get", session_id=parts[2],
+                      min_index=min_index, wait_s=wait_s)
+            return 200, out["value"], {"X-Consul-Index": str(out["index"])}
+        if len(parts) == 3 and parts[:2] == ["session", "node"]:
+            out = rpc("Session.NodeSessions", node=parts[2],
+                      min_index=min_index, wait_s=wait_s)
+            return 200, out["value"], {"X-Consul-Index": str(out["index"])}
 
         # ---- coordinates ----------------------------------------------
         if parts == ["coordinate", "datacenters"]:
@@ -333,6 +355,15 @@ class HTTPApi:
         if len(parts) == 3 and parts[:2] == ["coordinate", "node"]:
             out = rpc("Coordinate.Node", node=parts[2])
             return 200, out["value"], {"X-Consul-Index": str(out["index"])}
+        if parts == ["coordinate", "update"] and method == "PUT":
+            # Reference /v1/coordinate/update (coordinate_endpoint.go
+            # CoordinateUpdate): stage one node's coordinate for the
+            # server's batched flush. Validation (dimensionality,
+            # finite components) happens server-side.
+            req = json.loads(body)
+            rpc("Coordinate.Update", node=req["Node"],
+                coord=req["Coord"], segment=req.get("Segment", ""))
+            return 200, True, {}
 
         # ---- txn ------------------------------------------------------
         if parts == ["txn"] and method == "PUT":
@@ -412,6 +443,87 @@ class HTTPApi:
             return 200, {"Config": {"NodeName": self.agent.node},
                          "Member": {"Name": self.agent.node,
                                     "Addr": self.agent.address}}, {}
+        if parts == ["agent", "members"]:
+            # Reference /v1/agent/members (agent_endpoint.go
+            # AgentMembers: the serf membership view). Gossip
+            # membership is reconciled into the catalog by the leader
+            # (leader.py reconcile), so the member view here is the
+            # catalog + serfHealth rollup; ?wan= on a federated server
+            # lists the WAN pool (server_serf.go).
+            if q.get("wan") in ("1", "true"):
+                srv = self.server
+                if srv is None or srv.wan_registry is None:
+                    return 400, {"error":
+                                 "?wan= requires a federated server"}, {}
+                return 200, [
+                    {"Name": wid, "Addr": s.id, "Status": "alive",
+                     "Tags": {"dc": s.dc, "role": "consul"}}
+                    for wid, s in sorted(srv.wan_registry.items())
+                ], {}
+            nodes = rpc("Catalog.ListNodes")["value"]
+            checks = rpc("Health.ChecksInState", state="any")["value"]
+            by_node = {c["node"]: c["status"] for c in checks
+                       if c["check_id"] == "serfHealth"}
+            return 200, [
+                {"Name": n["node"], "Addr": n.get("address", ""),
+                 "Status": {"passing": "alive",
+                            "critical": "failed"}.get(
+                                by_node.get(n["node"], ""), "alive"),
+                 "Tags": {}}
+                for n in nodes
+            ], {}
+        if parts == ["agent", "leave"] and method == "PUT":
+            # Graceful leave (reference /v1/agent/leave → agent.Leave):
+            # deregister, stop duties, signal the runtime to exit.
+            return 200, self.agent.leave(), {}
+        if parts == ["agent", "host"]:
+            # Reference /v1/agent/host (agent_endpoint.go AgentHost via
+            # gopsutil): host diagnostics for `consul debug`.
+            import os as _os
+            import platform as _pf
+            u = _pf.uname()
+            mem = {}
+            try:
+                with open("/proc/meminfo") as f:
+                    for line in f:
+                        k, _, v = line.partition(":")
+                        if k in ("MemTotal", "MemAvailable"):
+                            mem[k] = int(v.split()[0]) * 1024
+            except (OSError, ValueError):
+                pass
+            return 200, {
+                "Host": {"hostname": u.node, "os": u.system.lower(),
+                         "kernelVersion": u.release, "arch": u.machine},
+                "CPU": {"count": _os.cpu_count()},
+                "Memory": mem,
+            }, {}
+        if len(parts) == 5 and parts[:4] == ["agent", "health", "service",
+                                             "id"]:
+            # Reference /v1/agent/health/service/id/:id
+            # (agent_endpoint.go AgentHealthServiceByID): the LOCAL
+            # rollup — worst status over the service's local checks
+            # plus node-level ones; the HTTP status encodes it
+            # (200/429/503, health.go).
+            s = self.agent.local.services.get(parts[4])
+            if s is None:
+                return 404, {"error": f"unknown service id {parts[4]}"}, {}
+            status = self._local_service_health([s.id])
+            return {"passing": 200, "warning": 429,
+                    "critical": 503}[status], {
+                "AggregatedStatus": status,
+                "Service": {"ID": s.id, "Service": s.service}}, {}
+        if len(parts) == 5 and parts[:4] == ["agent", "health", "service",
+                                             "name"]:
+            ids = [s.id for s in self.agent.local.services.values()
+                   if s.service == parts[4]]
+            if not ids:
+                return 404, {"error": f"unknown service {parts[4]}"}, {}
+            status = self._local_service_health(ids)
+            return {"passing": 200, "warning": 429,
+                    "critical": 503}[status], [{
+                "AggregatedStatus": status,
+                "Service": {"ID": sid, "Service": parts[4]}}
+                for sid in ids], {}
         if parts == ["agent", "services"]:
             # The agent's LOCAL registrations (reference
             # /v1/agent/services, agent_endpoint.go AgentServices —
@@ -449,6 +561,72 @@ class HTTPApi:
             return 200, True, {}
         if len(parts) == 4 and parts[:3] == ["agent", "service", "deregister"]:
             self.agent.remove_service(parts[3])
+            self.agent.tick(_now())
+            return 200, True, {}
+        if len(parts) == 3 and parts[0] == "agent" and \
+                parts[1] == "service" and method == "GET":
+            # Reference /v1/agent/service/:id (agent_endpoint.go
+            # AgentService): one LOCAL registration. (The reference
+            # hash-blocks on this; a plain read fits the model here.)
+            s = self.agent.local.services.get(parts[2])
+            if s is None:
+                return 404, {"error": f"unknown service id {parts[2]}"}, {}
+            return 200, {"ID": s.id, "Service": s.service, "Port": s.port,
+                         "Tags": list(s.tags), "Meta": dict(s.meta)}, {}
+        if parts == ["agent", "check", "register"] and method == "PUT":
+            # Reference /v1/agent/check/register (agent_endpoint.go
+            # AgentRegisterCheck): standalone check definitions —
+            # TTL / HTTP / TCP / alias runners (agent/checks/check.go).
+            req = json.loads(body)
+            cid = req.get("ID") or req.get("CheckID") or req["Name"]
+            sid = req.get("ServiceID", "")
+            if sid and sid not in self.agent.local.services:
+                return 400, {"error": f"unknown service id {sid!r}"}, {}
+            interval = _dur_to_s(req["Interval"]) if req.get("Interval") \
+                else 10.0
+            now = _now()
+            if req.get("TTL"):
+                self.agent.checks.add_ttl(cid, _dur_to_s(req["TTL"]), sid,
+                                          now=now)
+            elif req.get("HTTP"):
+                self.agent.checks.add_http(cid, req["HTTP"], interval,
+                                           service_id=sid, now=now)
+            elif req.get("TCP"):
+                host, _, port = req["TCP"].rpartition(":")
+                self.agent.checks.add_tcp(cid, host, int(port), interval,
+                                          service_id=sid, now=now)
+            elif req.get("AliasNode"):
+                self.agent.checks.add_alias(
+                    cid, self.agent.rpc, req["AliasNode"],
+                    req.get("AliasService", ""), interval_s=interval,
+                    service_id=sid, now=now)
+            else:
+                return 400, {"error":
+                             "check needs one of TTL/HTTP/TCP/AliasNode"}, {}
+            self.agent.tick(_now())
+            return 200, True, {}
+        if len(parts) == 4 and parts[:3] == ["agent", "check",
+                                             "deregister"] and method == "PUT":
+            if parts[3] not in self.agent.checks.checks:
+                return 404, {"error": f"unknown check {parts[3]}"}, {}
+            self.agent.checks.remove(parts[3])
+            self.agent.tick(_now())
+            return 200, True, {}
+        if len(parts) == 4 and parts[:3] == ["agent", "check", "update"] \
+                and method == "PUT":
+            # Reference /v1/agent/check/update/:id (AgentCheckUpdate):
+            # set a TTL check's status + output in one call.
+            req = json.loads(body or b"{}")
+            chk = self.agent.checks.checks.get(parts[3])
+            if chk is None:
+                return 404, {"error": f"unknown check {parts[3]}"}, {}
+            verb = {"passing": "pass_", "warning": "warn",
+                    "critical": "fail"}.get(req.get("Status", ""))
+            if verb is None or not hasattr(chk, verb):
+                return 400, {"error":
+                             "Status must be passing/warning/critical "
+                             "on a TTL check"}, {}
+            getattr(chk, verb)(_now(), req.get("Output", ""))
             self.agent.tick(_now())
             return 200, True, {}
         if parts == ["agent", "reload"] and method == "PUT":
@@ -500,6 +678,23 @@ class HTTPApi:
                 # ?cas returns the verdict like the reference (a bare
                 # set returns true).
                 return 200, bool(ok), {}
+        if parts == ["operator", "autopilot", "health"]:
+            # Reference /v1/operator/autopilot/health
+            # (operator_autopilot_endpoint.go ServerHealth →
+            # OperatorHealthReply).
+            h = rpc("Operator.ServerHealth")
+            return 200, {
+                "Healthy": h["healthy"],
+                "FailureTolerance": h["failure_tolerance"],
+                "Servers": [{
+                    "ID": s["id"], "Name": s["name"],
+                    "Healthy": s["healthy"], "Voter": s["voter"],
+                    "Leader": s["leader"],
+                    "LastContact": s["last_contact_ticks"],
+                    "TrailingLogs": s["trailing_logs"],
+                    "Reason": s["reason"],
+                } for s in h["servers"]],
+            }, {}
 
         # ---- internal (reference internal_endpoint.go NodeInfo/
         # NodeDump via /v1/internal/ui/*) --------------------------------
@@ -514,6 +709,52 @@ class HTTPApi:
             if not rows:
                 return 404, {"error": f"unknown node {parts[3]}"}, {}
             return 200, rows[0], {"X-Consul-Index": str(out["index"])}
+        if parts == ["internal", "ui", "services"]:
+            # Reference /v1/internal/ui/services (ui_endpoint.go
+            # UIServices): per-service rollup — instance count and
+            # worst check status — aggregated from the node dump.
+            out = rpc("Internal.NodeDump", min_index=min_index,
+                      wait_s=wait_s)
+            summary: dict[str, dict] = {}
+            for nd in out["value"]:
+                svc_checks = {}
+                node_worst = "passing"
+                for c in nd.get("checks", []):
+                    # Catalog check statuses are unvalidated on
+                    # registration — bucket anything unknown as
+                    # critical rather than 400ing the whole rollup.
+                    st = c.get("status", "critical")
+                    if st not in ("passing", "warning"):
+                        st = "critical"
+                    sid = c.get("service_id") or ""
+                    if sid:
+                        prev = svc_checks.get(sid, "passing")
+                        if _severity(st) > _severity(prev):
+                            svc_checks[sid] = st
+                        else:
+                            svc_checks.setdefault(sid, st)
+                    elif _severity(st) > _severity(node_worst):
+                        node_worst = st
+                for s in nd.get("services", []):
+                    name = s.get("service", "")
+                    row = summary.setdefault(name, {
+                        "Name": name, "Nodes": [], "InstanceCount": 0,
+                        "ChecksPassing": 0, "ChecksWarning": 0,
+                        "ChecksCritical": 0, "Tags": set(),
+                    })
+                    if nd["node"] not in row["Nodes"]:
+                        row["Nodes"].append(nd["node"])
+                    row["InstanceCount"] += 1
+                    row["Tags"].update(s.get("tags") or [])
+                    worst = svc_checks.get(s.get("id", ""), "passing")
+                    if _severity(node_worst) > _severity(worst):
+                        worst = node_worst  # node-level checks gate it
+                    row[{"passing": "ChecksPassing",
+                         "warning": "ChecksWarning",
+                         "critical": "ChecksCritical"}[worst]] += 1
+            rows = [dict(r, Tags=sorted(r["Tags"]))
+                    for _, r in sorted(summary.items())]
+            return 200, rows, {"X-Consul-Index": str(out["index"])}
 
         if parts == ["operator", "keyring"]:
             # Reference operator/keyring (agent/operator_endpoint.go):
@@ -632,6 +873,12 @@ def _lower_keys(d: Optional[dict]) -> Optional[dict]:
     return {{"ID": "id", "Service": "service", "Port": "port",
              "Tags": "tags", "Meta": "meta"}.get(k, k.lower()): v
             for k, v in d.items()}
+
+
+def _severity(status: str) -> int:
+    """Check-status severity ordering (reference structs' check status
+    precedence: any unrecognized status ranks as critical)."""
+    return {"passing": 0, "warning": 1}.get(status, 2)
 
 
 def _check_from_api(d: Optional[dict]) -> Optional[dict]:

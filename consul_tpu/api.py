@@ -218,6 +218,17 @@ class Session:
         out, meta, _ = self.c._call("GET", "/v1/session/list")
         return out, meta
 
+    def info(self, session_id: str):
+        """One session — a list, empty for an unknown id (reference
+        api/session.go Info)."""
+        out, meta, _ = self.c._call("GET", f"/v1/session/info/{session_id}")
+        return out, meta
+
+    def node(self, node: str):
+        """Sessions held by one node (reference api/session.go Node)."""
+        out, meta, _ = self.c._call("GET", f"/v1/session/node/{node}")
+        return out, meta
+
 
 class Coordinate:
     def __init__(self, c: Client):
@@ -326,6 +337,70 @@ class AgentAPI:
              "reason": reason or None})
         return bool(out)
 
+    def members(self, wan: bool = False) -> list[dict]:
+        """The agent's member view (reference api/agent.go Members)."""
+        out, _, _ = self.c._call("GET", "/v1/agent/members",
+                                 {"wan": "1"} if wan else None)
+        return out
+
+    def leave(self) -> bool:
+        """Graceful leave + shutdown (reference api/agent.go Leave)."""
+        out, _, _ = self.c._call("PUT", "/v1/agent/leave")
+        return bool(out)
+
+    def host(self) -> dict:
+        out, _, _ = self.c._call("GET", "/v1/agent/host")
+        return out
+
+    def service(self, service_id: str) -> dict:
+        """One LOCAL service registration (reference api/agent.go
+        AgentService)."""
+        out, _, _ = self.c._call("GET", f"/v1/agent/service/{service_id}")
+        return out
+
+    def check_register(self, name: str, check_id: str = "",
+                       ttl: str = "", http: str = "", tcp: str = "",
+                       alias_node: str = "", interval: str = "",
+                       service_id: str = "") -> bool:
+        """Standalone check registration (reference api/agent.go
+        CheckRegister)."""
+        body: dict = {"Name": name}
+        for k, v in (("ID", check_id), ("TTL", ttl), ("HTTP", http),
+                     ("TCP", tcp), ("AliasNode", alias_node),
+                     ("Interval", interval), ("ServiceID", service_id)):
+            if v:
+                body[k] = v
+        out, _, _ = self.c._call("PUT", "/v1/agent/check/register", None,
+                                 json.dumps(body).encode())
+        return bool(out)
+
+    def check_deregister(self, check_id: str) -> bool:
+        out, _, _ = self.c._call(
+            "PUT", f"/v1/agent/check/deregister/{check_id}")
+        return bool(out)
+
+    def check_update(self, check_id: str, status: str,
+                     output: str = "") -> bool:
+        """Set a TTL check's status + output (reference api/agent.go
+        UpdateTTL)."""
+        out, _, _ = self.c._call(
+            "PUT", f"/v1/agent/check/update/{check_id}", None,
+            json.dumps({"Status": status, "Output": output}).encode())
+        return bool(out)
+
+    def health_service_by_id(self, service_id: str) -> tuple[str, dict]:
+        """(aggregated status, body) for one local service (reference
+        api/agent.go AgentHealthServiceByID). Status rides the HTTP
+        code (200/429/503), so non-2xx is data here, not an error."""
+        try:
+            out, _, _ = self.c._call(
+                "GET", f"/v1/agent/health/service/id/{service_id}")
+        except APIError as e:
+            if e.status in (429, 503) and isinstance(e.body, dict):
+                return e.body["AggregatedStatus"], e.body
+            raise
+        return out["AggregatedStatus"], out
+
 
 class ConfigEntries:
     """Config-entry endpoints (reference api/config_entry.go:
@@ -413,6 +488,12 @@ class Operator:
             json.dumps(config).encode())
         return bool(out)
 
+    def autopilot_server_health(self) -> dict:
+        """Per-server autopilot health (reference api/operator_autopilot.go
+        AutopilotServerHealth → /v1/operator/autopilot/health)."""
+        out, _, _ = self.c._call("GET", "/v1/operator/autopilot/health")
+        return out
+
 
 class Internal:
     """The combined node+services+checks dump (reference
@@ -427,6 +508,12 @@ class Internal:
 
     def node_info(self, node: str):
         out, meta, _ = self.c._call("GET", f"/v1/internal/ui/node/{node}")
+        return out, meta
+
+    def ui_services(self):
+        """Per-service rollup — instance count + check status counts
+        (reference ui_endpoint.go UIServices)."""
+        out, meta, _ = self.c._call("GET", "/v1/internal/ui/services")
         return out, meta
 
 

@@ -13,9 +13,10 @@ framework implements:
   sessions list                                        (command/acl… session)
   snapshot save|restore                                (command/snapshot)
   join             route a client agent onto servers   (command/join)
+  leave            graceful leave + shutdown           (command/leave)
   event fire|list / watch / force-leave / debug
   operator raft list-peers|remove-peer                 (command/operator)
-  operator autopilot get-config|set-config
+  operator autopilot get-config|set-config|health
   maint            node/service maintenance mode       (command/maint)
   keyring          gossip key install/use/remove/list  (command/keyring)
   monitor          stream agent logs                   (command/monitor)
@@ -232,6 +233,14 @@ def cmd_join(client: Client, args) -> int:
     return 0 if ok else 1
 
 
+def cmd_leave(client: Client, args) -> int:
+    """Graceful leave (reference command/leave → /v1/agent/leave):
+    the agent deregisters and its runtime shuts down."""
+    ok = client.agent.leave()
+    print("Graceful leave complete" if ok else "error: leave failed")
+    return 0 if ok else 1
+
+
 def cmd_force_leave(client: Client, args) -> int:
     """Force a failed member out (reference command/forceleave →
     agent ForceLeave → serf.RemoveFailedNode)."""
@@ -261,6 +270,19 @@ def cmd_operator(client: Client, args) -> int:
             return 1
         print(f"Removed peer with id {args.id!r}")
         return 0
+    if args.operator_cmd == "autopilot" and args.autopilot_cmd == "health":
+        # Reference `consul operator autopilot ...` health view
+        # (api/operator_autopilot.go AutopilotServerHealth).
+        h = client.operator.autopilot_server_health()
+        print(f"Healthy: {h['Healthy']}  "
+              f"FailureTolerance: {h['FailureTolerance']}")
+        for s in h["Servers"]:
+            role = "leader" if s["Leader"] else (
+                "voter" if s["Voter"] else "non-voter")
+            state = "healthy" if s["Healthy"] else (
+                f"unhealthy ({s['Reason']})")
+            print(f"{s['Name']:<12} {role:<10} {state}")
+        return 0 if h["Healthy"] else 1
     if args.operator_cmd == "autopilot" and args.autopilot_cmd == "get-config":
         cfg = client.operator.autopilot_get_configuration()
         for k in sorted(cfg):
@@ -555,6 +577,8 @@ def build_parser() -> argparse.ArgumentParser:
     fl = sub.add_parser("force-leave", help="force a failed member out")
     fl.add_argument("node")
 
+    sub.add_parser("leave", help="gracefully leave and shut down the agent")
+
     op_p = sub.add_parser("operator", help="operator tooling")
     op_sub = op_p.add_subparsers(dest="operator_cmd", required=True)
     raft_p = op_sub.add_parser("raft")
@@ -565,6 +589,7 @@ def build_parser() -> argparse.ArgumentParser:
     ap_p = op_sub.add_parser("autopilot")
     ap_sub = ap_p.add_subparsers(dest="autopilot_cmd", required=True)
     ap_sub.add_parser("get-config")
+    ap_sub.add_parser("health")
     sc = ap_sub.add_parser("set-config")
     sc.add_argument("-cleanup-dead-servers", choices=["true", "false"],
                     default=None)
@@ -628,7 +653,7 @@ COMMANDS = {
     "catalog": cmd_catalog, "info": cmd_info, "services": cmd_services,
     "sessions": cmd_sessions, "snapshot": cmd_snapshot, "debug": cmd_debug,
     "event": cmd_event, "watch": cmd_watch, "join": cmd_join,
-    "force-leave": cmd_force_leave,
+    "force-leave": cmd_force_leave, "leave": cmd_leave,
     "operator": cmd_operator, "maint": cmd_maint, "keyring": cmd_keyring,
     "monitor": cmd_monitor, "validate": cmd_validate, "lock": cmd_lock,
     "exec": cmd_exec, "reload": cmd_reload, "config": cmd_config,

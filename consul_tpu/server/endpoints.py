@@ -363,6 +363,25 @@ class Server:
     def _session_list(self) -> dict:
         return {"index": self.store.index, "value": self.store.session_list()}
 
+    def _session_get(self, session_id: str, min_index: int = 0,
+                     wait_s: float = 10.0) -> dict:
+        """Blocking read of one session (reference session_endpoint.go
+        Get → /v1/session/info/:id). value is a LIST — empty for an
+        unknown id, like the reference's Sessions slice."""
+        def fn():
+            s = self.store.session_get(session_id)
+            return [] if s is None else [s]
+        return self._blocking(("sessions",), min_index, wait_s, fn)
+
+    def _session_node_sessions(self, node: str, min_index: int = 0,
+                               wait_s: float = 10.0) -> dict:
+        """Sessions held by one node (reference session_endpoint.go
+        NodeSessions → /v1/session/node/:node)."""
+        def fn():
+            return [s for s in self.store.session_list()
+                    if s.get("node") == node]
+        return self._blocking(("sessions",), min_index, wait_s, fn)
+
     def _session_renew(self, session_id: str) -> dict:
         """Reset a TTL session's destroy deadline and return the
         session (reference session_endpoint.go Renew →
@@ -483,6 +502,73 @@ class Server:
         if cas_index is not None:
             cmd["cas_index"] = cas_index
         return self._raft_apply(cmd)
+
+    def _operator_server_health(self) -> dict:
+        """Autopilot's per-server health verdicts plus the cluster
+        rollup (reference operator_autopilot_endpoint.go:56-76
+        ServerHealth → OperatorHealthReply: healthy/voter/leader per
+        server, FailureTolerance = healthy voters beyond quorum).
+        Scored from the same stats the autopilot loop fetches
+        (autopilot.server_health), taken over this server's raft view
+        of the configuration."""
+        from consul_tpu.server import autopilot as ap
+
+        leader_id = self.raft.leader_id
+        ids = sorted({self.raft.id, *self.raft.peers})
+        if leader_id is None or leader_id not in self.registry:
+            # No scorable leader (mid-transition, or leader_id points
+            # at a peer gone from the registry after remove-peer).
+            # This endpoint is the diagnostic an operator reaches for
+            # EXACTLY then — report every server unscored rather than
+            # erroring the whole request (the reference still answers
+            # with per-server rows from its last stats).
+            return {
+                "healthy": False, "failure_tolerance": 0,
+                "servers": [{
+                    "id": sid, "name": sid, "healthy": False,
+                    "voter": sid in self.raft.voters, "leader": False,
+                    "last_contact_ticks": None, "trailing_logs": 0,
+                    "reason": "no leader to score health from",
+                } for sid in ids],
+            }
+        leader = self.registry[leader_id].raft
+        stats: dict[str, Optional[dict]] = {}
+        for sid in ids:
+            srv = self.registry.get(sid)
+            n = srv.raft if srv is not None else None
+            if n is None or n.stopped:
+                stats[sid] = None
+            else:
+                stats[sid] = {
+                    "last_index": n.last_log_index(), "term": n.term,
+                    "contact_age": n.ticks - n.last_contact_tick,
+                    "voter": n.voter, "is_leader": n.state == "leader",
+                }
+        servers = []
+        for sid in ids:
+            srv = self.registry.get(sid)
+            if srv is None:
+                h = ap.ServerHealth(sid, False, False, None, 0,
+                                    "unknown server")
+            else:
+                # stats is pre-fetched, so server_health never touches
+                # its cluster argument (the StatsFetcher contract).
+                h = ap.server_health(None, srv.raft, leader, stats)
+            servers.append({
+                "id": h.id, "name": h.id, "healthy": h.healthy,
+                "voter": h.voter, "leader": h.id == leader_id,
+                "last_contact_ticks": h.last_contact_ticks,
+                "trailing_logs": h.trailing_logs, "reason": h.reason,
+            })
+        n_voters = len(self.raft.voters)
+        healthy_voters = sum(1 for s in servers
+                             if s["healthy"] and s["voter"])
+        quorum = n_voters // 2 + 1
+        return {
+            "healthy": all(s["healthy"] for s in servers),
+            "failure_tolerance": max(0, healthy_voters - quorum),
+            "servers": servers,
+        }
 
     # ------------------------------------------------------------------
     # Internal endpoint (reference agent/consul/internal_endpoint.go:

@@ -91,6 +91,27 @@ class TestAgentBoot:
         proc.send_signal(signal.SIGTERM)
         assert proc.wait(timeout=15) == 0
 
+    def test_leave_verb_shuts_down(self, tmp_path):
+        """`consul-tpu leave` (reference command/leave): the agent
+        answers 200, deregisters, and its process exits cleanly."""
+        cfg = tmp_path / "l.json"
+        cfg.write_text(json.dumps({
+            "node_name": "leaver-boot", "n_servers": 1,
+            "http": {"host": "127.0.0.1", "port": 0},
+        }))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "consul_tpu.cli", "agent",
+             "--config-file", str(cfg)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        ready = json.loads(proc.stdout.readline())
+        out = run_cli(env, ready["http_port"], "leave")
+        assert out.returncode == 0, out.stderr
+        assert "Graceful leave complete" in out.stdout
+        assert proc.wait(timeout=15) == 0
+
 
 class TestLoadConfig:
     def test_defaults(self):

@@ -596,3 +596,148 @@ class TestConfigHTTP:
         assert "proxy-defaults/global" in buf.getvalue()
         assert cli_main(argv + ["config", "delete", "-kind",
                                 "proxy-defaults", "-name", "global"]) == 0
+
+
+class TestRound5Surface:
+    """Round-5 HTTP surface: session info/node, coordinate update,
+    autopilot health, UI services rollup, agent members/host/leave,
+    standalone check CRUD, and the local health rollup endpoints."""
+
+    def test_session_info_and_node(self, stack):
+        # Reference /v1/session/info/:id + /v1/session/node/:node
+        # (session_endpoint.go Get/NodeSessions): lists, empty for
+        # unknown ids — never 404.
+        _, _, client, _ = stack
+        client.catalog.register("sess-node", "10.9.9.1")
+        assert wait_for(lambda: any(n["node"] == "sess-node"
+                                    for n in client.catalog.nodes()[0]))
+        sid = client.session.create(node="sess-node")
+        rows, _ = client.session.info(sid)
+        assert rows[0]["id"] == sid and rows[0]["node"] == "sess-node"
+        rows, _ = client.session.node("sess-node")
+        assert any(r["id"] == sid for r in rows)
+        rows, _ = client.session.info("not-a-session")
+        assert rows == []
+        client.session.destroy(sid)
+
+    def test_coordinate_update_over_http(self, stack):
+        # Reference /v1/coordinate/update (CoordinateUpdate): stage →
+        # batched raft flush.
+        cluster, _, client, _ = stack
+        client.catalog.register("cu-node", "10.9.9.2")
+        assert wait_for(lambda: any(n["node"] == "cu-node"
+                                    for n in client.catalog.nodes()[0]))
+        out, _, _ = client._call(
+            "PUT", "/v1/coordinate/update", None,
+            json.dumps({"Node": "cu-node",
+                        "Coord": {"vec": [0.002] * 8, "error": 0.1,
+                                  "height": 0.0001}}).encode())
+        assert out is True
+        cluster.registry[cluster.raft.wait_converged().id] \
+            .flush_coordinates()
+        assert wait_for(lambda: any(c["node"] == "cu-node"
+                                    for c in client.coordinate.nodes()[0]))
+        # Bad dimensionality is a 400, mirroring the RPC validation.
+        with pytest.raises(Exception, match="400|dimensionality"):
+            client._call(
+                "PUT", "/v1/coordinate/update", None,
+                json.dumps({"Node": "cu-node",
+                            "Coord": {"vec": [1.0]}}).encode())
+
+    def test_autopilot_server_health(self, stack):
+        # Reference /v1/operator/autopilot/health (OperatorHealthReply).
+        _, _, client, _ = stack
+        h = client.operator.autopilot_server_health()
+        assert h["Healthy"] is True
+        assert len(h["Servers"]) == 3
+        assert sum(1 for s in h["Servers"] if s["Leader"]) == 1
+        assert all(s["Voter"] for s in h["Servers"])
+        # 3 healthy voters, quorum 2 -> may lose exactly one.
+        assert h["FailureTolerance"] == 1
+
+    def test_ui_services_rollup(self, stack):
+        # Reference /v1/internal/ui/services (UIServices): instance
+        # count + per-status check counts per service name.
+        _, _, client, _ = stack
+        client.catalog.register(
+            "ui-n1", "10.9.9.3",
+            service={"id": "web-1", "service": "uiweb", "port": 80},
+            check={"CheckID": "c1", "Status": "passing",
+                   "ServiceID": "web-1"})
+        client.catalog.register(
+            "ui-n2", "10.9.9.4",
+            service={"id": "web-2", "service": "uiweb", "port": 80},
+            check={"CheckID": "c2", "Status": "critical",
+                   "ServiceID": "web-2"})
+        def row():
+            rows, _ = client.internal.ui_services()
+            return next((r for r in rows if r["Name"] == "uiweb"), None)
+        assert wait_for(lambda: (row() or {}).get("InstanceCount") == 2)
+        r = row()
+        assert sorted(r["Nodes"]) == ["ui-n1", "ui-n2"]
+        assert r["ChecksPassing"] == 1 and r["ChecksCritical"] == 1
+
+    def test_agent_members_and_host(self, stack):
+        # Reference /v1/agent/members + /v1/agent/host.
+        _, _, client, _ = stack
+        client.catalog.register("mem-node", "10.9.9.5")
+        assert wait_for(lambda: any(m["Name"] == "mem-node"
+                                    for m in client.agent.members()))
+        m = next(m for m in client.agent.members()
+                 if m["Name"] == "mem-node")
+        assert m["Addr"] == "10.9.9.5" and m["Status"] == "alive"
+        h = client.agent.host()
+        assert h["CPU"]["count"] >= 1 and "hostname" in h["Host"]
+
+    def test_agent_service_get_and_check_crud(self, stack):
+        # Reference /v1/agent/service/:id + check register/update/
+        # deregister (agent_endpoint.go).
+        _, _, client, _ = stack
+        client.agent.service_register("db", service_id="db1", port=5432)
+        s = client.agent.service("db1")
+        assert s == {"ID": "db1", "Service": "db", "Port": 5432,
+                     "Tags": [], "Meta": {}}
+        assert client.agent.service("nope") is None  # 404 -> None body
+        assert client.agent.check_register(
+            "db-ttl", check_id="db-ttl", ttl="10s", service_id="db1")
+        assert client.agent.checks()["db-ttl"]["Status"] == "critical"
+        assert client.agent.check_update("db-ttl", "warning", "meh")
+        assert client.agent.checks()["db-ttl"]["Status"] == "warning"
+        status, body = client.agent.health_service_by_id("db1")
+        assert status == "warning"
+        assert client.agent.check_update("db-ttl", "passing", "ok")
+        status, _ = client.agent.health_service_by_id("db1")
+        assert status == "passing"
+        out, _, _ = client._call("GET", "/v1/agent/health/service/name/db")
+        assert out[0]["AggregatedStatus"] == "passing"
+        assert client.agent.check_deregister("db-ttl")
+        assert "db-ttl" not in client.agent.checks()
+        client.agent.service_deregister("db1")
+
+    def test_agent_leave(self, stack):
+        # Reference /v1/agent/leave -> agent.Leave: deregister, stop
+        # anti-entropy, fire the runtime hook. A fresh Agent so the
+        # module's shared one keeps its duties.
+        _, agent, client, _ = stack
+        leaver = Agent("leaver", "10.9.9.9", agent.rpc, cluster_size=3)
+        api2 = HTTPApi(leaver, wait_write=lambda idx: None)
+        client.catalog.register("leaver", "10.9.9.9")
+        assert wait_for(lambda: any(n["node"] == "leaver"
+                                    for n in client.catalog.nodes()[0]))
+        fired, gossip_left = [], []
+        leaver.leave_hook = lambda: fired.append(1)
+        # The gossip plane must hear the leave (or the leader's serf
+        # reconcile would re-register the node): leave() self-applies
+        # the force-leave hook.
+        leaver.force_leave_hook = gossip_left.append
+        st, body, _ = api2.handle("PUT", "/v1/agent/leave", {}, b"")
+        assert st == 200 and body is True
+        assert fired == [1] and leaver.left
+        assert gossip_left == ["leaver"]
+        assert wait_for(lambda: all(n["node"] != "leaver"
+                                    for n in client.catalog.nodes()[0]))
+        # A left agent's tick is inert: nothing re-registers.
+        leaver.tick(time.time())
+        time.sleep(0.1)
+        assert all(n["node"] != "leaver"
+                   for n in client.catalog.nodes()[0])
