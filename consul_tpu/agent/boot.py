@@ -74,6 +74,11 @@ _DEFAULTS = {
     # outgoing upgrade (cert/key only needed against verify_incoming
     # servers).
     "tls": None,
+    # DNS interface (reference ports.dns 8600; agent/dns.go):
+    # {"host": ..., "port": 0} enables it (0 = free port); null = off.
+    # Tunables mirror dns_config: udp_answer_limit, only_passing,
+    # node_ttl_s / service_ttl_s.
+    "dns": None,
     "sim": None,
 }
 
@@ -166,6 +171,8 @@ class AgentRuntime:
         self.cluster = None
         self.rpc_listener = None
         self.rpc_port = None
+        self.dns = None
+        self.dns_port = None
 
         if cfg["server"]:
             rpc, wait_write, api_server = self._build_server_tier()
@@ -304,11 +311,26 @@ class AgentRuntime:
 
     # ------------------------------------------------------------------
     def start(self) -> int:
-        """Bind HTTP, start the raft pump (server mode); returns the
-        bound HTTP port."""
+        """Bind HTTP (+ DNS when configured), start the raft pump
+        (server mode); returns the bound HTTP port."""
         self.httpd, self.http_port = serve(
             self.api, self.cfg["http"]["host"], int(self.cfg["http"]["port"])
         )
+        dns_cfg = self.cfg.get("dns")
+        if dns_cfg:
+            from consul_tpu.agent.dns import DNSServer
+            self.dns = DNSServer(
+                self.agent.rpc, node_name=self.cfg["node_name"],
+                datacenter=self.cfg["datacenter"],
+                udp_answer_limit=int(
+                    dns_cfg.get("udp_answer_limit", 3)),
+                only_passing=bool(dns_cfg.get("only_passing", False)),
+                node_ttl_s=int(dns_cfg.get("node_ttl_s", 0)),
+                service_ttl_s=int(dns_cfg.get("service_ttl_s", 0)),
+            )
+            self.dns_port = self.dns.serve(
+                dns_cfg.get("host", "127.0.0.1"),
+                int(dns_cfg.get("port", 0)))
         if self.cluster is not None:
             threading.Thread(target=self._pump, daemon=True).start()
             # Seed the serfHealth record for this node (the leader's
@@ -406,6 +428,8 @@ class AgentRuntime:
 
     def shutdown(self):
         self._stop.set()
+        if self.dns is not None:
+            self.dns.close()
         if self.rpc_listener is not None:
             self.rpc_listener.close()
         if self.httpd is not None:
@@ -432,5 +456,6 @@ def run(config_file: Optional[str], overrides: Optional[dict] = None) -> int:
         "mode": "server" if cfg["server"] else "client",
         "servers": int(cfg["n_servers"]) if cfg["server"] else 0,
         "rpc_port": rt.rpc_port,
+        "dns_port": rt.dns_port,
     }), flush=True)
     return rt.run_forever()

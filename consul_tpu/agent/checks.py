@@ -207,12 +207,9 @@ class CheckRunner:
                 rows = [r for r in rows
                         if r.get("service_id") == target_service_id]
             # No checks on the target -> passing (alias.go:150-158).
-            worst = "passing"
-            order = {"passing": 0, "warning": 1, "critical": 2}
-            for r in rows:
-                st = r.get("status", "critical")
-                if order.get(st, 2) > order[worst]:
-                    worst = st
+            from consul_tpu.utils.health import worst_status
+            worst = worst_status(r.get("status", "critical")
+                                 for r in rows)
             return worst, (
                 "All checks passing." if worst == "passing"
                 else f"Aliased check(s) {worst} ({len(rows)} watched)."

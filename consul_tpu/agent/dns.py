@@ -1,0 +1,475 @@
+"""DNS interface: service discovery over real DNS packets.
+
+Mirrors the reference DNS server (reference agent/dns.go:186-1250):
+``<node>.node[.<dc>].consul`` A lookups, ``[tag.]<service>.service
+[.<dc>].consul`` A/SRV lookups over healthy instances, RFC 2782
+``_service._tag.service.consul`` SRV syntax, ``<name>.query[.<dc>]
+.consul`` prepared-query execution, ``<ip>.addr.consul`` and reverse
+``in-addr.arpa`` PTR lookups, NXDOMAIN+SOA negative answers, shuffled
+answers for load spread, and UDP truncation with the TC bit.
+
+The wire codec is implemented here from the RFCs (1035/2782) — the
+environment ships no DNS library, and the subset Consul speaks is
+small: queries with one question, responses with A/AAAA/CNAME/SRV/PTR/
+SOA records, name compression on decode (we emit uncompressed names).
+Cross-DC lookups ride the same ``dc=`` RPC forwarding as HTTP.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import random
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Callable, Optional
+
+# Record types (RFC 1035 / 2782).
+A, NS, CNAME, SOA, PTR, TXT, AAAA, SRV, ANY = \
+    1, 2, 5, 6, 12, 16, 28, 33, 255
+# Response codes.
+NOERROR, FORMERR, SERVFAIL, NXDOMAIN, NOTIMP, REFUSED = 0, 1, 2, 3, 4, 5
+
+DEFAULT_UDP_ANSWER_LIMIT = 3          # reference config: dns_config.udp_answer_limit
+MAX_UDP_PAYLOAD = 512                 # pre-EDNS0 classic limit
+
+
+# ----------------------------------------------------------------------
+# Wire codec
+# ----------------------------------------------------------------------
+
+def encode_name(name: str) -> bytes:
+    out = b""
+    for label in name.rstrip(".").split("."):
+        if not label:
+            continue
+        raw = label.encode()
+        if len(raw) > 63:
+            raise ValueError(f"label too long: {label!r}")
+        out += bytes([len(raw)]) + raw
+    return out + b"\x00"
+
+
+def decode_name(data: bytes, off: int) -> tuple[str, int]:
+    """Decode a (possibly compressed) name; returns (name, next offset).
+    Follows RFC 1035 §4.1.4 pointers with a hop cap against loops."""
+    labels, hops, jumped, end = [], 0, False, off
+    while True:
+        if off >= len(data):
+            raise ValueError("truncated name")
+        ln = data[off]
+        if ln & 0xC0 == 0xC0:
+            if off + 1 >= len(data):
+                raise ValueError("truncated pointer")
+            ptr = ((ln & 0x3F) << 8) | data[off + 1]
+            if not jumped:
+                end = off + 2
+            off, jumped, hops = ptr, True, hops + 1
+            if hops > 32:
+                raise ValueError("compression loop")
+            continue
+        off += 1
+        if ln == 0:
+            if not jumped:
+                end = off
+            break
+        labels.append(data[off:off + ln].decode("ascii", "replace"))
+        off += ln
+    return ".".join(labels), end
+
+
+def encode_query(qid: int, qname: str, qtype: int) -> bytes:
+    # Flags: RD set (standard resolver behavior).
+    return (struct.pack(">HHHHHH", qid, 0x0100, 1, 0, 0, 0)
+            + encode_name(qname) + struct.pack(">HH", qtype, 1))
+
+
+def _rdata(rtype: int, value: Any) -> bytes:
+    if rtype == A:
+        return ipaddress.IPv4Address(value).packed
+    if rtype == AAAA:
+        return ipaddress.IPv6Address(value).packed
+    if rtype in (CNAME, PTR, NS):
+        return encode_name(value)
+    if rtype == SRV:
+        pri, weight, port, target = value
+        return struct.pack(">HHH", pri, weight, port) + encode_name(target)
+    if rtype == TXT:
+        raw = value.encode() if isinstance(value, str) else value
+        return bytes([len(raw)]) + raw
+    if rtype == SOA:
+        mname, rname, serial, refresh, retry, expire, minimum = value
+        return (encode_name(mname) + encode_name(rname)
+                + struct.pack(">IIIII", serial, refresh, retry, expire,
+                              minimum))
+    raise ValueError(f"unsupported rtype {rtype}")
+
+
+def encode_response(qid: int, qname: str, qtype: int, answers: list,
+                    authority: list = (), rcode: int = NOERROR,
+                    tc: bool = False) -> bytes:
+    """answers/authority: [(name, rtype, ttl, value)]."""
+    flags = 0x8480 | (0x0200 if tc else 0) | (rcode & 0xF)
+    out = struct.pack(">HHHHHH", qid, flags, 1, len(answers),
+                      len(authority), 0)
+    out += encode_name(qname) + struct.pack(">HH", qtype, 1)
+    for name, rtype, ttl, value in [*answers, *authority]:
+        rd = _rdata(rtype, value)
+        out += (encode_name(name)
+                + struct.pack(">HHIH", rtype, 1, int(ttl), len(rd)) + rd)
+    return out
+
+
+def decode_message(data: bytes) -> dict:
+    """Decode header + question + answer/authority records (the subset
+    a test client or stub resolver needs)."""
+    qid, flags, qd, an, ns_n, _ = struct.unpack(">HHHHHH", data[:12])
+    off = 12
+    questions = []
+    for _ in range(qd):
+        name, off = decode_name(data, off)
+        qtype, qclass = struct.unpack(">HH", data[off:off + 4])
+        off += 4
+        questions.append({"name": name, "qtype": qtype})
+    def records(n, off):
+        out = []
+        for _ in range(n):
+            name, off = decode_name(data, off)
+            rtype, _, ttl, rdlen = struct.unpack(">HHIH", data[off:off + 10])
+            off += 10
+            body = data[off:off + rdlen]
+            if rtype == A:
+                value: Any = str(ipaddress.IPv4Address(body))
+            elif rtype == AAAA:
+                value = str(ipaddress.IPv6Address(body))
+            elif rtype in (CNAME, PTR, NS):
+                value, _ = decode_name(data, off)
+            elif rtype == SRV:
+                pri, weight, port = struct.unpack(">HHH", body[:6])
+                target, _ = decode_name(data, off + 6)
+                value = (pri, weight, port, target)
+            else:
+                value = body
+            off += rdlen
+            out.append({"name": name, "rtype": rtype, "ttl": ttl,
+                        "value": value})
+        return out, off
+    answers, off = records(an, off)
+    authority, off = records(ns_n, off)
+    return {"id": qid, "flags": flags, "rcode": flags & 0xF,
+            "tc": bool(flags & 0x0200), "questions": questions,
+            "answers": answers, "authority": authority}
+
+
+# ----------------------------------------------------------------------
+# Server
+# ----------------------------------------------------------------------
+
+class DNSServer:
+    """Serves the ``.consul`` domain from the agent's RPC surface.
+
+    ``rpc(method, **args)``: same route a HTTPApi uses (dc-aware).
+    The server is transport-split like the reference (dns.go
+    ListenAndServe starts a UDP and a TCP listener on the same port):
+    UDP answers are truncated to ``udp_answer_limit`` with TC set when
+    trimmed (trimDNSResponse), TCP returns everything length-prefixed.
+    """
+
+    def __init__(self, rpc: Callable[..., Any], *, node_name: str = "",
+                 domain: str = "consul", datacenter: str = "dc1",
+                 node_ttl_s: int = 0, service_ttl_s: int = 0,
+                 udp_answer_limit: int = DEFAULT_UDP_ANSWER_LIMIT,
+                 only_passing: bool = False, seed: int = 0):
+        self.rpc = rpc
+        self.node_name = node_name
+        self.domain = domain.strip(".").lower()
+        self.datacenter = datacenter
+        self.node_ttl_s = node_ttl_s
+        self.service_ttl_s = service_ttl_s
+        self.udp_answer_limit = udp_answer_limit
+        self.only_passing = only_passing
+        self.rng = random.Random(seed)
+        self._udp: Optional[socketserver.ThreadingUDPServer] = None
+        self._tcp: Optional[socketserver.ThreadingTCPServer] = None
+        self.port = 0
+        self.metrics = {"queries": 0, "nxdomain": 0, "errors": 0,
+                        "truncated": 0}
+
+    # -- lifecycle -----------------------------------------------------
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        outer = self
+
+        class UDPHandler(socketserver.BaseRequestHandler):
+            def handle(self):
+                data, sock = self.request
+                out = outer.handle_packet(data, udp=True)
+                if out:
+                    sock.sendto(out, self.client_address)
+
+        class TCPHandler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    hdr = self.request.recv(2)
+                    if len(hdr) < 2:
+                        return
+                    (ln,) = struct.unpack(">H", hdr)
+                    data = b""
+                    while len(data) < ln:
+                        chunk = self.request.recv(ln - len(data))
+                        if not chunk:
+                            return
+                        data += chunk
+                    out = outer.handle_packet(data, udp=False)
+                    if out:
+                        self.request.sendall(struct.pack(">H", len(out))
+                                             + out)
+                except OSError:
+                    pass
+
+        class _TCPServer(socketserver.ThreadingTCPServer):
+            # Scoped to this subclass — mutating the stdlib class
+            # would leak SO_REUSEADDR into every TCP server in the
+            # process.
+            allow_reuse_address = True
+
+        self._udp = socketserver.ThreadingUDPServer((host, port), UDPHandler)
+        self.port = self._udp.server_address[1]
+        # TCP rides the same port number (dns.go serves both).
+        self._tcp = _TCPServer((host, self.port), TCPHandler)
+        for srv in (self._udp, self._tcp):
+            srv.daemon_threads = True
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return self.port
+
+    def close(self):
+        for srv in (self._udp, self._tcp):
+            if srv is not None:
+                srv.shutdown()
+                srv.server_close()
+
+    # -- core ----------------------------------------------------------
+    def handle_packet(self, data: bytes, udp: bool) -> Optional[bytes]:
+        self.metrics["queries"] += 1
+        try:
+            msg = decode_message(data)
+            q = msg["questions"][0]
+        except (ValueError, struct.error, IndexError):
+            self.metrics["errors"] += 1
+            return None
+        qid, qname, qtype = msg["id"], q["name"], q["qtype"]
+        try:
+            answers, rcode = self.answer(qname, qtype)
+        except Exception:  # noqa: BLE001 — a lookup error is SERVFAIL
+            self.metrics["errors"] += 1
+            return encode_response(qid, qname, qtype, [], rcode=SERVFAIL)
+        authority = []
+        if rcode == NXDOMAIN or (rcode == NOERROR and not answers):
+            # Negative answers carry the SOA (dns.go addSOA).
+            self.metrics["nxdomain"] += rcode == NXDOMAIN
+            authority = [(self.domain, SOA, 0, self._soa_value())]
+        tc = False
+        if udp and len(answers) > self.udp_answer_limit:
+            # trimDNSResponse: drop answers, flag truncation so the
+            # client can retry over TCP.
+            answers = answers[:self.udp_answer_limit]
+            tc = True
+        out = encode_response(qid, qname, qtype, answers, authority,
+                              rcode, tc)
+        # Size trim too: a classic (non-EDNS0) stub drops datagrams
+        # past 512 bytes, so keep shedding answers until we fit
+        # (trimDNSResponse trims by size as well as count).
+        while udp and len(out) > MAX_UDP_PAYLOAD and answers:
+            answers = answers[:-1]
+            tc = True
+            out = encode_response(qid, qname, qtype, answers, authority,
+                                  rcode, tc)
+        if tc:
+            self.metrics["truncated"] += 1
+        return out
+
+    def _soa_value(self):
+        ns = f"ns.{self.domain}"
+        return (ns, f"hostmaster.{self.domain}", 0, 3600, 600, 86400, 0)
+
+    # -- dispatch (dns.go doDispatch:555-700) --------------------------
+    def answer(self, qname: str, qtype: int) -> tuple[list, int]:
+        labels = [p for p in qname.lower().split(".") if p]
+        if labels[-2:] == ["in-addr", "arpa"]:
+            return self._ptr_lookup(qname, labels)
+        if not labels or labels[-1] != self.domain:
+            return [], REFUSED
+        labels = labels[:-1]
+        if labels == ["ns"] or not labels:
+            # Apex/NS queries answer the server itself (nameservers()).
+            return ([(qname, SOA, 0, self._soa_value())]
+                    if qtype in (SOA, ANY) else []), NOERROR
+        kind_i = next((i for i in range(len(labels) - 1, -1, -1)
+                       if labels[i] in ("service", "connect", "node",
+                                        "query", "addr")), None)
+        if kind_i is None:
+            if qtype == SRV and labels and labels[-1].startswith("_"):
+                # SRV's optional "service" label (doDispatch default arm).
+                kind, parts, suffixes = "service", labels, []
+            else:
+                return [], NXDOMAIN
+        else:
+            kind = labels[kind_i]
+            parts, suffixes = labels[:kind_i], labels[kind_i + 1:]
+        dc = None
+        if suffixes:
+            if len(suffixes) > 1:
+                return [], NXDOMAIN
+            dc = suffixes[0] if suffixes[0] != self.datacenter else None
+        if not parts:
+            return [], NXDOMAIN
+        if kind == "node":
+            return self._node_lookup(qname, qtype, ".".join(parts), dc)
+        if kind in ("service", "connect"):
+            if (len(parts) == 2 and parts[0].startswith("_")
+                    and parts[1].startswith("_")):
+                # RFC 2782 _name._tag; _tcp means untagged (doDispatch).
+                tag = parts[1][1:]
+                return self._service_lookup(
+                    qname, qtype, parts[0][1:],
+                    "" if tag == "tcp" else tag, dc)
+            tag = ".".join(parts[:-1]) if len(parts) >= 2 else ""
+            return self._service_lookup(qname, qtype, parts[-1], tag, dc)
+        if kind == "query":
+            return self._query_lookup(qname, qtype, ".".join(parts), dc)
+        if kind == "addr":
+            # <hex-ip>.addr.consul (dns.go:680): echo the encoded
+            # address back as an A record.
+            try:
+                ip = str(ipaddress.IPv4Address(bytes.fromhex(parts[0])))
+            except ValueError:
+                return [], NXDOMAIN
+            return [(qname, A, self.node_ttl_s, ip)], NOERROR
+        return [], NXDOMAIN
+
+    # -- lookups -------------------------------------------------------
+    def _addr_records(self, qname: str, address: str, ttl: int) -> list:
+        """A for IPv4, AAAA for IPv6, CNAME otherwise (dns.go
+        formatNodeRecord)."""
+        try:
+            ip = ipaddress.ip_address(address)
+        except ValueError:
+            return [(qname, CNAME, ttl, address)]
+        return [(qname, AAAA if ip.version == 6 else A, ttl, str(ip))]
+
+    def _node_lookup(self, qname, qtype, node, dc):
+        got = self.rpc("Internal.NodeInfo",
+                       **({"node": node, "dc": dc} if dc
+                          else {"node": node}))
+        rows = got["value"]
+        if not rows:
+            return [], NXDOMAIN
+        addr = rows[0].get("address", "")
+        if not addr:
+            return [], NXDOMAIN
+        if qtype in (A, AAAA, ANY, TXT, SRV):
+            return self._addr_records(qname, addr, self.node_ttl_s), NOERROR
+        return [], NOERROR
+
+    def _service_rows_to_records(self, qname, qtype, rows, ttl):
+        self.rng.shuffle(rows)
+        answers = []
+        for r in rows:
+            addr = (r["service"].get("address")
+                    or r.get("address") or "")
+            if qtype == SRV:
+                target = f"{r['node']}.node.{self.domain}"
+                answers.append((qname, SRV, ttl,
+                                (1, 1, r["service"].get("port", 0),
+                                 target)))
+            elif addr:
+                answers.extend(self._addr_records(qname, addr, ttl))
+        return answers
+
+    def _service_lookup(self, qname, qtype, service, tag, dc):
+        args: dict = {"service": service,
+                      "passing_only": self.only_passing}
+        if dc:
+            args["dc"] = dc
+        out = self.rpc("Health.ServiceNodes", **args)
+        rows = out["value"]
+        if tag:
+            rows = [r for r in rows
+                    if tag in (r["service"].get("tags") or [])]
+        # DNS always filters critical instances (lookupServiceNodes
+        # filters; only_passing additionally drops warning).
+        rows = [r for r in rows
+                if r.get("aggregate_status", "passing") != "critical"]
+        if not rows:
+            return [], NXDOMAIN
+        return (self._service_rows_to_records(
+            qname, qtype, rows, self.service_ttl_s), NOERROR)
+
+    def _query_lookup(self, qname, qtype, name, dc):
+        args: dict = {"query_id_or_name": name}
+        if dc:
+            args["dc"] = dc
+        if self.node_name:
+            args["near"] = self.node_name
+        try:
+            out = self.rpc("PreparedQuery.Execute", **args)
+        except KeyError:
+            return [], NXDOMAIN
+        ttl_s = out.get("dns", {}).get("ttl", "")
+        try:
+            ttl = int(float(ttl_s.rstrip("s"))) if ttl_s \
+                else self.service_ttl_s
+        except ValueError:
+            ttl = self.service_ttl_s
+        rows = out["nodes"]
+        if not rows:
+            return [], NXDOMAIN
+        # Preserve the query's RTT sort: no extra shuffle when the
+        # query declared Near (preparedQueryLookup keeps order).
+        answers = []
+        for r in rows:
+            addr = (r["service"].get("address")
+                    or r.get("address") or "")
+            if qtype == SRV:
+                answers.append((qname, SRV, ttl,
+                                (1, 1, r["service"].get("port", 0),
+                                 f"{r['node']}.node.{self.domain}")))
+            elif addr:
+                answers.extend(self._addr_records(qname, addr, ttl))
+        return answers, NOERROR
+
+    def _ptr_lookup(self, qname, labels):
+        """Reverse lookup (dns.go handlePtr): match the address against
+        catalog nodes."""
+        octets = labels[:-2]
+        if len(octets) != 4:
+            return [], NXDOMAIN
+        addr = ".".join(reversed(octets))
+        out = self.rpc("Catalog.ListNodes")
+        for n in out["value"]:
+            if n.get("address") == addr:
+                return [(qname, PTR, self.node_ttl_s,
+                         f"{n['node']}.node.{self.domain}")], NOERROR
+        return [], NXDOMAIN
+
+
+def lookup(host: str, port: int, qname: str, qtype: int = A,
+           timeout_s: float = 3.0, tcp: bool = False) -> dict:
+    """Minimal stub resolver for tests/CLI (the dig of this module)."""
+    qid = random.randrange(0x10000)
+    pkt = encode_query(qid, qname, qtype)
+    if tcp:
+        with socket.create_connection((host, port),
+                                      timeout=timeout_s) as s:
+            s.sendall(struct.pack(">H", len(pkt)) + pkt)
+            hdr = s.recv(2)
+            (ln,) = struct.unpack(">H", hdr)
+            data = b""
+            while len(data) < ln:
+                data += s.recv(ln - len(data))
+    else:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.settimeout(timeout_s)
+            s.sendto(pkt, (host, port))
+            data, _ = s.recvfrom(4096)
+    return decode_message(data)

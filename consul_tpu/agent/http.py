@@ -26,6 +26,7 @@ from urllib.parse import parse_qs, urlparse
 from consul_tpu.agent.agent import Agent
 from consul_tpu.server.endpoints import Server
 from consul_tpu.server.raft import NotLeader
+from consul_tpu.utils import health as _health
 
 
 def _dur_to_s(s: str) -> float:
@@ -125,6 +126,102 @@ class HTTPApi:
             _time.sleep(0.01)
         raise RuntimeError(
             f"apply result for raft index {index} in {dc} unavailable")
+
+    def _query(self, method, parts, q, body, min_index, wait_s, rpc, dc):
+        """/v1/query family (reference agent/prepared_query_endpoint.go:
+        General=list/create, Specific=get/update/delete/execute/explain).
+        Writes confirm their apply verdict — a False from the FSM is a
+        replicated name collision, answered 400 like the reference's
+        endpoint error, never a silent success."""
+        def confirmed_apply(**args):
+            out = self.agent.rpc("PreparedQuery.Apply",
+                                 **(dict(args, dc=dc) if dc else args))
+            idx = out["index"] if isinstance(out, dict) else out
+            if dc:
+                verdict = self._confirm_dc_apply(idx, dc)
+            else:
+                res = self.wait_write(idx)
+                if not isinstance(res, dict) or not res.get("found"):
+                    res = self.agent.rpc("Status.ApplyResult", index=idx)
+                if not res.get("found"):
+                    raise RuntimeError(
+                        f"prepared query apply at index {idx} unconfirmed")
+                verdict = res["result"]
+            return out, verdict
+
+        if parts == ["query"] and method == "POST":
+            out, verdict = confirmed_apply(
+                op="create", query=_pq_from_api(json.loads(body)))
+            if verdict is False:
+                return 400, {"error": "prepared query name already in "
+                             "use"}, {}
+            return 200, {"ID": out["id"]}, {}
+        if parts == ["query"] and method == "GET":
+            out = rpc("PreparedQuery.List", min_index=min_index,
+                      wait_s=wait_s)
+            return 200, [_pq_to_api(x) for x in out["value"]], {
+                "X-Consul-Index": str(out["index"])}
+        if len(parts) < 2:
+            return 404, {"error": "missing query id"}, {}
+        qid = parts[1]
+        if len(parts) == 3 and parts[2] == "execute":
+            near = q.get("near", "")
+            if near == "_agent":
+                # The magic self-locating value (Execute:392) — only
+                # this tier knows which agent asked.
+                near = self.agent.node
+            try:
+                out = rpc("PreparedQuery.Execute", query_id_or_name=qid,
+                          limit=int(q.get("limit", 0)), near=near)
+            except KeyError:
+                return 404, {"error": f"prepared query {qid!r} not "
+                             "found"}, {}
+            return 200, {
+                "Service": out["service"], "Nodes": out["nodes"],
+                "Datacenter": out["datacenter"],
+                "Failovers": out["failovers"],
+                "DNS": {"TTL": out["dns"].get("ttl", "")},
+            }, {"X-Consul-Index": str(out["index"])}
+        if len(parts) == 3 and parts[2] == "explain":
+            try:
+                out = rpc("PreparedQuery.Explain", query_id_or_name=qid)
+            except KeyError:
+                return 404, {"error": f"prepared query {qid!r} not "
+                             "found"}, {}
+            return 200, {"Query": _pq_to_api(out["query"])}, {}
+        if method == "GET":
+            out = rpc("PreparedQuery.Get", query_id=qid,
+                      min_index=min_index, wait_s=wait_s)
+            if not out["value"]:
+                return 404, {"error": f"prepared query {qid!r} not "
+                             "found"}, {"X-Consul-Index": str(out["index"])}
+            return 200, [_pq_to_api(x) for x in out["value"]], {
+                "X-Consul-Index": str(out["index"])}
+        if method == "PUT":
+            query = _pq_from_api(json.loads(body))
+            query["id"] = qid
+            try:
+                _, verdict = confirmed_apply(op="update", query=query)
+            except KeyError as e:
+                # Only an unknown QUERY is a 404; an unknown session
+                # (or other validation KeyError) is the caller's bad
+                # request and must say so (the endpoint raises both).
+                if "session" in str(e):
+                    return 400, {"error": str(e)}, {}
+                return 404, {"error": f"prepared query {qid!r} not "
+                             "found"}, {}
+            if verdict is False:
+                return 400, {"error": "prepared query name already in "
+                             "use"}, {}
+            return 200, True, {}
+        if method == "DELETE":
+            try:
+                confirmed_apply(op="delete", query_id=qid)
+            except KeyError:
+                return 404, {"error": f"prepared query {qid!r} not "
+                             "found"}, {}
+            return 200, True, {}
+        return 405, {"error": "method not allowed"}, {}
 
     def _local_service_health(self, service_ids: list[str]) -> str:
         """Worst status over the named local services' checks plus the
@@ -271,6 +368,12 @@ class HTTPApi:
             out = rpc("Health.ChecksInState", state=parts[2],
                       min_index=min_index, wait_s=wait_s)
             return 200, out["value"], {"X-Consul-Index": str(out["index"])}
+
+        # ---- prepared queries (reference agent/prepared_query_
+        # endpoint.go; routes http_register.go /v1/query) ----------------
+        if parts[0] == "query":
+            return self._query(method, parts, q, body, min_index, wait_s,
+                               rpc, dc)
 
         # ---- kv -------------------------------------------------------
         if parts[0] == "kv":
@@ -592,8 +695,8 @@ class HTTPApi:
                 self.agent.checks.add_http(cid, req["HTTP"], interval,
                                            service_id=sid, now=now)
             elif req.get("TCP"):
-                host, _, port = req["TCP"].rpartition(":")
-                self.agent.checks.add_tcp(cid, host, int(port), interval,
+                host, port = _parse_tcp_target(req["TCP"])
+                self.agent.checks.add_tcp(cid, host, port, interval,
                                           service_id=sid, now=now)
             elif req.get("AliasNode"):
                 self.agent.checks.add_alias(
@@ -875,10 +978,84 @@ def _lower_keys(d: Optional[dict]) -> Optional[dict]:
             for k, v in d.items()}
 
 
+def _parse_tcp_target(addr: str) -> tuple[str, int]:
+    """``host:port`` with bracketed-IPv6 support (``[::1]:8080`` →
+    ``::1``); a missing or non-numeric port is a named 400, not a
+    check that can never pass."""
+    host, _, port = addr.rpartition(":")
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]
+    if not host or not port.isdigit():
+        raise ValueError(
+            f"TCP check target {addr!r} must be host:port "
+            "(IPv6 as [addr]:port)")
+    return host, int(port)
+
+
+def _pq_from_api(d: dict) -> dict:
+    """PreparedQueryDefinition (reference api/prepared_query.go) →
+    the endpoint's snake_case definition. Unknown fields fall through
+    to normalize()'s validation."""
+    out: dict = {}
+    for api_k, k in (("ID", "id"), ("Name", "name"),
+                     ("Session", "session"), ("Token", "token")):
+        if api_k in d:
+            out[k] = d[api_k]
+    t = d.get("Template") or {}
+    if t:
+        out["template"] = {"type": t.get("Type", ""),
+                           "regexp": t.get("Regexp", ""),
+                           "remove_empty_tags":
+                               bool(t.get("RemoveEmptyTags", False))}
+    s = d.get("Service") or {}
+    fo = s.get("Failover") or {}
+    out["service"] = {
+        "service": s.get("Service", ""),
+        "failover": {"nearest_n": int(fo.get("NearestN", 0)),
+                     "datacenters": fo.get("Datacenters") or []},
+        "only_passing": bool(s.get("OnlyPassing", False)),
+        "ignore_check_ids": s.get("IgnoreCheckIDs") or [],
+        "near": s.get("Near", ""),
+        "tags": s.get("Tags") or [],
+        "node_meta": s.get("NodeMeta") or {},
+        "service_meta": s.get("ServiceMeta") or {},
+    }
+    dns = d.get("DNS") or {}
+    if dns:
+        out["dns"] = {"ttl": dns.get("TTL", "")}
+    return out
+
+
+def _pq_to_api(q: dict) -> dict:
+    svc = q.get("service", {})
+    fo = svc.get("failover", {})
+    t = q.get("template", {})
+    return {
+        "ID": q.get("id", ""), "Name": q.get("name", ""),
+        "Session": q.get("session", ""), "Token": q.get("token", ""),
+        "Template": {"Type": t.get("type", ""),
+                     "Regexp": t.get("regexp", ""),
+                     "RemoveEmptyTags": t.get("remove_empty_tags", False)},
+        "Service": {
+            "Service": svc.get("service", ""),
+            "Failover": {"NearestN": fo.get("nearest_n", 0),
+                         "Datacenters": fo.get("datacenters", [])},
+            "OnlyPassing": svc.get("only_passing", False),
+            "IgnoreCheckIDs": svc.get("ignore_check_ids", []),
+            "Near": svc.get("near", ""),
+            "Tags": svc.get("tags", []),
+            "NodeMeta": svc.get("node_meta", {}),
+            "ServiceMeta": svc.get("service_meta", {}),
+        },
+        "DNS": {"TTL": q.get("dns", {}).get("ttl", "")},
+    }
+
+
 def _severity(status: str) -> int:
-    """Check-status severity ordering (reference structs' check status
-    precedence: any unrecognized status ranks as critical)."""
-    return {"passing": 0, "warning": 1}.get(status, 2)
+    """Check-status severity ordering — the shared helper (one
+    definition serves the agent rollups, UI services, prepared-query
+    filtering, and alias checks)."""
+    return _health.severity(status)
 
 
 def _check_from_api(d: Optional[dict]) -> Optional[dict]:

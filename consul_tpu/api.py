@@ -52,6 +52,7 @@ class Client:
         self.operator = Operator(self)
         self.config = ConfigEntries(self)
         self.internal = Internal(self)
+        self.query = PreparedQuery(self)
 
     def _call(self, method: str, path: str, params: Optional[dict] = None,
               body: Optional[bytes] = None) -> tuple[Any, QueryMeta, int]:
@@ -492,6 +493,52 @@ class Operator:
         """Per-server autopilot health (reference api/operator_autopilot.go
         AutopilotServerHealth → /v1/operator/autopilot/health)."""
         out, _, _ = self.c._call("GET", "/v1/operator/autopilot/health")
+        return out
+
+
+class PreparedQuery:
+    """Prepared-query CRUD + execute (reference api/prepared_query.go
+    PreparedQuery.Create/Update/List/Get/Delete/Execute over
+    /v1/query)."""
+
+    def __init__(self, c: Client):
+        self.c = c
+
+    def create(self, definition: dict) -> str:
+        out, _, _ = self.c._call("POST", "/v1/query", None,
+                                 json.dumps(definition).encode())
+        return out["ID"]
+
+    def update(self, query_id: str, definition: dict) -> bool:
+        out, _, _ = self.c._call("PUT", f"/v1/query/{query_id}", None,
+                                 json.dumps(definition).encode())
+        return bool(out)
+
+    def get(self, query_id: str):
+        out, meta, _ = self.c._call("GET", f"/v1/query/{query_id}")
+        return out, meta
+
+    def list(self):
+        out, meta, _ = self.c._call("GET", "/v1/query")
+        return out, meta
+
+    def delete(self, query_id: str) -> bool:
+        out, _, _ = self.c._call("DELETE", f"/v1/query/{query_id}")
+        return bool(out)
+
+    def execute(self, id_or_name: str, near: str = "",
+                limit: int = 0) -> dict:
+        params: dict = {}
+        if near:
+            params["near"] = near
+        if limit:
+            params["limit"] = limit
+        out, _, _ = self.c._call("GET", f"/v1/query/{id_or_name}/execute",
+                                 params or None)
+        return out
+
+    def explain(self, name: str) -> dict:
+        out, _, _ = self.c._call("GET", f"/v1/query/{name}/explain")
         return out
 
 

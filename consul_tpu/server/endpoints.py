@@ -23,6 +23,7 @@ the natural TPU shape is the same batch (SURVEY.md §2.5).
 from __future__ import annotations
 
 import math
+import random
 import uuid
 from typing import Any, Optional
 
@@ -449,6 +450,151 @@ class Server:
     # :1-89 RaftGetConfiguration/RaftRemovePeerByAddress,
     # operator_autopilot_endpoint.go:1-76 get/set autopilot config)
     # ------------------------------------------------------------------
+    # ------------------------------------------------------------------
+    # PreparedQuery endpoint (reference agent/consul/
+    # prepared_query_endpoint.go: Apply/Get/List/Explain/Execute/
+    # ExecuteRemote over the raft-replicated prepared_queries table)
+    # ------------------------------------------------------------------
+    def _preparedquery_apply(self, op: str, query: Optional[dict] = None,
+                             query_id: Optional[str] = None) -> Any:
+        from consul_tpu.server import prepared_query as pq_mod
+
+        if op == "delete":
+            if self.store.pq_get(query_id) is None:
+                raise KeyError(f"unknown prepared query {query_id!r}")
+            return self._raft_apply({"type": fsm_mod.PREPARED_QUERY,
+                                     "op": "delete", "id": query_id})
+        q = pq_mod.normalize(query or {})
+        if op == "create":
+            q["id"] = str(uuid.uuid4())
+        else:
+            if not q["id"] or self.store.pq_get(q["id"]) is None:
+                raise KeyError(f"unknown prepared query {q['id']!r}")
+        if q["session"] and self.store.session_get(q["session"]) is None:
+            # Validated before proposing, like the reference endpoint
+            # (prepared_query_endpoint.go:67-75 session verification);
+            # the query dies with the session afterwards.
+            raise KeyError(f"unknown session {q['session']!r}")
+        idx = self._raft_apply({"type": fsm_mod.PREPARED_QUERY,
+                                "op": op, "query": q})
+        return {"id": q["id"], "index": idx}
+
+    def _preparedquery_get(self, query_id: str, min_index: int = 0,
+                           wait_s: float = 10.0) -> dict:
+        def fn():
+            q = self.store.pq_get(query_id)
+            return [] if q is None else [q]
+        return self._blocking(("prepared_queries",), min_index, wait_s, fn)
+
+    def _preparedquery_list(self, min_index: int = 0,
+                            wait_s: float = 10.0) -> dict:
+        return self._blocking(("prepared_queries",), min_index, wait_s,
+                              self.store.pq_list)
+
+    def _preparedquery_explain(self, query_id_or_name: str) -> dict:
+        """The fully-rendered query an execute would run (reference
+        Explain — the template-debugging verb)."""
+        from consul_tpu.server import prepared_query as pq_mod
+        q = pq_mod.resolve(self.store.pq_list(), query_id_or_name)
+        if q is None:
+            raise KeyError(f"prepared query {query_id_or_name!r} not found")
+        return {"query": q, "index": self.store.index}
+
+    def _pq_run_local(self, q: dict) -> dict:
+        """Local-DC execution without sort/failover (reference
+        prepared_query_endpoint.go:511-558 execute): health rows for
+        the service, then the query's health/tag/meta filters."""
+        from consul_tpu.server import prepared_query as pq_mod
+        svc = q["service"]["service"]
+        rows = []
+        for s in self.store.service_nodes(svc):
+            nd = self.store.get_node(s["node"]) or {}
+            rows.append({"node": s["node"], "service": s,
+                         "checks": self.store.checks(node=s["node"]),
+                         "node_meta": nd.get("meta", {})})
+        return {"service": svc, "nodes": pq_mod.filter_nodes(q, rows),
+                "datacenter": self.dc, "failovers": 0,
+                "dns": q.get("dns", {}), "index": self.store.index}
+
+    def _preparedquery_execute(self, query_id_or_name: str, limit: int = 0,
+                               near: str = "") -> dict:
+        """Resolve → run → shuffle → RTT sort → limit → DC failover
+        (reference Execute, prepared_query_endpoint.go:331-458).
+        ``near`` here is already a node name — the ``_agent`` magic
+        value is the HTTP tier's to resolve, since only it knows the
+        requesting agent."""
+        from consul_tpu.server import prepared_query as pq_mod
+        q = pq_mod.resolve(self.store.pq_list(), query_id_or_name)
+        if q is None:
+            raise KeyError(f"prepared query {query_id_or_name!r} not found")
+        reply = self._pq_run_local(q)
+        nodes = reply["nodes"]
+        # Shuffle for load spread (Execute's Nodes.Shuffle) —
+        # deterministically seeded so replicas and tests agree.
+        random.Random(f"{q['id']}|{self.store.index}").shuffle(nodes)
+        near_node = near or q["service"].get("near", "")
+        if near_node:
+            sets = rtt.coord_sets_from_store(self.store.coordinates())
+            nodes = rtt.sort_nodes_by_distance(sets, near_node, nodes)
+            # The queried-from node itself belongs at position 0 when
+            # present near the front (Execute:430-441, depth-capped).
+            for i, row in enumerate(nodes[:10]):
+                if row["node"] == near_node:
+                    nodes[0], nodes[i] = nodes[i], nodes[0]
+                    break
+        if limit and len(nodes) > limit:
+            nodes = nodes[:limit]
+        reply["nodes"] = nodes
+        if not nodes:
+            self._pq_failover(q, limit, reply)
+        return reply
+
+    def _preparedquery_execute_remote(self, query: dict,
+                                      limit: int = 0) -> dict:
+        """Run an already-resolved query shipped from another DC
+        (reference ExecuteRemote:466-509 — the full definition rides
+        the request since this DC's store doesn't hold it; no onward
+        failover, fan-out stays one level)."""
+        reply = self._pq_run_local(query)
+        random.Random(
+            f"{query.get('id', '')}|{self.store.index}"
+        ).shuffle(reply["nodes"])
+        if limit and len(reply["nodes"]) > limit:
+            reply["nodes"] = reply["nodes"][:limit]
+        return reply
+
+    def _pq_failover(self, q: dict, limit: int, reply: dict) -> None:
+        """Try other DCs when the local result is empty (reference
+        queryFailover:677-770): the nearest N by WAN RTT, then any
+        explicitly listed DCs we know about, in order, stopping at the
+        first DC that answers with nodes."""
+        fo = q["service"]["failover"]
+        nearest_n = fo.get("nearest_n", 0)
+        explicit = fo.get("datacenters", [])
+        if nearest_n <= 0 and not explicit:
+            return
+        known = [d for d in self._catalog_list_datacenters()
+                 if d != self.dc]
+        dcs = list(known[:nearest_n])
+        for d in explicit:
+            # Unknown DCs are skipped, not errors (queryFailover:713).
+            if d in known and d not in dcs:
+                dcs.append(d)
+        failovers = 0
+        for dc in dcs:
+            failovers += 1
+            try:
+                remote = self._forward_dc(
+                    "PreparedQuery.ExecuteRemote", dc,
+                    {"query": q, "limit": limit})
+            except Exception:  # noqa: BLE001 — dead DC: try the next
+                continue
+            if remote["nodes"]:
+                reply["nodes"] = remote["nodes"]
+                reply["datacenter"] = remote["datacenter"]
+                break
+        reply["failovers"] = failovers
+
     def _operator_raft_get_configuration(self) -> dict:
         """The raft membership as this server's raft layer sees it:
         id/address/leader/voter per server (reference

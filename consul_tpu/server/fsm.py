@@ -22,6 +22,7 @@ SESSION = "session"
 COORDINATE_BATCH_UPDATE = "coordinate-batch-update"
 CONFIG_ENTRY = "config-entry"
 AUTOPILOT = "autopilot"
+PREPARED_QUERY = "prepared-query"
 TXN = "txn"
 
 # Tables each op type can write (for scoped TXN undo logs). KV ops can
@@ -29,12 +30,13 @@ TXN = "txn"
 # node deletes cascade widely; keep cascading types conservative.
 _TXN_TABLES: dict[str, set] = {
     KV: {"kv"},
-    SESSION: {"sessions", "kv"},
+    SESSION: {"sessions", "kv", "prepared_queries"},
     COORDINATE_BATCH_UPDATE: {"coordinates"},
     CONFIG_ENTRY: {"config_entries"},
+    PREPARED_QUERY: {"prepared_queries"},
     REGISTER: {"nodes", "services", "checks"},
     DEREGISTER: {"nodes", "services", "checks", "coordinates",
-                 "sessions", "kv"},
+                 "sessions", "kv", "prepared_queries"},
 }
 
 
@@ -124,6 +126,19 @@ class FSM:
                 command["kind"], command["name"], command["entry"],
                 cas_index=cas, index=index)
             return ok
+        if mtype == PREPARED_QUERY:
+            # Reference fsm applyPreparedQueryOperation (fsm/commands_
+            # oss.go): create/update upsert by id, delete removes.
+            # Name-collision on a replicated create is an apply-time
+            # verdict (False), like CAS — never a replica divergence.
+            if command["op"] == "delete":
+                self.store.pq_delete(command["id"], index=index)
+                return True
+            try:
+                self.store.pq_set(command["query"], index=index)
+            except ValueError:
+                return False
+            return command["query"]["id"]
         if mtype == AUTOPILOT:
             # Operator autopilot configuration (reference
             # fsm applyAutopilotUpdate, operator_autopilot_endpoint.go):

@@ -66,6 +66,7 @@ class StateStore:
         "coordinates",    # node[:segment] -> coordinate dict
         "config_entries",  # kind/name -> entry
         "autopilot",      # "config" -> operator autopilot configuration
+        "prepared_queries",  # query id -> definition
     )
 
     def __init__(self):
@@ -416,7 +417,49 @@ class StateStore:
                     else:
                         self._commit("kv", k, kv.value | {"session": None},
                                      index=idx)
+            self._invalidate_queries_for_session(session_id, idx)
             return idx
+
+    # ------------------------------------------------------------------
+    # Prepared queries (reference state/prepared_query.go)
+    # ------------------------------------------------------------------
+    def pq_set(self, query: dict, index: Optional[int] = None) -> int:
+        """Upsert one prepared query by id. Name uniqueness is enforced
+        here (reference state/prepared_query.go PreparedQuerySet: the
+        wrapped name index) so a replicated create can never land two
+        queries on one name."""
+        with self._lock:
+            name = query.get("name", "")
+            if name:
+                for qid, e in self.tables["prepared_queries"].rows.items():
+                    if qid != query["id"] and e.value.get("name") == name:
+                        raise ValueError(
+                            f"prepared query name {name!r} already in use")
+            return self._commit("prepared_queries", query["id"], query,
+                                index=index)
+
+    def pq_delete(self, query_id: str, index: Optional[int] = None) -> int:
+        return self._commit("prepared_queries", query_id, None, delete=True,
+                            index=index)
+
+    def pq_get(self, query_id: str) -> Optional[dict]:
+        with self._lock:
+            e = self.tables["prepared_queries"].rows.get(query_id)
+            return None if e is None else e.value
+
+    def pq_list(self) -> list[dict]:
+        with self._lock:
+            return [e.value for _, e in
+                    sorted(self.tables["prepared_queries"].rows.items())]
+
+    def _invalidate_queries_for_session(self, session_id: str, index: int):
+        """A query tied to a session dies with it (reference
+        state/prepared_query.go: the session invalidation path deletes
+        bound queries, mirroring KV lock release)."""
+        for qid, e in list(self.tables["prepared_queries"].rows.items()):
+            if e.value.get("session") == session_id:
+                self._commit("prepared_queries", qid, None, delete=True,
+                             index=index)
 
     def _invalidate_sessions_for_node(self, node: str, index: int):
         for sid, e in list(self.tables["sessions"].rows.items()):

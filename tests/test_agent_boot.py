@@ -91,6 +91,34 @@ class TestAgentBoot:
         proc.send_signal(signal.SIGTERM)
         assert proc.wait(timeout=15) == 0
 
+    def test_dns_interface_boots(self, tmp_path):
+        """A booted agent with ``dns`` configured answers real DNS
+        packets for its own node (reference ports.dns / agent/dns.go)."""
+        from consul_tpu.agent import dns as dns_mod
+        cfg = tmp_path / "d.json"
+        cfg.write_text(json.dumps({
+            "node_name": "dns-boot", "n_servers": 1,
+            "http": {"host": "127.0.0.1", "port": 0},
+            "dns": {"host": "127.0.0.1", "port": 0},
+        }))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "consul_tpu.cli", "agent",
+             "--config-file", str(cfg)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        try:
+            ready = json.loads(proc.stdout.readline())
+            assert ready["dns_port"] > 0
+            msg = dns_mod.lookup("127.0.0.1", ready["dns_port"],
+                                 "dns-boot.node.consul")
+            assert msg["rcode"] == dns_mod.NOERROR
+            assert msg["answers"][0]["value"] == "127.0.0.1"
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=15) == 0
+
     def test_leave_verb_shuts_down(self, tmp_path):
         """`consul-tpu leave` (reference command/leave): the agent
         answers 200, deregisters, and its process exits cleanly."""

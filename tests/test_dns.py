@@ -1,0 +1,203 @@
+"""DNS interface (reference agent/dns.go + dns_test.go): real UDP/TCP
+packets against the `.consul` domain — node and service lookups, RFC
+2782 SRV, tags, prepared queries, PTR, NXDOMAIN+SOA, truncation."""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu.agent import dns
+from consul_tpu.server.endpoints import ServerCluster
+
+
+class TestCodec:
+    def test_name_roundtrip(self):
+        data = dns.encode_name("web.service.consul")
+        name, off = dns.decode_name(data, 0)
+        assert name == "web.service.consul" and off == len(data)
+
+    def test_query_roundtrip(self):
+        pkt = dns.encode_query(77, "db.service.consul", dns.SRV)
+        msg = dns.decode_message(pkt)
+        assert msg["id"] == 77
+        assert msg["questions"] == [{"name": "db.service.consul",
+                                     "qtype": dns.SRV}]
+
+    def test_response_records_roundtrip(self):
+        pkt = dns.encode_response(5, "x.node.consul", dns.A, [
+            ("x.node.consul", dns.A, 60, "10.1.2.3"),
+            ("x.node.consul", dns.SRV, 30, (1, 1, 8080, "x.node.consul")),
+            ("3.2.1.10.in-addr.arpa", dns.PTR, 0, "x.node.consul"),
+        ])
+        msg = dns.decode_message(pkt)
+        vals = [(r["rtype"], r["value"]) for r in msg["answers"]]
+        assert (dns.A, "10.1.2.3") in vals
+        assert (dns.SRV, (1, 1, 8080, "x.node.consul")) in vals
+        assert (dns.PTR, "x.node.consul") in vals
+
+    def test_compressed_pointer_decode(self):
+        # Hand-build: name at offset 12, then a pointer to it.
+        base = dns.encode_name("a.consul")
+        data = b"\x00" * 12 + base + b"\xc0\x0c"
+        name, _ = dns.decode_name(data, 12 + len(base))
+        assert name == "a.consul"
+
+    def test_pointer_loop_rejected(self):
+        data = b"\x00" * 12 + b"\xc0\x0c"
+        with pytest.raises(ValueError, match="loop|pointer"):
+            dns.decode_name(data, 12)
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """Cluster + pumped raft + DNSServer on a real UDP/TCP port."""
+    cluster = ServerCluster(3, seed=5)
+    leader = cluster.wait_converged()
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    def pump():
+        while not stop.is_set():
+            with lock:
+                cluster.step()
+            time.sleep(0.002)
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    def rpc(method, **args):
+        with lock:
+            server = cluster.registry[cluster.raft.wait_converged().id]
+        return server.rpc(method, **args)
+
+    def write(method, **args):
+        out = rpc(method, **args)
+        idx = out["index"] if isinstance(out, dict) and "index" in out \
+            else out
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            with lock:
+                led = cluster.raft.leader()
+                if led is not None and led.last_applied >= idx:
+                    return out
+            time.sleep(0.002)
+        raise TimeoutError(f"apply {idx} not confirmed")
+
+    write("Catalog.Register", node="dns-n1", address="10.5.0.1",
+          service={"id": "web-1", "service": "web", "port": 8080,
+                   "tags": ["prod"]},
+          check={"check_id": "w1", "status": "passing",
+                 "service_id": "web-1"})
+    write("Catalog.Register", node="dns-n2", address="10.5.0.2",
+          service={"id": "web-2", "service": "web", "port": 8081},
+          check={"check_id": "w2", "status": "critical",
+                 "service_id": "web-2"})
+    write("PreparedQuery.Apply", op="create",
+          query={"name": "webq", "service": {"service": "web"}})
+    srv = dns.DNSServer(rpc, node_name="dns-n1", datacenter="dc1",
+                        service_ttl_s=30)
+    port = srv.serve("127.0.0.1", 0)
+    yield srv, port, write
+    srv.close()
+    stop.set()
+
+
+def q(port, name, qtype=dns.A, tcp=False):
+    return dns.lookup("127.0.0.1", port, name, qtype, tcp=tcp)
+
+
+class TestLookups:
+    def test_node_a_record(self, stack):
+        _, port, _ = stack
+        msg = q(port, "dns-n1.node.consul")
+        assert msg["rcode"] == dns.NOERROR
+        assert msg["answers"][0]["value"] == "10.5.0.1"
+        assert msg["answers"][0]["rtype"] == dns.A
+
+    def test_node_with_dc_label(self, stack):
+        _, port, _ = stack
+        msg = q(port, "dns-n1.node.dc1.consul")
+        assert msg["answers"][0]["value"] == "10.5.0.1"
+
+    def test_unknown_node_nxdomain_with_soa(self, stack):
+        _, port, _ = stack
+        msg = q(port, "ghost.node.consul")
+        assert msg["rcode"] == dns.NXDOMAIN
+        assert msg["authority"][0]["rtype"] == dns.SOA
+
+    def test_service_a_excludes_critical(self, stack):
+        _, port, _ = stack
+        msg = q(port, "web.service.consul")
+        assert msg["rcode"] == dns.NOERROR
+        # dns-n2 is critical: only the passing instance answers.
+        assert [a["value"] for a in msg["answers"]] == ["10.5.0.1"]
+
+    def test_service_srv_records(self, stack):
+        _, port, _ = stack
+        msg = q(port, "web.service.consul", dns.SRV)
+        assert msg["answers"][0]["rtype"] == dns.SRV
+        pri, weight, sport, target = msg["answers"][0]["value"]
+        assert sport == 8080 and target == "dns-n1.node.consul"
+
+    def test_rfc2782_srv_syntax(self, stack):
+        _, port, _ = stack
+        msg = q(port, "_web._tcp.service.consul", dns.SRV)
+        assert msg["answers"][0]["value"][2] == 8080
+        msg = q(port, "_web._prod.service.consul", dns.SRV)
+        assert msg["answers"][0]["value"][2] == 8080
+        msg = q(port, "_web._missingtag.service.consul", dns.SRV)
+        assert msg["rcode"] == dns.NXDOMAIN
+
+    def test_tag_service_lookup(self, stack):
+        _, port, _ = stack
+        msg = q(port, "prod.web.service.consul")
+        assert [a["value"] for a in msg["answers"]] == ["10.5.0.1"]
+        msg = q(port, "nope.web.service.consul")
+        assert msg["rcode"] == dns.NXDOMAIN
+
+    def test_prepared_query_lookup(self, stack):
+        _, port, _ = stack
+        msg = q(port, "webq.query.consul")
+        assert msg["rcode"] == dns.NOERROR
+        assert [a["value"] for a in msg["answers"]] == ["10.5.0.1"]
+        assert msg["answers"][0]["ttl"] == 30
+        msg = q(port, "webq.query.consul", dns.SRV)
+        assert msg["answers"][0]["value"][3] == "dns-n1.node.consul"
+
+    def test_ptr_lookup(self, stack):
+        _, port, _ = stack
+        msg = q(port, "1.0.5.10.in-addr.arpa", dns.PTR)
+        assert msg["answers"][0]["value"] == "dns-n1.node.consul"
+        msg = q(port, "9.9.9.9.in-addr.arpa", dns.PTR)
+        assert msg["rcode"] == dns.NXDOMAIN
+
+    def test_other_domain_refused(self, stack):
+        _, port, _ = stack
+        msg = q(port, "example.com")
+        assert msg["rcode"] == dns.REFUSED
+
+    def test_tcp_transport(self, stack):
+        _, port, _ = stack
+        msg = q(port, "web.service.consul", tcp=True)
+        assert [a["value"] for a in msg["answers"]] == ["10.5.0.1"]
+
+
+class TestTruncation:
+    def test_udp_truncates_tcp_does_not(self, stack):
+        srv, port, write = stack
+        for i in range(6):
+            write("Catalog.Register", node=f"many-{i}",
+                  address=f"10.6.0.{i}",
+                  service={"id": f"m-{i}", "service": "many", "port": 80},
+                  check={"check_id": f"mc-{i}", "status": "passing",
+                         "service_id": f"m-{i}"})
+        msg = q(port, "many.service.consul")
+        assert msg["tc"] is True
+        assert len(msg["answers"]) == srv.udp_answer_limit
+        msg = q(port, "many.service.consul", tcp=True)
+        assert msg["tc"] is False and len(msg["answers"]) == 6
+
+    def test_addr_echo(self, stack):
+        _, port, _ = stack
+        msg = q(port, "0a050001.addr.consul")
+        assert msg["answers"][0]["value"] == "10.5.0.1"

@@ -1,0 +1,308 @@
+"""Prepared queries (reference agent/consul/prepared_query_endpoint.go,
+prepared_query/template.go, state/prepared_query.go): raft-replicated
+service lookups with health/tag/meta filters, RTT ``near`` sorting,
+``name_prefix_match`` templates, session-bound lifetime, and cross-DC
+failover."""
+
+import pytest
+
+from consul_tpu.server import prepared_query as pq
+from consul_tpu.server.endpoints import ServerCluster, federate
+
+
+def defn(service="web", **over):
+    d = {"name": over.pop("name", ""), "service": {"service": service}}
+    d["service"].update(over.pop("service_over", {}))
+    d.update(over)
+    return d
+
+
+class TestLogic:
+    def test_normalize_defaults_and_validation(self):
+        q = pq.normalize({"service": {"service": "web"}})
+        assert q["service"]["failover"] == {"nearest_n": 0,
+                                            "datacenters": []}
+        assert q["template"]["type"] == ""
+        with pytest.raises(ValueError, match="Service"):
+            pq.normalize({"name": "x"})
+        with pytest.raises(ValueError, match="template type"):
+            pq.normalize({"service": {"service": "w"},
+                          "template": {"type": "bogus"}})
+        with pytest.raises(ValueError, match="regexp"):
+            pq.normalize({"service": {"service": "w"},
+                          "template": {"type": "name_prefix_match",
+                                       "regexp": "("}})
+        with pytest.raises(ValueError, match="unknown"):
+            pq.normalize({"service": {"service": "w"}, "bogus": 1})
+
+    def test_template_render_name_and_regexp(self):
+        # reference prepared_query/template_test.go: ${name.*} and
+        # ${match(N)} interpolation.
+        q = pq.normalize({
+            "name": "geo-db-",
+            "template": {"type": "name_prefix_match",
+                         "regexp": r"^geo-db-(.*?)-([^\-]+?)$"},
+            "service": {"service": "mysql-${match(2)}",
+                        "tags": ["${match(1)}", "${name.suffix}"]},
+        })
+        r = pq.render(q, "geo-db-customer-master")
+        assert r["service"]["service"] == "mysql-master"
+        assert r["service"]["tags"] == ["customer", "customer-master"]
+
+    def test_template_remove_empty_tags(self):
+        q = pq.normalize({
+            "name": "pre-",
+            "template": {"type": "name_prefix_match",
+                         "regexp": r"^pre-(.*)$",
+                         "remove_empty_tags": True},
+            "service": {"service": "svc", "tags": ["${match(1)}", "fixed"]},
+        })
+        assert pq.render(q, "pre-")["service"]["tags"] == ["fixed"]
+
+    def _rows(self):
+        def row(node, status, tags=(), checks_extra=(), smeta=None,
+                nmeta=None):
+            return {"node": node,
+                    "service": {"id": node + "-s", "service": "web",
+                                "tags": list(tags), "meta": smeta or {}},
+                    "checks": [{"check_id": "c", "status": status},
+                               *checks_extra],
+                    "node_meta": nmeta or {}}
+        return row
+
+    def test_filter_health_and_ignore(self):
+        row = self._rows()
+        q = pq.normalize(defn())
+        rows = [row("a", "passing"), row("b", "warning"),
+                row("c", "critical")]
+        assert [r["node"] for r in pq.filter_nodes(q, rows)] == ["a", "b"]
+        q2 = pq.normalize(defn(service_over={"only_passing": True}))
+        assert [r["node"] for r in pq.filter_nodes(q2, rows)] == ["a"]
+        # IgnoreCheckIDs rescues a node failed only by the ignored check.
+        q3 = pq.normalize(defn(service_over={
+            "only_passing": True, "ignore_check_ids": ["flaky"]}))
+        rows2 = [row("a", "passing",
+                     checks_extra=[{"check_id": "flaky",
+                                    "status": "critical"}])]
+        assert [r["node"] for r in pq.filter_nodes(q3, rows2)] == ["a"]
+
+    def test_filter_tags_and_meta(self):
+        row = self._rows()
+        q = pq.normalize(defn(service_over={"tags": ["Primary", "!legacy"]}))
+        rows = [row("a", "passing", tags=["primary"]),
+                row("b", "passing", tags=["primary", "legacy"]),
+                row("c", "passing")]
+        assert [r["node"] for r in pq.filter_nodes(q, rows)] == ["a"]
+        qm = pq.normalize(defn(service_over={"service_meta": {"v": "2"}}))
+        rows = [row("a", "passing", smeta={"v": "2"}),
+                row("b", "passing", smeta={"v": "1"})]
+        assert [r["node"] for r in pq.filter_nodes(qm, rows)] == ["a"]
+        qn = pq.normalize(defn(service_over={"node_meta": {"rack": "r1"}}))
+        rows = [row("a", "passing", nmeta={"rack": "r1"}),
+                row("b", "passing", nmeta={"rack": "r9"})]
+        assert [r["node"] for r in pq.filter_nodes(qn, rows)] == ["a"]
+
+    def test_resolve_precedence(self):
+        plain = dict(pq.normalize(defn(name="exact")), id="id-1")
+        tmpl = dict(pq.normalize({
+            "name": "exa", "template": {"type": "name_prefix_match"},
+            "service": {"service": "via-template"}}), id="id-2")
+        catch_all = dict(pq.normalize({
+            "name": "", "template": {"type": "name_prefix_match"},
+            "service": {"service": "fallback"}}), id="id-3")
+        qs = [plain, tmpl, catch_all]
+        assert pq.resolve(qs, "id-1")["name"] == "exact"
+        assert pq.resolve(qs, "exact")["name"] == "exact"
+        # Longest-prefix template wins; rendered copy comes back.
+        assert pq.resolve(qs, "exands")["service"]["service"] == \
+            "via-template"
+        assert pq.resolve(qs, "other")["service"]["service"] == "fallback"
+        with pytest.raises(ValueError, match="by name"):
+            pq.resolve(qs, "id-2")
+        with pytest.raises(ValueError, match="missing"):
+            pq.resolve(qs, "")
+
+
+@pytest.fixture
+def cluster():
+    c = ServerCluster(3, seed=7)
+    c.wait_converged()
+    return c
+
+
+def _register_web(c, nodes=("n1", "n2", "n3"), status="passing"):
+    leader = c.leader_server()
+    for i, n in enumerate(nodes):
+        c.write(leader, "Catalog.Register", node=n, address=f"10.0.0.{i}",
+                service={"id": f"web-{n}", "service": "web", "port": 80,
+                         "tags": ["prod"]},
+                check={"check_id": f"ck-{n}", "status": status,
+                       "service_id": f"web-{n}"})
+    return leader
+
+
+class TestEndpoint:
+    def test_crud_and_execute(self, cluster):
+        leader = _register_web(cluster)
+        out = cluster.write(leader, "PreparedQuery.Apply", op="create",
+                            query=defn(name="web-q"))
+        qid = out["id"]
+        got = leader.rpc("PreparedQuery.Get", query_id=qid)
+        assert got["value"][0]["name"] == "web-q"
+        res = leader.rpc("PreparedQuery.Execute", query_id_or_name="web-q")
+        assert res["service"] == "web" and len(res["nodes"]) == 3
+        assert res["datacenter"] == "dc1" and res["failovers"] == 0
+        # By id too; update narrows it with a tag filter.
+        res = leader.rpc("PreparedQuery.Execute", query_id_or_name=qid)
+        assert len(res["nodes"]) == 3
+        upd = dict(defn(name="web-q",
+                        service_over={"tags": ["!prod"]}), id=qid)
+        cluster.write(leader, "PreparedQuery.Apply", op="update", query=upd)
+        res = leader.rpc("PreparedQuery.Execute", query_id_or_name="web-q")
+        assert res["nodes"] == []
+        cluster.write(leader, "PreparedQuery.Apply", op="delete",
+                      query_id=qid)
+        assert leader.rpc("PreparedQuery.Get", query_id=qid)["value"] == []
+        with pytest.raises(KeyError):
+            leader.rpc("PreparedQuery.Execute", query_id_or_name="web-q")
+
+    def test_replicated_to_followers(self, cluster):
+        leader = _register_web(cluster)
+        cluster.write(leader, "PreparedQuery.Apply", op="create",
+                      query=defn(name="rep-q"))
+        for s in cluster.servers:
+            qs = s.store.pq_list()
+            assert any(x["name"] == "rep-q" for x in qs)
+
+    def test_name_collision_is_apply_verdict(self, cluster):
+        leader = _register_web(cluster)
+        cluster.write(leader, "PreparedQuery.Apply", op="create",
+                      query=defn(name="dup"))
+        out = cluster.write(leader, "PreparedQuery.Apply", op="create",
+                            query=defn(name="dup"))
+        # The SECOND create commits but its FSM verdict is False on
+        # every replica (deterministic apply-time collision check).
+        idx = out["index"]
+        res = leader.rpc("Status.ApplyResult", index=idx)
+        assert res["found"] and res["result"] is False
+        assert sum(1 for x in leader.store.pq_list()
+                   if x["name"] == "dup") == 1
+
+    def test_session_bound_query_dies_with_session(self, cluster):
+        leader = _register_web(cluster)
+        sess = cluster.write(leader, "Session.Apply", op="create",
+                             node="n1")
+        sid = sess["id"]
+        cluster.write(leader, "PreparedQuery.Apply", op="create",
+                      query=dict(defn(name="ephemeral"), session=sid))
+        assert any(x["name"] == "ephemeral"
+                   for x in leader.store.pq_list())
+        cluster.write(leader, "Session.Apply", op="destroy",
+                      session_id=sid)
+        assert not any(x["name"] == "ephemeral"
+                       for x in leader.store.pq_list())
+        # Creating against an unknown session is rejected up front.
+        with pytest.raises(KeyError, match="session"):
+            leader.rpc("PreparedQuery.Apply", op="create",
+                       query=dict(defn(name="x2"), session="nope"))
+
+    def test_only_passing_filter(self, cluster):
+        leader = _register_web(cluster, nodes=("ok1", "ok2"))
+        cluster.write(leader, "Catalog.Register", node="sick",
+                      address="10.0.0.9",
+                      service={"id": "web-sick", "service": "web",
+                               "port": 80},
+                      check={"check_id": "ck-sick", "status": "warning",
+                             "service_id": "web-sick"})
+        cluster.write(leader, "PreparedQuery.Apply", op="create",
+                      query=defn(name="healthy",
+                                 service_over={"only_passing": True}))
+        res = leader.rpc("PreparedQuery.Execute",
+                         query_id_or_name="healthy")
+        assert sorted(r["node"] for r in res["nodes"]) == ["ok1", "ok2"]
+
+    def test_near_sort_pins_node_first(self, cluster):
+        leader = _register_web(cluster)
+        # Plant coordinates: n3 nearest to itself, obviously.
+        for i, n in enumerate(("n1", "n2", "n3")):
+            leader.rpc("Coordinate.Update", node=n,
+                       coord={"vec": [0.001 * (i + 1)] * 8,
+                              "error": 0.1, "height": 1e-4})
+        leader.flush_coordinates()
+        for _ in range(50):
+            cluster.step()
+        cluster.write(leader, "PreparedQuery.Apply", op="create",
+                      query=defn(name="near-q",
+                                 service_over={"near": "n3"}))
+        res = leader.rpc("PreparedQuery.Execute",
+                         query_id_or_name="near-q")
+        assert res["nodes"][0]["node"] == "n3"
+
+    def test_template_execute_by_rendered_name(self, cluster):
+        leader = _register_web(cluster)
+        cluster.write(leader, "PreparedQuery.Apply", op="create", query={
+            "name": "find-",
+            "template": {"type": "name_prefix_match",
+                         "regexp": r"^find-(.+)$"},
+            "service": {"service": "${match(1)}"},
+        })
+        res = leader.rpc("PreparedQuery.Execute",
+                         query_id_or_name="find-web")
+        assert res["service"] == "web" and len(res["nodes"]) == 3
+        exp = leader.rpc("PreparedQuery.Explain",
+                         query_id_or_name="find-web")
+        assert exp["query"]["service"]["service"] == "web"
+
+    def test_limit_applies(self, cluster):
+        leader = _register_web(cluster)
+        cluster.write(leader, "PreparedQuery.Apply", op="create",
+                      query=defn(name="lim"))
+        res = leader.rpc("PreparedQuery.Execute", query_id_or_name="lim",
+                         limit=2)
+        assert len(res["nodes"]) == 2
+
+
+class TestFailover:
+    def test_failover_to_remote_dc(self):
+        c1 = ServerCluster(n=3, dc="dc1")
+        c2 = ServerCluster(n=3, dc="dc2", seed=1)
+        federate(c1, c2)
+        c1.wait_converged()
+        c2.wait_converged()
+        # Service exists only in dc2.
+        _register_web(c2, nodes=("r1", "r2"))
+        leader1 = c1.leader_server()
+        c1.write(leader1, "PreparedQuery.Apply", op="create",
+                 query=defn(name="fo",
+                            service_over={"failover": {"nearest_n": 1,
+                                                       "datacenters": []}}))
+        res = leader1.rpc("PreparedQuery.Execute", query_id_or_name="fo")
+        assert res["datacenter"] == "dc2"
+        assert res["failovers"] == 1
+        assert sorted(r["node"] for r in res["nodes"]) == ["r1", "r2"]
+
+    def test_failover_explicit_list_skips_unknown(self):
+        c1 = ServerCluster(n=3, dc="dc1")
+        c2 = ServerCluster(n=3, dc="dc2", seed=1)
+        federate(c1, c2)
+        c1.wait_converged()
+        c2.wait_converged()
+        _register_web(c2, nodes=("r1",))
+        leader1 = c1.leader_server()
+        c1.write(leader1, "PreparedQuery.Apply", op="create",
+                 query=defn(name="fo2", service_over={
+                     "failover": {"nearest_n": 0,
+                                  "datacenters": ["dc-ghost", "dc2"]}}))
+        res = leader1.rpc("PreparedQuery.Execute", query_id_or_name="fo2")
+        assert res["datacenter"] == "dc2"
+        # dc-ghost was skipped without counting as an attempt.
+        assert res["failovers"] == 1
+
+    def test_no_failover_when_not_configured(self):
+        c1 = ServerCluster(n=3, dc="dc1")
+        c1.wait_converged()
+        leader1 = c1.leader_server()
+        c1.write(leader1, "PreparedQuery.Apply", op="create",
+                 query=defn(name="solo"))
+        res = leader1.rpc("PreparedQuery.Execute", query_id_or_name="solo")
+        assert res["nodes"] == [] and res["failovers"] == 0
