@@ -79,6 +79,9 @@ _DEFAULTS = {
     # Tunables mirror dns_config: udp_answer_limit, only_passing,
     # node_ttl_s / service_ttl_s.
     "dns": None,
+    # ACLs (reference acl block): {"enabled": true, "default_policy":
+    # "allow"|"deny", "master_token": "..."}; null = ACLs off.
+    "acl": None,
     "sim": None,
 }
 
@@ -191,7 +194,8 @@ class AgentRuntime:
         self.agent.leave_hook = self._stop.set
         self.api = HTTPApi(self.agent, server=api_server,
                            wait_write=wait_write,
-                           datacenter=cfg["datacenter"])
+                           datacenter=cfg["datacenter"],
+                           acl=cfg.get("acl"))
         self.httpd = None
         self.http_port = None
 

@@ -415,12 +415,18 @@ class DNSServer:
             out = self.rpc("PreparedQuery.Execute", **args)
         except KeyError:
             return [], NXDOMAIN
-        ttl_s = out.get("dns", {}).get("ttl", "")
-        try:
-            ttl = int(float(ttl_s.rstrip("s"))) if ttl_s \
-                else self.service_ttl_s
-        except ValueError:
-            ttl = self.service_ttl_s
+        raw = out.get("dns", {}).get("ttl", "")
+        if isinstance(raw, (int, float)):
+            # Tolerate a numeric TTL (seconds) — clients DO send
+            # {"DNS": {"TTL": 10}}; crashing here would SERVFAIL every
+            # lookup of the query.
+            ttl = int(raw)
+        else:
+            try:
+                ttl = int(float(raw.rstrip("s"))) if raw \
+                    else self.service_ttl_s
+            except (ValueError, AttributeError):
+                ttl = self.service_ttl_s
         rows = out["nodes"]
         if not rows:
             return [], NXDOMAIN

@@ -48,8 +48,18 @@ class HTTPApi:
 
     def __init__(self, agent: Agent, server: Optional[Server] = None,
                  wait_write: Optional[Any] = None,
-                 datacenter: Optional[str] = None):
+                 datacenter: Optional[str] = None,
+                 acl: Optional[dict] = None):
         self.agent = agent
+        # ACL enforcement config (reference agent/acl.go: every
+        # endpoint resolves the request token and checks its family):
+        # {"enabled": bool, "default_policy": "allow"|"deny",
+        #  "master_token": str}. None/disabled = open (ACLs off).
+        acl = acl or {}
+        self.acl_enabled = bool(acl.get("enabled"))
+        self.acl_default_allow = acl.get("default_policy",
+                                         "allow") != "deny"
+        self.acl_master_token = acl.get("master_token", "")
         # This agent's own datacenter: ?dc= naming it resolves to the
         # plain local path (reference parseDC treats the local DC as
         # no-op), keeping the shared cache entries usable.
@@ -65,13 +75,18 @@ class HTTPApi:
 
     # ------------------------------------------------------------------
     def handle(self, method: str, path: str, query: dict[str, list[str]],
-               body: bytes) -> tuple[int, Any, dict[str, str]]:
+               body: bytes, headers: Optional[dict] = None,
+               ) -> tuple[int, Any, dict[str, str]]:
         """Returns (status, json-serializable body, extra headers)."""
         q = {k: v[-1] for k, v in query.items()}
         min_index = int(q.get("index", 0))
         wait_s = _dur_to_s(q["wait"]) if "wait" in q else 10.0
         near = q.get("near", "")
         try:
+            if self.acl_enabled:
+                denied = self._acl_gate(method, path, q, body, headers)
+                if denied is not None:
+                    return denied
             return self._route(method, path, q, query, body,
                                min_index, wait_s, near)
         except NotLeader as e:
@@ -126,6 +141,248 @@ class HTTPApi:
             _time.sleep(0.01)
         raise RuntimeError(
             f"apply result for raft index {index} in {dc} unavailable")
+
+    # -- ACL enforcement (reference agent/acl.go vetters: each endpoint
+    # family resolves the token and checks its resource) ----------------
+    def _authorizer(self, q, headers):
+        from consul_tpu.server import acl as acl_mod
+        # Case-insensitive header lookup: urllib canonicalizes
+        # X-Consul-Token to X-consul-token on the wire, and HTTP
+        # headers are case-insensitive by spec.
+        secret = next((v for k, v in (headers or {}).items()
+                       if k.lower() == "x-consul-token"), "") \
+            or q.get("token", "")
+        if self.acl_master_token and secret == self.acl_master_token:
+            # The agent-config master token (reference acl_master_token)
+            # is management without a store round-trip.
+            return acl_mod.management_authorizer()
+        res = self.agent.rpc("ACL.Resolve", secret_id=secret)
+        if res["management"]:
+            return acl_mod.management_authorizer()
+        return acl_mod.Authorizer(res["rules"],
+                                  default_allow=self.acl_default_allow)
+
+    def _acl_gate(self, method, path, q, body, headers):
+        """Family → (resource, name, access) mapping, the one
+        enforcement point (the reference checks inside each endpoint;
+        the divergence — 403 up front instead of row filtering on
+        catalog listings — is documented in COVERAGE.md). Returns a
+        403 response tuple or None to proceed."""
+        parts = [p for p in path.split("/") if p][1:]
+        if not parts:
+            return None
+        fam = parts[0]
+        write = method in ("PUT", "POST", "DELETE")
+        # Status + bootstrap stay open (reference: status endpoints are
+        # unauthenticated; bootstrap must work before tokens exist).
+        if fam == "status" or parts == ["acl", "bootstrap"]:
+            return None
+        try:
+            authz = self._authorizer(q, headers)
+        except Exception as e:  # noqa: BLE001 — resolution failure
+            return 500, {"error": f"ACL resolution failed: {e!r}"}, {}
+        node = self.agent.node
+        checks: list[tuple[str, str, str]] = []
+        if fam == "kv":
+            key = _kv_key(path, parts)
+            acc = "write" if write else "read"
+            if "recurse" in q or "keys" in q:
+                # Subtree operations authorize the whole prefix
+                # (KeyWritePrefix semantics) — an exact-key grant must
+                # not escalate to everything underneath it.
+                if not authz.allowed_prefix("key", key, acc):
+                    return 403, {"error": "Permission denied"}, {}
+                checks = []
+            else:
+                checks = [("key", key, acc)]
+        elif fam == "txn":
+            try:
+                for op in json.loads(body or b"[]"):
+                    kv = op.get("KV", {})
+                    acc = "read" if kv.get("Verb") == "get" else "write"
+                    checks.append(("key", kv.get("Key", ""), acc))
+            except (ValueError, AttributeError):
+                checks = [("key", "", "write")]
+        elif fam == "catalog":
+            if parts[1:2] == ["register"]:
+                try:
+                    checks = [("node", json.loads(body).get("Node", ""),
+                               "write")]
+                except ValueError:
+                    checks = [("node", "", "write")]
+            elif parts[1:2] == ["deregister"]:
+                try:
+                    checks = [("node", json.loads(body).get("Node", ""),
+                               "write")]
+                except ValueError:
+                    checks = [("node", "", "write")]
+            elif parts[1:2] == ["service"] and len(parts) > 2:
+                checks = [("service", parts[2], "read")]
+            elif parts[1:2] == ["node"] and len(parts) > 2:
+                checks = [("node", parts[2], "read")]
+            else:
+                checks = [("node", "", "read")]
+        elif fam == "health":
+            if parts[1:2] in (["service"], ["checks"]) and len(parts) > 2:
+                checks = [("service", parts[2], "read")]
+            elif parts[1:2] == ["node"] and len(parts) > 2:
+                checks = [("node", parts[2], "read")]
+            else:
+                checks = [("node", "", "read")]
+        elif fam == "session":
+            if parts[1:2] == ["create"]:
+                try:
+                    name = json.loads(body or b"{}").get("Node", node)
+                except ValueError:
+                    name = node
+                checks = [("session", name, "write")]
+            elif parts[1:2] in (["destroy"], ["renew"]):
+                checks = [("session", "", "write")]
+            else:
+                checks = [("session", "", "read")]
+        elif fam == "event":
+            if parts[1:2] == ["fire"]:
+                checks = [("event", parts[2] if len(parts) > 2 else "",
+                           "write")]
+            else:
+                checks = [("event", q.get("name", ""), "read")]
+        elif fam == "query":
+            name = parts[1] if len(parts) > 1 else ""
+            if len(parts) == 3 and parts[2] in ("execute", "explain"):
+                checks = [("query", name, "read")]
+            else:
+                checks = [("query", name,
+                           "write" if write else "read")]
+        elif fam == "coordinate":
+            if parts[1:2] == ["update"]:
+                try:
+                    checks = [("node", json.loads(body).get("Node", ""),
+                               "write")]
+                except ValueError:
+                    checks = [("node", "", "write")]
+            else:
+                checks = [("node", "", "read")]
+        elif fam == "config":
+            checks = [("operator", "", "write" if write else "read")]
+        elif fam == "operator":
+            if parts[1:2] == ["keyring"]:
+                checks = [("keyring", "",
+                           "write" if method != "GET" else "read")]
+            else:
+                checks = [("operator", "",
+                           "write" if write else "read")]
+        elif fam == "snapshot":
+            checks = [("operator", "", "write" if write else "read")]
+        elif fam == "internal":
+            checks = [("node", "", "read")]
+        elif fam == "agent":
+            checks = [("agent", node, "write" if write else "read")]
+        elif fam == "acl":
+            checks = [("acl", "", "write" if write else "read")]
+        for resource, name, access in checks:
+            if not authz.allowed(resource, name, access):
+                return 403, {"error": "Permission denied"}, {}
+        return None
+
+    def _acl_routes(self, method, parts, q, body, min_index, wait_s, rpc):
+        """/v1/acl/* (reference acl_endpoint.go HTTP surface — the
+        token/policy API subset; legacy create/update/info and
+        roles/auth-methods are out)."""
+        if parts == ["acl", "bootstrap"] and method == "PUT":
+            try:
+                out = self.agent.rpc("ACL.Bootstrap")
+            except ValueError as e:
+                return 403, {"error": str(e)}, {}
+            # The pre-propose check can race another bootstrap (or run
+            # against a lagging replica): the FSM's verdict is the
+            # truth — a False means the marker already existed at
+            # apply time and THIS token was discarded. Answering 200
+            # with it would hand out a credential that resolves as
+            # anonymous.
+            res = self.wait_write(out["index"])
+            if not isinstance(res, dict) or not res.get("found"):
+                res = self.agent.rpc("Status.ApplyResult",
+                                     index=out["index"])
+            if not res.get("found"):
+                raise RuntimeError("bootstrap apply unconfirmed")
+            if res["result"] is False:
+                return 403, {"error": "ACL system already "
+                             "bootstrapped"}, {}
+            return 200, _token_to_api(out["token"]), {}
+        if parts == ["acl", "token"] and method == "PUT":
+            out = self.agent.rpc("ACL.TokenSet",
+                                 token=_token_from_api(json.loads(body)))
+            self.wait_write(out["index"])
+            return 200, _token_to_api(out["token"]), {}
+        if len(parts) == 3 and parts[:2] == ["acl", "token"]:
+            if method == "GET":
+                out = rpc("ACL.TokenGet", accessor_id=parts[2],
+                          min_index=min_index, wait_s=wait_s)
+                if not out["value"]:
+                    return 404, {"error": "token not found"}, {}
+                return 200, _token_to_api(out["value"][0]), {
+                    "X-Consul-Index": str(out["index"])}
+            if method == "PUT":
+                t = _token_from_api(json.loads(body))
+                t["accessor_id"] = parts[2]
+                existing = rpc("ACL.TokenGet", accessor_id=parts[2])
+                if not existing["value"]:
+                    return 404, {"error": "token not found"}, {}
+                # SecretID immutability is enforced by the endpoint
+                # itself (ACL.TokenSet pins the stored secret).
+                out = self.agent.rpc("ACL.TokenSet", token=t)
+                self.wait_write(out["index"])
+                return 200, _token_to_api(out["token"]), {}
+            if method == "DELETE":
+                try:
+                    idx = self.agent.rpc("ACL.TokenDelete",
+                                         accessor_id=parts[2])
+                except KeyError:
+                    return 404, {"error": "token not found"}, {}
+                self.wait_write(idx)
+                return 200, True, {}
+        if parts == ["acl", "tokens"]:
+            out = rpc("ACL.TokenList", min_index=min_index, wait_s=wait_s)
+            return 200, [_token_to_api(t) for t in out["value"]], {
+                "X-Consul-Index": str(out["index"])}
+        if parts == ["acl", "policy"] and method == "PUT":
+            out = self.agent.rpc(
+                "ACL.PolicySet", policy=_policy_from_api(json.loads(body)))
+            self.wait_write(out["index"])
+            return 200, _policy_to_api(out["policy"]), {}
+        if len(parts) == 4 and parts[:3] == ["acl", "policy", "name"]:
+            out = rpc("ACL.PolicyGet", name=parts[3],
+                      min_index=min_index, wait_s=wait_s)
+            if not out["value"]:
+                return 404, {"error": "policy not found"}, {}
+            return 200, _policy_to_api(out["value"][0]), {
+                "X-Consul-Index": str(out["index"])}
+        if len(parts) == 3 and parts[:2] == ["acl", "policy"]:
+            if method == "PUT":
+                p = _policy_from_api(json.loads(body))
+                p["name"] = parts[2]
+                out = self.agent.rpc("ACL.PolicySet", policy=p)
+                self.wait_write(out["index"])
+                return 200, _policy_to_api(out["policy"]), {}
+            if method == "DELETE":
+                try:
+                    idx = self.agent.rpc("ACL.PolicyDelete", name=parts[2])
+                except KeyError:
+                    return 404, {"error": "policy not found"}, {}
+                self.wait_write(idx)
+                return 200, True, {}
+            out = rpc("ACL.PolicyGet", name=parts[2],
+                      min_index=min_index, wait_s=wait_s)
+            if not out["value"]:
+                return 404, {"error": "policy not found"}, {}
+            return 200, _policy_to_api(out["value"][0]), {
+                "X-Consul-Index": str(out["index"])}
+        if parts == ["acl", "policies"]:
+            out = rpc("ACL.PolicyList", min_index=min_index,
+                      wait_s=wait_s)
+            return 200, [_policy_to_api(p) for p in out["value"]], {
+                "X-Consul-Index": str(out["index"])}
+        return 404, {"error": f"no such ACL endpoint"}, {}
 
     def _query(self, method, parts, q, body, min_index, wait_s, rpc, dc):
         """/v1/query family (reference agent/prepared_query_endpoint.go:
@@ -369,6 +626,11 @@ class HTTPApi:
                       min_index=min_index, wait_s=wait_s)
             return 200, out["value"], {"X-Consul-Index": str(out["index"])}
 
+        # ---- ACL (reference acl_endpoint.go; /v1/acl/*) ---------------
+        if parts[0] == "acl":
+            return self._acl_routes(method, parts, q, body, min_index,
+                                    wait_s, rpc)
+
         # ---- prepared queries (reference agent/prepared_query_
         # endpoint.go; routes http_register.go /v1/query) ----------------
         if parts[0] == "query":
@@ -377,7 +639,10 @@ class HTTPApi:
 
         # ---- kv -------------------------------------------------------
         if parts[0] == "kv":
-            key = "/".join(parts[1:])
+            # Trailing slashes are part of the key space ("tree/" is a
+            # narrower recurse prefix than "tree") — recover them from
+            # the raw path, the split dropped them.
+            key = _kv_key(path, ["kv", *parts[1:]])
             return self._kv(method, key, q, body, min_index, wait_s,
                             rpc, rpc_write)
 
@@ -978,6 +1243,52 @@ def _lower_keys(d: Optional[dict]) -> Optional[dict]:
             for k, v in d.items()}
 
 
+def _kv_key(path: str, parts: list) -> str:
+    """KV key from the request path, preserving a meaningful trailing
+    slash that the empty-segment-dropping split loses."""
+    key = "/".join(parts[1:])
+    if key and path.endswith("/"):
+        key += "/"
+    return key
+
+
+def _token_from_api(d: dict) -> dict:
+    out = {}
+    for api_k, k in (("AccessorID", "accessor_id"),
+                     ("SecretID", "secret_id"),
+                     ("Description", "description")):
+        if api_k in d:
+            out[k] = d[api_k]
+    out["policies"] = [p["Name"] if isinstance(p, dict) else p
+                       for p in d.get("Policies") or []]
+    return out
+
+
+def _token_to_api(t: dict) -> dict:
+    out = {"AccessorID": t.get("accessor_id", ""),
+           "Description": t.get("description", ""),
+           "Policies": [{"Name": p} for p in t.get("policies", [])]}
+    if "secret_id" in t:
+        out["SecretID"] = t["secret_id"]
+    return out
+
+
+def _policy_from_api(d: dict) -> dict:
+    out = {}
+    for api_k, k in (("ID", "id"), ("Name", "name"),
+                     ("Description", "description"),
+                     ("Rules", "rules")):
+        if api_k in d:
+            out[k] = d[api_k]
+    return out
+
+
+def _policy_to_api(p: dict) -> dict:
+    return {"ID": p.get("id", ""), "Name": p.get("name", ""),
+            "Description": p.get("description", ""),
+            "Rules": p.get("rules", "")}
+
+
 def _parse_tcp_target(addr: str) -> tuple[str, int]:
     """``host:port`` with bracketed-IPv6 support (``[::1]:8080`` →
     ``::1``); a missing or non-numeric port is a named 400, not a
@@ -1107,7 +1418,8 @@ class _Handler(BaseHTTPRequestHandler):
         body = self.rfile.read(length) if length else b""
         status, payload, headers = self.api.handle(
             method, parsed.path,
-            parse_qs(parsed.query, keep_blank_values=True), body
+            parse_qs(parsed.query, keep_blank_values=True), body,
+            headers=dict(self.headers),
         )
         data = json.dumps(payload).encode()
         self.send_response(status)

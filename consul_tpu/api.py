@@ -35,13 +35,16 @@ class Client:
     ``status``, ``agent`` (reference api/api.go NewClient)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8500,
-                 scheme: str = "http", ssl_context=None):
+                 scheme: str = "http", ssl_context=None,
+                 token: str = ""):
         """``scheme="https"`` with an ``ssl_context`` (e.g.
         ``utils.tls.Configurator.outgoing_ctx()``) speaks TLS to the
         agent — the reference client's HttpClient with TLSConfig
-        (api/api.go SetupTLSConfig)."""
+        (api/api.go SetupTLSConfig). ``token`` rides every request as
+        X-Consul-Token (api/api.go Config.Token)."""
         self.base = f"{scheme}://{host}:{port}"
         self.ssl_context = ssl_context
+        self.token = token
         self.kv = KV(self)
         self.catalog = Catalog(self)
         self.health = Health(self)
@@ -53,6 +56,7 @@ class Client:
         self.config = ConfigEntries(self)
         self.internal = Internal(self)
         self.query = PreparedQuery(self)
+        self.acl = ACL(self)
 
     def _call(self, method: str, path: str, params: Optional[dict] = None,
               body: Optional[bytes] = None) -> tuple[Any, QueryMeta, int]:
@@ -61,6 +65,8 @@ class Client:
         )
         url = f"{self.base}{path}" + (f"?{qs}" if qs else "")
         req = urllib.request.Request(url, data=body, method=method)
+        if self.token:
+            req.add_header("X-Consul-Token", self.token)
         try:
             with urllib.request.urlopen(req, context=self.ssl_context) as resp:
                 payload = json.loads(resp.read() or b"null")
@@ -539,6 +545,67 @@ class PreparedQuery:
 
     def explain(self, name: str) -> dict:
         out, _, _ = self.c._call("GET", f"/v1/query/{name}/explain")
+        return out
+
+
+class ACL:
+    """Token + policy API (reference api/acl.go: ACL.Bootstrap,
+    TokenCreate/Read/Update/Delete/List, PolicyCreate/Read/Delete/
+    List over /v1/acl/*)."""
+
+    def __init__(self, c: Client):
+        self.c = c
+
+    def bootstrap(self) -> dict:
+        out, _, _ = self.c._call("PUT", "/v1/acl/bootstrap")
+        return out
+
+    def token_create(self, description: str = "",
+                     policies: Optional[list] = None) -> dict:
+        out, _, _ = self.c._call("PUT", "/v1/acl/token", None, json.dumps({
+            "Description": description,
+            "Policies": [{"Name": p} for p in policies or []],
+        }).encode())
+        return out
+
+    def token_read(self, accessor_id: str):
+        out, _, _ = self.c._call("GET", f"/v1/acl/token/{accessor_id}")
+        return out
+
+    def token_update(self, accessor_id: str, description: str = "",
+                     policies: Optional[list] = None) -> dict:
+        out, _, _ = self.c._call(
+            "PUT", f"/v1/acl/token/{accessor_id}", None, json.dumps({
+                "Description": description,
+                "Policies": [{"Name": p} for p in policies or []],
+            }).encode())
+        return out
+
+    def token_delete(self, accessor_id: str) -> bool:
+        out, _, _ = self.c._call("DELETE", f"/v1/acl/token/{accessor_id}")
+        return bool(out)
+
+    def token_list(self) -> list[dict]:
+        out, _, _ = self.c._call("GET", "/v1/acl/tokens")
+        return out
+
+    def policy_create(self, name: str, rules: Any = "",
+                      description: str = "") -> dict:
+        out, _, _ = self.c._call("PUT", "/v1/acl/policy", None, json.dumps({
+            "Name": name, "Rules": rules, "Description": description,
+        }).encode())
+        return out
+
+    def policy_read(self, name: str):
+        out, _, _ = self.c._call("GET", f"/v1/acl/policy/name/{name}")
+        return out
+
+    def policy_delete(self, name: str) -> bool:
+        out, _, _ = self.c._call("DELETE", f"/v1/acl/policy/{name}")
+        return bool(out)
+
+    def policy_list(self) -> list[dict]:
+        out, _, _ = self.c._call("GET", "/v1/acl/policies")
         return out
 
 

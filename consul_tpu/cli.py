@@ -14,6 +14,7 @@ framework implements:
   snapshot save|restore                                (command/snapshot)
   join             route a client agent onto servers   (command/join)
   leave            graceful leave + shutdown           (command/leave)
+  acl              bootstrap / policy / token CRUD     (command/acl)
   event fire|list / watch / force-leave / debug
   operator raft list-peers|remove-peer                 (command/operator)
   operator autopilot get-config|set-config|health
@@ -42,7 +43,9 @@ from consul_tpu.server.rtt import compute_distance
 
 def make_client(args) -> Client:
     host, _, port = args.http_addr.rpartition(":")
-    return Client(host or "127.0.0.1", int(port))
+    return Client(host or "127.0.0.1", int(port),
+                  token=getattr(args, "token", "")
+                  or os.environ.get("CONSUL_TPU_TOKEN", ""))
 
 
 def cmd_members(client: Client, args) -> int:
@@ -231,6 +234,75 @@ def cmd_join(client: Client, args) -> int:
     print(f"Successfully joined {args.address}" if ok
           else f"error: join {args.address} failed")
     return 0 if ok else 1
+
+
+def cmd_acl(client: Client, args) -> int:
+    """ACL management (reference command/acl: bootstrap, policy and
+    token CRUD against /v1/acl/*)."""
+    if args.acl_cmd == "bootstrap":
+        try:
+            tok = client.acl.bootstrap()
+        except APIError as e:
+            print(f"error: {e.body.get('error', e)}", file=sys.stderr)
+            return 1
+        print(f"AccessorID:   {tok['AccessorID']}")
+        print(f"SecretID:     {tok['SecretID']}")
+        print(f"Description:  {tok['Description']}")
+        return 0
+    if args.acl_cmd == "policy":
+        if args.policy_cmd == "create":
+            rules = args.rules
+            if rules.startswith("@"):
+                with open(rules[1:]) as f:
+                    rules = f.read()
+            p = client.acl.policy_create(args.name, rules,
+                                         args.description)
+            print(f"Created policy {p['Name']} ({p['ID']})")
+            return 0
+        if args.policy_cmd == "read":
+            p = client.acl.policy_read(args.name)
+            if p is None:
+                print(f"error: policy {args.name!r} not found",
+                      file=sys.stderr)
+                return 1
+            print(json.dumps(p, indent=2))
+            return 0
+        if args.policy_cmd == "delete":
+            ok = client.acl.policy_delete(args.name)
+            print(f"Deleted policy {args.name}" if ok else "error")
+            return 0 if ok else 1
+        if args.policy_cmd == "list":
+            for p in client.acl.policy_list():
+                print(f"{p['Name']:<24} {p['Description']}")
+            return 0
+    if args.acl_cmd == "token":
+        if args.token_cmd == "create":
+            t = client.acl.token_create(
+                args.description,
+                args.policy_name or [])
+            print(f"AccessorID:   {t['AccessorID']}")
+            print(f"SecretID:     {t['SecretID']}")
+            print(f"Policies:     "
+                  f"{', '.join(p['Name'] for p in t['Policies'])}")
+            return 0
+        if args.token_cmd == "read":
+            t = client.acl.token_read(args.id)
+            if t is None:
+                print(f"error: token {args.id!r} not found",
+                      file=sys.stderr)
+                return 1
+            print(json.dumps(t, indent=2))
+            return 0
+        if args.token_cmd == "delete":
+            ok = client.acl.token_delete(args.id)
+            print(f"Deleted token {args.id}" if ok else "error")
+            return 0 if ok else 1
+        if args.token_cmd == "list":
+            for t in client.acl.token_list():
+                pols = ", ".join(p["Name"] for p in t["Policies"])
+                print(f"{t['AccessorID']}  [{pols}] {t['Description']}")
+            return 0
+    raise AssertionError(args.acl_cmd)
 
 
 def cmd_leave(client: Client, args) -> int:
@@ -488,6 +560,10 @@ def build_parser() -> argparse.ArgumentParser:
         "--http-addr",
         default=os.environ.get("CONSUL_TPU_HTTP_ADDR", "127.0.0.1:8500"),
     )
+    p.add_argument(
+        "--token", default="",
+        help="ACL token (or CONSUL_TPU_TOKEN), sent as X-Consul-Token",
+    )
     sub = p.add_subparsers(dest="cmd", required=True)
 
     ag = sub.add_parser(
@@ -579,6 +655,30 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("leave", help="gracefully leave and shut down the agent")
 
+    acl_p = sub.add_parser("acl", help="ACL bootstrap / policies / tokens")
+    acl_sub = acl_p.add_subparsers(dest="acl_cmd", required=True)
+    acl_sub.add_parser("bootstrap")
+    pol_p = acl_sub.add_parser("policy")
+    pol_sub = pol_p.add_subparsers(dest="policy_cmd", required=True)
+    pc = pol_sub.add_parser("create")
+    pc.add_argument("-name", required=True)
+    pc.add_argument("-rules", required=True,
+                    help="rules document ('@file' reads a file)")
+    pc.add_argument("-description", default="")
+    for verb in ("read", "delete"):
+        vp = pol_sub.add_parser(verb)
+        vp.add_argument("-name", required=True)
+    pol_sub.add_parser("list")
+    tok_p = acl_sub.add_parser("token")
+    tok_sub = tok_p.add_subparsers(dest="token_cmd", required=True)
+    tc = tok_sub.add_parser("create")
+    tc.add_argument("-description", default="")
+    tc.add_argument("-policy-name", action="append", default=[])
+    for verb in ("read", "delete"):
+        vp = tok_sub.add_parser(verb)
+        vp.add_argument("-id", required=True)
+    tok_sub.add_parser("list")
+
     op_p = sub.add_parser("operator", help="operator tooling")
     op_sub = op_p.add_subparsers(dest="operator_cmd", required=True)
     raft_p = op_sub.add_parser("raft")
@@ -653,7 +753,7 @@ COMMANDS = {
     "catalog": cmd_catalog, "info": cmd_info, "services": cmd_services,
     "sessions": cmd_sessions, "snapshot": cmd_snapshot, "debug": cmd_debug,
     "event": cmd_event, "watch": cmd_watch, "join": cmd_join,
-    "force-leave": cmd_force_leave, "leave": cmd_leave,
+    "force-leave": cmd_force_leave, "leave": cmd_leave, "acl": cmd_acl,
     "operator": cmd_operator, "maint": cmd_maint, "keyring": cmd_keyring,
     "monitor": cmd_monitor, "validate": cmd_validate, "lock": cmd_lock,
     "exec": cmd_exec, "reload": cmd_reload, "config": cmd_config,

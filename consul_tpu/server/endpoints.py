@@ -27,6 +27,7 @@ import random
 import uuid
 from typing import Any, Optional
 
+from consul_tpu.server import acl as acl_mod
 from consul_tpu.server import fsm as fsm_mod
 from consul_tpu.server import rtt
 from consul_tpu.server.fsm import FSM
@@ -450,6 +451,126 @@ class Server:
     # :1-89 RaftGetConfiguration/RaftRemovePeerByAddress,
     # operator_autopilot_endpoint.go:1-76 get/set autopilot config)
     # ------------------------------------------------------------------
+    # ------------------------------------------------------------------
+    # ACL endpoint (reference agent/consul/acl_endpoint.go:
+    # Bootstrap + Token/Policy CRUD; resolution for enforcement)
+    # ------------------------------------------------------------------
+    def _acl_bootstrap(self) -> dict:
+        """Mint the initial management token exactly once
+        (acl_endpoint.go Bootstrap). The one-shot guard is an
+        apply-time verdict so a bootstrap race across servers resolves
+        to a single winner."""
+        if self.store.acl_bootstrapped():
+            raise ValueError("ACL system already bootstrapped")
+        token = {
+            "accessor_id": str(uuid.uuid4()),
+            "secret_id": str(uuid.uuid4()),
+            "description": "Bootstrap Token (Global Management)",
+            "policies": [acl_mod.MANAGEMENT_POLICY],
+        }
+        idx = self._raft_apply({"type": fsm_mod.ACL, "op": "bootstrap",
+                                "token": token})
+        return {"token": token, "index": idx}
+
+    def _acl_token_set(self, token: dict) -> dict:
+        t = dict(token)
+        t.setdefault("accessor_id", str(uuid.uuid4()))
+        existing = self.store.acl_token_get(t["accessor_id"])
+        if existing is not None:
+            # SecretID is immutable on update (reference acl_endpoint.go
+            # TokenSet: "cannot change the secret") — a rewrite could
+            # collide with another token's secret and make resolution
+            # ambiguous.
+            t["secret_id"] = existing["secret_id"]
+        else:
+            t.setdefault("secret_id", str(uuid.uuid4()))
+            clash = self.store.acl_token_by_secret(t["secret_id"])
+            if clash is not None and \
+                    clash["accessor_id"] != t["accessor_id"]:
+                raise ValueError("secret id already in use")
+        t.setdefault("description", "")
+        t.setdefault("policies", [])
+        for p in t["policies"]:
+            if p != acl_mod.MANAGEMENT_POLICY and \
+                    self.store.acl_policy_get(p) is None:
+                raise KeyError(f"unknown ACL policy {p!r}")
+        idx = self._raft_apply({"type": fsm_mod.ACL, "op": "token-set",
+                                "token": t})
+        return {"token": t, "index": idx}
+
+    def _acl_token_delete(self, accessor_id: str) -> int:
+        if self.store.acl_token_get(accessor_id) is None:
+            raise KeyError(f"unknown ACL token {accessor_id!r}")
+        return self._raft_apply({"type": fsm_mod.ACL, "op": "token-delete",
+                                 "accessor_id": accessor_id})
+
+    def _acl_token_get(self, accessor_id: str, min_index: int = 0,
+                       wait_s: float = 10.0) -> dict:
+        def fn():
+            t = self.store.acl_token_get(accessor_id)
+            return [] if t is None else [t]
+        return self._blocking(("acl_tokens",), min_index, wait_s, fn)
+
+    def _acl_token_list(self, min_index: int = 0,
+                        wait_s: float = 10.0) -> dict:
+        # Listings never expose secrets (acl_endpoint.go TokenList
+        # redacts unless the caller proves management; the HTTP tier
+        # has already gated this on acl:read).
+        def fn():
+            return [{k: v for k, v in t.items() if k != "secret_id"}
+                    for t in self.store.acl_token_list()]
+        return self._blocking(("acl_tokens",), min_index, wait_s, fn)
+
+    def _acl_policy_set(self, policy: dict) -> dict:
+        p = dict(policy)
+        if not p.get("name"):
+            raise ValueError("ACL policy needs a name")
+        if p["name"] == acl_mod.MANAGEMENT_POLICY:
+            raise ValueError(f"{acl_mod.MANAGEMENT_POLICY!r} is builtin")
+        acl_mod.parse_rules(p.get("rules"))  # validate before commit
+        p.setdefault("id", str(uuid.uuid4()))
+        p.setdefault("description", "")
+        idx = self._raft_apply({"type": fsm_mod.ACL, "op": "policy-set",
+                                "policy": p})
+        return {"policy": p, "index": idx}
+
+    def _acl_policy_delete(self, name: str) -> int:
+        if self.store.acl_policy_get(name) is None:
+            raise KeyError(f"unknown ACL policy {name!r}")
+        return self._raft_apply({"type": fsm_mod.ACL, "op": "policy-delete",
+                                 "name": name})
+
+    def _acl_policy_get(self, name: str, min_index: int = 0,
+                        wait_s: float = 10.0) -> dict:
+        def fn():
+            p = self.store.acl_policy_get(name)
+            return [] if p is None else [p]
+        return self._blocking(("acl_policies",), min_index, wait_s, fn)
+
+    def _acl_policy_list(self, min_index: int = 0,
+                         wait_s: float = 10.0) -> dict:
+        return self._blocking(("acl_policies",), min_index, wait_s,
+                              self.store.acl_policy_list)
+
+    def _acl_resolve(self, secret_id: str,
+                     default_allow: bool = True) -> dict:
+        """Secret → the token's compiled rule inputs (reference
+        acl.go ResolveToken): the HTTP tier builds the Authorizer.
+        Unknown secrets are anonymous, NOT an error (the reference
+        treats them as anonymous when down-policy permits; a hard
+        error would turn every stale token into an outage)."""
+        t = self.store.acl_token_by_secret(secret_id) if secret_id else None
+        if t is None:
+            return {"known": False, "management": False, "rules": []}
+        management = acl_mod.MANAGEMENT_POLICY in t.get("policies", [])
+        docs = []
+        for name in t.get("policies", []):
+            p = self.store.acl_policy_get(name)
+            if p is not None:
+                docs.append(acl_mod.parse_rules(p.get("rules")))
+        return {"known": True, "management": management, "rules": docs,
+                "accessor_id": t["accessor_id"]}
+
     # ------------------------------------------------------------------
     # PreparedQuery endpoint (reference agent/consul/
     # prepared_query_endpoint.go: Apply/Get/List/Explain/Execute/

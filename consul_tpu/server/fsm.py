@@ -23,6 +23,7 @@ COORDINATE_BATCH_UPDATE = "coordinate-batch-update"
 CONFIG_ENTRY = "config-entry"
 AUTOPILOT = "autopilot"
 PREPARED_QUERY = "prepared-query"
+ACL = "acl"
 TXN = "txn"
 
 # Tables each op type can write (for scoped TXN undo logs). KV ops can
@@ -34,6 +35,7 @@ _TXN_TABLES: dict[str, set] = {
     COORDINATE_BATCH_UPDATE: {"coordinates"},
     CONFIG_ENTRY: {"config_entries"},
     PREPARED_QUERY: {"prepared_queries"},
+    ACL: {"acl_tokens", "acl_policies", "acl_meta"},
     REGISTER: {"nodes", "services", "checks"},
     DEREGISTER: {"nodes", "services", "checks", "coordinates",
                  "sessions", "kv", "prepared_queries"},
@@ -139,6 +141,33 @@ class FSM:
             except ValueError:
                 return False
             return command["query"]["id"]
+        if mtype == ACL:
+            # Reference fsm applyACL* (fsm/commands_oss.go): token and
+            # policy upserts/deletes plus the one-shot bootstrap
+            # marker. Bootstrap races resolve deterministically at
+            # apply time: the second committed bootstrap is a False
+            # verdict (acl_endpoint.go Bootstrap "already bootstrapped").
+            op = command["op"]
+            if op == "token-set":
+                self.store.acl_token_set(command["token"], index=index)
+                return command["token"]["accessor_id"]
+            if op == "token-delete":
+                self.store.acl_token_delete(command["accessor_id"],
+                                            index=index)
+                return True
+            if op == "policy-set":
+                self.store.acl_policy_set(command["policy"], index=index)
+                return command["policy"]["name"]
+            if op == "policy-delete":
+                self.store.acl_policy_delete(command["name"], index=index)
+                return True
+            if op == "bootstrap":
+                if self.store.acl_bootstrapped():
+                    return False
+                self.store.acl_mark_bootstrapped(index=index)
+                self.store.acl_token_set(command["token"], index=index)
+                return True
+            raise ValueError(f"unknown ACL op {op!r}")
         if mtype == AUTOPILOT:
             # Operator autopilot configuration (reference
             # fsm applyAutopilotUpdate, operator_autopilot_endpoint.go):

@@ -67,6 +67,9 @@ class StateStore:
         "config_entries",  # kind/name -> entry
         "autopilot",      # "config" -> operator autopilot configuration
         "prepared_queries",  # query id -> definition
+        "acl_tokens",     # accessor id -> token (carries secret id)
+        "acl_policies",   # policy name -> {id, rules, description}
+        "acl_meta",       # "bootstrap" -> one-shot marker
     )
 
     def __init__(self):
@@ -451,6 +454,66 @@ class StateStore:
         with self._lock:
             return [e.value for _, e in
                     sorted(self.tables["prepared_queries"].rows.items())]
+
+    # ------------------------------------------------------------------
+    # ACL tokens + policies (reference state/acl.go)
+    # ------------------------------------------------------------------
+    def acl_token_set(self, token: dict, index: Optional[int] = None) -> int:
+        return self._commit("acl_tokens", token["accessor_id"], token,
+                            index=index)
+
+    def acl_token_delete(self, accessor_id: str,
+                         index: Optional[int] = None) -> int:
+        return self._commit("acl_tokens", accessor_id, None, delete=True,
+                            index=index)
+
+    def acl_token_get(self, accessor_id: str) -> Optional[dict]:
+        with self._lock:
+            e = self.tables["acl_tokens"].rows.get(accessor_id)
+            return None if e is None else e.value
+
+    def acl_token_by_secret(self, secret_id: str) -> Optional[dict]:
+        """Resolve a presented secret (reference state/acl.go
+        ACLTokenGetBySecret — an indexed lookup there; a scan here,
+        fine at control-plane token counts)."""
+        with self._lock:
+            for e in self.tables["acl_tokens"].rows.values():
+                if e.value.get("secret_id") == secret_id:
+                    return e.value
+            return None
+
+    def acl_token_list(self) -> list[dict]:
+        with self._lock:
+            return [e.value for _, e in
+                    sorted(self.tables["acl_tokens"].rows.items())]
+
+    def acl_policy_set(self, policy: dict,
+                       index: Optional[int] = None) -> int:
+        return self._commit("acl_policies", policy["name"], policy,
+                            index=index)
+
+    def acl_policy_delete(self, name: str,
+                          index: Optional[int] = None) -> int:
+        return self._commit("acl_policies", name, None, delete=True,
+                            index=index)
+
+    def acl_policy_get(self, name: str) -> Optional[dict]:
+        with self._lock:
+            e = self.tables["acl_policies"].rows.get(name)
+            return None if e is None else e.value
+
+    def acl_policy_list(self) -> list[dict]:
+        with self._lock:
+            return [e.value for _, e in
+                    sorted(self.tables["acl_policies"].rows.items())]
+
+    def acl_bootstrapped(self) -> bool:
+        with self._lock:
+            return "bootstrap" in self.tables["acl_meta"].rows
+
+    def acl_mark_bootstrapped(self, index: Optional[int] = None) -> int:
+        return self._commit("acl_meta", "bootstrap", {"done": True},
+                            index=index)
 
     def _invalidate_queries_for_session(self, session_id: str, index: int):
         """A query tied to a session dies with it (reference

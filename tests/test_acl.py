@@ -1,0 +1,422 @@
+"""ACL system (reference acl/policy.go + acl/acl.go + agent/consul/
+acl_endpoint.go + agent/acl.go): policy parsing (HCL DSL and JSON),
+authorizer precedence, raft-replicated token/policy CRUD, one-shot
+bootstrap, and HTTP enforcement with default allow/deny."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from consul_tpu.agent.agent import Agent
+from consul_tpu.agent.http import HTTPApi
+from consul_tpu.server import acl
+from consul_tpu.server.endpoints import ServerCluster
+
+
+class TestPolicyParsing:
+    def test_hcl_rules(self):
+        doc = acl.parse_rules('''
+key_prefix "app/" { policy = "write" }
+key "secret" { policy = "deny" }
+service_prefix "" { policy = "read" }
+operator = "read"
+''')
+        assert doc["key_prefix"]["app/"] == "write"
+        assert doc["key"]["secret"] == "deny"
+        assert doc["operator"] == "read"
+
+    def test_json_rules_and_validation(self):
+        doc = acl.parse_rules({"node_prefix": {"": {"policy": "write"}}})
+        assert doc["node_prefix"][""] == "write"
+        with pytest.raises(ValueError, match="unknown ACL resource"):
+            acl.parse_rules({"bogus": {"x": {"policy": "read"}}})
+        with pytest.raises(ValueError, match="bad policy"):
+            acl.parse_rules({"key": {"x": {"policy": "rwx"}}})
+        with pytest.raises(ValueError, match="bad operator"):
+            acl.parse_rules({"operator": "everything"})
+
+
+class TestAuthorizer:
+    def _authz(self, rules, default_allow=False):
+        return acl.Authorizer([acl.parse_rules(rules)],
+                              default_allow=default_allow)
+
+    def test_exact_beats_prefix(self):
+        a = self._authz('''
+key_prefix "app/" { policy = "write" }
+key "app/frozen" { policy = "read" }
+''')
+        assert a.allowed("key", "app/x", "write")
+        assert a.allowed("key", "app/frozen", "read")
+        assert not a.allowed("key", "app/frozen", "write")
+
+    def test_longest_prefix_wins(self):
+        a = self._authz('''
+key_prefix "" { policy = "read" }
+key_prefix "app/" { policy = "deny" }
+key_prefix "app/public/" { policy = "write" }
+''')
+        assert a.allowed("key", "other", "read")
+        assert not a.allowed("key", "other", "write")
+        assert not a.allowed("key", "app/private", "read")
+        assert a.allowed("key", "app/public/x", "write")
+
+    def test_default_policy(self):
+        allow = self._authz("", default_allow=True)
+        deny = self._authz("", default_allow=False)
+        assert allow.allowed("key", "anything", "write")
+        assert not deny.allowed("key", "anything", "read")
+        assert allow.allowed("operator", "", "write")
+        assert not deny.allowed("operator", "", "read")
+
+    def test_deny_precedence_across_policies(self):
+        # acl/policy_merger.go: deny beats write beats read when two
+        # policies of one token name the same rule.
+        a = acl.Authorizer([
+            acl.parse_rules({"key": {"k": {"policy": "write"}}}),
+            acl.parse_rules({"key": {"k": {"policy": "deny"}}}),
+        ], default_allow=True)
+        assert not a.allowed("key", "k", "read")
+
+    def test_management_allows_everything(self):
+        m = acl.management_authorizer()
+        assert m.allowed("key", "x", "write")
+        assert m.allowed("acl", "", "write")
+
+
+@pytest.fixture
+def cluster():
+    c = ServerCluster(3, seed=13)
+    c.wait_converged()
+    return c
+
+
+class TestEndpoints:
+    def test_bootstrap_once(self, cluster):
+        leader = cluster.leader_server()
+        out = cluster.write(leader, "ACL.Bootstrap")
+        tok = out["token"]
+        assert tok["secret_id"] and tok["accessor_id"]
+        assert tok["policies"] == [acl.MANAGEMENT_POLICY]
+        with pytest.raises(ValueError, match="already bootstrapped"):
+            leader.rpc("ACL.Bootstrap")
+        # Replicated: every server knows it is bootstrapped.
+        for s in cluster.servers:
+            assert s.store.acl_bootstrapped()
+
+    def test_policy_and_token_crud_replicate(self, cluster):
+        leader = cluster.leader_server()
+        cluster.write(leader, "ACL.PolicySet", policy={
+            "name": "kv-ro",
+            "rules": 'key_prefix "" { policy = "read" }'})
+        out = cluster.write(leader, "ACL.TokenSet",
+                            token={"description": "reader",
+                                   "policies": ["kv-ro"]})
+        tok = out["token"]
+        for s in cluster.servers:
+            assert s.store.acl_policy_get("kv-ro") is not None
+            assert s.store.acl_token_by_secret(
+                tok["secret_id"])["accessor_id"] == tok["accessor_id"]
+        res = leader.rpc("ACL.Resolve", secret_id=tok["secret_id"])
+        assert res["known"] and not res["management"]
+        a = acl.Authorizer(res["rules"], default_allow=False)
+        assert a.allowed("key", "anything", "read")
+        assert not a.allowed("key", "anything", "write")
+        cluster.write(leader, "ACL.TokenDelete",
+                      accessor_id=tok["accessor_id"])
+        assert leader.rpc("ACL.Resolve",
+                          secret_id=tok["secret_id"])["known"] is False
+
+    def test_token_with_unknown_policy_rejected(self, cluster):
+        leader = cluster.leader_server()
+        with pytest.raises(KeyError, match="unknown ACL policy"):
+            leader.rpc("ACL.TokenSet", token={"policies": ["ghost"]})
+
+    def test_bad_rules_rejected_before_commit(self, cluster):
+        leader = cluster.leader_server()
+        with pytest.raises(ValueError):
+            leader.rpc("ACL.PolicySet",
+                       policy={"name": "bad", "rules": {"wat": {}}})
+        assert leader.store.acl_policy_get("bad") is None
+
+
+@pytest.fixture(scope="module")
+def acl_stack():
+    """Cluster + HTTPApi with ACLs enabled, default-deny, and a
+    configured master token (reference acl_master_token)."""
+    cluster = ServerCluster(3, seed=17)
+    cluster.wait_converged()
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    def pump():
+        while not stop.is_set():
+            with lock:
+                cluster.step()
+            time.sleep(0.002)
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    def rpc(method, **args):
+        with lock:
+            server = cluster.registry[cluster.raft.wait_converged().id]
+        return server.rpc(method, **args)
+
+    def wait_write(idx):
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            with lock:
+                led = cluster.raft.leader()
+                if led is not None and led.last_applied >= idx:
+                    return
+            time.sleep(0.002)
+
+    agent = Agent("acl-agent", "10.11.0.1", rpc, cluster_size=3)
+    api = HTTPApi(agent, wait_write=wait_write,
+                  acl={"enabled": True, "default_policy": "deny",
+                       "master_token": "master-secret"})
+    yield api, rpc
+    stop.set()
+
+
+def call(api, method, path, body=b"", token=""):
+    headers = {"X-Consul-Token": token} if token else {}
+    return api.handle(method, path, {}, body, headers=headers)
+
+
+class TestHTTPEnforcement:
+    def test_anonymous_denied_under_default_deny(self, acl_stack):
+        api, _ = acl_stack
+        st, body, _ = call(api, "GET", "/v1/kv/secret")
+        assert st == 403 and "Permission denied" in body["error"]
+        st, _, _ = call(api, "PUT", "/v1/kv/secret", b"v")
+        assert st == 403
+
+    def test_status_open_without_token(self, acl_stack):
+        api, _ = acl_stack
+        st, _, _ = call(api, "GET", "/v1/status/leader")
+        assert st == 200
+
+    def test_master_token_is_management(self, acl_stack):
+        api, _ = acl_stack
+        st, _, _ = call(api, "PUT", "/v1/kv/secret", b"v",
+                        token="master-secret")
+        assert st == 200
+
+    def test_scoped_token_enforced(self, acl_stack):
+        api, _ = acl_stack
+        st, _, _ = call(
+            api, "PUT", "/v1/acl/policy",
+            json.dumps({"Name": "app-rw", "Rules":
+                        'key_prefix "app/" { policy = "write" }\n'
+                        'key "app/frozen" { policy = "read" }'}).encode(),
+            token="master-secret")
+        assert st == 200
+        st, tok, _ = call(
+            api, "PUT", "/v1/acl/token",
+            json.dumps({"Description": "app",
+                        "Policies": [{"Name": "app-rw"}]}).encode(),
+            token="master-secret")
+        assert st == 200
+        secret = tok["SecretID"]
+        # In scope: write allowed.
+        st, _, _ = call(api, "PUT", "/v1/kv/app/x", b"1", token=secret)
+        assert st == 200
+        # Exact read-only rule inside the writable prefix.
+        st, _, _ = call(api, "PUT", "/v1/kv/app/frozen", b"1",
+                        token=secret)
+        assert st == 403
+        st, _, _ = call(api, "GET", "/v1/kv/app/frozen", token=secret)
+        assert st in (200, 404)  # authorized; key may not exist
+        # Out of scope: denied by default-deny.
+        st, _, _ = call(api, "GET", "/v1/kv/other", token=secret)
+        assert st == 403
+        # The scoped token cannot touch the ACL API itself.
+        st, _, _ = call(api, "GET", "/v1/acl/tokens", token=secret)
+        assert st == 403
+
+    def test_acl_api_requires_management(self, acl_stack):
+        api, _ = acl_stack
+        st, rows, _ = call(api, "GET", "/v1/acl/tokens",
+                           token="master-secret")
+        assert st == 200
+        # Listings redact secrets.
+        assert all("SecretID" not in r for r in rows)
+
+    def test_bootstrap_one_shot_over_http(self, acl_stack):
+        api, _ = acl_stack
+        st, tok, _ = call(api, "PUT", "/v1/acl/bootstrap")
+        assert st == 200 and tok["SecretID"]
+        st, body, _ = call(api, "PUT", "/v1/acl/bootstrap")
+        assert st == 403 and "bootstrapped" in body["error"]
+        # The minted token IS management.
+        st, _, _ = call(api, "PUT", "/v1/kv/boot-check", b"1",
+                        token=tok["SecretID"])
+        assert st == 200
+
+    def test_service_and_agent_scoping(self, acl_stack):
+        api, _ = acl_stack
+        st, _, _ = call(
+            api, "PUT", "/v1/acl/policy",
+            json.dumps({"Name": "svc-web", "Rules": {
+                "service": {"web": {"policy": "read"}},
+                "node_prefix": {"": {"policy": "read"}},
+            }}).encode(), token="master-secret")
+        assert st == 200
+        st, tok, _ = call(
+            api, "PUT", "/v1/acl/token",
+            json.dumps({"Policies": [{"Name": "svc-web"}]}).encode(),
+            token="master-secret")
+        secret = tok["SecretID"]
+        st, _, _ = call(api, "GET", "/v1/health/service/web",
+                        token=secret)
+        assert st == 200
+        st, _, _ = call(api, "GET", "/v1/health/service/db",
+                        token=secret)
+        assert st == 403
+        st, _, _ = call(api, "GET", "/v1/catalog/nodes", token=secret)
+        assert st == 200
+        st, _, _ = call(api, "PUT", "/v1/agent/maintenance",
+                        token=secret)
+        assert st == 403
+
+
+class TestBootE2E:
+    def test_acl_enabled_agent_end_to_end(self, tmp_path):
+        """Subprocess e2e: boot with ACLs default-deny, bootstrap via
+        CLI, mint a scoped token, watch enforcement bite (reference
+        sdk/testutil harness idiom)."""
+        import os
+        import signal as _signal
+        import subprocess
+        import sys
+
+        cfg = tmp_path / "acl.json"
+        cfg.write_text(json.dumps({
+            "node_name": "acl-boot", "n_servers": 1,
+            "http": {"host": "127.0.0.1", "port": 0},
+            "acl": {"enabled": True, "default_policy": "deny"},
+        }))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "consul_tpu.cli", "agent",
+             "--config-file", str(cfg)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        try:
+            ready = json.loads(proc.stdout.readline())
+            port = ready["http_port"]
+
+            def cli(*args, token=""):
+                return subprocess.run(
+                    [sys.executable, "-m", "consul_tpu.cli",
+                     "--http-addr", f"127.0.0.1:{port}",
+                     *(["--token", token] if token else []), *args],
+                    capture_output=True, text=True, env=env, timeout=30)
+
+            # Anonymous writes are denied.
+            out = cli("kv", "put", "k", "v")
+            assert out.returncode != 0
+            # Bootstrap mints the management token.
+            out = cli("acl", "bootstrap")
+            assert out.returncode == 0, out.stderr
+            secret = next(ln.split()[-1] for ln in out.stdout.splitlines()
+                          if ln.startswith("SecretID"))
+            out = cli("kv", "put", "k", "v", token=secret)
+            assert out.returncode == 0, out.stderr
+            # Scoped token through the CLI.
+            out = cli("acl", "policy", "create", "-name", "ro",
+                      "-rules", 'key_prefix "" { policy = "read" }',
+                      token=secret)
+            assert out.returncode == 0, out.stderr
+            out = cli("acl", "token", "create", "-policy-name", "ro",
+                      token=secret)
+            assert out.returncode == 0, out.stderr
+            ro = next(ln.split()[-1] for ln in out.stdout.splitlines()
+                      if ln.startswith("SecretID"))
+            assert cli("kv", "get", "k", token=ro).returncode == 0
+            assert cli("kv", "put", "k", "x", token=ro).returncode != 0
+        finally:
+            proc.send_signal(_signal.SIGTERM)
+            assert proc.wait(timeout=15) == 0
+
+
+class TestGateHardening:
+    def test_exact_key_grant_does_not_cover_subtree(self, acl_stack):
+        """KeyWritePrefix semantics: ?recurse/?keys authorize the whole
+        prefix — an exact-key rule must not escalate."""
+        api, _ = acl_stack
+        st, _, _ = call(
+            api, "PUT", "/v1/acl/policy",
+            json.dumps({"Name": "one-key", "Rules": {
+                "key": {"app2": {"policy": "write"}}}}).encode(),
+            token="master-secret")
+        assert st == 200
+        st, tok, _ = call(
+            api, "PUT", "/v1/acl/token",
+            json.dumps({"Policies": [{"Name": "one-key"}]}).encode(),
+            token="master-secret")
+        secret = tok["SecretID"]
+        st, _, _ = call(api, "GET", "/v1/kv/app2", token=secret)
+        assert st in (200, 404)
+        st, _, _ = call(api, "GET", "/v1/kv/app2?recurse=1", b"",
+                        token=secret)
+        # handle() gets query dict, not raw path: emulate ?recurse.
+        st, _, _ = api.handle("GET", "/v1/kv/app2",
+                              {"recurse": ["1"]}, b"",
+                              headers={"X-Consul-Token": secret})
+        assert st == 403
+        # A prefix grant with no denies underneath covers the subtree.
+        st, _, _ = call(
+            api, "PUT", "/v1/acl/policy",
+            json.dumps({"Name": "tree", "Rules": {
+                "key_prefix": {"tree/": {"policy": "write"}}}}).encode(),
+            token="master-secret")
+        st, tok2, _ = call(
+            api, "PUT", "/v1/acl/token",
+            json.dumps({"Policies": [{"Name": "tree"}]}).encode(),
+            token="master-secret")
+        st, _, _ = api.handle("GET", "/v1/kv/tree/",
+                              {"recurse": ["1"]}, b"",
+                              headers={"X-Consul-Token":
+                                       tok2["SecretID"]})
+        assert st == 200
+
+    def test_deny_inside_prefix_blocks_recurse(self):
+        from consul_tpu.server import acl as acl_mod
+        a = acl_mod.Authorizer([acl_mod.parse_rules({
+            "key_prefix": {"app/": {"policy": "write"},
+                           "app/secret/": {"policy": "deny"}}})],
+            default_allow=False)
+        assert a.allowed("key", "app/x", "write")
+        assert not a.allowed_prefix("key", "app/", "write")
+        assert a.allowed_prefix("key", "app/public/", "write")
+
+    def test_secret_id_immutable_on_update(self, acl_stack):
+        api, _ = acl_stack
+        st, tok, _ = call(api, "PUT", "/v1/acl/token",
+                          json.dumps({"Description": "t"}).encode(),
+                          token="master-secret")
+        acc, secret = tok["AccessorID"], tok["SecretID"]
+        st, upd, _ = call(
+            api, "PUT", f"/v1/acl/token/{acc}",
+            json.dumps({"Description": "t2",
+                        "SecretID": "attacker-chosen"}).encode(),
+            token="master-secret")
+        assert st == 200
+        assert upd["SecretID"] == secret  # rewrite ignored
+        st, got, _ = call(api, "GET", f"/v1/acl/token/{acc}",
+                          token="master-secret")
+        assert got["Description"] == "t2"
+
+    def test_lowercased_token_header_accepted(self, acl_stack):
+        """urllib canonicalizes X-Consul-Token to X-consul-token on
+        the wire; the gate must match case-insensitively."""
+        api, _ = acl_stack
+        st, _, _ = api.handle("PUT", "/v1/kv/lc-header", {}, b"v",
+                              headers={"x-consul-token":
+                                       "master-secret"})
+        assert st == 200
