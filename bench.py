@@ -271,22 +271,26 @@ _CKPT_DIR = os.path.join(_HERE, ".bench_ckpt")
 
 
 def run_northstar(sim, s, rps, phase_name, *, chunk, kill_frac, left, emit,
-                  ckpt_every_ticks: int = 512, ckpt_dir: str = _CKPT_DIR):
+                  ckpt_every_ticks: int = 512, ckpt_dir: str = _CKPT_DIR,
+                  ckpt_min_interval_s: float = 120.0):
     """The 1M mass-kill convergence attempt (BASELINE.json): warm the
     metrics-on runner OUTSIDE the timed region, bound the run by the
     measured rate (``rps``) and remaining deadline so a marginal
     backend emits a (failed) result, never a SIGKILL.
 
     Mid-run checkpoint/resume (SURVEY §5: device arrays -> host
-    container each K steps; the serf snapshot rejoin-fast precedent,
-    reference serf/snapshot.go:59-431): the sim state is snapshotted
-    every ``ckpt_every_ticks`` through utils/checkpoint (digest-
-    verified, atomic-rename), so a tunnel loss mid-northstar costs at
-    most one slice — the next bench run RESUMES from the checkpoint
-    (provenance in the emitted phase: ``resumed_from_tick``) instead
-    of restarting a ~50 s run from zero. Only a CONVERGED attempt
-    retires its checkpoint; a budget-exhausted unconverged one keeps
-    it so the next run continues the same trajectory."""
+    container; the serf snapshot rejoin-fast precedent, reference
+    serf/snapshot.go:59-431): the sim state is snapshotted through
+    utils/checkpoint (digest-verified, atomic-rename) at most once per
+    ``ckpt_min_interval_s`` of WALL time — a 1M-node save drags the
+    whole device state through the remote-TPU tunnel (~150 s measured
+    round 5), so tick-paced saves would dominate the run — plus one
+    final save whenever the attempt exits unconverged, so a tunnel
+    loss or budget exhaustion mid-northstar costs at most one slice:
+    the next bench run RESUMES from the checkpoint (provenance in the
+    emitted phase: ``resumed_from_tick``) instead of restarting.
+    ``ckpt_every_ticks`` only bounds the convergence-check slice size.
+    Only a CONVERGED attempt retires its checkpoint."""
     import jax.numpy as jnp
 
     from consul_tpu.utils import checkpoint as ckpt_mod
@@ -320,13 +324,27 @@ def run_northstar(sim, s, rps, phase_name, *, chunk, kill_frac, left, emit,
     ticks_done = resumed_tick
     converged = False
     t0_ns = time.monotonic()
+    # Checkpoint cadence is WALL-based, not tick-based: a 1M-node
+    # save drags the whole device state through the remote-TPU tunnel
+    # (round-5 measurement: ~150 s per save — tick-based saves turned
+    # a 53 s northstar into 357 s). Resume exists to bound lost wall
+    # time, so pace saves by wall time: a run converging inside the
+    # interval pays for zero checkpoints, a genuinely long/wedged run
+    # still gets one every ``ckpt_min_interval_s``.
+    last_ckpt = t0_ns
     while ticks_done - resumed_tick < max_ticks and not converged:
         slice_t = min(max(ckpt_every_ticks, chunk),
                       max_ticks - (ticks_done - resumed_tick))
         converged, used, _ = sim.run_until_converged(
             max_ticks=slice_t, chunk=chunk)
         ticks_done += used
-        if not converged:
+        due = time.monotonic() - last_ckpt >= ckpt_min_interval_s
+        exhausted = ticks_done - resumed_tick >= max_ticks
+        # Interval-paced mid-run saves, plus ALWAYS a final save when
+        # the attempt ends unconverged — otherwise a short-budget run
+        # would leave nothing behind and the next run re-injects the
+        # kill from tick 0, voiding the resume guarantee.
+        if not converged and (due or exhausted):
             try:
                 ckpt_mod.save(ck_path, sim.state)
                 with open(meta_path, "w") as f:
@@ -334,6 +352,7 @@ def run_northstar(sim, s, rps, phase_name, *, chunk, kill_frac, left, emit,
                                "kill_frac": kill_frac,
                                "ticks_done": ticks_done,
                                "saved_at": time.time()}, f)
+                last_ckpt = time.monotonic()
             except OSError:
                 pass  # checkpointing must never fail the attempt
     wall = time.monotonic() - t0_ns

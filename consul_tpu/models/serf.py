@@ -423,7 +423,110 @@ def _lookup_any(cfg: SimConfig, s: SerfState, key_, origin):
     return jnp.where(event_is_query(key_), seen_q, seen_ev)
 
 
+def _query_response_tally(cfg: SimConfig, topo, s: SerfState, active,
+                          worig, wkey, isq, grows, k_resp) -> SerfState:
+    """Query responses: the deliverer answers the origin directly (one
+    response per node per query — exactly-once via the dedup buffer;
+    serf/query.go respondTo). Direct packet: origin must be up, the
+    packet must survive loss, and the query must still be open.
+    With ``query_relay_factor`` > 0, each responder also relays
+    duplicate copies through that many random members
+    (serf.go relayResponse :244, QueryParam.RelayFactor): a copy
+    arrives if its relay is up and BOTH legs survive loss, so the
+    response lands unless the direct packet and every relayed copy
+    drop. The tally counts each responder once (duplicates are deduped
+    by the origin in the reference; q_resps is that deduped count).
+
+    This block is the serf plane's only row-addressed all-to-all (two
+    gathers by the delivered entry's origin + two scatter-add tallies
+    — the TPU-costly ops). Single-chip it is gated behind ``lax.cond``
+    on "any query open anywhere": an event-only epidemic (the common
+    workload) pays nothing for the query machinery. Under sharding the
+    block stays unconditional — a collective inside data-dependent
+    control flow is not safely partitionable, and the collective
+    budget census pins the unconditional counts."""
+    n, k_deg = cfg.n, cfg.degree
+
+    def tally(s):
+        resp_drop = coll.uniform_rows(k_resp, n) < cfg.packet_loss
+        arrived = ~resp_drop
+        rf = cfg.serf.query_relay_factor
+        if rf > 0 and cfg.packet_loss > 0.0:
+            k_relay = jax.random.fold_in(k_resp, 1)
+            k_rl1, k_rl2, k_rcol = jax.random.split(k_relay, 3)
+            loss1 = coll.uniform_rows(k_rl1, n, (rf,)) < cfg.packet_loss
+            loss2 = coll.uniform_rows(k_rl2, n, (rf,)) < cfg.packet_loss
+            rcols = jax.random.randint(k_rcol, (rf,), 0, k_deg)
+            relay_up = jnp.stack(
+                [coll.roll(active, -topo.off[rcols[i]]) for i in range(rf)],
+                axis=1,
+            )
+            arrived = arrived | jnp.any(relay_up & ~loss1 & ~loss2, axis=1)
+        # The origin is an arbitrary global row: its liveness and
+        # open-query keys come from the globally-visible copies, and
+        # the tally is a row-addressed all-to-all delivery (under
+        # sharding: all_gather + reduce-scatter). The response lands
+        # in the [Q] slot whose open key matches the query being
+        # answered — concurrent queries from one origin tally
+        # independently (serf/query.go per-query QueryResponse state).
+        q_open_g = coll.all_rows(s.q_open_key)             # [N, Q]
+        up_g = coll.all_rows(s.swim.alive_truth & ~s.swim.left)
+        slot_hit = q_open_g[worig] == wkey[:, None]        # [N, Q]
+        landed = (
+            isq
+            & arrived
+            & up_g[worig]
+            & (worig != grows)  # origin's own delivery happened at submit
+            # External (bridge) seats never ack/answer on-device: their
+            # REAL agent does, over the wire, and the bridge tallies
+            # that one — counting the seat's row too would double-count
+            # every attached agent (wire/bridge.py _stage_qtally).
+            & ~s.swim.external
+        )
+        # Ack vs response (serf/query.go acks/responses channels):
+        # every delivering member acks; only registered responders
+        # answer. Two [N, Q] tallies, two reduce-scatters under
+        # sharding (the collective budget test pins this count and the
+        # Q-wide payload).
+        landed_slot = landed[:, None] & slot_hit
+        resp_slot = landed_slot & s.q_responder[:, None]
+        return s._replace(
+            q_resps=s.q_resps + coll.sum_scatter_rows(
+                worig, jnp.where(resp_slot, 1, 0).astype(s.q_resps.dtype),
+                n),
+            q_acks=s.q_acks + coll.sum_scatter_rows(
+                worig, jnp.where(landed_slot, 1, 0).astype(s.q_acks.dtype),
+                n),
+        )
+
+    if coll.sharded():
+        return tally(s)
+    return jax.lax.cond(jnp.any(s.q_open_key > 0), tally, lambda s: s, s)
+
+
 def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
+    """Single-chip, an IDLE event plane costs zero: with no queued
+    event anywhere and no open query, every mask in the body is false
+    and the state passes through — so the whole phase rides one
+    ``lax.cond`` on "any traffic at all". A quiet serf cluster then
+    ticks at SWIM speed (the reference's equivalent: no packets, no
+    work), and the full-serf northstar pays for the event plane only
+    while the epidemic is actually in flight. Under sharding the body
+    runs unconditionally: its collectives cannot sit inside
+    data-dependent control flow, and the budget census pins them."""
+    if coll.sharded():
+        return _event_phase_body(cfg, topo, s, active, key)
+    busy = jnp.any(s.ev_key > 0) | jnp.any(s.q_open_key > 0)
+    return jax.lax.cond(
+        busy,
+        lambda st: _event_phase_body(cfg, topo, st, active, key),
+        lambda st: st,
+        s,
+    )
+
+
+def _event_phase_body(cfg: SimConfig, topo, s: SerfState, active,
+                      key) -> SerfState:
     """Receive → queue → deliver pipeline for user events and queries.
 
     Receiving and delivering are decoupled, as in the reference (every
@@ -480,64 +583,8 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
         query_clock=lamport.witness(s.query_clock, lt, isq),
     )
 
-    # Query responses: the deliverer answers the origin directly (one
-    # response per node per query — exactly-once via the dedup buffer;
-    # serf/query.go respondTo). Direct packet: origin must be up, the
-    # packet must survive loss, and the query must still be open.
-    # With ``query_relay_factor`` > 0, each responder also relays
-    # duplicate copies through that many random members
-    # (serf.go relayResponse :244, QueryParam.RelayFactor): a copy
-    # arrives if its relay is up and BOTH legs survive loss, so the
-    # response lands unless the direct packet and every relayed copy
-    # drop. The tally counts each responder once (duplicates are deduped
-    # by the origin in the reference; q_resps is that deduped count).
-    resp_drop = coll.uniform_rows(k_resp, n) < cfg.packet_loss
-    arrived = ~resp_drop
-    rf = cfg.serf.query_relay_factor
-    if rf > 0 and cfg.packet_loss > 0.0:
-        k_relay = jax.random.fold_in(k_resp, 1)
-        k_rl1, k_rl2, k_rcol = jax.random.split(k_relay, 3)
-        loss1 = coll.uniform_rows(k_rl1, n, (rf,)) < cfg.packet_loss
-        loss2 = coll.uniform_rows(k_rl2, n, (rf,)) < cfg.packet_loss
-        rcols = jax.random.randint(k_rcol, (rf,), 0, k_deg)
-        relay_up = jnp.stack(
-            [coll.roll(active, -topo.off[rcols[i]]) for i in range(rf)],
-            axis=1,
-        )
-        arrived = arrived | jnp.any(relay_up & ~loss1 & ~loss2, axis=1)
-    # The origin is an arbitrary global row: its liveness and open-query
-    # keys come from the globally-visible copies, and the tally is a
-    # row-addressed all-to-all delivery (the one non-roll exchange of
-    # the serf plane; under sharding: all_gather + reduce-scatter). The
-    # response lands in the [Q] slot whose open key matches the query
-    # being answered — concurrent queries from one origin tally
-    # independently (serf/query.go per-query QueryResponse state).
-    q_open_g = coll.all_rows(s.q_open_key)             # [N, Q]
-    up_g = coll.all_rows(s.swim.alive_truth & ~s.swim.left)
-    slot_hit = q_open_g[worig] == wkey[:, None]        # [N, Q]
-    landed = (
-        isq
-        & arrived
-        & up_g[worig]
-        & (worig != grows)  # origin's own delivery happened at submit
-        # External (bridge) seats never ack/answer on-device: their
-        # REAL agent does, over the wire, and the bridge tallies that
-        # one — counting the seat's row too would double-count every
-        # attached agent (wire/bridge.py _stage_qtally).
-        & ~s.swim.external
-    )
-    # Ack vs response (serf/query.go acks/responses channels): every
-    # delivering member acks; only registered responders answer. Two
-    # [N, Q] tallies, two reduce-scatters under sharding (the
-    # collective budget test pins this count and the Q-wide payload).
-    landed_slot = landed[:, None] & slot_hit
-    resp_slot = landed_slot & s.q_responder[:, None]
-    s = s._replace(
-        q_resps=s.q_resps + coll.sum_scatter_rows(
-            worig, jnp.where(resp_slot, 1, 0).astype(s.q_resps.dtype), n),
-        q_acks=s.q_acks + coll.sum_scatter_rows(
-            worig, jnp.where(landed_slot, 1, 0).astype(s.q_acks.dtype), n),
-    )
+    s = _query_response_tally(cfg, topo, s, active, worig, wkey, isq,
+                              grows, k_resp)
 
     # ---- 2. Gossip out: most-retransmittable queue entries, sent along
     # per-tick shared displacements (swim-plane divergence note).

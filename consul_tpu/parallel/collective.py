@@ -64,6 +64,13 @@ def current() -> Optional[NodeAxisCtx]:
     return _CTX.get()
 
 
+def sharded() -> bool:
+    """True when tracing inside a node-axis shard_map — for trace-time
+    choices between the collective and the single-chip formulation
+    (e.g. control flow that must not wrap collectives)."""
+    return _CTX.get() is not None
+
+
 @contextlib.contextmanager
 def node_axis(axis_name: str, n_shards: int, n_global: int):
     """Declare that per-node arrays inside this context are shard_map

@@ -31,7 +31,8 @@ class TestNorthstarCheckpoint:
         bench.run_northstar(
             sim, n, rps=1.0, phase_name="northstar", chunk=chunk,
             kill_frac=0.05, left=lambda: 91.0, emit=phases.append,
-            ckpt_every_ticks=chunk, ckpt_dir=ckpt_dir)
+            ckpt_every_ticks=chunk, ckpt_dir=ckpt_dir,
+            ckpt_min_interval_s=0.0)
         first = phases[-1]
         assert first["converged"] is False
         assert first["resumed_from_tick"] == 0
@@ -47,7 +48,8 @@ class TestNorthstarCheckpoint:
         bench.run_northstar(
             sim2, n, rps=100.0, phase_name="northstar", chunk=chunk,
             kill_frac=0.05, left=lambda: 200.0, emit=phases.append,
-            ckpt_every_ticks=chunk, ckpt_dir=ckpt_dir)
+            ckpt_every_ticks=chunk, ckpt_dir=ckpt_dir,
+            ckpt_min_interval_s=0.0)
         second = phases[-1]
         assert second["resumed_from_tick"] == first["ticks"]
         assert second["converged"] is True
@@ -76,7 +78,8 @@ class TestNorthstarCheckpoint:
         bench.run_northstar(
             sim, n, rps=100.0, phase_name="northstar", chunk=chunk,
             kill_frac=0.05, left=lambda: 200.0, emit=phases.append,
-            ckpt_every_ticks=chunk, ckpt_dir=ckpt_dir)
+            ckpt_every_ticks=chunk, ckpt_dir=ckpt_dir,
+            ckpt_min_interval_s=0.0)
         final = phases[-1]
         assert final["resumed_from_tick"] == 0
         assert any(p.get("phase") == "northstar_ckpt_error"
@@ -94,13 +97,52 @@ class TestNorthstarCheckpoint:
         bench.run_northstar(
             sim, n, rps=1.0, phase_name="northstar", chunk=chunk,
             kill_frac=0.05, left=lambda: 91.0, emit=phases.append,
-            ckpt_every_ticks=chunk, ckpt_dir=ckpt_dir)
+            ckpt_every_ticks=chunk, ckpt_dir=ckpt_dir,
+            ckpt_min_interval_s=0.0)
         assert phases[-1]["converged"] is False  # checkpoint on disk
         sim2 = _sim(n)
         bench.run_northstar(
             sim2, n, rps=100.0, phase_name="northstar", chunk=chunk,
             kill_frac=0.10, left=lambda: 200.0, emit=phases.append,
-            ckpt_every_ticks=chunk, ckpt_dir=ckpt_dir)
+            ckpt_every_ticks=chunk, ckpt_dir=ckpt_dir,
+            ckpt_min_interval_s=0.0)
         final = phases[-1]
         assert final["resumed_from_tick"] == 0
         assert final["kill_frac"] == 0.10 and final["converged"] is True
+
+
+class TestWallPacedCadence:
+    def test_interval_skips_midrun_saves_but_final_save_lands(self, tmp_path):
+        """The production default paces saves by WALL time: a run
+        shorter than the interval writes no mid-run checkpoints, but
+        an unconverged exit ALWAYS leaves one behind (the resume
+        guarantee)."""
+        n, chunk = 256, 32
+        ckpt_dir = str(tmp_path / "ck")
+        phases = []
+        saves = []
+        import consul_tpu.utils.checkpoint as ckpt_mod
+        real_save = ckpt_mod.save
+
+        def counting_save(path, state):
+            saves.append(path)
+            return real_save(path, state)
+
+        ckpt_mod.save = counting_save
+        try:
+            sim = _sim(n)
+            bench.run_northstar(
+                sim, n, rps=1.0, phase_name="northstar", chunk=chunk,
+                kill_frac=0.05, left=lambda: 91.0, emit=phases.append,
+                ckpt_every_ticks=chunk, ckpt_dir=ckpt_dir,
+                ckpt_min_interval_s=9999.0)
+        finally:
+            ckpt_mod.save = real_save
+        assert phases[-1]["converged"] is False
+        # Exactly ONE save: the final unconverged-exit one; the
+        # mid-run slices were all inside the pacing interval.
+        assert len(saves) == 1
+        ck = os.path.join(ckpt_dir, f"northstar_{n}.ckpt")
+        assert os.path.exists(ck) and os.path.exists(ck + ".meta.json")
+        with open(ck + ".meta.json") as f:
+            assert json.load(f)["ticks_done"] == phases[-1]["ticks"]
