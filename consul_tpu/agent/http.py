@@ -262,6 +262,46 @@ class HTTPApi:
                     checks = [("node", "", "write")]
             else:
                 checks = [("node", "", "read")]
+        elif fam == "connect":
+            # Intentions ride service ACLs (reference: intention writes
+            # need service:intentions write on the destination). By-id
+            # operations authorize against the STORED intention's
+            # destination — the request body names whatever the caller
+            # wants and must not pick the rule that protects it; an
+            # update changing the destination needs write on BOTH.
+            rest = parts[2:]
+            if rest[:1] in (["check"], ["match"]):
+                checks = [("service",
+                           q.get("destination", q.get("name", "")),
+                           "read")]
+            elif len(rest) == 1 and rest[0] not in ("check", "match"):
+                stored = ""
+                try:
+                    got = self.agent.rpc("Intention.Get",
+                                         intention_id=rest[0])
+                    if got["value"]:
+                        stored = got["value"][0]["destination"]
+                except Exception:  # noqa: BLE001 — route will 404/500
+                    pass
+                acc = "write" if write else "read"
+                checks = [("service", stored, acc)]
+                if method == "PUT":
+                    try:
+                        body_dst = json.loads(body or b"{}").get(
+                            "DestinationName", "")
+                    except ValueError:
+                        body_dst = ""
+                    if body_dst and body_dst != stored:
+                        checks.append(("service", body_dst, "write"))
+            elif write:
+                try:
+                    name = json.loads(body or b"{}").get(
+                        "DestinationName", "")
+                except ValueError:
+                    name = ""
+                checks = [("service", name, "write")]
+            else:
+                checks = [("service", "", "read")]
         elif fam == "config":
             checks = [("operator", "", "write" if write else "read")]
         elif fam == "operator":
@@ -289,23 +329,17 @@ class HTTPApi:
         token/policy API subset; legacy create/update/info and
         roles/auth-methods are out)."""
         if parts == ["acl", "bootstrap"] and method == "PUT":
-            try:
-                out = self.agent.rpc("ACL.Bootstrap")
-            except ValueError as e:
-                return 403, {"error": str(e)}, {}
             # The pre-propose check can race another bootstrap (or run
             # against a lagging replica): the FSM's verdict is the
             # truth — a False means the marker already existed at
             # apply time and THIS token was discarded. Answering 200
             # with it would hand out a credential that resolves as
             # anonymous.
-            res = self.wait_write(out["index"])
-            if not isinstance(res, dict) or not res.get("found"):
-                res = self.agent.rpc("Status.ApplyResult",
-                                     index=out["index"])
-            if not res.get("found"):
-                raise RuntimeError("bootstrap apply unconfirmed")
-            if res["result"] is False:
+            try:
+                out, verdict = self._apply_confirmed("ACL.Bootstrap")
+            except ValueError as e:
+                return 403, {"error": str(e)}, {}
+            if verdict is False:
                 return 403, {"error": "ACL system already "
                              "bootstrapped"}, {}
             return 200, _token_to_api(out["token"]), {}
@@ -384,6 +418,78 @@ class HTTPApi:
                 "X-Consul-Index": str(out["index"])}
         return 404, {"error": f"no such ACL endpoint"}, {}
 
+    def _intentions(self, method, rest, q, body, min_index, wait_s, rpc,
+                    dc):
+        """/v1/connect/intentions family (reference agent/
+        intentions_endpoint.go: list/create, match, check, CRUD by id).
+        A write confirms the FSM verdict — False is a replicated
+        duplicate (source, destination) pair, a 409 like the
+        reference's DuplicateFound error. Writes thread ?dc= through
+        the shared apply-confirm helper like every other write."""
+        def confirmed(**args):
+            return self._apply_confirmed("Intention.Apply", dc=dc, **args)
+
+        if not rest and method == "GET":
+            out = rpc("Intention.List", min_index=min_index, wait_s=wait_s)
+            return 200, [_ixn_to_api(x) for x in out["value"]], {
+                "X-Consul-Index": str(out["index"])}
+        if not rest and method == "POST":
+            out, verdict = confirmed(op="create",
+                                     intention=_ixn_from_api(
+                                         json.loads(body)))
+            if verdict is False:
+                return 409, {"error": "duplicate intention found"}, {}
+            return 200, {"ID": out["id"]}, {}
+        if rest == ["match"]:
+            if method != "GET":
+                return 405, {"error": "method not allowed"}, {}
+            by = q.get("by", "")
+            out = rpc("Intention.Match", by=by, name=q.get("name", ""),
+                      min_index=min_index, wait_s=wait_s)
+            return 200, {q.get("name", ""):
+                         [_ixn_to_api(x) for x in out["value"]]}, {
+                "X-Consul-Index": str(out["index"])}
+        if rest == ["check"]:
+            if method != "GET":
+                return 405, {"error": "method not allowed"}, {}
+            if not q.get("source") or not q.get("destination"):
+                # A confident wrong answer to a typo'd param is worse
+                # than an error (the reference 400s too).
+                return 400, {"error":
+                             "?source= and ?destination= required"}, {}
+            out = rpc("Intention.Check", source=q["source"],
+                      destination=q["destination"],
+                      default_allow=(not self.acl_enabled
+                                     or self.acl_default_allow))
+            return 200, {"Allowed": out["allowed"]}, {}
+        if len(rest) == 1:
+            iid = rest[0]
+            if method == "GET":
+                out = rpc("Intention.Get", intention_id=iid,
+                          min_index=min_index, wait_s=wait_s)
+                if not out["value"]:
+                    return 404, {"error": "intention not found"}, {
+                        "X-Consul-Index": str(out["index"])}
+                return 200, _ixn_to_api(out["value"][0]), {
+                    "X-Consul-Index": str(out["index"])}
+            if method == "PUT":
+                x = _ixn_from_api(json.loads(body))
+                x["id"] = iid
+                try:
+                    _, verdict = confirmed(op="update", intention=x)
+                except KeyError:
+                    return 404, {"error": "intention not found"}, {}
+                if verdict is False:
+                    return 409, {"error": "duplicate intention found"}, {}
+                return 200, True, {}
+            if method == "DELETE":
+                try:
+                    confirmed(op="delete", intention_id=iid)
+                except KeyError:
+                    return 404, {"error": "intention not found"}, {}
+                return 200, True, {}
+        return 404, {"error": "no such intentions endpoint"}, {}
+
     def _query(self, method, parts, q, body, min_index, wait_s, rpc, dc):
         """/v1/query family (reference agent/prepared_query_endpoint.go:
         General=list/create, Specific=get/update/delete/execute/explain).
@@ -391,20 +497,8 @@ class HTTPApi:
         replicated name collision, answered 400 like the reference's
         endpoint error, never a silent success."""
         def confirmed_apply(**args):
-            out = self.agent.rpc("PreparedQuery.Apply",
-                                 **(dict(args, dc=dc) if dc else args))
-            idx = out["index"] if isinstance(out, dict) else out
-            if dc:
-                verdict = self._confirm_dc_apply(idx, dc)
-            else:
-                res = self.wait_write(idx)
-                if not isinstance(res, dict) or not res.get("found"):
-                    res = self.agent.rpc("Status.ApplyResult", index=idx)
-                if not res.get("found"):
-                    raise RuntimeError(
-                        f"prepared query apply at index {idx} unconfirmed")
-                verdict = res["result"]
-            return out, verdict
+            return self._apply_confirmed("PreparedQuery.Apply", dc=dc,
+                                         **args)
 
         if parts == ["query"] and method == "POST":
             out, verdict = confirmed_apply(
@@ -479,6 +573,25 @@ class HTTPApi:
                              "found"}, {}
             return 200, True, {}
         return 405, {"error": "method not allowed"}, {}
+
+    def _apply_confirmed(self, method: str, dc: Optional[str] = None,
+                         **args) -> tuple[Any, Any]:
+        """Propose through ``method`` and confirm the FSM's verdict —
+        the ONE apply-and-confirm helper (PreparedQuery/Intention/ACL
+        writes whose Apply returns ``{'id','index'}`` or a bare index).
+        Returns (apply output, FSM verdict). dc-aware: a forwarded
+        write confirms against the REMOTE raft's ApplyResult."""
+        out = self.agent.rpc(method, **(dict(args, dc=dc) if dc else args))
+        idx = out["index"] if isinstance(out, dict) else out
+        if dc:
+            return out, self._confirm_dc_apply(idx, dc)
+        res = self.wait_write(idx)
+        if not isinstance(res, dict) or not res.get("found"):
+            res = self.agent.rpc("Status.ApplyResult", index=idx)
+        if not res.get("found"):
+            raise RuntimeError(
+                f"{method} apply at index {idx} unconfirmed")
+        return out, res["result"]
 
     def _local_service_health(self, service_ids: list[str]) -> str:
         """Worst status over the named local services' checks plus the
@@ -630,6 +743,12 @@ class HTTPApi:
         if parts[0] == "acl":
             return self._acl_routes(method, parts, q, body, min_index,
                                     wait_s, rpc)
+
+        # ---- intentions (reference agent/intentions_endpoint.go;
+        # routes http_register.go /v1/connect/intentions*) --------------
+        if parts[0] == "connect" and parts[1:2] == ["intentions"]:
+            return self._intentions(method, parts[2:], q, body,
+                                    min_index, wait_s, rpc, dc)
 
         # ---- prepared queries (reference agent/prepared_query_
         # endpoint.go; routes http_register.go /v1/query) ----------------
@@ -1241,6 +1360,26 @@ def _lower_keys(d: Optional[dict]) -> Optional[dict]:
     return {{"ID": "id", "Service": "service", "Port": "port",
              "Tags": "tags", "Meta": "meta"}.get(k, k.lower()): v
             for k, v in d.items()}
+
+
+def _ixn_from_api(d: dict) -> dict:
+    out = {}
+    for api_k, k in (("ID", "id"), ("SourceName", "source"),
+                     ("DestinationName", "destination"),
+                     ("Action", "action"),
+                     ("Description", "description"), ("Meta", "meta")):
+        if api_k in d:
+            out[k] = d[api_k]
+    return out
+
+
+def _ixn_to_api(x: dict) -> dict:
+    return {"ID": x.get("id", ""), "SourceName": x.get("source", ""),
+            "DestinationName": x.get("destination", ""),
+            "Action": x.get("action", ""),
+            "Precedence": x.get("precedence", 0),
+            "Description": x.get("description", ""),
+            "Meta": x.get("meta", {})}
 
 
 def _kv_key(path: str, parts: list) -> str:

@@ -57,6 +57,7 @@ class Client:
         self.internal = Internal(self)
         self.query = PreparedQuery(self)
         self.acl = ACL(self)
+        self.connect = Connect(self)
 
     def _call(self, method: str, path: str, params: Optional[dict] = None,
               body: Optional[bytes] = None) -> tuple[Any, QueryMeta, int]:
@@ -546,6 +547,49 @@ class PreparedQuery:
     def explain(self, name: str) -> dict:
         out, _, _ = self.c._call("GET", f"/v1/query/{name}/explain")
         return out
+
+
+class Connect:
+    """Intention CRUD + match/check (reference api/connect_intention.go
+    over /v1/connect/intentions)."""
+
+    def __init__(self, c: Client):
+        self.c = c
+
+    def intention_create(self, source: str, destination: str,
+                         action: str, description: str = "") -> str:
+        out, _, _ = self.c._call(
+            "POST", "/v1/connect/intentions", None, json.dumps({
+                "SourceName": source, "DestinationName": destination,
+                "Action": action, "Description": description,
+            }).encode())
+        return out["ID"]
+
+    def intention_get(self, intention_id: str):
+        out, _, _ = self.c._call(
+            "GET", f"/v1/connect/intentions/{intention_id}")
+        return out
+
+    def intention_list(self):
+        out, meta, _ = self.c._call("GET", "/v1/connect/intentions")
+        return out, meta
+
+    def intention_delete(self, intention_id: str) -> bool:
+        out, _, _ = self.c._call(
+            "DELETE", f"/v1/connect/intentions/{intention_id}")
+        return bool(out)
+
+    def intention_match(self, name: str,
+                        by: str = "destination") -> list[dict]:
+        out, _, _ = self.c._call("GET", "/v1/connect/intentions/match",
+                                 {"by": by, "name": name})
+        return out.get(name, [])
+
+    def intention_check(self, source: str, destination: str) -> bool:
+        out, _, _ = self.c._call("GET", "/v1/connect/intentions/check",
+                                 {"source": source,
+                                  "destination": destination})
+        return bool(out["Allowed"])
 
 
 class ACL:

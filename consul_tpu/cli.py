@@ -15,6 +15,7 @@ framework implements:
   join             route a client agent onto servers   (command/join)
   leave            graceful leave + shutdown           (command/leave)
   acl              bootstrap / policy / token CRUD     (command/acl)
+  intention        create|get|list|delete|match|check  (command/intention)
   event fire|list / watch / force-leave / debug
   operator raft list-peers|remove-peer                 (command/operator)
   operator autopilot get-config|set-config|health
@@ -303,6 +304,47 @@ def cmd_acl(client: Client, args) -> int:
                 print(f"{t['AccessorID']}  [{pols}] {t['Description']}")
             return 0
     raise AssertionError(args.acl_cmd)
+
+
+def cmd_intention(client: Client, args) -> int:
+    """Intention management (reference command/intention: create,
+    get, delete, match, check)."""
+    if args.intention_cmd == "create":
+        action = "deny" if args.deny else "allow"
+        iid = client.connect.intention_create(args.source, args.destination,
+                                              action)
+        print(f"Created: {args.source} => {args.destination} "
+              f"({action}) [{iid}]")
+        return 0
+    if args.intention_cmd == "list":
+        rows, _ = client.connect.intention_list()
+        for x in rows:
+            print(f"{x['ID']}  {x['SourceName']} => "
+                  f"{x['DestinationName']} ({x['Action']})")
+        return 0
+    if args.intention_cmd == "get":
+        x = client.connect.intention_get(args.id)
+        if x is None:
+            print(f"error: intention {args.id!r} not found",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps(x, indent=2))
+        return 0
+    if args.intention_cmd == "delete":
+        ok = client.connect.intention_delete(args.id)
+        print("Deleted" if ok else "error")
+        return 0 if ok else 1
+    if args.intention_cmd == "match":
+        for x in client.connect.intention_match(args.name, args.by):
+            print(f"{x['SourceName']} => {x['DestinationName']} "
+                  f"({x['Action']})")
+        return 0
+    if args.intention_cmd == "check":
+        allowed = client.connect.intention_check(args.source,
+                                                 args.destination)
+        print("Allowed" if allowed else "Denied")
+        return 0 if allowed else 2
+    raise AssertionError(args.intention_cmd)
 
 
 def cmd_leave(client: Client, args) -> int:
@@ -655,6 +697,24 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("leave", help="gracefully leave and shut down the agent")
 
+    ixn_p = sub.add_parser("intention", help="connect intentions")
+    ixn_sub = ixn_p.add_subparsers(dest="intention_cmd", required=True)
+    ic = ixn_sub.add_parser("create")
+    ic.add_argument("source")
+    ic.add_argument("destination")
+    ic.add_argument("-deny", action="store_true")
+    ixn_sub.add_parser("list")
+    for verb in ("get", "delete"):
+        vp = ixn_sub.add_parser(verb)
+        vp.add_argument("id")
+    im = ixn_sub.add_parser("match")
+    im.add_argument("name")
+    im.add_argument("-by", choices=["source", "destination"],
+                    default="destination")
+    ich = ixn_sub.add_parser("check")
+    ich.add_argument("source")
+    ich.add_argument("destination")
+
     acl_p = sub.add_parser("acl", help="ACL bootstrap / policies / tokens")
     acl_sub = acl_p.add_subparsers(dest="acl_cmd", required=True)
     acl_sub.add_parser("bootstrap")
@@ -754,6 +814,7 @@ COMMANDS = {
     "sessions": cmd_sessions, "snapshot": cmd_snapshot, "debug": cmd_debug,
     "event": cmd_event, "watch": cmd_watch, "join": cmd_join,
     "force-leave": cmd_force_leave, "leave": cmd_leave, "acl": cmd_acl,
+    "intention": cmd_intention,
     "operator": cmd_operator, "maint": cmd_maint, "keyring": cmd_keyring,
     "monitor": cmd_monitor, "validate": cmd_validate, "lock": cmd_lock,
     "exec": cmd_exec, "reload": cmd_reload, "config": cmd_config,

@@ -572,6 +572,93 @@ class Server:
                 "accessor_id": t["accessor_id"]}
 
     # ------------------------------------------------------------------
+    # Intention endpoint (reference agent/consul/intention_endpoint.go:
+    # Apply/Get/List/Match/Check; structs/intention.go precedence)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _intention_precedence(source: str, destination: str) -> int:
+        """More-specific-first ordering (structs/intention.go
+        UpdatePrecedence, names-only form): exact destination beats
+        wildcard, then exact source beats wildcard."""
+        base = 9 if destination != "*" else 3
+        return base if source != "*" else base - 1
+
+    def _intention_apply(self, op: str, intention: Optional[dict] = None,
+                         intention_id: Optional[str] = None) -> Any:
+        if op == "delete":
+            if self.store.intention_get(intention_id) is None:
+                raise KeyError(f"unknown intention {intention_id!r}")
+            return self._raft_apply({"type": fsm_mod.INTENTION,
+                                     "op": "delete", "id": intention_id})
+        x = dict(intention or {})
+        for field in ("source", "destination"):
+            v = x.get(field, "")
+            if not v:
+                raise ValueError(f"intention {field} must be set")
+            if "*" in v and v != "*":
+                # Partial wildcards are invalid (Validate:177-196).
+                raise ValueError(
+                    f"intention {field}: '*' cannot be used with "
+                    "partial values")
+        if x.get("action") not in ("allow", "deny"):
+            raise ValueError("intention action must be allow or deny")
+        if op == "create":
+            x["id"] = str(uuid.uuid4())
+        elif not x.get("id") or self.store.intention_get(x["id"]) is None:
+            raise KeyError(f"unknown intention {x.get('id')!r}")
+        x.setdefault("description", "")
+        x.setdefault("meta", {})
+        # Precedence is read-only, recomputed on every write
+        # (UpdatePrecedence runs on Apply).
+        x["precedence"] = self._intention_precedence(
+            x["source"], x["destination"])
+        idx = self._raft_apply({"type": fsm_mod.INTENTION, "op": op,
+                                "intention": x})
+        return {"id": x["id"], "index": idx}
+
+    def _intention_get(self, intention_id: str, min_index: int = 0,
+                       wait_s: float = 10.0) -> dict:
+        def fn():
+            x = self.store.intention_get(intention_id)
+            return [] if x is None else [x]
+        return self._blocking(("intentions",), min_index, wait_s, fn)
+
+    def _intention_list(self, min_index: int = 0,
+                        wait_s: float = 10.0) -> dict:
+        return self._blocking(("intentions",), min_index, wait_s,
+                              self.store.intention_list)
+
+    def _intention_match(self, by: str, name: str, min_index: int = 0,
+                         wait_s: float = 10.0) -> dict:
+        """Intentions whose ``by`` side (source|destination) covers
+        ``name`` — exact or wildcard — highest precedence first
+        (intention_endpoint.go Match / state IntentionMatch)."""
+        if by not in ("source", "destination"):
+            raise ValueError(f"match by must be source|destination, "
+                             f"got {by!r}")
+
+        def fn():
+            return [x for x in self.store.intention_list()
+                    if x[by] in (name, "*")]
+        return self._blocking(("intentions",), min_index, wait_s, fn)
+
+    def _intention_check(self, source: str, destination: str,
+                         default_allow: bool = True) -> dict:
+        """Would a connection source → destination be authorized?
+        (intention_endpoint.go Check): the highest-precedence
+        destination match whose source also covers the caller decides;
+        no match falls through to ``default_allow`` (the reference
+        derives it from the ACL default policy — the HTTP tier passes
+        its configured default in)."""
+        matches = [x for x in self.store.intention_list()
+                   if x["destination"] in (destination, "*")]
+        for x in matches:  # already precedence-sorted
+            if x["source"] in (source, "*"):
+                return {"allowed": x["action"] == "allow",
+                        "matched": x["id"]}
+        return {"allowed": bool(default_allow), "matched": None}
+
+    # ------------------------------------------------------------------
     # PreparedQuery endpoint (reference agent/consul/
     # prepared_query_endpoint.go: Apply/Get/List/Explain/Execute/
     # ExecuteRemote over the raft-replicated prepared_queries table)

@@ -24,6 +24,7 @@ CONFIG_ENTRY = "config-entry"
 AUTOPILOT = "autopilot"
 PREPARED_QUERY = "prepared-query"
 ACL = "acl"
+INTENTION = "intention"
 TXN = "txn"
 
 # Tables each op type can write (for scoped TXN undo logs). KV ops can
@@ -36,6 +37,7 @@ _TXN_TABLES: dict[str, set] = {
     CONFIG_ENTRY: {"config_entries"},
     PREPARED_QUERY: {"prepared_queries"},
     ACL: {"acl_tokens", "acl_policies", "acl_meta"},
+    INTENTION: {"intentions"},
     REGISTER: {"nodes", "services", "checks"},
     DEREGISTER: {"nodes", "services", "checks", "coordinates",
                  "sessions", "kv", "prepared_queries"},
@@ -168,6 +170,18 @@ class FSM:
                 self.store.acl_token_set(command["token"], index=index)
                 return True
             raise ValueError(f"unknown ACL op {op!r}")
+        if mtype == INTENTION:
+            # Reference fsm applyIntentionOperation: upsert/delete by
+            # id; a duplicate (source, destination) pair on a
+            # replicated create is an apply-time False verdict.
+            if command["op"] == "delete":
+                self.store.intention_delete(command["id"], index=index)
+                return True
+            try:
+                self.store.intention_set(command["intention"], index=index)
+            except ValueError:
+                return False
+            return command["intention"]["id"]
         if mtype == AUTOPILOT:
             # Operator autopilot configuration (reference
             # fsm applyAutopilotUpdate, operator_autopilot_endpoint.go):

@@ -70,6 +70,7 @@ class StateStore:
         "acl_tokens",     # accessor id -> token (carries secret id)
         "acl_policies",   # policy name -> {id, rules, description}
         "acl_meta",       # "bootstrap" -> one-shot marker
+        "intentions",     # intention id -> {source, destination, action}
     )
 
     def __init__(self):
@@ -514,6 +515,42 @@ class StateStore:
     def acl_mark_bootstrapped(self, index: Optional[int] = None) -> int:
         return self._commit("acl_meta", "bootstrap", {"done": True},
                             index=index)
+
+    # ------------------------------------------------------------------
+    # Intentions (reference state/intention.go)
+    # ------------------------------------------------------------------
+    def intention_set(self, ixn: dict, index: Optional[int] = None) -> int:
+        """Upsert by id; the (source, destination) pair is unique
+        (reference state/intention.go IntentionSet: the source/
+        destination index) — enforced here so replicated creates
+        cannot double up."""
+        with self._lock:
+            for iid, e in self.tables["intentions"].rows.items():
+                if iid != ixn["id"] and \
+                        e.value["source"] == ixn["source"] and \
+                        e.value["destination"] == ixn["destination"]:
+                    raise ValueError(
+                        f"duplicate intention "
+                        f"{ixn['source']!r} -> {ixn['destination']!r}")
+            return self._commit("intentions", ixn["id"], ixn, index=index)
+
+    def intention_delete(self, intention_id: str,
+                         index: Optional[int] = None) -> int:
+        return self._commit("intentions", intention_id, None, delete=True,
+                            index=index)
+
+    def intention_get(self, intention_id: str) -> Optional[dict]:
+        with self._lock:
+            e = self.tables["intentions"].rows.get(intention_id)
+            return None if e is None else e.value
+
+    def intention_list(self) -> list[dict]:
+        """All intentions, highest precedence first (reference
+        structs.Intentions sort order)."""
+        with self._lock:
+            rows = [e.value for e in self.tables["intentions"].rows.values()]
+            return sorted(rows, key=lambda x: (-x["precedence"],
+                                               x["destination"], x["source"]))
 
     def _invalidate_queries_for_session(self, session_id: str, index: int):
         """A query tied to a session dies with it (reference

@@ -1,0 +1,262 @@
+"""Intentions (reference agent/consul/intention_endpoint.go +
+structs/intention.go): raft-replicated source→destination allow/deny
+rules with wildcard support, precedence ordering, Match and Check."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from consul_tpu.agent.agent import Agent
+from consul_tpu.agent.http import HTTPApi
+from consul_tpu.api import Client
+from consul_tpu.server.endpoints import Server, ServerCluster
+
+
+class TestPrecedence:
+    def test_ordering(self):
+        p = Server._intention_precedence
+        assert p("web", "db") > p("*", "db")
+        assert p("*", "db") > p("web", "*")
+        assert p("web", "*") > p("*", "*")
+
+
+@pytest.fixture
+def cluster():
+    c = ServerCluster(3, seed=23)
+    c.wait_converged()
+    return c
+
+
+class TestEndpoint:
+    def test_crud_replicates(self, cluster):
+        leader = cluster.leader_server()
+        out = cluster.write(leader, "Intention.Apply", op="create",
+                            intention={"source": "web",
+                                       "destination": "db",
+                                       "action": "allow"})
+        iid = out["id"]
+        for s in cluster.servers:
+            assert s.store.intention_get(iid)["source"] == "web"
+        got = leader.rpc("Intention.Get", intention_id=iid)
+        assert got["value"][0]["precedence"] == 9
+        cluster.write(leader, "Intention.Apply", op="update",
+                      intention={"id": iid, "source": "web",
+                                 "destination": "db", "action": "deny"})
+        assert leader.store.intention_get(iid)["action"] == "deny"
+        cluster.write(leader, "Intention.Apply", op="delete",
+                      intention_id=iid)
+        assert leader.store.intention_get(iid) is None
+
+    def test_validation(self, cluster):
+        leader = cluster.leader_server()
+        with pytest.raises(ValueError, match="source must be set"):
+            leader.rpc("Intention.Apply", op="create",
+                       intention={"destination": "db", "action": "allow"})
+        with pytest.raises(ValueError, match="partial"):
+            leader.rpc("Intention.Apply", op="create",
+                       intention={"source": "web*", "destination": "db",
+                                  "action": "allow"})
+        with pytest.raises(ValueError, match="allow or deny"):
+            leader.rpc("Intention.Apply", op="create",
+                       intention={"source": "a", "destination": "b",
+                                  "action": "maybe"})
+
+    def test_duplicate_pair_is_verdict(self, cluster):
+        leader = cluster.leader_server()
+        cluster.write(leader, "Intention.Apply", op="create",
+                      intention={"source": "a", "destination": "b",
+                                 "action": "allow"})
+        out = cluster.write(leader, "Intention.Apply", op="create",
+                            intention={"source": "a", "destination": "b",
+                                       "action": "deny"})
+        res = leader.rpc("Status.ApplyResult", index=out["index"])
+        assert res["found"] and res["result"] is False
+        assert len([x for x in leader.store.intention_list()
+                    if x["source"] == "a"]) == 1
+
+    def test_match_and_check_precedence(self, cluster):
+        leader = cluster.leader_server()
+        for src, dst, act in (("*", "db", "deny"),
+                              ("web", "db", "allow"),
+                              ("*", "*", "allow")):
+            cluster.write(leader, "Intention.Apply", op="create",
+                          intention={"source": src, "destination": dst,
+                                     "action": act})
+        m = leader.rpc("Intention.Match", by="destination", name="db")
+        # Highest precedence first: exact/exact, then */db, then */*.
+        assert [(x["source"], x["destination"]) for x in m["value"]] == \
+            [("web", "db"), ("*", "db"), ("*", "*")]
+        # web→db: the exact rule wins over the */db deny.
+        assert leader.rpc("Intention.Check", source="web",
+                          destination="db")["allowed"] is True
+        # api→db: */db deny wins over */* allow.
+        assert leader.rpc("Intention.Check", source="api",
+                          destination="db")["allowed"] is False
+        # api→cache: only */* matches -> allow.
+        assert leader.rpc("Intention.Check", source="api",
+                          destination="cache")["allowed"] is True
+        # No match at all -> default.
+        solo = ServerCluster(1, seed=29)
+        solo.wait_converged()
+        assert solo.leader_server().rpc(
+            "Intention.Check", source="x", destination="y")["allowed"] \
+            is True
+        assert solo.leader_server().rpc(
+            "Intention.Check", source="x", destination="y",
+            default_allow=False)["allowed"] is False
+
+
+@pytest.fixture(scope="module")
+def stack():
+    cluster = ServerCluster(3, seed=31)
+    cluster.wait_converged()
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    def pump():
+        while not stop.is_set():
+            with lock:
+                cluster.step()
+            time.sleep(0.002)
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    def rpc(method, **args):
+        with lock:
+            server = cluster.registry[cluster.raft.wait_converged().id]
+        return server.rpc(method, **args)
+
+    def wait_write(idx):
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            with lock:
+                led = cluster.raft.leader()
+                if led is not None and led.last_applied >= idx:
+                    return
+            time.sleep(0.002)
+
+    from consul_tpu.agent.http import serve
+    agent = Agent("ixn-agent", "10.12.0.1", rpc, cluster_size=3)
+    api = HTTPApi(agent, wait_write=wait_write)
+    httpd, port = serve(api)
+    yield Client("127.0.0.1", port), port
+    stop.set()
+    httpd.shutdown()
+
+
+class TestHTTP:
+    def test_roundtrip_over_the_wire(self, stack):
+        client, port = stack
+        iid = client.connect.intention_create("web", "db", "allow")
+        x = client.connect.intention_get(iid)
+        assert x["SourceName"] == "web" and x["Precedence"] == 9
+        rows, _ = client.connect.intention_list()
+        assert any(r["ID"] == iid for r in rows)
+        assert client.connect.intention_match("db") and \
+            client.connect.intention_check("web", "db") is True
+        # Duplicate pair -> 409.
+        from consul_tpu.api import APIError
+        with pytest.raises(APIError, match="duplicate"):
+            client.connect.intention_create("web", "db", "deny")
+        assert client.connect.intention_delete(iid)
+        assert client.connect.intention_get(iid) is None
+
+    def test_cli_flow(self, stack):
+        import subprocess
+        import sys
+        _, port = stack
+
+        def cli(*args):
+            return subprocess.run(
+                [sys.executable, "-m", "consul_tpu.cli", "--http-addr",
+                 f"127.0.0.1:{port}", "intention", *args],
+                capture_output=True, text=True, timeout=30)
+
+        out = cli("create", "cli-src", "cli-dst", "-deny")
+        assert out.returncode == 0, out.stderr
+        assert cli("check", "cli-src", "cli-dst").returncode == 2  # denied
+        out = cli("list")
+        assert "cli-src => cli-dst (deny)" in out.stdout
+        iid = next(ln.split()[0] for ln in out.stdout.splitlines()
+                   if "cli-src" in ln)
+        assert cli("delete", iid).returncode == 0
+        assert cli("check", "cli-src", "cli-dst").returncode == 0
+
+class TestHTTPHardening:
+    def test_check_requires_params_and_get_only(self, stack):
+        client, _ = stack
+        from consul_tpu.api import APIError
+        with pytest.raises(APIError, match="required"):
+            client._call("GET", "/v1/connect/intentions/check",
+                         {"source": "a"})
+        with pytest.raises(APIError, match="method not allowed"):
+            client._call("DELETE", "/v1/connect/intentions/match",
+                         {"by": "destination", "name": "db"})
+        with pytest.raises(APIError, match="method not allowed"):
+            client._call("PUT", "/v1/connect/intentions/check",
+                         {"source": "a", "destination": "b"})
+
+    def test_acl_gate_uses_stored_destination(self):
+        """DELETE/PUT by id authorize against the STORED intention's
+        destination, not the caller's body (reference: intention
+        management needs service:intentions write on the
+        destination)."""
+        cluster = ServerCluster(1, seed=37)
+        cluster.wait_converged()
+        leader = cluster.leader_server()
+
+        def rpc(method, **args):
+            cluster.step(5)
+            out = leader.rpc(method, **args)
+            cluster.step(5)
+            return out
+
+        agent = Agent("gate-agent", "10.13.0.1", rpc, cluster_size=1)
+        api = HTTPApi(agent, wait_write=lambda idx: cluster.step(20),
+                      acl={"enabled": True, "default_policy": "deny",
+                           "master_token": "mt"})
+
+        def call(method, path, body=b"", token="", q=None):
+            return api.handle(method, path, q or {}, body,
+                              headers={"X-Consul-Token": token})
+
+        st, _, _ = call("PUT", "/v1/acl/policy", json.dumps({
+            "Name": "svc-mine", "Rules": {
+                "service_prefix": {"": {"policy": "write"}},
+                "service": {"secret": {"policy": "deny"}},
+            }}).encode(), token="mt")
+        assert st == 200
+        st, tok, _ = call("PUT", "/v1/acl/token", json.dumps(
+            {"Policies": [{"Name": "svc-mine"}]}).encode(), token="mt")
+        limited = tok["SecretID"]
+        # Management creates an intention protecting "secret".
+        st, made, _ = call("POST", "/v1/connect/intentions", json.dumps({
+            "SourceName": "*", "DestinationName": "secret",
+            "Action": "deny"}).encode(), token="mt")
+        assert st == 200
+        iid = made["ID"]
+        # The limited token may NOT delete it (stored dest = secret),
+        # even though its body/prefix rules would pass an empty-name
+        # check.
+        st, _, _ = call("DELETE", f"/v1/connect/intentions/{iid}",
+                        token=limited)
+        assert st == 403
+        # Nor overwrite it by claiming a writable destination in the
+        # body (both stored and body destinations are checked).
+        st, _, _ = call("PUT", f"/v1/connect/intentions/{iid}",
+                        json.dumps({"SourceName": "*",
+                                    "DestinationName": "mine",
+                                    "Action": "allow"}).encode(),
+                        token=limited)
+        assert st == 403
+        # Intentions on non-denied services are manageable.
+        st, made2, _ = call("POST", "/v1/connect/intentions", json.dumps({
+            "SourceName": "web", "DestinationName": "mine",
+            "Action": "allow"}).encode(), token=limited)
+        assert st == 200
+        st, _, _ = call("DELETE",
+                        f"/v1/connect/intentions/{made2['ID']}",
+                        token=limited)
+        assert st == 200
