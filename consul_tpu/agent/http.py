@@ -198,9 +198,58 @@ class HTTPApi:
         elif fam == "txn":
             try:
                 for op in json.loads(body or b"[]"):
-                    kv = op.get("KV", {})
-                    acc = "read" if kv.get("Verb") == "get" else "write"
-                    checks.append(("key", kv.get("Key", ""), acc))
+                    if "KV" in op:
+                        kv = op["KV"]
+                        verb = kv.get("Verb", "")
+                        key = kv.get("Key", "")
+                        if verb == "delete-tree":
+                            # Subtree semantics, same as ?recurse on
+                            # /v1/kv: an exact-key grant must not
+                            # escalate to everything underneath.
+                            if not authz.allowed_prefix("key", key,
+                                                        "write"):
+                                return 403, {"error":
+                                             "Permission denied"}, {}
+                        else:
+                            acc = "read" if verb == "get" else "write"
+                            checks.append(("key", key, acc))
+                    elif "Node" in op:
+                        checks.append(("node", op["Node"].get(
+                            "Node", {}).get("Node", ""), "write"))
+                    elif "Service" in op:
+                        # The op keys on a service ID: authorization
+                        # covers the op's name AND, when that ID
+                        # already exists under a DIFFERENT stored name,
+                        # the stored name too — the body's name must
+                        # not pick the rule (an ID-keyed delete or
+                        # overwrite would otherwise bypass the victim
+                        # service's ACL). An ID-only op with a stored
+                        # match checks the stored name alone.
+                        sv = op["Service"]
+                        svc = sv.get("Service", {})
+                        name_in_op = svc.get("Service", "")
+                        sid = svc.get("ID") or name_in_op
+                        stored = None
+                        if sid and sv.get("Node"):
+                            try:
+                                rows = self.agent.rpc(
+                                    "Catalog.NodeServices",
+                                    node=sv["Node"])["value"]
+                                stored = next(
+                                    (r["service"] for r in rows
+                                     if r["id"] == sid), None)
+                            except Exception:  # noqa: BLE001
+                                stored = None
+                        if name_in_op:
+                            checks.append(("service", name_in_op,
+                                           "write"))
+                        if stored and stored != name_in_op:
+                            checks.append(("service", stored, "write"))
+                        if not name_in_op and not stored:
+                            checks.append(("service", "", "write"))
+                    elif "Check" in op:
+                        checks.append(("node", op["Check"].get(
+                            "Check", {}).get("Node", ""), "write"))
             except (ValueError, AttributeError):
                 checks = [("key", "", "write")]
         elif fam == "catalog":
@@ -854,18 +903,74 @@ class HTTPApi:
 
         # ---- txn ------------------------------------------------------
         if parts == ["txn"] and method == "PUT":
+            # All four reference op families (structs/txn.go TxnOp: KV,
+            # Node, Service, Check) — catalog verbs compile to the same
+            # REGISTER/DEREGISTER commands the FSM already applies
+            # atomically inside TXN batches.
             ops = []
             for op in json.loads(body):
-                kv = op["KV"]
-                ops.append({
-                    "type": "kv", "op": kv["Verb"], "key": kv["Key"],
-                    "value": base64.b64decode(kv.get("Value", "")),
-                    "cas_index": kv.get("Index"),
-                    "session": kv.get("Session"),
-                })
+                if "KV" in op:
+                    kv = op["KV"]
+                    ops.append({
+                        "type": "kv", "op": kv["Verb"], "key": kv["Key"],
+                        "value": base64.b64decode(kv.get("Value", "")),
+                        "cas_index": kv.get("Index"),
+                        "session": kv.get("Session"),
+                    })
+                elif "Node" in op:
+                    nd = op["Node"]
+                    node = nd["Node"]
+                    if nd["Verb"] == "set":
+                        ops.append({"type": "register",
+                                    "node": node["Node"],
+                                    "address": node.get("Address", ""),
+                                    "node_meta": node.get("Meta")})
+                    elif nd["Verb"] == "delete":
+                        ops.append({"type": "deregister",
+                                    "node": node["Node"]})
+                    else:
+                        raise ValueError(
+                            f"unsupported Node verb {nd['Verb']!r}")
+                elif "Service" in op:
+                    sv = op["Service"]
+                    svc = sv["Service"]
+                    if sv["Verb"] == "set":
+                        ops.append({"type": "register",
+                                    "node": sv["Node"],
+                                    "service": _lower_keys(svc)})
+                    elif sv["Verb"] == "delete":
+                        ops.append({"type": "deregister",
+                                    "node": sv["Node"],
+                                    "service_id": svc.get(
+                                        "ID", svc.get("Service"))})
+                    else:
+                        raise ValueError(
+                            f"unsupported Service verb {sv['Verb']!r}")
+                elif "Check" in op:
+                    ck = op["Check"]
+                    chk = ck["Check"]
+                    if ck["Verb"] == "set":
+                        ops.append({"type": "register",
+                                    "node": chk["Node"],
+                                    "check": _check_from_api(chk)})
+                    elif ck["Verb"] == "delete":
+                        ops.append({"type": "deregister",
+                                    "node": chk["Node"],
+                                    "check_id": chk.get("CheckID")})
+                    else:
+                        raise ValueError(
+                            f"unsupported Check verb {ck['Verb']!r}")
+                else:
+                    raise ValueError(
+                        "txn op needs one of KV/Node/Service/Check")
             _, result = rpc_write("Txn.Apply", ops=ops)
             if isinstance(result, dict) and result.get("ok"):
-                return 200, {"Results": result.get("results", [])}, {}
+                return 200, {"Results": [
+                    # get-op rows carry bytes: render as API KV rows.
+                    {"KV": _kv_to_api(r)} if isinstance(r, dict)
+                    and "value" in r else r
+                    for r in result.get("results", [])
+                ]}, {}
             # Rolled-back transaction: 409 with the failing op, like the
             # reference txn endpoint (agent/txn_endpoint.go).
             err = (result or {}).get("failed") or (result or {}).get("error")

@@ -54,10 +54,17 @@ class FSM:
         mtype = command["type"]
         if mtype == REGISTER:
             # One registration can carry node + service + check, like
-            # structs.RegisterRequest (fsm.go applyRegister).
+            # structs.RegisterRequest (fsm.go applyRegister). A
+            # service/check-only registration (no "address" — the txn
+            # Service/Check verbs) must not touch the node row: the
+            # reference's TxnServiceOp requires the node to exist and
+            # leaves it alone; a missing node aborts the (txn) apply.
             r = command
-            self.store.ensure_node(r["node"], r.get("address", ""),
-                                   r.get("node_meta"), index=index)
+            if "address" in r:
+                self.store.ensure_node(r["node"], r["address"],
+                                       r.get("node_meta"), index=index)
+            elif self.store.get_node(r["node"]) is None:
+                raise KeyError(f"node {r['node']!r} not registered")
             if "service" in r:
                 s = r["service"]
                 self.store.ensure_service(
@@ -82,6 +89,15 @@ class FSM:
             return self.store.delete_node(r["node"], index=index)
         if mtype == KV:
             op = command["op"]
+            if op == "get":
+                # Read-inside-txn (reference txn KVGet): the row rides
+                # the results list; a missing key fails the batch
+                # ("key does not exist", agent/consul/txn_endpoint.go).
+                e = self.store.kv_get(command["key"])
+                if e is None:
+                    raise KeyError(
+                        f"key {command['key']!r} does not exist")
+                return e
             if op == "unlock":
                 _, ok = self.store.kv_unlock(command["key"],
                                              command.get("session"),

@@ -420,3 +420,84 @@ class TestGateHardening:
                               headers={"x-consul-token":
                                        "master-secret"})
         assert st == 200
+
+
+class TestTxnGateHardening:
+    def _scoped(self, acl_stack, rules, name):
+        api, _ = acl_stack
+        st, _, _ = call(api, "PUT", "/v1/acl/policy",
+                        json.dumps({"Name": name,
+                                    "Rules": rules}).encode(),
+                        token="master-secret")
+        assert st == 200
+        st, tok, _ = call(api, "PUT", "/v1/acl/token",
+                          json.dumps({"Policies":
+                                      [{"Name": name}]}).encode(),
+                          token="master-secret")
+        assert st == 200
+        return tok["SecretID"]
+
+    def test_txn_delete_tree_needs_prefix_grant(self, acl_stack):
+        api, _ = acl_stack
+        secret = self._scoped(acl_stack, {
+            "key": {"solo": {"policy": "write"}}}, "txn-exact")
+        # Exact-key write passes a plain set...
+        st, _, _ = call(api, "PUT", "/v1/txn", json.dumps(
+            [{"KV": {"Verb": "set", "Key": "solo", "Value": ""}}]
+        ).encode(), token=secret)
+        assert st == 200
+        # ...but not a subtree delete rooted at it.
+        st, _, _ = call(api, "PUT", "/v1/txn", json.dumps(
+            [{"KV": {"Verb": "delete-tree", "Key": "solo"}}]
+        ).encode(), token=secret)
+        assert st == 403
+
+    def test_txn_service_id_cannot_bypass_name_acl(self, acl_stack):
+        api, _ = acl_stack
+        # Management registers a protected service.
+        st, _, _ = call(api, "PUT", "/v1/txn", json.dumps([
+            {"Node": {"Verb": "set",
+                      "Node": {"Node": "gate-n", "Address": "10.30.0.1"}}},
+            {"Service": {"Verb": "set", "Node": "gate-n",
+                         "Service": {"ID": "prot-1", "Service":
+                                     "protected", "Port": 1}}},
+        ]).encode(), token="master-secret")
+        assert st == 200
+        secret = self._scoped(acl_stack, {
+            "service": {"free": {"policy": "write"}},
+            "node_prefix": {"": {"policy": "read"}}}, "txn-svc")
+        # Claiming the writable NAME while targeting the protected ID
+        # is refused: the stored name is checked too.
+        st, _, _ = call(api, "PUT", "/v1/txn", json.dumps(
+            [{"Service": {"Verb": "delete", "Node": "gate-n",
+                          "Service": {"Service": "free",
+                                      "ID": "prot-1"}}}]
+        ).encode(), token=secret)
+        assert st == 403
+        st, _, _ = call(api, "PUT", "/v1/txn", json.dumps(
+            [{"Service": {"Verb": "set", "Node": "gate-n",
+                          "Service": {"Service": "free",
+                                      "ID": "prot-1", "Port": 99}}}]
+        ).encode(), token=secret)
+        assert st == 403
+
+    def test_txn_kv_get_rides_the_batch(self, acl_stack):
+        api, _ = acl_stack
+        st, _, _ = call(api, "PUT", "/v1/txn", json.dumps(
+            [{"KV": {"Verb": "set", "Key": "g/k", "Value":
+                     __import__("base64").b64encode(b"v").decode()}},
+             {"KV": {"Verb": "get", "Key": "g/k"}}]
+        ).encode(), token="master-secret")
+        assert st == 200
+        st, out, _ = call(api, "PUT", "/v1/txn", json.dumps(
+            [{"KV": {"Verb": "get", "Key": "g/k"}}]
+        ).encode(), token="master-secret")
+        assert st == 200
+        row = out["Results"][0]["KV"]
+        assert row["Key"] == "g/k"
+        # A get on a missing key aborts the whole batch (reference
+        # "key does not exist").
+        st, out, _ = call(api, "PUT", "/v1/txn", json.dumps(
+            [{"KV": {"Verb": "get", "Key": "g/ghost"}}]
+        ).encode(), token="master-secret")
+        assert st == 409

@@ -4,6 +4,7 @@ a consul binary and tests api/ against it; here the server is
 in-process but the HTTP boundary is a real TCP socket on a free port,
 the randomPortsSource idiom of agent/testagent.go:376)."""
 
+import base64
 import io
 import json
 import threading
@@ -817,3 +818,90 @@ class TestPreparedQueryHTTP:
         assert [n["node"] for n in res["Nodes"]] == ["pq-t1"]
         exp = client.query.explain("lookup-redis")
         assert exp["Query"]["Service"]["Service"] == "redis"
+
+
+class TestTxnCatalogVerbs:
+    """/v1/txn Node/Service/Check verbs (reference structs/txn.go
+    TxnOp families; agent/txn_endpoint.go) — catalog mutations in the
+    same atomic batch as KV ops."""
+
+    def test_mixed_batch_applies_atomically(self, stack):
+        _, _, client, _ = stack
+        ops = [
+            {"Node": {"Verb": "set",
+                      "Node": {"Node": "txn-n1",
+                               "Address": "10.20.0.1"}}},
+            {"Service": {"Verb": "set", "Node": "txn-n1",
+                         "Service": {"ID": "tsvc-1", "Service": "tsvc",
+                                     "Port": 900}}},
+            {"Check": {"Verb": "set",
+                       "Check": {"Node": "txn-n1", "CheckID": "tck-1",
+                                 "Status": "passing",
+                                 "ServiceID": "tsvc-1"}}},
+            {"KV": {"Verb": "set", "Key": "txn/flag",
+                    "Value": base64.b64encode(b"on").decode()}},
+        ]
+        out, _, _ = client._call("PUT", "/v1/txn", None,
+                                 json.dumps(ops).encode())
+        assert "Results" in out
+        assert wait_for(lambda: any(n["node"] == "txn-n1"
+                                    for n in client.catalog.nodes()[0]))
+        svc, _ = client.catalog.service("tsvc")
+        assert svc[0]["port"] == 900
+        health, _ = client.health.service("tsvc", passing=True)
+        assert health and health[0]["node"] == "txn-n1"
+        assert client.kv.get("txn/flag")[0]["Value"] == b"on"
+
+    def test_service_op_preserves_node_address(self, stack):
+        _, _, client, _ = stack
+        ops = [{"Service": {"Verb": "set", "Node": "txn-n1",
+                            "Service": {"ID": "tsvc-2",
+                                        "Service": "tsvc2",
+                                        "Port": 901}}}]
+        out, _, _ = client._call("PUT", "/v1/txn", None,
+                                 json.dumps(ops).encode())
+        assert wait_for(lambda: client.catalog.service("tsvc2")[0] != [])
+        n = next(n for n in client.catalog.nodes()[0]
+                 if n["node"] == "txn-n1")
+        assert n["address"] == "10.20.0.1"  # untouched by the svc op
+
+    def test_service_op_on_unknown_node_aborts_batch(self, stack):
+        _, _, client, _ = stack
+        import pytest as _pytest
+        from consul_tpu.api import APIError
+        ops = [
+            {"KV": {"Verb": "set", "Key": "txn/orphan",
+                    "Value": base64.b64encode(b"x").decode()}},
+            {"Service": {"Verb": "set", "Node": "ghost-node",
+                         "Service": {"ID": "g-1", "Service": "g"}}},
+        ]
+        with _pytest.raises(APIError) as e:
+            client._call("PUT", "/v1/txn", None, json.dumps(ops).encode())
+        assert e.value.status == 409
+        # Atomic: the KV op rolled back with the failed service op.
+        time.sleep(0.1)
+        assert client.kv.get("txn/orphan")[0] is None
+
+    def test_delete_verbs(self, stack):
+        _, _, client, _ = stack
+        ops = [{"Check": {"Verb": "delete",
+                          "Check": {"Node": "txn-n1",
+                                    "CheckID": "tck-1"}}},
+               {"Service": {"Verb": "delete", "Node": "txn-n1",
+                            "Service": {"ID": "tsvc-1"}}}]
+        client._call("PUT", "/v1/txn", None, json.dumps(ops).encode())
+        assert wait_for(lambda: client.catalog.service("tsvc")[0] == [])
+        ops = [{"Node": {"Verb": "delete",
+                         "Node": {"Node": "txn-n1"}}}]
+        client._call("PUT", "/v1/txn", None, json.dumps(ops).encode())
+        assert wait_for(lambda: all(n["node"] != "txn-n1"
+                                    for n in client.catalog.nodes()[0]))
+
+    def test_unknown_verb_rejected(self, stack):
+        _, _, client, _ = stack
+        import pytest as _pytest
+        from consul_tpu.api import APIError
+        with _pytest.raises(APIError, match="unsupported Node verb"):
+            client._call("PUT", "/v1/txn", None, json.dumps(
+                [{"Node": {"Verb": "lock",
+                           "Node": {"Node": "x"}}}]).encode())
