@@ -365,7 +365,17 @@ class HTTPApi:
         elif fam == "internal":
             checks = [("node", "", "read")]
         elif fam == "agent":
-            checks = [("agent", node, "write" if write else "read")]
+            if parts[1:3] == ["connect", "authorize"]:
+                # Reference AgentConnectAuthorize requires service
+                # write on the TARGET, not an agent permission.
+                try:
+                    target = json.loads(body or b"{}").get("Target", "")
+                except ValueError:
+                    target = ""
+                checks = [("service", target, "write")]
+            else:
+                checks = [("agent", node,
+                           "write" if write else "read")]
         elif fam == "acl":
             checks = [("acl", "", "write" if write else "read")]
         for resource, name, access in checks:
@@ -1064,6 +1074,42 @@ class HTTPApi:
                  "Tags": {}}
                 for n in nodes
             ], {}
+        if parts == ["agent", "connect", "authorize"] and method == "POST":
+            # Reference /v1/agent/connect/authorize (agent_endpoint.go
+            # AgentConnectAuthorize): would a connection from the
+            # client's identity to Target be allowed by intentions?
+            # The source rides a SPIFFE cert URI (.../svc/<name>) or,
+            # for non-mTLS callers here, a plain ClientServiceName.
+            req = json.loads(body or b"{}")
+            target = req.get("Target", "")
+            if not target:
+                return 400, {"error": "Target must be set"}, {}
+            source = req.get("ClientServiceName", "")
+            uri = req.get("ClientCertURI", "")
+            if not source and uri:
+                _, sep, svc = uri.rpartition("/svc/")
+                if not sep or not svc:
+                    # Not a service identity (e.g. an agent cert) —
+                    # reject, never authorize it by default
+                    # (AgentConnectAuthorize errors on non-service
+                    # URIs).
+                    return 400, {"error": "ClientCertURI is not a "
+                                 "service identity"}, {}
+                source = svc
+            if not source:
+                return 400, {"error": "ClientCertURI or "
+                             "ClientServiceName must identify the "
+                             "source service"}, {}
+            out = rpc("Intention.Check", source=source,
+                      destination=target,
+                      default_allow=(not self.acl_enabled
+                                     or self.acl_default_allow))
+            reason = ("Allowed by intention" if out["matched"]
+                      else "Default behavior") if out["allowed"] else \
+                ("Denied by intention" if out["matched"]
+                 else "Default behavior (deny)")
+            return 200, {"Authorized": out["allowed"],
+                         "Reason": reason}, {}
         if parts == ["agent", "leave"] and method == "PUT":
             # Graceful leave (reference /v1/agent/leave → agent.Leave):
             # deregister, stop duties, signal the runtime to exit.

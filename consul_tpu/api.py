@@ -10,6 +10,7 @@ values, ``X-Consul-Index`` — over stdlib ``http.client`` only.
 from __future__ import annotations
 
 import base64
+import hashlib
 import json
 import time
 import urllib.parse
@@ -718,14 +719,21 @@ class WatchPlan:
       service    service=[, tag=]   one service's nodes (serviceWatch)
       checks     [state=|service=]  health checks       (checksWatch)
       event      [name=]            agent user events   (eventWatch)
+      agent_service  service_id=    one LOCAL service   (agentServiceWatch)
 
     ``handler(index, result)`` is the WatchPlan Handler contract. Drive
     it explicitly with :meth:`run_once` (tests, schedulers) or loop it
     on a thread with :meth:`run` / :meth:`stop`.
+
+    ``agent_service`` is HASH-based like the reference (funcs.go
+    agentServiceWatch uses hash blocking, not raft indexes — agent
+    local state has no index): the plan fires when the response body's
+    digest changes, surfacing a locally-monotonic change counter as
+    the index.
     """
 
     TYPES = ("key", "keyprefix", "services", "nodes", "service",
-             "checks", "event")
+             "checks", "event", "agent_service")
 
     def __init__(self, client: Client, wtype: str, handler, **params):
         if wtype not in self.TYPES:
@@ -736,6 +744,9 @@ class WatchPlan:
         self.params = params
         self.index = 0
         self._stop = False
+        # Hash-watch state (agent_service).
+        self._last_hash = None
+        self._hash_seq = 0
 
     def _query(self, wait: str):
         c, p = self.client, self.params
@@ -784,13 +795,31 @@ class WatchPlan:
             out, meta, _ = c._call(
                 "GET", "/v1/event/list", {"name": p.get("name"), **idx})
             return meta.index, out
+        if self.type == "agent_service":
+            out, _, status = c._call(
+                "GET", f"/v1/agent/service/{p['service_id']}")
+            digest = hashlib.sha1(
+                json.dumps(out, sort_keys=True).encode()).hexdigest()
+            if digest != self._last_hash:
+                self._last_hash = digest
+                self._hash_seq += 1
+            return self._hash_seq, out
         raise AssertionError(self.type)
 
     def run_once(self, wait: str = "10s") -> bool:
         """One blocking-query round; returns True when the handler
-        fired (the index moved)."""
+        fired (the index moved). Hash-based types (agent_service) have
+        no server-side blocking — an unchanged round PACES itself with
+        a short client-side sleep so run() cannot busy-loop the agent
+        (the reference's watch retry interval)."""
         new_index, result = self._query(wait)
         if new_index == self.index:
+            if self.type == "agent_service":
+                try:
+                    w = float(str(wait).rstrip("s"))
+                except ValueError:
+                    w = 1.0
+                time.sleep(min(w, 1.0))
             return False
         # Reset on index regression, like the reference plan loop
         # (plan.go: an index that goes backwards restarts from 0).

@@ -260,3 +260,53 @@ class TestHTTPHardening:
                         f"/v1/connect/intentions/{made2['ID']}",
                         token=limited)
         assert st == 200
+
+
+class TestConnectAuthorize:
+    def test_authorize_and_agent_service_watch(self, stack):
+        """/v1/agent/connect/authorize (AgentConnectAuthorize) and the
+        agent_service hash watch (api/watch funcs.go
+        agentServiceWatch)."""
+        client, port = stack
+        client.connect.intention_create("caller", "payments", "deny")
+        out, _, _ = client._call(
+            "POST", "/v1/agent/connect/authorize", None, json.dumps({
+                "Target": "payments",
+                "ClientCertURI":
+                    "spiffe://x.consul/ns/default/dc/dc1/svc/caller",
+            }).encode())
+        assert out["Authorized"] is False
+        assert "intention" in out["Reason"]
+        out, _, _ = client._call(
+            "POST", "/v1/agent/connect/authorize", None, json.dumps({
+                "Target": "payments",
+                "ClientServiceName": "other"}).encode())
+        assert out["Authorized"] is True
+        from consul_tpu.api import APIError
+        with pytest.raises(APIError, match="Target"):
+            client._call("POST", "/v1/agent/connect/authorize", None,
+                         b"{}")
+
+        # agent_service watch: fires on registration change, not
+        # otherwise.
+        from consul_tpu.api import watch
+        fired = []
+        client.agent.service_register("wsvc", service_id="w-1", port=1)
+        plan = watch(client, "agent_service",
+                     lambda idx, res: fired.append(res), service_id="w-1")
+        assert plan.run_once() is True      # first observation fires
+        assert plan.run_once() is False     # unchanged: no fire
+        client.agent.service_register("wsvc", service_id="w-1", port=2)
+        assert plan.run_once() is True
+        assert fired[-1]["Port"] == 2
+
+    def test_authorize_rejects_non_service_uri(self, stack):
+        client, _ = stack
+        from consul_tpu.api import APIError
+        with pytest.raises(APIError, match="not a service identity"):
+            client._call("POST", "/v1/agent/connect/authorize", None,
+                         json.dumps({
+                             "Target": "payments",
+                             "ClientCertURI":
+                                 "spiffe://x.consul/agent/client/dc/dc1"
+                         }).encode())
