@@ -370,7 +370,7 @@ class HTTPApi:
                 # write on the TARGET, not an agent permission.
                 try:
                     target = json.loads(body or b"{}").get("Target", "")
-                except ValueError:
+                except (ValueError, AttributeError):
                     target = ""
                 checks = [("service", target, "write")]
             else:
@@ -1081,6 +1081,8 @@ class HTTPApi:
             # The source rides a SPIFFE cert URI (.../svc/<name>) or,
             # for non-mTLS callers here, a plain ClientServiceName.
             req = json.loads(body or b"{}")
+            if not isinstance(req, dict):
+                return 400, {"error": "body must be a JSON object"}, {}
             target = req.get("Target", "")
             if not target:
                 return 400, {"error": "Target must be set"}, {}

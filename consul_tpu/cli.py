@@ -66,10 +66,19 @@ def cmd_members(client: Client, args) -> int:
 
 def cmd_rtt(client: Client, args) -> int:
     # reference command/rtt/rtt.go: estimate RTT between two nodes from
-    # their coordinates (LAN by default).
-    coords, _ = client.coordinate.nodes()
-    by_node = {c["node"]: c["coord"] for c in coords
-               if not c.get("segment")}
+    # their coordinates (LAN by default; -wan reads the WAN server
+    # coordinates, addressed as <node>.<dc> or just <dc>).
+    if args.wan:
+        by_node = {}
+        for dcrow in client.coordinate.datacenters():
+            for c in dcrow.get("coordinates", []):
+                by_node[c["node"]] = c["coord"]
+                # A bare DC name resolves to its first server.
+                by_node.setdefault(dcrow["datacenter"], c["coord"])
+    else:
+        coords, _ = client.coordinate.nodes()
+        by_node = {c["node"]: c["coord"] for c in coords
+                   if not c.get("segment")}
     node2 = args.node2 or args.node1
     a, b = by_node.get(args.node1), by_node.get(node2)
     if a is None or b is None:
@@ -173,6 +182,19 @@ def cmd_snapshot(client: Client, args) -> int:
             body = f.read().encode()
         client._call("PUT", "/v1/snapshot", None, body)
         print(f"Restored snapshot from {args.file}")
+        return 0
+    if args.snapshot_cmd == "inspect":
+        # Reference `consul snapshot inspect`: offline summary of a
+        # saved archive — index + per-table row counts, no server
+        # needed.
+        with open(args.file) as f:
+            snap = json.load(f)
+        print(f"Index:  {snap.get('index')}")
+        tables = snap.get("tables", {})
+        width = max((len(t) for t in tables), default=5)
+        print(f"{'Table':<{width}}  Rows")
+        for name in sorted(tables):
+            print(f"{name:<{width}}  {len(tables[name])}")
         return 0
     raise AssertionError(args.snapshot_cmd)
 
@@ -622,6 +644,8 @@ def build_parser() -> argparse.ArgumentParser:
     rtt_p = sub.add_parser("rtt", help="estimate RTT between two nodes")
     rtt_p.add_argument("node1")
     rtt_p.add_argument("node2", nargs="?")
+    rtt_p.add_argument("-wan", action="store_true",
+                       help="use WAN server coordinates (<node>.<dc>)")
 
     kv_p = sub.add_parser("kv", help="KV store operations")
     kv_sub = kv_p.add_subparsers(dest="kv_cmd", required=True)
@@ -667,6 +691,8 @@ def build_parser() -> argparse.ArgumentParser:
     ss.add_argument("file")
     sr2 = snap_sub.add_parser("restore")
     sr2.add_argument("file")
+    si = snap_sub.add_parser("inspect")
+    si.add_argument("file")
 
     dbg = sub.add_parser("debug", help="capture a debug bundle")
     dbg.add_argument("--output", default="consul-tpu-debug.tar.gz")

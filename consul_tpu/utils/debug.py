@@ -38,7 +38,17 @@ def _host_info() -> dict:
     try:
         import jax
         info["Jax"] = jax.__version__
-        info["Devices"] = [str(d) for d in jax.devices()]
+        # Devices only when a backend ALREADY exists: the debug CLI is
+        # a pure HTTP client and must never initialize one itself —
+        # jax.devices() dials the device plugin, and on this
+        # environment's remote-TPU relay that call can hang
+        # indefinitely when the relay is wedged (measured: the whole
+        # `consul-tpu debug` verb froze on this line).
+        from jax._src import xla_bridge as _xb
+        if getattr(_xb, "_backends", None):
+            info["Devices"] = [str(d) for d in jax.devices()]
+        else:
+            info["Devices"] = "not initialized (host-side capture)"
     except Exception as e:  # noqa: BLE001 — host info must never fail
         info["JaxError"] = repr(e)
     return info
@@ -66,6 +76,14 @@ def capture_static(client) -> dict[str, dict]:
     grab("raft-configuration.json", client.operator.raft_get_configuration)
     grab("autopilot-config.json",
          client.operator.autopilot_get_configuration)
+    grab("autopilot-health.json",
+         client.operator.autopilot_server_health)
+    # Round-5 control-plane surfaces. Token listings are ALREADY
+    # secret-redacted by the endpoint, never re-fetched with secrets.
+    grab("intentions.json", lambda: client.connect.intention_list()[0])
+    grab("prepared-queries.json", lambda: client.query.list()[0])
+    grab("acl-policies.json", client.acl.policy_list)
+    grab("acl-tokens.json", client.acl.token_list)
     return out
 
 

@@ -332,7 +332,13 @@ class TestCLI:
             assert {"host.json", "self.json", "metrics.json",
                     "members.json", "node-dump.json",
                     "raft-configuration.json",
-                    "autopilot-config.json"} <= names
+                    "autopilot-config.json", "autopilot-health.json",
+                    "intentions.json", "prepared-queries.json",
+                    "acl-policies.json", "acl-tokens.json"} <= names
+            # Token capture must never carry secrets.
+            toks = json.loads(tar.extractfile("acl-tokens.json").read())
+            assert isinstance(toks, list), toks  # capture must succeed
+            assert all("SecretID" not in t for t in toks)
             metrics = json.loads(tar.extractfile("metrics.json").read())
             assert "Gauges" in metrics
             raft_cfg = json.loads(
@@ -905,3 +911,32 @@ class TestTxnCatalogVerbs:
             client._call("PUT", "/v1/txn", None, json.dumps(
                 [{"Node": {"Verb": "lock",
                            "Node": {"Node": "x"}}}]).encode())
+
+
+class TestSnapshotInspectAndWanRtt:
+    def test_snapshot_inspect_offline(self, stack, tmp_path):
+        _, _, client, port = stack
+        import subprocess
+        import sys
+        f = str(tmp_path / "s.snap")
+        argv = [sys.executable, "-m", "consul_tpu.cli",
+                "--http-addr", f"127.0.0.1:{port}"]
+        assert subprocess.run([*argv, "snapshot", "save", f],
+                              capture_output=True, timeout=30
+                              ).returncode == 0
+        out = subprocess.run([*argv, "snapshot", "inspect", f],
+                             capture_output=True, text=True, timeout=30)
+        assert out.returncode == 0, out.stderr
+        assert "Index:" in out.stdout and "kv" in out.stdout
+
+    def test_rtt_wan_flag(self, stack):
+        import io
+        from contextlib import redirect_stdout
+        _, _, _, port = stack
+        # A non-federated stack has one DC and no WAN coordinates:
+        # the command errors cleanly rather than crashing.
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = cli_main(["--http-addr", f"127.0.0.1:{port}",
+                           "rtt", "-wan", "dc1"])
+        assert rc == 1  # no WAN coordinate planted -> named error
