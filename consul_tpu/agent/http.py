@@ -109,6 +109,11 @@ class HTTPApi:
         if dc:
             args["dc"] = dc
         out = self.agent.rpc(method, **args)
+        if isinstance(out, bool):
+            # A pre-apply verdict with NO raft entry (e.g. a lock-delay
+            # rejection): nothing to wait for. bool is carved out
+            # before int — isinstance(False, int) is True.
+            return None, out
         if isinstance(out, int) and dc:
             return out, self._confirm_dc_apply(out, dc)
         if isinstance(out, int):
@@ -828,11 +833,26 @@ class HTTPApi:
         if parts == ["session", "create"] and method == "PUT":
             req = json.loads(body or b"{}")
             ttl = _dur_to_s(req["TTL"]) if req.get("TTL") else 0.0
+            # LockDelay: a Go duration string, or a number — small
+            # numbers are seconds, large ones are time.Duration
+            # nanoseconds (reference structs.go FixupLockDelay:
+            # values below the threshold are interpreted as seconds).
+            # null/"" means unspecified -> the 15s default; an
+            # explicit 0 turns the window off.
+            ld = req.get("LockDelay", "15s")
+            if ld is None or ld == "":
+                lock_delay_s = 15.0
+            elif isinstance(ld, str):
+                lock_delay_s = _dur_to_s(ld)
+            else:
+                lock_delay_s = (float(ld) / 1e9 if float(ld) >= 1000
+                                else float(ld))
             _, created = rpc_write(
                 "Session.Apply", op="create",
                 node=req.get("Node", self.agent.node), ttl_s=ttl,
                 behavior=req.get("Behavior", "release"),
                 checks=req.get("Checks"),
+                lock_delay_s=lock_delay_s,
             )
             # The create carries its raft index; wait for the apply so
             # an immediate renew/acquire from the same client cannot

@@ -203,8 +203,9 @@ class Session:
         self.c = c
 
     def create(self, node: Optional[str] = None, ttl: str = "",
-               behavior: str = "release") -> str:
-        body: dict = {"Behavior": behavior}
+               behavior: str = "release",
+               lock_delay: str = "15s") -> str:
+        body: dict = {"Behavior": behavior, "LockDelay": lock_delay}
         if node:
             body["Node"] = node
         if ttl:

@@ -319,7 +319,24 @@ class Server:
     # ------------------------------------------------------------------
     def _kvs_apply(self, op: str, key: str, value: bytes = b"",
                    flags: int = 0, cas_index: Optional[int] = None,
-                   session: Optional[str] = None) -> int:
+                   session: Optional[str] = None) -> Any:
+        if op == "lock":
+            # Lock-delay rejection (reference kvs_endpoint.go:73-78
+            # preApply): an acquire inside the post-invalidation window
+            # fails WITHOUT a raft entry — same false verdict a lost
+            # lock race produces. The check must run on the LEADER —
+            # the delay map is soft state recorded where the destroy
+            # applied first; an arbitrary RPC-receiving follower may
+            # lag it (the reference also pre-applies after forward()).
+            if not self.is_leader():
+                leader = self.raft.leader_id
+                if leader and leader != self.id and \
+                        leader in self.registry:
+                    self.metrics["rpc_forwarded"] += 1
+                    return self.registry[leader]._kvs_apply(
+                        op, key, value, flags, cas_index, session)
+            if self.store.kv_lock_delayed(key):
+                return False
         return self._raft_apply({
             "type": fsm_mod.KV, "op": op, "key": key, "value": value,
             "flags": flags, "cas_index": cas_index, "session": session,
@@ -340,7 +357,8 @@ class Server:
     # ------------------------------------------------------------------
     def _session_apply(self, op: str, node: str = "", session_id: str = "",
                        ttl_s: float = 0.0, behavior: str = "release",
-                       checks: Optional[list] = None) -> Any:
+                       checks: Optional[list] = None,
+                       lock_delay_s: float = 15.0) -> Any:
         if op == "create":
             # Validate before proposing (like the catalog endpoint): a
             # committed entry must not fail on apply. The local store
@@ -353,6 +371,9 @@ class Server:
                 "type": fsm_mod.SESSION, "op": "create", "id": session_id,
                 "node": node, "ttl_s": ttl_s, "behavior": behavior,
                 "checks": checks,
+                # Reference structs.Session.LockDelay (default 15s,
+                # capped at MaxLockDelay=60s at invalidation time).
+                "lock_delay_s": float(lock_delay_s),
             })
             # Both the pre-assigned id AND the raft index: callers that
             # answer synchronously (the HTTP tier) must wait for the

@@ -124,6 +124,7 @@ class FSM:
                 self.store.session_create(
                     command["id"], command["node"], command.get("ttl_s", 0.0),
                     command.get("behavior", "release"), command.get("checks"),
+                    lock_delay_s=command.get("lock_delay_s", 15.0),
                     index=index,
                 )
                 return command["id"]

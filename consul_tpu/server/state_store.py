@@ -78,6 +78,10 @@ class StateStore:
         self._cond = threading.Condition(self._lock)
         self.index = 0
         self.tables = {name: Table(name) for name in self.TABLES}
+        # Lock-delay windows (reference state.lockDelay): key -> wall
+        # expiry. Server-local soft state, consulted leader-side at
+        # acquire time; never replicated or snapshotted.
+        self._lock_delays: dict[str, float] = {}
 
     @contextlib.contextmanager
     def transaction(self):
@@ -350,6 +354,19 @@ class StateStore:
                 True,
             )
 
+    def kv_lock_delayed(self, key: str) -> bool:
+        """Is ``key`` inside a post-invalidation lock-delay window?
+        (reference state/kvs.go KVSLockDelay). Expired windows are
+        dropped on the way through."""
+        with self._lock:
+            exp = self._lock_delays.get(key)
+            if exp is None:
+                return False
+            if time.time() >= exp:
+                del self._lock_delays[key]
+                return False
+            return True
+
     def kv_get(self, key: str) -> Optional[dict]:
         with self._lock:
             e = self.tables["kv"].rows.get(key)
@@ -386,13 +403,15 @@ class StateStore:
     def session_create(self, session_id: str, node: str, ttl_s: float = 0.0,
                        behavior: str = "release",
                        checks: Optional[list[str]] = None,
+                       lock_delay_s: float = 15.0,
                        index: Optional[int] = None) -> int:
         if self.get_node(node) is None:
             raise KeyError(f"node {node!r} not registered")
         return self._commit(
             "sessions", session_id,
             {"id": session_id, "node": node, "ttl_s": ttl_s,
-             "behavior": behavior, "checks": checks or []},
+             "behavior": behavior, "checks": checks or [],
+             "lock_delay_s": lock_delay_s},
             index=index,
         )
 
@@ -408,14 +427,28 @@ class StateStore:
     def session_destroy(self, session_id: str,
                         index: Optional[int] = None) -> int:
         """Destroy a session, applying its behavior to held locks
-        (release or delete, reference state/session.go invalidation)."""
+        (release or delete, reference state/session.go invalidation).
+
+        Each released key enters a LOCK-DELAY window (session.go:322-370
+        + kvs_endpoint.go:73-78): re-acquisition is refused until
+        ``lock_delay_s`` after the invalidation — the reference's
+        split-brain guard, so a deposed holder that still thinks it owns
+        the lock has time to notice before a new holder acts. Like the
+        reference's ``lockDelay`` map this is SERVER-LOCAL soft state
+        (wall clock, not raft-replicated, not snapshotted): only the
+        leader consults it, at acquire time."""
         with self._lock:
             e = self.tables["sessions"].rows.get(session_id)
             behavior = e.value.get("behavior", "release") if e else "release"
+            delay = min(float((e.value.get("lock_delay_s", 15.0)
+                               if e else 0.0) or 0.0), 60.0)
             idx = self._commit("sessions", session_id, None, delete=True,
                                index=index)
+            now = time.time()
             for k, kv in list(self.tables["kv"].rows.items()):
                 if kv.value.get("session") == session_id:
+                    if delay > 0:
+                        self._lock_delays[k] = now + delay
                     if behavior == "delete":
                         self._commit("kv", k, None, delete=True, index=idx)
                     else:
@@ -704,7 +737,7 @@ class StateStore:
         index (long-pollers would see X-Consul-Index go backwards)."""
         names = list(tables) if tables is not None else list(self.TABLES)
         with self._lock:
-            return {
+            snap = {
                 "index": self.index,
                 "table_indexes": {
                     name: self.tables[name].max_index for name in names
@@ -715,12 +748,20 @@ class StateStore:
                     for name in names
                 },
             }
+            if "sessions" in names:
+                # Session mutations write lock-delay soft state; a TXN
+                # undo snapshot must roll those side effects back too
+                # (an aborted batch must not leave phantom windows).
+                snap["lock_delays"] = dict(self._lock_delays)
+            return snap
 
     def restore(self, snap: dict) -> None:
         """Restore the tables present in the snapshot (others are left
         untouched, supporting partial undo)."""
         with self._lock:
             self.index = snap["index"]
+            if "lock_delays" in snap:
+                self._lock_delays = dict(snap["lock_delays"])
             recorded = snap.get("table_indexes", {})
             for name, rows in snap["tables"].items():
                 t = self.tables[name]

@@ -940,3 +940,55 @@ class TestSnapshotInspectAndWanRtt:
             rc = cli_main(["--http-addr", f"127.0.0.1:{port}",
                            "rtt", "-wan", "dc1"])
         assert rc == 1  # no WAN coordinate planted -> named error
+
+
+class TestLockDelay:
+    """Session invalidation opens a lock-delay window on held keys
+    (reference state/session.go:322-370 + kvs_endpoint.go:73-78): the
+    split-brain guard — a deposed holder gets LockDelay to notice
+    before a new holder can acquire."""
+
+    def test_invalidation_blocks_reacquire_until_window_passes(self, stack):
+        _, _, client, _ = stack
+        client.catalog.register("ld-node", "10.50.0.1")
+        assert wait_for(lambda: any(n["node"] == "ld-node"
+                                    for n in client.catalog.nodes()[0]))
+        s1 = client.session.create(node="ld-node", lock_delay="0.3s")
+        assert client.kv.put("ld/lock", b"a", acquire=s1)
+        client.session.destroy(s1)
+        # Inside the window: a fresh session cannot acquire.
+        s2 = client.session.create(node="ld-node")
+        assert client.kv.put("ld/lock", b"b", acquire=s2) is False
+        # After the window: acquire succeeds.
+        assert wait_for(
+            lambda: client.kv.put("ld/lock", b"b", acquire=s2),
+            timeout=3.0)
+        client.session.destroy(s2)
+
+    def test_explicit_release_has_no_delay(self, stack):
+        _, _, client, _ = stack
+        client.catalog.register("ld-node", "10.50.0.1")
+        assert wait_for(lambda: any(n["node"] == "ld-node"
+                                    for n in client.catalog.nodes()[0]))
+        s1 = client.session.create(node="ld-node", lock_delay="5s")
+        assert client.kv.put("ld/free", b"a", acquire=s1)
+        assert client.kv.put("ld/free", b"a", release=s1)
+        # Voluntary release: immediately reacquirable (the delay only
+        # applies on session INVALIDATION).
+        s2 = client.session.create(node="ld-node")
+        assert client.kv.put("ld/free", b"b", acquire=s2)
+        client.session.destroy(s1)
+        client.session.destroy(s2)
+
+    def test_zero_delay_session_skips_window(self, stack):
+        _, _, client, _ = stack
+        client.catalog.register("ld-node", "10.50.0.1")
+        assert wait_for(lambda: any(n["node"] == "ld-node"
+                                    for n in client.catalog.nodes()[0]))
+        s1 = client.session.create(node="ld-node", lock_delay="0s")
+        assert client.kv.put("ld/nodelay", b"a", acquire=s1)
+        client.session.destroy(s1)
+        s2 = client.session.create(node="ld-node")
+        assert wait_for(
+            lambda: client.kv.put("ld/nodelay", b"b", acquire=s2))
+        client.session.destroy(s2)
