@@ -1463,7 +1463,22 @@ class HTTPApi:
             if "keys" in q:
                 out = rpc("KVS.List", prefix=key, min_index=min_index,
                           wait_s=wait_s)
-                return 200, [r["key"] for r in out["value"]], {
+                keys = [r["key"] for r in out["value"]]
+                sep = q.get("separator", "")
+                if sep:
+                    # Directory-style listing (reference state/kvs.go
+                    # kvsListKeys): each key truncates at the first
+                    # separator past the prefix; "subdirectories"
+                    # collapse to one entry ending in the separator.
+                    seen: dict = {}
+                    for k in keys:
+                        rest = k[len(key):]
+                        i = rest.find(sep)
+                        if i >= 0:
+                            k = key + rest[:i + len(sep)]
+                        seen.setdefault(k, None)
+                    keys = list(seen)
+                return 200, keys, {
                     "X-Consul-Index": str(out["index"])}
             if "recurse" in q:
                 out = rpc("KVS.List", prefix=key, min_index=min_index,

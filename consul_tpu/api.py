@@ -115,8 +115,12 @@ class KV:
         out, _, _ = self.c._call("DELETE", f"/v1/kv/{key}", params)
         return bool(out)
 
-    def keys(self, prefix: str = "") -> list[str]:
-        out, _, _ = self.c._call("GET", f"/v1/kv/{prefix}", {"keys": ""})
+    def keys(self, prefix: str = "", separator: str = "") -> list[str]:
+        """Key listing; ``separator`` gives directory-style truncation
+        (reference api/kv.go Keys)."""
+        out, _, _ = self.c._call("GET", f"/v1/kv/{prefix}",
+                                 {"keys": "", "separator":
+                                  separator or None})
         return out or []
 
     def list(self, prefix: str = "") -> list[dict]:

@@ -6,7 +6,7 @@ framework implements:
 
   members          catalog membership + serf health    (command/members)
   rtt              coordinate distance between nodes   (command/rtt/rtt.go:40)
-  kv get|put|delete|list                               (command/kv)
+  kv get|put|delete|list|export|import                 (command/kv)
   catalog nodes|services                               (command/catalog)
   info             agent + leadership info             (command/info)
   services register|deregister                         (command/services)
@@ -34,6 +34,7 @@ which routes every subcommand through the api client), selected by
 from __future__ import annotations
 
 import argparse
+import base64
 import json
 import os
 import sys
@@ -118,6 +119,33 @@ def cmd_kv(client: Client, args) -> int:
     if args.kv_cmd == "list":
         for k in client.kv.keys(args.key or ""):
             print(k)
+        return 0
+    if args.kv_cmd == "export":
+        # Reference `consul kv export`: a JSON array of
+        # {key, flags, value(base64)} rows for the prefix.
+        rows = client.kv.list(args.key or "")
+        print(json.dumps([{
+            "key": r["Key"], "flags": r.get("Flags", 0),
+            "value": base64.b64encode(r["Value"]).decode(),
+        } for r in rows], indent=2))
+        return 0
+    if args.kv_cmd == "import":
+        # Reference `consul kv import`: reads the export format from
+        # a file or stdin.
+        try:
+            if args.file and args.file != "-":
+                with open(args.file, encoding="utf-8") as f:
+                    raw = f.read()
+            else:
+                raw = sys.stdin.read()
+            rows = json.loads(raw)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        for e in rows:
+            client.kv.put(e["key"], base64.b64decode(e.get("value", "")),
+                          flags=int(e.get("flags", 0)))
+        print(f"Imported {len(rows)} entries")
         return 0
     raise AssertionError(args.kv_cmd)
 
@@ -661,6 +689,10 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--recurse", action="store_true")
     ls = kv_sub.add_parser("list")
     ls.add_argument("key", nargs="?")
+    ex = kv_sub.add_parser("export")
+    ex.add_argument("key", nargs="?")
+    im = kv_sub.add_parser("import")
+    im.add_argument("file", nargs="?", default="-")
 
     cat_p = sub.add_parser("catalog", help="catalog queries")
     cat_sub = cat_p.add_subparsers(dest="catalog_cmd", required=True)
