@@ -26,6 +26,7 @@ from urllib.parse import parse_qs, urlparse
 from consul_tpu.agent.agent import Agent
 from consul_tpu.server.endpoints import Server
 from consul_tpu.server.raft import NotLeader
+from consul_tpu.utils import bexpr
 from consul_tpu.utils import health as _health
 
 
@@ -87,8 +88,25 @@ class HTTPApi:
                 denied = self._acl_gate(method, path, q, body, headers)
                 if denied is not None:
                     return denied
-            return self._route(method, path, q, query, body,
-                               min_index, wait_s, near)
+            status, payload, hdrs = self._route(
+                method, path, q, query, body, min_index, wait_s, near)
+            if "filter" in q and status == 200:
+                # ?filter= boolean expressions over results (reference
+                # agent/http.go parseFilter -> go-bexpr, wired into the
+                # catalog/health/agent listings): one central
+                # application point. List results filter rows; map
+                # results (the agent's id-keyed services/checks
+                # listings) filter values, keeping matching keys.
+                if isinstance(payload, list) and \
+                        all(isinstance(r, dict) for r in payload):
+                    payload = bexpr.apply_filter(q["filter"], payload)
+                elif isinstance(payload, dict) and payload and \
+                        all(isinstance(v, dict)
+                            for v in payload.values()):
+                    flt = bexpr.Filter(q["filter"])
+                    payload = {k: v for k, v in payload.items()
+                               if flt.match(v)}
+            return status, payload, hdrs
         except NotLeader as e:
             return 500, {"error": f"no leader: {e}"}, {}
         except (ValueError, KeyError) as e:

@@ -139,6 +139,10 @@ def cmd_kv(client: Client, args) -> int:
             else:
                 raw = sys.stdin.read()
             rows = json.loads(raw)
+            if not isinstance(rows, list) or not all(
+                    isinstance(e, dict) and "key" in e for e in rows):
+                raise ValueError(
+                    "import expects a JSON array of {key, flags, value}")
         except (OSError, ValueError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 1

@@ -1,0 +1,301 @@
+"""Boolean filter expressions for API results (``?filter=``).
+
+Mirrors the reference's go-bexpr filtering (reference agent/http.go
+parseFilter → hashicorp/go-bexpr, wired into catalog/health/agent
+listings): a small boolean expression language over result rows —
+
+    Node == "web-1" and Service.Port != 80
+    "prod" in Service.Tags
+    Checks is not empty
+    Node matches "web-[0-9]+"
+    not (Status == critical or Status == warning)
+
+Grammar (bexpr's): ``or`` over ``and`` over ``not`` over primaries;
+primaries are parenthesised expressions, ``<selector> <op> <value>``,
+``<value> in|not in <selector>``, and ``<selector> is [not] empty``.
+Operators: ``==  !=  in  not in  contains  matches  not matches``.
+Values are double-quoted, backtick-quoted, or bare words.
+
+Selectors are dotted paths into the row (``Service.Tags``); this
+framework's rows use snake_case keys while the reference's selectors
+are Go field names, so lookup tries the selector verbatim, then its
+snake_case form — both spellings work.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional
+
+
+class FilterError(ValueError):
+    pass
+
+
+_TOKEN = re.compile(r"""
+    \s*(?:
+      (?P<lparen>\() | (?P<rparen>\)) |
+      (?P<dquote>"(?:[^"\\]|\\.)*") |
+      (?P<bquote>`[^`]*`) |
+      (?P<badquote>["`]) |
+      (?P<word>[^\s()"`]+)
+    )""", re.VERBOSE)
+
+_KEYWORDS = {"and", "or", "not", "in", "contains", "matches", "is",
+             "empty", "==", "!="}
+
+
+def _tokenize(src: str) -> list[tuple[str, str]]:
+    out, pos = [], 0
+    while pos < len(src):
+        m = _TOKEN.match(src, pos)
+        if m is None or m.end() == pos:
+            if src[pos:].strip():
+                raise FilterError(f"bad filter syntax at {src[pos:]!r}")
+            break
+        pos = m.end()
+        if m.group("lparen"):
+            out.append(("(", "("))
+        elif m.group("rparen"):
+            out.append((")", ")"))
+        elif m.group("dquote"):
+            raw = m.group("dquote")[1:-1]
+            out.append(("value", re.sub(r"\\(.)", r"\1", raw)))
+        elif m.group("bquote"):
+            out.append(("value", m.group("bquote")[1:-1]))
+        elif m.group("badquote"):
+            # A lone quote means an unterminated string: refuse loudly
+            # rather than comparing against a mangled literal.
+            raise FilterError(
+                f"unterminated string starting at {src[pos - 1:]!r}")
+        else:
+            w = m.group("word")
+            out.append(("word", w))
+    return out
+
+
+def _snake(name: str) -> str:
+    out = []
+    for i, ch in enumerate(name):
+        if ch.isupper() and i > 0 and not name[i - 1].isupper():
+            out.append("_")
+        out.append(ch.lower())
+    return "".join(out)
+
+
+def _lookup(row: Any, selector: str) -> tuple[bool, Any]:
+    """(found, value) for a dotted path; tries the given spelling then
+    snake_case per segment. A missing path is 'not found', never an
+    error (bexpr evaluates missing fields as non-matching)."""
+    cur = row
+    for seg in selector.split("."):
+        if isinstance(cur, dict):
+            if seg in cur:
+                cur = cur[seg]
+                continue
+            alt = _snake(seg)
+            if alt in cur:
+                cur = cur[alt]
+                continue
+            return False, None
+        if isinstance(cur, (list, tuple)) and seg.isdigit():
+            i = int(seg)
+            if i >= len(cur):
+                return False, None
+            cur = cur[i]
+            continue
+        return False, None
+    return True, cur
+
+
+def _as_str(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    return str(v)
+
+
+def _eq(field: Any, value: str) -> bool:
+    if isinstance(field, bool):
+        return _as_str(field) == value.lower()
+    if isinstance(field, (int, float)):
+        try:
+            return float(value) == float(field)
+        except ValueError:
+            return False
+    return _as_str(field) == value
+
+
+def _contains(field: Any, value: str) -> bool:
+    if isinstance(field, (list, tuple)):
+        return any(_eq(x, value) for x in field)
+    if isinstance(field, dict):
+        return value in field
+    if isinstance(field, (str, bytes)):
+        return value in _as_str(field)
+    return False
+
+
+class _Parser:
+    def __init__(self, tokens: list[tuple[str, str]]):
+        self.toks = tokens
+        self.pos = 0
+
+    def peek(self) -> Optional[tuple[str, str]]:
+        return self.toks[self.pos] if self.pos < len(self.toks) else None
+
+    def next(self) -> tuple[str, str]:
+        t = self.peek()
+        if t is None:
+            raise FilterError("unexpected end of filter")
+        self.pos += 1
+        return t
+
+    def expect_value(self) -> str:
+        kind, text = self.next()
+        if kind not in ("word", "value"):
+            raise FilterError(f"expected a value, got {text!r}")
+        return text
+
+    def parse(self):
+        node = self.parse_or()
+        if self.peek() is not None:
+            raise FilterError(f"trailing tokens at {self.peek()[1]!r}")
+        return node
+
+    def parse_or(self):
+        left = self.parse_and()
+        while self.peek() and self.peek()[1] == "or":
+            self.next()
+            right = self.parse_and()
+            left = ("or", left, right)
+        return left
+
+    def parse_and(self):
+        left = self.parse_unary()
+        while self.peek() and self.peek()[1] == "and":
+            self.next()
+            right = self.parse_unary()
+            left = ("and", left, right)
+        return left
+
+    def parse_unary(self):
+        t = self.peek()
+        if t and t[1] == "not":
+            self.next()
+            return ("not", self.parse_unary())
+        return self.parse_primary()
+
+    def parse_primary(self):
+        kind, text = self.next()
+        if kind == "(":
+            node = self.parse_or()
+            k, _ = self.next()
+            if k != ")":
+                raise FilterError("missing )")
+            return node
+        if kind == ")":
+            raise FilterError("unexpected )")
+        # Either  <value> [not] in <selector>   or
+        #         <selector> <op> ...
+        nxt = self.peek()
+        if nxt and nxt[1] == "in":
+            self.next()
+            sel = self.expect_value()
+            return ("in", text, sel)
+        if nxt and nxt[1] == "not" and self.pos + 1 < len(self.toks) \
+                and self.toks[self.pos + 1][1] == "in":
+            self.next()
+            self.next()
+            sel = self.expect_value()
+            return ("not", ("in", text, sel))
+        selector = text
+        if kind == "value":
+            raise FilterError(
+                f"quoted value {text!r} must be followed by in/not in")
+        k, op = self.next()
+        if op in ("==", "!="):
+            val = self.expect_value()
+            node = ("eq", selector, val)
+            return node if op == "==" else ("not", node)
+        if op == "contains":
+            return ("contains", selector, self.expect_value())
+        if op == "matches":
+            return ("matches", selector, self.expect_value())
+        if op == "not":
+            k2, op2 = self.next()
+            if op2 == "matches":
+                return ("not", ("matches", selector,
+                                self.expect_value()))
+            raise FilterError(f"bad operator 'not {op2}'")
+        if op == "is":
+            k2, w = self.next()
+            if w == "empty":
+                return ("empty", selector)
+            if w == "not":
+                k3, w2 = self.next()
+                if w2 == "empty":
+                    return ("not", ("empty", selector))
+            raise FilterError("expected 'is [not] empty'")
+        raise FilterError(f"unknown operator {op!r}")
+
+
+def _eval(node, row) -> bool:
+    op = node[0]
+    if op == "and":
+        return _eval(node[1], row) and _eval(node[2], row)
+    if op == "or":
+        return _eval(node[1], row) or _eval(node[2], row)
+    if op == "not":
+        return not _eval(node[1], row)
+    if op == "eq":
+        found, v = _lookup(row, node[1])
+        return found and _eq(v, node[2])
+    if op == "contains":
+        found, v = _lookup(row, node[1])
+        return found and _contains(v, node[2])
+    if op == "in":
+        found, v = _lookup(row, node[2])
+        return found and _contains(v, node[1])
+    if op == "matches":
+        found, v = _lookup(row, node[1])
+        if not found:
+            return False
+        try:
+            return re.search(node[2], _as_str(v)) is not None
+        except re.error as e:
+            raise FilterError(f"bad regexp {node[2]!r}: {e}") from e
+    if op == "empty":
+        found, v = _lookup(row, node[1])
+        if not found:
+            return True
+        if v is None:
+            return True
+        if isinstance(v, (list, tuple, dict, str, bytes)):
+            return len(v) == 0
+        return False
+    raise AssertionError(op)
+
+
+class Filter:
+    """Compiled filter: ``Filter('Port == 80').match(row)`` /
+    ``.apply(rows)`` (the bexpr.Evaluator shape)."""
+
+    def __init__(self, expression: str):
+        self.expression = expression
+        self._ast = _Parser(_tokenize(expression)).parse()
+
+    def match(self, row: Any) -> bool:
+        return _eval(self._ast, row)
+
+    def apply(self, rows: list) -> list:
+        return [r for r in rows if self.match(r)]
+
+
+def apply_filter(expression: Optional[str], rows: list) -> list:
+    """``rows`` unchanged when no expression; raises FilterError (→
+    HTTP 400) on a bad one."""
+    if not expression:
+        return rows
+    return Filter(expression).apply(rows)

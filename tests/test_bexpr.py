@@ -1,0 +1,102 @@
+"""?filter= boolean expressions (reference agent/http.go parseFilter →
+hashicorp/go-bexpr): grammar, selector lookup over snake/Camel rows,
+and the central HTTP application point."""
+
+import pytest
+
+from consul_tpu.utils.bexpr import Filter, FilterError, apply_filter
+
+ROWS = [
+    {"node": "web-1", "service": {"service": "web", "port": 80,
+                                  "tags": ["prod", "v2"], "meta": {}},
+     "checks": [{"status": "passing"}]},
+    {"node": "web-2", "service": {"service": "web", "port": 8080,
+                                  "tags": [], "meta": {"canary": "yes"}},
+     "checks": []},
+    {"node": "db-1", "service": {"service": "db", "port": 5432,
+                                 "tags": ["prod"], "meta": {}},
+     "checks": [{"status": "critical"}]},
+]
+
+
+class TestGrammar:
+    def test_equality_and_snake_aliasing(self):
+        # Go-style selectors resolve against snake_case rows.
+        assert [r["node"] for r in
+                Filter('Service.Service == "web"').apply(ROWS)] == \
+            ["web-1", "web-2"]
+        assert [r["node"] for r in
+                Filter('service.port == 5432').apply(ROWS)] == ["db-1"]
+
+    def test_neq_and_not(self):
+        got = Filter('Service.Service != "web"').apply(ROWS)
+        assert [r["node"] for r in got] == ["db-1"]
+        got = Filter('not Service.Service == "web"').apply(ROWS)
+        assert [r["node"] for r in got] == ["db-1"]
+
+    def test_and_or_parens(self):
+        f = Filter('(Service.Port == 80 or Service.Port == 8080) '
+                   'and Node matches "web"')
+        assert len(f.apply(ROWS)) == 2
+        f = Filter('Service.Service == "db" or Service.Port == 80')
+        assert [r["node"] for r in f.apply(ROWS)] == ["web-1", "db-1"]
+
+    def test_in_and_contains(self):
+        assert [r["node"] for r in
+                Filter('"prod" in Service.Tags').apply(ROWS)] == \
+            ["web-1", "db-1"]
+        assert [r["node"] for r in
+                Filter('Service.Tags contains "v2"').apply(ROWS)] == \
+            ["web-1"]
+        assert [r["node"] for r in
+                Filter('"prod" not in Service.Tags').apply(ROWS)] == \
+            ["web-2"]
+        # dict containment tests keys (bexpr map semantics).
+        assert [r["node"] for r in
+                Filter('"canary" in Service.Meta').apply(ROWS)] == \
+            ["web-2"]
+
+    def test_matches(self):
+        assert [r["node"] for r in
+                Filter('Node matches "^web-[0-9]+$"').apply(ROWS)] == \
+            ["web-1", "web-2"]
+        assert [r["node"] for r in
+                Filter('Node not matches "web"').apply(ROWS)] == ["db-1"]
+
+    def test_empty(self):
+        assert [r["node"] for r in
+                Filter('Checks is empty').apply(ROWS)] == ["web-2"]
+        assert [r["node"] for r in
+                Filter('Checks is not empty').apply(ROWS)] == \
+            ["web-1", "db-1"]
+        # A missing selector counts as empty, never an error.
+        assert len(Filter('Ghost is empty').apply(ROWS)) == 3
+
+    def test_quoting(self):
+        rows = [{"k": 'va"lue'}, {"k": "plain"}]
+        assert Filter(r'k == "va\"lue"').apply(rows) == [rows[0]]
+        assert Filter('k == `plain`').apply(rows) == [rows[1]]
+
+    def test_errors(self):
+        for bad in ('Node ==', 'Node === "x"', '(Node == "x"',
+                    'Node is full', '"v" in', 'Node matches "["'):
+            with pytest.raises(FilterError):
+                apply_filter(bad, ROWS)
+
+    def test_numbers_and_bools(self):
+        rows = [{"port": 80, "ok": True}, {"port": 443, "ok": False}]
+        assert Filter("port == 80").apply(rows) == [rows[0]]
+        assert Filter("ok == true").apply(rows) == [rows[0]]
+        assert Filter("ok == false").apply(rows) == [rows[1]]
+
+
+class TestHardening:
+    def test_unterminated_string_rejected(self):
+        with pytest.raises(FilterError, match="unterminated"):
+            apply_filter('node == "web-1', ROWS)
+
+    def test_paren_in_value_position_rejected(self):
+        with pytest.raises(FilterError, match="expected a value"):
+            apply_filter("node == (", ROWS)
+        with pytest.raises(FilterError):
+            apply_filter('"x" in (', ROWS)

@@ -1029,3 +1029,49 @@ class TestKvExportImportSeparator:
         row, _ = client.kv.get("imp/a")
         assert row["Value"] == b"alpha" and row["Flags"] == 7
         assert client.kv.get("imp/b")[0]["Value"] == b"\x00\x01binary"
+
+
+class TestFilterParam:
+    """?filter= over the wire (reference parseFilter -> go-bexpr on
+    catalog/health/agent listings; one central application point
+    here)."""
+
+    def test_filter_on_health_and_catalog(self, stack):
+        _, _, client, _ = stack
+        client.catalog.register(
+            "flt-1", "10.70.0.1",
+            service={"id": "f-1", "service": "fsvc", "port": 100,
+                     "tags": ["blue"]},
+            check={"CheckID": "fc1", "Status": "passing",
+                   "ServiceID": "f-1"})
+        client.catalog.register(
+            "flt-2", "10.70.0.2",
+            service={"id": "f-2", "service": "fsvc", "port": 200},
+            check={"CheckID": "fc2", "Status": "passing",
+                   "ServiceID": "f-2"})
+        assert wait_for(lambda: len(client.catalog.service("fsvc")[0]) == 2)
+        out, _, _ = client._call("GET", "/v1/health/service/fsvc",
+                                 {"filter": 'Service.Port == 100'})
+        assert [r["node"] for r in out] == ["flt-1"]
+        out, _, _ = client._call("GET", "/v1/health/service/fsvc",
+                                 {"filter": '"blue" in Service.Tags'})
+        assert [r["node"] for r in out] == ["flt-1"]
+        out, _, _ = client._call("GET", "/v1/catalog/nodes",
+                                 {"filter": 'Node matches "^flt-"'})
+        assert sorted(r["node"] for r in out) == ["flt-1", "flt-2"]
+        from consul_tpu.api import APIError
+        with pytest.raises(APIError) as e:
+            client._call("GET", "/v1/catalog/nodes", {"filter": "Node =="})
+        assert e.value.status == 400
+
+    def test_filter_on_agent_map_listings(self, stack):
+        """Map-shaped agent listings filter VALUES, keeping matching
+        keys (the reference supports ?filter on /v1/agent/services)."""
+        _, _, client, _ = stack
+        client.agent.service_register("fmap", service_id="fm-1", port=1)
+        client.agent.service_register("fmap", service_id="fm-2", port=2)
+        out, _, _ = client._call("GET", "/v1/agent/services",
+                                 {"filter": "Port == 2"})
+        assert list(out) == ["fm-2"]
+        client.agent.service_deregister("fm-1")
+        client.agent.service_deregister("fm-2")
