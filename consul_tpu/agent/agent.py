@@ -65,7 +65,13 @@ class Agent:
             0, coordinate_interval_s(cluster_size)
         )
         self.metrics = {"syncs": 0, "sync_writes": 0, "coordinate_sends": 0,
-                        "sync_failures": 0}
+                        "sync_failures": 0, "services_reaped": 0}
+        # DeregisterCriticalServiceAfter (reference structs/
+        # check_type.go:55 + agent.go reapServicesInternal): per-check
+        # reap timeout; critical-since bookkeeping feeds the reap pass
+        # in tick().
+        self._reap_after: dict[str, float] = {}
+        self._critical_since: dict[str, float] = {}
         # go-metrics sink served at /v1/agent/metrics (reference
         # lib/telemetry.go always attaches an InmemSink).
         from consul_tpu.utils import telemetry
@@ -306,6 +312,7 @@ class Agent:
             # stops the state syncer before deregistering).
             return ran
         self.checks.tick(now)
+        self._reap_critical_services(now)
         # Check status changes mark entries dirty; sync as scheduled or
         # immediately when something is dirty (changes trigger
         # SyncChanges promptly in the reference, local/state.go:505).
@@ -336,6 +343,46 @@ class Agent:
                 pass
             self._next_coord = now + coordinate_interval_s(self.cluster_size)
         return ran
+
+    def set_reap_after(self, check_id: str, seconds: float):
+        """Arm DeregisterCriticalServiceAfter for one check (reference
+        check_type.go:55; the reference floors tiny values at 1 min —
+        here the given value is honored so tests can run fast, with
+        the floor left to config policy)."""
+        self._reap_after[check_id] = float(seconds)
+
+    def _reap_critical_services(self, now: float):
+        """Deregister services whose check has been critical past its
+        reap timeout (reference agent.go reapServicesInternal)."""
+        for cid, c in list(self.local.checks.items()):
+            if c.status == "critical":
+                self._critical_since.setdefault(cid, now)
+            else:
+                self._critical_since.pop(cid, None)
+        for cid in list(self._critical_since):
+            # A check deregistered while critical must not leak its
+            # bookkeeping forever.
+            if cid not in self.local.checks:
+                self._critical_since.pop(cid, None)
+        for cid, timeout in list(self._reap_after.items()):
+            c = self.local.checks.get(cid)
+            if c is None:
+                self._reap_after.pop(cid, None)
+                self._critical_since.pop(cid, None)
+                continue
+            since = self._critical_since.get(cid)
+            if not c.service_id or timeout <= 0 or since is None:
+                continue
+            if now - since > timeout:
+                self.metrics["services_reaped"] += 1
+                self.remove_service(c.service_id)
+                self._reap_after.pop(cid, None)
+                self._critical_since.pop(cid, None)
+                # Deregister the catalog side PROMPTLY: removal leaves
+                # no dirty local entry for the dirty-detector to see,
+                # so pull the next anti-entropy pass to THIS tick
+                # (the reference's reap deregisters immediately).
+                self._next_sync = 0.0
 
     # -- reads through the cache (reference DNS/HTTP read path) --------
     def cached_service_nodes(self, service: str, ttl_s: float = 3.0,

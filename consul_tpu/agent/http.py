@@ -89,7 +89,8 @@ class HTTPApi:
                 if denied is not None:
                     return denied
             status, payload, hdrs = self._route(
-                method, path, q, query, body, min_index, wait_s, near)
+                method, path, q, query, body, min_index, wait_s, near,
+                headers)
             if "filter" in q and status == 200:
                 # ?filter= boolean expressions over results (reference
                 # agent/http.go parseFilter -> go-bexpr, wired into the
@@ -167,14 +168,18 @@ class HTTPApi:
 
     # -- ACL enforcement (reference agent/acl.go vetters: each endpoint
     # family resolves the token and checks its resource) ----------------
+    @staticmethod
+    def _secret_from(q, headers) -> str:
+        """The request's ACL secret: X-Consul-Token header
+        (case-insensitive — urllib lowercases it on the wire) or
+        ?token= — ONE implementation for the gate and token/self."""
+        return next((v for k, v in (headers or {}).items()
+                     if k.lower() == "x-consul-token"), "") \
+            or q.get("token", "")
+
     def _authorizer(self, q, headers):
         from consul_tpu.server import acl as acl_mod
-        # Case-insensitive header lookup: urllib canonicalizes
-        # X-Consul-Token to X-consul-token on the wire, and HTTP
-        # headers are case-insensitive by spec.
-        secret = next((v for k, v in (headers or {}).items()
-                       if k.lower() == "x-consul-token"), "") \
-            or q.get("token", "")
+        secret = self._secret_from(q, headers)
         if self.acl_master_token and secret == self.acl_master_token:
             # The agent-config master token (reference acl_master_token)
             # is management without a store round-trip.
@@ -198,7 +203,11 @@ class HTTPApi:
         write = method in ("PUT", "POST", "DELETE")
         # Status + bootstrap stay open (reference: status endpoints are
         # unauthenticated; bootstrap must work before tokens exist).
-        if fam == "status" or parts == ["acl", "bootstrap"]:
+        if fam == "status" or parts == ["acl", "bootstrap"] or \
+                parts == ["acl", "token", "self"]:
+            # token/self is authenticated by POSSESSION of the secret
+            # (the reference requires no ACL privilege to read your
+            # own token).
             return None
         try:
             authz = self._authorizer(q, headers)
@@ -406,7 +415,8 @@ class HTTPApi:
                 return 403, {"error": "Permission denied"}, {}
         return None
 
-    def _acl_routes(self, method, parts, q, body, min_index, wait_s, rpc):
+    def _acl_routes(self, method, parts, q, body, min_index, wait_s, rpc,
+                    headers=None):
         """/v1/acl/* (reference acl_endpoint.go HTTP surface — the
         token/policy API subset; legacy create/update/info and
         roles/auth-methods are out)."""
@@ -430,6 +440,21 @@ class HTTPApi:
                                  token=_token_from_api(json.loads(body)))
             self.wait_write(out["index"])
             return 200, _token_to_api(out["token"]), {}
+        if parts == ["acl", "token", "self"]:
+            # Reference /v1/acl/token/self: the token the request
+            # authenticated with, resolved from its own secret —
+            # read-only, and both the resolve and the fetch ride the
+            # same (dc-bound) rpc so ?dc= stays consistent.
+            if method != "GET":
+                return 405, {"error": "method not allowed"}, {}
+            secret = self._secret_from(q, headers)
+            res = rpc("ACL.Resolve", secret_id=secret)
+            if not res.get("known"):
+                return 404, {"error": "token not found"}, {}
+            out = rpc("ACL.TokenGet", accessor_id=res["accessor_id"])
+            if not out["value"]:
+                return 404, {"error": "token not found"}, {}
+            return 200, _token_to_api(out["value"][0]), {}
         if len(parts) == 3 and parts[:2] == ["acl", "token"]:
             if method == "GET":
                 out = rpc("ACL.TokenGet", accessor_id=parts[2],
@@ -687,7 +712,8 @@ class HTTPApi:
                 worst = c.status
         return worst
 
-    def _route(self, method, path, q, query, body, min_index, wait_s, near):
+    def _route(self, method, path, q, query, body, min_index, wait_s,
+               near, headers=None):
         parts = [p for p in path.split("/") if p]
         if not parts or parts[0] != "v1":
             return 404, {"error": "not found"}, {}
@@ -824,7 +850,7 @@ class HTTPApi:
         # ---- ACL (reference acl_endpoint.go; /v1/acl/*) ---------------
         if parts[0] == "acl":
             return self._acl_routes(method, parts, q, body, min_index,
-                                    wait_s, rpc)
+                                    wait_s, rpc, headers)
 
         # ---- intentions (reference agent/intentions_endpoint.go;
         # routes http_register.go /v1/connect/intentions*) --------------
@@ -1231,10 +1257,18 @@ class HTTPApi:
             ttl = None
             if req.get("Check", {}).get("TTL"):
                 ttl = _dur_to_s(req["Check"]["TTL"])
+            sid = req.get("ID", req["Name"])
             self.agent.add_service(
-                req.get("ID", req["Name"]), req["Name"],
+                sid, req["Name"],
                 req.get("Port", 0), req.get("Tags"), check_ttl_s=ttl,
             )
+            dcsa = req.get("Check", {}).get(
+                "DeregisterCriticalServiceAfter")
+            if dcsa:
+                # The service's TTL check carries the reap timeout
+                # (reference check_type.go:55).
+                self.agent.set_reap_after(f"service:{sid}",
+                                          _dur_to_s(dcsa))
             self.agent.tick(_now())
             return 200, True, {}
         if len(parts) == 4 and parts[:3] == ["agent", "service", "deregister"]:
@@ -1281,6 +1315,9 @@ class HTTPApi:
             else:
                 return 400, {"error":
                              "check needs one of TTL/HTTP/TCP/AliasNode"}, {}
+            if req.get("DeregisterCriticalServiceAfter"):
+                self.agent.set_reap_after(
+                    cid, _dur_to_s(req["DeregisterCriticalServiceAfter"]))
             self.agent.tick(_now())
             return 200, True, {}
         if len(parts) == 4 and parts[:3] == ["agent", "check",

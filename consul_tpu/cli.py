@@ -51,6 +51,19 @@ def make_client(args) -> Client:
 
 
 def cmd_members(client: Client, args) -> int:
+    if getattr(args, "wan", False):
+        # Reference `consul members -wan`: the WAN server pool.
+        try:
+            rows = client.agent.members(wan=True)
+        except APIError as e:
+            print(f"error: {e.body.get('error', e) if isinstance(e.body, dict) else e}",
+                  file=sys.stderr)
+            return 1
+        print(f"{'Node':<24} {'DC':<8} Status")
+        for m in rows:
+            print(f"{m['Name']:<24} {m['Tags'].get('dc', ''):<8} "
+                  f"{m['Status']}")
+        return 0
     nodes, _ = client.catalog.nodes()
     checks, _ = client.health.state("any")
     by_node = {}
@@ -671,7 +684,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="override http.port (0 = pick a free port)")
     ag.add_argument("--data-dir", default=None)
 
-    sub.add_parser("members", help="cluster members + health")
+    mem_p = sub.add_parser("members", help="cluster members + health")
+    mem_p.add_argument("-wan", action="store_true",
+                       help="list the WAN server pool")
 
     rtt_p = sub.add_parser("rtt", help="estimate RTT between two nodes")
     rtt_p.add_argument("node1")

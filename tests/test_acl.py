@@ -501,3 +501,33 @@ class TestTxnGateHardening:
             [{"KV": {"Verb": "get", "Key": "g/ghost"}}]
         ).encode(), token="master-secret")
         assert st == 409
+
+
+class TestTokenSelf:
+    def test_token_self_resolves_own_token(self, acl_stack):
+        api, _ = acl_stack
+        st, tok, _ = call(api, "PUT", "/v1/acl/token",
+                          json.dumps({"Description": "mine"}).encode(),
+                          token="master-secret")
+        assert st == 200
+        st, me, _ = call(api, "GET", "/v1/acl/token/self",
+                         token=tok["SecretID"])
+        assert st == 200
+        assert me["AccessorID"] == tok["AccessorID"]
+        assert me["Description"] == "mine"
+        st, _, _ = call(api, "GET", "/v1/acl/token/self",
+                        token="not-a-token")
+        assert st == 404
+
+    def test_token_self_is_get_only(self, acl_stack):
+        api, _ = acl_stack
+        st, tok, _ = call(api, "PUT", "/v1/acl/token",
+                          json.dumps({"Description": "keepme"}).encode(),
+                          token="master-secret")
+        st, _, _ = call(api, "DELETE", "/v1/acl/token/self",
+                        token=tok["SecretID"])
+        assert st == 405
+        # ...and the token is untouched.
+        st, me, _ = call(api, "GET", "/v1/acl/token/self",
+                         token=tok["SecretID"])
+        assert st == 200 and me["Description"] == "keepme"

@@ -182,3 +182,40 @@ class TestCache:
             time.sleep(0.05)
         assert len(got) == 2
         agent.close()
+
+
+class TestCriticalReap:
+    def test_deregister_critical_service_after(self):
+        """DeregisterCriticalServiceAfter (reference check_type.go:55 +
+        agent.go reapServicesInternal): a service whose check stays
+        critical past the timeout is deregistered by the agent."""
+        from consul_tpu.agent.agent import Agent
+
+        calls = []
+
+        def rpc(method, **args):
+            calls.append(method)
+            if method in ("Catalog.NodeServices",):
+                return {"index": 1, "value": []}
+            if method in ("Health.NodeChecks",):
+                return {"index": 1, "value": []}
+            return {"index": 1, "value": None}
+
+        a = Agent("reaper", "10.0.0.1", rpc, cluster_size=1)
+        a.add_service("w1", "web", check_ttl_s=10.0)
+        a.set_reap_after("service:w1", 1.0)
+        a.tick(0.0)
+        assert "w1" in a.local.services
+        # Critical (TTL never passed) but inside the window.
+        a.tick(0.9)
+        assert "w1" in a.local.services
+        # Past the window: reaped.
+        a.tick(2.0)
+        assert "w1" not in a.local.services
+        assert a.metrics["services_reaped"] == 1
+        # A passing check never reaps.
+        a.add_service("ok1", "ok", check_ttl_s=10.0)
+        a.set_reap_after("service:ok1", 0.5)
+        a.checks.checks["service:ok1"].pass_(2.1)
+        a.tick(4.0)
+        assert "ok1" in a.local.services
