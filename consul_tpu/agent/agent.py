@@ -168,6 +168,11 @@ class Agent:
                     tags: Optional[list] = None,
                     check_ttl_s: Optional[float] = None, now: float = 0.0):
         self.local.add_service(service_id, service, port, tags)
+        # A re-registration is a FRESH definition: stale reap config
+        # or critical-since bookkeeping from the previous registration
+        # must not survive it (the caller re-arms if still wanted).
+        self._reap_after.pop(f"service:{service_id}", None)
+        self._critical_since.pop(f"service:{service_id}", None)
         if check_ttl_s is not None:
             self.checks.add_ttl(f"service:{service_id}", check_ttl_s,
                                 service_id=service_id, now=now)

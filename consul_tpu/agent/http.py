@@ -410,6 +410,15 @@ class HTTPApi:
                            "write" if write else "read")]
         elif fam == "acl":
             checks = [("acl", "", "write" if write else "read")]
+        elif fam == "discovery-chain":
+            checks = [("service", parts[1] if len(parts) > 1 else "",
+                       "read")]
+        else:
+            # FAIL CLOSED: an endpoint family this gate doesn't know
+            # is still subject to the default policy (a new route must
+            # be mapped here consciously, never silently open under
+            # default-deny).
+            checks = [("operator", "", "write" if write else "read")]
         for resource, name, access in checks:
             if not authz.allowed(resource, name, access):
                 return 403, {"error": "Permission denied"}, {}
@@ -852,6 +861,21 @@ class HTTPApi:
             return self._acl_routes(method, parts, q, body, min_index,
                                     wait_s, rpc, headers)
 
+        # ---- discovery chain (reference agent/discovery_chain_
+        # endpoint.go; /v1/discovery-chain/:service) --------------------
+        if len(parts) == 2 and parts[0] == "discovery-chain":
+            if method not in ("GET", "POST"):
+                return 405, {"error": "method not allowed"}, {}
+            from consul_tpu.server.discovery_chain import \
+                ChainCompileError
+            try:
+                out = rpc("DiscoveryChain.Get", service=parts[1],
+                          min_index=min_index, wait_s=wait_s)
+            except ChainCompileError as e:
+                return 400, {"error": str(e)}, {}
+            return 200, {"Chain": out["value"]}, {
+                "X-Consul-Index": str(out["index"])}
+
         # ---- intentions (reference agent/intentions_endpoint.go;
         # routes http_register.go /v1/connect/intentions*) --------------
         if parts[0] == "connect" and parts[1:2] == ["intentions"]:
@@ -1265,6 +1289,13 @@ class HTTPApi:
             dcsa = req.get("Check", {}).get(
                 "DeregisterCriticalServiceAfter")
             if dcsa:
+                if ttl is None:
+                    # Accept-and-drop would be a silent lie: the reap
+                    # rides a check, so demand one (the reference
+                    # rejects checks with no type).
+                    return 400, {"error":
+                                 "DeregisterCriticalServiceAfter "
+                                 "requires a check (set Check.TTL)"}, {}
                 # The service's TTL check carries the reap timeout
                 # (reference check_type.go:55).
                 self.agent.set_reap_after(f"service:{sid}",

@@ -597,6 +597,12 @@ class Connect:
                                   "destination": destination})
         return bool(out["Allowed"])
 
+    def discovery_chain(self, service: str) -> dict:
+        """Compiled discovery chain (reference api/discovery_chain.go
+        Get → /v1/discovery-chain/:service)."""
+        out, _, _ = self.c._call("GET", f"/v1/discovery-chain/{service}")
+        return out["Chain"]
+
 
 class ACL:
     """Token + policy API (reference api/acl.go: ACL.Bootstrap,

@@ -593,6 +593,22 @@ class Server:
                 "accessor_id": t["accessor_id"]}
 
     # ------------------------------------------------------------------
+    # DiscoveryChain endpoint (reference agent/consul/
+    # discoverychain_endpoint.go Get + discoverychain/compile.go)
+    # ------------------------------------------------------------------
+    def _discoverychain_get(self, service: str, min_index: int = 0,
+                            wait_s: float = 10.0) -> dict:
+        """Compile the service's router/splitter/resolver config
+        entries into the walkable chain — a blocking read over the
+        config_entries table, so watchers recompile on entry changes."""
+        from consul_tpu.server import discovery_chain as dch
+
+        def fn():
+            return dch.compile_chain(self.store.config_get, service,
+                                     datacenter=self.dc)
+        return self._blocking(("config_entries",), min_index, wait_s, fn)
+
+    # ------------------------------------------------------------------
     # Intention endpoint (reference agent/consul/intention_endpoint.go:
     # Apply/Get/List/Match/Check; structs/intention.go precedence)
     # ------------------------------------------------------------------

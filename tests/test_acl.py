@@ -531,3 +531,16 @@ class TestTokenSelf:
         st, me, _ = call(api, "GET", "/v1/acl/token/self",
                          token=tok["SecretID"])
         assert st == 200 and me["Description"] == "keepme"
+
+
+class TestGateFailClosed:
+    def test_discovery_chain_and_unknown_routes_gated(self, acl_stack):
+        api, _ = acl_stack
+        st, _, _ = call(api, "GET", "/v1/discovery-chain/web")
+        assert st == 403  # anonymous under default-deny
+        st, _, _ = call(api, "GET", "/v1/discovery-chain/web",
+                        token="master-secret")
+        assert st == 200
+        # An unmapped family fails closed under default-deny.
+        st, _, _ = call(api, "GET", "/v1/definitely-not-a-route")
+        assert st == 403

@@ -1,0 +1,178 @@
+"""Discovery-chain compiler (reference discoverychain/compile.go +
+discoverychain_endpoint.go): router → splitter → resolver graphs from
+config entries, redirects, subsets, failover, cycle detection."""
+
+import pytest
+
+from consul_tpu.server.discovery_chain import (
+    ChainCompileError, compile_chain,
+)
+from consul_tpu.server.endpoints import ServerCluster
+
+
+def store(entries):
+    """get_entry over a literal {(kind, name): entry} dict."""
+    return lambda kind, name: entries.get((kind, name))
+
+
+class TestCompile:
+    def test_default_chain_is_one_resolver(self):
+        chain = compile_chain(store({}), "web")
+        assert chain["start_node"] == "resolver:default.web"
+        node = chain["nodes"]["resolver:default.web"]
+        assert node["resolver"]["default"] is True
+        tgt = chain["targets"][node["resolver"]["target"]]
+        assert tgt["service"] == "web" and tgt["datacenter"] == "dc1"
+
+    def test_splitter_to_subset_resolvers(self):
+        entries = {
+            ("service-splitter", "web"): {"splits": [
+                {"weight": 90, "service_subset": "v1"},
+                {"weight": 10, "service_subset": "v2"},
+            ]},
+            ("service-resolver", "web"): {"subsets": {
+                "v1": {"filter": 'Service.Meta.version == "1"'},
+                "v2": {"filter": 'Service.Meta.version == "2"'},
+            }},
+        }
+        chain = compile_chain(store(entries), "web")
+        assert chain["start_node"] == "splitter:web"
+        splits = chain["nodes"]["splitter:web"]["splits"]
+        assert [s["weight"] for s in splits] == [90.0, 10.0]
+        assert splits[0]["next_node"] == "resolver:v1.web"
+        t = chain["targets"]["v1.web.dc1"]
+        assert t["subset"]["filter"].endswith('== "1"')
+
+    def test_bad_split_weights_rejected(self):
+        entries = {("service-splitter", "web"):
+                   {"splits": [{"weight": 50}]}}
+        with pytest.raises(ChainCompileError, match="must be 100"):
+            compile_chain(store(entries), "web")
+
+    def test_router_routes_and_default(self):
+        entries = {
+            ("service-router", "web"): {"routes": [
+                {"match": {"http": {"path_prefix": "/admin"}},
+                 "destination": {"service": "admin"}},
+            ]},
+            ("service-splitter", "admin"): {"splits": [
+                {"weight": 100}]},
+        }
+        chain = compile_chain(store(entries), "web")
+        routes = chain["nodes"]["router:web"]["routes"]
+        assert routes[0]["match"]["http"]["path_prefix"] == "/admin"
+        assert routes[0]["next_node"] == "splitter:admin"
+        # Implicit catch-all back to web's resolver.
+        assert routes[-1]["match"] is None
+        assert routes[-1]["next_node"] == "resolver:default.web"
+
+    def test_redirect_followed_cross_dc(self):
+        entries = {
+            ("service-resolver", "web"): {"redirect": {
+                "service": "web-canary", "datacenter": "dc2"}},
+        }
+        chain = compile_chain(store(entries), "web")
+        node = chain["nodes"][chain["start_node"]]
+        tgt = chain["targets"][node["resolver"]["target"]]
+        assert tgt["service"] == "web-canary"
+        assert tgt["datacenter"] == "dc2"
+
+    def test_datacenter_only_redirect(self):
+        # A dc-only redirect is valid (no service change): same
+        # service, target pinned to the named DC — never a spurious
+        # cycle error.
+        entries = {("service-resolver", "web"):
+                   {"redirect": {"datacenter": "dc2"}}}
+        chain = compile_chain(store(entries), "web")
+        node = chain["nodes"][chain["start_node"]]
+        tgt = chain["targets"][node["resolver"]["target"]]
+        assert tgt["service"] == "web" and tgt["datacenter"] == "dc2"
+
+    def test_failover_targets(self):
+        entries = {
+            ("service-resolver", "api"): {"failover": {
+                "*": {"datacenters": ["dc2", "dc3"]}}},
+        }
+        chain = compile_chain(store(entries), "api")
+        node = chain["nodes"]["resolver:default.api"]
+        assert node["resolver"]["failover"]["targets"] == \
+            ["default.api.dc2", "default.api.dc3"]
+        assert set(chain["targets"]) >= {"default.api.dc1",
+                                         "default.api.dc2",
+                                         "default.api.dc3"}
+
+    def test_unknown_subset_rejected(self):
+        entries = {("service-splitter", "web"): {"splits": [
+            {"weight": 100, "service_subset": "ghost"}]}}
+        with pytest.raises(ChainCompileError, match="no subset"):
+            compile_chain(store(entries), "web")
+
+    def test_redirect_cycle_detected(self):
+        entries = {
+            ("service-resolver", "a"): {"redirect": {"service": "b"}},
+            ("service-resolver", "b"): {"redirect": {"service": "a"}},
+        }
+        with pytest.raises(ChainCompileError, match="circular"):
+            compile_chain(store(entries), "a")
+
+
+class TestEndpoint:
+    def test_chain_over_config_entries_and_http(self):
+        import threading
+        import time
+
+        from consul_tpu.agent.agent import Agent
+        from consul_tpu.agent.http import HTTPApi, serve
+        from consul_tpu.api import APIError, Client
+
+        cluster = ServerCluster(3, seed=41)
+        cluster.wait_converged()
+        stop = threading.Event()
+        lock = threading.Lock()
+
+        def pump():
+            while not stop.is_set():
+                with lock:
+                    cluster.step()
+                time.sleep(0.002)
+
+        threading.Thread(target=pump, daemon=True).start()
+
+        def rpc(method, **args):
+            with lock:
+                server = cluster.registry[
+                    cluster.raft.wait_converged().id]
+            return server.rpc(method, **args)
+
+        def wait_write(idx):
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                with lock:
+                    led = cluster.raft.leader()
+                    if led is not None and led.last_applied >= idx:
+                        return
+                time.sleep(0.002)
+
+        agent = Agent("dc-agent", "10.90.0.1", rpc, cluster_size=3)
+        api = HTTPApi(agent, wait_write=wait_write)
+        httpd, port = serve(api)
+        try:
+            client = Client("127.0.0.1", port)
+            # No entries: the default chain.
+            chain = client.connect.discovery_chain("web")
+            assert chain["start_node"] == "resolver:default.web"
+            # Write entries through the ConfigEntry surface; the chain
+            # recompiles from them.
+            client.config.set("service-splitter", "web", {
+                "splits": [{"weight": 100, "service": "web-next"}]})
+            chain = client.connect.discovery_chain("web")
+            assert chain["start_node"] == "splitter:web"
+            assert "resolver:default.web-next" in chain["nodes"]
+            # A broken entry is a clean 400 at compile time.
+            client.config.set("service-splitter", "bad", {
+                "splits": [{"weight": 1}]})
+            with pytest.raises(APIError, match="must be 100"):
+                client.connect.discovery_chain("bad")
+        finally:
+            stop.set()
+            httpd.shutdown()
