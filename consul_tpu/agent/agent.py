@@ -173,6 +173,11 @@ class Agent:
         # must not survive it (the caller re-arms if still wanted).
         self._reap_after.pop(f"service:{service_id}", None)
         self._critical_since.pop(f"service:{service_id}", None)
+        if check_ttl_s is None:
+            # A fresh definition WITHOUT a check must not keep the
+            # previous registration's TTL check alive (it would sit
+            # critical forever with nothing renewing it).
+            self.checks.remove(f"service:{service_id}")
         if check_ttl_s is not None:
             self.checks.add_ttl(f"service:{service_id}", check_ttl_s,
                                 service_id=service_id, now=now)

@@ -344,6 +344,18 @@ class HTTPApi:
             else:
                 checks = [("node", "", "read")]
         elif fam == "connect":
+            if parts[1:2] == ["ca"]:
+                # Roots are public trust material (the reference serves
+                # CARoots without a token); configuration is operator;
+                # and a ca path must NEVER fall into the intention
+                # checks below.
+                if parts[2:3] == ["configuration"]:
+                    checks = [("operator", "",
+                               "write" if write else "read")]
+                for resource, name, access in checks:
+                    if not authz.allowed(resource, name, access):
+                        return 403, {"error": "Permission denied"}, {}
+                return None
             # Intentions ride service ACLs (reference: intention writes
             # need service:intentions write on the destination). By-id
             # operations authorize against the STORED intention's
@@ -397,7 +409,14 @@ class HTTPApi:
         elif fam == "internal":
             checks = [("node", "", "read")]
         elif fam == "agent":
-            if parts[1:3] == ["connect", "authorize"]:
+            if parts[1:4] == ["connect", "ca", "leaf"]:
+                # Leaf certs need service:write on the named service
+                # (agent_endpoint.go AgentConnectCALeafCert ACL).
+                checks = [("service",
+                           parts[4] if len(parts) > 4 else "", "write")]
+            elif parts[1:4] == ["connect", "ca", "roots"]:
+                checks = []  # public trust material
+            elif parts[1:3] == ["connect", "authorize"]:
                 # Reference AgentConnectAuthorize requires service
                 # write on the TARGET, not an agent permission.
                 try:
@@ -876,6 +895,46 @@ class HTTPApi:
             return 200, {"Chain": out["value"]}, {
                 "X-Consul-Index": str(out["index"])}
 
+        # ---- connect CA (reference agent/connect_ca_endpoint.go;
+        # /v1/connect/ca/* + the agent-side roots/leaf reads) -----------
+        if parts[:3] == ["connect", "ca", "roots"]:
+            if method != "GET":
+                return 405, {"error": "method not allowed"}, {}
+            out = rpc("ConnectCA.Roots", min_index=min_index,
+                      wait_s=wait_s)
+            v = out["value"]
+            return 200, {
+                "ActiveRootID": v["active_root_id"],
+                "TrustDomain": v["trust_domain"],
+                "Roots": [_ca_root_to_api(r) for r in v["roots"]],
+            }, {"X-Consul-Index": str(out["index"])}
+        if parts[:3] == ["connect", "ca", "configuration"]:
+            if method == "GET":
+                return 200, rpc("ConnectCA.ConfigurationGet"), {}
+            if method == "PUT":
+                req = json.loads(body or b"{}")
+                cfg = {bexpr.snake_case(k): v for k, v in req.items()}
+                rpc_write("ConnectCA.ConfigurationSet", config=cfg)
+                return 200, True, {}
+            return 405, {"error": "method not allowed"}, {}
+        if parts[:4] == ["agent", "connect", "ca", "roots"]:
+            # Agent-side mirror of the cluster roots (the proxy
+            # bootstrap read, agent_endpoint.go AgentConnectCARoots).
+            return self._route("GET", "/v1/connect/ca/roots", q, query,
+                               b"", min_index, wait_s, near, headers)
+        if len(parts) == 5 and parts[:4] == ["agent", "connect", "ca",
+                                             "leaf"]:
+            leaf = rpc("ConnectCA.Sign", service=parts[4])
+            return 200, {
+                "SerialNumber": leaf["serial_number"],
+                "CertPEM": leaf["cert_pem"],
+                "PrivateKeyPEM": leaf["private_key_pem"],
+                "Service": leaf["service"],
+                "ServiceURI": leaf["spiffe_id"],
+                "ValidAfter": leaf["valid_after"],
+                "ValidBefore": leaf["valid_before"],
+            }, {}
+
         # ---- intentions (reference agent/intentions_endpoint.go;
         # routes http_register.go /v1/connect/intentions*) --------------
         if parts[0] == "connect" and parts[1:2] == ["intentions"]:
@@ -1282,20 +1341,21 @@ class HTTPApi:
             if req.get("Check", {}).get("TTL"):
                 ttl = _dur_to_s(req["Check"]["TTL"])
             sid = req.get("ID", req["Name"])
+            dcsa = req.get("Check", {}).get(
+                "DeregisterCriticalServiceAfter")
+            if dcsa and ttl is None:
+                # Validate BEFORE mutating: accept-and-drop would be a
+                # silent lie, and a 400 must not leave the service
+                # half-registered (the reference rejects checks with
+                # no type).
+                return 400, {"error":
+                             "DeregisterCriticalServiceAfter "
+                             "requires a check (set Check.TTL)"}, {}
             self.agent.add_service(
                 sid, req["Name"],
                 req.get("Port", 0), req.get("Tags"), check_ttl_s=ttl,
             )
-            dcsa = req.get("Check", {}).get(
-                "DeregisterCriticalServiceAfter")
             if dcsa:
-                if ttl is None:
-                    # Accept-and-drop would be a silent lie: the reap
-                    # rides a check, so demand one (the reference
-                    # rejects checks with no type).
-                    return 400, {"error":
-                                 "DeregisterCriticalServiceAfter "
-                                 "requires a check (set Check.TTL)"}, {}
                 # The service's TTL check carries the reap timeout
                 # (reference check_type.go:55).
                 self.agent.set_reap_after(f"service:{sid}",
@@ -1634,6 +1694,14 @@ def _lower_keys(d: Optional[dict]) -> Optional[dict]:
     return {{"ID": "id", "Service": "service", "Port": "port",
              "Tags": "tags", "Meta": "meta"}.get(k, k.lower()): v
             for k, v in d.items()}
+
+
+def _ca_root_to_api(r: dict) -> dict:
+    return {"ID": r.get("id", ""), "Name": r.get("name", ""),
+            "RootCert": r.get("root_cert", ""),
+            "Active": bool(r.get("active")),
+            "TrustDomain": r.get("trust_domain", ""),
+            "NotAfter": r.get("not_after", "")}
 
 
 def _ixn_from_api(d: dict) -> dict:

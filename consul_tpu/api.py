@@ -603,6 +603,27 @@ class Connect:
         out, _, _ = self.c._call("GET", f"/v1/discovery-chain/{service}")
         return out["Chain"]
 
+    def ca_roots(self) -> dict:
+        """CA trust bundle (reference api/connect_ca.go CARoots)."""
+        out, _, _ = self.c._call("GET", "/v1/connect/ca/roots")
+        return out
+
+    def ca_get_config(self) -> dict:
+        out, _, _ = self.c._call("GET", "/v1/connect/ca/configuration")
+        return out
+
+    def ca_set_config(self, config: dict) -> bool:
+        out, _, _ = self.c._call("PUT", "/v1/connect/ca/configuration",
+                                 None, json.dumps(config).encode())
+        return bool(out)
+
+    def ca_leaf(self, service: str) -> dict:
+        """Mint/fetch a leaf certificate for a service (reference
+        api/agent.go ConnectCALeaf → /v1/agent/connect/ca/leaf)."""
+        out, _, _ = self.c._call(
+            "GET", f"/v1/agent/connect/ca/leaf/{service}")
+        return out
+
 
 class ACL:
     """Token + policy API (reference api/acl.go: ACL.Bootstrap,

@@ -157,23 +157,23 @@ class _Compiler:
         entry = self._entry("service-resolver", service) or {}
         redirect = entry.get("redirect") or {}
         r_svc = redirect.get("service", "")
-        if redirect and (r_svc and r_svc != service
-                         or redirect.get("service_subset")):
-            # A service/subset redirect re-enters the chain at the
-            # destination's resolver (compile.go), carrying any
-            # datacenter override along; cycle-guarded.
+        if redirect and r_svc and r_svc != service:
+            # A redirect to a DIFFERENT service re-enters the chain at
+            # the destination's resolver (compile.go), carrying subset
+            # and datacenter overrides along; cycle-guarded.
             self._guard(f"redirect:{service}")
             try:
                 return self.resolver_node(
-                    r_svc or service,
+                    r_svc,
                     redirect.get("service_subset", subset),
                     dc_override=redirect.get("datacenter", dc_override))
             finally:
                 self._unguard()
         if redirect:
-            # Datacenter-only redirect (a valid reference shape):
-            # same service, target pinned to that DC — no recursion,
-            # so it can never trip the cycle guard.
+            # Same-service redirect (subset-only and/or dc-only — both
+            # valid reference shapes): adopt the overrides WITHOUT
+            # recursion, so the cycle guard can never trip on them.
+            subset = redirect.get("service_subset", subset)
             dc_override = redirect.get("datacenter", dc_override)
         subset = subset or entry.get("default_subset", "")
         dc = dc_override or self.datacenter

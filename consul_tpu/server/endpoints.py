@@ -25,6 +25,7 @@ from __future__ import annotations
 import math
 import random
 import uuid
+from time import monotonic as _monotonic, sleep as _sleep
 from typing import Any, Optional
 
 from consul_tpu.server import acl as acl_mod
@@ -591,6 +592,129 @@ class Server:
                 docs.append(acl_mod.parse_rules(p.get("rules")))
         return {"known": True, "management": management, "rules": docs,
                 "accessor_id": t["accessor_id"]}
+
+    # ------------------------------------------------------------------
+    # ConnectCA endpoint (reference agent/consul/connect_ca_endpoint.go:
+    # Roots / ConfigurationGet / ConfigurationSet / Sign)
+    # ------------------------------------------------------------------
+    def _ca_ensure_initialized(self) -> dict:
+        """Active root, lazily minted on first use (the reference
+        initializes the CA when a leader establishes; lazy-on-demand
+        gives the same replicated outcome). Generation happens HERE,
+        the log carries the PEMs; a racing double-init resolves to one
+        winner via the FSM's only_if_uninitialized verdict."""
+        from consul_tpu.server import connect_ca as ca_mod
+        root = self.store.ca_active_root()
+        if root is not None:
+            return root
+        cfg = self.store.ca_config_get() or {}
+        cluster_id = cfg.get("cluster_id") or ca_mod.new_cluster_id()
+        new_root = ca_mod.generate_root(cluster_id)
+        init_idx = self._raft_apply(
+            {"type": fsm_mod.CONNECT_CA, "op": "set-root",
+             "root": new_root, "only_if_uninitialized": True})
+        if self.store.ca_config_get() is None:
+            self._raft_apply({"type": fsm_mod.CONNECT_CA,
+                              "op": "set-config",
+                              "config": {"provider": "consul",
+                                         "cluster_id": cluster_id}})
+        # The proposal applies when the raft pump commits it; poll for
+        # the replicated copy (a racing init may have won with a
+        # DIFFERENT root — the store is the truth, and a leaf minted
+        # under a losing root would verify against nothing in the
+        # bundle). Only when the entry provably never even resolved —
+        # no pump stepped at all, i.e. the step-driven test harness,
+        # where no concurrent proposer can exist either — fall back to
+        # the material we just proposed.
+        deadline = _monotonic() + 2.0
+        while _monotonic() < deadline:
+            root = self.store.ca_active_root()
+            if root is not None:
+                return root
+            res = self._status_apply_result(init_idx)
+            if res["found"] and res["result"] is False:
+                # Our init lost the race; the winner's root is about
+                # to land in the store — keep polling for it.
+                pass
+            _sleep(0.005)
+        res = self._status_apply_result(init_idx)
+        if res["found"] and res["result"] is False:
+            raise RuntimeError(
+                "connect CA init lost a race and the winning root "
+                "never became visible")
+        return new_root
+
+    @staticmethod
+    def _ca_public_root(root: dict) -> dict:
+        """A root WITHOUT its private key — what Roots() serves
+        (connect_ca_endpoint.go redacts signing material)."""
+        return {k: v for k, v in root.items() if k != "private_key"}
+
+    def _connectca_roots(self, min_index: int = 0,
+                         wait_s: float = 10.0) -> dict:
+        self._ca_ensure_initialized()
+
+        def fn():
+            roots = [self._ca_public_root(r)
+                     for r in self.store.ca_roots()]
+            active = next((r for r in roots if r.get("active")), None)
+            return {
+                "active_root_id": active["id"] if active else None,
+                "trust_domain": active.get("trust_domain")
+                if active else None,
+                "roots": roots,
+            }
+        return self._blocking(("connect_ca",), min_index, wait_s, fn)
+
+    def _connectca_configuration_get(self) -> dict:
+        self._ca_ensure_initialized()
+        return dict(self.store.ca_config_get() or {})
+
+    def _connectca_configuration_set(self, config: dict) -> dict:
+        """Apply CA config; supplying root material (or requesting
+        rotation) mints/installs a new ACTIVE root, keeping old roots
+        in the trust bundle (the reference's rotation, minus the
+        cross-signing intermediate window — documented)."""
+        from consul_tpu.server import connect_ca as ca_mod
+        cfg = dict(config)
+        rotate = bool(cfg.pop("rotate", False))
+        provided = cfg.pop("root_cert", None)
+        provided_key = cfg.pop("private_key", None)
+        cfg.setdefault("provider", "consul")
+        old = self.store.ca_config_get() or {}
+        cfg.setdefault("cluster_id",
+                       old.get("cluster_id") or ca_mod.new_cluster_id())
+        idx = self._raft_apply({"type": fsm_mod.CONNECT_CA,
+                                "op": "set-config", "config": cfg})
+        if provided and provided_key:
+            td = ca_mod.trust_domain(cfg["cluster_id"])
+            root = {"id": ca_mod.root_id(provided),
+                    "name": "Provided CA Root Cert",
+                    "root_cert": provided, "private_key": provided_key,
+                    "trust_domain": td}
+            idx = self._raft_apply({"type": fsm_mod.CONNECT_CA,
+                                    "op": "set-root", "root": root})
+        elif rotate:
+            idx = self._raft_apply({"type": fsm_mod.CONNECT_CA,
+                                    "op": "set-root",
+                                    "root": ca_mod.generate_root(
+                                        cfg["cluster_id"])})
+        # A bare int return rides _rpc_write's synchronous-raftApply
+        # contract: the HTTP 200 waits for the LAST applied entry
+        # (the rotation, when one happened), so a rotate-then-read
+        # sequence observes the new bundle.
+        return idx
+
+    def _connectca_sign(self, service: str,
+                        ttl_s: Optional[float] = None) -> dict:
+        """Mint a leaf for ``service`` under the active root
+        (connect_ca_endpoint.go Sign; the /v1/agent/connect/ca/leaf
+        read rides this)."""
+        from consul_tpu.server import connect_ca as ca_mod
+        root = self._ca_ensure_initialized()
+        return ca_mod.sign_leaf(
+            root, service, self.dc,
+            ttl_s=ttl_s or ca_mod.DEFAULT_LEAF_TTL_S)
 
     # ------------------------------------------------------------------
     # DiscoveryChain endpoint (reference agent/consul/

@@ -25,6 +25,7 @@ AUTOPILOT = "autopilot"
 PREPARED_QUERY = "prepared-query"
 ACL = "acl"
 INTENTION = "intention"
+CONNECT_CA = "connect-ca"
 TXN = "txn"
 
 # Tables each op type can write (for scoped TXN undo logs). KV ops can
@@ -38,6 +39,7 @@ _TXN_TABLES: dict[str, set] = {
     PREPARED_QUERY: {"prepared_queries"},
     ACL: {"acl_tokens", "acl_policies", "acl_meta"},
     INTENTION: {"intentions"},
+    CONNECT_CA: {"connect_ca"},
     REGISTER: {"nodes", "services", "checks"},
     DEREGISTER: {"nodes", "services", "checks", "coordinates",
                  "sessions", "kv", "prepared_queries"},
@@ -187,6 +189,23 @@ class FSM:
                 self.store.acl_token_set(command["token"], index=index)
                 return True
             raise ValueError(f"unknown ACL op {op!r}")
+        if mtype == CONNECT_CA:
+            # Reference fsm applyConnectCAOperation: the PEM material
+            # is generated ONCE at the endpoint and carried in the log
+            # (an FSM must never generate randomness); init is
+            # idempotent — a racing second init is a False verdict.
+            op = command["op"]
+            if op == "set-root":
+                if command.get("only_if_uninitialized") and \
+                        self.store.ca_active_root() is not None:
+                    return False
+                self.store.ca_set_root(command["root"],
+                                       activate=True, index=index)
+                return command["root"]["id"]
+            if op == "set-config":
+                self.store.ca_config_set(command["config"], index=index)
+                return True
+            raise ValueError(f"unknown connect-ca op {op!r}")
         if mtype == INTENTION:
             # Reference fsm applyIntentionOperation: upsert/delete by
             # id; a duplicate (source, destination) pair on a

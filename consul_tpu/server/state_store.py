@@ -71,6 +71,7 @@ class StateStore:
         "acl_policies",   # policy name -> {id, rules, description}
         "acl_meta",       # "bootstrap" -> one-shot marker
         "intentions",     # intention id -> {source, destination, action}
+        "connect_ca",     # "config" + "root:<id>" -> CA material
     )
 
     def __init__(self):
@@ -548,6 +549,47 @@ class StateStore:
     def acl_mark_bootstrapped(self, index: Optional[int] = None) -> int:
         return self._commit("acl_meta", "bootstrap", {"done": True},
                             index=index)
+
+    # ------------------------------------------------------------------
+    # Connect CA (reference state/connect_ca.go)
+    # ------------------------------------------------------------------
+    def ca_set_root(self, root: dict, activate: bool = True,
+                    index: Optional[int] = None) -> int:
+        """Store a root; activating it deactivates every other root
+        (reference CARootSetCAS keeps old roots inactive for trust-
+        bundle continuity)."""
+        with self._lock:
+            idx = index
+            if activate:
+                for k, e in list(self.tables["connect_ca"].rows.items()):
+                    if k.startswith("root:") and e.value.get("active"):
+                        idx = self._commit(
+                            "connect_ca", k,
+                            e.value | {"active": False}, index=idx)
+            return self._commit("connect_ca", f"root:{root['id']}",
+                                dict(root, active=activate), index=idx)
+
+    def ca_roots(self) -> list[dict]:
+        with self._lock:
+            return [e.value for k, e in
+                    sorted(self.tables["connect_ca"].rows.items())
+                    if k.startswith("root:")]
+
+    def ca_active_root(self) -> Optional[dict]:
+        with self._lock:
+            for k, e in self.tables["connect_ca"].rows.items():
+                if k.startswith("root:") and e.value.get("active"):
+                    return e.value
+            return None
+
+    def ca_config_set(self, config: dict,
+                      index: Optional[int] = None) -> int:
+        return self._commit("connect_ca", "config", config, index=index)
+
+    def ca_config_get(self) -> Optional[dict]:
+        with self._lock:
+            e = self.tables["connect_ca"].rows.get("config")
+            return None if e is None else e.value
 
     # ------------------------------------------------------------------
     # Intentions (reference state/intention.go)

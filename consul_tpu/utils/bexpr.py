@@ -74,6 +74,11 @@ def _tokenize(src: str) -> list[tuple[str, str]]:
     return out
 
 
+def snake_case(name: str) -> str:
+    """Public camel→snake helper (shared with the HTTP tier)."""
+    return _snake(name)
+
+
 def _snake(name: str) -> str:
     out = []
     for i, ch in enumerate(name):

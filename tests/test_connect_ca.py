@@ -1,0 +1,156 @@
+"""Connect CA (reference connect_ca_endpoint.go + provider_consul.go):
+real X.509 — EC P-256 roots with SPIFFE trust domains, service leaf
+certs that verify, rotation keeping old roots in the bundle."""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu.server import connect_ca as ca
+from consul_tpu.server.endpoints import ServerCluster
+
+
+class TestCrypto:
+    def test_root_and_leaf_verify(self):
+        root = ca.generate_root("11111111-2222-3333-4444-555555555555")
+        assert root["trust_domain"].endswith(".consul")
+        leaf = ca.sign_leaf(root, "web", "dc1")
+        assert ca.verify_leaf(leaf["cert_pem"], root["root_cert"])
+        assert leaf["spiffe_id"].endswith("/ns/default/dc/dc1/svc/web")
+        # A different root does NOT verify it.
+        other = ca.generate_root("99999999-2222-3333-4444-555555555555")
+        assert not ca.verify_leaf(leaf["cert_pem"], other["root_cert"])
+
+    def test_leaf_san_carries_spiffe_uri(self):
+        from cryptography import x509
+        root = ca.generate_root("0" * 8)
+        leaf = ca.sign_leaf(root, "payments", "dc9")
+        cert = x509.load_pem_x509_certificate(leaf["cert_pem"].encode())
+        san = cert.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName).value
+        uris = list(
+            san.get_values_for_type(x509.UniformResourceIdentifier))
+        assert uris == [ca.spiffe_id(root["trust_domain"], "dc9",
+                                     "payments")]
+
+
+@pytest.fixture
+def cluster():
+    c = ServerCluster(3, seed=43)
+    c.wait_converged()
+    return c
+
+
+def pumped_write(cluster, fn):
+    out = fn()
+    for _ in range(100):
+        cluster.step()
+    return out
+
+
+class TestEndpoint:
+    def test_lazy_init_replicates_roots(self, cluster):
+        leader = cluster.leader_server()
+        # First call proposes the init; the harness pumps it through,
+        # then the bundle reads back (live runtimes pump continuously,
+        # covered by the endpoint's short confirmation poll).
+        pumped_write(cluster, lambda: leader.rpc("ConnectCA.Roots"))
+        v = leader.rpc("ConnectCA.Roots")["value"]
+        assert v["active_root_id"] and v["trust_domain"]
+        assert all("private_key" not in r for r in v["roots"])
+        # The root (WITH key) replicated to every server's store.
+        for s in cluster.servers:
+            r = s.store.ca_active_root()
+            assert r is not None and r["id"] == v["active_root_id"]
+
+    def test_sign_verifies_against_served_root(self, cluster):
+        leader = cluster.leader_server()
+        pumped_write(cluster, lambda: leader.rpc("ConnectCA.Roots"))
+        cluster.step(50)
+        leaf = leader.rpc("ConnectCA.Sign", service="api")
+        roots = leader.rpc("ConnectCA.Roots")["value"]["roots"]
+        active = next(r for r in roots if r["active"])
+        assert ca.verify_leaf(leaf["cert_pem"], active["root_cert"])
+        assert leaf["root_id"] == active["id"]
+
+    def test_rotation_keeps_old_root_inactive(self, cluster):
+        leader = cluster.leader_server()
+        pumped_write(cluster, lambda: leader.rpc("ConnectCA.Roots"))
+        old_id = leader.rpc(
+            "ConnectCA.Roots")["value"]["active_root_id"]
+        old_leaf = leader.rpc("ConnectCA.Sign", service="w")
+        pumped_write(cluster, lambda: leader.rpc(
+            "ConnectCA.ConfigurationSet", config={"rotate": True}))
+        v = leader.rpc("ConnectCA.Roots")["value"]
+        assert v["active_root_id"] != old_id
+        assert len(v["roots"]) == 2
+        old = next(r for r in v["roots"] if r["id"] == old_id)
+        assert old["active"] is False
+        # Old leaves still verify against the retained old root.
+        assert ca.verify_leaf(old_leaf["cert_pem"], old["root_cert"])
+        # New leaves verify against the new one.
+        new = next(r for r in v["roots"] if r["active"])
+        new_leaf = leader.rpc("ConnectCA.Sign", service="w")
+        assert ca.verify_leaf(new_leaf["cert_pem"], new["root_cert"])
+
+
+class TestHTTP:
+    def test_roots_and_leaf_over_the_wire(self):
+        from consul_tpu.agent.agent import Agent
+        from consul_tpu.agent.http import HTTPApi, serve
+        from consul_tpu.api import Client
+
+        cluster = ServerCluster(3, seed=47)
+        cluster.wait_converged()
+        stop = threading.Event()
+        lock = threading.Lock()
+
+        def pump():
+            while not stop.is_set():
+                with lock:
+                    cluster.step()
+                time.sleep(0.002)
+
+        threading.Thread(target=pump, daemon=True).start()
+
+        def rpc(method, **args):
+            with lock:
+                server = cluster.registry[
+                    cluster.raft.wait_converged().id]
+            return server.rpc(method, **args)
+
+        def wait_write(idx):
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                with lock:
+                    led = cluster.raft.leader()
+                    if led is not None and led.last_applied >= idx:
+                        return
+                time.sleep(0.002)
+
+        agent = Agent("ca-agent", "10.91.0.1", rpc, cluster_size=3)
+        api = HTTPApi(agent, wait_write=wait_write)
+        httpd, port = serve(api)
+        try:
+            client = Client("127.0.0.1", port)
+            roots = client.connect.ca_roots()
+            assert roots["ActiveRootID"]
+            assert roots["Roots"][0]["RootCert"].startswith(
+                "-----BEGIN CERTIFICATE-----")
+            assert "PrivateKey" not in roots["Roots"][0]
+            leaf = client.connect.ca_leaf("web")
+            assert leaf["Service"] == "web"
+            assert ca.verify_leaf(leaf["CertPEM"],
+                                  next(r["RootCert"]
+                                       for r in roots["Roots"]
+                                       if r["Active"]))
+            # Agent-side roots mirror.
+            mirrored, _, _ = client._call(
+                "GET", "/v1/agent/connect/ca/roots")
+            assert mirrored["ActiveRootID"] == roots["ActiveRootID"]
+            cfg = client.connect.ca_get_config()
+            assert cfg["provider"] == "consul" and cfg["cluster_id"]
+        finally:
+            stop.set()
+            httpd.shutdown()

@@ -176,3 +176,16 @@ class TestEndpoint:
         finally:
             stop.set()
             httpd.shutdown()
+
+
+class TestRedirectShapes:
+    def test_subset_only_redirect(self):
+        # A subset-only redirect (same service) adopts the subset
+        # without recursion — never a spurious cycle error.
+        entries = {("service-resolver", "web"): {
+            "redirect": {"service_subset": "v2"},
+            "subsets": {"v2": {"filter": "x"}}}}
+        chain = compile_chain(store(entries), "web")
+        node = chain["nodes"][chain["start_node"]]
+        tgt = chain["targets"][node["resolver"]["target"]]
+        assert tgt["service"] == "web" and tgt["service_subset"] == "v2"
