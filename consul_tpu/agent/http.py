@@ -933,6 +933,9 @@ class HTTPApi:
                 "ServiceURI": leaf["spiffe_id"],
                 "ValidAfter": leaf["valid_after"],
                 "ValidBefore": leaf["valid_before"],
+                # Which root signed it — the rotation signal the
+                # connect_leaf watch keys on.
+                "RootID": leaf["root_id"],
             }, {}
 
         # ---- intentions (reference agent/intentions_endpoint.go;

@@ -752,6 +752,8 @@ class WatchPlan:
       checks     [state=|service=]  health checks       (checksWatch)
       event      [name=]            agent user events   (eventWatch)
       agent_service  service_id=    one LOCAL service   (agentServiceWatch)
+      connect_roots  —              CA trust bundle     (connectRootsWatch)
+      connect_leaf   service=       one service's leaf  (connectLeafWatch)
 
     ``handler(index, result)`` is the WatchPlan Handler contract. Drive
     it explicitly with :meth:`run_once` (tests, schedulers) or loop it
@@ -765,7 +767,8 @@ class WatchPlan:
     """
 
     TYPES = ("key", "keyprefix", "services", "nodes", "service",
-             "checks", "event", "agent_service")
+             "checks", "event", "agent_service", "connect_roots",
+             "connect_leaf")
 
     def __init__(self, client: Client, wtype: str, handler, **params):
         if wtype not in self.TYPES:
@@ -776,9 +779,10 @@ class WatchPlan:
         self.params = params
         self.index = 0
         self._stop = False
-        # Hash-watch state (agent_service).
+        # Hash-watch state (agent_service / connect_leaf).
         self._last_hash = None
         self._hash_seq = 0
+        self._leaf_cache = None
 
     def _query(self, wait: str):
         c, p = self.client, self.params
@@ -827,6 +831,25 @@ class WatchPlan:
             out, meta, _ = c._call(
                 "GET", "/v1/event/list", {"name": p.get("name"), **idx})
             return meta.index, out
+        if self.type == "connect_roots":
+            out, meta, _ = c._call("GET", "/v1/connect/ca/roots", idx)
+            return meta.index, out
+        if self.type == "connect_leaf":
+            # Change detection rides the CHEAP roots read (minting a
+            # leaf generates a keypair + signs a cert server-side —
+            # doing that every poll round and discarding it would be
+            # ~86k wasted signings/day per watched service). A fresh
+            # leaf is fetched only when the active root actually
+            # changed — rotation, the reload signal a proxy needs.
+            roots, _, _ = c._call("GET", "/v1/connect/ca/roots")
+            digest = roots["ActiveRootID"]
+            if digest != self._last_hash:
+                self._last_hash = digest
+                self._hash_seq += 1
+                self._leaf_cache = c._call(
+                    "GET",
+                    f"/v1/agent/connect/ca/leaf/{p['service']}")[0]
+            return self._hash_seq, self._leaf_cache
         if self.type == "agent_service":
             out, _, status = c._call(
                 "GET", f"/v1/agent/service/{p['service_id']}")
@@ -846,7 +869,7 @@ class WatchPlan:
         (the reference's watch retry interval)."""
         new_index, result = self._query(wait)
         if new_index == self.index:
-            if self.type == "agent_service":
+            if self.type in ("agent_service", "connect_leaf"):
                 try:
                     w = float(str(wait).rstrip("s"))
                 except ValueError:

@@ -16,6 +16,7 @@ framework implements:
   leave            graceful leave + shutdown           (command/leave)
   acl              bootstrap / policy / token CRUD     (command/acl)
   intention        create|get|list|delete|match|check  (command/intention)
+  connect ca       get-config|set-config               (command/connect/ca)
   event fire|list / watch / force-leave / debug
   operator raft list-peers|remove-peer                 (command/operator)
   operator autopilot get-config|set-config|health
@@ -371,6 +372,25 @@ def cmd_acl(client: Client, args) -> int:
                 print(f"{t['AccessorID']}  [{pols}] {t['Description']}")
             return 0
     raise AssertionError(args.acl_cmd)
+
+
+def cmd_connect(client: Client, args) -> int:
+    """Connect CA management (reference command/connect/ca:
+    get-config / set-config)."""
+    if args.connect_cmd == "ca" and args.ca_cmd == "get-config":
+        print(json.dumps(client.connect.ca_get_config(), indent=2))
+        return 0
+    if args.connect_cmd == "ca" and args.ca_cmd == "set-config":
+        try:
+            with open(args.config_file, encoding="utf-8") as f:
+                cfg = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        client.connect.ca_set_config(cfg)
+        print("Configuration updated!")
+        return 0
+    raise AssertionError(args.connect_cmd)
 
 
 def cmd_intention(client: Client, args) -> int:
@@ -774,6 +794,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("leave", help="gracefully leave and shut down the agent")
 
+    conn_p = sub.add_parser("connect", help="connect CA management")
+    conn_sub = conn_p.add_subparsers(dest="connect_cmd", required=True)
+    ca_p = conn_sub.add_parser("ca")
+    ca_sub = ca_p.add_subparsers(dest="ca_cmd", required=True)
+    ca_sub.add_parser("get-config")
+    ca_sc = ca_sub.add_parser("set-config")
+    ca_sc.add_argument("-config-file", required=True)
+
     ixn_p = sub.add_parser("intention", help="connect intentions")
     ixn_sub = ixn_p.add_subparsers(dest="intention_cmd", required=True)
     ic = ixn_sub.add_parser("create")
@@ -891,7 +919,7 @@ COMMANDS = {
     "sessions": cmd_sessions, "snapshot": cmd_snapshot, "debug": cmd_debug,
     "event": cmd_event, "watch": cmd_watch, "join": cmd_join,
     "force-leave": cmd_force_leave, "leave": cmd_leave, "acl": cmd_acl,
-    "intention": cmd_intention,
+    "intention": cmd_intention, "connect": cmd_connect,
     "operator": cmd_operator, "maint": cmd_maint, "keyring": cmd_keyring,
     "monitor": cmd_monitor, "validate": cmd_validate, "lock": cmd_lock,
     "exec": cmd_exec, "reload": cmd_reload, "config": cmd_config,
