@@ -18,6 +18,6 @@ Layout:
   utils/       — convergence metrics, checkpointing, telemetry.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 from consul_tpu import config as config  # noqa: F401

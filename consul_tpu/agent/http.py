@@ -1335,9 +1335,16 @@ class HTTPApi:
             # go-metrics DisplayMetrics shape (reference
             # http_register.go:39 -> lib/telemetry.go InmemSink), with
             # the agent's own duty counters folded in as gauges.
+            # ?format=prometheus renders the text exposition format
+            # (agent_endpoint.go:90 promhttp).
             for k, v in self.agent.metrics.items():
                 self.agent.sink.set_gauge(f"consul.agent.{k}", v)
-            return 200, self.agent.sink.snapshot(), {}
+            snap = self.agent.sink.snapshot()
+            if q.get("format") == "prometheus":
+                from consul_tpu.utils import telemetry as _tm
+                return 200, _tm.to_prometheus(snap), {
+                    "Content-Type": "text/plain; version=0.0.4"}
+            return 200, snap, {}
         if parts == ["agent", "service", "register"] and method == "PUT":
             req = json.loads(body)
             ttl = None
@@ -1905,9 +1912,15 @@ class _Handler(BaseHTTPRequestHandler):
             parse_qs(parsed.query, keep_blank_values=True), body,
             headers=dict(self.headers),
         )
-        data = json.dumps(payload).encode()
+        if isinstance(payload, str) and headers.get(
+                "Content-Type", "").startswith("text/"):
+            # Raw text responses (Prometheus exposition format).
+            data = payload.encode()
+        else:
+            data = json.dumps(payload).encode()
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type",
+                         headers.pop("Content-Type", "application/json"))
         self.send_header("Content-Length", str(len(data)))
         for k, v in headers.items():
             self.send_header(k, v)

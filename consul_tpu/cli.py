@@ -51,6 +51,15 @@ def make_client(args) -> Client:
                   or os.environ.get("CONSUL_TPU_TOKEN", ""))
 
 
+def cmd_version(client: Client, args) -> int:
+    # One version source: the package (reference command/version reads
+    # the build's version package).
+    from consul_tpu import __version__
+    print(f"consul-tpu v{__version__}")
+    print("Protocol: consul-capability framework, TPU-native backend")
+    return 0
+
+
 def cmd_members(client: Client, args) -> int:
     if getattr(args, "wan", False):
         # Reference `consul members -wan`: the WAN server pool.
@@ -793,6 +802,7 @@ def build_parser() -> argparse.ArgumentParser:
     fl.add_argument("node")
 
     sub.add_parser("leave", help="gracefully leave and shut down the agent")
+    sub.add_parser("version", help="print the version")
 
     conn_p = sub.add_parser("connect", help="connect CA management")
     conn_sub = conn_p.add_subparsers(dest="connect_cmd", required=True)
@@ -920,6 +930,7 @@ COMMANDS = {
     "event": cmd_event, "watch": cmd_watch, "join": cmd_join,
     "force-leave": cmd_force_leave, "leave": cmd_leave, "acl": cmd_acl,
     "intention": cmd_intention, "connect": cmd_connect,
+    "version": cmd_version,
     "operator": cmd_operator, "maint": cmd_maint, "keyring": cmd_keyring,
     "monitor": cmd_monitor, "validate": cmd_validate, "lock": cmd_lock,
     "exec": cmd_exec, "reload": cmd_reload, "config": cmd_config,

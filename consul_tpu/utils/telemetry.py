@@ -107,6 +107,34 @@ class Sink:
             }
 
 
+def to_prometheus(snapshot: dict) -> str:
+    """Render a DisplayMetrics snapshot in the Prometheus text
+    exposition format (reference /v1/agent/metrics?format=prometheus,
+    agent_endpoint.go:90 via promhttp). Metric names sanitize the
+    go-metrics dotted names the Prometheus way (dots → underscores)."""
+    def norm(name: str) -> str:
+        return "".join(ch if ch.isalnum() or ch == "_" else "_"
+                       for ch in name)
+
+    lines: list[str] = []
+    for g in snapshot.get("Gauges", []):
+        n = norm(g["Name"])
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {float(g['Value'])}")
+    for c in snapshot.get("Counters", []):
+        n = norm(c["Name"])
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {float(c.get('Sum', c.get('Count', 0)))}")
+    for s in snapshot.get("Samples", []):
+        n = norm(s["Name"])
+        # Samples render as a summary (count + sum), the promhttp
+        # convention for go-metrics samples.
+        lines.append(f"# TYPE {n} summary")
+        lines.append(f"{n}_count {float(s.get('Count', 0))}")
+        lines.append(f"{n}_sum {float(s.get('Sum', 0.0))}")
+    return "\n".join(lines) + "\n"
+
+
 def emit_sim_metrics(state, sink: Sink,
                      health=None, rmse_s: Optional[float] = None,
                      rounds_per_sec: Optional[float] = None,

@@ -227,3 +227,68 @@ class TestSessionTTLLive:
         except urllib.error.HTTPError as e:
             raised = e.code == 404
         assert raised
+
+
+class TestKitchenSinkBoot:
+    def test_tls_acl_dns_together(self, tmp_path):
+        """Every boot-time subsystem at once — HTTPS + ACL default-deny
+        + DNS + data_dir durability — the combination a hardened
+        deployment runs (integration combos break where singles
+        pass)."""
+        from consul_tpu.utils import tls as tls_mod
+
+        paths = tls_mod.dev_ca(str(tmp_path / "tls"))
+        cfg = tmp_path / "full.json"
+        cfg.write_text(json.dumps({
+            "node_name": "fort", "n_servers": 3,
+            "data_dir": str(tmp_path / "data"),
+            "http": {"host": "127.0.0.1", "port": 0},
+            "dns": {"host": "127.0.0.1", "port": 0},
+            "acl": {"enabled": True, "default_policy": "deny"},
+            "tls": {"cert": paths["cert"], "key": paths["key"],
+                    "ca": paths["ca"]},
+        }))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "consul_tpu.cli", "agent",
+             "--config-file", str(cfg)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        try:
+            ready = json.loads(proc.stdout.readline())
+            port = ready["http_port"]
+            from consul_tpu.agent import dns as dnsm
+            from consul_tpu.api import APIError, Client
+
+            anon = Client("127.0.0.1", port)
+            # ACL bites over plain HTTP (the TLS block guards the RPC
+            # wire; HTTP here stays plain in this config).
+            try:
+                anon.kv.put("x", b"v")
+                raise AssertionError("expected 403")
+            except APIError as e:
+                assert e.status == 403
+            boot = anon.acl.bootstrap()
+            mgmt = Client("127.0.0.1", port, token=boot["SecretID"])
+            assert mgmt.kv.put("fort/k", b"v")
+            # Prometheus metrics render as text.
+            import urllib.request
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/agent/metrics"
+                "?format=prometheus")
+            req.add_header("X-Consul-Token", boot["SecretID"])
+            body = urllib.request.urlopen(req).read().decode()
+            assert "# TYPE" in body and "consul_agent_syncs" in body
+            # DNS answers node lookups alongside everything else.
+            msg = dnsm.lookup("127.0.0.1", ready["dns_port"],
+                              "fort.node.consul")
+            assert msg["answers"][0]["value"] == "127.0.0.1"
+            # version verb
+            out = subprocess.run(
+                [sys.executable, "-m", "consul_tpu.cli", "version"],
+                capture_output=True, text=True, env=env, timeout=30)
+            assert out.returncode == 0 and "consul-tpu v" in out.stdout
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=20) == 0
