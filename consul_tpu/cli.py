@@ -278,7 +278,9 @@ def cmd_watch(client: Client, args) -> int:
     for kv in args.param or []:
         k, _, v = kv.partition("=")
         params[k] = v
-    required = {"key": ["key"], "service": ["service"]}.get(args.type, [])
+    required = {"key": ["key"], "service": ["service"],
+                "agent_service": ["service_id"],
+                "connect_leaf": ["service"]}.get(args.type, [])
     missing = [r for r in required if r not in params]
     if missing:
         print(f"watch --type {args.type} requires --param "
@@ -788,7 +790,9 @@ def build_parser() -> argparse.ArgumentParser:
     w_p = sub.add_parser("watch", help="watch a view for changes")
     w_p.add_argument("--type", required=True,
                      choices=("key", "keyprefix", "services", "nodes",
-                              "service", "checks", "event"))
+                              "service", "checks", "event",
+                              "agent_service", "connect_roots",
+                              "connect_leaf"))
     w_p.add_argument("--param", action="append",
                      help="watch parameter key=value (e.g. key=config/db)")
     w_p.add_argument("--once", action="store_true")
