@@ -82,6 +82,13 @@ _DEFAULTS = {
     # ACLs (reference acl block): {"enabled": true, "default_policy":
     # "allow"|"deny", "master_token": "..."}; null = ACLs off.
     "acl": None,
+    # WAN federation across PROCESSES (reference -retry-join-wan /
+    # ports.serf_wan): RPC addresses ("host:port") of servers in OTHER
+    # datacenters. Each is dialed over the msgpack-RPC wire, its DC
+    # learned via Status.Datacenter, and registered in the WAN router
+    # so ?dc= forwarding crosses process boundaries. Federation is
+    # per-direction: each side lists the other.
+    "wan_join_rpc": [],
     "sim": None,
 }
 
@@ -147,6 +154,8 @@ def load_config(path: Optional[str], overrides: Optional[dict] = None) -> dict:
     # (/v1/agent/join) routes it onto a server set.
     for addr in cfg["retry_join_rpc"]:
         _parse_hostport(addr, field="retry_join_rpc entry")
+    for addr in cfg["wan_join_rpc"]:
+        _parse_hostport(addr, field="wan_join_rpc entry")
     _validate_tls(cfg)
     if cfg["sim"] is not None:
         # Validate the gossip tunables through the layered loader.
@@ -161,6 +170,47 @@ def _parse_hostport(addr: str, field: str = "address") -> tuple[str, int]:
     if not host or not port.isdigit():
         raise ValueError(f"{field} {addr!r} is not host:port")
     return host, int(port)
+
+
+class _WanWireRemote:
+    """A remote-DC server reachable over the msgpack-RPC wire, shaped
+    like a local Server for the router/forwardDC path (``rpc`` +
+    raft-liveness duck type). A connection failure puts it on a short
+    COOLDOWN — not a terminal blacklist: RpcClient reconnects on the
+    next call, and a transient timeout (or the wire's busy-as-
+    ConnectionError under load) must not sever cross-DC routing
+    forever. The reference's NotifyFailedServer likewise only cycles
+    the server in the rotation."""
+
+    FAIL_COOLDOWN_S = 5.0
+
+    class _Liveness:
+        def __init__(self):
+            self.failed_until = 0.0
+
+        @property
+        def stopped(self) -> bool:
+            return time.monotonic() < self.failed_until
+
+    def __init__(self, wan_id: str, dc: str, client):
+        self.id = wan_id
+        self.dc = dc
+        self._client = client
+        self.raft = self._Liveness()
+
+    def rpc(self, method: str, **args):
+        try:
+            return self._client.call(method, **args)
+        except (ConnectionError, OSError):
+            self.raft.failed_until = time.monotonic() + \
+                self.FAIL_COOLDOWN_S
+            raise
+
+    def close(self):
+        try:
+            self._client.close()
+        except Exception:  # noqa: BLE001 — teardown must not raise
+            pass
 
 
 class AgentRuntime:
@@ -248,7 +298,74 @@ class AgentRuntime:
         self.rpc_port = self.rpc_listener.port
         api_server = self.cluster.registry[
             self.cluster.raft.wait_converged().id]
+        if cfg["wan_join_rpc"]:
+            self._join_wan_over_wire(cfg, tls)
         return rpc, wait_write, api_server
+
+    def _join_wan_over_wire(self, cfg: dict, tls) -> None:
+        """Federate this DC with remote-DC server PROCESSES over the
+        msgpack-RPC wire (the reference's WAN serf + yamux pool,
+        process-shaped): dial each wan_join_rpc address, learn its DC
+        via Status.Datacenter, and register a wire-backed proxy in
+        every local server's router so forwardDC crosses process
+        boundaries. Addresses that are unreachable at boot RETRY on a
+        background loop until they join (the reference's
+        -retry-join-wan contract) — a supervisor starting both DCs
+        concurrently must not lose federation to boot order."""
+        self._wan_remotes: list[_WanWireRemote] = []
+        self._wan_tls = tls
+        pending = self._wan_try_join(cfg, list(cfg["wan_join_rpc"]))
+        if pending:
+            def retry():
+                left = pending
+                while left and not self._stop.is_set():
+                    self._stop.wait(5.0)
+                    if self._stop.is_set():
+                        return
+                    left = self._wan_try_join(cfg, left)
+            threading.Thread(target=retry, daemon=True).start()
+
+    def _wan_try_join(self, cfg: dict, addrs: list) -> list:
+        """Dial each address once; returns the ones still unreachable.
+        Every success re-registers the routers with the full remote
+        set."""
+        from consul_tpu.server.rpc_wire import RpcClient
+        from consul_tpu.server.router import Router, flood_join
+
+        remaining = []
+        joined_any = False
+        for addr in addrs:
+            host, port = _parse_hostport(addr, field="wan_join_rpc entry")
+            try:
+                client = RpcClient(host, port, tls=self._wan_tls)
+                dc = client.call("Status.Datacenter")
+            except (OSError, ConnectionError, ValueError) as e:
+                print(f"agent: wan join {addr}: unreachable ({e}); "
+                      "will retry", file=sys.stderr)
+                remaining.append(addr)
+                continue
+            if dc == cfg["datacenter"]:
+                print(f"agent: wan join {addr}: same datacenter "
+                      f"{dc!r}; skipping", file=sys.stderr)
+                client.close()
+                continue
+            self._wan_remotes.append(
+                _WanWireRemote(f"wire:{addr}.{dc}", dc, client))
+            joined_any = True
+        if joined_any:
+            wan_registry = {s.wan_id: s for s in self.cluster.servers}
+            wan_registry.update({r.id: r for r in self._wan_remotes})
+            local_ids = [s.wan_id for s in self.cluster.servers]
+            by_dc: dict = {}
+            for r in self._wan_remotes:
+                by_dc.setdefault(r.dc, []).append(r.id)
+            for s in self.cluster.servers:
+                router = Router(local_dc=cfg["datacenter"])
+                flood_join(router, cfg["datacenter"], local_ids)
+                for dc, ids in by_dc.items():
+                    flood_join(router, dc, ids)
+                s.join_wan(router, wan_registry)
+        return remaining
 
     def _build_client_tier(self):
         """Client mode: no local consensus — every RPC rides the wire
@@ -432,6 +549,8 @@ class AgentRuntime:
 
     def shutdown(self):
         self._stop.set()
+        for r in getattr(self, "_wan_remotes", []):
+            r.close()
         if self.dns is not None:
             self.dns.close()
         if self.rpc_listener is not None:

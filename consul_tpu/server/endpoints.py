@@ -199,6 +199,12 @@ class Server:
     def _status_peers(self) -> list[str]:
         return sorted([self.raft.id, *self.raft.peers])
 
+    def _status_datacenter(self) -> str:
+        """This server's datacenter — the WAN-join handshake reads it
+        over the wire to learn which DC an address belongs to (the
+        reference learns it from serf WAN member tags)."""
+        return self.dc
+
     # ------------------------------------------------------------------
     # Catalog endpoint (reference agent/consul/catalog_endpoint.go)
     # ------------------------------------------------------------------

@@ -292,3 +292,113 @@ class TestKitchenSinkBoot:
         finally:
             proc.send_signal(signal.SIGTERM)
             assert proc.wait(timeout=20) == 0
+
+
+class TestWanFederationAcrossProcesses:
+    def test_dc2_reads_and_writes_dc1_over_the_wire(self, tmp_path):
+        """Two server PROCESSES in different datacenters federate over
+        the msgpack-RPC wire (wan_join_rpc): ?dc= forwarding crosses
+        the process boundary — the reference's WAN story, process-
+        shaped."""
+        from consul_tpu.api import Client
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+        cfg1 = tmp_path / "dc1.json"
+        cfg1.write_text(json.dumps({
+            "node_name": "one", "n_servers": 1, "datacenter": "dc1",
+            "http": {"host": "127.0.0.1", "port": 0},
+        }))
+        p1 = subprocess.Popen(
+            [sys.executable, "-m", "consul_tpu.cli", "agent",
+             "--config-file", str(cfg1)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        p2 = None
+        try:
+            r1 = json.loads(p1.stdout.readline())
+            cfg2 = tmp_path / "dc2.json"
+            cfg2.write_text(json.dumps({
+                "node_name": "two", "n_servers": 1, "datacenter": "dc2",
+                "http": {"host": "127.0.0.1", "port": 0},
+                "wan_join_rpc": [f"127.0.0.1:{r1['rpc_port']}"],
+            }))
+            p2 = subprocess.Popen(
+                [sys.executable, "-m", "consul_tpu.cli", "agent",
+                 "--config-file", str(cfg2)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env)
+            r2 = json.loads(p2.stdout.readline())
+            c2 = Client("127.0.0.1", r2["http_port"])
+            c1 = Client("127.0.0.1", r1["http_port"])
+            # dc2 sees both datacenters through its router.
+            assert set(c2.catalog.datacenters()) == {"dc1", "dc2"}
+            # A write from dc2 addressed to dc1 lands in dc1's store...
+            assert c2.kv.put("wan/k", b"from-dc2", dc="dc1")
+            row, _ = c1.kv.get("wan/k")
+            assert row is not None and row["Value"] == b"from-dc2"
+            # ...and dc2 reads it back through the forward.
+            row, _ = c2.kv.get("wan/k", dc="dc1")
+            assert row["Value"] == b"from-dc2"
+            # Local keyspaces stay separate.
+            assert c2.kv.get("wan/k")[0] is None
+        finally:
+            for p in (p1, p2):
+                if p is not None:
+                    p.send_signal(signal.SIGTERM)
+                    assert p.wait(timeout=20) == 0
+
+    def test_wan_join_retries_until_remote_boots(self, tmp_path):
+        """Boot-order independence (reference -retry-join-wan): dc2
+        lists a dc1 address that is not up yet; the background retry
+        joins once dc1 arrives."""
+        import socket
+        import time as _time
+
+        from consul_tpu.api import Client
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        # Reserve a port for dc1's future RPC listener.
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dc1_rpc = s.getsockname()[1]
+        s.close()
+
+        cfg2 = tmp_path / "dc2.json"
+        cfg2.write_text(json.dumps({
+            "node_name": "two", "n_servers": 1, "datacenter": "dc2",
+            "http": {"host": "127.0.0.1", "port": 0},
+            "wan_join_rpc": [f"127.0.0.1:{dc1_rpc}"],
+        }))
+        p2 = subprocess.Popen(
+            [sys.executable, "-m", "consul_tpu.cli", "agent",
+             "--config-file", str(cfg2)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        p1 = None
+        try:
+            r2 = json.loads(p2.stdout.readline())
+            c2 = Client("127.0.0.1", r2["http_port"])
+            assert c2.catalog.datacenters() == ["dc2"]  # not joined yet
+            cfg1 = tmp_path / "dc1.json"
+            cfg1.write_text(json.dumps({
+                "node_name": "one", "n_servers": 1, "datacenter": "dc1",
+                "rpc_port": dc1_rpc,
+                "http": {"host": "127.0.0.1", "port": 0},
+            }))
+            p1 = subprocess.Popen(
+                [sys.executable, "-m", "consul_tpu.cli", "agent",
+                 "--config-file", str(cfg1)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env)
+            json.loads(p1.stdout.readline())
+            deadline = _time.time() + 20
+            while set(c2.catalog.datacenters()) != {"dc1", "dc2"}:
+                assert _time.time() < deadline, "retry join never landed"
+                _time.sleep(0.5)
+            assert c2.kv.put("late/k", b"v", dc="dc1")
+        finally:
+            for p in (p1, p2):
+                if p is not None:
+                    p.send_signal(signal.SIGTERM)
+                    assert p.wait(timeout=20) == 0
