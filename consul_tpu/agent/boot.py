@@ -26,6 +26,12 @@ Config file (JSON or HCL)::
                                       //  RPC addresses to join through
                                       //  (server/rpc_wire.py + the
                                       //  agent/pool rotation policy)
+      "wan_join_rpc": [],             // remote-DC server RPC addresses:
+                                      //  process-level WAN federation
+                                      //  with retry (-retry-join-wan)
+      "dns": {"host": ..., "port": 0},// the DNS interface (ports.dns)
+      "acl": {"enabled": true, ...},  // ACLs (default_policy, master_token)
+      "tls": {"cert": ..., ...},      // TLS on the RPC wire + HTTPS
       "sim": { ... }                  // gossip tunables, config_loader
     }
 
