@@ -133,9 +133,11 @@ class TestWatchPlans:
         assert got[-1] and got[-1][-1]["Name"] == "deploy"
 
     def test_unsupported_type_rejected(self, stack):
+        # connect_roots/connect_leaf graduated to SUPPORTED types in
+        # round 5 — the negative case needs a genuinely unknown one.
         _, _, client = stack
         with pytest.raises(ValueError, match="unsupported watch type"):
-            WatchPlan(client, "connect_roots", None)
+            WatchPlan(client, "definitely_not_a_type", None)
 
     def test_handler_not_fired_without_change(self, stack):
         _, _, client = stack
