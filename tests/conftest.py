@@ -24,3 +24,48 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+
+def pumped_cluster_stack(n=3, seed=11, node="test-agent",
+                         address="10.0.0.1", **http_kwargs):
+    """Shared harness: ServerCluster + background raft pump + Agent +
+    HTTPApi (the scaffolding test_http_api/test_soak/etc. all need).
+    Returns (cluster, agent, api, lock, stop_event). Caller sets
+    stop_event at teardown."""
+    import threading
+    import time
+
+    from consul_tpu.agent.agent import Agent
+    from consul_tpu.agent.http import HTTPApi
+    from consul_tpu.server.endpoints import ServerCluster
+
+    cluster = ServerCluster(n, seed=seed)
+    cluster.wait_converged()
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    def pump():
+        while not stop.is_set():
+            with lock:
+                cluster.step()
+            time.sleep(0.001)
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    def rpc(method, **args):
+        with lock:
+            server = cluster.registry[cluster.raft.wait_converged().id]
+        return server.rpc(method, **args)
+
+    def wait_write(idx):
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            with lock:
+                led = cluster.raft.leader()
+                if led is not None and led.last_applied >= idx:
+                    return
+            time.sleep(0.001)
+
+    agent = Agent(node, address, rpc, cluster_size=n)
+    api = HTTPApi(agent, wait_write=wait_write, **http_kwargs)
+    return cluster, agent, api, lock, stop

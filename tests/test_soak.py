@@ -1,0 +1,176 @@
+"""Cross-subsystem randomized soak: a seeded op mix over the whole
+HTTP surface with replica-equality and referential-integrity
+invariants — the cross-feature interaction hunter (the reference's
+fuzz/soak idiom over an in-process cluster).
+
+Every op is driven through HTTPApi.handle (the real routing/ACL/
+confirm paths, no sockets for speed); after the storm the three
+replicas' stores must be IDENTICAL and the store's invariants hold.
+"""
+
+import base64
+import json
+import random
+import time
+
+import pytest
+
+OPS = 600
+SEED = 20260731
+
+
+@pytest.fixture(scope="module")
+def stack():
+    from conftest import pumped_cluster_stack
+    cluster, _agent, api, lock, stop = pumped_cluster_stack(
+        3, seed=61, node="soak-agent", address="10.99.0.1")
+    yield cluster, api, lock
+    stop.set()
+
+
+def call(api, method, path, q=None, body=b""):
+    return api.handle(method, path, {k: [v] for k, v in (q or {}).items()},
+                      body)
+
+
+class TestSoak:
+    def test_randomized_storm_keeps_replicas_identical(self, stack):
+        cluster, api, lock = stack
+        rng = random.Random(SEED)
+        nodes = [f"sn-{i}" for i in range(6)]
+        for i, n in enumerate(nodes):
+            st, _, _ = call(api, "PUT", "/v1/catalog/register",
+                            body=json.dumps(
+                                {"Node": n,
+                                 "Address": f"10.99.1.{i}"}).encode())
+            assert st == 200
+        sessions: list[str] = []
+        intentions: list[str] = []
+        queries: list[str] = []
+        statuses = {"2xx": 0, "4xx": 0}
+
+        def record(st):
+            assert st < 500, f"unexpected {st}"
+            statuses["2xx" if st < 400 else "4xx"] += 1
+
+        for opno in range(OPS):
+            op = rng.randrange(14)
+            key = f"k/{rng.randrange(20)}"
+            if op == 0:
+                st, _, _ = call(api, "PUT", f"/v1/kv/{key}",
+                                body=f"v{opno}".encode())
+            elif op == 1:
+                st, _, _ = call(api, "DELETE", f"/v1/kv/{key}")
+            elif op == 2:
+                st, _, _ = call(api, "GET", f"/v1/kv/{key}")
+                if st == 404:
+                    st = 200  # a missing key is fine; a 500 is not
+            elif op == 3:
+                st, body, _ = call(
+                    api, "PUT", "/v1/session/create",
+                    body=json.dumps({"Node": rng.choice(nodes),
+                                     "LockDelay": "0s"}).encode())
+                if st == 200:
+                    sessions.append(body["ID"])
+            elif op == 4 and sessions:
+                sid = rng.choice(sessions)
+                st, _, _ = call(api, "PUT", f"/v1/session/destroy/{sid}")
+                sessions.remove(sid)
+            elif op == 5 and sessions:
+                st, _, _ = call(api, "PUT", f"/v1/kv/lock/{key}",
+                                {"acquire": rng.choice(sessions)},
+                                b"holder")
+            elif op == 6:
+                ops = [{"KV": {"Verb": "set", "Key": f"txn/{key}",
+                               "Value": base64.b64encode(
+                                   str(opno).encode()).decode()}},
+                       {"Node": {"Verb": "set",
+                                 "Node": {"Node": rng.choice(nodes),
+                                          "Address": "10.99.2.1"}}}]
+                st, _, _ = call(api, "PUT", "/v1/txn",
+                                body=json.dumps(ops).encode())
+            elif op == 7:
+                st, body, _ = call(
+                    api, "POST", "/v1/connect/intentions",
+                    body=json.dumps({
+                        "SourceName": f"s{rng.randrange(5)}",
+                        "DestinationName": f"d{rng.randrange(5)}",
+                        "Action": rng.choice(["allow", "deny"]),
+                    }).encode())
+                if st == 200:
+                    intentions.append(body["ID"])
+                elif st == 409:
+                    st = 200
+            elif op == 8 and intentions:
+                iid = rng.choice(intentions)
+                st, _, _ = call(api, "DELETE",
+                                f"/v1/connect/intentions/{iid}")
+                intentions.remove(iid)
+            elif op == 9:
+                st, _, _ = call(api, "GET",
+                                "/v1/connect/intentions/check",
+                                {"source": f"s{rng.randrange(5)}",
+                                 "destination": f"d{rng.randrange(5)}"})
+            elif op == 10:
+                name = f"q{rng.randrange(5)}"
+                st, body, _ = call(
+                    api, "POST", "/v1/query",
+                    body=json.dumps({
+                        "Name": name,
+                        "Service": {"Service": "web"}}).encode())
+                if st == 200:
+                    queries.append(body["ID"])
+                elif st == 400:
+                    st = 200  # duplicate name
+            elif op == 11 and queries:
+                st, _, _ = call(api, "GET",
+                                f"/v1/query/{rng.choice(queries)}/execute")
+            elif op == 12:
+                st, _, _ = call(
+                    api, "PUT", "/v1/catalog/register",
+                    body=json.dumps({
+                        "Node": rng.choice(nodes),
+                        "Address": "10.99.1.9",
+                        "Service": {"ID": f"svc-{rng.randrange(8)}",
+                                    "Service": "web",
+                                    "Port": 80}}).encode())
+            elif op == 13:
+                st, _, _ = call(api, "GET", "/v1/catalog/nodes",
+                                {"filter": 'Node matches "^sn-"'})
+            else:
+                continue
+            record(st)
+
+        assert statuses["2xx"] > OPS // 2  # the storm mostly succeeded
+
+        # Quiesce: let every replica apply everything.
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            with lock:
+                idxs = {n.last_applied
+                        for n in cluster.raft.nodes.values()}
+            if len(idxs) == 1:
+                break
+            time.sleep(0.01)
+        with lock:
+            snaps = [s.store.snapshot() for s in cluster.servers]
+
+        # Invariant 1: replicas identical, table by table.
+        for name in snaps[0]["tables"]:
+            rows0 = snaps[0]["tables"][name]
+            for i, snap in enumerate(snaps[1:], start=1):
+                assert snap["tables"][name] == rows0, \
+                    f"replica {i} diverged on table {name!r}"
+
+        # Invariant 2: referential integrity on the final state.
+        store = cluster.servers[0].store
+        session_ids = {s["id"] for s in store.session_list()}
+        for k in store.tables["kv"].rows:
+            sess = store.tables["kv"].rows[k].value.get("session")
+            assert sess is None or sess in session_ids, \
+                f"kv {k!r} holds a lock for a dead session"
+        for s in store.session_list():
+            assert store.get_node(s["node"]) is not None
+
+        # Invariant 3: indexes monotone and consistent.
+        assert all(snap["index"] == snaps[0]["index"] for snap in snaps)
