@@ -737,6 +737,95 @@ class Lock:
         return ok
 
 
+class Semaphore:
+    """Distributed counting semaphore over KV (reference
+    api/semaphore.go): up to ``limit`` concurrent holders of a prefix.
+    Each contender session-locks its own contender key under the
+    prefix; the holder set lives in ``<prefix>/.lock``, mutated with
+    CAS so two racing acquirers cannot both take the last slot, and
+    pruned of holders whose contender key (and so session) died."""
+
+    LOCK_KEY = ".lock"
+
+    def __init__(self, client: Client, prefix: str, limit: int,
+                 node: Optional[str] = None):
+        if limit < 1:
+            raise ValueError("semaphore limit must be >= 1")
+        self.client = client
+        self.prefix = prefix.rstrip("/")
+        self.limit = limit
+        self.node = node
+        self.session: Optional[str] = None
+
+    def _contender_key(self) -> str:
+        return f"{self.prefix}/{self.session}"
+
+    def _live_contenders(self) -> set:
+        rows = self.client.kv.list(self.prefix + "/")
+        return {r["Key"].rsplit("/", 1)[1] for r in rows
+                if not r["Key"].endswith(self.LOCK_KEY)
+                and r.get("Session")}
+
+    def acquire(self, retries: int = 10, backoff_s: float = 0.1) -> bool:
+        if self.session is None:
+            self.session = self.client.session.create(node=self.node)
+        lock_key = f"{self.prefix}/{self.LOCK_KEY}"
+        # Announce contention: session-lock our contender key
+        # (semaphore.go: the contender entry proves liveness — its
+        # session dying releases the key, pruning us from the set).
+        if not self.client.kv.put(self._contender_key(), b"",
+                                  acquire=self.session):
+            return False
+        for _ in range(retries):
+            row, _ = self.client.kv.get(lock_key)
+            if row is None:
+                holders: dict = {}
+                cas = 0
+            else:
+                doc = json.loads(row["Value"] or b"{}")
+                holders = doc.get("Holders", {})
+                cas = row["ModifyIndex"]
+            live = self._live_contenders()
+            holders = {s: True for s in holders if s in live}
+            if self.session in holders:
+                return True
+            if len(holders) < self.limit:
+                holders[self.session] = True
+                if self.client.kv.put(lock_key, json.dumps(
+                        {"Limit": self.limit,
+                         "Holders": holders}).encode(), cas=cas):
+                    return True
+                # CAS lost: another contender moved first — re-read.
+            time.sleep(backoff_s)
+        return False
+
+    def release(self) -> bool:
+        if self.session is None:
+            return False
+        lock_key = f"{self.prefix}/{self.LOCK_KEY}"
+        for _ in range(10):
+            row, _ = self.client.kv.get(lock_key)
+            if row is None:
+                break
+            doc = json.loads(row["Value"] or b"{}")
+            holders = doc.get("Holders", {})
+            if self.session not in holders:
+                break
+            del holders[self.session]
+            if self.client.kv.put(lock_key, json.dumps(
+                    {"Limit": doc.get("Limit", self.limit),
+                     "Holders": holders}).encode(),
+                    cas=row["ModifyIndex"]):
+                break
+            time.sleep(0.05)
+        self.client.kv.put(self._contender_key(), b"",
+                           release=self.session)
+        self.client.kv.delete(self._contender_key())
+        self.client.session.destroy(self.session)
+        self.session = None
+        return True
+
+
 class WatchPlan:
     """Watch-plan engine (reference api/watch/plan.go over the typed
     watch functions of api/watch/funcs.go:18-30): one blocking query

@@ -445,6 +445,16 @@ def cmd_intention(client: Client, args) -> int:
     raise AssertionError(args.intention_cmd)
 
 
+def cmd_tls(client: Client, args) -> int:
+    """Development TLS material (reference command/tls: ca create /
+    cert create) — a CA plus a server cert signed by it."""
+    from consul_tpu.utils.tls import dev_ca
+    paths = dev_ca(args.dir, hostname=args.hostname)
+    for k in ("ca", "cert", "key"):
+        print(f"{k}: {paths[k]}")
+    return 0
+
+
 def cmd_leave(client: Client, args) -> int:
     """Graceful leave (reference command/leave → /v1/agent/leave):
     the agent deregisters and its runtime shuts down."""
@@ -807,6 +817,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("leave", help="gracefully leave and shut down the agent")
     sub.add_parser("version", help="print the version")
+    tls_p = sub.add_parser("tls", help="create development TLS material")
+    tls_sub = tls_p.add_subparsers(dest="tls_cmd", required=True)
+    tc = tls_sub.add_parser("create", help="CA + server cert (dev flow)")
+    tc.add_argument("-dir", default=".")
+    tc.add_argument("-hostname", default="127.0.0.1")
 
     conn_p = sub.add_parser("connect", help="connect CA management")
     conn_sub = conn_p.add_subparsers(dest="connect_cmd", required=True)
@@ -934,7 +949,7 @@ COMMANDS = {
     "event": cmd_event, "watch": cmd_watch, "join": cmd_join,
     "force-leave": cmd_force_leave, "leave": cmd_leave, "acl": cmd_acl,
     "intention": cmd_intention, "connect": cmd_connect,
-    "version": cmd_version,
+    "version": cmd_version, "tls": cmd_tls,
     "operator": cmd_operator, "maint": cmd_maint, "keyring": cmd_keyring,
     "monitor": cmd_monitor, "validate": cmd_validate, "lock": cmd_lock,
     "exec": cmd_exec, "reload": cmd_reload, "config": cmd_config,

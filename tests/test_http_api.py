@@ -22,38 +22,12 @@ from consul_tpu.server.endpoints import ServerCluster
 
 @pytest.fixture(scope="module")
 def stack():
-    """ServerCluster + agent + HTTP server, with a background raft pump
-    (live deployments pump continuously; tests get the same)."""
-    cluster = ServerCluster(3, seed=11)
-    leader = cluster.wait_converged()
-    stop = threading.Event()
-    lock = threading.Lock()
-
-    def pump():
-        while not stop.is_set():
-            with lock:
-                cluster.step()
-            time.sleep(0.002)
-
-    th = threading.Thread(target=pump, daemon=True)
-    th.start()
-
-    def rpc(method, **args):
-        with lock:
-            server = cluster.registry[cluster.raft.wait_converged().id]
-        return server.rpc(method, **args)
-
-    def wait_write(idx):
-        deadline = time.time() + 5.0
-        while time.time() < deadline:
-            with lock:
-                led = cluster.raft.leader()
-                if led is not None and led.last_applied >= idx:
-                    return
-            time.sleep(0.002)
-
-    agent = Agent("web-agent", "10.9.0.1", rpc, cluster_size=3)
-    api = HTTPApi(agent, server=leader, wait_write=wait_write)
+    """ServerCluster + agent + HTTP server over the shared pumped
+    harness (conftest.pumped_cluster_stack) plus a real socket."""
+    from conftest import pumped_cluster_stack
+    cluster, agent, api, lock, stop = pumped_cluster_stack(
+        3, seed=11, node="web-agent", address="10.9.0.1")
+    api.server = cluster.registry[cluster.raft.wait_converged().id]
     httpd, port = serve(api)
     client = Client("127.0.0.1", port)
     yield cluster, agent, client, port
@@ -1075,3 +1049,33 @@ class TestFilterParam:
         assert list(out) == ["fm-2"]
         client.agent.service_deregister("fm-1")
         client.agent.service_deregister("fm-2")
+
+
+class TestSemaphoreRecipe:
+    def test_limit_enforced_and_slot_reuse(self, stack):
+        """Counting semaphore (reference api/semaphore.go): at most
+        ``limit`` concurrent holders; a released or dead holder's slot
+        becomes acquirable."""
+        from consul_tpu.api import Semaphore
+        _, _, client, _ = stack
+        client.catalog.register("sem-node", "10.97.0.1")
+        assert wait_for(lambda: any(n["node"] == "sem-node"
+                                    for n in client.catalog.nodes()[0]))
+        sems = [Semaphore(client, "sem/jobs", 2, node="sem-node")
+                for _ in range(3)]
+        assert sems[0].acquire()
+        assert sems[1].acquire()
+        # The third contender cannot take a slot while both are held.
+        assert sems[2].acquire(retries=2, backoff_s=0.05) is False
+        # Releasing one frees a slot for the third.
+        assert sems[0].release()
+        assert sems[2].acquire()
+        # A DEAD holder's slot is pruned: destroy the session behind
+        # sems[1] without a clean release.
+        client.session.destroy(sems[1].session)
+        sems[1].session = None
+        s4 = Semaphore(client, "sem/jobs", 2, node="sem-node")
+        assert wait_for(lambda: s4.acquire(retries=1, backoff_s=0.01),
+                        timeout=5.0)
+        s4.release()
+        sems[2].release()
