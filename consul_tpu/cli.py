@@ -23,6 +23,9 @@ framework implements:
   maint            node/service maintenance mode       (command/maint)
   keyring          gossip key install/use/remove/list  (command/keyring)
   monitor          stream agent logs                   (command/monitor)
+  reload           trigger a config reload             (command/reload)
+  version          print the version                   (command/version)
+  tls create       dev CA + server cert                (command/tls)
   validate         config file validation              (command/validate)
   lock             run a command under a KV lock       (command/lock)
   exec             remote execution via KV + events    (command/exec)
