@@ -174,3 +174,65 @@ class TestSoak:
 
         # Invariant 3: indexes monotone and consistent.
         assert all(snap["index"] == snaps[0]["index"] for snap in snaps)
+
+
+class TestFailoverStorm:
+    def test_leader_kill_mid_storm_converges_survivors(self):
+        """Kill the leader in the middle of a write storm: survivors
+        re-elect, writes resume, and the two surviving replicas end
+        bit-identical (failover under load, the reference's
+        leader-loss drill)."""
+        from conftest import pumped_cluster_stack
+
+        cluster, _agent, api, lock, stop = pumped_cluster_stack(
+            3, seed=67, node="fo-agent", address="10.98.0.1")
+        try:
+            rng = random.Random(7)
+
+            def storm(n, allow_5xx=False):
+                ok = 0
+                for i in range(n):
+                    st, _, _ = call(api, "PUT",
+                                    f"/v1/kv/fo/{rng.randrange(30)}",
+                                    body=f"v{i}".encode())
+                    if st == 200:
+                        ok += 1
+                    elif not allow_5xx:
+                        assert st < 500, f"unexpected {st}"
+                return ok
+
+            assert storm(100) == 100
+            with lock:
+                led = cluster.raft.leader()
+                dead = led.id
+                led.stop()
+            # The failover window: 5xx tolerated while the survivors
+            # elect; afterwards the storm must fully succeed again.
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                with lock:
+                    new = cluster.raft.leader()
+                if new is not None and new.id != dead and \
+                        not new.stopped:
+                    break
+                time.sleep(0.02)
+            storm(30, allow_5xx=True)   # drain the transition
+            assert storm(100) == 100    # fully live again
+            # Survivors quiesce identical.
+            survivors = [s for s in cluster.servers if s.id != dead]
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                with lock:
+                    idxs = {n.last_applied for n in
+                            cluster.raft.nodes.values()
+                            if n.id != dead and not n.stopped}
+                if len(idxs) == 1:
+                    break
+                time.sleep(0.01)
+            with lock:
+                snaps = [s.store.snapshot() for s in survivors]
+            for name in snaps[0]["tables"]:
+                assert snaps[0]["tables"][name] == \
+                    snaps[1]["tables"][name], f"diverged on {name!r}"
+        finally:
+            stop.set()
