@@ -95,6 +95,10 @@ _DEFAULTS = {
     # so ?dc= forwarding crosses process boundaries. Federation is
     # per-direction: each side lists the other.
     "wan_join_rpc": [],
+    # Opt-in for exec checks over the HTTP API (reference
+    # enable_script_checks; off by default — it is remote command
+    # execution on this host).
+    "enable_script_checks": False,
     "sim": None,
 }
 
@@ -252,6 +256,8 @@ class AgentRuntime:
                            wait_write=wait_write,
                            datacenter=cfg["datacenter"],
                            acl=cfg.get("acl"))
+        self.api.enable_script_checks = bool(
+            cfg.get("enable_script_checks"))
         self.httpd = None
         self.http_port = None
 

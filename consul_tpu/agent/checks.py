@@ -186,6 +186,33 @@ class CheckRunner:
             check_id, lambda: tcp_probe(host, port, timeout_s), interval_s,
             service_id, now, background)
 
+    def add_script(self, check_id: str, argv: list, interval_s: float,
+                   timeout_s: float = 30.0, service_id: str = "",
+                   now: float = 0.0,
+                   background: bool = True) -> CheckMonitor:
+        """Script check (reference agent/checks/check.go CheckMonitor
+        over exec: exit 0 = passing, 1 = warning, anything else —
+        including a timeout or spawn failure — critical; output is the
+        combined stdout/stderr tail)."""
+        def probe() -> tuple[str, str]:
+            import subprocess
+            try:
+                out = subprocess.run(
+                    argv, capture_output=True, text=True,
+                    errors="replace",  # binary output must not flip a
+                    timeout=timeout_s)  # passing check to critical
+            except subprocess.TimeoutExpired:
+                return "critical", f"check timed out after {timeout_s}s"
+            except OSError as e:
+                return "critical", f"failed to run check: {e}"
+            status = {0: "passing", 1: "warning"}.get(
+                out.returncode, "critical")
+            text = (out.stdout + out.stderr)[-4096:]
+            return status, text
+
+        return self.add_monitor(check_id, probe, interval_s, service_id,
+                                now, background)
+
     def add_alias(self, check_id: str, rpc, target_node: str,
                   target_service_id: str = "", interval_s: float = 1.0,
                   service_id: str = "", now: float = 0.0,

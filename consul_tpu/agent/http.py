@@ -61,6 +61,10 @@ class HTTPApi:
         self.acl_default_allow = acl.get("default_policy",
                                          "allow") != "deny"
         self.acl_master_token = acl.get("master_token", "")
+        # Script-check registration opt-in (reference
+        # enable_script_checks, default OFF — an exec check is remote
+        # command execution on this host).
+        self.enable_script_checks = False
         # This agent's own datacenter: ?dc= naming it resolves to the
         # plain local path (reference parseDC treats the local DC as
         # no-op), keeping the shared cache entries usable.
@@ -1413,9 +1417,25 @@ class HTTPApi:
                     cid, self.agent.rpc, req["AliasNode"],
                     req.get("AliasService", ""), interval_s=interval,
                     service_id=sid, now=now)
+            elif req.get("Args"):
+                # Script check (the reference's exec check; exit 0/1/
+                # other -> passing/warning/critical). DISABLED unless
+                # the agent opted in — registering one is arbitrary
+                # command execution on the agent host (reference
+                # enable_script_checks, off by default).
+                if not self.enable_script_checks:
+                    return 403, {"error":
+                                 "script checks are disabled; set "
+                                 "enable_script_checks in the agent "
+                                 "config"}, {}
+                kw = {"service_id": sid, "now": now}
+                if req.get("Timeout"):
+                    kw["timeout_s"] = _dur_to_s(req["Timeout"])
+                self.agent.checks.add_script(
+                    cid, list(req["Args"]), interval, **kw)
             else:
-                return 400, {"error":
-                             "check needs one of TTL/HTTP/TCP/AliasNode"}, {}
+                return 400, {"error": "check needs one of "
+                             "TTL/HTTP/TCP/AliasNode/Args"}, {}
             if req.get("DeregisterCriticalServiceAfter"):
                 self.agent.set_reap_after(
                     cid, _dur_to_s(req["DeregisterCriticalServiceAfter"]))

@@ -472,3 +472,75 @@ class TestAliasCheck:
         calls["fail"] = True  # unreachable catalog -> critical
         runner.tick(1.0)
         assert local.checks["alias-api"].status == "critical"
+
+
+class TestScriptCheck:
+    def test_exit_codes_map_to_statuses(self):
+        """Script checks (reference exec checks): exit 0/1/other ->
+        passing/warning/critical; timeouts and spawn failures are
+        critical."""
+        import sys as _sys
+
+        from consul_tpu.agent.checks import CheckRunner
+        from consul_tpu.agent.local import LocalState
+
+        local = LocalState("script-node", "10.0.0.1")
+        runner = CheckRunner(local)
+        for code, want in ((0, "passing"), (1, "warning"),
+                           (3, "critical")):
+            cid = f"sc-{code}"
+            runner.add_script(
+                cid, [_sys.executable, "-c", f"import sys; print('out');"
+                      f" sys.exit({code})"],
+                interval_s=0.01, background=False)
+            runner.tick(1.0)
+            assert local.checks[cid].status == want, (code, want)
+        assert "out" in local.checks["sc-0"].output
+        # Spawn failure -> critical with a reason.
+        runner.add_script("sc-bad", ["/definitely/not/a/binary"],
+                          interval_s=0.01, background=False)
+        runner.tick(2.0)
+        assert local.checks["sc-bad"].status == "critical"
+        assert "failed to run" in local.checks["sc-bad"].output
+
+    def test_register_over_http_requires_opt_in(self, stack):
+        import sys as _sys
+        import time as _t
+
+        import pytest as _pytest
+
+        from consul_tpu.api import APIError
+        cluster, agent, client = stack
+        body = json.dumps({
+            "Name": "script-ck",
+            "Args": [_sys.executable, "-c", "print('ok')"],
+            "Interval": "10s",
+        }).encode()
+        # OFF by default: registering an exec check is remote command
+        # execution, so it must be refused (reference
+        # enable_script_checks).
+        with _pytest.raises(APIError, match="disabled"):
+            client._call("PUT", "/v1/agent/check/register", {}, body)
+        # Find the api object to opt in (the stack serves one HTTPApi).
+        import gc
+
+        from consul_tpu.agent.http import HTTPApi
+        api = next(o for o in gc.get_objects()
+                   if isinstance(o, HTTPApi) and o.agent is agent)
+        api.enable_script_checks = True
+        try:
+            out, _, _ = client._call("PUT", "/v1/agent/check/register",
+                                     {}, body)
+            assert out is True
+            # The background probe posts its result directly to local
+            # state; poll until it lands.
+            deadline = _t.time() + 5
+            while _t.time() < deadline:
+                if client.agent.checks().get("script-ck", {}).get(
+                        "Status") == "passing":
+                    break
+                _t.sleep(0.1)
+            assert client.agent.checks()["script-ck"]["Status"] == \
+                "passing"
+        finally:
+            api.enable_script_checks = False
