@@ -16,7 +16,6 @@ import time
 import pytest
 
 OPS = 600
-SEED = 20260731
 
 
 @pytest.fixture(scope="module")
@@ -34,9 +33,11 @@ def call(api, method, path, q=None, body=b""):
 
 
 class TestSoak:
-    def test_randomized_storm_keeps_replicas_identical(self, stack):
+    @pytest.mark.parametrize("seed", [20260731, 7, 424242])
+    def test_randomized_storm_keeps_replicas_identical(self, stack,
+                                                       seed):
         cluster, api, lock = stack
-        rng = random.Random(SEED)
+        rng = random.Random(seed)
         nodes = [f"sn-{i}" for i in range(6)]
         for i, n in enumerate(nodes):
             st, _, _ = call(api, "PUT", "/v1/catalog/register",
