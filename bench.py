@@ -182,7 +182,17 @@ def child(platform: str, deadline: float):
     from consul_tpu.models.cluster import SerfSimulation
 
     # Full-stack serf throughput: the SWIM plane PLUS the user-event/
-    # query plane (models/serf.py) with a live epidemic in flight.
+    # query plane (models/serf.py), measured over an EVENT-BURST
+    # LIFECYCLE: 8 fresh events fire before each measured chunk, and
+    # the 128-tick window then covers their spread, retransmit drain,
+    # and (post-gate) idle tail — the workload's end-to-end cost, not
+    # a steady-state busy-plane cost. (A truly continuous measurement
+    # would need sub-chunk event injection, i.e. a second scan length,
+    # i.e. a second full XLA compile — ~6 min at 1M on TPU; not worth
+    # the budget.) The pure-idle rate is reported alongside in a
+    # SEPARATE phase line so a deadline during the extension cannot
+    # lose the burst number: idle-at-SWIM-speed is the event-phase
+    # gate's own headline.
     try:
         if left() > 120:
             ssim = build(n, cls=SerfSimulation)
@@ -190,13 +200,29 @@ def child(platform: str, deadline: float):
             ssim.user_event(jnp.arange(n) < 8, 1)
             jax.block_until_ready(ssim.state.ev_key)
             t1 = time.monotonic()
-            ssim.run(chunk * 2, chunk=chunk, with_metrics=False)
+            for rep in range(2):
+                ssim.user_event(jnp.arange(n) < 8, 2 + rep)
+                ssim.run(chunk, chunk=chunk, with_metrics=False)
             jax.block_until_ready(ssim.state.ev_key)
             _emit({
                 "phase": "serf_throughput",
                 "n": n,
-                "rounds_per_s": round(chunk * 2 / (time.monotonic() - t1), 2),
+                "rounds_per_s": round(
+                    chunk * 2 / (time.monotonic() - t1), 2),
             })
+            if left() > 60:
+                # Drain fully, then time the idle plane.
+                ssim.run(chunk * 4, chunk=chunk, with_metrics=False)
+                jax.block_until_ready(ssim.state.ev_key)
+                t2 = time.monotonic()
+                ssim.run(chunk, chunk=chunk, with_metrics=False)
+                jax.block_until_ready(ssim.state.ev_key)
+                _emit({
+                    "phase": "serf_idle",
+                    "n": n,
+                    "rounds_per_s": round(
+                        chunk / (time.monotonic() - t2), 2),
+                })
             del ssim
     except Exception as e:
         _emit({"phase": "error", "where": "serf", "error": repr(e)[:500]})
@@ -639,6 +665,8 @@ def main():
         "agreement": _get(primary["phases"], "rmse", "agreement"),
         "serf_rounds_per_s": _get(
             primary["phases"], "serf_throughput", "rounds_per_s"),
+        "serf_idle_rounds_per_s": _get(
+            primary["phases"], "serf_idle", "rounds_per_s"),
         "sweep": [
             {"n": p["n"], "rounds_per_s": p["rounds_per_s"],
              "compile_s": p.get("compile_s")}
