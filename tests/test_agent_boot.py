@@ -402,3 +402,72 @@ class TestWanFederationAcrossProcesses:
                 if p is not None:
                     p.send_signal(signal.SIGTERM)
                     assert p.wait(timeout=20) == 0
+
+    def test_prepared_query_failover_across_processes(self, tmp_path):
+        """A prepared query in dc1 fails over to dc2 THROUGH the wire
+        federation: ExecuteRemote rides the msgpack-RPC hop between
+        real processes (the reference's cross-DC failover story)."""
+        import socket
+        import time as _time
+
+        from consul_tpu.api import Client
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+        def free_port():
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+            s.close()
+            return p
+
+        rpc1, rpc2 = free_port(), free_port()
+        procs = []
+        for name, dc, rpc, peer in (("p1", "dc1", rpc1, rpc2),
+                                    ("p2", "dc2", rpc2, rpc1)):
+            cfg = tmp_path / f"{dc}.json"
+            cfg.write_text(json.dumps({
+                "node_name": name, "n_servers": 1, "datacenter": dc,
+                "rpc_port": rpc,
+                "http": {"host": "127.0.0.1", "port": 0},
+                "wan_join_rpc": [f"127.0.0.1:{peer}"],
+            }))
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "consul_tpu.cli", "agent",
+                 "--config-file", str(cfg)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env))
+        try:
+            readies = [json.loads(p.stdout.readline()) for p in procs]
+            c1 = Client("127.0.0.1", readies[0]["http_port"])
+            c2 = Client("127.0.0.1", readies[1]["http_port"])
+            deadline = _time.time() + 30
+            while set(c1.catalog.datacenters()) != {"dc1", "dc2"}:
+                assert _time.time() < deadline
+                _time.sleep(0.5)
+            # The service exists ONLY in dc2.
+            c2.catalog.register(
+                "far-node", "10.95.0.1",
+                service={"id": "far-1", "service": "faraway",
+                         "port": 777},
+                check={"CheckID": "fc", "Status": "passing",
+                       "ServiceID": "far-1"})
+            deadline = _time.time() + 10
+            while not c2.catalog.service("faraway")[0]:
+                assert _time.time() < deadline
+                _time.sleep(0.1)
+            # dc1's query fails over by WAN distance.
+            c1.query.create({
+                "Name": "find-far",
+                "Service": {"Service": "faraway",
+                            "Failover": {"NearestN": 1}},
+            })
+            res = c1.query.execute("find-far")
+            assert res["Datacenter"] == "dc2"
+            assert res["Failovers"] == 1
+            assert [n["node"] for n in res["Nodes"]] == ["far-node"]
+            assert res["Nodes"][0]["service"]["port"] == 777
+        finally:
+            for p in procs:
+                p.send_signal(signal.SIGTERM)
+                assert p.wait(timeout=20) == 0
