@@ -132,6 +132,7 @@ def child(platform: str, deadline: float):
             "view_degree": view_degree,
             "rounds_per_s": round(rounds_per_s, 2),
             "compile_s": round(t1 - t, 1),
+            "counters": sim.counters_snapshot(),
         })
     except Exception as e:
         _emit({"phase": "error", "where": "throughput", "error": repr(e)[:500]})
@@ -158,6 +159,7 @@ def child(platform: str, deadline: float):
                 "wall_s": round(wall, 2),
                 "sim_s": round(sim_s, 1),
                 "ticks": int(ticks_used),
+                "counters": sim.counters_snapshot(),
             })
     except Exception as e:
         _emit({"phase": "error", "where": "convergence", "error": repr(e)[:500]})
@@ -209,6 +211,7 @@ def child(platform: str, deadline: float):
                 "n": n,
                 "rounds_per_s": round(
                     chunk * 2 / (time.monotonic() - t1), 2),
+                "counters": ssim.counters_snapshot(),
             })
             if left() > 60:
                 # Drain fully, then time the idle plane.
@@ -667,6 +670,16 @@ def main():
             primary["phases"], "serf_throughput", "rounds_per_s"),
         "serf_idle_rounds_per_s": _get(
             primary["phases"], "serf_idle", "rounds_per_s"),
+        # Cumulative on-device gossip counters (models/counters.py) from
+        # the primary backend, preferring the convergence phase (it
+        # includes the throughput ticks — the dict is cumulative per
+        # Simulation). Stable key for downstream BENCH json consumers.
+        "counters": (
+            _get(primary["phases"], "convergence", "counters")
+            or _get(primary["phases"], "throughput", "counters")
+        ),
+        "serf_counters": _get(
+            primary["phases"], "serf_throughput", "counters"),
         "sweep": [
             {"n": p["n"], "rounds_per_s": p["rounds_per_s"],
              "compile_s": p.get("compile_s")}

@@ -246,6 +246,11 @@ class AgentRuntime:
             cfg["node_name"], cfg["bind_addr"], rpc,
             cluster_size=int(cfg["n_servers"]),
         )
+        # One telemetry sink per process: the RPC listener's wire
+        # counters and the agent's own metrics land in the same sink, so
+        # /v1/agent/metrics (and the debug bundle) shows the full tier.
+        if self.rpc_listener is not None:
+            self.agent.sink = self.rpc_listener.sink
         self.agent.reload_hook = self._reload
         self.agent.join_hook = getattr(self, "_join", None)
         # /v1/agent/leave: answer 200, then the main loop shuts down

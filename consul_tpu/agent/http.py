@@ -82,7 +82,25 @@ class HTTPApi:
     def handle(self, method: str, path: str, query: dict[str, list[str]],
                body: bytes, headers: Optional[dict] = None,
                ) -> tuple[int, Any, dict[str, str]]:
-        """Returns (status, json-serializable body, extra headers)."""
+        """Returns (status, json-serializable body, extra headers).
+
+        Wraps the dispatch in a ``consul.http.<METHOD>.<path>`` latency
+        sample (reference agent/http.go wrap(): MeasureSince with the
+        method + first path parts as labels), keyed by the first two
+        path segments so /v1/kv/<anything> aggregates under one name."""
+        t0 = _time.perf_counter()
+        try:
+            return self._handle(method, path, query, body, headers)
+        finally:
+            sink = getattr(self.agent, "sink", None)
+            if sink is not None:
+                parts = [p for p in path.split("/") if p][:2]
+                sink.measure_since(
+                    f"consul.http.{method.upper()}.{'.'.join(parts)}", t0)
+
+    def _handle(self, method: str, path: str, query: dict[str, list[str]],
+                body: bytes, headers: Optional[dict] = None,
+                ) -> tuple[int, Any, dict[str, str]]:
         q = {k: v[-1] for k, v in query.items()}
         min_index = int(q.get("index", 0))
         wait_s = _dur_to_s(q["wait"]) if "wait" in q else 10.0

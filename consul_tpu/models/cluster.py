@@ -16,8 +16,10 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from consul_tpu.config import SimConfig
+from consul_tpu.models import counters as counters_mod
 from consul_tpu.models import serf as serf_mod
 from consul_tpu.models import state as sim_state
 from consul_tpu.models import swim
@@ -36,28 +38,66 @@ class TickTrace(NamedTuple):
     rmse: jax.Array            # [C] f32
 
 
-def _chunk_runner(cfg: SimConfig, topo, world, chunk: int, with_metrics: bool,
-                  step_fn=swim.step, swim_of=lambda st: st):
-    """One compiled chunk program. ``step_fn`` is the per-tick step
-    (bare SWIM or the full serf stack); ``swim_of`` projects the SWIM
-    plane out of the step's state for metrics."""
-    def body(state, tick_key):
-        state = step_fn(cfg, topo, world, state, tick_key)
+def _topo_key(topo) -> tuple:
+    """Hashable fingerprint of a Topology's compile-time content. The
+    offset/remap tables are read *concretely* during tracing (static
+    roll shifts, models/swim.py _gather_by_col), so they are part of
+    the program's identity, not runtime inputs."""
+    return (
+        topo.n, topo.dense, np.asarray(topo.off).tobytes(),
+        None if topo.rcol is None else np.asarray(topo.rcol).tobytes(),
+        None if topo.inv is None else np.asarray(topo.inv).tobytes(),
+    )
+
+
+_RUNNER_CACHE: dict = {}
+
+
+def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
+                  step_fn=swim.step_counted, swim_of=lambda st: st):
+    """One compiled chunk program. ``step_fn`` is the per-tick counted
+    step (bare SWIM or the full serf stack) returning
+    (state, GossipCounters); ``swim_of`` projects the SWIM plane out of
+    the step's state for metrics. The counters ride the scan carry and
+    come back as one [] i32 pytree per chunk — the single extra
+    device→host fetch the tentpole budgets for.
+
+    Programs are memoized process-wide on (cfg, topology content,
+    chunk, with_metrics, step): the world enters as a program
+    *argument* rather than a baked constant, so two simulations over
+    the same topology (same seed, or any dense-mode pair) share one
+    executable instead of paying XLA twice. The topology itself stays
+    closed over — its tables feed trace-time static roll shifts."""
+    memo = (cfg, _topo_key(topo), chunk, with_metrics, step_fn, swim_of)
+    hit = _RUNNER_CACHE.get(memo)
+    if hit is not None:
+        return hit
+
+    def body(world, carry, tick_key):
+        state, cnt = carry
+        state, c = step_fn(cfg, topo, world, state, tick_key)
+        cnt = counters_mod.add(cnt, c)
         if not with_metrics:
-            return state, ()
+            return (state, cnt), ()
         sw = swim_of(state)
         h = metrics.health(cfg, topo, sw)
         rmse = metrics.vivaldi_rmse(
             cfg, world, sw, jax.random.fold_in(tick_key, 1), samples=2048
         )
-        return state, TickTrace(h.agreement, h.false_positive, h.undetected, rmse)
+        return (state, cnt), TickTrace(
+            h.agreement, h.false_positive, h.undetected, rmse)
 
-    def run(state, base_key):
+    def run(world, state, base_key):
         ticks = swim_of(state).t + jnp.arange(chunk)
         tick_keys = jax.vmap(lambda t: jax.random.fold_in(base_key, t))(ticks)
-        return jax.lax.scan(body, state, tick_keys)
+        (state, cnt), trace = jax.lax.scan(
+            functools.partial(body, world), (state, counters_mod.zeros()),
+            tick_keys)
+        return state, cnt, trace
 
-    return jax.jit(run, donate_argnums=(0,))
+    jitted = jax.jit(run, donate_argnums=(1,))
+    _RUNNER_CACHE[memo] = jitted
+    return jitted
 
 
 @dataclasses.dataclass
@@ -68,7 +108,7 @@ class Simulation:
     seed: int = 0
 
     # Driver hooks (SerfSimulation overrides these two).
-    _step_fn = staticmethod(swim.step)
+    _step_fn = staticmethod(swim.step_counted)
     _swim_of = staticmethod(lambda st: st)
 
     def _init_state(self, key):
@@ -87,6 +127,13 @@ class Simulation:
         # (telemetry.emit_sim_metrics); served by /v1/agent/metrics and
         # the debug bundle.
         self.sink = telemetry.Sink()
+        # Cumulative protocol-event counters (Python ints — i32 only
+        # per chunk on device, see models/counters.py). Throughput runs
+        # (with_metrics=False) defer the device fetch: per-chunk counter
+        # pytrees queue in _pending_counters and flush in one batched
+        # transfer when the totals are next read.
+        self._counters = {f: 0 for f in counters_mod.FIELDS}
+        self._pending_counters = []
 
     # -- fault injection ------------------------------------------------
     def kill(self, mask):
@@ -99,10 +146,16 @@ class Simulation:
     def _runner(self, chunk: int, with_metrics: bool):
         k = (chunk, with_metrics)
         if k not in self._runners:
-            self._runners[k] = _chunk_runner(
-                self.cfg, self.topo, self.world, chunk, with_metrics,
+            jitted = _chunk_runner(
+                self.cfg, self.topo, chunk, with_metrics,
                 step_fn=type(self)._step_fn, swim_of=type(self)._swim_of,
             )
+
+            def bound(state, base_key, _j=jitted, _w=self.world):
+                return _j(_w, state, base_key)
+
+            bound._cache_size = jitted._cache_size
+            self._runners[k] = bound
         return self._runners[k]
 
     def run(self, ticks: int, chunk: int = 64, with_metrics: bool = True):
@@ -113,19 +166,53 @@ class Simulation:
         while remaining > 0:
             c = min(chunk, remaining)
             t0 = time.perf_counter()
-            self.state, trace = self._runner(c, with_metrics)(self.state, self.base_key)
+            self.state, cnt, trace = \
+                self._runner(c, with_metrics)(self.state, self.base_key)
             if with_metrics:
                 # Block before reading the clock: the jitted runner
                 # returns on async dispatch, not completion.
                 jax.block_until_ready(trace)
                 traces.append(trace)
-                self._record_chunk(trace, c, t0)
+                self._record_chunk(trace, cnt, c, t0)
+            else:
+                # Throughput path: no device sync — the chunk's counter
+                # pytree queues for a lazy batched flush.
+                self._pending_counters.append(cnt)
             remaining -= c
         if not with_metrics:
             return None
         return jax.tree.map(lambda *xs: jnp.concatenate(xs), *traces)
 
-    def _record_chunk(self, trace: TickTrace, ticks: int, t0: float):
+    # -- counters -------------------------------------------------------
+    @property
+    def counters(self):
+        """Cumulative protocol-event totals (plain-int dict, keyed by
+        GossipCounters field name). Flushes any deferred throughput-run
+        chunks first."""
+        self._flush_counters()
+        return self._counters
+
+    def counters_snapshot(self):
+        """A copy of :attr:`counters` safe to serialize (bench.py)."""
+        return dict(self.counters)
+
+    def _flush_counters(self):
+        """One batched device→host transfer for every deferred chunk."""
+        if not self._pending_counters:
+            return
+        pending, self._pending_counters = self._pending_counters, []
+        vals = np.asarray(
+            jnp.stack([counters_mod.stack(c) for c in pending])
+        ).sum(axis=0)
+        self._fold_counter_deltas(
+            {f: int(v) for f, v in zip(counters_mod.FIELDS, vals)})
+
+    def _fold_counter_deltas(self, deltas):
+        for f, v in deltas.items():
+            self._counters[f] += v
+        telemetry.emit_counter_deltas(self.sink, deltas)
+
+    def _record_chunk(self, trace: TickTrace, cnt, ticks: int, t0: float):
         """Fold one chunk's trace into the telemetry sink under the
         reference metric names (the batched host-boundary equivalent of
         the reference's per-operation instrumentation). The first run
@@ -144,6 +231,14 @@ class Simulation:
             undetected=trace.undetected[-1],
             live_nodes=jnp.int32(0),
         )
+        self._flush_counters()
+        # The chunk's counter pytree lands in ONE [len(FIELDS)] i32
+        # fetch; the sink emission goes through emit_sim_metrics with
+        # everything else this chunk records.
+        vals = np.asarray(counters_mod.stack(cnt))
+        deltas = {f: int(v) for f, v in zip(counters_mod.FIELDS, vals)}
+        for f, v in deltas.items():
+            self._counters[f] += v
         telemetry.emit_sim_metrics(
             self.swim_state, self.sink,
             health=h, rmse_s=float(trace.rmse[-1]),
@@ -151,6 +246,7 @@ class Simulation:
             chunk_wall_s=wall_s, chunk_ticks=ticks,
             serf_state=self.serf_state,
             queue_depth_warning=self.cfg.serf.queue_depth_warning,
+            counters=deltas,
         )
 
     def run_until_converged(
@@ -173,9 +269,10 @@ class Simulation:
         while used < max_ticks:
             c = min(chunk, max_ticks - used)
             t0 = time.perf_counter()
-            self.state, trace = self._runner(c, True)(self.state, self.base_key)
+            self.state, cnt, trace = \
+                self._runner(c, True)(self.state, self.base_key)
             jax.block_until_ready(trace)
-            self._record_chunk(trace, c, t0)
+            self._record_chunk(trace, cnt, c, t0)
             used += c
             ok = float(trace.agreement[-1]) >= require_agreement
             if ok and rmse_target_s is not None:
@@ -192,10 +289,12 @@ class Simulation:
         XLA compilation never lands inside the measurement.
         """
         runner = self._runner(ticks, False)
-        self.state, _ = runner(self.state, self.base_key)
+        self.state, cnt, _ = runner(self.state, self.base_key)
+        self._pending_counters.append(cnt)
         jax.block_until_ready(self.swim_state.view_key)
         t0 = time.perf_counter()
-        self.state, _ = runner(self.state, self.base_key)
+        self.state, cnt, _ = runner(self.state, self.base_key)
+        self._pending_counters.append(cnt)
         jax.block_until_ready(self.swim_state.view_key)
         return ticks / (time.perf_counter() - t0)
 
@@ -229,7 +328,7 @@ class SerfSimulation(Simulation):
     metrics, and telemetry via the base driver's hooks; adds the
     serf-layer verbs."""
 
-    _step_fn = staticmethod(serf_mod.step)
+    _step_fn = staticmethod(serf_mod.step_counted)
     _swim_of = staticmethod(lambda st: st.swim)
 
     def _init_state(self, key):

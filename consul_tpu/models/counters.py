@@ -1,0 +1,96 @@
+"""On-device gossip counters: the per-tick event tallies the reference
+emits through go-metrics, accumulated inside the jitted scan.
+
+The reference increments a counter per protocol event — every
+``aliveNode``/``suspectNode``/``deadNode`` processed, every UDP packet
+sent/received, every push-pull exchange (memberlist state.go/net.go),
+every serf event queued or rebroadcast (serf/serf.go) — on the host,
+per operation. Here the same accounting is a :class:`GossipCounters`
+pytree of i32 scalars threaded through ``swim.step_counted`` /
+``serf.step_counted`` and summed across the chunk scan
+(models/cluster.py), so true counter semantics cost one extra
+device→host fetch per chunk and zero extra XLA compiles. The sharded
+path ``psum``-reduces the pytree over the node axis
+(parallel/shard_step.py), so each counter is the global total on every
+device.
+
+Counter dtypes are i32 *per chunk*: the largest per-chunk tally
+(gossip_rx at n=1M, fan=3, chunk=128 ≈ 4·10⁸) fits comfortably; the
+host accumulates chunk deltas into Python ints (models/cluster.py
+``Simulation.counters``), so cumulative totals never wrap.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class GossipCounters(NamedTuple):
+    """Per-tick (or per-chunk, after scan summation) protocol event
+    tallies. All [] i32. Field order is the wire order of the stacked
+    device→host fetch — keep FIELDS in sync."""
+
+    probes_sent: jax.Array          # probe cycles launched (§3)
+    acks_received: jax.Array        # probes acked (direct|indirect|tcp)
+    nacks_received: jax.Array       # Lifeguard nacks returned by relays
+    probe_timeouts: jax.Array       # probe windows closed with no ack
+    suspicions_started: jax.Array   # suspicion timers started/restarted
+    refutations: jax.Array          # own-incarnation bumps (refute)
+    deaths_declared: jax.Array      # suspicion expiries -> dead declared
+    gossip_tx: jax.Array            # gossip packets put on the wire
+    gossip_rx: jax.Array            # gossip packets accepted by a live rx
+    pushpull_merges: jax.Array      # push-pull merges applied (both dirs)
+    serf_intents_queued: jax.Array  # serf events/queries staged into queues
+    serf_intents_retx: jax.Array    # serf queue entries retransmitted
+    serf_intents_dropped: jax.Array  # serf queue evictions under pressure
+
+
+FIELDS = GossipCounters._fields
+
+# Sink names each counter folds into at the chunk boundary
+# (telemetry.emit_counter_deltas). Reference names where the reference
+# has a counter for the event; ``sim.*`` where it does not (the
+# COVERAGE.md telemetry table maps every name to its reference source,
+# and tests/test_metric_names.py asserts the table stays complete).
+METRIC_NAMES = {
+    "probes_sent": "memberlist.probeNode",
+    "acks_received": "sim.probe.acks",
+    "nacks_received": "sim.probe.nacks",
+    "probe_timeouts": "sim.probe.timeouts",
+    "suspicions_started": "memberlist.msg.suspect",
+    "refutations": "memberlist.msg.alive",
+    "deaths_declared": "memberlist.msg.dead",
+    "gossip_tx": "memberlist.udp.sent",
+    "gossip_rx": "memberlist.udp.received",
+    "pushpull_merges": "memberlist.pushPullNode",
+    "serf_intents_queued": "serf.events",
+    "serf_intents_retx": "sim.serf.event_retransmits",
+    "serf_intents_dropped": "sim.serf.event_drops",
+}
+assert set(METRIC_NAMES) == set(FIELDS)
+
+
+def zeros() -> GossipCounters:
+    z = jnp.zeros((), jnp.int32)
+    return GossipCounters(*([z] * len(FIELDS)))
+
+
+def count(mask) -> jax.Array:
+    """Sum a bool mask of any shape down to one i32 scalar."""
+    return jnp.sum(mask).astype(jnp.int32)
+
+
+def add(a: GossipCounters, b: GossipCounters) -> GossipCounters:
+    return jax.tree.map(jnp.add, a, b)
+
+
+def stack(c: GossipCounters) -> jax.Array:
+    """[len(FIELDS)] i32 — the single batched transfer shape."""
+    return jnp.stack(list(c))
+
+
+def unstack(vec) -> GossipCounters:
+    return GossipCounters(*(vec[i] for i in range(len(FIELDS))))

@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 
 from consul_tpu.config import SimConfig, to_ticks
+from consul_tpu.models import counters as counters_mod
 from consul_tpu.models import state as sim_state
 from consul_tpu.models import swim
 from consul_tpu.models.state import SimState
@@ -176,7 +177,11 @@ def _scatter_cols(arr, cols, vals):
 def _equeue_push(cfg: SimConfig, s: SerfState, mask, key_, origin, tx0):
     """Insert one event per masked node into its event queue — same slot
     semantics as the SWIM broadcast queue (invalidate same subject,
-    else empty slot, else evict most-transmitted; queue.go:182-242)."""
+    else empty slot, else evict most-transmitted; queue.go:182-242).
+
+    Returns (state, evicted[N] bool) — evicted marks nodes whose push
+    displaced a *different* live entry under queue pressure (same-subject
+    replacement is an update, not a drop)."""
     same = (s.ev_key == key_[:, None]) & (s.ev_origin == origin[:, None])
     # Unlike swim._queue_push, a spent (tx<=0) slot is NOT free here:
     # retirement is explicit (ev_key=0 in _event_phase) because a spent
@@ -190,11 +195,12 @@ def _equeue_push(cfg: SimConfig, s: SerfState, mask, key_, origin, tx0):
     slot = jnp.argmax(score, axis=1)
     e = cfg.serf.event_queue_slots
     onehot = (jnp.arange(e, dtype=jnp.int32)[None, :] == slot[:, None]) & mask[:, None]
+    evicted = jnp.any(onehot & ~same & ~empty, axis=1)
     return s._replace(
         ev_key=jnp.where(onehot, key_[:, None], s.ev_key),
         ev_origin=jnp.where(onehot, origin[:, None], s.ev_origin),
         ev_tx=jnp.where(onehot, tx0, s.ev_tx),
-    )
+    ), evicted
 
 
 def _sig(key_, origin):
@@ -314,7 +320,7 @@ def user_event(cfg: SimConfig, s: SerfState, mask, name: int) -> SerfState:
     s = s._replace(event_clock=lamport.increment(s.event_clock, mask))
     with jax.ensure_compile_time_eval():
         tx0 = int(scaling.retransmit_limit(cfg.gossip.retransmit_mult, cfg.n))
-    s = _equeue_push(cfg, s, mask, key_, rows, tx0)
+    s, _ = _equeue_push(cfg, s, mask, key_, rows, tx0)
     return _seen_append(cfg, s, mask, key_, rows)
 
 
@@ -347,7 +353,7 @@ def query(cfg: SimConfig, s: SerfState, mask, name: int) -> SerfState:
     )
     with jax.ensure_compile_time_eval():
         tx0 = int(scaling.retransmit_limit(cfg.gossip.retransmit_mult, cfg.n))
-    s = _equeue_push(cfg, s, mask, key_, rows, tx0)
+    s, _ = _equeue_push(cfg, s, mask, key_, rows, tx0)
     return _seen_append(cfg, s, mask, key_, rows)
 
 
@@ -382,11 +388,20 @@ def leave(cfg: SimConfig, s: SerfState, mask) -> SerfState:
 # ----------------------------------------------------------------------
 
 def step(cfg: SimConfig, topo, world: World, s: SerfState, key) -> SerfState:
+    """One serf tick. Thin wrapper over :func:`step_counted` — XLA dead-
+    code-eliminates the unused counter reductions, so existing callers
+    pay nothing for them."""
+    return step_counted(cfg, topo, world, s, key)[0]
+
+
+def step_counted(cfg: SimConfig, topo, world: World, s: SerfState, key):
     """One serf tick: SWIM membership tick, then event/query gossip,
-    response tally, query expiry, and reap bookkeeping."""
+    response tally, query expiry, and reap bookkeeping. Returns
+    (SerfState, GossipCounters) — the SWIM tick's counters plus the
+    serf intent-queue tallies."""
     k_swim, k_ev = jax.random.split(key)
     t = s.swim.t
-    sw = swim.step(cfg, topo, world, s.swim, k_swim)
+    sw, cnt = swim.step_counted(cfg, topo, world, s.swim, k_swim)
     # Pending graceful leaves whose propagate window closed go quiet now
     # (serf.Leave sleeps LeavePropagateDelay then shuts memberlist down).
     quiet = (s.leave_at >= 0) & (sw.t >= s.leave_at)
@@ -394,7 +409,12 @@ def step(cfg: SimConfig, topo, world: World, s: SerfState, key) -> SerfState:
     s = s._replace(swim=sw, leave_at=jnp.where(quiet, -1, s.leave_at))
     active = sw.alive_truth & ~sw.left
 
-    s = _event_phase(cfg, topo, s, active, k_ev)
+    s, (n_queued, n_retx, n_dropped) = _event_phase(cfg, topo, s, active, k_ev)
+    cnt = cnt._replace(
+        serf_intents_queued=n_queued,
+        serf_intents_retx=n_retx,
+        serf_intents_dropped=n_dropped,
+    )
 
     # Query expiry: past-deadline slots close (serf/query.go Deadline),
     # elementwise over the [N, Q] slot axis.
@@ -408,7 +428,7 @@ def step(cfg: SimConfig, topo, world: World, s: SerfState, key) -> SerfState:
     down_since = jnp.where(
         is_down & (s.down_since < 0), t, jnp.where(is_down, s.down_since, -1)
     )
-    return s._replace(down_since=down_since)
+    return s._replace(down_since=down_since), cnt
 
 
 def _lookup_any(cfg: SimConfig, s: SerfState, key_, origin):
@@ -504,7 +524,7 @@ def _query_response_tally(cfg: SimConfig, topo, s: SerfState, active,
     return jax.lax.cond(jnp.any(s.q_open_key > 0), tally, lambda s: s, s)
 
 
-def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
+def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key):
     """Single-chip, an IDLE event plane costs zero: with no queued
     event anywhere and no open query, every mask in the body is false
     and the state passes through — so the whole phase rides one
@@ -513,20 +533,24 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key) -> SerfState:
     work), and the full-serf northstar pays for the event plane only
     while the epidemic is actually in flight. Under sharding the body
     runs unconditionally: its collectives cannot sit inside
-    data-dependent control flow, and the budget census pins them."""
+    data-dependent control flow, and the budget census pins them.
+
+    Returns (state, (queued[] i32, retransmits[] i32, drops[] i32)) —
+    the idle branch returns zeros of the same structure so both cond
+    branches match."""
     if coll.sharded():
         return _event_phase_body(cfg, topo, s, active, key)
     busy = jnp.any(s.ev_key > 0) | jnp.any(s.q_open_key > 0)
+    z = jnp.zeros((), jnp.int32)
     return jax.lax.cond(
         busy,
         lambda st: _event_phase_body(cfg, topo, st, active, key),
-        lambda st: st,
+        lambda st: (st, (z, z, z)),
         s,
     )
 
 
-def _event_phase_body(cfg: SimConfig, topo, s: SerfState, active,
-                      key) -> SerfState:
+def _event_phase_body(cfg: SimConfig, topo, s: SerfState, active, key):
     """Receive → queue → deliver pipeline for user events and queries.
 
     Receiving and delivering are decoupled, as in the reference (every
@@ -648,19 +672,24 @@ def _event_phase_body(cfg: SimConfig, topo, s: SerfState, active,
     ckey = jnp.concatenate(cand_key, axis=1)       # [N, fan*PE]
     corig = jnp.concatenate(cand_orig, axis=1)
     fresh = (ckey > 0) & ~_lookup_any(cfg, s, ckey, corig)
+    n_queued = jnp.zeros((), jnp.int32)
+    n_dropped = jnp.zeros((), jnp.int32)
     for _ in range(2):
         win_key = jnp.min(jnp.where(fresh, ckey, sentinel), axis=1)
         got = win_key != sentinel
         slot_i = jnp.argmax(fresh & (ckey == win_key[:, None]), axis=1)
         win_orig = swim._take_col(corig, slot_i)
-        s = _equeue_push(
+        s, evicted = _equeue_push(
             cfg, s, got, jnp.where(got, win_key, 0),
             jnp.where(got, win_orig, -1), tx_limit,
         )
+        n_queued = n_queued + counters_mod.count(got)
+        n_dropped = n_dropped + counters_mod.count(evicted)
         taken = (ckey == win_key[:, None]) & (corig == win_orig[:, None]) \
             & got[:, None]
         fresh = fresh & ~taken
-    return s
+    n_retx = jnp.sum(sends).astype(jnp.int32)
+    return s, (n_queued, n_retx, n_dropped)
 
 
 # ----------------------------------------------------------------------

@@ -87,6 +87,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from consul_tpu.config import SimConfig
+from consul_tpu.models import counters as counters_mod
 from consul_tpu.models.state import SimState, own_key as _own_key
 from consul_tpu.ops import merge, scaling, topology, vivaldi
 from consul_tpu.ops.topology import Topology, World
@@ -172,7 +173,22 @@ def _gather_by_col(topo: Topology, packed: jax.Array, col: jax.Array,
 
 
 def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> SimState:
-    """Advance the whole cluster by one tick. Pure; jit/shard-map safe."""
+    """Advance the whole cluster by one tick. Pure; jit/shard-map safe.
+
+    Thin wrapper over :func:`step_counted` discarding the counters —
+    XLA dead-code-eliminates the counter reductions, so callers that
+    only want the state pay nothing for them."""
+    return step_counted(cfg, topo, world, state, key)[0]
+
+
+def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
+                 key):
+    """One tick plus its :class:`counters.GossipCounters` event tallies
+    (probes, acks/nacks, suspicions, deaths, gossip tx/rx, push-pull
+    merges, refutations) — every counter is a reduction over masks the
+    step already computes, so the tally adds no communication. Under
+    ``shard_map`` the sums are shard-local; parallel/shard_step.py
+    psums them into global totals."""
     n, k_deg = cfg.n, cfg.degree
     g = cfg.gossip
     t = state.t
@@ -223,6 +239,7 @@ def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> 
     expired = is_suspect & (remaining <= 0.0) & active[:, None]
     dead_key = merge.make_key(merge.key_incarnation(state.view_key), merge.DEAD)
     state = state._replace(view_key=jnp.where(expired, dead_key, state.view_key))
+    n_deaths = counters_mod.count(expired)
 
     # ------------------------------------------------------------------
     # 2. Probe windows closing this tick with no ack -> suspect target,
@@ -230,6 +247,7 @@ def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> 
     #    cycle, +1 per missing nack; state.go:437-456, awareness.go).
     # ------------------------------------------------------------------
     failing = (state.pending_col >= 0) & (t >= state.pending_fail_tick) & active
+    n_timeouts = counters_mod.count(failing)
     fcol = jnp.where(failing, state.pending_col, 0)
     fentry = _take_col(state.view_key, fcol)
     # suspectNode applies to alive entries at the known incarnation
@@ -346,6 +364,14 @@ def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> 
     # nack that never arrived is an awareness penalty.
     nack_rcvd = relay_reached & ~(target_up[:, None] & ~loss_b) & ~loss_c
     nack_miss = ic - jnp.sum(nack_rcvd, axis=1).astype(jnp.int32)
+    # Counter view of the probe plane: launches, acks, and the nacks
+    # that actually rode a failed-direct cycle (indirect probes only
+    # fire after the direct leg misses, state.go:366-435).
+    n_probes = counters_mod.count(has_target)
+    n_acks = counters_mod.count(acked)
+    n_nacks = counters_mod.count(
+        nack_rcvd & (has_target & ~direct_ok)[:, None]
+    )
 
     # A ping to a suspect target carries a suspect message so it can
     # refute immediately (compound ping+suspect, state.go:306-331);
@@ -427,7 +453,7 @@ def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> 
     # ------------------------------------------------------------------
     # 4. Gossip fan-out and delivery (receiver-side; no scatters).
     # ------------------------------------------------------------------
-    state, refute_gossip = _gossip_phase(
+    state, refute_gossip, n_gossip_tx, n_gossip_rx = _gossip_phase(
         cfg, topo, state, active, keys[8], tx_limit
     )
     refute_poke = _poke_refutes(
@@ -437,7 +463,9 @@ def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> 
     # ------------------------------------------------------------------
     # 5. Push-pull anti-entropy (receiver-side, both directions).
     # ------------------------------------------------------------------
-    state, refute_pp = _push_pull_phase(cfg, topo, state, active, pp_period, keys[9])
+    state, refute_pp, n_pp_merges = _push_pull_phase(
+        cfg, topo, state, active, pp_period, keys[9]
+    )
 
     # ------------------------------------------------------------------
     # Refutation: bump own incarnation past any accusation and re-arm
@@ -463,13 +491,25 @@ def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> 
     #    queues a broadcast wherever state changed; new accuser bits on
     #    a still-suspect entry also re-gossip, suspicion.go:103-129).
     # ------------------------------------------------------------------
-    state = _reconcile_suspicion(state, view0, t)
+    state, n_susp = _reconcile_suspicion(state, view0, t)
     changed = (state.view_key != view0) | ((state.susp_seen & ~seen0) != 0)
     state = state._replace(
         tx_left=jnp.where(changed & active[:, None], tx_limit, state.tx_left)
     )
 
-    return state._replace(t=t + 1)
+    cnt = counters_mod.zeros()._replace(
+        probes_sent=n_probes,
+        acks_received=n_acks,
+        nacks_received=n_nacks,
+        probe_timeouts=n_timeouts,
+        suspicions_started=n_susp,
+        refutations=counters_mod.count(refuting),
+        deaths_declared=n_deaths,
+        gossip_tx=n_gossip_tx,
+        gossip_rx=n_gossip_rx,
+        pushpull_merges=n_pp_merges,
+    )
+    return state._replace(t=t + 1), cnt
 
 
 def _vivaldi_observe(cfg, state: SimState, ok, peer_col, rtt,
@@ -513,7 +553,8 @@ def _vivaldi_observe(cfg, state: SimState, ok, peer_col, rtt,
 
 def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit):
     """Fan-out + receiver-side delivery + lattice merge + confirmations
-    + refute-claim collection. Returns (state, refute_inc[N]).
+    + refute-claim collection. Returns (state, refute_inc[N],
+    packets_tx[] i32, packets_rx[] i32).
 
     Senders pick their ``piggyback_msgs`` hottest view entries (highest
     remaining budget = fewest past transmits, the TransmitLimitedQueue
@@ -581,6 +622,7 @@ def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit):
     view = state.view_key
     refute_inc = jnp.zeros((ln,), jnp.uint32)
     seen_delta = jnp.zeros((ln, k_deg), jnp.uint32)
+    n_rx = jnp.zeros((), jnp.int32)
     cands = []
     for f in range(fan):
         j = jcols[f]
@@ -592,6 +634,7 @@ def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit):
             shift,
         )
         arrived = s_send & ~drop[:, f] & recv_up
+        n_rx = n_rx + counters_mod.count(arrived)
         fact_ok = arrived[:, None] & s_svalid
         rr = topology.remap_row(topo, j)                # [K]
         mycol = _vec_at(rr, s_scol)                     # [N, P]
@@ -638,7 +681,7 @@ def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit):
             seen_delta = seen_delta | jnp.where(oh, bits[:, pi:pi + 1], 0)
 
     state = state._replace(view_key=view, susp_seen=state.susp_seen | seen_delta)
-    return state, refute_inc
+    return state, refute_inc, counters_mod.count(sendable), n_rx
 
 
 def _poke_refutes(cfg, topo: Topology, state: SimState, poke_flag, poke_col,
@@ -682,7 +725,8 @@ def _push_pull_phase(cfg, topo: Topology, state: SimState, active, pp_period, ke
     net.go:777-1070, state.go:573-608). Receiver-side formulation: the
     pull direction gathers the partner's view forward along the
     displacement; the push direction gathers the initiator's view
-    backward; both remap columns through the static tables."""
+    backward; both remap columns through the static tables. Returns
+    (state, refute_inc[N], merges_applied[] i32)."""
     n, k_deg = cfg.n, cfg.degree
     rows = coll.rows(n)
 
@@ -745,14 +789,16 @@ def _push_pull_phase(cfg, topo: Topology, state: SimState, active, pp_period, ke
         ),
     )
 
-    return state._replace(view_key=view), refute_inc
+    n_merges = counters_mod.count(init_ok) + counters_mod.count(s_ok)
+    return state._replace(view_key=view), refute_inc, n_merges
 
 
 def _reconcile_suspicion(state: SimState, view0, t):
     """Derive suspicion-timer starts/resets from this tick's view delta:
     entries entering suspect (or re-suspected at a higher incarnation)
     start a timer now; entries leaving suspect clear it
-    (state.go:1000-1001, :1124-1158, :1178-1179)."""
+    (state.go:1000-1001, :1124-1158, :1178-1179). Returns
+    (state, timers_started[] i32)."""
     st0, st1 = merge.key_status(view0), merge.key_status(state.view_key)
     inc0, inc1 = merge.key_incarnation(view0), merge.key_incarnation(state.view_key)
     now_suspect = st1 == merge.SUSPECT
@@ -774,4 +820,5 @@ def _reconcile_suspicion(state: SimState, view0, t):
     susp_seen = jnp.where(
         fresh & (susp_seen == 0), jnp.uint32(1), susp_seen
     )
-    return state._replace(susp_start=susp_start, susp_seen=susp_seen)
+    return state._replace(susp_start=susp_start, susp_seen=susp_seen), \
+        counters_mod.count(restarted)

@@ -22,6 +22,21 @@ NODE_AXIS = "nodes"
 DC_AXIS = "dc"
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-portable ``shard_map``: newer jax exposes it as
+    ``jax.shard_map(..., check_vma=)``, older releases as
+    ``jax.experimental.shard_map.shard_map(..., check_rep=)`` (the same
+    replication check under its earlier name). All shard_map call sites
+    in this repo go through here."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None, n_dc: int = 1) -> Mesh:
     """1-D node mesh, or 2-D (dc, nodes) when federating datacenters."""
     devices = list(devices if devices is not None else jax.devices())

@@ -39,18 +39,27 @@ gathered tables are KBs per device).
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from consul_tpu.config import SimConfig
+from consul_tpu.models import counters as counters_mod
 from consul_tpu.models import swim
 from consul_tpu.ops.topology import Topology, World
 from consul_tpu.parallel import collective as coll
-from consul_tpu.parallel.mesh import NODE_AXIS, node_spec
+from consul_tpu.parallel.mesh import NODE_AXIS, node_spec, shard_map
 
 
-def _make_sharded(step_fn, cfg: SimConfig, topo: Topology, mesh: Mesh):
+def _make_sharded(step_fn, cfg: SimConfig, topo: Topology, mesh: Mesh,
+                  counted: bool = False):
     """Shared builder: jit(shard_map(step_fn)) over the node axis with
-    the collective context installed and state buffers donated."""
+    the collective context installed and state buffers donated.
+
+    With ``counted=True``, ``step_fn`` is a ``*_counted`` step returning
+    (state, GossipCounters): each shard's partial tallies are stacked
+    into one [len(FIELDS)] i32 vector and ``psum``-reduced over the node
+    axis — a single small collective — so every device holds the global
+    totals (out spec P(), replicated)."""
     n_shards = mesh.shape[NODE_AXIS]
     if cfg.n % n_shards != 0:
         raise ValueError(f"n={cfg.n} must divide over {n_shards} shards")
@@ -59,15 +68,21 @@ def _make_sharded(step_fn, cfg: SimConfig, topo: Topology, mesh: Mesh):
 
     def local_step(world_local, state_local, key):
         with coll.node_axis(NODE_AXIS, n_shards, cfg.n):
-            return step_fn(cfg, topo, world_local, state_local, key)
+            if not counted:
+                return step_fn(cfg, topo, world_local, state_local, key)
+            st, cnt = step_fn(cfg, topo, world_local, state_local, key)
+            red = jax.lax.psum(jnp.stack(list(cnt)), NODE_AXIS)
+            return st, counters_mod.unstack(red)
 
     def global_step(world_g, state_g, key):
         specs = jax.tree.map(lambda l: node_spec(l, cfg.n), state_g)
-        inner = jax.shard_map(
+        out_specs = specs if not counted else (
+            specs, jax.tree.map(lambda _: P(), counters_mod.zeros()))
+        inner = shard_map(
             local_step,
             mesh=mesh,
             in_specs=(world_spec, specs, P()),
-            out_specs=specs,
+            out_specs=out_specs,
             check_vma=False,
         )
         return inner(world_g, state_g, key)
@@ -92,6 +107,23 @@ def make_sharded_serf_step(cfg: SimConfig, topo: Topology, mesh: Mesh):
     from consul_tpu.models import serf
 
     return _make_sharded(serf.step, cfg, topo, mesh)
+
+
+def make_sharded_counted_step(cfg: SimConfig, topo: Topology, mesh: Mesh):
+    """``step(world, state, key) -> (state, GossipCounters)`` under
+    shard_map: the per-shard tallies are psum-reduced over the node axis
+    (one extra 13-lane i32 collective), so the returned counters are the
+    global per-tick totals, identical on every device."""
+    return _make_sharded(swim.step_counted, cfg, topo, mesh, counted=True)
+
+
+def make_sharded_counted_serf_step(cfg: SimConfig, topo: Topology,
+                                   mesh: Mesh):
+    """The counted full-serf step under shard_map (see
+    :func:`make_sharded_counted_step`)."""
+    from consul_tpu.models import serf
+
+    return _make_sharded(serf.step_counted, cfg, topo, mesh, counted=True)
 
 
 def place(mesh: Mesh, tree, n: int):

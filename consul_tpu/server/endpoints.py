@@ -34,6 +34,7 @@ from consul_tpu.server import rtt
 from consul_tpu.server.fsm import FSM
 from consul_tpu.server.raft import NotLeader, RaftCluster, RaftNode
 from consul_tpu.server.state_store import StateStore
+from consul_tpu.utils.telemetry import Sink
 
 # Reference defaults (agent/consul/config.go:519-521).
 COORDINATE_UPDATE_PERIOD_S = 5.0
@@ -55,9 +56,17 @@ class Server:
 
     def __init__(self, node_id: str, raft_node: RaftNode, fsm: FSM,
                  registry: dict[str, "Server"],
-                 vivaldi_dimensionality: int = 8, dc: str = "dc1"):
+                 vivaldi_dimensionality: int = 8, dc: str = "dc1",
+                 sink: Optional[Sink] = None):
         self.id = node_id
         self.raft = raft_node
+        # Telemetry sink shared with the raft node (reference
+        # lib/telemetry.go: one go-metrics sink per process); the raft
+        # timers (consul.raft.*) and the leader loop's reconcile timer
+        # (consul.leader.reconcile) land here.
+        self.sink = sink if sink is not None else Sink()
+        if getattr(raft_node, "sink", None) is None:
+            raft_node.sink = self.sink
         self.fsm = fsm
         self.registry = registry
         self.vivaldi_dimensionality = vivaldi_dimensionality
@@ -1237,17 +1246,22 @@ class ServerCluster:
             store_factory = lambda nid: DurableRaftStore(  # noqa: E731
                 os.path.join(data_dir, "raft", nid))
 
+        # One shared sink for the whole in-process cluster, so a test
+        # or bench can read consul.raft.* / consul.leader.* timers from
+        # a single place regardless of which node leads.
+        self.sink = Sink()
         self.raft = RaftCluster(
             n, apply_factory, seed=seed,
             snapshot_threshold=snapshot_threshold,
             snapshot_factory=lambda nid: fsms[nid].snapshot,
             restore_factory=lambda nid: fsms[nid].restore,
             store_factory=store_factory,
+            sink=self.sink,
         )
         self.dc = dc
         self.servers = [
             Server(nid, self.raft.nodes[nid], fsms[nid], self.registry,
-                   vivaldi_dimensionality, dc=dc)
+                   vivaldi_dimensionality, dc=dc, sink=self.sink)
             for nid in sorted(self.raft.nodes)
         ]
         # bootstrap-expect (reference server_serf.go:236 maybeBootstrap):

@@ -67,6 +67,7 @@ def reconcile(server: Server, members: Iterable[dict]) -> list[int]:
     reference agent/consul/server_serf.go:131, leader.go:918-…)."""
     if not server.is_leader():
         return []
+    t0 = time.perf_counter()
     indexes = []
     seen = set()
     for m in members:
@@ -88,6 +89,11 @@ def reconcile(server: Server, members: Iterable[dict]) -> list[int]:
         idx = reconcile_member(server, check["node"], "", "reap")
         if idx is not None:
             indexes.append(idx)
+    sink = getattr(server, "sink", None)
+    if sink is not None:
+        # Reference metrics.MeasureSince([]string{"leader", "reconcile"},
+        # ...) around the member sweep (leader.go:918).
+        sink.measure_since("consul.leader.reconcile", t0)
     return indexes
 
 

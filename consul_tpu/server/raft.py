@@ -22,6 +22,7 @@ from __future__ import annotations
 import dataclasses
 import random
 import threading
+import time
 import zlib
 from typing import Any, Callable, Optional
 
@@ -135,7 +136,7 @@ class RaftNode:
                  restore_fn: Optional[Callable[[dict], None]] = None,
                  snapshot_threshold: int = 1024,
                  store=None, voter: bool = True,
-                 voters: Optional[set] = None):
+                 voters: Optional[set] = None, sink=None):
         self.id = node_id
         self.peers = [p for p in peer_ids if p != node_id]
         # Voter configuration (reference raft Voter vs Nonvoter
@@ -177,6 +178,15 @@ class RaftNode:
         # the results of its own recent applies.
         self.apply_results: dict[int, Any] = {}
         self.apply_results_cap = 4096
+        # Telemetry (optional): the reference raft library's
+        # consul.raft.apply / consul.raft.commitTime /
+        # consul.raft.leader.lastContact instrumentation
+        # (hashicorp/raft raft.go + api.go metrics). _commit_t0 stamps
+        # propose time per index; _follower_contact stamps the last
+        # successful AppendEntries reply per follower.
+        self.sink = sink
+        self._commit_t0: dict[int, float] = {}
+        self._follower_contact: dict[str, float] = {}
         self.stopped = False
         # Stats surface for autopilot's StatsFetcher (stats_fetcher.go).
         self.ticks = 0
@@ -318,6 +328,15 @@ class RaftNode:
             if self.heartbeat_ticks <= 0:
                 self.heartbeat_ticks = HEARTBEAT_TICKS
                 self._broadcast_appends()
+                if self.sink is not None and self._follower_contact:
+                    # Staleness of the slowest follower, in ms
+                    # (consul.raft.leader.lastContact).
+                    now = time.perf_counter()
+                    self.sink.add_sample(
+                        "consul.raft.leader.lastContact",
+                        max(now - t
+                            for t in self._follower_contact.values())
+                        * 1000.0)
             return
         if not self.voter:
             return  # non-voters never campaign
@@ -375,6 +394,11 @@ class RaftNode:
                 raise NotLeader(self.leader_id)
             entry = LogEntry(self.term, self.last_log_index() + 1, command)
             self.log.append(entry)
+            if self.sink is not None:
+                self.sink.incr_counter("consul.raft.apply")
+                self._commit_t0[entry.index] = time.perf_counter()
+                if len(self._commit_t0) > 4096:  # uncommittable leftovers
+                    self._commit_t0.pop(next(iter(self._commit_t0)))
             self._persist_append([entry])
             self._broadcast_appends()
             # Configuration entries take effect at append (after the
@@ -537,6 +561,8 @@ class RaftNode:
                 self.match_index.get(msg.src, 0), p["match_index"]
             )
             self.next_index[msg.src] = self.match_index[msg.src] + 1
+            if self.sink is not None:
+                self._follower_contact[msg.src] = time.perf_counter()
             self._advance_commit()
         else:
             self.next_index[msg.src] = max(1, p["match_index"] + 1)
@@ -560,6 +586,13 @@ class RaftNode:
     def _apply_committed(self):
         while self.last_applied < self.commit_index:
             self.last_applied += 1
+            if self.sink is not None:
+                # Propose→commit latency on the proposing leader
+                # (consul.raft.commitTime, hashicorp/raft
+                # dispatchLogs/processLogs timing).
+                t0 = self._commit_t0.pop(self.last_applied, None)
+                if t0 is not None:
+                    self.sink.measure_since("consul.raft.commitTime", t0)
             entry = self.entry_at(self.last_applied)
             if entry is None or entry.command == {"type": "noop"}:
                 continue
@@ -657,7 +690,7 @@ class RaftCluster:
     def __init__(self, n: int, apply_factory: Callable[[str], Callable],
                  seed: int = 0, snapshot_threshold: int = 1024,
                  snapshot_factory=None, restore_factory=None,
-                 store_factory=None):
+                 store_factory=None, sink=None):
         self.transport = Transport()
         ids = [f"srv{i}" for i in range(n)]
         self.nodes = {}
@@ -665,6 +698,7 @@ class RaftCluster:
                            store_factory)
         self._seed = seed
         self._snapshot_threshold = snapshot_threshold
+        self._sink = sink
         for node_id in ids:
             self.nodes[node_id] = self._make_node(node_id, ids)
 
@@ -676,6 +710,7 @@ class RaftCluster:
             snapshot_fn=snap_f(node_id) if snap_f else None,
             restore_fn=restore_f(node_id) if restore_f else None,
             store=store_f(node_id) if store_f else None,
+            sink=self._sink,
         )
 
     def add_nonvoter(self, node_id: str) -> RaftNode:
@@ -694,7 +729,7 @@ class RaftCluster:
             snapshot_fn=snap_f(node_id) if snap_f else None,
             restore_fn=restore_f(node_id) if restore_f else None,
             store=store_f(node_id) if store_f else None,
-            voter=False, voters=set(voters),
+            voter=False, voters=set(voters), sink=self._sink,
         )
         self.nodes[node_id] = node
         node._persist_stable()  # records voter=False before any crash

@@ -52,12 +52,14 @@ import socket
 import ssl
 import struct
 import threading
+import time
 from typing import Any, Callable, Optional
 
 import msgpack
 
 from consul_tpu.server.endpoints import NoPathToDatacenter
 from consul_tpu.server.raft import NotLeader
+from consul_tpu.utils.telemetry import Sink
 
 # First-byte connection roles, byte values per reference
 # agent/pool/conn.go:3-30.
@@ -118,6 +120,51 @@ def _recv_frame(sock: socket.socket) -> dict:
 # Server side
 # ----------------------------------------------------------------------
 
+class _SinkMetricsView:
+    """Read-through view of the listener's wire counters living in the
+    shared telemetry :class:`Sink` (the listener's old ad-hoc dict,
+    preserved as an interface: ``listener.metrics["busy_rejections"]``
+    still works, but the numbers now come from — and are visible in —
+    the sink under the ``sim.rpc.*`` names)."""
+
+    _KEYS = ("busy_rejections", "peak_inflight", "tls_conns",
+             "plain_conns")
+
+    def __init__(self, sink: Sink):
+        self._sink = sink
+
+    def __getitem__(self, key: str) -> int:
+        if key not in self._KEYS:
+            raise KeyError(key)
+        if key == "peak_inflight":
+            return int(self._sink.gauge_value("sim.rpc.peak_inflight"))
+        return int(self._sink.counter_sum(f"sim.rpc.{key}"))
+
+    def __iter__(self):
+        return iter(self._KEYS)
+
+    def __len__(self):
+        return len(self._KEYS)
+
+    def keys(self):
+        return self._KEYS
+
+    def items(self):
+        return [(k, self[k]) for k in self._KEYS]
+
+    def __contains__(self, key):
+        return key in self._KEYS
+
+    def get(self, key, default=None):
+        return self[key] if key in self._KEYS else default
+
+    def __repr__(self):
+        return repr(dict(self.items()))
+
+    def __eq__(self, other):
+        return dict(self.items()) == other
+
+
 class RpcListener:
     """One TCP listener demuxing connections by first byte against
     ``rpc_fn(method, **args)`` (a Server.rpc or a leader-routing
@@ -132,6 +179,11 @@ class RpcListener:
     encrypts but does not authenticate peers; the reference's
     VerifyIncoming is both together (tlsutil/config.go).
     ``snapshot_fn``/``restore_fn`` serve the RPC_SNAPSHOT role.
+    ``sink`` is the shared telemetry sink; wire counters
+    (``sim.rpc.*``) and per-request timing (``consul.rpc.request`` /
+    ``consul.rpc.query`` MeasureSince, reference agent/consul/
+    rpc.go:190,220) land there, with :attr:`metrics` kept as a
+    read-through view.
     """
 
     def __init__(self, rpc_fn: Callable[..., Any],
@@ -139,7 +191,8 @@ class RpcListener:
                  tls=None, require_tls: bool = False,
                  max_inflight: int = DEFAULT_MAX_INFLIGHT,
                  snapshot_fn: Optional[Callable[[], Any]] = None,
-                 restore_fn: Optional[Callable[[Any], Any]] = None):
+                 restore_fn: Optional[Callable[[Any], Any]] = None,
+                 sink: Optional[Sink] = None):
         if require_tls and tls is None:
             raise ValueError("require_tls needs a TLS configurator")
         self.rpc_fn = rpc_fn
@@ -148,8 +201,8 @@ class RpcListener:
         self.max_inflight = int(max_inflight)
         self.snapshot_fn = snapshot_fn
         self.restore_fn = restore_fn
-        self.metrics = {"busy_rejections": 0, "peak_inflight": 0,
-                        "tls_conns": 0, "plain_conns": 0}
+        self.sink = sink if sink is not None else Sink()
+        self.metrics = _SinkMetricsView(self.sink)
         self._mlock = threading.Lock()
         self._sock = socket.create_server((host, port))
         self.port = self._sock.getsockname()[1]
@@ -177,8 +230,8 @@ class RpcListener:
                 tconn = self.tls.incoming_ctx().wrap_socket(
                     conn, server_side=True)
                 tconn.settimeout(None)
-                with self._mlock:
-                    self.metrics["tls_conns"] += 1
+                self.sink.incr_counter("sim.rpc.tls_conns")
+                self.sink.incr_counter("consul.rpc.accept_conn")
                 self._serve_conn(tconn, inside_tls=True)
                 return
             if proto == RPC_SNAPSHOT:
@@ -191,8 +244,8 @@ class RpcListener:
             if not inside_tls:
                 if self.require_tls:
                     return  # plaintext refused (VerifyIncoming)
-                with self._mlock:
-                    self.metrics["plain_conns"] += 1
+                self.sink.incr_counter("sim.rpc.plain_conns")
+                self.sink.incr_counter("consul.rpc.accept_conn")
             self._serve_rpc_stream(conn)
         except (RpcWireError, OSError, ssl.SSLError):
             pass
@@ -209,17 +262,19 @@ class RpcListener:
                 admitted = inflight[0] < self.max_inflight
                 if admitted:
                     inflight[0] += 1
+                    # Read-modify-write max under _mlock: concurrent
+                    # connections race on the shared peak gauge.
                     with self._mlock:
-                        self.metrics["peak_inflight"] = max(
-                            self.metrics["peak_inflight"], inflight[0])
+                        self.sink.set_gauge("sim.rpc.peak_inflight", max(
+                            self.sink.gauge_value("sim.rpc.peak_inflight"),
+                            inflight[0]))
             if not admitted:
                 # Cap hit: answer busy INLINE, no thread spawned — the
                 # yamux stream-window refusal. The send happens OUTSIDE
                 # ilock: a client that stops draining its socket blocks
                 # this sendall, and workers finishing their requests
                 # must still be able to decrement the in-flight count.
-                with self._mlock:
-                    self.metrics["busy_rejections"] += 1
+                self.sink.incr_counter("sim.rpc.busy_rejections")
                 busy = {"seq": req.get("seq", 0), "err_type": "busy",
                         "err": f"server busy: >{self.max_inflight} "
                                "in-flight requests on connection"}
@@ -236,8 +291,10 @@ class RpcListener:
 
     def _serve_one(self, conn, wlock, req, inflight, ilock):
         seq = req.get("seq", 0)
+        t0 = time.perf_counter()
+        args = req.get("args", {})
         try:
-            out = self.rpc_fn(req["method"], **req.get("args", {}))
+            out = self.rpc_fn(req["method"], **args)
             resp = {"seq": seq, "ok": out}
         except NotLeader as e:
             resp = {"seq": seq, "err_type": "not_leader",
@@ -256,6 +313,14 @@ class RpcListener:
         finally:
             with ilock:
                 inflight[0] -= 1
+            # Per-request service time under the reference's names
+            # (rpc.go MeasureSince): every request samples
+            # consul.rpc.request; blocking queries (a min_index arg —
+            # agent/structs QueryOptions.MinQueryIndex) additionally
+            # sample consul.rpc.query.
+            self.sink.measure_since("consul.rpc.request", t0)
+            if "min_index" in args:
+                self.sink.measure_since("consul.rpc.query", t0)
         try:
             _send_frame(conn, resp, wlock)
         except (OSError, RpcWireError):

@@ -91,6 +91,17 @@ class Sink:
         """MeasureSince: elapsed milliseconds sample (go-metrics)."""
         self.add_sample(name, (time.perf_counter() - t0) * 1000.0)
 
+    # Read-side accessors (the host tier's read-through views — e.g.
+    # RpcListener.metrics — poll these instead of keeping shadow dicts).
+    def counter_sum(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            agg = self._counters.get(name)
+            return agg.total if agg is not None else default
+
+    def gauge_value(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._gauges.get(name, default)
+
     def snapshot(self) -> dict:
         """The /v1/agent/metrics JSON shape (go-metrics
         DisplayMetrics)."""
@@ -116,17 +127,31 @@ def to_prometheus(snapshot: dict) -> str:
         return "".join(ch if ch.isalnum() or ch == "_" else "_"
                        for ch in name)
 
+    # Distinct dotted names can sanitize to the same Prometheus name
+    # ("serf.queue.Event-max" vs "serf.queue.Event.max"); a second
+    # # TYPE line for an already-declared name is invalid exposition
+    # format, so later collisions are skipped (keep first).
+    seen: set[str] = set()
     lines: list[str] = []
     for g in snapshot.get("Gauges", []):
         n = norm(g["Name"])
+        if n in seen:
+            continue
+        seen.add(n)
         lines.append(f"# TYPE {n} gauge")
         lines.append(f"{n} {float(g['Value'])}")
     for c in snapshot.get("Counters", []):
         n = norm(c["Name"])
+        if n in seen:
+            continue
+        seen.add(n)
         lines.append(f"# TYPE {n} counter")
         lines.append(f"{n} {float(c.get('Sum', c.get('Count', 0)))}")
     for s in snapshot.get("Samples", []):
         n = norm(s["Name"])
+        if n in seen:
+            continue
+        seen.add(n)
         # Samples render as a summary (count + sum), the promhttp
         # convention for go-metrics samples.
         lines.append(f"# TYPE {n} summary")
@@ -135,18 +160,33 @@ def to_prometheus(snapshot: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+def emit_counter_deltas(sink: Sink, deltas: dict):
+    """Fold one chunk's GossipCounters deltas (plain-int dict keyed by
+    field name) into the sink under the reference metric names
+    (models/counters.py METRIC_NAMES). Zero deltas are skipped so an
+    idle plane leaves no counter rows behind."""
+    from consul_tpu.models.counters import METRIC_NAMES
+
+    for field, delta in deltas.items():
+        if delta:
+            sink.incr_counter(METRIC_NAMES[field], delta)
+
+
 def emit_sim_metrics(state, sink: Sink,
                      health=None, rmse_s: Optional[float] = None,
                      rounds_per_sec: Optional[float] = None,
                      chunk_wall_s: Optional[float] = None,
                      chunk_ticks: Optional[int] = None,
                      serf_state=None,
-                     queue_depth_warning: int = 0):
+                     queue_depth_warning: int = 0,
+                     counters: Optional[dict] = None):
     """Record one chunk boundary's worth of reference-named metrics.
 
     One batched device→host fetch for the scalar reductions; the
     optional ``health``/``rmse_s`` reuse values the caller already
-    computed (utils/metrics.py) rather than recomputing."""
+    computed (utils/metrics.py) rather than recomputing. ``counters``
+    is the chunk's GossipCounters delta dict (already host-side ints),
+    folded in via :func:`emit_counter_deltas`."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -185,6 +225,8 @@ def emit_sim_metrics(state, sink: Sink,
         sink.set_gauge("sim.undetected", float(health.undetected))
     if rmse_s is not None:
         sink.set_gauge("sim.vivaldi_rmse_ms", rmse_s * 1000.0)
+    if counters is not None:
+        emit_counter_deltas(sink, counters)
     if serf_state is not None:
         # serf.queue.Event sample (checkQueueDepth, serf/serf.go:
         # 1627-1648): per-live-node occupied broadcast-queue slots. The

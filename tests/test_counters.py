@@ -1,0 +1,185 @@
+"""On-device gossip counter tests (models/counters.py + the scan carry).
+
+The counters are tallies of protocol events the reference instruments
+one call at a time (memberlist metrics.IncrCounter sites); here they
+ride the ``lax.scan`` carry as a pytree of i32 scalars and surface as
+one batched fetch per chunk. These tests pin the properties that make
+them trustworthy:
+
+  * conservation — on a lossless all-alive topology every gossip packet
+    sent is received and every probe is acked, exactly (N=1024,
+    multi-chunk);
+  * chunk invariance — totals don't depend on how the run is chunked,
+    nor on whether the metrics plane rides along;
+  * fault response — kill/revive moves the failure-path counters and
+    never decreases anything (monotone cumulative totals);
+  * zero compile cost — the counted runner compiles once per
+    (chunk, with_metrics) signature, fault injection adds no recompiles;
+  * sharded parity — the psum-reduced shard_map totals equal the
+    single-device totals exactly (i32, no float tolerance).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from consul_tpu.config import SimConfig
+from consul_tpu.models import counters as counters_mod
+from consul_tpu.models import serf
+from consul_tpu.models import state as sim_state
+from consul_tpu.models import swim
+from consul_tpu.models.cluster import SerfSimulation, Simulation
+from consul_tpu.ops import topology
+from consul_tpu.parallel import mesh as pmesh
+from consul_tpu.parallel import shard_step
+
+N_DEV = 8
+
+
+class TestConservation:
+    def test_lossless_all_alive_identities(self):
+        """N=1024, multi-chunk: tx == rx and probes == acks, exactly."""
+        sim = Simulation(SimConfig(n=1024, view_degree=32), seed=0)
+        sim.run(96, chunk=32, with_metrics=False)
+        c = sim.counters_snapshot()
+        # Every gossip packet sent lands: the simulated wire is lossless
+        # and every node is alive to receive.
+        assert c["gossip_tx"] == c["gossip_rx"] > 0
+        # Every probe window closes with its ack (same-tick RTT): the
+        # probe ledger balances with no timeouts and no nacks.
+        assert c["probes_sent"] == c["acks_received"] > 0
+        assert c["probes_sent"] == (
+            c["acks_received"] + c["nacks_received"] + c["probe_timeouts"]
+        )
+        # No failures -> the failure path never fires.
+        assert c["nacks_received"] == 0
+        assert c["probe_timeouts"] == 0
+        assert c["suspicions_started"] == 0
+        assert c["deaths_declared"] == 0
+        assert c["refutations"] == 0
+        # Push-pull converges views; it must have run at this length.
+        assert c["pushpull_merges"] > 0
+        # Bare SWIM sim: the serf event plane is absent.
+        assert c["serf_intents_queued"] == 0
+        assert c["serf_intents_retx"] == 0
+
+    def test_chunk_and_metrics_invariance(self):
+        """The same 64 ticks chunked 32/32 with the metrics plane vs one
+        64-tick metrics-free scan (the deferred batched-flush path) give
+        identical totals — counters survive chunk boundaries and don't
+        depend on the trace riding along."""
+        a = Simulation(SimConfig(n=128, view_degree=16), seed=3)
+        a.run(64, chunk=32, with_metrics=True)
+        b = Simulation(SimConfig(n=128, view_degree=16), seed=3)
+        b.run(64, chunk=64, with_metrics=False)
+        assert b._pending_counters  # deferred, not yet fetched
+        assert a.counters_snapshot() == b.counters_snapshot()
+        assert not b._pending_counters  # reading flushed the queue
+
+
+class TestFaultResponse:
+    def test_kill_revive_moves_failure_counters_monotonically(self):
+        sim = Simulation(SimConfig(n=256, view_degree=16), seed=1)
+        sim.run(64, chunk=32, with_metrics=False)
+        before = sim.counters_snapshot()
+
+        sim.kill(jnp.arange(256) < 26)
+        sim.run(128, chunk=32, with_metrics=False)
+        after_kill = sim.counters_snapshot()
+        # Dead nodes stop receiving: tx strictly exceeds rx now.
+        d = {k: after_kill[k] - before[k] for k in after_kill}
+        assert d["gossip_tx"] > d["gossip_rx"] > 0
+        # The failure path fires: timeouts -> suspicions -> deaths.
+        assert d["probe_timeouts"] > 0
+        assert d["suspicions_started"] > 0
+        assert d["deaths_declared"] > 0
+        assert d["nacks_received"] > 0  # indirect probes answered
+
+        sim.revive(jnp.arange(256) < 26)
+        sim.run(128, chunk=32, with_metrics=False)
+        final = sim.counters_snapshot()
+        # Revived nodes refute any lingering suspicion of themselves.
+        assert final["refutations"] > after_kill["refutations"]
+        # Cumulative totals never decrease across fault injection.
+        for k in final:
+            assert final[k] >= after_kill[k] >= before[k]
+
+    def test_serf_event_counters(self):
+        sim = SerfSimulation(SimConfig(n=256, view_degree=16), seed=0)
+        sim.run(32, chunk=32, with_metrics=False)
+        idle = sim.counters_snapshot()
+        assert idle["serf_intents_retx"] == 0  # nothing queued yet
+        sim.user_event(jnp.arange(256) < 8, 1)
+        sim.run(64, chunk=32, with_metrics=False)
+        c = sim.counters_snapshot()
+        # The event propagates: every node queues the intent once, and
+        # the queue retransmits it with the piggyback budget.
+        assert c["serf_intents_queued"] > 0
+        assert c["serf_intents_retx"] > 0
+        # SWIM-plane conservation still holds under the serf stack.
+        assert c["gossip_tx"] == c["gossip_rx"] > 0
+
+
+class TestCompileCount:
+    def test_one_compile_per_signature(self):
+        """Counters ride the existing programs: one XLA compile per
+        (chunk, with_metrics) signature, and fault injection (kill /
+        revive change state values, not shapes) adds none."""
+        sim = Simulation(SimConfig(n=128, view_degree=16), seed=0)
+        sim.run(64, chunk=32, with_metrics=False)
+        sim.run(32, chunk=32, with_metrics=False)
+        sim.kill(jnp.arange(128) < 13)
+        sim.run(32, chunk=32, with_metrics=False)
+        sim.revive(jnp.arange(128) < 13)
+        sim.run(32, chunk=32, with_metrics=False)
+        sim.run(32, chunk=32, with_metrics=True)
+        assert set(sim._runners) == {(32, False), (32, True)}
+        for key, runner in sim._runners.items():
+            assert runner._cache_size() == 1, key
+        # Reading counters costs no compiles either.
+        sim.counters_snapshot()
+        for key, runner in sim._runners.items():
+            assert runner._cache_size() == 1, key
+
+
+class TestShardedParity:
+    def _setup(self, n=64):
+        cfg = SimConfig(n=n, view_degree=8)
+        key = jax.random.PRNGKey(7)
+        kw, kn, ks, kt = jax.random.split(key, 4)
+        world = topology.make_world(cfg, kw)
+        topo = topology.make_topology(cfg, kn)
+        state = sim_state.init(cfg, ks)
+        return cfg, world, topo, state, kt
+
+    def _mesh(self):
+        return Mesh(np.array(jax.devices()[:N_DEV]), (pmesh.NODE_AXIS,))
+
+    def test_swim_counted_psum_matches_unsharded(self):
+        cfg, world, topo, state, kt = self._setup()
+        # Reference BEFORE the sharded call: the sharded runner donates
+        # its state buffers, and device_put may alias rather than copy.
+        _, want = swim.step_counted(cfg, topo, world, state, kt)
+        want = np.asarray(counters_mod.stack(want))
+
+        mesh = self._mesh()
+        step = shard_step.make_sharded_counted_step(cfg, topo, mesh)
+        _, got = step(shard_step.place(mesh, world, cfg.n),
+                      shard_step.place(mesh, state, cfg.n), kt)
+        np.testing.assert_array_equal(
+            np.asarray(counters_mod.stack(got)), want)
+
+    def test_serf_counted_psum_matches_unsharded(self):
+        cfg, world, topo, _, kt = self._setup()
+        kq = jax.random.PRNGKey(8)
+        sstate = serf.init(cfg, kq)
+        _, want = serf.step_counted(cfg, topo, world, sstate, kt)
+        want = np.asarray(counters_mod.stack(want))
+
+        mesh = self._mesh()
+        step = shard_step.make_sharded_counted_serf_step(cfg, topo, mesh)
+        _, got = step(shard_step.place(mesh, world, cfg.n),
+                      shard_step.place(mesh, sstate, cfg.n), kt)
+        np.testing.assert_array_equal(
+            np.asarray(counters_mod.stack(got)), want)

@@ -4,6 +4,8 @@ serf unit + convergence tests (reference serf/serf_test.go patterns:
 boot a small in-process cluster, fire an event/query, poll until it
 propagates everywhere)."""
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import pytest
@@ -17,14 +19,24 @@ from consul_tpu.ops import lamport, merge, topology
 pytestmark = pytest.mark.parametrize("vd", [0, 16], ids=["dense", "sparse16"])
 
 
-def make_sim(n=48, vd=0, **cfg_kw):
-    cfg = SimConfig(n=n, view_degree=vd, **cfg_kw)
+@functools.lru_cache(maxsize=None)
+def _sim_parts(cfg):
+    # Memoized per config: the world/topology/initial-state derivation
+    # is deterministic (PRNGKey(7)) and JAX arrays are immutable, so
+    # tests sharing a config share ONE compiled step instead of paying
+    # XLA per test function.
     key = jax.random.PRNGKey(7)
     kw, kn, ks = jax.random.split(key, 3)
     world = topology.make_world(cfg, kw)
     topo = topology.make_topology(cfg, kn)
     state = serf.init(cfg, ks)
     step = jax.jit(lambda st, k: serf.step(cfg, topo, world, st, k))
+    return topo, world, state, step
+
+
+def make_sim(n=48, vd=0, **cfg_kw):
+    cfg = SimConfig(n=n, view_degree=vd, **cfg_kw)
+    topo, world, state, step = _sim_parts(cfg)
     return cfg, topo, world, state, step
 
 

@@ -30,6 +30,37 @@ def _mesh():
     return Mesh(np.array(jax.devices()[:N_DEV]), (pmesh.NODE_AXIS,))
 
 
+@functools.lru_cache(maxsize=None)
+def _swim_cfg(n, view_degree):
+    # Memoized per shape: derivation is deterministic (PRNGKey(0)) and
+    # JAX arrays are immutable. The initial STATE is built fresh per
+    # test (see _swim_world): place() may alias replicated leaves
+    # rather than copy, and the sharded step donates its state — a
+    # cached state would come back deleted.
+    cfg = SimConfig(n=n, view_degree=view_degree)
+    key = jax.random.PRNGKey(0)
+    kw, kn, _ = jax.random.split(key, 3)
+    world = topology.make_world(cfg, kw)
+    topo = topology.make_topology(cfg, kn)
+    return cfg, topo, world
+
+
+def _swim_world(n, view_degree):
+    cfg, topo, world = _swim_cfg(n, view_degree)
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)[2]
+    return cfg, topo, world, sim_state.init(cfg, ks)
+
+
+@functools.lru_cache(maxsize=None)
+def _swim_steps(n, view_degree):
+    """One sharded + one unsharded compiled step per shape, shared by
+    every trajectory/convergence test instead of re-paying XLA."""
+    cfg, topo, world = _swim_cfg(n, view_degree)
+    sstep = shard_step.make_sharded_step(cfg, topo, _mesh())
+    ustep = jax.jit(functools.partial(swim.step, cfg, topo, world))
+    return sstep, ustep
+
+
 SHIFTS = [0, 1, 7, 8, 9, 32, 63, -3, -17, 100]
 
 
@@ -47,7 +78,7 @@ class TestRingRoll:
                 return coll.roll(xl, shift)
 
         got = jax.jit(
-            jax.shard_map(
+            pmesh.shard_map(
                 f, mesh=mesh, in_specs=P(pmesh.NODE_AXIS),
                 out_specs=P(pmesh.NODE_AXIS),
             )
@@ -65,7 +96,7 @@ class TestRingRoll:
                 return coll.roll(xl, s)
 
         got = jax.jit(
-            jax.shard_map(
+            pmesh.shard_map(
                 f, mesh=mesh, in_specs=(P(pmesh.NODE_AXIS), P()),
                 out_specs=P(pmesh.NODE_AXIS),
             )
@@ -85,7 +116,7 @@ class TestRingRoll:
                         return coll.roll(xl, s if traced else shift)
 
                 got = jax.jit(
-                    jax.shard_map(
+                    pmesh.shard_map(
                         f, mesh=mesh, in_specs=(spec, P()), out_specs=spec
                     )
                 )(arr, jnp.int32(shift))
@@ -104,7 +135,7 @@ class TestRingRoll:
 
         flag = jnp.zeros(n, bool).at[37].set(True)
         rows, anyv = jax.jit(
-            jax.shard_map(
+            pmesh.shard_map(
                 f, mesh=mesh, in_specs=P(pmesh.NODE_AXIS),
                 out_specs=(P(pmesh.NODE_AXIS), P()),
                 check_vma=False,
@@ -114,7 +145,7 @@ class TestRingRoll:
         assert bool(anyv)
         assert not bool(
             jax.jit(
-                jax.shard_map(
+                pmesh.shard_map(
                     f, mesh=mesh, in_specs=P(pmesh.NODE_AXIS),
                     out_specs=(P(pmesh.NODE_AXIS), P()),
                     check_vma=False,
@@ -132,7 +163,7 @@ class TestRingRoll:
                 return coll.uniform_rows(key, n, (4,))
 
         got = jax.jit(
-            jax.shard_map(
+            pmesh.shard_map(
                 f, mesh=mesh, in_specs=(), out_specs=P(pmesh.NODE_AXIS, None)
             )
         )()
@@ -145,19 +176,12 @@ class TestShardedStep:
     """Full SWIM step under shard_map vs the single-device step."""
 
     def _build(self, n=256, view_degree=16):
-        cfg = SimConfig(n=n, view_degree=view_degree)
-        key = jax.random.PRNGKey(0)
-        kw, kn, ks = jax.random.split(key, 3)
-        world = topology.make_world(cfg, kw)
-        topo = topology.make_topology(cfg, kn)
-        st = sim_state.init(cfg, ks)
-        return cfg, topo, world, st
+        return _swim_world(n, view_degree)
 
     def test_matches_unsharded_trajectory(self):
         cfg, topo, world, st0 = self._build()
         mesh = _mesh()
-        sstep = shard_step.make_sharded_step(cfg, topo, mesh)
-        ustep = jax.jit(functools.partial(swim.step, cfg, topo, world))
+        sstep, ustep = _swim_steps(cfg.n, 16)
 
         su = st0
         ss = shard_step.place(mesh, st0, cfg.n)
@@ -187,7 +211,7 @@ class TestShardedStep:
         and re-converge exactly like the protocol demands."""
         cfg, topo, world, st0 = self._build()
         mesh = _mesh()
-        sstep = shard_step.make_sharded_step(cfg, topo, mesh)
+        sstep, _ = _swim_steps(cfg.n, 16)
 
         ss = shard_step.place(mesh, st0, cfg.n)
         wg = shard_step.place(mesh, world, cfg.n)
@@ -224,8 +248,7 @@ class TestShardedStep:
         for discrete state."""
         cfg, topo, world, st0 = self._build(n=128, view_degree=0)
         mesh = _mesh()
-        sstep = shard_step.make_sharded_step(cfg, topo, mesh)
-        ustep = jax.jit(functools.partial(swim.step, cfg, topo, world))
+        sstep, ustep = _swim_steps(cfg.n, 0)
 
         su = st0
         ss = shard_step.place(mesh, st0, cfg.n)
@@ -248,7 +271,7 @@ class TestShardedStep:
         end-to-end behavior, not just trajectory equality)."""
         cfg, topo, world, st0 = self._build(n=128, view_degree=0)
         mesh = _mesh()
-        sstep = shard_step.make_sharded_step(cfg, topo, mesh)
+        sstep, _ = _swim_steps(cfg.n, 0)
         ss = shard_step.place(mesh, st0, cfg.n)
         wg = shard_step.place(mesh, world, cfg.n)
         for t in range(30):

@@ -21,7 +21,10 @@ N = 64
 NODE = 10
 
 
+@functools.lru_cache(maxsize=None)
 def make_world(vd=16, push_pull_ms=6_000):
+    # Memoized: derivation is deterministic (PRNGKey(2)) and JAX arrays
+    # are immutable, so tests sharing a config share ONE compiled step.
     cfg = SimConfig(n=N, view_degree=vd,
                     gossip=GossipConfig.lan(push_pull_interval_ms=push_pull_ms))
     key = jax.random.PRNGKey(2)
