@@ -181,6 +181,31 @@ def child(platform: str, deadline: float):
     finally:
         sim = None  # free the headline sim before the serf build below
 
+    # Chaos SLO probe: a short partition-heal scenario through the
+    # compiled fault-schedule plane (consul_tpu/chaos) on a small
+    # dedicated sim — the fault masks enter the jitted scan as a
+    # program argument, so this costs one extra executable, not one
+    # per schedule. Emits the on-device convergence SLO counters
+    # (time-to-first-suspect / confirm / heal, false-positive deaths)
+    # as a stable phase for downstream BENCH json consumers.
+    try:
+        if left() > 60:
+            from consul_tpu import chaos as chaos_mod
+
+            cn = int(os.environ.get("BENCH_CHAOS_N", "1024"))
+            csim = build(cn)
+            csim.run(64, chunk=32, with_metrics=False)  # form the cluster
+            res = csim.run_scenario(
+                [chaos_mod.Partition(start=4, stop=16,
+                                     side_a=slice(0, int(cn * 0.3)))],
+                chunk=32, settle=64,
+            )
+            _emit({"phase": "chaos", "n": cn, "ticks": res.ticks,
+                   "slo": res.slo})
+            del csim
+    except Exception as e:
+        _emit({"phase": "error", "where": "chaos", "error": repr(e)[:500]})
+
     from consul_tpu.models.cluster import SerfSimulation
 
     # Full-stack serf throughput: the SWIM plane PLUS the user-event/
@@ -680,6 +705,11 @@ def main():
         ),
         "serf_counters": _get(
             primary["phases"], "serf_throughput", "counters"),
+        # Chaos convergence SLOs (consul_tpu/chaos): stable keys
+        # fault_ticks / time_to_first_suspect / time_to_confirm /
+        # time_to_heal / false_positive_deaths / messages_dropped.
+        "chaos": _get(primary["phases"], "chaos", "slo"),
+        "chaos_n": _get(primary["phases"], "chaos", "n"),
         "sweep": [
             {"n": p["n"], "rounds_per_s": p["rounds_per_s"],
              "compile_s": p.get("compile_s")}

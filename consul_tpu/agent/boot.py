@@ -86,7 +86,10 @@ _DEFAULTS = {
     # node_ttl_s / service_ttl_s.
     "dns": None,
     # ACLs (reference acl block): {"enabled": true, "default_policy":
-    # "allow"|"deny", "master_token": "..."}; null = ACLs off.
+    # "allow"|"deny", "master_token": "...", "agent_token": "..."};
+    # null = ACLs off. agent_token is the token DNS lookups resolve
+    # with (DNS packets carry none — reference agent/dns.go resolves
+    # via agent.tokens).
     "acl": None,
     # WAN federation across PROCESSES (reference -retry-join-wan /
     # ports.serf_wan): RPC addresses ("host:port") of servers in OTHER
@@ -448,6 +451,36 @@ class AgentRuntime:
         return rpc, wait_write, None
 
     # ------------------------------------------------------------------
+    def _dns_authz(self):
+        """DNS packets carry no token: the reference resolves every
+        lookup with the agent's own token under the configured default
+        policy (agent/dns.go → agent.tokens, then the catalog/health
+        endpoint vetters). Returns an ``(resource, name, access) ->
+        bool`` gate for DNSServer, or None when ACLs are off (open,
+        exactly the pre-ACL behavior)."""
+        acl_cfg = self.cfg.get("acl") or {}
+        if not acl_cfg.get("enabled"):
+            return None
+        from consul_tpu.server import acl as acl_mod
+        default_allow = acl_cfg.get("default_policy", "allow") != "deny"
+        token = str(acl_cfg.get("agent_token", ""))
+        master = str(acl_cfg.get("master_token", ""))
+
+        def allowed(resource: str, name: str, access: str = "read"):
+            if master and token == master:
+                return True
+            try:
+                res = self.agent.rpc("ACL.Resolve", secret_id=token)
+                if res["management"]:
+                    return True
+                authz = acl_mod.Authorizer(res["rules"],
+                                           default_allow=default_allow)
+            except Exception:  # noqa: BLE001 — fail closed under ACLs
+                return False
+            return authz.allowed(resource, name, access)
+
+        return allowed
+
     def start(self) -> int:
         """Bind HTTP (+ DNS when configured), start the raft pump
         (server mode); returns the bound HTTP port."""
@@ -465,6 +498,7 @@ class AgentRuntime:
                 only_passing=bool(dns_cfg.get("only_passing", False)),
                 node_ttl_s=int(dns_cfg.get("node_ttl_s", 0)),
                 service_ttl_s=int(dns_cfg.get("service_ttl_s", 0)),
+                authz=self._dns_authz(),
             )
             self.dns_port = self.dns.serve(
                 dns_cfg.get("host", "127.0.0.1"),

@@ -174,14 +174,26 @@ class DNSServer:
     ListenAndServe starts a UDP and a TCP listener on the same port):
     UDP answers are truncated to ``udp_answer_limit`` with TC set when
     trimmed (trimDNSResponse), TCP returns everything length-prefixed.
+
+    ``authz``: DNS packets carry no ACL token, so the reference
+    resolves every lookup with the agent's own token under the
+    configured default policy (agent/dns.go → agent.tokens; the vetters
+    inside each catalog/health endpoint). Here the boot tier hands in
+    one ``(resource, name, access) -> bool`` gate built from the agent
+    token (boot.py _dns_authz); ``None`` means ACLs are off and every
+    lookup is open. A denied service/node read answers REFUSED — never
+    records, and never an NXDOMAIN that would poison negative caches
+    for authorized resolvers on the same name.
     """
 
     def __init__(self, rpc: Callable[..., Any], *, node_name: str = "",
                  domain: str = "consul", datacenter: str = "dc1",
                  node_ttl_s: int = 0, service_ttl_s: int = 0,
                  udp_answer_limit: int = DEFAULT_UDP_ANSWER_LIMIT,
-                 only_passing: bool = False, seed: int = 0):
+                 only_passing: bool = False, seed: int = 0,
+                 authz: Optional[Callable[[str, str, str], bool]] = None):
         self.rpc = rpc
+        self.authz = authz
         self.node_name = node_name
         self.domain = domain.strip(".").lower()
         self.datacenter = datacenter
@@ -348,6 +360,9 @@ class DNSServer:
         return [], NXDOMAIN
 
     # -- lookups -------------------------------------------------------
+    def _allowed(self, resource: str, name: str) -> bool:
+        return self.authz is None or self.authz(resource, name, "read")
+
     def _addr_records(self, qname: str, address: str, ttl: int) -> list:
         """A for IPv4, AAAA for IPv6, CNAME otherwise (dns.go
         formatNodeRecord)."""
@@ -358,6 +373,8 @@ class DNSServer:
         return [(qname, AAAA if ip.version == 6 else A, ttl, str(ip))]
 
     def _node_lookup(self, qname, qtype, node, dc):
+        if not self._allowed("node", node):
+            return [], REFUSED
         got = self.rpc("Internal.NodeInfo",
                        **({"node": node, "dc": dc} if dc
                           else {"node": node}))
@@ -387,6 +404,8 @@ class DNSServer:
         return answers
 
     def _service_lookup(self, qname, qtype, service, tag, dc):
+        if not self._allowed("service", service):
+            return [], REFUSED
         args: dict = {"service": service,
                       "passing_only": self.only_passing}
         if dc:
@@ -454,6 +473,10 @@ class DNSServer:
         out = self.rpc("Catalog.ListNodes")
         for n in out["value"]:
             if n.get("address") == addr:
+                # Node-read gating filters, like the reference's row
+                # vetting: a denied PTR looks like an absent record.
+                if not self._allowed("node", n.get("node", "")):
+                    return [], NXDOMAIN
                 return [(qname, PTR, self.node_ttl_s,
                          f"{n['node']}.node.{self.domain}")], NOERROR
         return [], NXDOMAIN

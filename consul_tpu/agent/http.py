@@ -340,7 +340,32 @@ class HTTPApi:
                     name = node
                 checks = [("session", name, "write")]
             elif parts[1:2] in (["destroy"], ["renew"]):
-                checks = [("session", "", "write")]
+                # By-id writes authorize against the STORED session's
+                # node (reference session_endpoint.go SessionDestroy/
+                # SessionRenew: fetch the session, then SessionWrite on
+                # its Node) — the URL names whatever id the caller
+                # wants and must not pick the rule that protects it,
+                # and the empty name would match any ``session ""``
+                # prefix rule. An unknown id is a deny, not a 404: the
+                # route handler only 404s for callers whose token could
+                # have touched the session.
+                stored = None
+                if len(parts) > 2:
+                    try:
+                        got = self.agent.rpc("Session.Get",
+                                             session_id=parts[2])
+                        if got["value"]:
+                            stored = got["value"][0].get("node", "")
+                    except Exception:  # noqa: BLE001 — treat as unknown
+                        pass
+                if stored is None:
+                    # Management still reaches the handler (honest 404
+                    # on unknown ids); everyone else is denied.
+                    if not authz.management:
+                        return 403, {"error": "Permission denied"}, {}
+                    checks = []
+                else:
+                    checks = [("session", stored, "write")]
             else:
                 checks = [("session", "", "read")]
         elif fam == "event":

@@ -1,0 +1,27 @@
+"""Device-side chaos engine: declarative fault schedules compiled to
+tick-indexed device tensors, threaded through the jitted SWIM/serf scan
+as a program argument (see chaos/schedule.py)."""
+
+from consul_tpu.chaos.schedule import (  # noqa: F401
+    MAX_LINKS,
+    MAX_PARTITIONS,
+    ChaosSchedule,
+    ChurnWave,
+    Degrade,
+    LinkLoss,
+    NodeTerms,
+    Partition,
+    compile_schedule,
+    down_at,
+    empty,
+    fault_started,
+    is_empty,
+    node_terms,
+    pack_terms,
+    pair_ok,
+    roll_terms,
+    shard_once,
+    shift_schedule,
+    static_key_of,
+    unpack_terms,
+)

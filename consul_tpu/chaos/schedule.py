@@ -1,0 +1,471 @@
+"""Declarative fault schedules compiled to device tensors.
+
+The reference survives partitions, asymmetric links, and churn because
+SWIM + Lifeguard were designed against exactly those faults — but a
+simulator that can only express one global iid ``packet_loss`` scalar
+cannot reproduce any of the headline behaviors (partition heal via
+push-pull and probe acks, awareness under asymmetric loss, suspicion
+scaling during churn). This module is the fault model, stated once:
+
+  host description              device form (one ChaosSchedule pytree)
+  ---------------------------------------------------------------------
+  Partition(start, stop, A)  -> part_start/stop [P] + part_side [N, P]
+  LinkLoss(start, stop,      -> ll_start/stop/fwd/rev [L] +
+    A, B, fwd, rev)             ll_a/ll_b [N, L]
+  ChurnWave(start, stop,     -> cw_start/stop/period/down [C] +
+    nodes, period, down)        cw_mask [N, C]
+  Degrade(start, stop,       -> dg_start/stop/tx/rx [D] +
+    nodes, tx, rx)              dg_mask [N, D]
+
+The schedule enters the jitted scan as a program ARGUMENT (like the
+world, models/cluster.py): schedules with the same slot counts
+(:func:`static_key_of`) share one XLA executable, and shifting every
+start/stop by the current tick (:func:`shift_schedule`) changes only
+values, never shapes — ``run_scenario`` replays a relative schedule at
+any point of a warm simulation without recompiling. ``None`` / an empty
+schedule short-circuits at trace time (a Python branch on the static
+slot counts), so the no-chaos program is byte-identical to today's step
+and the compile-count pin holds.
+
+Per-message semantics: every delivery leg in the step functions keeps
+its existing uniform draw and only the *threshold* changes. A leg
+src -> dst survives with probability
+
+  (1 - base_loss) * q_tx(src) * q_rx(dst)
+    * prod_l (1 - fwd_l)^[src in A_l][dst in B_l]
+    * (1 - rev_l)^[src in B_l][dst in A_l]
+
+and is additionally cut entirely when src and dst sit on different
+sides of any active Partition (:func:`pair_ok`). With every entry
+inactive the threshold degenerates to ``base_loss`` — the plain
+``cfg.packet_loss`` model.
+
+Node-axis leaves carry the node dimension FIRST, so under ``shard_map``
+they shard with the state (parallel/shard_step.py ``node_spec``); the
+per-entry scalars replicate. All per-node evaluation (:func:`node_terms`,
+:func:`down_at`) therefore works on whatever row block the leaves hold —
+the same code runs single-chip and sharded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.parallel import collective as coll
+
+# Slot-count caps: partition colors and link-side bitmasks ride the
+# probe plane's packed f32 gather (models/swim.py), which is exact only
+# below 2^24; 20 bits leaves headroom for the SLO status packing.
+MAX_PARTITIONS = 20
+MAX_LINKS = 20
+
+
+# ----------------------------------------------------------------------
+# Host-side schedule entries.
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Partition:
+    """Full partition over [start, stop): nodes in ``side_a`` can only
+    reach each other; everyone else forms side B. Models the network
+    split the reference heals via push-pull + probe acks after the
+    partition lifts (memberlist state.go pushPullNode / probeNode)."""
+
+    start: int
+    stop: int
+    side_a: object  # node ids, bool mask, or slice
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkLoss:
+    """Extra loss on the A->B direction (``fwd``) and independently on
+    B->A (``rev``) over [start, stop) — the asymmetric-link fault
+    Lifeguard's awareness/nack machinery exists for."""
+
+    start: int
+    stop: int
+    a: object
+    b: object
+    fwd: float
+    rev: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ChurnWave:
+    """Kill/revive pulses: over [start, stop) the masked nodes are down
+    whenever ``(t - start) mod period < down_ticks``. ``period=0`` means
+    one pulse spanning the whole window. Revives are warm (the node
+    keeps its views and rejoins by announcing a bumped incarnation,
+    models/state.py revive)."""
+
+    start: int
+    stop: int
+    nodes: object
+    period: int = 0
+    down_ticks: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Degrade:
+    """Slow/lossy nodes over [start, stop): every leg they send loses an
+    extra ``tx_loss`` fraction, every leg they receive an extra
+    ``rx_loss`` — the flaky-member fault that drives the node's own
+    Lifeguard awareness up (failed probe cycles + missing nacks)."""
+
+    start: int
+    stop: int
+    nodes: object
+    tx_loss: float = 0.0
+    rx_loss: float = 0.0
+
+
+# ----------------------------------------------------------------------
+# The compiled device pytree.
+# ----------------------------------------------------------------------
+
+class ChaosSchedule(NamedTuple):
+    """Tick-indexed fault schedule as device tensors. Per-entry scalars
+    are [slots]; node masks are [N, slots] (node axis first — shards
+    with the state under shard_map)."""
+
+    part_start: jax.Array  # [P] i32
+    part_stop: jax.Array   # [P] i32
+    part_side: jax.Array   # [N, P] bool — True = side A
+    ll_start: jax.Array    # [L] i32
+    ll_stop: jax.Array     # [L] i32
+    ll_fwd: jax.Array      # [L] f32 — extra loss A->B
+    ll_rev: jax.Array      # [L] f32 — extra loss B->A
+    ll_a: jax.Array        # [N, L] bool
+    ll_b: jax.Array        # [N, L] bool
+    cw_start: jax.Array    # [C] i32
+    cw_stop: jax.Array     # [C] i32
+    cw_period: jax.Array   # [C] i32
+    cw_down: jax.Array     # [C] i32
+    cw_mask: jax.Array     # [N, C] bool
+    dg_start: jax.Array    # [D] i32
+    dg_stop: jax.Array     # [D] i32
+    dg_tx: jax.Array       # [D] f32
+    dg_rx: jax.Array       # [D] f32
+    dg_mask: jax.Array     # [N, D] bool
+
+
+class NodeTerms(NamedTuple):
+    """Per-node chaos terms at one tick, the transportable form: five
+    per-node scalars that ride rolls/gathers to wherever a pairwise
+    check happens (src terms at the receiver, dst terms at the sender).
+    ``color`` is the partition-side bitfield — two nodes can talk iff
+    their colors are equal. ``a_bits``/``b_bits`` mark membership of
+    the active LinkLoss sides; ``q_tx``/``q_rx`` are the Degrade
+    survival products."""
+
+    color: jax.Array   # [N] i32
+    a_bits: jax.Array  # [N] i32
+    b_bits: jax.Array  # [N] i32
+    q_tx: jax.Array    # [N] f32
+    q_rx: jax.Array    # [N] f32
+
+
+def _as_mask(nodes, n: int) -> np.ndarray:
+    if isinstance(nodes, slice):
+        m = np.zeros(n, bool)
+        m[nodes] = True
+        return m
+    a = np.asarray(nodes)
+    if a.dtype == np.bool_:
+        if a.shape != (n,):
+            raise ValueError(f"bool mask must be [{n}], got {a.shape}")
+        return a.copy()
+    m = np.zeros(n, bool)
+    m[a.astype(np.int64)] = True
+    return m
+
+
+def _check_window(e, kind: str):
+    if not (0 <= e.start < e.stop):
+        raise ValueError(f"{kind} needs 0 <= start < stop, got "
+                         f"[{e.start}, {e.stop})")
+
+
+def _check_rate(v: float, what: str):
+    if not (0.0 <= v <= 1.0):
+        raise ValueError(f"{what} must be in [0, 1], got {v}")
+
+
+def compile_schedule(n: int, events: Sequence = ()) -> ChaosSchedule:
+    """Compile host-side schedule entries into one device pytree.
+    Start/stop ticks are relative to whatever origin the caller later
+    picks (:func:`shift_schedule` rebases them onto a live tick)."""
+    parts = [e for e in events if isinstance(e, Partition)]
+    links = [e for e in events if isinstance(e, LinkLoss)]
+    churn = [e for e in events if isinstance(e, ChurnWave)]
+    degr = [e for e in events if isinstance(e, Degrade)]
+    known = len(parts) + len(links) + len(churn) + len(degr)
+    if known != len(list(events)):
+        raise TypeError("events must be Partition/LinkLoss/ChurnWave/Degrade")
+    if len(parts) > MAX_PARTITIONS:
+        raise ValueError(f"at most {MAX_PARTITIONS} Partition entries")
+    if len(links) > MAX_LINKS:
+        raise ValueError(f"at most {MAX_LINKS} LinkLoss entries")
+
+    for e in parts:
+        _check_window(e, "Partition")
+    for e in links:
+        _check_window(e, "LinkLoss")
+        _check_rate(e.fwd, "LinkLoss.fwd")
+        _check_rate(e.rev, "LinkLoss.rev")
+    for e in churn:
+        _check_window(e, "ChurnWave")
+        if e.period < 0 or e.down_ticks < 0:
+            raise ValueError("ChurnWave period/down_ticks must be >= 0")
+    for e in degr:
+        _check_window(e, "Degrade")
+        _check_rate(e.tx_loss, "Degrade.tx_loss")
+        _check_rate(e.rx_loss, "Degrade.rx_loss")
+
+    def i32(xs):
+        return jnp.asarray(np.asarray(xs, np.int32))
+
+    def f32(xs):
+        return jnp.asarray(np.asarray(xs, np.float32))
+
+    def masks(entries, pick):
+        cols = [_as_mask(pick(e), n) for e in entries]
+        out = np.stack(cols, axis=1) if cols else np.zeros((n, 0), bool)
+        return jnp.asarray(out)
+
+    # A ChurnWave without an explicit period is one pulse covering the
+    # whole window: period = down = the window length.
+    cw_period = [e.period if e.period > 0 else e.stop - e.start
+                 for e in churn]
+    cw_down = [e.down_ticks if e.period > 0 else e.stop - e.start
+               for e in churn]
+
+    return ChaosSchedule(
+        part_start=i32([e.start for e in parts]),
+        part_stop=i32([e.stop for e in parts]),
+        part_side=masks(parts, lambda e: e.side_a),
+        ll_start=i32([e.start for e in links]),
+        ll_stop=i32([e.stop for e in links]),
+        ll_fwd=f32([e.fwd for e in links]),
+        ll_rev=f32([e.rev for e in links]),
+        ll_a=masks(links, lambda e: e.a),
+        ll_b=masks(links, lambda e: e.b),
+        cw_start=i32([e.start for e in churn]),
+        cw_stop=i32([e.stop for e in churn]),
+        cw_period=i32(cw_period),
+        cw_down=i32(cw_down),
+        cw_mask=masks(churn, lambda e: e.nodes),
+        dg_start=i32([e.start for e in degr]),
+        dg_stop=i32([e.stop for e in degr]),
+        dg_tx=f32([e.tx_loss for e in degr]),
+        dg_rx=f32([e.rx_loss for e in degr]),
+        dg_mask=masks(degr, lambda e: e.nodes),
+    )
+
+
+def empty(n: int) -> ChaosSchedule:
+    return compile_schedule(n, ())
+
+
+def is_empty(sched: ChaosSchedule) -> bool:
+    """Trace-time emptiness: slot counts are static shapes, so callers
+    branch in Python and an empty schedule compiles to exactly the
+    schedule-free program."""
+    return (
+        sched.part_start.shape[0] == 0
+        and sched.ll_start.shape[0] == 0
+        and sched.cw_start.shape[0] == 0
+        and sched.dg_start.shape[0] == 0
+    )
+
+
+def static_key_of(sched: Optional[ChaosSchedule]):
+    """Shape fingerprint for executable-cache memo keys: schedules of
+    the same slot counts trace to the same program; None/empty is the
+    schedule-free program."""
+    if sched is None or is_empty(sched):
+        return None
+    return ("chaos", sched.part_start.shape[0], sched.ll_start.shape[0],
+            sched.cw_start.shape[0], sched.dg_start.shape[0])
+
+
+def shift_schedule(sched: ChaosSchedule, dt) -> ChaosSchedule:
+    """Rebase every start/stop by ``dt`` ticks — values only, shapes
+    unchanged, so a relative schedule replays at any live tick without
+    recompiling (run_scenario's offset)."""
+    dt = jnp.asarray(dt, jnp.int32)
+    return sched._replace(
+        part_start=sched.part_start + dt, part_stop=sched.part_stop + dt,
+        ll_start=sched.ll_start + dt, ll_stop=sched.ll_stop + dt,
+        cw_start=sched.cw_start + dt, cw_stop=sched.cw_stop + dt,
+        dg_start=sched.dg_start + dt, dg_stop=sched.dg_stop + dt,
+    )
+
+
+# ----------------------------------------------------------------------
+# Per-tick evaluation (jit/shard_map safe).
+# ----------------------------------------------------------------------
+
+def node_terms(sched: ChaosSchedule, t) -> NodeTerms:
+    """Evaluate the schedule at tick ``t`` down to the five per-node
+    transport scalars. Works on whatever row block the [N, slots]
+    leaves hold (local block under shard_map)."""
+    t = jnp.asarray(t, jnp.int32)
+    nloc = sched.part_side.shape[0]
+    n_p = sched.part_start.shape[0]
+    n_l = sched.ll_start.shape[0]
+    n_d = sched.dg_start.shape[0]
+
+    if n_p:
+        p_act = (t >= sched.part_start) & (t < sched.part_stop)
+        w = jnp.int32(1) << jnp.arange(n_p, dtype=jnp.int32)
+        color = jnp.sum(
+            jnp.where(sched.part_side & p_act[None, :], w[None, :], 0),
+            axis=1,
+        ).astype(jnp.int32)
+    else:
+        color = jnp.zeros((nloc,), jnp.int32)
+
+    if n_l:
+        l_act = (t >= sched.ll_start) & (t < sched.ll_stop)
+        wl = jnp.int32(1) << jnp.arange(n_l, dtype=jnp.int32)
+        a_bits = jnp.sum(
+            jnp.where(sched.ll_a & l_act[None, :], wl[None, :], 0), axis=1
+        ).astype(jnp.int32)
+        b_bits = jnp.sum(
+            jnp.where(sched.ll_b & l_act[None, :], wl[None, :], 0), axis=1
+        ).astype(jnp.int32)
+    else:
+        a_bits = jnp.zeros((nloc,), jnp.int32)
+        b_bits = jnp.zeros((nloc,), jnp.int32)
+
+    if n_d:
+        d_act = (t >= sched.dg_start) & (t < sched.dg_stop)
+        on = sched.dg_mask & d_act[None, :]
+        q_tx = jnp.prod(
+            jnp.where(on, 1.0 - sched.dg_tx[None, :], 1.0), axis=1
+        )
+        q_rx = jnp.prod(
+            jnp.where(on, 1.0 - sched.dg_rx[None, :], 1.0), axis=1
+        )
+    else:
+        q_tx = jnp.ones((nloc,), jnp.float32)
+        q_rx = jnp.ones((nloc,), jnp.float32)
+
+    return NodeTerms(color, a_bits, b_bits, q_tx, q_rx)
+
+
+def down_at(sched: ChaosSchedule, t) -> jax.Array:
+    """[N] bool — which nodes a ChurnWave holds down at tick ``t``."""
+    nloc = sched.part_side.shape[0]
+    if sched.cw_start.shape[0] == 0:
+        return jnp.zeros((nloc,), bool)
+    t = jnp.asarray(t, jnp.int32)
+    act = (t >= sched.cw_start) & (t < sched.cw_stop)
+    phase = (t - sched.cw_start) % jnp.maximum(sched.cw_period, 1)
+    down = act & (phase < sched.cw_down)
+    return jnp.any(sched.cw_mask & down[None, :], axis=1)
+
+
+def fault_started(sched: ChaosSchedule, t) -> jax.Array:
+    """[] bool — has any reachability fault (Partition/ChurnWave) begun
+    by tick ``t``? Gates the time-to-heal accumulator: heal time only
+    counts after a fault existed and lifted."""
+    t = jnp.asarray(t, jnp.int32)
+    started = jnp.zeros((), bool)
+    if sched.part_start.shape[0]:
+        started = started | jnp.any(sched.part_start <= t)
+    if sched.cw_start.shape[0]:
+        started = started | jnp.any(sched.cw_start <= t)
+    return started
+
+
+# ----------------------------------------------------------------------
+# Pairwise deliverability.
+# ----------------------------------------------------------------------
+
+def _link_survival(sched: ChaosSchedule, src: NodeTerms,
+                   dst: NodeTerms) -> jax.Array:
+    n_l = sched.ll_start.shape[0]
+    q = jnp.ones_like(src.q_tx)
+    if n_l == 0:
+        return q
+    fwd_hit = src.a_bits & dst.b_bits
+    rev_hit = src.b_bits & dst.a_bits
+    for li in range(n_l):  # static, small — unrolled compare-selects
+        bit = jnp.int32(1 << li)
+        q = q * jnp.where((fwd_hit & bit) != 0, 1.0 - sched.ll_fwd[li], 1.0)
+        q = q * jnp.where((rev_hit & bit) != 0, 1.0 - sched.ll_rev[li], 1.0)
+    return q
+
+
+def _survival(sched: ChaosSchedule, src: NodeTerms, dst: NodeTerms):
+    return src.q_tx * dst.q_rx * _link_survival(sched, src, dst)
+
+
+def pair_ok(sched: ChaosSchedule, src: NodeTerms, dst: NodeTerms, u,
+            base_loss: float, round_trip: bool = False) -> jax.Array:
+    """One delivery leg src -> dst against an existing uniform draw
+    ``u``: survives iff the pair shares a partition side and ``u``
+    clears the combined loss threshold (base iid loss composed with the
+    chaos survival product). ``round_trip=True`` composes both
+    directions' survival onto the one draw — the step's direct-probe
+    and push-pull legs model the ping+ack round trip with a single
+    uniform, and chaos keeps that draw (and therefore the empty-schedule
+    trajectory) unchanged."""
+    q = _survival(sched, src, dst)
+    if round_trip:
+        q = q * _survival(sched, dst, src)
+    p = 1.0 - (1.0 - base_loss) * q
+    return (src.color == dst.color) & (u >= p)
+
+
+# ----------------------------------------------------------------------
+# Transport helpers.
+# ----------------------------------------------------------------------
+
+def pack_terms(terms: NodeTerms):
+    """The five per-node scalars as uint32 columns for
+    ``collective.roll_many`` (floats travel by bit-pattern)."""
+    return [
+        terms.color.astype(jnp.uint32),
+        terms.a_bits.astype(jnp.uint32),
+        terms.b_bits.astype(jnp.uint32),
+        jax.lax.bitcast_convert_type(terms.q_tx, jnp.uint32),
+        jax.lax.bitcast_convert_type(terms.q_rx, jnp.uint32),
+    ]
+
+
+def unpack_terms(cols) -> NodeTerms:
+    c, a, b, qt, qr = cols
+    return NodeTerms(
+        color=c.astype(jnp.int32),
+        a_bits=a.astype(jnp.int32),
+        b_bits=b.astype(jnp.int32),
+        q_tx=jax.lax.bitcast_convert_type(qt.astype(jnp.uint32), jnp.float32),
+        q_rx=jax.lax.bitcast_convert_type(qr.astype(jnp.uint32), jnp.float32),
+    )
+
+
+def roll_terms(terms: NodeTerms, shift) -> NodeTerms:
+    """Terms of the node ``shift`` seats back along the ring, at every
+    row: one packed exchange (collective.roll semantics — roll by
+    ``+off[j]`` lands the in-column-j sender's terms at the receiver,
+    by ``-off[c]`` the column-c target's terms at the prober)."""
+    return unpack_terms(coll.roll_many(pack_terms(terms), shift))
+
+
+def shard_once(x):
+    """Zero a replicated global indicator on every shard but 0: the
+    sharded counter reduction psums over the node axis, which would
+    multiply a replicated scalar by the shard count."""
+    ctx = coll.current()
+    if ctx is None:
+        return x
+    keep = jax.lax.axis_index(ctx.axis_name) == 0
+    return jnp.where(keep, x, jnp.zeros_like(x))

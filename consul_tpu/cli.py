@@ -27,6 +27,7 @@ framework implements:
   version          print the version                   (command/version)
   tls create       dev CA + server cert                (command/tls)
   validate         config file validation              (command/validate)
+  chaos            compiled fault-schedule scenarios   (consul_tpu/chaos)
   lock             run a command under a KV lock       (command/lock)
   exec             remote execution via KV + events    (command/exec)
 
@@ -704,6 +705,62 @@ def cmd_debug(client: Client, args) -> int:
     return 0
 
 
+def cmd_chaos(args) -> int:
+    """Run a compiled fault-schedule scenario (consul_tpu/chaos) on a
+    local in-process simulation and print the on-device convergence SLO
+    counters as one JSON line. No running agent is needed — like the
+    ``agent`` subcommand this path is special-cased in main() and
+    imports jax lazily so the HTTP-client commands stay light."""
+    from consul_tpu import chaos as chaos_mod
+    from consul_tpu.config import SimConfig
+    from consul_tpu.models.cluster import SerfSimulation, Simulation
+
+    n = args.n
+
+    def frac_nodes(frac):
+        return slice(0, max(1, int(n * frac)))
+
+    events = []
+    for spec in args.partition or []:
+        start, stop, frac = spec.split(",")
+        events.append(chaos_mod.Partition(
+            start=int(start), stop=int(stop),
+            side_a=frac_nodes(float(frac))))
+    for spec in args.link_loss or []:
+        f = spec.split(",")
+        na = max(1, int(n * float(f[2])))
+        nb = max(1, int(n * float(f[3])))
+        events.append(chaos_mod.LinkLoss(
+            start=int(f[0]), stop=int(f[1]),
+            a=slice(0, na), b=slice(na, na + nb),
+            fwd=float(f[4]), rev=float(f[5]) if len(f) > 5 else 0.0))
+    for spec in args.churn or []:
+        start, stop, frac = spec.split(",")
+        events.append(chaos_mod.ChurnWave(
+            start=int(start), stop=int(stop),
+            nodes=frac_nodes(float(frac))))
+    for spec in args.degrade or []:
+        f = spec.split(",")
+        events.append(chaos_mod.Degrade(
+            start=int(f[0]), stop=int(f[1]),
+            nodes=frac_nodes(float(f[2])),
+            tx_loss=float(f[3]),
+            rx_loss=float(f[4]) if len(f) > 4 else 0.0))
+    if not events:
+        # Default scenario: the acceptance-style 70/30 partition-heal.
+        events = [chaos_mod.Partition(
+            start=4, stop=16, side_a=frac_nodes(0.3))]
+
+    cfg = SimConfig(n=n, view_degree=min(args.view_degree, n - 2))
+    cls = SerfSimulation if args.serf else Simulation
+    sim = cls(cfg, seed=args.seed)
+    sim.run(args.form_ticks, chunk=args.chunk, with_metrics=False)
+    res = sim.run_scenario(events, chunk=args.chunk, settle=args.settle)
+    print(json.dumps({"n": n, "ticks": res.ticks, "slo": res.slo,
+                      "counters": res.counters}))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="consul-tpu",
@@ -727,6 +784,27 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("--http-port", type=int, default=None,
                     help="override http.port (0 = pick a free port)")
     ag.add_argument("--data-dir", default=None)
+
+    ch = sub.add_parser(
+        "chaos",
+        help="run a fault-schedule scenario locally, print SLO JSON")
+    ch.add_argument("--n", type=int, default=1024)
+    ch.add_argument("--seed", type=int, default=0)
+    ch.add_argument("--view-degree", type=int, default=16)
+    ch.add_argument("--form-ticks", type=int, default=64,
+                    help="ticks to form the cluster before the faults")
+    ch.add_argument("--chunk", type=int, default=32)
+    ch.add_argument("--settle", type=int, default=64,
+                    help="post-lift window for the heal probe")
+    ch.add_argument("--serf", action="store_true",
+                    help="run the full serf step (event/query plane)")
+    ch.add_argument("--partition", action="append",
+                    metavar="START,STOP,FRAC")
+    ch.add_argument("--link-loss", action="append",
+                    metavar="START,STOP,FRAC_A,FRAC_B,FWD[,REV]")
+    ch.add_argument("--churn", action="append", metavar="START,STOP,FRAC")
+    ch.add_argument("--degrade", action="append",
+                    metavar="START,STOP,FRAC,TX[,RX]")
 
     mem_p = sub.add_parser("members", help="cluster members + health")
     mem_p.add_argument("-wan", action="store_true",
@@ -980,6 +1058,8 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.cmd == "agent":
         return cmd_agent(args)
+    if args.cmd == "chaos":
+        return cmd_chaos(args)
     client = make_client(args)
     try:
         return COMMANDS[args.cmd](client, args)

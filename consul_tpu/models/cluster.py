@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from consul_tpu.chaos import schedule as chaos_mod
 from consul_tpu.config import SimConfig
 from consul_tpu.models import counters as counters_mod
 from consul_tpu.models import serf as serf_mod
@@ -38,6 +39,31 @@ class TickTrace(NamedTuple):
     rmse: jax.Array            # [C] f32
 
 
+# Stable serialization names for the chaos SLO counters — the `chaos`
+# keys bench.py emits and future PRs regress against. Keys match the
+# sink metric suffixes (models/counters.py METRIC_NAMES sim.chaos.*).
+SLO_KEYS = {
+    "chaos_fault_ticks": "fault_ticks",
+    "chaos_first_suspect_wait": "time_to_first_suspect",
+    "chaos_confirm_wait": "time_to_confirm",
+    "chaos_heal_wait": "time_to_heal",
+    "chaos_false_deaths": "false_positive_deaths",
+    "chaos_msgs_dropped": "messages_dropped",
+}
+
+
+class ScenarioResult(NamedTuple):
+    """What one run_scenario replay measured: ``slo`` is the stable-key
+    view of the chaos counters (SLO_KEYS), ``counters`` the full
+    protocol-event deltas over the window, ``trace`` the TickTrace when
+    metrics were on."""
+
+    slo: dict
+    counters: dict
+    ticks: int
+    trace: object
+
+
 def _topo_key(topo) -> tuple:
     """Hashable fingerprint of a Topology's compile-time content. The
     offset/remap tables are read *concretely* during tracing (static
@@ -54,7 +80,8 @@ _RUNNER_CACHE: dict = {}
 
 
 def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
-                  step_fn=swim.step_counted, swim_of=lambda st: st):
+                  step_fn=swim.step_counted, swim_of=lambda st: st,
+                  chaos_key=None):
     """One compiled chunk program. ``step_fn`` is the per-tick counted
     step (bare SWIM or the full serf stack) returning
     (state, GossipCounters); ``swim_of`` projects the SWIM plane out of
@@ -63,19 +90,26 @@ def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
     device→host fetch the tentpole budgets for.
 
     Programs are memoized process-wide on (cfg, topology content,
-    chunk, with_metrics, step): the world enters as a program
-    *argument* rather than a baked constant, so two simulations over
-    the same topology (same seed, or any dense-mode pair) share one
-    executable instead of paying XLA twice. The topology itself stays
-    closed over — its tables feed trace-time static roll shifts."""
-    memo = (cfg, _topo_key(topo), chunk, with_metrics, step_fn, swim_of)
+    chunk, with_metrics, step, chaos shape): the world AND the fault
+    schedule enter as program *arguments* rather than baked constants,
+    so two simulations over the same topology (same seed, or any
+    dense-mode pair) share one executable instead of paying XLA twice,
+    and any two schedules with the same slot counts
+    (chaos.static_key_of) share the chaos-enabled one. ``chaos_key``
+    None is the schedule-free program — the runner is then always
+    called with ``sched=None`` (Simulation.set_chaos normalizes empty
+    schedules away) so its jit cache never grows past one entry. The
+    topology itself stays closed over — its tables feed trace-time
+    static roll shifts."""
+    memo = (cfg, _topo_key(topo), chunk, with_metrics, step_fn, swim_of,
+            chaos_key)
     hit = _RUNNER_CACHE.get(memo)
     if hit is not None:
         return hit
 
-    def body(world, carry, tick_key):
+    def body(world, sched, carry, tick_key):
         state, cnt = carry
-        state, c = step_fn(cfg, topo, world, state, tick_key)
+        state, c = step_fn(cfg, topo, world, state, tick_key, sched)
         cnt = counters_mod.add(cnt, c)
         if not with_metrics:
             return (state, cnt), ()
@@ -87,15 +121,15 @@ def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
         return (state, cnt), TickTrace(
             h.agreement, h.false_positive, h.undetected, rmse)
 
-    def run(world, state, base_key):
+    def run(world, sched, state, base_key):
         ticks = swim_of(state).t + jnp.arange(chunk)
         tick_keys = jax.vmap(lambda t: jax.random.fold_in(base_key, t))(ticks)
         (state, cnt), trace = jax.lax.scan(
-            functools.partial(body, world), (state, counters_mod.zeros()),
-            tick_keys)
+            functools.partial(body, world, sched),
+            (state, counters_mod.zeros()), tick_keys)
         return state, cnt, trace
 
-    jitted = jax.jit(run, donate_argnums=(1,))
+    jitted = jax.jit(run, donate_argnums=(2,))
     _RUNNER_CACHE[memo] = jitted
     return jitted
 
@@ -134,6 +168,10 @@ class Simulation:
         # transfer when the totals are next read.
         self._counters = {f: 0 for f in counters_mod.FIELDS}
         self._pending_counters = []
+        # Installed fault schedule (chaos.ChaosSchedule or None). Enters
+        # the chunk runner as a program argument; None is the schedule-
+        # free program today's tests pin.
+        self.chaos = None
 
     # -- fault injection ------------------------------------------------
     def kill(self, mask):
@@ -142,6 +180,56 @@ class Simulation:
     def revive(self, mask):
         self.state = sim_state.revive(self.cfg, self.state, jnp.asarray(mask))
 
+    def set_chaos(self, sched):
+        """Install (or clear, with None) a fault schedule for subsequent
+        runs. Accepts a compiled :class:`chaos.ChaosSchedule` or a
+        sequence of schedule entries (compiled here). Empty schedules
+        normalize to None so the schedule-free executable keeps exactly
+        one jit cache entry (the compile-count pin)."""
+        if sched is not None and not isinstance(sched, chaos_mod.ChaosSchedule):
+            sched = chaos_mod.compile_schedule(self.cfg.n, sched)
+        if sched is not None and chaos_mod.is_empty(sched):
+            sched = None
+        self.chaos = sched
+        # Bound runners close over the schedule; rebind lazily. The
+        # process-wide _RUNNER_CACHE still memoizes the underlying
+        # programs, so toggling chaos on/off never recompiles.
+        self._runners = {}
+
+    def run_scenario(self, events, ticks=None, chunk: int = 64,
+                     with_metrics: bool = False, settle: int = 64):
+        """Replay a *relative* fault schedule from the current tick and
+        return the SLO counter deltas it produced.
+
+        ``events`` is a sequence of chaos entries (Partition/LinkLoss/
+        ChurnWave/Degrade) with start/stop relative to now; they are
+        compiled, rebased onto the live tick (values only — schedules of
+        the same shape share one executable), run for ``ticks`` ticks
+        (default: last stop + ``settle``, the post-lift window the heal
+        probe needs), and uninstalled again. Returns a ScenarioResult:
+        ``slo`` holds the six chaos counters plus the protocol-event
+        deltas over the scenario window, under the stable key names
+        bench.py serializes."""
+        sched = chaos_mod.compile_schedule(self.cfg.n, events)
+        if ticks is None:
+            stops = [int(e.stop) for e in events]
+            ticks = (max(stops) if stops else 0) + settle
+        t0 = int(self.swim_state.t)
+        prev = self.chaos
+        self.set_chaos(chaos_mod.shift_schedule(sched, t0))
+        before = dict(self.counters)
+        try:
+            trace = self.run(ticks, chunk=chunk, with_metrics=with_metrics)
+        finally:
+            self.set_chaos(prev)
+        after = self.counters
+        deltas = {f: after[f] - before[f] for f in counters_mod.FIELDS}
+        slo = {
+            SLO_KEYS[f]: deltas[f] for f in SLO_KEYS
+        }
+        return ScenarioResult(slo=slo, counters=deltas, ticks=ticks,
+                              trace=trace)
+
     # -- execution ------------------------------------------------------
     def _runner(self, chunk: int, with_metrics: bool):
         k = (chunk, with_metrics)
@@ -149,10 +237,12 @@ class Simulation:
             jitted = _chunk_runner(
                 self.cfg, self.topo, chunk, with_metrics,
                 step_fn=type(self)._step_fn, swim_of=type(self)._swim_of,
+                chaos_key=chaos_mod.static_key_of(self.chaos),
             )
 
-            def bound(state, base_key, _j=jitted, _w=self.world):
-                return _j(_w, state, base_key)
+            def bound(state, base_key, _j=jitted, _w=self.world,
+                      _s=self.chaos):
+                return _j(_w, _s, state, base_key)
 
             bound._cache_size = jitted._cache_size
             self._runners[k] = bound

@@ -46,6 +46,17 @@ class GossipCounters(NamedTuple):
     serf_intents_queued: jax.Array  # serf events/queries staged into queues
     serf_intents_retx: jax.Array    # serf queue entries retransmitted
     serf_intents_dropped: jax.Array  # serf queue evictions under pressure
+    # -- chaos SLO probes (consul_tpu/chaos): ticks are accumulated
+    # on-device while the condition holds, so a chunk delta divided by
+    # the scenario's fault count is the mean time-to-X in ticks. All
+    # zero when no fault schedule is installed (the chaos block is a
+    # trace-time branch, models/swim.py).
+    chaos_fault_ticks: jax.Array        # ticks any injected fault active
+    chaos_first_suspect_wait: jax.Array  # fault ticks before 1st suspicion
+    chaos_confirm_wait: jax.Array       # fault ticks before 1st death
+    chaos_heal_wait: jax.Array          # post-lift ticks with stale views
+    chaos_false_deaths: jax.Array       # deaths of up, reachable nodes
+    chaos_msgs_dropped: jax.Array       # gossip packets cut by chaos alone
 
 
 FIELDS = GossipCounters._fields
@@ -69,6 +80,12 @@ METRIC_NAMES = {
     "serf_intents_queued": "serf.events",
     "serf_intents_retx": "sim.serf.event_retransmits",
     "serf_intents_dropped": "sim.serf.event_drops",
+    "chaos_fault_ticks": "sim.chaos.fault_ticks",
+    "chaos_first_suspect_wait": "sim.chaos.time_to_first_suspect",
+    "chaos_confirm_wait": "sim.chaos.time_to_confirm",
+    "chaos_heal_wait": "sim.chaos.time_to_heal",
+    "chaos_false_deaths": "sim.chaos.false_positive_deaths",
+    "chaos_msgs_dropped": "sim.chaos.messages_dropped",
 }
 assert set(METRIC_NAMES) == set(FIELDS)
 

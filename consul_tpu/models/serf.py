@@ -51,6 +51,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from consul_tpu.chaos import schedule as chaos_mod
 from consul_tpu.config import SimConfig, to_ticks
 from consul_tpu.models import counters as counters_mod
 from consul_tpu.models import state as sim_state
@@ -387,21 +388,27 @@ def leave(cfg: SimConfig, s: SerfState, mask) -> SerfState:
 # The serf tick.
 # ----------------------------------------------------------------------
 
-def step(cfg: SimConfig, topo, world: World, s: SerfState, key) -> SerfState:
+def step(cfg: SimConfig, topo, world: World, s: SerfState, key,
+         sched=None) -> SerfState:
     """One serf tick. Thin wrapper over :func:`step_counted` — XLA dead-
     code-eliminates the unused counter reductions, so existing callers
     pay nothing for them."""
-    return step_counted(cfg, topo, world, s, key)[0]
+    return step_counted(cfg, topo, world, s, key, sched)[0]
 
 
-def step_counted(cfg: SimConfig, topo, world: World, s: SerfState, key):
+def step_counted(cfg: SimConfig, topo, world: World, s: SerfState, key,
+                 sched=None):
     """One serf tick: SWIM membership tick, then event/query gossip,
     response tally, query expiry, and reap bookkeeping. Returns
     (SerfState, GossipCounters) — the SWIM tick's counters plus the
-    serf intent-queue tallies."""
+    serf intent-queue tallies. ``sched`` (optional chaos schedule, see
+    swim.step_counted) gates the serf dissemination legs too — the same
+    tick's terms apply to the membership and the event planes."""
     k_swim, k_ev = jax.random.split(key)
     t = s.swim.t
-    sw, cnt = swim.step_counted(cfg, topo, world, s.swim, k_swim)
+    chaos_on = sched is not None and not chaos_mod.is_empty(sched)
+    sw, cnt = swim.step_counted(cfg, topo, world, s.swim, k_swim, sched)
+    terms = chaos_mod.node_terms(sched, t) if chaos_on else None
     # Pending graceful leaves whose propagate window closed go quiet now
     # (serf.Leave sleeps LeavePropagateDelay then shuts memberlist down).
     quiet = (s.leave_at >= 0) & (sw.t >= s.leave_at)
@@ -409,7 +416,10 @@ def step_counted(cfg: SimConfig, topo, world: World, s: SerfState, key):
     s = s._replace(swim=sw, leave_at=jnp.where(quiet, -1, s.leave_at))
     active = sw.alive_truth & ~sw.left
 
-    s, (n_queued, n_retx, n_dropped) = _event_phase(cfg, topo, s, active, k_ev)
+    s, (n_queued, n_retx, n_dropped) = _event_phase(
+        cfg, topo, s, active, k_ev,
+        sched if chaos_on else None, terms,
+    )
     cnt = cnt._replace(
         serf_intents_queued=n_queued,
         serf_intents_retx=n_retx,
@@ -444,7 +454,8 @@ def _lookup_any(cfg: SimConfig, s: SerfState, key_, origin):
 
 
 def _query_response_tally(cfg: SimConfig, topo, s: SerfState, active,
-                          worig, wkey, isq, grows, k_resp) -> SerfState:
+                          worig, wkey, isq, grows, k_resp,
+                          sched=None, terms=None) -> SerfState:
     """Query responses: the deliverer answers the origin directly (one
     response per node per query — exactly-once via the dedup buffer;
     serf/query.go respondTo). Direct packet: origin must be up, the
@@ -468,20 +479,40 @@ def _query_response_tally(cfg: SimConfig, topo, s: SerfState, active,
     n, k_deg = cfg.n, cfg.degree
 
     def tally(s):
-        resp_drop = coll.uniform_rows(k_resp, n) < cfg.packet_loss
-        arrived = ~resp_drop
+        pl = cfg.packet_loss
+        u_resp = coll.uniform_rows(k_resp, n)
         rf = cfg.serf.query_relay_factor
-        if rf > 0 and cfg.packet_loss > 0.0:
+        if sched is not None:
+            # The response targets an arbitrary origin row: its chaos
+            # terms come off the same globally-visible copies the open-
+            # query keys do (coll.all_rows + row-addressed read).
+            og = chaos_mod.NodeTerms(
+                *(coll.all_rows(x)[worig] for x in terms)
+            )
+            arrived = chaos_mod.pair_ok(sched, terms, og, u_resp, pl)
+        else:
+            arrived = u_resp >= pl
+        if rf > 0 and (sched is not None or pl > 0.0):
             k_relay = jax.random.fold_in(k_resp, 1)
             k_rl1, k_rl2, k_rcol = jax.random.split(k_relay, 3)
-            loss1 = coll.uniform_rows(k_rl1, n, (rf,)) < cfg.packet_loss
-            loss2 = coll.uniform_rows(k_rl2, n, (rf,)) < cfg.packet_loss
+            u1 = coll.uniform_rows(k_rl1, n, (rf,))
+            u2 = coll.uniform_rows(k_rl2, n, (rf,))
             rcols = jax.random.randint(k_rcol, (rf,), 0, k_deg)
             relay_up = jnp.stack(
                 [coll.roll(active, -topo.off[rcols[i]]) for i in range(rf)],
                 axis=1,
             )
-            arrived = arrived | jnp.any(relay_up & ~loss1 & ~loss2, axis=1)
+            if sched is not None:
+                legs = []
+                for i in range(rf):
+                    rt = chaos_mod.roll_terms(terms, -topo.off[rcols[i]])
+                    leg1 = chaos_mod.pair_ok(sched, terms, rt, u1[:, i], pl)
+                    leg2 = chaos_mod.pair_ok(sched, rt, og, u2[:, i], pl)
+                    legs.append(leg1 & leg2)
+                relayed = jnp.stack(legs, axis=1)
+            else:
+                relayed = (u1 >= pl) & (u2 >= pl)
+            arrived = arrived | jnp.any(relay_up & relayed, axis=1)
         # The origin is an arbitrary global row: its liveness and
         # open-query keys come from the globally-visible copies, and
         # the tally is a row-addressed all-to-all delivery (under
@@ -524,7 +555,8 @@ def _query_response_tally(cfg: SimConfig, topo, s: SerfState, active,
     return jax.lax.cond(jnp.any(s.q_open_key > 0), tally, lambda s: s, s)
 
 
-def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key):
+def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key,
+                 sched=None, terms=None):
     """Single-chip, an IDLE event plane costs zero: with no queued
     event anywhere and no open query, every mask in the body is false
     and the state passes through — so the whole phase rides one
@@ -539,18 +571,20 @@ def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key):
     the idle branch returns zeros of the same structure so both cond
     branches match."""
     if coll.sharded():
-        return _event_phase_body(cfg, topo, s, active, key)
+        return _event_phase_body(cfg, topo, s, active, key, sched, terms)
     busy = jnp.any(s.ev_key > 0) | jnp.any(s.q_open_key > 0)
     z = jnp.zeros((), jnp.int32)
     return jax.lax.cond(
         busy,
-        lambda st: _event_phase_body(cfg, topo, st, active, key),
+        lambda st: _event_phase_body(cfg, topo, st, active, key, sched,
+                                     terms),
         lambda st: (st, (z, z, z)),
         s,
     )
 
 
-def _event_phase_body(cfg: SimConfig, topo, s: SerfState, active, key):
+def _event_phase_body(cfg: SimConfig, topo, s: SerfState, active, key,
+                      sched=None, terms=None):
     """Receive → queue → deliver pipeline for user events and queries.
 
     Receiving and delivering are decoupled, as in the reference (every
@@ -608,7 +642,7 @@ def _event_phase_body(cfg: SimConfig, topo, s: SerfState, active, key):
     )
 
     s = _query_response_tally(cfg, topo, s, active, worig, wkey, isq,
-                              grows, k_resp)
+                              grows, k_resp, sched, terms)
 
     # ---- 2. Gossip out: most-retransmittable queue entries, sent along
     # per-tick shared displacements (swim-plane divergence note).
@@ -658,14 +692,22 @@ def _event_phase_body(cfg: SimConfig, topo, s: SerfState, active, key):
     # rolls single-chip; one packed ppermute sharded), as in the SWIM
     # plane.
     recv_up = s.swim.alive_truth & ~s.swim.left
-    drop = coll.uniform_rows(k_loss, n, (fan,)) < cfg.packet_loss
+    u_drop = coll.uniform_rows(k_loss, n, (fan,))
+    pl = cfg.packet_loss
+    tpack = chaos_mod.pack_terms(terms) if sched is not None else []
     cand_key, cand_orig = [], []
     for f in range(fan):
         shift = topo.off[jcols[f]]
-        s_key, s_orig, s_valid, s_peer = coll.roll_many(
-            [m_key, m_origin, m_valid, peer_ok[:, f]], shift
+        rolled = coll.roll_many(
+            [m_key, m_origin, m_valid, peer_ok[:, f]] + tpack, shift
         )
-        arrived = s_peer & ~drop[:, f] & recv_up
+        s_key, s_orig, s_valid, s_peer = rolled[:4]
+        if sched is not None:
+            s_terms = chaos_mod.unpack_terms(rolled[4:])
+            ok_leg = chaos_mod.pair_ok(sched, s_terms, terms, u_drop[:, f], pl)
+        else:
+            ok_leg = u_drop[:, f] >= pl
+        arrived = s_peer & ok_leg & recv_up
         ok = arrived[:, None] & s_valid
         cand_key.append(jnp.where(ok, s_key, 0))
         cand_orig.append(jnp.where(ok, s_orig, -1))

@@ -86,8 +86,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from consul_tpu.chaos import schedule as chaos_mod
 from consul_tpu.config import SimConfig
 from consul_tpu.models import counters as counters_mod
+from consul_tpu.models import state as sim_state_mod
 from consul_tpu.models.state import SimState, own_key as _own_key
 from consul_tpu.ops import merge, scaling, topology, vivaldi
 from consul_tpu.ops.topology import Topology, World
@@ -172,28 +174,53 @@ def _gather_by_col(topo: Topology, packed: jax.Array, col: jax.Array,
     return acc
 
 
-def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key) -> SimState:
+def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key,
+         sched=None) -> SimState:
     """Advance the whole cluster by one tick. Pure; jit/shard-map safe.
 
     Thin wrapper over :func:`step_counted` discarding the counters —
     XLA dead-code-eliminates the counter reductions, so callers that
     only want the state pay nothing for them."""
-    return step_counted(cfg, topo, world, state, key)[0]
+    return step_counted(cfg, topo, world, state, key, sched)[0]
 
 
 def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
-                 key):
+                 key, sched=None):
     """One tick plus its :class:`counters.GossipCounters` event tallies
     (probes, acks/nacks, suspicions, deaths, gossip tx/rx, push-pull
     merges, refutations) — every counter is a reduction over masks the
     step already computes, so the tally adds no communication. Under
     ``shard_map`` the sums are shard-local; parallel/shard_step.py
-    psums them into global totals."""
+    psums them into global totals.
+
+    ``sched`` is an optional :class:`chaos.ChaosSchedule` — a device
+    pytree of tick-indexed faults entering as a program ARGUMENT, so
+    same-shape schedules share one executable. ``None`` or an empty
+    schedule is a trace-time branch: the emitted program is exactly the
+    schedule-free step. With faults installed, every delivery leg keeps
+    its existing uniform draw and gates on ``chaos.pair_ok`` instead of
+    the bare ``cfg.packet_loss`` threshold, churn waves drive
+    kill/revive edges on-device, and the SLO block at the end of the
+    tick accumulates detection/heal latencies into the counters."""
     n, k_deg = cfg.n, cfg.degree
     g = cfg.gossip
     t = state.t
     rows = coll.rows(n)
     keys = jax.random.split(key, 10)
+    chaos_on = sched is not None and not chaos_mod.is_empty(sched)
+    if chaos_on:
+        # Churn edges first: a wave starting this tick kills its nodes
+        # before the tick runs (they stop probing/acking/gossiping,
+        # exactly like host-side kill between chunks); a wave ending
+        # revives them warm with a bumped incarnation — the restarted
+        # agent's rejoin announcement (models/state.py revive).
+        down_now = chaos_mod.down_at(sched, t)
+        down_prev = chaos_mod.down_at(sched, t - 1)
+        state = sim_state_mod.kill(state, down_now & ~down_prev)
+        state = sim_state_mod.revive(cfg, state, down_prev & ~down_now)
+        terms = chaos_mod.node_terms(sched, t)
+    else:
+        terms = None
     # Dense (or very-high-degree) mode runs the gather formulation:
     # probe-target attributes are read by global row id through
     # coll.take_rows — a plain gather single-chip, an all-gather +
@@ -295,18 +322,27 @@ def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
     # per-row shift (see _gather_by_col).
     viv = state.viv
     if roll_mode:
-        packed = jnp.concatenate(
-            [
-                (state.alive_truth & ~state.left).astype(jnp.float32)[:, None],
-                world.pos,
-                world.height[:, None],
-                viv.vec,
-                viv.height[:, None],
-                viv.error[:, None],
-                viv.adjustment[:, None],
-            ],
-            axis=1,
-        )
+        cols = [
+            (state.alive_truth & ~state.left).astype(jnp.float32)[:, None],
+            world.pos,
+            world.height[:, None],
+            viv.vec,
+            viv.height[:, None],
+            viv.error[:, None],
+            viv.adjustment[:, None],
+        ]
+        if chaos_on:
+            # Target chaos terms ride the same packed gather; the int
+            # bitfields are < 2^20 so the f32 trip is exact
+            # (chaos/schedule.py MAX_* caps).
+            cols += [
+                terms.color.astype(jnp.float32)[:, None],
+                terms.a_bits.astype(jnp.float32)[:, None],
+                terms.b_bits.astype(jnp.float32)[:, None],
+                terms.q_tx[:, None],
+                terms.q_rx[:, None],
+            ]
+        packed = jnp.concatenate(cols, axis=1)
         tat = _gather_by_col(topo, packed, jnp.where(has_target, target_col, 0))
         wd = world.pos.shape[1]
         target_up = (tat[:, 0] > 0.5) & has_target
@@ -316,6 +352,15 @@ def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
         t_vh, t_verr, t_vadj = (
             tat[:, 2 + wd + vd], tat[:, 3 + wd + vd], tat[:, 4 + wd + vd]
         )
+        if chaos_on:
+            cb = 5 + wd + vd
+            tgt_terms = chaos_mod.NodeTerms(
+                color=tat[:, cb].astype(jnp.int32),
+                a_bits=tat[:, cb + 1].astype(jnp.int32),
+                b_bits=tat[:, cb + 2].astype(jnp.int32),
+                q_tx=tat[:, cb + 3],
+                q_rx=tat[:, cb + 4],
+            )
     else:
         target = topology.neighbor_of(topo, rows, target_col)
         target_up = coll.take_rows(
@@ -326,6 +371,10 @@ def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
         t_vh = coll.take_rows(viv.height, target)
         t_verr = coll.take_rows(viv.error, target)
         t_vadj = coll.take_rows(viv.adjustment, target)
+        if chaos_on:
+            tgt_terms = chaos_mod.NodeTerms(
+                *(coll.take_rows(x, target) for x in terms)
+            )
     # The RTT model lives ONCE, shared by both target-attribute paths
     # (ops/topology.true_rtt semantics, jitter drawn shard-aware): a
     # latency-model change cannot diverge roll vs gather mode.
@@ -336,8 +385,23 @@ def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
     rtt_obs = true_rtt * jnp.exp(jitter) if cfg.rtt_jitter_frac > 0 else true_rtt
 
     timeout_s = g.probe_timeout_ms / 1000.0
-    loss = coll.uniform_rows(keys[1], n, (2,)) < cfg.packet_loss  # direct, TCP legs
-    direct_ok = has_target & target_up & (rtt_obs <= timeout_s) & ~loss[:, 0]
+    pl = cfg.packet_loss
+    u2 = coll.uniform_rows(keys[1], n, (2,))  # direct, TCP legs
+    if chaos_on:
+        # Same uniform draws as the plain model; only the survival
+        # threshold changes (chaos/schedule.py pair_ok). The direct
+        # probe and the TCP fallback each model a full round trip on
+        # one draw, so both directions' chaos terms compose onto it.
+        ok_direct_leg = chaos_mod.pair_ok(
+            sched, terms, tgt_terms, u2[:, 0], pl, round_trip=True
+        )
+        ok_tcp_leg = chaos_mod.pair_ok(
+            sched, terms, tgt_terms, u2[:, 1], pl, round_trip=True
+        )
+    else:
+        ok_direct_leg = u2[:, 0] >= pl
+        ok_tcp_leg = u2[:, 1] >= pl
+    direct_ok = has_target & target_up & (rtt_obs <= timeout_s) & ok_direct_leg
     # Indirect probes via k relays + TCP fallback (state.go:366-435),
     # relay displacements shared per tick like the gossip fan. Legs:
     # prober->relay (a), relay<->target (b), nack return (c).
@@ -351,18 +415,35 @@ def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
         ],
         axis=1,
     )
-    loss_a = coll.uniform_rows(keys[3], n, (ic,)) < cfg.packet_loss
-    loss_b = coll.uniform_rows(keys[4], n, (ic,)) < cfg.packet_loss
-    loss_c = coll.uniform_rows(keys[5], n, (ic,)) < cfg.packet_loss
-    relay_reached = relay_avail & ~loss_a
-    relay_ok = relay_reached & target_up[:, None] & ~loss_b
+    u_a = coll.uniform_rows(keys[3], n, (ic,))
+    u_b = coll.uniform_rows(keys[4], n, (ic,))
+    u_c = coll.uniform_rows(keys[5], n, (ic,))
+    if chaos_on:
+        oka, okb, okc = [], [], []
+        for i in range(ic):
+            # The column-c relay's terms land at the prober's row via
+            # the same traced-shift roll that checked its liveness.
+            rt = chaos_mod.roll_terms(terms, -topo.off[relay_jcols[i]])
+            oka.append(chaos_mod.pair_ok(sched, terms, rt, u_a[:, i], pl))
+            okb.append(chaos_mod.pair_ok(
+                sched, rt, tgt_terms, u_b[:, i], pl, round_trip=True))
+            okc.append(chaos_mod.pair_ok(sched, rt, terms, u_c[:, i], pl))
+        ok_a = jnp.stack(oka, axis=1)
+        ok_b = jnp.stack(okb, axis=1)
+        ok_c = jnp.stack(okc, axis=1)
+    else:
+        ok_a = u_a >= pl
+        ok_b = u_b >= pl
+        ok_c = u_c >= pl
+    relay_reached = relay_avail & ok_a
+    relay_ok = relay_reached & target_up[:, None] & ok_b
     indirect_ok = has_target & jnp.any(relay_ok, axis=1) & ~direct_ok
-    tcp_ok = has_target & target_up & ~loss[:, 1]
+    tcp_ok = has_target & target_up & ok_tcp_leg
     acked = direct_ok | indirect_ok | tcp_ok
     # Nacks: a relay that got the request but could not reach the
     # target replies nack (state.go:437-451). On a failed cycle every
     # nack that never arrived is an awareness penalty.
-    nack_rcvd = relay_reached & ~(target_up[:, None] & ~loss_b) & ~loss_c
+    nack_rcvd = relay_reached & ~(target_up[:, None] & ok_b) & ok_c
     nack_miss = ic - jnp.sum(nack_rcvd, axis=1).astype(jnp.int32)
     # Counter view of the probe plane: launches, acks, and the nacks
     # that actually rode a failed-direct cycle (indirect probes only
@@ -379,7 +460,7 @@ def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
     target_entry = _take_col(state.view_key, jnp.where(has_target, target_col, 0))
     target_status = merge.key_status(jnp.where(has_target, target_entry, 0))
     target_inc = merge.key_incarnation(target_entry)
-    poke_flag = has_target & (target_status == merge.SUSPECT) & ~loss[:, 0]
+    poke_flag = has_target & (target_status == merge.SUSPECT) & ok_direct_leg
     poke_col = jnp.where(has_target, target_col, _NEG)
 
     # Probe bookkeeping: window for failures, ticker reschedule scaled
@@ -453,9 +534,11 @@ def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
     # ------------------------------------------------------------------
     # 4. Gossip fan-out and delivery (receiver-side; no scatters).
     # ------------------------------------------------------------------
-    state, refute_gossip, n_gossip_tx, n_gossip_rx = _gossip_phase(
-        cfg, topo, state, active, keys[8], tx_limit
-    )
+    state, refute_gossip, n_gossip_tx, n_gossip_rx, n_chaos_drop = \
+        _gossip_phase(
+            cfg, topo, state, active, keys[8], tx_limit,
+            sched if chaos_on else None, terms,
+        )
     refute_poke = _poke_refutes(
         cfg, topo, state, poke_flag, poke_col, target_inc
     )
@@ -464,7 +547,8 @@ def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
     # 5. Push-pull anti-entropy (receiver-side, both directions).
     # ------------------------------------------------------------------
     state, refute_pp, n_pp_merges = _push_pull_phase(
-        cfg, topo, state, active, pp_period, keys[9]
+        cfg, topo, state, active, pp_period, keys[9],
+        sched if chaos_on else None, terms,
     )
 
     # ------------------------------------------------------------------
@@ -509,7 +593,86 @@ def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
         gossip_rx=n_gossip_rx,
         pushpull_merges=n_pp_merges,
     )
+    if chaos_on:
+        cnt = _chaos_slo(
+            cfg, topo, state, sched, terms, t, roll_mode, expired, active,
+            n_chaos_drop, cnt,
+        )
     return state._replace(t=t + 1), cnt
+
+
+def _chaos_slo(cfg, topo: Topology, state: SimState, sched, terms, t,
+               roll_mode, expired, active, n_chaos_drop, cnt):
+    """On-device convergence SLO probes: compare every tracker's end-of-
+    tick *belief* against the ground truth the schedule defines
+    (partition colors + liveness) and accumulate tick counters —
+    time-to-first-suspect, time-to-confirm, time-to-heal after lift, and
+    false-positive deaths. The waits are replicated global indicators
+    (one per tick), so under shard_map they are zeroed on all shards
+    but 0 before the counter psum (chaos/schedule.py shard_once); the
+    per-event tallies (false deaths, chaos drops) live on their rows
+    and psum to the true global count."""
+    n, k_deg = cfg.n, cfg.degree
+    rows = coll.rows(n)
+    # Subject ground truth per view column: pack (color, alive, left)
+    # into one i32 and move it subject row -> tracker row. Column c's
+    # subject sits at row r + off[c] — the same static-shift roll walk
+    # the probe plane uses.
+    pk = (
+        (terms.color << 2)
+        | (state.alive_truth.astype(jnp.int32) << 1)
+        | state.left.astype(jnp.int32)
+    )
+    if roll_mode:
+        off_np = np.asarray(topo.off)
+        subj = jnp.stack(
+            [coll.roll(pk, -int(off_np[j])) for j in range(k_deg)], axis=1
+        )
+    else:
+        idx = (rows[:, None] + jnp.asarray(topo.off)[None, :]) % n
+        subj = coll.take_rows(pk, idx)
+    subj_color = subj >> 2
+    subj_alive = (subj & 2) != 0
+    subj_left = (subj & 1) != 0
+
+    st_now = _statuses(state.view_key)
+    suspected = (st_now == merge.SUSPECT) | (st_now == merge.DEAD)
+    confirmed = st_now == merge.DEAD
+    cross = subj_color != terms.color[:, None]
+    # A subject is unreachable from this (active) tracker when the
+    # schedule cuts them apart or holds the subject down.
+    subj_down = ~subj_alive & ~subj_left
+    unreach = active[:, None] & (cross | subj_down)
+    fault_now = coll.any_rows(jnp.any(unreach, axis=1))
+    detected = coll.any_rows(jnp.any(unreach & suspected, axis=1))
+    confirm = coll.any_rows(jnp.any(unreach & confirmed, axis=1))
+    # Stale harm after the fault lifts: an active tracker still holding
+    # a reachable, live subject in suspect/dead.
+    wrong = active[:, None] & suspected & subj_alive & ~subj_left & ~cross
+    healing = (
+        chaos_mod.fault_started(sched, t)
+        & ~fault_now
+        & coll.any_rows(jnp.any(wrong, axis=1))
+    )
+    ind = chaos_mod.shard_once(jnp.stack([
+        fault_now,
+        fault_now & ~detected,
+        fault_now & ~confirm,
+        healing,
+    ]).astype(jnp.int32))
+    # False-positive deaths: suspicion expiries (this tick's phase 1)
+    # whose subject was in fact up and reachable.
+    false_deaths = counters_mod.count(
+        expired & subj_alive & ~subj_left & ~cross
+    )
+    return cnt._replace(
+        chaos_fault_ticks=ind[0],
+        chaos_first_suspect_wait=ind[1],
+        chaos_confirm_wait=ind[2],
+        chaos_heal_wait=ind[3],
+        chaos_false_deaths=false_deaths,
+        chaos_msgs_dropped=n_chaos_drop,
+    )
 
 
 def _vivaldi_observe(cfg, state: SimState, ok, peer_col, rtt,
@@ -551,10 +714,11 @@ def _vivaldi_observe(cfg, state: SimState, ok, peer_col, rtt,
     return state._replace(viv=new_viv, lat_buf=lat_buf, lat_cnt=lat_cnt)
 
 
-def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit):
+def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit,
+                  sched=None, terms=None):
     """Fan-out + receiver-side delivery + lattice merge + confirmations
     + refute-claim collection. Returns (state, refute_inc[N],
-    packets_tx[] i32, packets_rx[] i32).
+    packets_tx[] i32, packets_rx[] i32, chaos_drops[] i32).
 
     Senders pick their ``piggyback_msgs`` hottest view entries (highest
     remaining budget = fewest past transmits, the TransmitLimitedQueue
@@ -618,22 +782,36 @@ def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit):
     # the sender payload rides one exchange per hop (coll.roll_many:
     # separate fused rolls single-chip, one packed ppermute sharded).
     recv_up = state.alive_truth & ~state.left
-    drop = coll.uniform_rows(k_drop, n, (fan,)) < cfg.packet_loss
+    u_drop = coll.uniform_rows(k_drop, n, (fan,))
+    pl = cfg.packet_loss
+    tpack = chaos_mod.pack_terms(terms) if sched is not None else []
     view = state.view_key
     refute_inc = jnp.zeros((ln,), jnp.uint32)
     seen_delta = jnp.zeros((ln, k_deg), jnp.uint32)
     n_rx = jnp.zeros((), jnp.int32)
+    n_chaos_drop = jnp.zeros((), jnp.int32)
     cands = []
     for f in range(fan):
         j = jcols[f]
         shift = topo.off[j]
-        (s_send, s_scol, s_skey, s_sbits, s_svalid, s_own_ok,
-         s_ownk) = coll.roll_many(
+        rolled = coll.roll_many(
             [sendable[:, f], scol, skey, sbits, svalid, own_sendable,
-             ownk],
+             ownk] + tpack,
             shift,
         )
-        arrived = s_send & ~drop[:, f] & recv_up
+        s_send, s_scol, s_skey, s_sbits, s_svalid, s_own_ok, s_ownk = \
+            rolled[:7]
+        if sched is not None:
+            # Sender terms rode the same packet; the leg is one-way
+            # sender -> receiver on the existing drop draw.
+            s_terms = chaos_mod.unpack_terms(rolled[7:])
+            ok_leg = chaos_mod.pair_ok(sched, s_terms, terms, u_drop[:, f], pl)
+            n_chaos_drop = n_chaos_drop + counters_mod.count(
+                s_send & recv_up & (u_drop[:, f] >= pl) & ~ok_leg
+            )
+        else:
+            ok_leg = u_drop[:, f] >= pl
+        arrived = s_send & ok_leg & recv_up
         n_rx = n_rx + counters_mod.count(arrived)
         fact_ok = arrived[:, None] & s_svalid
         rr = topology.remap_row(topo, j)                # [K]
@@ -681,7 +859,7 @@ def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit):
             seen_delta = seen_delta | jnp.where(oh, bits[:, pi:pi + 1], 0)
 
     state = state._replace(view_key=view, susp_seen=state.susp_seen | seen_delta)
-    return state, refute_inc, counters_mod.count(sendable), n_rx
+    return state, refute_inc, counters_mod.count(sendable), n_rx, n_chaos_drop
 
 
 def _poke_refutes(cfg, topo: Topology, state: SimState, poke_flag, poke_col,
@@ -719,7 +897,8 @@ def _poke_refutes(cfg, topo: Topology, state: SimState, poke_flag, poke_col,
     return jnp.max(jnp.where(refut & hit, inc, 0), axis=1)
 
 
-def _push_pull_phase(cfg, topo: Topology, state: SimState, active, pp_period, key):
+def _push_pull_phase(cfg, topo: Topology, state: SimState, active, pp_period,
+                     key, sched=None, terms=None):
     """Full-state exchange, both directions, with one displacement-shared
     partner per due node (sendAndReceiveState/mergeState,
     net.go:777-1070, state.go:573-608). Receiver-side formulation: the
@@ -747,6 +926,16 @@ def _push_pull_phase(cfg, topo: Topology, state: SimState, active, pp_period, ke
     up = state.alive_truth & ~state.left
     pv, fwd_ownk, partner_up = coll.roll_many([view0, ownk, up], -shift)
     init_ok = due & partner_up & merge.is_contactable(view0[:, j])
+    if sched is not None:
+        # Push-pull is one TCP session: the whole bidirectional exchange
+        # happens iff the connection survives the schedule (both
+        # directions' chaos terms; no base iid loss — the reference's
+        # push-pull rides TCP, which the plain model never drops).
+        p_terms = chaos_mod.roll_terms(terms, -shift)
+        u_pp = coll.uniform_rows(jax.random.fold_in(key, 1), cfg.n)
+        init_ok = init_ok & chaos_mod.pair_ok(
+            sched, terms, p_terms, u_pp, 0.0, round_trip=True
+        )
 
     # PULL: the initiator merges its partner's full state (pv holds the
     # partner rows).

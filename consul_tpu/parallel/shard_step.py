@@ -51,7 +51,7 @@ from consul_tpu.parallel.mesh import NODE_AXIS, node_spec, shard_map
 
 
 def _make_sharded(step_fn, cfg: SimConfig, topo: Topology, mesh: Mesh,
-                  counted: bool = False):
+                  counted: bool = False, chaos: bool = False):
     """Shared builder: jit(shard_map(step_fn)) over the node axis with
     the collective context installed and state buffers donated.
 
@@ -59,30 +59,58 @@ def _make_sharded(step_fn, cfg: SimConfig, topo: Topology, mesh: Mesh,
     (state, GossipCounters): each shard's partial tallies are stacked
     into one [len(FIELDS)] i32 vector and ``psum``-reduced over the node
     axis — a single small collective — so every device holds the global
-    totals (out spec P(), replicated)."""
+    totals (out spec P(), replicated).
+
+    With ``chaos=True``, the returned function takes a fault schedule
+    after the world: ``step(world, sched, state, key)``. The schedule's
+    [N, slots] node masks shard with the state (node_spec) and the
+    per-entry scalars replicate, so every per-node chaos term is
+    evaluated on the local row block and the link masks stay
+    shard-consistent by construction — the same ppermute rolls that
+    carry the packets carry the sender-side terms
+    (chaos/schedule.py roll_terms)."""
     n_shards = mesh.shape[NODE_AXIS]
     if cfg.n % n_shards != 0:
         raise ValueError(f"n={cfg.n} must divide over {n_shards} shards")
 
     world_spec = World(pos=P(NODE_AXIS, None), height=P(NODE_AXIS))
 
-    def local_step(world_local, state_local, key):
+    def local_step(world_local, sched_local, state_local, key):
         with coll.node_axis(NODE_AXIS, n_shards, cfg.n):
             if not counted:
-                return step_fn(cfg, topo, world_local, state_local, key)
-            st, cnt = step_fn(cfg, topo, world_local, state_local, key)
+                return step_fn(cfg, topo, world_local, state_local, key,
+                               sched_local)
+            st, cnt = step_fn(cfg, topo, world_local, state_local, key,
+                              sched_local)
             red = jax.lax.psum(jnp.stack(list(cnt)), NODE_AXIS)
             return st, counters_mod.unstack(red)
 
+    def out_specs_of(specs):
+        return specs if not counted else (
+            specs, jax.tree.map(lambda _: P(), counters_mod.zeros()))
+
+    if chaos:
+        def global_step(world_g, sched_g, state_g, key):
+            specs = jax.tree.map(lambda l: node_spec(l, cfg.n), state_g)
+            sched_specs = jax.tree.map(lambda l: node_spec(l, cfg.n), sched_g)
+            inner = shard_map(
+                local_step,
+                mesh=mesh,
+                in_specs=(world_spec, sched_specs, specs, P()),
+                out_specs=out_specs_of(specs),
+                check_vma=False,
+            )
+            return inner(world_g, sched_g, state_g, key)
+
+        return jax.jit(global_step, donate_argnums=(2,))
+
     def global_step(world_g, state_g, key):
         specs = jax.tree.map(lambda l: node_spec(l, cfg.n), state_g)
-        out_specs = specs if not counted else (
-            specs, jax.tree.map(lambda _: P(), counters_mod.zeros()))
         inner = shard_map(
-            local_step,
+            lambda w, st, k: local_step(w, None, st, k),
             mesh=mesh,
             in_specs=(world_spec, specs, P()),
-            out_specs=out_specs,
+            out_specs=out_specs_of(specs),
             check_vma=False,
         )
         return inner(world_g, state_g, key)
@@ -112,8 +140,9 @@ def make_sharded_serf_step(cfg: SimConfig, topo: Topology, mesh: Mesh):
 def make_sharded_counted_step(cfg: SimConfig, topo: Topology, mesh: Mesh):
     """``step(world, state, key) -> (state, GossipCounters)`` under
     shard_map: the per-shard tallies are psum-reduced over the node axis
-    (one extra 13-lane i32 collective), so the returned counters are the
-    global per-tick totals, identical on every device."""
+    (one extra len(FIELDS)-lane i32 collective), so the returned
+    counters are the global per-tick totals, identical on every
+    device."""
     return _make_sharded(swim.step_counted, cfg, topo, mesh, counted=True)
 
 
@@ -124,6 +153,24 @@ def make_sharded_counted_serf_step(cfg: SimConfig, topo: Topology,
     from consul_tpu.models import serf
 
     return _make_sharded(serf.step_counted, cfg, topo, mesh, counted=True)
+
+
+def make_sharded_chaos_step(cfg: SimConfig, topo: Topology, mesh: Mesh, *,
+                            counted: bool = False, serf: bool = False):
+    """``step(world, sched, state, key)`` under shard_map with a fault
+    schedule as a program argument (chaos/schedule.py). The schedule's
+    node masks shard with the state; its per-entry scalars replicate —
+    every pairwise ``chaos.pair_ok`` check therefore sees exactly the
+    same (src, dst, tick) terms on every mesh size, which is what makes
+    sharded chaos trajectories bit-identical to single-device ones
+    (tests/test_chaos.py)."""
+    if serf:
+        from consul_tpu.models import serf as serf_m
+
+        fn = serf_m.step_counted if counted else serf_m.step
+    else:
+        fn = swim.step_counted if counted else swim.step
+    return _make_sharded(fn, cfg, topo, mesh, counted=counted, chaos=True)
 
 
 def place(mesh: Mesh, tree, n: int):

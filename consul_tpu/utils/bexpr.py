@@ -32,6 +32,78 @@ class FilterError(ValueError):
     pass
 
 
+# --- `matches` hardening (RE2 divergence, COVERAGE.md) ----------------
+#
+# go-bexpr matches via Go's regexp (RE2): guaranteed linear time. This
+# port uses Python's backtracking `re`, where a hostile
+# `?filter=... matches ...` pattern like (a+)+$ against a modest input
+# is exponential — a one-request DoS on the HTTP tier. RE2 itself is
+# not reimplementable here, so the exposure is closed structurally:
+# pattern and matched-input lengths are capped, and the nested
+# quantifier family (a repeat whose body contains another repeat) is
+# rejected when the Filter compiles, before any row is evaluated.
+
+try:  # Python 3.11+ spells the sre internals re._constants/_parser
+    from re import _constants as _sre_c
+    from re import _parser as _sre_p
+except ImportError:  # Python <= 3.10
+    import sre_constants as _sre_c
+    import sre_parse as _sre_p
+
+_RE_MAX_PATTERN = 256
+_RE_MAX_INPUT = 4096
+_REPEATS = (_sre_c.MAX_REPEAT, _sre_c.MIN_REPEAT)
+
+
+def _sre_children(op, av):
+    """Subpatterns nested inside one parsed sre node."""
+    if op in _REPEATS:
+        yield av[2]
+    elif op is _sre_c.BRANCH:
+        for alt in av[1]:
+            yield alt
+    elif op is _sre_c.SUBPATTERN:
+        yield av[3]
+    elif op in (_sre_c.ASSERT, _sre_c.ASSERT_NOT):
+        yield av[1]
+
+
+def _contains_repeat(sub) -> bool:
+    for op, av in sub:
+        if op in _REPEATS:
+            return True
+        if any(_contains_repeat(c) for c in _sre_children(op, av)):
+            return True
+    return False
+
+
+def _nested_quantifier(sub) -> bool:
+    for op, av in sub:
+        for child in _sre_children(op, av):
+            if op in _REPEATS and _contains_repeat(child):
+                return True
+            if _nested_quantifier(child):
+                return True
+    return False
+
+
+def _check_pattern(pattern: str) -> None:
+    """Raise FilterError for patterns the backtracking engine cannot
+    match safely. Runs at Filter compile time (parse_primary), so a bad
+    pattern is a 400 before any row is touched."""
+    if len(pattern) > _RE_MAX_PATTERN:
+        raise FilterError(
+            f"regexp too long ({len(pattern)} > {_RE_MAX_PATTERN} chars)")
+    try:
+        parsed = _sre_p.parse(pattern)
+    except re.error as e:
+        raise FilterError(f"bad regexp {pattern!r}: {e}") from e
+    if _nested_quantifier(parsed):
+        raise FilterError(
+            f"regexp {pattern!r} rejected: nested quantifiers risk "
+            "catastrophic backtracking (RE2 divergence, COVERAGE.md)")
+
+
 _TOKEN = re.compile(r"""
     \s*(?:
       (?P<lparen>\() | (?P<rparen>\)) |
@@ -227,12 +299,15 @@ class _Parser:
         if op == "contains":
             return ("contains", selector, self.expect_value())
         if op == "matches":
-            return ("matches", selector, self.expect_value())
+            pat = self.expect_value()
+            _check_pattern(pat)
+            return ("matches", selector, pat)
         if op == "not":
             k2, op2 = self.next()
             if op2 == "matches":
-                return ("not", ("matches", selector,
-                                self.expect_value()))
+                pat = self.expect_value()
+                _check_pattern(pat)
+                return ("not", ("matches", selector, pat))
             raise FilterError(f"bad operator 'not {op2}'")
         if op == "is":
             k2, w = self.next()
@@ -268,7 +343,10 @@ def _eval(node, row) -> bool:
         if not found:
             return False
         try:
-            return re.search(node[2], _as_str(v)) is not None
+            # Input cap pairs with the compile-time pattern checks: even
+            # a pathological bounded pattern only ever sees the first
+            # _RE_MAX_INPUT chars of a field.
+            return re.search(node[2], _as_str(v)[:_RE_MAX_INPUT]) is not None
         except re.error as e:
             raise FilterError(f"bad regexp {node[2]!r}: {e}") from e
     if op == "empty":

@@ -26,6 +26,13 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running scenario tests excluded from the tier-1 "
+        "run (ROADMAP.md runs -m 'not slow')")
+
+
 def pumped_cluster_stack(n=3, seed=11, node="test-agent",
                          address="10.0.0.1", **http_kwargs):
     """Shared harness: ServerCluster + background raft pump + Agent +

@@ -544,3 +544,89 @@ class TestGateFailClosed:
         # An unmapped family fails closed under default-deny.
         st, _, _ = call(api, "GET", "/v1/definitely-not-a-route")
         assert st == 403
+
+
+class TestSessionScoping:
+    """Session destroy/renew authorize against the STORED session's
+    node, not the id in the URL (reference session_endpoint.go
+    SessionDestroy/SessionRenew fetch-then-SessionWrite)."""
+
+    @pytest.fixture(scope="class")
+    def session_token(self, acl_stack):
+        api, _ = acl_stack
+        st, _, _ = call(
+            api, "PUT", "/v1/acl/policy",
+            json.dumps({"Name": "sess-agent", "Rules":
+                        'session "acl-agent" { policy = "write" }'
+                        }).encode(),
+            token="master-secret")
+        assert st == 200
+        st, tok, _ = call(
+            api, "PUT", "/v1/acl/token",
+            json.dumps({"Policies": [{"Name": "sess-agent"}]}).encode(),
+            token="master-secret")
+        assert st == 200
+        return tok["SecretID"]
+
+    def _mk_session(self, api):
+        # Sessions attach to a registered catalog node.
+        st, _, _ = call(api, "PUT", "/v1/catalog/register",
+                        json.dumps({"Node": "acl-agent",
+                                    "Address": "10.11.0.1"}).encode(),
+                        token="master-secret")
+        assert st == 200
+        st, out, _ = call(api, "PUT", "/v1/session/create",
+                          json.dumps({"TTL": "60s"}).encode(),
+                          token="master-secret")
+        assert st == 200
+        return out["ID"]
+
+    def test_scoped_token_can_renew_and_destroy(self, acl_stack,
+                                                session_token):
+        api, _ = acl_stack
+        sid = self._mk_session(api)
+        st, _, _ = call(api, "PUT", f"/v1/session/renew/{sid}",
+                        token=session_token)
+        assert st == 200
+        st, _, _ = call(api, "PUT", f"/v1/session/destroy/{sid}",
+                        token=session_token)
+        assert st == 200
+
+    def test_token_without_session_rules_denied(self, acl_stack,
+                                                session_token):
+        api, _ = acl_stack
+        sid = self._mk_session(api)
+        st, _, _ = call(
+            api, "PUT", "/v1/acl/policy",
+            json.dumps({"Name": "kv-only", "Rules": {
+                "key_prefix": {"": {"policy": "write"}}}}).encode(),
+            token="master-secret")
+        st, tok, _ = call(
+            api, "PUT", "/v1/acl/token",
+            json.dumps({"Policies": [{"Name": "kv-only"}]}).encode(),
+            token="master-secret")
+        other = tok["SecretID"]
+        st, _, _ = call(api, "PUT", f"/v1/session/destroy/{sid}",
+                        token=other)
+        assert st == 403
+        st, _, _ = call(api, "PUT", f"/v1/session/renew/{sid}",
+                        token=other)
+        assert st == 403
+        # The session survived the denied destroy.
+        st, _, _ = call(api, "PUT", f"/v1/session/destroy/{sid}",
+                        token="master-secret")
+        assert st == 200
+
+    def test_unknown_session_denied_for_scoped_token(self, acl_stack,
+                                                     session_token):
+        # An unknown id must not leak existence: a scoped token gets
+        # 403 (the gate can't pick a rule without the stored node),
+        # while management reaches the handler's honest 404.
+        api, _ = acl_stack
+        ghost = "00000000-0000-0000-0000-00000000beef"
+        st, _, _ = call(api, "PUT", f"/v1/session/renew/{ghost}",
+                        token=session_token)
+        assert st == 403
+        st, _, _ = call(api, "PUT", f"/v1/session/renew/{ghost}",
+                        token="master-secret")
+        assert st == 404

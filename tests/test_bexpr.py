@@ -100,3 +100,36 @@ class TestHardening:
             apply_filter("node == (", ROWS)
         with pytest.raises(FilterError):
             apply_filter('"x" in (', ROWS)
+
+    def test_nested_quantifier_rejected_at_compile(self):
+        # RE2 (the reference's regexp engine) has no catastrophic
+        # backtracking; Python's re does, so exponential patterns are
+        # rejected when the Filter compiles — before any row is seen.
+        for pat in ('(a+)+$', '(a*)*', '((x|y)+)*', '(\\d+)*z'):
+            with pytest.raises(FilterError, match="quantifier"):
+                Filter(f'node matches "{pat}"')
+            with pytest.raises(FilterError, match="quantifier"):
+                Filter(f'node not matches "{pat}"')
+
+    def test_overlong_pattern_rejected_at_compile(self):
+        with pytest.raises(FilterError, match="too long"):
+            Filter('node matches "%s"' % ("a" * 300))
+
+    def test_legit_regex_patterns_still_match(self):
+        assert [r["node"] for r in
+                Filter('node matches "^web-[0-9]$"').apply(ROWS)] == \
+            ["web-1", "web-2"]
+        assert [r["node"] for r in
+                Filter('node matches "web|db"').apply(ROWS)] == \
+            ["web-1", "web-2", "db-1"]
+        # Nested groups WITHOUT stacked quantifiers stay legal.
+        assert [r["node"] for r in
+                Filter('node matches "^(we(b)-)1$"').apply(ROWS)] == \
+            ["web-1"]
+
+    def test_match_input_truncated(self):
+        # Values are capped before re.search: a match that only exists
+        # past the 4096-byte cap is not found.
+        rows = [{"blob": "x" * 5000 + "needle"}]
+        assert Filter('blob matches "needle"').apply(rows) == []
+        assert Filter('blob matches "x"').apply(rows) == rows

@@ -1,0 +1,328 @@
+"""Device-side chaos engine tests (consul_tpu/chaos).
+
+Covers the fault-schedule contract end to end:
+
+  - schedule compilation: slot shapes, emptiness, static cache keys,
+    rebasing;
+  - the empty-schedule DCE guarantee (a ``sched=None`` step and an
+    empty-schedule step are the same traced program — bit-identical
+    trajectories, no extra executables);
+  - determinism: same seed + same schedule ⇒ bit-identical trajectories
+    across chunk sizes and across sharded (8-device shard_map) vs
+    single-device execution;
+  - the partition-heal acceptance scenario: 1024 nodes split 70/30,
+    partition lifted inside the suspicion window, both sides converge
+    back to one consistent alive view with zero false-positive deaths,
+    SLO counters surfaced through run_scenario / telemetry / the stable
+    bench keys;
+  - the compile-count pin: a chaos-enabled run adds at most ONE
+    executable per (cfg, chunk, flags) signature — same-shape schedules
+    with different values share it, and empty schedules reuse the
+    existing non-chaos executable.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from consul_tpu import chaos
+from consul_tpu.config import SimConfig
+from consul_tpu.models import counters as counters_mod
+from consul_tpu.models import state as sim_state
+from consul_tpu.models import swim
+from consul_tpu.models.cluster import SLO_KEYS, SerfSimulation, Simulation
+from consul_tpu.ops import topology
+from consul_tpu.parallel import mesh as pmesh
+from consul_tpu.parallel import shard_step
+
+N_DEV = 8
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:N_DEV]), (pmesh.NODE_AXIS,))
+
+
+@functools.lru_cache(maxsize=None)
+def _fixture(n, view_degree, packet_loss=0.0):
+    cfg = SimConfig(n=n, view_degree=view_degree, packet_loss=packet_loss)
+    key = jax.random.PRNGKey(0)
+    kw, kn, _ = jax.random.split(key, 3)
+    return cfg, topology.make_topology(cfg, kn), topology.make_world(cfg, kw)
+
+
+def _state(cfg):
+    return sim_state.init(cfg, jax.random.split(jax.random.PRNGKey(0), 3)[2])
+
+
+def _sched(n):
+    """A schedule touching every primitive (all four slot families)."""
+    return chaos.compile_schedule(n, [
+        chaos.Partition(start=1, stop=10, side_a=slice(0, n // 4)),
+        chaos.LinkLoss(start=0, stop=14, a=slice(0, n // 8),
+                       b=slice(n // 8, n // 4), fwd=0.8, rev=0.2),
+        chaos.ChurnWave(start=3, stop=9, nodes=[n // 2]),
+        chaos.Degrade(start=0, stop=14, nodes=slice(n - n // 8, n),
+                      tx_loss=0.4),
+    ])
+
+
+def _assert_trees_equal(a, b, float_exact=True):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        la, lb = np.asarray(la), np.asarray(lb)
+        if la.dtype.kind == "f" and not float_exact:
+            np.testing.assert_allclose(la, lb, rtol=1e-4, atol=1e-6)
+        else:
+            np.testing.assert_array_equal(la, lb)
+
+
+class TestScheduleCompile:
+    def test_empty_and_static_key(self):
+        e = chaos.empty(64)
+        assert chaos.is_empty(e)
+        assert chaos.static_key_of(e) is None
+        assert chaos.static_key_of(None) is None
+        s = _sched(64)
+        assert not chaos.is_empty(s)
+        assert chaos.static_key_of(s) == ("chaos", 1, 1, 1, 1)
+
+    def test_same_shape_same_key(self):
+        a = chaos.compile_schedule(64, [chaos.Partition(1, 5, [0, 1])])
+        b = chaos.compile_schedule(64, [chaos.Partition(9, 30, slice(0, 50))])
+        assert chaos.static_key_of(a) == chaos.static_key_of(b)
+
+    def test_shift_rebases_windows(self):
+        s = chaos.compile_schedule(32, [chaos.Partition(2, 7, [0])])
+        sh = chaos.shift_schedule(s, 100)
+        assert int(sh.part_start[0]) == 102 and int(sh.part_stop[0]) == 107
+        # Node masks are untouched by a rebase.
+        np.testing.assert_array_equal(np.asarray(s.part_side),
+                                      np.asarray(sh.part_side))
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            chaos.compile_schedule(32, [chaos.Partition(5, 5, [0])])
+        with pytest.raises(ValueError):
+            chaos.compile_schedule(
+                32, [chaos.LinkLoss(0, 5, [0], [1], fwd=1.5)])
+        with pytest.raises(ValueError):
+            chaos.compile_schedule(
+                32, [chaos.Partition(0, 5, [0])] * (chaos.MAX_PARTITIONS + 1))
+
+    def test_down_at_churn_window(self):
+        s = chaos.compile_schedule(
+            16, [chaos.ChurnWave(start=4, stop=8, nodes=[3])])
+        assert not bool(chaos.down_at(s, 3)[3])
+        assert bool(chaos.down_at(s, 5)[3])
+        assert not bool(chaos.down_at(s, 9)[3])
+
+
+class TestEmptyScheduleDCE:
+    def test_none_and_empty_bit_identical(self):
+        cfg, topo, world = _fixture(32, 8)
+        key = jax.random.PRNGKey(7)
+        s_none, s_empty = _state(cfg), _state(cfg)
+        empty = chaos.empty(cfg.n)
+        for t in range(8):
+            k = jax.random.fold_in(key, t)
+            s_none = swim.step(cfg, topo, world, s_none, k)
+            s_empty = swim.step(cfg, topo, world, s_empty, k, empty)
+        _assert_trees_equal(s_none, s_empty)
+
+    def test_set_chaos_normalizes_empty(self):
+        cfg = SimConfig(n=32, view_degree=8)
+        sim = Simulation(cfg, seed=3)
+        sim.set_chaos([])
+        assert sim.chaos is None
+        sim.set_chaos(chaos.empty(cfg.n))
+        assert sim.chaos is None
+
+
+class TestDeterminism:
+    def test_chunk_invariance(self):
+        """Same seed + schedule ⇒ bit-identical final state whether the
+        scenario runs in 8-tick or 32-tick scan chunks."""
+        events = [chaos.Partition(start=2, stop=12, side_a=slice(0, 16)),
+                  chaos.Degrade(start=0, stop=20, nodes=slice(48, 64),
+                                tx_loss=0.5)]
+        finals, slos = [], []
+        for chunk in (8, 32):
+            sim = Simulation(SimConfig(n=64, view_degree=8), seed=11)
+            sim.run(32, chunk=32, with_metrics=False)
+            res = sim.run_scenario(events, ticks=32, chunk=chunk)
+            finals.append(jax.tree.map(np.asarray, sim.swim_state))
+            slos.append(res.slo)
+        _assert_trees_equal(finals[0], finals[1])
+        assert slos[0] == slos[1]
+
+    def test_sharded_matches_single_device(self):
+        """Sharded chaos trajectories are bit-identical on discrete
+        state (floats to compiler-rounding tolerance, the
+        test_shardmap.py bar): the schedule's node masks shard with the
+        state and sender-side terms ride the same ppermute rolls as the
+        packets."""
+        cfg, topo, world = _fixture(64, 8, packet_loss=0.02)
+        sched = _sched(64)
+        key = jax.random.PRNGKey(0)
+        ref = _state(cfg)
+        stepj = jax.jit(lambda s, k: swim.step(cfg, topo, world, s, k,
+                                               sched))
+        for t in range(10):
+            ref = stepj(ref, jax.random.fold_in(key, t))
+
+        mesh = _mesh()
+        sstep = shard_step.make_sharded_chaos_step(cfg, topo, mesh)
+        wg = shard_step.place(mesh, world, cfg.n)
+        schedg = shard_step.place(mesh, sched, cfg.n)
+        sg = shard_step.place(mesh, _state(cfg), cfg.n)
+        for t in range(10):
+            sg = sstep(wg, schedg, sg, jax.random.fold_in(key, t))
+        for la, lb in zip(jax.tree.leaves(ref), jax.tree.leaves(sg)):
+            la, lb = np.asarray(la), np.asarray(lb)
+            if la.dtype.kind == "f":
+                np.testing.assert_allclose(la, lb, rtol=1e-4, atol=1e-6)
+            else:
+                np.testing.assert_array_equal(la, lb)
+
+    def test_sharded_counters_match_single_device(self):
+        cfg, topo, world = _fixture(64, 8, packet_loss=0.02)
+        sched = _sched(64)
+        key = jax.random.PRNGKey(0)
+        ref, tot = _state(cfg), None
+        stepc = jax.jit(
+            lambda s, k: swim.step_counted(cfg, topo, world, s, k, sched))
+        for t in range(10):
+            ref, c = stepc(ref, jax.random.fold_in(key, t))
+            tot = c if tot is None else counters_mod.add(tot, c)
+
+        mesh = _mesh()
+        sstep = shard_step.make_sharded_chaos_step(cfg, topo, mesh,
+                                                   counted=True)
+        wg = shard_step.place(mesh, world, cfg.n)
+        schedg = shard_step.place(mesh, sched, cfg.n)
+        sg = shard_step.place(mesh, _state(cfg), cfg.n)
+        tot_sh = None
+        for t in range(10):
+            sg, c = sstep(wg, schedg, sg, jax.random.fold_in(key, t))
+            tot_sh = c if tot_sh is None else counters_mod.add(tot_sh, c)
+        np.testing.assert_array_equal(
+            np.asarray(counters_mod.stack(tot)),
+            np.asarray(counters_mod.stack(tot_sh)))
+
+
+@functools.lru_cache(maxsize=None)
+def _healed_sim():
+    sim = Simulation(SimConfig(n=1024, view_degree=16), seed=0)
+    sim.run(64, chunk=32, with_metrics=False)
+    # 12 fault ticks << the ~60-tick suspicion window at n=1024, so
+    # cross-side views stay SUSPECT at lift and refute back. Full
+    # 1024-view agreement (the heal indicator) has a long gossip tail:
+    # measured ~248 ticks from fault start, so the window must be
+    # generous.
+    res = sim.run_scenario(
+        [chaos.Partition(start=2, stop=14, side_a=slice(0, 307))],
+        ticks=288, chunk=32)
+    return sim, res
+
+
+class TestPartitionHeal:
+    """The acceptance scenario: 1024 nodes split 70/30, lift, heal."""
+
+    def test_slo_counters(self):
+        sim, res = _healed_sim()
+        assert set(res.slo) == set(SLO_KEYS.values())
+        assert res.slo["fault_ticks"] == 12
+        # Cross-side unreachability was noticed while the wall was up...
+        assert 0 < res.slo["time_to_first_suspect"] <= 12
+        # ...but never confirmed DEAD (partition << suspicion timeout),
+        assert res.slo["time_to_confirm"] == res.slo["fault_ticks"]
+        # and after the lift every wrong suspicion refuted away
+        # (strictly inside the window — not the capped value).
+        assert 0 < res.slo["time_to_heal"] < 274
+        assert res.slo["false_positive_deaths"] == 0
+
+    def test_both_sides_converge_to_one_alive_view(self):
+        sim, _ = _healed_sim()
+        h = sim.health()
+        assert float(h.agreement) == 1.0
+        assert float(h.false_positive) == 0.0
+        assert float(h.undetected) == 0.0
+        assert int(jnp.sum(sim.swim_state.alive_truth)) == 1024
+
+    def test_slo_in_telemetry_sink(self):
+        sim, _ = _healed_sim()
+        names = {c["Name"] for c in sim.sink.snapshot()["Counters"]}
+        assert "sim.chaos.fault_ticks" in names
+        assert "sim.chaos.time_to_heal" in names
+
+    def test_stable_bench_keys(self):
+        """run_scenario's slo keys ARE the stable names bench.py and the
+        chaos CLI serialize under the `chaos` JSON key."""
+        _, res = _healed_sim()
+        import importlib.util
+        import pathlib
+        spec = importlib.util.spec_from_file_location(
+            "bench", pathlib.Path(__file__).parent.parent / "bench.py")
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        phases = [{"phase": "chaos", "n": 1024, "slo": res.slo}]
+        assert bench._get(phases, "chaos", "slo") == res.slo
+        assert set(res.slo) == {
+            "fault_ticks", "time_to_first_suspect", "time_to_confirm",
+            "time_to_heal", "false_positive_deaths", "messages_dropped"}
+
+    def test_compile_pin(self):
+        """Chaos adds at most one executable per (chunk, flags)
+        signature: a second same-shape scenario with different values
+        recompiles nothing, and post-scenario empty runs reuse the
+        original executables."""
+        from consul_tpu.models import cluster as cluster_mod
+
+        sim, _ = _healed_sim()
+        n_programs = len(cluster_mod._RUNNER_CACHE)
+        # Same-shape schedule, different values: zero new programs.
+        sim.run_scenario(
+            [chaos.Partition(start=3, stop=11, side_a=slice(100, 500))],
+            ticks=32, chunk=32)
+        assert len(cluster_mod._RUNNER_CACHE) == n_programs
+        # Empty-schedule runs reuse the schedule-free program compiled
+        # during formation (chaos_key=None memo hit).
+        sim.run(32, chunk=32, with_metrics=False)
+        assert len(cluster_mod._RUNNER_CACHE) == n_programs
+        for runner in sim._runners.values():
+            assert runner._cache_size() == 1
+
+
+class TestLinkLossAndDrops:
+    def test_messages_dropped_counted(self):
+        sim = Simulation(SimConfig(n=128, view_degree=8), seed=5)
+        sim.run(32, chunk=32, with_metrics=False)
+        res = sim.run_scenario(
+            [chaos.LinkLoss(start=0, stop=24, a=slice(0, 64),
+                            b=slice(64, 128), fwd=0.9, rev=0.9)],
+            ticks=32, chunk=32)
+        assert res.slo["messages_dropped"] > 0
+        assert res.slo["false_positive_deaths"] == 0
+
+
+@pytest.mark.slow
+class TestPartitionHealLong:
+    """Longer partition (still inside the suspicion window) on the FULL
+    serf stack, with a churn wave riding along."""
+
+    def test_serf_partition_heal_with_churn(self):
+        sim = SerfSimulation(SimConfig(n=1024, view_degree=16), seed=1)
+        sim.run(64, chunk=32, with_metrics=False)
+        res = sim.run_scenario(
+            [chaos.Partition(start=2, stop=42, side_a=slice(0, 307)),
+             chaos.ChurnWave(start=8, stop=24, nodes=slice(990, 1000))],
+            ticks=608, chunk=32)
+        assert res.slo["fault_ticks"] >= 40
+        assert 0 < res.slo["time_to_first_suspect"] <= 12
+        assert 0 < res.slo["time_to_heal"] < 566
+        h = sim.health()
+        assert float(h.agreement) == 1.0
+        assert float(h.false_positive) == 0.0

@@ -201,3 +201,57 @@ class TestTruncation:
         _, port, _ = stack
         msg = q(port, "0a050001.addr.consul")
         assert msg["answers"][0]["value"] == "10.5.0.1"
+
+
+class TestACL:
+    """DNS requests carry no token, so lookups resolve through the
+    agent's configured authz (reference agent/dns.go resolves the
+    agent token; CVE-2020-25864-class bypass: an unfiltered DNS path
+    leaks the catalog even when HTTP enforces ACLs)."""
+
+    @pytest.fixture(scope="class")
+    def acl_port(self, stack):
+        from consul_tpu.server.acl import Authorizer, parse_rules
+
+        srv, _, _ = stack
+        rules = parse_rules('service "web" { policy = "read" }')
+        authz = Authorizer([rules], default_allow=False)
+        acl_srv = dns.DNSServer(
+            srv.rpc, node_name="dns-n1", datacenter="dc1",
+            service_ttl_s=30,
+            authz=lambda res, name, access: authz.allowed(
+                res, name, access))
+        port = acl_srv.serve("127.0.0.1", 0)
+        yield port
+        acl_srv.close()
+
+    def test_granted_service_answers(self, acl_port):
+        msg = q(acl_port, "web.service.consul")
+        assert msg["rcode"] == dns.NOERROR
+        assert [a["value"] for a in msg["answers"]] == ["10.5.0.1"]
+
+    def test_denied_service_refused(self, acl_port):
+        # "many" exists in the catalog but the token has no rule for
+        # it: REFUSED, not NXDOMAIN, so resolvers don't negative-cache
+        # the denial as nonexistence.
+        msg = q(acl_port, "many.service.consul")
+        assert msg["rcode"] == dns.REFUSED
+        assert msg["answers"] == []
+
+    def test_denied_node_refused(self, acl_port):
+        msg = q(acl_port, "dns-n1.node.consul")
+        assert msg["rcode"] == dns.REFUSED
+        assert msg["answers"] == []
+
+    def test_denied_ptr_nxdomain(self, acl_port):
+        # PTR vets per-row (reference dns.go filters the matched
+        # node): with the node unreadable the answer set is empty.
+        msg = q(acl_port, "1.0.5.10.in-addr.arpa", dns.PTR)
+        assert msg["rcode"] == dns.NXDOMAIN
+
+    def test_no_authz_stays_open(self, stack):
+        # The unfiltered module server (authz=None) still answers
+        # node lookups — ACLs off means the DNS plane is open.
+        _, port, _ = stack
+        msg = q(port, "dns-n1.node.consul")
+        assert msg["rcode"] == dns.NOERROR
