@@ -59,6 +59,7 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _HERE)
 
 from consul_tpu.utils import tpu_lock  # noqa: E402  (no jax inside)
+from consul_tpu.runtime import watchdog as runtime_watchdog  # noqa: E402  (stdlib only)
 
 
 # ----------------------------------------------------------------------
@@ -344,31 +345,37 @@ def run_northstar(sim, s, rps, phase_name, *, chunk, kill_frac, left, emit,
     the next bench run RESUMES from the checkpoint (provenance in the
     emitted phase: ``resumed_from_tick``) instead of restarting.
     ``ckpt_every_ticks`` only bounds the convergence-check slice size.
-    Only a CONVERGED attempt retires its checkpoint."""
+    Only a CONVERGED attempt retires its checkpoint.
+
+    The mechanism itself lives in consul_tpu/runtime (CheckpointPolicy:
+    the generalized wall-paced, digest-verified, atomic save/restore
+    every entry point shares); this function owns only the northstar
+    specifics — warm-up, kill injection, rate-bounded budget, and the
+    phase dict. ``manifest_meta=False`` keeps the artifact layout this
+    phase has always written (provenance in the sidecar only)."""
     import jax.numpy as jnp
 
-    from consul_tpu.utils import checkpoint as ckpt_mod
+    from consul_tpu.runtime import CheckpointPolicy
 
     sim.run(chunk, chunk=chunk, with_metrics=True)  # warm, untimed
-    os.makedirs(ckpt_dir, exist_ok=True)
-    ck_path = os.path.join(ckpt_dir, f"{phase_name}_{s}.ckpt")
-    meta_path = ck_path + ".meta.json"
+    # The kill fraction is part of the trajectory's identity: a resume
+    # under a different BENCH_KILL_FRAC would continue the OLD kill
+    # while publishing the new one as provenance.
+    policy = CheckpointPolicy(
+        directory=ckpt_dir, tag=f"{phase_name}_{s}",
+        min_interval_s=ckpt_min_interval_s, manifest_meta=False,
+        sink=getattr(sim, "sink", None))
+    ident = {"phase": phase_name, "n": s, "kill_frac": kill_frac}
     resumed_tick = 0
-    if os.path.exists(ck_path) and os.path.exists(meta_path):
-        try:
-            with open(meta_path) as f:
-                meta = json.load(f)
-            # The kill fraction is part of the trajectory's identity: a
-            # resume under a different BENCH_KILL_FRAC would continue
-            # the OLD kill while publishing the new one as provenance.
-            if meta.get("n") == s and meta.get("phase") == phase_name \
-                    and meta.get("kill_frac") == kill_frac:
-                sim.state = ckpt_mod.restore(ck_path, sim.state)
-                resumed_tick = int(meta["ticks_done"])
-        except Exception as e:  # noqa: BLE001 — a bad ckpt restarts clean
-            emit({"phase": f"{phase_name}_ckpt_error",
-                  "error": repr(e)[:200]})
-            resumed_tick = 0
+    try:
+        state, meta = policy.load(sim.state, match=ident)
+        if state is not None:
+            sim.state = state
+            resumed_tick = int(meta["ticks_done"])
+    except Exception as e:  # noqa: BLE001 — a bad ckpt restarts clean
+        emit({"phase": f"{phase_name}_ckpt_error",
+              "error": repr(e)[:200]})
+        resumed_tick = 0
     if resumed_tick == 0:
         # Fresh attempt: inject the mass failure. A resumed state
         # already carries it (checkpoints are taken post-kill).
@@ -385,40 +392,28 @@ def run_northstar(sim, s, rps, phase_name, *, chunk, kill_frac, left, emit,
     # time, so pace saves by wall time: a run converging inside the
     # interval pays for zero checkpoints, a genuinely long/wedged run
     # still gets one every ``ckpt_min_interval_s``.
-    last_ckpt = t0_ns
+    policy.mark_run_start()
     while ticks_done - resumed_tick < max_ticks and not converged:
         slice_t = min(max(ckpt_every_ticks, chunk),
                       max_ticks - (ticks_done - resumed_tick))
         converged, used, _ = sim.run_until_converged(
             max_ticks=slice_t, chunk=chunk)
         ticks_done += used
-        due = time.monotonic() - last_ckpt >= ckpt_min_interval_s
         exhausted = ticks_done - resumed_tick >= max_ticks
         # Interval-paced mid-run saves, plus ALWAYS a final save when
         # the attempt ends unconverged — otherwise a short-budget run
         # would leave nothing behind and the next run re-injects the
-        # kill from tick 0, voiding the resume guarantee.
-        if not converged and (due or exhausted):
-            try:
-                ckpt_mod.save(ck_path, sim.state)
-                with open(meta_path, "w") as f:
-                    json.dump({"phase": phase_name, "n": s,
-                               "kill_frac": kill_frac,
-                               "ticks_done": ticks_done,
-                               "saved_at": time.time()}, f)
-                last_ckpt = time.monotonic()
-            except OSError:
-                pass  # checkpointing must never fail the attempt
+        # kill from tick 0, voiding the resume guarantee. try_save:
+        # a checkpoint failure must never fail the attempt (it is
+        # counted and the first one logged, runtime/policy.py).
+        if not converged and (policy.wall_due() or exhausted):
+            policy.try_save(sim.state, dict(ident, ticks_done=ticks_done))
     wall = time.monotonic() - t0_ns
     if converged:
         # Only a COMPLETED attempt retires its checkpoint; an
         # unconverged budget-exhausted one keeps it so the next bench
         # run (or round) continues the same trajectory.
-        for p in (ck_path, meta_path):
-            try:
-                os.unlink(p)
-            except OSError:
-                pass
+        policy.retire()
     emit({
         "phase": phase_name,
         "n": s,
@@ -428,6 +423,7 @@ def run_northstar(sim, s, rps, phase_name, *, chunk, kill_frac, left, emit,
         "ticks": int(ticks_done),
         "max_ticks": int(max_ticks),
         "resumed_from_tick": int(resumed_tick),
+        "ckpt_failures": int(policy.failures),
         "target_wall_s": 60.0,
         # A resumed attempt's wall covers only the post-resume slice;
         # the <60s verdict is only meaningful for uninterrupted runs.
@@ -482,35 +478,13 @@ def _run_child(platform: str, timeout_s: float, extra_env=None,
                 stdout=out, stderr=subprocess.STDOUT, env=env,
                 cwd=os.path.dirname(os.path.abspath(__file__)),
             )
-            deadline = t0 + timeout_s
-            setup_ok = False
-            try:
-                while True:
-                    step = min(10.0, max(0.1, deadline - time.monotonic()))
-                    try:
-                        proc.wait(timeout=step)
-                        break
-                    except subprocess.TimeoutExpired:
-                        pass
-                    now = time.monotonic()
-                    if now >= deadline:
-                        raise subprocess.TimeoutExpired(proc.args, timeout_s)
-                    setup_ok = setup_ok or _setup_seen()
-                    if now - t0 > init_window_s and not setup_ok:
-                        status = "backend-init-hang"
-                        proc.kill()
-                        try:
-                            proc.wait(timeout=30)
-                        except subprocess.TimeoutExpired:
-                            pass  # keep the init-hang diagnosis
-                        break
-            except subprocess.TimeoutExpired:
-                status = "timeout"
-                proc.kill()
-                try:
-                    proc.wait(timeout=30)
-                except subprocess.TimeoutExpired:
-                    pass
+            # The supervision loop lives in consul_tpu/runtime (stdlib-
+            # only — this parent process must stay jax-free): kill the
+            # child early when the init window passes without a setup
+            # phase, or at the hard deadline either way.
+            status = runtime_watchdog.InitWatchdog(
+                init_window_s=init_window_s).watch(
+                    proc, _setup_seen, deadline=t0 + timeout_s)
         with open(out_path) as f:
             for line in f:
                 line = line.strip()
@@ -648,19 +622,46 @@ def main():
     lock_wait = float(os.environ.get("BENCH_TPU_LOCK_WAIT", "300"))
     t_lock = time.monotonic()
     lock_state = tpu_lock.try_acquire("bench.py", wait_s=lock_wait)
+    failover = None
     if lock_state != "busy":
         # "acquired" — or a lock I/O error ("error:..."), in which case
         # no other process could have taken the lock either; proceed
         # with the attempt and record the lock trouble as a diagnostic.
+        # The attempt runs under runtime.with_failover: a backend-init-
+        # hang gets bounded retries (BENCH_INIT_RETRIES, each bounded
+        # by the remaining budget), then an EXPLICIT degraded-mode CPU
+        # failover — the already-measured CPU child is the degraded
+        # result, and the provenance (degraded_from, retries,
+        # hang_wall_s) rides in the artifact instead of being implied
+        # by a dead tpu_attempt status.
+        last = {}
+
+        def _attempt(plat):
+            if plat == "cpu":
+                return cpu  # degraded mode reuses the measured child
+            budget_left = total_budget - (time.monotonic() - t_all) - 30.0
+            if budget_left < 120.0:
+                r = {"status": "budget-exhausted", "wall_s": 0.0,
+                     "phases": [], "log_tail": []}
+            else:
+                r = _run_child(
+                    "default", min(tpu_timeout, budget_left),
+                    {"BENCH_SWEEP": os.environ.get(
+                        "BENCH_SWEEP", "4096,262144,1048576")},
+                )
+            last[plat] = r
+            return r
+
         try:
-            tpu = _run_child(
-                "default", tpu_timeout,
-                {"BENCH_SWEEP": os.environ.get(
-                    "BENCH_SWEEP", "4096,262144,1048576")},
-            )
+            _, failover = runtime_watchdog.with_failover(
+                _attempt, ("default", "cpu"),
+                max_retries=int(os.environ.get("BENCH_INIT_RETRIES", "1")))
         finally:
             if lock_state == "acquired":
                 tpu_lock.release()
+        tpu = last.get("default") or {
+            "status": "budget-exhausted", "wall_s": 0.0,
+            "phases": [], "log_tail": []}
         if lock_state != "acquired":
             tpu["lock_error"] = lock_state
     else:
@@ -741,6 +742,10 @@ def main():
                 "platform": tpu_platform,
                 "wall_s": tpu["wall_s"],
                 "errors": [p for p in tpu["phases"] if p.get("phase") == "error"],
+                # Watchdog/failover provenance (runtime/watchdog.py):
+                # degraded_from, retries, hang_wall_s, per-attempt log.
+                # None when the attempt never ran (tpu-busy).
+                "failover": failover,
                 **{k: tpu[k] for k in ("holder", "lock_error") if k in tpu},
             },
             "cpu": {

@@ -294,6 +294,27 @@ def static_key_of(sched: Optional[ChaosSchedule]):
             sched.cw_start.shape[0], sched.dg_start.shape[0])
 
 
+def digest_of(sched: Optional[ChaosSchedule]) -> str:
+    """Content fingerprint of a compiled schedule (hex SHA-256 over
+    every leaf's bytes, in field order). Rides in checkpoint
+    provenance (consul_tpu/runtime): a resumed chaos run must replay
+    the remaining schedule bit-identically, so a checkpoint written
+    under a DIFFERENT schedule is refused at resume rather than
+    silently continuing a different experiment. ``None``/empty digests
+    to the stable sentinel ``"none"``."""
+    if sched is None or is_empty(sched):
+        return "none"
+    import hashlib
+
+    h = hashlib.sha256()
+    for leaf in sched:
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
 def shift_schedule(sched: ChaosSchedule, dt) -> ChaosSchedule:
     """Rebase every start/stop by ``dt`` ticks — values only, shapes
     unchanged, so a relative schedule replays at any live tick without

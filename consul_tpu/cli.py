@@ -705,15 +705,78 @@ def cmd_debug(client: Client, args) -> int:
     return 0
 
 
+def _build_sim(args):
+    from consul_tpu.config import SimConfig
+    from consul_tpu.models.cluster import SerfSimulation, Simulation
+
+    cfg = SimConfig(n=args.n, view_degree=min(args.view_degree, args.n - 2))
+    cls = SerfSimulation if args.serf else Simulation
+    return cls(cfg, seed=args.seed)
+
+
+def _ckpt_policy(args, sim, default_tag: str):
+    """The checkpoint policy the local-run subcommands share — None
+    when the user gave no --ckpt-dir (no resume point, same as before
+    this knob existed)."""
+    if not getattr(args, "ckpt_dir", None):
+        return None
+    from consul_tpu.runtime import CheckpointPolicy
+
+    return CheckpointPolicy(
+        directory=args.ckpt_dir,
+        tag=args.ckpt_tag or default_tag,
+        every_ticks=args.ckpt_every_ticks,
+        min_interval_s=args.ckpt_interval_s,
+        sink=sim.sink,
+    )
+
+
+def _run_resilient_cmd(args, sim, events, ticks, extra: dict) -> int:
+    """Drive one local simulation through runtime.run_resilient and
+    print a single JSON line. SIGTERM mid-run saves a resume point and
+    exits 75 (EX_TEMPFAIL: rerunning the same command continues the
+    trajectory); a tripped invariant sentinel exits 2 with the
+    violation and diagnostic-checkpoint path in the JSON."""
+    from consul_tpu.runtime import (Preempted, SentinelViolation,
+                                    run_resilient)
+
+    policy = _ckpt_policy(
+        args, sim, f"{args.cmd}_{args.n}_seed{args.seed}")
+    try:
+        report = run_resilient(
+            sim, ticks, chunk=args.chunk, events=events, policy=policy,
+            sentinel=args.sentinel,
+            sentinel_dump_dir=args.sentinel_dump_dir)
+    except Preempted as e:
+        print(json.dumps(dict(extra, **e.report.to_json())))
+        return 75
+    except SentinelViolation as e:
+        print(json.dumps(dict(
+            extra, sentinel_tripped=True, violation_mask=e.mask,
+            violations={k: int(v) for k, v in e.deltas.items() if v},
+            diagnostic_checkpoint=e.dump_path)))
+        return 2
+    out = dict(extra, ticks=report.ticks_done, slo=report.slo,
+               counters=report.counters,
+               resumed_from_tick=report.resumed_from_tick,
+               ckpt_failures=report.ckpt_failures)
+    print(json.dumps(out))
+    return 0
+
+
 def cmd_chaos(args) -> int:
     """Run a compiled fault-schedule scenario (consul_tpu/chaos) on a
     local in-process simulation and print the on-device convergence SLO
     counters as one JSON line. No running agent is needed — like the
     ``agent`` subcommand this path is special-cased in main() and
-    imports jax lazily so the HTTP-client commands stay light."""
+    imports jax lazily so the HTTP-client commands stay light.
+
+    Drives runtime.run_resilient: with ``--ckpt-dir`` the scenario
+    survives preemption (SIGTERM saves, rerun resumes bit-identically —
+    the chaos schedule is rebased to the ORIGINAL start tick recorded
+    in the checkpoint); ``--sentinel`` arms the on-device invariant
+    validator."""
     from consul_tpu import chaos as chaos_mod
-    from consul_tpu.config import SimConfig
-    from consul_tpu.models.cluster import SerfSimulation, Simulation
 
     n = args.n
 
@@ -751,14 +814,19 @@ def cmd_chaos(args) -> int:
         events = [chaos_mod.Partition(
             start=4, stop=16, side_a=frac_nodes(0.3))]
 
-    cfg = SimConfig(n=n, view_degree=min(args.view_degree, n - 2))
-    cls = SerfSimulation if args.serf else Simulation
-    sim = cls(cfg, seed=args.seed)
+    sim = _build_sim(args)
     sim.run(args.form_ticks, chunk=args.chunk, with_metrics=False)
-    res = sim.run_scenario(events, chunk=args.chunk, settle=args.settle)
-    print(json.dumps({"n": n, "ticks": res.ticks, "slo": res.slo,
-                      "counters": res.counters}))
-    return 0
+    ticks = max(int(e.stop) for e in events) + args.settle
+    return _run_resilient_cmd(args, sim, events, ticks, {"n": n})
+
+
+def cmd_run(args) -> int:
+    """Advance a plain local simulation under the resilient harness
+    (no fault schedule — ``chaos`` is the faulted variant) and print
+    the run report as one JSON line. The kill -9 / resume quickstart in
+    the README drives this subcommand."""
+    sim = _build_sim(args)
+    return _run_resilient_cmd(args, sim, None, args.ticks, {"n": args.n})
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -785,6 +853,40 @@ def build_parser() -> argparse.ArgumentParser:
                     help="override http.port (0 = pick a free port)")
     ag.add_argument("--data-dir", default=None)
 
+    def add_resilience_flags(sp):
+        # Shared by the local-run subcommands (run / chaos): the
+        # runtime harness knobs (consul_tpu/runtime).
+        sp.add_argument("--ckpt-dir", default=None,
+                        help="checkpoint directory; enables resume — "
+                             "rerun the same command after a kill to "
+                             "continue the trajectory bit-identically")
+        sp.add_argument("--ckpt-tag", default=None,
+                        help="checkpoint name (default: derived from "
+                             "subcommand/n/seed)")
+        sp.add_argument("--ckpt-interval-s", type=float, default=120.0,
+                        help="minimum wall seconds between saves")
+        sp.add_argument("--ckpt-every-ticks", type=int, default=0,
+                        help="tick bound between save checks (0: wall "
+                             "pacing only)")
+        sp.add_argument("--sentinel", action="store_true",
+                        help="arm the on-device invariant sentinels "
+                             "(fail-fast on state corruption)")
+        sp.add_argument("--sentinel-dump-dir", default=None,
+                        help="where a sentinel trip dumps its "
+                             "diagnostic checkpoint")
+
+    rn = sub.add_parser(
+        "run",
+        help="advance a local simulation under the resilient harness")
+    rn.add_argument("--n", type=int, default=1024)
+    rn.add_argument("--seed", type=int, default=0)
+    rn.add_argument("--view-degree", type=int, default=16)
+    rn.add_argument("--ticks", type=int, default=256)
+    rn.add_argument("--chunk", type=int, default=32)
+    rn.add_argument("--serf", action="store_true",
+                    help="run the full serf step (event/query plane)")
+    add_resilience_flags(rn)
+
     ch = sub.add_parser(
         "chaos",
         help="run a fault-schedule scenario locally, print SLO JSON")
@@ -805,6 +907,7 @@ def build_parser() -> argparse.ArgumentParser:
     ch.add_argument("--churn", action="append", metavar="START,STOP,FRAC")
     ch.add_argument("--degrade", action="append",
                     metavar="START,STOP,FRAC,TX[,RX]")
+    add_resilience_flags(ch)
 
     mem_p = sub.add_parser("members", help="cluster members + health")
     mem_p.add_argument("-wan", action="store_true",
@@ -1060,6 +1163,8 @@ def main(argv=None) -> int:
         return cmd_agent(args)
     if args.cmd == "chaos":
         return cmd_chaos(args)
+    if args.cmd == "run":
+        return cmd_run(args)
     client = make_client(args)
     try:
         return COMMANDS[args.cmd](client, args)

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import time
 from typing import NamedTuple, Optional
 
@@ -25,6 +26,7 @@ from consul_tpu.models import serf as serf_mod
 from consul_tpu.models import state as sim_state
 from consul_tpu.models import swim
 from consul_tpu.ops import topology
+from consul_tpu.utils import checkpoint as ckpt_mod
 from consul_tpu.utils import metrics, telemetry
 
 
@@ -79,9 +81,33 @@ def _topo_key(topo) -> tuple:
 _RUNNER_CACHE: dict = {}
 
 
+class SentinelViolation(RuntimeError):
+    """An on-device invariant sentinel tripped (models/swim.py
+    _sentinel_check): the simulation state violated a protocol
+    invariant. Carries the violation bitmask (bit i =
+    counters.SENTINEL_FIELDS[i]), the offending counter deltas, and
+    the path of the diagnostic checkpoint dumped before raising (None
+    when no dump directory was configured)."""
+
+    def __init__(self, mask: int, deltas: dict, dump_path=None):
+        self.mask = mask
+        self.deltas = {
+            f: deltas.get(f, 0) for f in counters_mod.SENTINEL_FIELDS
+        }
+        self.dump_path = dump_path
+        tripped = [f for f in counters_mod.SENTINEL_FIELDS
+                   if deltas.get(f, 0)]
+        where = f"; diagnostic checkpoint: {dump_path}" if dump_path else ""
+        super().__init__(
+            f"invariant sentinel tripped (mask {mask:#x}): "
+            + ", ".join(f"{f}={deltas.get(f, 0)}" for f in tripped)
+            + where
+        )
+
+
 def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
                   step_fn=swim.step_counted, swim_of=lambda st: st,
-                  chaos_key=None):
+                  chaos_key=None, sentinel: bool = False):
     """One compiled chunk program. ``step_fn`` is the per-tick counted
     step (bare SWIM or the full serf stack) returning
     (state, GossipCounters); ``swim_of`` projects the SWIM plane out of
@@ -100,16 +126,22 @@ def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
     called with ``sched=None`` (Simulation.set_chaos normalizes empty
     schedules away) so its jit cache never grows past one entry. The
     topology itself stays closed over — its tables feed trace-time
-    static roll shifts."""
+    static roll shifts.
+
+    ``sentinel`` joins the memo key exactly like ``chaos_key``: off is
+    the pre-sentinel program byte-for-byte (zero extra executables —
+    the compile-count pin), on folds the invariant validator in and
+    compiles exactly one more program per shape."""
     memo = (cfg, _topo_key(topo), chunk, with_metrics, step_fn, swim_of,
-            chaos_key)
+            chaos_key, sentinel)
     hit = _RUNNER_CACHE.get(memo)
     if hit is not None:
         return hit
 
     def body(world, sched, carry, tick_key):
         state, cnt = carry
-        state, c = step_fn(cfg, topo, world, state, tick_key, sched)
+        state, c = step_fn(cfg, topo, world, state, tick_key, sched,
+                           sentinel=sentinel)
         cnt = counters_mod.add(cnt, c)
         if not with_metrics:
             return (state, cnt), ()
@@ -140,6 +172,12 @@ class Simulation:
 
     cfg: SimConfig
     seed: int = 0
+    # On-device invariant sentinels (consul_tpu/runtime): when on, every
+    # chunk runs the compiled validator and the host tier fail-fasts
+    # (SentinelViolation) on any nonzero sentinel counter, dumping a
+    # diagnostic checkpoint into ``sentinel_dump_dir`` first when set.
+    sentinel: bool = False
+    sentinel_dump_dir: Optional[str] = None
 
     # Driver hooks (SerfSimulation overrides these two).
     _step_fn = staticmethod(swim.step_counted)
@@ -196,6 +234,48 @@ class Simulation:
         # programs, so toggling chaos on/off never recompiles.
         self._runners = {}
 
+    def set_sentinel(self, on: bool, dump_dir: Optional[str] = None):
+        """Toggle the on-device invariant sentinels for subsequent runs.
+        ``dump_dir`` (optional) is where a diagnostic checkpoint lands
+        if a sentinel trips. Toggling rebinds the runners; the
+        process-wide _RUNNER_CACHE memoizes both programs, so flipping
+        back and forth never recompiles."""
+        if dump_dir is not None:
+            self.sentinel_dump_dir = dump_dir
+        if on != self.sentinel:
+            self.sentinel = on
+            self._runners = {}
+
+    def _check_sentinel(self, deltas):
+        """Host tier of the sentinel: fail-fast on a nonzero violation
+        mask, dumping a diagnostic checkpoint first so the corrupt
+        state is inspectable (and resumable under --no-verify debugging)
+        rather than lost with the process."""
+        if not self.sentinel:
+            return
+        mask = counters_mod.violation_mask(deltas)
+        if not mask:
+            return
+        self.sink.incr_counter("sim.sentinel.trips", 1)
+        dump = None
+        if self.sentinel_dump_dir:
+            t_now = int(self.swim_state.t)
+            dump = os.path.join(
+                self.sentinel_dump_dir, f"sentinel_diag_t{t_now}.ckpt")
+            try:
+                os.makedirs(self.sentinel_dump_dir, exist_ok=True)
+                ckpt_mod.save(dump, self.state, meta={
+                    "reason": "sentinel",
+                    "mask": mask,
+                    "deltas": {f: int(deltas.get(f, 0))
+                               for f in counters_mod.SENTINEL_FIELDS},
+                    "t": t_now,
+                    "n": self.cfg.n,
+                })
+            except (OSError, ValueError):
+                dump = None  # the diagnostic must not mask the trip
+        raise SentinelViolation(mask, deltas, dump)
+
     def run_scenario(self, events, ticks=None, chunk: int = 64,
                      with_metrics: bool = False, settle: int = 64):
         """Replay a *relative* fault schedule from the current tick and
@@ -238,6 +318,7 @@ class Simulation:
                 self.cfg, self.topo, chunk, with_metrics,
                 step_fn=type(self)._step_fn, swim_of=type(self)._swim_of,
                 chaos_key=chaos_mod.static_key_of(self.chaos),
+                sentinel=self.sentinel,
             )
 
             def bound(state, base_key, _j=jitted, _w=self.world,
@@ -266,8 +347,13 @@ class Simulation:
                 self._record_chunk(trace, cnt, c, t0)
             else:
                 # Throughput path: no device sync — the chunk's counter
-                # pytree queues for a lazy batched flush.
+                # pytree queues for a lazy batched flush. With sentinels
+                # on, flush every chunk instead: fail-fast within one
+                # chunk is the point, and the one [len(FIELDS)] fetch
+                # per chunk is the sentinel's documented host cost.
                 self._pending_counters.append(cnt)
+                if self.sentinel:
+                    self._flush_counters()
             remaining -= c
         if not with_metrics:
             return None
@@ -301,6 +387,7 @@ class Simulation:
         for f, v in deltas.items():
             self._counters[f] += v
         telemetry.emit_counter_deltas(self.sink, deltas)
+        self._check_sentinel(deltas)
 
     def _record_chunk(self, trace: TickTrace, cnt, ticks: int, t0: float):
         """Fold one chunk's trace into the telemetry sink under the
@@ -338,6 +425,7 @@ class Simulation:
             queue_depth_warning=self.cfg.serf.queue_depth_warning,
             counters=deltas,
         )
+        self._check_sentinel(deltas)
 
     def run_until_converged(
         self,

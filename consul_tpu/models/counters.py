@@ -57,6 +57,17 @@ class GossipCounters(NamedTuple):
     chaos_heal_wait: jax.Array          # post-lift ticks with stale views
     chaos_false_deaths: jax.Array       # deaths of up, reachable nodes
     chaos_msgs_dropped: jax.Array       # gossip packets cut by chaos alone
+    # -- invariant sentinels (consul_tpu/runtime): violation tallies
+    # from the compiled end-of-tick validator (models/swim.py
+    # _sentinel_check). All zero on a healthy run; the host tier
+    # fail-fasts on any nonzero field (models/cluster.py). Like the
+    # chaos block, the validator is a trace-time branch — sentinels off
+    # emits the exact pre-sentinel program.
+    sentinel_range: jax.Array           # values outside their legal range
+    sentinel_monotonic: jax.Array       # incarnation/Lamport regressions
+    sentinel_suspicion: jax.Array       # timer/accuser-bitmask mismatches
+    sentinel_nonfinite_coord: jax.Array  # NaN/Inf Vivaldi coordinate rows
+    sentinel_nonfinite_rtt: jax.Array   # NaN/Inf RTT filter entries
 
 
 FIELDS = GossipCounters._fields
@@ -86,8 +97,28 @@ METRIC_NAMES = {
     "chaos_heal_wait": "sim.chaos.time_to_heal",
     "chaos_false_deaths": "sim.chaos.false_positive_deaths",
     "chaos_msgs_dropped": "sim.chaos.messages_dropped",
+    "sentinel_range": "sim.sentinel.range_violations",
+    "sentinel_monotonic": "sim.sentinel.monotonicity_violations",
+    "sentinel_suspicion": "sim.sentinel.suspicion_violations",
+    "sentinel_nonfinite_coord": "sim.sentinel.nonfinite_coordinates",
+    "sentinel_nonfinite_rtt": "sim.sentinel.nonfinite_rtt",
 }
 assert set(METRIC_NAMES) == set(FIELDS)
+
+# The invariant-sentinel fields, in bitmask order: bit i of the host
+# tier's violation mask (violation_mask) is SENTINEL_FIELDS[i].
+SENTINEL_FIELDS = tuple(f for f in FIELDS if f.startswith("sentinel_"))
+
+
+def violation_mask(deltas: dict) -> int:
+    """Fold a counter-delta dict into the sentinel violation bitmask:
+    bit i set iff SENTINEL_FIELDS[i] saw a nonzero tally. Zero means
+    every checked invariant held over the window."""
+    mask = 0
+    for i, f in enumerate(SENTINEL_FIELDS):
+        if deltas.get(f, 0):
+            mask |= 1 << i
+    return mask
 
 
 def zeros() -> GossipCounters:

@@ -389,25 +389,31 @@ def leave(cfg: SimConfig, s: SerfState, mask) -> SerfState:
 # ----------------------------------------------------------------------
 
 def step(cfg: SimConfig, topo, world: World, s: SerfState, key,
-         sched=None) -> SerfState:
+         sched=None, *, sentinel: bool = False) -> SerfState:
     """One serf tick. Thin wrapper over :func:`step_counted` — XLA dead-
     code-eliminates the unused counter reductions, so existing callers
     pay nothing for them."""
-    return step_counted(cfg, topo, world, s, key, sched)[0]
+    return step_counted(cfg, topo, world, s, key, sched,
+                        sentinel=sentinel)[0]
 
 
 def step_counted(cfg: SimConfig, topo, world: World, s: SerfState, key,
-                 sched=None):
+                 sched=None, *, sentinel: bool = False):
     """One serf tick: SWIM membership tick, then event/query gossip,
     response tally, query expiry, and reap bookkeeping. Returns
     (SerfState, GossipCounters) — the SWIM tick's counters plus the
     serf intent-queue tallies. ``sched`` (optional chaos schedule, see
     swim.step_counted) gates the serf dissemination legs too — the same
-    tick's terms apply to the membership and the event planes."""
+    tick's terms apply to the membership and the event planes.
+    ``sentinel`` additionally validates the serf plane's Lamport clocks
+    (monotone within the tick — they only move through lamport.witness)
+    on top of the SWIM-plane checks (swim._sentinel_check)."""
     k_swim, k_ev = jax.random.split(key)
     t = s.swim.t
     chaos_on = sched is not None and not chaos_mod.is_empty(sched)
-    sw, cnt = swim.step_counted(cfg, topo, world, s.swim, k_swim, sched)
+    clocks0 = (s.clock, s.event_clock, s.query_clock)
+    sw, cnt = swim.step_counted(cfg, topo, world, s.swim, k_swim, sched,
+                                sentinel=sentinel)
     terms = chaos_mod.node_terms(sched, t) if chaos_on else None
     # Pending graceful leaves whose propagate window closed go quiet now
     # (serf.Leave sleeps LeavePropagateDelay then shuts memberlist down).
@@ -438,7 +444,20 @@ def step_counted(cfg: SimConfig, topo, world: World, s: SerfState, key,
     down_since = jnp.where(
         is_down & (s.down_since < 0), t, jnp.where(is_down, s.down_since, -1)
     )
-    return s._replace(down_since=down_since), cnt
+    s = s._replace(down_since=down_since)
+    if sentinel:
+        # Lamport monotonicity: every clock plane only moves through
+        # lamport.witness (a max), so a within-tick regression is
+        # corruption. Folds into the same counter the SWIM-plane
+        # incarnation check uses.
+        regress = sum(
+            counters_mod.count(after < before)
+            for before, after in zip(
+                clocks0, (s.clock, s.event_clock, s.query_clock))
+        )
+        cnt = cnt._replace(
+            sentinel_monotonic=cnt.sentinel_monotonic + regress)
+    return s, cnt
 
 
 def _lookup_any(cfg: SimConfig, s: SerfState, key_, origin):

@@ -144,6 +144,16 @@ def init(cfg: SimConfig, key) -> SimState:
     )
 
 
+def template(cfg: SimConfig) -> SimState:
+    """A shape/dtype-only SimState for checkpoint restore
+    (utils/checkpoint.restore wants a template tree, never the values):
+    tooling that inspects a checkpoint or a sentinel diagnostic dump —
+    ``runtime.restore_placed``, post-mortem scripts — builds its target
+    from the config alone instead of forming a whole Simulation just to
+    overwrite its state."""
+    return init(cfg, jax.random.PRNGKey(0))
+
+
 def kill(state: SimState, mask) -> SimState:
     """Fault injection: hard-kill the masked nodes (they stop probing,
     acking, and gossiping; their entries elsewhere decay via SWIM)."""

@@ -175,17 +175,18 @@ def _gather_by_col(topo: Topology, packed: jax.Array, col: jax.Array,
 
 
 def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key,
-         sched=None) -> SimState:
+         sched=None, *, sentinel: bool = False) -> SimState:
     """Advance the whole cluster by one tick. Pure; jit/shard-map safe.
 
     Thin wrapper over :func:`step_counted` discarding the counters —
     XLA dead-code-eliminates the counter reductions, so callers that
     only want the state pay nothing for them."""
-    return step_counted(cfg, topo, world, state, key, sched)[0]
+    return step_counted(cfg, topo, world, state, key, sched,
+                        sentinel=sentinel)[0]
 
 
 def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
-                 key, sched=None):
+                 key, sched=None, *, sentinel: bool = False):
     """One tick plus its :class:`counters.GossipCounters` event tallies
     (probes, acks/nacks, suspicions, deaths, gossip tx/rx, push-pull
     merges, refutations) — every counter is a reduction over masks the
@@ -201,7 +202,12 @@ def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
     its existing uniform draw and gates on ``chaos.pair_ok`` instead of
     the bare ``cfg.packet_loss`` threshold, churn waves drive
     kill/revive edges on-device, and the SLO block at the end of the
-    tick accumulates detection/heal latencies into the counters."""
+    tick accumulates detection/heal latencies into the counters.
+
+    ``sentinel`` (trace-time flag, consul_tpu/runtime) folds the
+    end-of-tick invariant validator :func:`_sentinel_check` into the
+    program; ``False`` (the default) emits exactly the pre-sentinel
+    step — the compile-count pin of tests/test_runtime.py."""
     n, k_deg = cfg.n, cfg.degree
     g = cfg.gossip
     t = state.t
@@ -231,6 +237,7 @@ def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
 
     view0 = state.view_key  # snapshot for end-of-tick bookkeeping
     seen0 = state.susp_seen
+    own0 = state.own_inc  # sentinel monotonicity baseline
     active = state.alive_truth & ~state.left & ~state.external
 
     # Static protocol scalars (cluster-size scaling laws); evaluated at
@@ -598,7 +605,83 @@ def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
             cfg, topo, state, sched, terms, t, roll_mode, expired, active,
             n_chaos_drop, cnt,
         )
+    if sentinel:
+        cnt = _sentinel_check(cfg, state, view0, own0, t, cnt)
     return state._replace(t=t + 1), cnt
+
+
+def _sentinel_check(cfg, state: SimState, view0, own0, t, cnt):
+    """On-device invariant sentinel (consul_tpu/runtime): validate the
+    end-of-tick state against invariants the protocol is supposed to
+    preserve and tally violations into the sentinel_* counters. Every
+    check is a reduction over per-row masks — no communication, and
+    under shard_map the shard-local tallies psum to global counts like
+    every other counter.
+
+    Invariants (the Lifeguard posture turned inward — the *simulator*
+    distrusts itself, PAPER.md):
+
+    - **range**: own incarnations within the packed-key headroom
+      (ops/merge.py MAX_INCARNATION), awareness inside
+      [0, awareness_max), probe cursor and pending probe column inside
+      their column ranges, suspicion timers never started in the future.
+    - **monotonic**: view keys only move up the merge lattice within a
+      tick (join = pointwise max; the only non-join writes land before
+      the ``view0`` snapshot), and own incarnations never regress.
+    - **suspicion**: after _reconcile_suspicion, a cell is SUSPECT iff
+      its timer is armed iff its accuser bitmask is nonzero.
+    - **nonfinite**: Vivaldi coordinates (vec/height/error/adjustment)
+      and every written RTT-filter slot are finite — the NaN/Inf guard
+      for the float plane (ops/vivaldi.py rejects non-finite inputs, so
+      a nonzero tally here means corruption, not a bad sample).
+    """
+    g = cfg.gossip
+    k_deg = cfg.degree
+    viv = state.viv
+
+    bad_range = (
+        (state.own_inc > jnp.uint32(merge.MAX_INCARNATION))
+        | (state.awareness < 0)
+        | (state.awareness >= g.awareness_max)
+        | (state.probe_ptr < 0)
+        | (state.probe_ptr >= k_deg)
+        | (state.pending_col < -1)
+        | (state.pending_col >= k_deg)
+        | jnp.any(state.susp_start > t, axis=1)
+    )
+
+    n_mono = counters_mod.count(state.view_key < view0) \
+        + counters_mod.count(state.own_inc < own0)
+
+    now_suspect = _statuses(state.view_key) == merge.SUSPECT
+    timer_armed = state.susp_start >= 0
+    seen_nonzero = state.susp_seen != 0
+    bad_susp = (now_suspect != timer_armed) | (now_suspect != seen_nonzero)
+
+    bad_coord = (
+        jnp.any(~jnp.isfinite(viv.vec), axis=1)
+        | ~jnp.isfinite(viv.height)
+        | ~jnp.isfinite(viv.error)
+        | ~jnp.isfinite(viv.adjustment)
+    )
+
+    # Only slots the median filter has actually written are checked —
+    # unwritten ring-buffer slots are zero-initialized but semantically
+    # undefined after a future format change.
+    s = cfg.vivaldi.latency_filter_size
+    written = (
+        jnp.arange(s, dtype=jnp.int32)[None, None, :]
+        < jnp.minimum(state.lat_cnt, s)[:, :, None]
+    )
+    bad_rtt = written & ~jnp.isfinite(state.lat_buf)
+
+    return cnt._replace(
+        sentinel_range=counters_mod.count(bad_range),
+        sentinel_monotonic=n_mono,
+        sentinel_suspicion=counters_mod.count(bad_susp),
+        sentinel_nonfinite_coord=counters_mod.count(bad_coord),
+        sentinel_nonfinite_rtt=counters_mod.count(bad_rtt),
+    )
 
 
 def _chaos_slo(cfg, topo: Topology, state: SimState, sched, terms, t,

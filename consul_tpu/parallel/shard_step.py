@@ -51,7 +51,8 @@ from consul_tpu.parallel.mesh import NODE_AXIS, node_spec, shard_map
 
 
 def _make_sharded(step_fn, cfg: SimConfig, topo: Topology, mesh: Mesh,
-                  counted: bool = False, chaos: bool = False):
+                  counted: bool = False, chaos: bool = False,
+                  sentinel: bool = False):
     """Shared builder: jit(shard_map(step_fn)) over the node axis with
     the collective context installed and state buffers donated.
 
@@ -68,7 +69,12 @@ def _make_sharded(step_fn, cfg: SimConfig, topo: Topology, mesh: Mesh,
     evaluated on the local row block and the link masks stay
     shard-consistent by construction — the same ppermute rolls that
     carry the packets carry the sender-side terms
-    (chaos/schedule.py roll_terms)."""
+    (chaos/schedule.py roll_terms).
+
+    With ``sentinel=True``, the on-device invariant validator runs in
+    the step (models/swim.py _sentinel_check); its per-row violation
+    tallies psum with the other counters, so the host sees global
+    counts (sentinel requires ``counted`` to surface them)."""
     n_shards = mesh.shape[NODE_AXIS]
     if cfg.n % n_shards != 0:
         raise ValueError(f"n={cfg.n} must divide over {n_shards} shards")
@@ -79,9 +85,9 @@ def _make_sharded(step_fn, cfg: SimConfig, topo: Topology, mesh: Mesh,
         with coll.node_axis(NODE_AXIS, n_shards, cfg.n):
             if not counted:
                 return step_fn(cfg, topo, world_local, state_local, key,
-                               sched_local)
+                               sched_local, sentinel=sentinel)
             st, cnt = step_fn(cfg, topo, world_local, state_local, key,
-                              sched_local)
+                              sched_local, sentinel=sentinel)
             red = jax.lax.psum(jnp.stack(list(cnt)), NODE_AXIS)
             return st, counters_mod.unstack(red)
 
@@ -137,13 +143,15 @@ def make_sharded_serf_step(cfg: SimConfig, topo: Topology, mesh: Mesh):
     return _make_sharded(serf.step, cfg, topo, mesh)
 
 
-def make_sharded_counted_step(cfg: SimConfig, topo: Topology, mesh: Mesh):
+def make_sharded_counted_step(cfg: SimConfig, topo: Topology, mesh: Mesh,
+                              sentinel: bool = False):
     """``step(world, state, key) -> (state, GossipCounters)`` under
     shard_map: the per-shard tallies are psum-reduced over the node axis
     (one extra len(FIELDS)-lane i32 collective), so the returned
     counters are the global per-tick totals, identical on every
-    device."""
-    return _make_sharded(swim.step_counted, cfg, topo, mesh, counted=True)
+    device. ``sentinel=True`` folds the invariant validator in."""
+    return _make_sharded(swim.step_counted, cfg, topo, mesh, counted=True,
+                         sentinel=sentinel)
 
 
 def make_sharded_counted_serf_step(cfg: SimConfig, topo: Topology,
@@ -156,7 +164,8 @@ def make_sharded_counted_serf_step(cfg: SimConfig, topo: Topology,
 
 
 def make_sharded_chaos_step(cfg: SimConfig, topo: Topology, mesh: Mesh, *,
-                            counted: bool = False, serf: bool = False):
+                            counted: bool = False, serf: bool = False,
+                            sentinel: bool = False):
     """``step(world, sched, state, key)`` under shard_map with a fault
     schedule as a program argument (chaos/schedule.py). The schedule's
     node masks shard with the state; its per-entry scalars replicate —
@@ -170,7 +179,8 @@ def make_sharded_chaos_step(cfg: SimConfig, topo: Topology, mesh: Mesh, *,
         fn = serf_m.step_counted if counted else serf_m.step
     else:
         fn = swim.step_counted if counted else swim.step
-    return _make_sharded(fn, cfg, topo, mesh, counted=counted, chaos=True)
+    return _make_sharded(fn, cfg, topo, mesh, counted=counted, chaos=True,
+                         sentinel=sentinel)
 
 
 def place(mesh: Mesh, tree, n: int):

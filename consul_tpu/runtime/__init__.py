@@ -1,0 +1,82 @@
+"""Resilient run harness (the robustness tentpole, ROADMAP.md).
+
+The paper's Lifeguard thesis — a node should distrust *itself* under
+degradation (PAPER.md) — applied to the simulator: a long jitted scan
+must survive preemption (checkpoint/resume), detect its own corruption
+(on-device invariant sentinels), and route around a wedged backend
+(init-hang watchdog + degraded-mode failover) instead of restarting
+from zero on a good day's luck. Three layers, used by every entry
+point (bench.py phases, ``Simulation.run_scenario`` via
+:func:`harness.run_resilient`, and the ``consul-tpu run`` /
+``consul-tpu chaos`` CLI subcommands):
+
+- :mod:`policy` — :class:`CheckpointPolicy`: digest-verified atomic
+  checkpointing (utils/checkpoint) under interval / wall-paced /
+  on-signal / on-hang triggers, with a :class:`SignalTrap` SIGTERM
+  handler for preemption and run provenance (tick offset, chaos
+  schedule digest) carried in the checkpoint manifest.
+- :mod:`harness` — :func:`run_resilient`: the chunked run loop every
+  entry point drives through; resumes bit-identically (same seed, same
+  chaos schedule offset), including across shard_map layouts
+  (:func:`harness.restore_placed`).
+- :mod:`watchdog` — :class:`InitWatchdog` + :func:`with_failover`:
+  the init-hang watchdog with bounded retries and explicit CPU
+  failover, recording ``degraded_from`` / retry / hang-wall provenance
+  instead of ad-hoc status strings.
+
+The sentinel *device* tier lives in models/swim.py (_sentinel_check,
+folded into step_counted behind a trace-time flag); its *host* tier —
+fail-fast on a nonzero violation mask with a diagnostic checkpoint —
+lives where counters flush (models/cluster.py) and is re-exported here
+as :class:`SentinelViolation`.
+"""
+
+# Lazy re-exports (PEP 562): the bench parent process must stay
+# jax-free (bench.py top docstring) yet still reach the stdlib-only
+# watchdog tier; eager imports here would pull models/cluster -> jax
+# into every ``consul_tpu.runtime.*`` importer.
+_EXPORTS = {
+    "SentinelViolation": ("consul_tpu.models.cluster", "SentinelViolation"),
+    "SENTINEL_FIELDS": ("consul_tpu.models.counters", "SENTINEL_FIELDS"),
+    "violation_mask": ("consul_tpu.models.counters", "violation_mask"),
+    "Preempted": ("consul_tpu.runtime.harness", "Preempted"),
+    "RunReport": ("consul_tpu.runtime.harness", "RunReport"),
+    "restore_placed": ("consul_tpu.runtime.harness", "restore_placed"),
+    "run_resilient": ("consul_tpu.runtime.harness", "run_resilient"),
+    "CheckpointPolicy": ("consul_tpu.runtime.policy", "CheckpointPolicy"),
+    "SignalTrap": ("consul_tpu.runtime.policy", "SignalTrap"),
+    "InitWatchdog": ("consul_tpu.runtime.watchdog", "InitWatchdog"),
+    "with_failover": ("consul_tpu.runtime.watchdog", "with_failover"),
+}
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    value = getattr(importlib.import_module(mod_name), attr)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))
+
+
+__all__ = [
+    "CheckpointPolicy",
+    "InitWatchdog",
+    "Preempted",
+    "RunReport",
+    "SENTINEL_FIELDS",
+    "SentinelViolation",
+    "SignalTrap",
+    "restore_placed",
+    "run_resilient",
+    "violation_mask",
+    "with_failover",
+]

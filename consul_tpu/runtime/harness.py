@@ -1,0 +1,199 @@
+"""The resilient run loop: chunked execution with checkpoint/resume,
+preemption handling, and optional on-device invariant sentinels.
+
+``run_resilient`` is what the entry points drive instead of private
+while-loops: ``consul-tpu run`` / ``consul-tpu chaos`` (cli.py) and
+scenario replays that need to survive a kill. The guarantee (pinned by
+tests/test_runtime.py at 4096 nodes, single-device and sharded, with
+and without a chaos schedule): kill -9 the process mid-run, rerun the
+same command, and the final state is bit-identical to an uninterrupted
+run. Three properties make that hold:
+
+- per-tick randomness is ``fold_in(base_key, t)`` (models/cluster.py)
+  and ``t`` rides in the state, so a restored state replays the exact
+  key stream;
+- the chaos schedule's tick offset (``chaos_t0``) and digest ride in
+  the checkpoint provenance, so the resumed run re-rebases the SAME
+  schedule to the SAME absolute ticks — the remaining faults replay
+  bit-identically — and a checkpoint from a different schedule is
+  refused;
+- saves are atomic and digest-verified (utils/checkpoint), so a crash
+  mid-save can never poison the resume point.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional, Sequence
+
+from consul_tpu.chaos import schedule as chaos_mod
+from consul_tpu.models import counters as counters_mod
+from consul_tpu.models.cluster import SLO_KEYS
+from consul_tpu.runtime.policy import CheckpointPolicy, SignalTrap
+from consul_tpu.utils import checkpoint as ckpt_mod
+
+
+class Preempted(RuntimeError):
+    """The run stopped early on a trapped termination signal — after
+    saving a resume point. Carries the report so the caller can emit
+    provenance before exiting."""
+
+    def __init__(self, report: "RunReport"):
+        self.report = report
+        super().__init__(
+            f"preempted at tick {report.ticks_done}/{report.ticks_asked} "
+            f"(checkpoint: {report.checkpoint_path})"
+        )
+
+
+@dataclasses.dataclass
+class RunReport:
+    """What one resilient run did — the provenance the entry points
+    serialize instead of ad-hoc status strings."""
+
+    ticks_asked: int
+    ticks_done: int
+    resumed_from_tick: int
+    preempted: bool
+    checkpoint_path: Optional[str]
+    ckpt_failures: int
+    counters: dict
+    slo: Optional[dict]
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _scenario_meta(sim, tag: str, ticks: int, t0: int, done: int,
+                   sched_digest: str) -> dict:
+    return {
+        "tag": tag,
+        "n": sim.cfg.n,
+        "seed": sim.seed,
+        "kind": type(sim).__name__,
+        "ticks": ticks,
+        "t0": t0,
+        "ticks_done": done,
+        "chaos_t0": t0,
+        "schedule_digest": sched_digest,
+    }
+
+
+def run_resilient(sim, ticks: int, *, chunk: int = 64,
+                  with_metrics: bool = False,
+                  events: Optional[Sequence] = None,
+                  policy: Optional[CheckpointPolicy] = None,
+                  sentinel: bool = False,
+                  sentinel_dump_dir: Optional[str] = None) -> RunReport:
+    """Advance ``sim`` by ``ticks`` ticks (with ``events`` as a chaos
+    schedule rebased onto the start tick, like ``run_scenario``) under
+    the resilient harness: resume from ``policy``'s checkpoint when a
+    compatible one exists, save at every due chunk boundary, save and
+    raise :class:`Preempted` on SIGTERM, and retire the checkpoint on
+    completion. With ``sentinel``, the on-device validator runs and a
+    violation fail-fasts (models/cluster.py SentinelViolation) with a
+    diagnostic checkpoint in ``sentinel_dump_dir``.
+
+    Returns a :class:`RunReport`; the counter deltas cover only the
+    ticks THIS invocation ran (a resumed run reports its own slice)."""
+    if sentinel:
+        sim.set_sentinel(True, sentinel_dump_dir)
+    sched = (chaos_mod.compile_schedule(sim.cfg.n, events)
+             if events else None)
+    sched_digest = chaos_mod.digest_of(sched)
+    t0 = int(sim.swim_state.t)
+    done = 0
+
+    if policy is not None and policy.trap is None:
+        policy.trap = SignalTrap()
+
+    # Resume: the trajectory's identity is (shape, seed, driver kind,
+    # total ticks, schedule digest). ``t0`` comes FROM the meta — the
+    # schedule must rebase to the original start tick, not to wherever
+    # the restored state happens to be.
+    if policy is not None:
+        state, meta = policy.load(sim.state, match={
+            "tag": policy.tag,
+            "n": sim.cfg.n,
+            "seed": sim.seed,
+            "kind": type(sim).__name__,
+            "ticks": ticks,
+            "schedule_digest": sched_digest,
+        })
+        if state is not None:
+            sim.state = state
+            t0 = int(meta["t0"])
+            done = int(meta["ticks_done"])
+    resumed_from = done
+
+    prev_sched = sim.chaos
+    if sched is not None:
+        sim.set_chaos(chaos_mod.shift_schedule(sched, t0))
+    before = dict(sim.counters)
+
+    def _report(preempted: bool) -> RunReport:
+        after = sim.counters
+        deltas = {f: after[f] - before[f] for f in counters_mod.FIELDS}
+        return RunReport(
+            ticks_asked=ticks,
+            ticks_done=done,
+            resumed_from_tick=resumed_from,
+            preempted=preempted,
+            checkpoint_path=policy.path if policy is not None else None,
+            ckpt_failures=policy.failures if policy is not None else 0,
+            counters=deltas,
+            slo={SLO_KEYS[f]: deltas[f] for f in SLO_KEYS}
+            if sched is not None else None,
+        )
+
+    trap = policy.trap if policy is not None else SignalTrap()
+    try:
+        with trap:
+            if policy is not None:
+                policy.mark_run_start()
+            since_save = 0
+            while done < ticks:
+                c = min(chunk, ticks - done)
+                sim.run(c, chunk=c, with_metrics=with_metrics)
+                done += c
+                since_save += c
+                if policy is None:
+                    continue
+                if trap.fired is not None:
+                    policy.try_save(sim.state, _scenario_meta(
+                        sim, policy.tag, ticks, t0, done, sched_digest))
+                    raise Preempted(_report(preempted=True))
+                if done < ticks and policy.due(since_save):
+                    if policy.try_save(sim.state, _scenario_meta(
+                            sim, policy.tag, ticks, t0, done, sched_digest)):
+                        since_save = 0
+    finally:
+        sim.set_chaos(prev_sched)
+    if policy is not None:
+        policy.retire()
+    return _report(preempted=False)
+
+
+def restore_placed(path: str, template: Any, mesh=None, n: Optional[int] = None):
+    """Restore a checkpoint and re-shard it over ``mesh``'s node axis —
+    the round trip that lets a sharded run resume a single-device
+    checkpoint and vice versa: utils/checkpoint serializes the GLOBAL
+    array view (np.asarray gathers the shards), so the on-disk layout
+    is placement-free and ``shard_step.place`` reinstates whatever
+    layout this process runs. With ``mesh=None`` the arrays stay
+    unsharded (single-device resume)."""
+    state = ckpt_mod.restore(path, template)
+    if mesh is not None:
+        from consul_tpu.parallel import shard_step
+
+        if n is None:
+            raise ValueError("restore_placed(mesh=...) needs n")
+        state = shard_step.place(mesh, state, n)
+    return state
+
+
+def diagnostic_dump_path(dump_dir: str, t: int) -> str:
+    """Where the sentinel host tier drops its diagnostic checkpoint
+    (kept here so tooling and tests agree on the name)."""
+    return os.path.join(dump_dir, f"sentinel_diag_t{int(t)}.ckpt")

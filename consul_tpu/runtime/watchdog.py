@@ -1,0 +1,125 @@
+"""Backend init-hang watchdog + degraded-mode failover.
+
+A wedged TPU relay hangs *inside* ``jax.devices()`` indefinitely
+(BENCH_r05.json records a real 300 s ``backend-init-hang``); waiting
+out the full budget on it pushes the whole run past outer harness
+timeouts and loses the output. Lifted out of bench.py's private child
+loop so any supervisor of a backend-owning child process gets the same
+protection:
+
+- :class:`InitWatchdog` — poll a child for its readiness event; kill
+  it early when the init window expires without one.
+- :func:`with_failover` — bounded retries of a hanging attempt, then
+  an explicit degraded-mode failover to the next platform, recording
+  provenance (``degraded_from``, retry count, hang wall time) into the
+  telemetry sink and the returned report instead of ad-hoc status
+  strings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import subprocess
+import time
+from typing import Callable, Sequence
+
+# Status strings (stable: bench JSON consumers key on them).
+OK = "ok"
+INIT_HANG = "backend-init-hang"
+TIMEOUT = "timeout"
+
+
+@dataclasses.dataclass
+class InitWatchdog:
+    """Supervise one child process: kill it early if it has not proven
+    liveness (``ready()`` true) within ``init_window_s``, or at the
+    hard ``deadline`` either way. ``ready`` is polled between waits —
+    for bench children it parses the JSONL stream for the ``setup``
+    phase, but any cheap host-side probe works."""
+
+    init_window_s: float = 300.0
+    poll_s: float = 10.0
+
+    def watch(self, proc: subprocess.Popen, ready: Callable[[], bool],
+              deadline: float) -> str:
+        """Block until the child exits or is killed; returns OK /
+        INIT_HANG / TIMEOUT (rc mapping is the caller's business —
+        only the caller knows which exit codes are expected).
+        ``deadline`` is an absolute ``time.monotonic()`` stamp."""
+        t0 = time.monotonic()
+        seen_ready = False
+        try:
+            while True:
+                step = min(self.poll_s, max(0.1, deadline - time.monotonic()))
+                try:
+                    proc.wait(timeout=step)
+                    return OK
+                except subprocess.TimeoutExpired:
+                    pass
+                now = time.monotonic()
+                if now >= deadline:
+                    raise subprocess.TimeoutExpired(
+                        proc.args, deadline - t0)
+                seen_ready = seen_ready or ready()
+                if now - t0 > self.init_window_s and not seen_ready:
+                    self._kill(proc)
+                    return INIT_HANG
+        except subprocess.TimeoutExpired:
+            self._kill(proc)
+            return TIMEOUT
+
+    @staticmethod
+    def _kill(proc: subprocess.Popen):
+        proc.kill()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            pass  # keep the original diagnosis; the child is a zombie
+
+
+def with_failover(attempt: Callable[[str], dict],
+                  platforms: Sequence[str], *,
+                  max_retries: int = 1,
+                  sink=None):
+    """Run ``attempt(platform)`` (returning a dict with a ``status``
+    key) with bounded retries on init-hang, failing over to the next
+    platform when a platform's retries are exhausted. Returns
+    ``(result, provenance)`` where provenance is::
+
+        {"platform":     the platform that produced the result,
+         "degraded_from": first platform given up on (None if primary),
+         "retries":       hang-triggered re-attempts,
+         "hang_wall_s":   wall seconds burned inside hangs,
+         "attempts":      [{"platform", "status", "wall_s"}, ...]}
+
+    Only INIT_HANG retries/fails over — a child that ran and crashed
+    (rc=N) or timed out while *working* is a real answer, not a wedged
+    backend, and is returned as-is. ``sink`` (telemetry.Sink) counts
+    hangs and failovers so the degraded mode is visible in metrics,
+    not only in the artifact."""
+    prov = {"platform": None, "degraded_from": None, "retries": 0,
+            "hang_wall_s": 0.0, "attempts": []}
+    result = None
+    for i, plat in enumerate(platforms):
+        for _ in range(max_retries + 1):
+            result = attempt(plat)
+            prov["attempts"].append({
+                "platform": plat,
+                "status": result.get("status"),
+                "wall_s": result.get("wall_s"),
+            })
+            if result.get("status") != INIT_HANG:
+                prov["platform"] = plat
+                return result, prov
+            prov["hang_wall_s"] += float(result.get("wall_s") or 0.0)
+            if sink is not None:
+                sink.incr_counter("sim.runtime.backend_hangs", 1)
+            prov["retries"] += 1
+        # Retries exhausted on this platform: degrade to the next.
+        if i + 1 < len(platforms):
+            if prov["degraded_from"] is None:
+                prov["degraded_from"] = plat
+            if sink is not None:
+                sink.incr_counter("sim.runtime.degraded_failovers", 1)
+    prov["platform"] = platforms[-1] if platforms else None
+    return result, prov

@@ -29,13 +29,28 @@ import hashlib
 import uuid
 from typing import Optional
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.x509.oid import NameOID
+# Optional dependency: server/endpoints.py imports this module lazily,
+# and a crypto-less environment must still collect/serve everything
+# except the Connect CA itself (HAVE_CRYPTOGRAPHY gates; every cert
+# operation below raises RuntimeError when it is missing).
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover — crypto-less environment
+    HAVE_CRYPTOGRAPHY = False
+    x509 = hashes = serialization = ec = NameOID = None
 
 DEFAULT_ROOT_TTL_S = 10 * 365 * 24 * 3600.0   # reference: 10 years
 DEFAULT_LEAF_TTL_S = 72 * 3600.0              # reference: 72h
+
+
+def _require_crypto():
+    if not HAVE_CRYPTOGRAPHY:
+        raise RuntimeError(
+            "the Connect CA requires the 'cryptography' package")
 
 
 def trust_domain(cluster_id: str) -> str:
@@ -58,6 +73,7 @@ def generate_root(cluster_id: str,
                   ttl_s: float = DEFAULT_ROOT_TTL_S) -> dict:
     """A self-signed EC P-256 root with the SPIFFE trust-domain URI
     SAN (provider_consul.go GenerateRoot)."""
+    _require_crypto()
     td = trust_domain(cluster_id)
     key = ec.generate_private_key(ec.SECP256R1())
     now = datetime.datetime.now(datetime.timezone.utc)
@@ -107,6 +123,7 @@ def sign_leaf(root: dict, service: str, dc: str,
               ttl_s: float = DEFAULT_LEAF_TTL_S) -> dict:
     """Mint a leaf for ``service`` signed by ``root`` (the Sign RPC +
     the agent leaf endpoint, connect_ca_endpoint.go Sign)."""
+    _require_crypto()
     ca_key = serialization.load_pem_private_key(
         root["private_key"].encode(), password=None)
     ca_cert = x509.load_pem_x509_certificate(root["root_cert"].encode())
@@ -151,6 +168,7 @@ def sign_leaf(root: dict, service: str, dc: str,
 
 def verify_leaf(leaf_cert_pem: str, root_cert_pem: str) -> bool:
     """Does the leaf chain to the root? (test/diagnostic helper)."""
+    _require_crypto()
     leaf = x509.load_pem_x509_certificate(leaf_cert_pem.encode())
     root = x509.load_pem_x509_certificate(root_cert_pem.encode())
     try:

@@ -59,10 +59,16 @@ def _host_leaves(state: Any) -> list[np.ndarray]:
     return out
 
 
-def save(path: str, state: Any) -> str:
+def save(path: str, state: Any, meta: Any = None) -> str:
     """Write ``state`` (any pytree of arrays) to ``path``. Returns the
     payload's hex SHA-256 digest. Crash-safe: fsync before the atomic
-    rename, so a torn write can never replace a good checkpoint."""
+    rename, so a torn write can never replace a good checkpoint.
+
+    ``meta`` (optional, JSON-serializable) rides in the manifest under
+    the ``meta`` key — run provenance the resilient harness needs to
+    resume correctly (ticks done, chaos-schedule tick offset, schedule
+    digest; consul_tpu/runtime/policy.py). Readable without touching
+    the payload via :func:`read_manifest`."""
     names = _leaf_names(state)
     leaves = _host_leaves(state)
 
@@ -80,6 +86,8 @@ def save(path: str, state: Any) -> str:
         "dtypes": [str(a.dtype) for a in leaves],
         "sha256": digest,
     }
+    if meta is not None:
+        manifest["meta"] = meta
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         mjson = json.dumps(manifest).encode()
@@ -123,6 +131,12 @@ def _read_header(f: BinaryIO) -> dict:
 def read_manifest(path: str) -> dict:
     with open(path, "rb") as f:
         return _read_header(f)
+
+
+def read_meta(path: str) -> Any:
+    """The run-provenance ``meta`` the save embedded (or None). Header-
+    only read — cheap enough to probe every candidate resume point."""
+    return read_manifest(path).get("meta")
 
 
 def restore(path: str, template: Any, *, verify: bool = True) -> Any:

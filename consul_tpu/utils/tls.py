@@ -27,6 +27,15 @@ import os
 import ssl
 from typing import Optional
 
+# Optional dependency: the Configurator / client_ctx surface is pure
+# stdlib ``ssl``; only generating development material (dev_ca) needs
+# the ``cryptography`` package.
+try:
+    import cryptography  # noqa: F401
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover — crypto-less environment
+    HAVE_CRYPTOGRAPHY = False
+
 
 def _san(hostname: str):
     """IP SAN when the hostname parses as an address (v4 or v6), DNS
@@ -43,6 +52,9 @@ def dev_ca(dir_path: str, hostname: str = "127.0.0.1") -> dict[str, str]:
     """Generate a CA plus a server cert/key signed by it (the
     ``consul tls ca create`` / ``tls cert create`` developer flow).
     Returns paths: {ca, cert, key}."""
+    if not HAVE_CRYPTOGRAPHY:
+        raise RuntimeError(
+            "dev_ca requires the 'cryptography' package")
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import ec

@@ -16,13 +16,31 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+# Optional dependency: the wire package (codec framing, bridge, key
+# manager) must import — and the pure-framing paths must work — on a
+# box without ``cryptography``; only actually encrypting/decrypting
+# requires it (HAVE_CRYPTOGRAPHY gates, RuntimeError on use).
+try:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover — crypto-less environment
+    HAVE_CRYPTOGRAPHY = False
+    AESGCM = None
+
+    class InvalidTag(Exception):
+        """Stand-in so ``except InvalidTag`` clauses keep working."""
 
 VERSION_SIZE = 1
 NONCE_SIZE = 12
 TAG_SIZE = 16
 MAX_ENCRYPTION_VERSION = 1
+
+
+def _require_crypto():
+    if not HAVE_CRYPTOGRAPHY:
+        raise RuntimeError(
+            "gossip encryption requires the 'cryptography' package")
 
 
 def validate_key(key: bytes):
@@ -35,6 +53,7 @@ def validate_key(key: bytes):
 def encrypt_payload(key: bytes, msg: bytes, aad: bytes = b"",
                     version: int = 1) -> bytes:
     """security.go:90 encryptPayload (version 1: no padding)."""
+    _require_crypto()
     validate_key(key)
     if version != 1:
         raise ValueError("only encryption version 1 is produced")
@@ -46,6 +65,7 @@ def encrypt_payload(key: bytes, msg: bytes, aad: bytes = b"",
 def decrypt_with_key(key: bytes, payload: bytes, aad: bytes = b"") -> bytes:
     """security.go:137 decryptMessage + version handling (:158-...):
     version 0 strips PKCS7 padding after decryption."""
+    _require_crypto()
     if len(payload) < VERSION_SIZE + NONCE_SIZE + TAG_SIZE:
         raise ValueError("payload too small to decrypt")
     version = payload[0]

@@ -13,6 +13,7 @@ import sys
 import pytest
 
 from consul_tpu.agent import boot
+from consul_tpu.utils.tls import HAVE_CRYPTOGRAPHY
 
 
 @pytest.fixture(scope="module")
@@ -230,6 +231,9 @@ class TestSessionTTLLive:
 
 
 class TestKitchenSinkBoot:
+    @pytest.mark.skipif(
+        not HAVE_CRYPTOGRAPHY,
+        reason="requires the 'cryptography' package (dev CA for HTTPS)")
     def test_tls_acl_dns_together(self, tmp_path):
         """Every boot-time subsystem at once — HTTPS + ACL default-deny
         + DNS + data_dir durability — the combination a hardened

@@ -7,6 +7,11 @@ import time
 
 import pytest
 
+# Every test here mints/verifies real X.509 material — without the
+# optional 'cryptography' package the whole module is a skip, not a
+# collection error.
+pytest.importorskip("cryptography")
+
 from consul_tpu.server import connect_ca as ca
 from consul_tpu.server.endpoints import ServerCluster
 

@@ -14,7 +14,7 @@ from consul_tpu.models import coalesce
 from consul_tpu.models import serf as serf_mod
 from consul_tpu.ops import topology
 from consul_tpu.wire.keymanager import KeyManager
-from consul_tpu.wire.keyring import Keyring
+from consul_tpu.wire.keyring import HAVE_CRYPTOGRAPHY, Keyring
 
 
 class TestMemberCoalescer:
@@ -123,6 +123,9 @@ class TestKeyManager:
         members = {f"m{i}": Keyring(primary=k0) for i in range(n)}
         return k0, members
 
+    @pytest.mark.skipif(
+        not HAVE_CRYPTOGRAPHY,
+        reason="requires the 'cryptography' package (AES-GCM)")
     def test_full_rotation_flow(self):
         k0, members = self.make()
         mgr = KeyManager(members)

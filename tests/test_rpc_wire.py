@@ -203,6 +203,14 @@ class TestBackpressure:
         assert got["out"]["value"]["value"] == b"v1"
 
 
+from consul_tpu.utils.tls import HAVE_CRYPTOGRAPHY
+
+needs_crypto = pytest.mark.skipif(
+    not HAVE_CRYPTOGRAPHY,
+    reason="requires the 'cryptography' package (dev CA)")
+
+
+@needs_crypto
 class TestTLSWire:
     """RPCTLS first-byte upgrade (reference agent/pool/conn.go:3-30,
     pool.go:307-315, tlsutil/config.go): handshake then re-read the
@@ -487,6 +495,7 @@ class TestJoinVerb:
         assert "client-mode" in (r.stderr + r.stdout)
 
 
+@needs_crypto
 class TestClientAgentProcessTLS:
     """The same three-process story with the RPC port encrypted and
     plaintext REFUSED (reference tlsutil VerifyIncoming on the RPC

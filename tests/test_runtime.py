@@ -1,0 +1,586 @@
+"""Resilient run harness (consul_tpu/runtime): checkpoint policy
+triggers, SIGTERM preemption, kill-and-rerun bit-identical resume
+(single-device and sharded, with and without a chaos schedule),
+on-device invariant sentinels (injected corruption fail-fasts with a
+diagnostic checkpoint), the compile-count pin for the sentinel flag,
+and the init-hang watchdog + degraded-mode failover."""
+
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consul_tpu import runtime as rt
+from consul_tpu.chaos import schedule as chaos_mod
+from consul_tpu.config import SimConfig
+from consul_tpu.models import cluster as cluster_mod
+from consul_tpu.models import counters as counters_mod
+from consul_tpu.ops import merge
+from consul_tpu.runtime import watchdog as wd
+
+
+def _sim(n=128, seed=11, serf=False):
+    cls = cluster_mod.SerfSimulation if serf else cluster_mod.Simulation
+    return cls(SimConfig(n=n, view_degree=16), seed=seed)
+
+
+def _events():
+    return [chaos_mod.Partition(start=4, stop=12, side_a=slice(0, 40)),
+            chaos_mod.ChurnWave(start=8, stop=16, nodes=slice(100, 108),
+                                period=4, down_ticks=2)]
+
+
+def _leaves(state):
+    return jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(np.asarray, state))
+
+
+def _identical(a, b):
+    la, lb = _leaves(a), _leaves(b)
+    assert len(la) == len(lb)
+    return all(np.array_equal(x, y, equal_nan=True)
+               for x, y in zip(la, lb))
+
+
+class _CountingSink:
+    def __init__(self):
+        self.counters = {}
+
+    def incr_counter(self, name, v=1):
+        self.counters[name] = self.counters.get(name, 0) + v
+
+
+# ----------------------------------------------------------------------
+# CheckpointPolicy
+# ----------------------------------------------------------------------
+
+class TestCheckpointPolicy:
+    def test_save_load_retire_roundtrip(self, tmp_path):
+        sim = _sim(n=64)
+        sim.run(8, chunk=8)
+        pol = rt.CheckpointPolicy(directory=str(tmp_path), tag="t")
+        pol.save(sim.state, {"a": 1, "ticks_done": 8})
+        assert os.path.exists(pol.path) and os.path.exists(pol.meta_path)
+        assert pol.read_meta()["a"] == 1
+        # Manifest meta rides in the checkpoint file too (default mode).
+        from consul_tpu.utils import checkpoint as ckpt_mod
+        assert ckpt_mod.read_meta(pol.path)["ticks_done"] == 8
+        tpl = _sim(n=64)
+        state, meta = pol.load(tpl.state, match={"a": 1})
+        assert meta["ticks_done"] == 8
+        tpl.state = state
+        assert _identical(sim.state, tpl.state)
+        pol.retire()
+        assert not os.path.exists(pol.path)
+        assert pol.load(tpl.state) == (None, None)
+
+    def test_load_refuses_mismatched_identity(self, tmp_path):
+        sim = _sim(n=64)
+        pol = rt.CheckpointPolicy(directory=str(tmp_path), tag="t")
+        pol.save(sim.state, {"n": 64, "seed": 11})
+        assert pol.load(sim.state, match={"n": 64, "seed": 12}) == (None, None)
+        assert pol.load(sim.state, match={"n": 64, "seed": 11})[1] is not None
+
+    def test_triggers(self, tmp_path):
+        pol = rt.CheckpointPolicy(directory=str(tmp_path), tag="t",
+                                  min_interval_s=9999.0)
+        assert not pol.due(10_000)     # inside the wall interval
+        pol.request()                  # on-hang trigger overrides pacing
+        assert pol.due(0)
+        pol._requested = False
+        pol._last_save -= 10_000       # wall interval elapsed
+        assert pol.due(0)
+        # every_ticks bounds the tick slice but still respects the wall.
+        pol2 = rt.CheckpointPolicy(directory=str(tmp_path), tag="u",
+                                   every_ticks=64, min_interval_s=0.0)
+        assert not pol2.due(32)
+        assert pol2.due(64)
+
+    def test_signal_trigger(self, tmp_path):
+        pol = rt.CheckpointPolicy(directory=str(tmp_path), tag="t",
+                                  min_interval_s=9999.0,
+                                  trap=rt.SignalTrap())
+        with pol.trap:
+            assert not pol.due(0)
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert pol.trap.fired == signal.SIGTERM
+            assert pol.signal_pending and pol.due(0)
+
+    def test_try_save_counts_and_logs_failures(self, tmp_path, caplog):
+        sink = _CountingSink()
+        pol = rt.CheckpointPolicy(directory=str(tmp_path / "nope"),
+                                  tag="t", sink=sink)
+        sim = _sim(n=64)
+        import consul_tpu.utils.checkpoint as ckpt_mod
+        real = ckpt_mod.save
+
+        def boom(path, state, meta=None):
+            raise OSError("disk on fire")
+
+        ckpt_mod.save = boom
+        try:
+            with caplog.at_level(logging.WARNING,
+                                 logger="consul_tpu.runtime.policy"):
+                assert pol.try_save(sim.state, {}) is False
+                assert pol.try_save(sim.state, {}) is False
+        finally:
+            ckpt_mod.save = real
+        assert pol.failures == 2
+        assert sink.counters["sim.runtime.ckpt_failures"] == 2
+        assert isinstance(pol.first_error, OSError)
+        # First failure logged (with traceback), later ones only counted.
+        assert sum("checkpoint save failed" in r.message
+                   for r in caplog.records) == 1
+
+    def test_try_save_propagates_real_bugs(self, tmp_path):
+        pol = rt.CheckpointPolicy(directory=str(tmp_path), tag="t")
+        sim = _sim(n=64)
+        import consul_tpu.utils.checkpoint as ckpt_mod
+        real = ckpt_mod.save
+
+        def boom(path, state, meta=None):
+            raise TypeError("not an I/O problem")
+
+        ckpt_mod.save = boom
+        try:
+            with pytest.raises(TypeError):
+                pol.try_save(sim.state, {})
+        finally:
+            ckpt_mod.save = real
+
+
+class TestSignalTrap:
+    def test_records_and_restores(self):
+        seen = []
+        prev = signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+        try:
+            with rt.SignalTrap() as trap:
+                os.kill(os.getpid(), signal.SIGTERM)
+                assert trap.fired == signal.SIGTERM
+                assert not seen  # trapped, not delivered to the old handler
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert seen == [signal.SIGTERM]  # previous handler restored
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+
+# ----------------------------------------------------------------------
+# run_resilient: resume bit-identity
+# ----------------------------------------------------------------------
+
+def _interrupt_after_first_save(monkeypatch):
+    """Make the first policy save raise — the closest in-process
+    equivalent of SIGKILL right after a checkpoint lands."""
+    class Killed(BaseException):
+        pass
+
+    orig = rt.CheckpointPolicy.try_save
+    calls = {"n": 0}
+
+    def wrapper(self, state, meta):
+        ok = orig(self, state, meta)
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise Killed()
+        return ok
+
+    monkeypatch.setattr(rt.CheckpointPolicy, "try_save", wrapper)
+    return Killed
+
+
+def _resume_bit_identical(n, seed, events, ticks, chunk, monkeypatch,
+                          tmp_path, serf=False, mesh=None):
+    ref = _sim(n=n, seed=seed, serf=serf)
+    rt.run_resilient(ref, ticks, chunk=chunk, events=events)
+
+    sim = _sim(n=n, seed=seed, serf=serf)
+    pol = rt.CheckpointPolicy(directory=str(tmp_path), tag="bi",
+                              every_ticks=chunk, min_interval_s=0.0)
+    Killed = _interrupt_after_first_save(monkeypatch)
+    with pytest.raises(Killed):
+        rt.run_resilient(sim, ticks, chunk=chunk, events=events, policy=pol)
+    monkeypatch.undo()
+    assert os.path.exists(pol.path)
+
+    sim2 = _sim(n=n, seed=seed, serf=serf)
+    pol2 = rt.CheckpointPolicy(directory=str(tmp_path), tag="bi",
+                               every_ticks=chunk, min_interval_s=0.0)
+    if mesh is not None:
+        restored = rt.restore_placed(pol2.path, sim2.state, mesh=mesh, n=n)
+        assert _identical(
+            restored, rt.restore_placed(pol2.path, sim2.state))
+    rep = rt.run_resilient(sim2, ticks, chunk=chunk, events=events,
+                           policy=pol2)
+    assert rep.resumed_from_tick > 0
+    assert rep.ticks_done == ticks
+    assert _identical(ref.state, sim2.state)
+    assert not os.path.exists(pol2.path)  # completed run retires
+
+
+class TestResumeBitIdentity:
+    def test_plain_run(self, tmp_path, monkeypatch):
+        _resume_bit_identical(128, 11, None, 48, 16, monkeypatch, tmp_path)
+
+    def test_with_chaos_schedule(self, tmp_path, monkeypatch):
+        """The resumed run replays the REMAINING faults bit-identically:
+        the schedule is rebased to the original start tick recorded in
+        the checkpoint, not to the resume point."""
+        _resume_bit_identical(128, 11, _events(), 48, 16, monkeypatch,
+                              tmp_path)
+
+    @pytest.mark.slow
+    def test_serf_driver(self, tmp_path, monkeypatch):
+        _resume_bit_identical(128, 11, None, 32, 16, monkeypatch, tmp_path,
+                              serf=True)
+
+    @pytest.mark.slow
+    def test_schedule_digest_gates_resume(self, tmp_path, monkeypatch):
+        sim = _sim()
+        pol = rt.CheckpointPolicy(directory=str(tmp_path), tag="dg",
+                                  every_ticks=16, min_interval_s=0.0)
+        Killed = _interrupt_after_first_save(monkeypatch)
+        with pytest.raises(Killed):
+            rt.run_resilient(sim, 48, chunk=16, events=_events(),
+                             policy=pol)
+        monkeypatch.undo()
+        # Same command but a DIFFERENT schedule: the checkpoint is for
+        # another trajectory and must be refused, not resumed.
+        other = [chaos_mod.Partition(start=2, stop=20, side_a=slice(0, 64))]
+        sim2 = _sim()
+        pol2 = rt.CheckpointPolicy(directory=str(tmp_path), tag="dg",
+                                   every_ticks=1 << 30,
+                                   min_interval_s=9999.0)
+        rep = rt.run_resilient(sim2, 48, chunk=16, events=other,
+                               policy=pol2)
+        assert rep.resumed_from_tick == 0
+
+    def test_preempted_on_sigterm_saves_and_raises(self, tmp_path):
+        sim = _sim()
+        pol = rt.CheckpointPolicy(directory=str(tmp_path), tag="pre",
+                                  min_interval_s=9999.0)
+        real_run = cluster_mod.Simulation.run
+        fired = {"done": False}
+
+        def run_and_sigterm(self, *a, **kw):
+            out = real_run(self, *a, **kw)
+            if not fired["done"]:
+                fired["done"] = True
+                os.kill(os.getpid(), signal.SIGTERM)
+            return out
+
+        cluster_mod.Simulation.run = run_and_sigterm
+        try:
+            with pytest.raises(rt.Preempted) as ei:
+                rt.run_resilient(sim, 64, chunk=16, policy=pol)
+        finally:
+            cluster_mod.Simulation.run = real_run
+        assert ei.value.report.preempted
+        assert ei.value.report.ticks_done == 16  # one chunk, then saved
+        assert os.path.exists(pol.path)  # resume point on disk
+        meta = pol.read_meta()
+        assert meta["ticks_done"] == 16
+        # Rerunning the same command completes the trajectory.
+        sim2 = _sim()
+        pol2 = rt.CheckpointPolicy(directory=str(tmp_path), tag="pre",
+                                   min_interval_s=9999.0)
+        rep = rt.run_resilient(sim2, 64, chunk=16, policy=pol2)
+        assert rep.resumed_from_tick == 16 and rep.ticks_done == 64
+        ref = _sim()
+        rt.run_resilient(ref, 64, chunk=16)
+        assert _identical(ref.state, sim2.state)
+
+
+@pytest.mark.slow
+class TestResumeAcceptance:
+    """The ISSUE acceptance shapes: 4096 nodes, single-device and
+    sharded, with and without a chaos schedule."""
+
+    N = 4096
+
+    def test_single_device(self, tmp_path, monkeypatch):
+        _resume_bit_identical(self.N, 3, None, 64, 32, monkeypatch,
+                              tmp_path)
+
+    def test_single_device_chaos(self, tmp_path, monkeypatch):
+        ev = [chaos_mod.Partition(start=8, stop=24,
+                                  side_a=slice(0, self.N // 3))]
+        _resume_bit_identical(self.N, 3, ev, 64, 32, monkeypatch, tmp_path)
+
+    def test_sharded_roundtrip(self, tmp_path, monkeypatch):
+        """A checkpoint taken single-device restores onto a shard_map
+        mesh bit-identically (the on-disk layout is placement-free)."""
+        from jax.sharding import Mesh
+        from consul_tpu.parallel import mesh as pmesh
+        mesh = Mesh(np.array(jax.devices()[:8]), (pmesh.NODE_AXIS,))
+        _resume_bit_identical(self.N, 3, None, 64, 32, monkeypatch,
+                              tmp_path, mesh=mesh)
+
+
+# ----------------------------------------------------------------------
+# Sentinels
+# ----------------------------------------------------------------------
+
+class TestSentinels:
+    def test_healthy_run_counts_zero(self):
+        sim = _sim()
+        sim.set_sentinel(True)
+        sim.run(32, chunk=16)
+        for f in counters_mod.SENTINEL_FIELDS:
+            assert sim.counters[f] == 0
+
+    def test_disabled_outputs_identical(self):
+        """Sentinels off must be byte-identical to the pre-flag step:
+        same states, same counters."""
+        a, b = _sim(), _sim()
+        b.set_sentinel(True)
+        b.set_sentinel(False)
+        a.run(32, chunk=16)
+        b.run(32, chunk=16)
+        assert _identical(a.state, b.state)
+        assert a.counters == b.counters
+
+    def test_compile_count_pin(self):
+        """Toggling sentinels costs exactly one extra executable; with
+        them off, zero (the validator must DCE to the existing
+        program)."""
+        sim = _sim(n=64)
+        sim.run(16, chunk=16, with_metrics=False)
+        n0 = len(cluster_mod._RUNNER_CACHE)
+        sim2 = _sim(n=64)
+        sim2.run(16, chunk=16, with_metrics=False)
+        assert len(cluster_mod._RUNNER_CACHE) == n0  # off: zero extra
+        sim2.set_sentinel(True)
+        sim2.run(16, chunk=16, with_metrics=False)
+        assert len(cluster_mod._RUNNER_CACHE) == n0 + 1  # on: exactly one
+        sim2.set_sentinel(False)
+        sim2.run(16, chunk=16, with_metrics=False)
+        assert len(cluster_mod._RUNNER_CACHE) == n0 + 1  # memo reused
+
+    def _trip(self, sim, field, chunk=16, ticks=32):
+        with pytest.raises(cluster_mod.SentinelViolation) as ei:
+            sim.run(ticks, chunk=chunk, with_metrics=False)
+        assert ei.value.deltas.get(field, 0) > 0
+        assert ei.value.mask != 0
+        return ei.value
+
+    def test_nan_vivaldi_coordinate_trips_within_one_chunk(self, tmp_path):
+        sim = _sim()
+        sim.set_sentinel(True, dump_dir=str(tmp_path))
+        viv = sim.swim_state.viv
+        vec = np.asarray(viv.vec).copy()
+        vec[3, :] = np.nan
+        sim.set_swim_state(sim.swim_state._replace(
+            viv=viv._replace(vec=jnp.asarray(vec))))
+        e = self._trip(sim, "sentinel_nonfinite_coord")
+        # Fail-fast within one flush interval: the very first chunk.
+        assert int(sim.swim_state.t) == 16
+        # Diagnostic checkpoint restores to the corrupted state.
+        assert e.dump_path and os.path.exists(e.dump_path)
+        from consul_tpu.utils import checkpoint as ckpt_mod
+        meta = ckpt_mod.read_meta(e.dump_path)
+        assert meta["reason"] == "sentinel"
+        assert meta["deltas"]["sentinel_nonfinite_coord"] > 0
+        assert meta["t"] == 16 and meta["n"] == 128
+        # The dump restores (digest-verified) into a config-built
+        # template — no Simulation needed for post-mortem inspection.
+        from consul_tpu.models import state as sim_state
+        restored = ckpt_mod.restore(
+            e.dump_path, sim_state.template(SimConfig(n=128,
+                                                      view_degree=16)))
+        assert int(restored.t) == 16
+
+    def test_out_of_range_incarnation_trips(self):
+        sim = _sim()
+        sim.set_sentinel(True)
+        oi = np.asarray(sim.swim_state.own_inc).copy()
+        oi[5] = merge.MAX_INCARNATION + 5
+        sim.set_swim_state(sim.swim_state._replace(
+            own_inc=jnp.asarray(oi, dtype=jnp.uint32)))
+        self._trip(sim, "sentinel_range")
+
+    def test_nonfinite_rtt_trips(self):
+        sim = _sim()
+        sim.run(16, chunk=16)  # populate some latency samples first
+        sim.set_sentinel(True)
+        buf = np.asarray(sim.swim_state.lat_buf).copy()
+        cnt = np.asarray(sim.swim_state.lat_cnt)
+        rows = np.argwhere(cnt > 0)
+        assert rows.size, "formation should have produced RTT samples"
+        i, j = rows[0]
+        buf[i, j, 0] = np.inf
+        sim.set_swim_state(sim.swim_state._replace(
+            lat_buf=jnp.asarray(buf)))
+        self._trip(sim, "sentinel_nonfinite_rtt")
+
+    def test_trip_counted_in_sink(self):
+        sim = _sim()
+        sim.set_sentinel(True)
+        oi = np.asarray(sim.swim_state.own_inc).copy()
+        oi[0] = merge.MAX_INCARNATION + 1
+        sim.set_swim_state(sim.swim_state._replace(
+            own_inc=jnp.asarray(oi, dtype=jnp.uint32)))
+        with pytest.raises(cluster_mod.SentinelViolation):
+            sim.run(16, chunk=16)
+        assert sim.sink.counter_sum("sim.sentinel.trips") >= 1
+
+    def test_run_resilient_surfaces_violation(self, tmp_path):
+        sim = _sim()
+        viv = sim.swim_state.viv
+        vec = np.asarray(viv.vec).copy()
+        vec[0, :] = np.inf
+        sim.set_swim_state(sim.swim_state._replace(
+            viv=viv._replace(vec=jnp.asarray(vec))))
+        with pytest.raises(cluster_mod.SentinelViolation):
+            rt.run_resilient(sim, 32, chunk=16, sentinel=True,
+                             sentinel_dump_dir=str(tmp_path))
+
+
+# ----------------------------------------------------------------------
+# Watchdog + failover
+# ----------------------------------------------------------------------
+
+def _spawn(code: str):
+    return subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+
+class TestInitWatchdog:
+    def test_ok_exit(self):
+        proc = _spawn("pass")
+        status = wd.InitWatchdog(init_window_s=30, poll_s=0.05).watch(
+            proc, lambda: True, deadline=time.monotonic() + 30)
+        assert status == wd.OK
+
+    def test_init_hang_killed_early(self):
+        proc = _spawn("import time; time.sleep(600)")
+        t0 = time.monotonic()
+        status = wd.InitWatchdog(init_window_s=0.2, poll_s=0.05).watch(
+            proc, lambda: False, deadline=time.monotonic() + 600)
+        assert status == wd.INIT_HANG
+        assert time.monotonic() - t0 < 30
+        assert proc.poll() is not None  # child actually killed
+
+    def test_ready_child_survives_init_window_then_deadline(self):
+        proc = _spawn("import time; time.sleep(600)")
+        status = wd.InitWatchdog(init_window_s=0.1, poll_s=0.05).watch(
+            proc, lambda: True, deadline=time.monotonic() + 0.5)
+        assert status == wd.TIMEOUT
+        assert proc.poll() is not None
+
+
+class TestWithFailover:
+    def test_primary_success_no_provenance(self):
+        result, prov = wd.with_failover(
+            lambda p: {"status": "ok", "platform": p},
+            ("tpu", "cpu"))
+        assert result["platform"] == "tpu"
+        assert prov["degraded_from"] is None
+        assert prov["retries"] == 0 and prov["platform"] == "tpu"
+
+    def test_retry_then_success(self):
+        calls = []
+
+        def attempt(p):
+            calls.append(p)
+            st = wd.INIT_HANG if len(calls) == 1 else "ok"
+            return {"status": st, "wall_s": 1.5}
+
+        sink = _CountingSink()
+        result, prov = wd.with_failover(attempt, ("tpu", "cpu"),
+                                        max_retries=1, sink=sink)
+        assert calls == ["tpu", "tpu"]
+        assert result["status"] == "ok"
+        assert prov["retries"] == 1 and prov["degraded_from"] is None
+        assert prov["hang_wall_s"] == 1.5
+        assert sink.counters["sim.runtime.backend_hangs"] == 1
+        assert "sim.runtime.degraded_failovers" not in sink.counters
+
+    def test_degrades_to_next_platform(self):
+        def attempt(p):
+            return {"status": wd.INIT_HANG if p == "tpu" else "ok",
+                    "wall_s": 2.0}
+
+        sink = _CountingSink()
+        result, prov = wd.with_failover(attempt, ("tpu", "cpu"),
+                                        max_retries=1, sink=sink)
+        assert result["status"] == "ok"
+        assert prov["platform"] == "cpu"
+        assert prov["degraded_from"] == "tpu"
+        assert prov["retries"] == 2  # both tpu attempts hung
+        assert prov["hang_wall_s"] == 4.0
+        assert sink.counters["sim.runtime.backend_hangs"] == 2
+        assert sink.counters["sim.runtime.degraded_failovers"] == 1
+        assert [a["platform"] for a in prov["attempts"]] == \
+            ["tpu", "tpu", "cpu"]
+
+    def test_crash_is_final_not_retried(self):
+        calls = []
+
+        def attempt(p):
+            calls.append(p)
+            return {"status": "rc=1", "wall_s": 0.1}
+
+        result, prov = wd.with_failover(attempt, ("tpu", "cpu"),
+                                        max_retries=3)
+        assert calls == ["tpu"]  # a crashed child is an answer
+        assert result["status"] == "rc=1"
+        assert prov["degraded_from"] is None
+
+
+# ----------------------------------------------------------------------
+# CLI kill -9 / resume quickstart (the README flow, end to end)
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestCliKillResume:
+    def test_kill9_then_rerun_is_bit_identical(self, tmp_path):
+        """The README quickstart: run, kill -9 mid-flight, rerun the
+        SAME command — the final counters match an uninterrupted run."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        cmd = [sys.executable, "-m", "consul_tpu.cli", "run",
+               "--n", "256", "--ticks", "96", "--chunk", "16",
+               "--ckpt-dir", str(tmp_path / "ck"),
+               "--ckpt-every-ticks", "16", "--ckpt-interval-s", "0"]
+        # Uninterrupted reference.
+        ref = subprocess.run(cmd + ["--ckpt-tag", "ref"], env=env,
+                             capture_output=True, text=True, timeout=300)
+        assert ref.returncode == 0, ref.stderr[-2000:]
+        ref_out = json.loads(ref.stdout.strip().splitlines()[-1])
+
+        tag = ["--ckpt-tag", "killed"]
+        proc = subprocess.Popen(cmd + tag, env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        ck = tmp_path / "ck" / "killed.ckpt"
+        deadline = time.monotonic() + 240
+        while not ck.exists() and time.monotonic() < deadline:
+            if proc.poll() is not None:
+                break
+            time.sleep(0.1)
+        if proc.poll() is None:
+            assert ck.exists(), "no checkpoint appeared before the kill"
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=30)
+        rerun = subprocess.run(cmd + tag, env=env, capture_output=True,
+                               text=True, timeout=300)
+        assert rerun.returncode == 0, rerun.stderr[-2000:]
+        out = json.loads(rerun.stdout.strip().splitlines()[-1])
+        assert out["ticks"] == ref_out["ticks"]
+        # Counter deltas cover only the resumed slice, so compare the
+        # trajectory end-state instead: rerun again with a fresh tag is
+        # wasteful — the counters of an uninterrupted run over the SAME
+        # remaining slice are not observable here, but bit-identity of
+        # the state is pinned in-process above; at the CLI level assert
+        # the run completed, resumed, and retired its checkpoint.
+        if proc.returncode in (-signal.SIGKILL,):
+            assert out["resumed_from_tick"] > 0
+        assert not ck.exists()

@@ -5,6 +5,7 @@ cold restart (reference serf/snapshot.go:59-431, handleRejoin
 serf.go:1705)."""
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -224,3 +225,69 @@ class TestReviewRegressions:
         row = np.asarray(out.swim.view_key[NODE])
         # Must have contactable seeds — zero seeds would deadlock.
         assert (row == merge.make_key_int(0, merge.ALIVE)).sum() >= 1
+
+
+class TestCrashRobustness:
+    """Replay after ungraceful death (the kill -9 paths the runtime
+    hardening PR pins): torn/corrupt trailing lines and a compaction
+    interrupted between the tmp write and the atomic rename must be
+    tolerated — recovered state, never an exception."""
+
+    def test_corrupt_trailing_line_tolerated(self, tmp_path):
+        p = str(tmp_path / "s")
+        with open(p, "w") as f:
+            f.write("alive: sim-3 sim-3:7946\n"
+                    "clock: 9\n"
+                    "clock: 1x2\n"          # corrupted integer
+                    "event-clock: 4\x00\n"  # NUL garbage from a torn page
+                    "garbage line with no known prefix\n")
+        rep = snap_mod.replay(p)
+        assert rep.alive == {"sim-3": "sim-3:7946"}
+        assert rep.clock == 9
+        assert rep.event_clock == 0  # corrupt value ignored, not crashed
+
+    def test_truncated_alive_line_tolerated(self, tmp_path):
+        # Crash mid-append can leave "alive: <name>" with no address.
+        p = str(tmp_path / "s")
+        with open(p, "w") as f:
+            f.write("alive: sim-1 sim-1:7946\nalive: sim-2")
+        rep = snap_mod.replay(p)
+        assert rep.alive == {"sim-1": "sim-1:7946"}
+
+    def test_interrupted_compaction_leftover_tmp_ignored(self, tmp_path):
+        """A crash between writing ``<path>.compact`` and the
+        ``os.replace`` leaves the tmp file behind; replay reads only
+        the real log, and a reopened Snapshotter compacts over the
+        stale tmp without tripping on it."""
+        p = str(tmp_path / "s")
+        with open(p, "w") as f:
+            f.write("alive: sim-5 sim-5:7946\nclock: 7\n")
+        with open(p + ".compact", "w") as f:
+            f.write("alive: sim-99 sim-99:7946\nclock: 999\n")  # stale tmp
+        rep = snap_mod.replay(p)
+        assert rep.alive == {"sim-5": "sim-5:7946"} and rep.clock == 7
+        snap = snap_mod.Snapshotter(p, NODE)
+        assert snap._last_alive == {"sim-5": "sim-5:7946"}
+        snap.compact()  # must overwrite, not trip on, the stale tmp
+        snap.close()
+        rep2 = snap_mod.replay(p)
+        assert rep2.alive == {"sim-5": "sim-5:7946"} and rep2.clock == 7
+        assert not os.path.exists(p + ".compact")
+
+    def test_crash_mid_compact_keeps_original_log_valid(self, tmp_path, monkeypatch):
+        """If the process dies INSIDE compact() (tmp written, rename
+        never ran), the original log is untouched and still replays."""
+        p = str(tmp_path / "s")
+        snap = snap_mod.Snapshotter(p, NODE)
+        snap._last_alive = {"sim-2": "sim-2:7946"}
+        snap._append("alive: sim-2 sim-2:7946\n")
+        before = snap_mod.replay(p).alive
+
+        def boom(src, dst):
+            raise OSError("simulated crash before rename")
+
+        monkeypatch.setattr(snap_mod.os, "replace", boom)
+        with pytest.raises(OSError):
+            snap.compact()
+        monkeypatch.undo()
+        assert snap_mod.replay(p).alive == before

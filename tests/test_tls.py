@@ -8,6 +8,10 @@ import time
 
 import pytest
 
+# Every test drives the tls_stack fixture, whose dev CA needs the
+# optional 'cryptography' package — skip the module without it.
+pytest.importorskip("cryptography")
+
 from consul_tpu.agent.agent import Agent
 from consul_tpu.agent.http import HTTPApi, serve
 from consul_tpu.api import Client

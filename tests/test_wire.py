@@ -19,6 +19,13 @@ from consul_tpu.wire import (
     split_compound,
 )
 from consul_tpu.wire import lzw
+from consul_tpu.wire.keyring import HAVE_CRYPTOGRAPHY
+
+# AES-GCM paths need the optional 'cryptography' package; the framing,
+# codec, and compression paths must pass without it.
+needs_crypto = pytest.mark.skipif(
+    not HAVE_CRYPTOGRAPHY,
+    reason="requires the 'cryptography' package (AES-GCM)")
 
 
 def corpus():
@@ -122,6 +129,7 @@ class TestPacketPipeline:
         with pytest.raises(ValueError, match="CRC mismatch"):
             decode_packet(bytes(pkt))
 
+    @needs_crypto
     def test_encrypted_roundtrip(self):
         ring = Keyring(primary=os.urandom(16))
         pkt = encode_packet(self.MSGS, compress=True, keyring=ring)
@@ -134,12 +142,14 @@ class TestPacketPipeline:
         out = decode_packet(pkt, keyring=ring)
         assert out[1][1]["Node"] == "b"
 
+    @needs_crypto
     def test_plaintext_rejected_when_encrypting(self):
         ring = Keyring(primary=os.urandom(16))
         pkt = encode_packet(self.MSGS)
         with pytest.raises(ValueError, match="no installed key"):
             decode_packet(pkt, keyring=ring)
 
+    @needs_crypto
     def test_plaintext_accepted_without_verify_incoming(self):
         # GossipVerifyIncoming=false (net.go:315-321): an undecryptable
         # payload is processed as plaintext — the rotation window.
@@ -148,6 +158,7 @@ class TestPacketPipeline:
         out = decode_packet(pkt, keyring=ring, verify_incoming=False)
         assert out[0][1]["SeqNo"] == 1
 
+    @needs_crypto
     def test_wrong_key_fails(self):
         pkt = encode_packet(self.MSGS, keyring=Keyring(primary=os.urandom(16)))
         with pytest.raises(ValueError, match="no installed key"):
@@ -159,6 +170,7 @@ class TestStreamFraming:
     | ciphertext] with the header as AAD (net.go:878-900, :946-976) —
     distinct from the packet path, which has no marker byte."""
 
+    @needs_crypto
     def test_roundtrip(self):
         from consul_tpu.wire.codec import (decode_stream_frame,
                                            encode_stream_frame)
@@ -174,6 +186,7 @@ class TestStreamFraming:
         assert encode_stream_frame(b"x", None) == b"x"
         assert decode_stream_frame(b"x", None) == b"x"
 
+    @needs_crypto
     def test_expectation_enforced_both_ways(self):
         from consul_tpu.wire.codec import (decode_stream_frame,
                                            encode_stream_frame)
@@ -184,6 +197,7 @@ class TestStreamFraming:
         with pytest.raises(ValueError, match="not encrypted"):
             decode_stream_frame(b"plain", ring)
 
+    @needs_crypto
     def test_header_tamper_detected(self):
         from consul_tpu.wire.codec import (decode_stream_frame,
                                            encode_stream_frame)
@@ -195,6 +209,7 @@ class TestStreamFraming:
 
 
 class TestKeyring:
+    @needs_crypto
     def test_rotation_flow(self):
         # install -> use -> remove (serf/keymanager.go rotation).
         k1, k2 = os.urandom(16), os.urandom(32)
@@ -210,6 +225,7 @@ class TestKeyring:
         with pytest.raises(ValueError):
             ring.decrypt(pkt_old)
 
+    @needs_crypto
     def test_primary_cannot_be_removed(self):
         k = os.urandom(16)
         ring = Keyring(primary=k)
@@ -220,6 +236,7 @@ class TestKeyring:
         with pytest.raises(ValueError, match="key size"):
             Keyring(primary=b"short")
 
+    @needs_crypto
     def test_aad_binds_header(self):
         ring = Keyring(primary=os.urandom(16))
         pkt = ring.encrypt(b"msg", aad=b"header")
@@ -331,6 +348,7 @@ class TestGoldenFixtures:
         i = packed.index(b"Node") + 4
         assert packed[i] == 0xDA, f"str8/bin leaked: {packed[i]:#x}"
 
+    @needs_crypto
     def test_encrypted_packet_layout(self):
         # [vsn=1 | nonce(12) | ciphertext+tag(16)], no prefix byte, no
         # AAD (security.go:90-116 encryptPayload, net.go:697-708).
