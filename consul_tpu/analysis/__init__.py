@@ -1,0 +1,55 @@
+"""Trace-hygiene static analysis for the jitted gossip core.
+
+The paper's premise is that SWIM/serf/Vivaldi run as *one* compiled
+scan on device — every silent recompile, implicit host<->device
+transfer, or accidental dtype widening erodes the <60 s / 1M-node
+target. This package enforces the device/host tier boundary
+mechanically, in two layers:
+
+- **Static** (this module + :mod:`engine` / :mod:`rules` /
+  :mod:`callgraph`): an AST lint pass (stdlib ``ast``, no new deps)
+  over the device tier. Trace reachability is computed from the real
+  trace entry points (``jax.jit`` / ``lax.scan`` / ``shard_map`` /
+  ``vmap`` call sites), so "host sync inside traced code" means
+  *reachable from the jitted scan*, not "mentions numpy somewhere".
+  Findings carry file:line + rule id; exemptions live only in the
+  checked-in ``analysis/allowlist.toml`` with a mandatory reason.
+  Run it as ``consul-tpu lint`` (exit 1 on unallowlisted findings) or
+  through the tier-1 gate test (tests/test_analysis.py).
+
+- **Runtime** (:mod:`guards`, imported lazily — it needs jax, the
+  static layer does not): ``jax.transfer_guard`` wrappers and the
+  process-wide :class:`~consul_tpu.analysis.guards.CompileLedger`
+  built on ``jax.monitoring``, which the compile-count pins in
+  tests/test_counters.py, test_chaos.py and test_runtime.py share.
+
+Rule ids (one-line rationale per id in COVERAGE.md):
+
+==========  ==========================================================
+TH101       implicit scalar host sync (``.item()``/``.tolist()``/
+            ``int()``/``float()``/``bool()``) inside traced code
+TH102       host transfer API (``np.asarray``/``jax.device_get``/...)
+            inside traced code
+TH103       impure host stdlib (``time``/``random``/``datetime``) in a
+            device-tier module
+TH104       ``jnp`` array constructor without an explicit dtype in a
+            device-tier module
+TH105       swallowed exception (bare/broad ``except`` + ``pass``)
+            anywhere in the package
+TH106       mutable default argument anywhere in the package
+TH107       module-level mutable state read inside traced code
+==========  ==========================================================
+"""
+
+from consul_tpu.analysis.allowlist import (Allowlist, AllowlistError,
+                                           load_allowlist)
+from consul_tpu.analysis.engine import (Finding, LintReport,
+                                        default_allowlist_path,
+                                        lint_package, lint_sources)
+from consul_tpu.analysis.rules import RULES
+
+__all__ = [
+    "Allowlist", "AllowlistError", "Finding", "LintReport", "RULES",
+    "default_allowlist_path", "lint_package", "lint_sources",
+    "load_allowlist",
+]

@@ -1,0 +1,163 @@
+"""The checked-in lint allowlist: every exemption is reviewable.
+
+Format (``analysis/allowlist.toml``)::
+
+    [[allow]]
+    rule = "TH103"                          # required
+    path = "consul_tpu/models/cluster.py"   # required, repo-relative
+    symbol = "Simulation.run"               # optional: enclosing def
+    line = 123                              # optional: exact line pin
+    reason = "host-tier chunk timing"       # required, non-empty
+
+Matching prefers ``symbol`` over ``line`` — symbols survive line
+drift, so entries stay valid across unrelated edits. An entry that
+matches nothing is reported as *unused* and fails the tier-1 gate:
+the allowlist can only shrink or stay justified, never rot.
+
+Python 3.10 has no ``tomllib``, and the container must not grow deps,
+so this module carries a parser for exactly the TOML subset above
+(comments, ``[[allow]]`` table arrays, string/int/bool values). It
+delegates to ``tomllib`` when the interpreter provides it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional
+
+try:  # Python >= 3.11
+    import tomllib as _tomllib
+except ImportError:  # Python 3.10: the subset parser below
+    _tomllib = None
+
+
+class AllowlistError(ValueError):
+    """Malformed allowlist file (syntax or schema)."""
+
+
+@dataclasses.dataclass
+class AllowEntry:
+    rule: str
+    path: str
+    reason: str
+    symbol: Optional[str] = None
+    line: Optional[int] = None
+    hits: int = 0
+
+    def matches(self, finding) -> bool:
+        if self.rule != finding.rule or self.path != finding.path:
+            return False
+        if self.symbol is not None:
+            sym = finding.symbol
+            if sym != self.symbol and not sym.startswith(
+                    self.symbol + "."):
+                return False
+        if self.line is not None and self.line != finding.line:
+            return False
+        return True
+
+
+class Allowlist:
+    def __init__(self, entries: Iterable[AllowEntry]):
+        self.entries = list(entries)
+
+    def match(self, finding) -> Optional[AllowEntry]:
+        """First entry suppressing ``finding`` (marking it used)."""
+        for e in self.entries:
+            if e.matches(finding):
+                e.hits += 1
+                return e
+        return None
+
+    def unused(self) -> list:
+        return [e for e in self.entries if e.hits == 0]
+
+
+def load_allowlist(path: str) -> Allowlist:
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    return parse_allowlist(text, where=path)
+
+
+def parse_allowlist(text: str, where: str = "<allowlist>") -> Allowlist:
+    data = (_tomllib.loads(text) if _tomllib is not None
+            else _parse_toml_subset(text, where))
+    entries = []
+    for i, raw in enumerate(data.get("allow", [])):
+        if not isinstance(raw, dict):
+            raise AllowlistError(f"{where}: [[allow]] #{i + 1} is not "
+                                 "a table")
+        unknown = set(raw) - {"rule", "path", "symbol", "line", "reason"}
+        if unknown:
+            raise AllowlistError(
+                f"{where}: [[allow]] #{i + 1} has unknown keys "
+                f"{sorted(unknown)}")
+        for req in ("rule", "path", "reason"):
+            if not isinstance(raw.get(req), str) or not raw[req].strip():
+                raise AllowlistError(
+                    f"{where}: [[allow]] #{i + 1} needs a non-empty "
+                    f"string {req!r} — every exemption carries its "
+                    "justification")
+        line = raw.get("line")
+        if line is not None and not isinstance(line, int):
+            raise AllowlistError(
+                f"{where}: [[allow]] #{i + 1}: line must be an integer")
+        entries.append(AllowEntry(
+            rule=raw["rule"], path=raw["path"].replace("\\", "/"),
+            reason=raw["reason"], symbol=raw.get("symbol"), line=line))
+    return Allowlist(entries)
+
+
+def _parse_toml_subset(text: str, where: str) -> dict:
+    tables: list = []
+    current: Optional[dict] = None
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[allow]]":
+            current = {}
+            tables.append(current)
+            continue
+        if line.startswith("["):
+            raise AllowlistError(
+                f"{where}:{lineno}: only [[allow]] tables are "
+                f"supported, got {line!r}")
+        if "=" not in line:
+            raise AllowlistError(
+                f"{where}:{lineno}: expected 'key = value', got "
+                f"{line!r}")
+        if current is None:
+            raise AllowlistError(
+                f"{where}:{lineno}: key/value outside an [[allow]] "
+                "table")
+        key, _, value = line.partition("=")
+        current[key.strip()] = _parse_value(value.strip(), where, lineno)
+    return {"allow": tables}
+
+
+def _parse_value(value: str, where: str, lineno: int):
+    # strip trailing comments outside strings
+    if value.startswith(("\"", "'")):
+        quote = value[0]
+        end = value.find(quote, 1)
+        while end != -1 and value[end - 1] == "\\":
+            end = value.find(quote, end + 1)
+        if end == -1:
+            raise AllowlistError(
+                f"{where}:{lineno}: unterminated string")
+        tail = value[end + 1:].strip()
+        if tail and not tail.startswith("#"):
+            raise AllowlistError(
+                f"{where}:{lineno}: trailing junk after string: "
+                f"{tail!r}")
+        return value[1:end].replace("\\\"", "\"").replace("\\\\", "\\")
+    value = value.split("#", 1)[0].strip()
+    if value in ("true", "false"):
+        return value == "true"
+    try:
+        return int(value)
+    except ValueError:
+        raise AllowlistError(
+            f"{where}:{lineno}: unsupported value {value!r} (strings "
+            "must be quoted)") from None

@@ -1,0 +1,218 @@
+"""Trace reachability: which function defs run inside compiled code.
+
+Seeds are the real trace entry points — any function (or lambda, or
+``functools.partial`` of one) passed to ``jax.jit`` / ``jax.vmap`` /
+``jax.lax.scan`` / ``shard_map`` / friends — plus defs carrying an
+explicit ``# lint: traced`` pragma for the few hand-offs the static
+pass cannot follow (e.g. ``staticmethod`` driver hooks). From a seed,
+reachability propagates through every function *referenced* in a
+traced body (called directly, handed to ``partial``/``lax.cond``, or
+named as a parameter default that the body then calls), across module
+boundaries via the import maps.
+
+The result intentionally over-approximates a little (a function both
+traced and called on the host is treated as traced — its host uses
+must then also be hygienic) and under-approximates where Python gets
+too dynamic (``self.method`` dispatch); the pragma closes those gaps
+explicitly and reviewably.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet
+
+# Callables whose function-valued arguments enter tracing. Matched on
+# resolved dotted fqnames; the shard_map entries cover both the jax
+# spellings and this repo's version-portability shim.
+TRACE_WRAPPERS = frozenset({
+    "jax.jit", "jax.pmap", "jax.vmap", "jax.grad", "jax.value_and_grad",
+    "jax.checkpoint", "jax.remat", "jax.custom_jvp", "jax.custom_vjp",
+    "jax.lax.scan", "jax.lax.cond", "jax.lax.while_loop",
+    "jax.lax.fori_loop", "jax.lax.switch", "jax.lax.map",
+    "jax.lax.associative_scan", "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "consul_tpu.parallel.mesh.shard_map",
+    "jax.numpy.vectorize", "jax.named_call",
+})
+
+TRACED_PRAGMA = "lint: traced"
+HOST_PRAGMA = "lint: host"
+
+
+def _pragma_on_def(mod, node, pragma: str) -> bool:
+    line = getattr(node, "lineno", 0)
+    if 0 < line <= len(mod.lines):
+        return pragma in mod.lines[line - 1]
+    return False
+
+
+def _function_refs(mod, func_node, expr):
+    """Yield (fqname or local node) for every function reference inside
+    ``expr``: dotted paths resolving somewhere, lambdas, and partial
+    targets. Used for wrapper arguments."""
+    if isinstance(expr, ast.Lambda):
+        yield expr
+        return
+    if isinstance(expr, ast.Call):
+        fn = mod.resolve(expr.func, func_node)
+        if fn and fn.rsplit(".", 1)[-1] == "partial":
+            for a in expr.args:
+                yield from _function_refs(mod, func_node, a)
+            return
+    fq = mod.resolve(expr, func_node)
+    if fq is not None:
+        yield fq
+
+
+class _RefCollector(ast.NodeVisitor):
+    """Collect, inside one traced function's body, every reference that
+    could pull another function into the trace: dotted paths in call
+    position or argument position, parameter-default targets, nested
+    defs and lambdas that are referenced."""
+
+    def __init__(self, mod, func_node):
+        self.mod = mod
+        self.func_node = func_node
+        self.refs: set = set()          # fqname strings
+        self.local_nodes: set = set()   # nested def/lambda AST nodes
+        # parameter name -> default expression (followed when the
+        # parameter is referenced: `step_fn=swim.step_counted`)
+        self.param_defaults: dict = {}
+        args = getattr(func_node, "args", None)
+        if args is not None:
+            pos = args.posonlyargs + args.args
+            for a, d in zip(pos[len(pos) - len(args.defaults):],
+                            args.defaults):
+                self.param_defaults[a.arg] = d
+            for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                if d is not None:
+                    self.param_defaults[a.arg] = d
+
+    def _add_expr(self, expr):
+        if isinstance(expr, (ast.Lambda, ast.FunctionDef)):
+            self.local_nodes.add(id(expr))
+            return
+        fq = self.mod.resolve(expr, self.func_node)
+        if fq is not None:
+            self.refs.add(fq)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            if node.id in self.param_defaults:
+                self._add_expr(self.param_defaults[node.id])
+            else:
+                self._add_expr(node)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self._add_expr(node)
+            if self.mod.resolve(node, self.func_node) is not None:
+                # a resolved dotted path is handled as a whole; don't
+                # re-resolve its prefix (`swim` alone)
+                return
+        self.generic_visit(node)
+
+    def visit_Lambda(self, node):
+        self.local_nodes.add(id(node))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        # Nested defs inside a traced function are traced: they only
+        # exist to be closed over by the compiled program.
+        self.local_nodes.add(id(node))
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def traced_functions(modules) -> Dict[str, FrozenSet[int]]:
+    """Map module name -> frozenset of id(func node) for every
+    function/lambda definition reachable from a trace entry point."""
+    # fqname -> (module, func node) for every def in every module
+    def_index = {}
+    for m in modules:
+        for qual, node in m.functions.items():
+            def_index[f"{m.modname}.{qual}"] = (m, node)
+
+    # node-id keyed structures need the actual node; keep a lookup
+    node_by_id = {}
+    for m in modules:
+        for node in ast.walk(m.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                node_by_id[id(node)] = node
+
+    traced: dict = {m.modname: set() for m in modules}
+    work: list = []
+
+    def mark(mod, node):
+        if _pragma_on_def(mod, node, HOST_PRAGMA):
+            return  # explicitly host-tier: never traced
+        if id(node) not in traced[mod.modname]:
+            traced[mod.modname].add(id(node))
+            work.append((mod, node))
+
+    def mark_fq(fq: str):
+        hit = def_index.get(fq)
+        if hit is not None:
+            mark(*hit)
+
+    # -- seeds ----------------------------------------------------------
+    for m in modules:
+        for node in ast.walk(m.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _pragma_on_def(m, node, TRACED_PRAGMA):
+                mark(m, node)
+            if not isinstance(node, ast.Call):
+                continue
+            encl = _enclosing_function(m, node)
+            fq = m.resolve(node.func, encl)
+            if fq not in TRACE_WRAPPERS:
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                for ref in _function_refs(m, encl, arg):
+                    if isinstance(ref, str):
+                        mark_fq(ref)
+                    else:
+                        mark(m, ref)
+
+    # -- propagation ----------------------------------------------------
+    while work:
+        mod, node = work.pop()
+        coll = _RefCollector(mod, node if not isinstance(node, ast.Lambda)
+                             else _nearest_def(mod, node))
+        for child in ast.iter_child_nodes(node):
+            coll.visit(child)
+        for fq in coll.refs:
+            mark_fq(fq)
+        for nid in coll.local_nodes:
+            inner = node_by_id.get(nid)
+            if inner is not None:
+                mark(mod, inner)
+
+    return {name: frozenset(ids) for name, ids in traced.items()}
+
+
+def _enclosing_function(mod, node):
+    """The innermost def lexically containing ``node`` (None at module
+    level). Computed lazily via a parent walk over the module tree the
+    first time it is needed."""
+    parents = getattr(mod, "_parents", None)
+    if parents is None:
+        parents = {}
+        for parent in ast.walk(mod.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[id(child)] = parent
+        mod._parents = parents
+    cur = parents.get(id(node))
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = parents.get(id(cur))
+    return None
+
+
+def _nearest_def(mod, lam):
+    return _enclosing_function(mod, lam)

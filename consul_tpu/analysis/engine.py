@@ -1,0 +1,318 @@
+"""Lint engine: module indexing, name resolution, orchestration.
+
+The engine parses every module once into a :class:`ModuleIndex`
+(imports, function table, lexical nesting), hands the set to
+:mod:`callgraph` to compute which function definitions are reachable
+from a trace entry point, then runs the :mod:`rules` visitors with
+that reachability in hand. Everything is stdlib ``ast`` — linting
+never imports the code under analysis (and never imports jax), so
+``consul-tpu lint`` stays instant and safe to run anywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterable, Optional
+
+from consul_tpu.analysis import allowlist as allowlist_mod
+from consul_tpu.analysis import callgraph, rules
+
+# Directories (relative to the package) whose modules form the device
+# tier: code in them is presumed to build or run inside compiled
+# programs, so the module-scoped rules (TH103/TH104) apply everywhere
+# in them, not just in provably-traced functions.
+DEVICE_TIER_DIRS = ("models", "ops", "parallel", "chaos")
+
+PACKAGE = "consul_tpu"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation. ``symbol`` is the enclosing def's dotted
+    qualname ('' at module level) — the stable handle allowlist
+    entries use, so exemptions survive line drift."""
+
+    rule: str
+    path: str     # repo-relative, forward slashes
+    line: int
+    col: int
+    symbol: str
+    message: str
+
+    def format(self) -> str:
+        where = f" [{self.symbol}]" if self.symbol else ""
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule}{where}: {self.message}")
+
+
+@dataclasses.dataclass
+class LintReport:
+    """What one lint run produced, already split by the allowlist."""
+
+    findings: list          # unallowlisted Finding, file/line ordered
+    suppressed: list        # (Finding, entry) pairs the allowlist ate
+    unused_entries: list    # allowlist entries that matched nothing
+    n_files: int
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+class ModuleIndex:
+    """Everything the rules and the callgraph need to know about one
+    parsed module, computed in a single AST walk."""
+
+    def __init__(self, relpath: str, source: str, tree: ast.Module):
+        self.relpath = relpath
+        self.source = source
+        self.tree = tree
+        self.modname = _modname_of(relpath)
+        self.device_tier = _is_device_tier(relpath)
+        self.lines = source.splitlines()
+        # alias -> imported module fqname ("np" -> "numpy")
+        self.import_map: dict = {}
+        # local name -> "module.attr" fqname from `from m import a`
+        self.from_map: dict = {}
+        # dotted qualname -> FunctionDef/AsyncFunctionDef node
+        self.functions: dict = {}
+        # id(func node) -> qualname (includes lambdas, as "<lambda>")
+        self.qualname_of: dict = {}
+        # id(func node) -> id(enclosing func node) (lexical nesting)
+        self.parent_of: dict = {}
+        # id(func node) -> {local name: value AST} for simple
+        # `x = <expr>` statements in that function's immediate body
+        # (callgraph follows x when x is referenced from traced code)
+        self.local_bindings: dict = {}
+        # module-level names bound to mutable literals (TH107)
+        self.mutable_globals: set = set()
+        self._index()
+
+    def _index(self):
+        for node in self.tree.body:
+            _collect_mutable_global(node, self.mutable_globals)
+        self.local_bindings[None] = _simple_bindings(self.tree.body)
+        stack: list = []
+
+        def visit(node):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_map[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+                    if a.asname:
+                        self.import_map[a.asname] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    if a.name != "*":
+                        self.from_map[a.asname or a.name] = \
+                            f"{node.module}.{a.name}"
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                name = getattr(node, "name", "<lambda>")
+                qual = ".".join(
+                    [q for q, _ in stack] + [name])
+                self.qualname_of[id(node)] = qual
+                self.parent_of[id(node)] = id(stack[-1][1]) if stack \
+                    else None
+                if not isinstance(node, ast.Lambda):
+                    self.functions[qual] = node
+                    self.local_bindings[id(node)] = \
+                        _simple_bindings(node.body)
+                stack.append((name, node))
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                stack.pop()
+                return
+            if isinstance(node, ast.ClassDef):
+                stack.append((node.name, node))
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                stack.pop()
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for node in self.tree.body:
+            visit(node)
+
+    # -- name resolution ------------------------------------------------
+    def resolve(self, node, func_node=None) -> Optional[str]:
+        """Best-effort dotted fqname of a Name/Attribute expression
+        ("jnp.zeros" -> "jax.numpy.zeros", "swim.step_counted" ->
+        "consul_tpu.models.swim.step_counted"). ``func_node`` scopes
+        the lookup through enclosing functions' simple local bindings.
+        None when the expression isn't a static dotted path."""
+        parts = _dotted_parts(node)
+        if not parts:
+            return None
+        head, rest = parts[0], parts[1:]
+        base = self._resolve_head(head, func_node)
+        if base is None:
+            return None
+        return ".".join([base] + rest) if rest else base
+
+    def _resolve_head(self, head: str, func_node) -> Optional[str]:
+        # Walk lexically outward through simple local bindings first.
+        fid = id(func_node) if func_node is not None else None
+        while True:
+            bound = self.local_bindings.get(fid, {}).get(head)
+            if bound is not None:
+                inner = _dotted_parts(bound)
+                if inner and inner[0] != head:
+                    resolved = self.resolve(bound, None)
+                    if resolved:
+                        return resolved
+                return None  # bound to a non-path expression: opaque
+            if fid is None:
+                break
+            fid = self.parent_of.get(fid)
+        if head in self.from_map:
+            return self.from_map[head]
+        if head in self.import_map:
+            return self.import_map[head]
+        if head in self.functions:
+            return f"{self.modname}.{head}"
+        return None
+
+
+def _dotted_parts(node) -> Optional[list]:
+    parts: list = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    if isinstance(node, ast.Call):
+        # see through functools.partial(f, ...) to f
+        fn = _dotted_parts(node.func)
+        if fn and fn[-1] == "partial" and node.args:
+            return _dotted_parts(node.args[0])
+    return None
+
+
+def _simple_bindings(body) -> dict:
+    out = {}
+    for stmt in body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            out[stmt.targets[0].id] = stmt.value
+    return out
+
+
+def _collect_mutable_global(node, acc: set):
+    if isinstance(node, (ast.Assign, ast.AnnAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        value = node.value
+        if value is not None and _is_mutable_literal(value):
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    acc.add(t.id)
+
+
+def _is_mutable_literal(node) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in ("list", "dict", "set", "bytearray",
+                                "defaultdict", "deque")
+    return False
+
+
+def _modname_of(relpath: str) -> str:
+    mod = relpath.replace("\\", "/")
+    if mod.endswith(".py"):
+        mod = mod[:-3]
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    return mod.replace("/", ".")
+
+
+def _is_device_tier(relpath: str) -> bool:
+    parts = relpath.replace("\\", "/").split("/")
+    return (len(parts) >= 2 and parts[0] == PACKAGE
+            and parts[1] in DEVICE_TIER_DIRS)
+
+
+def default_allowlist_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "allowlist.toml")
+
+
+def _iter_py_files(paths: Iterable[str], root: str):
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full):
+            yield full
+        else:
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git"))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def lint_sources(sources: dict, allowlist=None) -> LintReport:
+    """Lint in-memory sources: {repo-relative path: source text}.
+    The unit tests drive this; ``lint_package`` is the on-disk
+    wrapper. ``allowlist`` is an :class:`Allowlist` or None."""
+    modules = []
+    findings = []
+    for relpath in sorted(sources):
+        src = sources[relpath]
+        try:
+            tree = ast.parse(src, filename=relpath)
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="TH000", path=relpath, line=e.lineno or 0,
+                col=e.offset or 0, symbol="",
+                message=f"syntax error: {e.msg}"))
+            continue
+        modules.append(ModuleIndex(relpath, src, tree))
+
+    traced = callgraph.traced_functions(modules)
+    for mod in modules:
+        findings.extend(rules.run_rules(mod, traced.get(mod.modname,
+                                                        frozenset())))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    if allowlist is None:
+        allowlist = allowlist_mod.Allowlist(())
+    kept, suppressed = [], []
+    for f in findings:
+        entry = allowlist.match(f)
+        if entry is None:
+            kept.append(f)
+        else:
+            suppressed.append((f, entry))
+    return LintReport(findings=kept, suppressed=suppressed,
+                      unused_entries=allowlist.unused(),
+                      n_files=len(sources))
+
+
+def lint_package(paths=(PACKAGE,), root: Optional[str] = None,
+                 allowlist_path: Optional[str] = None,
+                 use_allowlist: bool = True) -> LintReport:
+    """Lint on-disk trees. ``paths`` are files or directories relative
+    to ``root`` (default: the repo root inferred as the parent of this
+    package). The checked-in allowlist applies unless disabled."""
+    if root is None:
+        root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    sources = {}
+    for full in _iter_py_files(paths, root):
+        rel = os.path.relpath(full, root).replace(os.sep, "/")
+        with open(full, "r", encoding="utf-8") as f:
+            sources[rel] = f.read()
+    allowlist = None
+    if use_allowlist:
+        path = allowlist_path or default_allowlist_path()
+        if os.path.exists(path):
+            allowlist = allowlist_mod.load_allowlist(path)
+    return lint_sources(sources, allowlist)

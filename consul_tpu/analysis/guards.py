@@ -1,0 +1,113 @@
+"""Runtime guard rails for the compiled gossip core.
+
+Two teeth, both monkeypatch-free:
+
+- :class:`CompileLedger`: a process-wide compile counter built on
+  ``jax.monitoring``. XLA emits one
+  ``/jax/core/compile/backend_compile_duration`` event per executable
+  it actually builds (cache hits are silent), so the ledger sees every
+  compile in the process — jit, scan bodies, eager dispatch fallbacks
+  — without wrapping or patching anything. Tests pin steady-state
+  behaviour with ``ledger.expect(0)`` around a repeated call pattern;
+  a silent recompile (weak-type drift, shape leak, new donation
+  signature) fails loudly with the observed delta.
+
+- :func:`no_transfers`: ``jax.transfer_guard("disallow")`` scoped as a
+  context manager. Inside it, any *implicit* host<->device transfer —
+  a stray Python scalar entering an eager op, an un-jitted ``jnp``
+  constructor, a numpy argument to a jitted call — raises. Explicit
+  escapes (``jax.device_get`` / ``jax.device_put``) stay allowed,
+  which is exactly the tier discipline the lint rules prescribe: all
+  boundary crossings are spelled out, at the chunk boundary.
+
+This module needs jax and is therefore *not* imported by the static
+lint layer (``consul_tpu.analysis`` stays importable without jax).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+# The monitoring event XLA's compile path records once per executable
+# actually compiled (jax 0.4.x: pxla/dispatch both route through it).
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class CompileLedgerError(AssertionError):
+    """An ``expect()`` window saw a different number of compiles."""
+
+
+class CompileLedger:
+    """Process-wide compile counter.
+
+    One ``jax.monitoring`` listener is registered for the whole
+    process the first time a ledger is built; every instance reads the
+    same underlying counter, so ledgers are cheap handles, not
+    stateful subscriptions. Typical use::
+
+        led = CompileLedger()
+        sim.run(64)                 # warm every (chunk, metrics) shape
+        with led.expect(0):         # steady state: memo must hold
+            sim.run(64)
+    """
+
+    _lock = threading.Lock()
+    _count = 0
+    _registered = False
+
+    def __init__(self):
+        cls = type(self)
+        with cls._lock:
+            if not cls._registered:
+                jax.monitoring.register_event_duration_secs_listener(
+                    cls._on_event)
+                cls._registered = True
+
+    @classmethod
+    def _on_event(cls, event: str, duration: float, **kwargs):
+        if event == COMPILE_EVENT:
+            with cls._lock:
+                cls._count += 1
+
+    # -- reads ----------------------------------------------------------
+    @property
+    def total(self) -> int:
+        """Compiles observed process-wide since first registration."""
+        with type(self)._lock:
+            return type(self)._count
+
+    def snapshot(self) -> int:
+        return self.total
+
+    def delta(self, since: int) -> int:
+        return self.total - since
+
+    # -- the pin --------------------------------------------------------
+    @contextlib.contextmanager
+    def expect(self, n: int, what: str = ""):
+        """Assert exactly ``n`` compiles happen inside the block."""
+        start = self.total
+        yield self
+        got = self.delta(start)
+        if got != n:
+            label = f" ({what})" if what else ""
+            raise CompileLedgerError(
+                f"expected exactly {n} compile(s){label}, observed "
+                f"{got} — a cached executable was silently rebuilt "
+                "(or a new one traced) inside the pinned window")
+
+
+@contextlib.contextmanager
+def no_transfers():
+    """Forbid implicit host<->device transfers inside the block.
+
+    Explicit ``jax.device_get`` / ``jax.device_put`` still work —
+    the point is that every boundary crossing is *written down*.
+    Compile executables outside the block first: tracing constants is
+    legitimately transfer-heavy, steady-state execution must not be.
+    """
+    with jax.transfer_guard("disallow"):
+        yield

@@ -1,0 +1,370 @@
+"""The trace-hygiene rules. Each rule is one method on the visitor;
+``RULES`` documents every id for the CLI and COVERAGE.md.
+
+Scoping model: ``traced`` rules (TH101/TH102/TH107) fire only inside
+function definitions the callgraph proved reachable from a trace entry
+point — host-tier driver code in the same file is untouched.
+``device-module`` rules (TH103/TH104) fire anywhere in a device-tier
+module (models/ ops/ parallel/ chaos/). ``package`` rules
+(TH105/TH106) fire everywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+
+RULES = {
+    "TH101": "implicit scalar host sync in traced code — .item()/"
+             ".tolist()/int()/float()/bool() on a traced value blocks "
+             "the device stream and breaks the compiled scan",
+    "TH102": "host transfer API in traced code — np.asarray/np.array/"
+             "jax.device_get/device_put/block_until_ready inside a "
+             "jitted function forces a device round-trip per trace",
+    "TH103": "impure host stdlib (time/random/datetime) in a device-"
+             "tier module — wall clocks and host RNG are invisible to "
+             "XLA and silently freeze at trace time",
+    "TH104": "jnp array constructor without an explicit dtype in a "
+             "device-tier module — default promotion widens dtypes "
+             "and forks executables between platforms",
+    "TH105": "bare/broad except swallowing errors — a silent pass "
+             "hides device failures the sentinels exist to surface",
+    "TH106": "mutable default argument — shared mutable state leaks "
+             "across calls and across traces",
+    "TH107": "module-level mutable state read inside traced code — "
+             "the value is baked at trace time and silently goes "
+             "stale (or recompiles) when mutated",
+}
+
+# TH101: int()/float()/bool() arguments considered static (config
+# plumbing, shape math) — these never hold device values.
+_STATIC_ROOTS = frozenset({"cfg", "self", "len", "n", "k", "chunk"})
+
+# TH102: the host-boundary APIs that must not appear under a trace.
+_TRANSFER_CALLS = frozenset({
+    "numpy.asarray", "numpy.array", "numpy.frombuffer",
+    "numpy.ascontiguousarray", "numpy.copyto", "numpy.save",
+    "numpy.load", "jax.device_get", "jax.device_put",
+})
+_TRANSFER_METHODS = frozenset({"block_until_ready",
+                               "copy_to_host_async"})
+
+# TH103: host-impure stdlib modules banned from the device tier.
+_IMPURE_MODULES = frozenset({"time", "random", "datetime"})
+
+# TH104: jax.numpy constructors that take a dtype, with the positional
+# index the dtype may appear at.
+_DTYPE_CTORS = {
+    "jax.numpy.array": 1,
+    "jax.numpy.zeros": 1,
+    "jax.numpy.ones": 1,
+    "jax.numpy.empty": 1,
+    "jax.numpy.full": 2,
+    "jax.numpy.arange": 3,
+}
+
+_SCALAR_CASTS = frozenset({"int", "float", "bool"})
+
+
+def run_rules(mod, traced_ids) -> list:
+    v = _RuleVisitor(mod, traced_ids)
+    v.visit(mod.tree)
+    return v.findings
+
+
+class _RuleVisitor(ast.NodeVisitor):
+    def __init__(self, mod, traced_ids):
+        from consul_tpu.analysis.engine import Finding
+        self._Finding = Finding
+        self.mod = mod
+        self.traced_ids = traced_ids
+        self.findings: list = []
+        self._scope: list = []  # (qualname segment, is_traced)
+        # Depth of enclosing `with jax.ensure_compile_time_eval():`
+        # blocks — the canonical static-at-trace idiom. Host syncs in
+        # them run once at trace time, so TH101/TH102 stay quiet.
+        self._compile_time_depth = 0
+        # Names proven concrete by an `isinstance(x, jax.core.Tracer)`
+        # guard (the non-Tracer branch) — int(x) there is host math.
+        self._proven_static: set = set()
+
+    # -- helpers --------------------------------------------------------
+    def _emit(self, rule: str, node, message: str):
+        self.findings.append(self._Finding(
+            rule=rule, path=self.mod.relpath,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            symbol=self._symbol(), message=message))
+
+    def _symbol(self) -> str:
+        return ".".join(s for s, _ in self._scope)
+
+    def _in_trace(self) -> bool:
+        return any(t for _, t in self._scope)
+
+    # -- scope tracking -------------------------------------------------
+    def visit_FunctionDef(self, node):
+        self._check_defaults(node)
+        self._scope.append((node.name, id(node) in self.traced_ids))
+        for dec in node.decorator_list:
+            self.visit(dec)
+        self.visit(node.args)
+        self._visit_body(node.body)
+        self._scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._scope.append(("<lambda>", id(node) in self.traced_ids))
+        self.generic_visit(node)
+        self._scope.pop()
+
+    def visit_ClassDef(self, node):
+        self._scope.append((node.name, False))
+        self.generic_visit(node)
+        self._scope.pop()
+
+    # -- static-at-trace idioms the trace rules must respect ------------
+    def visit_With(self, node):
+        static = any(
+            isinstance(item.context_expr, ast.Call)
+            and self.mod.resolve(item.context_expr.func, None)
+            == "jax.ensure_compile_time_eval"
+            for item in node.items)
+        if static:
+            self._compile_time_depth += 1
+        self.generic_visit(node)
+        if static:
+            self._compile_time_depth -= 1
+
+    def visit_If(self, node):
+        kept = self._guarded_if(node)
+        if kept:
+            self._proven_static.discard(kept)
+
+    def _guarded_if(self, node):
+        """Visit an If statement. When its test is a Tracer guard and
+        the tracer branch terminates (early return/raise), returns the
+        guarded name so the caller can keep it proven-static for the
+        rest of the enclosing block; otherwise None."""
+        guarded = _tracer_guard_name(node.test, self.mod)
+        if guarded is None:
+            self.generic_visit(node)
+            return None
+        name, tracer_is_body = guarded
+        for test_child in ast.iter_child_nodes(node.test):
+            self.visit(test_child)
+        tracer_branch = node.body if tracer_is_body else node.orelse
+        static_branch = node.orelse if tracer_is_body else node.body
+        for stmt in tracer_branch:
+            self.visit(stmt)
+        added = name not in self._proven_static
+        if added:
+            self._proven_static.add(name)
+        for stmt in static_branch:
+            self.visit(stmt)
+        if added and tracer_is_body and _terminates(tracer_branch):
+            return name
+        if added:
+            self._proven_static.discard(name)
+        return None
+
+    def _visit_body(self, stmts):
+        """Visit a statement block, extending a Tracer guard's
+        proven-static scope past an early-returning guard:
+        ``if isinstance(x, Tracer): return dyn(x)`` makes ``x``
+        concrete for every following sibling statement."""
+        keep = []
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                kept = self._guarded_if(stmt)
+                if kept:
+                    keep.append(kept)
+            else:
+                self.visit(stmt)
+        for name in keep:
+            self._proven_static.discard(name)
+
+    # -- TH105: swallowed exceptions ------------------------------------
+    def visit_ExceptHandler(self, node):
+        broad = node.type is None or (
+            isinstance(node.type, ast.Name)
+            and node.type.id in ("Exception", "BaseException"))
+        silent = all(
+            isinstance(s, ast.Pass)
+            or (isinstance(s, ast.Expr)
+                and isinstance(s.value, ast.Constant)
+                and s.value.value is Ellipsis)
+            for s in node.body)
+        if broad and silent:
+            what = ast.unparse(node.type) if node.type else "bare"
+            self._emit("TH105", node,
+                       f"{what} except with a silent pass swallows "
+                       "errors — narrow the exception or handle it")
+        self.generic_visit(node)
+
+    # -- TH106: mutable defaults ----------------------------------------
+    def _check_defaults(self, node):
+        from consul_tpu.analysis.engine import _is_mutable_literal
+        args = node.args
+        for d in list(args.defaults) + [d for d in args.kw_defaults
+                                        if d is not None]:
+            if _is_mutable_literal(d):
+                self._emit("TH106", d,
+                           f"mutable default {ast.unparse(d)!r} is "
+                           "shared across calls — default to None")
+
+    # -- call-shaped rules ----------------------------------------------
+    def visit_Call(self, node):
+        fq = self.mod.resolve(node.func, None)
+        in_trace = self._in_trace()
+
+        if in_trace:
+            self._rule_th101(node, fq)
+            self._rule_th102(node, fq)
+        if self.mod.device_tier:
+            self._rule_th104(node, fq)
+        self.generic_visit(node)
+
+    def _rule_th101(self, node, fq):
+        if self._compile_time_depth:
+            return  # ensure_compile_time_eval: runs once, at trace time
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("item", "tolist") \
+                and not node.args:
+            self._emit("TH101", node,
+                       f".{node.func.attr}() forces a device->host "
+                       "sync inside traced code")
+            return
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in _SCALAR_CASTS and len(node.args) == 1:
+            arg = node.args[0]
+            if isinstance(arg, ast.Name) and arg.id in self._proven_static:
+                return  # isinstance(x, Tracer) guard proved x concrete
+            if not _is_static_expr(arg):
+                self._emit(
+                    "TH101", node,
+                    f"{node.func.id}({ast.unparse(arg)}) on a traced "
+                    "value host-syncs inside traced code — use "
+                    "jnp casts/astype instead")
+
+    def _rule_th102(self, node, fq):
+        if self._compile_time_depth:
+            return  # ensure_compile_time_eval: runs once, at trace time
+        if fq in _TRANSFER_CALLS:
+            self._emit("TH102", node,
+                       f"{fq} inside traced code forces a host "
+                       "round-trip per trace — keep transfers at the "
+                       "chunk boundary (jax.device_get on the host "
+                       "tier)")
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _TRANSFER_METHODS:
+            self._emit("TH102", node,
+                       f".{node.func.attr}() inside traced code "
+                       "blocks the device stream")
+
+    def _rule_th104(self, node, fq):
+        idx = _DTYPE_CTORS.get(fq)
+        if idx is None:
+            return
+        if any(k.arg == "dtype" for k in node.keywords):
+            return
+        if len(node.args) > idx:
+            return  # dtype passed positionally
+        name = fq.rsplit(".", 1)[-1]
+        self._emit("TH104", node,
+                   f"jnp.{name}(...) without an explicit dtype — "
+                   "default promotion differs across platforms; spell "
+                   "the dtype")
+
+    # -- TH103 / TH107: name-shaped rules -------------------------------
+    def visit_Attribute(self, node):
+        if self.mod.device_tier and isinstance(node.ctx, ast.Load):
+            parts = []
+            base = node
+            while isinstance(base, ast.Attribute):
+                parts.append(base.attr)
+                base = base.value
+            if isinstance(base, ast.Name):
+                root = self.mod.import_map.get(base.id)
+                if root in _IMPURE_MODULES:
+                    self._emit(
+                        "TH103", node,
+                        f"{root}.{'.'.join(reversed(parts))} in a "
+                        "device-tier module — host clocks/RNG freeze "
+                        "at trace time; thread ticks/keys through the "
+                        "state instead")
+        self.generic_visit(node)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            if self.mod.device_tier:
+                fq = self.mod.from_map.get(node.id)
+                if fq is not None and fq.split(".")[0] in _IMPURE_MODULES:
+                    self._emit(
+                        "TH103", node,
+                        f"{fq} in a device-tier module — host "
+                        "clocks/RNG freeze at trace time")
+            if self._in_trace() and node.id in self.mod.mutable_globals:
+                self._emit(
+                    "TH107", node,
+                    f"module-level mutable {node.id!r} read inside "
+                    "traced code — its contents bake into the "
+                    "executable at trace time")
+        self.generic_visit(node)
+
+
+def _terminates(stmts) -> bool:
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+def _tracer_guard_name(test, mod):
+    """Recognise ``isinstance(x, ...Tracer)`` (or its negation) as an
+    If test. Returns ``(name, tracer_branch_is_body)`` or None. In the
+    non-Tracer branch ``x`` is a concrete Python value, so ``int(x)``
+    there is plain host math, not a device sync."""
+    negated = False
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        test, negated = test.operand, True
+    if not (isinstance(test, ast.Call)
+            and isinstance(test.func, (ast.Name, ast.Attribute))
+            and len(test.args) == 2
+            and isinstance(test.args[0], ast.Name)):
+        return None
+    if mod.resolve(test.func, None) != "isinstance" and not (
+            isinstance(test.func, ast.Name)
+            and test.func.id == "isinstance"):
+        return None
+    cls = mod.resolve(test.args[1], None)
+    if cls is None or not cls.rsplit(".", 1)[-1].endswith("Tracer"):
+        return None
+    return test.args[0].id, not negated
+
+
+def _is_static_expr(node) -> bool:
+    """True when an int()/float()/bool() argument is clearly host-side
+    static: literals, len()/ord() results, config plumbing rooted at
+    cfg/self, UPPER_CASE constants, and arithmetic over those. These
+    shapes must NOT fire TH101 (the known false positives the tests
+    pin)."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Name):
+        return (node.id in _STATIC_ROOTS or node.id.isupper()
+                or node.id.startswith("n_"))
+    if isinstance(node, ast.Attribute):
+        base = node
+        while isinstance(base, ast.Attribute):
+            base = base.value
+        return isinstance(base, ast.Name) and base.id in _STATIC_ROOTS
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name) and node.func.id == "len":
+            return True  # len() is a Python int whatever the argument
+        return (isinstance(node.func, ast.Name)
+                and node.func.id in ("ord", "round", "min", "max")
+                and all(_is_static_expr(a) for a in node.args))
+    if isinstance(node, ast.BinOp):
+        return _is_static_expr(node.left) and _is_static_expr(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_expr(node.operand)
+    return False

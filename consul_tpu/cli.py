@@ -1093,6 +1093,21 @@ def build_parser() -> argparse.ArgumentParser:
     va = sub.add_parser("validate", help="validate a config file")
     va.add_argument("path")
 
+    ln = sub.add_parser(
+        "lint",
+        help="trace-hygiene static analysis over the device tier")
+    ln.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: the consul_tpu "
+                         "package)")
+    ln.add_argument("--allowlist", default=None,
+                    help="allowlist TOML (default: the checked-in "
+                         "analysis/allowlist.toml)")
+    ln.add_argument("--no-allowlist", action="store_true",
+                    help="report every finding, ignoring the allowlist")
+    ln.add_argument("--verbose", action="store_true",
+                    help="also print suppressed findings with the "
+                         "allowlist reason that ate each one")
+
     lk = sub.add_parser("lock", help="run a command under a KV lock")
     lk.add_argument("prefix")
     lk.add_argument("command")
@@ -1157,8 +1172,39 @@ def cmd_agent(args) -> int:
     return boot.run(args.config_file, overrides)
 
 
+def cmd_lint(args) -> int:
+    """Static trace-hygiene pass (consul_tpu/analysis). Pure stdlib
+    ast — no jax import, no agent, instant anywhere."""
+    from consul_tpu import analysis
+
+    try:
+        report = analysis.lint_package(
+            paths=tuple(args.paths) if args.paths else ("consul_tpu",),
+            allowlist_path=args.allowlist,
+            use_allowlist=not args.no_allowlist)
+    except analysis.AllowlistError as e:
+        print(f"allowlist error: {e}", file=sys.stderr)
+        return 2
+    for f in report.findings:
+        print(f.format())
+    if args.verbose:
+        for f, entry in report.suppressed:
+            print(f"allowed: {f.format()}  [{entry.reason}]")
+    for entry in report.unused_entries:
+        print(f"unused allowlist entry: {entry.rule} {entry.path}"
+              f"{' ' + entry.symbol if entry.symbol else ''} — remove "
+              f"it ({entry.reason})", file=sys.stderr)
+    ok = not report.findings and not report.unused_entries
+    print(f"{report.n_files} files: {len(report.findings)} finding(s), "
+          f"{len(report.suppressed)} allowlisted, "
+          f"{len(report.unused_entries)} unused entrie(s)")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.cmd == "lint":
+        return cmd_lint(args)
     if args.cmd == "agent":
         return cmd_agent(args)
     if args.cmd == "chaos":

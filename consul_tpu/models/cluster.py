@@ -154,7 +154,7 @@ def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
             h.agreement, h.false_positive, h.undetected, rmse)
 
     def run(world, sched, state, base_key):
-        ticks = swim_of(state).t + jnp.arange(chunk)
+        ticks = swim_of(state).t + jnp.arange(chunk, dtype=jnp.int32)
         tick_keys = jax.vmap(lambda t: jax.random.fold_in(base_key, t))(ticks)
         (state, cnt), trace = jax.lax.scan(
             functools.partial(body, world, sched),
@@ -259,7 +259,7 @@ class Simulation:
         self.sink.incr_counter("sim.sentinel.trips", 1)
         dump = None
         if self.sentinel_dump_dir:
-            t_now = int(self.swim_state.t)
+            t_now = int(jax.device_get(self.swim_state.t))
             dump = os.path.join(
                 self.sentinel_dump_dir, f"sentinel_diag_t{t_now}.ckpt")
             try:
@@ -294,7 +294,7 @@ class Simulation:
         if ticks is None:
             stops = [int(e.stop) for e in events]
             ticks = (max(stops) if stops else 0) + settle
-        t0 = int(self.swim_state.t)
+        t0 = int(jax.device_get(self.swim_state.t))
         prev = self.chaos
         self.set_chaos(chaos_mod.shift_schedule(sched, t0))
         before = dict(self.counters)
@@ -372,16 +372,31 @@ class Simulation:
         """A copy of :attr:`counters` safe to serialize (bench.py)."""
         return dict(self.counters)
 
-    def _flush_counters(self):
-        """One batched device→host transfer for every deferred chunk."""
-        if not self._pending_counters:
-            return
-        pending, self._pending_counters = self._pending_counters, []
-        vals = np.asarray(
-            jnp.stack([counters_mod.stack(c) for c in pending])
-        ).sum(axis=0)
-        self._fold_counter_deltas(
-            {f: int(v) for f, v in zip(counters_mod.FIELDS, vals)})
+    def _flush_counters(self, extra=None):
+        """One explicit batched device→host transfer for every deferred
+        chunk — plus, optionally, the current chunk's counters
+        (``extra``), whose deltas are returned *unfolded* for the
+        caller to record alongside its own telemetry. Batching through
+        a single ``jax.device_get`` keeps the throughput path at one
+        boundary crossing per flush (and, unlike stacking on device,
+        compiles no per-batch-length executables); the explicit API is
+        what keeps the whole loop legal under
+        ``jax.transfer_guard("disallow")``."""
+        stacks = [counters_mod.stack(c) for c in self._pending_counters]
+        n_pending = len(stacks)
+        if extra is not None:
+            stacks.append(counters_mod.stack(extra))
+        if not stacks:
+            return None
+        self._pending_counters = []
+        host = jax.device_get(stacks)
+        if n_pending:
+            vals = np.sum(np.stack(host[:n_pending]), axis=0)
+            self._fold_counter_deltas(
+                {f: int(v) for f, v in zip(counters_mod.FIELDS, vals)})
+        if extra is None:
+            return None
+        return {f: int(v) for f, v in zip(counters_mod.FIELDS, host[-1])}
 
     def _fold_counter_deltas(self, deltas):
         for f, v in deltas.items():
@@ -408,12 +423,10 @@ class Simulation:
             undetected=trace.undetected[-1],
             live_nodes=jnp.int32(0),
         )
-        self._flush_counters()
-        # The chunk's counter pytree lands in ONE [len(FIELDS)] i32
-        # fetch; the sink emission goes through emit_sim_metrics with
-        # everything else this chunk records.
-        vals = np.asarray(counters_mod.stack(cnt))
-        deltas = {f: int(v) for f, v in zip(counters_mod.FIELDS, vals)}
+        # Any deferred chunks and this chunk's counter pytree land in
+        # ONE device_get; the sink emission goes through
+        # emit_sim_metrics with everything else this chunk records.
+        deltas = self._flush_counters(extra=cnt)
         for f, v in deltas.items():
             self._counters[f] += v
         telemetry.emit_sim_metrics(

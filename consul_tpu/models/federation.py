@@ -195,7 +195,8 @@ class Federation:
                 def body(st, _):
                     k = jax.random.fold_in(base_key, st.lan.t[0])
                     return step(st, k), ()
-                return jax.lax.scan(body, state, jnp.arange(chunk))[0]
+                return jax.lax.scan(
+                    body, state, jnp.arange(chunk, dtype=jnp.int32))[0]
 
             self._runners[chunk] = jax.jit(run, donate_argnums=(0,))
         return self._runners[chunk]

@@ -134,7 +134,8 @@ def init(cfg: SimConfig, key) -> SimState:
         pending_col=jnp.full((n,), -1, jnp.int32),
         pending_fail_tick=jnp.zeros((n,), jnp.int32),
         pending_nack_miss=jnp.zeros((n,), jnp.int32),
-        view_key=jnp.full((n, k_deg), int(merge.make_key(1, merge.ALIVE)), jnp.uint32),
+        view_key=jnp.full((n, k_deg), merge.make_key_int(1, merge.ALIVE),
+                          jnp.uint32),
         susp_start=jnp.full((n, k_deg), -1, jnp.int32),
         susp_seen=jnp.zeros((n, k_deg), jnp.uint32),
         tx_left=jnp.zeros((n, k_deg), jnp.int32),

@@ -778,7 +778,9 @@ def _vivaldi_observe(cfg, state: SimState, ok, peer_col, rtt,
     row_buf = jnp.sum(
         jnp.where(col_oh[:, :, None], lat_buf, 0.0), axis=1
     )  # [N, S] — exclusive one-hot, no gather
-    padded = jnp.where(jnp.arange(s)[None, :] < filled[:, None], row_buf, jnp.inf)
+    padded = jnp.where(
+        jnp.arange(s, dtype=jnp.int32)[None, :] < filled[:, None],
+        row_buf, jnp.inf)
     med = _take_col(jnp.sort(padded, axis=1), filled // 2)
     # Vivaldi update; rejected (rtt=-1) rows pass through untouched. The
     # coincident-point fallback directions are drawn here — this layer

@@ -88,7 +88,7 @@ def demote_dead_to_suspect(key):
 # Host-side scalar versions of the key algebra (plain ints, no device
 # dispatch) — for the transport bridge and other per-fact host loops.
 
-def make_key_int(incarnation: int, status: int) -> int:
+def make_key_int(incarnation: int, status: int) -> int:  # lint: host
     return (int(incarnation) << _STATUS_BITS) | int(status)
 
 

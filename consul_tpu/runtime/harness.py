@@ -27,6 +27,8 @@ import dataclasses
 import os
 from typing import Any, Optional, Sequence
 
+import jax
+
 from consul_tpu.chaos import schedule as chaos_mod
 from consul_tpu.models import counters as counters_mod
 from consul_tpu.models.cluster import SLO_KEYS
@@ -102,7 +104,7 @@ def run_resilient(sim, ticks: int, *, chunk: int = 64,
     sched = (chaos_mod.compile_schedule(sim.cfg.n, events)
              if events else None)
     sched_digest = chaos_mod.digest_of(sched)
-    t0 = int(sim.swim_state.t)
+    t0 = int(jax.device_get(sim.swim_state.t))
     done = 0
 
     if policy is not None and policy.trap is None:

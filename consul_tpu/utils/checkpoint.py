@@ -51,7 +51,10 @@ def _leaf_names(tree: Any) -> list[str]:
 def _host_leaves(state: Any) -> list[np.ndarray]:
     out = []
     for leaf in jax.tree.leaves(state):
-        arr = np.asarray(leaf)
+        # device_get is the explicit boundary crossing (legal under
+        # transfer_guard "disallow"); asarray then only normalizes
+        # host scalars.
+        arr = np.asarray(jax.device_get(leaf))
         if not arr.flags.c_contiguous:
             # ascontiguousarray promotes 0-d to 1-d; restore the shape.
             arr = np.ascontiguousarray(arr).reshape(arr.shape)

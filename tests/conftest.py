@@ -21,9 +21,25 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+
+@pytest.fixture
+def compile_ledger():
+    """The shared compile-count pin (consul_tpu/analysis/guards.py).
+
+    One process-wide jax.monitoring listener counts every executable
+    XLA actually builds; tests wrap steady-state call patterns in
+    ``ledger.expect(0)`` (or ``expect(k)`` for deliberate retraces) so
+    silent recompiles fail with the observed delta instead of passing
+    quietly. Instances are cheap handles over one global counter.
+    """
+    from consul_tpu.analysis.guards import CompileLedger
+
+    return CompileLedger()
 
 
 def pytest_configure(config):

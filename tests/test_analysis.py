@@ -1,0 +1,492 @@
+"""Trace-hygiene analyzer (consul_tpu/analysis): per-rule true
+positives, the false-positive shapes each rule must NOT fire on
+(ensure_compile_time_eval blocks, isinstance-Tracer guards, host-tier
+drivers, positional dtypes), trace reachability across modules, the
+allowlist round-trip (suppression, unused detection, schema errors),
+the CLI exit codes, the CompileLedger, and — the tier-1 gate — the
+real package linting clean against the checked-in allowlist."""
+
+import textwrap
+
+import pytest
+
+from consul_tpu import analysis
+from consul_tpu.analysis.allowlist import parse_allowlist
+from consul_tpu.cli import main as cli_main
+
+# Synthetic modules land under these paths so the device-tier rules
+# (TH103/TH104) and trace rules see them the same way the real tree
+# is seen.
+DEV = "consul_tpu/models/fake.py"
+DEV2 = "consul_tpu/ops/fake2.py"
+HOST = "consul_tpu/agent/fake.py"
+
+
+def _lint(files, allowlist=None):
+    srcs = {p: textwrap.dedent(s) for p, s in files.items()}
+    return analysis.lint_sources(srcs, allowlist)
+
+
+def _rules(report):
+    return [f.rule for f in report.findings]
+
+
+# ----------------------------------------------------------------------
+# TH101: scalar host syncs inside traced code
+# ----------------------------------------------------------------------
+
+class TestTH101:
+    def test_item_and_int_in_jitted_fn(self):
+        rep = _lint({DEV: """
+            import jax
+
+            def step(x):
+                y = x.item()
+                z = int(x)
+                return y + z
+
+            run = jax.jit(step)
+        """})
+        assert _rules(rep) == ["TH101", "TH101"]
+        assert all(f.symbol == "step" for f in rep.findings)
+        assert rep.findings[0].line == 5
+
+    def test_scan_body_reached_through_partial(self):
+        rep = _lint({DEV: """
+            import functools
+            import jax
+
+            def body(cfg, carry, t):
+                bad = float(carry)
+                return carry, bad
+
+            def run(cfg, xs):
+                return jax.lax.scan(functools.partial(body, cfg), 0, xs)
+        """})
+        assert _rules(rep) == ["TH101"]
+        assert rep.findings[0].symbol == "body"
+
+    def test_untraced_host_function_is_silent(self):
+        # Same calls, but nothing hands `step` to a trace wrapper.
+        rep = _lint({DEV: """
+            def step(x):
+                return int(x) + x.item()
+        """})
+        assert rep.clean
+
+    def test_static_config_plumbing_is_silent(self):
+        rep = _lint({DEV: """
+            import jax
+
+            def step(cfg, x):
+                n = int(cfg.n_nodes)
+                k = int(len(x.shape) + N_ROUNDS)
+                return x * n * k
+
+            run = jax.jit(step)
+        """})
+        assert rep.clean
+
+    def test_ensure_compile_time_eval_is_silent(self):
+        # The canonical static-at-trace idiom (swim.py, state.py).
+        rep = _lint({DEV: """
+            import jax
+
+            def step(x):
+                with jax.ensure_compile_time_eval():
+                    lo = int(x.shape[0] * scale())
+                return x + lo
+
+            run = jax.jit(step)
+        """})
+        assert rep.clean
+
+    def test_isinstance_tracer_guard_is_silent(self):
+        # collective.roll: int(shift) only on the concrete branch.
+        rep = _lint({DEV: """
+            import jax
+
+            def roll(x, shift):
+                if isinstance(shift, jax.core.Tracer):
+                    return dynamic_roll(x, shift)
+                return static_roll(x, int(shift))
+
+            run = jax.jit(roll)
+        """})
+        assert rep.clean
+
+    def test_tracer_branch_itself_still_fires(self):
+        rep = _lint({DEV: """
+            import jax
+
+            def roll(x, shift):
+                if isinstance(shift, jax.core.Tracer):
+                    return static_roll(x, int(shift))
+                return static_roll(x, int(shift))
+
+            run = jax.jit(roll)
+        """})
+        # Only the Tracer branch's int() is a sync.
+        assert _rules(rep) == ["TH101"]
+        assert rep.findings[0].line == 6
+
+
+# ----------------------------------------------------------------------
+# TH102: transfer APIs inside traced code
+# ----------------------------------------------------------------------
+
+class TestTH102:
+    def test_np_asarray_and_device_get(self):
+        rep = _lint({DEV: """
+            import jax
+            import numpy as np
+
+            def step(x):
+                host = np.asarray(x)
+                also = jax.device_get(x)
+                x.block_until_ready()
+                return host, also
+
+            run = jax.jit(step)
+        """})
+        assert _rules(rep) == ["TH102", "TH102", "TH102"]
+
+    def test_host_tier_driver_is_silent(self):
+        # The chunk-boundary device_get in the un-traced driver is the
+        # *prescribed* pattern — it must not fire.
+        rep = _lint({DEV: """
+            import jax
+
+            def flush(pending):
+                return jax.device_get(pending)
+        """})
+        assert rep.clean
+
+
+# ----------------------------------------------------------------------
+# TH103: impure host stdlib in device-tier modules
+# ----------------------------------------------------------------------
+
+class TestTH103:
+    def test_time_random_datetime(self):
+        rep = _lint({DEV: """
+            import random
+            import time
+            from datetime import datetime
+
+            def jitter():
+                return time.monotonic() + random.random()
+
+            def stamp():
+                return datetime.now()
+        """})
+        assert sorted(_rules(rep)) == ["TH103", "TH103", "TH103"]
+
+    def test_host_tier_module_is_silent(self):
+        rep = _lint({HOST: """
+            import time
+
+            def backoff():
+                return time.monotonic()
+        """})
+        assert rep.clean
+
+
+# ----------------------------------------------------------------------
+# TH104: dtype-less jnp constructors in device-tier modules
+# ----------------------------------------------------------------------
+
+class TestTH104:
+    def test_missing_dtype_fires(self):
+        rep = _lint({DEV: """
+            import jax.numpy as jnp
+
+            def init(n):
+                return jnp.zeros((n,)), jnp.arange(n), jnp.full((n,), 3)
+        """})
+        assert _rules(rep) == ["TH104", "TH104", "TH104"]
+
+    def test_keyword_and_positional_dtype_are_silent(self):
+        rep = _lint({DEV: """
+            import jax.numpy as jnp
+
+            def init(n):
+                a = jnp.zeros((n,), jnp.int32)        # positional
+                b = jnp.arange(n, dtype=jnp.int32)    # keyword
+                c = jnp.full((n,), 3, jnp.uint32)
+                return a, b, c
+        """})
+        assert rep.clean
+
+    def test_host_tier_module_is_silent(self):
+        rep = _lint({HOST: """
+            import jax.numpy as jnp
+
+            def pad(n):
+                return jnp.zeros((n,))
+        """})
+        assert rep.clean
+
+
+# ----------------------------------------------------------------------
+# TH105 / TH106 / TH107: package-wide hygiene
+# ----------------------------------------------------------------------
+
+class TestPackageRules:
+    def test_th105_swallowed_exception(self):
+        rep = _lint({HOST: """
+            def close(sock):
+                try:
+                    sock.close()
+                except Exception:
+                    pass
+                try:
+                    sock.shutdown()
+                except OSError:
+                    pass
+        """})
+        # Broad except+pass fires; the narrowed OSError one does not.
+        assert _rules(rep) == ["TH105"]
+
+    def test_th106_mutable_default(self):
+        rep = _lint({HOST: """
+            def register(name, tags=[], meta={}):
+                return name, tags, meta
+
+            def ok(name, tags=None, n=3):
+                return name, tags, n
+        """})
+        assert _rules(rep) == ["TH106", "TH106"]
+
+    def test_th107_mutable_global_read_in_trace(self):
+        rep = _lint({DEV: """
+            import jax
+
+            _TABLE = {}
+
+            def step(x):
+                return x + _TABLE["bias"]
+
+            def host_read():
+                return _TABLE.get("bias")
+
+            run = jax.jit(step)
+        """})
+        # Traced read fires; the host-tier read of the same global is
+        # legitimate driver state.
+        assert _rules(rep) == ["TH107"]
+        assert rep.findings[0].symbol == "step"
+
+
+# ----------------------------------------------------------------------
+# callgraph: reachability across modules and hand-off shapes
+# ----------------------------------------------------------------------
+
+class TestCallgraph:
+    def test_cross_module_default_step_fn(self):
+        # cluster.py's shape: the traced runner defaults step_fn to a
+        # function from another module; its body must become traced.
+        rep = _lint({
+            DEV: """
+                import jax
+                from consul_tpu.ops import fake2
+
+                def run(state, xs, step_fn=fake2.step):
+                    def body(c, t):
+                        return step_fn(c), ()
+                    return jax.lax.scan(body, state, xs)
+
+                jitted = jax.jit(run)
+            """,
+            DEV2: """
+                def step(c):
+                    return int(c)
+            """,
+        })
+        assert _rules(rep) == ["TH101"]
+        assert rep.findings[0].path == DEV2
+
+    def test_lambda_handed_to_vmap(self):
+        rep = _lint({DEV: """
+            import jax
+
+            keys = jax.vmap(lambda t: int(t))
+        """})
+        assert _rules(rep) == ["TH101"]
+
+    def test_host_pragma_stops_tracing(self):
+        rep = _lint({DEV: """
+            import jax
+
+            def helper(x):  # lint: host
+                return int(x)
+
+            def step(c, t):
+                return helper(c), ()
+
+            def run(state, xs):
+                return jax.lax.scan(step, state, xs)
+
+            jitted = jax.jit(run)
+        """})
+        assert rep.clean
+
+    def test_traced_pragma_forces_tracing(self):
+        rep = _lint({DEV: """
+            def dynamic_hook(x):  # lint: traced
+                return int(x)
+        """})
+        assert _rules(rep) == ["TH101"]
+
+
+# ----------------------------------------------------------------------
+# allowlist: round-trip, unused detection, schema enforcement
+# ----------------------------------------------------------------------
+
+BAD_SRC = {DEV: """
+    import jax
+
+    def step(x):
+        return int(x)
+
+    run = jax.jit(step)
+"""}
+
+
+class TestAllowlist:
+    def test_suppression_round_trip(self):
+        al = parse_allowlist("""
+            [[allow]]
+            rule = "TH101"
+            path = "consul_tpu/models/fake.py"
+            symbol = "step"
+            reason = "test fixture"
+        """)
+        rep = _lint(BAD_SRC, al)
+        assert rep.clean
+        assert len(rep.suppressed) == 1
+        finding, entry = rep.suppressed[0]
+        assert finding.rule == "TH101" and entry.reason == "test fixture"
+        assert rep.unused_entries == []
+
+    def test_wrong_symbol_does_not_suppress_and_reports_unused(self):
+        al = parse_allowlist("""
+            [[allow]]
+            rule = "TH101"
+            path = "consul_tpu/models/fake.py"
+            symbol = "other_fn"
+            reason = "stale entry"
+        """)
+        rep = _lint(BAD_SRC, al)
+        assert _rules(rep) == ["TH101"]
+        assert len(rep.unused_entries) == 1
+
+    def test_line_pin_and_symbol_prefix(self):
+        al = parse_allowlist("""
+            [[allow]]
+            rule = "TH101"
+            path = "consul_tpu/models/fake.py"
+            line = 5
+            reason = "line-pinned"
+        """)
+        rep = _lint(BAD_SRC, al)
+        assert rep.clean and len(rep.suppressed) == 1
+
+    def test_schema_requires_reason(self):
+        with pytest.raises(analysis.AllowlistError,
+                           match="justification"):
+            parse_allowlist("""
+                [[allow]]
+                rule = "TH101"
+                path = "consul_tpu/models/fake.py"
+            """)
+
+    def test_schema_rejects_unknown_keys(self):
+        with pytest.raises(analysis.AllowlistError, match="unknown"):
+            parse_allowlist("""
+                [[allow]]
+                rule = "TH101"
+                path = "p.py"
+                reason = "r"
+                because = "typo'd key"
+            """)
+
+    def test_subset_parser_syntax_errors(self):
+        for bad in ("rule = \"x\"",              # kv outside a table
+                    "[allow]",                   # wrong table syntax
+                    "[[allow]]\nrule = unquoted"):
+            with pytest.raises(analysis.AllowlistError):
+                parse_allowlist(bad)
+
+
+# ----------------------------------------------------------------------
+# CLI: exit codes, in process
+# ----------------------------------------------------------------------
+
+class TestCLI:
+    def test_lint_clean_package_exits_zero(self, capsys):
+        assert cli_main(["lint"]) == 0
+        out = capsys.readouterr().out
+        assert "0 finding(s)" in out
+
+    def test_lint_no_allowlist_exits_one(self, capsys):
+        # The intentional (allowlisted) sites exist, so the raw pass
+        # must fail — proving exit 1 actually has teeth.
+        assert cli_main(["lint", "--no-allowlist"]) == 1
+        out = capsys.readouterr().out
+        assert "TH10" in out
+
+    def test_lint_verbose_prints_reasons(self, capsys):
+        assert cli_main(["lint", "--verbose"]) == 0
+        assert "allowed:" in capsys.readouterr().out
+
+
+# ----------------------------------------------------------------------
+# CompileLedger (needs jax — the one runtime-layer suite here)
+# ----------------------------------------------------------------------
+
+class TestCompileLedger:
+    def test_expect_counts_and_raises(self, compile_ledger):
+        import jax
+        import jax.numpy as jnp
+
+        from consul_tpu.analysis.guards import CompileLedgerError
+
+        f = jax.jit(lambda x: x * 2 + 1)
+        xi = jnp.zeros((16,), jnp.int32)
+        xf = jnp.zeros((16,), jnp.float32)
+        f(xi).block_until_ready()  # warm (arange/zeros compile too)
+        with compile_ledger.expect(0, "cache hit"):
+            f(xi).block_until_ready()
+        with pytest.raises(CompileLedgerError, match="expected exactly 0"):
+            with compile_ledger.expect(0):
+                f(xf).block_until_ready()  # new dtype: silent retrace
+
+    def test_ledgers_share_one_counter(self, compile_ledger):
+        from consul_tpu.analysis.guards import CompileLedger
+
+        assert CompileLedger().total == compile_ledger.total
+
+
+# ----------------------------------------------------------------------
+# the tier-1 gate: the real package is clean
+# ----------------------------------------------------------------------
+
+class TestPackageGate:
+    def test_package_has_no_unallowlisted_findings(self):
+        rep = analysis.lint_package()
+        msgs = "\n".join(f.format() for f in rep.findings)
+        assert rep.clean, f"unallowlisted trace-hygiene findings:\n{msgs}"
+
+    def test_allowlist_has_no_dead_entries(self):
+        rep = analysis.lint_package()
+        dead = "\n".join(f"{e.rule} {e.path} {e.symbol}: {e.reason}"
+                         for e in rep.unused_entries)
+        assert not rep.unused_entries, f"unused allowlist entries:\n{dead}"
+
+    def test_every_rule_id_is_documented(self):
+        assert set(analysis.RULES) == {
+            "TH101", "TH102", "TH103", "TH104", "TH105", "TH106",
+            "TH107"}
+        for rid, rationale in analysis.RULES.items():
+            assert rationale.strip(), rid

@@ -274,23 +274,32 @@ class TestPartitionHeal:
             "fault_ticks", "time_to_first_suspect", "time_to_confirm",
             "time_to_heal", "false_positive_deaths", "messages_dropped"}
 
-    def test_compile_pin(self):
+    def test_compile_pin(self, compile_ledger):
         """Chaos adds at most one executable per (chunk, flags)
         signature: a second same-shape scenario with different values
         recompiles nothing, and post-scenario empty runs reuse the
-        original executables."""
+        original executables. The ledger pins the whole process, so
+        eager helpers (schedule shifting, counter flushes) are covered
+        too, not just the runner memo."""
         from consul_tpu.models import cluster as cluster_mod
 
         sim, _ = _healed_sim()
         n_programs = len(cluster_mod._RUNNER_CACHE)
-        # Same-shape schedule, different values: zero new programs.
+        # Warm the scenario shape once (first run of this schedule
+        # shape may compile eager schedule/flush helpers)...
         sim.run_scenario(
             [chaos.Partition(start=3, stop=11, side_a=slice(100, 500))],
             ticks=32, chunk=32)
         assert len(cluster_mod._RUNNER_CACHE) == n_programs
-        # Empty-schedule runs reuse the schedule-free program compiled
-        # during formation (chaos_key=None memo hit).
-        sim.run(32, chunk=32, with_metrics=False)
+        # ...then a same-shape, different-values repeat must be
+        # compile-free process-wide, as must empty-schedule runs
+        # (chaos_key=None memo hit on the formation program).
+        with compile_ledger.expect(0, "same-shape scenario repeat"):
+            sim.run_scenario(
+                [chaos.Partition(start=5, stop=13,
+                                 side_a=slice(200, 600))],
+                ticks=32, chunk=32)
+            sim.run(32, chunk=32, with_metrics=False)
         assert len(cluster_mod._RUNNER_CACHE) == n_programs
         for runner in sim._runners.values():
             assert runner._cache_size() == 1

@@ -122,23 +122,31 @@ class TestFaultResponse:
 
 
 class TestCompileCount:
-    def test_one_compile_per_signature(self):
+    def test_one_compile_per_signature(self, compile_ledger):
         """Counters ride the existing programs: one XLA compile per
         (chunk, with_metrics) signature, and fault injection (kill /
-        revive change state values, not shapes) adds none."""
+        revive change state values, not shapes) adds none. The ledger
+        pins the whole process — eager dispatch fallbacks included —
+        not just the runner memo."""
         sim = Simulation(SimConfig(n=128, view_degree=16), seed=0)
+        # Warm pass: every signature (and the fault-injection eager
+        # ops) compiles here, exactly once.
         sim.run(64, chunk=32, with_metrics=False)
-        sim.run(32, chunk=32, with_metrics=False)
         sim.kill(jnp.arange(128) < 13)
         sim.run(32, chunk=32, with_metrics=False)
         sim.revive(jnp.arange(128) < 13)
-        sim.run(32, chunk=32, with_metrics=False)
         sim.run(32, chunk=32, with_metrics=True)
-        assert set(sim._runners) == {(32, False), (32, True)}
-        for key, runner in sim._runners.items():
-            assert runner._cache_size() == 1, key
-        # Reading counters costs no compiles either.
         sim.counters_snapshot()
+        # Steady state: the same pattern again is compile-free.
+        with compile_ledger.expect(0, "steady-state repeat"):
+            sim.run(32, chunk=32, with_metrics=False)
+            sim.kill(jnp.arange(128) < 13)
+            sim.run(32, chunk=32, with_metrics=False)
+            sim.revive(jnp.arange(128) < 13)
+            sim.run(32, chunk=32, with_metrics=True)
+            # Reading counters costs no compiles either.
+            sim.counters_snapshot()
+        assert set(sim._runners) == {(32, False), (32, True)}
         for key, runner in sim._runners.items():
             assert runner._cache_size() == 1, key
 

@@ -347,21 +347,26 @@ class TestSentinels:
         assert _identical(a.state, b.state)
         assert a.counters == b.counters
 
-    def test_compile_count_pin(self):
+    def test_compile_count_pin(self, compile_ledger):
         """Toggling sentinels costs exactly one extra executable; with
         them off, zero (the validator must DCE to the existing
-        program)."""
+        program). The ledger asserts the exact process-wide compile
+        deltas, not just the memo-cache size."""
         sim = _sim(n=64)
         sim.run(16, chunk=16, with_metrics=False)
+        sim.counters_snapshot()  # warm the counter-flush eager ops
         n0 = len(cluster_mod._RUNNER_CACHE)
         sim2 = _sim(n=64)
-        sim2.run(16, chunk=16, with_metrics=False)
+        with compile_ledger.expect(0, "sentinels off: memo hit"):
+            sim2.run(16, chunk=16, with_metrics=False)
         assert len(cluster_mod._RUNNER_CACHE) == n0  # off: zero extra
         sim2.set_sentinel(True)
-        sim2.run(16, chunk=16, with_metrics=False)
+        with compile_ledger.expect(1, "sentinels on: one new program"):
+            sim2.run(16, chunk=16, with_metrics=False)
         assert len(cluster_mod._RUNNER_CACHE) == n0 + 1  # on: exactly one
         sim2.set_sentinel(False)
-        sim2.run(16, chunk=16, with_metrics=False)
+        with compile_ledger.expect(0, "sentinels back off: memo reused"):
+            sim2.run(16, chunk=16, with_metrics=False)
         assert len(cluster_mod._RUNNER_CACHE) == n0 + 1  # memo reused
 
     def _trip(self, sim, field, chunk=16, ticks=32):
@@ -584,3 +589,28 @@ class TestCliKillResume:
         if proc.returncode in (-signal.SIGKILL,):
             assert out["resumed_from_tick"] > 0
         assert not ck.exists()
+
+
+class TestTransferDiscipline:
+    def test_warmed_chunk_loop_is_transfer_clean(self, compile_ledger):
+        """A warmed steady-state run_resilient loop executes a full
+        chunked trajectory under jax.transfer_guard("disallow"):
+        every host<->device crossing in the chunk loop is explicit
+        (jax.device_get at the chunk boundary), so nothing implicit —
+        stray Python scalars, numpy args, eager constants — can sneak
+        into the hot path. Compiles are pinned to zero in the same
+        window: tracing is the one phase allowed to move constants,
+        and it must all have happened during the warm pass."""
+        from consul_tpu.analysis.guards import no_transfers
+
+        sim = _sim(n=64)
+        # Warm pass: compiles the chunk program and the counter-flush
+        # ops; tracing legitimately bakes host constants into the
+        # executable, so it stays outside the guard.
+        rt.run_resilient(sim, 32, chunk=16)
+        sim.counters_snapshot()
+        with no_transfers(), compile_ledger.expect(0, "guarded loop"):
+            report = rt.run_resilient(sim, 32, chunk=16)
+            _ = sim.counters_snapshot()
+        assert report.ticks_done == 32
+        assert not report.preempted
