@@ -38,6 +38,8 @@ TH105       swallowed exception (bare/broad ``except`` + ``pass``)
             anywhere in the package
 TH106       mutable default argument anywhere in the package
 TH107       module-level mutable state read inside traced code
+TH108       host-tier retry loop with a bare constant ``time.sleep``
+            and no bound/backoff anywhere in the package
 ==========  ==========================================================
 """
 

@@ -33,6 +33,10 @@ RULES = {
     "TH107": "module-level mutable state read inside traced code — "
              "the value is baked at trace time and silently goes "
              "stale (or recompiles) when mutated",
+    "TH108": "host-tier retry loop with a bare constant time.sleep "
+             "and no bound/backoff — an unbounded while around a "
+             "fixed sleep spins forever on a wedged dependency and "
+             "synchronizes retry storms across workers",
 }
 
 # TH101: int()/float()/bool() arguments considered static (config
@@ -276,6 +280,43 @@ class _RuleVisitor(ast.NodeVisitor):
                    "default promotion differs across platforms; spell "
                    "the dtype")
 
+    # -- TH108: unbounded host retry loops ------------------------------
+    def visit_While(self, node):
+        self._rule_th108(node)
+        self.generic_visit(node)
+
+    def _rule_th108(self, node):
+        """A ``while`` that paces itself with a fixed ``time.sleep``
+        but carries no bound: no comparison in the loop test (deadline
+        or attempt counter), no ``while not done:`` stop flag, and no
+        comparison-gated escape in the body. The canonical offender::
+
+            while True:
+                if ping():      # a probe, not a bound
+                    break
+                time.sleep(5)
+
+        — liveness depends entirely on the dependency coming back.
+        Bounded shapes (``while time.monotonic() < deadline``,
+        ``for _ in range(retries)``, ``if attempt > max: raise``) and
+        variable sleeps (a computed backoff) stay quiet."""
+        if any(isinstance(t, ast.Compare) for t in ast.walk(node.test)):
+            return  # deadline / attempt comparison bounds the loop
+        test = node.test
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return  # `while not done:` — an externally-set stop flag
+        sleep = _bare_sleep(node.body, self.mod)
+        if sleep is None:
+            return
+        if _bounded_escape(node.body):
+            return
+        self._emit(
+            "TH108", sleep,
+            f"retry loop sleeps a fixed {ast.unparse(sleep.args[0])}s "
+            "with no bound or backoff — a wedged dependency spins this "
+            "forever; bound the attempts (deadline compare or max "
+            "retries) and back off with jitter")
+
     # -- TH103 / TH107: name-shaped rules -------------------------------
     def visit_Attribute(self, node):
         if self.mod.device_tier and isinstance(node.ctx, ast.Load):
@@ -311,6 +352,80 @@ class _RuleVisitor(ast.NodeVisitor):
                     "traced code — its contents bake into the "
                     "executable at trace time")
         self.generic_visit(node)
+
+
+def _sub_blocks(stmt):
+    """The nested statement blocks of one compound statement that the
+    SAME iteration executes — if/try/with arms. New scopes and nested
+    loops are deliberately excluded: their sleeps and breaks pace the
+    inner construct, not the loop TH108 is judging."""
+    if isinstance(stmt, ast.If):
+        yield stmt.body
+        yield stmt.orelse
+    elif isinstance(stmt, ast.Try):
+        yield stmt.body
+        for h in stmt.handlers:
+            yield h.body
+        yield stmt.orelse
+        yield stmt.finalbody
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        yield stmt.body
+
+
+def _bare_sleep(stmts, mod):
+    """The first ``time.sleep(<constant>)`` expression statement in a
+    loop body (recursing through if/try/with, not into nested scopes or
+    loops). Resolved through the module's import map, so aliases
+    (``from time import sleep``, ``import time as t``) are caught; a
+    variable argument (a computed backoff) does not match."""
+    for s in stmts:
+        if isinstance(s, ast.Expr) and isinstance(s.value, ast.Call):
+            call = s.value
+            if mod.resolve(call.func, None) == "time.sleep" \
+                    and call.args \
+                    and isinstance(call.args[0], ast.Constant):
+                return call
+        for blk in _sub_blocks(s):
+            found = _bare_sleep(blk, mod)
+            if found is not None:
+                return found
+    return None
+
+
+def _bounded_escape(stmts, top: bool = True) -> bool:
+    """Does a loop body guarantee a bound? True for an unconditional
+    top-level break/return/raise, or an ``if`` whose test COMPARES
+    something (a deadline, an attempt counter) and whose branch
+    escapes. An ``if probe(): break`` does NOT count — that is the
+    pattern under judgment: the escape exists but nothing bounds how
+    long the loop waits for it."""
+    for s in stmts:
+        if top and isinstance(s, (ast.Break, ast.Return, ast.Raise)):
+            return True
+        if isinstance(s, ast.If):
+            gated = any(isinstance(t, ast.Compare)
+                        for t in ast.walk(s.test))
+            escapes = any(
+                isinstance(x, (ast.Break, ast.Return, ast.Raise))
+                for blk in (s.body, s.orelse)
+                for st in blk for x in ast.walk(st))
+            if gated and escapes:
+                return True
+            if _bounded_escape(s.body, top=False) \
+                    or _bounded_escape(s.orelse, top=False):
+                return True
+        elif isinstance(s, ast.Try):
+            # The try body runs unconditionally; handlers/else do not.
+            if _bounded_escape(s.body, top) \
+                    or _bounded_escape(s.finalbody, top) \
+                    or _bounded_escape(s.orelse, top=False) \
+                    or any(_bounded_escape(h.body, top=False)
+                           for h in s.handlers):
+                return True
+        elif isinstance(s, (ast.With, ast.AsyncWith)):
+            if _bounded_escape(s.body, top):
+                return True
+    return False
 
 
 def _terminates(stmts) -> bool:

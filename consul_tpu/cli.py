@@ -740,13 +740,25 @@ def _run_resilient_cmd(args, sim, events, ticks, extra: dict) -> int:
     from consul_tpu.runtime import (Preempted, SentinelViolation,
                                     run_resilient)
 
+    if getattr(args, "dcn_retry_max", None) is not None:
+        # The process-wide LinkPolicy default: any DCN federation this
+        # run builds inherits the bound (parallel/dcn).
+        import dataclasses as _dc
+
+        from consul_tpu.parallel import dcn as dcn_mod
+
+        dcn_mod.DEFAULT_LINK_POLICY = _dc.replace(
+            dcn_mod.DEFAULT_LINK_POLICY, retry_max=args.dcn_retry_max)
+
     policy = _ckpt_policy(
         args, sim, f"{args.cmd}_{args.n}_seed{args.seed}")
     try:
         report = run_resilient(
             sim, ticks, chunk=args.chunk, events=events, policy=policy,
             sentinel=args.sentinel,
-            sentinel_dump_dir=args.sentinel_dump_dir)
+            sentinel_dump_dir=args.sentinel_dump_dir,
+            heartbeat_s=args.heartbeat_s or None,
+            elastic=args.elastic)
     except Preempted as e:
         print(json.dumps(dict(extra, **e.report.to_json())))
         return 75
@@ -759,7 +771,9 @@ def _run_resilient_cmd(args, sim, events, ticks, extra: dict) -> int:
     out = dict(extra, ticks=report.ticks_done, slo=report.slo,
                counters=report.counters,
                resumed_from_tick=report.resumed_from_tick,
-               ckpt_failures=report.ckpt_failures)
+               ckpt_failures=report.ckpt_failures,
+               reshards=report.reshards,
+               hang_status=report.hang_status)
     print(json.dumps(out))
     return 0
 
@@ -874,6 +888,21 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--sentinel-dump-dir", default=None,
                         help="where a sentinel trip dumps its "
                              "diagnostic checkpoint")
+        sp.add_argument("--elastic", action="store_true",
+                        help="place the run over the largest mesh the "
+                             "surviving devices support, and re-shard "
+                             "a resumed checkpoint onto it (chip-loss "
+                             "survival: resume 8->4->1 devices)")
+        sp.add_argument("--heartbeat-s", type=float, default=0.0,
+                        help="per-chunk heartbeat deadline in seconds "
+                             "(0: off) — a chunk that fails to finish "
+                             "in time is classified mid-run-hang and "
+                             "a diagnostic checkpoint of the last "
+                             "completed state is written")
+        sp.add_argument("--dcn-retry-max", type=int, default=None,
+                        help="bound on consecutive DCN federation "
+                             "link retries before a link is marked "
+                             "degraded (parallel/dcn LinkPolicy)")
 
     rn = sub.add_parser(
         "run",

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from consul_tpu.config import GossipConfig, SimConfig
 from consul_tpu.models import state as sim_state
 from consul_tpu.models import swim
+from consul_tpu.models.cluster import _topo_key
 from consul_tpu.models.state import SimState
 from consul_tpu.ops import merge, topology
 from consul_tpu.ops.topology import World
@@ -97,6 +98,79 @@ class FederationState(NamedTuple):
     wan_accum_ms: jax.Array  # [] int32 — Bresenham accumulator
 
 
+def _fed_step(cfg: FederationConfig, lan_topo, wan_topo):
+    """The per-tick federation step, with everything instance-specific
+    passed as program *arguments* (the cluster.py _chunk_runner idiom):
+    the LAN/WAN worlds and the WAN row offset of this instance's owned
+    slice. Only the configs and topology tables stay closed over — they
+    are read concretely during tracing and are part of the program's
+    identity."""
+    lan_cfg, wan_cfg = cfg.lan, cfg.wan
+    lan_step = functools.partial(swim.step, lan_cfg, lan_topo)
+
+    def step(lan_world, wan_world, off, state: FederationState, key):
+        k_lan, k_wan = jax.random.split(key)
+        lan_keys = jax.random.split(k_lan, cfg.n_dc)
+        lan = jax.vmap(lan_step)(lan_world, state.lan, lan_keys)
+        # WAN servers that died in their LAN pool are dead on the WAN
+        # too (same process; reference: one serf agent in both pools).
+        # Ground truth flows LAN -> WAN, into the OWNED rows only —
+        # other islands' rows keep their last-synced truth. ``off`` is
+        # a traced scalar so same-shape islands share one executable.
+        server_alive = lan.alive_truth[:, :cfg.servers_per_dc].reshape(-1)
+        server_left = lan.left[:, :cfg.servers_per_dc].reshape(-1)
+        wan = state.wan._replace(
+            alive_truth=jax.lax.dynamic_update_slice(
+                state.wan.alive_truth, server_alive, (off,)),
+            left=jax.lax.dynamic_update_slice(
+                state.wan.left, server_left, (off,)),
+        )
+        # Bresenham: fire a WAN tick whenever accumulated LAN time
+        # crosses the WAN tick size.
+        accum = state.wan_accum_ms + lan_cfg.gossip.tick_ms
+        fire = accum >= wan_cfg.gossip.tick_ms
+        wan = jax.lax.cond(
+            fire,
+            lambda w: swim.step(wan_cfg, wan_topo, wan_world, w, k_wan),
+            lambda w: w, wan,
+        )
+        accum = jnp.where(fire, accum - wan_cfg.gossip.tick_ms, accum)
+        return FederationState(lan=lan, wan=wan, wan_accum_ms=accum)
+
+    return step
+
+
+_FED_RUNNER_CACHE: dict = {}
+
+
+def _fed_chunk_runner(cfg: FederationConfig, lan_topo, wan_topo,
+                      chunk: int):
+    """Scan-compiled multi-tick federation runner, memoized
+    process-wide like cluster.py's _chunk_runner. ``dc_offset`` is
+    normalized out of the memo key and enters the program as a scalar
+    argument, so every same-shape island of a DCN federation — and
+    every later Federation built over the same configs/topologies —
+    reuses one executable instead of paying XLA per instance."""
+    cfg = dataclasses.replace(cfg, dc_offset=0)
+    memo = (cfg, _topo_key(lan_topo), _topo_key(wan_topo), chunk)
+    hit = _FED_RUNNER_CACHE.get(memo)
+    if hit is not None:
+        return hit
+
+    step = _fed_step(cfg, lan_topo, wan_topo)
+
+    def run(lan_world, wan_world, off, state, base_key):
+        def body(st, _):
+            k = jax.random.fold_in(base_key, st.lan.t[0])
+            return step(lan_world, wan_world, off, st, k), ()
+        return jax.lax.scan(
+            body, state, jnp.arange(chunk, dtype=jnp.int32))[0]
+
+    jitted = jax.jit(run, donate_argnums=(3,))
+    _FED_RUNNER_CACHE[memo] = jitted
+    return jitted
+
+
 class Federation:
     """Driver for one federated simulation (LAN pools + WAN pool)."""
 
@@ -141,71 +215,26 @@ class Federation:
         self.state = FederationState(
             lan=lan_state, wan=wan_state, wan_accum_ms=jnp.int32(0)
         )
-        self._step = self._build_step()
-        self._runners = {}
+        self._wan_off = jnp.int32(cfg.dc_offset * cfg.servers_per_dc)
 
     # ------------------------------------------------------------------
-    def _build_step(self):
-        cfg = self.cfg
-        lan_cfg, wan_cfg = cfg.lan, cfg.wan
-        lan_step = functools.partial(swim.step, lan_cfg, self.lan_topo)
-        wan_step = functools.partial(
-            swim.step, wan_cfg, self.wan_topo, self.wan_world
-        )
-
-        def step(state: FederationState, key) -> FederationState:
-            k_lan, k_wan = jax.random.split(key)
-            lan_keys = jax.random.split(k_lan, cfg.n_dc)
-            lan = jax.vmap(lan_step)(self.lan_world, state.lan, lan_keys)
-            # WAN servers that died in their LAN pool are dead on the
-            # WAN too (same process; reference: one serf agent in both
-            # pools). Ground truth flows LAN -> WAN, into the OWNED rows
-            # only — other islands' rows keep their last-synced truth.
-            s = cfg.servers_per_dc
-            off = cfg.dc_offset * s
-            server_alive = lan.alive_truth[:, :s].reshape(-1)
-            server_left = lan.left[:, :s].reshape(-1)
-            wan = state.wan._replace(
-                alive_truth=state.wan.alive_truth.at[
-                    off:off + server_alive.shape[0]].set(server_alive),
-                left=state.wan.left.at[
-                    off:off + server_left.shape[0]].set(server_left),
-            )
-            # Bresenham: fire a WAN tick whenever accumulated LAN time
-            # crosses the WAN tick size.
-            accum = state.wan_accum_ms + lan_cfg.gossip.tick_ms
-            fire = accum >= wan_cfg.gossip.tick_ms
-            wan = jax.lax.cond(
-                fire, lambda w: wan_step(w, k_wan), lambda w: w, wan
-            )
-            accum = jnp.where(fire, accum - wan_cfg.gossip.tick_ms, accum)
-            return FederationState(lan=lan, wan=wan, wan_accum_ms=accum)
-
-        return jax.jit(step, donate_argnums=(0,))
-
-    def _runner(self, chunk: int):
-        """Scan-compiled multi-tick runner: the whole chunk executes
-        on-device with zero host round-trips (round-1 weakness #4 — the
-        per-tick ``int(t)`` host sync — removed; per-tick keys fold the
-        on-device tick counter, the cluster.py idiom)."""
-        if chunk not in self._runners:
-            step = self._step.__wrapped__  # un-jitted
-
-            def run(state, base_key):
-                def body(st, _):
-                    k = jax.random.fold_in(base_key, st.lan.t[0])
-                    return step(st, k), ()
-                return jax.lax.scan(
-                    body, state, jnp.arange(chunk, dtype=jnp.int32))[0]
-
-            self._runners[chunk] = jax.jit(run, donate_argnums=(0,))
-        return self._runners[chunk]
-
     def run(self, lan_ticks: int, chunk: int = 32):
+        """Advance ``lan_ticks`` in scan-compiled chunks: the whole
+        chunk executes on-device with zero host round-trips (round-1
+        weakness #4 — the per-tick ``int(t)`` host sync — removed;
+        per-tick keys fold the on-device tick counter, the cluster.py
+        idiom). Runners come from the process-wide memo, so repeated
+        instances and same-shape DCN islands share executables."""
         remaining = lan_ticks
         while remaining > 0:
             c = min(chunk, remaining)
-            self.state = self._runner(c)(self.state, self.base_key)
+            runner = _fed_chunk_runner(
+                self.cfg, self.lan_topo, self.wan_topo, c
+            )
+            self.state = runner(
+                self.lan_world, self.wan_world, self._wan_off,
+                self.state, self.base_key,
+            )
             remaining -= c
         return self.state
 

@@ -29,6 +29,22 @@ Ownership: island k owns the WAN rows of the servers in its DCs
 (``FederationConfig.dc_offset``/``n_dc``); LAN ground truth flows into
 owned rows only (models/federation.py), so a server's liveness is
 always authored by the island that simulates its datacenter.
+
+Fault envelope: real DCN links time out, drop, and partition. Each
+directed link (src island -> dst island) runs a small state machine
+(:class:`LinkPolicy` / ``_LinkState``): a failed send (injected via
+:meth:`DcnFederation.inject_link_faults` — ``timeout`` models a send
+that burns its ``send_timeout_s`` budget, ``drop`` a fast failure)
+puts the link into bounded exponential backoff measured in SYNC
+ROUNDS with deterministic jitter (no wall clocks, no host RNG — this
+is a device-tier module, TH103), while the undelivered anti-entropy
+payloads buffer in a bounded retransmit queue (drop-oldest; the
+newest payload always survives, which is all anti-entropy needs — a
+later push-pull supersedes an earlier one). On heal the queue
+re-merges oldest-to-newest and the replicas reconverge. Every event
+is counted through the telemetry sink: ``sim.dcn.retries``,
+``sim.dcn.link_down_ticks``, ``sim.dcn.send_timeouts``,
+``sim.dcn.retx_dropped``, ``sim.dcn.heals``.
 """
 
 from __future__ import annotations
@@ -42,6 +58,62 @@ import jax.numpy as jnp
 from consul_tpu.models.federation import Federation, FederationConfig
 
 
+@dataclasses.dataclass(frozen=True)
+class LinkPolicy:
+    """Per-link fault envelope for the DCN tier. Backoff is measured
+    in sync rounds (the DCN superstep IS the link's clock — one round
+    = ``sync_every`` LAN ticks of modeled time), bounded exponentially:
+    after the k-th consecutive failure the link stays down
+    ``min(backoff_cap, backoff_base * 2**(k-1)) + jitter`` rounds,
+    with deterministic hash jitter so simultaneous link failures
+    de-synchronize their retries without host RNG. ``retry_max``
+    bounds the consecutive retries before the link is marked degraded
+    (it keeps retrying at the capped cadence — a WAN partition must
+    heal eventually — but the degradation is counted and visible)."""
+
+    send_timeout_s: float = 2.0     # modeled per-send budget (timeout kind)
+    retry_max: int = 5
+    backoff_base: int = 1           # sync rounds
+    backoff_cap: int = 8            # sync rounds
+    queue_bound: int = 4            # buffered anti-entropy payloads
+
+
+DEFAULT_LINK_POLICY = LinkPolicy()
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkFault:
+    """An injected DCN link fault: sends src->dst fail during sync
+    rounds [start, stop). ``kind`` is ``"drop"`` (fast failure) or
+    ``"timeout"`` (the send burns its ``send_timeout_s`` budget first
+    — same outcome, distinct diagnosis and counter)."""
+
+    src: int
+    dst: int
+    start: int
+    stop: int
+    kind: str = "drop"
+
+
+@dataclasses.dataclass
+class _LinkState:
+    """One directed link's retry machine (host-side bookkeeping)."""
+
+    queue: list = dataclasses.field(default_factory=list)
+    attempt: int = 0          # consecutive failures
+    down_until: int = 0       # backoff expiry, in sync rounds
+    degraded: bool = False
+    queue_peak: int = 0
+
+
+def _jitter(src: int, dst: int, attempt: int) -> int:
+    """Deterministic backoff jitter in {0, 1} rounds — a Knuth-style
+    hash of (link, attempt), so retries de-correlate across links
+    without host randomness (reproducible trajectories, TH103)."""
+    h = (src * 73856093) ^ (dst * 19349663) ^ (attempt * 83492791)
+    return (h >> 4) & 1
+
+
 class DcnFederation:
     """Driver for a federation partitioned over ``n_islands`` meshes.
 
@@ -53,7 +125,8 @@ class DcnFederation:
     """
 
     def __init__(self, cfg: FederationConfig, n_islands: int = 2,
-                 seed: int = 0, meshes: Optional[Sequence] = None):
+                 seed: int = 0, meshes: Optional[Sequence] = None,
+                 link_policy: Optional[LinkPolicy] = None, sink=None):
         if cfg.n_dc % n_islands != 0:
             raise ValueError(
                 f"n_dc={cfg.n_dc} must divide into {n_islands} islands"
@@ -88,13 +161,97 @@ class DcnFederation:
         self._owner = jnp.repeat(
             jnp.arange(n_islands, dtype=jnp.int32), per * s
         )  # [n_wan] owning island of each WAN row
+        # The DCN fault envelope: one retry machine per directed link.
+        self.link_policy = link_policy if link_policy is not None \
+            else DEFAULT_LINK_POLICY
+        self.sink = sink
+        self._links = {
+            (a, b): _LinkState()
+            for a in range(n_islands) for b in range(n_islands) if a != b
+        }
+        self._faults: list[LinkFault] = []
+        self._round = 0  # sync rounds elapsed — the link-layer clock
 
     # ------------------------------------------------------------------
-    def sync(self):
-        """One DCN reconciliation: every island's replica takes every
-        other island's owned WAN rows wholesale (see module docstring).
-        One device->host pull and one host->device push per island —
-        the batched host-boundary discipline of SURVEY §7."""
+    # Link fault envelope
+    # ------------------------------------------------------------------
+    def inject_link_faults(self, faults: Sequence[LinkFault]):
+        """Arm a DCN fault schedule: each entry fails sends on one
+        directed link for a sync-round window (chaos for the WAN tier,
+        the host-side analogue of chaos/schedule.py's device tensors)."""
+        self._faults = list(faults)
+
+    def _fault_kind(self, src: int, dst: int, rnd: int) -> Optional[str]:
+        for f in self._faults:
+            if f.src == src and f.dst == dst and f.start <= rnd < f.stop:
+                return f.kind
+        return None
+
+    def _count(self, name: str, n: int = 1):
+        if self.sink is not None and n:
+            self.sink.incr_counter(name, n)
+
+    def link_state(self, src: int, dst: int) -> _LinkState:
+        """The directed link's retry machine (tests + bench probes)."""
+        return self._links[(src, dst)]
+
+    def _offer(self, src: int, dst: int, payload, ticks: int) -> list:
+        """Run one sync round of the (src -> dst) link: enqueue the
+        fresh anti-entropy payload, then either deliver the whole
+        buffered queue (link up) or count the failure and back off.
+        Returns the payloads to merge at dst, oldest first (empty while
+        the link is down)."""
+        pol, link, rnd = self.link_policy, self._links[(src, dst)], self._round
+        link.queue.append(payload)
+        if len(link.queue) > pol.queue_bound:
+            # Drop-oldest: anti-entropy payloads supersede each other,
+            # so the newest must survive — bounding memory across an
+            # arbitrarily long partition.
+            dropped = len(link.queue) - pol.queue_bound
+            del link.queue[:dropped]
+            self._count("sim.dcn.retx_dropped", dropped)
+        link.queue_peak = max(link.queue_peak, len(link.queue))
+
+        if rnd < link.down_until:
+            # Still backing off: down, not even attempting.
+            self._count("sim.dcn.link_down_ticks", ticks)
+            return []
+        retrying = link.attempt > 0
+        if retrying:
+            self._count("sim.dcn.retries", 1)
+        kind = self._fault_kind(src, dst, rnd)
+        if kind is None:
+            # Delivered: the link is (back) up — flush the buffer.
+            if retrying:
+                self._count("sim.dcn.heals", 1)
+            link.attempt = 0
+            link.degraded = False
+            out, link.queue = link.queue, []
+            return out
+        # Failed send: classify, then bounded exponential backoff.
+        if kind == "timeout":
+            self._count("sim.dcn.send_timeouts", 1)
+        link.attempt += 1
+        if link.attempt >= pol.retry_max and not link.degraded:
+            link.degraded = True
+            self._count("sim.dcn.link_degraded", 1)
+        backoff = min(pol.backoff_cap,
+                      pol.backoff_base * (1 << min(link.attempt - 1, 16)))
+        link.down_until = rnd + 1 + backoff + _jitter(src, dst, link.attempt)
+        self._count("sim.dcn.link_down_ticks", ticks)
+        return []
+
+    # ------------------------------------------------------------------
+    def sync(self, ticks: int = 1):
+        """One DCN reconciliation round: every island receives, over
+        its per-source links, the other islands' owned WAN rows
+        wholesale (see module docstring) — links that are faulted or
+        backing off deliver nothing this round and their payloads
+        buffer in the retransmit queue instead. One device->host pull
+        and one host->device push per island — the batched
+        host-boundary discipline of SURVEY §7. ``ticks`` is how many
+        LAN ticks this round represents (the run loop passes its sync
+        cadence so ``sim.dcn.link_down_ticks`` counts modeled time)."""
         # The DCN hop: replicas live on disjoint device sets, so the
         # exchange goes through the host — one pull per island, one
         # numpy-side merge, one push per island.
@@ -108,34 +265,44 @@ class DcnFederation:
         # row-merged. SimState's one non-per-row field is the tick
         # counter ``t`` (models/state.py:58-91); every other field —
         # including every nested viv leaf — is [n_wan, ...], which the
-        # assert pins against future drift.
+        # hard error pins against future drift.
         scalar_fields = {"t"}
 
-        def select(*leaves):
-            if leaves[0].shape[0] != owner.shape[0]:
-                # A hard error (not an assert, which python -O strips):
-                # a future non-per-row leaf must fail loudly here, not
-                # silently mis-broadcast through np.where.
-                raise ValueError(
-                    f"per-row WAN leaf with leading dim {leaves[0].shape}"
-                )
-            sel = owner.reshape((-1,) + (1,) * (leaves[0].ndim - 1))
-            out = leaves[0]
-            for k in range(1, len(leaves)):
-                out = np.where(sel == k, leaves[k], out)
-            return out
+        def take_rows(dst_wan, src_wan, src_island):
+            """Overwrite ``src_island``'s owned rows in dst's replica
+            with the delivered payload's rows."""
+            def sel(a, b):
+                if a.shape[0] != owner.shape[0]:
+                    # A hard error (not an assert, which python -O
+                    # strips): a future non-per-row leaf must fail
+                    # loudly here, not silently mis-broadcast.
+                    raise ValueError(
+                        f"per-row WAN leaf with leading dim {a.shape}"
+                    )
+                m = (owner == src_island).reshape(
+                    (-1,) + (1,) * (a.ndim - 1))
+                return np.where(m, b, a)
 
-        merged = type(wans[0])(**{
-            name: (getattr(wans[0], name) if name in scalar_fields
-                   else jax.tree.map(
-                       select, *[getattr(w, name) for w in wans]))
-            for name in type(wans[0])._fields
-        })
-        for i, isl in enumerate(self.islands):
+            return type(dst_wan)(**{
+                name: (getattr(dst_wan, name) if name in scalar_fields
+                       else jax.tree.map(sel, getattr(dst_wan, name),
+                                         getattr(src_wan, name)))
+                for name in type(dst_wan)._fields
+            })
+
+        for d, isl in enumerate(self.islands):
+            merged = wans[d]
+            for s in range(self.n_islands):
+                if s == d:
+                    continue
+                for payload in self._offer(s, d, wans[s], ticks):
+                    # Oldest first: a newer anti-entropy payload
+                    # supersedes an older one row-for-row.
+                    merged = take_rows(merged, payload, s)
             if self.meshes is not None:
                 from consul_tpu.parallel import mesh as pmesh
                 wan_shard = pmesh.federation_sharding(
-                    isl.state, self.meshes[i]
+                    isl.state, self.meshes[d]
                 ).wan
                 wan = jax.tree.map(jax.device_put, merged, wan_shard)
             else:
@@ -145,6 +312,7 @@ class DcnFederation:
                     lambda x: jax.device_put(jnp.asarray(x)), merged
                 )
             isl.state = isl.state._replace(wan=wan)
+        self._round += 1
 
     def run(self, lan_ticks: int, sync_every: int = 16, chunk: int = 16):
         """Advance all islands ``lan_ticks`` LAN ticks, reconciling the
@@ -155,8 +323,28 @@ class DcnFederation:
             c = min(sync_every, remaining)
             for isl in self.islands:
                 isl.run(c, chunk=min(chunk, c))
-            self.sync()
+            self.sync(ticks=c)
             remaining -= c
+
+    # ------------------------------------------------------------------
+    def replicas_agree(self) -> bool:
+        """True when every island's WAN replica is element-identical —
+        what a clean (all links delivered) sync round guarantees, and
+        the convergence probe a healed partition must pass."""
+        import numpy as np
+
+        wans = [jax.device_get(isl.state.wan) for isl in self.islands]
+        ref_leaves = jax.tree.leaves(wans[0])
+        for w in wans[1:]:
+            for a, b in zip(ref_leaves, jax.tree.leaves(w)):
+                if not np.array_equal(np.asarray(a), np.asarray(b)):
+                    return False
+        return True
+
+    def queue_peak(self) -> int:
+        """High-water retransmit-queue depth across all links (never
+        exceeds ``LinkPolicy.queue_bound`` — the bound tests pin)."""
+        return max((l.queue_peak for l in self._links.values()), default=0)
 
     # ------------------------------------------------------------------
     def island_of_dc(self, dc: int) -> tuple[Federation, int]:

@@ -47,6 +47,54 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None, n_dc: int = 1) -> 
     return Mesh(grid, (DC_AXIS, NODE_AXIS))
 
 
+def elastic_mesh(n: int, devices: Optional[Sequence[jax.Device]] = None,
+                 n_dc: int = 1) -> Mesh:
+    """The largest mesh the *surviving* devices support: take the
+    biggest device count k ≤ len(devices) that both divides evenly
+    into ``n_dc`` datacenters and divides the node axis ``n`` — the
+    mesh an elastic resume rebuilds after chips are lost (8→4→1 all
+    work for any power-of-two ``n``). Always succeeds for ``n_dc=1``
+    (a 1-device mesh divides everything); raises when no surviving
+    subset can host ``n_dc`` DCs."""
+    devices = list(devices if devices is not None else jax.devices())
+    for k in range(len(devices), 0, -1):
+        if k % n_dc == 0 and n % (k // n_dc or 1) == 0 and k >= n_dc:
+            return make_mesh(devices[:k], n_dc=n_dc)
+    raise ValueError(
+        f"no usable mesh: {len(devices)} surviving device(s) cannot "
+        f"host n={n} nodes across n_dc={n_dc} datacenters")
+
+
+def sharding_from_manifest(mesh: Mesh, specs: Sequence, tree):
+    """Rebuild a NamedSharding pytree from a checkpoint's recorded
+    PartitionSpec manifest (utils/checkpoint.read_partition_spec) over
+    a NEW mesh — the re-shard half of a shape-agnostic resume. Axis
+    names the new mesh does not carry (or leaves saved unsharded,
+    spec None) fall back to replication; the node-axis rule re-applies
+    them via :func:`node_spec` when the caller knows ``n``."""
+    leaves, treedef = jax.tree.flatten(tree)
+    if len(specs) != len(leaves):
+        raise ValueError(
+            f"partition manifest has {len(specs)} entries for "
+            f"{len(leaves)} leaves — checkpoint/template mismatch")
+    axis_names = set(mesh.axis_names)
+
+    def to_spec(entry):
+        if entry is None:
+            return P()
+        axes = []
+        for a in entry:
+            names = [a] if isinstance(a, str) or a is None else list(a)
+            if all(x is None or x in axis_names for x in names):
+                axes.append(tuple(names) if isinstance(a, list) else a)
+            else:
+                axes.append(None)  # axis lost with the old mesh shape
+        return P(*axes)
+
+    shardings = [NamedSharding(mesh, to_spec(s)) for s in specs]
+    return jax.tree.unflatten(treedef, shardings)
+
+
 def node_spec(leaf, n: int) -> P:
     """The one node-axis partition rule: leaves whose leading dim is the
     node count shard on it, everything else replicates. Shared by the

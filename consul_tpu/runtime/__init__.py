@@ -18,11 +18,18 @@ point (bench.py phases, ``Simulation.run_scenario`` via
 - :mod:`harness` — :func:`run_resilient`: the chunked run loop every
   entry point drives through; resumes bit-identically (same seed, same
   chaos schedule offset), including across shard_map layouts
-  (:func:`harness.restore_placed`).
-- :mod:`watchdog` — :class:`InitWatchdog` + :func:`with_failover`:
-  the init-hang watchdog with bounded retries and explicit CPU
-  failover, recording ``degraded_from`` / retry / hang-wall provenance
-  instead of ad-hoc status strings.
+  (:func:`harness.restore_placed`) and across *device counts*: with
+  ``elastic=True`` a checkpoint written on k devices resumes on
+  whatever mesh the surviving devices support (parallel/mesh.
+  ``elastic_mesh``), re-sharded on entry and counted as
+  ``sim.runtime.reshards``.
+- :mod:`watchdog` — :class:`InitWatchdog` + :class:`HeartbeatMonitor`
+  + :func:`with_failover`: the init-hang watchdog with bounded retries
+  and explicit CPU failover (``degraded_from`` / retry / hang-wall
+  provenance instead of ad-hoc status strings), plus the in-process
+  per-chunk heartbeat deadline that classifies a wedged chunk as
+  ``mid-run-hang`` and checkpoints the last completed state from the
+  monitor thread.
 
 The sentinel *device* tier lives in models/swim.py (_sentinel_check,
 folded into step_counted behind a trace-time flag); its *host* tier —
@@ -41,10 +48,12 @@ _EXPORTS = {
     "violation_mask": ("consul_tpu.models.counters", "violation_mask"),
     "Preempted": ("consul_tpu.runtime.harness", "Preempted"),
     "RunReport": ("consul_tpu.runtime.harness", "RunReport"),
+    "hang_dump_path": ("consul_tpu.runtime.harness", "hang_dump_path"),
     "restore_placed": ("consul_tpu.runtime.harness", "restore_placed"),
     "run_resilient": ("consul_tpu.runtime.harness", "run_resilient"),
     "CheckpointPolicy": ("consul_tpu.runtime.policy", "CheckpointPolicy"),
     "SignalTrap": ("consul_tpu.runtime.policy", "SignalTrap"),
+    "HeartbeatMonitor": ("consul_tpu.runtime.watchdog", "HeartbeatMonitor"),
     "InitWatchdog": ("consul_tpu.runtime.watchdog", "InitWatchdog"),
     "with_failover": ("consul_tpu.runtime.watchdog", "with_failover"),
 }
@@ -69,12 +78,14 @@ def __dir__():
 
 __all__ = [
     "CheckpointPolicy",
+    "HeartbeatMonitor",
     "InitWatchdog",
     "Preempted",
     "RunReport",
     "SENTINEL_FIELDS",
     "SentinelViolation",
     "SignalTrap",
+    "hang_dump_path",
     "restore_placed",
     "run_resilient",
     "violation_mask",

@@ -19,6 +19,16 @@ run. Three properties make that hold:
   refused;
 - saves are atomic and digest-verified (utils/checkpoint), so a crash
   mid-save can never poison the resume point.
+
+A fourth property makes the trajectory **elastic** (mesh-shape
+agnostic): checkpoints hold the globally-gathered leaves plus a
+PartitionSpec manifest (utils/checkpoint), so resuming does not need
+the mesh that wrote them — ``mesh=``/``elastic=True`` re-shard the
+restored state onto whatever the surviving devices support
+(parallel/mesh.elastic_mesh), counted as ``sim.runtime.reshards``.
+Determinism survives the re-shard because the discrete protocol state
+is bit-identical across placements (parallel/shard_step docstring) and
+per-tick keys fold the restored on-device tick counter.
 """
 
 from __future__ import annotations
@@ -33,6 +43,7 @@ from consul_tpu.chaos import schedule as chaos_mod
 from consul_tpu.models import counters as counters_mod
 from consul_tpu.models.cluster import SLO_KEYS
 from consul_tpu.runtime.policy import CheckpointPolicy, SignalTrap
+from consul_tpu.runtime.watchdog import HeartbeatMonitor
 from consul_tpu.utils import checkpoint as ckpt_mod
 
 
@@ -62,9 +73,26 @@ class RunReport:
     ckpt_failures: int
     counters: dict
     slo: Optional[dict]
+    reshards: int = 0
+    hang_status: Optional[str] = None
+    hang_checkpoint: Optional[str] = None
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
+
+
+def _placement_width(state) -> int:
+    """How many devices the state's arrays actually live on — the
+    mesh-shape provenance a resume compares against to count reshards.
+    Host-only pytrees (plain numpy in tests) count as width 1."""
+    for leaf in jax.tree.leaves(state):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None:
+            try:
+                return len(sharding.device_set)
+            except AttributeError:
+                return 1
+    return 1
 
 
 def _scenario_meta(sim, tag: str, ticks: int, t0: int, done: int,
@@ -79,7 +107,17 @@ def _scenario_meta(sim, tag: str, ticks: int, t0: int, done: int,
         "ticks_done": done,
         "chaos_t0": t0,
         "schedule_digest": sched_digest,
+        # Provenance only — NOT part of the resume match: the
+        # trajectory's identity is device-count-agnostic, which is
+        # exactly what lets a smaller mesh pick it up.
+        "mesh_devices": _placement_width(sim.state),
     }
+
+
+def hang_dump_path(dump_dir: str, t: int) -> str:
+    """Where the heartbeat monitor drops the mid-run-hang diagnostic
+    checkpoint (kept here so tooling and tests agree on the name)."""
+    return os.path.join(dump_dir, f"hang_diag_t{int(t)}.ckpt")
 
 
 def run_resilient(sim, ticks: int, *, chunk: int = 64,
@@ -87,7 +125,10 @@ def run_resilient(sim, ticks: int, *, chunk: int = 64,
                   events: Optional[Sequence] = None,
                   policy: Optional[CheckpointPolicy] = None,
                   sentinel: bool = False,
-                  sentinel_dump_dir: Optional[str] = None) -> RunReport:
+                  sentinel_dump_dir: Optional[str] = None,
+                  heartbeat_s: Optional[float] = None,
+                  hang_dump_dir: Optional[str] = None,
+                  mesh=None, elastic: bool = False) -> RunReport:
     """Advance ``sim`` by ``ticks`` ticks (with ``events`` as a chaos
     schedule rebased onto the start tick, like ``run_scenario``) under
     the resilient harness: resume from ``policy``'s checkpoint when a
@@ -96,6 +137,25 @@ def run_resilient(sim, ticks: int, *, chunk: int = 64,
     completion. With ``sentinel``, the on-device validator runs and a
     violation fail-fasts (models/cluster.py SentinelViolation) with a
     diagnostic checkpoint in ``sentinel_dump_dir``.
+
+    Elasticity: ``mesh`` places the state (fresh or restored) over an
+    explicit device mesh; ``elastic=True`` instead rebuilds the
+    largest mesh the currently-surviving devices support
+    (parallel/mesh.elastic_mesh). A resume whose checkpoint was
+    written on a different device count re-shards on entry and counts
+    ``sim.runtime.reshards`` — the trajectory identity (the ``match``
+    dict) is deliberately device-count-free.
+
+    ``heartbeat_s`` arms a per-chunk heartbeat deadline
+    (watchdog.HeartbeatMonitor): a chunk that fails to complete within
+    the deadline is classified (``mid-run-hang`` after at least one
+    completed chunk, ``backend-init-hang`` before) and a diagnostic
+    checkpoint of the last COMPLETED state is written from the monitor
+    thread into ``hang_dump_dir`` (default: ``sentinel_dump_dir``,
+    then the policy directory) — the main thread is wedged inside the
+    device computation at that point, so each beat mirrors the chunk's
+    finished state to the host (the cost of diagnosability; heartbeat
+    is opt-in).
 
     Returns a :class:`RunReport`; the counter deltas cover only the
     ticks THIS invocation ran (a resumed run reports its own slice)."""
@@ -106,6 +166,15 @@ def run_resilient(sim, ticks: int, *, chunk: int = 64,
     sched_digest = chaos_mod.digest_of(sched)
     t0 = int(jax.device_get(sim.swim_state.t))
     done = 0
+    reshards = 0
+    sink = (policy.sink if policy is not None else None) \
+        or getattr(sim, "sink", None)
+
+    target_mesh = mesh
+    if target_mesh is None and elastic:
+        from consul_tpu.parallel import mesh as pmesh
+
+        target_mesh = pmesh.elastic_mesh(sim.cfg.n)
 
     if policy is not None and policy.trap is None:
         policy.trap = SignalTrap()
@@ -114,6 +183,7 @@ def run_resilient(sim, ticks: int, *, chunk: int = 64,
     # total ticks, schedule digest). ``t0`` comes FROM the meta — the
     # schedule must rebase to the original start tick, not to wherever
     # the restored state happens to be.
+    saved_width = None
     if policy is not None:
         state, meta = policy.load(sim.state, match={
             "tag": policy.tag,
@@ -127,12 +197,50 @@ def run_resilient(sim, ticks: int, *, chunk: int = 64,
             sim.state = state
             t0 = int(meta["t0"])
             done = int(meta["ticks_done"])
+            saved_width = int(meta.get("mesh_devices") or 1)
     resumed_from = done
+
+    if target_mesh is not None:
+        from consul_tpu.parallel import shard_step
+
+        sim.state = shard_step.place(target_mesh, sim.state, sim.cfg.n)
+    if saved_width is not None:
+        new_width = _placement_width(sim.state)
+        if new_width != saved_width:
+            # The reshard-on-entry event: same trajectory, different
+            # surviving-device count (the checkpoint payload is the
+            # gathered global view, so this is pure re-placement).
+            reshards += 1
+            if sink is not None:
+                sink.incr_counter("sim.runtime.reshards", 1)
 
     prev_sched = sim.chaos
     if sched is not None:
         sim.set_chaos(chaos_mod.shift_schedule(sched, t0))
     before = dict(sim.counters)
+
+    monitor = None
+    hang_ckpt: list = [None]  # monitor thread writes, report reads
+    if heartbeat_s:
+        dump_dir = hang_dump_dir or sentinel_dump_dir or (
+            policy.directory if policy is not None else None)
+
+        def _on_hang(status, hung_done, last_state):
+            if policy is not None:
+                policy.request()  # save if the main thread unblocks
+            if dump_dir is None or last_state is None:
+                return
+            os.makedirs(dump_dir, exist_ok=True)
+            path = hang_dump_path(dump_dir, t0 + hung_done)
+            ckpt_mod.save(path, last_state, meta=dict(
+                _scenario_meta(sim, policy.tag if policy is not None
+                               else "hang", ticks, t0, hung_done,
+                               sched_digest),
+                classification=status))
+            hang_ckpt[0] = path
+
+        monitor = HeartbeatMonitor(
+            heartbeat_s, on_hang=_on_hang, sink=sink).start()
 
     def _report(preempted: bool) -> RunReport:
         after = sim.counters
@@ -147,6 +255,9 @@ def run_resilient(sim, ticks: int, *, chunk: int = 64,
             counters=deltas,
             slo={SLO_KEYS[f]: deltas[f] for f in SLO_KEYS}
             if sched is not None else None,
+            reshards=reshards,
+            hang_status=monitor.status if monitor is not None else None,
+            hang_checkpoint=hang_ckpt[0],
         )
 
     trap = policy.trap if policy is not None else SignalTrap()
@@ -160,6 +271,11 @@ def run_resilient(sim, ticks: int, *, chunk: int = 64,
                 sim.run(c, chunk=c, with_metrics=with_metrics)
                 done += c
                 since_save += c
+                if monitor is not None:
+                    # Host-mirror the completed chunk's state: the NEXT
+                    # chunk donates these buffers, and a wedged device
+                    # cannot serve a fetch after the fact.
+                    monitor.beat(done, jax.device_get(sim.state))
                 if policy is None:
                     continue
                 if trap.fired is not None:
@@ -171,6 +287,8 @@ def run_resilient(sim, ticks: int, *, chunk: int = 64,
                             sim, policy.tag, ticks, t0, done, sched_digest)):
                         since_save = 0
     finally:
+        if monitor is not None:
+            monitor.stop()
         sim.set_chaos(prev_sched)
     if policy is not None:
         policy.retire()
@@ -178,21 +296,37 @@ def run_resilient(sim, ticks: int, *, chunk: int = 64,
 
 
 def restore_placed(path: str, template: Any, mesh=None, n: Optional[int] = None):
-    """Restore a checkpoint and re-shard it over ``mesh``'s node axis —
-    the round trip that lets a sharded run resume a single-device
-    checkpoint and vice versa: utils/checkpoint serializes the GLOBAL
-    array view (np.asarray gathers the shards), so the on-disk layout
-    is placement-free and ``shard_step.place`` reinstates whatever
-    layout this process runs. With ``mesh=None`` the arrays stay
-    unsharded (single-device resume)."""
+    """Restore a checkpoint and re-shard it over ``mesh`` — the round
+    trip that lets a sharded run resume a single-device checkpoint and
+    vice versa: utils/checkpoint serializes the GLOBAL array view
+    (np.asarray gathers the shards), so the on-disk layout is
+    placement-free. The checkpoint's PartitionSpec manifest drives the
+    re-shard when it names axes the new mesh carries (a sharded source
+    re-applies its own partitioning onto any device count that divides
+    the axis); a spec-free source (saved unsharded, or a pre-manifest
+    checkpoint) falls back to the node-axis rule, which needs ``n``.
+    With ``mesh=None`` the arrays stay unsharded (single-device
+    resume)."""
     state = ckpt_mod.restore(path, template)
-    if mesh is not None:
-        from consul_tpu.parallel import shard_step
+    if mesh is None:
+        return state
+    from consul_tpu.parallel import mesh as pmesh
+    from consul_tpu.parallel import shard_step
 
-        if n is None:
-            raise ValueError("restore_placed(mesh=...) needs n")
-        state = shard_step.place(mesh, state, n)
-    return state
+    specs = ckpt_mod.read_partition_spec(path)
+    axis_names = set(mesh.axis_names)
+    if specs is not None and any(
+            a in axis_names
+            for s in specs if s
+            for entry in s
+            for a in ([entry] if isinstance(entry, str) or entry is None
+                      else entry)):
+        shardings = pmesh.sharding_from_manifest(mesh, specs, state)
+        return jax.tree.map(jax.device_put, state, shardings)
+    if n is None:
+        raise ValueError("restore_placed(mesh=...) needs n when the "
+                         "checkpoint carries no usable partition spec")
+    return shard_step.place(mesh, state, n)
 
 
 def diagnostic_dump_path(dump_dir: str, t: int) -> str:

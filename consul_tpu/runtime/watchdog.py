@@ -1,31 +1,46 @@
-"""Backend init-hang watchdog + degraded-mode failover.
+"""Backend hang watchdogs + degraded-mode failover.
 
 A wedged TPU relay hangs *inside* ``jax.devices()`` indefinitely
 (BENCH_r05.json records a real 300 s ``backend-init-hang``); waiting
 out the full budget on it pushes the whole run past outer harness
-timeouts and loses the output. Lifted out of bench.py's private child
-loop so any supervisor of a backend-owning child process gets the same
-protection:
+timeouts and loses the output. A chip can also wedge *mid-run* — a
+device computation that never completes — which an init-only window
+cannot see. Lifted out of bench.py's private child loop so any
+supervisor of a backend-owning child process gets the same protection:
 
 - :class:`InitWatchdog` — poll a child for its readiness event; kill
-  it early when the init window expires without one.
+  it early when the init window expires without one, or (with
+  ``heartbeat_s``) when a ready child stops making observable
+  progress — the two failure modes come back as distinct
+  classifications (``backend-init-hang`` vs ``mid-run-hang``).
+- :class:`HeartbeatMonitor` — the in-process tier: the run loop beats
+  at every chunk boundary; a missed deadline classifies the hang and
+  hands the last completed state to an ``on_hang`` callback (the
+  resilient harness writes a diagnostic checkpoint from it, since the
+  main thread is still blocked inside the wedged computation).
 - :func:`with_failover` — bounded retries of a hanging attempt, then
   an explicit degraded-mode failover to the next platform, recording
   provenance (``degraded_from``, retry count, hang wall time) into the
   telemetry sink and the returned report instead of ad-hoc status
-  strings.
+  strings. Only init hangs retry: a mid-run hang already produced
+  partial phases and a diagnostic state, which is a real answer.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import logging
 import subprocess
+import threading
 import time
-from typing import Callable, Sequence
+from typing import Any, Callable, Optional, Sequence
+
+log = logging.getLogger(__name__)
 
 # Status strings (stable: bench JSON consumers key on them).
 OK = "ok"
 INIT_HANG = "backend-init-hang"
+MID_RUN_HANG = "mid-run-hang"
 TIMEOUT = "timeout"
 
 
@@ -39,15 +54,29 @@ class InitWatchdog:
 
     init_window_s: float = 300.0
     poll_s: float = 10.0
+    heartbeat_s: float = 0.0  # 0 disables mid-run stall detection
 
     def watch(self, proc: subprocess.Popen, ready: Callable[[], bool],
-              deadline: float) -> str:
+              deadline: float,
+              progress: Optional[Callable[[], Any]] = None) -> str:
         """Block until the child exits or is killed; returns OK /
-        INIT_HANG / TIMEOUT (rc mapping is the caller's business —
-        only the caller knows which exit codes are expected).
-        ``deadline`` is an absolute ``time.monotonic()`` stamp."""
+        INIT_HANG / MID_RUN_HANG / TIMEOUT (rc mapping is the caller's
+        business — only the caller knows which exit codes are
+        expected). ``deadline`` is an absolute ``time.monotonic()``
+        stamp.
+
+        ``progress`` (optional, with ``heartbeat_s > 0``) is a cheap
+        host-side probe of the child's forward motion — any value that
+        changes while the child works (bench children: the output
+        file's size). Once the child has proven readiness, a progress
+        value frozen for longer than ``heartbeat_s`` classifies it as
+        a MID_RUN_HANG: the backend came up and then wedged, which is
+        a different diagnosis (and failover decision) than never
+        coming up at all."""
         t0 = time.monotonic()
         seen_ready = False
+        last_progress = progress() if progress is not None else None
+        last_beat = t0
         try:
             while True:
                 step = min(self.poll_s, max(0.1, deadline - time.monotonic()))
@@ -60,10 +89,20 @@ class InitWatchdog:
                 if now >= deadline:
                     raise subprocess.TimeoutExpired(
                         proc.args, deadline - t0)
-                seen_ready = seen_ready or ready()
+                if not seen_ready and ready():
+                    seen_ready = True
+                    last_beat = now  # the stall clock starts at readiness
                 if now - t0 > self.init_window_s and not seen_ready:
                     self._kill(proc)
                     return INIT_HANG
+                if progress is not None and self.heartbeat_s > 0 \
+                        and seen_ready:
+                    cur = progress()
+                    if cur != last_progress:
+                        last_progress, last_beat = cur, now
+                    elif now - last_beat > self.heartbeat_s:
+                        self._kill(proc)
+                        return MID_RUN_HANG
         except subprocess.TimeoutExpired:
             self._kill(proc)
             return TIMEOUT
@@ -75,6 +114,91 @@ class InitWatchdog:
             proc.wait(timeout=30)
         except subprocess.TimeoutExpired:
             pass  # keep the original diagnosis; the child is a zombie
+
+
+class HeartbeatMonitor:
+    """In-process per-chunk heartbeat deadline: the run loop calls
+    :meth:`beat` at every chunk boundary; if no beat lands within
+    ``heartbeat_s`` the monitor thread classifies the hang —
+    MID_RUN_HANG when at least one chunk completed, INIT_HANG when the
+    very first chunk (compile + first execution) never finished — and
+    fires ``on_hang(status, ticks_done, last_state)`` exactly once.
+
+    The main thread is blocked inside the wedged device computation
+    when this fires, so ``on_hang`` runs on the monitor thread and
+    must only touch already-completed buffers: :meth:`beat` stashes a
+    reference to the last chunk's finished state for exactly that
+    purpose (the resilient harness checkpoints it as the diagnostic
+    state). ``sink`` counts the classification
+    (``sim.runtime.mid_run_hangs`` / ``sim.runtime.backend_hangs``)
+    so the hang is visible in metrics even when the process never
+    returns."""
+
+    def __init__(self, heartbeat_s: float, *,
+                 on_hang: Optional[Callable[[str, int, Any], None]] = None,
+                 sink=None, poll_s: Optional[float] = None):
+        self.heartbeat_s = float(heartbeat_s)
+        self.on_hang = on_hang
+        self.sink = sink
+        self.poll_s = poll_s if poll_s is not None \
+            else max(0.05, self.heartbeat_s / 4.0)
+        self.status: Optional[str] = None  # None until a hang fires
+        self.beats = 0
+        self.ticks_done = 0
+        self._last_state: Any = None
+        self._last_beat = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "HeartbeatMonitor":
+        self._last_beat = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._watch, name="heartbeat-monitor", daemon=True)
+        self._thread.start()
+        return self
+
+    def beat(self, ticks_done: int, state: Any = None):
+        """Mark liveness at a chunk boundary; ``state`` (optional) is
+        the chunk's completed state pytree — the newest buffers that
+        are guaranteed ready if a later computation wedges."""
+        self.beats += 1
+        self.ticks_done = int(ticks_done)
+        if state is not None:
+            self._last_state = state
+        self._last_beat = time.monotonic()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(1.0, 2 * self.poll_s))
+
+    def __enter__(self) -> "HeartbeatMonitor":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _watch(self):
+        while not self._stop.wait(self.poll_s):
+            if time.monotonic() - self._last_beat <= self.heartbeat_s:
+                continue
+            self.status = MID_RUN_HANG if self.beats else INIT_HANG
+            if self.sink is not None:
+                self.sink.incr_counter(
+                    "sim.runtime.mid_run_hangs" if self.beats
+                    else "sim.runtime.backend_hangs", 1)
+            if self.on_hang is not None:
+                try:
+                    self.on_hang(self.status, self.ticks_done,
+                                 self._last_state)
+                except Exception:
+                    # Diagnosis must not kill the monitor — the
+                    # classification already landed in .status and
+                    # the sink; the failed dump is worth a traceback.
+                    log.warning("heartbeat on_hang callback failed",
+                                exc_info=True)
+            return  # one-shot: a hang is terminal for this run
 
 
 def with_failover(attempt: Callable[[str], dict],

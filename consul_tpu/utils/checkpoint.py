@@ -62,6 +62,31 @@ def _host_leaves(state: Any) -> list[np.ndarray]:
     return out
 
 
+def _partition_specs(state: Any) -> list:
+    """Per-leaf PartitionSpec manifest (pytree order): each entry is
+    the leaf's axis-name list (``["nodes", None]``-style, JSON-clean)
+    when the leaf carries a :class:`jax.sharding.NamedSharding`, else
+    None. The payload itself is always the globally-gathered view
+    (:func:`_host_leaves`), so this records how the SOURCE run was
+    laid out — the provenance an elastic resume uses to re-shard the
+    same logical partitioning onto whatever mesh the surviving
+    devices support (runtime/harness.restore_placed)."""
+    specs = []
+    for leaf in jax.tree.leaves(state):
+        spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+        if spec is None:
+            specs.append(None)
+            continue
+        axes = []
+        for a in spec:
+            if a is None or isinstance(a, str):
+                axes.append(a)
+            else:  # a tuple of axis names (multi-axis partitioning)
+                axes.append([str(x) for x in a])
+        specs.append(axes)
+    return specs
+
+
 def save(path: str, state: Any, meta: Any = None) -> str:
     """Write ``state`` (any pytree of arrays) to ``path``. Returns the
     payload's hex SHA-256 digest. Crash-safe: fsync before the atomic
@@ -88,6 +113,11 @@ def save(path: str, state: Any, meta: Any = None) -> str:
         "shapes": [list(a.shape) for a in leaves],
         "dtypes": [str(a.dtype) for a in leaves],
         "sha256": digest,
+        # Mesh-shape provenance, not a restore requirement: the
+        # payload is the gathered global view either way, so any
+        # device count can restore it (FORMAT_VERSION unchanged —
+        # old readers ignore the extra key).
+        "partition_spec": _partition_specs(state),
     }
     if meta is not None:
         manifest["meta"] = meta
@@ -140,6 +170,13 @@ def read_meta(path: str) -> Any:
     """The run-provenance ``meta`` the save embedded (or None). Header-
     only read — cheap enough to probe every candidate resume point."""
     return read_manifest(path).get("meta")
+
+
+def read_partition_spec(path: str) -> Any:
+    """The per-leaf PartitionSpec manifest the save recorded (or None
+    for checkpoints written before FORMAT_VERSION 2 grew the key).
+    Header-only read; see :func:`_partition_specs` for the encoding."""
+    return read_manifest(path).get("partition_spec")
 
 
 def restore(path: str, template: Any, *, verify: bool = True) -> Any:

@@ -279,6 +279,130 @@ class TestPackageRules:
 
 
 # ----------------------------------------------------------------------
+# TH108: unbounded host retry loops around a fixed sleep
+# ----------------------------------------------------------------------
+
+class TestTH108:
+    def test_unbounded_probe_loop_fires(self):
+        # The canonical offender: the escape exists but nothing bounds
+        # how long the loop waits for it.
+        rep = _lint({HOST: """
+            import time
+
+            def wait_ready(client):
+                while True:
+                    if client.ping():
+                        break
+                    time.sleep(5)
+        """})
+        assert _rules(rep) == ["TH108"]
+        assert rep.findings[0].symbol == "wait_ready"
+
+    def test_aliased_sleep_fires(self):
+        rep = _lint({HOST: """
+            from time import sleep
+
+            def wait(flagbox):
+                while flagbox.get():
+                    sleep(0.5)
+        """})
+        assert _rules(rep) == ["TH108"]
+
+    def test_deadline_compare_in_test_is_silent(self):
+        rep = _lint({HOST: """
+            import time
+
+            def wait(client, deadline):
+                while time.monotonic() < deadline:
+                    if client.ping():
+                        return True
+                    time.sleep(1)
+                return False
+        """})
+        assert rep.clean
+
+    def test_comparison_gated_escape_is_silent(self):
+        rep = _lint({HOST: """
+            import time
+
+            def wait(client, retries):
+                attempt = 0
+                while True:
+                    attempt += 1
+                    if attempt > retries:
+                        raise TimeoutError
+                    time.sleep(2)
+        """})
+        assert rep.clean
+
+    def test_stop_flag_and_computed_backoff_are_silent(self):
+        rep = _lint({HOST: """
+            import time
+
+            def pump(stop, q):
+                while not stop.is_set():
+                    q.drain()
+                    time.sleep(1)
+
+            def retry(op, delays):
+                while True:
+                    if op():
+                        break
+                    time.sleep(delays.pop())
+        """})
+        # `while not flag` is an externally-bounded loop; a variable
+        # sleep is a computed backoff, not a fixed spin.
+        assert rep.clean
+
+    def test_for_range_retries_is_silent(self):
+        rep = _lint({HOST: """
+            import time
+
+            def retry(op):
+                for _ in range(5):
+                    if op():
+                        return True
+                    time.sleep(1)
+                return False
+        """})
+        assert rep.clean
+
+    def test_nested_loop_sleep_does_not_leak_outward(self):
+        # The inner for paces ITSELF with the sleep; the outer while is
+        # judged on its own (empty) direct body.
+        rep = _lint({HOST: """
+            import time
+
+            def outer(jobs, deadline):
+                while jobs.active():
+                    for j in jobs.batch():
+                        if time.monotonic() > deadline:
+                            return
+                        time.sleep(0.1)
+        """})
+        assert rep.clean
+
+    def test_allowlist_suppresses(self):
+        al = parse_allowlist("""
+            [[allow]]
+            rule = "TH108"
+            path = "consul_tpu/agent/fake.py"
+            symbol = "wait_ready"
+            reason = "external watchdog bounds this process"
+        """)
+        rep = _lint({HOST: """
+            import time
+
+            def wait_ready(client):
+                while True:
+                    if client.ping():
+                        break
+                    time.sleep(5)
+        """}, al)
+        assert rep.clean and len(rep.suppressed) == 1
+
+
+# ----------------------------------------------------------------------
 # callgraph: reachability across modules and hand-off shapes
 # ----------------------------------------------------------------------
 
@@ -487,6 +611,6 @@ class TestPackageGate:
     def test_every_rule_id_is_documented(self):
         assert set(analysis.RULES) == {
             "TH101", "TH102", "TH103", "TH104", "TH105", "TH106",
-            "TH107"}
+            "TH107", "TH108"}
         for rid, rationale in analysis.RULES.items():
             assert rationale.strip(), rid

@@ -87,3 +87,81 @@ def test_manifest_readable(sim, tmp_path):
     assert m["format_version"] == checkpoint.FORMAT_VERSION
     assert m["n_leaves"] == len(jax.tree.leaves(state))
     assert any("view_key" in n for n in m["names"])
+
+
+# ----------------------------------------------------------------------
+# Mesh-shape-agnostic layout: the PartitionSpec manifest
+# ----------------------------------------------------------------------
+
+def _node_mesh(k):
+    import numpy as np
+    from jax.sharding import Mesh
+    from consul_tpu.parallel import mesh as pmesh
+    return Mesh(np.array(jax.devices()[:k]), (pmesh.NODE_AXIS,))
+
+
+def test_partition_spec_recorded_for_sharded_save(sim, tmp_path):
+    """A sharded save records each leaf's axis names (the provenance an
+    elastic resume re-applies); the payload stays the gathered global
+    view, so the format version does not change."""
+    from consul_tpu.parallel import mesh as pmesh
+    from consul_tpu.parallel import shard_step
+    cfg, state, _ = sim
+    placed = shard_step.place(_node_mesh(8), state, cfg.n)
+    p = str(tmp_path / "sharded.bin")
+    checkpoint.save(p, placed)
+    specs = checkpoint.read_partition_spec(p)
+    assert specs is not None
+    assert len(specs) == len(jax.tree.leaves(placed))
+    assert any(s and s[0] == pmesh.NODE_AXIS for s in specs)
+    # Replicated leaves (scalars) record an axis-free entry.
+    assert any(s is None or all(a is None for a in s) for s in specs)
+    assert checkpoint.read_manifest(p)["format_version"] == \
+        checkpoint.FORMAT_VERSION
+
+
+def test_partition_spec_none_for_unsharded_save(sim, tmp_path):
+    cfg, state, _ = sim
+    p = str(tmp_path / "plain.bin")
+    checkpoint.save(p, state)
+    specs = checkpoint.read_partition_spec(p)
+    assert specs is not None and len(specs) == len(jax.tree.leaves(state))
+    assert all(s is None or all(a is None for a in s) for s in specs)
+
+
+def test_sharded_save_restores_without_the_mesh(sim, tmp_path):
+    """The acceptance property behind cross-shape resume: a checkpoint
+    written on 8 devices restores on a mesh-free (single-device)
+    template bit-identically."""
+    from consul_tpu.parallel import shard_step
+    cfg, state, step = sim
+    state = run(state, step, 5)
+    placed = shard_step.place(_node_mesh(8), state, cfg.n)
+    p = str(tmp_path / "xshape.bin")
+    checkpoint.save(p, placed)
+    restored = checkpoint.restore(p, serf.init(cfg, jax.random.PRNGKey(0)))
+    assert_trees_equal(state, restored)
+
+
+def test_pre_manifest_checkpoint_still_restores(sim, tmp_path):
+    """Checkpoints written before the partition_spec key existed (same
+    FORMAT_VERSION, key absent) restore unchanged and report None."""
+    import json
+    cfg, state, _ = sim
+    p = str(tmp_path / "old.bin")
+    checkpoint.save(p, state)
+    with open(p, "rb") as f:
+        f.read(len(checkpoint.MAGIC))
+        mlen = int.from_bytes(f.read(8), "little")
+        manifest = json.loads(f.read(mlen))
+        payload = f.read()
+    del manifest["partition_spec"]
+    mjson = json.dumps(manifest).encode()
+    with open(p, "wb") as f:
+        f.write(checkpoint.MAGIC)
+        f.write(len(mjson).to_bytes(8, "little"))
+        f.write(mjson)
+        f.write(payload)
+    assert checkpoint.read_partition_spec(p) is None
+    restored = checkpoint.restore(p, serf.init(cfg, jax.random.PRNGKey(0)))
+    assert_trees_equal(state, restored)

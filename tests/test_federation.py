@@ -11,11 +11,20 @@ from consul_tpu.models.federation import Federation, FederationConfig
 from consul_tpu.server.router import Router
 
 
+# One shape + seed + chunk across the module: federation runners are
+# memoized process-wide on (cfg, topology content, chunk), so every
+# fresh instance below reuses the fixture's compiled scan instead of
+# paying XLA again. Chunk size never changes results — per-tick keys
+# fold the on-device tick counter (models/federation.py).
+CFG = FederationConfig(n_dc=3, nodes_per_dc=48, servers_per_dc=3)
+SEED = 4
+CHUNK = 30
+
+
 @pytest.fixture(scope="module")
 def fed():
-    cfg = FederationConfig(n_dc=3, nodes_per_dc=48, servers_per_dc=3)
-    f = Federation(cfg, seed=4)
-    f.run(60)  # form both tiers
+    f = Federation(CFG, seed=SEED)
+    f.run(60, chunk=CHUNK)  # form both tiers
     return f
 
 
@@ -33,26 +42,25 @@ class TestFederation:
         assert abs(wan_t - lan_t * 0.4) <= 2
 
     def test_lan_failure_stays_local(self, fed):
-        cfg = FederationConfig(n_dc=2, nodes_per_dc=48, servers_per_dc=3)
-        f = Federation(cfg, seed=5)
-        f.run(30)
+        f = Federation(CFG, seed=SEED)  # fresh state, shared executable
+        f.run(30, chunk=CHUNK)
         # Kill a non-server node in dc0 (index >= servers_per_dc).
-        f.kill(0, jnp.arange(cfg.nodes_per_dc) == 10)
-        f.run(60)
-        h0, h1 = f.lan_health(0), f.lan_health(1)
+        f.kill(0, jnp.arange(CFG.nodes_per_dc) == 10)
+        f.run(60, chunk=CHUNK)
+        h0, h1, h2 = f.lan_health(0), f.lan_health(1), f.lan_health(2)
         assert float(h0.agreement) == 1.0      # dc0 detected it
-        assert int(h0.live_nodes) == cfg.nodes_per_dc - 1
-        assert int(h1.live_nodes) == cfg.nodes_per_dc  # dc1 untouched
+        assert int(h0.live_nodes) == CFG.nodes_per_dc - 1
+        assert int(h1.live_nodes) == CFG.nodes_per_dc  # dc1 untouched
+        assert int(h2.live_nodes) == CFG.nodes_per_dc  # dc2 untouched
         assert float(f.wan_health().agreement) == 1.0  # servers all fine
 
     def test_dead_dc_detected_on_wan(self, fed):
-        cfg = FederationConfig(n_dc=3, nodes_per_dc=32, servers_per_dc=3)
-        f = Federation(cfg, seed=6)
-        f.run(30)
+        f = Federation(CFG, seed=SEED)  # fresh state, shared executable
+        f.run(30, chunk=CHUNK)
         f.kill_dc(2)
         # WAN timing is slow by design (5s probes, suspicion
         # 6*log10(n)*5s, config.go:272-281): give it ~2.5 sim-minutes.
-        f.run(750)
+        f.run(750, chunk=CHUNK)
         h = f.wan_health()
         assert float(h.agreement) == 1.0
         assert float(h.undetected) == 0.0

@@ -1,9 +1,13 @@
 """Resilient run harness (consul_tpu/runtime): checkpoint policy
 triggers, SIGTERM preemption, kill-and-rerun bit-identical resume
 (single-device and sharded, with and without a chaos schedule),
-on-device invariant sentinels (injected corruption fail-fasts with a
-diagnostic checkpoint), the compile-count pin for the sentinel flag,
-and the init-hang watchdog + degraded-mode failover."""
+elastic cross-shape resume (a checkpoint written at one device count
+resumed at another, re-sharded on entry, digest-identical), the
+per-chunk heartbeat deadline (mid-run-hang classification + the
+diagnostic checkpoint written from the monitor thread), on-device
+invariant sentinels (injected corruption fail-fasts with a diagnostic
+checkpoint), the compile-count pins, and the init-hang watchdog +
+degraded-mode failover."""
 
 import json
 import logging
@@ -323,6 +327,271 @@ class TestResumeAcceptance:
         _resume_bit_identical(self.N, 3, None, 64, 32, monkeypatch,
                               tmp_path, mesh=mesh)
 
+    def _mesh(self, k):
+        from jax.sharding import Mesh
+        from consul_tpu.parallel import mesh as pmesh
+        return Mesh(np.array(jax.devices()[:k]), (pmesh.NODE_AXIS,))
+
+    def _cross(self, tmp_path, monkeypatch, save_mesh, resume_mesh):
+        ticks, chunk = 64, 32
+        ref = _sim(n=self.N, seed=3)
+        rt.run_resilient(ref, ticks, chunk=chunk)
+        sim = _sim(n=self.N, seed=3)
+        pol = rt.CheckpointPolicy(directory=str(tmp_path), tag="xs",
+                                  every_ticks=chunk, min_interval_s=0.0)
+        Killed = _interrupt_after_first_save(monkeypatch)
+        with pytest.raises(Killed):
+            rt.run_resilient(sim, ticks, chunk=chunk, policy=pol,
+                             mesh=save_mesh)
+        monkeypatch.undo()
+        sim2 = _sim(n=self.N, seed=3)
+        pol2 = rt.CheckpointPolicy(directory=str(tmp_path), tag="xs",
+                                   every_ticks=chunk, min_interval_s=0.0)
+        rep = rt.run_resilient(sim2, ticks, chunk=chunk, policy=pol2,
+                               mesh=resume_mesh)
+        assert rep.resumed_from_tick > 0 and rep.reshards == 1
+        assert _identical(ref.state, sim2.state)
+
+    def test_cross_shape_sharded_to_single(self, tmp_path, monkeypatch):
+        """The ISSUE acceptance drill at full size: checkpoint written
+        by the 8-way sharded run, resumed single-device, digest
+        identical to the uninterrupted reference."""
+        self._cross(tmp_path, monkeypatch, self._mesh(8), None)
+
+    def test_cross_shape_single_to_sharded(self, tmp_path, monkeypatch):
+        self._cross(tmp_path, monkeypatch, None, self._mesh(8))
+
+
+# ----------------------------------------------------------------------
+# Elastic cross-shape resume
+# ----------------------------------------------------------------------
+
+class TestElasticMesh:
+    def test_largest_usable_survivor_subset(self):
+        from consul_tpu.parallel import mesh as pmesh
+        assert pmesh.elastic_mesh(256).devices.size == 8
+        # 5 survivors, n=256: 5 does not divide 256; 4 does.
+        assert pmesh.elastic_mesh(
+            256, jax.devices()[:5]).devices.size == 4
+        # 6 nodes over 4 survivors: falls to 3.
+        assert pmesh.elastic_mesh(6, jax.devices()[:4]).devices.size == 3
+
+    def test_single_survivor_always_works(self):
+        from consul_tpu.parallel import mesh as pmesh
+        assert pmesh.elastic_mesh(
+            12345, jax.devices()[:1]).devices.size == 1
+
+    def test_dc_axis_preserved(self):
+        from consul_tpu.parallel import mesh as pmesh
+        m = pmesh.elastic_mesh(64, jax.devices()[:8], n_dc=2)
+        assert dict(m.shape) == {pmesh.DC_AXIS: 2, pmesh.NODE_AXIS: 4}
+
+    def test_unhostable_federation_raises(self):
+        from consul_tpu.parallel import mesh as pmesh
+        with pytest.raises(ValueError, match="no usable mesh"):
+            pmesh.elastic_mesh(64, jax.devices()[:2], n_dc=3)
+
+
+class TestElasticResume:
+    """The ISSUE 6 tentpole: a checkpoint written at one device count
+    resumes at another (8->4->1 and back), the state re-sharded on
+    entry (counted as sim.runtime.reshards), with the final digest
+    identical to an uninterrupted run. Works because the on-disk
+    layout is the gathered global view plus a PartitionSpec manifest
+    (utils/checkpoint), and the trajectory identity is deliberately
+    device-count-free."""
+
+    N = 256
+
+    def _mesh(self, k):
+        from jax.sharding import Mesh
+        from consul_tpu.parallel import mesh as pmesh
+        return Mesh(np.array(jax.devices()[:k]), (pmesh.NODE_AXIS,))
+
+    def _cross(self, tmp_path, monkeypatch, save_mesh, resume_mesh,
+               events=None, elastic=False):
+        ticks, chunk = 48, 16
+        ref = _sim(n=self.N, seed=5)
+        rt.run_resilient(ref, ticks, chunk=chunk, events=events)
+
+        sim = _sim(n=self.N, seed=5)
+        pol = rt.CheckpointPolicy(directory=str(tmp_path), tag="el",
+                                  every_ticks=chunk, min_interval_s=0.0)
+        Killed = _interrupt_after_first_save(monkeypatch)
+        with pytest.raises(Killed):
+            rt.run_resilient(sim, ticks, chunk=chunk, events=events,
+                             policy=pol, mesh=save_mesh)
+        monkeypatch.undo()
+
+        sink = _CountingSink()
+        sim2 = _sim(n=self.N, seed=5)
+        pol2 = rt.CheckpointPolicy(directory=str(tmp_path), tag="el",
+                                   every_ticks=chunk, min_interval_s=0.0,
+                                   sink=sink)
+        rep = rt.run_resilient(sim2, ticks, chunk=chunk, events=events,
+                               policy=pol2, mesh=resume_mesh,
+                               elastic=elastic)
+        assert rep.resumed_from_tick > 0 and rep.ticks_done == ticks
+        assert _identical(ref.state, sim2.state)
+        return rep, sink
+
+    def test_sharded_to_smaller_mesh(self, tmp_path, monkeypatch):
+        rep, sink = self._cross(tmp_path, monkeypatch,
+                                self._mesh(8), self._mesh(4))
+        assert rep.reshards == 1
+        assert sink.counters["sim.runtime.reshards"] == 1
+
+    def test_sharded_to_single_device(self, tmp_path, monkeypatch):
+        rep, sink = self._cross(tmp_path, monkeypatch,
+                                self._mesh(8), None)
+        assert rep.reshards == 1
+        assert sink.counters["sim.runtime.reshards"] == 1
+
+    def test_single_device_to_sharded_with_chaos(self, tmp_path,
+                                                 monkeypatch):
+        """The reverse direction, under a chaos schedule: the resumed
+        sharded run replays the remaining faults bit-identically."""
+        rep, sink = self._cross(tmp_path, monkeypatch, None,
+                                self._mesh(8), events=_events())
+        assert rep.reshards == 1
+
+    def test_elastic_rebuilds_from_surviving_devices(self, tmp_path,
+                                                     monkeypatch):
+        """elastic=True needs no explicit mesh: it rebuilds the largest
+        mesh the surviving devices support and re-shards onto it."""
+        rep, sink = self._cross(tmp_path, monkeypatch, None, None,
+                                elastic=True)
+        assert rep.reshards == 1  # saved width 1, resumed width 8
+        assert sink.counters["sim.runtime.reshards"] == 1
+
+    def test_same_shape_resume_counts_no_reshard(self, tmp_path,
+                                                 monkeypatch):
+        rep, sink = self._cross(tmp_path, monkeypatch,
+                                self._mesh(4), self._mesh(4))
+        assert rep.reshards == 0
+        assert "sim.runtime.reshards" not in sink.counters
+
+    def test_compile_count_per_mesh_shape(self, compile_ledger,
+                                          tmp_path):
+        """<= one executable per mesh shape: a second run at a shape
+        this process already compiled adds zero executables."""
+        mesh4 = self._mesh(4)
+        sim = _sim(n=self.N, seed=5)
+        rt.run_resilient(sim, 16, chunk=16, mesh=mesh4)  # warm the shape
+        sim.counters_snapshot()
+        sim2 = _sim(n=self.N, seed=5)
+        with compile_ledger.expect(0, "same mesh shape: cache hit"):
+            rt.run_resilient(sim2, 16, chunk=16, mesh=mesh4)
+
+
+# ----------------------------------------------------------------------
+# Heartbeat: mid-run hang classification + diagnostic checkpoint
+# ----------------------------------------------------------------------
+
+class TestHeartbeatMonitor:
+    def test_no_beat_classifies_init_hang(self):
+        sink = _CountingSink()
+        hangs = []
+        mon = wd.HeartbeatMonitor(
+            0.15, on_hang=lambda s, t, st: hangs.append((s, t, st)),
+            sink=sink, poll_s=0.03).start()
+        try:
+            deadline = time.monotonic() + 5
+            while mon.status is None and time.monotonic() < deadline:
+                time.sleep(0.02)
+        finally:
+            mon.stop()
+        assert mon.status == wd.INIT_HANG
+        assert hangs == [(wd.INIT_HANG, 0, None)]
+        assert sink.counters["sim.runtime.backend_hangs"] == 1
+
+    def test_beat_then_stall_is_mid_run_hang(self):
+        sink = _CountingSink()
+        hangs = []
+        with wd.HeartbeatMonitor(
+                0.15, on_hang=lambda s, t, st: hangs.append((s, t, st)),
+                sink=sink, poll_s=0.03) as mon:
+            mon.beat(16, {"chunk": 1})
+            deadline = time.monotonic() + 5
+            while mon.status is None and time.monotonic() < deadline:
+                time.sleep(0.02)
+        assert mon.status == wd.MID_RUN_HANG
+        # One-shot, and the callback got the last COMPLETED state.
+        assert hangs == [(wd.MID_RUN_HANG, 16, {"chunk": 1})]
+        assert sink.counters["sim.runtime.mid_run_hangs"] == 1
+
+    def test_live_beats_never_fire(self):
+        with wd.HeartbeatMonitor(0.3, poll_s=0.02) as mon:
+            for i in range(5):
+                time.sleep(0.04)
+                mon.beat(i + 1)
+        assert mon.status is None and mon.beats == 5
+
+    def test_on_hang_failure_keeps_classification(self, caplog):
+        def boom(s, t, st):
+            raise RuntimeError("dump failed")
+
+        with caplog.at_level(logging.WARNING,
+                             logger="consul_tpu.runtime.watchdog"):
+            with wd.HeartbeatMonitor(0.1, on_hang=boom,
+                                     poll_s=0.02) as mon:
+                deadline = time.monotonic() + 5
+                while mon.status is None \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.02)
+        assert mon.status == wd.INIT_HANG
+        assert any("on_hang" in r.message for r in caplog.records)
+
+
+class TestMidRunHang:
+    def test_stalled_chunk_classified_and_dumped(self, tmp_path):
+        """A chunk that wedges past the heartbeat deadline is
+        classified mid-run-hang and the LAST COMPLETED state lands as
+        a diagnostic checkpoint — written from the monitor thread,
+        because the main thread is still inside the stuck
+        computation."""
+        sim = _sim(n=64)
+        # Compile outside the heartbeat window (the harness runs the
+        # metrics-off program — warm that exact variant).
+        sim.run(16, chunk=16, with_metrics=False)
+        real_run = cluster_mod.Simulation.run
+        calls = {"n": 0}
+
+        def stall_second(self, *a, **kw):
+            out = real_run(self, *a, **kw)
+            calls["n"] += 1
+            if calls["n"] == 2:
+                time.sleep(2.0)  # wedge inside the second chunk window
+            return out
+
+        cluster_mod.Simulation.run = stall_second
+        try:
+            rep = rt.run_resilient(sim, 48, chunk=16, heartbeat_s=0.4,
+                                   hang_dump_dir=str(tmp_path))
+        finally:
+            cluster_mod.Simulation.run = real_run
+        assert rep.hang_status == wd.MID_RUN_HANG
+        assert rep.ticks_done == 48  # this stall eventually unwedged
+        path = rep.hang_checkpoint
+        assert path == rt.hang_dump_path(str(tmp_path), 32)
+        assert os.path.exists(path)
+        from consul_tpu.utils import checkpoint as ckpt_mod
+        meta = ckpt_mod.read_meta(path)
+        assert meta["classification"] == wd.MID_RUN_HANG
+        assert meta["ticks_done"] == 16  # one chunk of this run
+        # The dump is the completed chunk's exact state.
+        ref = _sim(n=64)
+        ref.run(32, chunk=16, with_metrics=False)
+        restored = ckpt_mod.restore(path, ref.state)
+        assert _identical(ref.state, restored)
+
+    def test_healthy_run_reports_no_hang(self, tmp_path):
+        sim = _sim(n=64)
+        rep = rt.run_resilient(sim, 32, chunk=16, heartbeat_s=30.0,
+                               hang_dump_dir=str(tmp_path))
+        assert rep.hang_status is None and rep.hang_checkpoint is None
+        assert not os.listdir(str(tmp_path))
+
 
 # ----------------------------------------------------------------------
 # Sentinels
@@ -480,6 +749,29 @@ class TestInitWatchdog:
             proc, lambda: True, deadline=time.monotonic() + 0.5)
         assert status == wd.TIMEOUT
         assert proc.poll() is not None
+
+    def test_frozen_progress_is_mid_run_hang(self):
+        """A ready child whose progress probe never moves is a wedged
+        backend, not a slow one — distinct classification from both
+        init-hang (it DID come up) and timeout (we did not wait)."""
+        proc = _spawn("import time; time.sleep(600)")
+        t0 = time.monotonic()
+        status = wd.InitWatchdog(
+            init_window_s=30, poll_s=0.05, heartbeat_s=0.2).watch(
+            proc, lambda: True, deadline=time.monotonic() + 600,
+            progress=lambda: 0)
+        assert status == wd.MID_RUN_HANG
+        assert time.monotonic() - t0 < 30
+        assert proc.poll() is not None
+
+    def test_advancing_progress_is_not_a_hang(self):
+        proc = _spawn("import time; time.sleep(600)")
+        ticker = iter(range(10 ** 6))
+        status = wd.InitWatchdog(
+            init_window_s=30, poll_s=0.05, heartbeat_s=10.0).watch(
+            proc, lambda: True, deadline=time.monotonic() + 0.5,
+            progress=lambda: next(ticker))
+        assert status == wd.TIMEOUT  # deadline, never misdiagnosed
 
 
 class TestWithFailover:
