@@ -37,6 +37,11 @@ RULES = {
              "and no bound/backoff — an unbounded while around a "
              "fixed sleep spins forever on a wedged dependency and "
              "synchronizes retry storms across workers",
+    "TH109": "data-dependent scatter (.at[idx].add/set/...) in traced "
+             "code — XLA lowers it to a serialized HLO scatter on TPU "
+             "(the dense [N, E] update the fused serf core exists to "
+             "avoid); use one-hot matmul/gather shapes or the "
+             "collective reduce-scatter helper",
 }
 
 # TH101: int()/float()/bool() arguments considered static (config
@@ -67,6 +72,10 @@ _DTYPE_CTORS = {
 }
 
 _SCALAR_CASTS = frozenset({"int", "float", "bool"})
+
+# TH109: the indexed-update methods that lower to HLO scatter when the
+# index is a traced array.
+_SCATTER_OPS = frozenset({"add", "set", "max", "min", "mul", "multiply"})
 
 
 def run_rules(mod, traced_ids) -> list:
@@ -225,6 +234,7 @@ class _RuleVisitor(ast.NodeVisitor):
         if in_trace:
             self._rule_th101(node, fq)
             self._rule_th102(node, fq)
+            self._rule_th109(node)
         if self.mod.device_tier:
             self._rule_th104(node, fq)
         self.generic_visit(node)
@@ -279,6 +289,34 @@ class _RuleVisitor(ast.NodeVisitor):
                    f"jnp.{name}(...) without an explicit dtype — "
                    "default promotion differs across platforms; spell "
                    "the dtype")
+
+    def _rule_th109(self, node):
+        """``x.at[idx].add(v)`` (or set/max/min/mul/multiply) inside
+        traced code, where ``idx`` is not a compile-time-static index
+        expression. A static index (``.at[..., 0].set``, ``.at[3:5]``)
+        lowers to a dynamic-update-slice — cheap and vectorized; a
+        traced index lowers to HLO scatter, which TPUs serialize
+        row-by-row. The serf hot path deliberately has zero of these
+        (one-hot matmuls and top-k gathers instead, models/serf.py);
+        this rule keeps new ones from creeping back in. Deliberate
+        scatters (collective.sum_scatter_rows, whose scatter-add IS the
+        reduce-scatter) are allowlisted by symbol."""
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr in _SCATTER_OPS):
+            return
+        sub = f.value
+        if not (isinstance(sub, ast.Subscript)
+                and isinstance(sub.value, ast.Attribute)
+                and sub.value.attr == "at"):
+            return
+        if _static_index(sub.slice):
+            return
+        self._emit(
+            "TH109", node,
+            f".at[{ast.unparse(sub.slice)}].{f.attr}(...) with a "
+            "traced index lowers to a serialized HLO scatter on TPU — "
+            "reformulate as a one-hot matmul / gather, or route "
+            "through the collective reduce-scatter helper")
 
     # -- TH108: unbounded host retry loops ------------------------------
     def visit_While(self, node):
@@ -454,6 +492,23 @@ def _tracer_guard_name(test, mod):
     if cls is None or not cls.rsplit(".", 1)[-1].endswith("Tracer"):
         return None
     return test.args[0].id, not negated
+
+
+def _static_index(node) -> bool:
+    """True when an ``.at[...]`` index is compile-time static —
+    constants (including Ellipsis/None), negative constants, slices
+    with static bounds, and tuples of those. These lower to
+    (dynamic-)update-slice, not scatter, so TH109 stays quiet."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp):
+        return _static_index(node.operand)
+    if isinstance(node, ast.Slice):
+        return all(p is None or _static_index(p)
+                   for p in (node.lower, node.upper, node.step))
+    if isinstance(node, ast.Tuple):
+        return all(_static_index(e) for e in node.elts)
+    return False
 
 
 def _is_static_expr(node) -> bool:

@@ -708,7 +708,12 @@ def cmd_debug(client: Client, args) -> int:
 def _build_sim(args):
     from consul_tpu.config import SimConfig
     from consul_tpu.models.cluster import SerfSimulation, Simulation
+    from consul_tpu.utils import compile_cache
 
+    if getattr(args, "compile_cache", None):
+        compile_cache.enable(args.compile_cache)
+    else:
+        compile_cache.maybe_enable_from_env()
     cfg = SimConfig(n=args.n, view_degree=min(args.view_degree, args.n - 2))
     cls = SerfSimulation if args.serf else Simulation
     return cls(cfg, seed=args.seed)
@@ -903,6 +908,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="bound on consecutive DCN federation "
                              "link retries before a link is marked "
                              "degraded (parallel/dcn LinkPolicy)")
+        sp.add_argument("--compile-cache", default=None, metavar="DIR",
+                        help="persistent XLA compilation cache "
+                             "directory (or CONSUL_TPU_COMPILE_CACHE):"
+                             " a second cold process deserializes "
+                             "executables instead of recompiling")
 
     rn = sub.add_parser(
         "run",

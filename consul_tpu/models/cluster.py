@@ -556,3 +556,15 @@ class SerfSimulation(Simulation):
     @property
     def serf_state(self):
         return self.state
+
+
+@dataclasses.dataclass
+class ReferenceSerfSimulation(SerfSimulation):
+    """SerfSimulation on the pre-fusion reference step
+    (serf.step_reference_counted): the event/query plane runs as its
+    own sweep after the SWIM pass, exactly the PR-1..6 algorithm. Not a
+    production path and not covered by the compile-ledger pins — it
+    exists for the fused-vs-legacy golden parity suite
+    (tests/test_serf_fused.py)."""
+
+    _step_fn = staticmethod(serf_mod.step_reference_counted)

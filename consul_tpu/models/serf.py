@@ -31,13 +31,21 @@ exactly-once per node via the ltime-bucketed dedup buffer plus a
 Lamport recency floor raised on bucket eviction (serf's LTime dedup +
 eventMinTime gates, serf.go:1258-1357) — an event either delivers once
 or, past the window, is rejected as stale; it is never double-applied.
-Dedup identity is a 32-bit avalanche signature of (event key, origin)
-(:func:`_sig`): a collision spuriously dedups a fresh event at
-~2^-31 per (candidate, slot) pair — the same order of modeled loss as
-the buffer-overflow drop above, and half the state/compare traffic of
-carrying the (key, origin) pair per slot.
+Dedup identity is a 32-bit signature of (event key, origin)
+(:func:`_sig`). Below ``_EXACT_SIG_MAX_N`` nodes the signature is an
+EXACT bit-pack of (origin, name, is_query) — the ltime is deliberately
+dropped because the bucket's ``*_bkt_lt`` already carries it
+(the dedup state the fused core deduplicates against), so membership
+is collision-free and bucket-scoped (:func:`_buf_lookup`). Above that
+the pack falls back to a murmur3-finalizer avalanche whose collisions
+spuriously dedup at ~2^-31 per (candidate, slot) pair — the same order
+of modeled loss as the buffer-overflow drop above.
 Fresh arrivals stage into the receiver's own broadcast queue (receive ≠
-deliver, see _event_phase) and deliver oldest-first at one per tick.
+deliver) and deliver oldest-first at one per tick. The fused core
+(:func:`step_counted`) rides event/query packets on the SAME per-tick
+gossip exchange as the SWIM plane (swim._gossip_phase ``extra_tx``);
+the pre-fusion two-sweep algorithm is preserved verbatim as
+:func:`step_reference` for golden parity testing.
 Bounded-capacity divergences (vs Go's unbounded structures): intake 2
 arrivals/tick, queue eviction under pressure, ``seen_width`` concurrent
 same-ltime origins per bucket.
@@ -65,6 +73,28 @@ from consul_tpu.ops.topology import World
 _NAME_SHIFT = 1
 _LTIME_SHIFT = 9
 
+# Up to here (origin + 1) << 9 stays below bit 31, so the exact-pack
+# dedup signature ((1<<31) | (origin+1)<<9 | name/is_query) is
+# collision-free; larger clusters fall back to the avalanche hash.
+_EXACT_SIG_MAX_N = 1 << 21
+
+
+def origin_dtype(n: int):
+    """Narrowest signed dtype holding every origin row id (plus the -1
+    empty marker) for an ``n``-node cluster — the packed ``ev_origin``
+    storage dtype. Widened to int32 at every transport/arithmetic
+    boundary (parallel/collective.roll_many only moves 32-bit lanes)."""
+    return jnp.int16 if n <= 32767 else jnp.int32
+
+
+def _tx_dtype(cfg: SimConfig):
+    """Narrowest dtype for remaining-transmit counters: the retransmit
+    budget is ``mult * ceil(log10(n+1))`` (ops/scaling.py) — 28 at one
+    million nodes — so int8 holds any sane configuration."""
+    with jax.ensure_compile_time_eval():
+        lim = int(scaling.retransmit_limit(cfg.gossip.retransmit_mult, cfg.n))
+    return jnp.int8 if lim <= 127 else jnp.int32
+
 
 def make_event_key(ltime, name, is_query=False):
     lt = jnp.asarray(ltime, jnp.uint32)
@@ -87,10 +117,16 @@ class SerfState(NamedTuple):
     clock: jax.Array         # [N] uint32 — membership intents
     event_clock: jax.Array   # [N] uint32
     query_clock: jax.Array   # [N] uint32
-    # -- user-event/query broadcast queue -----------------------------
+    # -- user-event/query broadcast queue (packed narrow dtypes:
+    # origin_dtype(n) for origins, int8 transmit budgets — widened to
+    # 32-bit at the roll_many transport boundary) ---------------------
     ev_key: jax.Array        # [N, E] uint32, 0 = empty
-    ev_origin: jax.Array     # [N, E] int32
-    ev_tx: jax.Array         # [N, E] int32 transmits remaining
+    ev_origin: jax.Array     # [N, E] origin_dtype(n)
+    ev_tx: jax.Array         # [N, E] int8 transmits remaining
+    # Staged-but-undelivered marker: the fused core delivers from this
+    # bit (receive != deliver); locally-originated entries are born
+    # delivered (pending=False) — they hit the dedup buffer at submit.
+    ev_pending: jax.Array    # [N, E] bool
     # -- recent-event dedup buffers (ltime-bucketed; see module doc) ---
     ev_bkt_lt: jax.Array     # [N, R] uint32 ltime owning each bucket, 0=empty
     ev_bkt_sig: jax.Array    # [N, R, O] uint32 (key, origin) sigs, 0=empty
@@ -133,8 +169,9 @@ def init(cfg: SimConfig, key) -> SerfState:
         event_clock=jnp.ones((n,), jnp.uint32),
         query_clock=jnp.ones((n,), jnp.uint32),
         ev_key=jnp.zeros((n, e), jnp.uint32),
-        ev_origin=jnp.full((n, e), -1, jnp.int32),
-        ev_tx=jnp.zeros((n, e), jnp.int32),
+        ev_origin=jnp.full((n, e), -1, origin_dtype(n)),
+        ev_tx=jnp.zeros((n, e), _tx_dtype(cfg)),
+        ev_pending=jnp.zeros((n, e), bool),
         ev_bkt_lt=jnp.zeros((n, r), jnp.uint32),
         ev_bkt_sig=jnp.zeros((n, r, o), jnp.uint32),
         q_bkt_lt=jnp.zeros((n, r), jnp.uint32),
@@ -175,23 +212,30 @@ def _scatter_cols(arr, cols, vals):
     return jnp.where(hit, newv.astype(arr.dtype), arr)
 
 
-def _equeue_push(cfg: SimConfig, s: SerfState, mask, key_, origin, tx0):
+def _equeue_push(cfg: SimConfig, s: SerfState, mask, key_, origin, tx0,
+                 pending: bool = False):
     """Insert one event per masked node into its event queue — same slot
     semantics as the SWIM broadcast queue (invalidate same subject,
     else empty slot, else evict most-transmitted; queue.go:182-242).
 
+    ``pending`` marks the entry staged-but-undelivered (intake path);
+    locally-originated entries push with ``pending=False`` — their
+    origin delivered them to itself at submit time.
+
     Returns (state, evicted[N] bool) — evicted marks nodes whose push
     displaced a *different* live entry under queue pressure (same-subject
     replacement is an update, not a drop)."""
-    same = (s.ev_key == key_[:, None]) & (s.ev_origin == origin[:, None])
+    same = (s.ev_key == key_[:, None]) & (
+        s.ev_origin.astype(jnp.int32) == origin[:, None]
+    )
     # Unlike swim._queue_push, a spent (tx<=0) slot is NOT free here:
-    # retirement is explicit (ev_key=0 in _event_phase) because a spent
+    # retirement is explicit (ev_key=0 in the step) because a spent
     # entry may still be awaiting its local delivery turn.
     empty = s.ev_key == 0
     score = (
         jnp.where(same, 3_000_000, 0)
         + jnp.where(empty, 2_000_000, 0)
-        + (1_000_000 - jnp.minimum(s.ev_tx, 999_999))
+        + (1_000_000 - jnp.minimum(s.ev_tx.astype(jnp.int32), 999_999))
     )
     slot = jnp.argmax(score, axis=1)
     e = cfg.serf.event_queue_slots
@@ -199,16 +243,36 @@ def _equeue_push(cfg: SimConfig, s: SerfState, mask, key_, origin, tx0):
     evicted = jnp.any(onehot & ~same & ~empty, axis=1)
     return s._replace(
         ev_key=jnp.where(onehot, key_[:, None], s.ev_key),
-        ev_origin=jnp.where(onehot, origin[:, None], s.ev_origin),
-        ev_tx=jnp.where(onehot, tx0, s.ev_tx),
+        ev_origin=jnp.where(
+            onehot, origin[:, None], s.ev_origin.astype(jnp.int32)
+        ).astype(s.ev_origin.dtype),
+        ev_tx=jnp.where(onehot, tx0, s.ev_tx.astype(jnp.int32)).astype(
+            s.ev_tx.dtype),
+        ev_pending=jnp.where(onehot, pending, s.ev_pending),
     ), evicted
 
 
-def _sig(key_, origin):
-    """32-bit dedup identity of (event key, origin): a murmur3-finalizer
-    avalanche of the pair, forced nonzero (0 = empty slot). A collision
-    spuriously dedups at ~2^-31 per (candidate, slot) compare — the
-    module docstring's modeled-loss bound."""
+def _sig(cfg: SimConfig, key_, origin):
+    """32-bit dedup identity of (event key, origin), 0 reserved = empty.
+
+    Below ``_EXACT_SIG_MAX_N`` nodes: an EXACT pack of
+    ``(1<<31) | (origin+1)<<9 | (name<<1 | is_query)`` — collision-free,
+    and the ltime is deliberately NOT packed: the bucket's ``*_bkt_lt``
+    already owns it, so carrying it per slot would duplicate dedup
+    state (:func:`_buf_lookup` scopes membership to the candidate's
+    bucket and guards on ``bkt_lt == ltime`` instead). Nonzero by the
+    forced top bit; ``origin+1`` keeps even the -1 empty marker in
+    range. Above the cap: the murmur3-finalizer avalanche of the full
+    (key, origin) pair, colliding at ~2^-31 per compare — the module
+    docstring's modeled-loss bound."""
+    if cfg.n <= _EXACT_SIG_MAX_N:
+        org = jnp.asarray(origin, jnp.int32) + 1
+        low = jnp.asarray(key_, jnp.uint32) & jnp.uint32((1 << _LTIME_SHIFT) - 1)
+        return (
+            jnp.uint32(1 << 31)
+            | (org.astype(jnp.uint32) << _LTIME_SHIFT)
+            | low
+        )
     h = jnp.asarray(key_, jnp.uint32) ^ (
         jnp.asarray(origin, jnp.int32).astype(jnp.uint32)
         * jnp.uint32(0x9E3779B9)
@@ -234,25 +298,32 @@ def _buf_lookup(cfg: SimConfig, bkt_lt, bkt_sig, floor, key_, origin):
 
     Cost shape (the serf plane's hottest path — this went through two
     rounds of on-chip whole-step A/Bs, BASELINE.md): membership is ONE
-    [N, E, R·O] bool compare of the candidate sig against every slot —
-    valid without addressing the bucket because ``_buf_apply``'s
-    takeover-clearing keeps every live slot's ltime equal to its
-    bucket's, so a sig equality already implies the right bucket. The
-    only per-candidate bucket selects left are over the [N, R] bucket
-    ltimes and a precomputed [N, R] fullness bit (one-hot via
-    swim._take_cols — per-row-indexed gathers are the 90x TPU cliff).
-    No [N, E, R, O]-shaped intermediate survives.
+    [N, E, R·O] bool compare of the candidate sig against every slot,
+    scoped to the candidate's bucket by a second compare on the flat
+    slot->bucket table (``arange(R*O) // O`` — bucket-major layout) and
+    guarded on ``bkt_lt == ltime``. The bucket scoping is what lets the
+    exact-pack signature drop the ltime (it lives once per bucket in
+    ``bkt_lt``, not per slot): a same-(name, origin) sig surviving from
+    an older ltime sits under a bucket whose ``bkt_lt`` differs, so the
+    guard rejects the false hit. The only per-candidate bucket selects
+    are over the [N, R] bucket ltimes and a precomputed [N, R] fullness
+    bit (one-hot via swim._take_cols — per-row-indexed gathers are the
+    90x TPU cliff). No [N, E, R, O]-shaped intermediate survives.
     """
-    r = cfg.serf.seen_ring
+    r, o = cfg.serf.seen_ring, cfg.serf.seen_width
     lt = event_ltime(key_)                      # [N, E]
     b = (lt % jnp.uint32(r)).astype(jnp.int32)
     blt = swim._take_cols(bkt_lt, b)            # [N, E]
     full = swim._take_cols(jnp.all(bkt_sig != 0, axis=2), b)   # [N, E]
     flat = bkt_sig.reshape(bkt_sig.shape[0], -1)               # [N, R*O]
+    slot_bucket = jnp.arange(r * o, dtype=jnp.int32) // o      # [R*O]
     hit = jnp.any(
-        flat[:, None, :] == _sig(key_, origin)[:, :, None], axis=2
+        (flat[:, None, :] == _sig(cfg, key_, origin)[:, :, None])
+        & (slot_bucket[None, None, :] == b[:, :, None]),
+        axis=2,
     )
-    return hit | (full & (blt == lt)) | (blt > lt) | (lt < floor[:, None])
+    return (hit & (blt == lt)) | (full & (blt == lt)) | (blt > lt) \
+        | (lt < floor[:, None])
 
 
 def _buf_apply(cfg: SimConfig, bkt_lt, bkt_sig, floor, mask, key_, origin):
@@ -285,7 +356,7 @@ def _buf_apply(cfg: SimConfig, bkt_lt, bkt_sig, floor, mask, key_, origin):
     slot = jnp.where(takeover, 0, free)
     s_oh = (jnp.arange(o, dtype=jnp.int32)[None, :] == slot[:, None])
     new_slot_sig = jnp.where(
-        s_oh, _sig(key_, origin)[:, None],
+        s_oh, _sig(cfg, key_, origin)[:, None],
         jnp.where(takeover[:, None], 0, cur_sig),
     )
     bkt_sig = jnp.where(b_oh[:, :, None], new_slot_sig[:, None, :], bkt_sig)
@@ -399,21 +470,63 @@ def step(cfg: SimConfig, topo, world: World, s: SerfState, key,
 
 def step_counted(cfg: SimConfig, topo, world: World, s: SerfState, key,
                  sched=None, *, sentinel: bool = False):
-    """One serf tick: SWIM membership tick, then event/query gossip,
-    response tally, query expiry, and reap bookkeeping. Returns
+    """One FUSED serf tick: the event/query plane rides the SAME per-tick
+    gossip exchange as the SWIM probe/ack plane (swim._gossip_phase
+    ``extra_tx`` hook) instead of running a second full sweep over the
+    view. Sender-side selection (the top-``piggyback_events`` queue
+    entries by remaining budget) happens before the membership tick;
+    the packets roll with the membership gossip; intake, delivery,
+    budget decrement and the query-response tally run after. Returns
     (SerfState, GossipCounters) — the SWIM tick's counters plus the
     serf intent-queue tallies. ``sched`` (optional chaos schedule, see
-    swim.step_counted) gates the serf dissemination legs too — the same
-    tick's terms apply to the membership and the event planes.
-    ``sentinel`` additionally validates the serf plane's Lamport clocks
-    (monotone within the tick — they only move through lamport.witness)
-    on top of the SWIM-plane checks (swim._sentinel_check)."""
+    swim.step_counted) gates the fused legs — one drop draw per leg
+    covers both planes (they share the packet). ``sentinel``
+    additionally validates the serf plane's Lamport clocks (monotone
+    within the tick — they only move through lamport.witness) on top
+    of the SWIM-plane checks (swim._sentinel_check).
+
+    Pre-fusion algorithm preserved as :func:`step_reference_counted`
+    (golden parity, tests/test_serf_fused.py). Documented 1-tick
+    divergences vs the reference step: sends are selected from the
+    pre-tick queue (the reference selects post-delivery), and a node
+    whose oldest staged entry went stale delivers nothing that tick
+    instead of skipping to the next fresh entry."""
     k_swim, k_ev = jax.random.split(key)
     t = s.swim.t
     chaos_on = sched is not None and not chaos_mod.is_empty(sched)
     clocks0 = (s.clock, s.event_clock, s.query_clock)
-    sw, cnt = swim.step_counted(cfg, topo, world, s.swim, k_swim, sched,
-                                sentinel=sentinel)
+
+    # ---- Sender-side selection: most-retransmittable queue entries,
+    # chosen BEFORE the membership tick so they ride its gossip rolls.
+    # Static argmax peeling instead of lax.top_k (sort-lowered on TPU)
+    # — pe is tiny and the peel is pure compare-select; selection is
+    # identical to top_k's (max value, lowest index on ties). The
+    # narrow queue dtypes widen here: roll_many moves 32-bit lanes.
+    pe = cfg.serf.piggyback_events
+    e_slots = cfg.serf.event_queue_slots
+    slots_i = jnp.arange(e_slots, dtype=jnp.int32)
+    peel_tx, m_tx_l, order_l = s.ev_tx.astype(jnp.int32), [], []
+    for _ in range(pe):
+        best = jnp.argmax(peel_tx, axis=1).astype(jnp.int32)
+        m_tx_l.append(jnp.max(peel_tx, axis=1))
+        order_l.append(best)
+        peel_tx = jnp.where(
+            slots_i[None, :] == best[:, None], jnp.iinfo(jnp.int32).min,
+            peel_tx,
+        )
+    m_tx = jnp.stack(m_tx_l, axis=1)
+    order = jnp.stack(order_l, axis=1)
+    m_key = swim._take_cols(s.ev_key, order)
+    m_origin = swim._take_cols(s.ev_origin, order).astype(jnp.int32)
+    # No activity gate here: the per-sender liveness gate lives in the
+    # gossip phase (ex_sendable — inactive senders reach zero legs, so
+    # their budgets never decrement either).
+    m_valid = (m_key > 0) & (m_tx > 0)
+
+    sw, cnt, (ex_legs, ex_n_sends) = swim.step_counted(
+        cfg, topo, world, s.swim, k_swim, sched, sentinel=sentinel,
+        extra_tx=[m_key, m_origin, m_valid],
+    )
     terms = chaos_mod.node_terms(sched, t) if chaos_on else None
     # Pending graceful leaves whose propagate window closed go quiet now
     # (serf.Leave sleeps LeavePropagateDelay then shuts memberlist down).
@@ -422,8 +535,9 @@ def step_counted(cfg: SimConfig, topo, world: World, s: SerfState, key,
     s = s._replace(swim=sw, leave_at=jnp.where(quiet, -1, s.leave_at))
     active = sw.alive_truth & ~sw.left
 
-    s, (n_queued, n_retx, n_dropped) = _event_phase(
-        cfg, topo, s, active, k_ev,
+    s, (n_queued, n_retx, n_dropped) = _fused_event_post(
+        cfg, topo, s, active, k_ev, ex_legs, ex_n_sends,
+        m_tx, order, m_valid,
         sched if chaos_on else None, terms,
     )
     cnt = cnt._replace(
@@ -450,6 +564,66 @@ def step_counted(cfg: SimConfig, topo, world: World, s: SerfState, key,
         # lamport.witness (a max), so a within-tick regression is
         # corruption. Folds into the same counter the SWIM-plane
         # incarnation check uses.
+        regress = sum(
+            counters_mod.count(after < before)
+            for before, after in zip(
+                clocks0, (s.clock, s.event_clock, s.query_clock))
+        )
+        cnt = cnt._replace(
+            sentinel_monotonic=cnt.sentinel_monotonic + regress)
+    return s, cnt
+
+
+def step_reference(cfg: SimConfig, topo, world: World, s: SerfState, key,
+                   sched=None, *, sentinel: bool = False) -> SerfState:
+    """Pre-fusion serf tick (counter-free wrapper); see
+    :func:`step_reference_counted`."""
+    return step_reference_counted(cfg, topo, world, s, key, sched,
+                                  sentinel=sentinel)[0]
+
+
+def step_reference_counted(cfg: SimConfig, topo, world: World, s: SerfState,
+                           key, sched=None, *, sentinel: bool = False):
+    """The PRE-FUSION serf tick: the SWIM membership tick first, then
+    the event/query plane as a second full sweep over the view
+    (_event_phase_ref — the algorithm :func:`step_counted` replaced).
+    Kept verbatim (modulo the packed queue dtypes, which widen at the
+    same boundaries) as the golden reference for the fused-vs-legacy
+    parity suite: same seed, same SWIM trajectory — the fused step must
+    reproduce its delivered-event sets, Lamport floors and SLO
+    counters. Not a production path; no compile-ledger pin covers it."""
+    k_swim, k_ev = jax.random.split(key)
+    t = s.swim.t
+    chaos_on = sched is not None and not chaos_mod.is_empty(sched)
+    clocks0 = (s.clock, s.event_clock, s.query_clock)
+    sw, cnt = swim.step_counted(cfg, topo, world, s.swim, k_swim, sched,
+                                sentinel=sentinel)
+    terms = chaos_mod.node_terms(sched, t) if chaos_on else None
+    quiet = (s.leave_at >= 0) & (sw.t >= s.leave_at)
+    sw = sw._replace(left=sw.left | quiet)
+    s = s._replace(swim=sw, leave_at=jnp.where(quiet, -1, s.leave_at))
+    active = sw.alive_truth & ~sw.left
+
+    s, (n_queued, n_retx, n_dropped) = _event_phase_ref(
+        cfg, topo, s, active, k_ev,
+        sched if chaos_on else None, terms,
+    )
+    cnt = cnt._replace(
+        serf_intents_queued=n_queued,
+        serf_intents_retx=n_retx,
+        serf_intents_dropped=n_dropped,
+    )
+
+    expired = (s.q_open_key > 0) & (sw.t >= s.q_deadline)
+    s = s._replace(q_open_key=jnp.where(expired, 0, s.q_open_key))
+
+    st = merge.key_status(sw.view_key)
+    is_down = (st == merge.DEAD) | (st == merge.LEFT)
+    down_since = jnp.where(
+        is_down & (s.down_since < 0), t, jnp.where(is_down, s.down_since, -1)
+    )
+    s = s._replace(down_since=down_since)
+    if sentinel:
         regress = sum(
             counters_mod.count(after < before)
             for before, after in zip(
@@ -574,36 +748,160 @@ def _query_response_tally(cfg: SimConfig, topo, s: SerfState, active,
     return jax.lax.cond(jnp.any(s.q_open_key > 0), tally, lambda s: s, s)
 
 
-def _event_phase(cfg: SimConfig, topo, s: SerfState, active, key,
-                 sched=None, terms=None):
-    """Single-chip, an IDLE event plane costs zero: with no queued
-    event anywhere and no open query, every mask in the body is false
-    and the state passes through — so the whole phase rides one
-    ``lax.cond`` on "any traffic at all". A quiet serf cluster then
-    ticks at SWIM speed (the reference's equivalent: no packets, no
-    work), and the full-serf northstar pays for the event plane only
-    while the epidemic is actually in flight. Under sharding the body
-    runs unconditionally: its collectives cannot sit inside
+def _fused_event_post(cfg: SimConfig, topo, s: SerfState, active, key,
+                      ex_legs, ex_n_sends, m_tx, order, m_valid,
+                      sched=None, terms=None):
+    """Post-gossip half of the fused event plane: delivery, budget
+    decrement, intake, query tally. Single-chip, an IDLE event plane
+    costs (almost) zero: with no queued event anywhere and no open
+    query, every mask in the body is false and the state passes
+    through — the whole block rides one ``lax.cond`` on "any traffic
+    at all" (the fused legs still rolled a few all-zero lanes with the
+    membership packets — the only idle cost left). Under sharding the
+    body runs unconditionally: its collectives cannot sit inside
     data-dependent control flow, and the budget census pins them.
 
     Returns (state, (queued[] i32, retransmits[] i32, drops[] i32)) —
     the idle branch returns zeros of the same structure so both cond
     branches match."""
     if coll.sharded():
-        return _event_phase_body(cfg, topo, s, active, key, sched, terms)
+        return _fused_event_post_body(
+            cfg, topo, s, active, key, ex_legs, ex_n_sends, m_tx, order,
+            m_valid, sched, terms)
     busy = jnp.any(s.ev_key > 0) | jnp.any(s.q_open_key > 0)
     z = jnp.zeros((), jnp.int32)
     return jax.lax.cond(
         busy,
-        lambda st: _event_phase_body(cfg, topo, st, active, key, sched,
-                                     terms),
+        lambda st: _fused_event_post_body(
+            cfg, topo, st, active, key, ex_legs, ex_n_sends, m_tx, order,
+            m_valid, sched, terms),
         lambda st: (st, (z, z, z)),
         s,
     )
 
 
-def _event_phase_body(cfg: SimConfig, topo, s: SerfState, active, key,
-                      sched=None, terms=None):
+def _fused_event_post_body(cfg: SimConfig, topo, s: SerfState, active, key,
+                           ex_legs, ex_n_sends, m_tx, order, m_valid,
+                           sched=None, terms=None):
+    """Deliver → decrement/retire → intake, consuming the fused legs.
+
+    ``ex_legs`` is swim._gossip_phase's extra-plane output: per leg,
+    the rolled (key, origin, valid) payload of this receiver's sender
+    plus the leg's arrival mask (loss/chaos/receiver-liveness already
+    applied — the packets shared the membership plane's draws).
+    ``ex_n_sends`` counts each sender's delivered legs; ``m_tx`` /
+    ``order`` / ``m_valid`` are the sender-side selection the budget
+    decrement must mirror (selected pre-tick, see step_counted).
+
+    Delivery runs off the ``ev_pending`` bit (receive != deliver): the
+    oldest staged-undelivered entry per node delivers each tick, after
+    a staleness re-check against the dedup buffer — the floor may have
+    risen (bucket eviction) or a duplicate delivered since staging; a
+    stale winner is dropped (pending cleared) without delivering.
+    Entries retire (ev_key=0) once their budget is spent AND they are
+    not pending — a spent undelivered entry survives to deliver."""
+    n = cfg.n
+    e_slots = cfg.serf.event_queue_slots
+    slots_i = jnp.arange(e_slots, dtype=jnp.int32)
+    grows = coll.rows(n)                      # global ids (identity)
+    k_resp = key
+    sentinel = jnp.uint32(0xFFFFFFFF)
+    with jax.ensure_compile_time_eval():
+        tx_limit = int(scaling.retransmit_limit(cfg.gossip.retransmit_mult, n))
+
+    # ---- 1. Deliver: oldest staged-undelivered entry of the own queue.
+    pend = s.ev_pending & (s.ev_key > 0) & active[:, None]
+    del_key = jnp.min(jnp.where(pend, s.ev_key, sentinel), axis=1)
+    has = del_key != sentinel
+    slot_match = pend & (s.ev_key == del_key[:, None])
+    del_slot = jnp.argmax(slot_match, axis=1)
+    del_origin = swim._take_col(s.ev_origin, del_slot).astype(jnp.int32)
+    wkey = jnp.where(has, del_key, 0)
+    worig = jnp.where(has, del_origin, 0)
+    stale = _lookup_any(cfg, s, wkey[:, None], worig[:, None])[:, 0]
+    deliver = has & ~stale
+    s = _seen_append(cfg, s, deliver, wkey, worig)
+    lt = event_ltime(wkey)
+    isq = event_is_query(wkey) & deliver
+    isev = ~event_is_query(wkey) & deliver
+    s = s._replace(
+        event_clock=lamport.witness(s.event_clock, lt, isev),
+        query_clock=lamport.witness(s.query_clock, lt, isq),
+    )
+    s = _query_response_tally(cfg, topo, s, active, worig, wkey, isq,
+                              grows, k_resp, sched, terms)
+    # The winner's pending bit clears whether it delivered or proved
+    # stale (a stale entry must not win the min again next tick).
+    cleared = (slots_i[None, :] == del_slot[:, None]) & has[:, None]
+    ev_pending = s.ev_pending & ~cleared
+
+    # ---- 2. Budget decrement by the fused plane's actual sends, then
+    # retire spent delivered entries.
+    sends = ex_n_sends[:, None] * jnp.where(m_valid, 1, 0)
+    ev_tx = _scatter_cols(s.ev_tx, order, jnp.maximum(m_tx - sends, 0))
+    retire = (ev_tx <= 0) & ~ev_pending
+    s = s._replace(
+        ev_tx=ev_tx,
+        ev_key=jnp.where(retire, 0, s.ev_key),
+        ev_pending=ev_pending,
+    )
+
+    # ---- 3. Intake: stage up to 2 fresh arrivals off the fused legs.
+    cand_key, cand_orig = [], []
+    for payload, ex_arrived in ex_legs:
+        r_key, r_orig, r_valid = payload
+        ok = ex_arrived[:, None] & r_valid
+        cand_key.append(jnp.where(ok, r_key, 0))
+        cand_orig.append(jnp.where(ok, r_orig, -1))
+    ckey = jnp.concatenate(cand_key, axis=1)       # [N, fan*PE]
+    corig = jnp.concatenate(cand_orig, axis=1)
+    fresh = (ckey > 0) & ~_lookup_any(cfg, s, ckey, corig)
+    n_queued = jnp.zeros((), jnp.int32)
+    n_dropped = jnp.zeros((), jnp.int32)
+    for _ in range(2):
+        win_key = jnp.min(jnp.where(fresh, ckey, sentinel), axis=1)
+        got = win_key != sentinel
+        slot_i = jnp.argmax(fresh & (ckey == win_key[:, None]), axis=1)
+        win_orig = swim._take_col(corig, slot_i)
+        s, evicted = _equeue_push(
+            cfg, s, got, jnp.where(got, win_key, 0),
+            jnp.where(got, win_orig, -1), tx_limit, pending=True,
+        )
+        n_queued = n_queued + counters_mod.count(got)
+        n_dropped = n_dropped + counters_mod.count(evicted)
+        taken = (ckey == win_key[:, None]) & (corig == win_orig[:, None]) \
+            & got[:, None]
+        fresh = fresh & ~taken
+    n_retx = jnp.sum(sends).astype(jnp.int32)
+    return s, (n_queued, n_retx, n_dropped)
+
+
+def _event_phase_ref(cfg: SimConfig, topo, s: SerfState, active, key,
+                     sched=None, terms=None):
+    """Pre-fusion event phase (the second-sweep algorithm), kept for
+    :func:`step_reference_counted`. Single-chip the whole phase rides
+    one ``lax.cond`` on "any traffic at all"; under sharding the body
+    runs unconditionally (collectives cannot sit inside data-dependent
+    control flow).
+
+    Returns (state, (queued[] i32, retransmits[] i32, drops[] i32)) —
+    the idle branch returns zeros of the same structure so both cond
+    branches match."""
+    if coll.sharded():
+        return _event_phase_body_ref(cfg, topo, s, active, key, sched, terms)
+    busy = jnp.any(s.ev_key > 0) | jnp.any(s.q_open_key > 0)
+    z = jnp.zeros((), jnp.int32)
+    return jax.lax.cond(
+        busy,
+        lambda st: _event_phase_body_ref(cfg, topo, st, active, key, sched,
+                                         terms),
+        lambda st: (st, (z, z, z)),
+        s,
+    )
+
+
+def _event_phase_body_ref(cfg: SimConfig, topo, s: SerfState, active, key,
+                          sched=None, terms=None):
     """Receive → queue → deliver pipeline for user events and queries.
 
     Receiving and delivering are decoupled, as in the reference (every
@@ -647,7 +945,7 @@ def _event_phase_body(cfg: SimConfig, topo, s: SerfState, active, key,
     # _equeue_push's same-subject replacement prevents).
     slot_match = q_fresh & (s.ev_key == del_key[:, None])
     del_slot = jnp.argmax(slot_match, axis=1)
-    del_origin = swim._take_col(s.ev_origin, del_slot)
+    del_origin = swim._take_col(s.ev_origin, del_slot).astype(jnp.int32)
     wkey = jnp.where(has, del_key, 0)
     worig = jnp.where(has, del_origin, 0)
 
@@ -670,7 +968,7 @@ def _event_phase_body(cfg: SimConfig, topo, s: SerfState, active, key,
     # identical to top_k's (max value, lowest index on ties). One-hot
     # column selects throughout (the no-gather style; argsort +
     # take_along_axis gathers are the TPU cliff — BASELINE.md).
-    peel_tx, m_tx_l, order_l = s.ev_tx, [], []
+    peel_tx, m_tx_l, order_l = s.ev_tx.astype(jnp.int32), [], []
     slots_i = jnp.arange(e_slots, dtype=jnp.int32)
     for _ in range(pe):
         best = jnp.argmax(peel_tx, axis=1).astype(jnp.int32)
@@ -683,7 +981,7 @@ def _event_phase_body(cfg: SimConfig, topo, s: SerfState, active, key,
     m_tx = jnp.stack(m_tx_l, axis=1)
     order = jnp.stack(order_l, axis=1)
     m_key = swim._take_cols(s.ev_key, order)
-    m_origin = swim._take_cols(s.ev_origin, order)
+    m_origin = swim._take_cols(s.ev_origin, order).astype(jnp.int32)
     m_valid = (m_key > 0) & (m_tx > 0) & active[:, None]
 
     jcols = jax.random.randint(k_cols, (fan,), 0, k_deg)
@@ -742,7 +1040,7 @@ def _event_phase_body(cfg: SimConfig, topo, s: SerfState, active, key,
         win_orig = swim._take_col(corig, slot_i)
         s, evicted = _equeue_push(
             cfg, s, got, jnp.where(got, win_key, 0),
-            jnp.where(got, win_orig, -1), tx_limit,
+            jnp.where(got, win_orig, -1), tx_limit, pending=True,
         )
         n_queued = n_queued + counters_mod.count(got)
         n_dropped = n_dropped + counters_mod.count(evicted)
@@ -780,11 +1078,15 @@ def newest_query_slot(s: SerfState, row: int) -> int:
 def event_coverage(cfg: SimConfig, s: SerfState, key_, origin) -> jax.Array:
     """Fraction of active nodes whose dedup buffer holds (key, origin) —
     the "did the event reach everyone" question serf's convergence
-    simulator answers (lib/serf.go:21-25 comment)."""
+    simulator answers (lib/serf.go:21-25 comment). Under the exact-pack
+    signature this aliases same-(name, origin) events across ltimes —
+    coverage probes should use distinct (name, origin) pairs (the
+    bucket-scoped dedup in :func:`_buf_lookup` does NOT alias; only
+    this whole-buffer membership sweep does)."""
     active = s.swim.alive_truth & ~s.swim.left
     key_ = jnp.asarray(key_, jnp.uint32)
     bkt_sig = jnp.where(event_is_query(key_), s.q_bkt_sig, s.ev_bkt_sig)
-    got = jnp.any(bkt_sig == _sig(key_, origin), axis=(1, 2))
+    got = jnp.any(bkt_sig == _sig(cfg, key_, origin), axis=(1, 2))
     return jnp.sum(got & active) / jnp.maximum(jnp.sum(active), 1)
 
 

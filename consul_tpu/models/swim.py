@@ -186,13 +186,29 @@ def step(cfg: SimConfig, topo: Topology, world: World, state: SimState, key,
 
 
 def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
-                 key, sched=None, *, sentinel: bool = False):
+                 key, sched=None, *, sentinel: bool = False, extra_tx=None):
     """One tick plus its :class:`counters.GossipCounters` event tallies
     (probes, acks/nacks, suspicions, deaths, gossip tx/rx, push-pull
     merges, refutations) — every counter is a reduction over masks the
     step already computes, so the tally adds no communication. Under
     ``shard_map`` the sums are shard-local; parallel/shard_step.py
     psums them into global totals.
+
+    ``extra_tx`` (the serf fusion hook, models/serf.py) is an optional
+    list of per-node payload arrays ([N] or [N, P], roll_many dtypes)
+    that ride the SAME gossip exchange as the membership plane — one
+    roll per displacement leg carries both planes' packets. When given,
+    the return value grows a third element ``(ex_legs, ex_n_sends)``:
+    ``ex_legs`` is a list of fan ``(payload_arrays, arrived[N])`` pairs
+    (the extra payload as seen by each receiver, plus the per-leg
+    delivery mask), and ``ex_n_sends[N] i32`` counts how many legs each
+    sender actually reached. The extra plane has its OWN sender gate —
+    ``alive_truth & ~left``, which INCLUDES external bridge seats: an
+    attached agent originates serf events through its seat
+    (wire/bridge.py), while the membership plane's ``active`` excludes
+    external seats because their real agent runs SWIM itself. ``None``
+    (the default) emits exactly the pre-fusion program — the extra
+    plane is dead code XLA eliminates.
 
     ``sched`` is an optional :class:`chaos.ChaosSchedule` — a device
     pytree of tick-indexed faults entering as a program ARGUMENT, so
@@ -541,11 +557,12 @@ def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
     # ------------------------------------------------------------------
     # 4. Gossip fan-out and delivery (receiver-side; no scatters).
     # ------------------------------------------------------------------
+    gossip_out = _gossip_phase(
+        cfg, topo, state, active, keys[8], tx_limit,
+        sched if chaos_on else None, terms, extra_tx=extra_tx,
+    )
     state, refute_gossip, n_gossip_tx, n_gossip_rx, n_chaos_drop = \
-        _gossip_phase(
-            cfg, topo, state, active, keys[8], tx_limit,
-            sched if chaos_on else None, terms,
-        )
+        gossip_out[:5]
     refute_poke = _poke_refutes(
         cfg, topo, state, poke_flag, poke_col, target_inc
     )
@@ -607,7 +624,10 @@ def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
         )
     if sentinel:
         cnt = _sentinel_check(cfg, state, view0, own0, t, cnt)
-    return state._replace(t=t + 1), cnt
+    out_state = state._replace(t=t + 1)
+    if extra_tx is not None:
+        return out_state, cnt, gossip_out[5]
+    return out_state, cnt
 
 
 def _sentinel_check(cfg, state: SimState, view0, own0, t, cnt):
@@ -800,15 +820,21 @@ def _vivaldi_observe(cfg, state: SimState, ok, peer_col, rtt,
 
 
 def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit,
-                  sched=None, terms=None):
+                  sched=None, terms=None, extra_tx=None):
     """Fan-out + receiver-side delivery + lattice merge + confirmations
     + refute-claim collection. Returns (state, refute_inc[N],
-    packets_tx[] i32, packets_rx[] i32, chaos_drops[] i32).
+    packets_tx[] i32, packets_rx[] i32, chaos_drops[] i32), plus a
+    sixth element ``(ex_legs, ex_n_sends)`` iff ``extra_tx`` is given
+    (the serf fusion hook — see :func:`step_counted`).
 
     Senders pick their ``piggyback_msgs`` hottest view entries (highest
     remaining budget = fewest past transmits, the TransmitLimitedQueue
     order, queue.go:288-373) plus their own-fact, and send them to
-    ``gossip_nodes`` displacement-shared peers. Receivers gather."""
+    ``gossip_nodes`` displacement-shared peers. Receivers gather. The
+    extra plane rides the same per-leg roll (one exchange per hop
+    carries both planes), drops on the same chaos/loss draw, and lands
+    behind the same receiver-liveness gate — only its sender gate
+    differs (includes external seats; see step_counted)."""
     g = cfg.gossip
     n, k_deg = cfg.n, cfg.degree
     ln = coll.local_n(n)
@@ -850,6 +876,18 @@ def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit,
     sendable = merge.is_contactable(state.view_key[:, jcols]) & active[:, None]
     n_sends = jnp.sum(sendable, axis=1).astype(jnp.int32)
 
+    # Fused extra plane (serf events/queries): its own sender gate —
+    # external bridge seats DO originate serf traffic (wire/bridge.py),
+    # so the gate is liveness-only, unlike the membership ``active``.
+    if extra_tx is not None:
+        ex_active = state.alive_truth & ~state.left
+        ex_sendable = (
+            merge.is_contactable(state.view_key[:, jcols])
+            & ex_active[:, None]
+        )
+        ex_n_sends = jnp.sum(ex_sendable, axis=1).astype(jnp.int32)
+        ex_legs = []
+
     # Budget decrements for actual transmits (queue.go GetBroadcasts).
     sel_oh = jnp.any(
         (scol[:, None, :] == col_ids[None, :, None]) & svalid[:, None, :],
@@ -879,17 +917,17 @@ def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit,
     for f in range(fan):
         j = jcols[f]
         shift = topo.off[j]
-        rolled = coll.roll_many(
-            [sendable[:, f], scol, skey, sbits, svalid, own_sendable,
-             ownk] + tpack,
-            shift,
-        )
+        payload = [sendable[:, f], scol, skey, sbits, svalid, own_sendable,
+                   ownk] + tpack
+        if extra_tx is not None:
+            payload = payload + [ex_sendable[:, f]] + list(extra_tx)
+        rolled = coll.roll_many(payload, shift)
         s_send, s_scol, s_skey, s_sbits, s_svalid, s_own_ok, s_ownk = \
             rolled[:7]
         if sched is not None:
             # Sender terms rode the same packet; the leg is one-way
             # sender -> receiver on the existing drop draw.
-            s_terms = chaos_mod.unpack_terms(rolled[7:])
+            s_terms = chaos_mod.unpack_terms(rolled[7:7 + len(tpack)])
             ok_leg = chaos_mod.pair_ok(sched, s_terms, terms, u_drop[:, f], pl)
             n_chaos_drop = n_chaos_drop + counters_mod.count(
                 s_send & recv_up & (u_drop[:, f] >= pl) & ~ok_leg
@@ -897,6 +935,11 @@ def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit,
         else:
             ok_leg = u_drop[:, f] >= pl
         arrived = s_send & ok_leg & recv_up
+        if extra_tx is not None:
+            base = 7 + len(tpack)
+            ex_send = rolled[base]
+            ex_arrived = ex_send & ok_leg & recv_up
+            ex_legs.append((rolled[base + 1:], ex_arrived))
         n_rx = n_rx + counters_mod.count(arrived)
         fact_ok = arrived[:, None] & s_svalid
         rr = topology.remap_row(topo, j)                # [K]
@@ -944,7 +987,11 @@ def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit,
             seen_delta = seen_delta | jnp.where(oh, bits[:, pi:pi + 1], 0)
 
     state = state._replace(view_key=view, susp_seen=state.susp_seen | seen_delta)
-    return state, refute_inc, counters_mod.count(sendable), n_rx, n_chaos_drop
+    base_out = (state, refute_inc, counters_mod.count(sendable), n_rx,
+                n_chaos_drop)
+    if extra_tx is not None:
+        return base_out + ((ex_legs, ex_n_sends),)
+    return base_out
 
 
 def _poke_refutes(cfg, topo: Topology, state: SimState, poke_flag, poke_col,

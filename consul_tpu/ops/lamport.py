@@ -13,6 +13,12 @@ operations (reference serf/lamport.go:10-45):
 In the vectorized framework the clock is an array ``clock[N]`` and both
 operations are elementwise, so a whole cluster's worth of clock traffic
 is two fused ops per tick.
+
+Under the fused serf core (models/serf.py ``step_counted``) the ltimes
+being witnessed arrive packed in the high bits of the u32 event keys
+(``ltime << 9``) riding the SWIM exchange legs; witness stays a pure
+``maximum``, which is why the fused step's sentinel can assert clocks
+are monotone within a tick — they have no other way to move.
 """
 
 from __future__ import annotations

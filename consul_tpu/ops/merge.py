@@ -14,7 +14,13 @@ semilattice over keys ``(incarnation, state priority)`` ordered
 lexicographically, with priority alive=0 < suspect=1 < dead=2 < left=3.
 Taking the max key over any batch of concurrent messages is associative,
 commutative, and idempotent, so batched scatter-max delivery reaches the
-same fixed point as any serial delivery order.
+same fixed point as any serial delivery order. The same three algebraic
+properties are what let the sharded push-pull merge reductions fold
+through the hierarchical recursive-doubling ladder
+(``parallel/collective.py tree_psum``) instead of a flat all-reduce:
+any reduction-tree shape over a semilattice reaches the same join, so
+the (node-shard × DC) tree the fused serf core uses is
+observationally identical to the flat fold it replaced.
 
 Known canonicalization (documented divergence): the reference keeps a
 dead(inc=5) entry even when a suspect(inc=6) arrives ("ignore non-alive

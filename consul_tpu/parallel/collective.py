@@ -224,13 +224,48 @@ def roll_many(arrays, shift):
     return out
 
 
+def tree_psum(x: jax.Array) -> jax.Array:
+    """All-reduce sum over the node axis as a recursive-doubling
+    ppermute ladder instead of a flat ``lax.psum``.
+
+    Stage ``s`` exchanges at ring distance ``2^s`` and doubles the
+    reduced span, so the reduction is a log2(D)-depth binary tree whose
+    early (high-traffic) stages stay between ring neighbors. Under the
+    (node-shard x DC) meshes built by parallel/mesh.py the node axis is
+    the *minor* (fastest-varying) device axis, so distance-1 and
+    distance-2 stages are intra-DC ICI hops and only the last
+    log2(n_dc) stages cross the DC seam — the tree respects the mesh
+    hierarchy by construction, with no axis bookkeeping needed here.
+
+    Unsharded: identity. Non-power-of-two shard counts fall back to the
+    flat ``lax.psum`` (recursive doubling needs the span to tile the
+    ring exactly). Exact for integer dtypes — a sum tree reassociates,
+    which is bitwise-invisible to i32/u32 counters."""
+    ctx = _CTX.get()
+    if ctx is None:
+        return x
+    d = ctx.n_shards
+    if d <= 1:
+        return x
+    if d & (d - 1):
+        return jax.lax.psum(x, ctx.axis_name)
+    y = x
+    amt = 1
+    while amt < d:
+        y = y + jax.lax.ppermute(y, ctx.axis_name, _perm(ctx, amt))
+        amt <<= 1
+    return y
+
+
 def any_rows(x: jax.Array) -> jax.Array:
-    """``jnp.any`` over the full (global) node axis."""
+    """``jnp.any`` over the full (global) node axis. Sharded, the fold
+    rides :func:`tree_psum` — a hierarchical scalar reduction rather
+    than a flat all-reduce."""
     ctx = _CTX.get()
     local = jnp.any(x)
     if ctx is None:
         return local
-    return jax.lax.psum(local.astype(jnp.int32), ctx.axis_name) > 0
+    return tree_psum(local.astype(jnp.int32)) > 0
 
 
 def all_rows(x: jax.Array) -> jax.Array:
@@ -263,7 +298,10 @@ def sum_scatter_rows(idx: jax.Array, vals: jax.Array, n: int) -> jax.Array:
     ``vals`` may carry trailing axes ([rows, Q] tallies land per-slot).
     Each shard accumulates into a global-sized buffer; a reduce-scatter
     (psum_scatter) folds the shards and hands each device exactly its
-    block — half the bandwidth of a full psum + slice."""
+    block — half the bandwidth of a full psum + slice. Deliberately NOT
+    routed through :func:`tree_psum`: a reduce-scatter already IS the
+    optimal tree (each device keeps only its block), so a ladder here
+    would double the bytes moved."""
     ctx = _CTX.get()
     full = jnp.zeros((n,) + vals.shape[1:], vals.dtype).at[idx].add(vals)
     if ctx is None:

@@ -58,9 +58,10 @@ def _make_sharded(step_fn, cfg: SimConfig, topo: Topology, mesh: Mesh,
 
     With ``counted=True``, ``step_fn`` is a ``*_counted`` step returning
     (state, GossipCounters): each shard's partial tallies are stacked
-    into one [len(FIELDS)] i32 vector and ``psum``-reduced over the node
-    axis — a single small collective — so every device holds the global
-    totals (out spec P(), replicated).
+    into one [len(FIELDS)] i32 vector and tree-reduced over the node
+    axis (collective.tree_psum — a log2(D) recursive-doubling ppermute
+    ladder respecting the node x DC hierarchy) so every device holds
+    the global totals (out spec P(), replicated).
 
     With ``chaos=True``, the returned function takes a fault schedule
     after the world: ``step(world, sched, state, key)``. The schedule's
@@ -88,7 +89,7 @@ def _make_sharded(step_fn, cfg: SimConfig, topo: Topology, mesh: Mesh,
                                sched_local, sentinel=sentinel)
             st, cnt = step_fn(cfg, topo, world_local, state_local, key,
                               sched_local, sentinel=sentinel)
-            red = jax.lax.psum(jnp.stack(list(cnt)), NODE_AXIS)
+            red = coll.tree_psum(jnp.stack(list(cnt)))
             return st, counters_mod.unstack(red)
 
     def out_specs_of(specs):

@@ -95,6 +95,27 @@ def _placement_width(state) -> int:
     return 1
 
 
+def state_layout_digest(state, n: int) -> str:
+    """Stable digest of a state pytree's LAYOUT: leaf paths, dtypes,
+    and shapes with the node axis abstracted to ``N`` (so the digest is
+    shape-family, not instance). Two states with the same digest are
+    field-for-field restorable into each other; a digest change means
+    the program's state schema moved (a new field, a packed dtype, a
+    reshaped buffer — e.g. the fused-serf refactor narrowed ev_origin
+    to i16 and added ev_pending) and a checkpoint across the change
+    must be refused, not shape-crashed into."""
+    import hashlib
+
+    parts = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        shape = tuple("N" if d == n else int(d)
+                      for d in getattr(leaf, "shape", ()))
+        dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+        parts.append(f"{jax.tree_util.keystr(path)}:{dtype}:{shape}")
+    joined = "|".join(sorted(parts))
+    return hashlib.sha256(joined.encode()).hexdigest()[:16]
+
+
 def _scenario_meta(sim, tag: str, ticks: int, t0: int, done: int,
                    sched_digest: str) -> dict:
     return {
@@ -107,6 +128,10 @@ def _scenario_meta(sim, tag: str, ticks: int, t0: int, done: int,
         "ticks_done": done,
         "chaos_t0": t0,
         "schedule_digest": sched_digest,
+        # The state schema this checkpoint serialized — resume
+        # compatibility, checked EXPLICITLY (clear refusal) rather than
+        # via the match dict (silent fresh start) in run_resilient.
+        "state_layout": state_layout_digest(sim.state, sim.cfg.n),
         # Provenance only — NOT part of the resume match: the
         # trajectory's identity is device-count-agnostic, which is
         # exactly what lets a smaller mesh pick it up.
@@ -185,14 +210,37 @@ def run_resilient(sim, ticks: int, *, chunk: int = 64,
     # the restored state happens to be.
     saved_width = None
     if policy is not None:
-        state, meta = policy.load(sim.state, match={
+        ident = {
             "tag": policy.tag,
             "n": sim.cfg.n,
             "seed": sim.seed,
             "kind": type(sim).__name__,
             "ticks": ticks,
             "schedule_digest": sched_digest,
-        })
+        }
+        # Layout gate BEFORE the restore: a checkpoint that names this
+        # trajectory but was written by a program with a different
+        # state schema (pre-fusion SerfState: no ev_pending, i32
+        # ev_origin/ev_tx) must be refused with a diagnosis — letting
+        # ckpt_mod.restore hit the field/dtype mismatch produces a
+        # shape crash deep in deserialization instead.
+        layout_now = state_layout_digest(sim.state, sim.cfg.n)
+        meta0 = policy.read_meta()
+        if (meta0 is not None and os.path.exists(policy.path)
+                and all(meta0.get(k) == v for k, v in ident.items())):
+            saved_layout = meta0.get("state_layout")
+            if saved_layout != layout_now and (
+                    saved_layout is not None
+                    or "Serf" in str(meta0.get("kind", ""))):
+                raise RuntimeError(
+                    f"checkpoint {policy.path} matches this trajectory "
+                    f"but was written by an incompatible state layout "
+                    f"({saved_layout or 'pre-layout-digest (pre-fusion)'}"
+                    f" vs {layout_now}): it cannot be resumed into this "
+                    "program. Retire it (delete the .ckpt/.meta.json "
+                    "pair) or rerun with the build that wrote it."
+                )
+        state, meta = policy.load(sim.state, match=ident)
         if state is not None:
             sim.state = state
             t0 = int(meta["t0"])

@@ -1,0 +1,137 @@
+"""Persistent XLA compilation cache plumbing (ROADMAP item 2).
+
+The serf north-star program costs ~387 s of XLA compile on a cold
+process (BENCH_r05). jax ships a persistent compilation cache —
+``jax_compilation_cache_dir`` — that serializes every compiled
+executable to disk keyed on (HLO, compile options, backend version),
+so the SECOND cold process deserializes in ~0 s instead of recompiling.
+This module is the one switch for it:
+
+- :func:`enable` points jax at a directory (created if missing) and
+  drops the min-size/min-time thresholds so even small test programs
+  cache (the default 1 s floor would skip everything but the north
+  star itself).
+- :func:`maybe_enable_from_env` wires the ``CONSUL_TPU_COMPILE_CACHE``
+  environment variable; the CLI/bench ``--compile-cache DIR`` flag
+  calls :func:`enable` directly.
+- :func:`stats` reports hit/miss counts observed process-wide via
+  ``jax.monitoring`` (the CompileLedger idiom, analysis/guards.py) so
+  bench JSON can record *provenance*: a ``compile_s`` next to
+  ``{"hits": 8, "misses": 0}`` is a warm-from-disk number, not a
+  measured compile.
+
+No jax import happens at module load beyond the top-level ``import
+jax`` this package already pays everywhere device-side.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+import jax
+
+ENV_VAR = "CONSUL_TPU_COMPILE_CACHE"
+
+# Events the jax 0.4.x compilation-cache path records (compiler.py /
+# compilation_cache.py): one per executable looked up.
+HIT_EVENT = "/jax/compilation_cache/cache_hits"
+MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_lock = threading.Lock()
+_state = {"dir": None, "hits": 0, "misses": 0, "registered": False}
+
+
+def _on_event(event: str, **kwargs):
+    if event == HIT_EVENT:
+        with _lock:
+            _state["hits"] += 1
+    elif event == MISS_EVENT:
+        with _lock:
+            _state["misses"] += 1
+
+
+def _register_listener():
+    with _lock:
+        if _state["registered"]:
+            return
+        _state["registered"] = True
+    jax.monitoring.register_event_listener(_on_event)
+
+
+def enable(directory: str) -> str:
+    """Turn the persistent compilation cache on, rooted at
+    ``directory`` (created if missing). Returns the absolute path.
+    Idempotent; re-pointing at a new directory is allowed."""
+    path = os.path.abspath(directory)
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # Cache everything: the defaults skip executables under 1 s of
+    # compile / tiny byte sizes, which would exclude every program in
+    # the test tier and make hit/miss provenance unobservable there.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # jax initializes its cache state AT MOST ONCE, on the first compile
+    # (_initialize_cache latches _cache_initialized). Any device constant
+    # materialized before this call — e.g. a module-level jnp scalar in
+    # an imported model — has already latched the cache OFF for the whole
+    # process, and setting the config above is then a silent no-op.
+    # reset_cache() returns it to the pristine state so the next compile
+    # re-reads the config we just set.
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _jax_cc,
+        )
+        _jax_cc.reset_cache()
+    except (ImportError, AttributeError):  # pragma: no cover - old jax
+        pass
+    _register_listener()
+    with _lock:
+        _state["dir"] = path
+    return path
+
+
+def maybe_enable_from_env(environ=os.environ) -> Optional[str]:
+    """Enable the cache iff ``CONSUL_TPU_COMPILE_CACHE`` is set and
+    non-empty; returns the directory or None. Call sites: bench main(),
+    CLI local-run subcommands."""
+    directory = environ.get(ENV_VAR, "").strip()
+    if not directory:
+        return None
+    return enable(directory)
+
+
+def enabled() -> bool:
+    with _lock:
+        return _state["dir"] is not None
+
+
+def cache_dir() -> Optional[str]:
+    with _lock:
+        return _state["dir"]
+
+
+def stats() -> dict:
+    """Provenance snapshot for bench JSON ``compile_s`` entries:
+    ``{"enabled": bool, "dir": str|None, "hits": int, "misses": int}``.
+    Counts are process-wide since the cache was first enabled."""
+    with _lock:
+        return {
+            "enabled": _state["dir"] is not None,
+            "dir": _state["dir"],
+            "hits": _state["hits"],
+            "misses": _state["misses"],
+        }
+
+
+def stats_delta(before: dict) -> dict:
+    """The hit/miss movement since a :func:`stats` snapshot — what one
+    bench phase's compiles resolved to."""
+    now = stats()
+    return {
+        "enabled": now["enabled"],
+        "dir": now["dir"],
+        "hits": now["hits"] - before.get("hits", 0),
+        "misses": now["misses"] - before.get("misses", 0),
+    }

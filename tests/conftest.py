@@ -42,6 +42,27 @@ def compile_ledger():
     return CompileLedger()
 
 
+@pytest.fixture
+def expect_serf():
+    """Compile-budget pin for the fused serf core: ``with
+    expect_serf(1): sim.run(...)`` asserts the enclosed serf activity
+    builds exactly one executable — the single fused-step program the
+    event, query, and chaos-value variants all share (firing an event
+    or opening a query changes state VALUES, never the program). Sugar
+    over :class:`CompileLedger` so a failure names the fused-core
+    invariant instead of a bare count."""
+    from consul_tpu.analysis.guards import CompileLedger
+
+    ledger = CompileLedger()
+
+    def expect(n: int = 1):
+        return ledger.expect(
+            n, "fused serf core (event/query/chaos variants share "
+               "one executable)")
+
+    return expect
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

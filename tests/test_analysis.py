@@ -403,6 +403,80 @@ class TestTH108:
 
 
 # ----------------------------------------------------------------------
+# TH109: data-dependent scatters inside traced code
+# ----------------------------------------------------------------------
+
+class TestTH109:
+    def test_traced_dense_scatter_fires(self):
+        rep = _lint({DEV: """
+            import jax
+            import jax.numpy as jnp
+
+            def step(table, order, vals):
+                rows = jnp.arange(table.shape[0], dtype=jnp.int32)[:, None]
+                return table.at[rows, order].add(vals)
+
+            run = jax.jit(step)
+        """})
+        assert _rules(rep) == ["TH109"]
+        assert rep.findings[0].symbol == "step"
+
+    def test_every_update_method_fires(self):
+        rep = _lint({DEV: """
+            import jax
+
+            def step(x, i, v):
+                a = x.at[i].set(v)
+                b = a.at[i].max(v)
+                return b.at[i].multiply(v)
+
+            run = jax.jit(step)
+        """})
+        assert _rules(rep) == ["TH109", "TH109", "TH109"]
+
+    def test_static_index_is_silent(self):
+        # Constant / ellipsis / slice indices lower to update-slice,
+        # not scatter (the ops/vivaldi.py e0 shape).
+        rep = _lint({DEV: """
+            import jax
+
+            def step(d, v):
+                e0 = d.at[..., 0].set(1.0)
+                head = e0.at[3:5].set(v)
+                return head.at[-1, 2].add(v)
+
+            run = jax.jit(step)
+        """})
+        assert rep.clean
+
+    def test_untraced_host_function_is_silent(self):
+        # The bridge-intake shape: host-tier eager updates are fine.
+        rep = _lint({DEV: """
+            def intake(state, seat, row):
+                return state.at[seat].set(row)
+        """})
+        assert rep.clean
+
+    def test_allowlist_suppresses_by_symbol(self):
+        al = parse_allowlist("""
+            [[allow]]
+            rule = "TH109"
+            path = "consul_tpu/models/fake.py"
+            symbol = "scatter_rows"
+            reason = "this scatter-add IS the reduce-scatter"
+        """)
+        rep = _lint({DEV: """
+            import jax
+
+            def scatter_rows(x, idx, v):
+                return x.at[idx].add(v)
+
+            run = jax.jit(scatter_rows)
+        """}, al)
+        assert rep.clean and len(rep.suppressed) == 1
+
+
+# ----------------------------------------------------------------------
 # callgraph: reachability across modules and hand-off shapes
 # ----------------------------------------------------------------------
 
@@ -611,6 +685,6 @@ class TestPackageGate:
     def test_every_rule_id_is_documented(self):
         assert set(analysis.RULES) == {
             "TH101", "TH102", "TH103", "TH104", "TH105", "TH106",
-            "TH107", "TH108"}
+            "TH107", "TH108", "TH109"}
         for rid, rationale in analysis.RULES.items():
             assert rationale.strip(), rid

@@ -126,10 +126,16 @@ class TestEmptyScheduleDCE:
         key = jax.random.PRNGKey(7)
         s_none, s_empty = _state(cfg), _state(cfg)
         empty = chaos.empty(cfg.n)
+        # Jitted on purpose: the DCE claim is about the COMPILED program
+        # (an all-clear schedule folds to the schedule-free step), and
+        # jitting also dodges 16 ticks of eager per-op dispatch.
+        step_none = jax.jit(lambda s, k: swim.step(cfg, topo, world, s, k))
+        step_empty = jax.jit(
+            lambda s, k: swim.step(cfg, topo, world, s, k, empty))
         for t in range(8):
             k = jax.random.fold_in(key, t)
-            s_none = swim.step(cfg, topo, world, s_none, k)
-            s_empty = swim.step(cfg, topo, world, s_empty, k, empty)
+            s_none = step_none(s_none, k)
+            s_empty = step_empty(s_empty, k)
         _assert_trees_equal(s_none, s_empty)
 
     def test_set_chaos_normalizes_empty(self):
@@ -307,11 +313,14 @@ class TestPartitionHeal:
 
 class TestLinkLossAndDrops:
     def test_messages_dropped_counted(self):
-        sim = Simulation(SimConfig(n=128, view_degree=8), seed=5)
+        # Same (cfg, chunk) signature as _healed_sim so both the plain
+        # and chaos executables are already warm from TestPartitionHeal
+        # — seed and schedule values are runtime arguments.
+        sim = Simulation(SimConfig(n=1024, view_degree=16), seed=5)
         sim.run(32, chunk=32, with_metrics=False)
         res = sim.run_scenario(
-            [chaos.LinkLoss(start=0, stop=24, a=slice(0, 64),
-                            b=slice(64, 128), fwd=0.9, rev=0.9)],
+            [chaos.LinkLoss(start=0, stop=24, a=slice(0, 512),
+                            b=slice(512, 1024), fwd=0.9, rev=0.9)],
             ticks=32, chunk=32)
         assert res.slo["messages_dropped"] > 0
         assert res.slo["false_positive_deaths"] == 0

@@ -14,15 +14,19 @@ The budget being defended (parallel/collective.py, SURVEY §2.5):
   - SWIM plane: rolls only — ``lax.ppermute`` hops moving O(N/D)-row
     blocks. Traced-shift rolls cost a log2(D)+1 conditional-hop ladder
     (3 + 1 seam transfer at D=8), so permute *count* is
-    4 x (number of traced rolls), a trace-time constant.
-  - Serf event plane: + one *packed* roll per gossip fan displacement
-    (roll_many: the [key, origin, valid, peer] payload rides ONE
-    ppermute per hop, not four), + exactly two all-gathers (the
-    query-origin attribute reads: q_open_key u32[N, Q] — Q=4
-    concurrent query slots per origin — and the folded liveness bool)
-    + exactly two reduce-scatters (the [N, Q] ack and response
-    tallies, [N/D, Q] rows out per device).
-  - The only all-reduce is the scalar convergence psum (4 bytes).
+    4 x (number of traced rolls), a trace-time constant. The scalar
+    convergence fold is a log2(D)=3-hop recursive-doubling ladder
+    (collective.tree_psum), so there is NO all-reduce at all on
+    power-of-two meshes.
+  - Serf event plane: ZERO extra permutes — the fused core
+    (models/serf.py step_counted) packs the top-k event columns into
+    the SAME roll_many payloads that carry the SWIM gossip legs, so
+    the event exchange costs payload bytes, not collective ops. What
+    remains serf-specific: exactly two all-gathers (the query-origin
+    attribute reads: q_open_key u32[N, Q] — Q=4 concurrent query slots
+    per origin — and the folded liveness bool) + exactly two
+    reduce-scatters (the [N, Q] ack and response tallies, [N/D, Q]
+    rows out per device).
 
 Counts are pinned by equality: a legitimate protocol change that adds
 or removes an exchange should update the constants HERE, consciously,
@@ -108,18 +112,22 @@ LADDER = 4
 # Traced rolls per SWIM tick (probe/ack/indirect legs, gossip fan,
 # push-pull exchange — models/swim.py), measured at this config and
 # stable across shapes: 116 permute ops = 29 ladders' worth of hops
-# (some rolls are static single-hop). The count is pinned against the
+# (some rolls are static single-hop), + 3 hops for the tree_psum
+# convergence fold (recursive doubling at D=8 — the former scalar
+# all-reduce, now a ladder). The count is pinned against the
 # ``jax.experimental.shard_map`` lowering the version-portable shim
 # (parallel/mesh.py) selects on this jax; ``jax.shard_map`` on newer
-# releases lowers two hops tighter (114) — same budget class, so a
+# releases lowers two hops tighter — same budget class, so a
 # shim-path change that moves this number two ops either way is a
 # lowering difference, not a protocol regression. The uncounted step's
 # census is identical with and without the GossipCounters tallies
 # (models/counters.py): the discarded counters are dead code to XLA.
-SWIM_PERMUTES = 116
-# The serf event plane adds gossip_nodes=3 packed event exchanges
-# (roll_many -> ONE ladder each), nothing else.
-SERF_EXTRA_PERMUTES = 3 * LADDER
+SWIM_PERMUTES = 116 + 3
+# The fused core's event columns ride the SWIM gossip rolls: the serf
+# step adds NO permutes of its own (pre-fusion it paid 3 ladders for a
+# second sweep). A nonzero delta here means an event exchange escaped
+# the shared roll_many payload.
+SERF_EXTRA_PERMUTES = 0
 # Upper bound on the average payload a single permute hop may carry,
 # bytes per block row. Measured: SWIM 19.8, serf extra 28 (the packed
 # [2xkey, 2xorigin, 2xvalid, peer] u32 columns). A new wide payload or

@@ -1,0 +1,85 @@
+"""Persistent XLA compile cache plumbing (utils/compile_cache.py).
+
+Everything that flips process-global jax state (the cache dir, the
+monitoring listener, reset_cache) runs in a SUBPROCESS: enabling the
+cache in the tier-1 process would change what backend_compile events
+the shared CompileLedger pins observe for every test after this one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from consul_tpu.utils import compile_cache
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The regression scenario: a model module is imported BEFORE the cache
+# is enabled. consul_tpu.models.swim materializes a module-level device
+# constant at import, which triggers the process's first XLA compile —
+# and jax initializes its persistent-cache state at most once, on that
+# first compile. Without the reset_cache() call in enable(), pointing
+# jax_compilation_cache_dir at a directory afterwards is a silent no-op
+# (zero hits, zero misses, empty directory — exactly what bench.py's
+# child used to record).
+_CHILD = """
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, {repo!r})
+import jax
+import consul_tpu.models.swim  # first XLA compile happens HERE
+from consul_tpu.utils import compile_cache
+compile_cache.enable({cache!r})
+import jax.numpy as jnp
+jax.jit(lambda x: x * 2 + 1)(jnp.arange(8, dtype=jnp.int32))
+print(json.dumps(compile_cache.stats()))
+"""
+
+
+def _run_child(cache_dir: str) -> dict:
+    out = subprocess.run(
+        [sys.executable, "-c", _CHILD.format(repo=REPO, cache=cache_dir)],
+        capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+class TestEnableAfterFirstCompile:
+    def test_cache_engages_despite_prior_import(self, tmp_path):
+        cache = str(tmp_path / "cc")
+        stats = _run_child(cache)
+        assert stats["enabled"] and stats["dir"] == cache
+        assert stats["misses"] > 0, (
+            "enable() after an import-time compile never engaged the "
+            "persistent cache — the reset_cache() latch fix regressed")
+        assert stats["hits"] == 0
+        assert os.listdir(cache), "no executables serialized to disk"
+
+    @pytest.mark.slow
+    def test_second_cold_process_warms_from_disk(self, tmp_path):
+        cache = str(tmp_path / "cc")
+        cold = _run_child(cache)
+        assert cold["misses"] > 0
+        warm = _run_child(cache)
+        assert warm["hits"] > 0
+        assert warm["misses"] == 0
+
+
+class TestHostSide:
+    def test_maybe_enable_from_env_empty_is_none(self):
+        assert compile_cache.maybe_enable_from_env({}) is None
+        assert compile_cache.maybe_enable_from_env(
+            {compile_cache.ENV_VAR: "  "}) is None
+
+    def test_stats_delta_arithmetic(self):
+        before = {"hits": 3, "misses": 5}
+        now = compile_cache.stats()
+        delta = compile_cache.stats_delta(before)
+        assert delta["hits"] == now["hits"] - 3
+        assert delta["misses"] == now["misses"] - 5
+        assert delta["enabled"] == now["enabled"]

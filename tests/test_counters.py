@@ -150,6 +150,42 @@ class TestCompileCount:
         for key, runner in sim._runners.items():
             assert runner._cache_size() == 1, key
 
+    def test_fused_serf_core_is_one_executable(self, expect_serf):
+        """The fused core's compile budget: the step program builds
+        ONCE, and the event, query, and chaos variants all ride it.
+        Firing events/queries changes state values, not the program;
+        a chaos schedule of a given shape adds exactly one more
+        program (chaos.static_key_of memoization), and replaying a
+        same-shape schedule with different values adds none."""
+        from consul_tpu import chaos
+
+        sim = SerfSimulation(SimConfig(n=128, view_degree=16), seed=0)
+        # Warm the eager verb ops (mask building, queue pushes) so the
+        # pin below sees only the step program itself.
+        sim.user_event(jnp.arange(128) < 1, 1)
+        sim.query(jnp.arange(128) < 1, 1)
+        with expect_serf(1):
+            sim.run(32, chunk=32, with_metrics=False)
+        # Every variant reuses that one executable.
+        with expect_serf(0):
+            sim.user_event(jnp.arange(128) < 4, 2)
+            sim.run(32, chunk=32, with_metrics=False)
+            sim.query(jnp.arange(128) < 4, 3)
+            sim.run(32, chunk=32, with_metrics=False)
+        assert set(sim._runners) == {(32, False)}
+        assert sim._runners[(32, False)]._cache_size() == 1
+        # Chaos: one more program per schedule SHAPE, zero per value.
+        sim.run_scenario(
+            [chaos.LinkLoss(start=1, stop=9, a=slice(0, 16),
+                            b=slice(64, 128), fwd=0.5, rev=0.5)],
+            ticks=32, chunk=32)
+        sim.counters_snapshot()
+        with expect_serf(0):
+            sim.run_scenario(
+                [chaos.LinkLoss(start=2, stop=11, a=slice(16, 32),
+                                b=slice(64, 96), fwd=0.25, rev=0.75)],
+                ticks=32, chunk=32)
+
 
 class TestShardedParity:
     def _setup(self, n=64):

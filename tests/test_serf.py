@@ -34,6 +34,14 @@ def _sim_parts(cfg):
     return topo, world, state, step
 
 
+# One shared non-default serf config for every test that needs a tweaked
+# knob: a tiny dedup window (seen_ring=4, vs default 16) AND a short reap
+# window (reference default is 24h, serf/config.go:277). Each test uses
+# one knob and ignores the other, so they all ride ONE compiled step per
+# view mode instead of paying XLA per knob combination.
+_VARIANT_SERF = SerfConfig(seen_ring=4, reconnect_timeout_ms=8_000)
+
+
 def make_sim(n=48, vd=0, **cfg_kw):
     cfg = SimConfig(n=n, view_degree=vd, **cfg_kw)
     topo, world, state, step = _sim_parts(cfg)
@@ -107,7 +115,9 @@ class TestUserEvents:
         # eviction raises the Lamport floor, so stale events are
         # rejected — possibly dropped, never delivered twice
         # (eventMinTime semantics, serf.go:1258-1357).
-        cfg, _, _, state, step = make_sim(vd=vd, serf=SerfConfig(seen_ring=4))
+        # Shares the _VARIANT_SERF config (one compiled step) with the
+        # reap test below; the reconnect knob is inert here (no deaths).
+        cfg, _, _, state, step = make_sim(vd=vd, serf=_VARIANT_SERF)
         origin = jnp.arange(cfg.n) == 0
         n_events = 8
         for name in range(n_events):
@@ -143,7 +153,7 @@ class TestQueries:
         assert int(state.q_resps[5, 0]) == cfg.n - 1
 
     def test_query_closes_at_deadline(self, vd):
-        cfg, _, _, state, step = make_sim(n=24, vd=vd)
+        cfg, _, _, state, step = make_sim(vd=vd)
         origin = jnp.arange(cfg.n) == 0
         state = serf.query(cfg, state, origin, 1)
         assert int(state.q_open_key[0, 0]) != 0
@@ -232,11 +242,9 @@ class TestLeaveAndReap:
         assert bool(jnp.all(jnp.where(observers, st == merge.LEFT, True)))
 
     def test_reap_after_reconnect_timeout(self, vd):
-        # Shrink the reap window so it fits in a short run (reference
-        # default is 24h, serf/config.go:277).
-        cfg, _, _, state, step = make_sim(
-            n=32, vd=vd, serf=SerfConfig(reconnect_timeout_ms=8_000)
-        )
+        # Shares _VARIANT_SERF with the window-overflow test (the tiny
+        # seen_ring is inert here: no events fire).
+        cfg, _, _, state, step = make_sim(vd=vd, serf=_VARIANT_SERF)
         state.swim  # formed cluster
         state = state._replace(
             swim=state.swim._replace(

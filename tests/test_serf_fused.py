@@ -1,0 +1,227 @@
+"""Golden parity: the fused serf core vs the pre-fusion reference sweep.
+
+The fused step (models/serf.py ``step_counted``) rides the event/query
+plane on the SAME per-tick message-exchange pass as the SWIM probe/ack
+plane; the reference step (``step_reference_counted``) runs the
+PR-1..6 algorithm verbatim — a second full sweep after the SWIM pass.
+The two draw different event-plane randomness (the fused core inherits
+the gossip legs' outcomes; the reference samples its own columns and
+loss), so transient queue states differ by design. What must be
+IDENTICAL, same seed, is everything observable:
+
+  - the SWIM plane, bit for bit — both steps split ``key`` into
+    (k_swim, k_ev) the same way and the extra event lanes consume no
+    SWIM randomness, so any drift here means the fusion leaked into
+    the membership protocol;
+  - the delivered-event sets (every fired (name, origin) at coverage
+    1.0 on both, per-node delivered counts equal element-wise);
+  - the Lamport floors and clocks (event_clock / ev_floor / q_floor);
+  - the chaos SLO counters (SWIM-plane, so exactly equal).
+
+Scenarios: chaos off with events + an open query, chaos on EVENT-ONLY
+(the fused core reuses k_ev where the reference splits three ways, so
+query response tallies under loss are legitimately path-dependent —
+events, being exactly-once converged, are not), and the sharded fused
+step against the single-device reference.
+
+Slow tier: 4096 nodes, full convergence windows.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from consul_tpu import chaos
+from consul_tpu.config import SimConfig
+from consul_tpu.models import serf
+from consul_tpu.models.cluster import (
+    SLO_KEYS,
+    ReferenceSerfSimulation,
+    SerfSimulation,
+)
+from consul_tpu.ops import topology
+from consul_tpu.parallel import mesh as pmesh
+from consul_tpu.parallel import shard_step
+
+pytestmark = pytest.mark.slow
+
+N = 4096
+SEED = 3
+TICKS = 48
+CHUNK = 16
+# (origin row, event name) pairs — distinct (name, origin) so the
+# exact-pack signature sweep in event_coverage cannot alias them.
+EVENTS = [(0, 11), (97, 42), (N - 1, 7)]
+QUERY = (9, 3)
+
+
+def _origin_mask(row):
+    return jnp.zeros(N, bool).at[row].set(True)
+
+
+def _fire_events(sim):
+    keys = []
+    for row, name in EVENTS:
+        keys.append((serf.make_event_key(sim.state.event_clock[row], name),
+                     row))
+        sim.user_event(_origin_mask(row), name)
+    return keys
+
+
+def _swim_leaves(swim_st):
+    """(path, leaf) pairs — SimState fields can themselves be pytrees
+    (the Vivaldi block), so compare leaves, not fields."""
+    return [(jax.tree_util.keystr(p), leaf) for p, leaf in
+            jax.tree_util.tree_flatten_with_path(swim_st)[0]]
+
+
+def _assert_observables_match(fused_st, ref_st, fired_keys, cfg):
+    # SWIM plane: bit-identical, every leaf, ints and floats alike —
+    # the fused step's extra lanes must not perturb the membership
+    # protocol's math or its randomness.
+    for (path, a), (_, b) in zip(_swim_leaves(fused_st.swim),
+                                 _swim_leaves(ref_st.swim)):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"swim{path}")
+    # Delivered sets: every fired event at full coverage on both, and
+    # the per-node distinct-delivery counts equal element-wise.
+    for key_, origin in fired_keys:
+        cf = float(serf.event_coverage(cfg, fused_st, key_, origin))
+        cr = float(serf.event_coverage(cfg, ref_st, key_, origin))
+        assert cf == 1.0, (key_, origin, cf)
+        assert cr == 1.0, (key_, origin, cr)
+    np.testing.assert_array_equal(
+        np.asarray(fused_st.ev_delivered), np.asarray(ref_st.ev_delivered),
+        err_msg="ev_delivered")
+    # Lamport clocks and floors.
+    for field in ("event_clock", "ev_floor", "q_floor"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fused_st, field)),
+            np.asarray(getattr(ref_st, field)), err_msg=field)
+
+
+def _slo(sim):
+    c = sim.counters
+    return {f: c[f] for f in SLO_KEYS}
+
+
+@functools.lru_cache(maxsize=None)
+def _converged_pair(with_chaos: bool):
+    """One (fused, reference, fired_keys) trio per scenario, shared by
+    the per-observable assertions below — the 4096-node runs compile
+    and execute once, not once per test."""
+    fused = SerfSimulation(SimConfig(n=N, view_degree=16), seed=SEED)
+    ref = ReferenceSerfSimulation(SimConfig(n=N, view_degree=16), seed=SEED)
+    fired = None
+    for sim in (fused, ref):
+        keys = _fire_events(sim)
+        if fired is None:
+            fired = keys
+        assert keys == fired, "same seed must yield the same event keys"
+        if with_chaos:
+            # EVENT-ONLY under faults (module docstring): a link-loss
+            # window over a slice while the events spread, then a
+            # settle window long enough for retransmits to finish.
+            sim.run_scenario(
+                [chaos.LinkLoss(start=1, stop=13, a=slice(0, N // 8),
+                                b=slice(N // 2, N), fwd=0.5, rev=0.5)],
+                ticks=TICKS, chunk=CHUNK)
+        else:
+            sim.query(_origin_mask(QUERY[0]), QUERY[1])
+            sim.run(TICKS, chunk=CHUNK, with_metrics=False)
+    return fused, ref, fired
+
+
+class TestFusedParityQuiet:
+    """Chaos off: events + an open query, full convergence."""
+
+    def test_observables_identical(self):
+        fused, ref, fired = _converged_pair(False)
+        _assert_observables_match(fused.state, ref.state, fired, fused.cfg)
+
+    def test_query_delivered_everywhere_on_both(self):
+        fused, ref, _ = _converged_pair(False)
+        qrow, qname = QUERY
+        # Both sims fired the query at the same Lamport time (same
+        # seed, same preceding verbs), so the probe key is shared.
+        qkey = serf.make_event_key(
+            fused.state.query_clock[qrow] - 1, qname, is_query=True)
+        for sim in (fused, ref):
+            cov = float(serf.event_coverage(sim.cfg, sim.state, qkey, qrow))
+            assert cov == 1.0, cov
+
+    def test_slo_counters_identical(self):
+        fused, ref, _ = _converged_pair(False)
+        assert _slo(fused) == _slo(ref)
+
+
+class TestFusedParityChaos:
+    """Chaos on, event-only: loss reorders both planes' retransmit
+    paths, but the converged observables must still agree."""
+
+    def test_observables_identical(self):
+        fused, ref, fired = _converged_pair(True)
+        _assert_observables_match(fused.state, ref.state, fired, fused.cfg)
+
+    def test_slo_counters_identical(self):
+        fused, ref, _ = _converged_pair(True)
+        assert _slo(fused) == _slo(ref)
+        assert _slo(fused)["chaos_msgs_dropped"] > 0  # the faults bit
+
+
+class TestFusedParitySharded:
+    """The fused step under shard_map (8-device virtual mesh) against
+    the single-device reference: same convergent observables, and the
+    SWIM plane equal to sharding tolerance (float reductions reorder)."""
+
+    def test_sharded_fused_matches_reference(self):
+        cfg = SimConfig(n=N, view_degree=16)
+        key = jax.random.PRNGKey(SEED)
+        kw, kn, ks = jax.random.split(key, 3)
+        world = topology.make_world(cfg, kw)
+        topo = topology.make_topology(cfg, kn)
+        st0 = serf.init(cfg, ks)
+        mesh = Mesh(np.array(jax.devices()[:8]), (pmesh.NODE_AXIS,))
+
+        sstep = shard_step.make_sharded_serf_step(cfg, topo, mesh)
+        rstep = jax.jit(
+            functools.partial(serf.step_reference, cfg, topo, world))
+
+        fired = []
+        su = st0
+        for row, name in EVENTS:
+            fired.append(
+                (serf.make_event_key(su.event_clock[row], name), row))
+            su = serf.user_event(cfg, su, _origin_mask(row), name)
+        ss = shard_step.place(mesh, su, cfg.n)
+        wg = shard_step.place(mesh, world, cfg.n)
+        base = jax.random.PRNGKey(17)
+        for t in range(TICKS):
+            k = jax.random.fold_in(base, t)
+            su = rstep(su, k)
+            ss = sstep(wg, ss, k)
+
+        # SWIM ints bit-exact, floats to sharded-reduction tolerance
+        # (the same envelope tests/test_shardmap.py pins fused-vs-fused).
+        for (path, a), (_, b) in zip(_swim_leaves(ss.swim),
+                                     _swim_leaves(su.swim)):
+            x, y = np.asarray(a), np.asarray(b)
+            if np.issubdtype(x.dtype, np.floating):
+                np.testing.assert_allclose(
+                    x, y, rtol=1e-4, atol=1e-6, err_msg=f"swim{path}")
+            else:
+                np.testing.assert_array_equal(
+                    x, y, err_msg=f"swim{path}")
+        for key_, origin in fired:
+            assert float(serf.event_coverage(cfg, ss, key_, origin)) == 1.0
+            assert float(serf.event_coverage(cfg, su, key_, origin)) == 1.0
+        np.testing.assert_array_equal(
+            np.asarray(ss.ev_delivered), np.asarray(su.ev_delivered))
+        for field in ("event_clock", "ev_floor", "q_floor"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ss, field)),
+                np.asarray(getattr(su, field)), err_msg=field)
