@@ -355,6 +355,55 @@ def child(platform: str, deadline: float):
     except Exception as e:
         _emit({"phase": "error", "where": "serf", "error": repr(e)[:500]})
 
+    # Serving plane: batched NearestN reads straight from the live
+    # simulation tensors (consul_tpu/serving) — queries/s/chip to set
+    # against the reference's ~7.5-16k req/s KV GET numbers in
+    # BASELINE.md. One warm batch compiles the bucket's executable,
+    # then the timed region is pure pack + kernel + one device_get per
+    # batch. (The n-node scan program is already in _RUNNER_CACHE from
+    # the throughput phase, so this phase adds only the projection and
+    # the one bucket executable.)
+    try:
+        if left() > 60:
+            import random as _srv_random
+
+            from consul_tpu.serving import MODE_NEAREST, ServingPlane
+
+            sb = int(os.environ.get("BENCH_SERVE_BATCH", "1024"))
+            sk = int(os.environ.get("BENCH_SERVE_K", "8"))
+            sreps = int(os.environ.get("BENCH_SERVE_REPS", "32"))
+            qsim = build(n)
+            qsim.run(chunk, chunk=chunk, with_metrics=False)
+            plane = ServingPlane(k=sk, buckets=(sb,))
+            qsim.attach_serving(plane)
+            srng = _srv_random.Random(0)
+
+            def _serve_batch():
+                return [(MODE_NEAREST, srng.randrange(n), -1)
+                        for _ in range(sb)]
+
+            plane.batcher.execute(_serve_batch())  # warm the bucket
+            plane.batcher.latencies_s.clear()  # p50/p99 = steady state
+            t1 = time.monotonic()
+            for _ in range(sreps):
+                plane.batcher.execute(_serve_batch())
+            wall = time.monotonic() - t1
+            st = plane.stats()
+            _emit({
+                "phase": "serving",
+                "n": n,
+                "batch": sb,
+                "k": sk,
+                "queries": sreps * sb,
+                "queries_per_sec_per_chip": round(sreps * sb / wall, 1),
+                "p50_batch_ms": st["p50_batch_ms"],
+                "p99_batch_ms": st["p99_batch_ms"],
+                "padding_waste_pct": st["padding_waste_pct"],
+            })
+            del plane, qsim
+    except Exception as e:
+        _emit({"phase": "error", "where": "serving", "error": repr(e)[:500]})
+
     # Scaling sweep: throughput at each shape, each its own try/except,
     # each gated on remaining deadline (SURVEY §7 phases 4-5 shapes).
     def northstar(sim, s, rps, phase_name, events=0):
@@ -903,6 +952,13 @@ def main():
         "elasticity": next(
             (p for p in primary["phases"]
              if p.get("phase") == "elasticity"), None),
+        # Serving-plane read throughput (consul_tpu/serving): batched
+        # NearestN straight from the simulation tensors —
+        # queries_per_sec_per_chip, p50/p99 batch latency, padding
+        # waste %. Compare BASELINE.md KV GET (~7.5-16k req/s).
+        "serving": next(
+            (p for p in primary["phases"]
+             if p.get("phase") == "serving"), None),
         "cpu_fallback": {
             "rounds_per_s": cpu_ok,
             "n_nodes": _get(cpu["phases"], "throughput", "n"),

@@ -58,6 +58,9 @@ class Agent:
         self.checks = CheckRunner(self.local)
         self.cache = Cache()
         self.cluster_size = cluster_size
+        # Device serving plane (consul_tpu/serving.ServingPlane), wired
+        # by attach_serving(); None means host-path reads only.
+        self.serving = None
         self._register_cache_types()
 
         self._next_sync = 0.0  # first tick syncs immediately
@@ -154,6 +157,25 @@ class Agent:
         self.cache.register_type("health-services", health_services)
         self.cache.register_type("catalog-services", catalog_services)
         self.cache.register_type("coordinate-nodes", coordinate_nodes)
+
+    def attach_serving(self, plane) -> None:
+        """Wire a device serving plane into this agent: registers the
+        ``serving-nearest`` cache type (the batched device path IS the
+        fetcher, so TTL-fresh NearestN reads cost zero device
+        round-trips) and exposes the plane's stats at
+        ``/v1/agent/metrics`` as ``consul.serving.*`` gauges."""
+        self.serving = plane
+        if getattr(plane, "sink", None) is None:
+            plane.sink = self.sink
+        plane.register_cache_type(self.cache)
+
+    def serving_nearest(self, src, service: int = -1) -> dict:
+        """NearestN through the agent cache (requires
+        :meth:`attach_serving`); repeated reads within the TTL are
+        cache hits counted into ``sim.serving.cache_hits``."""
+        if self.serving is None:
+            raise RuntimeError("no serving plane attached")
+        return self.serving.cached_nearest(self.cache, src, service=service)
 
     def reload(self) -> Optional[list]:
         """Re-read config sources and apply the safe subset; None when

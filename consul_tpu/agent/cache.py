@@ -28,6 +28,11 @@ import time
 from typing import Any, Callable, NamedTuple, Optional
 
 
+class CacheClosedError(RuntimeError):
+    """``get()`` on a closed cache with nothing cached to serve — a
+    closed cache never issues fetches, so there is no way to answer."""
+
+
 class CacheType(NamedTuple):
     """A registered entry type (reference agent/cache-types/*): how to
     fetch this kind of request and its freshness policy."""
@@ -56,6 +61,9 @@ class Cache:
         self._types: dict[str, CacheType] = {}
         self.metrics = {"hits": 0, "misses": 0, "fetches": 0}
         self._stop = threading.Event()
+        # Live refresh threads, joined by close() so a dropped cache
+        # takes its background blocking queries down with it.
+        self._threads: list[threading.Thread] = []
 
     # -- typed entries (reference cache.go RegisterType + cache-types/) --
     def register_type(self, name: str, fetch_factory, ttl_s: float = 3.0,
@@ -83,6 +91,12 @@ class Cache:
         background refresh query. Returns ``{"index", "value"}``."""
         t = self._types[name]
         key = self._key(name, req)
+        if self._stop.is_set():
+            with self._lock:
+                e = self._entries.get(key)
+            if e is not None:
+                return {"index": e.index, "value": e.value, "hit": True}
+            raise CacheClosedError(key)
         if not t.refresh:
             # A non-refresh type has no background loop to advance the
             # entry — a parked read would only ever time out. Serve the
@@ -107,6 +121,8 @@ class Cache:
             if e is not None:
                 break
         if e is None:
+            if self._stop.is_set():
+                raise CacheClosedError(key)
             out = t.fetch_factory(**req)(min_index, wait_s)
             return {"index": out["index"], "value": out["value"],
                     "hit": False}
@@ -128,6 +144,15 @@ class Cache:
         now = time.monotonic() if now is None else now
         with self._lock:
             e = self._entries.get(key)
+            if self._stop.is_set():
+                # Closed: never fetch again (the close() contract). Any
+                # cached value — stale included — is the best available
+                # answer; with nothing cached there is no answer.
+                if e is not None:
+                    e.hits += 1
+                    self.metrics["hits"] += 1
+                    return e.value
+                raise CacheClosedError(key)
             # Refresh-typed entries never TTL-expire (reference cache.go
             # exempts refresh types): the background loop IS their
             # freshness, and its blocking re-arm (5 s) outlasts short
@@ -148,7 +173,11 @@ class Cache:
                 e = self._entries[key] = CacheEntry(
                     out["value"], out["index"], now + ttl_s)
             e.fetches += 1
-            start_refresh = refresh and key not in self._refreshing
+            # A close() that landed while the fetch above was in flight
+            # must still win: store the data we already have, but never
+            # start a refresh loop on a closed cache.
+            start_refresh = (refresh and key not in self._refreshing
+                             and not self._stop.is_set())
             if start_refresh:
                 self._refreshing.add(key)
         # Update in place + notify: parked get_blocking watchers hold a
@@ -160,6 +189,8 @@ class Cache:
                 target=self._refresh_loop, args=(key, fetch, ttl_s),
                 daemon=True,
             )
+            with self._lock:
+                self._threads.append(t)
             t.start()
         return out["value"]
 
@@ -191,6 +222,11 @@ class Cache:
                 if self._stop.wait(0.2):
                     return
                 continue
+            if self._stop.is_set():
+                # Fetch was in flight when close() landed: drop the
+                # result rather than storing into (and waking watchers
+                # of) a closed cache.
+                return
             with self._lock:
                 cur = self._entries.get(key)
                 self.metrics["fetches"] += 1
@@ -213,4 +249,22 @@ class Cache:
             self._entries.pop(key, None)
 
     def close(self):
+        """Stop the cache: no further fetches will be issued (``get``
+        serves only what is already cached, raising
+        :class:`CacheClosedError` when nothing is), parked
+        ``get_blocking`` watchers wake immediately instead of timing
+        out, and refresh threads are joined. The fix for refresh-typed
+        entries issuing blocking queries after the cache was dropped."""
         self._stop.set()
+        with self._lock:
+            entries = list(self._entries.values())
+            threads = list(self._threads)
+        for e in entries:
+            with e.changed:
+                e.changed.notify_all()
+        for t in threads:
+            # Worst case a refresh fetch is mid-flight (bounded at 5 s
+            # server-side); don't hang shutdown on it — the loop drops
+            # the result on return regardless, and the daemon thread
+            # exits at its next _stop check.
+            t.join(timeout=0.2)

@@ -191,9 +191,15 @@ class DNSServer:
                  node_ttl_s: int = 0, service_ttl_s: int = 0,
                  udp_answer_limit: int = DEFAULT_UDP_ANSWER_LIMIT,
                  only_passing: bool = False, seed: int = 0,
-                 authz: Optional[Callable[[str, str, str], bool]] = None):
+                 authz: Optional[Callable[[str, str, str], bool]] = None,
+                 serving: Optional[Callable[[list], list]] = None):
         self.rpc = rpc
         self.authz = authz
+        # Optional serving-plane row sorter (rows -> rows): when set,
+        # service answers come back in device-computed NearestN order
+        # from this agent's node instead of the reference's random
+        # shuffle. Opt-in; default DNS behavior is unchanged.
+        self.serving = serving
         self.node_name = node_name
         self.domain = domain.strip(".").lower()
         self.datacenter = datacenter
@@ -389,7 +395,10 @@ class DNSServer:
         return [], NOERROR
 
     def _service_rows_to_records(self, qname, qtype, rows, ttl):
-        self.rng.shuffle(rows)
+        if self.serving is not None:
+            rows = self.serving(rows)
+        else:
+            self.rng.shuffle(rows)
         answers = []
         for r in rows:
             addr = (r["service"].get("address")

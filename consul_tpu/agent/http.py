@@ -1386,6 +1386,15 @@ class HTTPApi:
             # (agent_endpoint.go:90 promhttp).
             for k, v in self.agent.metrics.items():
                 self.agent.sink.set_gauge(f"consul.agent.{k}", v)
+            serving = getattr(self.agent, "serving", None)
+            if serving is not None:
+                # Read-plane stats as consul.serving.* gauges (queries,
+                # batches, padded_slots, cache_hits, padding waste and
+                # batch-latency percentiles) so the device serving path
+                # shows up in the same Prometheus scrape as the rest of
+                # the agent.
+                for k, v in serving.stats().items():
+                    self.agent.sink.set_gauge(f"consul.serving.{k}", v)
             snap = self.agent.sink.snapshot()
             if q.get("format") == "prometheus":
                 from consul_tpu.utils import telemetry as _tm

@@ -848,6 +848,50 @@ def cmd_run(args) -> int:
     return _run_resilient_cmd(args, sim, None, args.ticks, {"n": args.n})
 
 
+def cmd_serve_bench(args) -> int:
+    """Benchmark the device serving plane against a local simulation:
+    form a cluster, attach a ServingPlane, and drive batched NearestN
+    queries through the QueryBatcher. Prints one JSON line with the
+    same stable keys as bench.py's ``serving`` phase (queries/s/chip,
+    p50/p99 batch latency, padding waste %). The kernel runs on one
+    device, so per-chip and total throughput coincide."""
+    import random as _random
+    import time as _time
+
+    sim = _build_sim(args)
+    sim.run(args.form_ticks, chunk=args.chunk, with_metrics=False)
+
+    from consul_tpu.serving import MODE_NEAREST, ServingPlane
+
+    plane = ServingPlane(k=args.k, buckets=(args.batch,))
+    sim.attach_serving(plane)
+    rng = _random.Random(args.seed)
+
+    def make_batch(b: int):
+        return [(MODE_NEAREST, rng.randrange(args.n), -1) for _ in range(b)]
+
+    # Warm the bucket's executable so compilation never lands in the
+    # timed region (the throughput() discipline), and drop its latency
+    # sample so p50/p99 describe steady state only.
+    plane.batcher.execute(make_batch(args.batch))
+    plane.batcher.latencies_s.clear()
+    total = 0
+    t0 = _time.perf_counter()
+    while total < args.queries:
+        b = min(args.batch, args.queries - total)
+        plane.batcher.execute(make_batch(b))
+        total += b
+    wall = _time.perf_counter() - t0
+    out = dict(plane.stats())
+    # Timed-region numbers win over the batcher's lifetime counters
+    # (which include the warmup batch).
+    out.update({"n": args.n, "k": args.k, "batch": args.batch,
+                "queries": total, "wall_s": round(wall, 3),
+                "queries_per_sec_per_chip": round(total / wall, 1)})
+    print(json.dumps(out))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="consul-tpu",
@@ -925,6 +969,27 @@ def build_parser() -> argparse.ArgumentParser:
     rn.add_argument("--serf", action="store_true",
                     help="run the full serf step (event/query plane)")
     add_resilience_flags(rn)
+
+    sv = sub.add_parser(
+        "serve-bench",
+        help="benchmark the device serving plane (batched NearestN "
+             "reads straight from the simulation tensors)")
+    sv.add_argument("--n", type=int, default=4096)
+    sv.add_argument("--seed", type=int, default=0)
+    sv.add_argument("--view-degree", type=int, default=16)
+    sv.add_argument("--form-ticks", type=int, default=64,
+                    help="ticks to form the cluster before serving")
+    sv.add_argument("--chunk", type=int, default=32)
+    sv.add_argument("--queries", type=int, default=65536,
+                    help="total queries to serve in the timed region")
+    sv.add_argument("--batch", type=int, default=512,
+                    help="batch bucket size (one XLA executable)")
+    sv.add_argument("--k", type=int, default=8,
+                    help="result width (top-k nearest per query)")
+    sv.add_argument("--serf", action="store_true",
+                    help="serve over the full serf simulation")
+    sv.add_argument("--compile-cache", default=None, metavar="DIR",
+                    help="persistent XLA compilation cache directory")
 
     ch = sub.add_parser(
         "chaos",
@@ -1250,6 +1315,8 @@ def main(argv=None) -> int:
         return cmd_chaos(args)
     if args.cmd == "run":
         return cmd_run(args)
+    if args.cmd == "serve-bench":
+        return cmd_serve_bench(args)
     client = make_client(args)
     try:
         return COMMANDS[args.cmd](client, args)

@@ -210,13 +210,35 @@ class Simulation:
         # the chunk runner as a program argument; None is the schedule-
         # free program today's tests pin.
         self.chaos = None
+        # Attached read plane (consul_tpu/serving.ServingPlane or None).
+        # When set, every chunk boundary republishes a double-buffered
+        # device snapshot so concurrent readers see state consistent as
+        # of the last completed tick — never torn mid-scan, and never
+        # blocking the scan loop.
+        self.serving = None
+
+    # -- serving plane ---------------------------------------------------
+    def attach_serving(self, plane):
+        """Attach a serving read plane (consul_tpu/serving): publishes
+        a snapshot now and republishes at every chunk boundary."""
+        plane.attach(self)
+
+    def publish_serving(self):
+        """Republish the serving snapshot from current state (no-op
+        when no plane is attached). The projection is one jitted
+        program producing fresh buffers, so snapshots survive the
+        runner's donated-state overwrite on the next chunk."""
+        if self.serving is not None:
+            self.serving.publish(self)
 
     # -- fault injection ------------------------------------------------
     def kill(self, mask):
         self.state = sim_state.kill(self.state, jnp.asarray(mask))
+        self.publish_serving()
 
     def revive(self, mask):
         self.state = sim_state.revive(self.cfg, self.state, jnp.asarray(mask))
+        self.publish_serving()
 
     def set_chaos(self, sched):
         """Install (or clear, with None) a fault schedule for subsequent
@@ -354,6 +376,7 @@ class Simulation:
                 self._pending_counters.append(cnt)
                 if self.sentinel:
                     self._flush_counters()
+            self.publish_serving()
             remaining -= c
         if not with_metrics:
             return None
@@ -464,6 +487,7 @@ class Simulation:
                 self._runner(c, True)(self.state, self.base_key)
             jax.block_until_ready(trace)
             self._record_chunk(trace, cnt, c, t0)
+            self.publish_serving()
             used += c
             ok = float(trace.agreement[-1]) >= require_agreement
             if ok and rmse_target_s is not None:
@@ -487,7 +511,9 @@ class Simulation:
         self.state, cnt, _ = runner(self.state, self.base_key)
         self._pending_counters.append(cnt)
         jax.block_until_ready(self.swim_state.view_key)
-        return ticks / (time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self.publish_serving()
+        return ticks / dt
 
     # -- inspection -----------------------------------------------------
     def health(self) -> metrics.HealthMetrics:

@@ -1,0 +1,146 @@
+"""Batched read-plane kernels: masked top-k NearestN, node distance,
+and health/catalog lookups over a published device snapshot.
+
+This is the device tier of the serving plane (``consul_tpu/serving``):
+the host-side ``QueryBatcher`` packs concurrent requests into
+fixed-shape padded batches (bucketed sizes so same-shape batches share
+one XLA executable, the ``models/cluster.py`` memoization idiom) and
+each batch runs as ONE program here — a broadcast Vivaldi distance, a
+mode/eligibility mask, and a single ``lax.top_k`` per query, vmapped
+over the batch. Thousands of concurrent lookups become one gather/top-k
+kernel instead of thousands of host RPCs.
+
+Distance math reuses :func:`consul_tpu.ops.vivaldi.distance`; the host
+``server/rtt.py`` stays the documented reference implementation, and
+the golden-parity suite (tests/test_serving.py) pins agreement with it,
+including the +inf unknown-coordinate and adjustment-clamp edges.
+
+Snapshots are immutable projections of live simulation state published
+by the scan loop (see ``Simulation.publish_serving``): readers holding
+a snapshot never block the simulation and never observe a torn state —
+every result in a batch is consistent as of the snapshot's ``tick``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.ops import vivaldi
+
+# Query modes. NOOP fills padding slots (all-false eligibility, so a
+# padded slot costs the same top-k but returns count=0 and no ids).
+MODE_NOOP = 0
+MODE_NEAREST = 1   # live nodes (optionally one service), RTT order
+MODE_DIST = 2      # single node distance: arg = target node index
+MODE_CATALOG = 3   # all registered nodes (optionally one service), id order
+MODE_HEALTH = 4    # live nodes (optionally one service), id order
+
+# Sort-key sentinels. UNKNOWN must order after every real distance but
+# before PAD so eligible-but-coordinate-less nodes keep their place at
+# the back of the result (host parity: rtt unknown -> inf, sorts last,
+# stable) while ineligible/padding rows never surface at all.
+_UNKNOWN_KEY = 1e30
+_PAD_KEY = float(jnp.finfo(jnp.float32).max)
+
+
+class Snapshot(NamedTuple):
+    """Immutable device projection of one simulation tick.
+
+    All arrays share the node axis N. ``known`` marks finite Vivaldi
+    state (pairs with an unknown side answer +inf, the rtt.py rule);
+    ``live`` gates NEAREST/HEALTH eligibility; ``service`` is an i32
+    label per node (queries filter with arg, -1 = any); ``tick`` is the
+    simulation tick the whole snapshot is consistent as of.
+    """
+
+    vec: jax.Array         # [N, D] f32 Vivaldi position
+    height: jax.Array      # [N] f32
+    adjustment: jax.Array  # [N] f32
+    known: jax.Array       # [N] bool — finite coordinate state
+    live: jax.Array        # [N] bool — alive and not left
+    service: jax.Array     # [N] i32 service label
+    tick: jax.Array        # [] i32
+
+
+@jax.jit
+def project(state, service: jax.Array) -> Snapshot:
+    """Project live SimState into a read snapshot (one fused program).
+
+    Produces fresh output buffers, which is what makes double-buffered
+    publication safe: the scan runner donates and overwrites ``state``
+    on the next chunk, but a published Snapshot holds independent
+    arrays, so readers keep a coherent tick-T view for free.
+    """
+    viv = state.viv
+    known = (jnp.all(jnp.isfinite(viv.vec), axis=-1)
+             & jnp.isfinite(viv.height)
+             & jnp.isfinite(viv.adjustment))
+    live = state.alive_truth & ~state.left
+    return Snapshot(vec=viv.vec, height=viv.height,
+                    adjustment=viv.adjustment, known=known, live=live,
+                    service=service, tick=state.t)
+
+
+def _execute(k: int, snap: Snapshot, mode: jax.Array, src: jax.Array,
+             arg: jax.Array):
+    """One padded batch: mode/src/arg are [B] i32; returns
+    ``(ids [B,k] i32, rtts [B,k] f32, count [B] i32, tick [] i32)``.
+
+    Per query: broadcast Vivaldi distance from ``src`` to every node,
+    mask eligibility by mode, then one stable ``lax.top_k`` over the
+    composed sort key. top_k breaks ties toward the lower index, which
+    matches Python's stable sort over index-ordered rows — the property
+    the golden-parity suite leans on for exact order agreement.
+    Invalid slots (beyond ``count``) come back as id -1 / rtt +inf.
+    """
+    n = snap.height.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    slot = jnp.arange(k, dtype=jnp.int32)
+
+    def one(m, s, a):
+        dist = vivaldi.distance(
+            snap.vec[s], snap.height[s], snap.adjustment[s],
+            snap.vec, snap.height, snap.adjustment)
+        pair_known = snap.known[s] & snap.known
+        dist = jnp.where(pair_known, dist, jnp.inf)
+        svc_ok = (a < jnp.int32(0)) | (snap.service == a)
+        elig = jnp.where(
+            m == MODE_DIST, idx == a,
+            jnp.where(m == MODE_CATALOG, svc_ok,
+                      jnp.where((m == MODE_NEAREST) | (m == MODE_HEALTH),
+                                snap.live & svc_ok,
+                                jnp.zeros_like(snap.live))))
+        by_dist = (m == MODE_NEAREST) | (m == MODE_DIST)
+        key = jnp.where(
+            by_dist,
+            jnp.where(jnp.isfinite(dist), dist, jnp.float32(_UNKNOWN_KEY)),
+            idx.astype(jnp.float32))
+        key = jnp.where(elig, key, jnp.float32(_PAD_KEY))
+        _, ids = jax.lax.top_k(-key, k)
+        count = jnp.sum(elig.astype(jnp.int32))
+        valid = slot < count
+        return (jnp.where(valid, ids.astype(jnp.int32), jnp.int32(-1)),
+                jnp.where(valid, dist[ids], jnp.inf),
+                count)
+
+    ids, rtts, count = jax.vmap(one)(mode, src, arg)
+    return ids, rtts, count, snap.tick
+
+
+# One jit object per result width k; jit's own shape cache then yields
+# exactly one executable per (bucket B, node count N, dim D) — the
+# compile-ledger pin in tests/test_serving.py holds steady-state
+# serving to zero new compiles.
+_KERNEL_CACHE: dict[int, object] = {}
+
+
+def kernel_for(k: int):
+    """Memoized jitted batch executor for result width ``k``."""
+    fn = _KERNEL_CACHE.get(k)
+    if fn is None:
+        fn = _KERNEL_CACHE[k] = jax.jit(functools.partial(_execute, k))
+    return fn

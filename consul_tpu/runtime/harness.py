@@ -262,6 +262,13 @@ def run_resilient(sim, ticks: int, *, chunk: int = 64,
             if sink is not None:
                 sink.incr_counter("sim.runtime.reshards", 1)
 
+    # A restore (or re-placement) replaced sim.state wholesale: any
+    # attached serving plane is still publishing the pre-resume tick.
+    # Republish before the first chunk so reads are consistent as of
+    # the restored state, not the orphaned one.
+    if getattr(sim, "publish_serving", None) is not None:
+        sim.publish_serving()
+
     prev_sched = sim.chaos
     if sched is not None:
         sim.set_chaos(chaos_mod.shift_schedule(sched, t0))

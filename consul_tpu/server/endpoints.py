@@ -78,6 +78,11 @@ class Server:
         registry[node_id] = self
         # Coordinate staging (coordinate_endpoint.go:42-53).
         self._coord_updates: dict[str, dict] = {}
+        # Optional device serving plane (consul_tpu/serving): when
+        # attached, ?near= sorting and prepared-query NearestN go
+        # through one batched device kernel instead of per-row host
+        # math. rtt.py stays the reference path (and the fallback).
+        self.serving = None
         # Leader-side session TTL timers (leader.SessionTimers),
         # attached by the runtime pump while this server leads.
         self.session_timers = None
@@ -97,6 +102,26 @@ class Server:
     @property
     def store(self) -> StateStore:
         return self.fsm.store
+
+    def attach_serving(self, plane) -> None:
+        """Route this server's nearness sorting through a device
+        serving plane (consul_tpu/serving.ServingPlane)."""
+        self.serving = plane
+
+    def _near_sorted(self, near: str, rows: list,
+                     node_key: str = "node") -> list:
+        """``?near=`` nearness sort: batched device kernel when a
+        serving plane is attached (which itself falls back on shapes it
+        can't represent), host ``rtt.py`` — the documented reference
+        implementation — otherwise. Same contract either way: stable
+        order, unknown coordinates last, rows unchanged for an unknown
+        source."""
+        sets = rtt.coord_sets_from_store(self.store.coordinates())
+        if self.serving is not None:
+            return self.serving.sort_rows(sets, near, rows,
+                                          node_key=node_key)
+        return rtt.sort_nodes_by_distance(sets, near, rows,
+                                          node_key=node_key)
 
     def is_leader(self) -> bool:
         return self.raft.state == "leader" and not self.raft.stopped
@@ -252,8 +277,7 @@ class Server:
                             near: str = "") -> dict:
         out = self._blocking(["nodes"], min_index, wait_s, self.store.nodes)
         if near:
-            sets = rtt.coord_sets_from_store(self.store.coordinates())
-            out["value"] = rtt.sort_nodes_by_distance(sets, near, out["value"])
+            out["value"] = self._near_sorted(near, out["value"])
         return out
 
     def _catalog_list_services(self, min_index: int = 0,
@@ -269,8 +293,7 @@ class Server:
             lambda: self.store.service_nodes(service, tag),
         )
         if near:
-            sets = rtt.coord_sets_from_store(self.store.coordinates())
-            out["value"] = rtt.sort_nodes_by_distance(sets, near, out["value"])
+            out["value"] = self._near_sorted(near, out["value"])
         return out
 
     def _catalog_node_services(self, node: str) -> dict:
@@ -309,8 +332,7 @@ class Server:
         out = self._blocking(["services", "checks", "nodes"],
                              min_index, wait_s, fn)
         if near:
-            sets = rtt.coord_sets_from_store(self.store.coordinates())
-            out["value"] = rtt.sort_nodes_by_distance(sets, near, out["value"])
+            out["value"] = self._near_sorted(near, out["value"])
         return out
 
     def _health_node_checks(self, node: str, min_index: int = 0,
@@ -918,14 +940,8 @@ class Server:
         random.Random(f"{q['id']}|{self.store.index}").shuffle(nodes)
         near_node = near or q["service"].get("near", "")
         if near_node:
-            sets = rtt.coord_sets_from_store(self.store.coordinates())
-            nodes = rtt.sort_nodes_by_distance(sets, near_node, nodes)
-            # The queried-from node itself belongs at position 0 when
-            # present near the front (Execute:430-441, depth-capped).
-            for i, row in enumerate(nodes[:10]):
-                if row["node"] == near_node:
-                    nodes[0], nodes[i] = nodes[i], nodes[0]
-                    break
+            nodes = pq_mod.nearest_sorted(nodes, near_node,
+                                          self._near_sorted)
         if limit and len(nodes) > limit:
             nodes = nodes[:limit]
         reply["nodes"] = nodes

@@ -191,3 +191,22 @@ def resolve(queries: list[dict], id_or_name: str) -> Optional[dict]:
     if best is not None:
         return render(best, id_or_name)
     return None
+
+
+def nearest_sorted(nodes: list[dict], near_node: str, sort_fn) -> list[dict]:
+    """RTT-order an executed query's nodes from ``near_node``, then
+    float the queried-from node itself to position 0 when it lands near
+    the front (reference Execute:430-441, depth-capped at 10 — a node
+    asking for its own service should be offered itself first).
+
+    ``sort_fn(near, rows)`` is the nearness sorter — host
+    ``rtt.sort_nodes_by_distance`` over store coordinate sets or the
+    device serving plane's batched path; this helper stays pure either
+    way.
+    """
+    nodes = list(sort_fn(near_node, nodes))
+    for i, row in enumerate(nodes[:10]):
+        if row["node"] == near_node:
+            nodes[0], nodes[i] = nodes[i], nodes[0]
+            break
+    return nodes

@@ -10,6 +10,14 @@ adjusted value stays positive, infinity for nil/mismatched coordinates.
 
 This is the read-side math behind ``consul rtt`` and catalog ``?near=``
 sorting (reference command/rtt/rtt.go, agent/consul/rtt.go:21-221).
+
+This module is the documented REFERENCE IMPLEMENTATION for the device
+serving plane (``consul_tpu/serving`` + ``ops/serving.py``): the
+batched NearestN/distance kernel must agree with ``compute_distance``
+and ``sort_nodes_by_distance`` bit-for-bit in ordering — including the
++inf unknown-coordinate rule and the adjustment clamp — and the
+golden-parity suite in tests/test_serving.py pins that agreement. Keep
+behavior changes here mirrored in the kernel (and vice versa).
 """
 
 from __future__ import annotations

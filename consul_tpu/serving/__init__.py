@@ -1,0 +1,21 @@
+"""Device-resident query-serving plane.
+
+Batched NearestN / health / catalog / distance reads straight from the
+simulation tensors: a :class:`QueryBatcher` packs concurrent requests
+into fixed-shape bucketed batches, each batch runs as one masked top-k
+kernel (``ops/serving.py``) against a double-buffered device snapshot
+(:class:`ServingPlane`), and results fan back out to waiters. Host
+``server/rtt.py`` remains the documented reference implementation —
+the device path is pinned to it by the golden-parity suite.
+"""
+
+from consul_tpu.ops.serving import (MODE_CATALOG, MODE_DIST, MODE_HEALTH,
+                                    MODE_NEAREST, MODE_NOOP, Snapshot)
+from consul_tpu.serving.batcher import QueryBatcher, QueryResult
+from consul_tpu.serving.plane import NearestResult, ServingPlane
+
+__all__ = [
+    "MODE_CATALOG", "MODE_DIST", "MODE_HEALTH", "MODE_NEAREST", "MODE_NOOP",
+    "NearestResult", "QueryBatcher", "QueryResult", "ServingPlane",
+    "Snapshot",
+]

@@ -1,0 +1,191 @@
+"""QueryBatcher: collect concurrent read requests into fixed-shape
+padded batches and run each batch as one device kernel.
+
+Batch sizes are bucketed (default 1/8/64/512) so every batch reuses one
+of a handful of XLA executables — the same memoization discipline as
+``models/cluster.py``'s runner cache. A request that arrives alone pays
+one small-bucket launch; requests that arrive together share a launch,
+and padding slots run as MODE_NOOP (count 0, no ids), their cost
+surfaced through the ``sim.serving.padded_slots`` counter and the
+``padding_waste_pct`` stat.
+
+Concurrency model: there is no background thread to manage (nothing to
+leak at shutdown — the lesson of the agent cache's refresh plane).
+``submit()`` parks the caller up to ``max_wait_s``; whoever's wait
+expires first pumps EVERY pending request into one batch and fans the
+results back to the other waiters. ``execute()`` is the synchronous
+path for callers that already hold a whole batch (bench, row sorting).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from consul_tpu.ops import serving as kernels
+
+
+class QueryResult(NamedTuple):
+    """One query's answer: ``ids[i]``/``rtts[i]`` for i < count are the
+    result rows (node indices and estimated RTT seconds, +inf for
+    eligible-but-unknown coordinates); slots at and past ``count`` hold
+    id -1 / rtt +inf. ``tick`` is the snapshot tick the answer is
+    consistent as of."""
+
+    ids: np.ndarray    # [k] i32
+    rtts: np.ndarray   # [k] f32
+    count: int
+    tick: int
+
+
+class _Waiter:
+    __slots__ = ("mode", "src", "arg", "done", "result")
+
+    def __init__(self, mode: int, src: int, arg: int):
+        self.mode = mode
+        self.src = src
+        self.arg = arg
+        self.done = threading.Event()
+        self.result: Optional[QueryResult] = None
+
+
+class QueryBatcher:
+    """Packs (mode, src, arg) queries into padded bucketed batches and
+    executes them against ``plane.snapshot()`` via the memoized kernel.
+    """
+
+    def __init__(self, plane, k: int = 16,
+                 buckets: Sequence[int] = (1, 8, 64, 512),
+                 max_wait_s: float = 0.002):
+        if not buckets:
+            raise ValueError("need at least one batch bucket")
+        self.plane = plane
+        self.k = int(k)
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        self.max_batch = self.buckets[-1]
+        self.max_wait_s = float(max_wait_s)
+        self._lock = threading.Lock()
+        self._pending: list[_Waiter] = []
+        # Plain-int counters mirror the sink emissions so stats() works
+        # without a sink attached.
+        self.batches = 0
+        self.queries = 0
+        self.padded_slots = 0
+        self.latencies_s: deque[float] = deque(maxlen=4096)
+
+    # ------------------------------------------------------------------
+    # Synchronous batched path
+    # ------------------------------------------------------------------
+    def execute(self, queries: Sequence[tuple[int, int, int]]
+                ) -> list[QueryResult]:
+        """Run a caller-assembled batch; oversize inputs are chunked at
+        the largest bucket. One kernel launch + one device_get per
+        chunk."""
+        out: list[QueryResult] = []
+        for i in range(0, len(queries), self.max_batch):
+            out.extend(self._run_batch(queries[i:i + self.max_batch]))
+        return out
+
+    def _bucket(self, b: int) -> int:
+        for cap in self.buckets:
+            if cap >= b:
+                return cap
+        return self.max_batch
+
+    def _run_batch(self, queries: Sequence[tuple[int, int, int]]
+                   ) -> list[QueryResult]:
+        import jax
+
+        snap = self.plane.snapshot()
+        t0 = time.perf_counter()
+        b = len(queries)
+        bucket = self._bucket(b)
+        mode = np.full(bucket, kernels.MODE_NOOP, dtype=np.int32)
+        src = np.zeros(bucket, dtype=np.int32)
+        arg = np.full(bucket, -1, dtype=np.int32)
+        for j, (m, s, a) in enumerate(queries):
+            mode[j] = m
+            src[j] = s
+            arg[j] = a
+        dm, ds, da = jax.device_put((mode, src, arg))
+        ids, rtts, count, tick = kernels.kernel_for(self.k)(snap, dm, ds, da)
+        h_ids, h_rtts, h_count, h_tick = jax.device_get(
+            (ids, rtts, count, tick))
+        self.latencies_s.append(time.perf_counter() - t0)
+
+        pad = bucket - b
+        self.batches += 1
+        self.queries += b
+        self.padded_slots += pad
+        sink = getattr(self.plane, "sink", None)
+        if sink is not None:
+            sink.incr_counter("sim.serving.batches", 1)
+            sink.incr_counter("sim.serving.queries", b)
+            if pad:
+                sink.incr_counter("sim.serving.padded_slots", pad)
+
+        tick_i = int(h_tick)
+        return [QueryResult(h_ids[j], h_rtts[j], int(h_count[j]), tick_i)
+                for j in range(b)]
+
+    # ------------------------------------------------------------------
+    # Concurrent submit/fan-out path
+    # ------------------------------------------------------------------
+    def submit(self, mode: int, src: int, arg: int = -1,
+               timeout_s: float = 10.0) -> QueryResult:
+        """Enqueue one query and block for its result. Concurrent
+        submitters coalesce: each parks up to ``max_wait_s`` and the
+        first to time out (or to fill the largest bucket) pumps the
+        whole pending set as one batch, fanning results back."""
+        w = _Waiter(int(mode), int(src), int(arg))
+        with self._lock:
+            self._pending.append(w)
+            full = len(self._pending) >= self.max_batch
+        if full:
+            self.pump()
+        deadline = time.monotonic() + timeout_s
+        while not w.done.wait(self.max_wait_s):
+            if time.monotonic() >= deadline:
+                raise TimeoutError("serving query timed out")
+            self.pump()
+        assert w.result is not None
+        return w.result
+
+    def pump(self) -> int:
+        """Drain pending waiters (up to one max bucket) into one batch;
+        returns how many were served."""
+        with self._lock:
+            batch = self._pending[:self.max_batch]
+            del self._pending[:len(batch)]
+        if not batch:
+            return 0
+        results = self._run_batch([(w.mode, w.src, w.arg) for w in batch])
+        for w, r in zip(batch, results):
+            w.result = r
+            w.done.set()
+        return len(batch)
+
+    # ------------------------------------------------------------------
+    # Stats
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        lats = sorted(self.latencies_s)
+        if lats:
+            p50 = lats[len(lats) // 2]
+            p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+        else:
+            p50 = p99 = 0.0
+        slots = self.queries + self.padded_slots
+        return {
+            "batches": self.batches,
+            "queries": self.queries,
+            "padded_slots": self.padded_slots,
+            "padding_waste_pct": round(100.0 * self.padded_slots
+                                       / max(1, slots), 2),
+            "p50_batch_ms": round(p50 * 1e3, 3),
+            "p99_batch_ms": round(p99 * 1e3, 3),
+        }

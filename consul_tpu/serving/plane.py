@@ -1,0 +1,336 @@
+"""ServingPlane: double-buffered device snapshots + high-level reads.
+
+The plane owns two snapshot slots and an index to the current one;
+``publish`` projects live state into the idle slot and then atomically
+swaps the index. Readers that grabbed the previous snapshot keep using
+it — JAX arrays are immutable, so a reader's view stays coherent as of
+its snapshot's tick while the simulation (and future publishes) race
+ahead. Readers never block the scan loop and never observe a torn
+state.
+
+Two sources can feed a plane (one per instance, never both):
+
+* **sim** — attached to a ``models/cluster.py`` Simulation; the scan
+  loop republishes at every chunk boundary (``publish_serving``).
+  Queries address nodes by simulation index.
+* **host** — built from server-store coordinate rows
+  (``publish_coords``); this is what backs catalog/health ``?near=``
+  sorting and prepared-query NearestN. Queries address nodes by name.
+  Coordinate sets using named segments fall back to the host
+  ``server/rtt.py`` reference path (documented narrowing: the device
+  snapshot models one default-segment coordinate per node).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from consul_tpu.ops import serving as kernels
+from consul_tpu.serving.batcher import QueryBatcher, QueryResult
+
+
+class NearestResult(NamedTuple):
+    """A NearestN answer with ids resolved to the plane's addressing
+    (simulation indices or node names)."""
+
+    nodes: list          # [(node, rtt_s)] ascending RTT, len == count
+    count: int
+    tick: int
+
+
+class ServingPlane:
+    def __init__(self, k: int = 16,
+                 buckets: Sequence[int] = (1, 8, 64, 512),
+                 max_wait_s: float = 0.002, sink=None,
+                 num_services: int = 0):
+        self.k = int(k)
+        self.sink = sink
+        # Synthetic service labels for sim mode: node i -> service
+        # i mod num_services (0/1 = single unlabeled service). Enough
+        # to exercise health-filtered service lookups at scale; the
+        # host mode carries real store rows instead.
+        self.num_services = int(num_services)
+        self.batcher = QueryBatcher(self, k=k, buckets=buckets,
+                                    max_wait_s=max_wait_s)
+        # Double buffer: write the idle slot, then swap the index.
+        self._slots: list = [None, None]
+        self._cur = -1
+        self._source: Optional[str] = None  # "sim" | "host"
+        self._service_labels = None  # cached per-n device labels (sim)
+        self.cache_hits = 0
+        # Host-mode name table (publish_coords).
+        self._names: tuple[str, ...] = ()
+        self._name_idx: dict[str, int] = {}
+        self._host_fp = None
+        self._host_d = 0
+        self._host_version = 0
+        self._host_usable: dict[str, bool] = {}
+
+    # ------------------------------------------------------------------
+    # Snapshot publication
+    # ------------------------------------------------------------------
+    def snapshot(self) -> kernels.Snapshot:
+        if self._cur < 0:
+            raise RuntimeError("serving plane has no published snapshot")
+        return self._slots[self._cur]
+
+    @property
+    def tick(self) -> int:
+        import jax
+
+        return int(jax.device_get(self.snapshot().tick))
+
+    def _flip(self, snap: kernels.Snapshot) -> None:
+        idle = 1 - self._cur if self._cur >= 0 else 0
+        self._slots[idle] = snap
+        self._cur = idle
+
+    def attach(self, sim) -> None:
+        """Bind to a Simulation: adopt its sink, register on the sim so
+        the scan loop republishes each chunk, and publish now."""
+        if self._source == "host":
+            raise RuntimeError("plane already serves host coordinates")
+        self._source = "sim"
+        if self.sink is None:
+            self.sink = getattr(sim, "sink", None)
+        sim.serving = self
+        self.publish(sim)
+
+    def publish(self, sim) -> None:
+        """Project the sim's current state into the idle buffer and
+        swap. Called by the scan loop at chunk boundaries; one jitted
+        projection, no host round-trip."""
+        self.publish_state(sim.swim_state)
+
+    def publish_state(self, state) -> None:
+        import jax.numpy as jnp
+
+        n = state.alive_truth.shape[0]
+        labels = self._service_labels
+        if labels is None or labels.shape[0] != n:
+            if self.num_services > 1:
+                labels = (jnp.arange(n, dtype=jnp.int32)
+                          % jnp.int32(self.num_services))
+            else:
+                labels = jnp.zeros(n, dtype=jnp.int32)
+            self._service_labels = labels
+        self._flip(kernels.project(state, labels))
+
+    # ------------------------------------------------------------------
+    # Host-coordinate publication (server store rows)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _flatten(cset: dict) -> Optional[dict]:
+        # Device snapshots model one default-segment coordinate per
+        # node; anything else falls back to rtt.py's pairwise
+        # intersect() semantics on the host.
+        if set(cset) == {""}:
+            return cset[""]
+        return None
+
+    def publish_coords(self, coord_sets: dict) -> bool:
+        """Build/refresh a device snapshot from per-node coordinate
+        sets (``rtt.coord_sets_from_store`` shape). Returns False —
+        leaving any prior snapshot untouched — when the sets use
+        segment shapes the device path doesn't model."""
+        import jax
+
+        if self._source == "sim":
+            raise RuntimeError("plane already serves a simulation")
+        flat: dict[str, Optional[dict]] = {}
+        fp = []
+        for name in sorted(coord_sets):
+            c = self._flatten(coord_sets[name])
+            if c is None:
+                return False
+            flat[name] = c
+            fp.append((name, tuple(c.get("vec", ())),
+                       float(c.get("height", 0.0)),
+                       float(c.get("adjustment", 0.0))))
+        fp = tuple(fp)
+        if fp == self._host_fp:
+            return True
+
+        names = tuple(flat)
+        dims = [len(c.get("vec", ())) for c in flat.values()]
+        # Modal dimensionality hosts the snapshot; off-dimension nodes
+        # are "unknown" (sort_rows falls back when the SOURCE itself is
+        # off-dimension, where host math would still be finite).
+        d = max(set(dims), key=dims.count) if dims else 1
+        d = max(d, 1)
+        # Pad the node axis to a power of two so snapshot shapes (and
+        # the executables compiled against them) stay stable as
+        # membership grows.
+        n_pad = max(4, 1 << (max(len(names), 1) - 1).bit_length())
+        vec = np.zeros((n_pad, d), dtype=np.float32)
+        height = np.zeros(n_pad, dtype=np.float32)
+        adj = np.zeros(n_pad, dtype=np.float32)
+        known = np.zeros(n_pad, dtype=bool)
+        live = np.zeros(n_pad, dtype=bool)
+        usable: dict[str, bool] = {}
+        for i, (name, c) in enumerate(flat.items()):
+            v = c.get("vec", ())
+            ok = (len(v) == d and all(math.isfinite(x) for x in v)
+                  and math.isfinite(c.get("height", 0.0))
+                  and math.isfinite(c.get("adjustment", 0.0)))
+            usable[name] = ok
+            live[i] = True
+            if ok:
+                vec[i] = np.asarray(v, dtype=np.float32)
+                height[i] = c.get("height", 0.0)
+                adj[i] = c.get("adjustment", 0.0)
+                known[i] = True
+        self._names = names
+        self._name_idx = {name: i for i, name in enumerate(names)}
+        self._host_fp = fp
+        self._host_d = d
+        self._host_usable = usable
+        self._host_version += 1
+        dv, dh, da_, dk, dl, ds, dt = jax.device_put(
+            (vec, height, adj, known, live,
+             np.zeros(n_pad, dtype=np.int32),
+             np.int32(self._host_version)))
+        self._source = "host"
+        self._flip(kernels.Snapshot(vec=dv, height=dh, adjustment=da_,
+                                    known=dk, live=dl, service=ds,
+                                    tick=dt))
+        return True
+
+    # ------------------------------------------------------------------
+    # High-level reads
+    # ------------------------------------------------------------------
+    def _to_idx(self, node) -> int:
+        if isinstance(node, str):
+            return self._name_idx.get(node, -1)
+        return int(node)
+
+    def _from_idx(self, i: int):
+        if self._source == "host" and 0 <= i < len(self._names):
+            return self._names[i]
+        return i
+
+    def _resolve(self, res: QueryResult) -> NearestResult:
+        nodes = [(self._from_idx(int(res.ids[j])), float(res.rtts[j]))
+                 for j in range(min(res.count, len(res.ids)))
+                 if int(res.ids[j]) >= 0]
+        return NearestResult(nodes=nodes, count=res.count, tick=res.tick)
+
+    def nearest(self, src, service: int = -1,
+                timeout_s: float = 10.0) -> NearestResult:
+        """Top-k live nodes by estimated RTT from ``src`` (batched with
+        concurrent callers via the QueryBatcher)."""
+        res = self.batcher.submit(kernels.MODE_NEAREST, self._to_idx(src),
+                                  service, timeout_s=timeout_s)
+        return self._resolve(res)
+
+    def nearest_many(self, sources: Sequence,
+                     service: int = -1) -> list[NearestResult]:
+        """One caller, many sources: a single pre-assembled batch."""
+        qs = [(kernels.MODE_NEAREST, self._to_idx(s), service)
+              for s in sources]
+        return [self._resolve(r) for r in self.batcher.execute(qs)]
+
+    def node_distance(self, a, b, timeout_s: float = 10.0) -> float:
+        """Estimated RTT seconds between two nodes; +inf when either
+        side is unknown (the rtt.compute_distance rule)."""
+        bi = self._to_idx(b)
+        res = self.batcher.submit(kernels.MODE_DIST, self._to_idx(a), bi,
+                                  timeout_s=timeout_s)
+        if res.count < 1:
+            return math.inf
+        return float(res.rtts[0])
+
+    def catalog_nodes(self, service: int = -1,
+                      timeout_s: float = 10.0) -> NearestResult:
+        """Registered nodes (id order, optionally one service label)."""
+        res = self.batcher.submit(kernels.MODE_CATALOG, 0, service,
+                                  timeout_s=timeout_s)
+        return self._resolve(res)
+
+    def health_nodes(self, service: int = -1,
+                     timeout_s: float = 10.0) -> NearestResult:
+        """Live (health-passing) nodes, id order."""
+        res = self.batcher.submit(kernels.MODE_HEALTH, 0, service,
+                                  timeout_s=timeout_s)
+        return self._resolve(res)
+
+    # ------------------------------------------------------------------
+    # Host row sorting (?near= and prepared-query NearestN)
+    # ------------------------------------------------------------------
+    def sort_rows(self, coord_sets: dict, source: str, rows: list,
+                  node_key: str = "node") -> list:
+        """Drop-in for ``rtt.sort_nodes_by_distance``: same contract
+        (stable sort, unknown coordinates last, rows unchanged for an
+        unknown source) but the distances come from one batched device
+        kernel — one MODE_DIST slot per row. Falls back to the host
+        reference path whenever the device snapshot can't represent
+        the inputs exactly."""
+        from consul_tpu.server import rtt
+
+        if not coord_sets.get(source):
+            return list(rows)
+        if len(rows) <= 1:
+            return list(rows)
+        if not self.publish_coords(coord_sets):
+            return rtt.sort_nodes_by_distance(coord_sets, source, rows,
+                                              node_key=node_key)
+        si = self._name_idx.get(source, -1)
+        if si < 0 or not self._host_usable.get(source, False):
+            # Off-dimension / non-finite source: host math can still
+            # yield finite same-dimension distances — defer to it.
+            return rtt.sort_nodes_by_distance(coord_sets, source, rows,
+                                              node_key=node_key)
+        qs = [(kernels.MODE_DIST, si,
+               self._name_idx.get(row.get(node_key), -1)) for row in rows]
+        results = self.batcher.execute(qs)
+        keys = [float(r.rtts[0]) if r.count >= 1 else math.inf
+                for r in results]
+        order = sorted(range(len(rows)), key=keys.__getitem__)
+        return [rows[i] for i in order]
+
+    # ------------------------------------------------------------------
+    # Cache front (agent/cache.py)
+    # ------------------------------------------------------------------
+    def register_cache_type(self, cache, name: str = "serving-nearest",
+                            ttl_s: float = 0.5) -> None:
+        """Register the batched device path as a CacheType: the fetcher
+        IS a serving query, so repeated NearestN reads within the TTL
+        cost zero device round-trips."""
+
+        def factory(src=0, service=-1):
+            def fetch(min_index: int, wait_s: float) -> dict:
+                res = self.nearest(src, service=service)
+                return {"index": res.tick,
+                        "value": {"nodes": res.nodes, "count": res.count,
+                                  "tick": res.tick}}
+
+            return fetch
+
+        cache.register_type(name, factory, ttl_s=ttl_s, refresh=False)
+        self._cache_type = name
+
+    def cached_nearest(self, cache, src, service: int = -1,
+                       name: str = "serving-nearest") -> dict:
+        """NearestN through the agent cache, counting hits into
+        ``sim.serving.cache_hits``."""
+        before = cache.metrics["hits"]
+        val = cache.get_typed(name, src=self._to_idx(src), service=service)
+        if cache.metrics["hits"] > before:
+            self.note_cache_hit()
+        return val
+
+    def note_cache_hit(self) -> None:
+        self.cache_hits += 1
+        if self.sink is not None:
+            self.sink.incr_counter("sim.serving.cache_hits", 1)
+
+    # ------------------------------------------------------------------
+    # Stats
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        out = self.batcher.stats()
+        out["cache_hits"] = self.cache_hits
+        return out

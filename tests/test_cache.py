@@ -175,3 +175,62 @@ class TestNonRefreshTypes:
         out = cache.get_blocking("t", min_index=0, wait_s=1.0)
         assert out["index"] == 1 and out["value"] == "v1"
         cache.close()
+
+
+class TestClose:
+    def test_no_fetches_after_close(self):
+        """The shutdown contract: once close() returns (threads joined),
+        a refresh-typed entry issues NO further store round-trips — the
+        refresh-thread leak this guards against kept blocking queries
+        alive after the cache was dropped."""
+        store, cache = FakeStore(), Cache()
+        cache.register_type("t", store.fetcher, ttl_s=0.01, refresh=True)
+        assert cache.get_typed("t") == "v1"
+        # Let the refresh loop reach its blocking park.
+        assert wait_for(lambda: store.blocking_waits >= 1)
+        cache.close()
+        before = store.fetches
+        # Advance the store: a live refresh loop would fetch again.
+        store.set("v2")
+        time.sleep(0.3)
+        assert store.fetches == before
+        # get() after close never fetches either: it serves the stale
+        # entry (TTL long expired) without touching the store.
+        assert cache.get_typed("t") == "v1"
+        assert store.fetches == before
+
+    def test_get_after_close_without_entry_raises(self):
+        from consul_tpu.agent.cache import CacheClosedError
+
+        import pytest
+
+        store, cache = FakeStore(), Cache()
+        cache.register_type("t", store.fetcher, ttl_s=30.0, refresh=False)
+        cache.close()
+        with pytest.raises(CacheClosedError):
+            cache.get_typed("t")
+        assert store.fetches == 0
+
+    def test_close_wakes_parked_blocking_watchers(self):
+        """Parked get_blocking watchers wake on close() immediately
+        (notify_all on every entry) instead of riding out their 1 s
+        poll interval against a dead cache."""
+        store, cache = FakeStore(), Cache()
+        cache.register_type("t", store.fetcher, ttl_s=30.0, refresh=True)
+        cache.get_typed("t")  # warm the entry + refresh loop
+        got = {}
+
+        def blocked():
+            t0 = time.monotonic()
+            got["out"] = cache.get_blocking("t", min_index=99, wait_s=30.0)
+            got["wall"] = time.monotonic() - t0
+
+        th = threading.Thread(target=blocked)
+        th.start()
+        time.sleep(0.1)
+        cache.close()
+        th.join(timeout=5.0)
+        assert not th.is_alive()
+        # Woke on the close notification, not the 30 s timeout.
+        assert got["wall"] < 5.0
+        assert got["out"]["value"] == "v1"

@@ -1,0 +1,412 @@
+"""Device-resident serving plane (consul_tpu/serving + ops/serving.py).
+
+Covers the golden-parity contract against the host reference
+(server/rtt.py), snapshot semantics (consistent-as-of-tick, never
+torn), the QueryBatcher's bucketing/padding/fan-out, the compile-ledger
+pin (steady-state serving adds zero executables), the agent-cache
+front, telemetry counters, and the DNS / endpoints / prepared-query
+wiring."""
+
+import math
+import random
+import threading
+
+import pytest
+
+from consul_tpu.config import SimConfig
+from consul_tpu.models.cluster import Simulation
+from consul_tpu.server import rtt
+from consul_tpu.server.prepared_query import nearest_sorted
+from consul_tpu.serving import (MODE_DIST, MODE_NEAREST, QueryBatcher,
+                                ServingPlane)
+
+
+def make_coord_sets(n=12, seed=7, dims=4):
+    """Random host coordinate sets exercising every edge the reference
+    math has: continuous coords (no accidental ties), one huge negative
+    adjustment (the adjusted<=0 clamp), one dimensionality mismatch
+    (+inf pairs)."""
+    rng = random.Random(seed)
+    sets = {}
+    for i in range(n):
+        sets[f"n{i}"] = {"": {
+            "vec": [rng.uniform(-0.05, 0.05) for _ in range(dims)],
+            "height": rng.uniform(1e-5, 0.01),
+            "adjustment": rng.uniform(-0.02, 0.02),
+        }}
+    sets["n3"][""]["adjustment"] = -10.0      # clamp: adjusted <= 0
+    sets["n7"] = {"": {"vec": [0.1, 0.2],     # wrong dimensionality
+                       "height": 0.001, "adjustment": 0.0}}
+    return sets
+
+
+def host_pair_distance(sets, a, b):
+    sa, sb = sets.get(a), sets.get(b)
+    if not sa or not sb:
+        return math.inf
+    return rtt.compute_distance(*rtt.intersect(sa, sb))
+
+
+class TestGoldenParity:
+    """Device kernel vs server/rtt.py — the documented reference."""
+
+    def test_sort_rows_matches_reference(self):
+        sets = make_coord_sets()
+        rows = [{"node": f"n{i}"} for i in range(12)]
+        rows += [{"node": "ghost"}, {"node": "ghost2"}]  # unregistered
+        random.Random(3).shuffle(rows)
+        plane = ServingPlane(k=4, buckets=(1, 4, 16))
+        got = plane.sort_rows(sets, "n0", [dict(r) for r in rows])
+        want = rtt.sort_nodes_by_distance(sets, "n0",
+                                          [dict(r) for r in rows])
+        assert [r["node"] for r in got] == [r["node"] for r in want]
+        # Unknown coordinates (wrong dims, unregistered) sorted last.
+        assert {r["node"] for r in got[-3:]} == {"n7", "ghost", "ghost2"}
+
+    def test_sort_rows_from_every_source(self):
+        sets = make_coord_sets(seed=11)
+        rows = [{"node": f"n{i}"} for i in range(12)]
+        plane = ServingPlane(k=4, buckets=(1, 4, 16))
+        for src in ("n1", "n3", "n5"):  # incl. the clamped source
+            got = plane.sort_rows(sets, src, [dict(r) for r in rows])
+            want = rtt.sort_nodes_by_distance(sets, src,
+                                              [dict(r) for r in rows])
+            assert [r["node"] for r in got] == [r["node"] for r in want]
+
+    def test_node_distance_matches_compute_distance(self):
+        sets = make_coord_sets()
+        plane = ServingPlane(k=2, buckets=(1, 4))
+        assert plane.publish_coords(sets)
+        for a, b in [("n0", "n1"), ("n0", "n3"), ("n2", "n5"),
+                     ("n3", "n4"), ("n0", "n0")]:
+            want = host_pair_distance(sets, a, b)
+            got = plane.node_distance(a, b)
+            assert got == pytest.approx(want, rel=1e-4, abs=1e-6)
+
+    def test_unknown_coordinate_is_inf(self):
+        sets = make_coord_sets()
+        plane = ServingPlane(k=2, buckets=(1, 4))
+        assert plane.publish_coords(sets)
+        # Wrong dimensionality pairs and unregistered nodes: +inf on
+        # both paths (reference lib/rtt.go nil/mismatch rule).
+        assert math.isinf(host_pair_distance(sets, "n0", "n7"))
+        assert math.isinf(plane.node_distance("n0", "n7"))
+        assert math.isinf(plane.node_distance("n0", "ghost"))
+
+    def test_adjustment_clamp_matches(self):
+        # n3 carries adjustment=-10: adjusted <= 0, so both paths must
+        # return the UNadjusted distance (coordinate.go clamp).
+        sets = make_coord_sets()
+        plane = ServingPlane(k=2, buckets=(1, 4))
+        assert plane.publish_coords(sets)
+        want = host_pair_distance(sets, "n3", "n5")
+        c3, c5 = sets["n3"][""], sets["n5"][""]
+        unadjusted = (math.dist(c3["vec"], c5["vec"])
+                      + c3["height"] + c5["height"])
+        assert want == pytest.approx(unadjusted)  # clamp engaged
+        assert plane.node_distance("n3", "n5") == pytest.approx(
+            want, rel=1e-4)
+
+    def test_unknown_source_returns_rows_unchanged(self):
+        sets = make_coord_sets()
+        rows = [{"node": f"n{i}"} for i in range(5)]
+        plane = ServingPlane(k=2, buckets=(1, 8))
+        got = plane.sort_rows(sets, "nope", [dict(r) for r in rows])
+        assert [r["node"] for r in got] == [r["node"] for r in rows]
+
+    def test_segmented_sets_fall_back_to_reference(self):
+        # Named segments aren't modeled on device; the plane must defer
+        # to rtt.py and still produce the reference order.
+        sets = make_coord_sets()
+        sets["n1"]["alpha"] = {"vec": [0.0] * 4, "height": 0.0,
+                               "adjustment": 0.0}
+        rows = [{"node": f"n{i}"} for i in range(12)]
+        plane = ServingPlane(k=4, buckets=(1, 16))
+        got = plane.sort_rows(sets, "n0", [dict(r) for r in rows])
+        want = rtt.sort_nodes_by_distance(sets, "n0",
+                                          [dict(r) for r in rows])
+        assert [r["node"] for r in got] == [r["node"] for r in want]
+        assert plane.batcher.queries == 0  # device path never ran
+
+
+@pytest.fixture(scope="module")
+def served_sim():
+    """One small formed simulation with an attached plane, shared by
+    the sim-mode tests (module-scoped: forming is the slow part)."""
+    sim = Simulation(SimConfig(n=64, view_degree=8), seed=3)
+    sim.run(64, chunk=32, with_metrics=False)
+    plane = ServingPlane(k=8, buckets=(1, 4, 16))
+    sim.attach_serving(plane)
+    return sim, plane
+
+
+class TestSimServing:
+    def test_nearest_matches_host_math_on_device_coords(self, served_sim):
+        import jax
+
+        sim, plane = served_sim
+        snap = plane.snapshot()
+        vec, height, adj = jax.device_get(
+            (snap.vec, snap.height, snap.adjustment))
+        src = 5
+        res = plane.nearest(src)
+        assert res.count == int(jax.device_get(snap.live).sum())
+
+        def host_dist(j):
+            d = (math.dist(vec[src].tolist(), vec[j].tolist())
+                 + float(height[src]) + float(height[j]))
+            a = d + float(adj[src]) + float(adj[j])
+            return a if a > 0.0 else d
+
+        rtts = [r for _, r in res.nodes]
+        assert rtts == sorted(rtts)  # ascending RTT
+        for node, r in res.nodes:
+            assert r == pytest.approx(host_dist(node), rel=1e-4, abs=1e-6)
+
+    def test_snapshot_is_consistent_as_of_tick_never_torn(self, served_sim):
+        import jax
+
+        sim, plane = served_sim
+        old = plane.snapshot()
+        old_tick = int(jax.device_get(old.tick))
+        old_live = int(jax.device_get(old.live).sum())
+        sim.run(32, chunk=32, with_metrics=False)
+        # The plane republished at the chunk boundary...
+        assert plane.tick == old_tick + 32
+        # ...but a reader's previously-grabbed snapshot is untouched:
+        # same tick, same live view (immutable arrays, double buffer).
+        assert int(jax.device_get(old.tick)) == old_tick
+        assert int(jax.device_get(old.live).sum()) == old_live
+
+    def test_kill_excludes_from_nearest_and_health(self, served_sim):
+        sim, plane = served_sim
+        before = plane.health_nodes().count
+        sim.kill([1] * 8 + [0] * 56)
+        res = plane.nearest(20)
+        assert all(node >= 8 for node, _ in res.nodes)
+        assert plane.health_nodes().count == before - 8
+        sim.revive([1] * 8 + [0] * 56)
+
+    def test_catalog_includes_dead_nodes(self, served_sim):
+        sim, plane = served_sim
+        sim.kill([1] * 4 + [0] * 60)
+        try:
+            # Catalog = registered, health = live (reference catalog vs
+            # health endpoint split).
+            assert plane.catalog_nodes().count == 64
+            assert plane.health_nodes().count == 60
+        finally:
+            sim.revive([1] * 4 + [0] * 60)
+
+
+class TestQueryBatcher:
+    def test_bucketing_pads_to_fixed_shapes(self, served_sim):
+        _, plane = served_sim
+        b = QueryBatcher(plane, k=4, buckets=(1, 4, 16))
+        b.execute([(MODE_NEAREST, 2, -1)] * 3)  # 3 -> bucket 4
+        assert b.batches == 1 and b.queries == 3 and b.padded_slots == 1
+        st = b.stats()
+        assert st["padding_waste_pct"] == pytest.approx(25.0)
+
+    def test_oversize_batch_chunks_at_max_bucket(self, served_sim):
+        _, plane = served_sim
+        b = QueryBatcher(plane, k=4, buckets=(1, 4))
+        out = b.execute([(MODE_DIST, i % 64, (i + 1) % 64)
+                        for i in range(10)])
+        assert len(out) == 10
+        assert b.batches == 3  # 4 + 4 + 2(->4)
+        assert all(r.count == 1 for r in out)
+
+    def test_concurrent_submits_coalesce_and_fan_out(self, served_sim):
+        _, plane = served_sim
+        b = QueryBatcher(plane, k=4, buckets=(1, 4, 16),
+                         max_wait_s=0.05)
+        results = {}
+        errors = []
+
+        def reader(i):
+            try:
+                results[i] = b.submit(MODE_DIST, i, (i + 1) % 64,
+                                      timeout_s=10.0)
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=reader, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert not errors
+        assert len(results) == 12
+        assert b.queries == 12
+        # Every waiter got ITS OWN answer fanned back (src-specific).
+        for i, r in results.items():
+            assert r.count == 1
+            assert math.isfinite(r.rtts[0])
+        # Coalescing happened: fewer kernel launches than queries.
+        assert b.batches < 12
+
+    def test_telemetry_counters_through_shared_sink(self, served_sim):
+        sim, plane = served_sim
+        before_q = sim.sink.counter_sum("sim.serving.queries")
+        before_b = sim.sink.counter_sum("sim.serving.batches")
+        before_p = sim.sink.counter_sum("sim.serving.padded_slots")
+        plane.batcher.execute([(MODE_NEAREST, 1, -1)] * 3)  # bucket 4
+        assert sim.sink.counter_sum("sim.serving.queries") == before_q + 3
+        assert sim.sink.counter_sum("sim.serving.batches") == before_b + 1
+        assert (sim.sink.counter_sum("sim.serving.padded_slots")
+                == before_p + 1)
+
+
+class TestCompileLedgerPin:
+    def test_steady_state_serving_adds_zero_compiles(self, compile_ledger):
+        """Bucketed shapes = one executable per bucket: after one warm
+        batch per bucket, any mix of batch sizes, modes, and republishes
+        compiles NOTHING new (the acceptance-criteria pin)."""
+        sim = Simulation(SimConfig(n=64, view_degree=8), seed=5)
+        sim.run(32, chunk=32, with_metrics=False)
+        plane = ServingPlane(k=4, buckets=(1, 4, 16))
+        sim.attach_serving(plane)  # warms project()
+        # Warm each bucket's executable once.
+        plane.batcher.execute([(MODE_NEAREST, 0, -1)] * 1)
+        plane.batcher.execute([(MODE_NEAREST, 0, -1)] * 4)
+        plane.batcher.execute([(MODE_NEAREST, 0, -1)] * 16)
+        with compile_ledger.expect(0):
+            # New batch sizes within warmed buckets, different modes,
+            # different values, and scan-loop republishes.
+            sim.run(64, chunk=32, with_metrics=False)
+            plane.batcher.execute([(MODE_DIST, 1, 2)] * 3)       # -> 4
+            plane.batcher.execute([(MODE_NEAREST, 7, -1)] * 9)   # -> 16
+            plane.nearest(11)                                    # -> 1
+            plane.health_nodes()
+            plane.catalog_nodes()
+
+
+class TestCacheFront:
+    def test_cache_type_fetcher_is_the_device_path(self, served_sim):
+        from consul_tpu.agent.cache import Cache
+
+        _, plane = served_sim
+        cache = Cache()
+        plane.register_cache_type(cache, ttl_s=30.0)
+        before_hits = plane.cache_hits
+        v1 = plane.cached_nearest(cache, 3)
+        v2 = plane.cached_nearest(cache, 3)
+        assert v1 == v2
+        assert v1["count"] > 0 and v1["nodes"][0][0] == 3  # self nearest
+        # One device fetch, one cache hit.
+        assert cache.fetch_count("serving-nearest", src=3, service=-1) == 1
+        assert plane.cache_hits == before_hits + 1
+        cache.close()
+
+    def test_agent_attach_serving(self, served_sim):
+        from consul_tpu.agent.agent import Agent
+
+        _, plane = served_sim
+        agent = Agent("n0", "10.0.0.1", rpc=lambda *a, **k: None)
+        agent.attach_serving(plane)
+        out1 = agent.serving_nearest(9)
+        out2 = agent.serving_nearest(9)
+        assert out1 == out2 and out1["count"] > 0
+        assert (agent.cache.fetch_count("serving-nearest", src=9,
+                                        service=-1) == 1)
+        agent.close()
+
+
+class TestWiring:
+    def test_dns_serving_order(self):
+        """DNS answers come back in serving-plane NearestN order from
+        the agent's node (instead of the reference shuffle) when a
+        sorter is wired."""
+        from consul_tpu.agent import dns
+
+        sets = make_coord_sets(n=6, seed=2)
+        plane = ServingPlane(k=4, buckets=(1, 8))
+        srv = dns.DNSServer(
+            lambda *a, **k: None, node_name="n0",
+            serving=lambda rows: plane.sort_rows(sets, "n0", rows))
+        rows = [{"node": f"n{i}",
+                 "service": {"address": f"10.0.0.{i}", "port": 80}}
+                for i in range(5, -1, -1)]
+        recs = srv._service_rows_to_records(
+            "web.service.consul", dns.A, rows, 0)
+        got = [r[3] for r in recs]
+        want_rows = rtt.sort_nodes_by_distance(
+            sets, "n0", [{"node": f"n{i}"} for i in range(5, -1, -1)])
+        want = [f"10.0.0.{r['node'][1:]}" for r in want_rows]
+        assert got == want
+
+    def test_endpoints_near_sorting_through_plane(self):
+        from consul_tpu.server.endpoints import ServerCluster
+
+        c = ServerCluster(3, seed=1)
+        c.wait_converged()
+        leader = c.leader_server()
+        for i in range(3):
+            c.write(leader, "Catalog.Register", node=f"n{i}",
+                    address=f"10.0.0.{i}",
+                    service={"id": "web", "service": "web"})
+            leader.rpc("Coordinate.Update", node=f"n{i}",
+                       coord={"vec": [i * 0.010] + [0.0] * 7,
+                              "error": 1.5, "height": 0.0,
+                              "adjustment": 0.0})
+        leader.flush_coordinates()
+        c.step(30)
+        plane = ServingPlane(k=4, buckets=(1, 8))
+        leader.attach_serving(plane)
+        out = leader.rpc("Catalog.ListNodes", near="n2")
+        assert [n["node"] for n in out["value"]] == ["n2", "n1", "n0"]
+        out = leader.rpc("Health.ServiceNodes", service="web", near="n0")
+        assert [n["node"] for n in out["value"]] == ["n0", "n1", "n2"]
+        assert plane.batcher.queries > 0  # the device path served them
+
+    def test_prepared_query_nearest_sorted_pins_near_node_first(self):
+        sets = make_coord_sets(n=6, seed=4)
+        plane = ServingPlane(k=4, buckets=(1, 8))
+        nodes = [{"node": f"n{i}"} for i in range(6)]
+
+        def sort_fn(near, rows):
+            return plane.sort_rows(sets, near, rows)
+
+        got = nearest_sorted([dict(r) for r in nodes], "n4", sort_fn)
+        # n4 floats to position 0; the rest keep reference RTT order.
+        assert got[0]["node"] == "n4"
+        want = rtt.sort_nodes_by_distance(sets, "n4",
+                                          [dict(r) for r in nodes])
+        assert sorted(r["node"] for r in got) == sorted(
+            r["node"] for r in want)
+
+    def test_http_metrics_exposes_consul_serving_gauges(self, served_sim):
+        from consul_tpu.agent.agent import Agent
+        from consul_tpu.agent.http import HTTPApi
+
+        _, plane = served_sim
+        agent = Agent("n0", "10.0.0.1", rpc=lambda *a, **k: None)
+        agent.attach_serving(plane)
+        api = HTTPApi(agent)
+        status, snap, _ = api.handle("GET", "/v1/agent/metrics", {}, b"")
+        assert status == 200
+        gauges = {g["Name"] for g in snap["Gauges"]}
+        for name in ("consul.serving.queries", "consul.serving.batches",
+                     "consul.serving.padded_slots",
+                     "consul.serving.cache_hits",
+                     "consul.serving.p50_batch_ms"):
+            assert name in gauges
+        agent.close()
+
+
+class TestPlaneGuards:
+    def test_one_plane_one_source(self, served_sim):
+        _, plane = served_sim
+        with pytest.raises(RuntimeError, match="simulation"):
+            plane.publish_coords(make_coord_sets())
+        host_plane = ServingPlane(k=2, buckets=(1, 4))
+        assert host_plane.publish_coords(make_coord_sets())
+        with pytest.raises(RuntimeError, match="host"):
+            host_plane.attach(object())
+
+    def test_unpublished_plane_refuses_reads(self):
+        plane = ServingPlane(k=2, buckets=(1,))
+        with pytest.raises(RuntimeError, match="snapshot"):
+            plane.nearest(0)
