@@ -76,6 +76,17 @@ def child(platform: str, deadline: float):
         return deadline - time.monotonic()
 
     t0 = time.monotonic()
+    # BENCH_DEVICES: force a host (CPU) device count for the multi-chip
+    # path without real chips. Must land in XLA_FLAGS before the first
+    # jax import in this process — the flag only affects the CPU
+    # backend, so it is harmless on a real TPU child. The same value
+    # also caps default_mesh() below, so BENCH_DEVICES=4 on an 8-chip
+    # host means "run the 4-device mesh".
+    bench_devices = int(os.environ.get("BENCH_DEVICES", "0") or 0)
+    if bench_devices > 1:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={bench_devices}")
     try:
         import jax
 
@@ -85,12 +96,28 @@ def child(platform: str, deadline: float):
             # is not enough.
             jax.config.update("jax_platforms", platform)
         devs = jax.devices()
+        # Per-device memory provenance: on TPU, memory_stats() reports
+        # HBM in use / limit; the CPU backend may return None or raise,
+        # so every read is guarded — this phase must never kill a child.
+        mem = []
+        for d in jax.local_devices():
+            try:
+                ms = d.memory_stats() or {}
+                mem.append({
+                    "device": str(d),
+                    "bytes_in_use": int(ms.get("bytes_in_use", 0)),
+                    "bytes_limit": int(ms.get("bytes_limit", 0)),
+                })
+            except Exception:
+                mem.append({"device": str(d), "memory_stats": None})
         _emit({
             "phase": "setup",
             "platform": devs[0].platform,
             "device": str(devs[0]),
+            "devices": len(devs),
             "jax": jax.__version__,
             "init_s": round(time.monotonic() - t0, 1),
+            "memory": mem,
         })
     except Exception as e:  # backend init failed: nothing else can run
         _emit({"phase": "error", "where": "setup", "error": repr(e)[:500]})
@@ -100,6 +127,7 @@ def child(platform: str, deadline: float):
 
     from consul_tpu.config import SimConfig
     from consul_tpu.models.cluster import Simulation
+    from consul_tpu.parallel import mesh as pmesh
     from consul_tpu.utils import compile_cache
     from consul_tpu.utils import metrics as obs
 
@@ -116,10 +144,53 @@ def child(platform: str, deadline: float):
     kill_frac = float(os.environ.get("BENCH_KILL_FRAC", "0.05"))
     chunk = int(os.environ.get("BENCH_CHUNK", "128"))
     profile = os.environ.get("BENCH_PROFILE", "")
+    n_dc = int(os.environ.get("BENCH_N_DC", "1"))
 
-    def build(n_nodes, cls=Simulation):
+    def build(n_nodes, cls=Simulation, device_count=None):
+        # Multi-chip is the default headline path: whenever more than
+        # one device is visible, every phase sim runs its fused core
+        # under shard_map over the full elastic mesh (parallel/mesh:
+        # default_mesh trims the device count to a divisor of n).
+        # BENCH_DEVICES caps the mesh, BENCH_N_DC folds in a dc axis;
+        # a single visible device keeps the exact single-device path.
         cfg = SimConfig(n=n_nodes, view_degree=min(view_degree, n_nodes - 2))
-        return cls(cfg, seed=0)
+        dc = device_count if device_count is not None else \
+            (bench_devices or None)
+        return cls(cfg, seed=0,
+                   mesh=pmesh.default_mesh(n_nodes, device_count=dc,
+                                           n_dc=n_dc))
+
+    # AOT prewarm (utils/prewarm.py): compile every (n, kind, chunk,
+    # mesh-shape) signature this child is about to run into the
+    # persistent compile cache BEFORE any timed region, so the
+    # compile_s fields below record trace + cache-read, not XLA builds.
+    # Most useful with the cache enabled (a later cold process warm-
+    # starts from disk); gated behind --prewarm / BENCH_PREWARM because
+    # the AOT compiles themselves cost the same wall as the first run.
+    if os.environ.get("BENCH_PREWARM", ""):
+        from consul_tpu.utils import prewarm as prewarm_mod
+
+        sweep_ns = [int(x) for x in
+                    os.environ.get("BENCH_SWEEP", "").split(",") if x.strip()]
+        for pn in [n] + [x for x in sweep_ns if x != n]:
+            if left() < 180:
+                _emit({"phase": "prewarm_skipped", "n": pn,
+                       "reason": "deadline"})
+                continue
+            try:
+                summary = prewarm_mod.prewarm(
+                    ns=[pn], kinds=("swim", "serf"), chunks=(chunk,),
+                    metrics_modes=(False, True),
+                    device_count=bench_devices or None, n_dc=n_dc,
+                    view_degree=view_degree)
+                _emit({"phase": "prewarm", "n": pn,
+                       "cache_enabled": bool(cc_dir),
+                       "compiled": summary["compiled"],
+                       "cache": summary["cache"],
+                       "wall_s": summary["wall_s"]})
+            except Exception as e:
+                _emit({"phase": "error", "where": f"prewarm:{pn}",
+                       "error": repr(e)[:500]})
 
     sim = None
     try:
@@ -141,6 +212,8 @@ def child(platform: str, deadline: float):
             "phase": "throughput",
             "n": n,
             "view_degree": view_degree,
+            "mesh": (None if sim.mesh is None else
+                     [int(sim.mesh.shape[a]) for a in sim.mesh.axis_names]),
             "rounds_per_s": round(rounds_per_s, 2),
             "compile_s": round(t1 - t, 1),
             "compile_cache": compile_cache.stats_delta(cc0),
@@ -403,6 +476,72 @@ def child(platform: str, deadline: float):
             del plane, qsim
     except Exception as e:
         _emit({"phase": "error", "where": "serving", "error": repr(e)[:500]})
+
+    # Weak/strong scaling over the device ladder (1, 2, 4, ... up to
+    # the visible count): strong holds n fixed (BENCH_SCALING_N) while
+    # devices grow, weak grows n with the devices
+    # (BENCH_SCALING_PER_CHIP per device). Each rung rebuilds the sim
+    # on a mesh truncated to that device count, so the measured
+    # rounds/s is the shard_map program at that grid — the d=1 rung is
+    # the true single-device program (no shard_map), the efficiency
+    # denominator. parallel_efficiency: strong = rps(d) / (d * rps(1))
+    # (ideal speed-up is linear), weak = rps(d) / rps(1) (ideal rate is
+    # flat as work grows with the chips). Entries emit incrementally —
+    # a deadline mid-ladder keeps the rungs already measured.
+    try:
+        scaling_chunk = int(os.environ.get("BENCH_SCALING_CHUNK", "32"))
+        strong_n = int(os.environ.get("BENCH_SCALING_N", "16384"))
+        per_chip = int(os.environ.get("BENCH_SCALING_PER_CHIP", "2048"))
+        visible = bench_devices or len(jax.devices())
+
+        def scaling_rung(n_s, d):
+            zsim = build(n_s, device_count=d)
+            zsim.run(scaling_chunk, chunk=scaling_chunk,
+                     with_metrics=False)  # warm + compile
+            jax.block_until_ready(zsim.state.view_key)
+            reps = 2
+            t1 = time.monotonic()
+            zsim.run(scaling_chunk * reps, chunk=scaling_chunk,
+                     with_metrics=False)
+            jax.block_until_ready(zsim.state.view_key)
+            del zsim
+            return scaling_chunk * reps / (time.monotonic() - t1)
+
+        for kind, fixed in (("scaling_strong", True), ("scaling_weak", False)):
+            try:
+                if left() < 120:
+                    _emit({"phase": kind, "entries": [],
+                           "skipped": "deadline"})
+                    continue
+                entries, base_rps = [], None
+                d = 1
+                while d <= visible:
+                    n_s = strong_n if fixed else per_chip * d
+                    if n_s % d == 0 and left() > 90:
+                        rps = scaling_rung(n_s, d)
+                        if d == 1:
+                            base_rps = rps
+                        denom = (d * base_rps if fixed else base_rps) \
+                            if base_rps else None
+                        entries.append({
+                            "devices": d,
+                            "n": n_s,
+                            "rounds_per_s": round(rps, 2),
+                            "rounds_per_s_per_chip": round(rps / d, 2),
+                            "parallel_efficiency":
+                                round(rps / denom, 3) if denom else None,
+                        })
+                    d *= 2
+                _emit({"phase": kind, "chunk": scaling_chunk,
+                       "devices_visible": visible,
+                       **({"n": strong_n} if fixed
+                          else {"per_chip": per_chip}),
+                       "entries": entries})
+            except Exception as e:
+                _emit({"phase": "error", "where": kind,
+                       "error": repr(e)[:500]})
+    except Exception as e:
+        _emit({"phase": "error", "where": "scaling", "error": repr(e)[:500]})
 
     # Scaling sweep: throughput at each shape, each its own try/except,
     # each gated on remaining deadline (SURVEY §7 phases 4-5 shapes).
@@ -791,6 +930,11 @@ def main():
         i = argv.index("--compile-cache")
         if i + 1 < len(argv):
             os.environ["CONSUL_TPU_COMPILE_CACHE"] = argv[i + 1]
+    # --prewarm: each child AOT-compiles its program signatures into
+    # the persistent cache before any timed phase (BENCH_PREWARM is
+    # inherited through _run_child's env copy).
+    if "--prewarm" in argv:
+        os.environ["BENCH_PREWARM"] = "1"
     platform_child = os.environ.get("BENCH_CHILD")
     if platform_child:
         deadline = time.monotonic() + float(
@@ -959,6 +1103,23 @@ def main():
         "serving": next(
             (p for p in primary["phases"]
              if p.get("phase") == "serving"), None),
+        # Device-ladder scaling phases: entries of {devices, n,
+        # rounds_per_s, rounds_per_s_per_chip, parallel_efficiency}
+        # (strong: fixed n; weak: n grows per-chip). Stable keys for
+        # the MULTICHIP trajectory artifacts.
+        "scaling_strong": next(
+            (p for p in primary["phases"]
+             if p.get("phase") == "scaling_strong"), None),
+        "scaling_weak": next(
+            (p for p in primary["phases"]
+             if p.get("phase") == "scaling_weak"), None),
+        # Mesh + prewarm provenance for the headline number: how many
+        # devices the child saw, and what the AOT prewarm pass
+        # compiled/deserialized before the timed phases.
+        "devices": _get(primary["phases"], "setup", "devices"),
+        "mesh": _get(primary["phases"], "throughput", "mesh"),
+        "prewarm": [p for p in primary["phases"]
+                    if p.get("phase") == "prewarm"] or None,
         "cpu_fallback": {
             "rounds_per_s": cpu_ok,
             "n_nodes": _get(cpu["phases"], "throughput", "n"),

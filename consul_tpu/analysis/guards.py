@@ -5,9 +5,11 @@ Two teeth, both monkeypatch-free:
 - :class:`CompileLedger`: a process-wide compile counter built on
   ``jax.monitoring``. XLA emits one
   ``/jax/core/compile/backend_compile_duration`` event per executable
-  it actually builds (cache hits are silent), so the ledger sees every
-  compile in the process — jit, scan bodies, eager dispatch fallbacks
-  — without wrapping or patching anything. Tests pin steady-state
+  it builds (in-process jit-cache hits are silent; persistent-cache
+  hits fire the event too but are netted out via the paired
+  ``cache_hits`` counter), so the ledger sees every real compile in
+  the process — jit, scan bodies, eager dispatch fallbacks — without
+  wrapping or patching anything. Tests pin steady-state
   behaviour with ``ledger.expect(0)`` around a repeated call pattern;
   a silent recompile (weak-type drift, shape leak, new donation
   signature) fails loudly with the observed delta.
@@ -35,6 +37,14 @@ import jax
 # actually compiled (jax 0.4.x: pxla/dispatch both route through it).
 COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
+# Recorded by compiler.compile_or_get_cached on a persistent-cache
+# deserialization. jax wraps that whole call in the COMPILE_EVENT
+# timer, so a cache hit fires BOTH events even though XLA built
+# nothing — the ledger nets hits out so it counts real builds. With
+# the persistent cache disabled (the tier-1 default) no hit events
+# fire and the arithmetic is a no-op.
+CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+
 
 class CompileLedgerError(AssertionError):
     """An ``expect()`` window saw a different number of compiles."""
@@ -56,6 +66,7 @@ class CompileLedger:
 
     _lock = threading.Lock()
     _count = 0
+    _hits = 0
     _registered = False
 
     def __init__(self):
@@ -64,6 +75,7 @@ class CompileLedger:
             if not cls._registered:
                 jax.monitoring.register_event_duration_secs_listener(
                     cls._on_event)
+                jax.monitoring.register_event_listener(cls._on_plain_event)
                 cls._registered = True
 
     @classmethod
@@ -72,12 +84,24 @@ class CompileLedger:
             with cls._lock:
                 cls._count += 1
 
+    @classmethod
+    def _on_plain_event(cls, event: str, **kwargs):
+        if event == CACHE_HIT_EVENT:
+            with cls._lock:
+                cls._hits += 1
+
     # -- reads ----------------------------------------------------------
     @property
     def total(self) -> int:
-        """Compiles observed process-wide since first registration."""
+        """Executables actually BUILT process-wide since first
+        registration: backend-compile events net of persistent-cache
+        hits (a hit deserializes — jax still fires the compile timer
+        around it, but no compilation happened). This is what makes
+        ``prewarm → run`` pinnable at ``expect(0)``: the run's dispatch
+        loads the prewarmed executable from the cache instead of
+        building it."""
         with type(self)._lock:
-            return type(self)._count
+            return type(self)._count - type(self)._hits
 
     def snapshot(self) -> int:
         return self.total

@@ -42,6 +42,13 @@ RULES = {
              "(the dense [N, E] update the fused serf core exists to "
              "avoid); use one-hot matmul/gather shapes or the "
              "collective reduce-scatter helper",
+    "TH110": "sharding-less device placement (jax.device_put without a "
+             "sharding / jnp.asarray) in a mesh-handling host path — "
+             "the array lands committed to a single device (or "
+             "replicated), and every sharded program that consumes it "
+             "pays a reshard or fails the multi-chip parity contract; "
+             "place node-axis data with NamedSharding(mesh, "
+             "node_spec(...)) (parallel/shard_step.place)",
 }
 
 # TH101: int()/float()/bool() arguments considered static (config
@@ -77,6 +84,16 @@ _SCALAR_CASTS = frozenset({"int", "float", "bool"})
 # index is a traced array.
 _SCATTER_OPS = frozenset({"add", "set", "max", "min", "mul", "multiply"})
 
+# TH110: a host function is "mesh-handling" when it takes a mesh
+# parameter, reads a .mesh attribute, or builds one via these
+# constructors — the scope where a sharding-less placement silently
+# breaks the multi-chip layout.
+_MESH_CTORS = frozenset({"elastic_mesh", "make_mesh", "default_mesh"})
+
+# TH110: the jnp constructors that materialize host data on a device
+# with no way to say where (asarray/array take no sharding argument).
+_UNSHARDED_CTORS = frozenset({"jax.numpy.asarray", "jax.numpy.array"})
+
 
 def run_rules(mod, traced_ids) -> list:
     v = _RuleVisitor(mod, traced_ids)
@@ -92,6 +109,10 @@ class _RuleVisitor(ast.NodeVisitor):
         self.traced_ids = traced_ids
         self.findings: list = []
         self._scope: list = []  # (qualname segment, is_traced)
+        # Parallel stack of "this function handles a mesh" flags
+        # (TH110). Kept separate from _scope: its 2-tuples are
+        # unpacked at every _symbol()/_in_trace() call site.
+        self._mesh_scope: list = []
         # Depth of enclosing `with jax.ensure_compile_time_eval():`
         # blocks — the canonical static-at-trace idiom. Host syncs in
         # them run once at trace time, so TH101/TH102 stay quiet.
@@ -114,27 +135,36 @@ class _RuleVisitor(ast.NodeVisitor):
     def _in_trace(self) -> bool:
         return any(t for _, t in self._scope)
 
+    def _in_mesh_scope(self) -> bool:
+        return any(self._mesh_scope)
+
     # -- scope tracking -------------------------------------------------
     def visit_FunctionDef(self, node):
         self._check_defaults(node)
         self._scope.append((node.name, id(node) in self.traced_ids))
+        self._mesh_scope.append(_touches_mesh(node, self.mod))
         for dec in node.decorator_list:
             self.visit(dec)
         self.visit(node.args)
         self._visit_body(node.body)
         self._scope.pop()
+        self._mesh_scope.pop()
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
     def visit_Lambda(self, node):
         self._scope.append(("<lambda>", id(node) in self.traced_ids))
+        self._mesh_scope.append(False)  # inherits via any()
         self.generic_visit(node)
         self._scope.pop()
+        self._mesh_scope.pop()
 
     def visit_ClassDef(self, node):
         self._scope.append((node.name, False))
+        self._mesh_scope.append(False)
         self.generic_visit(node)
         self._scope.pop()
+        self._mesh_scope.pop()
 
     # -- static-at-trace idioms the trace rules must respect ------------
     def visit_With(self, node):
@@ -235,6 +265,8 @@ class _RuleVisitor(ast.NodeVisitor):
             self._rule_th101(node, fq)
             self._rule_th102(node, fq)
             self._rule_th109(node)
+        elif self._in_mesh_scope():
+            self._rule_th110(node, fq)
         if self.mod.device_tier:
             self._rule_th104(node, fq)
         self.generic_visit(node)
@@ -318,6 +350,39 @@ class _RuleVisitor(ast.NodeVisitor):
             "reformulate as a one-hot matmul / gather, or route "
             "through the collective reduce-scatter helper")
 
+    def _rule_th110(self, node, fq):
+        """Sharding-less device placement in a mesh-handling host
+        function. ``jax.device_put(x)`` with no sharding/device
+        argument commits to device 0; ``jnp.asarray``/``jnp.array``
+        materialize wherever the default device is (and cannot say
+        otherwise — they take no sharding). Either way a node-axis
+        array built next to a mesh lands mis-placed, and the first
+        sharded program that consumes it pays a full reshard (or, for
+        a committed input, fails with an incompatible-devices error).
+        The fix is the one placement rule every sharded path shares:
+        ``NamedSharding(mesh, node_spec(leaf, n))`` via
+        ``parallel/shard_step.place``. Deliberate scalar/replicated
+        conversions are allowlisted by symbol with their reason."""
+        if fq == "jax.device_put":
+            if len(node.args) >= 2 or any(
+                    k.arg in ("device", "sharding") for k in node.keywords):
+                return  # placement is spelled out
+            self._emit(
+                "TH110", node,
+                "jax.device_put without an explicit sharding in a "
+                "mesh-handling host path commits the array to a single "
+                "device — place it with NamedSharding(mesh, "
+                "node_spec(...)) (parallel/shard_step.place)")
+        elif fq in _UNSHARDED_CTORS:
+            name = fq.rsplit(".", 1)[-1]
+            self._emit(
+                "TH110", node,
+                f"jnp.{name}(...) in a mesh-handling host path cannot "
+                "express a sharding — a node-axis array lands on the "
+                "default device and every sharded consumer pays a "
+                "reshard; build host-side (numpy) and place via "
+                "parallel/shard_step.place")
+
     # -- TH108: unbounded host retry loops ------------------------------
     def visit_While(self, node):
         self._rule_th108(node)
@@ -390,6 +455,33 @@ class _RuleVisitor(ast.NodeVisitor):
                     "traced code — its contents bake into the "
                     "executable at trace time")
         self.generic_visit(node)
+
+
+def _touches_mesh(node, mod) -> bool:
+    """Is this function a mesh-handling host path (TH110 scope)? True
+    when it takes a parameter named ``mesh``, reads any ``.mesh``
+    attribute, or calls a mesh constructor (elastic_mesh / make_mesh /
+    default_mesh). Nested defs are scanned too — a helper closure
+    inside a mesh function inherits the scope via the visitor stack
+    anyway, so the over-approximation only widens the same net."""
+    args = node.args
+    names = [a.arg for a in args.args + args.posonlyargs
+             + args.kwonlyargs]
+    if args.vararg is not None:
+        names.append(args.vararg.arg)
+    if args.kwarg is not None:
+        names.append(args.kwarg.arg)
+    if "mesh" in names:
+        return True
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr == "mesh" \
+                and isinstance(sub.ctx, ast.Load):
+            return True
+        if isinstance(sub, ast.Call):
+            fq = mod.resolve(sub.func, None)
+            if fq is not None and fq.rsplit(".", 1)[-1] in _MESH_CTORS:
+                return True
+    return False
 
 
 def _sub_blocks(stmt):

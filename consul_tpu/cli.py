@@ -705,6 +705,19 @@ def cmd_debug(client: Client, args) -> int:
     return 0
 
 
+def _mesh_from_args(args, n: int):
+    """Default-mesh selection for the local-run subcommands: the
+    largest elastic mesh over the visible devices whenever more than
+    one is visible — multi-chip is the DEFAULT headline path — with
+    ``--devices``/``--n-dc`` as explicit overrides (``--devices 1``
+    pins single-device execution)."""
+    from consul_tpu.parallel import mesh as pmesh
+
+    return pmesh.default_mesh(
+        n, device_count=getattr(args, "devices", None),
+        n_dc=getattr(args, "n_dc", 1) or 1)
+
+
 def _build_sim(args):
     from consul_tpu.config import SimConfig
     from consul_tpu.models.cluster import SerfSimulation, Simulation
@@ -716,7 +729,14 @@ def _build_sim(args):
         compile_cache.maybe_enable_from_env()
     cfg = SimConfig(n=args.n, view_degree=min(args.view_degree, args.n - 2))
     cls = SerfSimulation if args.serf else Simulation
-    return cls(cfg, seed=args.seed)
+    sim = cls(cfg, seed=args.seed, mesh=_mesh_from_args(args, args.n))
+    if getattr(args, "prewarm", False):
+        from consul_tpu.utils import prewarm as prewarm_mod
+
+        chunk = getattr(args, "chunk", 32)
+        for with_metrics in (False, True):
+            prewarm_mod.prewarm_simulation(sim, chunk, with_metrics)
+    return sim
 
 
 def _ckpt_policy(args, sim, default_tag: str):
@@ -848,6 +868,43 @@ def cmd_run(args) -> int:
     return _run_resilient_cmd(args, sim, None, args.ticks, {"n": args.n})
 
 
+def cmd_prewarm(args) -> int:
+    """AOT-compile every requested (n, kind, chunk, mesh-shape,
+    chaos-shape) chunk-program signature into the persistent compile
+    cache (utils/prewarm.py) and print a JSON summary — signatures
+    compiled, cache hit/miss movement, wall_s. Run it off the critical
+    path so a later ``consul-tpu run``/bench at the same signature
+    starts with compile_s ~ 0."""
+    from consul_tpu.utils import prewarm as prewarm_mod
+
+    mesh = None
+    if args.mesh:
+        import jax
+
+        from consul_tpu.parallel import mesh as pmesh
+
+        dims = [int(x) for x in args.mesh.lower().split("x")]
+        if len(dims) == 1:
+            n_dc, per_dc = 1, dims[0]
+        elif len(dims) == 2:
+            n_dc, per_dc = dims
+        else:
+            print(f"--mesh {args.mesh!r}: want NODES or DCxNODES",
+                  file=sys.stderr)
+            return 2
+        mesh = pmesh.make_mesh(jax.devices()[:n_dc * per_dc], n_dc=n_dc)
+    summary = prewarm_mod.prewarm(
+        ns=[int(x) for x in args.n.split(",") if x],
+        kinds=tuple(x.strip() for x in args.kinds.split(",") if x.strip()),
+        chunks=[int(x) for x in args.chunks.split(",") if x],
+        mesh=mesh, device_count=args.devices, n_dc=args.n_dc,
+        chaos=args.chaos, seed=args.seed, view_degree=args.view_degree,
+        sentinel=args.sentinel, cache_dir=args.compile_cache,
+    )
+    print(json.dumps(summary))
+    return 0
+
+
 def cmd_serve_bench(args) -> int:
     """Benchmark the device serving plane against a local simulation:
     form a cluster, attach a ServingPlane, and drive batched NearestN
@@ -958,6 +1015,21 @@ def build_parser() -> argparse.ArgumentParser:
                              " a second cold process deserializes "
                              "executables instead of recompiling")
 
+    def add_mesh_flags(sp):
+        # Multi-chip placement knobs: by default the local-run
+        # subcommands run over the largest elastic mesh the visible
+        # devices support (parallel/mesh.default_mesh); these override.
+        sp.add_argument("--devices", type=int, default=None,
+                        help="number of devices to mesh over (default: "
+                             "all visible; 1 pins single-device)")
+        sp.add_argument("--n-dc", type=int, default=1,
+                        help="fold a dc axis into the mesh: devices "
+                             "arrange as a (dc, nodes) grid")
+        sp.add_argument("--prewarm", action="store_true",
+                        help="AOT-compile this run's chunk programs "
+                             "into the persistent compile cache before "
+                             "t0 (see the prewarm subcommand)")
+
     rn = sub.add_parser(
         "run",
         help="advance a local simulation under the resilient harness")
@@ -969,6 +1041,7 @@ def build_parser() -> argparse.ArgumentParser:
     rn.add_argument("--serf", action="store_true",
                     help="run the full serf step (event/query plane)")
     add_resilience_flags(rn)
+    add_mesh_flags(rn)
 
     sv = sub.add_parser(
         "serve-bench",
@@ -990,6 +1063,7 @@ def build_parser() -> argparse.ArgumentParser:
                     help="serve over the full serf simulation")
     sv.add_argument("--compile-cache", default=None, metavar="DIR",
                     help="persistent XLA compilation cache directory")
+    add_mesh_flags(sv)
 
     ch = sub.add_parser(
         "chaos",
@@ -1012,6 +1086,38 @@ def build_parser() -> argparse.ArgumentParser:
     ch.add_argument("--degrade", action="append",
                     metavar="START,STOP,FRAC,TX[,RX]")
     add_resilience_flags(ch)
+    add_mesh_flags(ch)
+
+    pw = sub.add_parser(
+        "prewarm",
+        help="AOT-compile chunk programs into the persistent compile "
+             "cache so a later run/bench starts with compile_s ~ 0")
+    pw.add_argument("--n", default="4096",
+                    help="comma-separated node counts")
+    pw.add_argument("--kinds", default="swim",
+                    help="comma list of step kinds: swim,serf")
+    pw.add_argument("--chunks", default="32",
+                    help="comma-separated scan chunk sizes")
+    pw.add_argument("--mesh", default=None, metavar="[DCx]NODES",
+                    help="device grid to compile for, e.g. 8 or 2x4 "
+                         "(default: largest elastic mesh over the "
+                         "visible devices)")
+    pw.add_argument("--devices", type=int, default=None,
+                    help="devices for the default mesh (1 = "
+                         "single-device programs)")
+    pw.add_argument("--n-dc", type=int, default=1)
+    pw.add_argument("--chaos", action="store_true",
+                    help="also compile the chaos-enabled program for "
+                         "the default one-partition schedule shape")
+    pw.add_argument("--sentinel", action="store_true",
+                    help="compile the sentinel-armed programs")
+    pw.add_argument("--seed", type=int, default=0,
+                    help="must match the run being warmed (topology "
+                         "constants are part of the program identity)")
+    pw.add_argument("--view-degree", type=int, default=16)
+    pw.add_argument("--compile-cache", default=None, metavar="DIR",
+                    help="persistent cache directory (or "
+                         "CONSUL_TPU_COMPILE_CACHE)")
 
     mem_p = sub.add_parser("members", help="cluster members + health")
     mem_p.add_argument("-wan", action="store_true",
@@ -1315,6 +1421,8 @@ def main(argv=None) -> int:
         return cmd_chaos(args)
     if args.cmd == "run":
         return cmd_run(args)
+    if args.cmd == "prewarm":
+        return cmd_prewarm(args)
     if args.cmd == "serve-bench":
         return cmd_serve_bench(args)
     client = make_client(args)

@@ -26,6 +26,7 @@ from consul_tpu.models import serf as serf_mod
 from consul_tpu.models import state as sim_state
 from consul_tpu.models import swim
 from consul_tpu.ops import topology
+from consul_tpu.parallel import mesh as pmesh
 from consul_tpu.utils import checkpoint as ckpt_mod
 from consul_tpu.utils import metrics, telemetry
 
@@ -107,7 +108,7 @@ class SentinelViolation(RuntimeError):
 
 def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
                   step_fn=swim.step_counted, swim_of=lambda st: st,
-                  chaos_key=None, sentinel: bool = False):
+                  chaos_key=None, sentinel: bool = False, mesh=None):
     """One compiled chunk program. ``step_fn`` is the per-tick counted
     step (bare SWIM or the full serf stack) returning
     (state, GossipCounters); ``swim_of`` projects the SWIM plane out of
@@ -131,12 +132,31 @@ def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
     ``sentinel`` joins the memo key exactly like ``chaos_key``: off is
     the pre-sentinel program byte-for-byte (zero extra executables —
     the compile-count pin), on folds the invariant validator in and
-    compiles exactly one more program per shape."""
+    compiles exactly one more program per shape.
+
+    ``mesh`` selects the multi-chip program: a shard_map runner over
+    the device grid (parallel/shard_step.make_sharded_chunk_runner)
+    with the SAME call convention. The mesh fingerprint — axis names,
+    shape AND device ids (parallel/mesh.mesh_key) — joins the memo key,
+    so an elastic 8->4 reshard can never reuse the stale 8-device
+    executable; each surviving-mesh shape compiles (or persistent-cache
+    loads) exactly one program."""
     memo = (cfg, _topo_key(topo), chunk, with_metrics, step_fn, swim_of,
-            chaos_key, sentinel)
+            chaos_key, sentinel, pmesh.mesh_key(mesh))
     hit = _RUNNER_CACHE.get(memo)
     if hit is not None:
         return hit
+
+    if mesh is not None:
+        from consul_tpu.parallel import shard_step
+
+        jitted = shard_step.make_sharded_chunk_runner(
+            cfg, topo, mesh, chunk, with_metrics,
+            step_fn=step_fn, swim_of=swim_of,
+            chaos=chaos_key is not None, sentinel=sentinel,
+        )
+        _RUNNER_CACHE[memo] = jitted
+        return jitted
 
     def body(world, sched, carry, tick_key):
         state, cnt = carry
@@ -178,6 +198,12 @@ class Simulation:
     # diagnostic checkpoint into ``sentinel_dump_dir`` first when set.
     sentinel: bool = False
     sentinel_dump_dir: Optional[str] = None
+    # Device mesh (jax.sharding.Mesh or None). When set, chunk runners
+    # execute under shard_map over the grid with explicit ppermute
+    # collectives (parallel/shard_step.py) and the world/state/schedule
+    # live sharded over the node axis. None is the single-device
+    # program today's compile-ledger pins count.
+    mesh: Optional[object] = None
 
     # Driver hooks (SerfSimulation overrides these two).
     _step_fn = staticmethod(swim.step_counted)
@@ -216,6 +242,40 @@ class Simulation:
         # of the last completed tick — never torn mid-scan, and never
         # blocking the scan loop.
         self.serving = None
+        if self.mesh is not None:
+            self.set_mesh(self.mesh)
+
+    # -- multi-chip placement -------------------------------------------
+    def set_mesh(self, mesh):
+        """Install (or clear, with None) a device mesh for subsequent
+        runs: places the world, state and any installed fault schedule
+        sharded over the node axis and rebinds the runners. The
+        process-wide _RUNNER_CACHE keys on the mesh fingerprint
+        (parallel/mesh.mesh_key), so revisiting a mesh shape — elastic
+        4->8 recovery — never recompiles, while a NEW shape can never
+        hit the old shape's executable."""
+        self.mesh = mesh
+        self._runners = {}
+        if mesh is None:
+            return
+        from consul_tpu.parallel import shard_step
+
+        self.world = shard_step.place(mesh, self.world, self.cfg.n)
+        self.state = shard_step.place(mesh, self.state, self.cfg.n)
+        if self.chaos is not None:
+            self.chaos = shard_step.place(mesh, self.chaos, self.cfg.n)
+
+    def _place_node(self, value):
+        """Host-built per-node array -> device, sharded over the node
+        axis when a mesh is installed. The single funnel for fault/verb
+        masks: an implicit ``jnp.asarray`` would replicate [N] rows on
+        every chip (the TH110 hazard — silent HBM blowup at 1M+)."""
+        arr = jnp.asarray(value)
+        if self.mesh is None:
+            return arr
+        from consul_tpu.parallel import shard_step
+
+        return shard_step.place(self.mesh, arr, self.cfg.n)
 
     # -- serving plane ---------------------------------------------------
     def attach_serving(self, plane):
@@ -233,11 +293,12 @@ class Simulation:
 
     # -- fault injection ------------------------------------------------
     def kill(self, mask):
-        self.state = sim_state.kill(self.state, jnp.asarray(mask))
+        self.state = sim_state.kill(self.state, self._place_node(mask))
         self.publish_serving()
 
     def revive(self, mask):
-        self.state = sim_state.revive(self.cfg, self.state, jnp.asarray(mask))
+        self.state = sim_state.revive(
+            self.cfg, self.state, self._place_node(mask))
         self.publish_serving()
 
     def set_chaos(self, sched):
@@ -250,6 +311,10 @@ class Simulation:
             sched = chaos_mod.compile_schedule(self.cfg.n, sched)
         if sched is not None and chaos_mod.is_empty(sched):
             sched = None
+        if sched is not None and self.mesh is not None:
+            from consul_tpu.parallel import shard_step
+
+            sched = shard_step.place(self.mesh, sched, self.cfg.n)
         self.chaos = sched
         # Bound runners close over the schedule; rebind lazily. The
         # process-wide _RUNNER_CACHE still memoizes the underlying
@@ -340,7 +405,7 @@ class Simulation:
                 self.cfg, self.topo, chunk, with_metrics,
                 step_fn=type(self)._step_fn, swim_of=type(self)._swim_of,
                 chaos_key=chaos_mod.static_key_of(self.chaos),
-                sentinel=self.sentinel,
+                sentinel=self.sentinel, mesh=self.mesh,
             )
 
             def bound(state, base_key, _j=jitted, _w=self.world,
@@ -554,23 +619,24 @@ class SerfSimulation(Simulation):
     # -- serf verbs -----------------------------------------------------
     def user_event(self, mask, name: int):
         self.state = serf_mod.user_event(self.cfg, self.state,
-                                         jnp.asarray(mask), name)
+                                         self._place_node(mask), name)
 
     def query(self, mask, name: int):
         self.state = serf_mod.query(self.cfg, self.state,
-                                    jnp.asarray(mask), name)
+                                    self._place_node(mask), name)
 
     def leave(self, mask):
-        self.state = serf_mod.leave(self.cfg, self.state, jnp.asarray(mask))
+        self.state = serf_mod.leave(
+            self.cfg, self.state, self._place_node(mask))
 
     def kill(self, mask):
         self.state = self.state._replace(
-            swim=sim_state.kill(self.state.swim, jnp.asarray(mask)))
+            swim=sim_state.kill(self.state.swim, self._place_node(mask)))
 
     def revive(self, mask):
         self.state = self.state._replace(
             swim=sim_state.revive(self.cfg, self.state.swim,
-                                  jnp.asarray(mask)))
+                                  self._place_node(mask)))
 
     @property
     def swim_state(self) -> sim_state.SimState:

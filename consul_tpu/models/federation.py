@@ -144,15 +144,24 @@ _FED_RUNNER_CACHE: dict = {}
 
 
 def _fed_chunk_runner(cfg: FederationConfig, lan_topo, wan_topo,
-                      chunk: int):
+                      chunk: int, mesh=None):
     """Scan-compiled multi-tick federation runner, memoized
     process-wide like cluster.py's _chunk_runner. ``dc_offset`` is
     normalized out of the memo key and enters the program as a scalar
     argument, so every same-shape island of a DCN federation — and
     every later Federation built over the same configs/topologies —
-    reuses one executable instead of paying XLA per instance."""
+    reuses one executable instead of paying XLA per instance.
+
+    The mesh fingerprint (parallel/mesh.mesh_key — axis names, shape,
+    device ids) joins the memo key like cluster.py's: a Federation
+    placed over a new surviving-device grid after an elastic reshard
+    binds a fresh runner rather than one whose sharding assumptions
+    were baked for the old mesh."""
+    from consul_tpu.parallel.mesh import mesh_key
+
     cfg = dataclasses.replace(cfg, dc_offset=0)
-    memo = (cfg, _topo_key(lan_topo), _topo_key(wan_topo), chunk)
+    memo = (cfg, _topo_key(lan_topo), _topo_key(wan_topo), chunk,
+            mesh_key(mesh))
     hit = _FED_RUNNER_CACHE.get(memo)
     if hit is not None:
         return hit
@@ -174,8 +183,13 @@ def _fed_chunk_runner(cfg: FederationConfig, lan_topo, wan_topo,
 class Federation:
     """Driver for one federated simulation (LAN pools + WAN pool)."""
 
-    def __init__(self, cfg: FederationConfig, seed: int = 0):
+    def __init__(self, cfg: FederationConfig, seed: int = 0, mesh=None):
         self.cfg = cfg
+        # Device mesh the state is placed over (parallel/mesh.py
+        # federation_sharding); joins the runner memo key so reshards
+        # rebind executables. Placement itself stays the caller's job
+        # (runtime/dcn.py / the dryrun own the device_put).
+        self.mesh = mesh
         lan, wan = cfg.lan, cfg.wan
         key = jax.random.PRNGKey(seed)
         k_lan_w, k_lan_s, k_wan_w, k_wan_s, k_centers, self.base_key = \
@@ -229,7 +243,7 @@ class Federation:
         while remaining > 0:
             c = min(chunk, remaining)
             runner = _fed_chunk_runner(
-                self.cfg, self.lan_topo, self.wan_topo, c
+                self.cfg, self.lan_topo, self.wan_topo, c, mesh=self.mesh
             )
             self.state = runner(
                 self.lan_world, self.wan_world, self._wan_off,

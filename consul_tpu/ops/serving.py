@@ -144,3 +144,122 @@ def kernel_for(k: int):
     if fn is None:
         fn = _KERNEL_CACHE[k] = jax.jit(functools.partial(_execute, k))
     return fn
+
+
+def _execute_sharded(k: int, mesh, snap: Snapshot, mode: jax.Array,
+                     src: jax.Array, arg: jax.Array):
+    """Two-stage shard_map top-k over a mesh-sharded Snapshot.
+
+    Stage 1 (per shard): each device scores its node block — the same
+    distance/eligibility/key math as :func:`_execute` but over
+    ``block = N / D`` rows with GLOBAL ids — and takes a local
+    ``lax.top_k`` of width ``min(k, block)``. Stage 0 feeds it: each
+    query's source row lives on one shard, so the owner contributes it
+    to a [B, D+3] psum broadcast (no host gather, no replicated vec).
+
+    Stage 2: all-gather the per-shard candidate (key, id, rtt) triples
+    — shard-major, so candidates are ordered by (shard, local rank) —
+    and merge with one global ``top_k`` of width ``k``; counts psum.
+
+    Tie-break contract: identical to the single-device kernel. Within a
+    shard, top_k's lower-index preference yields ascending global ids
+    among equal keys; the shard-major candidate layout keeps lower
+    shards (= lower global ids) earlier, and the merge's positional
+    preference again picks the earliest. Per-shard truncation cannot
+    drop a global winner: any row cut locally has >= k better-or-equal
+    lower-id rows in its own shard, which already outrank it globally.
+    """
+    from consul_tpu.parallel.mesh import node_axes, node_spec, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axis, n_shards = node_axes(mesh)
+    n = snap.height.shape[0]
+    if n % n_shards != 0:
+        raise ValueError(f"snapshot n={n} must divide over {n_shards} shards")
+    block = n // n_shards
+    kk = min(k, block)
+    slot = jnp.arange(k, dtype=jnp.int32)
+
+    def local(snap_l: Snapshot, m, s, a):
+        shard = jax.lax.axis_index(axis).astype(jnp.int32)
+        base = shard * block
+        gidx = base + jnp.arange(block, dtype=jnp.int32)
+
+        li = jnp.clip(s - base, 0, block - 1)
+        own = (s >= base) & (s < base + block)
+        src_vec = jax.lax.psum(
+            jnp.where(own[:, None], snap_l.vec[li], 0.0), axis)
+        src_h = jax.lax.psum(jnp.where(own, snap_l.height[li], 0.0), axis)
+        src_adj = jax.lax.psum(
+            jnp.where(own, snap_l.adjustment[li], 0.0), axis)
+        src_known = jax.lax.psum(
+            (own & snap_l.known[li]).astype(jnp.int32), axis) > 0
+
+        def one(m1, sv, sh, sa, sk, a1):
+            dist = vivaldi.distance(
+                sv, sh, sa, snap_l.vec, snap_l.height, snap_l.adjustment)
+            pair_known = sk & snap_l.known
+            dist = jnp.where(pair_known, dist, jnp.inf)
+            svc_ok = (a1 < jnp.int32(0)) | (snap_l.service == a1)
+            elig = jnp.where(
+                m1 == MODE_DIST, gidx == a1,
+                jnp.where(m1 == MODE_CATALOG, svc_ok,
+                          jnp.where((m1 == MODE_NEAREST) | (m1 == MODE_HEALTH),
+                                    snap_l.live & svc_ok,
+                                    jnp.zeros_like(snap_l.live))))
+            by_dist = (m1 == MODE_NEAREST) | (m1 == MODE_DIST)
+            key = jnp.where(
+                by_dist,
+                jnp.where(jnp.isfinite(dist), dist,
+                          jnp.float32(_UNKNOWN_KEY)),
+                gidx.astype(jnp.float32))
+            key = jnp.where(elig, key, jnp.float32(_PAD_KEY))
+            neg, lids = jax.lax.top_k(-key, kk)
+            return (-neg, gidx[lids], dist[lids],
+                    jnp.sum(elig.astype(jnp.int32)))
+
+        ck, ci, cr, cl = jax.vmap(one)(
+            m, src_vec, src_h, src_adj, src_known, a)
+        b = ck.shape[0]
+        ak = jnp.moveaxis(jax.lax.all_gather(ck, axis), 0, 1).reshape(b, -1)
+        ai = jnp.moveaxis(jax.lax.all_gather(ci, axis), 0, 1).reshape(b, -1)
+        ar = jnp.moveaxis(jax.lax.all_gather(cr, axis), 0, 1).reshape(b, -1)
+        count = jax.lax.psum(cl, axis)
+        _, pos = jax.lax.top_k(-ak, k)
+        ids = jnp.take_along_axis(ai, pos, axis=1)
+        rtts = jnp.take_along_axis(ar, pos, axis=1)
+        valid = slot[None, :] < count[:, None]
+        return (jnp.where(valid, ids.astype(jnp.int32), jnp.int32(-1)),
+                jnp.where(valid, rtts, jnp.inf),
+                count)
+
+    snap_specs = jax.tree.map(lambda l: node_spec(l, n, axis), snap)
+    inner = shard_map(
+        local, mesh=mesh,
+        in_specs=(snap_specs, P(), P(), P()),
+        out_specs=(P(), P(), P()), check_vma=False,
+    )
+    ids, rtts, count = inner(snap, mode, src, arg)
+    return ids, rtts, count, snap.tick
+
+
+# One jit object per (k, mesh fingerprint); the mesh is baked into the
+# shard_map program, so — exactly like the chunk-runner memo — a new
+# surviving-device grid binds a fresh executable and an old one can
+# never serve it.
+_SHARDED_KERNEL_CACHE: dict = {}
+
+
+def sharded_kernel_for(k: int, mesh):
+    """Memoized jitted two-stage batch executor for result width ``k``
+    over ``mesh``. Same signature and result contract as
+    :func:`kernel_for` — drop-in for the batcher when the attached
+    simulation runs multi-chip."""
+    from consul_tpu.parallel.mesh import mesh_key
+
+    key = (k, mesh_key(mesh))
+    fn = _SHARDED_KERNEL_CACHE.get(key)
+    if fn is None:
+        fn = _SHARDED_KERNEL_CACHE[key] = jax.jit(
+            functools.partial(_execute_sharded, k, mesh))
+    return fn

@@ -37,6 +37,54 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
                       out_specs=out_specs, check_rep=check_vma)
 
 
+def mesh_key(mesh: Optional[Mesh]):
+    """Hashable fingerprint of a mesh's identity: axis names, shape AND
+    the concrete device ids. Every process-wide runner memo that bakes a
+    mesh into its program (shard_map closes over the mesh) must include
+    this, so an elastic 8->4 reshard can never hit a cached executable
+    built for the old device set. ``None`` (single-device, no mesh)
+    fingerprints as None."""
+    if mesh is None:
+        return None
+    return (
+        tuple(mesh.axis_names),
+        tuple(mesh.shape[a] for a in mesh.axis_names),
+        tuple(d.id for d in mesh.devices.flat),
+    )
+
+
+def node_axes(mesh: Mesh):
+    """(axis_name, n_shards) carrying the node dimension of a flat
+    Simulation over ``mesh``. A 1-D mesh shards nodes over NODE_AXIS; a
+    2-D (dc, nodes) mesh shards the single node axis over BOTH axes —
+    spec ``P((DC_AXIS, NODE_AXIS))`` — so the full device grid
+    participates even when the model itself has no dc dimension
+    (collectives take the tuple axis name; lax flattens it row-major,
+    matching the mesh's device order)."""
+    if DC_AXIS in mesh.axis_names:
+        return ((DC_AXIS, NODE_AXIS),
+                mesh.shape[DC_AXIS] * mesh.shape[NODE_AXIS])
+    return (NODE_AXIS, mesh.shape[NODE_AXIS])
+
+
+def default_mesh(n: int, device_count: Optional[int] = None,
+                 n_dc: int = 1) -> Optional[Mesh]:
+    """The mesh the CLIs and bench children run over by default: the
+    largest elastic mesh the visible devices support — or ``None``
+    (single-device execution, no shard_map) when only one device is
+    visible or the caller pinned ``--devices 1``. ``device_count``
+    truncates ``jax.devices()`` (the --devices override); ``n_dc``
+    folds a dc axis in (the --n-dc override)."""
+    devices = jax.devices()
+    if device_count is not None:
+        if device_count < 1:
+            raise ValueError(f"device_count={device_count} must be >= 1")
+        devices = devices[:device_count]
+    if len(devices) <= 1 and n_dc <= 1:
+        return None
+    return elastic_mesh(n, devices, n_dc=n_dc)
+
+
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None, n_dc: int = 1) -> Mesh:
     """1-D node mesh, or 2-D (dc, nodes) when federating datacenters."""
     devices = list(devices if devices is not None else jax.devices())
@@ -95,12 +143,14 @@ def sharding_from_manifest(mesh: Mesh, specs: Sequence, tree):
     return jax.tree.unflatten(treedef, shardings)
 
 
-def node_spec(leaf, n: int) -> P:
+def node_spec(leaf, n: int, axis=NODE_AXIS) -> P:
     """The one node-axis partition rule: leaves whose leading dim is the
     node count shard on it, everything else replicates. Shared by the
-    auto-SPMD path (here) and the shard_map path (parallel/shard_step.py)."""
+    auto-SPMD path (here) and the shard_map path (parallel/shard_step.py).
+    ``axis`` may be a tuple — the 2-D (dc, nodes) grid sharding one flat
+    node axis over both mesh axes (:func:`node_axes`)."""
     if leaf.ndim >= 1 and leaf.shape[0] == n:
-        return P(NODE_AXIS, *([None] * (leaf.ndim - 1)))
+        return P(axis, *([None] * (leaf.ndim - 1)))
     return P()
 
 

@@ -47,7 +47,9 @@ from consul_tpu.models import counters as counters_mod
 from consul_tpu.models import swim
 from consul_tpu.ops.topology import Topology, World
 from consul_tpu.parallel import collective as coll
-from consul_tpu.parallel.mesh import NODE_AXIS, node_spec, shard_map
+from consul_tpu.parallel.mesh import (
+    NODE_AXIS, node_axes, node_spec, shard_map,
+)
 
 
 def _make_sharded(step_fn, cfg: SimConfig, topo: Topology, mesh: Mesh,
@@ -75,15 +77,19 @@ def _make_sharded(step_fn, cfg: SimConfig, topo: Topology, mesh: Mesh,
     With ``sentinel=True``, the on-device invariant validator runs in
     the step (models/swim.py _sentinel_check); its per-row violation
     tallies psum with the other counters, so the host sees global
-    counts (sentinel requires ``counted`` to surface them)."""
-    n_shards = mesh.shape[NODE_AXIS]
+    counts (sentinel requires ``counted`` to surface them).
+
+    A 2-D (dc, nodes) mesh shards the flat node axis over BOTH axes
+    (mesh.node_axes): the collectives take the tuple axis name and the
+    device ring is the row-major flattening of the grid."""
+    axis, n_shards = node_axes(mesh)
     if cfg.n % n_shards != 0:
         raise ValueError(f"n={cfg.n} must divide over {n_shards} shards")
 
-    world_spec = World(pos=P(NODE_AXIS, None), height=P(NODE_AXIS))
+    world_spec = World(pos=P(axis, None), height=P(axis))
 
     def local_step(world_local, sched_local, state_local, key):
-        with coll.node_axis(NODE_AXIS, n_shards, cfg.n):
+        with coll.node_axis(axis, n_shards, cfg.n):
             if not counted:
                 return step_fn(cfg, topo, world_local, state_local, key,
                                sched_local, sentinel=sentinel)
@@ -98,8 +104,9 @@ def _make_sharded(step_fn, cfg: SimConfig, topo: Topology, mesh: Mesh,
 
     if chaos:
         def global_step(world_g, sched_g, state_g, key):
-            specs = jax.tree.map(lambda l: node_spec(l, cfg.n), state_g)
-            sched_specs = jax.tree.map(lambda l: node_spec(l, cfg.n), sched_g)
+            specs = jax.tree.map(lambda l: node_spec(l, cfg.n, axis), state_g)
+            sched_specs = jax.tree.map(
+                lambda l: node_spec(l, cfg.n, axis), sched_g)
             inner = shard_map(
                 local_step,
                 mesh=mesh,
@@ -112,7 +119,7 @@ def _make_sharded(step_fn, cfg: SimConfig, topo: Topology, mesh: Mesh,
         return jax.jit(global_step, donate_argnums=(2,))
 
     def global_step(world_g, state_g, key):
-        specs = jax.tree.map(lambda l: node_spec(l, cfg.n), state_g)
+        specs = jax.tree.map(lambda l: node_spec(l, cfg.n, axis), state_g)
         inner = shard_map(
             lambda w, st, k: local_step(w, None, st, k),
             mesh=mesh,
@@ -184,8 +191,100 @@ def make_sharded_chaos_step(cfg: SimConfig, topo: Topology, mesh: Mesh, *,
                          sentinel=sentinel)
 
 
+def make_sharded_chunk_runner(cfg: SimConfig, topo: Topology, mesh: Mesh,
+                              chunk: int, with_metrics: bool, *,
+                              step_fn, swim_of,
+                              chaos: bool = False, sentinel: bool = False):
+    """The multi-chip analogue of models/cluster.py ``_chunk_runner``:
+    one jitted program per (cfg, topo content, chunk, metrics, step,
+    chaos shape, sentinel, MESH) signature with the same call convention
+    ``run(world, sched, state, base_key) -> (state, counters, trace)``.
+
+    The whole ``chunk``-tick scan executes INSIDE a single shard_map
+    region — per-tick keys fold the on-device tick counter, every
+    cross-node exchange is an explicit ppermute/all-gather on the node
+    axis (parallel/collective.py), and the per-shard counter tallies
+    accumulate locally across the scan with exactly ONE tree_psum at
+    the chunk boundary (log2(D) ladder instead of chunk psums).
+
+    Metrics differ from the single-device runner by design: computing
+    agreement/RMSE per tick would force a global gather inside every
+    scan iteration, so the sharded runner samples them ONCE per chunk on
+    the final state — outside the shard_map region but inside the same
+    jit, where the SPMD partitioner handles the global reductions. The
+    returned TickTrace has length-[1] rows; every consumer
+    (run_until_converged, _record_chunk) reads only ``trace.*[-1]``, so
+    convergence detection and telemetry see identical values at chunk
+    granularity. The RMSE sample key matches the single-device last
+    row's (fold_in(fold_in(base_key, t_last), 1)) so the chunk-boundary
+    rows agree to float tolerance."""
+    from consul_tpu.models.cluster import TickTrace  # deferred: no cycle
+    from consul_tpu.utils import metrics
+
+    axis, n_shards = node_axes(mesh)
+    if cfg.n % n_shards != 0:
+        raise ValueError(f"n={cfg.n} must divide over {n_shards} shards")
+
+    world_spec = World(pos=P(axis, None), height=P(axis))
+    cnt_specs = jax.tree.map(lambda _: P(), counters_mod.zeros())
+
+    def local_run(world_l, sched_l, state_l, base_key):
+        ticks = swim_of(state_l).t + jnp.arange(chunk, dtype=jnp.int32)
+        tick_keys = jax.vmap(
+            lambda t: jax.random.fold_in(base_key, t))(ticks)
+
+        def body(carry, tick_key):
+            state, cnt = carry
+            with coll.node_axis(axis, n_shards, cfg.n):
+                state, c = step_fn(cfg, topo, world_l, state, tick_key,
+                                   sched_l, sentinel=sentinel)
+            return (state, counters_mod.add(cnt, c)), ()
+
+        (state_l, cnt), _ = jax.lax.scan(
+            body, (state_l, counters_mod.zeros()), tick_keys)
+        with coll.node_axis(axis, n_shards, cfg.n):
+            red = coll.tree_psum(jnp.stack(list(cnt)))
+        return state_l, counters_mod.unstack(red)
+
+    def run(world, sched, state, base_key):
+        specs = jax.tree.map(lambda l: node_spec(l, cfg.n, axis), state)
+        if chaos:
+            sched_specs = jax.tree.map(
+                lambda l: node_spec(l, cfg.n, axis), sched)
+            inner = shard_map(
+                local_run, mesh=mesh,
+                in_specs=(world_spec, sched_specs, specs, P()),
+                out_specs=(specs, cnt_specs), check_vma=False,
+            )
+            state, cnt = inner(world, sched, state, base_key)
+        else:
+            inner = shard_map(
+                lambda w, st, k: local_run(w, None, st, k), mesh=mesh,
+                in_specs=(world_spec, specs, P()),
+                out_specs=(specs, cnt_specs), check_vma=False,
+            )
+            state, cnt = inner(world, state, base_key)
+        if not with_metrics:
+            return state, cnt, ()
+        sw = swim_of(state)
+        h = metrics.health(cfg, topo, sw)
+        last_key = jax.random.fold_in(base_key, sw.t - 1)
+        rmse = metrics.vivaldi_rmse(
+            cfg, world, sw, jax.random.fold_in(last_key, 1), samples=2048)
+        trace = TickTrace(
+            h.agreement[None], h.false_positive[None],
+            h.undetected[None], rmse[None])
+        return state, cnt, trace
+
+    return jax.jit(run, donate_argnums=(2,))
+
+
 def place(mesh: Mesh, tree, n: int):
-    """Shard a pytree's node-axis leaves over the mesh (others replicate)."""
+    """Shard a pytree's node-axis leaves over the mesh (others
+    replicate). On a 2-D (dc, nodes) mesh the flat node axis spans both
+    grid axes (mesh.node_axes)."""
+    axis, _ = node_axes(mesh)
     return jax.tree.map(
-        lambda l: jax.device_put(l, NamedSharding(mesh, node_spec(l, n))), tree
+        lambda l: jax.device_put(
+            l, NamedSharding(mesh, node_spec(l, n, axis))), tree
     )

@@ -249,9 +249,24 @@ def run_resilient(sim, ticks: int, *, chunk: int = 64,
     resumed_from = done
 
     if target_mesh is not None:
-        from consul_tpu.parallel import shard_step
+        if getattr(sim, "mesh", None) is not None \
+                and hasattr(sim, "set_mesh"):
+            # The sim already executes under shard_map: continue on the
+            # surviving grid — set_mesh re-places world/state/schedule
+            # and rebinds the runners, and the mesh fingerprint in the
+            # runner memo key guarantees a reshard never reuses the old
+            # mesh's executable.
+            sim.set_mesh(target_mesh)
+        else:
+            # Single-device program with a placement mesh: re-place the
+            # DATA only, never the execution. This is the layout-only
+            # semantics the cross-shape bit-identity pins cover — the
+            # sharded program's collectives reassociate float reductions,
+            # so flipping a meshless sim into shard_map execution here
+            # would silently change the trajectory it is resuming.
+            from consul_tpu.parallel import shard_step
 
-        sim.state = shard_step.place(target_mesh, sim.state, sim.cfg.n)
+            sim.state = shard_step.place(target_mesh, sim.state, sim.cfg.n)
     if saved_width is not None:
         new_width = _placement_width(sim.state)
         if new_width != saved_width:

@@ -112,7 +112,9 @@ class QueryBatcher:
             src[j] = s
             arg[j] = a
         dm, ds, da = jax.device_put((mode, src, arg))
-        ids, rtts, count, tick = kernels.kernel_for(self.k)(snap, dm, ds, da)
+        kernel = getattr(self.plane, "kernel", None)
+        kernel = kernel() if kernel is not None else kernels.kernel_for(self.k)
+        ids, rtts, count, tick = kernel(snap, dm, ds, da)
         h_ids, h_rtts, h_count, h_tick = jax.device_get(
             (ids, rtts, count, tick))
         self.latencies_s.append(time.perf_counter() - t0)

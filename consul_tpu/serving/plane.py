@@ -60,6 +60,11 @@ class ServingPlane:
         self._cur = -1
         self._source: Optional[str] = None  # "sim" | "host"
         self._service_labels = None  # cached per-n device labels (sim)
+        self._labels_key = None      # (n, mesh fingerprint) of the cache
+        # Device mesh of the attached simulation (None = single-device
+        # or host mode). Refreshed on every publish so an elastic
+        # reshard retargets the two-stage kernel automatically.
+        self._mesh = None
         self.cache_hits = 0
         # Host-mode name table (publish_coords).
         self._names: tuple[str, ...] = ()
@@ -103,21 +108,49 @@ class ServingPlane:
         """Project the sim's current state into the idle buffer and
         swap. Called by the scan loop at chunk boundaries; one jitted
         projection, no host round-trip."""
+        self._mesh = getattr(sim, "mesh", None)
         self.publish_state(sim.swim_state)
 
     def publish_state(self, state) -> None:
         import jax.numpy as jnp
 
+        from consul_tpu.parallel.mesh import mesh_key
+
         n = state.alive_truth.shape[0]
         labels = self._service_labels
-        if labels is None or labels.shape[0] != n:
+        lk = (n, mesh_key(self._mesh))
+        if labels is None or self._labels_key != lk:
             if self.num_services > 1:
                 labels = (jnp.arange(n, dtype=jnp.int32)
                           % jnp.int32(self.num_services))
             else:
                 labels = jnp.zeros(n, dtype=jnp.int32)
+            if self._mesh is not None:
+                # Explicit node-axis placement: an unsharded [N] label
+                # array next to a sharded state would replicate on
+                # every chip (the TH110 hazard).
+                from consul_tpu.parallel import shard_step
+
+                labels = shard_step.place(self._mesh, labels, n)
             self._service_labels = labels
+            self._labels_key = lk
         self._flip(kernels.project(state, labels))
+
+    def kernel(self):
+        """The batch executor the QueryBatcher runs: the two-stage
+        shard_map top-k (ops/serving.sharded_kernel_for) when the
+        attached simulation is mesh-sharded and the node axis divides
+        the shards, else the single-device kernel. Same signature and
+        result contract either way."""
+        mesh = self._mesh
+        if mesh is not None and self._cur >= 0:
+            from consul_tpu.parallel.mesh import node_axes
+
+            n = int(self.snapshot().height.shape[0])
+            _, shards = node_axes(mesh)
+            if n % shards == 0 and shards > 1:
+                return kernels.sharded_kernel_for(self.k, mesh)
+        return kernels.kernel_for(self.k)
 
     # ------------------------------------------------------------------
     # Host-coordinate publication (server store rows)

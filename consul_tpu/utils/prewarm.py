@@ -1,0 +1,144 @@
+"""AOT compile prewarm: build every chunk-program signature off the
+critical path (ROADMAP item 2 — the 387.5 s serf cold start at 1M).
+
+``jit(...).lower(avals).compile()`` compiles a program WITHOUT running
+it, from abstract ``jax.ShapeDtypeStruct`` arguments that carry the
+real arrays' shapes, dtypes AND shardings. Routed through the
+persistent compilation cache (utils/compile_cache.py), the compiled
+executable lands on disk keyed by its HLO fingerprint; a later process
+that builds the same simulation — same (n, kind, chunk, mesh shape,
+chaos shape) signature, same seed-derived topology (the topology
+tables are trace-time constants, so the seed is part of the program
+identity) — deserializes it instead of recompiling. A warm 1M serf
+start then records ``compile_s ~ 0`` (trace + cache read) in bench
+JSON, and the compile ledger (analysis/guards.py) pins steady state to
+zero backend compiles: persistent-cache loads don't fire the
+backend_compile event.
+
+The prewarm builds REAL Simulation objects (cheap next to the compile
+it avoids) rather than synthesizing avals by hand: that is the only
+way to guarantee the fingerprint matches what ``run``/``chaos``/bench
+will execute.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+import jax
+
+from consul_tpu.utils import compile_cache
+
+
+def _abstract(tree):
+    """ShapeDtypeStruct pytree mirroring ``tree``'s shapes, dtypes and
+    shardings — the avals ``.lower()`` compiles against."""
+
+    def one(leaf):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            sh = getattr(leaf, "sharding", None)
+            # Only mesh placements are part of the program identity.
+            # Single-device leaves stay unspecified, exactly as the
+            # real call sees its uncommitted inputs — mixing a pinned
+            # SingleDeviceSharding with mesh shardings would both fail
+            # to lower and fingerprint a program nobody runs.
+            if not isinstance(sh, jax.sharding.NamedSharding):
+                sh = None
+            return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=sh)
+        return leaf
+
+    return jax.tree.map(one, tree)
+
+
+def prewarm_simulation(sim, chunk: int, with_metrics: bool) -> None:
+    """AOT-compile one chunk-runner signature for ``sim`` exactly as
+    ``Simulation.run(ticks, chunk, with_metrics)`` would bind it —
+    same memoized program (models/cluster._chunk_runner), same mesh,
+    same chaos shape — without advancing any state."""
+    from consul_tpu.chaos import schedule as chaos_mod
+    from consul_tpu.models import cluster
+
+    jitted = cluster._chunk_runner(
+        sim.cfg, sim.topo, chunk, with_metrics,
+        step_fn=type(sim)._step_fn, swim_of=type(sim)._swim_of,
+        chaos_key=chaos_mod.static_key_of(sim.chaos),
+        sentinel=sim.sentinel, mesh=sim.mesh,
+    )
+    jitted.lower(
+        _abstract(sim.world), _abstract(sim.chaos),
+        _abstract(sim.state), _abstract(sim.base_key),
+    ).compile()
+
+
+def _mesh_shape(mesh) -> Optional[list]:
+    if mesh is None:
+        return None
+    return [int(mesh.shape[a]) for a in mesh.axis_names]
+
+
+def prewarm(ns: Sequence[int], kinds: Sequence[str] = ("swim",),
+            chunks: Sequence[int] = (64,),
+            metrics_modes: Sequence[bool] = (False, True),
+            mesh=None, device_count: Optional[int] = None, n_dc: int = 1,
+            chaos: bool = False, seed: int = 0, view_degree: int = 16,
+            sentinel: bool = False, cache_dir: Optional[str] = None) -> dict:
+    """Compile every (n, kind, chunk, mesh-shape, chaos-shape)
+    signature into the persistent compile cache and return a JSON-ready
+    summary: the signatures compiled, cache hit/miss movement, and wall
+    time. ``mesh`` overrides the per-``n`` default
+    (parallel/mesh.default_mesh over the visible devices, honoring
+    ``device_count``/``n_dc``); ``chaos=True`` additionally compiles
+    the chaos-enabled program for the default one-partition schedule
+    shape (the ``consul-tpu chaos`` / bench chaos-phase signature).
+
+    ``view_degree``/``seed`` must match the run being warmed — they
+    change the seed-derived topology constants and with them the
+    program fingerprint (the signature key documented in COVERAGE.md).
+    """
+    from consul_tpu import chaos as chaos_api
+    from consul_tpu.config import SimConfig
+    from consul_tpu.models.cluster import SerfSimulation, Simulation
+    from consul_tpu.parallel import mesh as pmesh
+
+    if cache_dir:
+        compile_cache.enable(cache_dir)
+    else:
+        compile_cache.maybe_enable_from_env()
+    classes = {"swim": Simulation, "serf": SerfSimulation}
+    for kind in kinds:
+        if kind not in classes:
+            raise ValueError(f"unknown kind {kind!r} (swim|serf)")
+
+    before = compile_cache.stats()
+    t_start = time.perf_counter()
+    signatures = []
+    for n in ns:
+        m = mesh if mesh is not None else pmesh.default_mesh(
+            n, device_count=device_count, n_dc=n_dc)
+        for kind in kinds:
+            cfg = SimConfig(n=n, view_degree=min(view_degree, n - 2))
+            sim = classes[kind](cfg, seed=seed, sentinel=sentinel, mesh=m)
+            schedules = [None]
+            if chaos:
+                schedules.append([chaos_api.Partition(
+                    start=4, stop=16, side_a=slice(0, max(1, n // 3)))])
+            for sched in schedules:
+                sim.set_chaos(sched)
+                for chunk in chunks:
+                    for with_metrics in metrics_modes:
+                        t0 = time.perf_counter()
+                        prewarm_simulation(sim, chunk, with_metrics)
+                        signatures.append({
+                            "n": int(n), "kind": kind, "chunk": int(chunk),
+                            "mesh": _mesh_shape(m),
+                            "with_metrics": bool(with_metrics),
+                            "chaos": sched is not None,
+                            "wall_s": round(time.perf_counter() - t0, 3),
+                        })
+    return {
+        "signatures": signatures,
+        "compiled": len(signatures),
+        "cache": compile_cache.stats_delta(before),
+        "wall_s": round(time.perf_counter() - t_start, 3),
+    }

@@ -477,6 +477,108 @@ class TestTH109:
 
 
 # ----------------------------------------------------------------------
+# TH110: sharding-less placement in mesh-handling host paths
+# ----------------------------------------------------------------------
+
+class TestTH110:
+    def test_bare_device_put_in_mesh_function_fires(self):
+        # The multi-chip footgun: a mesh is in hand, but the node-axis
+        # array is committed to device 0 anyway.
+        rep = _lint({HOST: """
+            import jax
+
+            def restore(mesh, state):
+                return jax.device_put(state)
+        """})
+        assert _rules(rep) == ["TH110"]
+        assert rep.findings[0].symbol == "restore"
+
+    def test_asarray_near_mesh_attribute_fires(self):
+        # Reading .mesh marks the function mesh-handling; jnp.asarray
+        # cannot express a sharding at all.
+        rep = _lint({HOST: """
+            import jax.numpy as jnp
+
+            class Sim:
+                def place(self, value):
+                    if self.mesh is None:
+                        pass
+                    return jnp.asarray(value)
+        """})
+        assert _rules(rep) == ["TH110"]
+        assert rep.findings[0].symbol == "Sim.place"
+
+    def test_mesh_constructor_call_marks_scope(self):
+        rep = _lint({HOST: """
+            import jax
+            from consul_tpu.parallel.mesh import default_mesh
+
+            def build(n):
+                m = default_mesh(n)
+                return jax.device_put(list(range(n)))
+        """})
+        assert _rules(rep) == ["TH110"]
+
+    def test_explicit_sharding_is_silent(self):
+        # Both spellings of an explicit placement: second positional
+        # and device=/sharding= keyword.
+        rep = _lint({HOST: """
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def place(mesh, x, y):
+                a = jax.device_put(x, NamedSharding(mesh, P("nodes")))
+                b = jax.device_put(y, device=jax.devices()[0])
+                return a, b
+        """})
+        assert rep.clean
+
+    def test_meshless_host_function_is_silent(self):
+        # No mesh anywhere in scope: plain host staging is fine
+        # (single-device paths stay untouched).
+        rep = _lint({HOST: """
+            import jax
+            import jax.numpy as jnp
+
+            def stage(x):
+                return jax.device_put(jnp.asarray(x))
+        """})
+        assert rep.clean
+
+    def test_traced_code_is_th102_territory(self):
+        # Inside a trace the same call is TH102's finding, not TH110's
+        # — the rules partition on tier, they never double-report.
+        rep = _lint({HOST: """
+            import jax
+
+            def step(mesh, x):
+                return jax.device_put(x)
+
+            run = jax.jit(step)
+        """})
+        assert _rules(rep) == ["TH102"]
+
+    def test_allowlist_suppresses_by_symbol(self):
+        al = parse_allowlist("""
+            [[allow]]
+            rule = "TH110"
+            path = "consul_tpu/agent/fake.py"
+            symbol = "Sim.place"
+            reason = "feeds shard_step.place on the next line"
+        """)
+        rep = _lint({HOST: """
+            import jax.numpy as jnp
+
+            class Sim:
+                def place(self, value):
+                    if self.mesh is None:
+                        pass
+                    return jnp.asarray(value)
+        """}, al)
+        assert rep.clean and len(rep.suppressed) == 1
+
+
+# ----------------------------------------------------------------------
 # callgraph: reachability across modules and hand-off shapes
 # ----------------------------------------------------------------------
 
@@ -685,6 +787,6 @@ class TestPackageGate:
     def test_every_rule_id_is_documented(self):
         assert set(analysis.RULES) == {
             "TH101", "TH102", "TH103", "TH104", "TH105", "TH106",
-            "TH107", "TH108", "TH109"}
+            "TH107", "TH108", "TH109", "TH110"}
         for rid, rationale in analysis.RULES.items():
             assert rationale.strip(), rid

@@ -1,0 +1,194 @@
+"""Multi-chip scale-out coverage: the cheap contract pins.
+
+Runs on the forced 8-device virtual CPU mesh (tests/conftest.py) and
+pins the fast ISSUE-10 contracts:
+
+- the two-stage shard_map serving top-k agrees with the single-device
+  kernel bit-for-bit on ids/counts, including coordinate ties (the
+  documented ascending-global-id tie-break) and the k > block edge;
+- runner memos key on the mesh fingerprint: one executable per mesh
+  shape, never a stale one across shapes;
+- default_mesh selection rules (the CLI/bench default path).
+
+The heavy end-to-end runs (full driver parity with a mesh installed,
+prewarm-then-run ledger pins) live in tests/test_shardmap_scaleout.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.config import SimConfig
+from consul_tpu.models import cluster
+from consul_tpu.models.cluster import Simulation
+from consul_tpu.ops import serving
+from consul_tpu.parallel import mesh as pmesh
+
+N_DEV = 8
+
+
+def _mesh(k: int = N_DEV, n_dc: int = 1):
+    return pmesh.make_mesh(jax.devices()[:k], n_dc=n_dc)
+
+
+# ----------------------------------------------------------------------
+# Two-stage serving top-k vs the single-device kernel
+# ----------------------------------------------------------------------
+
+def _snapshot(n: int, seed: int = 0) -> serving.Snapshot:
+    rng = np.random.default_rng(seed)
+    live = np.ones(n, dtype=bool)
+    live[rng.choice(n, size=max(1, n // 8), replace=False)] = False
+    known = np.ones(n, dtype=bool)
+    known[1] = False  # one coordinate-less node: rtt unknown, sorts last
+    return serving.Snapshot(
+        vec=jnp.asarray(rng.normal(size=(n, 4)).astype(np.float32)),
+        height=jnp.asarray(
+            rng.uniform(0.01, 0.1, size=n).astype(np.float32)),
+        adjustment=jnp.asarray(
+            rng.normal(0.0, 0.01, size=n).astype(np.float32)),
+        known=jnp.asarray(known),
+        live=jnp.asarray(live),
+        service=jnp.asarray((np.arange(n) % 3).astype(np.int32)),
+        tick=jnp.int32(42),
+    )
+
+
+def _queries(n: int):
+    mode = jnp.asarray([serving.MODE_NEAREST, serving.MODE_NEAREST,
+                        serving.MODE_HEALTH, serving.MODE_CATALOG,
+                        serving.MODE_DIST, serving.MODE_NEAREST],
+                       dtype=jnp.int32)
+    src = jnp.asarray([0, n - 1, 3, 5, 2, n // 2], dtype=jnp.int32)
+    arg = jnp.asarray([-1, 1, 2, -1, n - 3, 0], dtype=jnp.int32)
+    return mode, src, arg
+
+
+def _compare_kernels(snap: serving.Snapshot, k: int, mesh):
+    mode, src, arg = _queries(snap.height.shape[0])
+    ids_s, rtts_s, count_s, tick_s = serving.kernel_for(k)(
+        snap, mode, src, arg)
+    ids_m, rtts_m, count_m, tick_m = serving.sharded_kernel_for(k, mesh)(
+        snap, mode, src, arg)
+    np.testing.assert_array_equal(np.asarray(ids_s), np.asarray(ids_m))
+    np.testing.assert_array_equal(np.asarray(count_s), np.asarray(count_m))
+    np.testing.assert_allclose(np.asarray(rtts_s), np.asarray(rtts_m),
+                               rtol=1e-5, atol=1e-7)
+    assert int(tick_s) == int(tick_m)
+    return np.asarray(ids_m)
+
+
+class TestTwoStageServingTopK:
+    def test_matches_single_device_kernel(self):
+        _compare_kernels(_snapshot(64), k=5, mesh=_mesh())
+
+    def test_matches_on_dc_by_node_mesh(self):
+        _compare_kernels(_snapshot(64, seed=4), k=5,
+                         mesh=_mesh(8, n_dc=2))
+
+    def test_k_wider_than_shard_block(self):
+        # n=16 over 8 shards -> block 2 < k: per-shard candidate lists
+        # truncate to kk=min(k, block) and the merge must still agree.
+        _compare_kernels(_snapshot(16, seed=2), k=6, mesh=_mesh())
+
+    def test_coordinate_ties_break_toward_lower_global_id(self):
+        snap = _snapshot(64, seed=7)
+        vec = np.asarray(snap.vec).copy()
+        h = np.asarray(snap.height).copy()
+        adj = np.asarray(snap.adjustment).copy()
+        # Nodes 8..23 share node 8's exact coordinates: equal distance
+        # from any source, spanning several shard boundaries.
+        vec[8:24] = vec[8]
+        h[8:24] = h[8]
+        adj[8:24] = adj[8]
+        snap = snap._replace(
+            vec=jnp.asarray(vec), height=jnp.asarray(h),
+            adjustment=jnp.asarray(adj),
+            live=jnp.asarray(np.ones(64, dtype=bool)),
+            service=jnp.asarray(np.full(64, 1, dtype=np.int32)))
+        mode = jnp.full(2, serving.MODE_NEAREST, dtype=jnp.int32)
+        src = jnp.asarray([8, 40], dtype=jnp.int32)
+        arg = jnp.full(2, -1, dtype=jnp.int32)
+        k = 10
+        ids_s, *_ = serving.kernel_for(k)(snap, mode, src, arg)
+        ids_m, *_ = serving.sharded_kernel_for(k, _mesh())(
+            snap, mode, src, arg)
+        ids_s, ids_m = np.asarray(ids_s), np.asarray(ids_m)
+        np.testing.assert_array_equal(ids_s, ids_m)
+        # Query from node 8: the 16 zero-distance clones win, and among
+        # equal keys the order is ascending global id — the documented
+        # tie-break contract both kernels share.
+        np.testing.assert_array_equal(ids_m[0], np.arange(8, 18))
+
+
+# ----------------------------------------------------------------------
+# One executable per mesh shape: the memo fingerprint
+# ----------------------------------------------------------------------
+
+class TestRunnerMemoMeshKey:
+    def test_chunk_runner_memoizes_per_mesh_fingerprint(self):
+        sim = Simulation(SimConfig(n=64, view_degree=16), seed=0)
+        kw = dict(step_fn=Simulation._step_fn,
+                  swim_of=Simulation._swim_of,
+                  chaos_key=None, sentinel=False)
+        r8 = cluster._chunk_runner(sim.cfg, sim.topo, 16, False,
+                                   mesh=_mesh(8), **kw)
+        # A distinct Mesh object over the same grid is the same
+        # fingerprint — elastic 4->8 recovery must not recompile.
+        assert cluster._chunk_runner(sim.cfg, sim.topo, 16, False,
+                                     mesh=_mesh(8), **kw) is r8
+        r4 = cluster._chunk_runner(sim.cfg, sim.topo, 16, False,
+                                   mesh=_mesh(4), **kw)
+        r2x4 = cluster._chunk_runner(sim.cfg, sim.topo, 16, False,
+                                     mesh=_mesh(8, n_dc=2), **kw)
+        rn = cluster._chunk_runner(sim.cfg, sim.topo, 16, False,
+                                   mesh=None, **kw)
+        assert len({id(r8), id(r4), id(r2x4), id(rn)}) == 4
+
+    def test_sharded_serving_kernel_memoizes_per_mesh(self):
+        k8a = serving.sharded_kernel_for(5, _mesh(8))
+        k8b = serving.sharded_kernel_for(5, _mesh(8))
+        k4 = serving.sharded_kernel_for(5, _mesh(4))
+        assert k8a is k8b
+        assert k4 is not k8a
+
+    def test_mesh_key_distinguishes_axes_and_devices(self):
+        assert pmesh.mesh_key(None) is None
+        assert pmesh.mesh_key(_mesh(8)) == pmesh.mesh_key(_mesh(8))
+        assert pmesh.mesh_key(_mesh(8)) != pmesh.mesh_key(_mesh(4))
+        assert pmesh.mesh_key(_mesh(8)) != pmesh.mesh_key(_mesh(8, n_dc=2))
+
+
+# ----------------------------------------------------------------------
+# default_mesh: the CLI/bench selection rules
+# ----------------------------------------------------------------------
+
+class TestDefaultMeshSelection:
+    def test_multi_device_defaults_to_full_mesh(self):
+        m = pmesh.default_mesh(256)
+        assert m is not None
+        assert m.axis_names == (pmesh.NODE_AXIS,)
+        assert m.shape[pmesh.NODE_AXIS] == N_DEV
+
+    def test_devices_one_pins_single_device(self):
+        assert pmesh.default_mesh(256, device_count=1) is None
+
+    def test_n_dc_folds_a_dc_axis_in(self):
+        m = pmesh.default_mesh(256, n_dc=2)
+        assert m.axis_names == (pmesh.DC_AXIS, pmesh.NODE_AXIS)
+        assert (m.shape[pmesh.DC_AXIS], m.shape[pmesh.NODE_AXIS]) == (2, 4)
+
+    def test_indivisible_n_trims_elastically(self):
+        # n=12 over 8 visible: largest k with 12 % k == 0 is 6.
+        m = pmesh.default_mesh(12)
+        assert m.shape[pmesh.NODE_AXIS] == 6
+
+    def test_n_dc_three_trims_to_divisible_grid(self):
+        m = pmesh.default_mesh(256, n_dc=3)
+        assert (m.shape[pmesh.DC_AXIS], m.shape[pmesh.NODE_AXIS]) == (3, 2)
+
+    def test_device_count_caps_the_grid(self):
+        m = pmesh.default_mesh(256, device_count=4)
+        assert m.shape[pmesh.NODE_AXIS] == 4

@@ -1,0 +1,171 @@
+"""Heavy multi-chip integration runs: full drivers + prewarm, end to end.
+
+The cheap multi-chip contract pins (two-stage serving top-k, memo
+fingerprints, default_mesh selection) live in tests/test_multichip.py.
+This module holds the expensive end-to-end runs on the forced 8-device
+virtual CPU mesh (tests/conftest.py) — each test compiles full scan
+programs or boots a fresh interpreter, so they are grouped here with
+the shardmap layer's slowest coverage instead of inflating the cheap
+pin module:
+
+- sharded == single-device parity for the FULL drivers — the fused
+  serf chunk runner (including a host-injected user_event through
+  Simulation._place_node) and a chaos scenario's SLO counters — not
+  just the bare step (tests/test_shardmap.py covers that layer);
+- prewarm-then-run records zero net compiles (subprocess — enabling
+  the persistent cache is process-global state the tier-1 ledger pins
+  must not see, same rule as tests/test_compile_cache.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from consul_tpu import chaos as chaos_api
+from consul_tpu.config import SimConfig
+from consul_tpu.models.cluster import SerfSimulation, Simulation
+from consul_tpu.parallel import mesh as pmesh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+N_DEV = 8
+
+
+def _mesh(k: int = N_DEV, n_dc: int = 1):
+    return pmesh.make_mesh(jax.devices()[:k], n_dc=n_dc)
+
+
+def _assert_trees_match(a, b, context: str):
+    """Int leaves exact, float leaves allclose — the same tolerance the
+    sharded-step trajectory suite uses (collective reassociation)."""
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        x, y = np.asarray(x), np.asarray(y)
+        if np.issubdtype(x.dtype, np.floating):
+            np.testing.assert_allclose(x, y, rtol=1e-4, atol=1e-6,
+                                       err_msg=context)
+        else:
+            np.testing.assert_array_equal(x, y, err_msg=context)
+
+
+# ----------------------------------------------------------------------
+# Driver-level parity: Simulation/SerfSimulation with a mesh installed
+# ----------------------------------------------------------------------
+
+class TestShardedDriverParity:
+    """The same seeds, verbs and tick counts through the public driver
+    produce the same trajectory with and without a mesh — the property
+    that makes multi-chip safe to turn on by default."""
+
+    def _drive_serf(self, mesh):
+        sim = SerfSimulation(SimConfig(n=128, view_degree=16), seed=3,
+                             mesh=mesh)
+        sim.run(16, chunk=8, with_metrics=False)
+        mask = np.zeros(128, dtype=bool)
+        mask[5] = True
+        sim.user_event(mask, 7)  # host mask -> _place_node funnel
+        sim.run(16, chunk=8, with_metrics=False)
+        return sim
+
+    def test_fused_serf_runner_matches_single_device(self):
+        ref = self._drive_serf(None)
+        got = self._drive_serf(_mesh())
+        _assert_trees_match(jax.device_get(ref.state),
+                            jax.device_get(got.state), "serf state")
+        assert ref.counters == got.counters
+        # The event actually entered the queues in both executions.
+        assert ref.counters["serf_intents_queued"] > 0
+
+    def _chaos_slo(self, mesh):
+        sim = Simulation(SimConfig(n=128, view_degree=16), seed=1,
+                         mesh=mesh)
+        events = [chaos_api.Partition(start=4, stop=20,
+                                      side_a=slice(0, 48))]
+        return sim.run_scenario(events, ticks=40, chunk=8)
+
+    def test_chaos_scenario_slo_matches_single_device(self):
+        ref = self._chaos_slo(None)
+        got = self._chaos_slo(_mesh())
+        assert ref.slo == got.slo
+        assert ref.counters == got.counters
+        # The partition did real damage, identically on both paths.
+        assert sum(abs(v) for v in ref.slo.values()) > 0
+
+
+# ----------------------------------------------------------------------
+# Prewarm-then-run: zero net compiles (subprocess — cache state is
+# process-global, same isolation rule as tests/test_compile_cache.py)
+# ----------------------------------------------------------------------
+
+_PREWARM_CHILD = """
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8")
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_threefry_partitionable", True)
+from consul_tpu.analysis.guards import CompileLedger
+from consul_tpu.config import SimConfig
+from consul_tpu.models.cluster import Simulation
+from consul_tpu.parallel import mesh as pmesh
+from consul_tpu.utils import prewarm as prewarm_mod
+
+led = CompileLedger()
+summary = prewarm_mod.prewarm(ns=[64], kinds=("swim",), chunks=(16,),
+                              metrics_modes=(False,), cache_dir={cache!r})
+mesh = pmesh.default_mesh(64)
+sim = Simulation(SimConfig(n=64, view_degree=16), seed=0, mesh=mesh)
+start = led.total
+sim.run(32, chunk=16, with_metrics=False)
+jax.block_until_ready(sim.state)
+print(json.dumps({{
+    "mesh": [int(mesh.shape[a]) for a in mesh.axis_names],
+    "prewarm_compiled": summary["compiled"],
+    "cache": summary["cache"],
+    "built_in_run": led.total - start,
+}}))
+"""
+
+
+class TestPrewarmThenRun:
+    def test_warm_run_records_zero_net_compiles(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-c", _PREWARM_CHILD.format(
+                repo=REPO, cache=str(tmp_path / "cc"))],
+            capture_output=True, text=True, timeout=420)
+        assert out.returncode == 0, out.stderr[-2000:]
+        got = json.loads(out.stdout.strip().splitlines()[-1])
+        assert got["mesh"] == [N_DEV]
+        assert got["prewarm_compiled"] == 1
+        assert got["cache"]["enabled"] and got["cache"]["misses"] >= 1
+        # The run re-traces and LOADS the prewarmed executable from the
+        # persistent cache — backend-compile events net of cache hits
+        # must be exactly zero (analysis/guards.CompileLedger.total).
+        assert got["built_in_run"] == 0
+
+
+@pytest.mark.slow
+class TestPrewarmCli:
+    def test_prewarm_subcommand_emits_json_summary(self, tmp_path):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-m", "consul_tpu.cli", "prewarm",
+             "--n", "64", "--kinds", "swim", "--chunks", "8",
+             "--devices", "2",
+             "--compile-cache", str(tmp_path / "cc")],
+            capture_output=True, text=True, timeout=420, env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        summary = json.loads(out.stdout.strip().splitlines()[-1])
+        # metrics on/off for one (n, kind, chunk, mesh) signature.
+        assert summary["compiled"] == 2
+        assert [s["mesh"] for s in summary["signatures"]] == [[2], [2]]
+        assert summary["cache"]["misses"] >= 1
+        assert os.listdir(tmp_path / "cc")
