@@ -266,6 +266,49 @@ def child(platform: str, deadline: float):
     finally:
         sim = None  # free the headline sim before the serf build below
 
+    # Memory-budget provenance (runtime/membudget.py): at-rest bytes
+    # per node for each state layout x kind, the packed compaction
+    # factor vs the dense f32/i32 baseline, and the largest population
+    # one chip could hold resident per layout under its reported
+    # budget. Sizing is pure eval_shape arithmetic (zero allocation);
+    # the per-device peak HBM readings are guarded like the setup
+    # phase's — the CPU backend may report nothing.
+    try:
+        from consul_tpu.runtime import membudget
+
+        cfg_mem = SimConfig(n=n, view_degree=min(view_degree, n - 2))
+        layouts = {}
+        for lay in ("dense", "packed"):
+            per_kind = {}
+            for mkind in membudget.KINDS:
+                mp = membudget.plan(cfg_mem, mkind, layout=lay)
+                per_kind[mkind] = {
+                    "bytes_per_node": round(mp.state_bytes_per_node, 2),
+                    "dense_f32i32_bytes_per_node": round(
+                        mp.dense_f32i32_bytes_per_node, 2),
+                    "packed_cut": round(mp.packed_cut, 3),
+                    "max_n_per_chip": int(mp.max_n_resident),
+                    "streamed_at_bench_n": bool(mp.streamed),
+                    "cohort_n": int(mp.cohort_n),
+                }
+            layouts[lay] = per_kind
+        peaks = []
+        for d in jax.local_devices():
+            try:
+                ms = d.memory_stats() or {}
+                peaks.append({
+                    "device": str(d),
+                    "bytes_in_use": int(ms.get("bytes_in_use", 0)),
+                    "peak_bytes_in_use": int(ms.get("peak_bytes_in_use", 0)),
+                    "bytes_limit": int(ms.get("bytes_limit", 0)),
+                })
+            except Exception:
+                peaks.append({"device": str(d), "memory_stats": None})
+        _emit({"phase": "memory", "n": n, "view_degree": view_degree,
+               "layouts": layouts, "device_peaks": peaks})
+    except Exception as e:
+        _emit({"phase": "error", "where": "memory", "error": repr(e)[:500]})
+
     # Chaos SLO probe: a short partition-heal scenario through the
     # compiled fault-schedule plane (consul_tpu/chaos) on a small
     # dedicated sim — the fault masks enter the jitted scan as a
@@ -899,6 +942,10 @@ def _maybe_replay(result):
         return result
     merged = dict(saved)
     merged["replayed_from"] = os.path.basename(path)
+    # Honesty marker for downstream consumers: every replayed headline
+    # is stale by construction — measured earlier in the session, not
+    # at round end — and must never be read as a live observation.
+    merged["stale"] = True
     if when is not None:
         merged["replay_recorded_at"] = round(when, 1)
         merged["replay_age_s"] = round(max(0.0, time.time() - when), 1)
@@ -1096,6 +1143,13 @@ def main():
         "elasticity": next(
             (p for p in primary["phases"]
              if p.get("phase") == "elasticity"), None),
+        # MemoryBudget provenance (runtime/membudget.py): per-layout x
+        # kind bytes/node, the packed compaction factor vs the dense
+        # f32/i32 baseline, max-n-per-chip, and per-device peak HBM.
+        # Stable key for downstream BENCH json consumers.
+        "memory": next(
+            (p for p in primary["phases"]
+             if p.get("phase") == "memory"), None),
         # Serving-plane read throughput (consul_tpu/serving): batched
         # NearestN straight from the simulation tensors —
         # queries_per_sec_per_chip, p50/p99 batch latency, padding

@@ -49,6 +49,12 @@ RULES = {
              "pays a reshard or fails the multi-chip parity contract; "
              "place node-axis data with NamedSharding(mesh, "
              "node_spec(...)) (parallel/shard_step.place)",
+    "TH111": "hand-widened packed state field in traced code — an "
+             ".astype(<wide dtype>) reaching directly into a packed "
+             "StateLayout field (meta/flags/view_inc/susp_delta/"
+             "*_delta) bypasses the one codec (models/layout.unpack) "
+             "and silently drops its sentinels, tick anchors, and fp8 "
+             "scale; unpack the whole state instead",
 }
 
 # TH101: int()/float()/bool() arguments considered static (config
@@ -93,6 +99,23 @@ _MESH_CTORS = frozenset({"elastic_mesh", "make_mesh", "default_mesh"})
 # TH110: the jnp constructors that materialize host data on a device
 # with no way to say where (asarray/array take no sharding argument).
 _UNSHARDED_CTORS = frozenset({"jax.numpy.asarray", "jax.numpy.array"})
+
+# TH111: fields that exist ONLY on the packed StateLayout
+# (models/layout.py PackedSimState) — touching one means the code is
+# holding a packed state. The packed encoding is a codec, not just
+# narrow dtypes: susp_delta/next_probe_delta/pending_fail_delta are
+# tick-anchored with saturation sentinels, meta is a bitfield, and the
+# latency lanes carry an fp8 scale. A hand-spelled widening cast
+# reproduces none of that.
+_PACKED_ONLY_FIELDS = frozenset({
+    "flags", "meta", "view_inc", "susp_delta", "next_probe_delta",
+    "pending_fail_delta",
+})
+
+# TH111: the wide dtypes a hand-widening cast lands on.
+_WIDE_DTYPES = frozenset({
+    "int32", "int64", "uint32", "uint64", "float32", "float64",
+})
 
 
 def run_rules(mod, traced_ids) -> list:
@@ -265,6 +288,7 @@ class _RuleVisitor(ast.NodeVisitor):
             self._rule_th101(node, fq)
             self._rule_th102(node, fq)
             self._rule_th109(node)
+            self._rule_th111(node)
         elif self._in_mesh_scope():
             self._rule_th110(node, fq)
         if self.mod.device_tier:
@@ -382,6 +406,49 @@ class _RuleVisitor(ast.NodeVisitor):
                 "default device and every sharded consumer pays a "
                 "reshard; build host-side (numpy) and place via "
                 "parallel/shard_step.place")
+
+    def _rule_th111(self, node):
+        """``<expr over a packed-only field>.astype(<wide dtype>)``
+        inside traced code. The packed StateLayout (models/layout.py)
+        is a codec: its delta fields are tick-anchored with saturation
+        sentinels (susp_delta 65535 = no suspicion), ``meta`` is a
+        status/tx/perm bitfield, and the fp8 lanes carry a x256 scale.
+        A widening cast spelled at a use site reproduces none of that
+        — it decodes the representation without the codec, which reads
+        plausibly and corrupts silently (a suspicion that never
+        expires, a deadline off by the tick anchor). The one sanctioned
+        decode path is ``models/layout.unpack``; its own widening
+        casts are the codec and are allowlisted by symbol."""
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "astype"
+                and node.args):
+            return
+        target = self._dtype_of(node.args[0])
+        if target not in _WIDE_DTYPES:
+            return
+        field = next(
+            (x.attr for x in ast.walk(f.value)
+             if isinstance(x, ast.Attribute)
+             and x.attr in _PACKED_ONLY_FIELDS), None)
+        if field is None:
+            return
+        self._emit(
+            "TH111", node,
+            f"packed state field {field!r} hand-widened with "
+            f".astype({ast.unparse(node.args[0])}) — the packed layout "
+            "is a codec (sentinels, tick anchors, fp8 scale); decode "
+            "through models/layout.unpack instead")
+
+    def _dtype_of(self, node):
+        """Best-effort dtype name of an ``astype`` argument: the tail
+        of a resolved dotted path (jnp.int32 -> 'int32') or a string
+        literal ('int32'). None for anything opaque."""
+        fq = self.mod.resolve(node, None)
+        if fq:
+            return fq.rsplit(".", 1)[-1]
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        return None
 
     # -- TH108: unbounded host retry loops ------------------------------
     def visit_While(self, node):

@@ -718,9 +718,42 @@ def _mesh_from_args(args, n: int):
         n_dc=getattr(args, "n_dc", 1) or 1)
 
 
+def _plan_from_args(args, cfg, kind: str, mesh):
+    """MemoryBudget resolution for the local-run subcommands. Returns
+    None when the legacy dense path applies (no --layout/--budget
+    given) so runs that never asked for planning are byte-identical to
+    before the planner existed. A population that exceeds the
+    per-device budget over the mesh replans single-device — that is
+    the cohort-streamed regime (models/cluster.StreamedSimulation)."""
+    layout = getattr(args, "layout", None) or "dense"
+    budget = getattr(args, "budget", None)
+    if layout == "dense" and budget is None:
+        return None
+    from consul_tpu.runtime import membudget
+
+    chunk = getattr(args, "chunk", None)
+    try:
+        return membudget.plan(cfg, kind, layout=layout,
+                              budget=budget or "auto", mesh=mesh,
+                              chunk=chunk)
+    except ValueError:
+        if mesh is None or getattr(mesh, "size", 1) <= 1:
+            raise
+        return membudget.plan(cfg, kind, layout=layout,
+                              budget=budget or "auto", mesh=None,
+                              chunk=chunk)
+
+
 def _build_sim(args):
+    """Build the simulation a local-run subcommand drives, honoring the
+    MemoryBudget plan when --layout/--budget ask for one. Returns
+    ``(sim, plan)``; ``plan`` is None on the legacy dense path, and
+    ``plan.streamed`` means ``sim`` is a StreamedSimulation (cohorts
+    through one device, no mesh/sentinel/serving)."""
     from consul_tpu.config import SimConfig
-    from consul_tpu.models.cluster import SerfSimulation, Simulation
+    from consul_tpu.models.cluster import (SerfSimulation, Simulation,
+                                           StreamedSerfSimulation,
+                                           StreamedSimulation)
     from consul_tpu.utils import compile_cache
 
     if getattr(args, "compile_cache", None):
@@ -728,15 +761,24 @@ def _build_sim(args):
     else:
         compile_cache.maybe_enable_from_env()
     cfg = SimConfig(n=args.n, view_degree=min(args.view_degree, args.n - 2))
+    kind = "serf" if args.serf else "swim"
+    mesh = _mesh_from_args(args, args.n)
+    plan = _plan_from_args(args, cfg, kind, mesh)
+    if plan is not None and plan.streamed:
+        scls = StreamedSerfSimulation if args.serf else StreamedSimulation
+        sim = scls(cfg, cohort_n=plan.cohort_n, seed=args.seed,
+                   layout=plan.layout, chunk=plan.chunk)
+        return sim, plan
     cls = SerfSimulation if args.serf else Simulation
-    sim = cls(cfg, seed=args.seed, mesh=_mesh_from_args(args, args.n))
+    sim = cls(cfg, seed=args.seed, mesh=mesh,
+              layout=plan.layout if plan else "dense")
     if getattr(args, "prewarm", False):
         from consul_tpu.utils import prewarm as prewarm_mod
 
         chunk = getattr(args, "chunk", 32)
         for with_metrics in (False, True):
             prewarm_mod.prewarm_simulation(sim, chunk, with_metrics)
-    return sim
+    return sim, plan
 
 
 def _ckpt_policy(args, sim, default_tag: str):
@@ -853,19 +895,51 @@ def cmd_chaos(args) -> int:
         events = [chaos_mod.Partition(
             start=4, stop=16, side_a=frac_nodes(0.3))]
 
-    sim = _build_sim(args)
-    sim.run(args.form_ticks, chunk=args.chunk, with_metrics=False)
+    sim, plan = _build_sim(args)
     ticks = max(int(e.stop) for e in events) + args.settle
-    return _run_resilient_cmd(args, sim, events, ticks, {"n": n})
+    extra = {"n": n}
+    if plan is not None:
+        extra["memory_plan"] = plan.to_dict()
+    if plan is not None and plan.streamed:
+        # Beyond-budget population: form, then replay the schedule
+        # inside every cohort (shifted past formation — the streamed
+        # driver has no harness to rebase it). The resilient-harness
+        # knobs (checkpoint/sentinel) don't apply to this path.
+        import dataclasses as _dc
+
+        sim.run(args.form_ticks)
+        sim.set_chaos([_dc.replace(e, start=e.start + args.form_ticks,
+                                   stop=e.stop + args.form_ticks)
+                       for e in events])
+        summary = sim.run(ticks)
+        print(json.dumps(dict(extra, **summary, streamed=True,
+                              counters=sim.counters_snapshot())))
+        return 0
+    sim.run(args.form_ticks, chunk=args.chunk, with_metrics=False)
+    return _run_resilient_cmd(args, sim, events, ticks, extra)
 
 
 def cmd_run(args) -> int:
     """Advance a plain local simulation under the resilient harness
     (no fault schedule — ``chaos`` is the faulted variant) and print
     the run report as one JSON line. The kill -9 / resume quickstart in
-    the README drives this subcommand."""
-    sim = _build_sim(args)
-    return _run_resilient_cmd(args, sim, None, args.ticks, {"n": args.n})
+    the README drives this subcommand.
+
+    With ``--layout``/``--budget`` the MemoryBudget planner
+    (runtime/membudget.py) picks the state layout and chunk; a
+    population beyond the per-device budget runs cohort-streamed
+    (models/cluster.StreamedSimulation) and the JSON carries
+    ``streamed: true`` plus the plan under ``memory_plan``."""
+    sim, plan = _build_sim(args)
+    extra = {"n": args.n}
+    if plan is not None:
+        extra["memory_plan"] = plan.to_dict()
+    if plan is not None and plan.streamed:
+        summary = sim.run(args.ticks)
+        print(json.dumps(dict(extra, **summary, streamed=True,
+                              counters=sim.counters_snapshot())))
+        return 0
+    return _run_resilient_cmd(args, sim, None, args.ticks, extra)
 
 
 def cmd_prewarm(args) -> int:
@@ -900,6 +974,7 @@ def cmd_prewarm(args) -> int:
         mesh=mesh, device_count=args.devices, n_dc=args.n_dc,
         chaos=args.chaos, seed=args.seed, view_degree=args.view_degree,
         sentinel=args.sentinel, cache_dir=args.compile_cache,
+        layout=args.layout,
     )
     print(json.dumps(summary))
     return 0
@@ -915,7 +990,7 @@ def cmd_serve_bench(args) -> int:
     import random as _random
     import time as _time
 
-    sim = _build_sim(args)
+    sim, _ = _build_sim(args)
     sim.run(args.form_ticks, chunk=args.chunk, with_metrics=False)
 
     from consul_tpu.serving import MODE_NEAREST, ServingPlane
@@ -1015,6 +1090,22 @@ def build_parser() -> argparse.ArgumentParser:
                              " a second cold process deserializes "
                              "executables instead of recompiling")
 
+    def add_layout_flags(sp):
+        # MemoryBudget planner knobs (runtime/membudget.py): the state
+        # layout and the per-device byte budget that together decide
+        # resident-vs-streamed and dense-vs-packed.
+        sp.add_argument("--layout", choices=("auto", "dense", "packed"),
+                        default="dense",
+                        help="per-node state layout: dense f32/i32 "
+                             "(golden reference, default), packed "
+                             "(2.5x smaller at rest), or auto (planner "
+                             "picks per the memory budget)")
+        sp.add_argument("--budget", default=None, metavar="BYTES",
+                        help="per-device memory budget ('auto' probes "
+                             "the device, or e.g. '2GB'/'512MiB'); "
+                             "populations beyond it stream as node "
+                             "cohorts through one device")
+
     def add_mesh_flags(sp):
         # Multi-chip placement knobs: by default the local-run
         # subcommands run over the largest elastic mesh the visible
@@ -1042,6 +1133,7 @@ def build_parser() -> argparse.ArgumentParser:
                     help="run the full serf step (event/query plane)")
     add_resilience_flags(rn)
     add_mesh_flags(rn)
+    add_layout_flags(rn)
 
     sv = sub.add_parser(
         "serve-bench",
@@ -1087,6 +1179,7 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="START,STOP,FRAC,TX[,RX]")
     add_resilience_flags(ch)
     add_mesh_flags(ch)
+    add_layout_flags(ch)
 
     pw = sub.add_parser(
         "prewarm",
@@ -1115,6 +1208,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="must match the run being warmed (topology "
                          "constants are part of the program identity)")
     pw.add_argument("--view-degree", type=int, default=16)
+    pw.add_argument("--layout", choices=("dense", "packed"),
+                    default="dense",
+                    help="state layout the warmed programs bind "
+                         "(part of the program identity)")
     pw.add_argument("--compile-cache", default=None, metavar="DIR",
                     help="persistent cache directory (or "
                          "CONSUL_TPU_COMPILE_CACHE)")

@@ -22,6 +22,7 @@ import numpy as np
 from consul_tpu.chaos import schedule as chaos_mod
 from consul_tpu.config import SimConfig
 from consul_tpu.models import counters as counters_mod
+from consul_tpu.models import layout as layout_mod
 from consul_tpu.models import serf as serf_mod
 from consul_tpu.models import state as sim_state
 from consul_tpu.models import swim
@@ -108,7 +109,8 @@ class SentinelViolation(RuntimeError):
 
 def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
                   step_fn=swim.step_counted, swim_of=lambda st: st,
-                  chaos_key=None, sentinel: bool = False, mesh=None):
+                  chaos_key=None, sentinel: bool = False, mesh=None,
+                  layout: str = layout_mod.DENSE):
     """One compiled chunk program. ``step_fn`` is the per-tick counted
     step (bare SWIM or the full serf stack) returning
     (state, GossipCounters); ``swim_of`` projects the SWIM plane out of
@@ -140,9 +142,16 @@ def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
     shape AND device ids (parallel/mesh.mesh_key) — joins the memo key,
     so an elastic 8->4 reshard can never reuse the stale 8-device
     executable; each surviving-mesh shape compiles (or persistent-cache
-    loads) exactly one program."""
+    loads) exactly one program.
+
+    ``layout`` selects the at-rest state encoding (models/layout.py):
+    ``"packed"`` carries the compact PackedSimState through the scan —
+    the body unpacks to the dense working set, steps, and re-packs, so
+    the resident footprint (and the donated carry) is the 2.5x-smaller
+    packed form while the step math is unchanged. The dense program is
+    byte-for-byte the pre-layout one (the compile-count pin)."""
     memo = (cfg, _topo_key(topo), chunk, with_metrics, step_fn, swim_of,
-            chaos_key, sentinel, pmesh.mesh_key(mesh))
+            chaos_key, sentinel, pmesh.mesh_key(mesh), layout)
     hit = _RUNNER_CACHE.get(memo)
     if hit is not None:
         return hit
@@ -153,24 +162,29 @@ def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
         jitted = shard_step.make_sharded_chunk_runner(
             cfg, topo, mesh, chunk, with_metrics,
             step_fn=step_fn, swim_of=swim_of,
-            chaos=chaos_key is not None, sentinel=sentinel,
+            chaos=chaos_key is not None, sentinel=sentinel, layout=layout,
         )
         _RUNNER_CACHE[memo] = jitted
         return jitted
 
+    packed = layout == layout_mod.PACKED
+
     def body(world, sched, carry, tick_key):
         state, cnt = carry
+        if packed:
+            state = layout_mod.unpack_state(state)
         state, c = step_fn(cfg, topo, world, state, tick_key, sched,
                            sentinel=sentinel)
         cnt = counters_mod.add(cnt, c)
+        out = layout_mod.pack_state(state) if packed else state
         if not with_metrics:
-            return (state, cnt), ()
+            return (out, cnt), ()
         sw = swim_of(state)
         h = metrics.health(cfg, topo, sw)
         rmse = metrics.vivaldi_rmse(
             cfg, world, sw, jax.random.fold_in(tick_key, 1), samples=2048
         )
-        return (state, cnt), TickTrace(
+        return (out, cnt), TickTrace(
             h.agreement, h.false_positive, h.undetected, rmse)
 
     def run(world, sched, state, base_key):
@@ -204,6 +218,11 @@ class Simulation:
     # live sharded over the node axis. None is the single-device
     # program today's compile-ledger pins count.
     mesh: Optional[object] = None
+    # At-rest state encoding (models/layout.py): "dense" is the f32/i32
+    # golden-parity reference, "packed" the 2.5x-compacted form that
+    # buys the beyond-HBM tier. Chosen per run (the MemoryBudget
+    # planner picks it for the CLI); joins the runner memo key.
+    layout: str = layout_mod.DENSE
 
     # Driver hooks (SerfSimulation overrides these two).
     _step_fn = staticmethod(swim.step_counted)
@@ -213,11 +232,14 @@ class Simulation:
         return sim_state.init(self.cfg, key)
 
     def __post_init__(self):
+        layout_mod.validate(self.cfg, self.layout)
         key = jax.random.PRNGKey(self.seed)
         kw, kn, ks, kb = jax.random.split(key, 4)
         self.world = topology.make_world(self.cfg, kw)
         self.topo = topology.make_topology(self.cfg, kn)
         self.state = self._init_state(ks)
+        if self.layout == layout_mod.PACKED:
+            self.state = layout_mod.pack_state(self.state)
         self.base_key = kb
         self._runners = {}
         self._warmed: set = set()
@@ -291,14 +313,34 @@ class Simulation:
         if self.serving is not None:
             self.serving.publish(self)
 
+    # -- layout plumbing ------------------------------------------------
+    def _to_dense(self):
+        """The driver state with a dense SWIM plane (identity when the
+        layout already is). Host-side verbs (fault injection, serf
+        intents) edit the dense form and hand back via _from_dense —
+        one unpack/pack pair per verb, never inside the scan."""
+        return layout_mod.unpack_state(self.state)
+
+    def _from_dense(self, st):
+        if self.layout == layout_mod.PACKED:
+            st = layout_mod.pack_state(st)
+        self.state = st
+
+    def _tick(self) -> int:
+        """Current tick as a host int — reads the one scalar ``t`` leaf
+        directly off the (possibly packed) state, so it never
+        materializes a dense copy of a big population."""
+        return int(jax.device_get(layout_mod.tick_of(self.state)))
+
     # -- fault injection ------------------------------------------------
     def kill(self, mask):
-        self.state = sim_state.kill(self.state, self._place_node(mask))
+        self._from_dense(
+            sim_state.kill(self._to_dense(), self._place_node(mask)))
         self.publish_serving()
 
     def revive(self, mask):
-        self.state = sim_state.revive(
-            self.cfg, self.state, self._place_node(mask))
+        self._from_dense(sim_state.revive(
+            self.cfg, self._to_dense(), self._place_node(mask)))
         self.publish_serving()
 
     def set_chaos(self, sched):
@@ -346,7 +388,7 @@ class Simulation:
         self.sink.incr_counter("sim.sentinel.trips", 1)
         dump = None
         if self.sentinel_dump_dir:
-            t_now = int(jax.device_get(self.swim_state.t))
+            t_now = self._tick()
             dump = os.path.join(
                 self.sentinel_dump_dir, f"sentinel_diag_t{t_now}.ckpt")
             try:
@@ -381,7 +423,7 @@ class Simulation:
         if ticks is None:
             stops = [int(e.stop) for e in events]
             ticks = (max(stops) if stops else 0) + settle
-        t0 = int(jax.device_get(self.swim_state.t))
+        t0 = self._tick()
         prev = self.chaos
         self.set_chaos(chaos_mod.shift_schedule(sched, t0))
         before = dict(self.counters)
@@ -405,7 +447,7 @@ class Simulation:
                 self.cfg, self.topo, chunk, with_metrics,
                 step_fn=type(self)._step_fn, swim_of=type(self)._swim_of,
                 chaos_key=chaos_mod.static_key_of(self.chaos),
-                sentinel=self.sentinel, mesh=self.mesh,
+                sentinel=self.sentinel, mesh=self.mesh, layout=self.layout,
             )
 
             def bound(state, base_key, _j=jitted, _w=self.world,
@@ -571,11 +613,11 @@ class Simulation:
         runner = self._runner(ticks, False)
         self.state, cnt, _ = runner(self.state, self.base_key)
         self._pending_counters.append(cnt)
-        jax.block_until_ready(self.swim_state.view_key)
+        jax.block_until_ready(jax.tree.leaves(self.state))
         t0 = time.perf_counter()
         self.state, cnt, _ = runner(self.state, self.base_key)
         self._pending_counters.append(cnt)
-        jax.block_until_ready(self.swim_state.view_key)
+        jax.block_until_ready(jax.tree.leaves(self.state))
         dt = time.perf_counter() - t0
         self.publish_serving()
         return ticks / dt
@@ -593,9 +635,11 @@ class Simulation:
     # driver runs bare SWIM or the full serf stack) --------------------
     @property
     def swim_state(self) -> sim_state.SimState:
-        return self.state
+        return layout_mod.swim_plane(self.state)
 
     def set_swim_state(self, st: sim_state.SimState):
+        if self.layout == layout_mod.PACKED:
+            st = layout_mod.pack(st)
         self.state = st
 
     @property
@@ -616,38 +660,225 @@ class SerfSimulation(Simulation):
     def _init_state(self, key):
         return serf_mod.init(self.cfg, key)
 
-    # -- serf verbs -----------------------------------------------------
+    # -- serf verbs (edit the dense SWIM plane; _from_dense re-packs) ---
     def user_event(self, mask, name: int):
-        self.state = serf_mod.user_event(self.cfg, self.state,
-                                         self._place_node(mask), name)
+        self._from_dense(serf_mod.user_event(
+            self.cfg, self._to_dense(), self._place_node(mask), name))
 
     def query(self, mask, name: int):
-        self.state = serf_mod.query(self.cfg, self.state,
-                                    self._place_node(mask), name)
+        self._from_dense(serf_mod.query(
+            self.cfg, self._to_dense(), self._place_node(mask), name))
 
     def leave(self, mask):
-        self.state = serf_mod.leave(
-            self.cfg, self.state, self._place_node(mask))
+        self._from_dense(serf_mod.leave(
+            self.cfg, self._to_dense(), self._place_node(mask)))
 
     def kill(self, mask):
-        self.state = self.state._replace(
-            swim=sim_state.kill(self.state.swim, self._place_node(mask)))
+        st = self._to_dense()
+        self._from_dense(st._replace(
+            swim=sim_state.kill(st.swim, self._place_node(mask))))
 
     def revive(self, mask):
-        self.state = self.state._replace(
-            swim=sim_state.revive(self.cfg, self.state.swim,
-                                  self._place_node(mask)))
+        st = self._to_dense()
+        self._from_dense(st._replace(
+            swim=sim_state.revive(self.cfg, st.swim,
+                                  self._place_node(mask))))
 
     @property
     def swim_state(self) -> sim_state.SimState:
-        return self.state.swim
+        return layout_mod.swim_plane(self.state)
 
     def set_swim_state(self, st: sim_state.SimState):
+        if self.layout == layout_mod.PACKED:
+            st = layout_mod.pack(st)
         self.state = self.state._replace(swim=st)
 
     @property
     def serf_state(self):
         return self.state
+
+
+@dataclasses.dataclass
+class StreamedSimulation:
+    """Beyond-HBM driver: the population streams through the device as
+    independent node cohorts, host<->device double-buffered.
+
+    A population too big for device memory is split into
+    ``cfg.n / cohort_n`` cohorts of ``cohort_n`` nodes. Each cohort is a
+    self-contained gossip island — same circulant topology (ONE set of
+    trace-time roll constants, therefore ONE compiled executable for
+    every cohort: the compile-ledger pin across cohort flips), its own
+    world placement and PRNG stream — modeling a federation of
+    same-shaped DCs rather than one flat gossip domain (the documented
+    divergence; consul federates WAN pools the same way instead of
+    running one planet-wide SWIM domain). At rest cohorts live in host
+    RAM as (packed) numpy archives; the device holds at most two: the
+    one computing and the one being staged.
+
+    The streaming schedule is cohorts-OUTER, chunks-inner — each cohort
+    runs all its ticks in one residency, so a full pass costs exactly C
+    host->device uploads and C downloads regardless of tick count. The
+    double buffer is JAX's async dispatch: cohort i+1's ``device_put``
+    is issued *before* the blocking ``device_get`` on cohort i's result,
+    so the upload overlaps the drain (the 2112.09017 out-of-core
+    pattern). The per-cohort archive round-trips through the SAME
+    chunk-runner seam every other driver uses — the MemoryBudget
+    planner (runtime/membudget.py) only picks ``cohort_n``, ``chunk``
+    and the layout; nothing about the step changes.
+
+    Scope: single-device execution per cohort (a mesh shards *within* a
+    resident population — combine by pointing ``mesh`` runs at the
+    resident tier instead), no serving plane, no sentinel. Chaos
+    schedules are supported compiled at cohort shape and applied to
+    every cohort identically.
+    """
+
+    cfg: SimConfig            # the FULL population: cfg.n = total nodes
+    cohort_n: int             # resident nodes per cohort (divides cfg.n)
+    seed: int = 0
+    layout: str = layout_mod.PACKED
+    chunk: int = 64           # scan length per compiled program
+
+    _step_fn = staticmethod(swim.step_counted)
+    _swim_of = staticmethod(lambda st: st)
+
+    def _init_state(self, cfg, key):
+        return sim_state.init(cfg, key)
+
+    def __post_init__(self):
+        if self.cfg.n % self.cohort_n != 0:
+            raise ValueError(
+                f"cohort_n={self.cohort_n} must divide n={self.cfg.n}")
+        if not self.cfg.view_degree:
+            raise ValueError(
+                "streamed cohorts need the sparse view (view_degree>0): "
+                "dense mode's topology is population-shaped")
+        self.cohorts = self.cfg.n // self.cohort_n
+        self.cohort_cfg = dataclasses.replace(self.cfg, n=self.cohort_n)
+        layout_mod.validate(self.cohort_cfg, self.layout)
+        key = jax.random.PRNGKey(self.seed)
+        self._kw, kn, self._ks, self._kb = jax.random.split(key, 4)
+        # ONE topology: every cohort shares the same roll constants,
+        # so every cohort hits the same executable.
+        self.topo = topology.make_topology(self.cohort_cfg, kn)
+        self.chaos = None
+        self._counters = {f: 0 for f in counters_mod.FIELDS}
+        self.sink = telemetry.Sink()
+        # Host archives: one (packed) state pytree of numpy leaves per
+        # cohort. Worlds are NOT archived — they regenerate from the
+        # per-cohort key at swap-in (deterministic, cheaper than RAM).
+        self._archive = [None] * self.cohorts
+        for i in range(self.cohorts):
+            st = self._init_state(
+                self.cohort_cfg, jax.random.fold_in(self._ks, i))
+            if self.layout == layout_mod.PACKED:
+                st = layout_mod.pack_state(st)
+            self._archive[i] = jax.device_get(st)
+
+    # -- cohort staging -------------------------------------------------
+    def _world_of(self, i: int):
+        return topology.make_world(
+            self.cohort_cfg, jax.random.fold_in(self._kw, i))
+
+    def _stage(self, i: int):
+        """Upload cohort i (async dispatch — returns immediately)."""
+        return self._world_of(i), jax.device_put(self._archive[i])
+
+    def _cohort_key(self, i: int):
+        return jax.random.fold_in(self._kb, i)
+
+    def set_chaos(self, events):
+        """Install a fault schedule, compiled at cohort shape and
+        replayed identically inside every cohort (None clears)."""
+        sched = events
+        if sched is not None and not isinstance(sched,
+                                                chaos_mod.ChaosSchedule):
+            sched = chaos_mod.compile_schedule(self.cohort_n, sched)
+        if sched is not None and chaos_mod.is_empty(sched):
+            sched = None
+        self.chaos = sched
+
+    def _runner(self, chunk: int):
+        return _chunk_runner(
+            self.cohort_cfg, self.topo, chunk, False,
+            step_fn=type(self)._step_fn, swim_of=type(self)._swim_of,
+            chaos_key=chaos_mod.static_key_of(self.chaos),
+            sentinel=False, mesh=None, layout=self.layout,
+        )
+
+    # -- execution ------------------------------------------------------
+    def run(self, ticks: int):
+        """Advance every cohort by ``ticks`` ticks (one full streaming
+        pass). Returns a summary dict; counters fold into
+        :attr:`counters` summed across cohorts."""
+        t0 = time.perf_counter()
+        staged = self._stage(0)
+        for i in range(self.cohorts):
+            world, state = staged
+            cnts = []
+            remaining = ticks
+            while remaining > 0:
+                c = min(self.chunk, remaining)
+                state, cnt, _ = self._runner(c)(
+                    world, self.chaos, state, self._cohort_key(i))
+                cnts.append(counters_mod.stack(cnt))
+                remaining -= c
+            if i + 1 < self.cohorts:
+                # Double buffer: issue the next upload before blocking
+                # on this cohort's drain.
+                staged = self._stage(i + 1)
+            host_state, host_cnts = jax.device_get((state, cnts))
+            self._archive[i] = host_state
+            vals = np.sum(np.stack(host_cnts), axis=0)
+            for f, v in zip(counters_mod.FIELDS, vals):
+                self._counters[f] += int(v)
+        wall_s = time.perf_counter() - t0
+        self.sink.incr_counter("sim.stream.passes", 1)
+        return {
+            "cohorts": self.cohorts,
+            "cohort_n": self.cohort_n,
+            "n": self.cfg.n,
+            "ticks": ticks,
+            "layout": self.layout,
+            "wall_s": wall_s,
+        }
+
+    # -- inspection -----------------------------------------------------
+    @property
+    def counters(self):
+        return self._counters
+
+    def counters_snapshot(self):
+        return dict(self._counters)
+
+    def _tick(self) -> int:
+        """All cohorts advance in lockstep; read cohort 0's clock."""
+        return int(layout_mod.tick_of(self._archive[0]))
+
+    def cohort_swim_state(self, i: int) -> sim_state.SimState:
+        """Cohort i's SWIM plane, dense, as host arrays (inspection)."""
+        return layout_mod.swim_plane(self._archive[i])
+
+    def resident_bytes(self) -> int:
+        """Peak device bytes the streaming schedule holds: two cohort
+        states (double buffer) plus one world."""
+        state_b = sum(layout_mod.np_size_bytes(l)
+                      for l in jax.tree.leaves(self._archive[0]))
+        world = jax.eval_shape(lambda: self._world_of(0))
+        world_b = sum(layout_mod.np_size_bytes(l)
+                      for l in jax.tree.leaves(world))
+        return 2 * state_b + world_b
+
+
+@dataclasses.dataclass
+class StreamedSerfSimulation(StreamedSimulation):
+    """Streamed cohorts over the full serf stack (fused core)."""
+
+    _step_fn = staticmethod(serf_mod.step_counted)
+    _swim_of = staticmethod(lambda st: st.swim)
+
+    def _init_state(self, cfg, key):
+        return serf_mod.init(cfg, key)
 
 
 @dataclasses.dataclass

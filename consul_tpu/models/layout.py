@@ -1,0 +1,331 @@
+"""StateLayout: packed per-node state for beyond-HBM populations.
+
+The dense ``SimState`` spends 4 bytes on every per-node element (189
+elements = 756 B/node at the default K=16 view), which caps a chip at
+~16M nodes. This module defines a packed twin — ``PackedSimState`` —
+that stores the same information in 296 B/node (2.55x smaller) and the
+``pack``/``unpack`` bijection between them. The dense f32/i32 path
+remains the golden-parity reference (tests/test_layout_parity.py), the
+same contract ``step_reference`` carries for the fused serf core.
+
+Encoding rules, with the invariants that make round-trips exact:
+
+* **Discrete plane — bit-exact.** Every integer field is narrowed to
+  the width its protocol bound needs: statuses are 2 bits, gossip
+  retransmit budgets 6 bits (``retransmit_mult * log10`` stays < 64 up
+  to 10^15 nodes), probe-permutation columns and probe cursors 8 bits
+  (requires K <= 255), incarnations 16 bits (saturating; a simulated
+  node refutes a handful of times, never 65k). Unpack(pack(x)) == x
+  whenever the bounds hold, so the SWIM plane is *bit-identical* to the
+  dense reference — asserted, not hoped, by the parity suite.
+
+* **Tick-anchored deadlines become saturating i16/u16 deltas.**
+  ``next_probe_tick``/``pending_fail_tick`` are stored relative to the
+  current tick (live values span at most one awareness-scaled probe
+  interval); ``susp_start`` as age-since with a u16 sentinel for
+  "none". A *frozen* deadline on a dead node drifts past the i16 range
+  and saturates — behaviorally identical because both the packed and
+  dense step only ever compare ``t >= deadline``, and a saturated past
+  deadline is still past. ``pending_fail_tick`` is additionally
+  canonicalized to ``t`` every tick while no probe is outstanding
+  (models/swim.py step tail) so the delta of every *live* window fits
+  exactly.
+
+* **Vivaldi floats in bf16 at rest, f32 in flight.** Coordinates,
+  heights, errors and adjustments round to bfloat16 between ticks; the
+  step computes in f32 as before (unpack widens). bf16's ~0.4% relative
+  rounding sits an order of magnitude below the 5% RTT jitter the world
+  model injects, so convergence is not degraded — the parity suite
+  asserts the packed path's final RMSE matches the dense reference's
+  within tolerance rather than trusting this argument.
+
+* **RTT sample windows in scaled float8.** ``lat_buf``/``adj_samples``
+  hold RTT-magnitude seconds; stored as ``float8_e4m3fn`` scaled by
+  256 (a power of two, so the scaling itself is exact). Range: +-1.75 s
+  saturating (beyond the chaos Degrade envelope; the Vivaldi gate
+  rejects >10 s observations anyway), resolution floor 2^-9/256 ~ 7.6us
+  against millisecond-scale RTTs.
+
+Documented bounds (validate() enforces the static ones): K <= 255,
+retransmit limit <= 63, awareness_max <= 256, probe interval <= 32767
+ticks, adjustment window <= 255; saturation beyond incarnation 65535,
+suspicion age 65534 ticks, or 65535 latency samples per peer
+(~5.2M ticks at the probe cadence) is accepted and documented rather
+than guarded — all far outside simulated regimes.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.config import SimConfig
+from consul_tpu.ops import merge, vivaldi
+
+DENSE = "dense"
+PACKED = "packed"
+LAYOUTS = (DENSE, PACKED)
+
+# float8_e4m3fn codec for RTT-scale seconds: scale by 2^8 (exact), clip
+# to the format's finite range. max finite e4m3fn = 448 -> +-1.75 s.
+_F8 = jnp.float8_e4m3fn
+_F8_SCALE = 256.0
+_F8_CLIP = 448.0 / _F8_SCALE
+
+# Sentinels for "none" in narrowed fields.
+_NO_COL = 255        # pending_col == -1
+_NO_SUSP = 65535     # susp_start == -1
+_SUSP_MAX = 65534    # saturation for live suspicion ages
+
+# meta[N, K] bit layout: status(2) | tx_left(6) | probe_perm(8).
+_META_STATUS_BITS = 2
+_META_TX_BITS = 6
+_META_TX_MAX = (1 << _META_TX_BITS) - 1
+
+
+def _to_f8(x):
+    """f32 seconds -> scaled float8 (saturating)."""
+    return (jnp.clip(x, -_F8_CLIP, _F8_CLIP) * _F8_SCALE).astype(_F8)
+
+
+def _from_f8(x):
+    """Scaled float8 -> f32 seconds (exact: power-of-two scale)."""
+    return x.astype(jnp.float32) / _F8_SCALE
+
+
+class PackedVivaldi(NamedTuple):
+    """VivaldiState at rest: bf16 coordinates, float8 sample window."""
+
+    vec: jax.Array          # [..., D] bfloat16
+    height: jax.Array       # [...]    bfloat16
+    error: jax.Array        # [...]    bfloat16
+    adjustment: jax.Array   # [...]    bfloat16
+    adj_samples: jax.Array  # [..., W] float8_e4m3fn (x256 codec)
+    adj_idx: jax.Array      # [...]    uint8 (W <= 255)
+    resets: jax.Array       # [...]    uint8 (wraps mod 256; diagnostic)
+
+
+class PackedSimState(NamedTuple):
+    """SimState at rest, 296 B/node at K=16 (vs 756 dense f32/i32)."""
+
+    t: jax.Array            # [] int32 — the global tick stays wide; the
+                            # deltas below are anchored to it
+    flags: jax.Array        # [N] uint8: alive_truth|left<<1|leaving<<2|
+                            # external<<3
+    own_inc: jax.Array      # [N] uint16 (saturating)
+    own_tx: jax.Array       # [N] uint8 (own_limit <= max(63, K) <= 255)
+    awareness: jax.Array    # [N] uint8 (awareness_max <= 256)
+    probe_ptr: jax.Array    # [N] uint8 (K <= 255)
+    next_probe_delta: jax.Array   # [N] int16 = next_probe_tick - t (sat)
+    pending_col: jax.Array        # [N] uint8, 255 = none
+    pending_fail_delta: jax.Array  # [N] int16 = pending_fail_tick - t (sat)
+    pending_nack_miss: jax.Array   # [N] uint8 (<= indirect_checks/tick,
+                                   # cleared on window close)
+    view_inc: jax.Array     # [N, K] uint16 view incarnation (saturating)
+    meta: jax.Array         # [N, K] uint16: status(2)|tx_left(6)|perm(8)
+    susp_delta: jax.Array   # [N, K] uint16 = t - susp_start, 65535 = none
+    susp_seen: jax.Array    # [N, K] uint32 accuser bitmask (irreducible:
+                            # 32 hash buckets are the protocol)
+    lat_cnt: jax.Array      # [N, K] uint16 (saturating at 65535 samples)
+    lat_buf: jax.Array      # [N, K, S] float8_e4m3fn (x256 codec)
+    viv: PackedVivaldi      # batched [N]
+
+
+def validate(cfg: SimConfig, layout: str) -> None:
+    """Reject configs whose protocol bounds overflow the packed widths.
+    Static, host-side, and exhaustive: any config that passes here
+    round-trips the discrete plane exactly."""
+    if layout not in LAYOUTS:
+        raise ValueError(f"unknown state layout {layout!r}; "
+                         f"expected one of {LAYOUTS}")
+    if layout == DENSE:
+        return
+    from consul_tpu.ops import scaling
+
+    k_deg = cfg.degree
+    if k_deg > 255:
+        raise ValueError(
+            f"packed layout needs view degree <= 255 (8-bit probe "
+            f"columns + pending_col sentinel); got K={k_deg}")
+    tx_limit = int(scaling.retransmit_limit(cfg.gossip.retransmit_mult,
+                                            cfg.n))
+    if tx_limit > _META_TX_MAX:
+        raise ValueError(
+            f"packed layout stores tx_left in {_META_TX_BITS} bits "
+            f"(<= {_META_TX_MAX}); retransmit limit for n={cfg.n} is "
+            f"{tx_limit}")
+    if cfg.gossip.awareness_max > 256:
+        raise ValueError(
+            f"packed layout stores awareness in 8 bits; awareness_max="
+            f"{cfg.gossip.awareness_max} > 256")
+    interval_max = cfg.gossip.probe_period_ticks * cfg.gossip.awareness_max
+    if interval_max > 32767:
+        raise ValueError(
+            f"packed layout stores probe deadlines as i16 tick deltas; "
+            f"max probe interval {interval_max} overflows")
+    if cfg.vivaldi.adjustment_window_size > 255:
+        raise ValueError(
+            f"packed layout stores the adjustment-window cursor in 8 "
+            f"bits; window size {cfg.vivaldi.adjustment_window_size}")
+
+
+def pack(state) -> PackedSimState:
+    """Dense SimState -> PackedSimState (elementwise; shard_map-safe)."""
+    t = state.t
+    flags = (state.alive_truth.astype(jnp.uint8)
+             | (state.left.astype(jnp.uint8) << 1)
+             | (state.leaving.astype(jnp.uint8) << 2)
+             | (state.external.astype(jnp.uint8) << 3))
+    status = (state.view_key & (merge.N_STATUS - 1)).astype(jnp.uint16)
+    tx = jnp.clip(state.tx_left, 0, _META_TX_MAX).astype(jnp.uint16)
+    meta = (status
+            | (tx << _META_STATUS_BITS)
+            | (state.probe_perm.astype(jnp.uint16)
+               << (_META_STATUS_BITS + _META_TX_BITS)))
+    susp_age = jnp.clip(t - state.susp_start, 0, _SUSP_MAX)
+    susp_delta = jnp.where(state.susp_start < 0, _NO_SUSP,
+                           susp_age).astype(jnp.uint16)
+    v = state.viv
+    return PackedSimState(
+        t=t,
+        flags=flags,
+        own_inc=jnp.minimum(state.own_inc, 65535).astype(jnp.uint16),
+        own_tx=jnp.clip(state.own_tx, 0, 255).astype(jnp.uint8),
+        awareness=state.awareness.astype(jnp.uint8),
+        probe_ptr=state.probe_ptr.astype(jnp.uint8),
+        next_probe_delta=jnp.clip(
+            state.next_probe_tick - t, -32768, 32767).astype(jnp.int16),
+        pending_col=jnp.where(state.pending_col < 0, _NO_COL,
+                              state.pending_col).astype(jnp.uint8),
+        pending_fail_delta=jnp.clip(
+            state.pending_fail_tick - t, -32768, 32767).astype(jnp.int16),
+        pending_nack_miss=jnp.clip(
+            state.pending_nack_miss, 0, 255).astype(jnp.uint8),
+        view_inc=jnp.minimum(merge.key_incarnation(state.view_key),
+                             65535).astype(jnp.uint16),
+        meta=meta,
+        susp_delta=susp_delta,
+        susp_seen=state.susp_seen,
+        lat_cnt=jnp.minimum(state.lat_cnt, 65535).astype(jnp.uint16),
+        lat_buf=_to_f8(state.lat_buf),
+        viv=PackedVivaldi(
+            vec=v.vec.astype(jnp.bfloat16),
+            height=v.height.astype(jnp.bfloat16),
+            error=v.error.astype(jnp.bfloat16),
+            adjustment=v.adjustment.astype(jnp.bfloat16),
+            adj_samples=_to_f8(v.adj_samples),
+            adj_idx=v.adj_idx.astype(jnp.uint8),
+            resets=v.resets.astype(jnp.uint8),
+        ),
+    )
+
+
+def unpack(packed: PackedSimState):
+    """PackedSimState -> dense SimState the step functions consume."""
+    from consul_tpu.models import state as sim_state
+
+    t = packed.t
+    status = (packed.meta & (merge.N_STATUS - 1)).astype(jnp.uint32)
+    tx_left = ((packed.meta >> _META_STATUS_BITS)
+               & _META_TX_MAX).astype(jnp.int32)
+    perm = (packed.meta
+            >> (_META_STATUS_BITS + _META_TX_BITS)).astype(jnp.int32)
+    susp_start = jnp.where(
+        packed.susp_delta == _NO_SUSP, jnp.int32(-1),
+        t - packed.susp_delta.astype(jnp.int32))
+    pv = packed.viv
+    return sim_state.SimState(
+        t=t,
+        alive_truth=(packed.flags & 1) != 0,
+        left=(packed.flags & 2) != 0,
+        leaving=(packed.flags & 4) != 0,
+        external=(packed.flags & 8) != 0,
+        own_inc=packed.own_inc.astype(jnp.uint32),
+        own_tx=packed.own_tx.astype(jnp.int32),
+        awareness=packed.awareness.astype(jnp.int32),
+        probe_perm=perm,
+        probe_ptr=packed.probe_ptr.astype(jnp.int32),
+        next_probe_tick=t + packed.next_probe_delta.astype(jnp.int32),
+        pending_col=jnp.where(packed.pending_col == _NO_COL, jnp.int32(-1),
+                              packed.pending_col.astype(jnp.int32)),
+        pending_fail_tick=t + packed.pending_fail_delta.astype(jnp.int32),
+        pending_nack_miss=packed.pending_nack_miss.astype(jnp.int32),
+        view_key=merge.make_key(packed.view_inc.astype(jnp.uint32), status),
+        susp_start=susp_start,
+        susp_seen=packed.susp_seen,
+        tx_left=tx_left,
+        viv=vivaldi.VivaldiState(
+            vec=pv.vec.astype(jnp.float32),
+            height=pv.height.astype(jnp.float32),
+            error=pv.error.astype(jnp.float32),
+            adjustment=pv.adjustment.astype(jnp.float32),
+            adj_samples=_from_f8(pv.adj_samples),
+            adj_idx=pv.adj_idx.astype(jnp.int32),
+            resets=pv.resets.astype(jnp.int32),
+        ),
+        lat_buf=_from_f8(packed.lat_buf),
+        lat_cnt=packed.lat_cnt.astype(jnp.int32),
+    )
+
+
+# ----------------------------------------------------------------------
+# Whole-driver-state dispatch: SerfState keeps its (already PR-7-packed)
+# event/query plane verbatim and swaps only the SWIM plane.
+# ----------------------------------------------------------------------
+
+def pack_state(state):
+    """Pack a driver state (SimState or SerfState) for at-rest storage.
+    Idempotent: an already-packed SWIM plane passes through."""
+    if hasattr(state, "swim"):
+        if isinstance(state.swim, PackedSimState):
+            return state
+        return state._replace(swim=pack(state.swim))
+    if isinstance(state, PackedSimState):
+        return state
+    return pack(state)
+
+
+def unpack_state(state):
+    """Inverse of :func:`pack_state` (idempotent on dense input)."""
+    if hasattr(state, "swim"):
+        if isinstance(state.swim, PackedSimState):
+            return state._replace(swim=unpack(state.swim))
+        return state
+    if isinstance(state, PackedSimState):
+        return unpack(state)
+    return state
+
+
+def is_packed(state) -> bool:
+    sw = state.swim if hasattr(state, "swim") else state
+    return isinstance(sw, PackedSimState)
+
+
+def swim_plane(state):
+    """The SWIM plane of any driver state, dense, without touching the
+    rest: the cheap accessor host code uses to read ``t`` off a packed
+    state without materializing a dense copy of the K-plane."""
+    sw = state.swim if hasattr(state, "swim") else state
+    if isinstance(sw, PackedSimState):
+        return unpack(sw)
+    return sw
+
+
+def tick_of(state):
+    """Current tick of any (possibly packed) driver state — reads the
+    ``t`` leaf directly, no unpacking, no dense materialization."""
+    sw = state.swim if hasattr(state, "swim") else state
+    return sw.t
+
+
+def bytes_per_node(tree, n: int) -> float:
+    """At-rest bytes per node of a state pytree with node axis size n
+    (abstract values welcome — pairs with jax.eval_shape)."""
+    total = sum(int(np_size_bytes(l)) for l in jax.tree.leaves(tree))
+    return total / float(n)
+
+
+def np_size_bytes(leaf) -> int:
+    return int(leaf.size) * int(jnp.dtype(leaf.dtype).itemsize)

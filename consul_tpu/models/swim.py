@@ -604,6 +604,16 @@ def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
     state = state._replace(
         tx_left=jnp.where(changed & active[:, None], tx_limit, state.tx_left)
     )
+    # Canonicalize the probe-window deadline while no probe is
+    # outstanding: its only reader gates on pending_col >= 0 (phase 2),
+    # so pinning it to the current tick is unobservable — and it keeps
+    # the tick-anchored i16 delta of the packed StateLayout exact for
+    # every live window (models/layout.py).
+    state = state._replace(
+        pending_fail_tick=jnp.where(
+            state.pending_col < 0, t, state.pending_fail_tick
+        )
+    )
 
     cnt = counters_mod.zeros()._replace(
         probes_sent=n_probes,

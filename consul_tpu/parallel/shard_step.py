@@ -194,7 +194,8 @@ def make_sharded_chaos_step(cfg: SimConfig, topo: Topology, mesh: Mesh, *,
 def make_sharded_chunk_runner(cfg: SimConfig, topo: Topology, mesh: Mesh,
                               chunk: int, with_metrics: bool, *,
                               step_fn, swim_of,
-                              chaos: bool = False, sentinel: bool = False):
+                              chaos: bool = False, sentinel: bool = False,
+                              layout: str = "dense"):
     """The multi-chip analogue of models/cluster.py ``_chunk_runner``:
     one jitted program per (cfg, topo content, chunk, metrics, step,
     chaos shape, sentinel, MESH) signature with the same call convention
@@ -217,10 +218,20 @@ def make_sharded_chunk_runner(cfg: SimConfig, topo: Topology, mesh: Mesh,
     convergence detection and telemetry see identical values at chunk
     granularity. The RMSE sample key matches the single-device last
     row's (fold_in(fold_in(base_key, t_last), 1)) so the chunk-boundary
-    rows agree to float tolerance."""
+    rows agree to float tolerance.
+
+    With ``layout="packed"`` the carried state is the compact
+    PackedSimState (models/layout.py); the scan body unpacks to the
+    dense working set, steps, and re-packs — pack/unpack are purely
+    elementwise, so they shard over the node axis like any other local
+    math and the discrete protocol plane stays bit-identical to the
+    dense runner (tests/test_layout_parity.py covers the sharded
+    pairing)."""
+    from consul_tpu.models import layout as layout_mod
     from consul_tpu.models.cluster import TickTrace  # deferred: no cycle
     from consul_tpu.utils import metrics
 
+    packed = layout == layout_mod.PACKED
     axis, n_shards = node_axes(mesh)
     if cfg.n % n_shards != 0:
         raise ValueError(f"n={cfg.n} must divide over {n_shards} shards")
@@ -235,9 +246,13 @@ def make_sharded_chunk_runner(cfg: SimConfig, topo: Topology, mesh: Mesh,
 
         def body(carry, tick_key):
             state, cnt = carry
+            if packed:
+                state = layout_mod.unpack_state(state)
             with coll.node_axis(axis, n_shards, cfg.n):
                 state, c = step_fn(cfg, topo, world_l, state, tick_key,
                                    sched_l, sentinel=sentinel)
+            if packed:
+                state = layout_mod.pack_state(state)
             return (state, counters_mod.add(cnt, c)), ()
 
         (state_l, cnt), _ = jax.lax.scan(
@@ -267,6 +282,8 @@ def make_sharded_chunk_runner(cfg: SimConfig, topo: Topology, mesh: Mesh,
         if not with_metrics:
             return state, cnt, ()
         sw = swim_of(state)
+        if packed:
+            sw = layout_mod.unpack(sw)
         h = metrics.health(cfg, topo, sw)
         last_key = jax.random.fold_in(base_key, sw.t - 1)
         rmse = metrics.vivaldi_rmse(

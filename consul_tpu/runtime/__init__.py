@@ -53,6 +53,8 @@ _EXPORTS = {
     "run_resilient": ("consul_tpu.runtime.harness", "run_resilient"),
     "CheckpointPolicy": ("consul_tpu.runtime.policy", "CheckpointPolicy"),
     "SignalTrap": ("consul_tpu.runtime.policy", "SignalTrap"),
+    "MemoryPlan": ("consul_tpu.runtime.membudget", "MemoryPlan"),
+    "plan_memory": ("consul_tpu.runtime.membudget", "plan"),
     "HeartbeatMonitor": ("consul_tpu.runtime.watchdog", "HeartbeatMonitor"),
     "InitWatchdog": ("consul_tpu.runtime.watchdog", "InitWatchdog"),
     "with_failover": ("consul_tpu.runtime.watchdog", "with_failover"),
@@ -80,12 +82,14 @@ __all__ = [
     "CheckpointPolicy",
     "HeartbeatMonitor",
     "InitWatchdog",
+    "MemoryPlan",
     "Preempted",
     "RunReport",
     "SENTINEL_FIELDS",
     "SentinelViolation",
     "SignalTrap",
     "hang_dump_path",
+    "plan_memory",
     "restore_placed",
     "run_resilient",
     "violation_mask",

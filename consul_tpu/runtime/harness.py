@@ -76,6 +76,10 @@ class RunReport:
     reshards: int = 0
     hang_status: Optional[str] = None
     hang_checkpoint: Optional[str] = None
+    # Widen-on-load provenance: set when the resume point was a
+    # pre-packing dense checkpoint restored into a packed layout
+    # ({"widened_from": <digest>, "widened_to": <digest>}), else None.
+    widened: Optional[dict] = None
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -95,25 +99,10 @@ def _placement_width(state) -> int:
     return 1
 
 
-def state_layout_digest(state, n: int) -> str:
-    """Stable digest of a state pytree's LAYOUT: leaf paths, dtypes,
-    and shapes with the node axis abstracted to ``N`` (so the digest is
-    shape-family, not instance). Two states with the same digest are
-    field-for-field restorable into each other; a digest change means
-    the program's state schema moved (a new field, a packed dtype, a
-    reshaped buffer — e.g. the fused-serf refactor narrowed ev_origin
-    to i16 and added ev_pending) and a checkpoint across the change
-    must be refused, not shape-crashed into."""
-    import hashlib
-
-    parts = []
-    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
-        shape = tuple("N" if d == n else int(d)
-                      for d in getattr(leaf, "shape", ()))
-        dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
-        parts.append(f"{jax.tree_util.keystr(path)}:{dtype}:{shape}")
-    joined = "|".join(sorted(parts))
-    return hashlib.sha256(joined.encode()).hexdigest()[:16]
+# The layout digest lives with the serializer now (it guards restores);
+# re-exported here because the harness is where callers historically
+# found it.
+state_layout_digest = ckpt_mod.state_layout_digest
 
 
 def _scenario_meta(sim, tag: str, ticks: int, t0: int, done: int,
@@ -189,7 +178,8 @@ def run_resilient(sim, ticks: int, *, chunk: int = 64,
     sched = (chaos_mod.compile_schedule(sim.cfg.n, events)
              if events else None)
     sched_digest = chaos_mod.digest_of(sched)
-    t0 = int(jax.device_get(sim.swim_state.t))
+    t0 = (sim._tick() if hasattr(sim, "_tick")
+          else int(jax.device_get(sim.swim_state.t)))
     done = 0
     reshards = 0
     sink = (policy.sink if policy is not None else None) \
@@ -209,6 +199,7 @@ def run_resilient(sim, ticks: int, *, chunk: int = 64,
     # schedule must rebase to the original start tick, not to wherever
     # the restored state happens to be.
     saved_width = None
+    widened_prov = None
     if policy is not None:
         ident = {
             "tag": policy.tag,
@@ -226,21 +217,45 @@ def run_resilient(sim, ticks: int, *, chunk: int = 64,
         # shape crash deep in deserialization instead.
         layout_now = state_layout_digest(sim.state, sim.cfg.n)
         meta0 = policy.read_meta()
+        state = meta = None
         if (meta0 is not None and os.path.exists(policy.path)
                 and all(meta0.get(k) == v for k, v in ident.items())):
             saved_layout = meta0.get("state_layout")
             if saved_layout != layout_now and (
                     saved_layout is not None
                     or "Serf" in str(meta0.get("kind", ""))):
-                raise RuntimeError(
-                    f"checkpoint {policy.path} matches this trajectory "
-                    f"but was written by an incompatible state layout "
-                    f"({saved_layout or 'pre-layout-digest (pre-fusion)'}"
-                    f" vs {layout_now}): it cannot be resumed into this "
-                    "program. Retire it (delete the .ckpt/.meta.json "
-                    "pair) or rerun with the build that wrote it."
-                )
-        state, meta = policy.load(sim.state, match=ident)
+                # Widen-on-load: if the saved schema is exactly the
+                # DENSE twin of this run's packed layout, the
+                # checkpoint predates packing but names the same
+                # trajectory — restore it dense, pack it, and resume,
+                # with both digests carried as provenance. Anything
+                # else is a genuine schema mismatch and keeps the
+                # clear refusal.
+                from consul_tpu.models import layout as layout_mod
+
+                dense_tpl = (layout_mod.unpack_state(sim.state)
+                             if layout_mod.is_packed(sim.state) else None)
+                if (dense_tpl is not None and saved_layout ==
+                        state_layout_digest(dense_tpl, sim.cfg.n)):
+                    state, widened_prov = ckpt_mod.restore_widened(
+                        policy.path, dense_tpl, layout_mod.pack_state,
+                        sim.cfg.n)
+                    meta = meta0
+                    if sink is not None:
+                        sink.incr_counter(
+                            "sim.runtime.widened_restores", 1)
+                else:
+                    raise RuntimeError(
+                        f"checkpoint {policy.path} matches this "
+                        f"trajectory but was written by an incompatible "
+                        f"state layout ({saved_layout or 'pre-layout-digest (pre-fusion)'}"
+                        f" vs {layout_now}): it cannot be resumed into "
+                        "this program. Retire it (delete the "
+                        ".ckpt/.meta.json pair) or rerun with the build "
+                        "that wrote it."
+                    )
+        if state is None:
+            state, meta = policy.load(sim.state, match=ident)
         if state is not None:
             sim.state = state
             t0 = int(meta["t0"])
@@ -328,6 +343,7 @@ def run_resilient(sim, ticks: int, *, chunk: int = 64,
             reshards=reshards,
             hang_status=monitor.status if monitor is not None else None,
             hang_checkpoint=hang_ckpt[0],
+            widened=widened_prov,
         )
 
     trap = policy.trap if policy is not None else SignalTrap()

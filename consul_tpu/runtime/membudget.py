@@ -1,0 +1,261 @@
+"""MemoryBudget: pick chunk, state layout, and cohort plan per device.
+
+The planner answers one question before any array is allocated: *how
+does a population of n nodes fit this device?* Given device memory
+stats (or an explicit budget) and the run shape (n, kind, chaos, mesh),
+it returns a :class:`MemoryPlan` naming
+
+  - the **state layout** (models/layout.py): dense f32/i32 when the
+    working set fits comfortably, packed (2.5x smaller at rest) when
+    it buys headroom;
+  - the **chunk** length for the scan runners;
+  - the **cohort plan**: ``cohort_n == n`` resident when the population
+    fits, otherwise the largest power-of-two divisor of n whose
+    double-buffered working set fits the budget — the shape
+    ``models.cluster.StreamedSimulation`` streams host<->device;
+  - the **prewarm signature** (utils/prewarm.py): the (ns, kinds,
+    chunks, layout) tuple to AOT-compile, so the same binary serves a
+    64k CPU run and a 64M pod run by planning instead of editing.
+
+Sizing is arithmetic over ``jax.eval_shape`` — zero allocation. The
+working-set model is deliberately conservative: at rest the carry holds
+one state copy per buffered cohort, but inside a packed scan body the
+step materializes a full dense working copy plus step temporaries, so
+live bytes per node are estimated as
+
+    live = buffers * at_rest(layout) + WORKING_MULT * dense_actual
+
+which over- rather than under-provisions (XLA fuses most temporaries
+away; the dense copy does not survive the tick).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Optional
+
+import jax
+
+from consul_tpu.config import SimConfig
+from consul_tpu.models import layout as layout_mod
+
+KINDS = ("swim", "serf")
+
+# Step-temporary multiplier over the dense per-node working set: the
+# scan body holds the dense state plus a small number of same-shaped
+# intermediates (gossip payload rolls, merge keys) before XLA fusion.
+WORKING_MULT = 3.0
+
+# Fraction of the reported device budget the plan may fill — headroom
+# for the executable, RNG keys, counters, and allocator slack.
+FILL_FRACTION = 0.8
+
+_SIZE_RE = re.compile(r"^\s*([0-9]+(?:\.[0-9]+)?)\s*([KMGT]?i?B?)\s*$",
+                      re.IGNORECASE)
+_UNIT = {"": 1, "B": 1,
+         "KB": 10**3, "MB": 10**6, "GB": 10**9, "TB": 10**12,
+         "KIB": 2**10, "MIB": 2**20, "GIB": 2**30, "TIB": 2**40}
+
+
+def parse_budget(budget) -> Optional[int]:
+    """"auto" -> None (probe the device); int/float bytes pass through;
+    "2GB"/"512MiB"-style strings parse with SI/binary units."""
+    if budget is None or budget == "auto":
+        return None
+    if isinstance(budget, (int, float)):
+        return int(budget)
+    m = _SIZE_RE.match(str(budget))
+    if not m:
+        raise ValueError(f"unparseable memory budget {budget!r}")
+    num, unit = float(m.group(1)), m.group(2).upper()
+    if unit in ("K", "M", "G", "T"):
+        unit += "B"
+    return int(num * _UNIT[unit])
+
+
+def device_budget_bytes(device=None) -> int:
+    """Usable bytes on one device: ``memory_stats`` when the backend
+    reports them (TPU/GPU), else host RAM (the CPU tier's arrays live
+    in host memory anyway)."""
+    dev = device or jax.devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    if stats:
+        limit = stats.get("bytes_limit") or stats.get(
+            "bytes_reservable_limit")
+        if limit:
+            return int(limit)
+    try:
+        return os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+    except (ValueError, OSError):  # pragma: no cover - exotic platform
+        return 8 * 2**30
+
+
+def _state_abstract(cfg: SimConfig, kind: str, layout: str):
+    """Shape/dtype skeleton of one population's at-rest state — pure
+    eval_shape, no allocation (safe to call for a 64M-node config)."""
+    from consul_tpu.models import serf as serf_mod
+    from consul_tpu.models import state as sim_state
+
+    init = serf_mod.init if kind == "serf" else sim_state.init
+
+    def build(key):
+        st = init(cfg, key)
+        if layout == layout_mod.PACKED:
+            st = layout_mod.pack_state(st)
+        return st
+
+    return jax.eval_shape(build, jax.ShapeDtypeStruct((2,), "uint32"))
+
+
+def state_bytes_per_node(cfg: SimConfig, kind: str = "swim",
+                         layout: str = layout_mod.DENSE) -> float:
+    """At-rest bytes per node for (cfg, kind, layout)."""
+    return layout_mod.bytes_per_node(_state_abstract(cfg, kind, layout),
+                                     cfg.n)
+
+
+def dense_f32i32_bytes_per_node(cfg: SimConfig, kind: str = "swim") -> float:
+    """The ISSUE's comparison baseline: every dense element at 4 bytes
+    (bools and narrow serf lanes counted as if f32/i32)."""
+    tree = _state_abstract(cfg, kind, layout_mod.DENSE)
+    elems = sum(int(l.size) for l in jax.tree.leaves(tree))
+    return elems * 4.0 / cfg.n
+
+
+def live_bytes_per_node(cfg: SimConfig, kind: str, layout: str,
+                        buffers: int = 1) -> float:
+    """Working-set bytes per node while a population is stepping (see
+    module docstring for the model)."""
+    at_rest = state_bytes_per_node(cfg, kind, layout)
+    dense = state_bytes_per_node(cfg, kind, layout_mod.DENSE)
+    return buffers * at_rest + WORKING_MULT * dense
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryPlan:
+    """What the planner decided for one run. ``streamed`` means the
+    population exceeds the per-device budget and must go through
+    ``StreamedSimulation`` at ``cohort_n`` nodes per cohort."""
+
+    n: int
+    kind: str
+    layout: str
+    chunk: int
+    cohort_n: int
+    streamed: bool
+    devices: int
+    budget_bytes: int
+    state_bytes_per_node: float
+    dense_bytes_per_node: float       # dense-actual at-rest bytes/node
+    dense_f32i32_bytes_per_node: float  # the all-4-byte baseline
+    resident_bytes: int               # projected peak per device
+    max_n_resident: int               # biggest resident pop at layout
+
+    @property
+    def packed_cut(self) -> float:
+        """Compaction factor vs the dense f32/i32 baseline."""
+        return self.dense_f32i32_bytes_per_node / self.state_bytes_per_node
+
+    def prewarm_args(self) -> dict:
+        """The signature utils/prewarm.prewarm compiles ahead of time:
+        one program shape covers every cohort (and the resident case,
+        where the single "cohort" is the whole population)."""
+        return {
+            "ns": [self.cohort_n],
+            "kinds": [self.kind],
+            "chunks": [self.chunk],
+            "layout": self.layout,
+        }
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["packed_cut"] = round(self.packed_cut, 3)
+        return d
+
+
+def _pow2_cohort(n: int, max_cohort: int) -> int:
+    """Largest n/2^k (>= 1k floor) that fits ``max_cohort`` nodes."""
+    cohort = n
+    while cohort > max_cohort and cohort % 2 == 0 and cohort > 1024:
+        cohort //= 2
+    return cohort
+
+
+def plan(cfg: SimConfig, kind: str = "swim", layout: str = "auto",
+         budget="auto", chaos: bool = False, mesh=None,
+         chunk: Optional[int] = None, device=None) -> MemoryPlan:
+    """Pick (layout, chunk, cohort plan) for running ``cfg`` on this
+    device/mesh under ``budget`` bytes per device.
+
+    ``layout="auto"`` keeps the dense golden reference whenever the
+    whole population fits it resident, and switches to packed only when
+    compaction is what makes the run fit (or shrinks the cohort count
+    of a streamed run). ``chaos`` reserves schedule headroom; ``mesh``
+    divides the population over its devices.
+    """
+    if kind not in KINDS:
+        raise ValueError(f"kind must be one of {KINDS}; got {kind!r}")
+    devices = 1
+    if mesh is not None:
+        devices = int(getattr(mesh, "size", None) or len(mesh.devices))
+    total = parse_budget(budget)
+    if total is None:
+        total = device_budget_bytes(device)
+    usable = int(total * FILL_FRACTION)
+    if chaos:
+        # Schedule masks are [N, slots] u8-ish — budget a slim slice.
+        usable = int(usable * 0.95)
+
+    n_dev = cfg.n // devices  # nodes this device must hold
+
+    def max_resident(lay: str) -> int:
+        return int(usable / live_bytes_per_node(cfg, kind, lay, buffers=1))
+
+    if layout == "auto":
+        layout = (layout_mod.DENSE if n_dev <= max_resident(layout_mod.DENSE)
+                  else layout_mod.PACKED)
+    layout_mod.validate(cfg, layout)
+
+    fits = n_dev <= max_resident(layout)
+    if fits:
+        cohort_n, streamed, buffers = cfg.n, False, 1
+    else:
+        if devices > 1:
+            raise ValueError(
+                "beyond-budget populations stream on a single device; "
+                "shrink n per device or raise the budget")
+        # Streaming double-buffers: two cohorts resident at the swap.
+        per_cohort = int(usable
+                         / live_bytes_per_node(cfg, kind, layout, buffers=2))
+        cohort_n = _pow2_cohort(cfg.n, per_cohort)
+        streamed, buffers = True, 2
+        if not cfg.view_degree:
+            raise ValueError(
+                "streaming needs the sparse view (view_degree > 0)")
+
+    if chunk is None:
+        # Long scans amortize dispatch; huge populations take smaller
+        # chunks so a chunk's wall time stays interactive.
+        chunk = 64 if (cohort_n if streamed else n_dev) <= 2**21 else 16
+
+    per_node = state_bytes_per_node(cfg, kind, layout)
+    resident = int(live_bytes_per_node(cfg, kind, layout, buffers)
+                   * (cohort_n if streamed else n_dev))
+    return MemoryPlan(
+        n=cfg.n,
+        kind=kind,
+        layout=layout,
+        chunk=chunk,
+        cohort_n=cohort_n,
+        streamed=streamed,
+        devices=devices,
+        budget_bytes=usable,
+        state_bytes_per_node=per_node,
+        dense_bytes_per_node=state_bytes_per_node(cfg, kind,
+                                                  layout_mod.DENSE),
+        dense_f32i32_bytes_per_node=dense_f32i32_bytes_per_node(cfg, kind),
+        resident_bytes=resident,
+        max_n_resident=max_resident(layout),
+    )

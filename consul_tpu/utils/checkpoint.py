@@ -179,6 +179,44 @@ def read_partition_spec(path: str) -> Any:
     return read_manifest(path).get("partition_spec")
 
 
+def state_layout_digest(state: Any, n: int) -> str:
+    """Stable digest of a state pytree's LAYOUT: leaf paths, dtypes,
+    and shapes with the node axis abstracted to ``N`` (so the digest is
+    shape-family, not instance). Two states with the same digest are
+    field-for-field restorable into each other; a digest change means
+    the program's state schema moved (a new field, a packed dtype, a
+    reshaped buffer — e.g. the fused-serf refactor narrowed ev_origin
+    to i16, and the packed StateLayout re-encodes the whole SWIM
+    plane) and a checkpoint across the change must be either widened
+    (:func:`restore_widened`, when the saved schema is the dense twin
+    of the running packed one) or refused, never shape-crashed into."""
+    parts = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        shape = tuple("N" if d == n else int(d)
+                      for d in getattr(leaf, "shape", ()))
+        dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+        parts.append(f"{jax.tree_util.keystr(path)}:{dtype}:{shape}")
+    joined = "|".join(sorted(parts))
+    return hashlib.sha256(joined.encode()).hexdigest()[:16]
+
+
+def restore_widened(path: str, dense_template: Any, widen, n: int, *,
+                    verify: bool = True) -> tuple:
+    """Widen-on-load: restore a checkpoint written by the PRE-PACKING
+    dense program into a packed-layout run. ``dense_template`` is the
+    dense twin of the running state (models.layout.unpack_state of it);
+    ``widen`` converts the restored dense pytree into the running
+    layout (models.layout.pack_state). Returns ``(state, provenance)``
+    where provenance records both layout digests — the audit trail
+    that distinguishes a widened resume from a native one."""
+    state = restore(path, dense_template, verify=verify)
+    out = widen(state)
+    return out, {
+        "widened_from": state_layout_digest(dense_template, n),
+        "widened_to": state_layout_digest(out, n),
+    }
+
+
 def restore(path: str, template: Any, *, verify: bool = True) -> Any:
     """Load a checkpoint into the structure of ``template`` (an
     ``init()``-produced pytree). Structure/shape/dtype mismatches and

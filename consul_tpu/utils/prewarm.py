@@ -64,6 +64,7 @@ def prewarm_simulation(sim, chunk: int, with_metrics: bool) -> None:
         step_fn=type(sim)._step_fn, swim_of=type(sim)._swim_of,
         chaos_key=chaos_mod.static_key_of(sim.chaos),
         sentinel=sim.sentinel, mesh=sim.mesh,
+        layout=getattr(sim, "layout", "dense"),
     )
     jitted.lower(
         _abstract(sim.world), _abstract(sim.chaos),
@@ -82,8 +83,9 @@ def prewarm(ns: Sequence[int], kinds: Sequence[str] = ("swim",),
             metrics_modes: Sequence[bool] = (False, True),
             mesh=None, device_count: Optional[int] = None, n_dc: int = 1,
             chaos: bool = False, seed: int = 0, view_degree: int = 16,
-            sentinel: bool = False, cache_dir: Optional[str] = None) -> dict:
-    """Compile every (n, kind, chunk, mesh-shape, chaos-shape)
+            sentinel: bool = False, cache_dir: Optional[str] = None,
+            layout: str = "dense") -> dict:
+    """Compile every (n, kind, chunk, mesh-shape, chaos-shape, layout)
     signature into the persistent compile cache and return a JSON-ready
     summary: the signatures compiled, cache hit/miss movement, and wall
     time. ``mesh`` overrides the per-``n`` default
@@ -118,7 +120,8 @@ def prewarm(ns: Sequence[int], kinds: Sequence[str] = ("swim",),
             n, device_count=device_count, n_dc=n_dc)
         for kind in kinds:
             cfg = SimConfig(n=n, view_degree=min(view_degree, n - 2))
-            sim = classes[kind](cfg, seed=seed, sentinel=sentinel, mesh=m)
+            sim = classes[kind](cfg, seed=seed, sentinel=sentinel, mesh=m,
+                                layout=layout)
             schedules = [None]
             if chaos:
                 schedules.append([chaos_api.Partition(
@@ -134,6 +137,7 @@ def prewarm(ns: Sequence[int], kinds: Sequence[str] = ("swim",),
                             "mesh": _mesh_shape(m),
                             "with_metrics": bool(with_metrics),
                             "chaos": sched is not None,
+                            "layout": layout,
                             "wall_s": round(time.perf_counter() - t0, 3),
                         })
     return {

@@ -579,6 +579,98 @@ class TestTH110:
 
 
 # ----------------------------------------------------------------------
+# TH111: hand-widened packed state fields inside traced code
+# ----------------------------------------------------------------------
+
+class TestTH111:
+    def test_widening_a_packed_field_fires(self):
+        # Reaching past the codec: decoding p.meta by hand instead of
+        # going through models/layout.unpack.
+        rep = _lint({DEV: """
+            import jax
+            import jax.numpy as jnp
+
+            def step(p):
+                status = p.meta.astype(jnp.int32) & 3
+                armed = (p.susp_delta.astype(jnp.int32) != 65535)
+                return status, armed
+
+            run = jax.jit(step)
+        """})
+        assert _rules(rep) == ["TH111", "TH111"]
+        assert "meta" in rep.findings[0].message
+        assert "susp_delta" in rep.findings[1].message
+
+    def test_string_dtype_spelling_fires(self):
+        rep = _lint({DEV: """
+            import jax
+
+            def step(p):
+                return p.flags.astype("int32") & 1
+
+            run = jax.jit(step)
+        """})
+        assert _rules(rep) == ["TH111"]
+
+    def test_non_wide_target_is_silent(self):
+        # Same-width or narrower casts are repacking, not decoding.
+        rep = _lint({DEV: """
+            import jax
+            import jax.numpy as jnp
+
+            def step(p):
+                return p.view_inc.astype(jnp.uint16)
+
+            run = jax.jit(step)
+        """})
+        assert rep.clean
+
+    def test_dense_field_is_silent(self):
+        # Fields that also exist on the dense state (own_inc,
+        # susp_seen, ...) widen legitimately in the dense step.
+        rep = _lint({DEV: """
+            import jax
+            import jax.numpy as jnp
+
+            def step(state):
+                return state.own_inc.astype(jnp.uint32) + 1
+
+            run = jax.jit(step)
+        """})
+        assert rep.clean
+
+    def test_untraced_host_function_is_silent(self):
+        # Host-side inspection of a packed state is fine — the codec
+        # contract only binds compiled code.
+        rep = _lint({DEV: """
+            import jax.numpy as jnp
+
+            def describe(p):
+                return p.meta.astype(jnp.int32)
+        """})
+        assert rep.clean
+
+    def test_allowlist_suppresses_by_symbol(self):
+        al = parse_allowlist("""
+            [[allow]]
+            rule = "TH111"
+            path = "consul_tpu/models/fake.py"
+            symbol = "unpack"
+            reason = "this IS the codec"
+        """)
+        rep = _lint({DEV: """
+            import jax
+            import jax.numpy as jnp
+
+            def unpack(p):
+                return p.meta.astype(jnp.int32) & 3
+
+            run = jax.jit(unpack)
+        """}, al)
+        assert rep.clean and len(rep.suppressed) == 1
+
+
+# ----------------------------------------------------------------------
 # callgraph: reachability across modules and hand-off shapes
 # ----------------------------------------------------------------------
 
@@ -787,6 +879,6 @@ class TestPackageGate:
     def test_every_rule_id_is_documented(self):
         assert set(analysis.RULES) == {
             "TH101", "TH102", "TH103", "TH104", "TH105", "TH106",
-            "TH107", "TH108", "TH109", "TH110"}
+            "TH107", "TH108", "TH109", "TH110", "TH111"}
         for rid, rationale in analysis.RULES.items():
             assert rationale.strip(), rid

@@ -1,0 +1,299 @@
+"""StateLayout codec + MemoryBudget planner (fast tier).
+
+The packed layout (models/layout.py) is a codec, not an approximation,
+on the discrete plane: unpack(pack(x)) must reproduce every integer
+field bit-for-bit whenever the documented bounds hold, and a second
+pack must be a fixed point (the float narrowings are idempotent). The
+planner (runtime/membudget.py) is pure arithmetic over eval_shape —
+every decision here is asserted against hand-computed byte budgets.
+The deep 4096-node packed-vs-dense run lives in the slow tier
+(tests/test_layout_parity.py); this file keeps populations tiny.
+"""
+
+import dataclasses
+import functools
+import types
+
+import jax
+import numpy as np
+import pytest
+
+from consul_tpu.config import SimConfig
+from consul_tpu.models import layout
+from consul_tpu.models import state as sim_state
+from consul_tpu.models.cluster import (
+    Simulation,
+    StreamedSerfSimulation,
+    StreamedSimulation,
+)
+from consul_tpu.runtime import membudget
+from consul_tpu.utils import checkpoint
+
+# Small but non-trivial: enough ticks for probes, suspicion windows and
+# Vivaldi updates to populate every packed field.
+N = 128
+SEED = 5
+TICKS = 12
+
+# SimState fields the codec must reproduce exactly (everything except
+# the Vivaldi block and the float RTT windows, which narrow to bf16/f8
+# under a documented tolerance instead).
+_DISCRETE = (
+    "t", "alive_truth", "left", "leaving", "external", "own_inc",
+    "own_tx", "awareness", "probe_perm", "probe_ptr", "next_probe_tick",
+    "pending_col", "pending_fail_tick", "pending_nack_miss", "view_key",
+    "susp_start", "susp_seen", "tx_left", "lat_cnt",
+)
+
+
+@functools.lru_cache(maxsize=None)
+def _stepped_state() -> sim_state.SimState:
+    sim = Simulation(SimConfig(n=N, view_degree=8), seed=SEED)
+    sim.kill(np.arange(N) == 3)  # arm suspicion/refute machinery
+    sim.run(TICKS, chunk=4, with_metrics=False)
+    return sim.state
+
+
+class TestCodec:
+    def test_discrete_plane_round_trips_exactly(self):
+        dense = _stepped_state()
+        back = layout.unpack(layout.pack(dense))
+        for field in _DISCRETE:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(dense, field)),
+                np.asarray(getattr(back, field)), err_msg=field)
+
+    def test_pack_is_a_fixed_point(self):
+        # pack -> unpack -> pack must be bit-stable on EVERY leaf: the
+        # bf16 and scaled-f8 narrowings lose information once, then
+        # never again (the at-rest form is self-consistent).
+        p1 = layout.pack(_stepped_state())
+        p2 = layout.pack(layout.unpack(p1))
+        for (path, a), (_, b) in zip(
+                jax.tree_util.tree_flatten_with_path(p1)[0],
+                jax.tree_util.tree_flatten_with_path(p2)[0]):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=jax.tree_util.keystr(path))
+
+    def test_pack_state_and_unpack_state_are_idempotent(self):
+        dense = _stepped_state()
+        packed = layout.pack_state(dense)
+        assert layout.is_packed(packed)
+        assert layout.pack_state(packed) is packed
+        assert layout.unpack_state(dense) is dense
+        assert int(layout.tick_of(packed)) == TICKS
+        np.testing.assert_array_equal(
+            np.asarray(layout.swim_plane(packed).view_key),
+            np.asarray(dense.view_key))
+
+    def test_f8_codec_bounds(self):
+        import jax.numpy as jnp
+        x = jnp.array([0.0, 0.004, -0.25, 1.0, 10.0], jnp.float32)
+        y = layout._from_f8(layout._to_f8(x))
+        # Saturates at +-1.75 s; millisecond-scale values survive to
+        # well under the 5% RTT jitter floor.
+        assert float(y[-1]) == pytest.approx(1.75)
+        np.testing.assert_allclose(np.asarray(y[:4]),
+                                   np.asarray(x[:4]), rtol=0.0625)
+
+
+class TestValidate:
+    def test_unknown_layout_rejected(self):
+        with pytest.raises(ValueError, match="unknown state layout"):
+            layout.validate(SimConfig(n=64, view_degree=8), "sparse")
+
+    def test_dense_always_passes(self):
+        layout.validate(SimConfig(n=1024), layout.DENSE)
+
+    def test_wide_view_rejected_for_packed(self):
+        with pytest.raises(ValueError, match="view degree"):
+            layout.validate(SimConfig(n=512, view_degree=300),
+                            layout.PACKED)
+
+
+class TestBytes:
+    @pytest.mark.parametrize("k", [8, 16])
+    def test_packed_cut_beats_2_5x(self, k):
+        cfg = SimConfig(n=4096, view_degree=k)
+        packed = membudget.state_bytes_per_node(cfg, "swim", layout.PACKED)
+        base = membudget.dense_f32i32_bytes_per_node(cfg, "swim")
+        assert base / packed >= 2.5, (k, base, packed)
+
+    def test_eval_shape_matches_real_arrays(self):
+        cfg = SimConfig(n=N, view_degree=8)
+        st = sim_state.init(cfg, jax.random.PRNGKey(0))
+        real = layout.bytes_per_node(layout.pack_state(st), N)
+        assert real == pytest.approx(
+            membudget.state_bytes_per_node(cfg, "swim", layout.PACKED))
+
+
+class TestBudgetParsing:
+    def test_units(self):
+        assert membudget.parse_budget("2GB") == 2 * 10**9
+        assert membudget.parse_budget("512MiB") == 512 * 2**20
+        assert membudget.parse_budget("1.5G") == int(1.5 * 10**9)
+        assert membudget.parse_budget(12345) == 12345
+        assert membudget.parse_budget("auto") is None
+        assert membudget.parse_budget(None) is None
+
+    def test_garbage_rejected(self):
+        with pytest.raises(ValueError, match="unparseable"):
+            membudget.parse_budget("lots")
+
+
+class TestPlanner:
+    def test_small_population_stays_dense_resident(self):
+        plan = membudget.plan(SimConfig(n=2048, view_degree=8),
+                              budget="1GB")
+        assert plan.layout == layout.DENSE
+        assert not plan.streamed and plan.cohort_n == 2048
+
+    def test_forced_packed_resident(self):
+        plan = membudget.plan(SimConfig(n=2048, view_degree=8),
+                              layout="packed", budget="1GB")
+        assert plan.layout == layout.PACKED and not plan.streamed
+        assert plan.packed_cut >= 2.5
+
+    def test_beyond_budget_streams_packed_pow2_cohorts(self):
+        cfg = SimConfig(n=65536, view_degree=8)
+        plan = membudget.plan(cfg, budget="20MB")
+        assert plan.streamed and plan.layout == layout.PACKED
+        assert cfg.n % plan.cohort_n == 0
+        assert plan.cohort_n >= 1024
+        assert (cfg.n // plan.cohort_n) & (cfg.n // plan.cohort_n - 1) == 0
+        # Double-buffered working set honors the usable budget.
+        per = membudget.live_bytes_per_node(cfg, "swim", plan.layout,
+                                            buffers=2)
+        assert per * plan.cohort_n <= plan.budget_bytes
+
+    def test_multi_device_cannot_stream(self):
+        mesh = types.SimpleNamespace(size=8, devices=[None] * 8)
+        with pytest.raises(ValueError, match="single device"):
+            membudget.plan(SimConfig(n=65536, view_degree=8),
+                           budget="4MB", mesh=mesh)
+
+    def test_prewarm_signature_and_dict(self):
+        plan = membudget.plan(SimConfig(n=65536, view_degree=8),
+                              kind="serf", budget="20MB")
+        assert plan.prewarm_args() == {
+            "ns": [plan.cohort_n], "kinds": ["serf"],
+            "chunks": [plan.chunk], "layout": layout.PACKED}
+        d = plan.to_dict()
+        assert d["packed_cut"] == round(plan.packed_cut, 3)
+        assert d["streamed"] is True
+
+    def test_auto_budget_probes_the_device(self):
+        # CPU tier: host RAM dwarfs a 1k population, so auto must plan
+        # a dense resident run without raising.
+        plan = membudget.plan(SimConfig(n=1024, view_degree=8))
+        assert not plan.streamed and plan.layout == layout.DENSE
+
+
+class TestWidenOnLoad:
+    def test_dense_checkpoint_restores_into_packed_layout(self, tmp_path):
+        dense = _stepped_state()
+        path = str(tmp_path / "pre_packing.ckpt")
+        checkpoint.save(path, dense)
+
+        packed_run = layout.pack_state(
+            sim_state.init(SimConfig(n=N, view_degree=8),
+                           jax.random.PRNGKey(1)))
+        dense_twin = layout.unpack_state(packed_run)
+        restored, prov = checkpoint.restore_widened(
+            path, dense_twin, layout.pack_state, N)
+        assert layout.is_packed(restored)
+        assert prov["widened_from"] == checkpoint.state_layout_digest(
+            dense, N)
+        assert prov["widened_to"] == checkpoint.state_layout_digest(
+            packed_run, N)
+        assert prov["widened_from"] != prov["widened_to"]
+        np.testing.assert_array_equal(
+            np.asarray(layout.swim_plane(restored).view_key),
+            np.asarray(dense.view_key))
+
+    def test_genuine_mismatch_still_refused(self, tmp_path):
+        # A checkpoint from a DIFFERENT config is not the dense twin:
+        # the template check must refuse it, widen or not.
+        other = sim_state.init(SimConfig(n=64, view_degree=8),
+                               jax.random.PRNGKey(0))
+        path = str(tmp_path / "other.ckpt")
+        checkpoint.save(path, other)
+        twin = layout.unpack_state(layout.pack_state(
+            sim_state.init(SimConfig(n=N, view_degree=8),
+                           jax.random.PRNGKey(1))))
+        with pytest.raises(ValueError):
+            checkpoint.restore_widened(path, twin, layout.pack_state, N)
+
+
+class TestStreamed:
+    def test_cohort_n_must_divide(self):
+        with pytest.raises(ValueError, match="divide"):
+            StreamedSimulation(SimConfig(n=1000, view_degree=8),
+                               cohort_n=300)
+
+    def test_dense_view_rejected(self):
+        with pytest.raises(ValueError, match="sparse view"):
+            StreamedSimulation(SimConfig(n=1024), cohort_n=256)
+
+    def test_cohorts_advance_in_lockstep(self):
+        sim = StreamedSimulation(SimConfig(n=1024, view_degree=8),
+                                 cohort_n=256, seed=2, chunk=4)
+        out = sim.run(8)
+        assert out["cohorts"] == 4 and out["layout"] == layout.PACKED
+        assert sim._tick() == 8
+        for i in range(4):
+            assert int(sim.cohort_swim_state(i).t) == 8
+        assert sim.counters["probes_sent"] > 0
+
+    def test_cohort_flips_compile_once(self, compile_ledger):
+        # The tentpole's compile pin: every cohort shares ONE topology,
+        # hence ONE executable — after the first cohort of the first
+        # pass compiles it, 7 more cohort flips run with zero backend
+        # compiles.
+        sim = StreamedSimulation(SimConfig(n=1024, view_degree=8),
+                                 cohort_n=256, seed=2, chunk=4)
+        sim.run(4)  # warm: compiles the single cohort-shaped program
+        with compile_ledger.expect(0, "cohort flips reuse one executable"):
+            sim.run(4)
+        assert sim._tick() == 8
+
+    def test_streamed_serf_smoke(self):
+        sim = StreamedSerfSimulation(SimConfig(n=512, view_degree=8),
+                                     cohort_n=256, seed=1, chunk=4)
+        out = sim.run(4)
+        assert out["cohorts"] == 2 and sim._tick() == 4
+        assert sim.counters["gossip_tx"] > 0
+
+    def test_resident_bytes_double_buffer(self):
+        sim = StreamedSimulation(SimConfig(n=1024, view_degree=8),
+                                 cohort_n=256, seed=2)
+        state_b = sum(layout.np_size_bytes(l)
+                      for l in jax.tree.leaves(sim._archive[0]))
+        assert sim.resident_bytes() >= 2 * state_b
+
+    def test_chaos_applies_per_cohort(self):
+        from consul_tpu import chaos
+        sim = StreamedSimulation(SimConfig(n=1024, view_degree=8),
+                                 cohort_n=256, seed=2, chunk=4)
+        sim.set_chaos([chaos.LinkLoss(start=1, stop=6, a=slice(0, 64),
+                                      b=slice(128, 256), fwd=1.0, rev=1.0)])
+        sim.run(8)
+        assert sim.counters["chaos_msgs_dropped"] > 0
+
+
+class TestPlannerDrivesStreaming:
+    def test_planned_cohort_fits_within_budget(self):
+        # The seam: plan a beyond-budget population, hand the plan's
+        # shape straight to StreamedSimulation, and verify the
+        # device-resident footprint honors what the planner promised.
+        # (Executing a planned stream end-to-end is the slow-tier 4M
+        # acceptance test; compiling a second cohort shape here would
+        # only re-pay that cost.)
+        cfg = SimConfig(n=4096, view_degree=8)
+        plan = membudget.plan(cfg, budget="4MB")
+        assert plan.streamed
+        sim = StreamedSimulation(cfg, cohort_n=plan.cohort_n, seed=0,
+                                 layout=plan.layout, chunk=plan.chunk)
+        assert sim.resident_bytes() <= plan.budget_bytes
+        assert sim._tick() == 0
