@@ -479,6 +479,7 @@ def child(platform: str, deadline: float):
     # batch. (The n-node scan program is already in _RUNNER_CACHE from
     # the throughput phase, so this phase adds only the projection and
     # the one bucket executable.)
+    qsim = None
     try:
         if left() > 60:
             import random as _srv_random
@@ -516,9 +517,34 @@ def child(platform: str, deadline: float):
                 "p99_batch_ms": st["p99_batch_ms"],
                 "padding_waste_pct": st["padding_waste_pct"],
             })
-            del plane, qsim
+            del plane
     except Exception as e:
         _emit({"phase": "error", "where": "serving", "error": repr(e)[:500]})
+
+    # Mixed read/write/watch serving (consul_tpu/serving/mixed): the
+    # device write path + watch plane driven at a fixed R:W:Watch
+    # ratio against the same formed cluster — per-class q/s/chip and
+    # p50/p99 (watch latency = flip + delta kernel + fan-out).
+    try:
+        if qsim is not None and left() > 60:
+            from consul_tpu.serving import ServingPlane as _MixPlane
+            from consul_tpu.serving.mixed import run_mixed
+
+            mb = int(os.environ.get("BENCH_MIXED_BATCH", "1024"))
+            mixed_plane = _MixPlane(k=8, buckets=(mb,), num_services=8)
+            qsim.attach_serving(mixed_plane, writes=True, kv_slots=256)
+            mixed = run_mixed(
+                qsim, mixed_plane,
+                ratio=os.environ.get("BENCH_MIXED_RATIO", "90:9:1"),
+                rounds=int(os.environ.get("BENCH_MIXED_ROUNDS", "16")),
+                read_batch=mb, watchers=8, seed=0)
+            _emit({"phase": "serving_mixed", "n": n, **mixed})
+            del mixed_plane
+    except Exception as e:
+        _emit({"phase": "error", "where": "serving_mixed",
+               "error": repr(e)[:500]})
+    finally:
+        del qsim
 
     # Weak/strong scaling over the device ladder (1, 2, 4, ... up to
     # the visible count): strong holds n fixed (BENCH_SCALING_N) while
@@ -932,6 +958,25 @@ def _save_tpu_session(result):
         pass
 
 
+# Stable result keys that hold a whole child phase dict. Every one of
+# them is stamped {"status": "not_run", "reason": ...} when its phase
+# never executed — a bare null reads as "lost in transit" downstream,
+# while not_run + reason records the skip as a deliberate outcome.
+_PHASE_KEYS = ("northstar_1m", "northstar_1m_serf", "compile_cache",
+               "elasticity", "memory", "serving", "serving_mixed",
+               "scaling_strong", "scaling_weak")
+
+
+def _phase_or_not_run(phases, name, reason, pick=None):
+    """First phase dict matching `name`, optionally projected through
+    `pick`; an explicit not_run marker (never a bare null) when the
+    child skipped or never reached the phase."""
+    for p in phases:
+        if p.get("phase") == name:
+            return pick(p) if pick else p
+    return {"status": "not_run", "reason": reason}
+
+
 def _maybe_replay(result):
     """When the live TPU window is dead, re-emit the freshest in-session
     TPU artifact as the primary result — with explicit provenance, so
@@ -965,6 +1010,21 @@ def _maybe_replay(result):
         cpu=result["backends"]["cpu"],
     )
     merged["total_wall_s"] = result["total_wall_s"]
+    # Replayed artifacts may predate newer stable keys (or carry bare
+    # nulls from before the not_run contract): stamp every absent phase
+    # key explicitly, and mark surviving not_run entries stale so they
+    # are never mistaken for a this-run skip decision.
+    base = os.path.basename(path)
+    for k in _PHASE_KEYS:
+        v = merged.get(k)
+        if not v:
+            merged[k] = {
+                "status": "not_run",
+                "reason": f"absent from replayed artifact {base}",
+                "stale": True,
+            }
+        elif isinstance(v, dict) and v.get("status") == "not_run":
+            merged[k] = dict(v, stale=True)
     return merged
 
 
@@ -1123,50 +1183,58 @@ def main():
             for p in (tpu["phases"] if tpu else [])
             if p.get("phase") == "serf_sweep"
         ],
-        "northstar_1m": next(
-            (p for p in (tpu["phases"] if tpu else [])
-             if p.get("phase") == "northstar"), None),
-        "northstar_1m_serf": next(
-            (p for p in (tpu["phases"] if tpu else [])
-             if p.get("phase") == "northstar_serf"), None),
+        "northstar_1m": _phase_or_not_run(
+            tpu["phases"] if tpu else [], "northstar",
+            "needs a live TPU child with time budget left"),
+        "northstar_1m_serf": _phase_or_not_run(
+            tpu["phases"] if tpu else [], "northstar_serf",
+            "needs a live TPU child with time budget left after "
+            "northstar"),
         # Persistent-compilation-cache provenance for every compile_s
         # above: {"enabled", "dir", "hits", "misses"} from the primary
         # child (utils/compile_cache). A repeat run with --compile-cache
         # shows hits>0 and near-zero compile_s.
-        "compile_cache": next(
-            ({k: p.get(k) for k in ("enabled", "dir", "hits", "misses")}
-             for p in primary["phases"]
-             if p.get("phase") == "compile_cache"), None),
+        "compile_cache": _phase_or_not_run(
+            primary["phases"], "compile_cache",
+            "child exited before the compile-cache report",
+            pick=lambda p: {k: p.get(k) for k in
+                            ("enabled", "dir", "hits", "misses")}),
         # Elastic-runtime drill (chip-loss resume + DCN fault heal):
         # the whole phase dict under one stable key — reshards,
         # digest_identical, and the nested dcn retry/heal counters.
-        "elasticity": next(
-            (p for p in primary["phases"]
-             if p.get("phase") == "elasticity"), None),
+        "elasticity": _phase_or_not_run(
+            primary["phases"], "elasticity",
+            "skipped: time budget exhausted or drill errored"),
         # MemoryBudget provenance (runtime/membudget.py): per-layout x
         # kind bytes/node, the packed compaction factor vs the dense
         # f32/i32 baseline, max-n-per-chip, and per-device peak HBM.
         # Stable key for downstream BENCH json consumers.
-        "memory": next(
-            (p for p in primary["phases"]
-             if p.get("phase") == "memory"), None),
+        "memory": _phase_or_not_run(
+            primary["phases"], "memory",
+            "skipped: time budget exhausted or planner errored"),
         # Serving-plane read throughput (consul_tpu/serving): batched
         # NearestN straight from the simulation tensors —
         # queries_per_sec_per_chip, p50/p99 batch latency, padding
         # waste %. Compare BASELINE.md KV GET (~7.5-16k req/s).
-        "serving": next(
-            (p for p in primary["phases"]
-             if p.get("phase") == "serving"), None),
+        "serving": _phase_or_not_run(
+            primary["phases"], "serving",
+            "skipped: time budget exhausted or phase errored"),
+        # Mixed read/write/watch serving (consul_tpu/serving/mixed):
+        # per-class counts, q/s/chip and p50/p99 under the R:W:Watch
+        # ratio, plus write rejected/shed and watch deliveries.
+        "serving_mixed": _phase_or_not_run(
+            primary["phases"], "serving_mixed",
+            "skipped: time budget exhausted or phase errored"),
         # Device-ladder scaling phases: entries of {devices, n,
         # rounds_per_s, rounds_per_s_per_chip, parallel_efficiency}
         # (strong: fixed n; weak: n grows per-chip). Stable keys for
         # the MULTICHIP trajectory artifacts.
-        "scaling_strong": next(
-            (p for p in primary["phases"]
-             if p.get("phase") == "scaling_strong"), None),
-        "scaling_weak": next(
-            (p for p in primary["phases"]
-             if p.get("phase") == "scaling_weak"), None),
+        "scaling_strong": _phase_or_not_run(
+            primary["phases"], "scaling_strong",
+            "skipped: needs >1 visible device or time budget left"),
+        "scaling_weak": _phase_or_not_run(
+            primary["phases"], "scaling_weak",
+            "skipped: needs >1 visible device or time budget left"),
         # Mesh + prewarm provenance for the headline number: how many
         # devices the child saw, and what the AOT prewarm pass
         # compiled/deserialized before the timed phases.

@@ -428,3 +428,9 @@ class Agent:
 
     def close(self):
         self.cache.close()
+        # The serving plane's close mirrors the cache's: wake parked
+        # batcher waiters and watch pollers, reject new submits with
+        # ServingClosedError — no thread is ever left parked on a
+        # plane that will not pump again.
+        if self.serving is not None:
+            self.serving.close()

@@ -787,6 +787,90 @@ class HTTPApi:
                 worst = c.status
         return worst
 
+    # -- device serving-plane routes (write-attached planes only) -------
+    @staticmethod
+    def _device_block(srv, min_index: int, wait_s: float) -> int:
+        """The ``?index=`` blocking contract against the DEVICE apply
+        index: ``index=0`` answers immediately at the current index;
+        ``index=N`` parks on the watch plane until a snapshot flip
+        advances past N (or the wait expires). The returned index is
+        never smaller than the caller's and never less than 1 — the
+        reference blockingQuery floor."""
+        if min_index > 0:
+            return srv.watch.wait_index(min_index, wait_s)
+        return max(srv.apply_index, 1)
+
+    def _device_route(self, srv, method, parts, q, body, min_index,
+                      wait_s):
+        """Serve catalog/health/kv endpoints from the device plane.
+        Returns None for paths the device tier doesn't model (they fall
+        through to the store tier). Device addressing is by simulation
+        index; service labels are i32 (a non-integer service path
+        segment falls through). KV carries one i32 word per key (the
+        ops/deltas.py narrowing): PUT bodies parse as an integer or
+        hash to one word."""
+        import zlib
+
+        # -- blocking reads --------------------------------------------
+        if method == "GET" and parts == ["catalog", "nodes"]:
+            idx = self._device_block(srv, min_index, wait_s)
+            res = srv.catalog_nodes(-1)
+            rows = [{"Node": node, "ServiceID": -1} for node, _ in res.nodes]
+            return 200, rows, {"X-Consul-Index": str(idx)}
+        if method == "GET" and parts == ["health", "state", "any"]:
+            idx = self._device_block(srv, min_index, wait_s)
+            res = srv.health_nodes(-1)
+            rows = [{"Node": node, "Status": "passing"}
+                    for node, _ in res.nodes]
+            return 200, rows, {"X-Consul-Index": str(idx)}
+        if method == "GET" and len(parts) == 3 and \
+                parts[:2] == ["health", "service"] and \
+                parts[2].lstrip("-").isdigit():
+            idx = self._device_block(srv, min_index, wait_s)
+            res = srv.health_nodes(int(parts[2]))
+            rows = [{"Node": node, "Status": "passing"}
+                    for node, _ in res.nodes]
+            return 200, rows, {"X-Consul-Index": str(idx)}
+        if method == "GET" and len(parts) >= 2 and parts[0] == "kv":
+            key = "/".join(parts[1:])
+            idx = self._device_block(srv, min_index, wait_s)
+            row = srv.kv_get(key)
+            if row is None:
+                return 404, None, {"X-Consul-Index": str(idx)}
+            return 200, [row], {"X-Consul-Index": str(idx)}
+
+        # -- writes (coalesced through the WriteBatcher) ---------------
+        if method == "PUT" and len(parts) >= 2 and parts[0] == "kv":
+            key = "/".join(parts[1:])
+            try:
+                word = int(body)
+            except (TypeError, ValueError):
+                word = zlib.crc32(body or b"") & 0x7FFFFFFF
+            out = srv.kv_put(key, word)
+            return 200, out.applied, {"X-Consul-Index": str(out.index)}
+        if method == "DELETE" and len(parts) >= 2 and parts[0] == "kv":
+            key = "/".join(parts[1:])
+            out = srv.kv_delete(key)
+            return 200, out.applied, {"X-Consul-Index": str(out.index)}
+        if method == "PUT" and parts == ["catalog", "register"]:
+            req = json.loads(body)
+            node = req.get("Node")
+            if isinstance(node, (int, str)) and str(node).isdigit():
+                svc = (req.get("Service") or {}).get("Service", 0)
+                out = srv.register(int(node), int(svc))
+                return 200, out.applied, \
+                    {"X-Consul-Index": str(out.index)}
+            return None  # named nodes stay on the store tier
+        if method == "PUT" and parts == ["catalog", "deregister"]:
+            req = json.loads(body)
+            node = req.get("Node")
+            if isinstance(node, (int, str)) and str(node).isdigit():
+                out = srv.deregister(int(node))
+                return 200, out.applied, \
+                    {"X-Consul-Index": str(out.index)}
+            return None
+        return None
+
     def _route(self, method, path, q, query, body, min_index, wait_s,
                near, headers=None):
         parts = [p for p in path.split("/") if p]
@@ -812,6 +896,22 @@ class HTTPApi:
         else:
             rpc = self.agent.rpc
         rpc_write = functools.partial(self._rpc_write, dc=dc)
+
+        # ---- device serving plane (write-attached) --------------------
+        # When the agent carries a sim-backed serving plane WITH the
+        # device write path, catalog/health/kv reads and writes serve
+        # straight from the device tensors: blocking ``?index=`` parks
+        # on the watch plane's apply index (snapshot flips wake it) and
+        # ``X-Consul-Index`` IS the device apply index. Agents without
+        # a write-attached plane fall through to the store tier
+        # untouched.
+        srv = getattr(self.agent, "serving", None)
+        if srv is not None and not dc and \
+                getattr(srv, "has_writes", lambda: False)():
+            hit = self._device_route(srv, method, parts, q, body,
+                                     min_index, wait_s)
+            if hit is not None:
+                return hit
 
         # ---- status ---------------------------------------------------
         if parts == ["status", "leader"]:

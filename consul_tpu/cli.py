@@ -995,9 +995,25 @@ def cmd_serve_bench(args) -> int:
 
     from consul_tpu.serving import MODE_NEAREST, ServingPlane
 
-    plane = ServingPlane(k=args.k, buckets=(args.batch,))
-    sim.attach_serving(plane)
+    # Plain serve-bench keeps the unlabeled plane; --mixed wants a
+    # non-trivial service space for register churn + watch fan-out.
+    services = args.services or (8 if args.mixed else 0)
+    plane = ServingPlane(k=args.k, buckets=(args.batch,),
+                         num_services=services)
+    sim.attach_serving(plane, writes=bool(args.mixed),
+                       kv_slots=args.kv_slots)
     rng = _random.Random(args.seed)
+
+    if args.mixed:
+        from consul_tpu.serving.mixed import run_mixed
+        mixed = run_mixed(sim, plane, ratio=args.mixed,
+                          rounds=args.mixed_rounds, read_batch=args.batch,
+                          watchers=args.watchers, seed=args.seed)
+        out = dict(plane.stats())
+        out.update({"n": args.n, "k": args.k, "batch": args.batch,
+                    "mixed": mixed})
+        print(json.dumps(out))
+        return 0
 
     def make_batch(b: int):
         return [(MODE_NEAREST, rng.randrange(args.n), -1) for _ in range(b)]
@@ -1153,6 +1169,20 @@ def build_parser() -> argparse.ArgumentParser:
                     help="result width (top-k nearest per query)")
     sv.add_argument("--serf", action="store_true",
                     help="serve over the full serf simulation")
+    sv.add_argument("--mixed", nargs="?", const="90:9:1", default=None,
+                    metavar="R:W:WATCH",
+                    help="run the mixed read/write/watch workload at "
+                         "this ratio (flag alone = 90:9:1); attaches "
+                         "the device write path and watch plane")
+    sv.add_argument("--mixed-rounds", type=int, default=32,
+                    help="interleaved rounds for --mixed")
+    sv.add_argument("--services", type=int, default=0,
+                    help="synthetic service label count for the plane "
+                         "(0: unlabeled, or 8 under --mixed)")
+    sv.add_argument("--kv-slots", type=int, default=256,
+                    help="device KV slot capacity (--mixed)")
+    sv.add_argument("--watchers", type=int, default=8,
+                    help="registered service watchers (--mixed)")
     sv.add_argument("--compile-cache", default=None, metavar="DIR",
                     help="persistent XLA compilation cache directory")
     add_mesh_flags(sv)

@@ -300,10 +300,17 @@ class Simulation:
         return shard_step.place(self.mesh, arr, self.cfg.n)
 
     # -- serving plane ---------------------------------------------------
-    def attach_serving(self, plane):
+    def attach_serving(self, plane, writes: bool = False,
+                       kv_slots: int = 256, **write_kw):
         """Attach a serving read plane (consul_tpu/serving): publishes
-        a snapshot now and republishes at every chunk boundary."""
+        a snapshot now and republishes at every chunk boundary. With
+        ``writes=True`` the device write path + watch plane come up
+        too (``plane.attach_writes``): batched catalog/KV/session
+        writes apply between chunks, become visible at flips, and
+        every flip carries the monotone device apply index."""
         plane.attach(self)
+        if writes:
+            plane.attach_writes(kv_slots=kv_slots, **write_kw)
 
     def publish_serving(self):
         """Republish the serving snapshot from current state (no-op

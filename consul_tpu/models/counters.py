@@ -68,6 +68,13 @@ class GossipCounters(NamedTuple):
     sentinel_suspicion: jax.Array       # timer/accuser-bitmask mismatches
     sentinel_nonfinite_coord: jax.Array  # NaN/Inf Vivaldi coordinate rows
     sentinel_nonfinite_rtt: jax.Array   # NaN/Inf RTT filter entries
+    # -- serving write plane (consul_tpu/serving/writes.py): applied
+    # device writes. The scan never touches this field; the
+    # WriteBatcher folds it host-side per batch through
+    # ``Simulation._fold_counter_deltas``, so the cumulative total IS
+    # the monotone device apply index — every counters_snapshot() and
+    # bench artifact carries the index its reads are consistent as of.
+    writes_applied: jax.Array           # serving writes applied on device
 
 
 FIELDS = GossipCounters._fields
@@ -102,6 +109,7 @@ METRIC_NAMES = {
     "sentinel_suspicion": "sim.sentinel.suspicion_violations",
     "sentinel_nonfinite_coord": "sim.sentinel.nonfinite_coordinates",
     "sentinel_nonfinite_rtt": "sim.sentinel.nonfinite_rtt",
+    "writes_applied": "sim.serving.writes_applied",
 }
 assert set(METRIC_NAMES) == set(FIELDS)
 

@@ -1,0 +1,400 @@
+"""Write-path and watch-delta kernels: batched catalog/KV/session
+writes applied on device, and per-flip snapshot diffs for watchers.
+
+This is the device tier of the serving *write* plane
+(``consul_tpu/serving/writes.py`` / ``watch.py``) — the write-side twin
+of ``ops/serving.py``. The host ``WriteBatcher`` coalesces concurrent
+register/deregister, KV put/delete, and session ops into fixed-shape
+:class:`WriteBatch` tensors (bucketed sizes, the ``models/cluster.py``
+memoization idiom) and each batch runs as ONE jitted leader-apply
+program here. A monotone raft-style **apply index** lives on device in
+:class:`WriteState`; every applied op gets the next index, and every
+snapshot flip carries the index it is consistent as of.
+
+Batch semantics (the raft-log contract, ``server/state_store.py``'s
+``_commit`` rule): ops apply in batch order, each applied op is
+assigned ``apply_index + (its 1-based rank among applied ops)``, and
+within one batch the last writer to a node/slot wins — exactly what a
+sequential host replay of the same log produces. The host references
+:func:`apply_writes_reference` / :func:`diff_snapshots_reference` ARE
+that sequential replay (plain numpy, state-store style); the
+golden-parity suite (tests/test_writes.py) pins the kernels to them
+exactly, single-device and sharded.
+
+Vectorization (lint-clean, no TH109 scatters): per-target last-writer
+selection is an O(B·N) one-hot rank-max — ``sel[b, t]`` marks applied
+ops addressing target ``t``, ``max_b sel·(b+1)`` finds the winning op,
+and plain gathers pull its op/arg/index. B is capped by the batcher's
+largest bucket (default 64), so the one-hot never dominates the [N]
+state it updates.
+
+Documented narrowings (COVERAGE.md "write/watch plane"): the device KV
+models one i32 payload word per key slot (the host ``KeyTable`` owns
+string-key -> slot allocation), and device sessions are one id per
+node with no KV lock coupling — state-store lock/CAS semantics stay on
+the host tier.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Write ops. NOOP fills padding slots (never applied, never indexed).
+OP_NOOP = 0
+OP_REGISTER = 1         # target = node, arg = service label (>= 0)
+OP_DEREGISTER = 2       # target = node
+OP_KV_PUT = 3           # target = kv slot, arg = i32 payload word
+OP_KV_DELETE = 4        # target = kv slot
+OP_SESSION_CREATE = 5   # target = node, arg = session id (>= 0)
+OP_SESSION_DESTROY = 6  # target = node
+
+# Delta kinds for changed-node rows (bitmask).
+CHANGE_SERVICE = 1      # service membership changed (label/registration)
+CHANGE_WENT_LIVE = 2    # health transition dead -> live
+CHANGE_WENT_DEAD = 4    # health transition live -> dead
+
+# Compaction sort-key sentinel (the ops/serving.py discipline: changed
+# rows keep their id order, unchanged rows never surface).
+_PAD_KEY = float(jnp.finfo(jnp.float32).max)
+
+
+class WriteState(NamedTuple):
+    """Device-resident write-side state, node axis N + KV slot axis S.
+
+    ``service``/``registered`` are the catalog truth the serving plane
+    publishes as snapshot labels at every flip (a registered node's
+    label is its service; an unregistered node reads as -1).
+    ``apply_index`` is the monotone raft-style index: bumped once per
+    applied op, stamped on every flip, surfaced as ``X-Consul-Index``.
+    """
+
+    service: jax.Array      # [N] i32 service label
+    registered: jax.Array   # [N] bool
+    session: jax.Array      # [N] i32 session id, -1 = none
+    kv_used: jax.Array      # [S] bool
+    kv_val: jax.Array       # [S] i32 payload word
+    kv_ver: jax.Array       # [S] i32 apply index of last mutation
+    apply_index: jax.Array  # [] i32 monotone apply index
+
+
+class WriteBatch(NamedTuple):
+    """One fixed-shape coalesced batch: ``op``/``target``/``arg`` are
+    [B] i32, padding slots are OP_NOOP."""
+
+    op: jax.Array
+    target: jax.Array
+    arg: jax.Array
+
+
+class DeltaFrame(NamedTuple):
+    """One flip-to-flip delta, fixed shape [K] (+ [] counts).
+
+    ``node_ids`` holds the first K changed node ids ascending (-1 pad);
+    ``node_kinds`` is the CHANGE_* bitmask per row; ``svc_prev`` /
+    ``svc_cur`` are the service labels either side of the flip (-1 =
+    unregistered) so service watchers of both the old and new label can
+    be routed. ``kv_slots``/``kv_vers`` list the first K changed KV
+    slots with their new version. Counts may exceed K — the watch plane
+    marks such frames truncated rather than capping silently.
+    """
+
+    node_ids: jax.Array      # [K] i32
+    node_kinds: jax.Array    # [K] i32 CHANGE_* bitmask
+    svc_prev: jax.Array      # [K] i32
+    svc_cur: jax.Array       # [K] i32
+    n_node_changes: jax.Array  # [] i32
+    kv_slots: jax.Array      # [K] i32
+    kv_vers: jax.Array       # [K] i32
+    n_kv_changes: jax.Array  # [] i32
+    apply_index: jax.Array   # [] i32 (the newer flip's index)
+    tick: jax.Array          # [] i32 (the newer snapshot's tick)
+
+
+def init_state(n: int, kv_slots: int, service=None) -> WriteState:
+    """Host-built initial WriteState (numpy; the caller device-places
+    it — ``cluster._place_node``-style — so [N] leaves shard instead of
+    replicating). Every sim seat starts registered with its synthetic
+    service label, so attaching a write plane changes NO read until the
+    first write lands."""
+    if service is None:
+        service = np.zeros(n, dtype=np.int32)
+    return WriteState(
+        service=np.asarray(service, dtype=np.int32),
+        registered=np.ones(n, dtype=bool),
+        session=np.full(n, -1, dtype=np.int32),
+        kv_used=np.zeros(kv_slots, dtype=bool),
+        kv_val=np.zeros(kv_slots, dtype=np.int32),
+        kv_ver=np.zeros(kv_slots, dtype=np.int32),
+        apply_index=np.int32(0),
+    )
+
+
+def _last_writer(sel: jax.Array):
+    """Per-target last-writer-wins over an applied-op selection matrix
+    ``sel [B, T]``: returns (has [T] bool, bi [T] i32) — whether any op
+    addressed the target, and the batch row of the LAST one that did.
+    Rank-max over ``(b+1)·sel`` instead of a scatter (TH109)."""
+    b = sel.shape[0]
+    rank = jnp.arange(1, b + 1, dtype=jnp.int32)
+    last = jnp.max(sel.astype(jnp.int32) * rank[:, None], axis=0)
+    return last > 0, jnp.maximum(last - 1, 0)
+
+
+def _apply_writes(ws: WriteState, batch: WriteBatch):
+    """One coalesced batch as one program; returns
+    ``(new_state, applied [B] bool, index [B] i32)``.
+
+    ``applied[i]`` is False for NOOP padding and out-of-range targets;
+    ``index[i]`` is the apply index assigned to op i (the state's index
+    after op i — unchanged for unapplied rows), so a write's HTTP
+    response can report the index its effect becomes visible at.
+    """
+    n = ws.service.shape[0]
+    s = ws.kv_used.shape[0]
+    op, tgt, arg = batch.op, batch.target, batch.arg
+
+    node_op = ((op == OP_REGISTER) | (op == OP_DEREGISTER)
+               | (op == OP_SESSION_CREATE) | (op == OP_SESSION_DESTROY))
+    kv_op = (op == OP_KV_PUT) | (op == OP_KV_DELETE)
+    needs_arg = (op == OP_REGISTER) | (op == OP_SESSION_CREATE)
+    in_range = jnp.where(node_op, (tgt >= 0) & (tgt < n),
+                         (tgt >= 0) & (tgt < s))
+    applied = (node_op | kv_op) & in_range & (~needs_arg | (arg >= 0))
+
+    # Per-op assigned index: apply_index + 1-based rank among applied.
+    opidx = ws.apply_index + jnp.cumsum(applied.astype(jnp.int32))
+
+    def family(width, in_family):
+        sel = (applied & in_family)[:, None] \
+            & (tgt[:, None] == jnp.arange(width, dtype=jnp.int32)[None, :])
+        has, bi = _last_writer(sel)
+        return has, op[bi], arg[bi], opidx[bi]
+
+    # Catalog family: register/deregister -> service + registered.
+    has, fop, farg, _ = family(
+        n, (op == OP_REGISTER) | (op == OP_DEREGISTER))
+    service = jnp.where(has & (fop == OP_REGISTER), farg, ws.service)
+    service = jnp.where(has & (fop == OP_DEREGISTER), jnp.int32(-1),
+                        service)
+    registered = jnp.where(has, fop == OP_REGISTER, ws.registered)
+
+    # Session family: one id per node (no KV lock coupling — see the
+    # module-docstring narrowing).
+    has, fop, farg, _ = family(
+        n, (op == OP_SESSION_CREATE) | (op == OP_SESSION_DESTROY))
+    session = jnp.where(has & (fop == OP_SESSION_CREATE), farg, ws.session)
+    session = jnp.where(has & (fop == OP_SESSION_DESTROY), jnp.int32(-1),
+                        session)
+
+    # KV family: slot-addressed put/delete; version = mutating op's
+    # index (deletes bump it too, the state-store table-index rule).
+    has, fop, farg, fidx = family(s, kv_op)
+    kv_val = jnp.where(has & (fop == OP_KV_PUT), farg, ws.kv_val)
+    kv_used = jnp.where(has, fop == OP_KV_PUT, ws.kv_used)
+    kv_ver = jnp.where(has, fidx, ws.kv_ver)
+
+    new = WriteState(
+        service=service, registered=registered, session=session,
+        kv_used=kv_used, kv_val=kv_val, kv_ver=kv_ver,
+        apply_index=ws.apply_index
+        + jnp.sum(applied.astype(jnp.int32)))
+    return new, applied, opidx
+
+
+# One jit object; jit's own shape cache yields one executable per
+# (B bucket, N, S) — the compile-ledger pin in tests/test_writes.py
+# holds steady-state writes to zero new compiles.
+apply_writes = jax.jit(_apply_writes)
+
+
+@jax.jit
+def labels_of(ws: WriteState) -> jax.Array:
+    """Snapshot service labels from write state: a registered node's
+    label is its service, an unregistered node reads -1 (filtered out
+    of every service-addressed query)."""
+    return jnp.where(ws.registered, ws.service, jnp.int32(-1))
+
+
+def _compact(changed: jax.Array, k: int):
+    """First k set indices of a bool mask, ascending, -1 padded, plus
+    the total count (may exceed k). Same top-k compaction as the read
+    kernels: key = id where changed else PAD, lower index wins ties."""
+    n = changed.shape[0]
+    kk = min(k, n)  # top_k caps at the axis length; pad back out to k
+    idx = jnp.arange(n, dtype=jnp.int32)
+    key = jnp.where(changed, idx.astype(jnp.float32),
+                    jnp.float32(_PAD_KEY))
+    _, ids = jax.lax.top_k(-key, kk)
+    if kk < k:
+        ids = jnp.concatenate(
+            [ids, jnp.zeros(k - kk, dtype=ids.dtype)])
+    count = jnp.sum(changed.astype(jnp.int32))
+    valid = jnp.arange(k, dtype=jnp.int32) < jnp.minimum(count, kk)
+    return jnp.where(valid, ids.astype(jnp.int32), jnp.int32(-1)), count, \
+        valid
+
+
+def _diff_snapshots(k: int, prev_snap, prev_ws: WriteState, cur_snap,
+                    cur_ws: WriteState) -> DeltaFrame:
+    """Everything that changed between two consecutive flips, as one
+    fixed-shape frame: changed-service membership (label or
+    registration), health transitions (snapshot ``live`` bit), and KV
+    slot changes (version or liveness). One kernel per flip, one
+    device_get in the watch plane, fan-out on the host."""
+    svc_prev = jnp.where(prev_ws.registered, prev_ws.service, jnp.int32(-1))
+    svc_cur = jnp.where(cur_ws.registered, cur_ws.service, jnp.int32(-1))
+    svc_changed = svc_prev != svc_cur
+    went_live = cur_snap.live & ~prev_snap.live
+    went_dead = prev_snap.live & ~cur_snap.live
+    node_changed = svc_changed | went_live | went_dead
+
+    ids, n_nodes, valid = _compact(node_changed, k)
+    safe = jnp.maximum(ids, 0)
+    kinds = (svc_changed[safe].astype(jnp.int32) * CHANGE_SERVICE
+             + went_live[safe].astype(jnp.int32) * CHANGE_WENT_LIVE
+             + went_dead[safe].astype(jnp.int32) * CHANGE_WENT_DEAD)
+    kinds = jnp.where(valid, kinds, 0)
+
+    kv_changed = (prev_ws.kv_ver != cur_ws.kv_ver) \
+        | (prev_ws.kv_used != cur_ws.kv_used)
+    slots, n_kv, kv_valid = _compact(kv_changed, k)
+    kv_safe = jnp.maximum(slots, 0)
+
+    return DeltaFrame(
+        node_ids=ids,
+        node_kinds=kinds,
+        svc_prev=jnp.where(valid, svc_prev[safe], jnp.int32(-1)),
+        svc_cur=jnp.where(valid, svc_cur[safe], jnp.int32(-1)),
+        n_node_changes=n_nodes,
+        kv_slots=slots,
+        kv_vers=jnp.where(kv_valid, cur_ws.kv_ver[kv_safe], jnp.int32(0)),
+        n_kv_changes=n_kv,
+        apply_index=cur_ws.apply_index,
+        tick=cur_snap.tick,
+    )
+
+
+# One jit object per frame width k (the ops/serving.py kernel-cache
+# idiom); shapes then memoize inside jit.
+_DIFF_CACHE: dict[int, object] = {}
+
+
+def diff_kernel_for(k: int):
+    """Memoized jitted flip-differ for frame width ``k``."""
+    fn = _DIFF_CACHE.get(k)
+    if fn is None:
+        fn = _DIFF_CACHE[k] = jax.jit(functools.partial(_diff_snapshots, k))
+    return fn
+
+
+# ----------------------------------------------------------------------
+# Host references (golden parity, the server/rtt.py contract shape):
+# plain numpy, sequential per-op replay in state-store style. The
+# kernels above are pinned to these EXACTLY by tests/test_writes.py.
+# ----------------------------------------------------------------------
+
+def apply_writes_reference(ws: WriteState, batch: WriteBatch):
+    """Sequential host replay of one batch: ops in order, one global
+    modify index per applied op (``state_store._commit`` semantics),
+    last writer wins by construction. Returns the same
+    ``(new_state, applied, index)`` triple as the kernel, numpy-typed.
+    """
+    service = np.array(ws.service, dtype=np.int32, copy=True)
+    registered = np.array(ws.registered, dtype=bool, copy=True)
+    session = np.array(ws.session, dtype=np.int32, copy=True)
+    kv_used = np.array(ws.kv_used, dtype=bool, copy=True)
+    kv_val = np.array(ws.kv_val, dtype=np.int32, copy=True)
+    kv_ver = np.array(ws.kv_ver, dtype=np.int32, copy=True)
+    index = int(ws.apply_index)
+    n, s = len(service), len(kv_used)
+
+    ops = np.asarray(batch.op, dtype=np.int32)
+    tgts = np.asarray(batch.target, dtype=np.int32)
+    args = np.asarray(batch.arg, dtype=np.int32)
+    applied = np.zeros(len(ops), dtype=bool)
+    opidx = np.zeros(len(ops), dtype=np.int32)
+
+    for i, (op, tgt, arg) in enumerate(zip(ops, tgts, args)):
+        ok = False
+        if op in (OP_REGISTER, OP_DEREGISTER,
+                  OP_SESSION_CREATE, OP_SESSION_DESTROY):
+            ok = 0 <= tgt < n and (
+                op not in (OP_REGISTER, OP_SESSION_CREATE) or arg >= 0)
+            if ok:
+                index += 1
+                if op == OP_REGISTER:
+                    service[tgt], registered[tgt] = arg, True
+                elif op == OP_DEREGISTER:
+                    service[tgt], registered[tgt] = -1, False
+                elif op == OP_SESSION_CREATE:
+                    session[tgt] = arg
+                else:
+                    session[tgt] = -1
+        elif op in (OP_KV_PUT, OP_KV_DELETE):
+            ok = 0 <= tgt < s
+            if ok:
+                index += 1
+                if op == OP_KV_PUT:
+                    kv_used[tgt], kv_val[tgt] = True, arg
+                else:
+                    kv_used[tgt] = False
+                kv_ver[tgt] = index
+        applied[i] = ok
+        opidx[i] = index
+
+    new = WriteState(service=service, registered=registered,
+                     session=session, kv_used=kv_used, kv_val=kv_val,
+                     kv_ver=kv_ver, apply_index=np.int32(index))
+    return new, applied, opidx
+
+
+def diff_snapshots_reference(k: int, prev_snap, prev_ws, cur_snap,
+                             cur_ws) -> DeltaFrame:
+    """Host replay of the flip diff: same frame, numpy-typed."""
+    svc_prev = np.where(np.asarray(prev_ws.registered),
+                        np.asarray(prev_ws.service), -1).astype(np.int32)
+    svc_cur = np.where(np.asarray(cur_ws.registered),
+                       np.asarray(cur_ws.service), -1).astype(np.int32)
+    prev_live = np.asarray(prev_snap.live)
+    cur_live = np.asarray(cur_snap.live)
+    svc_changed = svc_prev != svc_cur
+    went_live = cur_live & ~prev_live
+    went_dead = prev_live & ~cur_live
+    node_changed = svc_changed | went_live | went_dead
+
+    ids = np.flatnonzero(node_changed).astype(np.int32)
+    n_nodes = len(ids)
+    ids = ids[:k]
+    node_ids = np.full(k, -1, dtype=np.int32)
+    node_ids[:len(ids)] = ids
+    kinds = np.zeros(k, dtype=np.int32)
+    kinds[:len(ids)] = (svc_changed[ids] * CHANGE_SERVICE
+                        + went_live[ids] * CHANGE_WENT_LIVE
+                        + went_dead[ids] * CHANGE_WENT_DEAD)
+    sp = np.full(k, -1, dtype=np.int32)
+    sc = np.full(k, -1, dtype=np.int32)
+    sp[:len(ids)] = svc_prev[ids]
+    sc[:len(ids)] = svc_cur[ids]
+
+    kv_changed = (np.asarray(prev_ws.kv_ver) != np.asarray(cur_ws.kv_ver)) \
+        | (np.asarray(prev_ws.kv_used) != np.asarray(cur_ws.kv_used))
+    kslots = np.flatnonzero(kv_changed).astype(np.int32)
+    n_kv = len(kslots)
+    kslots = kslots[:k]
+    kv_slots = np.full(k, -1, dtype=np.int32)
+    kv_slots[:len(kslots)] = kslots
+    kv_vers = np.zeros(k, dtype=np.int32)
+    kv_vers[:len(kslots)] = np.asarray(cur_ws.kv_ver)[kslots]
+
+    return DeltaFrame(
+        node_ids=node_ids, node_kinds=kinds, svc_prev=sp, svc_cur=sc,
+        n_node_changes=np.int32(n_nodes), kv_slots=kv_slots,
+        kv_vers=kv_vers, n_kv_changes=np.int32(n_kv),
+        apply_index=np.asarray(cur_ws.apply_index, dtype=np.int32),
+        tick=np.asarray(cur_snap.tick, dtype=np.int32),
+    )

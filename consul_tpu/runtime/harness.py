@@ -125,7 +125,19 @@ def _scenario_meta(sim, tag: str, ticks: int, t0: int, done: int,
         # trajectory's identity is device-count-agnostic, which is
         # exactly what lets a smaller mesh pick it up.
         "mesh_devices": _placement_width(sim.state),
+        # Serving write-plane provenance (also not matched): the device
+        # apply index the last snapshot flip was consistent as of, so a
+        # checkpoint records which writes its reads had seen. None when
+        # no write-attached plane rides the sim.
+        "serving_apply_index": _serving_apply_index(sim),
     }
+
+
+def _serving_apply_index(sim):
+    plane = getattr(sim, "serving", None)
+    if plane is None or not getattr(plane, "has_writes", lambda: False)():
+        return None
+    return int(plane.apply_index)
 
 
 def hang_dump_path(dump_dir: str, t: int) -> str:

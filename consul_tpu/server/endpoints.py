@@ -209,6 +209,23 @@ class Server:
     # ------------------------------------------------------------------
     # Status endpoint (reference agent/consul/status_endpoint.go)
     # ------------------------------------------------------------------
+    def _serving_apply_index(self) -> int:
+        """``Serving.ApplyIndex``: the attached device plane's monotone
+        raft-style apply index — the ``X-Consul-Index`` a write-attached
+        plane serves blocking queries against (consul_tpu/serving/
+        watch.py). 0 when no plane (or no write path) is attached."""
+        srv = self.serving
+        if srv is None or not getattr(srv, "has_writes",
+                                      lambda: False)():
+            return 0
+        return int(srv.apply_index)
+
+    def _serving_stats(self) -> Optional[dict]:
+        """``Serving.Stats``: the attached plane's flat stats dict
+        (query/write batch counters, watch fan-out tallies) — None when
+        no plane is attached."""
+        return None if self.serving is None else self.serving.stats()
+
     def _status_leader(self) -> Optional[str]:
         return self.raft.leader_id
 

@@ -7,15 +7,31 @@ kernel (``ops/serving.py``) against a double-buffered device snapshot
 (:class:`ServingPlane`), and results fan back out to waiters. Host
 ``server/rtt.py`` remains the documented reference implementation —
 the device path is pinned to it by the golden-parity suite.
+
+The write-side twin (``ServingPlane.attach_writes``): a
+:class:`WriteBatcher` coalesces catalog/KV/session writes into
+fixed-shape batches applied on device between flips (``ops/deltas.py``,
+monotone raft-style apply index), and a :class:`WatchPlane` serves
+blocking queries and watches as device-computed deltas between
+consecutive snapshot flips. Both batchers run bounded queues with
+drop/shed admission control; ``ServingPlane.close()`` wakes every
+parked waiter with :class:`ServingClosedError`.
 """
 
 from consul_tpu.ops.serving import (MODE_CATALOG, MODE_DIST, MODE_HEALTH,
                                     MODE_NEAREST, MODE_NOOP, Snapshot)
-from consul_tpu.serving.batcher import QueryBatcher, QueryResult
+from consul_tpu.serving.batcher import (QueryBatcher, QueryResult,
+                                        ServingClosedError,
+                                        ServingOverloadError)
 from consul_tpu.serving.plane import NearestResult, ServingPlane
+from consul_tpu.serving.watch import Watcher, WatchEvent, WatchPlane
+from consul_tpu.serving.writes import (KeyTable, WriteBatcher,
+                                       WriteResult)
 
 __all__ = [
     "MODE_CATALOG", "MODE_DIST", "MODE_HEALTH", "MODE_NEAREST", "MODE_NOOP",
-    "NearestResult", "QueryBatcher", "QueryResult", "ServingPlane",
-    "Snapshot",
+    "KeyTable", "NearestResult", "QueryBatcher", "QueryResult",
+    "ServingClosedError", "ServingOverloadError", "ServingPlane",
+    "Snapshot", "Watcher", "WatchEvent", "WatchPlane", "WriteBatcher",
+    "WriteResult",
 ]

@@ -29,6 +29,19 @@ import numpy as np
 from consul_tpu.ops import serving as kernels
 
 
+class ServingClosedError(RuntimeError):
+    """The serving plane (or one of its batchers) has been closed:
+    parked waiters are woken with this, and new submits are rejected
+    with it — the ``agent/cache.py`` CacheClosedError discipline."""
+
+
+class ServingOverloadError(RuntimeError):
+    """Admission control rejected a submit: the bounded pending queue
+    is full and the batcher's policy is ``reject`` (callers retry with
+    backoff; the ``shed_oldest`` policy drops the oldest waiter
+    instead and admits the new one)."""
+
+
 class QueryResult(NamedTuple):
     """One query's answer: ``ids[i]``/``rtts[i]`` for i < count are the
     result rows (node indices and estimated RTT seconds, +inf for
@@ -43,7 +56,7 @@ class QueryResult(NamedTuple):
 
 
 class _Waiter:
-    __slots__ = ("mode", "src", "arg", "done", "result")
+    __slots__ = ("mode", "src", "arg", "done", "result", "error")
 
     def __init__(self, mode: int, src: int, arg: int):
         self.mode = mode
@@ -51,6 +64,7 @@ class _Waiter:
         self.arg = arg
         self.done = threading.Event()
         self.result: Optional[QueryResult] = None
+        self.error: Optional[Exception] = None
 
 
 class QueryBatcher:
@@ -70,6 +84,7 @@ class QueryBatcher:
         self.max_wait_s = float(max_wait_s)
         self._lock = threading.Lock()
         self._pending: list[_Waiter] = []
+        self._closed = False
         # Plain-int counters mirror the sink emissions so stats() works
         # without a sink attached.
         self.batches = 0
@@ -145,6 +160,8 @@ class QueryBatcher:
         whole pending set as one batch, fanning results back."""
         w = _Waiter(int(mode), int(src), int(arg))
         with self._lock:
+            if self._closed:
+                raise ServingClosedError("serving plane is closed")
             self._pending.append(w)
             full = len(self._pending) >= self.max_batch
         if full:
@@ -154,6 +171,8 @@ class QueryBatcher:
             if time.monotonic() >= deadline:
                 raise TimeoutError("serving query timed out")
             self.pump()
+        if w.error is not None:
+            raise w.error
         assert w.result is not None
         return w.result
 
@@ -170,6 +189,26 @@ class QueryBatcher:
             w.result = r
             w.done.set()
         return len(batch)
+
+    # ------------------------------------------------------------------
+    # Shutdown (the agent/cache.py close discipline: wake every parked
+    # waiter, reject every new submit)
+    # ------------------------------------------------------------------
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        """Idempotent: mark closed, fail parked waiters with
+        :class:`ServingClosedError` (never leave a thread parked on a
+        plane that will not pump again), reject new submits."""
+        with self._lock:
+            self._closed = True
+            pending, self._pending = self._pending, []
+        for w in pending:
+            w.error = ServingClosedError("serving plane closed while "
+                                         "query was pending")
+            w.done.set()
 
     # ------------------------------------------------------------------
     # Stats

@@ -24,6 +24,7 @@ Two sources can feed a plane (one per instance, never both):
 from __future__ import annotations
 
 import math
+import threading
 from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
@@ -66,6 +67,18 @@ class ServingPlane:
         # reshard retargets the two-stage kernel automatically.
         self._mesh = None
         self.cache_hits = 0
+        self._sim = None
+        self._closed = False
+        # Write path (attach_writes): the PENDING device WriteState the
+        # WriteBatcher advances between flips, the (snapshot,
+        # write-state) pair captured AT the current flip (what readers
+        # and the watch diff see), and the host-side key table.
+        self.write_state = None
+        self.write_lock = threading.Lock()
+        self.writes = None   # WriteBatcher
+        self.watch = None    # WatchPlane
+        self.keys = None     # KeyTable
+        self._flip_pair = None  # (Snapshot, WriteState) as of last flip
         # Host-mode name table (publish_coords).
         self._names: tuple[str, ...] = ()
         self._name_idx: dict[str, int] = {}
@@ -99,6 +112,7 @@ class ServingPlane:
         if self._source == "host":
             raise RuntimeError("plane already serves host coordinates")
         self._source = "sim"
+        self._sim = sim
         if self.sink is None:
             self.sink = getattr(sim, "sink", None)
         sim.serving = self
@@ -112,13 +126,35 @@ class ServingPlane:
         self.publish_state(sim.swim_state)
 
     def publish_state(self, state) -> None:
-        import jax.numpy as jnp
-
         from consul_tpu.parallel.mesh import mesh_key
 
         n = state.alive_truth.shape[0]
+        if self.write_state is not None:
+            # Write plane attached: snapshot labels come from the
+            # device write state (the merge point — a write becomes
+            # visible to readers exactly here, at the flip). Capture
+            # the pending state atomically against concurrent batches.
+            from consul_tpu.ops import deltas as deltas_mod
+
+            with self.write_lock:
+                ws = self.write_state
+            snap = kernels.project(state, deltas_mod.labels_of(ws))
+            self._flip(snap)
+            prev = self._flip_pair
+            self._flip_pair = (snap, ws)
+            if self.watch is not None:
+                self.watch.on_flip(prev, self._flip_pair)
+            return
+        labels = self._synthetic_labels(n, mesh_key(self._mesh))
+        self._flip(kernels.project(state, labels))
+
+    def _synthetic_labels(self, n: int, mkey):
+        """Cached sim-mode service labels (node i -> i mod
+        num_services), node-axis placed under a mesh."""
+        import jax.numpy as jnp
+
         labels = self._service_labels
-        lk = (n, mesh_key(self._mesh))
+        lk = (n, mkey)
         if labels is None or self._labels_key != lk:
             if self.num_services > 1:
                 labels = (jnp.arange(n, dtype=jnp.int32)
@@ -134,7 +170,7 @@ class ServingPlane:
                 labels = shard_step.place(self._mesh, labels, n)
             self._service_labels = labels
             self._labels_key = lk
-        self._flip(kernels.project(state, labels))
+        return labels
 
     def kernel(self):
         """The batch executor the QueryBatcher runs: the two-stage
@@ -151,6 +187,184 @@ class ServingPlane:
             if n % shards == 0 and shards > 1:
                 return kernels.sharded_kernel_for(self.k, mesh)
         return kernels.kernel_for(self.k)
+
+    # ------------------------------------------------------------------
+    # Write path + watch plane (consul_tpu/serving/writes.py, watch.py)
+    # ------------------------------------------------------------------
+    def attach_writes(self, kv_slots: int = 256,
+                      buckets: Sequence[int] = (1, 8, 64),
+                      max_wait_s: float = 0.002, max_pending: int = 1024,
+                      policy: str = "reject", watch_k: int = 64,
+                      watch_queue: int = 256) -> None:
+        """Attach the device write path + watch plane to a sim-backed
+        plane: build the initial WriteState (every sim seat registered
+        with its synthetic label, so no read changes until the first
+        write), place its [N] leaves through the sim's node funnel
+        (``cluster._place_node`` — sharded under a mesh, never
+        replicated), and republish so the first flip carries it."""
+        import jax
+
+        from consul_tpu.ops import deltas as deltas_mod
+        from consul_tpu.serving.watch import WatchPlane
+        from consul_tpu.serving.writes import KeyTable, WriteBatcher
+
+        if self._source != "sim" or self._sim is None:
+            raise RuntimeError(
+                "write plane needs a sim-attached serving plane "
+                "(host-coordinate planes serve reads only)")
+        if self.write_state is not None:
+            raise RuntimeError("write plane already attached")
+        sim = self._sim
+        n = sim.cfg.n
+        labels = np.arange(n, dtype=np.int32) % max(self.num_services, 1)
+        host_ws = deltas_mod.init_state(n, kv_slots, service=labels)
+        place = getattr(sim, "_place_node", None)
+        if place is not None:
+            kv_used, kv_val, kv_ver, aidx = jax.device_put(
+                (host_ws.kv_used, host_ws.kv_val, host_ws.kv_ver,
+                 host_ws.apply_index))
+            ws = deltas_mod.WriteState(
+                service=place(host_ws.service),
+                registered=place(host_ws.registered),
+                session=place(host_ws.session),
+                kv_used=kv_used, kv_val=kv_val, kv_ver=kv_ver,
+                apply_index=aidx)
+        else:
+            ws = jax.device_put(host_ws)
+        self.write_state = ws
+        self.keys = KeyTable(kv_slots)
+        self.writes = WriteBatcher(self, buckets=buckets,
+                                   max_wait_s=max_wait_s,
+                                   max_pending=max_pending, policy=policy)
+        self.watch = WatchPlane(self, k=watch_k, max_queue=watch_queue)
+        self.publish(sim)
+
+    def has_writes(self) -> bool:
+        return self.write_state is not None
+
+    @property
+    def apply_index(self) -> int:
+        """The device apply index the CURRENT flip is consistent as of
+        (0 before the first write-attached flip) — what the HTTP tier
+        serves as ``X-Consul-Index``."""
+        return self.watch.apply_index if self.watch is not None else 0
+
+    def fold_write_counters(self, n_applied: int) -> None:
+        """Thread applied-write tallies into the attached sim's
+        GossipCounters fold: cumulative ``counters['writes_applied']``
+        equals the device apply index (and flows to the telemetry sink
+        under the METRIC_NAMES mapping like every device counter)."""
+        if n_applied and self._sim is not None:
+            fold = getattr(self._sim, "_fold_counter_deltas", None)
+            if fold is not None:
+                fold({"writes_applied": int(n_applied)})
+
+    # -- host-friendly write/read verbs (sim addressing) ----------------
+    def register(self, node: int, service: int, **kw):
+        """Catalog register: label ``node`` with ``service``. Visible
+        to reads at the next flip; the result carries the apply index
+        that flip will be consistent as of."""
+        from consul_tpu.ops import deltas as deltas_mod
+
+        return self.writes.submit(deltas_mod.OP_REGISTER, node, service,
+                                  **kw)
+
+    def deregister(self, node: int, **kw):
+        from consul_tpu.ops import deltas as deltas_mod
+
+        return self.writes.submit(deltas_mod.OP_DEREGISTER, node, **kw)
+
+    def kv_put(self, key: str, value: int, **kw):
+        """Device KV put: one i32 payload word per string key (the
+        documented ops/deltas.py narrowing). A full slot table is an
+        admission failure, not silence."""
+        from consul_tpu.ops import deltas as deltas_mod
+        from consul_tpu.serving.batcher import ServingOverloadError
+
+        slot = self.keys.slot_for(key, create=True)
+        if slot < 0:
+            self.writes.rejected += 1
+            if self.sink is not None:
+                self.sink.incr_counter("sim.serving.rejected", 1)
+            raise ServingOverloadError(
+                f"kv slot table full ({self.keys.slots} slots)")
+        return self.writes.submit(deltas_mod.OP_KV_PUT, slot, int(value),
+                                  **kw)
+
+    def kv_delete(self, key: str, **kw):
+        from consul_tpu.ops import deltas as deltas_mod
+
+        slot = self.keys.slot_for(key)
+        if slot < 0:
+            from consul_tpu.serving.writes import WriteResult
+
+            return WriteResult(applied=False, index=0, status="rejected")
+        return self.writes.submit(deltas_mod.OP_KV_DELETE, slot, **kw)
+
+    def session_create(self, node: int, session_id: int, **kw):
+        from consul_tpu.ops import deltas as deltas_mod
+
+        return self.writes.submit(deltas_mod.OP_SESSION_CREATE, node,
+                                  int(session_id), **kw)
+
+    def session_destroy(self, node: int, **kw):
+        from consul_tpu.ops import deltas as deltas_mod
+
+        return self.writes.submit(deltas_mod.OP_SESSION_DESTROY, node,
+                                  **kw)
+
+    def kv_get(self, key: str):
+        """Read one KV slot AS OF THE CURRENT FLIP (snapshot
+        semantics: a write between flips is not visible yet). Returns
+        ``{"Key", "Value", "ModifyIndex"}`` or None."""
+        import jax
+
+        slot = self.keys.slot_for(key) if self.keys is not None else -1
+        if slot < 0 or self._flip_pair is None:
+            return None
+        _, ws = self._flip_pair
+        used, val, ver = jax.device_get(
+            (ws.kv_used[slot], ws.kv_val[slot], ws.kv_ver[slot]))
+        if not bool(used):
+            return None
+        return {"Key": key, "Value": int(val), "ModifyIndex": int(ver)}
+
+    def node_entry(self, node: int):
+        """One node's catalog row as of the current flip:
+        ``{"Node", "Service", "Registered", "Session", "Live"}``."""
+        import jax
+
+        if self._flip_pair is None:
+            return None
+        snap, ws = self._flip_pair
+        n = ws.service.shape[0]
+        if not 0 <= int(node) < n:
+            return None
+        svc, reg, ses, live = jax.device_get(
+            (ws.service[node], ws.registered[node], ws.session[node],
+             snap.live[node]))
+        return {"Node": int(node), "Service": int(svc),
+                "Registered": bool(reg), "Session": int(ses),
+                "Live": bool(live)}
+
+    # ------------------------------------------------------------------
+    # Shutdown (satellite: the agent/cache.py close discipline, plumbed
+    # through Agent.close)
+    # ------------------------------------------------------------------
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        """Idempotent: close the query batcher, the write batcher, and
+        the watch plane — wake every parked waiter, reject every new
+        submit with ServingClosedError."""
+        self._closed = True
+        self.batcher.close()
+        if self.writes is not None:
+            self.writes.close()
+        if self.watch is not None:
+            self.watch.close()
 
     # ------------------------------------------------------------------
     # Host-coordinate publication (server store rows)
@@ -366,4 +580,11 @@ class ServingPlane:
     def stats(self) -> dict:
         out = self.batcher.stats()
         out["cache_hits"] = self.cache_hits
+        # Flat keys: stats() feeds consul.serving.* gauges one scalar
+        # per key (agent/http.py metrics loop).
+        if self.writes is not None:
+            for k, v in self.writes.stats().items():
+                out[k if k.startswith("write") else f"write_{k}"] = v
+        if self.watch is not None:
+            out.update(self.watch.stats())
         return out

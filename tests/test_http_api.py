@@ -1079,3 +1079,106 @@ class TestSemaphoreRecipe:
                         timeout=5.0)
         s4.release()
         sems[2].release()
+
+
+class TestBlockingIndex:
+    """?index= blocking against the DEVICE apply index (the write-
+    attached serving plane): X-Consul-Index is the raft-style apply
+    index a snapshot flip is consistent as of, and the blocking
+    contract matches the reference blockingQuery — immediate when the
+    index has advanced, parked until a flip otherwise, never a smaller
+    index than called with. Served by HTTPApi.handle directly (the
+    httptest idiom) over a dedicated small sim."""
+
+    @pytest.fixture(scope="class")
+    def device_api(self):
+        from consul_tpu.config import SimConfig
+        from consul_tpu.models.cluster import Simulation
+        from consul_tpu.serving import ServingPlane
+
+        sim = Simulation(SimConfig(n=16, view_degree=4), seed=7)
+        sim.run(16, chunk=8, with_metrics=False)
+        plane = ServingPlane(k=8, num_services=4)
+        sim.attach_serving(plane, writes=True, kv_slots=16)
+        agent = Agent("dev-agent", "10.42.0.1",
+                      lambda method, **kw: {}, cluster_size=1)
+        agent.attach_serving(plane)
+        api = HTTPApi(agent)
+        yield sim, plane, api
+        plane.close()
+
+    @staticmethod
+    def _advance(sim, plane):
+        from consul_tpu.ops import deltas
+        plane.writes.execute([(deltas.OP_SESSION_CREATE, 1, 42)])
+        sim.publish_serving()
+
+    def test_index_zero_returns_immediately(self, device_api):
+        sim, plane, api = device_api
+        self._advance(sim, plane)
+        t0 = time.monotonic()
+        status, rows, hdrs = api.handle(
+            "GET", "/v1/catalog/nodes", {"index": ["0"]}, b"")
+        assert status == 200 and rows
+        assert time.monotonic() - t0 < 1.0
+        assert int(hdrs["X-Consul-Index"]) == plane.apply_index >= 1
+
+    def test_advanced_index_answers_without_parking(self, device_api):
+        sim, plane, api = device_api
+        self._advance(sim, plane)
+        cur = plane.apply_index
+        t0 = time.monotonic()
+        status, _, hdrs = api.handle(
+            "GET", "/v1/catalog/nodes",
+            {"index": [str(cur - 1)], "wait": ["5s"]}, b"")
+        assert status == 200
+        assert time.monotonic() - t0 < 1.0
+        assert int(hdrs["X-Consul-Index"]) >= cur
+
+    def test_parks_until_flip_advances_index(self, device_api):
+        sim, plane, api = device_api
+        cur = plane.apply_index
+
+        def later():
+            time.sleep(0.05)
+            self._advance(sim, plane)
+
+        t = threading.Thread(target=later)
+        t.start()
+        t0 = time.monotonic()
+        status, _, hdrs = api.handle(
+            "GET", "/v1/catalog/nodes",
+            {"index": [str(cur)], "wait": ["10s"]}, b"")
+        t.join()
+        assert status == 200
+        assert time.monotonic() - t0 >= 0.03  # actually parked
+        assert int(hdrs["X-Consul-Index"]) > cur
+
+    def test_timeout_never_returns_smaller_index(self, device_api):
+        _, plane, api = device_api
+        target = plane.apply_index + 10_000
+        status, _, hdrs = api.handle(
+            "GET", "/v1/catalog/nodes",
+            {"index": [str(target)], "wait": ["50ms"]}, b"")
+        assert status == 200
+        assert int(hdrs["X-Consul-Index"]) >= target
+
+    def test_write_response_carries_visibility_index(self, device_api):
+        """A device KV PUT answers with the apply index its effect
+        becomes visible at; the read after the flip carries an index
+        at least that large (watch-plane parity with test_writes)."""
+        sim, plane, api = device_api
+        status, ok, hdrs = api.handle(
+            "PUT", "/v1/kv/blocking/word", {}, b"7")
+        assert status == 200 and ok is True
+        windex = int(hdrs["X-Consul-Index"])
+        # Invisible until the flip: snapshot reads still 404.
+        status, _, _ = api.handle(
+            "GET", "/v1/kv/blocking/word", {"index": ["0"]}, b"")
+        assert status == 404
+        sim.publish_serving()
+        status, rows, hdrs = api.handle(
+            "GET", "/v1/kv/blocking/word", {"index": ["0"]}, b"")
+        assert status == 200 and rows[0]["Value"] == 7
+        assert rows[0]["ModifyIndex"] == windex
+        assert int(hdrs["X-Consul-Index"]) >= windex

@@ -1,0 +1,584 @@
+"""Device write path + watch plane tests (consul_tpu/serving/writes.py,
+watch.py, ops/deltas.py).
+
+Golden parity pins the jitted kernels to their sequential host
+references EXACTLY (the server/rtt.py contract shape) — single-device
+AND sharded over the 8-device virtual CPU mesh. The behavioral suites
+cover the flip-boundary visibility contract (a write is invisible to
+readers until the next snapshot flip), the monotone apply index, the
+WriteBatcher's park-and-pump coalescing and admission policies, the
+watch plane's per-flip delta fan-out, and the shared close discipline
+(ServingClosedError everywhere, plumbed through Agent.close). The
+compile-ledger pin holds steady-state write/flip/fan-out traffic to
+zero new executables."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from consul_tpu.config import SimConfig
+from consul_tpu.models.cluster import Simulation
+from consul_tpu.ops import deltas
+from consul_tpu.ops.serving import Snapshot
+from consul_tpu.parallel import mesh as pmesh
+from consul_tpu.parallel import shard_step
+from consul_tpu.serving import (ServingClosedError, ServingOverloadError,
+                                ServingPlane)
+from consul_tpu.serving.watch import Watcher
+from consul_tpu.serving.writes import WriteBatcher
+
+N = 32
+N_DEV = 8
+
+
+@pytest.fixture(scope="module")
+def wsim():
+    """One formed sim with a write-attached plane, shared by the
+    behavioral suites (tests assert relative change, never absolute
+    apply-index values, so ordering within the module is free)."""
+    sim = Simulation(SimConfig(n=N, view_degree=8), seed=3)
+    sim.run(32, chunk=16, with_metrics=False)
+    plane = ServingPlane(k=8, num_services=4)
+    sim.attach_serving(plane, writes=True, kv_slots=16)
+    yield sim, plane
+    plane.close()
+
+
+def _fresh_wsim(n=16, kv_slots=8, **attach_kw):
+    sim = Simulation(SimConfig(n=n, view_degree=4), seed=5)
+    sim.run(16, chunk=8, with_metrics=False)
+    plane = ServingPlane(k=8, num_services=4)
+    sim.attach_serving(plane, writes=True, kv_slots=kv_slots, **attach_kw)
+    return sim, plane
+
+
+def _rand_batch(rng, b, n, s):
+    """Random batch covering every op family plus NOOP padding,
+    out-of-range targets, and negative args."""
+    return deltas.WriteBatch(
+        op=rng.integers(0, 7, size=b).astype(np.int32),
+        target=rng.integers(-2, max(n, s) + 3, size=b).astype(np.int32),
+        arg=rng.integers(-3, 100, size=b).astype(np.int32),
+    )
+
+
+def _assert_state_equal(a, b):
+    for field in deltas.WriteState._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, field)), np.asarray(getattr(b, field)),
+            err_msg=f"WriteState.{field} diverged")
+
+
+def _assert_frame_equal(a, b):
+    for field in deltas.DeltaFrame._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, field)), np.asarray(getattr(b, field)),
+            err_msg=f"DeltaFrame.{field} diverged")
+
+
+def _snap(live, tick):
+    """Minimal snapshot for the diff kernel (which reads live + tick)."""
+    n = len(live)
+    return Snapshot(
+        vec=np.zeros((n, 2), dtype=np.float32),
+        height=np.zeros(n, dtype=np.float32),
+        adjustment=np.zeros(n, dtype=np.float32),
+        known=np.ones(n, dtype=bool),
+        live=np.asarray(live, dtype=bool),
+        service=np.zeros(n, dtype=np.int32),
+        tick=np.int32(tick),
+    )
+
+
+class TestGoldenParityApply:
+    """ops/deltas.apply_writes pinned EXACTLY to the sequential host
+    replay (apply_writes_reference): same state, same applied mask,
+    same per-op indexes — the raft-log batch contract."""
+
+    def test_random_batches_match_reference_exactly(self):
+        rng = np.random.default_rng(0)
+        n, s = 24, 8
+        ws_ref = deltas.init_state(n, s, service=np.arange(n) % 4)
+        ws_dev = jax.device_put(ws_ref)
+        for b in (4, 16, 16, 64, 16):
+            batch = _rand_batch(rng, b, n, s)
+            ws_ref, applied_ref, idx_ref = deltas.apply_writes_reference(
+                ws_ref, batch)
+            ws_dev, applied_dev, idx_dev = deltas.apply_writes(
+                ws_dev, jax.device_put(batch))
+            _assert_state_equal(ws_dev, ws_ref)
+            np.testing.assert_array_equal(np.asarray(applied_dev),
+                                          applied_ref)
+            np.testing.assert_array_equal(np.asarray(idx_dev), idx_ref)
+        assert int(np.asarray(ws_dev.apply_index)) > 0
+
+    def test_last_writer_wins_and_rank_indexes(self):
+        ws = jax.device_put(deltas.init_state(4, 2))
+        batch = deltas.WriteBatch(
+            op=np.array([deltas.OP_REGISTER, deltas.OP_KV_PUT,
+                         deltas.OP_DEREGISTER, deltas.OP_NOOP,
+                         deltas.OP_KV_PUT], dtype=np.int32),
+            target=np.array([1, 0, 1, 0, 0], dtype=np.int32),
+            arg=np.array([7, 11, -1, -1, 13], dtype=np.int32))
+        new, applied, idx = jax.device_get(deltas.apply_writes(ws, batch))
+        # Node 1: register then deregister in one batch -> deregistered.
+        assert not bool(new.registered[1])
+        assert int(new.service[1]) == -1
+        # Slot 0: two puts, last writer wins, version = last op's index.
+        assert int(new.kv_val[0]) == 13
+        assert int(new.kv_ver[0]) == 4
+        # Applied ops get 1-based ranks; the NOOP keeps the prior index.
+        np.testing.assert_array_equal(applied,
+                                      [True, True, True, False, True])
+        np.testing.assert_array_equal(idx, [1, 2, 3, 3, 4])
+        assert int(new.apply_index) == 4
+
+    def test_sharded_apply_matches_reference(self):
+        """Same batch against a node-axis-sharded WriteState (GSPMD
+        partitions the one-hot over N) — still bit-exact."""
+        mesh = Mesh(np.array(jax.devices()[:N_DEV]), (pmesh.NODE_AXIS,))
+        rng = np.random.default_rng(1)
+        n, s = 32, 8
+        host_ws = deltas.init_state(n, s, service=np.arange(n) % 4)
+        dev_ws = deltas.WriteState(
+            service=shard_step.place(mesh, host_ws.service, n),
+            registered=shard_step.place(mesh, host_ws.registered, n),
+            session=shard_step.place(mesh, host_ws.session, n),
+            kv_used=jax.device_put(host_ws.kv_used),
+            kv_val=jax.device_put(host_ws.kv_val),
+            kv_ver=jax.device_put(host_ws.kv_ver),
+            apply_index=jax.device_put(host_ws.apply_index))
+        ref = host_ws
+        for _ in range(3):
+            batch = _rand_batch(rng, 16, n, s)
+            ref, applied_ref, idx_ref = deltas.apply_writes_reference(
+                ref, batch)
+            dev_ws, applied_dev, idx_dev = deltas.apply_writes(
+                dev_ws, jax.device_put(batch))
+            _assert_state_equal(dev_ws, ref)
+            np.testing.assert_array_equal(np.asarray(applied_dev),
+                                          applied_ref)
+            np.testing.assert_array_equal(np.asarray(idx_dev), idx_ref)
+
+
+class TestGoldenParityDiff:
+    """ops/deltas.diff_snapshots pinned exactly to the host replay,
+    including counts beyond the frame width (truncation is a flag, not
+    a silent cap) and k > n."""
+
+    def _pairs(self, rng, n, s, n_batches=2):
+        ws0 = deltas.init_state(n, s, service=np.arange(n) % 4)
+        ws1 = ws0
+        for _ in range(n_batches):
+            ws1, _, _ = deltas.apply_writes_reference(
+                ws1, _rand_batch(rng, 16, n, s))
+        live0 = rng.random(n) < 0.8
+        live1 = live0 ^ (rng.random(n) < 0.3)
+        return (_snap(live0, 7), ws0), (_snap(live1, 9), ws1)
+
+    @pytest.mark.parametrize("k", [4, 16, 64])
+    def test_diff_matches_reference_exactly(self, k):
+        rng = np.random.default_rng(2)
+        (s0, w0), (s1, w1) = self._pairs(rng, 24, 8)
+        ref = deltas.diff_snapshots_reference(k, s0, w0, s1, w1)
+        dev = deltas.diff_kernel_for(k)(
+            jax.device_put(s0), jax.device_put(w0),
+            jax.device_put(s1), jax.device_put(w1))
+        _assert_frame_equal(jax.device_get(dev), ref)
+        if k == 4:
+            # Random churn over 24 nodes overflows a width-4 frame:
+            # the count survives truncation.
+            assert int(np.asarray(ref.n_node_changes)) > 4
+
+    def test_sharded_diff_matches_reference(self):
+        mesh = Mesh(np.array(jax.devices()[:N_DEV]), (pmesh.NODE_AXIS,))
+        rng = np.random.default_rng(3)
+        n = 32
+        (s0, w0), (s1, w1) = self._pairs(rng, n, 8)
+
+        def place_pair(snap, ws):
+            dsnap = Snapshot(
+                vec=shard_step.place(mesh, snap.vec, n),
+                height=shard_step.place(mesh, snap.height, n),
+                adjustment=shard_step.place(mesh, snap.adjustment, n),
+                known=shard_step.place(mesh, snap.known, n),
+                live=shard_step.place(mesh, snap.live, n),
+                service=shard_step.place(mesh, snap.service, n),
+                tick=jax.device_put(snap.tick))
+            dws = deltas.WriteState(
+                service=shard_step.place(mesh, ws.service, n),
+                registered=shard_step.place(mesh, ws.registered, n),
+                session=shard_step.place(mesh, ws.session, n),
+                kv_used=jax.device_put(ws.kv_used),
+                kv_val=jax.device_put(ws.kv_val),
+                kv_ver=jax.device_put(ws.kv_ver),
+                apply_index=jax.device_put(ws.apply_index))
+            return dsnap, dws
+
+        ref = deltas.diff_snapshots_reference(16, s0, w0, s1, w1)
+        dev = deltas.diff_kernel_for(16)(*place_pair(s0, w0),
+                                         *place_pair(s1, w1))
+        _assert_frame_equal(jax.device_get(dev), ref)
+
+
+class TestFlipVisibility:
+    """The snapshot-flip boundary IS the write visibility point, and
+    every flip carries a monotone apply index."""
+
+    def test_write_invisible_until_flip(self, wsim):
+        sim, plane = wsim
+        # Find a node currently outside service 2 and register it.
+        before = {node for node, _ in plane.catalog_nodes(2).nodes}
+        node = next(i for i in range(N) if i not in before)
+        res = plane.register(node, 2)
+        assert res.status == "applied"
+        # Applied on the pending state, but the published snapshot is
+        # still the pre-write flip: reads can't see it yet.
+        mid = {n_ for n_, _ in plane.catalog_nodes(2).nodes}
+        assert node not in mid
+        sim.publish_serving()
+        after = {n_ for n_, _ in plane.catalog_nodes(2).nodes}
+        assert node in after
+
+    def test_apply_index_monotone_and_stamped_on_flips(self, wsim):
+        sim, plane = wsim
+        seen = [plane.apply_index]
+        for i in range(3):
+            res = plane.register(i, 1)
+            assert res.index > seen[-1]
+            sim.publish_serving()
+            seen.append(plane.apply_index)
+            # The flip's index covers the write that preceded it.
+            assert seen[-1] >= res.index
+        assert seen == sorted(seen)
+
+    def test_counters_thread_the_apply_index(self, wsim):
+        """GossipCounters threading: cumulative writes_applied equals
+        the device apply index (host-side fold per batch)."""
+        sim, plane = wsim
+        plane.register(3, 1)
+        sim.publish_serving()
+        counters = sim.counters_snapshot()
+        dev_index = int(jax.device_get(plane.write_state.apply_index))
+        assert counters["writes_applied"] == dev_index
+        assert plane.apply_index == dev_index
+
+    def test_kv_reads_are_flip_consistent(self, wsim):
+        sim, plane = wsim
+        res = plane.kv_put("cfg/a", 41)
+        assert res.status == "applied"
+        assert plane.kv_get("cfg/a") is None  # not flipped yet
+        sim.publish_serving()
+        row = plane.kv_get("cfg/a")
+        assert row == {"Key": "cfg/a", "Value": 41,
+                       "ModifyIndex": res.index}
+        plane.kv_delete("cfg/a")
+        sim.publish_serving()
+        assert plane.kv_get("cfg/a") is None
+
+
+class TestWriteBatcher:
+    def test_execute_pads_to_bucket(self, wsim):
+        _, plane = wsim
+        wb = plane.writes
+        pad0, batches0 = wb.padded_slots, wb.write_batches
+        out = wb.execute([(deltas.OP_SESSION_CREATE, i, 100 + i)
+                          for i in range(5)])
+        assert [r.status for r in out] == ["applied"] * 5
+        assert wb.write_batches == batches0 + 1
+        assert wb.padded_slots == pad0 + 3  # bucket 8 holds 5 ops
+
+    def test_invalid_ops_reject_not_crash(self, wsim):
+        _, plane = wsim
+        rejected0 = plane.writes.rejected
+        out = plane.writes.execute([
+            (deltas.OP_REGISTER, N + 7, 1),      # out of range
+            (deltas.OP_REGISTER, 0, -1),         # register needs arg
+            (deltas.OP_KV_PUT, 10_000, 5),       # slot out of range
+        ])
+        assert [r.status for r in out] == ["rejected"] * 3
+        assert plane.writes.rejected == rejected0 + 3
+
+    def test_concurrent_submits_coalesce(self, wsim):
+        _, plane = wsim
+        wb = plane.writes
+        batches0 = wb.write_batches
+        results = [None] * 8
+        def go(i):
+            results[i] = wb.submit(deltas.OP_SESSION_CREATE, i, 500 + i)
+        threads = [threading.Thread(target=go, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(r.status == "applied" for r in results)
+        # Coalescing: strictly fewer batches than writes, and every op
+        # got a distinct monotone index.
+        assert wb.write_batches - batches0 < 8
+        assert len({r.index for r in results}) == 8
+
+    def test_reject_policy_raises_overload(self, wsim):
+        _, plane = wsim
+        wb = WriteBatcher(plane, buckets=(4,), max_pending=0,
+                          policy="reject")
+        with pytest.raises(ServingOverloadError):
+            wb.submit(deltas.OP_REGISTER, 1, 2)
+        assert wb.rejected == 1
+
+    def test_shed_oldest_policy_completes_victim(self, wsim):
+        _, plane = wsim
+        wb = WriteBatcher(plane, buckets=(4,), max_wait_s=0.5,
+                          max_pending=1, policy="shed_oldest")
+        results = {}
+        def first():
+            results["first"] = wb.submit(deltas.OP_REGISTER, 1, 2)
+        t = threading.Thread(target=first)
+        t.start()
+        deadline = time.monotonic() + 2.0
+        while not wb._pending and time.monotonic() < deadline:
+            time.sleep(0.001)
+        out = wb.submit(deltas.OP_REGISTER, 2, 3)
+        t.join(timeout=5.0)
+        assert results["first"].status == "shed"
+        assert not results["first"].applied
+        assert out.status == "applied"
+        assert wb.shed == 1
+
+    def test_kv_slot_exhaustion_is_overload(self):
+        _, plane = _fresh_wsim(kv_slots=2)
+        try:
+            plane.kv_put("a", 1)
+            plane.kv_put("b", 2)
+            with pytest.raises(ServingOverloadError):
+                plane.kv_put("c", 3)
+            # Slots are never recycled: a delete frees no slot (the
+            # watch-target stability rule), but re-putting an existing
+            # key reuses its slot.
+            plane.kv_delete("a")
+            assert plane.kv_put("a", 9).status == "applied"
+        finally:
+            plane.close()
+
+
+class TestWatchPlane:
+    def test_service_watch_sees_registration(self, wsim):
+        sim, plane = wsim
+        w = plane.watch.register("service", 3)
+        try:
+            before = {node for node, _ in plane.catalog_nodes(3).nodes}
+            node = next(i for i in range(N) if i not in before)
+            res = plane.register(node, 3)
+            sim.publish_serving()
+            ev = w.poll(timeout_s=5.0)
+            assert ev is not None and ev.kind == "service" and ev.key == 3
+            assert ev.index >= res.index
+            assert any(nid == node and kinds & deltas.CHANGE_SERVICE
+                       for nid, kinds in ev.changes)
+        finally:
+            plane.watch.unregister(w)
+
+    def test_service_watch_routes_old_and_new_label(self, wsim):
+        """A node moving service 1 -> 2 wakes watchers of BOTH labels
+        (the leave and the join are one membership change)."""
+        sim, plane = wsim
+        plane.register(9, 1)
+        sim.publish_serving()
+        w_old = plane.watch.register("service", 1)
+        w_new = plane.watch.register("service", 2)
+        try:
+            plane.register(9, 2)
+            sim.publish_serving()
+            ev_old = w_old.poll(timeout_s=5.0)
+            ev_new = w_new.poll(timeout_s=5.0)
+            for ev in (ev_old, ev_new):
+                assert ev is not None
+                assert any(nid == 9 for nid, _ in ev.changes)
+        finally:
+            plane.watch.unregister(w_old)
+            plane.watch.unregister(w_new)
+
+    def test_kv_prefix_watch(self, wsim):
+        sim, plane = wsim
+        w = plane.watch.register("kv_prefix", "app/")
+        try:
+            res = plane.kv_put("app/port", 8500)
+            plane.kv_put("other/key", 1)
+            sim.publish_serving()
+            ev = w.poll(timeout_s=5.0)
+            assert ev is not None and ev.key == "app/"
+            keys = {key for key, _ in ev.changes}
+            assert keys == {"app/port"}  # prefix-filtered
+            assert ("app/port", res.index) in ev.changes
+        finally:
+            plane.watch.unregister(w)
+
+    def test_bounded_queue_sheds_oldest(self):
+        w = Watcher("any", None, max_queue=2)
+        evs = [object(), object(), object()]
+        import consul_tpu.serving.watch as watch_mod
+        mk = lambda i: watch_mod.WatchEvent(
+            kind="any", key=None, index=i, tick=i, changes=(),
+            truncated=False)
+        assert w._offer(mk(1)) and w._offer(mk(2))
+        assert not w._offer(mk(3))  # full: evicts oldest = shed
+        assert w.dropped == 1
+        assert [ev.index for ev in w.queue] == [2, 3]  # newest survive
+
+    def test_truncated_frame_flags_watchers(self):
+        """More changed nodes than the frame width K: the event says
+        re-read, never a silent cap."""
+        sim, plane = _fresh_wsim(n=16, watch_k=4)
+        try:
+            w = plane.watch.register("any")
+            plane.writes.execute([(deltas.OP_DEREGISTER, i, -1)
+                                  for i in range(6)])
+            sim.publish_serving()
+            ev = w.poll(timeout_s=5.0)
+            assert ev is not None and ev.truncated
+            assert plane.watch.truncated_frames >= 1
+        finally:
+            plane.close()
+
+
+class TestWaitIndex:
+    def test_returns_immediately_when_advanced(self, wsim):
+        sim, plane = wsim
+        plane.register(0, 1)
+        sim.publish_serving()
+        cur = plane.apply_index
+        t0 = time.monotonic()
+        got = plane.watch.wait_index(cur - 1, wait_s=5.0)
+        assert time.monotonic() - t0 < 1.0
+        assert got >= cur
+
+    def test_parks_until_flip_advances(self, wsim):
+        sim, plane = wsim
+        cur = plane.apply_index
+
+        def later():
+            time.sleep(0.05)
+            plane.writes.execute([(deltas.OP_SESSION_CREATE, 2, 7)])
+            sim.publish_serving()
+
+        t = threading.Thread(target=later)
+        t.start()
+        t0 = time.monotonic()
+        got = plane.watch.wait_index(cur, wait_s=10.0)
+        t.join()
+        assert got > cur
+        assert time.monotonic() - t0 >= 0.03  # actually parked
+
+    def test_never_returns_smaller_than_called(self, wsim):
+        _, plane = wsim
+        target = plane.apply_index + 10_000
+        got = plane.watch.wait_index(target, wait_s=0.05)
+        assert got >= target
+
+
+class TestCloseSemantics:
+    """The agent/cache.py close discipline, shared by QueryBatcher,
+    WriteBatcher, and WatchPlane, plumbed through Agent.close."""
+
+    def test_close_rejects_new_work_everywhere(self):
+        _, plane = _fresh_wsim()
+        plane.close()
+        assert plane.closed and plane.batcher.closed \
+            and plane.writes.closed
+        with pytest.raises(ServingClosedError):
+            plane.batcher.submit(0, 0, -1)
+        with pytest.raises(ServingClosedError):
+            plane.writes.submit(deltas.OP_REGISTER, 0, 1)
+        with pytest.raises(ServingClosedError):
+            plane.watch.register("any")
+        # Idempotent.
+        plane.close()
+
+    def test_close_wakes_parked_writer(self):
+        _, plane = _fresh_wsim()
+        wb = WriteBatcher(plane, buckets=(4,), max_wait_s=5.0)
+        err = {}
+
+        def parked():
+            try:
+                wb.submit(deltas.OP_REGISTER, 1, 2, timeout_s=30.0)
+            except Exception as e:  # noqa: BLE001
+                err["e"] = e
+
+        t = threading.Thread(target=parked)
+        t.start()
+        deadline = time.monotonic() + 2.0
+        while not wb._pending and time.monotonic() < deadline:
+            time.sleep(0.001)
+        t0 = time.monotonic()
+        wb.close()
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        assert time.monotonic() - t0 < 2.0  # woke, not timed out
+        assert isinstance(err.get("e"), ServingClosedError)
+        plane.close()
+
+    def test_close_wakes_watchers_and_index_waiters(self):
+        _, plane = _fresh_wsim()
+        w = plane.watch.register("any")
+        got = {}
+
+        def poller():
+            got["ev"] = w.poll(timeout_s=30.0)
+
+        def blocker():
+            got["idx"] = plane.watch.wait_index(
+                plane.apply_index + 100, wait_s=30.0)
+
+        threads = [threading.Thread(target=poller),
+                   threading.Thread(target=blocker)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        plane.close()
+        for t in threads:
+            t.join(timeout=5.0)
+            assert not t.is_alive()
+        assert got["ev"] is None  # poll returns None on close
+
+    def test_agent_close_plumbs_through(self):
+        from consul_tpu.agent.agent import Agent
+
+        _, plane = _fresh_wsim()
+        agent = Agent("w-agent", "10.0.0.9",
+                      lambda method, **kw: {}, cluster_size=1)
+        agent.attach_serving(plane)
+        agent.close()
+        assert plane.closed and plane.batcher.closed \
+            and plane.writes.closed
+
+
+class TestCompileLedgerPin:
+    def test_steady_state_write_flip_fanout_zero_compiles(
+            self, compile_ledger):
+        sim, plane = _fresh_wsim()
+        try:
+            w = plane.watch.register("any")
+            ops = [(deltas.OP_SESSION_CREATE, i, i) for i in range(4)]
+            # Warm-up: the apply executable for this bucket, the
+            # projection + labels_of for the flip, and the diff kernel
+            # (which needs a second flip to have a prev pair).
+            plane.writes.execute(ops)
+            sim.publish_serving()
+            plane.writes.execute(ops)
+            sim.publish_serving()
+            with compile_ledger.expect(
+                    0, "steady-state writes/flips/fan-out reuse the "
+                       "warm apply + projection + diff executables"):
+                for _ in range(3):
+                    plane.writes.execute(ops)
+                    sim.publish_serving()
+                    plane.watch.wait_index(0, wait_s=0.1)
+                    while w.poll(timeout_s=0.01) is not None:
+                        pass
+        finally:
+            plane.close()
