@@ -125,7 +125,7 @@ def child(platform: str, deadline: float):
 
     import jax.numpy as jnp
 
-    from consul_tpu.config import SimConfig
+    from consul_tpu.config import SimConfig, clamp_view_degree
     from consul_tpu.models.cluster import Simulation
     from consul_tpu.parallel import mesh as pmesh
     from consul_tpu.utils import compile_cache
@@ -153,7 +153,8 @@ def child(platform: str, deadline: float):
         # default_mesh trims the device count to a divisor of n).
         # BENCH_DEVICES caps the mesh, BENCH_N_DC folds in a dc axis;
         # a single visible device keeps the exact single-device path.
-        cfg = SimConfig(n=n_nodes, view_degree=min(view_degree, n_nodes - 2))
+        cfg = SimConfig(n=n_nodes,
+                        view_degree=clamp_view_degree(n_nodes, view_degree))
         dc = device_count if device_count is not None else \
             (bench_devices or None)
         return cls(cfg, seed=0,
@@ -276,7 +277,7 @@ def child(platform: str, deadline: float):
     try:
         from consul_tpu.runtime import membudget
 
-        cfg_mem = SimConfig(n=n, view_degree=min(view_degree, n - 2))
+        cfg_mem = SimConfig(n=n, view_degree=clamp_view_degree(n, view_degree))
         layouts = {}
         for lay in ("dense", "packed"):
             per_kind = {}
@@ -333,6 +334,40 @@ def child(platform: str, deadline: float):
             del csim
     except Exception as e:
         _emit({"phase": "error", "where": "chaos", "error": repr(e)[:500]})
+
+    # Topology lab: sweep the same S-scenario fault grid against every
+    # registered view-graph family at equal degree (chaos/sweep.py) —
+    # the schedules stack on a vmapped scenario axis and the topology
+    # tables travel as program arguments, so the whole table runs in
+    # ONE executable per (n, degree, S, chunk) shared across families —
+    # and emit the bandwidth-vs-convergence Pareto table
+    # (bytes/tick/node vs time-to-heal) as a stable "topology" phase.
+    try:
+        if left() > 90:
+            from consul_tpu.chaos import sweep as sweep_mod
+
+            # n=1024 / settle=192 is the largest shape whose 4-family
+            # table fits the CPU child budget AND whose settle window
+            # outlasts the slowest family's heal tail — a too-short
+            # window rails time_to_heal at the window end for every
+            # family and erases the convergence axis (the n=4096
+            # version of this table lives in tests/test_sweep.py's
+            # slow acceptance drill, settle=320).
+            tn = int(os.environ.get("BENCH_TOPO_N", "1024"))
+            tdeg = int(os.environ.get("BENCH_TOPO_DEGREE", "16"))
+            tscen = int(os.environ.get("BENCH_TOPO_SCENARIOS", "16"))
+            tsettle = int(os.environ.get("BENCH_TOPO_SETTLE", "192"))
+            tfam = tuple(
+                f.strip() for f in os.environ.get(
+                    "BENCH_TOPO_FAMILIES",
+                    "circulant,expander,smallworld,hier").split(",")
+                if f.strip())
+            _emit({"phase": "topology",
+                   **sweep_mod.bench_pareto(
+                       n=tn, degree=tdeg, scenarios=tscen, families=tfam,
+                       settle=tsettle, seed=0)})
+    except Exception as e:
+        _emit({"phase": "error", "where": "topology", "error": repr(e)[:500]})
 
     # Elasticity drill: the chip-loss survival path end-to-end on a
     # small dedicated sim — preempt a resilient run after one chunk,
@@ -964,7 +999,7 @@ def _save_tpu_session(result):
 # while not_run + reason records the skip as a deliberate outcome.
 _PHASE_KEYS = ("northstar_1m", "northstar_1m_serf", "compile_cache",
                "elasticity", "memory", "serving", "serving_mixed",
-               "scaling_strong", "scaling_weak")
+               "scaling_strong", "scaling_weak", "topology")
 
 
 def _phase_or_not_run(phases, name, reason, pick=None):
@@ -1235,6 +1270,13 @@ def main():
         "scaling_weak": _phase_or_not_run(
             primary["phases"], "scaling_weak",
             "skipped: needs >1 visible device or time budget left"),
+        # Topology-lab Pareto table (chaos/sweep.py bench_pareto):
+        # bytes/tick/node vs time-to-heal per view-graph family at
+        # equal degree, swept over one vmapped scenario grid, plus
+        # which families strictly dominate the circulant default.
+        "topology": _phase_or_not_run(
+            primary["phases"], "topology",
+            "skipped: time budget exhausted or sweep errored"),
         # Mesh + prewarm provenance for the headline number: how many
         # devices the child saw, and what the AOT prewarm pass
         # compiled/deserialized before the timed phases.

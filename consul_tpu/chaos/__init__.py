@@ -1,6 +1,10 @@
 """Device-side chaos engine: declarative fault schedules compiled to
 tick-indexed device tensors, threaded through the jitted SWIM/serf scan
-as a program argument (see chaos/schedule.py)."""
+as a program argument (see chaos/schedule.py).
+
+``consul_tpu.chaos.sweep`` (the vmapped scenario-sweep plane) loads
+lazily: it imports models/cluster.py, which imports this package for
+the schedule types — eager re-export here would close the cycle."""
 
 from consul_tpu.chaos.schedule import (  # noqa: F401
     MAX_LINKS,
@@ -25,3 +29,11 @@ from consul_tpu.chaos.schedule import (  # noqa: F401
     static_key_of,
     unpack_terms,
 )
+
+
+def __getattr__(name):  # PEP 562: lazy, cycle-free sweep export
+    if name == "sweep":
+        import consul_tpu.chaos.sweep as _sweep
+
+        return _sweep
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,402 @@
+"""Vmapped adversarial scenario sweeps over program-argument topologies.
+
+Two compounding ideas, both about amortizing XLA executables:
+
+**Program-argument topology.** The standard chunk runner bakes the
+topology tables into the program (trace-time static roll shifts —
+models/cluster.py ``_topo_key``), which is the right call for a single
+long run but means every graph family costs a fresh compile. The sweep
+runner instead passes ``off``/``rcol``/``inv`` as *traced inputs* and
+rebuilds the ``Topology`` NamedTuple inside the jit: the roll sites in
+models/swim.py and ops/topology.py detect the traced offsets
+(``isinstance(off, jax.core.Tracer)``) and emit dynamic-shift rolls
+(parallel/collective.py handles both). Result: every same-shape family
+in consul_tpu/topo/families.py shares ONE executable — stronger than
+one-per-family, and what makes a 4-family Pareto table cheap.
+
+**Vmapped scenario axis.** The chaos engine already compiles fault
+schedules to tick-indexed tensors that enter the program as arguments
+(chaos/schedule.py). Stacking S same-shape schedules on a leading
+scenario axis and ``jax.vmap``-ing the chunk body over (schedule,
+state) runs dozens of Partition/ChurnWave/Degrade parameterizations in
+ONE executable launch, with per-scenario SLO counters
+(first-suspect/confirm/heal/false-deaths — models/counters.py chaos_*)
+reduced on device and fetched in a single [fields, S] transfer.
+
+Parity contract: per-tick keys are ``fold_in(base_key, t)`` — a
+function of the tick alone, not the scenario — so scenario ``s`` of a
+sweep consumes exactly the randomness the same schedule would consume
+in a solo :meth:`Simulation.run_scenario` replay from the same formed
+state; the SLO counters match the K independent runs *exactly*
+(tests/test_sweep.py, single-device and sharded).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.chaos import schedule as chaos_mod
+from consul_tpu.config import SimConfig, clamp_view_degree
+from consul_tpu.models import counters as counters_mod
+from consul_tpu.ops import topology
+from consul_tpu.parallel import mesh as pmesh
+
+# Estimated wire bytes for the Pareto bandwidth axis, mirroring the
+# reference msgpack encodings the 1400-byte UDP budget is divided by
+# (memberlist state.go/util.go): a compound-message frame per packet
+# plus ~33 encoded bytes per piggybacked alive/suspect/dead message.
+PACKET_OVERHEAD_BYTES = 12
+MSG_BYTES = 33
+
+# Process-wide memo for sweep runners, the chaos/sweep analogue of
+# models/cluster._RUNNER_CACHE. Keyed on *shape only* — the family
+# enters through runtime tensors, never the key — so families share.
+_SWEEP_CACHE: dict = {}
+
+
+def _shape_cfg(cfg: SimConfig) -> SimConfig:
+    """The family-free canonical config the sweep program is traced
+    with: the step math never reads ``topo_family``/``topo_param``
+    (only make_topology does), so erasing them from the memo key is
+    what lets same-shape families share one executable."""
+    return dataclasses.replace(cfg, topo_family="circulant", topo_param=0.0)
+
+
+def _sweep_runner(cfg: SimConfig, chunk: int, n_scen: int, chaos_key,
+                  step_fn, swim_of, mesh):
+    """One compiled sweep program:
+    ``run(world, off, rcol, inv, scheds, states, base_key) ->
+    (states, counters)`` with states/scheds stacked on a leading
+    scenario axis and counters returned as [S]-leaf pytrees. ``cfg``
+    must be the canonical family-free config (:func:`_shape_cfg`)."""
+    memo = ("sweep", cfg, chunk, n_scen, chaos_key, step_fn, swim_of,
+            pmesh.mesh_key(mesh))
+    hit = _SWEEP_CACHE.get(memo)
+    if hit is not None:
+        return hit
+
+    if mesh is not None:
+        from consul_tpu.parallel import shard_step
+
+        jitted = shard_step.make_sharded_sweep_runner(
+            cfg, mesh, chunk, step_fn=step_fn, swim_of=swim_of)
+        _SWEEP_CACHE[memo] = jitted
+        return jitted
+
+    def one(topo, world, sched, state, base_key):
+        ticks = swim_of(state).t + jnp.arange(chunk, dtype=jnp.int32)
+        tick_keys = jax.vmap(
+            lambda t: jax.random.fold_in(base_key, t))(ticks)
+
+        def body(carry, tick_key):
+            st, cnt = carry
+            st, c = step_fn(cfg, topo, world, st, tick_key, sched,
+                            sentinel=False)
+            return (st, counters_mod.add(cnt, c)), ()
+
+        (state, cnt), _ = jax.lax.scan(
+            body, (state, counters_mod.zeros()), tick_keys)
+        return state, cnt
+
+    def run(world, off, rcol, inv, scheds, states, base_key):
+        topo = topology.Topology(
+            n=cfg.n, dense=False, off=off, rcol=rcol, inv=inv)
+        return jax.vmap(
+            lambda sc, st: one(topo, world, sc, st, base_key)
+        )(scheds, states)
+
+    jitted = jax.jit(run, donate_argnums=(5,))
+    _SWEEP_CACHE[memo] = jitted
+    return jitted
+
+
+def _check_sim(sim):
+    if sim.topo.dense:
+        raise ValueError(
+            "chaos sweeps need the sparse view (view_degree > 0): "
+            "topology families only differ there — pass --view-degree "
+            "(an even K, e.g. 16)")
+    if getattr(sim, "layout", "dense") != "dense":
+        raise ValueError("chaos sweeps run on the dense state layout")
+
+
+def _compile_scenarios(sim, scenarios, ticks, settle):
+    """Compile + shape-check + rebase the scenario schedules onto the
+    sim's live tick (values only, exactly like run_scenario)."""
+    if not scenarios:
+        raise ValueError("empty scenario sweep")
+    scheds = [chaos_mod.compile_schedule(sim.cfg.n, ev) for ev in scenarios]
+    keys = {chaos_mod.static_key_of(s) for s in scheds}
+    if len(keys) != 1 or None in keys:
+        raise ValueError(
+            "sweep scenarios must share one schedule shape so they can "
+            f"stack into one executable; got shapes {sorted(map(str, keys))}"
+            " — pad the short ones with no-op entries (empty node slices"
+            " / zero loss rates)")
+    if ticks is None:
+        stops = [int(e.stop) for ev in scenarios for e in ev]
+        ticks = (max(stops) if stops else 0) + settle
+    t0 = sim._tick()
+    scheds = [chaos_mod.shift_schedule(s, t0) for s in scheds]
+    stack = jax.tree.map(lambda *ls: jnp.stack(ls), *scheds)
+    return stack, len(scheds), ticks, chaos_mod.static_key_of(scheds[0])
+
+
+def _stack_states(sim, n_scen: int):
+    return jax.tree.map(
+        lambda l: jnp.stack([l] * n_scen), sim.state)
+
+
+def run_sweep(sim, scenarios, *, ticks=None, chunk: int = 32,
+              settle: int = 64):
+    """Run S fault scenarios against ``sim``'s current state in one
+    vmapped executable; returns a list of S per-scenario dicts
+    ``{"slo": ..., "counters": ..., "ticks": ...}`` in input order.
+
+    ``scenarios`` is a sequence of event lists (Partition/LinkLoss/
+    ChurnWave/Degrade), all compiling to the same slot shape
+    (chaos/schedule.static_key_of). Each runs on its own copy of the
+    state — ``sim`` itself is not advanced — with start/stop rebased
+    onto the live tick, for ``ticks`` ticks (default: global max stop
+    + ``settle``). Counter semantics match
+    :meth:`Simulation.run_scenario` exactly (the parity pin)."""
+    from consul_tpu.models import cluster
+
+    _check_sim(sim)
+    sched_stack, n_scen, ticks, chaos_key = _compile_scenarios(
+        sim, scenarios, ticks, settle)
+    states = _stack_states(sim, n_scen)
+    cfg = _shape_cfg(sim.cfg)
+    topo = sim.topo
+    if sim.mesh is not None:
+        from consul_tpu.parallel import shard_step
+
+        sched_stack = shard_step.place_sweep(
+            sim.mesh, sched_stack, cfg.n)
+        states = shard_step.place_sweep(sim.mesh, states, cfg.n)
+
+    totals = None
+    remaining = ticks
+    while remaining > 0:
+        c = min(chunk, remaining)
+        runner = _sweep_runner(cfg, c, n_scen, chaos_key,
+                               type(sim)._step_fn, type(sim)._swim_of,
+                               sim.mesh)
+        states, cnt = runner(sim.world, topo.off, topo.rcol, topo.inv,
+                             sched_stack, states, sim.base_key)
+        totals = cnt if totals is None else counters_mod.add(totals, cnt)
+        remaining -= c
+
+    # One batched [fields, S] device->host transfer for the whole sweep.
+    vals = jax.device_get(counters_mod.stack(totals))
+    sim.sink.incr_counter("sim.sweep.runs", 1)
+    sim.sink.incr_counter("sim.sweep.scenarios", n_scen)
+    results = []
+    for s in range(n_scen):
+        deltas = {f: int(vals[i][s])
+                  for i, f in enumerate(counters_mod.FIELDS)}
+        slo = {cluster.SLO_KEYS[f]: deltas[f] for f in cluster.SLO_KEYS}
+        results.append({"slo": slo, "counters": deltas, "ticks": ticks})
+    return results
+
+
+def prewarm_sweep(sim, scenarios, *, ticks=None, chunk: int = 32,
+                  settle: int = 64) -> None:
+    """AOT-compile every sweep executable :func:`run_sweep` would bind
+    for (sim shape, S, chunk, ticks) — including the tail-remainder
+    chunk when ``chunk`` does not divide ``ticks`` — from abstract
+    state avals, no state advanced. Routed through the persistent
+    compile cache when enabled (utils/compile_cache.py), like
+    utils/prewarm.prewarm_simulation."""
+    from consul_tpu.utils.prewarm import _abstract
+
+    _check_sim(sim)
+    sched_stack, n_scen, ticks, chaos_key = _compile_scenarios(
+        sim, scenarios, ticks, settle)
+    cfg = _shape_cfg(sim.cfg)
+    if sim.mesh is not None:
+        from consul_tpu.parallel import shard_step
+
+        sched_stack = shard_step.place_sweep(sim.mesh, sched_stack, cfg.n)
+        states = _abstract(shard_step.place_sweep(
+            sim.mesh, _stack_states(sim, n_scen), cfg.n))
+    else:
+        states = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct((n_scen,) + l.shape, l.dtype),
+            sim.state)
+    topo = sim.topo
+    chunk_sizes = sorted({min(chunk, ticks), ticks % chunk or chunk})
+    for c in chunk_sizes:
+        runner = _sweep_runner(cfg, c, n_scen, chaos_key,
+                               type(sim)._step_fn, type(sim)._swim_of,
+                               sim.mesh)
+        runner.lower(
+            _abstract(sim.world), _abstract(topo.off), _abstract(topo.rcol),
+            _abstract(topo.inv), _abstract(sched_stack), states,
+            _abstract(sim.base_key),
+        ).compile()
+
+
+# ---------------------------------------------------------------------------
+# Scenario generators: the search space of the worst-case plane.
+
+def scenario_grid(n: int, count: int, *, start: int = 4):
+    """``count`` partition scenarios over a (fraction x duration) grid —
+    all one Partition slot, so the whole grid stacks into one sweep."""
+    fracs = [0.1, 0.2, 0.3, 0.45]
+    durs = [8, 12, 16, 24]
+    out = []
+    for i in range(count):
+        fr = fracs[i % len(fracs)]
+        du = durs[(i // len(fracs)) % len(durs)]
+        out.append([chaos_mod.Partition(
+            start=start, stop=start + du,
+            side_a=slice(0, max(1, int(n * fr))))])
+    return out
+
+
+def scenario_random(n: int, count: int, seed: int = 0, *, start: int = 4,
+                    max_dur: int = 24):
+    """``count`` seeded random compound scenarios, each one Partition +
+    one ChurnWave + one Degrade slot (no-op entries keep the shape
+    uniform when a draw lands at zero intensity)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(count):
+        fr = float(rng.uniform(0.05, 0.45))
+        du = int(rng.integers(6, max_dur + 1))
+        churn = int(n * float(rng.uniform(0.0, 0.2)))
+        tx_loss = float(rng.uniform(0.0, 0.5))
+        out.append([
+            chaos_mod.Partition(start=start, stop=start + du,
+                                side_a=slice(0, max(1, int(n * fr)))),
+            chaos_mod.ChurnWave(start=start, stop=start + du,
+                                nodes=slice(0, churn)),
+            chaos_mod.Degrade(start=start, stop=start + du,
+                              nodes=slice(0, max(1, n // 10)),
+                              tx_loss=tx_loss),
+        ])
+    return out
+
+
+def worst_case(results):
+    """Index of the worst scenario: slowest heal, then most false
+    deaths, then slowest detection — the argmax the sweep plane
+    searches for."""
+    def severity(r):
+        s = r["slo"]
+        return (s["time_to_heal"], s["false_positive_deaths"],
+                s["time_to_first_suspect"])
+
+    return max(range(len(results)), key=lambda i: severity(results[i]))
+
+
+# ---------------------------------------------------------------------------
+# Pareto table: bandwidth vs convergence per family.
+
+def wire_bytes_per_tick_node(counters: dict, ticks: int, n: int) -> float:
+    """Estimated gossip-plane wire bytes per tick per node over a
+    scenario window (the Pareto bandwidth axis): packets pay the
+    compound-frame overhead, each piggybacked message its encoded
+    size."""
+    total = (counters["gossip_tx"] * PACKET_OVERHEAD_BYTES
+             + counters["gossip_msgs_tx"] * MSG_BYTES)
+    return float(total) / float(max(1, ticks) * n)
+
+
+def pareto_table(per_family: dict) -> list:
+    """Rank family summaries on (bytes/tick/node, worst time-to-heal).
+    Adds ``dominated_by`` to each row (standard Pareto dominance:
+    <= on both axes, < on at least one). Rows sort by bytes."""
+    rows = [dict(family=fam, **d) for fam, d in per_family.items()]
+    for r in rows:
+        r["dominated_by"] = sorted(
+            o["family"] for o in rows
+            if o["family"] != r["family"]
+            and o["bytes_per_tick_node"] <= r["bytes_per_tick_node"]
+            and o["time_to_heal_worst"] <= r["time_to_heal_worst"]
+            and (o["bytes_per_tick_node"] < r["bytes_per_tick_node"]
+                 or o["time_to_heal_worst"] < r["time_to_heal_worst"]))
+    return sorted(rows, key=lambda r: r["bytes_per_tick_node"])
+
+
+def strict_dominators(per_family: dict, baseline: str = "circulant"):
+    """Families strictly better than ``baseline`` on BOTH axes (the
+    acceptance bar: lower bytes AND faster worst-case heal)."""
+    base = per_family.get(baseline)
+    if base is None:
+        return []
+    return sorted(
+        fam for fam, d in per_family.items()
+        if fam != baseline
+        and d["bytes_per_tick_node"] < base["bytes_per_tick_node"]
+        and d["time_to_heal_worst"] < base["time_to_heal_worst"])
+
+
+def family_sweep(sim, scenarios, *, ticks=None, chunk: int = 32,
+                 settle: int = 64) -> dict:
+    """Sweep one formed sim and fold the results into a JSON-ready
+    per-family summary row (the Pareto table input)."""
+    from consul_tpu.topo import spectral_gap
+
+    results = run_sweep(sim, scenarios, ticks=ticks, chunk=chunk,
+                        settle=settle)
+    ticks_run = results[0]["ticks"]
+    n = sim.cfg.n
+    byt = [wire_bytes_per_tick_node(r["counters"], ticks_run, n)
+           for r in results]
+    heal = [r["slo"]["time_to_heal"] for r in results]
+    wi = worst_case(results)
+    return {
+        "degree": sim.topo.degree,
+        "spectral_gap": round(
+            spectral_gap(np.asarray(sim.topo.off), n), 6),
+        "bytes_per_tick_node": round(float(np.mean(byt)), 3),
+        "time_to_heal_worst": int(max(heal)),
+        "time_to_heal_mean": round(float(np.mean(heal)), 2),
+        "worst_scenario": int(wi),
+        "worst_slo": dict(results[wi]["slo"]),
+        "scenarios": [
+            {"bytes_per_tick_node": round(float(b), 3), **r["slo"]}
+            for b, r in zip(byt, results)
+        ],
+    }
+
+
+def bench_pareto(*, n: int, degree: int, scenarios: int,
+                 families=("circulant", "expander", "smallworld", "hier"),
+                 seed: int = 0, form_ticks: int = 64, chunk: int = 32,
+                 settle: int = 64, mode: str = "grid",
+                 sweep_seed: int = 0, serf: bool = False,
+                 mesh=None) -> dict:
+    """The bench.py ``topology`` phase body (also reused by
+    ``consul-tpu chaos --sweep``): form one sim per family at equal
+    degree, run the same S-scenario sweep against each — every family
+    reuses ONE sweep executable (program-argument topology) — and emit
+    the bandwidth-vs-convergence Pareto table."""
+    from consul_tpu.models import cluster
+
+    cls = cluster.SerfSimulation if serf else cluster.Simulation
+    scens = (scenario_grid(n, scenarios) if mode == "grid"
+             else scenario_random(n, scenarios, seed=sweep_seed))
+    per_family = {}
+    for fam in families:
+        cfg = SimConfig(n=n, view_degree=clamp_view_degree(n, degree),
+                        topo_family=fam)
+        sim = cls(cfg, seed=seed, mesh=mesh)
+        sim.run(form_ticks, chunk=chunk, with_metrics=False)
+        per_family[fam] = family_sweep(sim, scens, chunk=chunk,
+                                       settle=settle)
+    return {
+        "n": int(n),
+        "degree": int(degree),
+        "scenario_count": int(scenarios),
+        "mode": mode,
+        "families": list(families),
+        "pareto": pareto_table(per_family),
+        "dominates_default": strict_dominators(per_family),
+    }

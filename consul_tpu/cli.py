@@ -750,7 +750,7 @@ def _build_sim(args):
     ``(sim, plan)``; ``plan`` is None on the legacy dense path, and
     ``plan.streamed`` means ``sim`` is a StreamedSimulation (cohorts
     through one device, no mesh/sentinel/serving)."""
-    from consul_tpu.config import SimConfig
+    from consul_tpu.config import SimConfig, clamp_view_degree
     from consul_tpu.models.cluster import (SerfSimulation, Simulation,
                                            StreamedSerfSimulation,
                                            StreamedSimulation)
@@ -760,7 +760,18 @@ def _build_sim(args):
         compile_cache.enable(args.compile_cache)
     else:
         compile_cache.maybe_enable_from_env()
-    cfg = SimConfig(n=args.n, view_degree=min(args.view_degree, args.n - 2))
+    # clamp_view_degree fails fast on an odd degree (the symmetric
+    # circulant constraint) and keeps the n-2 cap even — the old
+    # min(view_degree, n - 2) could produce an odd degree that
+    # make_topology rejected only after the argv had long scrolled by.
+    try:
+        vd = clamp_view_degree(args.n, args.view_degree)
+    except ValueError as e:
+        print(f"--view-degree: {e}", file=sys.stderr)
+        raise SystemExit(2)
+    cfg = SimConfig(n=args.n, view_degree=vd,
+                    topo_family=getattr(args, "family", "circulant"),
+                    topo_param=getattr(args, "family_param", 0.0))
     kind = "serf" if args.serf else "swim"
     mesh = _mesh_from_args(args, args.n)
     plan = _plan_from_args(args, cfg, kind, mesh)
@@ -860,6 +871,8 @@ def cmd_chaos(args) -> int:
     from consul_tpu import chaos as chaos_mod
 
     n = args.n
+    if args.sweep > 0:
+        return _cmd_chaos_sweep(args)
 
     def frac_nodes(frac):
         return slice(0, max(1, int(n * frac)))
@@ -919,6 +932,55 @@ def cmd_chaos(args) -> int:
     return _run_resilient_cmd(args, sim, events, ticks, extra)
 
 
+def _cmd_chaos_sweep(args) -> int:
+    """``consul-tpu chaos --sweep S``: run S scenario parameterizations
+    per family in ONE vmapped executable each (chaos/sweep.py) and
+    print the per-family worst cases plus the bandwidth-vs-convergence
+    Pareto table as one JSON line. Same-shape families share a single
+    program — the topology tables travel as program arguments — so the
+    whole table costs one compile per (n, degree, S, chunk)."""
+    from consul_tpu.chaos import sweep as sweep_mod
+    from consul_tpu.topo import FAMILIES
+
+    if args.families:
+        if args.families.strip() == "all":
+            families = [f for f in sorted(FAMILIES)
+                        if f != "hier" or args.n % 8 == 0]
+        else:
+            families = [f.strip() for f in args.families.split(",")
+                        if f.strip()]
+    else:
+        families = [args.family]
+    unknown = [f for f in families if f not in FAMILIES]
+    if unknown:
+        print(f"--families: unknown famil{'ies' if len(unknown) > 1 else 'y'}"
+              f" {', '.join(unknown)}; registered: "
+              f"{', '.join(sorted(FAMILIES))}", file=sys.stderr)
+        return 2
+
+    scens = (sweep_mod.scenario_grid(args.n, args.sweep)
+             if args.sweep_mode == "grid"
+             else sweep_mod.scenario_random(args.n, args.sweep,
+                                            seed=args.sweep_seed))
+    per_family = {}
+    for fam in families:
+        fam_args = argparse.Namespace(**vars(args))
+        fam_args.family = fam
+        sim, _plan = _build_sim(fam_args)
+        sim.run(args.form_ticks, chunk=args.chunk, with_metrics=False)
+        per_family[fam] = sweep_mod.family_sweep(
+            sim, scens, chunk=args.chunk, settle=args.settle)
+    print(json.dumps({
+        "n": args.n,
+        "sweep": args.sweep,
+        "mode": args.sweep_mode,
+        "families": families,
+        "pareto": sweep_mod.pareto_table(per_family),
+        "dominates_default": sweep_mod.strict_dominators(per_family),
+    }))
+    return 0
+
+
 def cmd_run(args) -> int:
     """Advance a plain local simulation under the resilient harness
     (no fault schedule — ``chaos`` is the faulted variant) and print
@@ -974,7 +1036,9 @@ def cmd_prewarm(args) -> int:
         mesh=mesh, device_count=args.devices, n_dc=args.n_dc,
         chaos=args.chaos, seed=args.seed, view_degree=args.view_degree,
         sentinel=args.sentinel, cache_dir=args.compile_cache,
-        layout=args.layout,
+        layout=args.layout, family=args.family,
+        family_param=args.family_param, sweep=args.sweep,
+        sweep_chunk=args.sweep_chunk,
     )
     print(json.dumps(summary))
     return 0
@@ -1122,6 +1186,20 @@ def build_parser() -> argparse.ArgumentParser:
                              "populations beyond it stream as node "
                              "cohorts through one device")
 
+    def add_family_flags(sp):
+        # Topology-lab knobs (consul_tpu/topo): which view-graph family
+        # generates the circulant offset set, and its one parameter
+        # (expander: candidate draws; smallworld: rewire beta; hier:
+        # datacenter count).
+        sp.add_argument("--family", default="circulant",
+                        help="view-graph family: circulant (default), "
+                             "expander, smallworld, hier "
+                             "(consul_tpu/topo/families.py)")
+        sp.add_argument("--family-param", type=float, default=0.0,
+                        help="family parameter (0 = family default: "
+                             "expander 32 draws, smallworld beta 0.2, "
+                             "hier 8 DCs)")
+
     def add_mesh_flags(sp):
         # Multi-chip placement knobs: by default the local-run
         # subcommands run over the largest elastic mesh the visible
@@ -1143,6 +1221,7 @@ def build_parser() -> argparse.ArgumentParser:
     rn.add_argument("--n", type=int, default=1024)
     rn.add_argument("--seed", type=int, default=0)
     rn.add_argument("--view-degree", type=int, default=16)
+    add_family_flags(rn)
     rn.add_argument("--ticks", type=int, default=256)
     rn.add_argument("--chunk", type=int, default=32)
     rn.add_argument("--serf", action="store_true",
@@ -1158,6 +1237,7 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--n", type=int, default=4096)
     sv.add_argument("--seed", type=int, default=0)
     sv.add_argument("--view-degree", type=int, default=16)
+    add_family_flags(sv)
     sv.add_argument("--form-ticks", type=int, default=64,
                     help="ticks to form the cluster before serving")
     sv.add_argument("--chunk", type=int, default=32)
@@ -1193,6 +1273,7 @@ def build_parser() -> argparse.ArgumentParser:
     ch.add_argument("--n", type=int, default=1024)
     ch.add_argument("--seed", type=int, default=0)
     ch.add_argument("--view-degree", type=int, default=16)
+    add_family_flags(ch)
     ch.add_argument("--form-ticks", type=int, default=64,
                     help="ticks to form the cluster before the faults")
     ch.add_argument("--chunk", type=int, default=32)
@@ -1207,6 +1288,22 @@ def build_parser() -> argparse.ArgumentParser:
     ch.add_argument("--churn", action="append", metavar="START,STOP,FRAC")
     ch.add_argument("--degrade", action="append",
                     metavar="START,STOP,FRAC,TX[,RX]")
+    ch.add_argument("--sweep", type=int, default=0, metavar="S",
+                    help="run S scenario parameterizations in ONE "
+                         "vmapped executable per family instead of one "
+                         "scenario (chaos/sweep.py); prints the "
+                         "bandwidth-vs-convergence Pareto table")
+    ch.add_argument("--sweep-mode", choices=("grid", "random"),
+                    default="grid",
+                    help="scenario search: partition fraction x "
+                         "duration grid, or seeded random compound "
+                         "scenarios (partition+churn+degrade)")
+    ch.add_argument("--sweep-seed", type=int, default=0,
+                    help="rng seed for --sweep-mode random")
+    ch.add_argument("--families", default=None, metavar="F1,F2,...",
+                    help="comma list of view-graph families to sweep "
+                         "(default: the single --family; 'all' = every "
+                         "registered family that fits n)")
     add_resilience_flags(ch)
     add_mesh_flags(ch)
     add_layout_flags(ch)
@@ -1238,6 +1335,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="must match the run being warmed (topology "
                          "constants are part of the program identity)")
     pw.add_argument("--view-degree", type=int, default=16)
+    add_family_flags(pw)
+    pw.add_argument("--sweep", type=int, default=0, metavar="S",
+                    help="also compile the S-scenario vmapped sweep "
+                         "program (chaos/sweep.py) — topology travels "
+                         "as a program argument, so one warm covers "
+                         "every same-shape family")
+    pw.add_argument("--sweep-chunk", type=int, default=32)
     pw.add_argument("--layout", choices=("dense", "packed"),
                     default="dense",
                     help="state layout the warmed programs bind "

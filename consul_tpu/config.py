@@ -33,6 +33,35 @@ def to_ticks(ms: float, tick_ms: float) -> int:
 _ticks = to_ticks  # internal alias used by the config properties below
 
 
+def clamp_view_degree(n: int, view_degree: int) -> int:
+    """Clamp a requested partial-view degree to a valid value for ``n``.
+
+    The sparse view is a symmetric circulant: every offset ``d`` pairs
+    with ``n - d``, so a sparse degree must be even (ops/topology.py
+    rejects odd degrees at build time). An explicit odd request is an
+    error — silently rounding a user's choice would hide a config typo —
+    but the *cap* at ``n - 2`` rounds down to the nearest even value so
+    small clusters under a wide default (e.g. n=17 with view_degree=16)
+    still build. 0 always means the complete graph.
+    """
+    if view_degree < 0:
+        raise ValueError(f"view_degree must be >= 0, got {view_degree}")
+    if view_degree == 0:
+        return 0
+    if view_degree % 2 != 0:
+        raise ValueError(
+            f"view_degree must be even: the sparse view pairs every "
+            f"offset d with n-d (symmetric circulant, ops/topology.py), "
+            f"got {view_degree} — use {view_degree - 1} or "
+            f"{view_degree + 1}")
+    if view_degree >= n - 1:
+        return view_degree  # SimConfig.degree falls back to dense
+    capped = min(view_degree, n - 2)
+    if capped % 2 != 0:
+        capped -= 1
+    return max(capped, 0)
+
+
 @dataclasses.dataclass(frozen=True)
 class GossipConfig:
     """SWIM / gossip protocol knobs (reference memberlist/config.go).
@@ -229,6 +258,17 @@ class SimConfig:
     # views every other node, like a real memberlist member map — only
     # feasible for small n; the >=100k configs must bound this).
     view_degree: int = 0
+
+    # Sparse-view graph family (consul_tpu/topo/families.py registry).
+    # Every family emits a symmetric circulant offset set, so the
+    # roll-based delivery machinery is family-independent; "circulant"
+    # reproduces the original sampling bit-for-bit. Ignored when the
+    # view is dense (view_degree == 0).
+    topo_family: str = "circulant"
+    # One per-family shape parameter; 0.0 selects the family default
+    # (smallworld: rewire probability 0.2, hier: 8 datacenters,
+    # expander: 32 candidate draws). circulant ignores it.
+    topo_param: float = 0.0
 
     # Ground-truth latency model: nodes are planted in a Vivaldi-style
     # space; RTT(i,j) = euclidean distance + per-node access-link height,

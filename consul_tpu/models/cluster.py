@@ -446,6 +446,18 @@ class Simulation:
         return ScenarioResult(slo=slo, counters=deltas, ticks=ticks,
                               trace=trace)
 
+    def sweep(self, scenarios, *, ticks=None, chunk: int = 32,
+              settle: int = 64):
+        """Run S fault scenarios against the current state in ONE
+        vmapped executable (chaos/sweep.py run_sweep) — each on its own
+        state copy, so the simulation itself does not advance. Counter
+        semantics match S independent :meth:`run_scenario` replays
+        exactly (the sweep parity pin, tests/test_sweep.py)."""
+        from consul_tpu.chaos import sweep as sweep_mod
+
+        return sweep_mod.run_sweep(self, scenarios, ticks=ticks,
+                                   chunk=chunk, settle=settle)
+
     # -- execution ------------------------------------------------------
     def _runner(self, chunk: int, with_metrics: bool):
         k = (chunk, with_metrics)

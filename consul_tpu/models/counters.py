@@ -42,6 +42,10 @@ class GossipCounters(NamedTuple):
     deaths_declared: jax.Array      # suspicion expiries -> dead declared
     gossip_tx: jax.Array            # gossip packets put on the wire
     gossip_rx: jax.Array            # gossip packets accepted by a live rx
+    gossip_msgs_tx: jax.Array       # queued broadcast msgs transmitted
+                                    # (packets x piggybacked facts — the
+                                    # TransmitLimitedQueue drain volume,
+                                    # the sweep Pareto bandwidth axis)
     pushpull_merges: jax.Array      # push-pull merges applied (both dirs)
     serf_intents_queued: jax.Array  # serf events/queries staged into queues
     serf_intents_retx: jax.Array    # serf queue entries retransmitted
@@ -94,6 +98,7 @@ METRIC_NAMES = {
     "deaths_declared": "memberlist.msg.dead",
     "gossip_tx": "memberlist.udp.sent",
     "gossip_rx": "memberlist.udp.received",
+    "gossip_msgs_tx": "sim.gossip.msgs_sent",
     "pushpull_merges": "memberlist.pushPullNode",
     "serf_intents_queued": "serf.events",
     "serf_intents_retx": "sim.serf.event_retransmits",

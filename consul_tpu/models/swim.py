@@ -162,13 +162,20 @@ def _gather_by_col(topo: Topology, packed: jax.Array, col: jax.Array,
                    forward: bool = True):
     """``packed[(i + off[col[i]]) % n]`` (forward) without a per-row
     gather: K-unrolled static-shift rolls selected per row. The offsets
-    are trace-time constants, so every roll is a static slice+concat.
-    ``packed`` is [N, F]; ``col`` is [N] and must be in range where the
-    result is consumed."""
-    off_np = np.asarray(topo.off)
+    are normally trace-time constants, so every roll is a static
+    slice+concat; with a program-argument topology (chaos/sweep.py
+    passes ``topo.off`` traced so same-shape families share one
+    executable) the K rolls carry traced shifts instead — coll.roll
+    handles both. ``packed`` is [N, F]; ``col`` is [N] and must be in
+    range where the result is consumed."""
+    off = topo.off
+    if isinstance(off, jax.core.Tracer):
+        shifts = [off[j] for j in range(topo.degree)]
+    else:
+        off_np = np.asarray(off)
+        shifts = [int(off_np[j]) for j in range(topo.degree)]
     acc = jnp.zeros_like(packed)
-    for j in range(off_np.shape[0]):
-        shift = int(off_np[j])
+    for j, shift in enumerate(shifts):
         rolled = coll.roll(packed, -shift if forward else shift)
         acc = jnp.where((col == j)[:, None], rolled, acc)
     return acc
@@ -561,8 +568,8 @@ def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
         cfg, topo, state, active, keys[8], tx_limit,
         sched if chaos_on else None, terms, extra_tx=extra_tx,
     )
-    state, refute_gossip, n_gossip_tx, n_gossip_rx, n_chaos_drop = \
-        gossip_out[:5]
+    (state, refute_gossip, n_gossip_tx, n_gossip_rx, n_chaos_drop,
+     n_gossip_msgs) = gossip_out[:6]
     refute_poke = _poke_refutes(
         cfg, topo, state, poke_flag, poke_col, target_inc
     )
@@ -625,6 +632,7 @@ def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
         deaths_declared=n_deaths,
         gossip_tx=n_gossip_tx,
         gossip_rx=n_gossip_rx,
+        gossip_msgs_tx=n_gossip_msgs,
         pushpull_merges=n_pp_merges,
     )
     if chaos_on:
@@ -636,7 +644,7 @@ def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
         cnt = _sentinel_check(cfg, state, view0, own0, t, cnt)
     out_state = state._replace(t=t + 1)
     if extra_tx is not None:
-        return out_state, cnt, gossip_out[5]
+        return out_state, cnt, gossip_out[6]
     return out_state, cnt
 
 
@@ -736,13 +744,21 @@ def _chaos_slo(cfg, topo: Topology, state: SimState, sched, terms, t,
         | (state.alive_truth.astype(jnp.int32) << 1)
         | state.left.astype(jnp.int32)
     )
+    off = topo.off
     if roll_mode:
-        off_np = np.asarray(topo.off)
-        subj = jnp.stack(
-            [coll.roll(pk, -int(off_np[j])) for j in range(k_deg)], axis=1
-        )
+        if isinstance(off, jax.core.Tracer):
+            # Program-argument topology (chaos/sweep.py): traced shifts.
+            subj = jnp.stack(
+                [coll.roll(pk, -off[j]) for j in range(k_deg)], axis=1
+            )
+        else:
+            off_np = np.asarray(off)
+            subj = jnp.stack(
+                [coll.roll(pk, -int(off_np[j])) for j in range(k_deg)],
+                axis=1,
+            )
     else:
-        idx = (rows[:, None] + jnp.asarray(topo.off)[None, :]) % n
+        idx = (rows[:, None] + jnp.asarray(off)[None, :]) % n
         subj = coll.take_rows(pk, idx)
     subj_color = subj >> 2
     subj_alive = (subj & 2) != 0
@@ -833,9 +849,10 @@ def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit,
                   sched=None, terms=None, extra_tx=None):
     """Fan-out + receiver-side delivery + lattice merge + confirmations
     + refute-claim collection. Returns (state, refute_inc[N],
-    packets_tx[] i32, packets_rx[] i32, chaos_drops[] i32), plus a
-    sixth element ``(ex_legs, ex_n_sends)`` iff ``extra_tx`` is given
-    (the serf fusion hook — see :func:`step_counted`).
+    packets_tx[] i32, packets_rx[] i32, chaos_drops[] i32,
+    msgs_tx[] i32), plus a seventh element ``(ex_legs, ex_n_sends)``
+    iff ``extra_tx`` is given (the serf fusion hook — see
+    :func:`step_counted`).
 
     Senders pick their ``piggyback_msgs`` hottest view entries (highest
     remaining budget = fewest past transmits, the TransmitLimitedQueue
@@ -885,6 +902,15 @@ def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit,
     # state.go:521-535) or never-heard (join-address semantics).
     sendable = merge.is_contactable(state.view_key[:, jcols]) & active[:, None]
     n_sends = jnp.sum(sendable, axis=1).astype(jnp.int32)
+    # Queued broadcast messages actually transmitted: each of a sender's
+    # n_sends packets carries its top-P valid facts plus the own-fact
+    # when armed — the TransmitLimitedQueue drain volume the reference
+    # meters per broadcast, and the bandwidth axis of the topology
+    # Pareto table (chaos/sweep.py). Pure reduction, no communication.
+    n_msgs = jnp.sum(
+        n_sends * (jnp.sum(svalid, axis=1).astype(jnp.int32)
+                   + own_sendable.astype(jnp.int32))
+    ).astype(jnp.int32)
 
     # Fused extra plane (serf events/queries): its own sender gate —
     # external bridge seats DO originate serf traffic (wire/bridge.py),
@@ -998,7 +1024,7 @@ def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit,
 
     state = state._replace(view_key=view, susp_seen=state.susp_seen | seen_delta)
     base_out = (state, refute_inc, counters_mod.count(sendable), n_rx,
-                n_chaos_drop)
+                n_chaos_drop, n_msgs)
     if extra_tx is not None:
         return base_out + ((ex_legs, ex_n_sends),)
     return base_out
@@ -1013,11 +1039,16 @@ def _poke_refutes(cfg, topo: Topology, state: SimState, poke_flag, poke_col,
     n, k_deg = cfg.n, cfg.degree
     up = state.alive_truth & ~state.left
     if (not topo.dense) and k_deg <= _ROLL_DEGREE_MAX:
-        off_np = np.asarray(topo.off)
+        off = topo.off
+        if isinstance(off, jax.core.Tracer):
+            # Program-argument topology (chaos/sweep.py): traced shifts.
+            shifts = [off[j] for j in range(k_deg)]
+        else:
+            off_np = np.asarray(off)
+            shifts = [int(off_np[j]) for j in range(k_deg)]
         claim = jnp.zeros((coll.local_n(n),), jnp.uint32)
         poked_inc = jnp.where(poke_flag, poke_inc, 0).astype(jnp.uint32)
-        for j in range(k_deg):
-            shift = int(off_np[j])
+        for j, shift in enumerate(shifts):
             contrib = coll.roll(
                 jnp.where(poke_col == j, poked_inc, 0), shift
             )

@@ -89,7 +89,17 @@ class Topology(NamedTuple):
 
 
 def make_topology(cfg: SimConfig, key) -> Topology:
-    """Build the offset table and static remap tables (host-side, once)."""
+    """Build the offset table and static remap tables (host-side, once).
+
+    The offset set comes from the family registry
+    (consul_tpu/topo/families.py, selected by ``cfg.topo_family``);
+    every family emits a symmetric circulant offset set, so the remap
+    tables below are family-independent. The default "circulant"
+    family consumes the rng exactly like the pre-registry code, so
+    default topologies are bit-identical (golden-pinned in tests).
+    """
+    from consul_tpu import topo as topo_families
+
     n, k_deg = cfg.n, cfg.degree
     if k_deg == n - 1:  # complete graph (view_degree 0 or >= n-1)
         off = jnp.arange(1, n, dtype=jnp.int32)
@@ -97,11 +107,15 @@ def make_topology(cfg: SimConfig, key) -> Topology:
     if k_deg % 2 != 0:
         raise ValueError("sparse view_degree must be even (symmetric offsets)")
     rng = np.random.default_rng(int(jax.random.randint(key, (), 0, 2**31 - 1)))
-    # Sample K/2 distinct offsets from [1, N/2), then close under
-    # negation. Avoiding d == N-d (possible only at d = N/2) keeps the
-    # union size exactly K.
-    half = rng.choice(np.arange(1, (n + 1) // 2), size=k_deg // 2, replace=False)
-    off_np = np.sort(np.concatenate([half, n - half]).astype(np.int64))
+    off_np = topo_families.offsets_for(
+        cfg.topo_family, n, k_deg, rng, param=cfg.topo_param)
+    return topology_from_offsets(n, off_np)
+
+
+def topology_from_offsets(n: int, off_np: np.ndarray) -> Topology:
+    """Build the remap/inverse tables for a validated offset set."""
+    off_np = np.asarray(off_np, dtype=np.int64)
+    k_deg = off_np.shape[0]
     # Static remap: rcol[j, c] = column of (off[c] - off[j]) mod n.
     d = (off_np[None, :] - off_np[:, None]) % n          # [K, K]
     col = np.searchsorted(off_np, d)
@@ -179,14 +193,21 @@ def gather_cols(topo: Topology, x: jax.Array) -> jax.Array:
     """[N, K] view of a per-node array along the neighbor relation:
     out[i, c] = x[(i + off[c]) mod N] (used by metrics/tests). Sparse
     mode stacks K static rolls — TPU-cheap contiguous copies — instead
-    of an [N, K] per-row gather."""
+    of an [N, K] per-row gather. When the offsets are a *program
+    argument* (chaos/sweep.py passes them traced so same-shape families
+    share one executable), the rolls take traced shifts instead."""
+    off = topo.off
     if not topo.dense and topo.degree <= 256:
-        off_np = np.asarray(topo.off)
+        if isinstance(off, jax.core.Tracer):
+            return jnp.stack(
+                [jnp.roll(x, -off[c]) for c in range(topo.degree)], axis=1
+            )
+        off_np = np.asarray(off)
         return jnp.stack(
             [jnp.roll(x, -int(off_np[c])) for c in range(topo.degree)], axis=1
         )
     rows = jnp.arange(topo.n, dtype=jnp.int32)
-    return x[(rows[:, None] + topo.off[None, :]) % topo.n]
+    return x[(rows[:, None] + off[None, :]) % topo.n]
 
 
 # ----------------------------------------------------------------------

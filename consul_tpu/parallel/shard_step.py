@@ -305,3 +305,92 @@ def place(mesh: Mesh, tree, n: int):
         lambda l: jax.device_put(
             l, NamedSharding(mesh, node_spec(l, n, axis))), tree
     )
+
+
+# ----------------------------------------------------------------------
+# Scenario-sweep plane (chaos/sweep.py): vmap over a leading scenario
+# axis INSIDE the shard_map region, topology tables as traced inputs.
+# ----------------------------------------------------------------------
+
+def sweep_spec(leaf, n: int, axis=NODE_AXIS) -> P:
+    """The node-axis rule for scenario-stacked leaves: a [S, N, ...]
+    leaf shards its node dimension (dim 1) over ``axis`` and replicates
+    the scenario axis — every device holds all S scenarios of its own
+    node block, which is exactly what vmap-inside-shard_map consumes.
+    Everything else ([S]-stacked scalars, per-entry chaos terms)
+    replicates, mirroring :func:`parallel.mesh.node_spec`."""
+    if leaf.ndim >= 2 and leaf.shape[1] == n:
+        return P(None, axis, *([None] * (leaf.ndim - 2)))
+    return P()
+
+
+def place_sweep(mesh: Mesh, tree, n: int):
+    """:func:`place` for scenario-stacked pytrees (states / schedule
+    stacks with a leading [S] axis)."""
+    axis, _ = node_axes(mesh)
+    return jax.tree.map(
+        lambda l: jax.device_put(
+            l, NamedSharding(mesh, sweep_spec(l, n, axis))), tree
+    )
+
+
+def make_sharded_sweep_runner(cfg: SimConfig, mesh: Mesh, chunk: int, *,
+                              step_fn, swim_of):
+    """The multi-chip sweep runner (chaos/sweep.py ``_sweep_runner``):
+    ``run(world, off, rcol, inv, scheds, states, base_key) ->
+    (states, counters)`` with states/schedules stacked on a leading
+    scenario axis and the topology tables as *traced inputs* (the
+    program-argument seam — same-shape families share this executable).
+
+    The scenario vmap sits INSIDE the shard_map region: each device
+    scans all S scenarios over its own node block, so the per-tick
+    ppermute neighbor exchanges batch across scenarios for free (vmap
+    adds a leading batch dim to every collective operand) and there is
+    still exactly ONE counter tree_psum per (scenario, chunk) — applied
+    inside the vmapped body, where psum's batching rule reduces each
+    scenario lane independently. The reduced [S]-leaf counters are
+    replicated (out spec P()), identical on every device."""
+    axis, n_shards = node_axes(mesh)
+    if cfg.n % n_shards != 0:
+        raise ValueError(f"n={cfg.n} must divide over {n_shards} shards")
+
+    world_spec = World(pos=P(axis, None), height=P(axis))
+    cnt_specs = jax.tree.map(lambda _: P(), counters_mod.zeros())
+
+    def local_run(world_l, off, rcol, inv, sched_l, states_l, base_key):
+        topo = Topology(n=cfg.n, dense=False, off=off, rcol=rcol, inv=inv)
+
+        def one(sched, state):
+            ticks = swim_of(state).t + jnp.arange(chunk, dtype=jnp.int32)
+            tick_keys = jax.vmap(
+                lambda t: jax.random.fold_in(base_key, t))(ticks)
+
+            def body(carry, tick_key):
+                st, cnt = carry
+                with coll.node_axis(axis, n_shards, cfg.n):
+                    st, c = step_fn(cfg, topo, world_l, st, tick_key,
+                                    sched, sentinel=False)
+                return (st, counters_mod.add(cnt, c)), ()
+
+            (state, cnt), _ = jax.lax.scan(
+                body, (state, counters_mod.zeros()), tick_keys)
+            with coll.node_axis(axis, n_shards, cfg.n):
+                red = coll.tree_psum(jnp.stack(list(cnt)))
+            return state, counters_mod.unstack(red)
+
+        return jax.vmap(one)(sched_l, states_l)
+
+    def run(world, off, rcol, inv, scheds, states, base_key):
+        state_specs = jax.tree.map(
+            lambda l: sweep_spec(l, cfg.n, axis), states)
+        sched_specs = jax.tree.map(
+            lambda l: sweep_spec(l, cfg.n, axis), scheds)
+        inner = shard_map(
+            local_run, mesh=mesh,
+            in_specs=(world_spec, P(), P(), P(), sched_specs,
+                      state_specs, P()),
+            out_specs=(state_specs, cnt_specs), check_vma=False,
+        )
+        return inner(world, off, rcol, inv, scheds, states, base_key)
+
+    return jax.jit(run, donate_argnums=(5,))

@@ -233,7 +233,12 @@ def plan(cfg: SimConfig, kind: str = "swim", layout: str = "auto",
         streamed, buffers = True, 2
         if not cfg.view_degree:
             raise ValueError(
-                "streaming needs the sparse view (view_degree > 0)")
+                f"streaming needs the sparse view (view_degree > 0), but "
+                f"this config is dense (view_degree=0, topology family "
+                f"{cfg.topo_family!r}): a dense view is O(n^2) state and "
+                f"cannot stream in cohorts — pass --view-degree (an even "
+                f"K, e.g. 16) and optionally --family to pick the view "
+                f"graph (consul_tpu/topo/families.py)")
 
     if chunk is None:
         # Long scans amortize dispatch; huge populations take smaller

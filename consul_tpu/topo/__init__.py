@@ -1,0 +1,14 @@
+"""Topology lab: the view-graph family registry and structural probes.
+
+See consul_tpu/topo/families.py for the family contract (symmetric
+circulant offset sets) and consul_tpu/chaos/sweep.py for the
+program-argument sweep plane built on top of it.
+"""
+
+from consul_tpu.topo.families import (  # noqa: F401
+    FAMILIES,
+    offsets_for,
+    register,
+    spectral_gap,
+    validate_offsets,
+)

@@ -84,7 +84,9 @@ def prewarm(ns: Sequence[int], kinds: Sequence[str] = ("swim",),
             mesh=None, device_count: Optional[int] = None, n_dc: int = 1,
             chaos: bool = False, seed: int = 0, view_degree: int = 16,
             sentinel: bool = False, cache_dir: Optional[str] = None,
-            layout: str = "dense") -> dict:
+            layout: str = "dense", family: str = "circulant",
+            family_param: float = 0.0, sweep: int = 0,
+            sweep_chunk: int = 32) -> dict:
     """Compile every (n, kind, chunk, mesh-shape, chaos-shape, layout)
     signature into the persistent compile cache and return a JSON-ready
     summary: the signatures compiled, cache hit/miss movement, and wall
@@ -94,12 +96,16 @@ def prewarm(ns: Sequence[int], kinds: Sequence[str] = ("swim",),
     the chaos-enabled program for the default one-partition schedule
     shape (the ``consul-tpu chaos`` / bench chaos-phase signature).
 
-    ``view_degree``/``seed`` must match the run being warmed — they
-    change the seed-derived topology constants and with them the
-    program fingerprint (the signature key documented in COVERAGE.md).
+    ``view_degree``/``seed``/``family``/``family_param`` must match the
+    run being warmed — they change the seed-derived topology constants
+    and with them the program fingerprint (the signature key documented
+    in COVERAGE.md). ``sweep=S`` additionally compiles the S-scenario
+    vmapped sweep program (chaos/sweep.py) at ``sweep_chunk`` — that
+    one is topology-as-argument, so a single family warms every family
+    of the same shape.
     """
     from consul_tpu import chaos as chaos_api
-    from consul_tpu.config import SimConfig
+    from consul_tpu.config import SimConfig, clamp_view_degree
     from consul_tpu.models.cluster import SerfSimulation, Simulation
     from consul_tpu.parallel import mesh as pmesh
 
@@ -119,7 +125,8 @@ def prewarm(ns: Sequence[int], kinds: Sequence[str] = ("swim",),
         m = mesh if mesh is not None else pmesh.default_mesh(
             n, device_count=device_count, n_dc=n_dc)
         for kind in kinds:
-            cfg = SimConfig(n=n, view_degree=min(view_degree, n - 2))
+            cfg = SimConfig(n=n, view_degree=clamp_view_degree(n, view_degree),
+                            topo_family=family, topo_param=family_param)
             sim = classes[kind](cfg, seed=seed, sentinel=sentinel, mesh=m,
                                 layout=layout)
             schedules = [None]
@@ -138,8 +145,25 @@ def prewarm(ns: Sequence[int], kinds: Sequence[str] = ("swim",),
                             "with_metrics": bool(with_metrics),
                             "chaos": sched is not None,
                             "layout": layout,
+                            "family": family,
                             "wall_s": round(time.perf_counter() - t0, 3),
                         })
+            if sweep > 0:
+                from consul_tpu.chaos import sweep as sweep_mod
+
+                sim.set_chaos(None)
+                t0 = time.perf_counter()
+                sweep_mod.prewarm_sweep(
+                    sim, sweep_mod.scenario_grid(n, sweep),
+                    chunk=sweep_chunk)
+                signatures.append({
+                    "n": int(n), "kind": kind, "chunk": int(sweep_chunk),
+                    "mesh": _mesh_shape(m), "with_metrics": False,
+                    "chaos": True, "layout": layout,
+                    "family": "*",  # topology-as-argument: any family
+                    "sweep": int(sweep),
+                    "wall_s": round(time.perf_counter() - t0, 3),
+                })
     return {
         "signatures": signatures,
         "compiled": len(signatures),

@@ -1,0 +1,317 @@
+"""The vmapped scenario-sweep plane (consul_tpu/chaos/sweep.py).
+
+Core pins:
+  - PARITY: the S-scenario vmapped sweep's per-scenario SLO counters
+    match S independent ``run_scenario`` replays EXACTLY — every
+    counter field, single-device and sharded.
+  - ONE EXECUTABLE: a K-scenario sweep compiles exactly one program
+    per (shape, chunk), and every other *family* at the same shape
+    compiles zero — the topology tables are program arguments.
+  - WARM ZERO: ``prewarm --sweep`` + the persistent compile cache make
+    a later sweep record zero net backend compiles (subprocess, same
+    isolation rule as tests/test_compile_cache.py — enabling the cache
+    is process-global).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import pytest
+
+from consul_tpu.chaos import schedule as chaos_mod
+from consul_tpu.chaos import sweep as sweep_mod
+from consul_tpu.config import SimConfig
+from consul_tpu.models import cluster
+from consul_tpu.models import counters as counters_mod
+from consul_tpu.parallel import mesh as pmesh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N, VD = 128, 8
+FORM, TICKS, CHUNK = 32, 40, 20
+
+
+def formed(mesh=None, cls=cluster.Simulation, family="circulant"):
+    cfg = SimConfig(n=N, view_degree=VD, topo_family=family)
+    sim = cls(cfg, seed=0, mesh=mesh)
+    sim.run(FORM, chunk=16, with_metrics=False)
+    return sim
+
+
+def assert_parity(results, scens, mesh=None, cls=cluster.Simulation):
+    """Each sweep lane must equal a fresh solo run_scenario replay."""
+    for i, ev in enumerate(scens):
+        solo = formed(mesh=mesh, cls=cls)
+        ref = solo.run_scenario(ev, ticks=TICKS, chunk=CHUNK)
+        for f in counters_mod.FIELDS:
+            assert results[i]["counters"][f] == ref.counters[f], (i, f)
+        assert results[i]["slo"] == ref.slo, i
+        assert results[i]["ticks"] == ref.ticks
+
+
+class TestParity:
+    def test_single_device_matches_independent_runs(self):
+        scens = sweep_mod.scenario_grid(N, 3)
+        sim = formed()
+        t_before = sim._tick()
+        res = sim.sweep(scens, ticks=TICKS, chunk=CHUNK)
+        assert sim._tick() == t_before, "sweep must not advance the sim"
+        assert_parity(res, scens)
+
+    def test_sharded_matches_single_device(self):
+        scens = sweep_mod.scenario_grid(N, 4)
+        mesh = pmesh.make_mesh(jax.devices())
+        res_sh = formed(mesh=mesh).sweep(scens, ticks=TICKS, chunk=CHUNK)
+        res_1d = formed().sweep(scens, ticks=TICKS, chunk=CHUNK)
+        for i in range(len(scens)):
+            for f in counters_mod.FIELDS:
+                assert res_sh[i]["counters"][f] == res_1d[i]["counters"][f], \
+                    (i, f)
+
+    def test_serf_sweep_parity(self):
+        scens = sweep_mod.scenario_grid(N, 2)
+        res = formed(cls=cluster.SerfSimulation).sweep(
+            scens, ticks=TICKS, chunk=CHUNK)
+        assert_parity(res, scens, cls=cluster.SerfSimulation)
+
+    def test_uneven_chunk_split_matches(self):
+        """ticks % chunk != 0 exercises the tail-remainder runner."""
+        scens = sweep_mod.scenario_grid(N, 2)
+        res_a = formed().sweep(scens, ticks=TICKS, chunk=16)  # 16+16+8
+        res_b = formed().sweep(scens, ticks=TICKS, chunk=TICKS)
+        for i in range(len(scens)):
+            assert res_a[i]["counters"] == res_b[i]["counters"], i
+
+    def test_random_scenarios_sweepable(self):
+        scens = sweep_mod.scenario_random(N, 3, seed=7)
+        keys = {chaos_mod.static_key_of(
+            chaos_mod.compile_schedule(N, ev)) for ev in scens}
+        assert len(keys) == 1, "random scenarios must share one shape"
+        res = formed().sweep(scens, ticks=TICKS, chunk=CHUNK)
+        assert len(res) == 3
+
+
+class TestCompileLedger:
+    def test_sweep_compiles_one_executable_per_shape(self, compile_ledger):
+        """K scenarios -> ONE program; every other family at the same
+        shape -> ZERO programs (topology travels as an argument).
+
+        The warm-up sweep at a throwaway chunk size compiles the small
+        eager helper ops (schedule/state stacking, counter reduction)
+        outside the pinned windows, so the windows see exactly the
+        sweep runner itself."""
+        scens = sweep_mod.scenario_grid(N, 5)  # S=5: unique in-process
+        sim = formed()
+        sim.sweep(scens, ticks=TICKS, chunk=8)  # warm eager helpers
+        with compile_ledger.expect(
+                1, "5-scenario sweep must be one vmapped executable"):
+            sim.sweep(scens, ticks=TICKS, chunk=TICKS)
+        for family in ("expander", "smallworld", "hier"):
+            sim_f = formed(family=family)  # family build/form: not pinned
+            with compile_ledger.expect(
+                    0, f"{family} must reuse the sweep executable"):
+                sim_f.sweep(scens, ticks=TICKS, chunk=TICKS)
+
+
+_SWEEP_WARM_CHILD = """
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8")
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_threefry_partitionable", True)
+from consul_tpu.analysis.guards import CompileLedger
+from consul_tpu.chaos import sweep as sweep_mod
+from consul_tpu.config import SimConfig
+from consul_tpu.models.cluster import Simulation
+from consul_tpu.utils import compile_cache, prewarm as prewarm_mod
+
+compile_cache.enable({cache!r})
+led = CompileLedger()
+summary = prewarm_mod.prewarm(
+    ns=[128], kinds=("swim",), chunks=(16,), metrics_modes=(False,),
+    device_count=1, view_degree=8, sweep=4, sweep_chunk=48)
+sim = Simulation(SimConfig(n=128, view_degree=8), seed=0)
+sim.run(32, chunk=16, with_metrics=False)
+scens = sweep_mod.scenario_grid(128, 4)
+# Warm the eager helper ops (stacking, counter reduction) at a
+# throwaway chunk size so the measured sweep is the runner alone.
+sim.sweep(scens, chunk=13)
+start = led.total
+res = sim.sweep(scens, chunk=48)
+built_in_sweep = led.total - start
+# The family knob must be part of the program identity: warming a
+# second family at the same shape misses the persistent cache again
+# (different baked-in topology constants -> different fingerprint).
+s2 = prewarm_mod.prewarm(
+    ns=[128], kinds=("swim",), chunks=(16,), metrics_modes=(False,),
+    device_count=1, view_degree=8, family="smallworld")
+print(json.dumps({{
+    "built_in_sweep": built_in_sweep,
+    "sweep_sig": [s for s in summary["signatures"] if "sweep" in s],
+    "scenarios": len(res),
+    "family2_sig": s2["signatures"][0]["family"],
+    "family2_misses": s2["cache"]["misses"],
+}}))
+"""
+
+
+class TestPrewarmCache:
+    def test_prewarm_sweep_warm_and_family_fingerprint(self, tmp_path):
+        """``prewarm --sweep S`` writes the sweep executables into the
+        persistent cache, so the real sweep records zero net backend
+        compiles (expect(0) warm); and the family knob changes the
+        prewarm fingerprint for the baked-topology runners."""
+        out = subprocess.run(
+            [sys.executable, "-c", _SWEEP_WARM_CHILD.format(
+                repo=REPO, cache=str(tmp_path / "cc"))],
+            capture_output=True, text=True, timeout=420)
+        assert out.returncode == 0, out.stderr[-2000:]
+        got = json.loads(out.stdout.strip().splitlines()[-1])
+        assert got["scenarios"] == 4
+        assert got["sweep_sig"] and got["sweep_sig"][0]["family"] == "*"
+        assert got["built_in_sweep"] == 0
+        assert got["family2_sig"] == "smallworld"
+        assert got["family2_misses"] >= 1, (
+            "a different family must be a different program")
+
+
+class TestGuardrails:
+    def test_mixed_shapes_need_padding(self):
+        sim = formed()
+        with pytest.raises(ValueError, match="pad the short ones"):
+            sim.sweep([
+                [chaos_mod.Partition(start=4, stop=12,
+                                     side_a=slice(0, 32))],
+                [chaos_mod.Partition(start=4, stop=12,
+                                     side_a=slice(0, 32)),
+                 chaos_mod.ChurnWave(start=4, stop=12,
+                                     nodes=slice(0, 8))],
+            ], ticks=TICKS)
+
+    def test_dense_view_rejected(self):
+        sim = cluster.Simulation(SimConfig(n=64, view_degree=0), seed=0)
+        with pytest.raises(ValueError, match="view_degree"):
+            sim.sweep(sweep_mod.scenario_grid(64, 2))
+
+    def test_empty_sweep_rejected(self):
+        with pytest.raises(ValueError, match="empty"):
+            formed().sweep([])
+
+    def test_membudget_streaming_error_names_family(self):
+        """The streaming-needs-sparse-view error must carry the chosen
+        family and the knobs that fix it."""
+        from consul_tpu.runtime import membudget
+
+        cfg = SimConfig(n=1 << 22, view_degree=0, topo_family="expander")
+        with pytest.raises(ValueError) as ei:
+            membudget.plan(cfg, "swim", layout="dense", budget="1GB")
+        msg = str(ei.value)
+        assert "expander" in msg
+        assert "--view-degree" in msg and "--family" in msg
+
+    def test_sink_counters(self):
+        sim = formed()
+        sim.sweep(sweep_mod.scenario_grid(N, 2), ticks=TICKS, chunk=TICKS)
+        assert sim.sink.counter_sum("sim.sweep.runs") == 1
+        assert sim.sink.counter_sum("sim.sweep.scenarios") == 2
+
+
+class TestParetoMachinery:
+    PF = {
+        "circulant": {"bytes_per_tick_node": 80.0, "time_to_heal_worst": 270},
+        "smallworld": {"bytes_per_tick_node": 50.0, "time_to_heal_worst": 96},
+        "expander": {"bytes_per_tick_node": 81.0, "time_to_heal_worst": 60},
+    }
+
+    def test_pareto_table_dominance(self):
+        rows = {r["family"]: r for r in sweep_mod.pareto_table(self.PF)}
+        assert rows["circulant"]["dominated_by"] == ["smallworld"]
+        assert rows["smallworld"]["dominated_by"] == []
+        assert rows["expander"]["dominated_by"] == []
+
+    def test_strict_dominators(self):
+        assert sweep_mod.strict_dominators(self.PF) == ["smallworld"]
+        # Equal on one axis is NOT strict dominance.
+        pf = dict(self.PF,
+                  tied={"bytes_per_tick_node": 80.0,
+                        "time_to_heal_worst": 10})
+        assert "tied" not in sweep_mod.strict_dominators(pf)
+
+    def test_worst_case_ordering(self):
+        res = [
+            {"slo": {"time_to_heal": 10, "false_positive_deaths": 0,
+                     "time_to_first_suspect": 3}},
+            {"slo": {"time_to_heal": 40, "false_positive_deaths": 0,
+                     "time_to_first_suspect": 2}},
+            {"slo": {"time_to_heal": 40, "false_positive_deaths": 2,
+                     "time_to_first_suspect": 1}},
+        ]
+        assert sweep_mod.worst_case(res) == 2
+
+    def test_scenario_grid_shapes_stack(self):
+        scens = sweep_mod.scenario_grid(256, 16)
+        keys = {chaos_mod.static_key_of(
+            chaos_mod.compile_schedule(256, ev)) for ev in scens}
+        assert len(keys) == 1
+        assert len(scens) == 16
+
+    def test_wire_bytes_estimate(self):
+        c = {"gossip_tx": 100, "gossip_msgs_tx": 300}
+        want = (100 * sweep_mod.PACKET_OVERHEAD_BYTES
+                + 300 * sweep_mod.MSG_BYTES) / (50 * 64)
+        assert sweep_mod.wire_bytes_per_tick_node(c, 50, 64) == want
+
+
+class TestFamilySweepSmoke:
+    def test_family_sweep_row_schema(self):
+        row = sweep_mod.family_sweep(
+            formed(), sweep_mod.scenario_grid(N, 2), ticks=TICKS,
+            chunk=TICKS)
+        for k in ("degree", "spectral_gap", "bytes_per_tick_node",
+                  "time_to_heal_worst", "time_to_heal_mean",
+                  "worst_scenario", "worst_slo", "scenarios"):
+            assert k in row, k
+        assert row["degree"] == VD
+        assert len(row["scenarios"]) == 2
+        json.dumps(row)  # must be JSON-clean for the bench artifact
+
+
+@pytest.mark.slow
+class TestAcceptance4096:
+    def test_sweep_16_scenarios_3_families_n4096(self, compile_ledger):
+        """The PR acceptance drill: a 16-scenario sweep over >= 3
+        families at n=4096 end-to-end on CPU — ONE executable per
+        (shape, chunk) shared by every family (expect(1) then
+        expect(0) after an eager warm-up) — and at least one
+        non-circulant family strictly dominating the circulant default
+        at equal degree."""
+        scens = sweep_mod.scenario_grid(4096, 16)
+        per_family = {}
+        first = True
+        for fam in ("circulant", "smallworld", "expander"):
+            cfg = SimConfig(n=4096, view_degree=16, topo_family=fam)
+            sim = cluster.Simulation(cfg, seed=0)
+            sim.run(64, chunk=64, with_metrics=False)
+            if first:
+                # Warm the eager helper ops at a throwaway chunk so the
+                # pinned windows see only the sweep runner.
+                sim.sweep(scens, ticks=12, chunk=12)
+            # settle=320: the n=4096 heal tail (circulant ~271 ticks)
+            # must finish inside the window or the convergence axis
+            # saturates and every family ties.
+            with compile_ledger.expect(1 if first else 0,
+                                       "families share one executable"):
+                per_family[fam] = sweep_mod.family_sweep(
+                    sim, scens, chunk=348, settle=320)
+            first = False
+        doms = sweep_mod.strict_dominators(per_family)
+        assert doms, (
+            "expected a non-circulant family to strictly dominate "
+            f"the default; table: {sweep_mod.pareto_table(per_family)}")

@@ -13,7 +13,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from consul_tpu.config import SimConfig
+from consul_tpu import topo as topo_lab
+from consul_tpu.config import SimConfig, clamp_view_degree
 from consul_tpu.ops import topology
 
 
@@ -146,3 +147,218 @@ def test_dense_remap_matches_sparse_construction():
             np.asarray(topology.remap_row(topo_d, j)), rcol[j]
         )
         assert int(topology.inv_col(topo_d, j)) == inv[j]
+
+
+# ---------------------------------------------------------------------------
+# Topology lab (consul_tpu/topo): family invariants, golden pin, clamp.
+
+# Pre-registry make_topology output, captured verbatim before the
+# family registry landed. The default "circulant" family must keep
+# producing these exact offsets (same rng consumption) — bit-identity
+# is what lets every existing seed-pinned trajectory survive the
+# refactor.
+GOLDEN_OFFSETS = {
+    # jax_threefry_partitionable=True (the suite-wide conftest setting —
+    # the topology seed derives through jax.random.randint).
+    (97, 16, 0): [3, 5, 16, 23, 24, 39, 43, 47, 50, 54, 58, 73, 74, 81,
+                  92, 94],
+    (1024, 32, 0): [25, 53, 84, 114, 191, 216, 237, 253, 268, 275, 343,
+                    406, 425, 456, 462, 487, 537, 562, 568, 599, 618, 681,
+                    749, 756, 771, 787, 808, 833, 910, 940, 971, 999],
+    (64, 8, 0): [2, 11, 17, 31, 33, 47, 53, 62],
+    (4096, 16, 0): [103, 213, 784, 962, 1031, 1097, 1734, 1991, 2105,
+                    2362, 2999, 3065, 3134, 3312, 3883, 3993],
+    (1024, 16, 0): [26, 53, 194, 240, 257, 272, 432, 495, 529, 592, 752,
+                    767, 784, 830, 971, 998],
+}
+
+
+@pytest.mark.parametrize("n,vd,seed", sorted(GOLDEN_OFFSETS))
+def test_circulant_default_bit_identical_golden(n, vd, seed):
+    # The exact key Simulation.__post_init__ hands make_topology.
+    kn = jax.random.split(jax.random.PRNGKey(seed), 4)[1]
+    topo = topology.make_topology(SimConfig(n=n, view_degree=vd), kn)
+    assert np.asarray(topo.off).tolist() == GOLDEN_OFFSETS[(n, vd, seed)]
+
+
+FAMILY_NS = [64, 1024, 4096]
+
+
+@pytest.mark.parametrize("family", sorted(topo_lab.FAMILIES))
+@pytest.mark.parametrize("n", FAMILY_NS)
+def test_family_structural_invariants(family, n):
+    """Every registered family: degree bound, range, sortedness,
+    symmetry closure, connectivity — at several seeds per shape."""
+    k_deg = 16 if n > 64 else 8
+    for seed in (0, 1, 2):
+        rng = np.random.default_rng(seed)
+        off = topo_lab.offsets_for(family, n, k_deg, rng)
+        # offsets_for validates internally; re-assert the invariants
+        # explicitly so a validator regression cannot silently pass.
+        off_np = np.asarray(off)
+        assert off_np.shape == (k_deg,)
+        assert np.all(np.diff(off_np) > 0)
+        assert off_np.min() >= 1 and off_np.max() <= n - 1
+        assert set(off_np.tolist()) == {n - d for d in off_np.tolist()}
+        topo_lab.validate_offsets(off, n, k_deg, family=family)
+
+
+@pytest.mark.parametrize("family", sorted(topo_lab.FAMILIES))
+def test_family_connectivity_bfs(family):
+    """BFS reachability oracle at n=64: the arithmetic gcd connectivity
+    test must agree with actually walking the graph."""
+    n, k_deg = 64, 8
+    off = topo_lab.offsets_for(family, n, k_deg, np.random.default_rng(0))
+    seen = {0}
+    frontier = [0]
+    while frontier:
+        i = frontier.pop()
+        for d in np.asarray(off).tolist():
+            j = (i + d) % n
+            if j not in seen:
+                seen.add(j)
+                frontier.append(j)
+    assert len(seen) == n
+
+
+@pytest.mark.parametrize("family,param", [
+    ("circulant", 0.0), ("expander", 4.0), ("smallworld", 0.3),
+    ("hier", 4.0),
+])
+def test_family_make_topology_tables(family, param):
+    """make_topology builds valid remap tables for every family — the
+    column algebra is family-independent."""
+    n = 64
+    cfg = SimConfig(n=n, view_degree=8, topo_family=family,
+                    topo_param=param)
+    topo = topology.make_topology(cfg, jax.random.PRNGKey(3))
+    off = np.asarray(topo.off)
+    topo_lab.validate_offsets(off, n, 8, family=family)
+    nbrs = np.asarray(topology.nbrs_table(topo))
+    counts = np.bincount(nbrs.ravel(), minlength=n)
+    assert np.all(counts == 8)  # exact in-degree K for every family
+    # inv/rcol spot check via the oracle helpers above.
+    x = jnp.asarray(np.random.default_rng(1).integers(0, 1000, n), jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(topology.gather_cols(topo, x)), np.asarray(x)[nbrs])
+
+
+def test_hier_bridges_align_with_dc_blocks():
+    off = topo_lab.offsets_for("hier", 1024, 16, np.random.default_rng(0),
+                               param=8.0)
+    per_dc = 1024 // 8
+    bridges = [d for d in np.asarray(off).tolist() if d % per_dc == 0]
+    assert bridges, "hier must place at least one inter-DC bridge offset"
+    # Bridges hop whole DCs: same in-DC seat, different DC.
+    for b in bridges:
+        assert b % per_dc == 0
+
+
+def test_hier_rejects_indivisible_n():
+    with pytest.raises(ValueError, match="n_dc"):
+        topo_lab.offsets_for("hier", 100, 8, np.random.default_rng(0),
+                             param=8.0)
+
+
+def test_unknown_family_lists_registered():
+    with pytest.raises(ValueError, match="registered families"):
+        topo_lab.offsets_for("moebius", 64, 8, np.random.default_rng(0))
+
+
+def test_expander_beats_plain_circulant_gap():
+    """Best-of-m selection must produce a spectral gap at least as good
+    as a single draw from the same generator stream."""
+    n, k_deg = 1024, 16
+    plain = topo_lab.offsets_for("circulant", n, k_deg,
+                                 np.random.default_rng(7))
+    best = topo_lab.offsets_for("expander", n, k_deg,
+                                np.random.default_rng(7), param=32.0)
+    assert (topo_lab.spectral_gap(np.asarray(best), n)
+            >= topo_lab.spectral_gap(np.asarray(plain), n))
+
+
+def test_spectral_gap_closed_form():
+    # Odd ring C_5 with offsets {1,4}: lambda_d = 2cos(2 pi d/5);
+    # max |lambda_{d != 0}| = 2cos(pi/5) = (1+sqrt(5))/2.
+    gap = topo_lab.spectral_gap(np.array([1, 4]), 5)
+    assert abs(gap - (1 - (1 + np.sqrt(5)) / 4)) < 1e-9
+    # Even ring C_8 is bipartite: lambda at d=4 is -2, |lambda|=K, gap 0.
+    assert abs(topo_lab.spectral_gap(np.array([1, 7]), 8)) < 1e-12
+    # Disconnected {2, 6} on n=8 (all even): lambda at d=4 is +2, gap 0.
+    assert abs(topo_lab.spectral_gap(np.array([2, 6]), 8)) < 1e-12
+    # Against a brute-force adjacency eigensolve on a random shape.
+    n, k = 31, 6
+    off = topo_lab.offsets_for("circulant", n, k, np.random.default_rng(5))
+    adj = np.zeros((n, n))
+    for d in np.asarray(off):
+        adj[np.arange(n), (np.arange(n) + d) % n] = 1.0
+    lam = np.linalg.eigvalsh(adj)
+    lam_max = np.max(np.abs(lam[np.argsort(-np.abs(lam))][1:]))
+    assert abs(topo_lab.spectral_gap(np.asarray(off), n)
+               - (1 - lam_max / k)) < 1e-9
+
+
+def test_circulant_redraws_disconnected():
+    """Seeds whose first draw shares a factor with n must still yield a
+    connected graph (the registry's connectivity contract)."""
+    import math
+    from functools import reduce
+
+    n, k_deg = 128, 8
+    for seed in range(24):
+        off = topo_lab.offsets_for("circulant", n, k_deg,
+                                   np.random.default_rng(seed))
+        assert reduce(math.gcd, (int(d) for d in np.asarray(off)), n) == 1
+
+
+def test_family_mesh_path_smoke():
+    """A non-default family forms under shard_map exactly like the
+    default (the tables are host constants; the mesh path is
+    family-independent)."""
+    from consul_tpu.models.cluster import Simulation
+    from consul_tpu.parallel import mesh as pmesh
+
+    cfg = SimConfig(n=64, view_degree=8, topo_family="smallworld")
+    mesh = pmesh.make_mesh(jax.devices()[:4])
+    sim = Simulation(cfg, seed=0, mesh=mesh)
+    sim.run(8, chunk=4, with_metrics=False)
+    single = Simulation(cfg, seed=0)
+    single.run(8, chunk=4, with_metrics=False)
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(sim.state.view_key)),
+        np.asarray(jax.device_get(single.state.view_key)))
+
+
+# -- clamp_view_degree (the early, even-valued CLI clamp) -------------------
+
+def test_clamp_view_degree_even_cap():
+    # The old min(vd, n - 2) could return an odd degree at small n
+    # (vd=16, n=17 -> 15) that make_topology rejected much later; the
+    # clamp resolves those shapes to the dense fallback (vd >= n-1 IS
+    # the complete graph the user asked for at that n).
+    assert clamp_view_degree(17, 16) == 16   # SimConfig.degree -> n-1
+    assert SimConfig(n=17, view_degree=16).degree == 16
+    assert clamp_view_degree(18, 16) == 16
+    assert clamp_view_degree(1024, 16) == 16
+    assert clamp_view_degree(8, 16) == 16    # >= n-1: dense fallback
+    assert clamp_view_degree(64, 0) == 0     # dense stays dense
+
+
+def test_clamp_view_degree_rejects_odd():
+    with pytest.raises(ValueError, match="even"):
+        clamp_view_degree(1024, 15)
+    with pytest.raises(ValueError, match=">= 0"):
+        clamp_view_degree(1024, -2)
+
+
+def test_chaos_parser_keeps_resilience_and_family_flags():
+    # The chaos subcommand grew --sweep/--families without losing the
+    # resilient-harness knobs the non-sweep path dereferences
+    # (cmd_chaos -> _run_resilient_cmd reads args.sentinel et al.).
+    from consul_tpu.cli import build_parser
+
+    args = build_parser().parse_args(["chaos", "--n", "64"])
+    for knob in ("sentinel", "sentinel_dump_dir", "ckpt_dir",
+                 "heartbeat_s", "elastic", "family", "sweep",
+                 "families", "sweep_mode"):
+        assert hasattr(args, knob), knob
