@@ -60,6 +60,7 @@ sys.path.insert(0, _HERE)
 
 from consul_tpu.utils import tpu_lock  # noqa: E402  (no jax inside)
 from consul_tpu.runtime import watchdog as runtime_watchdog  # noqa: E402  (stdlib only)
+from consul_tpu.obs import blackbox as obs_blackbox  # noqa: E402  (stdlib only)
 
 
 # ----------------------------------------------------------------------
@@ -67,6 +68,13 @@ from consul_tpu.runtime import watchdog as runtime_watchdog  # noqa: E402  (stdl
 # ----------------------------------------------------------------------
 
 def _emit(obj):
+    # Uniform timing contract: every phase line carries BOTH wall_s and
+    # compile_s (0.0 when the phase had no separately measured compile
+    # region), so downstream consumers never branch on key presence.
+    # Error lines are diagnostics, not measurements, and stay bare.
+    if obj.get("phase") and obj["phase"] != "error":
+        obj.setdefault("wall_s", 0.0)
+        obj.setdefault("compile_s", 0.0)
     sys.stdout.write(json.dumps(obj) + "\n")
     sys.stdout.flush()
 
@@ -117,6 +125,7 @@ def child(platform: str, deadline: float):
             "devices": len(devs),
             "jax": jax.__version__,
             "init_s": round(time.monotonic() - t0, 1),
+            "wall_s": round(time.monotonic() - t0, 1),
             "memory": mem,
         })
     except Exception as e:  # backend init failed: nothing else can run
@@ -188,7 +197,10 @@ def child(platform: str, deadline: float):
                        "cache_enabled": bool(cc_dir),
                        "compiled": summary["compiled"],
                        "cache": summary["cache"],
-                       "wall_s": summary["wall_s"]})
+                       "wall_s": summary["wall_s"],
+                       # Prewarm's wall IS compile: the phase exists
+                       # only to pay AOT builds outside timed regions.
+                       "compile_s": summary["wall_s"]})
             except Exception as e:
                 _emit({"phase": "error", "where": f"prewarm:{pn}",
                        "error": repr(e)[:500]})
@@ -208,7 +220,8 @@ def child(platform: str, deadline: float):
         t1 = time.monotonic()
         sim.run(runner_ticks * reps, chunk=chunk, with_metrics=False)
         jax.block_until_ready(sim.state.view_key)
-        rounds_per_s = runner_ticks * reps / (time.monotonic() - t1)
+        timed_wall = time.monotonic() - t1
+        rounds_per_s = runner_ticks * reps / timed_wall
         _emit({
             "phase": "throughput",
             "n": n,
@@ -216,6 +229,7 @@ def child(platform: str, deadline: float):
             "mesh": (None if sim.mesh is None else
                      [int(sim.mesh.shape[a]) for a in sim.mesh.axis_names]),
             "rounds_per_s": round(rounds_per_s, 2),
+            "wall_s": round(timed_wall, 2),
             "compile_s": round(t1 - t, 1),
             "compile_cache": compile_cache.stats_delta(cc0),
             "counters": sim.counters_snapshot(),
@@ -225,6 +239,14 @@ def child(platform: str, deadline: float):
 
     try:
         if sim is not None and left() > 30:
+            # Warm the metrics-on runner (run_until_converged's
+            # program) BEFORE the kill, so its one-off compile is
+            # measured as compile_s instead of polluting the
+            # convergence wall — the extra formed ticks are harmless.
+            t_warm = time.monotonic()
+            sim.run(chunk, chunk=chunk, with_metrics=True)
+            jax.block_until_ready(sim.state.view_key)
+            conv_compile_s = time.monotonic() - t_warm
             if profile:
                 jax.profiler.start_trace(profile)
             n_kill = int(n * kill_frac)
@@ -243,6 +265,7 @@ def child(platform: str, deadline: float):
                 "converged": bool(converged),
                 "kill_frac": kill_frac,
                 "wall_s": round(wall, 2),
+                "compile_s": round(conv_compile_s, 1),
                 "sim_s": round(sim_s, 1),
                 "ticks": int(ticks_used),
                 "counters": sim.counters_snapshot(),
@@ -252,6 +275,7 @@ def child(platform: str, deadline: float):
 
     try:
         if sim is not None:
+            t_rmse = time.monotonic()
             h = sim.health()
             _emit({
                 "phase": "rmse",
@@ -261,6 +285,7 @@ def child(platform: str, deadline: float):
                 "health_score_mean": round(
                     float(jnp.mean(jnp.asarray(sim.state.awareness, jnp.float32))), 3
                 ),
+                "wall_s": round(time.monotonic() - t_rmse, 2),
             })
     except Exception as e:
         _emit({"phase": "error", "where": "rmse", "error": repr(e)[:500]})
@@ -277,6 +302,7 @@ def child(platform: str, deadline: float):
     try:
         from consul_tpu.runtime import membudget
 
+        t_mem = time.monotonic()
         cfg_mem = SimConfig(n=n, view_degree=clamp_view_degree(n, view_degree))
         layouts = {}
         for lay in ("dense", "packed"):
@@ -306,7 +332,8 @@ def child(platform: str, deadline: float):
             except Exception:
                 peaks.append({"device": str(d), "memory_stats": None})
         _emit({"phase": "memory", "n": n, "view_degree": view_degree,
-               "layouts": layouts, "device_peaks": peaks})
+               "layouts": layouts, "device_peaks": peaks,
+               "wall_s": round(time.monotonic() - t_mem, 2)})
     except Exception as e:
         _emit({"phase": "error", "where": "memory", "error": repr(e)[:500]})
 
@@ -322,15 +349,22 @@ def child(platform: str, deadline: float):
             from consul_tpu import chaos as chaos_mod
 
             cn = int(os.environ.get("BENCH_CHAOS_N", "1024"))
+            t_form = time.monotonic()
             csim = build(cn)
             csim.run(64, chunk=32, with_metrics=False)  # form the cluster
+            chaos_compile_s = time.monotonic() - t_form
+            t_scen = time.monotonic()
             res = csim.run_scenario(
                 [chaos_mod.Partition(start=4, stop=16,
                                      side_a=slice(0, int(cn * 0.3)))],
                 chunk=32, settle=64,
             )
             _emit({"phase": "chaos", "n": cn, "ticks": res.ticks,
-                   "slo": res.slo})
+                   "slo": res.slo,
+                   "wall_s": round(time.monotonic() - t_scen, 2),
+                   # Build + formation: where this phase's programs
+                   # (and the schedule-plane executable's inputs) warm.
+                   "compile_s": round(chaos_compile_s, 1)})
             del csim
     except Exception as e:
         _emit({"phase": "error", "where": "chaos", "error": repr(e)[:500]})
@@ -362,10 +396,12 @@ def child(platform: str, deadline: float):
                     "BENCH_TOPO_FAMILIES",
                     "circulant,expander,smallworld,hier").split(",")
                 if f.strip())
-            _emit({"phase": "topology",
-                   **sweep_mod.bench_pareto(
-                       n=tn, degree=tdeg, scenarios=tscen, families=tfam,
-                       settle=tsettle, seed=0)})
+            t_topo = time.monotonic()
+            topo = sweep_mod.bench_pareto(
+                n=tn, degree=tdeg, scenarios=tscen, families=tfam,
+                settle=tsettle, seed=0)
+            topo.setdefault("wall_s", round(time.monotonic() - t_topo, 2))
+            _emit({"phase": "topology", **topo})
     except Exception as e:
         _emit({"phase": "error", "where": "topology", "error": repr(e)[:500]})
 
@@ -391,6 +427,7 @@ def child(platform: str, deadline: float):
             from consul_tpu.utils.telemetry import Sink
 
             en = int(os.environ.get("BENCH_ELASTIC_N", "512"))
+            t_elastic = time.monotonic()
             with tempfile.TemporaryDirectory() as td:
                 esim = build(en)
                 trap = SignalTrap()
@@ -434,6 +471,7 @@ def child(platform: str, deadline: float):
                 _emit({
                     "phase": "elasticity",
                     "n": en,
+                    "wall_s": round(time.monotonic() - t_elastic, 2),
                     "devices": len(jax.devices()),
                     "resumed_from_tick": int(report.resumed_from_tick),
                     "reshards": int(report.reshards),
@@ -473,20 +511,24 @@ def child(platform: str, deadline: float):
     # gate's own headline.
     try:
         if left() > 120:
+            t_serf = time.monotonic()
             ssim = build(n, cls=SerfSimulation)
             ssim.run(chunk, chunk=chunk, with_metrics=False)
             ssim.user_event(jnp.arange(n) < 8, 1)
             jax.block_until_ready(ssim.state.ev_key)
+            serf_compile_s = time.monotonic() - t_serf
             t1 = time.monotonic()
             for rep in range(2):
                 ssim.user_event(jnp.arange(n) < 8, 2 + rep)
                 ssim.run(chunk, chunk=chunk, with_metrics=False)
             jax.block_until_ready(ssim.state.ev_key)
+            serf_wall = time.monotonic() - t1
             _emit({
                 "phase": "serf_throughput",
                 "n": n,
-                "rounds_per_s": round(
-                    chunk * 2 / (time.monotonic() - t1), 2),
+                "rounds_per_s": round(chunk * 2 / serf_wall, 2),
+                "wall_s": round(serf_wall, 2),
+                "compile_s": round(serf_compile_s, 1),
                 "counters": ssim.counters_snapshot(),
             })
             if left() > 60:
@@ -496,11 +538,12 @@ def child(platform: str, deadline: float):
                 t2 = time.monotonic()
                 ssim.run(chunk, chunk=chunk, with_metrics=False)
                 jax.block_until_ready(ssim.state.ev_key)
+                idle_wall = time.monotonic() - t2
                 _emit({
                     "phase": "serf_idle",
                     "n": n,
-                    "rounds_per_s": round(
-                        chunk / (time.monotonic() - t2), 2),
+                    "rounds_per_s": round(chunk / idle_wall, 2),
+                    "wall_s": round(idle_wall, 2),
                 })
             del ssim
     except Exception as e:
@@ -534,7 +577,9 @@ def child(platform: str, deadline: float):
                 return [(MODE_NEAREST, srng.randrange(n), -1)
                         for _ in range(sb)]
 
+            t_warm = time.monotonic()
             plane.batcher.execute(_serve_batch())  # warm the bucket
+            serve_compile_s = time.monotonic() - t_warm
             plane.batcher.latencies_s.clear()  # p50/p99 = steady state
             t1 = time.monotonic()
             for _ in range(sreps):
@@ -548,6 +593,8 @@ def child(platform: str, deadline: float):
                 "k": sk,
                 "queries": sreps * sb,
                 "queries_per_sec_per_chip": round(sreps * sb / wall, 1),
+                "wall_s": round(wall, 2),
+                "compile_s": round(serve_compile_s, 1),
                 "p50_batch_ms": st["p50_batch_ms"],
                 "p99_batch_ms": st["p99_batch_ms"],
                 "padding_waste_pct": st["padding_waste_pct"],
@@ -566,6 +613,7 @@ def child(platform: str, deadline: float):
             from consul_tpu.serving.mixed import run_mixed
 
             mb = int(os.environ.get("BENCH_MIXED_BATCH", "1024"))
+            t_mixed = time.monotonic()
             mixed_plane = _MixPlane(k=8, buckets=(mb,), num_services=8)
             qsim.attach_serving(mixed_plane, writes=True, kv_slots=256)
             mixed = run_mixed(
@@ -573,6 +621,8 @@ def child(platform: str, deadline: float):
                 ratio=os.environ.get("BENCH_MIXED_RATIO", "90:9:1"),
                 rounds=int(os.environ.get("BENCH_MIXED_ROUNDS", "16")),
                 read_batch=mb, watchers=8, seed=0)
+            mixed.setdefault("wall_s",
+                             round(time.monotonic() - t_mixed, 2))
             _emit({"phase": "serving_mixed", "n": n, **mixed})
             del mixed_plane
     except Exception as e:
@@ -599,17 +649,20 @@ def child(platform: str, deadline: float):
         visible = bench_devices or len(jax.devices())
 
         def scaling_rung(n_s, d):
+            t_w = time.monotonic()
             zsim = build(n_s, device_count=d)
             zsim.run(scaling_chunk, chunk=scaling_chunk,
                      with_metrics=False)  # warm + compile
             jax.block_until_ready(zsim.state.view_key)
+            warm_s = time.monotonic() - t_w
             reps = 2
             t1 = time.monotonic()
             zsim.run(scaling_chunk * reps, chunk=scaling_chunk,
                      with_metrics=False)
             jax.block_until_ready(zsim.state.view_key)
             del zsim
-            return scaling_chunk * reps / (time.monotonic() - t1)
+            return (scaling_chunk * reps / (time.monotonic() - t1),
+                    warm_s)
 
         for kind, fixed in (("scaling_strong", True), ("scaling_weak", False)):
             try:
@@ -618,11 +671,14 @@ def child(platform: str, deadline: float):
                            "skipped": "deadline"})
                     continue
                 entries, base_rps = [], None
+                ladder_compile_s = 0.0
+                t_ladder = time.monotonic()
                 d = 1
                 while d <= visible:
                     n_s = strong_n if fixed else per_chip * d
                     if n_s % d == 0 and left() > 90:
-                        rps = scaling_rung(n_s, d)
+                        rps, warm_s = scaling_rung(n_s, d)
+                        ladder_compile_s += warm_s
                         if d == 1:
                             base_rps = rps
                         denom = (d * base_rps if fixed else base_rps) \
@@ -632,6 +688,7 @@ def child(platform: str, deadline: float):
                             "n": n_s,
                             "rounds_per_s": round(rps, 2),
                             "rounds_per_s_per_chip": round(rps / d, 2),
+                            "compile_s": round(warm_s, 1),
                             "parallel_efficiency":
                                 round(rps / denom, 3) if denom else None,
                         })
@@ -640,7 +697,9 @@ def child(platform: str, deadline: float):
                        "devices_visible": visible,
                        **({"n": strong_n} if fixed
                           else {"per_chip": per_chip}),
-                       "entries": entries})
+                       "entries": entries,
+                       "wall_s": round(time.monotonic() - t_ladder, 2),
+                       "compile_s": round(ladder_compile_s, 1)})
             except Exception as e:
                 _emit({"phase": "error", "where": kind,
                        "error": repr(e)[:500]})
@@ -669,11 +728,13 @@ def child(platform: str, deadline: float):
             t1 = time.monotonic()
             ssim.run(chunk, chunk=chunk, with_metrics=False)
             jax.block_until_ready(ssim.state.view_key)
-            rps = chunk / (time.monotonic() - t1)
+            sweep_wall = time.monotonic() - t1
+            rps = chunk / sweep_wall
             _emit({
                 "phase": "sweep",
                 "n": s,
                 "rounds_per_s": round(rps, 2),
+                "wall_s": round(sweep_wall, 2),
                 "compile_s": round(compile_s, 1),
                 "compile_cache": compile_cache.stats_delta(cc0),
             })
@@ -702,11 +763,13 @@ def child(platform: str, deadline: float):
                 t4 = time.monotonic()
                 fsim.run(chunk, chunk=chunk, with_metrics=False)
                 jax.block_until_ready(fsim.state.ev_key)
-                srps = chunk / (time.monotonic() - t4)
+                serf_sweep_wall = time.monotonic() - t4
+                srps = chunk / serf_sweep_wall
                 _emit({
                     "phase": "serf_sweep",
                     "n": s,
                     "rounds_per_s": round(srps, 2),
+                    "wall_s": round(serf_sweep_wall, 2),
                     "compile_s": round(serf_compile, 1),
                     "compile_cache": compile_cache.stats_delta(cc1),
                 })
@@ -720,9 +783,31 @@ def child(platform: str, deadline: float):
                 del fsim
         except Exception as e:
             _emit({"phase": "error", "where": f"sweep:{s}", "error": repr(e)[:400]})
+    # Flight-recorder artifact (obs/trace.py): the host-span ring this
+    # child accumulated — chunk markers, xla.backend_compile spans, the
+    # serving/checkpoint/DCN seams — exported as one Perfetto-loadable
+    # file. Opt-in via BENCH_TRACE_DIR; the path is stamped with the
+    # platform so the TPU and CPU children never clobber each other.
+    trace_dir = os.environ.get("BENCH_TRACE_DIR", "")
+    if trace_dir:
+        try:
+            from consul_tpu.obs import trace as obs_trace
+
+            t_tr = time.monotonic()
+            tracer = obs_trace.get_tracer()
+            trace_path = tracer.export(
+                os.path.join(trace_dir, f"bench_{platform}_trace.json"))
+            _emit({"phase": "trace", "path": trace_path,
+                   "events": len(tracer.events()),
+                   "dropped_events": tracer.dropped,
+                   "wall_s": round(time.monotonic() - t_tr, 2)})
+        except Exception as e:
+            _emit({"phase": "error", "where": "trace",
+                   "error": repr(e)[:500]})
     # Whole-child cache provenance: cumulative hits/misses, so the
     # parent can record whether THIS process compiled or deserialized.
-    _emit({"phase": "compile_cache", **compile_cache.stats()})
+    _emit({"phase": "compile_cache", **compile_cache.stats(),
+           "wall_s": round(time.monotonic() - t0, 1)})
     return 0
 
 
@@ -903,6 +988,16 @@ def _run_child(platform: str, timeout_s: float, extra_env=None,
             pass
         return False
 
+    # Backend-init black box (obs/blackbox.py): an INIT_HANG kill
+    # captures env/libtpu/device-progress plus the child's own last
+    # output into a per-attempt timestamped directory, and the path
+    # rides the attempt dict so with_failover provenance links it.
+    bb_dir = os.path.join(
+        os.environ.get("BENCH_BLACKBOX_DIR",
+                       os.path.join(_HERE, ".bench_blackbox")),
+        f"{platform}_{int(t0 * 1000)}")
+    wd = runtime_watchdog.InitWatchdog(
+        init_window_s=init_window_s, blackbox_dir=bb_dir)
     try:
         with os.fdopen(fd, "w") as out:
             proc = subprocess.Popen(
@@ -914,9 +1009,9 @@ def _run_child(platform: str, timeout_s: float, extra_env=None,
             # only — this parent process must stay jax-free): kill the
             # child early when the init window passes without a setup
             # phase, or at the hard deadline either way.
-            status = runtime_watchdog.InitWatchdog(
-                init_window_s=init_window_s).watch(
-                    proc, _setup_seen, deadline=t0 + timeout_s)
+            status = wd.watch(
+                proc, _setup_seen, deadline=t0 + timeout_s,
+                child_tail=lambda: obs_blackbox.tail_file(out_path))
         with open(out_path) as f:
             for line in f:
                 line = line.strip()
@@ -945,6 +1040,9 @@ def _run_child(platform: str, timeout_s: float, extra_env=None,
         "platform_requested": platform,
         "phases": phases,
         "log_tail": raw_tail[-3:],
+        # The init-hang postmortem artifact path (None on every other
+        # outcome) — with_failover lifts it into attempt provenance.
+        "blackbox": getattr(wd, "blackbox_path", None),
     }
 
 
@@ -999,7 +1097,7 @@ def _save_tpu_session(result):
 # while not_run + reason records the skip as a deliberate outcome.
 _PHASE_KEYS = ("northstar_1m", "northstar_1m_serf", "compile_cache",
                "elasticity", "memory", "serving", "serving_mixed",
-               "scaling_strong", "scaling_weak", "topology")
+               "scaling_strong", "scaling_weak", "topology", "trace")
 
 
 def _phase_or_not_run(phases, name, reason, pick=None):
@@ -1270,6 +1368,13 @@ def main():
         "scaling_weak": _phase_or_not_run(
             primary["phases"], "scaling_weak",
             "skipped: needs >1 visible device or time budget left"),
+        # Flight-recorder artifact (obs/trace.py): the primary child's
+        # exported Perfetto trace path + event count. Opt-in — set
+        # BENCH_TRACE_DIR to arm it; not_run otherwise.
+        "trace": _phase_or_not_run(
+            primary["phases"], "trace",
+            "tracing disabled: set BENCH_TRACE_DIR to export the "
+            "child's host-span ring"),
         # Topology-lab Pareto table (chaos/sweep.py bench_pareto):
         # bytes/tick/node vs time-to-heal per view-graph family at
         # equal degree, swept over one vmapped scenario grid, plus

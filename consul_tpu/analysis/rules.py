@@ -6,7 +6,7 @@ function definitions the callgraph proved reachable from a trace entry
 point — host-tier driver code in the same file is untouched.
 ``device-module`` rules (TH103/TH104) fire anywhere in a device-tier
 module (models/ ops/ parallel/ chaos/). ``package`` rules
-(TH105/TH106) fire everywhere.
+(TH105/TH106/TH108/TH112) fire everywhere.
 """
 
 from __future__ import annotations
@@ -55,6 +55,11 @@ RULES = {
              "*_delta) bypasses the one codec (models/layout.unpack) "
              "and silently drops its sentinels, tick anchors, and fp8 "
              "scale; unpack the whole state instead",
+    "TH112": "time.time() used to compute a duration — subtracting "
+             "wall-clock reads measures NTP steps and clock slews, "
+             "not elapsed time; spans and latency math must use "
+             "time.perf_counter()/time.monotonic() (genuine "
+             "wall-clock-timestamp sites are allowlisted)",
 }
 
 # TH101: int()/float()/bool() arguments considered static (config
@@ -143,6 +148,11 @@ class _RuleVisitor(ast.NodeVisitor):
         # Names proven concrete by an `isinstance(x, jax.core.Tracer)`
         # guard (the non-Tracer branch) — int(x) there is host math.
         self._proven_static: set = set()
+        # Per-scope sets of names assigned from time.time() (TH112):
+        # a later subtraction over one of them is a wall-clock
+        # duration. Stack-shaped like _scope; lookups see enclosing
+        # scopes so a closure over a wall stamp still fires.
+        self._walltime_scope: list = [set()]
 
     # -- helpers --------------------------------------------------------
     def _emit(self, rule: str, node, message: str):
@@ -166,28 +176,34 @@ class _RuleVisitor(ast.NodeVisitor):
         self._check_defaults(node)
         self._scope.append((node.name, id(node) in self.traced_ids))
         self._mesh_scope.append(_touches_mesh(node, self.mod))
+        self._walltime_scope.append(set())
         for dec in node.decorator_list:
             self.visit(dec)
         self.visit(node.args)
         self._visit_body(node.body)
         self._scope.pop()
         self._mesh_scope.pop()
+        self._walltime_scope.pop()
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
     def visit_Lambda(self, node):
         self._scope.append(("<lambda>", id(node) in self.traced_ids))
         self._mesh_scope.append(False)  # inherits via any()
+        self._walltime_scope.append(set())
         self.generic_visit(node)
         self._scope.pop()
         self._mesh_scope.pop()
+        self._walltime_scope.pop()
 
     def visit_ClassDef(self, node):
         self._scope.append((node.name, False))
         self._mesh_scope.append(False)
+        self._walltime_scope.append(set())
         self.generic_visit(node)
         self._scope.pop()
         self._mesh_scope.pop()
+        self._walltime_scope.pop()
 
     # -- static-at-trace idioms the trace rules must respect ------------
     def visit_With(self, node):
@@ -486,6 +502,48 @@ class _RuleVisitor(ast.NodeVisitor):
             "with no bound or backoff — a wedged dependency spins this "
             "forever; bound the attempts (deadline compare or max "
             "retries) and back off with jitter")
+
+    # -- TH112: wall-clock durations ------------------------------------
+    def visit_Assign(self, node):
+        is_wall = isinstance(node.value, ast.Call) \
+            and self.mod.resolve(node.value.func, None) == "time.time"
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                if is_wall:
+                    self._walltime_scope[-1].add(t.id)
+                else:
+                    self._walltime_scope[-1].discard(t.id)
+        self.generic_visit(node)
+
+    def visit_BinOp(self, node):
+        if isinstance(node.op, ast.Sub):
+            self._rule_th112(node)
+        self.generic_visit(node)
+
+    def _rule_th112(self, node):
+        """A subtraction with a ``time.time()`` read on either side —
+        directly (``time.time() - t0``) or through a name assigned
+        from it in an enclosing scope (``t0 = time.time() ...
+        t1 - t0``). Wall clocks step under NTP and slew continuously,
+        so the difference is not elapsed time; every span/latency/
+        timeout measurement must use ``time.perf_counter()`` or
+        ``time.monotonic()``. Genuine wall-clock timestamp arithmetic
+        (e.g. age against a file mtime, which IS wall-clock) is
+        allowlisted by symbol with its reason."""
+        def _is_wall(n):
+            if isinstance(n, ast.Call) \
+                    and self.mod.resolve(n.func, None) == "time.time":
+                return True
+            return isinstance(n, ast.Name) \
+                and any(n.id in s for s in self._walltime_scope)
+
+        if _is_wall(node.left) or _is_wall(node.right):
+            self._emit(
+                "TH112", node,
+                f"{ast.unparse(node)!s} computes a duration from "
+                "time.time() — wall clocks step (NTP) and slew, so "
+                "this is not elapsed time; use time.perf_counter() "
+                "or time.monotonic() for spans and latency math")
 
     # -- TH103 / TH107: name-shaped rules -------------------------------
     def visit_Attribute(self, node):

@@ -28,6 +28,7 @@ framework implements:
   tls create       dev CA + server cert                (command/tls)
   validate         config file validation              (command/validate)
   chaos            compiled fault-schedule scenarios   (consul_tpu/chaos)
+  trace            flight-record a local run           (consul_tpu/obs)
   lock             run a command under a KV lock       (command/lock)
   exec             remote execution via KV + events    (command/exec)
 
@@ -776,6 +777,11 @@ def _build_sim(args):
     mesh = _mesh_from_args(args, args.n)
     plan = _plan_from_args(args, cfg, kind, mesh)
     if plan is not None and plan.streamed:
+        if int(getattr(args, "lens", 0) or 0):
+            print("--lens: the node lens needs a resident population; "
+                  "cohort-streamed runs cannot record it",
+                  file=sys.stderr)
+            raise SystemExit(2)
         scls = StreamedSerfSimulation if args.serf else StreamedSimulation
         sim = scls(cfg, cohort_n=plan.cohort_n, seed=args.seed,
                    layout=plan.layout, chunk=plan.chunk)
@@ -783,6 +789,13 @@ def _build_sim(args):
     cls = SerfSimulation if args.serf else Simulation
     sim = cls(cfg, seed=args.seed, mesh=mesh,
               layout=plan.layout if plan else "dense")
+    lens_n = int(getattr(args, "lens", 0) or 0)
+    if lens_n:
+        if mesh is not None:
+            print("--lens: the node lens is single-device; drop the "
+                  "mesh flags to use it", file=sys.stderr)
+            raise SystemExit(2)
+        sim.set_lens(lens_n)
     if getattr(args, "prewarm", False):
         from consul_tpu.utils import prewarm as prewarm_mod
 
@@ -790,6 +803,24 @@ def _build_sim(args):
         for with_metrics in (False, True):
             prewarm_mod.prewarm_simulation(sim, chunk, with_metrics)
     return sim, plan
+
+
+def _export_trace(args, sim=None):
+    """Write the flight-recorder artifact (obs/trace.py Chrome
+    trace-event JSON; the armed lens's node timelines merge in) when
+    the run asked for one via ``--trace-dir``. Returns the artifact
+    path, or None when tracing was not requested."""
+    tdir = getattr(args, "trace_dir", None)
+    if not tdir:
+        return None
+    from consul_tpu.obs import trace as obs_trace
+
+    extra = None
+    lens = getattr(sim, "lens", None) if sim is not None else None
+    if lens is not None:
+        extra = lens.to_trace_events()
+    return obs_trace.get_tracer().export(
+        os.path.join(tdir, "trace.json"), extra_events=extra)
 
 
 def _ckpt_policy(args, sim, default_tag: str):
@@ -852,6 +883,9 @@ def _run_resilient_cmd(args, sim, events, ticks, extra: dict) -> int:
                ckpt_failures=report.ckpt_failures,
                reshards=report.reshards,
                hang_status=report.hang_status)
+    trace_path = _export_trace(args, sim)
+    if trace_path:
+        out["trace"] = trace_path
     print(json.dumps(out))
     return 0
 
@@ -925,8 +959,12 @@ def cmd_chaos(args) -> int:
                                    stop=e.stop + args.form_ticks)
                        for e in events])
         summary = sim.run(ticks)
-        print(json.dumps(dict(extra, **summary, streamed=True,
-                              counters=sim.counters_snapshot())))
+        out = dict(extra, **summary, streamed=True,
+                   counters=sim.counters_snapshot())
+        trace_path = _export_trace(args, sim)
+        if trace_path:
+            out["trace"] = trace_path
+        print(json.dumps(out))
         return 0
     sim.run(args.form_ticks, chunk=args.chunk, with_metrics=False)
     return _run_resilient_cmd(args, sim, events, ticks, extra)
@@ -998,8 +1036,12 @@ def cmd_run(args) -> int:
         extra["memory_plan"] = plan.to_dict()
     if plan is not None and plan.streamed:
         summary = sim.run(args.ticks)
-        print(json.dumps(dict(extra, **summary, streamed=True,
-                              counters=sim.counters_snapshot())))
+        out = dict(extra, **summary, streamed=True,
+                   counters=sim.counters_snapshot())
+        trace_path = _export_trace(args, sim)
+        if trace_path:
+            out["trace"] = trace_path
+        print(json.dumps(out))
         return 0
     return _run_resilient_cmd(args, sim, None, args.ticks, extra)
 
@@ -1076,6 +1118,9 @@ def cmd_serve_bench(args) -> int:
         out = dict(plane.stats())
         out.update({"n": args.n, "k": args.k, "batch": args.batch,
                     "mixed": mixed})
+        trace_path = _export_trace(args, sim)
+        if trace_path:
+            out["trace"] = trace_path
         print(json.dumps(out))
         return 0
 
@@ -1100,6 +1145,31 @@ def cmd_serve_bench(args) -> int:
     out.update({"n": args.n, "k": args.k, "batch": args.batch,
                 "queries": total, "wall_s": round(wall, 3),
                 "queries_per_sec_per_chip": round(total / wall, 1)})
+    trace_path = _export_trace(args, sim)
+    if trace_path:
+        out["trace"] = trace_path
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Flight-record a short local run: arm the node lens, advance the
+    simulation, and write the Perfetto-loadable trace artifact — host
+    spans, XLA compile spans, per-chunk markers, and one counter
+    timeline per sampled node in a single file. Prints one JSON line
+    with the artifact path (load it at https://ui.perfetto.dev or
+    chrome://tracing)."""
+    sim, plan = _build_sim(args)
+    trace = sim.run(args.ticks, chunk=args.chunk)
+    path = _export_trace(args, sim)
+    out = {
+        "n": args.n,
+        "ticks": args.ticks,
+        "lens_ids": list(sim.lens.ids) if sim.lens is not None else [],
+        "agreement": float(trace.agreement[-1]) if trace is not None
+        else None,
+        "trace": path,
+    }
     print(json.dumps(out))
     return 0
 
@@ -1170,6 +1240,20 @@ def build_parser() -> argparse.ArgumentParser:
                              " a second cold process deserializes "
                              "executables instead of recompiling")
 
+    def add_obs_flags(sp, lens_default: int = 0):
+        """The flight-recorder knobs every local-run subcommand
+        shares (consul_tpu/obs)."""
+        sp.add_argument("--trace-dir", default=None, metavar="DIR",
+                        help="write the Perfetto trace artifact "
+                             "(host spans + XLA compiles + chunk "
+                             "markers + lens timelines) under DIR")
+        sp.add_argument("--lens", type=int, default=lens_default,
+                        metavar="N",
+                        help="record N evenly spaced nodes' per-tick "
+                             "observables inside the compiled scan "
+                             "(obs/lens.py; 0 = off, the byte-"
+                             "identical pre-lens program)")
+
     def add_layout_flags(sp):
         # MemoryBudget planner knobs (runtime/membudget.py): the state
         # layout and the per-device byte budget that together decide
@@ -1229,6 +1313,31 @@ def build_parser() -> argparse.ArgumentParser:
     add_resilience_flags(rn)
     add_mesh_flags(rn)
     add_layout_flags(rn)
+    add_obs_flags(rn)
+
+    tr = sub.add_parser(
+        "trace",
+        help="flight-record a short local run: host spans + XLA "
+             "compiles + chunk markers + per-node lens timelines in "
+             "one Perfetto-loadable trace file")
+    tr.add_argument("--n", type=int, default=1024)
+    tr.add_argument("--seed", type=int, default=0)
+    tr.add_argument("--view-degree", type=int, default=16)
+    add_family_flags(tr)
+    tr.add_argument("--ticks", type=int, default=256)
+    tr.add_argument("--chunk", type=int, default=32)
+    tr.add_argument("--serf", action="store_true",
+                    help="trace the full serf step (event/query plane)")
+    # The lens is single-device; pin the default mesh off rather than
+    # erroring on multi-chip hosts.
+    tr.add_argument("--devices", type=int, default=1,
+                    help=argparse.SUPPRESS)
+    tr.add_argument("--trace-dir", default="traces", metavar="DIR",
+                    help="artifact directory (default: ./traces)")
+    tr.add_argument("--lens", type=int, default=8, metavar="N",
+                    help="record N evenly spaced nodes' per-tick "
+                         "observables inside the compiled scan "
+                         "(obs/lens.py; 0 = off)")
 
     sv = sub.add_parser(
         "serve-bench",
@@ -1266,6 +1375,7 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--compile-cache", default=None, metavar="DIR",
                     help="persistent XLA compilation cache directory")
     add_mesh_flags(sv)
+    add_obs_flags(sv)
 
     ch = sub.add_parser(
         "chaos",
@@ -1307,6 +1417,7 @@ def build_parser() -> argparse.ArgumentParser:
     add_resilience_flags(ch)
     add_mesh_flags(ch)
     add_layout_flags(ch)
+    add_obs_flags(ch)
 
     pw = sub.add_parser(
         "prewarm",
@@ -1656,6 +1767,8 @@ def main(argv=None) -> int:
         return cmd_prewarm(args)
     if args.cmd == "serve-bench":
         return cmd_serve_bench(args)
+    if args.cmd == "trace":
+        return cmd_trace(args)
     client = make_client(args)
     try:
         return COMMANDS[args.cmd](client, args)

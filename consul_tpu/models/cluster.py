@@ -26,6 +26,8 @@ from consul_tpu.models import layout as layout_mod
 from consul_tpu.models import serf as serf_mod
 from consul_tpu.models import state as sim_state
 from consul_tpu.models import swim
+from consul_tpu.obs import lens as lens_obs
+from consul_tpu.obs import trace as obs_trace
 from consul_tpu.ops import topology
 from consul_tpu.parallel import mesh as pmesh
 from consul_tpu.utils import checkpoint as ckpt_mod
@@ -110,7 +112,8 @@ class SentinelViolation(RuntimeError):
 def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
                   step_fn=swim.step_counted, swim_of=lambda st: st,
                   chaos_key=None, sentinel: bool = False, mesh=None,
-                  layout: str = layout_mod.DENSE):
+                  layout: str = layout_mod.DENSE, lens: tuple = (),
+                  clock_of=None):
     """One compiled chunk program. ``step_fn`` is the per-tick counted
     step (bare SWIM or the full serf stack) returning
     (state, GossipCounters); ``swim_of`` projects the SWIM plane out of
@@ -149,14 +152,27 @@ def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
     the body unpacks to the dense working set, steps, and re-packs, so
     the resident footprint (and the donated carry) is the 2.5x-smaller
     packed form while the step math is unchanged. The dense program is
-    byte-for-byte the pre-layout one (the compile-count pin)."""
+    byte-for-byte the pre-layout one (the compile-count pin).
+
+    ``lens`` (a static node-id tuple, empty = off) threads the
+    on-device node lens (obs/lens.py) through the scan: each tick
+    gathers one [S, F] row at the static ids and the chunk returns a
+    stacked [C, S, F] buffer as a fourth result. Empty follows the
+    ``sentinel``/``layout`` DCE contract — the program (and the return
+    arity) is byte-for-byte the pre-lens one, so toggling the lens off
+    compiles nothing. ``clock_of`` projects the serf Lamport clock out
+    of the step's state for the lens (None under bare SWIM)."""
     memo = (cfg, _topo_key(topo), chunk, with_metrics, step_fn, swim_of,
-            chaos_key, sentinel, pmesh.mesh_key(mesh), layout)
+            chaos_key, sentinel, pmesh.mesh_key(mesh), layout, lens,
+            clock_of)
     hit = _RUNNER_CACHE.get(memo)
     if hit is not None:
         return hit
 
     if mesh is not None:
+        if lens:
+            raise ValueError("the node lens is single-device; clear it "
+                             "before installing a mesh")
         from consul_tpu.parallel import shard_step
 
         jitted = shard_step.make_sharded_chunk_runner(
@@ -177,23 +193,30 @@ def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
                            sentinel=sentinel)
         cnt = counters_mod.add(cnt, c)
         out = layout_mod.pack_state(state) if packed else state
+        row = lens_obs.snapshot(
+            swim_of(state),
+            None if clock_of is None else clock_of(state),
+            lens) if lens else None
         if not with_metrics:
-            return (out, cnt), ()
+            return (out, cnt), (row if lens else ())
         sw = swim_of(state)
         h = metrics.health(cfg, topo, sw)
         rmse = metrics.vivaldi_rmse(
             cfg, world, sw, jax.random.fold_in(tick_key, 1), samples=2048
         )
-        return (out, cnt), TickTrace(
-            h.agreement, h.false_positive, h.undetected, rmse)
+        trace = TickTrace(h.agreement, h.false_positive, h.undetected, rmse)
+        return (out, cnt), ((trace, row) if lens else trace)
 
     def run(world, sched, state, base_key):
         ticks = swim_of(state).t + jnp.arange(chunk, dtype=jnp.int32)
         tick_keys = jax.vmap(lambda t: jax.random.fold_in(base_key, t))(ticks)
-        (state, cnt), trace = jax.lax.scan(
+        (state, cnt), ys = jax.lax.scan(
             functools.partial(body, world, sched),
             (state, counters_mod.zeros()), tick_keys)
-        return state, cnt, trace
+        if lens:
+            trace, lbuf = ys if with_metrics else (None, ys)
+            return state, cnt, trace, lbuf
+        return state, cnt, ys
 
     jitted = jax.jit(run, donate_argnums=(2,))
     _RUNNER_CACHE[memo] = jitted
@@ -224,9 +247,12 @@ class Simulation:
     # planner picks it for the CLI); joins the runner memo key.
     layout: str = layout_mod.DENSE
 
-    # Driver hooks (SerfSimulation overrides these two).
+    # Driver hooks (SerfSimulation overrides these).
     _step_fn = staticmethod(swim.step_counted)
     _swim_of = staticmethod(lambda st: st)
+    # Lamport-clock projection for the node lens (obs/lens.py). Bare
+    # SWIM has no serf clock; the lens records 0 for the field.
+    _clock_of = None
 
     def _init_state(self, key):
         return sim_state.init(self.cfg, key)
@@ -264,6 +290,20 @@ class Simulation:
         # of the last completed tick — never torn mid-scan, and never
         # blocking the scan loop.
         self.serving = None
+        # On-device node lens (obs/lens.py): the armed static id tuple
+        # joins the runner memo key; () is the pre-lens program
+        # byte-for-byte (the set_sentinel DCE contract). ``lens`` is
+        # the host-side LensRecorder while armed.
+        self._lens_ids: tuple = ()
+        self.lens = None
+        # Monotone chunk sequence number — the alignment key shared by
+        # the XLA StepTraceAnnotation and the host "chunk" span.
+        self._chunk_seq = 0
+        # Host span tracing (obs/trace.py): span durations mirror into
+        # this sim's sink (last attach wins — one process-wide tracer,
+        # the Sink idiom) and XLA compiles fold in as cat="xla" spans.
+        obs_trace.get_tracer().attach_sink(self.sink)
+        obs_trace.install_jax_hooks()
         if self.mesh is not None:
             self.set_mesh(self.mesh)
 
@@ -276,6 +316,9 @@ class Simulation:
         (parallel/mesh.mesh_key), so revisiting a mesh shape — elastic
         4->8 recovery — never recompiles, while a NEW shape can never
         hit the old shape's executable."""
+        if mesh is not None and self._lens_ids:
+            raise ValueError("the node lens is single-device; "
+                             "set_lens(0) before installing a mesh")
         self.mesh = mesh
         self._runners = {}
         if mesh is None:
@@ -382,6 +425,28 @@ class Simulation:
             self.sentinel = on
             self._runners = {}
 
+    def set_lens(self, sample) -> tuple:
+        """Arm (or clear, with ``0``/empty) the on-device node lens for
+        subsequent runs: ``sample`` is either an int count (evenly
+        spaced ids) or an explicit id list (obs/lens.normalize_ids).
+        Arming rebinds the runners and starts a fresh
+        :class:`obs.lens.LensRecorder` at the live tick (one scalar
+        device read here — never per chunk). Toggling follows the
+        set_sentinel contract: off is the pre-lens program
+        byte-for-byte, and the process-wide _RUNNER_CACHE memoizes both
+        programs so flipping never recompiles. Returns the resolved id
+        tuple."""
+        ids = lens_obs.normalize_ids(self.cfg.n, sample)
+        if ids and self.mesh is not None:
+            raise ValueError("the node lens is single-device; clear "
+                             "the mesh before arming it")
+        if ids != self._lens_ids:
+            self._lens_ids = ids
+            self._runners = {}
+        self.lens = (lens_obs.LensRecorder(ids, tick0=self._tick())
+                     if ids else None)
+        return ids
+
     def _check_sentinel(self, deltas):
         """Host tier of the sentinel: fail-fast on a nonzero violation
         mask, dumping a diagnostic checkpoint first so the corrupt
@@ -467,6 +532,7 @@ class Simulation:
                 step_fn=type(self)._step_fn, swim_of=type(self)._swim_of,
                 chaos_key=chaos_mod.static_key_of(self.chaos),
                 sentinel=self.sentinel, mesh=self.mesh, layout=self.layout,
+                lens=self._lens_ids, clock_of=type(self)._clock_of,
             )
 
             def bound(state, base_key, _j=jitted, _w=self.world,
@@ -477,6 +543,32 @@ class Simulation:
             self._runners[k] = bound
         return self._runners[k]
 
+    def _exec_chunk(self, c: int, with_metrics: bool):
+        """Dispatch one compiled chunk under the observability bracket:
+        the XLA ``StepTraceAnnotation`` plus the host ``chunk`` span
+        (same step number — the cross-file alignment key), and, when
+        the node lens is armed, queue the chunk's ``[C, S, F]`` device
+        buffer on the LensRecorder (a reference hand-off — the one
+        batched transfer happens at flush). Returns ``(cnt, trace)``;
+        ``self.state`` is advanced in place. The span brackets the
+        *dispatch* (the runner returns on async enqueue); callers that
+        block for completion do so outside, so the lens tick window is
+        the dispatch window — monotone and inside the chunk span, which
+        is all the export interpolation needs."""
+        tr = obs_trace.get_tracer()
+        t0_us = tr.now_us()
+        step = self._chunk_seq
+        self._chunk_seq += 1
+        with obs_trace.chunk_annotation(step, c):
+            out = self._runner(c, with_metrics)(self.state, self.base_key)
+        if self._lens_ids:
+            self.state, cnt, trace, lbuf = out
+            if self.lens is not None:
+                self.lens.record(lbuf, c, t0_us, tr.now_us())
+        else:
+            self.state, cnt, trace = out
+        return cnt, trace
+
     def run(self, ticks: int, chunk: int = 64, with_metrics: bool = True):
         """Advance ``ticks`` ticks; returns the concatenated TickTrace
         (or None when metrics are disabled for pure-throughput runs)."""
@@ -485,8 +577,7 @@ class Simulation:
         while remaining > 0:
             c = min(chunk, remaining)
             t0 = time.perf_counter()
-            self.state, cnt, trace = \
-                self._runner(c, with_metrics)(self.state, self.base_key)
+            cnt, trace = self._exec_chunk(c, with_metrics)
             if with_metrics:
                 # Block before reading the clock: the jitted runner
                 # returns on async dispatch, not completion.
@@ -609,8 +700,7 @@ class Simulation:
         while used < max_ticks:
             c = min(chunk, max_ticks - used)
             t0 = time.perf_counter()
-            self.state, cnt, trace = \
-                self._runner(c, True)(self.state, self.base_key)
+            cnt, trace = self._exec_chunk(c, True)
             jax.block_until_ready(trace)
             self._record_chunk(trace, cnt, c, t0)
             self.publish_serving()
@@ -629,12 +719,11 @@ class Simulation:
         Warmup runs the *same* compiled program as the timed region, so
         XLA compilation never lands inside the measurement.
         """
-        runner = self._runner(ticks, False)
-        self.state, cnt, _ = runner(self.state, self.base_key)
+        cnt, _ = self._exec_chunk(ticks, False)
         self._pending_counters.append(cnt)
         jax.block_until_ready(jax.tree.leaves(self.state))
         t0 = time.perf_counter()
-        self.state, cnt, _ = runner(self.state, self.base_key)
+        cnt, _ = self._exec_chunk(ticks, False)
         self._pending_counters.append(cnt)
         jax.block_until_ready(jax.tree.leaves(self.state))
         dt = time.perf_counter() - t0
@@ -675,6 +764,8 @@ class SerfSimulation(Simulation):
 
     _step_fn = staticmethod(serf_mod.step_counted)
     _swim_of = staticmethod(lambda st: st.swim)
+    # The serf membership Lamport clock feeds the lens's lamport field.
+    _clock_of = staticmethod(lambda st: st.clock)
 
     def _init_state(self, key):
         return serf_mod.init(self.cfg, key)
@@ -801,7 +892,9 @@ class StreamedSimulation:
 
     def _stage(self, i: int):
         """Upload cohort i (async dispatch — returns immediately)."""
-        return self._world_of(i), jax.device_put(self._archive[i])
+        with obs_trace.span("stream.upload", cat="stream",
+                            args={"cohort": i}):
+            return self._world_of(i), jax.device_put(self._archive[i])
 
     def _cohort_key(self, i: int):
         return jax.random.fold_in(self._kb, i)
@@ -846,7 +939,9 @@ class StreamedSimulation:
                 # Double buffer: issue the next upload before blocking
                 # on this cohort's drain.
                 staged = self._stage(i + 1)
-            host_state, host_cnts = jax.device_get((state, cnts))
+            with obs_trace.span("stream.drain", cat="stream",
+                                args={"cohort": i}):
+                host_state, host_cnts = jax.device_get((state, cnts))
             self._archive[i] = host_state
             vals = np.sum(np.stack(host_cnts), axis=0)
             for f, v in zip(counters_mod.FIELDS, vals):

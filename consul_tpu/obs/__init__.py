@@ -1,0 +1,24 @@
+"""Flight-recorder observability plane.
+
+Three layers, one artifact:
+
+- :mod:`consul_tpu.obs.trace` — host span tracing: a stdlib-only
+  tracer (context-manager + decorator, monotonic clocks, bounded
+  process-wide ring buffer) emitting Chrome trace-event / Perfetto
+  JSON, with XLA compile events folded in via the same
+  ``jax.monitoring`` listener the CompileLedger counts.
+- :mod:`consul_tpu.obs.lens` — the on-device node lens: S statically
+  sampled node ids recorded per tick inside the jitted scan, exported
+  as per-node counter timelines in the same Perfetto file.
+- :mod:`consul_tpu.obs.blackbox` — the backend-init black box: when a
+  child wedges inside backend init, capture *why* (env, libtpu, the
+  child's last output, device-enumeration progress, the last host
+  spans) into a ``blackbox.json`` artifact.
+
+The package is host-tier (never under a trace except the lens snapshot,
+which is pure gathers); importing it must not pay for JAX.
+"""
+
+from consul_tpu.obs import blackbox, lens, trace  # noqa: F401
+
+__all__ = ["blackbox", "lens", "trace"]

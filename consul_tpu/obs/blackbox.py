@@ -1,0 +1,144 @@
+"""The backend-init black box: capture *why* a backend wedged.
+
+BENCH_r05.json records a real 300 s ``backend-init-hang``; the
+watchdog (runtime/watchdog.py) turns that into a kill + a status
+string, and with_failover degrades to the next platform — but the
+status string is where the diagnosis used to END. A wedged TPU relay
+leaves no traceback: the child is blocked inside ``jax.devices()``
+when it dies, so the only evidence is environmental. This module is
+the flight-recorder dump for that moment — everything the host side
+can still see once the child is gone:
+
+- the backend-relevant environment (JAX_*/TPU_*/XLA_*... — the knobs
+  that select platforms, relays, and plugin paths);
+- the installed libtpu version and the tail of its newest log file
+  (libtpu writes under ``TPU_LOG_DIR`` or ``/tmp/tpu_logs``);
+- the tail of the child's last stdout/stderr (the supervisor passes
+  it — the JSONL phases the child streamed before wedging);
+- partial device-enumeration progress: which backends THIS process
+  has initialized, read from jax's backend registry without calling
+  ``jax.devices()`` (which is exactly the call that hangs — the
+  utils/debug.py hang-guard pattern);
+- the last N host spans from the process tracer's bounded ring
+  (obs/trace.py) — what the host was doing leading up to the hang.
+
+:func:`capture` writes one ``blackbox.json`` and returns the dict;
+with_failover provenance links the artifact path so the bench JSON
+points at the evidence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+SCHEMA_VERSION = 1
+
+# Environment prefixes that steer backend selection and init — the
+# knob set a wedged-relay postmortem always starts from.
+_ENV_PREFIXES = ("JAX", "TPU", "XLA", "LIBTPU", "PJRT", "TF_")
+
+# Default log-tail / span-tail sizes: enough to see the last moves,
+# bounded so the artifact stays a few KB.
+_TAIL_LINES = 50
+_LAST_SPANS = 64
+
+
+def capture_env() -> dict:
+    """The backend-relevant environment (sorted, values verbatim —
+    these are config knobs, not secrets)."""
+    return {k: v for k, v in sorted(os.environ.items())
+            if k.startswith(_ENV_PREFIXES)}
+
+
+def tail_file(path: str, lines: int = _TAIL_LINES) -> Optional[str]:
+    """Last ``lines`` lines of a text file; None when unreadable."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - 64 * 1024))
+            data = f.read().decode("utf-8", errors="replace")
+    except OSError:
+        return None
+    return "\n".join(data.splitlines()[-lines:])
+
+
+def libtpu_info() -> dict:
+    """Installed libtpu version + the tail of its newest log file.
+    Pure metadata reads — never imports or initializes the library."""
+    info: dict = {"version": None, "log_file": None, "log_tail": None}
+    try:
+        from importlib import metadata
+        for dist in ("libtpu", "libtpu-nightly"):
+            try:
+                info["version"] = f"{dist} {metadata.version(dist)}"
+                break
+            except metadata.PackageNotFoundError:
+                continue
+    except Exception as e:  # noqa: BLE001 — diagnosis must never raise
+        info["version_error"] = repr(e)
+    log_dir = os.environ.get("TPU_LOG_DIR", "/tmp/tpu_logs")
+    try:
+        files = [os.path.join(log_dir, f) for f in os.listdir(log_dir)]
+        files = [f for f in files if os.path.isfile(f)]
+        if files:
+            newest = max(files, key=os.path.getmtime)
+            info["log_file"] = newest
+            info["log_tail"] = tail_file(newest)
+    except OSError:
+        pass
+    return info
+
+
+def device_progress() -> dict:
+    """How far backend bring-up got in THIS process, read from jax's
+    backend registry WITHOUT calling ``jax.devices()`` — that call is
+    the one that hangs on a wedged relay (the utils/debug.py
+    hang-guard). ``backends`` lists platforms that fully initialized;
+    an empty list during an init-hang means the wedge is inside the
+    first bring-up."""
+    out: dict = {"jax_imported": False, "backends": [], "error": None}
+    import sys
+    if "jax" not in sys.modules:
+        return out  # never pay for (or hang on) a jax import here
+    out["jax_imported"] = True
+    try:
+        from jax._src import xla_bridge as _xb
+        backends = getattr(_xb, "_backends", None)
+        if backends:
+            out["backends"] = sorted(backends.keys())
+    except Exception as e:  # noqa: BLE001
+        out["error"] = repr(e)
+    return out
+
+
+def capture(path: Optional[str] = None, *,
+            status: Optional[str] = None,
+            child_tail: Optional[str] = None,
+            extra: Optional[dict] = None,
+            last_spans: int = _LAST_SPANS) -> dict:
+    """Assemble the black box; write it to ``path`` (blackbox.json)
+    when given. Every section is best-effort — a postmortem that
+    raises is worse than a partial one."""
+    from consul_tpu.obs import trace as trace_mod
+
+    box: dict = {
+        "schema_version": SCHEMA_VERSION,
+        "status": status,
+        "env": capture_env(),
+        "libtpu": libtpu_info(),
+        "devices": device_progress(),
+        "child": {"tail": child_tail},
+        "spans": trace_mod.get_tracer().last_spans(last_spans),
+    }
+    if extra:
+        box.update(extra)
+    if path:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(box, f, indent=2, default=str)
+    return box

@@ -1,0 +1,203 @@
+"""The on-device node lens: replay one node's life out of the batch.
+
+Counters answer "how many false suspicions happened"; they cannot
+answer "why was node X falsely suspected at tick 4017" — the batched
+representation has no per-node narrative. The lens is that narrative
+for S statically sampled node ids: every tick inside the jitted scan,
+one ``[S, F]`` row of per-node observables is gathered at *static*
+indices (constant-index gathers — zero TH109 scatters) and rides the
+scan's stacked output exactly like the TickTrace, so a chunk costs one
+extra ``[C, S, F]`` device buffer and ONE explicit batched
+``jax.device_get`` at flush time (GossipCounters' transfer discipline).
+
+Toggling follows the ``set_sentinel`` DCE contract: lens off is the
+pre-lens program byte-for-byte (the compile-ledger pins zero extra
+executables), lens on compiles exactly one more program per shape.
+
+Fields (wire order of the F axis; all recorded as f32 — every value
+fits in f32's 24-bit integer range by construction):
+
+  ======================  =============================================
+  field                   meaning (source leaf)
+  ======================  =============================================
+  status                  ground truth: 0 dead / 1 alive / 2 leaving /
+                          3 left  (alive_truth, leaving, left)
+  incarnation             the node's own incarnation (own_inc)
+  susp_age                ticks since the OLDEST active suspicion this
+                          node holds; -1 when none (susp_start)
+  probe_deadline_delta    ticks until the outstanding probe window
+                          closes; -1 when no probe in flight
+                          (pending_fail_tick, pending_col)
+  lamport                 serf membership Lamport clock; 0 under bare
+                          SWIM (SerfState.clock)
+  vivaldi_error           Vivaldi confidence estimate (viv.error)
+  msgs_tx                 queued broadcast transmits remaining
+                          (tx_left row sum + own_tx)
+  ======================  =============================================
+
+Export renders each sampled node's fields as Perfetto counter tracks
+("C" events under a dedicated ``node-lens`` process) in the same
+Chrome trace-event file as the host spans; tick timestamps interpolate
+linearly across the enclosing chunk's host span, so node timelines and
+host/XLA activity line up in one view.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+# Field order is the wire order of the [.., F] axis — pinned by the
+# golden schema test. Keep the module docstring table in sync.
+FIELDS = ("status", "incarnation", "susp_age", "probe_deadline_delta",
+          "lamport", "vivaldi_error", "msgs_tx")
+
+# Perfetto process id grouping the lens counter tracks apart from the
+# host-span pid (the host tracer uses os.getpid()).
+LENS_PID = 2
+
+
+def normalize_ids(n: int, sample: Union[int, Sequence[int]]) -> tuple:
+    """Resolve a lens request to a static id tuple: an int S picks S
+    evenly spaced node ids (deterministic — same S, same ids); an
+    iterable passes through validated."""
+    if isinstance(sample, bool):
+        raise TypeError("lens sample must be an int count or id list")
+    if isinstance(sample, int):
+        if sample <= 0:
+            return ()
+        s = min(sample, n)
+        stride = n // s
+        ids = tuple(i * stride for i in range(s))
+    else:
+        ids = tuple(int(i) for i in sample)
+    for i in ids:
+        if not 0 <= i < n:
+            raise ValueError(f"lens node id {i} outside [0, {n})")
+    if len(set(ids)) != len(ids):
+        raise ValueError("lens node ids must be distinct")
+    return ids
+
+
+def snapshot(sw, clock, ids: tuple):
+    """One per-tick lens row: ``[S, F]`` f32, gathered from the dense
+    SWIM plane ``sw`` (and the serf Lamport ``clock`` when the driver
+    has one) at the static ``ids``. Runs inside the jitted scan body —
+    pure gathers and reductions, no scatters, no host syncs."""
+    import jax.numpy as jnp
+
+    idx = jnp.array(ids, dtype=jnp.int32)
+    f32 = jnp.float32
+    status = jnp.where(
+        sw.left[idx], f32(3.0),
+        jnp.where(sw.leaving[idx], f32(2.0),
+                  jnp.where(sw.alive_truth[idx], f32(1.0), f32(0.0))))
+    inc = sw.own_inc[idx].astype(f32)
+    ss = sw.susp_start[idx]                      # [S, K]
+    active = ss >= 0
+    oldest = jnp.min(jnp.where(active, ss, jnp.int32(2 ** 31 - 1)), axis=1)
+    susp_age = jnp.where(jnp.any(active, axis=1),
+                         (sw.t - oldest).astype(f32), f32(-1.0))
+    probing = sw.pending_col[idx] >= 0
+    probe_delta = jnp.where(
+        probing, (sw.pending_fail_tick[idx] - sw.t).astype(f32), f32(-1.0))
+    if clock is None:
+        lamport = jnp.zeros((len(ids),), f32)
+    else:
+        lamport = clock[idx].astype(f32)
+    viv_err = sw.viv.error[idx].astype(f32)
+    msgs = (jnp.sum(sw.tx_left[idx], axis=1) + sw.own_tx[idx]).astype(f32)
+    return jnp.stack([status, inc, susp_age, probe_delta,
+                      lamport, viv_err, msgs], axis=1)
+
+
+class LensRecorder:
+    """Host half of the lens: per-chunk ``[C, S, F]`` device buffers
+    queue here (references only — no transfer) and drain in ONE
+    explicit batched ``jax.device_get`` at :meth:`flush`, keeping the
+    chunk loop legal under ``jax.transfer_guard("disallow")``.
+
+    Each chunk records its host wall window (tracer-relative
+    microseconds) so export can interpolate a timestamp per tick and
+    the node timelines land inside the matching ``chunk`` span."""
+
+    def __init__(self, ids: tuple, tick0: int = 0):
+        self.ids = tuple(ids)
+        self.fields = FIELDS
+        self._next_tick = int(tick0)
+        self._pending: list = []   # (tick0, ticks, t0_us, t1_us, dev buf)
+        self._chunks: list = []    # same tuples with host numpy buffers
+
+    def record(self, buf, ticks: int,
+               t0_us: float = 0.0, t1_us: float = 0.0) -> None:
+        """Queue one chunk's device buffer (no transfer here)."""
+        self._pending.append(
+            (self._next_tick, int(ticks), float(t0_us), float(t1_us), buf))
+        self._next_tick += int(ticks)
+
+    def flush(self) -> None:
+        """One batched device→host transfer for every queued chunk."""
+        if not self._pending:
+            return
+        import jax
+
+        host = jax.device_get([p[4] for p in self._pending])
+        for (t0, ticks, a, b, _), h in zip(self._pending, host):
+            self._chunks.append((t0, ticks, a, b, h))
+        self._pending = []
+
+    @property
+    def ticks_recorded(self) -> int:
+        self_len = sum(p[1] for p in self._pending)
+        return self_len + sum(c[1] for c in self._chunks)
+
+    def timelines(self):
+        """``(ticks [T] i32, values [T, S, F] f32)`` — the whole
+        recording as host numpy arrays (flushes first)."""
+        import numpy as np
+
+        self.flush()
+        if not self._chunks:
+            return (np.zeros((0,), np.int32),
+                    np.zeros((0, len(self.ids), len(FIELDS)), np.float32))
+        ticks = np.concatenate([
+            np.arange(t0, t0 + n, dtype=np.int32)
+            for t0, n, _, _, _ in self._chunks])
+        vals = np.concatenate([np.asarray(h, np.float32)
+                               for _, _, _, _, h in self._chunks])
+        return ticks, vals
+
+    def to_json(self) -> dict:
+        """The bundle-able summary (debug bundle ``lens.json``)."""
+        ticks, vals = self.timelines()
+        return {
+            "ids": list(self.ids),
+            "fields": list(self.fields),
+            "ticks": [int(t) for t in ticks],
+            "values": [[[float(v) for v in node] for node in row]
+                       for row in vals],
+        }
+
+    def to_trace_events(self) -> list:
+        """Perfetto counter tracks: one "C" series per (node, field),
+        timestamps interpolated across each chunk's host wall window.
+        Returned as plain event dicts for ``Tracer.export``'s
+        ``extra_events`` — they merge into the host-span file without
+        evicting ring entries."""
+        self.flush()
+        events: list = [
+            {"name": "process_name", "ph": "M", "pid": LENS_PID,
+             "args": {"name": "node-lens"}},
+        ]
+        for t0, nticks, a, b, h in self._chunks:
+            step_us = (b - a) / max(1, nticks)
+            for j in range(nticks):
+                ts = a + step_us * j
+                for s, nid in enumerate(self.ids):
+                    for f, field in enumerate(FIELDS):
+                        events.append({
+                            "name": f"node{nid}/{field}", "cat": "lens",
+                            "ph": "C", "ts": round(ts, 3),
+                            "pid": LENS_PID,
+                            "args": {"value": float(h[j, s, f])},
+                        })
+        return events

@@ -1,0 +1,285 @@
+"""Host span tracing: the flight recorder for the hot host seams.
+
+The device side of the step already has a profiler (``jax.profiler``
+writes an XLA trace); what the repo could not see is the *host* choreography
+around it — cohort uploads, batcher pumps, watch-plane flips, checkpoint
+I/O, DCN retry rounds, bench phases, and XLA compiles. This module is a
+stdlib-only tracer for exactly those seams:
+
+- one shared :class:`Tracer` per process (the Sink idiom: module-level
+  singleton behind :func:`get_tracer`), always recording into a bounded
+  ring buffer — so the last-N spans are available to the backend-init
+  black box even when nobody asked for a trace artifact;
+- spans via context manager (:func:`span`) or decorator
+  (:func:`traced`), timed with ``time.perf_counter`` (monotonic — the
+  TH112 rule bans wall-clock duration math for exactly this job);
+- export as Chrome trace-event JSON (:meth:`Tracer.export`), the format
+  Perfetto and ``chrome://tracing`` load directly; the on-device lens
+  appends its per-node counter tracks to the same file so host spans,
+  chunk markers, and node timelines render in one view;
+- XLA compile events folded in through the same ``jax.monitoring``
+  backend-compile listener the CompileLedger counts
+  (:func:`install_jax_hooks`) — every real executable build shows up as
+  a ``cat="xla"`` span without wrapping or patching anything;
+- span-duration aggregates flow into an attached telemetry Sink as
+  ``sim.obs.span.<name>`` samples, whose p50/p99 the Prometheus
+  exposition renders (utils/telemetry.to_prometheus).
+
+``jax.profiler.StepTraceAnnotation`` alignment: the chunk loop wraps
+each compiled chunk in :func:`chunk_annotation`, which emits BOTH the
+XLA step marker (visible in the profiler's trace) and a host ``chunk``
+span (visible here) with the same step number — loading the two files
+into one Perfetto session lines the timelines up.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+# Pinned by the golden schema test (tests/test_obs.py): consumers of
+# the artifact key on these.
+SCHEMA_VERSION = 1
+
+# Ring capacity: bounded so an un-exported tracer can never grow the
+# process (the InmemSink discipline). 4096 events at ~200 B each is
+# under a megabyte.
+DEFAULT_CAPACITY = 4096
+
+# Metric-name prefix for span-duration samples (COVERAGE.md telemetry
+# table; tests/test_metric_names.py extracts the static prefix).
+SPAN_METRIC_PREFIX = "sim.obs.span"
+
+
+class Tracer:
+    """Bounded ring of Chrome trace events, monotonic-clocked.
+
+    Timestamps are microseconds since the tracer's birth on the
+    ``perf_counter`` clock — durations are exact, absolute wall time is
+    deliberately absent (spans measure, they do not timestamp)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=capacity)
+        self._t0 = time.perf_counter()
+        self._pid = os.getpid()
+        self._sink = None
+        self.dropped = 0  # events evicted by the bounded ring
+
+    # -- clock ----------------------------------------------------------
+    def now_us(self) -> float:
+        """Microseconds since tracer birth (monotonic)."""
+        return (time.perf_counter() - self._t0) * 1e6
+
+    # -- sink mirror ----------------------------------------------------
+    def attach_sink(self, sink) -> None:
+        """Mirror span durations into a telemetry Sink as
+        ``sim.obs.span.<name>`` samples (p50/p99 in to_prometheus).
+        Last attach wins — one process, one sink, like the Sink itself.
+        """
+        self._sink = sink
+
+    # -- recording ------------------------------------------------------
+    def _append(self, ev: dict) -> None:
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
+            self._events.append(ev)
+
+    def complete(self, name: str, start_us: float, dur_us: float,
+                 cat: str = "host", args: Optional[dict] = None,
+                 tid: Optional[int] = None) -> None:
+        """Record one complete ("X") span with explicit timing — the
+        raw entry point the jax compile listener uses (it only learns
+        the duration after the fact)."""
+        ev = {"name": name, "cat": cat, "ph": "X",
+              "ts": round(start_us, 3), "dur": round(dur_us, 3),
+              "pid": self._pid,
+              "tid": tid if tid is not None else threading.get_ident()}
+        if args:
+            ev["args"] = dict(args)
+        self._append(ev)
+        sink = self._sink
+        if sink is not None:
+            sink.add_sample(f"{SPAN_METRIC_PREFIX}.{name}", dur_us / 1e3)
+
+    def instant(self, name: str, cat: str = "host",
+                args: Optional[dict] = None) -> None:
+        """Record an instant ("i") event — a point marker, no duration
+        (and no sink sample)."""
+        ev = {"name": name, "cat": cat, "ph": "i", "s": "t",
+              "ts": round(self.now_us(), 3), "pid": self._pid,
+              "tid": threading.get_ident()}
+        if args:
+            ev["args"] = dict(args)
+        self._append(ev)
+
+    def counter(self, name: str, value: float, ts_us: float,
+                series: str = "value", pid: Optional[int] = None) -> None:
+        """Record a counter ("C") sample — a point on a counter track.
+        The lens renders each sampled node's fields as these."""
+        self._append({"name": name, "cat": "lens", "ph": "C",
+                      "ts": round(ts_us, 3),
+                      "pid": pid if pid is not None else self._pid,
+                      "args": {series: float(value)}})
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "host",
+             args: Optional[dict] = None):
+        """Time a block as one complete span."""
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            t1 = time.perf_counter()
+            self.complete(name, (t0 - self._t0) * 1e6, (t1 - t0) * 1e6,
+                          cat=cat, args=args)
+
+    def traced(self, name: Optional[str] = None, cat: str = "host"
+               ) -> Callable:
+        """Decorator form of :meth:`span`."""
+        def deco(fn):
+            label = name or fn.__qualname__
+
+            @functools.wraps(fn)
+            def wrapper(*a, **kw):
+                with self.span(label, cat=cat):
+                    return fn(*a, **kw)
+            return wrapper
+        return deco
+
+    # -- reads ----------------------------------------------------------
+    def events(self) -> list:
+        with self._lock:
+            return list(self._events)
+
+    def last_spans(self, n: int = 64) -> list:
+        """The newest ``n`` events — the black box's flight-recorder
+        tail."""
+        with self._lock:
+            evs = list(self._events)
+        return evs[-n:]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+    # -- export ---------------------------------------------------------
+    def to_json(self, extra_events: Optional[list] = None) -> dict:
+        """The Chrome trace-event JSON object (the golden schema the
+        tests pin): ``traceEvents`` plus provenance in ``otherData``."""
+        evs = self.events()
+        if extra_events:
+            evs = evs + list(extra_events)
+        return {
+            "traceEvents": evs,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "schema_version": SCHEMA_VERSION,
+                "producer": "consul-tpu obs.trace",
+                "clock": "perf_counter_us_since_tracer_birth",
+                "dropped_events": self.dropped,
+            },
+        }
+
+    def export(self, path: str,
+               extra_events: Optional[list] = None) -> str:
+        """Write the Perfetto-loadable JSON artifact; returns ``path``.
+        ``extra_events`` (e.g. the lens's counter tracks) merge into the
+        same file."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_json(extra_events), f)
+        return path
+
+
+# -- the shared process tracer (the Sink idiom) -------------------------
+_TRACER: Optional[Tracer] = None
+_TRACER_LOCK = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    """The one process-wide tracer. Always recording (bounded ring), so
+    the black box has a span tail even when nobody exports."""
+    global _TRACER
+    with _TRACER_LOCK:
+        if _TRACER is None:
+            _TRACER = Tracer()
+        return _TRACER
+
+
+@contextlib.contextmanager
+def span(name: str, cat: str = "host", args: Optional[dict] = None):
+    """Module-level sugar: a span on the shared tracer."""
+    with get_tracer().span(name, cat=cat, args=args):
+        yield
+
+
+def traced(name: Optional[str] = None, cat: str = "host") -> Callable:
+    """Module-level decorator sugar on the shared tracer (bound at call
+    time, so tests that reset the tracer see their spans)."""
+    def deco(fn):
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with get_tracer().span(label, cat=cat):
+                return fn(*a, **kw)
+        return wrapper
+    return deco
+
+
+# -- XLA compile events (the CompileLedger's hook) ----------------------
+_JAX_HOOKED = False
+
+
+def install_jax_hooks() -> None:
+    """Register a ``jax.monitoring`` listener for the backend-compile
+    duration event (analysis/guards.COMPILE_EVENT — the same event the
+    CompileLedger counts), recording every real executable build as a
+    ``cat="xla"`` span. Idempotent; needs jax, so it is called from the
+    drivers, never at import."""
+    global _JAX_HOOKED
+    with _TRACER_LOCK:
+        if _JAX_HOOKED:
+            return
+        import jax
+
+        from consul_tpu.analysis.guards import COMPILE_EVENT
+
+        def _on_event(event: str, duration: float, **kw):
+            if event != COMPILE_EVENT:
+                return
+            tr = get_tracer()
+            # The listener fires at compile END with the duration; back
+            # the start out so the span lands where the compile ran.
+            end_us = tr.now_us()
+            tr.complete("xla.backend_compile",
+                        max(0.0, end_us - duration * 1e6),
+                        duration * 1e6, cat="xla")
+
+        jax.monitoring.register_event_duration_secs_listener(_on_event)
+        _JAX_HOOKED = True
+
+
+@contextlib.contextmanager
+def chunk_annotation(step_num: int, ticks: int):
+    """Bracket one compiled chunk: emits the XLA
+    ``StepTraceAnnotation`` (so the device profiler's trace carries the
+    chunk marker) AND a host ``chunk`` span with the same step number —
+    the alignment key between the two timelines."""
+    import jax
+
+    with jax.profiler.StepTraceAnnotation("sim_chunk", step_num=step_num):
+        with span("chunk", cat="chunk",
+                  args={"step": int(step_num), "ticks": int(ticks)}):
+            yield

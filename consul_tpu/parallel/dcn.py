@@ -56,6 +56,7 @@ import jax
 import jax.numpy as jnp
 
 from consul_tpu.models.federation import Federation, FederationConfig
+from consul_tpu.obs import trace as obs_trace
 
 
 @dataclasses.dataclass(frozen=True)
@@ -257,6 +258,8 @@ class DcnFederation:
         # numpy-side merge, one push per island.
         import numpy as np
 
+        tr = obs_trace.get_tracer()
+        t0_us = tr.now_us()
         wans = [jax.device_get(isl.state.wan) for isl in self.islands]
         owner = np.asarray(self._owner)
 
@@ -313,6 +316,10 @@ class DcnFederation:
                 )
             isl.state = isl.state._replace(wan=wan)
         self._round += 1
+        # Explicit timing so the round number rides along as an arg
+        # (retry/backoff rounds show as consecutive dcn.sync spans).
+        tr.complete("dcn.sync", t0_us, tr.now_us() - t0_us, cat="dcn",
+                    args={"round": self._round, "ticks": int(ticks)})
 
     def run(self, lan_ticks: int, sync_every: int = 16, chunk: int = 16):
         """Advance all islands ``lan_ticks`` LAN ticks, reconciling the

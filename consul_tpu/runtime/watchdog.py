@@ -55,10 +55,18 @@ class InitWatchdog:
     init_window_s: float = 300.0
     poll_s: float = 10.0
     heartbeat_s: float = 0.0  # 0 disables mid-run stall detection
+    # Where the backend-init black box lands (obs/blackbox.py): when
+    # set, an INIT_HANG kill is followed by a best-effort capture of
+    # env/libtpu/device-progress/child-tail/host-spans into
+    # ``<blackbox_dir>/blackbox.json``; the path is published on
+    # ``self.blackbox_path`` for the caller to link into provenance.
+    blackbox_dir: Optional[str] = None
 
     def watch(self, proc: subprocess.Popen, ready: Callable[[], bool],
               deadline: float,
-              progress: Optional[Callable[[], Any]] = None) -> str:
+              progress: Optional[Callable[[], Any]] = None,
+              child_tail: Optional[Callable[[], Optional[str]]] = None
+              ) -> str:
         """Block until the child exits or is killed; returns OK /
         INIT_HANG / MID_RUN_HANG / TIMEOUT (rc mapping is the caller's
         business — only the caller knows which exit codes are
@@ -72,7 +80,12 @@ class InitWatchdog:
         value frozen for longer than ``heartbeat_s`` classifies it as
         a MID_RUN_HANG: the backend came up and then wedged, which is
         a different diagnosis (and failover decision) than never
-        coming up at all."""
+        coming up at all.
+
+        ``child_tail`` (optional) returns the tail of the child's last
+        output for the black box — only consulted after an INIT_HANG
+        kill, when the child can no longer produce more."""
+        self.blackbox_path: Optional[str] = None
         t0 = time.monotonic()
         seen_ready = False
         last_progress = progress() if progress is not None else None
@@ -94,6 +107,7 @@ class InitWatchdog:
                     last_beat = now  # the stall clock starts at readiness
                 if now - t0 > self.init_window_s and not seen_ready:
                     self._kill(proc)
+                    self._capture_blackbox(child_tail)
                     return INIT_HANG
                 if progress is not None and self.heartbeat_s > 0 \
                         and seen_ready:
@@ -106,6 +120,25 @@ class InitWatchdog:
         except subprocess.TimeoutExpired:
             self._kill(proc)
             return TIMEOUT
+
+    def _capture_blackbox(self, child_tail):
+        """Best-effort postmortem (obs/blackbox.py) after an init-hang
+        kill. A failed capture must not mask the INIT_HANG diagnosis —
+        the classification is the primary product."""
+        if not self.blackbox_dir:
+            return
+        import os
+
+        from consul_tpu.obs import blackbox
+        try:
+            tail = child_tail() if child_tail is not None else None
+            self.blackbox_path = os.path.join(
+                self.blackbox_dir, "blackbox.json")
+            blackbox.capture(self.blackbox_path, status=INIT_HANG,
+                             child_tail=tail)
+        except Exception:  # noqa: BLE001
+            log.warning("blackbox capture failed", exc_info=True)
+            self.blackbox_path = None
 
     @staticmethod
     def _kill(proc: subprocess.Popen):
@@ -214,7 +247,13 @@ def with_failover(attempt: Callable[[str], dict],
          "degraded_from": first platform given up on (None if primary),
          "retries":       hang-triggered re-attempts,
          "hang_wall_s":   wall seconds burned inside hangs,
-         "attempts":      [{"platform", "status", "wall_s"}, ...]}
+         "attempts":      [{"platform", "status", "wall_s",
+                            "blackbox"}, ...]}
+
+    ``blackbox`` is the attempt's backend-init black box artifact path
+    (obs/blackbox.py — ``attempt`` puts it under a ``"blackbox"`` key
+    when its watchdog captured one), so the provenance record points
+    straight at the postmortem evidence for every hung attempt.
 
     Only INIT_HANG retries/fails over — a child that ran and crashed
     (rc=N) or timed out while *working* is a real answer, not a wedged
@@ -231,6 +270,7 @@ def with_failover(attempt: Callable[[str], dict],
                 "platform": plat,
                 "status": result.get("status"),
                 "wall_s": result.get("wall_s"),
+                "blackbox": result.get("blackbox"),
             })
             if result.get("status") != INIT_HANG:
                 prov["platform"] = plat

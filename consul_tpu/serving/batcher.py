@@ -26,6 +26,7 @@ from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
 
+from consul_tpu.obs import trace as obs_trace
 from consul_tpu.ops import serving as kernels
 
 
@@ -184,7 +185,10 @@ class QueryBatcher:
             del self._pending[:len(batch)]
         if not batch:
             return 0
-        results = self._run_batch([(w.mode, w.src, w.arg) for w in batch])
+        with obs_trace.span("serving.query_pump", cat="serving",
+                            args={"n": len(batch)}):
+            results = self._run_batch(
+                [(w.mode, w.src, w.arg) for w in batch])
         for w, r in zip(batch, results):
             w.result = r
             w.done.set()

@@ -32,6 +32,7 @@ import threading
 from collections import deque
 from typing import NamedTuple, Optional
 
+from consul_tpu.obs import trace as obs_trace
 from consul_tpu.ops import deltas
 from consul_tpu.serving.batcher import ServingClosedError
 
@@ -162,6 +163,8 @@ class WatchPlane:
             _, ws = cur_pair
             self._advance(int(jax.device_get(ws.apply_index)))
             return
+        tr = obs_trace.get_tracer()
+        t0_us = tr.now_us()
         prev_snap, prev_ws = prev_pair
         cur_snap, cur_ws = cur_pair
         frame = deltas.diff_kernel_for(self.k)(
@@ -235,6 +238,11 @@ class WatchPlane:
             if shed:
                 sink.incr_counter("sim.serving.shed", shed)
         self._advance(index)
+        # Span recorded with explicit timing so the fan-out stats ride
+        # along as args (they only exist once delivery finished).
+        tr.complete("watch.on_flip", t0_us, tr.now_us() - t0_us,
+                    cat="serving",
+                    args={"delivered": delivered, "shed": shed})
 
     def _advance(self, index: int) -> None:
         with self._index_cond:

@@ -35,6 +35,7 @@ from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
 
+from consul_tpu.obs import trace as obs_trace
 from consul_tpu.ops import deltas
 from consul_tpu.serving.batcher import (ServingClosedError,
                                         ServingOverloadError)
@@ -247,7 +248,10 @@ class WriteBatcher:
             del self._pending[:len(batch)]
         if not batch:
             return 0
-        results = self._run_batch([(w.op, w.target, w.arg) for w in batch])
+        with obs_trace.span("serving.write_pump", cat="serving",
+                            args={"n": len(batch)}):
+            results = self._run_batch(
+                [(w.op, w.target, w.arg) for w in batch])
         for w, r in zip(batch, results):
             w.result = r
             w.done.set()

@@ -38,6 +38,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from consul_tpu.obs import trace as obs_trace
+
 MAGIC = b"CTPU"
 FORMAT_VERSION = 2
 _MAX_MANIFEST = 64 << 20
@@ -87,6 +89,7 @@ def _partition_specs(state: Any) -> list:
     return specs
 
 
+@obs_trace.traced("ckpt.save", cat="io")
 def save(path: str, state: Any, meta: Any = None) -> str:
     """Write ``state`` (any pytree of arrays) to ``path``. Returns the
     payload's hex SHA-256 digest. Crash-safe: fsync before the atomic
@@ -217,6 +220,7 @@ def restore_widened(path: str, dense_template: Any, widen, n: int, *,
     }
 
 
+@obs_trace.traced("ckpt.restore", cat="io")
 def restore(path: str, template: Any, *, verify: bool = True) -> Any:
     """Load a checkpoint into the structure of ``template`` (an
     ``init()``-produced pytree). Structure/shape/dtype mismatches and

@@ -111,6 +111,13 @@ def capture_sim(sim, profile_ticks: int = 0,
         "tick": int(swim_st.t),
     }
     out["metrics.json"] = sim.sink.snapshot()
+    # The flight recorder's view of this process: the host-span ring
+    # (Chrome trace-event JSON, obs/trace.py) and — when the node lens
+    # is armed — the recorded per-node timelines.
+    from consul_tpu.obs import trace as obs_trace
+    out["spans.json"] = obs_trace.get_tracer().to_json()
+    if getattr(sim, "lens", None) is not None:
+        out["lens.json"] = sim.lens.to_json()
     if profile_ticks > 0 and trace_dir:
         with jax.profiler.trace(trace_dir):
             sim.run(profile_ticks, with_metrics=False)

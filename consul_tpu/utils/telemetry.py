@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Optional
 
 # jax/numpy are imported lazily inside emit_sim_metrics: the Sink class
@@ -43,26 +44,42 @@ from typing import Optional
 # JAX import/backend init at startup.
 
 
+# Bounded per-aggregate sample window for the percentile views. 512
+# recent values bound memory like the go-metrics interval ring does;
+# p50/p99 over the window is what the Prometheus summary lines expose.
+_PCTL_WINDOW = 512
+
+
 class _Aggregate:
-    __slots__ = ("count", "total", "min", "max")
+    __slots__ = ("count", "total", "min", "max", "recent")
 
     def __init__(self):
         self.count = 0
         self.total = 0.0
         self.min = float("inf")
         self.max = float("-inf")
+        self.recent = deque(maxlen=_PCTL_WINDOW)
 
     def add(self, v: float):
         self.count += 1
         self.total += v
         self.min = min(self.min, v)
         self.max = max(self.max, v)
+        self.recent.append(v)
+
+    def percentile(self, q: float) -> float:
+        """Nearest-rank percentile over the bounded recent window."""
+        if not self.recent:
+            return 0.0
+        vals = sorted(self.recent)
+        return vals[min(len(vals) - 1, int(len(vals) * q))]
 
     def view(self, name: str) -> dict:
         mean = self.total / self.count if self.count else 0.0
         return {"Name": name, "Count": self.count, "Sum": self.total,
                 "Min": self.min if self.count else 0.0,
-                "Max": self.max if self.count else 0.0, "Mean": mean}
+                "Max": self.max if self.count else 0.0, "Mean": mean,
+                "P50": self.percentile(0.5), "P99": self.percentile(0.99)}
 
 
 class Sink:
@@ -152,9 +169,14 @@ def to_prometheus(snapshot: dict) -> str:
         if n in seen:
             continue
         seen.add(n)
-        # Samples render as a summary (count + sum), the promhttp
+        # Samples render as a summary — quantile lines (p50/p99 over
+        # the bounded recent window) plus count + sum, the promhttp
         # convention for go-metrics samples.
         lines.append(f"# TYPE {n} summary")
+        if "P50" in s:
+            lines.append(f'{n}{{quantile="0.5"}} {float(s["P50"])}')
+        if "P99" in s:
+            lines.append(f'{n}{{quantile="0.99"}} {float(s["P99"])}')
         lines.append(f"{n}_count {float(s.get('Count', 0))}")
         lines.append(f"{n}_sum {float(s.get('Sum', 0.0))}")
     return "\n".join(lines) + "\n"

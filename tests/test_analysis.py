@@ -671,6 +671,100 @@ class TestTH111:
 
 
 # ----------------------------------------------------------------------
+# TH112: wall-clock durations (time.time() subtraction)
+# ----------------------------------------------------------------------
+
+class TestTH112:
+    def test_direct_subtraction_fires(self):
+        rep = _lint({HOST: """
+            import time
+
+            def latency(t0):
+                return time.time() - t0
+        """})
+        assert _rules(rep) == ["TH112"]
+        assert rep.findings[0].symbol == "latency"
+
+    def test_stamp_name_subtraction_fires(self):
+        # t0 = time.time() ... t1 - t0: both sides are names, but the
+        # assignments mark them as wall stamps.
+        rep = _lint({HOST: """
+            import time
+
+            def span():
+                t0 = time.time()
+                work()
+                t1 = time.time()
+                return t1 - t0
+        """})
+        assert _rules(rep) == ["TH112"]
+
+    def test_aliased_import_fires(self):
+        rep = _lint({HOST: """
+            from time import time
+
+            def age(start):
+                return time() - start
+        """})
+        assert _rules(rep) == ["TH112"]
+
+    def test_monotonic_and_perf_counter_are_silent(self):
+        rep = _lint({HOST: """
+            import time
+
+            def span():
+                t0 = time.monotonic()
+                work()
+                return time.monotonic() - t0, time.perf_counter() - t0
+        """})
+        assert rep.clean
+
+    def test_timestamp_arithmetic_without_subtraction_is_silent(self):
+        # Deadlines, stamps, and comparisons are legitimate wall-clock
+        # uses — only the duration (subtraction) shape fires.
+        rep = _lint({HOST: """
+            import time
+
+            def stamp(meta, exp):
+                meta["saved_at"] = time.time()
+                deadline = time.time() + 30.0
+                return time.time() >= exp, deadline
+        """})
+        assert rep.clean
+
+    def test_reassigned_name_is_silent(self):
+        # A name that once held a wall stamp but was reassigned to
+        # something else is no longer a wall stamp.
+        rep = _lint({HOST: """
+            import time
+
+            def f(x):
+                t0 = time.time()
+                log(t0)
+                t0 = x.ticks
+                return x.total - t0
+        """})
+        assert rep.clean
+
+    def test_allowlist_suppresses_by_symbol(self):
+        al = parse_allowlist("""
+            [[allow]]
+            rule = "TH112"
+            path = "consul_tpu/agent/fake.py"
+            symbol = "lock_age"
+            reason = "file mtime is wall-clock; the subtraction must be too"
+        """)
+        rep = _lint({HOST: """
+            import os
+            import time
+
+            def lock_age(path):
+                return time.time() - os.path.getmtime(path)
+        """}, al)
+        assert rep.clean and len(rep.suppressed) == 1
+
+
+# ----------------------------------------------------------------------
 # callgraph: reachability across modules and hand-off shapes
 # ----------------------------------------------------------------------
 
@@ -879,6 +973,6 @@ class TestPackageGate:
     def test_every_rule_id_is_documented(self):
         assert set(analysis.RULES) == {
             "TH101", "TH102", "TH103", "TH104", "TH105", "TH106",
-            "TH107", "TH108", "TH109", "TH110", "TH111"}
+            "TH107", "TH108", "TH109", "TH110", "TH111", "TH112"}
         for rid, rationale in analysis.RULES.items():
             assert rationale.strip(), rid

@@ -1,0 +1,378 @@
+"""Flight-recorder observability plane (consul_tpu/obs): the golden
+Chrome trace-event schema the `consul-tpu trace` artifact is written
+in, the on-device node lens (sampling math, recorder mechanics, and
+the set_sentinel-style compile/DCE discipline: off is the memoized
+pre-lens program, on costs exactly one build, the chunk loop stays
+legal under transfer_guard), the backend-init black box (capture
+sections + the forced init-hang end-to-end through InitWatchdog), and
+the debug-bundle integration (the jax.devices() hang-guard and the
+tarball round-trip)."""
+
+import json
+import os
+import subprocess
+import sys
+import tarfile
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from consul_tpu.analysis.guards import no_transfers
+from consul_tpu.config import SimConfig
+from consul_tpu.models import cluster as cluster_mod
+from consul_tpu.obs import blackbox
+from consul_tpu.obs import lens as lens_mod
+from consul_tpu.obs import trace as trace_mod
+from consul_tpu.runtime import watchdog as wd
+from consul_tpu.utils import debug
+
+
+def _sim(n=96, seed=11, serf=False):
+    cls = cluster_mod.SerfSimulation if serf else cluster_mod.Simulation
+    return cls(SimConfig(n=n, view_degree=16), seed=seed)
+
+
+@pytest.fixture
+def tracer():
+    """The shared process tracer, cleared on both sides so span counts
+    here are exact and other tests never see our events."""
+    tr = trace_mod.get_tracer()
+    tr.clear()
+    yield tr
+    tr.clear()
+
+
+# ---------------------------------------------------------------------------
+# The golden trace-event schema. Perfetto/chrome://tracing consume the
+# artifact, so the shape is wire format — these pins are the contract.
+# ---------------------------------------------------------------------------
+class TestTraceGolden:
+    def test_top_level_schema(self, tracer):
+        with tracer.span("unit.work", args={"k": 1}):
+            pass
+        doc = tracer.to_json()
+        assert set(doc) == {"traceEvents", "displayTimeUnit", "otherData"}
+        assert doc["displayTimeUnit"] == "ms"
+        assert doc["otherData"] == {
+            "schema_version": 1,
+            "producer": "consul-tpu obs.trace",
+            "clock": "perf_counter_us_since_tracer_birth",
+            "dropped_events": 0,
+        }
+
+    def test_complete_span_event_shape(self, tracer):
+        with tracer.span("unit.work", cat="host", args={"k": 1}):
+            time.sleep(0.001)
+        (ev,) = tracer.events()
+        assert set(ev) == {"name", "cat", "ph", "ts", "dur",
+                           "pid", "tid", "args"}
+        assert ev["ph"] == "X"
+        assert ev["name"] == "unit.work"
+        assert ev["cat"] == "host"
+        assert ev["pid"] == os.getpid()
+        assert ev["ts"] >= 0.0
+        assert ev["dur"] >= 1000.0  # slept 1 ms; clock is microseconds
+        assert ev["args"] == {"k": 1}
+
+    def test_instant_and_counter_event_shapes(self, tracer):
+        tracer.instant("mark")
+        tracer.counter("node0/status", 2.0, ts_us=10.0)
+        inst, ctr = tracer.events()
+        assert inst["ph"] == "i" and inst["s"] == "t"
+        assert ctr == {"name": "node0/status", "cat": "lens", "ph": "C",
+                       "ts": 10.0, "pid": os.getpid(),
+                       "args": {"value": 2.0}}
+
+    def test_bounded_ring_counts_drops(self):
+        tr = trace_mod.Tracer(capacity=4)
+        for i in range(6):
+            tr.instant(f"e{i}")
+        assert tr.dropped == 2
+        assert [e["name"] for e in tr.events()] == ["e2", "e3", "e4", "e5"]
+        assert tr.to_json()["otherData"]["dropped_events"] == 2
+        assert [e["name"] for e in tr.last_spans(2)] == ["e4", "e5"]
+
+    def test_export_round_trips_with_extra_events(self, tracer, tmp_path):
+        tracer.instant("host.mark")
+        extra = [{"name": "node0/status", "ph": "C", "ts": 1.0,
+                  "pid": lens_mod.LENS_PID, "args": {"value": 1.0}}]
+        path = tracer.export(str(tmp_path / "nested" / "trace.json"),
+                             extra_events=extra)
+        with open(path) as f:
+            doc = json.load(f)
+        names = [e["name"] for e in doc["traceEvents"]]
+        assert names == ["host.mark", "node0/status"]
+        # extra_events merge into the file, never into the ring
+        assert len(tracer.events()) == 1
+
+    def test_traced_decorator_uses_qualname(self, tracer):
+        @trace_mod.traced()
+        def slow_bit():
+            return 7
+
+        assert slow_bit() == 7
+        (ev,) = tracer.events()
+        assert ev["name"].endswith("slow_bit")
+
+    def test_sink_mirror_emits_span_metric(self, tracer):
+        samples = []
+
+        class FakeSink:
+            def add_sample(self, name, value):
+                samples.append((name, value))
+
+        tracer.attach_sink(FakeSink())
+        try:
+            tracer.complete("compile", 0.0, 2500.0)
+        finally:
+            tracer.attach_sink(None)
+        assert samples == [("sim.obs.span.compile", 2.5)]  # us -> ms
+
+
+# ---------------------------------------------------------------------------
+# The node lens: id resolution, recorder mechanics, counter-track export.
+# ---------------------------------------------------------------------------
+class TestLensRecorder:
+    def test_normalize_ids_int_is_evenly_spaced(self):
+        assert lens_mod.normalize_ids(16, 4) == (0, 4, 8, 12)
+        assert lens_mod.normalize_ids(16, 0) == ()
+        # an oversized request clamps to every node
+        assert lens_mod.normalize_ids(4, 99) == (0, 1, 2, 3)
+
+    def test_normalize_ids_explicit_list_validated(self):
+        assert lens_mod.normalize_ids(8, [7, 0, 3]) == (7, 0, 3)
+        with pytest.raises(TypeError):
+            lens_mod.normalize_ids(8, True)
+        with pytest.raises(ValueError):
+            lens_mod.normalize_ids(8, [1, 1])
+        with pytest.raises(ValueError):
+            lens_mod.normalize_ids(8, [8])
+
+    def test_record_flush_timelines_shapes(self):
+        rec = lens_mod.LensRecorder(ids=(1, 3), tick0=5)
+        rec.record(np.zeros((4, 2, len(lens_mod.FIELDS)), np.float32),
+                   ticks=4, t0_us=0.0, t1_us=40.0)
+        rec.record(np.ones((4, 2, len(lens_mod.FIELDS)), np.float32),
+                   ticks=4, t0_us=40.0, t1_us=80.0)
+        assert rec.ticks_recorded == 8
+        ticks, vals = rec.timelines()
+        assert ticks.tolist() == list(range(5, 13))
+        assert vals.shape == (8, 2, len(lens_mod.FIELDS))
+        assert vals.dtype == np.float32
+        assert float(vals[0].sum()) == 0.0 and float(vals[-1, 0, 0]) == 1.0
+
+    def test_empty_recorder_timelines(self):
+        ticks, vals = lens_mod.LensRecorder(ids=(0, 2)).timelines()
+        assert ticks.shape == (0,)
+        assert vals.shape == (0, 2, len(lens_mod.FIELDS))
+
+    def test_to_trace_events_counter_tracks(self):
+        rec = lens_mod.LensRecorder(ids=(0, 4))
+        rec.record(np.zeros((2, 2, len(lens_mod.FIELDS)), np.float32),
+                   ticks=2, t0_us=100.0, t1_us=200.0)
+        evs = rec.to_trace_events()
+        meta, rest = evs[0], evs[1:]
+        assert meta == {"name": "process_name", "ph": "M",
+                        "pid": lens_mod.LENS_PID,
+                        "args": {"name": "node-lens"}}
+        # one counter sample per (tick, node, field)
+        assert len(rest) == 2 * 2 * len(lens_mod.FIELDS)
+        assert all(e["ph"] == "C" and e["pid"] == lens_mod.LENS_PID
+                   for e in rest)
+        # tick timestamps interpolate inside the chunk's host window
+        assert {e["ts"] for e in rest} == {100.0, 150.0}
+        assert {e["name"] for e in rest} == {
+            f"node{n}/{f}" for n in (0, 4) for f in lens_mod.FIELDS}
+
+
+# ---------------------------------------------------------------------------
+# Lens discipline on a live Simulation: the set_sentinel contract.
+# Off must be the memoized pre-lens executable (0 compiles — the
+# byte-identical proof), arming costs exactly one build, and the armed
+# chunk loop stays clean under the transfer guard (the recorder queues
+# device buffers; the ONE batched device_get at flush is explicit).
+# ---------------------------------------------------------------------------
+class TestLensDiscipline:
+    def test_compile_ledger_pins_and_byte_identical_off(self, compile_ledger):
+        sim = _sim()
+        sim.run(16, chunk=8)  # warm the pre-lens program
+        with compile_ledger.expect(0, "steady state, lens off"):
+            sim.run(8, chunk=8)
+        assert sim.set_lens(4) == lens_mod.normalize_ids(sim.cfg.n, 4)
+        with compile_ledger.expect(1, "arming the lens rebuilds once"):
+            sim.run(8, chunk=8)
+        with compile_ledger.expect(0, "steady state, lens on"):
+            sim.run(8, chunk=8)
+        assert sim.lens.ticks_recorded == 16
+        sim.set_lens(0)
+        assert sim.lens is None
+        with compile_ledger.expect(
+                0, "lens off returns to the memoized pre-lens program"):
+            sim.run(8, chunk=8)
+
+    def test_traced_lens_loop_clean_under_transfer_guard(
+            self, compile_ledger, tracer):
+        sim = _sim(seed=7)
+        sim.set_lens(4)
+        # Compile the armed program outside the guard. The guarded loop
+        # runs the throughput path (with_metrics=False) like the
+        # run_resilient transfer pin: the per-chunk metrics fold is a
+        # host-boundary step that legitimately builds device constants.
+        sim.run(8, chunk=8, with_metrics=False)
+        with no_transfers(), compile_ledger.expect(0, "guarded lens loop"):
+            with tracer.span("test.loop"):
+                sim.run(16, chunk=8, with_metrics=False)
+            # flush is ONE explicit batched device_get — legal under
+            # the guard by design (guards.no_transfers docstring)
+            ticks, vals = sim.lens.timelines()
+        assert ticks.shape == (24,)
+        assert vals.shape == (24, 4, len(lens_mod.FIELDS))
+        # everyone alive in a calm cluster: status == 1.0 across ticks
+        assert np.all(vals[:, :, lens_mod.FIELDS.index("status")] == 1.0)
+        names = [e["name"] for e in tracer.events()]
+        assert "test.loop" in names
+        assert any(n == "chunk" for n in names)  # per-chunk host spans
+
+    def test_lens_rejects_mesh(self):
+        sim = _sim()
+        sim.mesh = object()  # any armed mesh forbids the lens
+        with pytest.raises(ValueError, match="single-device"):
+            sim.set_lens(4)
+
+
+# ---------------------------------------------------------------------------
+# The backend-init black box.
+# ---------------------------------------------------------------------------
+class TestBlackbox:
+    def test_capture_env_filters_backend_knobs(self, monkeypatch):
+        monkeypatch.setenv("TPU_FAKE_KNOB", "relay")
+        monkeypatch.setenv("UNRELATED_SECRET", "nope")
+        env = blackbox.capture_env()
+        assert env["TPU_FAKE_KNOB"] == "relay"
+        assert "UNRELATED_SECRET" not in env
+
+    def test_tail_file(self, tmp_path):
+        p = tmp_path / "out.log"
+        p.write_text("\n".join(f"line{i}" for i in range(100)))
+        assert blackbox.tail_file(str(p), lines=3) == \
+            "line97\nline98\nline99"
+        assert blackbox.tail_file(str(tmp_path / "missing.log")) is None
+
+    def test_device_progress_reads_registry_without_dialing(
+            self, monkeypatch):
+        def _boom(*a, **kw):
+            raise AssertionError("device_progress must not call "
+                                 "jax.devices()")
+        monkeypatch.setattr(jax, "devices", _boom)
+        prog = blackbox.device_progress()
+        assert prog["jax_imported"] is True
+        # the conftest CPU backend initialized long ago
+        assert "cpu" in prog["backends"]
+
+    def test_capture_schema_and_artifact(self, tmp_path, tracer):
+        tracer.instant("pre-hang.mark")
+        path = str(tmp_path / "bb" / "blackbox.json")
+        box = blackbox.capture(path, status=wd.INIT_HANG,
+                               child_tail="phase setup\nwedged here",
+                               extra={"platform": "tpu"})
+        assert set(box) >= {"schema_version", "status", "env", "libtpu",
+                            "devices", "child", "spans", "platform"}
+        assert box["schema_version"] == 1
+        assert box["status"] == wd.INIT_HANG
+        assert box["child"]["tail"].endswith("wedged here")
+        assert [e["name"] for e in box["spans"]] == ["pre-hang.mark"]
+        with open(path) as f:
+            assert json.load(f)["status"] == wd.INIT_HANG
+
+    def test_forced_init_hang_writes_blackbox(self, tmp_path, tracer):
+        """End-to-end: a child that never reports ready is killed by
+        the watchdog, which drops blackbox.json with the environment,
+        the child's output tail, and the host-span flight recorder."""
+        tracer.instant("launch.child")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(60)"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            watchdog = wd.InitWatchdog(
+                init_window_s=0.2, poll_s=0.05,
+                blackbox_dir=str(tmp_path / "bb"))
+            status = watchdog.watch(
+                proc, lambda: False, time.monotonic() + 30.0,
+                child_tail=lambda: "phase setup\nlast child line")
+        finally:
+            proc.kill()
+            proc.wait()
+        assert status == wd.INIT_HANG
+        assert watchdog.blackbox_path is not None
+        with open(watchdog.blackbox_path) as f:
+            box = json.load(f)
+        assert box["status"] == wd.INIT_HANG
+        assert isinstance(box["env"], dict)
+        assert box["child"]["tail"] == "phase setup\nlast child line"
+        assert "launch.child" in [e["name"] for e in box["spans"]]
+
+    def test_failover_provenance_links_blackbox(self):
+        """with_failover lifts each attempt's artifact path into the
+        provenance record, so the bench JSON points at the evidence."""
+        calls = []
+
+        def attempt(platform):
+            calls.append(platform)
+            if platform == "tpu":
+                return {"status": wd.INIT_HANG, "wall_s": 0.3,
+                        "blackbox": "/tmp/bb/blackbox.json"}
+            return {"status": wd.OK, "wall_s": 1.0, "blackbox": None}
+
+        result, prov = wd.with_failover(attempt, ("tpu", "cpu"),
+                                        max_retries=0)
+        assert result["status"] == wd.OK
+        assert calls == ["tpu", "cpu"]
+        assert prov["degraded_from"] == "tpu"
+        assert [a.get("blackbox") for a in prov["attempts"]] == \
+            ["/tmp/bb/blackbox.json", None]
+
+
+# ---------------------------------------------------------------------------
+# Debug-bundle integration.
+# ---------------------------------------------------------------------------
+class TestDebugBundle:
+    def test_host_info_guards_uninitialized_backend(self, monkeypatch):
+        """The debug CLI must never initialize a backend: with no
+        backend in the registry, jax.devices() (the call that hangs on
+        a wedged relay) must not be dialed at all."""
+        from jax._src import xla_bridge as _xb
+
+        def _boom(*a, **kw):
+            raise AssertionError("_host_info dialed jax.devices()")
+        monkeypatch.setattr(jax, "devices", _boom)
+        monkeypatch.setattr(_xb, "_backends", {})
+        info = debug._host_info()
+        assert info["Devices"] == "not initialized (host-side capture)"
+        assert "JaxError" not in info
+
+    def test_host_info_reports_live_backend(self):
+        info = debug._host_info()
+        assert isinstance(info["Devices"], list)
+        assert len(info["Devices"]) == jax.device_count()
+
+    def test_capture_sim_and_bundle_round_trip(self, tmp_path):
+        sim = _sim(n=64, seed=3)
+        sim.set_lens(2)
+        sim.run(8, chunk=8)
+        files = debug.capture_sim(sim)
+        assert {"host.json", "config.json", "health.json",
+                "metrics.json", "spans.json", "lens.json"} <= set(files)
+        assert files["spans.json"]["otherData"]["schema_version"] == 1
+        assert files["lens.json"]["fields"] == list(lens_mod.FIELDS)
+        assert len(files["lens.json"]["ticks"]) == 8
+
+        path = debug.write_bundle(str(tmp_path / "bundle.tar.gz"), files)
+        with tarfile.open(path, "r:gz") as tar:
+            members = tar.getnames()
+            assert sorted(members) == sorted(files)
+            for name in members:
+                payload = json.load(tar.extractfile(name))
+                assert isinstance(payload, dict)

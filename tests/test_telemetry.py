@@ -40,6 +40,37 @@ class TestSink:
         [sm] = s.snapshot()["Samples"]
         assert sm["Name"] == "memberlist.gossip" and sm["Count"] == 1
 
+    def test_prometheus_sample_summary_lines(self):
+        """add_sample aggregates render as a Prometheus summary —
+        p50/p99 quantile lines plus _count and _sum (the promhttp
+        convention for go-metrics samples)."""
+        s = telemetry.Sink()
+        for v in (1.0, 2.0, 3.0, 4.0):
+            s.add_sample("sim.obs.span.chunk", v)
+        body = telemetry.to_prometheus(s.snapshot())
+        lines = body.splitlines()
+        assert "# TYPE sim_obs_span_chunk summary" in lines
+        q = {ln.split(" ")[0]: float(ln.split(" ")[1]) for ln in lines
+             if ln.startswith("sim_obs_span_chunk")}
+        # nearest-rank over the window: P50 of (1,2,3,4) is vals[2]
+        assert q['sim_obs_span_chunk{quantile="0.5"}'] == 3.0
+        assert q['sim_obs_span_chunk{quantile="0.99"}'] == 4.0
+        assert q["sim_obs_span_chunk_count"] == 4.0
+        assert q["sim_obs_span_chunk_sum"] == 10.0
+
+    def test_tracer_span_mirror_reaches_prometheus(self):
+        """The obs tracer's sink mirror lands span durations in the
+        scrape output end-to-end."""
+        from consul_tpu.obs import trace as trace_mod
+
+        s = telemetry.Sink()
+        tr = trace_mod.Tracer()
+        tr.attach_sink(s)
+        tr.complete("compile", 0.0, 1500.0)  # 1.5 ms
+        body = telemetry.to_prometheus(s.snapshot())
+        assert "# TYPE sim_obs_span_compile summary" in body
+        assert 'sim_obs_span_compile{quantile="0.5"} 1.5' in body
+
 
 class TestSimEmission:
     def test_reference_names_recorded_during_run(self):
