@@ -369,6 +369,80 @@ def child(platform: str, deadline: float):
     except Exception as e:
         _emit({"phase": "error", "where": "chaos", "error": repr(e)[:500]})
 
+    # Raft tier: batched multi-group consensus riding the same chunked
+    # scan (ops/raft_ops.py). Ladder over R-groups x P-peers shapes on
+    # one dedicated sim size: steady-state tick rate with the tier
+    # armed, elections/s under a split-vote storm window, and the
+    # commit-visibility latency of proposed writes in ticks (chunk
+    # resolution — proposals enter at a chunk boundary and commit is
+    # observed at the next boundary, so p50/p99 quantize to the probe
+    # chunk).
+    try:
+        if left() > 60:
+            from consul_tpu import chaos as chaos_mod
+
+            rn = int(os.environ.get("BENCH_RAFT_N", "1024"))
+            ladder = []
+            for spec in os.environ.get(
+                    "BENCH_RAFT_LADDER", "4x3,16x5").split(","):
+                r_s, p_s = spec.strip().lower().split("x")
+                ladder.append((int(r_s), int(p_s)))
+            rchunk = int(os.environ.get("BENCH_RAFT_CHUNK", "8"))
+            t_raft = time.monotonic()
+            entries = []
+            for rg, rp in ladder:
+                if left() < 45:
+                    break
+                t_c = time.monotonic()
+                rsim = build(rn)
+                plane = rsim.set_raft(rg, peers=rp)
+                # Form the cluster and let every group elect once; this
+                # is also where the raft-carrying chunk program warms.
+                rsim.run(4 * rchunk, chunk=rchunk, with_metrics=False)
+                raft_compile_s = time.monotonic() - t_c
+                # Steady state: tick rate with the tier armed.
+                t_run = time.monotonic()
+                steady = 16 * rchunk
+                rsim.run(steady, chunk=rchunk, with_metrics=False)
+                steady_s = time.monotonic() - t_run
+                # Election churn: a storm window suppresses every
+                # leader and splits votes; count elections over wall.
+                before = plane.counters_snapshot()["elections_started"]
+                t_storm = time.monotonic()
+                rsim.run_scenario(
+                    [chaos_mod.RaftStorm(start=2, stop=2 + 4 * rchunk)],
+                    chunk=rchunk, settle=2 * rchunk)
+                storm_s = time.monotonic() - t_storm
+                elections = (plane.counters_snapshot()["elections_started"]
+                             - before)
+                # Commit latency: propose one write per probe, step
+                # until the quorum commit point releases the ticket.
+                lat = []
+                for i in range(8):
+                    tk = plane.propose(
+                        [("kv_put", f"bench/raft/{rg}x{rp}/{i}", b"v")])
+                    ticks = 0
+                    while not tk.done.is_set() and ticks < 32 * rchunk:
+                        rsim.run(rchunk, chunk=rchunk, with_metrics=False)
+                        ticks += rchunk
+                    lat.append(ticks)
+                lat.sort()
+                entries.append({
+                    "groups": rg, "peers": rp,
+                    "ticks_per_s": round(steady / steady_s, 1),
+                    "elections": int(elections),
+                    "elections_per_s": round(elections / storm_s, 1),
+                    "commit_ticks_p50": lat[len(lat) // 2],
+                    "commit_ticks_p99": lat[-1],
+                    "compile_s": round(raft_compile_s, 2),
+                })
+                del rsim, plane
+            _emit({"phase": "raft", "n": rn, "chunk": rchunk,
+                   "entries": entries,
+                   "wall_s": round(time.monotonic() - t_raft, 2)})
+    except Exception as e:
+        _emit({"phase": "error", "where": "raft", "error": repr(e)[:500]})
+
     # Topology lab: sweep the same S-scenario fault grid against every
     # registered view-graph family at equal degree (chaos/sweep.py) —
     # the schedules stack on a vmapped scenario axis and the topology
@@ -1097,7 +1171,8 @@ def _save_tpu_session(result):
 # while not_run + reason records the skip as a deliberate outcome.
 _PHASE_KEYS = ("northstar_1m", "northstar_1m_serf", "compile_cache",
                "elasticity", "memory", "serving", "serving_mixed",
-               "scaling_strong", "scaling_weak", "topology", "trace")
+               "scaling_strong", "scaling_weak", "topology", "trace",
+               "raft")
 
 
 def _phase_or_not_run(phases, name, reason, pick=None):
@@ -1382,6 +1457,13 @@ def main():
         "topology": _phase_or_not_run(
             primary["phases"], "topology",
             "skipped: time budget exhausted or sweep errored"),
+        # Raft tier (ops/raft_ops.py): per-(groups x peers) ladder of
+        # steady tick rate with the tier armed, elections/s under a
+        # split-vote storm, and quorum-commit visibility latency of
+        # proposed writes in ticks (chunk resolution).
+        "raft": _phase_or_not_run(
+            primary["phases"], "raft",
+            "skipped: time budget exhausted or phase errored"),
         # Mesh + prewarm provenance for the headline number: how many
         # devices the child saw, and what the AOT prewarm pass
         # compiled/deserialized before the timed phases.

@@ -9,12 +9,16 @@ the schedule types — eager re-export here would close the cycle."""
 from consul_tpu.chaos.schedule import (  # noqa: F401
     MAX_LINKS,
     MAX_PARTITIONS,
+    MAX_RAFT_EVENTS,
     ChaosSchedule,
     ChurnWave,
     Degrade,
     LinkLoss,
     NodeTerms,
     Partition,
+    RaftKill,
+    RaftPartition,
+    RaftStorm,
     compile_schedule,
     down_at,
     empty,

@@ -63,6 +63,9 @@ from consul_tpu.parallel import collective as coll
 # below 2^24; 20 bits leaves headroom for the SLO status packing.
 MAX_PARTITIONS = 20
 MAX_LINKS = 20
+# Raft events share one [K]-slot lane (kind discriminator), no packing
+# constraint — the cap just bounds the per-tick mask reduction.
+MAX_RAFT_EVENTS = 20
 
 
 # ----------------------------------------------------------------------
@@ -124,6 +127,47 @@ class Degrade:
     rx_loss: float = 0.0
 
 
+@dataclasses.dataclass(frozen=True)
+class RaftKill:
+    """Freeze raft peer ``peer`` of group ``group`` over [start, stop):
+    it neither acts nor sends nor receives (ops/raft_ops.chaos_masks).
+    ``peer=-1`` targets whoever LEADS the group at each tick — the
+    leader-kill drill; ``group=-1`` hits every group. A killed leader
+    keeps its role while down, so on revive it is deposed by the next
+    higher-term AppendEntries it hears (the stale-leader probe)."""
+
+    start: int
+    stop: int
+    group: int = -1
+    peer: int = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class RaftPartition:
+    """Split a raft group's peers at ``cut`` over [start, stop): seats
+    ``p < cut`` and ``p >= cut`` cannot exchange raft messages. A
+    minority-side leader keeps emitting heartbeats into the void while
+    the majority elects around it — the classic stale-read hazard the
+    quorum commit rule exists for."""
+
+    start: int
+    stop: int
+    cut: int
+    group: int = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class RaftStorm:
+    """Total in-group message blackout over [start, stop): every timer
+    expires with no vote ever delivered, so terms climb in lockstep and
+    the election storm resolves only after the window lifts — the
+    split-vote convergence scenario for the sweep plane."""
+
+    start: int
+    stop: int
+    group: int = -1
+
+
 # ----------------------------------------------------------------------
 # The compiled device pytree.
 # ----------------------------------------------------------------------
@@ -152,6 +196,15 @@ class ChaosSchedule(NamedTuple):
     dg_tx: jax.Array       # [D] f32
     dg_rx: jax.Array       # [D] f32
     dg_mask: jax.Array     # [N, D] bool
+    # Raft lane (ops/raft_ops.chaos_masks): one [K] slot set with a
+    # kind discriminator instead of per-family [N, slots] masks — raft
+    # groups are addressed by global group id, not node id, so these
+    # replicate under shard_map and the group-id comparison localizes.
+    rk_kind: jax.Array     # [K] i32 (RK_KILL/RK_PARTITION/RK_STORM)
+    rk_group: jax.Array    # [K] i32, -1 = every group
+    rk_arg: jax.Array      # [K] i32 (kill: peer|-1=leader; part: cut)
+    rk_start: jax.Array    # [K] i32
+    rk_stop: jax.Array     # [K] i32
 
 
 class NodeTerms(NamedTuple):
@@ -204,13 +257,18 @@ def compile_schedule(n: int, events: Sequence = ()) -> ChaosSchedule:
     links = [e for e in events if isinstance(e, LinkLoss)]
     churn = [e for e in events if isinstance(e, ChurnWave)]
     degr = [e for e in events if isinstance(e, Degrade)]
-    known = len(parts) + len(links) + len(churn) + len(degr)
+    rafts = [e for e in events
+             if isinstance(e, (RaftKill, RaftPartition, RaftStorm))]
+    known = len(parts) + len(links) + len(churn) + len(degr) + len(rafts)
     if known != len(list(events)):
-        raise TypeError("events must be Partition/LinkLoss/ChurnWave/Degrade")
+        raise TypeError("events must be Partition/LinkLoss/ChurnWave/"
+                        "Degrade/RaftKill/RaftPartition/RaftStorm")
     if len(parts) > MAX_PARTITIONS:
         raise ValueError(f"at most {MAX_PARTITIONS} Partition entries")
     if len(links) > MAX_LINKS:
         raise ValueError(f"at most {MAX_LINKS} LinkLoss entries")
+    if len(rafts) > MAX_RAFT_EVENTS:
+        raise ValueError(f"at most {MAX_RAFT_EVENTS} raft events")
 
     for e in parts:
         _check_window(e, "Partition")
@@ -226,6 +284,10 @@ def compile_schedule(n: int, events: Sequence = ()) -> ChaosSchedule:
         _check_window(e, "Degrade")
         _check_rate(e.tx_loss, "Degrade.tx_loss")
         _check_rate(e.rx_loss, "Degrade.rx_loss")
+    for e in rafts:
+        _check_window(e, type(e).__name__)
+        if isinstance(e, RaftPartition) and e.cut < 1:
+            raise ValueError("RaftPartition.cut must be >= 1")
 
     def i32(xs):
         return jnp.asarray(np.asarray(xs, np.int32))
@@ -244,6 +306,13 @@ def compile_schedule(n: int, events: Sequence = ()) -> ChaosSchedule:
                  for e in churn]
     cw_down = [e.down_ticks if e.period > 0 else e.stop - e.start
                for e in churn]
+
+    # Kind codes match ops/raft_ops RK_KILL/RK_PARTITION/RK_STORM.
+    rk_kind = [{RaftKill: 1, RaftPartition: 2, RaftStorm: 3}[type(e)]
+               for e in rafts]
+    rk_arg = [e.peer if isinstance(e, RaftKill)
+              else e.cut if isinstance(e, RaftPartition) else 0
+              for e in rafts]
 
     return ChaosSchedule(
         part_start=i32([e.start for e in parts]),
@@ -265,6 +334,11 @@ def compile_schedule(n: int, events: Sequence = ()) -> ChaosSchedule:
         dg_tx=f32([e.tx_loss for e in degr]),
         dg_rx=f32([e.rx_loss for e in degr]),
         dg_mask=masks(degr, lambda e: e.nodes),
+        rk_kind=i32(rk_kind),
+        rk_group=i32([e.group for e in rafts]),
+        rk_arg=i32(rk_arg),
+        rk_start=i32([e.start for e in rafts]),
+        rk_stop=i32([e.stop for e in rafts]),
     )
 
 
@@ -281,6 +355,7 @@ def is_empty(sched: ChaosSchedule) -> bool:
         and sched.ll_start.shape[0] == 0
         and sched.cw_start.shape[0] == 0
         and sched.dg_start.shape[0] == 0
+        and sched.rk_kind.shape[0] == 0
     )
 
 
@@ -291,7 +366,8 @@ def static_key_of(sched: Optional[ChaosSchedule]):
     if sched is None or is_empty(sched):
         return None
     return ("chaos", sched.part_start.shape[0], sched.ll_start.shape[0],
-            sched.cw_start.shape[0], sched.dg_start.shape[0])
+            sched.cw_start.shape[0], sched.dg_start.shape[0],
+            sched.rk_kind.shape[0])
 
 
 def digest_of(sched: Optional[ChaosSchedule]) -> str:
@@ -325,6 +401,7 @@ def shift_schedule(sched: ChaosSchedule, dt) -> ChaosSchedule:
         ll_start=sched.ll_start + dt, ll_stop=sched.ll_stop + dt,
         cw_start=sched.cw_start + dt, cw_stop=sched.cw_stop + dt,
         dg_start=sched.dg_start + dt, dg_stop=sched.dg_stop + dt,
+        rk_start=sched.rk_start + dt, rk_stop=sched.rk_stop + dt,
     )
 
 

@@ -67,14 +67,23 @@ def _shape_cfg(cfg: SimConfig) -> SimConfig:
 
 
 def _sweep_runner(cfg: SimConfig, chunk: int, n_scen: int, chaos_key,
-                  step_fn, swim_of, mesh):
+                  step_fn, swim_of, mesh, raft=None):
     """One compiled sweep program:
     ``run(world, off, rcol, inv, scheds, states, base_key) ->
     (states, counters)`` with states/scheds stacked on a leading
     scenario axis and counters returned as [S]-leaf pytrees. ``cfg``
-    must be the canonical family-free config (:func:`_shape_cfg`)."""
+    must be the canonical family-free config (:func:`_shape_cfg`).
+
+    With ``raft`` (a config.RaftConfig) the state slot is the
+    ``(model_state, RaftState)`` pair — both scenario-stacked — and the
+    counters pair up as ``(GossipCounters, RaftCounters)``: each
+    scenario lane steps its own raft tier against its own schedule's
+    RaftKill/RaftPartition/RaftStorm windows, which is how election
+    storms and leader kills become sweepable adversarial parameters.
+    Single-device only (run_sweep raises on mesh+raft — documented
+    narrowing)."""
     memo = ("sweep", cfg, chunk, n_scen, chaos_key, step_fn, swim_of,
-            pmesh.mesh_key(mesh))
+            pmesh.mesh_key(mesh), raft)
     hit = _SWEEP_CACHE.get(memo)
     if hit is not None:
         return hit
@@ -87,19 +96,39 @@ def _sweep_runner(cfg: SimConfig, chunk: int, n_scen: int, chaos_key,
         _SWEEP_CACHE[memo] = jitted
         return jitted
 
+    if raft is not None:
+        from consul_tpu.ops import raft_ops
+
     def one(topo, world, sched, state, base_key):
+        if raft is not None:
+            state, rst = state
         ticks = swim_of(state).t + jnp.arange(chunk, dtype=jnp.int32)
         tick_keys = jax.vmap(
             lambda t: jax.random.fold_in(base_key, t))(ticks)
 
         def body(carry, tick_key):
-            st, cnt = carry
+            if raft is not None:
+                (st, rst), (cnt, rcnt) = carry
+            else:
+                st, cnt = carry
+            if raft is not None:
+                t_pre = swim_of(st).t
             st, c = step_fn(cfg, topo, world, st, tick_key, sched,
                             sentinel=False)
-            return (st, counters_mod.add(cnt, c)), ()
+            cnt = counters_mod.add(cnt, c)
+            if raft is not None:
+                rst, rc = raft_ops.tick(raft, rst, t_pre, tick_key,
+                                        sched=sched)
+                return ((st, rst),
+                        (cnt, raft_ops.counters_add(rcnt, rc))), ()
+            return (st, cnt), ()
 
-        (state, cnt), _ = jax.lax.scan(
-            body, (state, counters_mod.zeros()), tick_keys)
+        if raft is not None:
+            carry0 = ((state, rst),
+                      (counters_mod.zeros(), raft_ops.counters_zeros()))
+        else:
+            carry0 = (state, counters_mod.zeros())
+        (state, cnt), _ = jax.lax.scan(body, carry0, tick_keys)
         return state, cnt
 
     def run(world, off, rcol, inv, scheds, states, base_key):
@@ -158,15 +187,30 @@ def run_sweep(sim, scenarios, *, ticks=None, chunk: int = 32,
     ``{"slo": ..., "counters": ..., "ticks": ...}`` in input order.
 
     ``scenarios`` is a sequence of event lists (Partition/LinkLoss/
-    ChurnWave/Degrade), all compiling to the same slot shape
+    ChurnWave/Degrade, plus RaftKill/RaftPartition/RaftStorm when the
+    sim's raft tier is armed), all compiling to the same slot shape
     (chaos/schedule.static_key_of). Each runs on its own copy of the
     state — ``sim`` itself is not advanced — with start/stop rebased
     onto the live tick, for ``ticks`` ticks (default: global max stop
     + ``settle``). Counter semantics match
-    :meth:`Simulation.run_scenario` exactly (the parity pin)."""
+    :meth:`Simulation.run_scenario` exactly (the parity pin).
+
+    With ``sim.set_raft(...)`` armed, every scenario lane also steps a
+    copy of the live RaftState and each result dict gains a ``raft``
+    entry: per-group terms/leaders/commit after the scenario plus the
+    scenario's RaftCounters deltas — how many elections a kill window
+    forced, how far a storm burned through terms. Raft sweeps are
+    single-device only (a mesh sweep with raft armed raises — the
+    documented narrowing; group-sharded raft lives in the chunk
+    runner, parallel/shard_step.py)."""
     from consul_tpu.models import cluster
 
     _check_sim(sim)
+    raft_cfg = getattr(sim, "_raft_cfg", None)
+    if raft_cfg is not None and sim.mesh is not None:
+        raise ValueError(
+            "raft-armed sweeps are single-device only: clear the mesh "
+            "or set_raft(None) before run_sweep (documented narrowing)")
     sched_stack, n_scen, ticks, chaos_key = _compile_scenarios(
         sim, scenarios, ticks, settle)
     states = _stack_states(sim, n_scen)
@@ -178,6 +222,10 @@ def run_sweep(sim, scenarios, *, ticks=None, chunk: int = 32,
         sched_stack = shard_step.place_sweep(
             sim.mesh, sched_stack, cfg.n)
         states = shard_step.place_sweep(sim.mesh, states, cfg.n)
+    if raft_cfg is not None:
+        rst0 = sim.raft.take_state()
+        states = (states, jax.tree.map(
+            lambda l: jnp.stack([l] * n_scen), rst0))
 
     totals = None
     remaining = ticks
@@ -185,11 +233,35 @@ def run_sweep(sim, scenarios, *, ticks=None, chunk: int = 32,
         c = min(chunk, remaining)
         runner = _sweep_runner(cfg, c, n_scen, chaos_key,
                                type(sim)._step_fn, type(sim)._swim_of,
-                               sim.mesh)
+                               sim.mesh, raft=raft_cfg)
         states, cnt = runner(sim.world, topo.off, topo.rcol, topo.inv,
                              sched_stack, states, sim.base_key)
-        totals = cnt if totals is None else counters_mod.add(totals, cnt)
+        totals = (cnt if totals is None
+                  else jax.tree.map(jnp.add, totals, cnt))
         remaining -= c
+
+    raft_rows = None
+    if raft_cfg is not None:
+        from consul_tpu.ops import raft_ops
+
+        states, rst_stack = states
+        totals, rtotals = totals
+        # One batched transfer for the raft plane: the vmapped summary
+        # plus the [fields, S] counter matrix.
+        summ, rvals = jax.device_get((
+            jax.vmap(raft_ops.summary)(rst_stack),
+            raft_ops.counters_stack(rtotals)))
+        term_g, leader_g, commit_g, cc = summ
+        raft_rows = []
+        for s in range(n_scen):
+            raft_rows.append({
+                "terms": [int(x) for x in term_g[s]],
+                "leaders": [int(x) for x in leader_g[s]],
+                "commit": [int(x) for x in commit_g[s]],
+                "committed_clients": [int(x) for x in cc[s]],
+                "counters": {f: int(rvals[i][s]) for i, f in
+                             enumerate(raft_ops.FIELDS)},
+            })
 
     # One batched [fields, S] device->host transfer for the whole sweep.
     vals = jax.device_get(counters_mod.stack(totals))
@@ -200,7 +272,10 @@ def run_sweep(sim, scenarios, *, ticks=None, chunk: int = 32,
         deltas = {f: int(vals[i][s])
                   for i, f in enumerate(counters_mod.FIELDS)}
         slo = {cluster.SLO_KEYS[f]: deltas[f] for f in cluster.SLO_KEYS}
-        results.append({"slo": slo, "counters": deltas, "ticks": ticks})
+        row = {"slo": slo, "counters": deltas, "ticks": ticks}
+        if raft_rows is not None:
+            row["raft"] = raft_rows[s]
+        results.append(row)
     return results
 
 
@@ -215,6 +290,11 @@ def prewarm_sweep(sim, scenarios, *, ticks=None, chunk: int = 32,
     from consul_tpu.utils.prewarm import _abstract
 
     _check_sim(sim)
+    raft_cfg = getattr(sim, "_raft_cfg", None)
+    if raft_cfg is not None and sim.mesh is not None:
+        raise ValueError(
+            "raft-armed sweeps are single-device only: clear the mesh "
+            "or set_raft(None) before prewarm_sweep")
     sched_stack, n_scen, ticks, chaos_key = _compile_scenarios(
         sim, scenarios, ticks, settle)
     cfg = _shape_cfg(sim.cfg)
@@ -228,12 +308,16 @@ def prewarm_sweep(sim, scenarios, *, ticks=None, chunk: int = 32,
         states = jax.tree.map(
             lambda l: jax.ShapeDtypeStruct((n_scen,) + l.shape, l.dtype),
             sim.state)
+    if raft_cfg is not None:
+        states = (states, jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct((n_scen,) + l.shape, l.dtype),
+            sim.raft.state))
     topo = sim.topo
     chunk_sizes = sorted({min(chunk, ticks), ticks % chunk or chunk})
     for c in chunk_sizes:
         runner = _sweep_runner(cfg, c, n_scen, chaos_key,
                                type(sim)._step_fn, type(sim)._swim_of,
-                               sim.mesh)
+                               sim.mesh, raft=raft_cfg)
         runner.lower(
             _abstract(sim.world), _abstract(topo.off), _abstract(topo.rcol),
             _abstract(topo.inv), _abstract(sched_stack), states,

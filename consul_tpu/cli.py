@@ -782,6 +782,11 @@ def _build_sim(args):
                   "cohort-streamed runs cannot record it",
                   file=sys.stderr)
             raise SystemExit(2)
+        if int(getattr(args, "raft_groups", 0) or 0):
+            print("--raft-groups: the raft tier rides the resident "
+                  "chunk scan; cohort-streamed runs cannot arm it",
+                  file=sys.stderr)
+            raise SystemExit(2)
         scls = StreamedSerfSimulation if args.serf else StreamedSimulation
         sim = scls(cfg, cohort_n=plan.cohort_n, seed=args.seed,
                    layout=plan.layout, chunk=plan.chunk)
@@ -796,6 +801,10 @@ def _build_sim(args):
                   "mesh flags to use it", file=sys.stderr)
             raise SystemExit(2)
         sim.set_lens(lens_n)
+    raft_groups = int(getattr(args, "raft_groups", 0) or 0)
+    if raft_groups:
+        sim.set_raft(raft_groups,
+                     peers=int(getattr(args, "raft_peers", 5)))
     if getattr(args, "prewarm", False):
         from consul_tpu.utils import prewarm as prewarm_mod
 
@@ -883,6 +892,10 @@ def _run_resilient_cmd(args, sim, events, ticks, extra: dict) -> int:
                ckpt_failures=report.ckpt_failures,
                reshards=report.reshards,
                hang_status=report.hang_status)
+    if getattr(sim, "raft", None) is not None:
+        sim.raft.pump()
+        out["raft"] = dict(sim.raft.summary(),
+                           counters=sim.raft.counters_snapshot())
     trace_path = _export_trace(args, sim)
     if trace_path:
         out["trace"] = trace_path
@@ -937,6 +950,28 @@ def cmd_chaos(args) -> int:
             nodes=frac_nodes(float(f[2])),
             tx_loss=float(f[3]),
             rx_loss=float(f[4]) if len(f) > 4 else 0.0))
+    raft_events = []
+    for spec in args.raft_kill or []:
+        f = spec.split(",")
+        raft_events.append(chaos_mod.RaftKill(
+            start=int(f[0]), stop=int(f[1]),
+            group=int(f[2]) if len(f) > 2 else -1,
+            peer=int(f[3]) if len(f) > 3 else -1))
+    for spec in args.raft_partition or []:
+        f = spec.split(",")
+        raft_events.append(chaos_mod.RaftPartition(
+            start=int(f[0]), stop=int(f[1]), cut=int(f[2]),
+            group=int(f[3]) if len(f) > 3 else -1))
+    for spec in args.raft_storm or []:
+        f = spec.split(",")
+        raft_events.append(chaos_mod.RaftStorm(
+            start=int(f[0]), stop=int(f[1]),
+            group=int(f[2]) if len(f) > 2 else -1))
+    if raft_events and not getattr(args, "raft_groups", 0):
+        print("--raft-kill/--raft-partition/--raft-storm act on the "
+              "raft tier; arm it with --raft-groups R", file=sys.stderr)
+        return 2
+    events.extend(raft_events)
     if not events:
         # Default scenario: the acceptance-style 70/30 partition-heal.
         events = [chaos_mod.Partition(
@@ -1080,7 +1115,8 @@ def cmd_prewarm(args) -> int:
         sentinel=args.sentinel, cache_dir=args.compile_cache,
         layout=args.layout, family=args.family,
         family_param=args.family_param, sweep=args.sweep,
-        sweep_chunk=args.sweep_chunk,
+        sweep_chunk=args.sweep_chunk, raft_groups=args.raft_groups,
+        raft_peers=args.raft_peers,
     )
     print(json.dumps(summary))
     return 0
@@ -1284,6 +1320,22 @@ def build_parser() -> argparse.ArgumentParser:
                              "expander 32 draws, smallworld beta 0.2, "
                              "hier 8 DCs)")
 
+    def add_raft_flags(sp):
+        # Batched device raft tier (models/raft.py, ops/raft_ops.py):
+        # R consensus groups x P peers stepped inside the same jitted
+        # scan, the commit point feeding the serving write path.
+        sp.add_argument("--raft-groups", type=int, default=0,
+                        metavar="R",
+                        help="arm the batched raft server tier with R "
+                             "groups (0 = off, the byte-identical "
+                             "pre-raft program); the run report gains "
+                             "per-group terms/leaders/commit + "
+                             "consul.raft.* counters")
+        sp.add_argument("--raft-peers", type=int, default=5,
+                        metavar="P",
+                        help="peers per raft group (odd; quorum "
+                             "P//2+1)")
+
     def add_mesh_flags(sp):
         # Multi-chip placement knobs: by default the local-run
         # subcommands run over the largest elastic mesh the visible
@@ -1314,6 +1366,7 @@ def build_parser() -> argparse.ArgumentParser:
     add_mesh_flags(rn)
     add_layout_flags(rn)
     add_obs_flags(rn)
+    add_raft_flags(rn)
 
     tr = sub.add_parser(
         "trace",
@@ -1338,6 +1391,7 @@ def build_parser() -> argparse.ArgumentParser:
                     help="record N evenly spaced nodes' per-tick "
                          "observables inside the compiled scan "
                          "(obs/lens.py; 0 = off)")
+    add_raft_flags(tr)
 
     sv = sub.add_parser(
         "serve-bench",
@@ -1398,6 +1452,22 @@ def build_parser() -> argparse.ArgumentParser:
     ch.add_argument("--churn", action="append", metavar="START,STOP,FRAC")
     ch.add_argument("--degrade", action="append",
                     metavar="START,STOP,FRAC,TX[,RX]")
+    ch.add_argument("--raft-kill", action="append",
+                    metavar="START,STOP[,GROUP[,PEER]]",
+                    help="freeze a raft peer for the window (group -1 "
+                         "= every group, peer -1 = whoever leads at "
+                         "each tick: the leader-kill drill); needs "
+                         "--raft-groups")
+    ch.add_argument("--raft-partition", action="append",
+                    metavar="START,STOP,CUT[,GROUP]",
+                    help="split a raft group's peers at seat CUT "
+                         "(minority side cannot commit); needs "
+                         "--raft-groups")
+    ch.add_argument("--raft-storm", action="append",
+                    metavar="START,STOP[,GROUP]",
+                    help="total message blackout: every timeout fires, "
+                         "no vote lands — a split-vote election storm "
+                         "burning through terms; needs --raft-groups")
     ch.add_argument("--sweep", type=int, default=0, metavar="S",
                     help="run S scenario parameterizations in ONE "
                          "vmapped executable per family instead of one "
@@ -1418,6 +1488,7 @@ def build_parser() -> argparse.ArgumentParser:
     add_mesh_flags(ch)
     add_layout_flags(ch)
     add_obs_flags(ch)
+    add_raft_flags(ch)
 
     pw = sub.add_parser(
         "prewarm",
@@ -1453,6 +1524,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "as a program argument, so one warm covers "
                          "every same-shape family")
     pw.add_argument("--sweep-chunk", type=int, default=32)
+    pw.add_argument("--raft-groups", type=int, default=0, metavar="R",
+                    help="also arm the batched raft tier (R groups) "
+                         "so the warmed program matches a "
+                         "run --raft-groups R")
+    pw.add_argument("--raft-peers", type=int, default=5, metavar="P")
     pw.add_argument("--layout", choices=("dense", "packed"),
                     default="dense",
                     help="state layout the warmed programs bind "

@@ -113,7 +113,7 @@ def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
                   step_fn=swim.step_counted, swim_of=lambda st: st,
                   chaos_key=None, sentinel: bool = False, mesh=None,
                   layout: str = layout_mod.DENSE, lens: tuple = (),
-                  clock_of=None):
+                  clock_of=None, raft=None):
     """One compiled chunk program. ``step_fn`` is the per-tick counted
     step (bare SWIM or the full serf stack) returning
     (state, GossipCounters); ``swim_of`` projects the SWIM plane out of
@@ -161,10 +161,17 @@ def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
     ``sentinel``/``layout`` DCE contract — the program (and the return
     arity) is byte-for-byte the pre-lens one, so toggling the lens off
     compiles nothing. ``clock_of`` projects the serf Lamport clock out
-    of the step's state for the lens (None under bare SWIM)."""
+    of the step's state for the lens (None under bare SWIM).
+
+    ``raft`` (a config.RaftConfig, None = off) steps the batched raft
+    tier (ops/raft_ops.tick) inside the same scan: the carry becomes
+    ``((state, RaftState), (GossipCounters, RaftCounters))`` and the
+    runner takes/returns the state PAIR in the donated slot. None
+    follows the sentinel/lens DCE contract — byte-for-byte the
+    pre-raft program, zero extra executables."""
     memo = (cfg, _topo_key(topo), chunk, with_metrics, step_fn, swim_of,
             chaos_key, sentinel, pmesh.mesh_key(mesh), layout, lens,
-            clock_of)
+            clock_of, raft)
     hit = _RUNNER_CACHE.get(memo)
     if hit is not None:
         return hit
@@ -179,6 +186,7 @@ def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
             cfg, topo, mesh, chunk, with_metrics,
             step_fn=step_fn, swim_of=swim_of,
             chaos=chaos_key is not None, sentinel=sentinel, layout=layout,
+            raft=raft,
         )
         _RUNNER_CACHE[memo] = jitted
         return jitted
@@ -186,33 +194,68 @@ def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
     packed = layout == layout_mod.PACKED
 
     def body(world, sched, carry, tick_key):
-        state, cnt = carry
+        if raft is not None:
+            (state, rst), (cnt, rcnt) = carry
+        else:
+            state, cnt = carry
         if packed:
             state = layout_mod.unpack_state(state)
+        if raft is not None:
+            # The raft tick is keyed on the PRE-step tick (the same t
+            # this tick_key was folded from) so chaos windows and the
+            # draw ladder line up with the oracle's step(t).
+            t_pre = swim_of(state).t
         state, c = step_fn(cfg, topo, world, state, tick_key, sched,
                            sentinel=sentinel)
         cnt = counters_mod.add(cnt, c)
+        if raft is not None:
+            from consul_tpu.ops import raft_ops
+
+            rst, rc = raft_ops.tick(raft, rst, t_pre, tick_key,
+                                    sched=sched)
+            rcnt = raft_ops.counters_add(rcnt, rc)
         out = layout_mod.pack_state(state) if packed else state
-        row = lens_obs.snapshot(
-            swim_of(state),
-            None if clock_of is None else clock_of(state),
-            lens) if lens else None
+        if raft is not None:
+            carry_out = ((out, rst), (cnt, rcnt))
+        else:
+            carry_out = (out, cnt)
+        if lens:
+            row = lens_obs.snapshot(
+                swim_of(state),
+                None if clock_of is None else clock_of(state),
+                lens)
+            if raft is not None:
+                from consul_tpu.obs import lens as _lens
+
+                row = jnp.concatenate(
+                    [row, _lens.raft_snapshot(rst, lens)], axis=1)
+        else:
+            row = None
         if not with_metrics:
-            return (out, cnt), (row if lens else ())
+            return carry_out, (row if lens else ())
         sw = swim_of(state)
         h = metrics.health(cfg, topo, sw)
         rmse = metrics.vivaldi_rmse(
             cfg, world, sw, jax.random.fold_in(tick_key, 1), samples=2048
         )
         trace = TickTrace(h.agreement, h.false_positive, h.undetected, rmse)
-        return (out, cnt), ((trace, row) if lens else trace)
+        return carry_out, ((trace, row) if lens else trace)
 
     def run(world, sched, state, base_key):
-        ticks = swim_of(state).t + jnp.arange(chunk, dtype=jnp.int32)
+        if raft is not None:
+            from consul_tpu.ops import raft_ops
+
+            model_state, rst = state
+            ticks = swim_of(model_state).t + jnp.arange(chunk,
+                                                        dtype=jnp.int32)
+            carry0 = ((model_state, rst),
+                      (counters_mod.zeros(), raft_ops.counters_zeros()))
+        else:
+            ticks = swim_of(state).t + jnp.arange(chunk, dtype=jnp.int32)
+            carry0 = (state, counters_mod.zeros())
         tick_keys = jax.vmap(lambda t: jax.random.fold_in(base_key, t))(ticks)
         (state, cnt), ys = jax.lax.scan(
-            functools.partial(body, world, sched),
-            (state, counters_mod.zeros()), tick_keys)
+            functools.partial(body, world, sched), carry0, tick_keys)
         if lens:
             trace, lbuf = ys if with_metrics else (None, ys)
             return state, cnt, trace, lbuf
@@ -296,6 +339,12 @@ class Simulation:
         # the host-side LensRecorder while armed.
         self._lens_ids: tuple = ()
         self.lens = None
+        # Device raft tier (models/raft.py): ``_raft_cfg`` (a frozen
+        # RaftConfig) joins the runner memo key like chaos/sentinel —
+        # None is the byte-identical pre-raft program; ``raft`` is the
+        # host RaftPlane (proposals, commit pump, counters) while armed.
+        self._raft_cfg = None
+        self.raft = None
         # Monotone chunk sequence number — the alignment key shared by
         # the XLA StepTraceAnnotation and the host "chunk" span.
         self._chunk_seq = 0
@@ -359,9 +408,54 @@ class Simulation:
         """Republish the serving snapshot from current state (no-op
         when no plane is attached). The projection is one jitted
         program producing fresh buffers, so snapshots survive the
-        runner's donated-state overwrite on the next chunk."""
+        runner's donated-state overwrite on the next chunk. With the
+        raft tier armed, the commit pump runs FIRST: quorum-committed
+        proposals apply to the write state here, so the snapshot a
+        flip captures is consistent as of the committed prefix."""
+        if self.raft is not None:
+            self.raft.pump()
         if self.serving is not None:
             self.serving.publish(self)
+
+    # -- raft tier -------------------------------------------------------
+    def set_raft(self, groups=None, **kw):
+        """Arm (or clear, with None) the batched device raft tier for
+        subsequent runs: ``groups`` is an int group count (remaining
+        RaftConfig knobs via ``kw``) or a full
+        :class:`~consul_tpu.config.RaftConfig`. Arming rebinds the
+        runners and builds a fresh :class:`~consul_tpu.models.raft.
+        RaftPlane`; toggling follows the set_sentinel/set_lens DCE
+        contract — off is the pre-raft program byte-for-byte, and the
+        process-wide _RUNNER_CACHE memoizes both programs so flipping
+        never recompiles. Returns the RaftPlane (None when cleared)."""
+        from consul_tpu.config import RaftConfig
+
+        if groups is None:
+            rcfg = None
+        elif isinstance(groups, RaftConfig):
+            rcfg = groups
+        else:
+            rcfg = RaftConfig(groups=int(groups), **kw)
+        if rcfg != self._raft_cfg:
+            self._raft_cfg = rcfg
+            self._runners = {}
+        if rcfg is None:
+            self.raft = None
+        else:
+            from consul_tpu.models import raft as raft_mod
+
+            self.raft = raft_mod.RaftPlane(self, rcfg)
+        # The lens field layout depends on whether raft rides along —
+        # restart the recorder so its schema matches the buffers.
+        if self._lens_ids:
+            self.lens = lens_obs.LensRecorder(
+                self._lens_ids, tick0=self._tick(),
+                fields=self._lens_fields())
+        return self.raft
+
+    def _lens_fields(self) -> tuple:
+        return (lens_obs.FIELDS + lens_obs.RAFT_FIELDS
+                if self._raft_cfg is not None else lens_obs.FIELDS)
 
     # -- layout plumbing ------------------------------------------------
     def _to_dense(self):
@@ -443,7 +537,8 @@ class Simulation:
         if ids != self._lens_ids:
             self._lens_ids = ids
             self._runners = {}
-        self.lens = (lens_obs.LensRecorder(ids, tick0=self._tick())
+        self.lens = (lens_obs.LensRecorder(ids, tick0=self._tick(),
+                                           fields=self._lens_fields())
                      if ids else None)
         return ids
 
@@ -533,6 +628,7 @@ class Simulation:
                 chaos_key=chaos_mod.static_key_of(self.chaos),
                 sentinel=self.sentinel, mesh=self.mesh, layout=self.layout,
                 lens=self._lens_ids, clock_of=type(self)._clock_of,
+                raft=self._raft_cfg,
             )
 
             def bound(state, base_key, _j=jitted, _w=self.world,
@@ -559,14 +655,21 @@ class Simulation:
         t0_us = tr.now_us()
         step = self._chunk_seq
         self._chunk_seq += 1
+        arg = (self.state if self.raft is None
+               else (self.state, self.raft.take_state()))
         with obs_trace.chunk_annotation(step, c):
-            out = self._runner(c, with_metrics)(self.state, self.base_key)
+            out = self._runner(c, with_metrics)(arg, self.base_key)
         if self._lens_ids:
-            self.state, cnt, trace, lbuf = out
+            st, cnt, trace, lbuf = out
             if self.lens is not None:
                 self.lens.record(lbuf, c, t0_us, tr.now_us())
         else:
-            self.state, cnt, trace = out
+            st, cnt, trace = out
+        if self.raft is not None:
+            (self.state, self.raft.state), (cnt, rcnt) = st, cnt
+            self.raft.absorb(rcnt)
+        else:
+            self.state = st
         return cnt, trace
 
     def run(self, ticks: int, chunk: int = 64, with_metrics: bool = True):

@@ -1,0 +1,250 @@
+"""RaftPlane: the host tier of the device raft subsystem.
+
+The device half lives in ``ops/raft_ops.py`` — R groups × P peers of
+term/role/log tensors stepped inside the same jitted scan as SWIM/serf
+(models/cluster.py threads the :class:`~consul_tpu.ops.raft_ops.
+RaftState` through the chunk carry). This module owns everything that
+must NOT live in the scan: proposal intake, the commit-point pump that
+turns quorum-committed entries into real write applies, and the counter
+fold into the telemetry sink.
+
+Commit contract (the tentpole): with a write-attached serving plane,
+``WriteBatcher._run_batch`` routes batches here (:meth:`stage`) instead
+of applying immediately. Each batch becomes one proposal ticket on a
+raft group; the device's per-group commit index advances only when a
+quorum of that group's peers holds the entries; and :meth:`pump`
+(called from the sim's chunk boundary, right before the serving
+republish) applies exactly the tickets whose entries sit inside the
+committed prefix — through the batcher's real apply kernel, so the
+device apply index (``X-Consul-Index``) moves ONLY at commit. A write
+acknowledged with an index therefore survives leader loss by
+construction: the index existing means a quorum held the entry, and
+the election up-to-date rule forbids any candidate without it from
+winning (the leader-kill drill pins this end to end).
+
+Proposals are intent-based (see raft_ops module docstring): propose()
+bumps the group's ``next_seq`` and every current leader appends until
+its log carries that many client entries, so entries stranded on a
+deposed leader re-propose automatically and the k-th committed client
+entry of a group is always proposal k — ticket completion is a pure
+comparison of the committed-client count against the ticket's end
+sequence, no entry ids shipped to the device.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.config import RaftConfig
+from consul_tpu.obs import trace as obs_trace
+from consul_tpu.ops import raft_ops
+
+# Folded into the sim's base key for the initial timeout draws — keeps
+# raft init independent of topology/state init splits, and gives the
+# lockstep oracle (server/raft.py) the same concrete init key.
+_INIT_SALT = 40961
+
+# A per-group term jump of at least this many terms between two pumps
+# marks an election storm (split votes burning through terms faster
+# than single back-to-back timeouts) — surfaced as a flight-recorder
+# instant so storms are visible on the trace timeline.
+STORM_TERM_JUMP = 3
+
+
+class RaftTicket:
+    """One staged proposal batch: ``ops`` are (op, target, arg) write
+    triples, ``end_seq`` the group's client-entry sequence after this
+    batch. ``done`` fires at commit with ``results`` holding the real
+    per-op WriteResults (quorum-committed indexes)."""
+
+    __slots__ = ("ops", "group", "end_seq", "done", "results", "error")
+
+    def __init__(self, ops, group: int, end_seq: int):
+        self.ops = list(ops)
+        self.group = group
+        self.end_seq = end_seq
+        self.done = threading.Event()
+        self.results = None
+        self.error: Optional[Exception] = None
+
+    def wait(self, timeout_s: float = 30.0):
+        if not self.done.wait(timeout_s):
+            raise TimeoutError(
+                f"raft group {self.group} did not commit seq "
+                f"{self.end_seq} in {timeout_s}s")
+        if self.error is not None:
+            raise self.error
+        return self.results
+
+
+def init_key_of(sim) -> jax.Array:
+    """The raft init key for a sim — shared with the parity oracle."""
+    return jax.random.fold_in(sim.base_key, _INIT_SALT)
+
+
+class RaftPlane:
+    """Host companion of the in-scan raft tier (built by
+    ``Simulation.set_raft``). Holds the live RaftState between chunks,
+    the proposal ticket queues, and the cumulative counter dict."""
+
+    def __init__(self, sim, rcfg: RaftConfig):
+        self.sim = sim
+        self.rcfg = rcfg
+        self.state = raft_ops.init(rcfg, init_key_of(sim))
+        self.counters = {f: 0 for f in raft_ops.FIELDS}
+        self._pending_vecs: list = []
+        self._lock = threading.Lock()
+        self._tickets = [deque() for _ in range(rcfg.groups)]
+        self._next_seq = [0] * rcfg.groups
+        self._rr = 0
+        self._writes = None  # WriteBatcher applying committed tickets
+        self._last_term = np.zeros(rcfg.groups, np.int64)
+        self._summary = jax.jit(raft_ops.summary)
+        # Host-side intent bumps, folded into the device ``next_seq``
+        # at the next chunk dispatch (take_state) — never touching a
+        # possibly-donated buffer from a proposer thread.
+        self._bumps = np.zeros(rcfg.groups, np.int32)
+
+    # ------------------------------------------------------------------
+    # Proposal intake
+    # ------------------------------------------------------------------
+    def propose(self, ops: Sequence[tuple], group: Optional[int] = None
+                ) -> RaftTicket:
+        """Stage one batch of write triples on a raft group (round-robin
+        by default). Returns the ticket; the entries land in the next
+        leader tick and the ticket completes at quorum commit."""
+        with self._lock:
+            if group is None:
+                group = self._rr
+                self._rr = (self._rr + 1) % self.rcfg.groups
+            group = int(group)
+            self._next_seq[group] += len(ops)
+            tk = RaftTicket(ops, group, self._next_seq[group])
+            self._tickets[group].append(tk)
+            self._bumps[group] += len(ops)
+        return tk
+
+    def take_state(self):
+        """The RaftState to feed the next chunk, with any pending
+        proposal intents folded in (one eager [R] add — no traced
+        scatter, one executable per shape)."""
+        with self._lock:
+            if self._bumps.any():
+                self.state = self.state._replace(
+                    next_seq=self.state.next_seq + jnp.asarray(self._bumps))
+                self._bumps[:] = 0
+            return self.state
+
+    def stage(self, batcher, ops: Sequence[tuple]) -> list:
+        """WriteBatcher gate: turn an apply-now batch into a proposal.
+        Returns provisional ``proposed`` results immediately (the
+        batcher's synchronous contract); the REAL results — with
+        quorum-committed apply indexes — land on the ticket at commit,
+        applied through ``batcher._apply_batch``."""
+        from consul_tpu.serving.writes import WriteResult
+
+        self._writes = batcher
+        self.propose(ops)
+        return [WriteResult(applied=False, index=-1, status="proposed")
+                for _ in ops]
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return sum(len(q) for q in self._tickets)
+
+    # ------------------------------------------------------------------
+    # Commit pump (chunk boundary, before the serving republish)
+    # ------------------------------------------------------------------
+    def pump(self) -> int:
+        """Fold pending counters, read the per-group commit frontier
+        (one jitted summary + one small device_get), and apply every
+        ticket whose entries are quorum-committed. Returns the number
+        of tickets applied."""
+        self.flush_counters()
+        with obs_trace.span("raft.step", cat="raft",
+                            args={"groups": self.rcfg.groups}):
+            term_g, leader_g, commit_g, cc = jax.device_get(
+                self._summary(self.state))
+        jump = term_g.astype(np.int64) - self._last_term
+        if np.any(jump >= STORM_TERM_JUMP) and np.any(self._last_term > 0):
+            obs_trace.get_tracer().instant(
+                "raft.election_storm", cat="raft",
+                args={"max_jump": int(jump.max()),
+                      "terms": [int(x) for x in term_g]})
+        self._last_term = term_g.astype(np.int64)
+        sink = getattr(self.sim, "sink", None)
+        if sink is not None:
+            sink.set_gauge("consul.raft.commitIndex", int(commit_g.max()))
+        applied = 0
+        for r in range(self.rcfg.groups):
+            while True:
+                with self._lock:
+                    q = self._tickets[r]
+                    if not q or q[0].end_seq > int(cc[r]):
+                        break
+                    tk = q.popleft()
+                applied += 1
+                with obs_trace.span("raft.commit", cat="raft",
+                                    args={"group": r, "n": len(tk.ops),
+                                          "commit": int(commit_g[r])}):
+                    try:
+                        if self._writes is not None:
+                            tk.results = self._writes._apply_batch(tk.ops)
+                        else:
+                            from consul_tpu.serving.writes import WriteResult
+
+                            tk.results = [
+                                WriteResult(applied=True,
+                                            index=int(commit_g[r]),
+                                            status="committed")
+                                for _ in tk.ops]
+                    except Exception as e:  # surface on the waiter
+                        tk.error = e
+                tk.done.set()
+        return applied
+
+    # ------------------------------------------------------------------
+    # Counters (the Simulation._flush_counters discipline)
+    # ------------------------------------------------------------------
+    def absorb(self, rcnt) -> None:
+        """Queue one chunk's RaftCounters pytree for a lazy batched
+        flush (no device sync on the hot path)."""
+        self._pending_vecs.append(raft_ops.counters_stack(rcnt))
+
+    def flush_counters(self) -> None:
+        if not self._pending_vecs:
+            return
+        vecs, self._pending_vecs = self._pending_vecs, []
+        vals = np.sum(np.stack(jax.device_get(vecs)), axis=0)
+        deltas = {f: int(v) for f, v in zip(raft_ops.FIELDS, vals)}
+        sink = getattr(self.sim, "sink", None)
+        for f, v in deltas.items():
+            self.counters[f] += v
+            if v and sink is not None:
+                sink.incr_counter(raft_ops.METRIC_NAMES[f], v)
+
+    def counters_snapshot(self) -> dict:
+        self.flush_counters()
+        return dict(self.counters)
+
+    # ------------------------------------------------------------------
+    # Introspection
+    # ------------------------------------------------------------------
+    def summary(self) -> dict:
+        """Per-group host view: terms, leader ids (-1 = none), commit
+        indexes, committed client-entry counts."""
+        term_g, leader_g, commit_g, cc = jax.device_get(
+            self._summary(self.state))
+        return {
+            "terms": [int(x) for x in term_g],
+            "leaders": [int(x) for x in leader_g],
+            "commit": [int(x) for x in commit_g],
+            "committed_clients": [int(x) for x in cc],
+        }

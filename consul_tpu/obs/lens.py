@@ -51,6 +51,13 @@ from typing import Optional, Sequence, Union
 FIELDS = ("status", "incarnation", "susp_age", "probe_deadline_delta",
           "lamport", "vivaldi_error", "msgs_tx")
 
+# Extra field group appended when the raft tier rides the scan
+# (Simulation.set_raft + set_lens): lens slot s tracks raft group
+# ``ids[s] mod R`` — per-group max term, seat 0's role, the leader id
+# the rank-max summary sees (-1 = none), and the group's max commit
+# index. Same f32 wire discipline as FIELDS.
+RAFT_FIELDS = ("raft_term", "raft_role", "raft_leader", "raft_commit")
+
 # Perfetto process id grouping the lens counter tracks apart from the
 # host-span pid (the host tracer uses os.getpid()).
 LENS_PID = 2
@@ -110,6 +117,25 @@ def snapshot(sw, clock, ids: tuple):
                       lamport, viv_err, msgs], axis=1)
 
 
+def raft_snapshot(rst, ids: tuple):
+    """Per-tick raft lens rows: ``[S, len(RAFT_FIELDS)]`` f32, lens
+    slot s mapped onto raft group ``ids[s] mod R`` (static indices —
+    the snapshot() gather discipline). Concatenated onto the SWIM row
+    along the field axis by the chunk body when raft is armed."""
+    import jax.numpy as jnp
+
+    from consul_tpu.ops import raft_ops
+
+    r_count = rst.term.shape[0]
+    g = jnp.array([i % r_count for i in ids], dtype=jnp.int32)
+    f32 = jnp.float32
+    term = jnp.max(rst.term[g], axis=1).astype(f32)
+    role = rst.role[g, jnp.zeros((len(ids),), jnp.int32)].astype(f32)
+    _, leader_g, commit_g, _ = raft_ops.summary(rst)
+    return jnp.stack([term, role, leader_g[g].astype(f32),
+                      commit_g[g].astype(f32)], axis=1)
+
+
 class LensRecorder:
     """Host half of the lens: per-chunk ``[C, S, F]`` device buffers
     queue here (references only — no transfer) and drain in ONE
@@ -120,9 +146,10 @@ class LensRecorder:
     microseconds) so export can interpolate a timestamp per tick and
     the node timelines land inside the matching ``chunk`` span."""
 
-    def __init__(self, ids: tuple, tick0: int = 0):
+    def __init__(self, ids: tuple, tick0: int = 0,
+                 fields: tuple = FIELDS):
         self.ids = tuple(ids)
-        self.fields = FIELDS
+        self.fields = tuple(fields)
         self._next_tick = int(tick0)
         self._pending: list = []   # (tick0, ticks, t0_us, t1_us, dev buf)
         self._chunks: list = []    # same tuples with host numpy buffers
@@ -158,7 +185,8 @@ class LensRecorder:
         self.flush()
         if not self._chunks:
             return (np.zeros((0,), np.int32),
-                    np.zeros((0, len(self.ids), len(FIELDS)), np.float32))
+                    np.zeros((0, len(self.ids), len(self.fields)),
+                             np.float32))
         ticks = np.concatenate([
             np.arange(t0, t0 + n, dtype=np.int32)
             for t0, n, _, _, _ in self._chunks])
@@ -193,7 +221,7 @@ class LensRecorder:
             for j in range(nticks):
                 ts = a + step_us * j
                 for s, nid in enumerate(self.ids):
-                    for f, field in enumerate(FIELDS):
+                    for f, field in enumerate(self.fields):
                         events.append({
                             "name": f"node{nid}/{field}", "cat": "lens",
                             "ph": "C", "ts": round(ts, 3),

@@ -1,0 +1,481 @@
+"""Batched multi-group raft as dense tensor ops on device.
+
+The reference's server tier is hashicorp/raft: one event-driven state
+machine per server, goroutines and channels per peer (raft.go
+runFollower/runCandidate/runLeader). Here R independent raft groups of
+P peers each are ONE set of ``[R, P]`` tensors stepped synchronously
+inside the same jitted scan as SWIM/serf (models/cluster.py): a tick is
+a fixed sub-phase pipeline — timers, election start, one RequestVote
+round, leader appends, one AppendEntries round, quorum commit — where
+every message exchange is a dense ``[R, P, P]`` one-hot round and every
+state update a masked ``jnp.where`` full-array write. Zero
+data-dependent scatters (TH109-clean): log writes are masked-arange
+selects, vote/append source selection is the rank-max idiom of
+``ops/deltas._last_writer``, commit advance is a quorum count over the
+static window axis.
+
+Determinism contract: the per-tick randomness is ONE election-timeout
+draw per (group, peer), keyed off the scan's existing per-tick key
+ladder (``fold_in(fold_in(base_key, t), _RAFT_SALT)`` then a per-seat
+fold on the GLOBAL ``group*P + peer`` index). A peer resets its timer
+at most once per tick, so the draw table is the complete randomness
+spec — the host oracle (server/raft.py LockstepRaftOracle) replays it
+exactly via :func:`draw_table`, and the sharded runner reproduces it
+bit-for-bit by folding global group ids (``group0`` offset).
+
+Synchronous-model narrowings vs hashicorp/raft (COVERAGE.md server
+tier): no membership changes, no InstallSnapshot (the log is a bounded
+``window``-entry absolute-index buffer; entry w+1 lives at slot w), and
+AppendEntries ships the leader's FULL window with wholesale adoption
+instead of per-follower nextIndex backoff — safe because the election
+up-to-date rule (§5.4.1) preserves Leader Completeness, so a leader's
+log always contains every committed entry and replacing a follower's
+suffix can never drop one. Commit advance keeps the §5.4.2
+current-term-only rule.
+
+Client traffic is intent-based: the host bumps ``next_seq[r]``
+(models/raft.py RaftPlane.propose) and every CURRENT leader of group r
+appends client entries until its log holds ``next_seq[r]`` of them —
+so entries stranded on a deposed leader's uncommitted suffix are
+re-proposed by the next leader automatically, and the k-th committed
+client entry of a group is always proposal k (the FIFO ticket mapping
+RaftPlane.pump relies on).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.config import RaftConfig
+
+ROLE_FOLLOWER = 0
+ROLE_CANDIDATE = 1
+ROLE_LEADER = 2
+
+# Salt folded into the scan's per-tick key for the raft draw ladder —
+# keeps raft randomness independent of the SWIM/serf split(key, 10)
+# consumption at the same tick.
+_RAFT_SALT = 7919
+
+
+class RaftState(NamedTuple):
+    """Per-(group, peer) raft state, all dense. ``match`` is row p's
+    leader-side view of every peer's replicated length (meaningful only
+    while p leads). ``next_seq`` is the host-bumped client-entry intent
+    per group (see module docstring)."""
+
+    term: jax.Array        # [R, P] i32
+    role: jax.Array        # [R, P] i32 (ROLE_*)
+    voted_for: jax.Array   # [R, P] i32, -1 = none this term
+    leader: jax.Array      # [R, P] i32, -1 = unknown
+    timer: jax.Array       # [R, P] i32 election countdown
+    hb: jax.Array          # [R, P] i32 leader heartbeat countdown
+    log_term: jax.Array    # [R, P, W] i32, slot w = entry w+1 (0 = empty)
+    log_client: jax.Array  # [R, P, W] bool — client entry vs leader no-op
+    last_index: jax.Array  # [R, P] i32 entries held
+    commit: jax.Array      # [R, P] i32 committed prefix length
+    match: jax.Array       # [R, P, P] i32 leader replication view
+    next_seq: jax.Array    # [R] i32 client-entry intent
+
+
+class RaftCounters(NamedTuple):
+    """Per-tick raft event tallies, [] i32 — the GossipCounters pattern
+    (models/counters.py) as a SEPARATE pytree so arming raft never
+    changes the gossip counter stack width (the raft-off byte-identity
+    pin). Field order is the wire order of the stacked fetch."""
+
+    elections_started: jax.Array     # timers expired -> candidate
+    elections_won: jax.Array         # quorum reached -> leader
+    term_changes: jax.Array          # higher term adopted from a message
+    commit_advances: jax.Array       # leader commit-index advances
+    heartbeats_sent: jax.Array       # heartbeat-cadence AppendEntries
+    heartbeats_suppressed: jax.Array  # quiet leader ticks (no send due)
+    entries_appended: jax.Array      # log entries appended (noop+client)
+    votes_granted: jax.Array         # RequestVote grants issued
+
+
+FIELDS = RaftCounters._fields
+
+# Sink names (telemetry table: COVERAGE.md server tier;
+# tests/test_metric_names.py folds these in like counters.METRIC_NAMES).
+METRIC_NAMES = {
+    "elections_started": "consul.raft.state.candidate",
+    "elections_won": "consul.raft.state.leader",
+    "term_changes": "consul.raft.term.changes",
+    "commit_advances": "consul.raft.commit.advances",
+    "heartbeats_sent": "consul.raft.replication.heartbeat",
+    "heartbeats_suppressed": "consul.raft.heartbeat.suppressed",
+    "entries_appended": "consul.raft.log.appends",
+    "votes_granted": "consul.raft.vote.granted",
+}
+assert set(METRIC_NAMES) == set(FIELDS)
+
+
+def counters_zeros() -> RaftCounters:
+    z = jnp.zeros((), jnp.int32)
+    return RaftCounters(*([z] * len(FIELDS)))
+
+
+def counters_add(a: RaftCounters, b: RaftCounters) -> RaftCounters:
+    return jax.tree.map(jnp.add, a, b)
+
+
+def counters_stack(c: RaftCounters) -> jax.Array:
+    return jnp.stack(list(c))
+
+
+def counters_unstack(vec) -> RaftCounters:
+    return RaftCounters(*(vec[i] for i in range(len(FIELDS))))
+
+
+def _count(mask) -> jax.Array:
+    return jnp.sum(mask).astype(jnp.int32)
+
+
+# ----------------------------------------------------------------------
+# Randomness spec (shared with the host oracle).
+# ----------------------------------------------------------------------
+
+def timeout_draws(rcfg: RaftConfig, key, group0, r_count: int):
+    """``[r_count, P]`` i32 election-timeout draws in
+    [election_ticks_min, election_ticks_max], one per seat, keyed on
+    the GLOBAL seat index ``(group0 + r) * P + p`` — shard-invariant by
+    construction (the sharded runner passes its group offset)."""
+    p = rcfg.peers
+    base = jnp.asarray(group0, jnp.int32) * p
+    idx = base + jnp.arange(r_count * p, dtype=jnp.int32)
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(idx)
+    draw = jax.vmap(lambda k: jax.random.randint(
+        k, (), rcfg.election_ticks_min, rcfg.election_ticks_max + 1))(keys)
+    return draw.reshape(r_count, p).astype(jnp.int32)
+
+
+def draw_table(rcfg: RaftConfig, base_key, t: int, group0: int = 0,
+               r_count: Optional[int] = None) -> np.ndarray:
+    """Host view of tick ``t``'s draw table (numpy [R, P]) — the oracle
+    consumes exactly what the device consumed."""
+    r_count = rcfg.groups if r_count is None else r_count
+    tick_key = jax.random.fold_in(base_key, t)
+    d = timeout_draws(rcfg, jax.random.fold_in(tick_key, _RAFT_SALT),
+                      group0, r_count)
+    return np.asarray(jax.device_get(d))
+
+
+def init(rcfg: RaftConfig, key) -> RaftState:
+    """Fresh raft state: everyone a follower at term 0 with a seeded
+    initial election timeout (same per-seat fold ladder as the per-tick
+    draws, so init is part of the shared randomness spec)."""
+    r, p, w = rcfg.groups, rcfg.peers, rcfg.window
+    i32 = jnp.int32
+    return RaftState(
+        term=jnp.zeros((r, p), i32),
+        role=jnp.full((r, p), ROLE_FOLLOWER, i32),
+        voted_for=jnp.full((r, p), -1, i32),
+        leader=jnp.full((r, p), -1, i32),
+        timer=timeout_draws(rcfg, key, 0, r),
+        hb=jnp.zeros((r, p), i32),
+        log_term=jnp.zeros((r, p, w), i32),
+        log_client=jnp.zeros((r, p, w), bool),
+        last_index=jnp.zeros((r, p), i32),
+        commit=jnp.zeros((r, p), i32),
+        match=jnp.zeros((r, p, p), i32),
+        next_seq=jnp.zeros((r,), i32),
+    )
+
+
+# ----------------------------------------------------------------------
+# Chaos masks: raft events -> per-tick liveness/deliverability.
+# ----------------------------------------------------------------------
+
+RK_KILL = 1
+RK_PARTITION = 2
+RK_STORM = 3
+
+
+def chaos_masks(sched, t, role, group_ids):
+    """Evaluate the schedule's raft slots at tick ``t`` down to
+    ``(alive [R, P] bool, deliver [R, P, P] bool)`` where
+    ``deliver[r, i, j]`` means a message j -> i is deliverable this
+    tick. ``role`` is the tick-start role tensor (leader-kill with
+    ``peer=-1`` targets whoever currently leads); ``group_ids`` maps
+    local rows to global group ids (sharded runs pass an offset).
+    ``sched`` None or zero raft slots is a trace-time no-chaos branch
+    (the DCE contract — the raft-chaos-free program is byte-identical
+    to a schedule-free one)."""
+    r_count, p = role.shape
+    if sched is None or sched.rk_kind.shape[0] == 0:
+        alive = jnp.ones((r_count, p), bool)
+        return alive, jnp.ones((r_count, p, p), bool)
+    t = jnp.asarray(t, jnp.int32)
+    pid = jnp.arange(p, dtype=jnp.int32)
+    act = (t >= sched.rk_start) & (t < sched.rk_stop)           # [K]
+    gsel = act[:, None] & ((sched.rk_group[:, None] < 0)
+                           | (sched.rk_group[:, None]
+                              == group_ids[None, :]))           # [K, R]
+    kind = sched.rk_kind
+    arg = sched.rk_arg
+    # Kill: explicit peer id, or -1 = the group's current leader(s).
+    kill_target = jnp.where(
+        arg[:, None, None] < 0,
+        (role == ROLE_LEADER)[None, :, :],
+        arg[:, None, None] == pid[None, None, :])               # [K, R, P]
+    kill = jnp.any(
+        gsel[:, :, None] & (kind == RK_KILL)[:, None, None] & kill_target,
+        axis=0)                                                 # [R, P]
+    # Partition: peers talk iff both sit on the same side of the cut;
+    # Storm: total in-group blackout (the split-vote generator).
+    side = pid[None, :] < arg[:, None]                          # [K, P]
+    cross = side[:, :, None] != side[:, None, :]                # [K, P, P]
+    blocked = jnp.any(
+        gsel[:, :, None, None]
+        & ((kind == RK_PARTITION)[:, None, None, None]
+           & cross[:, None, :, :]
+           | (kind == RK_STORM)[:, None, None, None]),
+        axis=0)                                                 # [R, P, P]
+    alive = ~kill
+    deliver = ~blocked & alive[:, :, None] & alive[:, None, :]
+    return alive, deliver
+
+
+def chaos_masks_reference(events, t: int, role: np.ndarray,
+                          group_ids) -> tuple:
+    """Numpy twin of :func:`chaos_masks` over HOST event entries
+    (chaos/schedule.py RaftKill/RaftPartition/RaftStorm) — the golden
+    pair the oracle replays (the ``apply_writes_reference`` pattern)."""
+    from consul_tpu.chaos import schedule as chaos_mod
+
+    r_count, p = role.shape
+    group_ids = np.asarray(group_ids)
+    kill = np.zeros((r_count, p), bool)
+    blocked = np.zeros((r_count, p, p), bool)
+    for e in events:
+        if not isinstance(e, (chaos_mod.RaftKill, chaos_mod.RaftPartition,
+                              chaos_mod.RaftStorm)):
+            continue
+        if not (e.start <= t < e.stop):
+            continue
+        rows = np.nonzero((group_ids == e.group) if e.group >= 0
+                          else np.ones(r_count, bool))[0]
+        for r in rows:
+            if isinstance(e, chaos_mod.RaftKill):
+                if e.peer >= 0:
+                    kill[r, e.peer] = True
+                else:
+                    kill[r, role[r] == ROLE_LEADER] = True
+            elif isinstance(e, chaos_mod.RaftPartition):
+                for i in range(p):
+                    for j in range(p):
+                        if (i < e.cut) != (j < e.cut):
+                            blocked[r, i, j] = True
+            else:
+                blocked[r, :, :] = True
+    alive = ~kill
+    deliver = (~blocked & alive[:, :, None] & alive[:, None, :])
+    return alive, deliver
+
+
+# ----------------------------------------------------------------------
+# The tick.
+# ----------------------------------------------------------------------
+
+def tick(rcfg: RaftConfig, rst: RaftState, t, tick_key, sched=None,
+         group0=0) -> tuple:
+    """One synchronous raft tick over every group: returns
+    ``(RaftState, RaftCounters)``. ``t`` is the global tick (the SWIM
+    plane's pre-step ``t``), ``tick_key`` the scan's per-tick key.
+    Killed peers are fully frozen — they neither act nor send nor
+    receive — and every update below is a masked full-array write
+    (no ``.at[traced]`` anywhere; the consul-tpu lint walks this file).
+    """
+    p, w = rcfg.peers, rcfg.window
+    r_count = rst.term.shape[0]
+    quorum = rcfg.quorum
+    i32 = jnp.int32
+    pid = jnp.arange(p, dtype=i32)
+    wid = jnp.arange(w, dtype=i32)
+    eye = jnp.eye(p, dtype=bool)
+    group_ids = jnp.asarray(group0, i32) + jnp.arange(r_count, dtype=i32)
+
+    alive, deliver = chaos_masks(sched, t, rst.role, group_ids)
+    draws = timeout_draws(
+        rcfg, jax.random.fold_in(tick_key, _RAFT_SALT), group0, r_count)
+
+    term, role, voted = rst.term, rst.role, rst.voted_for
+    leader, timer, hb = rst.leader, rst.timer, rst.hb
+    log_term, log_client = rst.log_term, rst.log_client
+    last, commit, match = rst.last_index, rst.commit, rst.match
+
+    # -- A: election timers tick down for live non-leaders ------------
+    timer = jnp.where(alive & (role != ROLE_LEADER), timer - 1, timer)
+
+    # -- B: timeout -> candidate (term++, vote self, fresh timeout) ---
+    start = alive & (role != ROLE_LEADER) & (timer <= 0)
+    term = jnp.where(start, term + 1, term)
+    role = jnp.where(start, ROLE_CANDIDATE, role)
+    voted = jnp.where(start, pid[None, :], voted)
+    leader = jnp.where(start, -1, leader)
+    timer = jnp.where(start, draws, timer)
+    c_started = _count(start)
+
+    # -- C: one RequestVote round -------------------------------------
+    # Last-log term via a one-hot select over the static window axis.
+    llt = jnp.sum(jnp.where(wid[None, None, :] == (last - 1)[..., None],
+                            log_term, 0), axis=-1)              # [R, P]
+    cand = (role == ROLE_CANDIDATE) & alive                     # senders j
+    req = cand[:, None, :] & deliver & ~eye[None]               # [R, i, j]
+    # Receivers adopt the max delivered candidate term (> own ->
+    # follower, vote cleared) before judging eligibility.
+    max_rt = jnp.max(jnp.where(req, term[:, None, :], 0), axis=2)
+    adopt = alive & (max_rt > term)
+    term_rx = jnp.where(adopt, max_rt, term)
+    role = jnp.where(adopt, ROLE_FOLLOWER, role)
+    voted = jnp.where(adopt, -1, voted)
+    leader = jnp.where(adopt, -1, leader)
+    c_terms = _count(adopt)
+    # Grant rule: same term, candidate's log up-to-date (§5.4.1), vote
+    # free or already his. voted_for makes at most one j eligible when
+    # set, so first-True argmax is both "re-grant" and "lowest id".
+    up_to_date = (llt[:, None, :] > llt[:, :, None]) | (
+        (llt[:, None, :] == llt[:, :, None])
+        & (last[:, None, :] >= last[:, :, None]))
+    eligible = (req & alive[:, :, None]
+                & (term[:, None, :] == term_rx[:, :, None]) & up_to_date
+                & ((voted[:, :, None] == -1)
+                   | (voted[:, :, None] == pid[None, None, :])))
+    any_el = jnp.any(eligible, axis=2)
+    grant_to = jnp.where(any_el, jnp.argmax(eligible, axis=2).astype(i32),
+                         -1)                                     # [R, i]
+    granted = grant_to >= 0
+    voted = jnp.where(granted, grant_to, voted)
+    timer = jnp.where(granted, draws, timer)
+    c_votes = _count(granted)
+    term = term_rx
+    # Tally: self-vote plus grants whose reply leg (i -> j) delivers.
+    gr = granted[:, :, None] & (grant_to[:, :, None] == pid[None, None, :])
+    votes = jnp.sum((gr & jnp.transpose(deliver, (0, 2, 1))).astype(i32),
+                    axis=1) + 1                                  # [R, j]
+    win = (role == ROLE_CANDIDATE) & alive & (votes >= quorum)
+    role = jnp.where(win, ROLE_LEADER, role)
+    leader = jnp.where(win, pid[None, :], leader)
+    hb = jnp.where(win, 0, hb)                # first heartbeat this tick
+    c_won = _count(win)
+    # Winner appends a no-op barrier entry when the window has room.
+    can_noop = win & (last < w)
+    noop_at = can_noop[..., None] & (wid[None, None, :] == last[..., None])
+    log_term = jnp.where(noop_at, term[..., None], log_term)
+    log_client = jnp.where(noop_at, False, log_client)
+    last = jnp.where(can_noop, last + 1, last)
+    match = jnp.where(win[..., None],
+                      jnp.where(eye[None], last[..., None], 0), match)
+
+    # -- D: leaders append pending client intents ---------------------
+    is_lead = (role == ROLE_LEADER) & alive
+    n_client = jnp.sum((log_client
+                        & (wid[None, None, :] < last[..., None])).astype(i32),
+                       axis=-1)                                  # [R, P]
+    pending = jnp.maximum(rst.next_seq[:, None] - n_client, 0)
+    k_app = jnp.where(is_lead, jnp.minimum(pending, w - last), 0)
+    app_at = ((wid[None, None, :] >= last[..., None])
+              & (wid[None, None, :] < (last + k_app)[..., None]))
+    log_term = jnp.where(app_at, term[..., None], log_term)
+    log_client = jnp.where(app_at, True, log_client)
+    last = last + k_app
+    c_appends = _count(noop_at) + _count(app_at)
+    match = jnp.where(is_lead[..., None] & eye[None],
+                      last[..., None], match)
+
+    # -- E: one AppendEntries round (full-window adoption) ------------
+    hb = jnp.where(is_lead, hb - 1, hb)
+    lag = jnp.any((match < last[..., None]) & ~eye[None], axis=-1)
+    send = is_lead & ((hb <= 0) | lag)
+    hb_fire = send & (hb <= 0)
+    hb = jnp.where(hb_fire, rcfg.heartbeat_ticks, hb)
+    c_hb = _count(hb_fire)
+    c_hb_sup = _count(is_lead & ~send)
+    # Receiver accepts the highest-term delivering leader (lowest id on
+    # the impossible tie — rank-max, ops/deltas._last_writer idiom).
+    app = (send[:, None, :] & deliver & ~eye[None] & alive[:, :, None]
+           & (term[:, None, :] >= term[:, :, None]))            # [R, i, j]
+    score = jnp.where(app, term[:, None, :] * i32(p + 1)
+                      + (i32(p) - pid[None, None, :]), -1)
+    has_src = jnp.max(score, axis=2) >= 0
+    src = jnp.where(has_src, jnp.argmax(score, axis=2).astype(i32), -1)
+    src_c = jnp.maximum(src, 0)
+    src_term = jnp.take_along_axis(term, src_c, axis=1)
+    term_up = has_src & (src_term > term)
+    term = jnp.where(has_src, jnp.maximum(term, src_term), term)
+    voted = jnp.where(term_up, -1, voted)
+    role = jnp.where(has_src, ROLE_FOLLOWER, role)
+    leader = jnp.where(has_src, src, leader)
+    timer = jnp.where(has_src, draws, timer)
+    c_terms = c_terms + _count(term_up)
+    # Wholesale log adoption from the chosen leader (gathers only).
+    src_lt = jnp.take_along_axis(log_term, src_c[..., None], axis=1)
+    src_lc = jnp.take_along_axis(log_client, src_c[..., None], axis=1)
+    src_last = jnp.take_along_axis(last, src_c, axis=1)
+    src_commit = jnp.take_along_axis(commit, src_c, axis=1)
+    log_term = jnp.where(has_src[..., None], src_lt, log_term)
+    log_client = jnp.where(has_src[..., None], src_lc, log_client)
+    last = jnp.where(has_src, src_last, last)
+    commit = jnp.where(
+        has_src,
+        jnp.maximum(commit, jnp.minimum(src_commit, src_last)), commit)
+    # Ack return leg: leader j learns follower i now matches its log.
+    ack = (has_src[:, :, None] & (src[:, :, None] == pid[None, None, :])
+           & jnp.transpose(deliver, (0, 2, 1)))                 # [R, i, j]
+    match = jnp.where(jnp.transpose(ack, (0, 2, 1)),
+                      last[:, :, None], match)
+
+    # -- F: quorum commit (current-term entries only, §5.4.2) ---------
+    still_lead = (role == ROLE_LEADER) & alive
+    repl = jnp.sum(
+        (match[:, :, None, :] >= (wid[None, None, :, None] + 1)).astype(i32),
+        axis=3)                                                 # [R, P, W]
+    ok_w = ((repl >= quorum) & (log_term == term[..., None])
+            & (wid[None, None, :] < last[..., None]))
+    reach = jnp.max(jnp.where(ok_w, wid[None, None, :] + 1, 0), axis=-1)
+    new_commit = jnp.where(still_lead, jnp.maximum(commit, reach), commit)
+    c_commit = _count(still_lead & (new_commit > commit))
+    commit = new_commit
+
+    out = RaftState(term=term, role=role, voted_for=voted, leader=leader,
+                    timer=timer, hb=hb, log_term=log_term,
+                    log_client=log_client, last_index=last, commit=commit,
+                    match=match, next_seq=rst.next_seq)
+    cnt = RaftCounters(
+        elections_started=c_started, elections_won=c_won,
+        term_changes=c_terms, commit_advances=c_commit,
+        heartbeats_sent=c_hb, heartbeats_suppressed=c_hb_sup,
+        entries_appended=c_appends, votes_granted=c_votes)
+    return out, cnt
+
+
+# ----------------------------------------------------------------------
+# Host-facing summaries (one small fetch per pump).
+# ----------------------------------------------------------------------
+
+def summary(rst: RaftState) -> tuple:
+    """Per-group ``(term [R], leader [R], commit [R],
+    committed_clients [R])`` — max term, highest-term live leader id
+    (-1 when none), max committed prefix, and the number of CLIENT
+    entries inside any peer's committed prefix. The last is the commit
+    frontier RaftPlane.pump maps back to proposal tickets: committed
+    prefixes are stable, so client entry k is always proposal k."""
+    r_count, p, w = rst.log_term.shape
+    i32 = jnp.int32
+    pid = jnp.arange(p, dtype=i32)
+    wid = jnp.arange(w, dtype=i32)
+    term_g = jnp.max(rst.term, axis=1)
+    score = jnp.where(rst.role == ROLE_LEADER,
+                      rst.term * i32(p + 1) + (i32(p) - pid[None, :]), -1)
+    leader_g = jnp.where(jnp.max(score, axis=1) >= 0,
+                         jnp.argmax(score, axis=1).astype(i32), -1)
+    commit_g = jnp.max(rst.commit, axis=1)
+    cc = jnp.sum((rst.log_client
+                  & (wid[None, None, :] < rst.commit[..., None])).astype(i32),
+                 axis=-1)
+    return term_g, leader_g, commit_g, jnp.max(cc, axis=1)

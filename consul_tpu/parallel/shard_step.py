@@ -195,7 +195,7 @@ def make_sharded_chunk_runner(cfg: SimConfig, topo: Topology, mesh: Mesh,
                               chunk: int, with_metrics: bool, *,
                               step_fn, swim_of,
                               chaos: bool = False, sentinel: bool = False,
-                              layout: str = "dense"):
+                              layout: str = "dense", raft=None):
     """The multi-chip analogue of models/cluster.py ``_chunk_runner``:
     one jitted program per (cfg, topo content, chunk, metrics, step,
     chaos shape, sentinel, MESH) signature with the same call convention
@@ -226,7 +226,21 @@ def make_sharded_chunk_runner(cfg: SimConfig, topo: Topology, mesh: Mesh,
     elementwise, so they shard over the node axis like any other local
     math and the discrete protocol plane stays bit-identical to the
     dense runner (tests/test_layout_parity.py covers the sharded
-    pairing)."""
+    pairing).
+
+    ``raft`` (a config.RaftConfig, None = off) threads the batched raft
+    tier through the scan exactly like the single-device runner: the
+    state slot becomes the ``(model_state, RaftState)`` pair and the
+    counters the ``(GossipCounters, RaftCounters)`` pair. Sharding rule:
+    when ``groups`` divides over the mesh, raft leaves shard on their
+    leading group axis and each shard steps its own block with
+    ``group0 = shard_index * groups_local`` — the PRNG ladder keys on
+    GLOBAL seat ids (raft_ops.timeout_draws), so sharded trajectories
+    are bit-identical to single-device ones and the counter psum sums
+    disjoint per-shard tallies. Otherwise the raft leaves replicate
+    (every shard steps all groups identically) and the replicated
+    tallies are zeroed off shard 0 before the psum so globals are not
+    multiplied by the shard count."""
     from consul_tpu.models import layout as layout_mod
     from consul_tpu.models.cluster import TickTrace  # deferred: no cycle
     from consul_tpu.utils import metrics
@@ -238,50 +252,105 @@ def make_sharded_chunk_runner(cfg: SimConfig, topo: Topology, mesh: Mesh,
 
     world_spec = World(pos=P(axis, None), height=P(axis))
     cnt_specs = jax.tree.map(lambda _: P(), counters_mod.zeros())
+    if raft is not None:
+        from consul_tpu.ops import raft_ops
+
+        raft_sharded = raft.groups % n_shards == 0
+        r_local = raft.groups // n_shards if raft_sharded else raft.groups
+        raft_spec = (
+            (lambda l: P(axis, *([None] * (l.ndim - 1))))
+            if raft_sharded else (lambda l: P()))
+        rcnt_specs = jax.tree.map(lambda _: P(), raft_ops.counters_zeros())
 
     def local_run(world_l, sched_l, state_l, base_key):
+        if raft is not None:
+            state_l, rst_l = state_l
+            group0 = (jax.lax.axis_index(axis).astype(jnp.int32) * r_local
+                      if raft_sharded else jnp.int32(0))
         ticks = swim_of(state_l).t + jnp.arange(chunk, dtype=jnp.int32)
         tick_keys = jax.vmap(
             lambda t: jax.random.fold_in(base_key, t))(ticks)
 
         def body(carry, tick_key):
-            state, cnt = carry
+            if raft is not None:
+                (state, rst), (cnt, rcnt) = carry
+            else:
+                state, cnt = carry
             if packed:
                 state = layout_mod.unpack_state(state)
+            if raft is not None:
+                # Keyed on the PRE-step tick — the t this tick_key was
+                # folded from — matching the single-device runner and
+                # the lockstep oracle's step(t).
+                t_pre = swim_of(state).t
             with coll.node_axis(axis, n_shards, cfg.n):
                 state, c = step_fn(cfg, topo, world_l, state, tick_key,
                                    sched_l, sentinel=sentinel)
             if packed:
                 state = layout_mod.pack_state(state)
-            return (state, counters_mod.add(cnt, c)), ()
+            cnt = counters_mod.add(cnt, c)
+            if raft is not None:
+                rst, rc = raft_ops.tick(raft, rst, t_pre, tick_key,
+                                        sched=sched_l, group0=group0)
+                return ((state, rst),
+                        (cnt, raft_ops.counters_add(rcnt, rc))), ()
+            return (state, cnt), ()
 
-        (state_l, cnt), _ = jax.lax.scan(
-            body, (state_l, counters_mod.zeros()), tick_keys)
+        if raft is not None:
+            carry0 = ((state_l, rst_l),
+                      (counters_mod.zeros(), raft_ops.counters_zeros()))
+        else:
+            carry0 = (state_l, counters_mod.zeros())
+        (state_l, cnt), _ = jax.lax.scan(body, carry0, tick_keys)
+        if raft is not None:
+            (state_l, rst_l), (cnt, rcnt) = state_l, cnt
         with coll.node_axis(axis, n_shards, cfg.n):
             red = coll.tree_psum(jnp.stack(list(cnt)))
-        return state_l, counters_mod.unstack(red)
+            if raft is not None:
+                rvec = raft_ops.counters_stack(rcnt)
+                if not raft_sharded:
+                    # Replicated compute: every shard tallied the SAME
+                    # global events — keep shard 0's copy only so the
+                    # psum is a broadcast, not a multiply.
+                    idx = jax.lax.axis_index(axis).astype(jnp.int32)
+                    rvec = jnp.where(idx == 0, rvec, jnp.zeros_like(rvec))
+                rred = coll.tree_psum(rvec)
+        gcnt = counters_mod.unstack(red)
+        if raft is not None:
+            return ((state_l, rst_l),
+                    (gcnt, raft_ops.counters_unstack(rred)))
+        return state_l, gcnt
 
     def run(world, sched, state, base_key):
-        specs = jax.tree.map(lambda l: node_spec(l, cfg.n, axis), state)
+        if raft is not None:
+            model_state, rst = state
+            specs = (jax.tree.map(lambda l: node_spec(l, cfg.n, axis),
+                                  model_state),
+                     jax.tree.map(raft_spec, rst))
+            out_cnt_specs = (cnt_specs, rcnt_specs)
+        else:
+            specs = jax.tree.map(lambda l: node_spec(l, cfg.n, axis),
+                                 state)
+            out_cnt_specs = cnt_specs
         if chaos:
             sched_specs = jax.tree.map(
                 lambda l: node_spec(l, cfg.n, axis), sched)
             inner = shard_map(
                 local_run, mesh=mesh,
                 in_specs=(world_spec, sched_specs, specs, P()),
-                out_specs=(specs, cnt_specs), check_vma=False,
+                out_specs=(specs, out_cnt_specs), check_vma=False,
             )
             state, cnt = inner(world, sched, state, base_key)
         else:
             inner = shard_map(
                 lambda w, st, k: local_run(w, None, st, k), mesh=mesh,
                 in_specs=(world_spec, specs, P()),
-                out_specs=(specs, cnt_specs), check_vma=False,
+                out_specs=(specs, out_cnt_specs), check_vma=False,
             )
             state, cnt = inner(world, state, base_key)
         if not with_metrics:
             return state, cnt, ()
-        sw = swim_of(state)
+        sw = swim_of(state[0] if raft is not None else state)
         if packed:
             sw = layout_mod.unpack(sw)
         h = metrics.health(cfg, topo, sw)

@@ -130,6 +130,24 @@ def _scenario_meta(sim, tag: str, ticks: int, t0: int, done: int,
         # checkpoint records which writes its reads had seen. None when
         # no write-attached plane rides the sim.
         "serving_apply_index": _serving_apply_index(sim),
+        # Raft-tier provenance (also not matched): per-group commit
+        # frontier at save time when the batched raft tier is armed —
+        # which quorum-committed prefix this checkpoint's write plane
+        # reflects. None when raft is off.
+        "raft": _raft_meta(sim),
+    }
+
+
+def _raft_meta(sim):
+    plane = getattr(sim, "raft", None)
+    if plane is None:
+        return None
+    s = plane.summary()
+    return {
+        "groups": plane.rcfg.groups,
+        "peers": plane.rcfg.peers,
+        "terms": s["terms"],
+        "commit": s["commit"],
     }
 
 

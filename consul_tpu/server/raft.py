@@ -831,3 +831,273 @@ class RaftCluster:
             if led.commit_index >= idx:
                 return idx
         raise TimeoutError(f"entry {idx} not committed")
+
+
+# ----------------------------------------------------------------------
+# Lockstep oracle for the device raft tier (models/raft.py).
+# ----------------------------------------------------------------------
+
+class LockstepRaftOracle:
+    """Golden replay of ``ops/raft_ops.tick`` as plain-Python scalar
+    loops over concrete ints — deliberately NOT the dense tensor
+    expressions, so a vectorization bug in the device kernel cannot
+    hide in a shared implementation. Shares only the randomness spec
+    (``raft_ops.draw_table`` — the per-seat timeout fold ladder) and
+    the chaos semantics (``raft_ops.chaos_masks_reference``); everything
+    else is the six tick sub-phases written the way hashicorp/raft's
+    runFollower/runCandidate/runLeader read, one peer at a time.
+
+    The parity contract (tests/test_raft_device.py) is exact equality
+    of the FULL state arrays after every tick — terms, roles, votes,
+    leader views, timers, logs, commit indexes, even the leader-side
+    ``match`` matrix — for single-device and sharded runs alike.
+    """
+
+    FIELDS = ("elections_started", "elections_won", "term_changes",
+              "commit_advances", "heartbeats_sent",
+              "heartbeats_suppressed", "entries_appended",
+              "votes_granted")
+
+    FOLLOWER_ROLE = 0
+    CANDIDATE_ROLE = 1
+    LEADER_ROLE = 2
+
+    def __init__(self, rcfg, base_key, init_key, events=(),
+                 group0: int = 0):
+        import jax as _jax
+        import numpy as _np
+
+        from consul_tpu.ops import raft_ops as _rops
+
+        self.rcfg = rcfg
+        self.base_key = base_key
+        self.events = tuple(events)
+        self.group0 = int(group0)
+        self._rops = _rops
+        r, p, w = rcfg.groups, rcfg.peers, rcfg.window
+        self.group_ids = _np.arange(r, dtype=_np.int64) + self.group0
+        self.term = _np.zeros((r, p), _np.int64)
+        self.role = _np.zeros((r, p), _np.int64)
+        self.voted = _np.full((r, p), -1, _np.int64)
+        self.leader = _np.full((r, p), -1, _np.int64)
+        self.timer = _np.asarray(_jax.device_get(_rops.timeout_draws(
+            rcfg, init_key, self.group0, r))).astype(_np.int64)
+        self.hb = _np.zeros((r, p), _np.int64)
+        self.log_term = _np.zeros((r, p, w), _np.int64)
+        self.log_client = _np.zeros((r, p, w), bool)
+        self.last = _np.zeros((r, p), _np.int64)
+        self.commit = _np.zeros((r, p), _np.int64)
+        self.match = _np.zeros((r, p, p), _np.int64)
+        self.next_seq = [0] * r
+        self.cnt = {f: 0 for f in self.FIELDS}
+
+    def bump(self, group: int, k: int = 1) -> int:
+        """Mirror RaftPlane.propose's intent bump; returns the 1-based
+        client sequence the k-th new proposal will commit as."""
+        self.next_seq[group] += int(k)
+        return self.next_seq[group]
+
+    def step(self, t: int) -> None:
+        draws = self._rops.draw_table(
+            self.rcfg, self.base_key, int(t), self.group0,
+            self.rcfg.groups).astype("int64")
+        alive, deliver = self._rops.chaos_masks_reference(
+            self.events, int(t), self.role.copy(), self.group_ids)
+        for r in range(self.rcfg.groups):
+            self._step_group(r, draws[r], alive[r], deliver[r])
+
+    def run(self, ticks) -> None:
+        for t in ticks:
+            self.step(int(t))
+
+    # -- one group, one tick, scalar style -----------------------------
+    def _step_group(self, r, draws, alive, deliver):
+        cfg = self.rcfg
+        peers, w_cap, quorum = cfg.peers, cfg.window, cfg.quorum
+        fol, cand_r, led_r = (self.FOLLOWER_ROLE, self.CANDIDATE_ROLE,
+                              self.LEADER_ROLE)
+        term, role = self.term[r], self.role[r]
+        voted, lead = self.voted[r], self.leader[r]
+        timer, hb = self.timer[r], self.hb[r]
+        lt, lc = self.log_term[r], self.log_client[r]
+        last, com, match = self.last[r], self.commit[r], self.match[r]
+        cnt = self.cnt
+
+        # A: timers run for live non-leaders.
+        for p in range(peers):
+            if alive[p] and role[p] != led_r:
+                timer[p] -= 1
+
+        # B: expiry -> candidate.
+        for p in range(peers):
+            if alive[p] and role[p] != led_r and timer[p] <= 0:
+                term[p] += 1
+                role[p] = cand_r
+                voted[p] = p
+                lead[p] = -1
+                timer[p] = draws[p]
+                cnt["elections_started"] += 1
+
+        # C: one RequestVote round.
+        llt = [int(lt[p][last[p] - 1]) if last[p] > 0 else 0
+               for p in range(peers)]
+        s_term = term.copy()  # senders' post-B terms
+        s_last = last.copy()
+        req = [[bool(role[j] == cand_r and alive[j] and deliver[i][j]
+                     and i != j) for j in range(peers)]
+               for i in range(peers)]
+        term_rx = term.copy()
+        for i in range(peers):
+            mx = max((int(s_term[j]) for j in range(peers) if req[i][j]),
+                     default=0)
+            if alive[i] and mx > term[i]:
+                term_rx[i] = mx
+                role[i] = fol
+                voted[i] = -1
+                lead[i] = -1
+                cnt["term_changes"] += 1
+        grant_to = [-1] * peers
+        for i in range(peers):
+            for j in range(peers):
+                up_to_date = (llt[j] > llt[i]
+                              or (llt[j] == llt[i]
+                                  and s_last[j] >= s_last[i]))
+                if (req[i][j] and alive[i] and s_term[j] == term_rx[i]
+                        and up_to_date and voted[i] in (-1, j)):
+                    grant_to[i] = j
+                    break
+            if grant_to[i] >= 0:
+                voted[i] = grant_to[i]
+                timer[i] = draws[i]
+                cnt["votes_granted"] += 1
+        term[:] = term_rx
+        votes = [1] * peers
+        for j in range(peers):
+            for i in range(peers):
+                if grant_to[i] == j and deliver[j][i]:
+                    votes[j] += 1
+        for j in range(peers):
+            if role[j] == cand_r and alive[j] and votes[j] >= quorum:
+                role[j] = led_r
+                lead[j] = j
+                hb[j] = 0
+                cnt["elections_won"] += 1
+                if last[j] < w_cap:
+                    lt[j][last[j]] = term[j]
+                    lc[j][last[j]] = False
+                    last[j] += 1
+                    cnt["entries_appended"] += 1
+                match[j][:] = 0
+                match[j][j] = last[j]
+
+        # D: leaders append pending client intents.
+        for p in range(peers):
+            if role[p] == led_r and alive[p]:
+                n_client = sum(1 for w in range(last[p]) if lc[p][w])
+                k = min(max(self.next_seq[r] - n_client, 0),
+                        w_cap - int(last[p]))
+                for _ in range(k):
+                    lt[p][last[p]] = term[p]
+                    lc[p][last[p]] = True
+                    last[p] += 1
+                    cnt["entries_appended"] += 1
+                match[p][p] = last[p]
+
+        # E: one AppendEntries round, full-window adoption.
+        send = [False] * peers
+        for p in range(peers):
+            if role[p] == led_r and alive[p]:
+                hb[p] -= 1
+                lag = any(match[p][i] < last[p]
+                          for i in range(peers) if i != p)
+                send[p] = bool(hb[p] <= 0 or lag)
+                if send[p] and hb[p] <= 0:
+                    hb[p] = cfg.heartbeat_ticks
+                    cnt["heartbeats_sent"] += 1
+                if not send[p]:
+                    cnt["heartbeats_suppressed"] += 1
+        e_term, e_lt = term.copy(), lt.copy()
+        e_lc, e_last, e_com = lc.copy(), last.copy(), com.copy()
+        src = [-1] * peers
+        for i in range(peers):
+            best, best_score = -1, -1
+            for j in range(peers):
+                if (j != i and send[j] and deliver[i][j] and alive[i]
+                        and e_term[j] >= e_term[i]):
+                    score = int(e_term[j]) * (peers + 1) + (peers - j)
+                    if score > best_score:
+                        best, best_score = j, score
+            src[i] = best
+        for i in range(peers):
+            j = src[i]
+            if j < 0:
+                continue
+            if e_term[j] > term[i]:
+                voted[i] = -1
+                cnt["term_changes"] += 1
+            term[i] = max(int(term[i]), int(e_term[j]))
+            role[i] = fol
+            lead[i] = j
+            timer[i] = draws[i]
+            lt[i][:] = e_lt[j]
+            lc[i][:] = e_lc[j]
+            last[i] = e_last[j]
+            com[i] = max(int(com[i]), min(int(e_com[j]), int(e_last[j])))
+        # Ack return leg: the device writes the leader's POST-adoption
+        # length (a same-tick deposed leader's row goes stale — harmless,
+        # rows are re-zeroed on election — but parity is exact equality).
+        for i in range(peers):
+            j = src[i]
+            if j >= 0 and deliver[j][i]:
+                match[j][i] = last[j]
+
+        # F: quorum commit, current-term entries only.
+        for p in range(peers):
+            if role[p] == led_r and alive[p]:
+                best = 0
+                for w in range(w_cap):
+                    repl = sum(1 for i in range(peers)
+                               if match[p][i] >= w + 1)
+                    if (repl >= quorum and lt[p][w] == term[p]
+                            and w < last[p]):
+                        best = w + 1
+                if best > com[p]:
+                    com[p] = best
+                    cnt["commit_advances"] += 1
+
+    # -- comparison views ----------------------------------------------
+    def snapshot(self) -> dict:
+        import numpy as _np
+
+        return {
+            "term": self.term.copy(), "role": self.role.copy(),
+            "voted_for": self.voted.copy(), "leader": self.leader.copy(),
+            "timer": self.timer.copy(), "hb": self.hb.copy(),
+            "log_term": self.log_term.copy(),
+            "log_client": self.log_client.copy(),
+            "last_index": self.last.copy(), "commit": self.commit.copy(),
+            "match": self.match.copy(),
+            "next_seq": _np.asarray(self.next_seq, _np.int64),
+        }
+
+    def summary(self):
+        """(term, leader, commit, committed_clients) per group — the
+        device ``raft_ops.summary`` quadruple."""
+        r_n, peers = self.term.shape
+        terms, leaders, commits, clients = [], [], [], []
+        for r in range(r_n):
+            terms.append(int(self.term[r].max()))
+            best, best_score = -1, -1
+            for p in range(peers):
+                if self.role[r][p] == self.LEADER_ROLE:
+                    score = (int(self.term[r][p]) * (peers + 1)
+                             + (peers - p))
+                    if score > best_score:
+                        best, best_score = p, score
+            leaders.append(best)
+            commits.append(int(self.commit[r].max()))
+            clients.append(max(
+                sum(1 for w in range(int(self.commit[r][p]))
+                    if self.log_client[r][p][w])
+                for p in range(peers)))
+        return terms, leaders, commits, clients

@@ -243,6 +243,18 @@ class ServingPlane:
         return self.write_state is not None
 
     @property
+    def raft_gate(self):
+        """The attached sim's RaftPlane when its raft tier is armed
+        (``Simulation.set_raft``) and the write path is up — the
+        WriteBatcher then stages batches as raft proposals and the
+        commit pump applies them at quorum commit (serving/writes.py
+        ``_run_batch``). None routes writes straight to the apply
+        kernel, the pre-raft behavior byte for byte."""
+        if self._sim is None or self.write_state is None:
+            return None
+        return getattr(self._sim, "raft", None)
+
+    @property
     def apply_index(self) -> int:
         """The device apply index the CURRENT flip is consistent as of
         (0 before the first write-attached flip) — what the HTTP tier

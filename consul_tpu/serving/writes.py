@@ -146,6 +146,20 @@ class WriteBatcher:
 
     def _run_batch(self, ops: Sequence[tuple[int, int, int]]
                    ) -> list[WriteResult]:
+        # With the raft tier armed (models/raft.py), the batch becomes
+        # a proposal instead of an immediate apply: the gate stages it
+        # on a raft group, returns provisional ``proposed`` results,
+        # and the commit pump calls back into ``_apply_batch`` ONLY
+        # once a quorum holds the entries — so the apply index
+        # (X-Consul-Index) moves strictly at quorum commit and an
+        # acknowledged index survives leader loss by construction.
+        gate = getattr(self.plane, "raft_gate", None)
+        if gate is not None:
+            return gate.stage(self, ops)
+        return self._apply_batch(ops)
+
+    def _apply_batch(self, ops: Sequence[tuple[int, int, int]]
+                     ) -> list[WriteResult]:
         import jax
 
         t0 = time.perf_counter()

@@ -55,20 +55,26 @@ def prewarm_simulation(sim, chunk: int, with_metrics: bool) -> None:
     """AOT-compile one chunk-runner signature for ``sim`` exactly as
     ``Simulation.run(ticks, chunk, with_metrics)`` would bind it —
     same memoized program (models/cluster._chunk_runner), same mesh,
-    same chaos shape — without advancing any state."""
+    same chaos shape, same raft arming — without advancing any
+    state. With the raft tier armed the donated state aval is the
+    ``(model_state, RaftState)`` pair the runner binds."""
     from consul_tpu.chaos import schedule as chaos_mod
     from consul_tpu.models import cluster
 
+    raft_cfg = getattr(sim, "_raft_cfg", None)
     jitted = cluster._chunk_runner(
         sim.cfg, sim.topo, chunk, with_metrics,
         step_fn=type(sim)._step_fn, swim_of=type(sim)._swim_of,
         chaos_key=chaos_mod.static_key_of(sim.chaos),
         sentinel=sim.sentinel, mesh=sim.mesh,
         layout=getattr(sim, "layout", "dense"),
+        raft=raft_cfg,
     )
+    state_aval = (_abstract(sim.state) if raft_cfg is None
+                  else (_abstract(sim.state), _abstract(sim.raft.state)))
     jitted.lower(
         _abstract(sim.world), _abstract(sim.chaos),
-        _abstract(sim.state), _abstract(sim.base_key),
+        state_aval, _abstract(sim.base_key),
     ).compile()
 
 
@@ -86,7 +92,8 @@ def prewarm(ns: Sequence[int], kinds: Sequence[str] = ("swim",),
             sentinel: bool = False, cache_dir: Optional[str] = None,
             layout: str = "dense", family: str = "circulant",
             family_param: float = 0.0, sweep: int = 0,
-            sweep_chunk: int = 32) -> dict:
+            sweep_chunk: int = 32, raft_groups: int = 0,
+            raft_peers: int = 5) -> dict:
     """Compile every (n, kind, chunk, mesh-shape, chaos-shape, layout)
     signature into the persistent compile cache and return a JSON-ready
     summary: the signatures compiled, cache hit/miss movement, and wall
@@ -102,7 +109,9 @@ def prewarm(ns: Sequence[int], kinds: Sequence[str] = ("swim",),
     in COVERAGE.md). ``sweep=S`` additionally compiles the S-scenario
     vmapped sweep program (chaos/sweep.py) at ``sweep_chunk`` — that
     one is topology-as-argument, so a single family warms every family
-    of the same shape.
+    of the same shape. ``raft_groups=R`` (with ``raft_peers``) arms the
+    batched raft tier before compiling, warming the raft-carrying
+    program a ``consul-tpu run --raft-groups R`` binds.
     """
     from consul_tpu import chaos as chaos_api
     from consul_tpu.config import SimConfig, clamp_view_degree
@@ -129,6 +138,8 @@ def prewarm(ns: Sequence[int], kinds: Sequence[str] = ("swim",),
                             topo_family=family, topo_param=family_param)
             sim = classes[kind](cfg, seed=seed, sentinel=sentinel, mesh=m,
                                 layout=layout)
+            if raft_groups > 0:
+                sim.set_raft(raft_groups, peers=raft_peers)
             schedules = [None]
             if chaos:
                 schedules.append([chaos_api.Partition(
@@ -146,6 +157,7 @@ def prewarm(ns: Sequence[int], kinds: Sequence[str] = ("swim",),
                             "chaos": sched is not None,
                             "layout": layout,
                             "family": family,
+                            "raft_groups": int(raft_groups),
                             "wall_s": round(time.perf_counter() - t0, 3),
                         })
             if sweep > 0:

@@ -87,7 +87,9 @@ class TestScheduleCompile:
         assert chaos.static_key_of(None) is None
         s = _sched(64)
         assert not chaos.is_empty(s)
-        assert chaos.static_key_of(s) == ("chaos", 1, 1, 1, 1)
+        # Five slot families since the raft tier landed: partitions,
+        # link-loss, churn, degrade, raft events.
+        assert chaos.static_key_of(s) == ("chaos", 1, 1, 1, 1, 0)
 
     def test_same_shape_same_key(self):
         a = chaos.compile_schedule(64, [chaos.Partition(1, 5, [0, 1])])

@@ -13,6 +13,7 @@ import pathlib
 import re
 
 from consul_tpu.models import counters as counters_mod
+from consul_tpu.ops import raft_ops
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 EMIT_RE = re.compile(
@@ -32,6 +33,8 @@ def _emitted_names():
                 out.append((name, f"{p.relative_to(ROOT)}"))
     for field, name in sorted(counters_mod.METRIC_NAMES.items()):
         out.append((name, f"counters.METRIC_NAMES[{field!r}]"))
+    for field, name in sorted(raft_ops.METRIC_NAMES.items()):
+        out.append((name, f"raft_ops.METRIC_NAMES[{field!r}]"))
     return out
 
 
@@ -44,6 +47,7 @@ def test_all_emitted_names_are_extracted():
     assert "consul.leader.reconcile" in names
     assert "consul.http" in names            # f-string prefix
     assert "memberlist.udp.sent" in names    # via METRIC_NAMES
+    assert "consul.raft.commit.advances" in names  # device raft tier
     assert len(names) >= 35
 
 

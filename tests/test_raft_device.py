@@ -1,0 +1,366 @@
+"""Golden-parity + integration suite for the device raft tier.
+
+The batched multi-group consensus plane (ops/raft_ops.py, [R, P]
+term/role/log tensors stepped inside the jitted chunk scan) is pinned
+EXACTLY against the lockstep host oracle (server/raft.py
+LockstepRaftOracle): every RaftState field at every chunk boundary,
+single-device AND sharded, quiet and under fault schedules — the
+apply_writes_reference discipline applied to consensus. On top of the
+parity pins: the set_raft DCE/compile-ledger contract, the counter →
+Sink fold, the lens raft field group, prewarm + sweep integration, the
+write-path commit gate, and the slow leader-kill durability drill
+(an acknowledged X-Consul-Index survives leader loss by construction).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from consul_tpu.chaos import schedule as chaos_mod
+from consul_tpu.config import RaftConfig, SimConfig
+from consul_tpu.models import raft as raft_mod
+from consul_tpu.models.cluster import Simulation
+from consul_tpu.ops import raft_ops
+from consul_tpu.server.raft import LockstepRaftOracle
+
+
+def _mk_sim(n=48, seed=7, mesh=None):
+    sim = Simulation(SimConfig(n=n, view_degree=12), seed=seed)
+    if mesh is not None:
+        sim.set_mesh(mesh)
+    return sim
+
+
+def _rcfg(groups=2, peers=3, window=16):
+    # Short timeouts so elections resolve inside small test windows.
+    return RaftConfig(groups=groups, peers=peers, window=window,
+                      election_ticks_min=6, election_ticks_max=12)
+
+
+def _oracle_for(sim, rcfg, events=(), group0=0):
+    return LockstepRaftOracle(rcfg, sim.base_key,
+                              raft_mod.init_key_of(sim),
+                              events=events, group0=group0)
+
+
+def _assert_state_equal(rst, oracle, where=""):
+    """Every RaftState field, bit-for-bit against the oracle arrays."""
+    got = jax.device_get(rst)
+    want = oracle.snapshot()
+    for f in raft_ops.RaftState._fields:
+        g = np.asarray(getattr(got, f))
+        w = np.asarray(want[f])
+        assert np.array_equal(g.astype(np.int64), w.astype(np.int64)), (
+            f"{where}: RaftState.{f} diverged from oracle:\n"
+            f"device={g}\noracle={w}")
+
+
+class TestOracleParity:
+    """Device trajectory == host oracle trajectory, field by field."""
+
+    def test_single_device_chunked_trajectory(self):
+        sim = _mk_sim()
+        rcfg = _rcfg()
+        plane = sim.set_raft(rcfg)
+        oracle = _oracle_for(sim, rcfg)
+        t = 0
+        for i, chunk in enumerate([5, 7, 9, 11]):
+            if i == 1:  # proposals mid-trajectory, mirrored as bumps
+                plane.propose([(0, 1, 5)], group=0)
+                plane.propose([(0, 2, 6), (0, 3, 7)], group=1)
+                oracle.bump(0, 1)
+                oracle.bump(1, 2)
+            sim.run(chunk, chunk=chunk, with_metrics=False)
+            oracle.run(range(t, t + chunk))
+            t += chunk
+            _assert_state_equal(plane.state, oracle, f"after chunk {i}")
+        # The quadruple summary and the counter tallies agree too.
+        s = plane.summary()
+        os_ = oracle.summary()
+        assert s["terms"] == list(os_[0])
+        assert s["leaders"] == list(os_[1])
+        assert s["commit"] == list(os_[2])
+        assert s["committed_clients"] == list(os_[3])
+        assert plane.counters_snapshot() == oracle.cnt
+        # Something actually happened: elections resolved and the
+        # proposed client entries quorum-committed.
+        assert all(ld >= 0 for ld in s["leaders"])
+        assert s["committed_clients"] == [1, 2]
+
+    def test_chaos_schedule_parity(self):
+        """Leader kill + minority cut + split-vote storm windows,
+        device masks vs the oracle's reference masks."""
+        sim = _mk_sim(seed=11)
+        rcfg = _rcfg()
+        events = [
+            chaos_mod.RaftKill(start=14, stop=26, group=0, peer=-1),
+            chaos_mod.RaftPartition(start=18, stop=30, cut=1, group=1),
+            chaos_mod.RaftStorm(start=34, stop=44, group=-1),
+        ]
+        plane = sim.set_raft(rcfg)
+        sim.set_chaos(events)
+        oracle = _oracle_for(sim, rcfg, events=events)
+        t = 0
+        for chunk in (12, 12, 12, 12):
+            sim.run(chunk, chunk=chunk, with_metrics=False)
+            oracle.run(range(t, t + chunk))
+            t += chunk
+            _assert_state_equal(plane.state, oracle, f"tick {t}")
+        assert plane.counters_snapshot() == oracle.cnt
+        # The kill window deposed group 0's first leader: its term
+        # moved past the first election's.
+        assert plane.summary()["terms"][0] >= 2
+
+
+class TestShardedParity:
+    """The mesh path is bit-identical to single-device — for the
+    group-sharded layout (R % shards == 0) AND the replicated
+    fallback."""
+
+    @pytest.mark.parametrize("groups", [8, 3])
+    def test_mesh_matches_single_device(self, groups):
+        from consul_tpu.parallel import mesh as pmesh
+
+        rcfg = RaftConfig(groups=groups, peers=5, window=16,
+                          election_ticks_min=6, election_ticks_max=12)
+
+        def traj(mesh):
+            sim = _mk_sim(n=64, seed=7, mesh=mesh)
+            plane = sim.set_raft(rcfg)
+            states = []
+            for i in range(3):
+                if i == 1:
+                    plane.propose([(0, 1, 5)], group=0)
+                    plane.propose([(0, 2, 6)], group=groups - 1)
+                sim.run(12, chunk=12, with_metrics=False)
+                states.append(jax.device_get(plane.state))
+            return states, plane.counters_snapshot(), plane.summary()
+
+        s1, c1, sum1 = traj(None)
+        s8, c8, sum8 = traj(pmesh.make_mesh(jax.devices()))
+        for k, (a, b) in enumerate(zip(s1, s8)):
+            for f in raft_ops.RaftState._fields:
+                av, bv = np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+                assert np.array_equal(av, bv), (groups, k, f)
+        assert c1 == c8
+        assert sum1 == sum8
+
+    def test_sharded_matches_oracle(self):
+        """The mesh trajectory also pins against the host oracle
+        directly (not just against the single-device run)."""
+        from consul_tpu.parallel import mesh as pmesh
+
+        rcfg = RaftConfig(groups=8, peers=3, window=16,
+                          election_ticks_min=6, election_ticks_max=12)
+        sim = _mk_sim(n=64, seed=3, mesh=pmesh.make_mesh(jax.devices()))
+        plane = sim.set_raft(rcfg)
+        oracle = _oracle_for(sim, rcfg)
+        sim.run(24, chunk=12, with_metrics=False)
+        oracle.run(range(24))
+        _assert_state_equal(plane.state, oracle, "sharded vs oracle")
+        assert plane.counters_snapshot() == oracle.cnt
+
+
+class TestCompileContract:
+    """set_raft follows the set_sentinel/set_lens DCE discipline."""
+
+    def test_toggle_never_recompiles(self, compile_ledger):
+        sim = _mk_sim(n=32)
+        sim.run(8, chunk=8, with_metrics=False)  # warm the base program
+        with compile_ledger.expect(
+                1, "arming raft compiles exactly one new chunk program"):
+            sim.set_raft(2, peers=3, window=16,
+                         election_ticks_min=6, election_ticks_max=12)
+            sim.run(8, chunk=8, with_metrics=False)
+        with compile_ledger.expect(
+                0, "raft off DCEs back to the memoized pre-raft program"):
+            sim.set_raft(None)
+            sim.run(8, chunk=8, with_metrics=False)
+        with compile_ledger.expect(
+                0, "re-arming the same shape reuses the memoized program"):
+            sim.set_raft(2, peers=3, window=16,
+                         election_ticks_min=6, election_ticks_max=12)
+            sim.run(8, chunk=8, with_metrics=False)
+
+    def test_prewarm_covers_raft_signature(self, compile_ledger):
+        from consul_tpu.utils import prewarm as prewarm_mod
+
+        sim = _mk_sim(n=32)
+        sim.set_raft(2, peers=3, window=16,
+                     election_ticks_min=6, election_ticks_max=12)
+        prewarm_mod.prewarm_simulation(sim, chunk=8, with_metrics=False)
+        with compile_ledger.expect(
+                0, "a prewarmed raft run must not compile"):
+            sim.run(8, chunk=8, with_metrics=False)
+
+
+class TestTelemetryAndLens:
+    def test_counters_reach_sink_under_consul_raft_names(self):
+        sim = _mk_sim()
+        plane = sim.set_raft(_rcfg())
+        sim.run(24, chunk=12, with_metrics=False)
+        snap = plane.counters_snapshot()
+        assert snap["elections_started"] >= 1
+        assert snap["elections_won"] >= 1
+        for field, name in raft_ops.METRIC_NAMES.items():
+            assert sim.sink.counter_sum(name) == snap[field], (field, name)
+        plane.pump()
+        assert sim.sink.gauge_value("consul.raft.commitIndex") >= 0
+
+    def test_lens_gains_raft_field_group(self):
+        from consul_tpu.obs import lens as lens_obs
+
+        sim = _mk_sim()
+        sim.set_raft(_rcfg())
+        sim.set_lens(4)
+        assert sim.lens.fields == lens_obs.FIELDS + lens_obs.RAFT_FIELDS
+        sim.run(12, chunk=6, with_metrics=False)
+        ticks, vals = sim.lens.timelines()
+        assert vals.shape == (12, 4, len(sim.lens.fields))
+        term_col = sim.lens.fields.index("raft_term")
+        # Once a leader exists, sampled seats see a positive term.
+        assert vals[-1, :, term_col].max() >= 1
+        # Clearing raft restores the base schema.
+        sim.set_raft(None)
+        assert sim.lens.fields == lens_obs.FIELDS
+
+
+class TestSweepIntegration:
+    def test_sweep_rows_carry_raft_and_sim_unmoved(self):
+        from consul_tpu.chaos import sweep as sweep_mod
+
+        sim = _mk_sim(n=64, seed=3)
+        plane = sim.set_raft(_rcfg())
+        sim.run(24, chunk=12, with_metrics=False)
+        base = plane.summary()
+        res = sweep_mod.run_sweep(sim, [
+            [chaos_mod.RaftStorm(start=2, stop=18)],
+            [chaos_mod.RaftKill(start=2, stop=14, group=0, peer=-1)],
+        ], ticks=32, chunk=16)
+        assert len(res) == 2
+        for row in res:
+            assert set(row["raft"]) >= {"terms", "leaders", "commit",
+                                        "committed_clients", "counters"}
+        # The storm lane burns terms beyond the quiet baseline.
+        assert max(res[0]["raft"]["terms"]) > max(base["terms"])
+        # The sweep ran on copies: the live plane did not move.
+        assert plane.summary() == base
+
+    def test_mesh_plus_raft_sweep_is_a_documented_narrowing(self):
+        from consul_tpu.chaos import sweep as sweep_mod
+        from consul_tpu.parallel import mesh as pmesh
+
+        sim = _mk_sim(n=64, mesh=pmesh.make_mesh(jax.devices()))
+        sim.set_raft(_rcfg())
+        with pytest.raises(ValueError, match="single-device"):
+            sweep_mod.run_sweep(
+                sim, [[chaos_mod.RaftStorm(start=2, stop=10)]], ticks=16)
+
+
+class TestWriteGate:
+    def _armed_stack(self, n=48):
+        from consul_tpu.serving.plane import ServingPlane
+
+        sim = _mk_sim(n=n)
+        plane = ServingPlane(k=4)
+        sim.attach_serving(plane, writes=True, kv_slots=32)
+        rplane = sim.set_raft(_rcfg())
+        return sim, plane, rplane
+
+    def _run_until(self, sim, pred, max_chunks=24, chunk=8):
+        for _ in range(max_chunks):
+            if pred():
+                return True
+            sim.run(chunk, chunk=chunk, with_metrics=False)
+        return pred()
+
+    def test_write_applies_only_at_quorum_commit(self):
+        sim, plane, rplane = self._armed_stack()
+        res = plane.kv_put("svc/leader", 42)
+        # The gate answered provisionally: staged, not applied.
+        assert res.status == "proposed" and not res.applied
+        assert rplane.inflight == 1
+        base_index = plane.apply_index
+        ok = self._run_until(sim, lambda: rplane.inflight == 0)
+        assert ok, "proposal never quorum-committed"
+        # The commit pump applied it through the real batcher: the
+        # device apply index moved, and the flip shows the value.
+        assert plane.apply_index > base_index
+        sim.publish_serving()
+        got = plane.kv_get("svc/leader")
+        assert got is not None and got["Value"] == 42
+
+    def test_ticket_wait_returns_committed_results(self):
+        import threading
+
+        sim, plane, rplane = self._armed_stack()
+        tk = rplane.propose([(2, 0, 7)])  # OP_KV_PUT slot 0
+        done = []
+        th = threading.Thread(
+            target=lambda: done.append(tk.wait(timeout_s=30.0)))
+        th.start()
+        self._run_until(sim, lambda: tk.done.is_set())
+        th.join(timeout=30.0)
+        assert done and all(r.applied for r in done[0])
+        assert all(r.status == "applied" or r.applied for r in done[0])
+
+
+@pytest.mark.slow
+class TestLeaderKillDrill:
+    """The tentpole durability pin: a write acknowledged with an apply
+    index was quorum-committed, so killing the leader that acked it
+    cannot lose it — and the group re-elects within a bounded window."""
+
+    def test_no_committed_write_lost_bounded_reelection(self):
+        from consul_tpu.serving.plane import ServingPlane
+
+        sim = _mk_sim(n=64, seed=5)
+        plane = ServingPlane(k=4)
+        sim.attach_serving(plane, writes=True, kv_slots=64)
+        rcfg = _rcfg(groups=1, peers=5)
+        rplane = sim.set_raft(rcfg)
+        # Elect, then commit a batch of acked writes.
+        sim.run(24, chunk=8, with_metrics=False)
+        for i in range(6):
+            plane.kv_put(f"drill/{i}", 100 + i)
+        for _ in range(24):
+            if rplane.inflight == 0:
+                break
+            sim.run(8, chunk=8, with_metrics=False)
+        assert rplane.inflight == 0
+        acked_index = plane.apply_index
+        before = rplane.summary()
+        term0 = before["terms"][0]
+        assert before["leaders"][0] >= 0
+        committed0 = before["committed_clients"][0]
+        assert committed0 == 6
+        # Kill the live leader for a window, then heal.
+        t0 = sim._tick()
+        sim.set_chaos([chaos_mod.RaftKill(start=t0 + 2, stop=t0 + 20,
+                                          group=0, peer=-1)])
+        sim.run(48, chunk=8, with_metrics=False)
+        sim.set_chaos(None)
+        after = rplane.summary()
+        # Bounded re-election: a new leader holds a higher term well
+        # inside the window (48 ticks spans >= 2 max election timeouts).
+        assert after["leaders"][0] >= 0
+        assert after["terms"][0] > term0
+        # Zero committed writes lost: the committed-client count never
+        # regressed, the apply index never moved backwards, and every
+        # acked value is still served.
+        assert after["committed_clients"][0] >= committed0
+        assert plane.apply_index >= acked_index
+        sim.publish_serving()
+        for i in range(6):
+            got = plane.kv_get(f"drill/{i}")
+            assert got is not None and got["Value"] == 100 + i, i
+        # The tier keeps accepting writes after the failover.
+        res = plane.kv_put("drill/post", 999)
+        assert res.status == "proposed"
+        for _ in range(24):
+            if rplane.inflight == 0:
+                break
+            sim.run(8, chunk=8, with_metrics=False)
+        assert rplane.inflight == 0
+        sim.publish_serving()
+        assert plane.kv_get("drill/post")["Value"] == 999
