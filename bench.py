@@ -50,6 +50,7 @@ is therefore the per-chip simulation speed-up over real time.
 import glob
 import json
 import os
+import signal
 import subprocess
 import sys
 import tempfile
@@ -705,6 +706,49 @@ def child(platform: str, deadline: float):
     finally:
         del qsim
 
+    # Game day (consul_tpu/gameday): the federated soak — composed
+    # Partition+ChurnWave+RaftKill on the compiled schedule, sustained
+    # mixed traffic through the chosen host frontend, a DCN federation
+    # leg, and watchers on the reduction tree — distilled into the one
+    # SLO verdict {pass, p99s, lost_writes, max_time_to_heal_ticks}.
+    # BENCH_GAMEDAY=0 skips; BENCH_GAMEDAY_RESUME_DIR arms the
+    # phase-boundary resume, and a SIGTERM mid-soak exits the child
+    # with EX_TEMPFAIL (75) so the parent stamps the completed phases
+    # instead of recording a crash.
+    try:
+        if left() > 240 and os.environ.get("BENCH_GAMEDAY", "1") != "0":
+            from consul_tpu.gameday import GamedayConfig, run_gameday
+            from consul_tpu.runtime.policy import SignalTrap
+
+            t_gd = time.monotonic()
+            gcfg = GamedayConfig(
+                n=int(os.environ.get("BENCH_GAMEDAY_N", "1024")),
+                view_degree=16,
+                watchers=int(os.environ.get("BENCH_GAMEDAY_WATCHERS",
+                                            "256")),
+                read_batch=int(os.environ.get("BENCH_GAMEDAY_BATCH",
+                                              "256")),
+                frontend=os.environ.get("BENCH_GAMEDAY_FRONTEND",
+                                        "threaded"),
+                steady_rounds=2, fault_rounds=4, heal_rounds=2,
+                drain_rounds=2,
+                resume_dir=os.environ.get("BENCH_GAMEDAY_RESUME_DIR")
+                or None)
+            with SignalTrap() as trap:
+                verdict = run_gameday(gcfg, trap=trap)
+            verdict.pop("thresholds", None)
+            _emit({"phase": "gameday",
+                   "wall_s": round(time.monotonic() - t_gd, 2),
+                   **verdict})
+            if trap.fired is not None:
+                # Preempted mid-soak with resume state saved: hand the
+                # parent the sysexits EX_TEMPFAIL verdict it maps to
+                # "preempted" (completed phases stamped, not a crash).
+                return 75
+    except Exception as e:
+        _emit({"phase": "error", "where": "gameday",
+               "error": repr(e)[:500]})
+
     # Weak/strong scaling over the device ladder (1, 2, 4, ... up to
     # the visible count): strong holds n fixed (BENCH_SCALING_N) while
     # devices grow, weak grows n with the devices
@@ -1026,6 +1070,26 @@ def run_northstar(sim, s, rps, phase_name, *, chunk, kill_frac, left, emit,
 # Parent: orchestrate children, merge, always print one line, rc=0.
 # ----------------------------------------------------------------------
 
+# Exit codes that mean "preempted, resumable" rather than "crashed":
+# sysexits EX_TEMPFAIL (a child that trapped SIGTERM, checkpointed,
+# and exited deliberately) and a raw SIGTERM kill (the watchdog or
+# the platform got there before the trap).
+_PREEMPT_RCS = (75, -signal.SIGTERM)
+
+
+def _child_status(status, returncode):
+    """Map a finished child's exit to its status string. Preemption is
+    its own state — the harvested phases are completed work to resume
+    past, not debris from a crash."""
+    if status != "ok":
+        return status
+    if returncode in (0, None):
+        return "ok"
+    if returncode in _PREEMPT_RCS:
+        return "preempted"
+    return f"rc={returncode}"
+
+
 def _run_child(platform: str, timeout_s: float, extra_env=None,
                init_window_s: float = 300.0):
     """Run one backend child; harvest its per-phase JSON lines.
@@ -1102,9 +1166,8 @@ def _run_child(platform: str, timeout_s: float, extra_env=None,
             os.unlink(out_path)
         except OSError:
             pass
-    if status == "ok" and proc.returncode not in (0, None):
-        status = f"rc={proc.returncode}"
-    return {
+    status = _child_status(status, proc.returncode)
+    out = {
         "status": status,
         "wall_s": round(time.monotonic() - t0, 1),
         # The platform this child was ASKED to run. A hung backend
@@ -1118,6 +1181,18 @@ def _run_child(platform: str, timeout_s: float, extra_env=None,
         # outcome) — with_failover lifts it into attempt provenance.
         "blackbox": getattr(wd, "blackbox_path", None),
     }
+    if status == "preempted":
+        # Stamp what the child FINISHED before the preemption signal:
+        # the resume path (gameday phase-boundary checkpoints, replay
+        # keeping live phases) picks up after the last completed
+        # phase instead of restarting the whole round.
+        out["preempted"] = True
+        out["completed_phases"] = [
+            p["phase"] for p in phases
+            if isinstance(p, dict) and p.get("phase")
+            and p["phase"] != "error"
+        ]
+    return out
 
 
 def _get(phases, name, key, default=None):
@@ -1172,7 +1247,7 @@ def _save_tpu_session(result):
 _PHASE_KEYS = ("northstar_1m", "northstar_1m_serf", "compile_cache",
                "elasticity", "memory", "serving", "serving_mixed",
                "scaling_strong", "scaling_weak", "topology", "trace",
-               "raft")
+               "raft", "gameday")
 
 
 def _phase_or_not_run(phases, name, reason, pick=None):
@@ -1223,7 +1298,20 @@ def _maybe_replay(result):
     # key explicitly, and mark surviving not_run entries stale so they
     # are never mistaken for a this-run skip decision.
     base = os.path.basename(path)
+    # A phase the LIVE chip attempt completed before dying (preemption
+    # mid-soak, deadline mid-ladder) beats any replayed copy: the
+    # merged artifact resumes from the last completed phase rather than
+    # discarding this round's work for an older, stale one. Gated on
+    # the live primary actually being the chip — phases measured by the
+    # CPU floor child must never masquerade inside a TPU artifact.
+    live_is_chip = "tpu" in str(result.get("device", "")).lower()
+    resumed = []
     for k in _PHASE_KEYS:
+        live = result.get(k) if live_is_chip else None
+        if isinstance(live, dict) and live.get("status") != "not_run":
+            merged[k] = live
+            resumed.append(k)
+            continue
         v = merged.get(k)
         if not v:
             merged[k] = {
@@ -1233,6 +1321,8 @@ def _maybe_replay(result):
             }
         elif isinstance(v, dict) and v.get("status") == "not_run":
             merged[k] = dict(v, stale=True)
+    if resumed:
+        merged["live_phases"] = resumed
     return merged
 
 
@@ -1464,6 +1554,13 @@ def main():
         "raft": _phase_or_not_run(
             primary["phases"], "raft",
             "skipped: time budget exhausted or phase errored"),
+        # Game-day soak verdict (consul_tpu/gameday): the single SLO
+        # pass/fail over the composed-chaos federated soak — pass,
+        # per-class p99s, lost_writes (must be 0), heal bound, watch
+        # delivery lag, shed/reject counts, preemption/resume marks.
+        "gameday": _phase_or_not_run(
+            primary["phases"], "gameday",
+            "skipped: time budget exhausted or soak errored"),
         # Mesh + prewarm provenance for the headline number: how many
         # devices the child saw, and what the AOT prewarm pass
         # compiled/deserialized before the timed phases.

@@ -42,6 +42,17 @@ def _dur_to_s(s: str) -> float:
     return float(s)
 
 
+def parse_blocking(q: dict, default_wait_s: float = 10.0
+                   ) -> tuple[int, float]:
+    """``?index=`` + ``?wait=`` -> (min_index, wait_s): the reference
+    parseWait contract (agent/http.go), shared between this threaded
+    surface and the async serving frontend so both answer blocking
+    queries with identical parameter semantics."""
+    min_index = int(q.get("index", 0))
+    wait_s = _dur_to_s(q["wait"]) if "wait" in q else default_wait_s
+    return min_index, wait_s
+
+
 class HTTPApi:
     """Routes parsed requests to the agent + its RPC surface. Transport
     free: the handler below serves it over a socket; tests may call
@@ -102,8 +113,7 @@ class HTTPApi:
                 body: bytes, headers: Optional[dict] = None,
                 ) -> tuple[int, Any, dict[str, str]]:
         q = {k: v[-1] for k, v in query.items()}
-        min_index = int(q.get("index", 0))
-        wait_s = _dur_to_s(q["wait"]) if "wait" in q else 10.0
+        min_index, wait_s = parse_blocking(q)
         near = q.get("near", "")
         try:
             if self.acl_enabled:

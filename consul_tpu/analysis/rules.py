@@ -6,7 +6,8 @@ function definitions the callgraph proved reachable from a trace entry
 point — host-tier driver code in the same file is untouched.
 ``device-module`` rules (TH103/TH104) fire anywhere in a device-tier
 module (models/ ops/ parallel/ chaos/). ``package`` rules
-(TH105/TH106/TH108/TH112) fire everywhere.
+(TH105/TH106/TH108/TH112) fire everywhere. ``host-serving`` rules
+(TH113) fire in the host serving tiers (serving/ server/ gameday/).
 """
 
 from __future__ import annotations
@@ -60,6 +61,13 @@ RULES = {
              "not elapsed time; spans and latency math must use "
              "time.perf_counter()/time.monotonic() (genuine "
              "wall-clock-timestamp sites are allowlisted)",
+    "TH113": "unbounded threading.Thread spawn in host-tier serving/"
+             "gameday code — a thread per connection or blocking "
+             "query grows without limit under churny load (the "
+             "failure mode the async frontend exists to kill); keep "
+             "a handle that is join()ed, drain it through a joined "
+             "container, or hand the work to the event-loop frontend "
+             "(intentional sites are allowlisted with their bound)",
 }
 
 # TH101: int()/float()/bool() arguments considered static (config
@@ -123,10 +131,104 @@ _WIDE_DTYPES = frozenset({
 })
 
 
+# TH113: the host serving tiers where a per-request thread spawn is a
+# capacity bug, not a style choice — the threaded HTTP/RPC surfaces,
+# the async frontend, and the game-day harness/swarm drivers.
+_TH113_PREFIXES = ("consul_tpu/serving/", "consul_tpu/server/",
+                   "consul_tpu/gameday/")
+
+
 def run_rules(mod, traced_ids) -> list:
     v = _RuleVisitor(mod, traced_ids)
     v.visit(mod.tree)
+    if mod.relpath.startswith(_TH113_PREFIXES):
+        v.findings.extend(_run_th113(mod))
     return v.findings
+
+
+def _run_th113(mod) -> list:
+    """Unbounded ``threading.Thread`` spawns in a host-serving module.
+
+    Boundedness is a whole-module property (spawned in ``start``,
+    joined in ``close``), so this runs as its own two-pass walk:
+
+    1. Collect every join drain — ``X.join(...)`` marks the spelled
+       receiver ``X`` (a name or a ``self`` attribute) as a joined
+       handle, and ``for t in C: t.join()`` marks the container ``C``
+       as join-drained.
+    2. Every ``threading.Thread(...)`` constructor is then judged by
+       what happens to the handle: assigned to a joined name, or
+       appended into a join-drained container → bounded; assigned to
+       an unjoined name, chained straight into ``.start()``, or
+       passed/stored anywhere opaque → a finding.
+    """
+    from consul_tpu.analysis.engine import Finding
+
+    joined: set = set()
+    drained: set = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "join":
+            joined.add(ast.unparse(node.func.value))
+        if isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+            loop_var = node.target.id
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Call) \
+                        and isinstance(inner.func, ast.Attribute) \
+                        and inner.func.attr == "join" \
+                        and isinstance(inner.func.value, ast.Name) \
+                        and inner.func.value.id == loop_var:
+                    drained.add(ast.unparse(node.iter))
+                    break
+
+    parents: dict = {}
+    for p in ast.walk(mod.tree):
+        for c in ast.iter_child_nodes(p):
+            parents[c] = p
+
+    def _symbol(node) -> str:
+        names = []
+        cur = node
+        while cur in parents:
+            cur = parents[cur]
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                names.append(cur.name)
+        return ".".join(reversed(names))
+
+    findings = []
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and mod.resolve(node.func, None) == "threading.Thread"):
+            continue
+        parent = parents.get(node)
+        if isinstance(parent, ast.Assign):
+            if any(ast.unparse(t) in joined for t in parent.targets):
+                continue
+            shape = (f"handle {ast.unparse(parent.targets[0])} is "
+                     "never join()ed")
+        elif isinstance(parent, ast.Call) \
+                and isinstance(parent.func, ast.Attribute) \
+                and parent.func.attr == "append":
+            container = ast.unparse(parent.func.value)
+            if container in drained or container in joined:
+                continue
+            shape = f"container {container} is never join-drained"
+        elif isinstance(parent, ast.Attribute) and parent.attr == "start":
+            shape = "spawned and started with no handle kept"
+        else:
+            shape = "handle escapes without a visible join"
+        findings.append(Finding(
+            rule="TH113", path=mod.relpath,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            symbol=_symbol(node),
+            message=f"unbounded thread spawn — {shape}; under churny "
+                    "serving load this grows the thread count without "
+                    "limit: join the handle, drain it through a joined "
+                    "container, or use the async frontend's event loop"))
+    return findings
 
 
 class _RuleVisitor(ast.NodeVisitor):

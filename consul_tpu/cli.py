@@ -1054,6 +1054,47 @@ def _cmd_chaos_sweep(args) -> int:
     return 0
 
 
+def cmd_gameday(args) -> int:
+    """Run the federated game-day soak (consul_tpu/gameday) locally and
+    print the single SLO verdict as one JSON line. Like ``chaos`` this
+    is special-cased in main() and imports jax lazily — no running
+    agent is needed; the harness builds its own simulation, arms the
+    raft tier, composes Partition+ChurnWave+RaftKill on the compiled
+    schedule, and drives sustained mixed traffic through the chosen
+    host frontend while a DCN federation leg and the watcher tree run
+    alongside.
+
+    SIGTERM mid-soak saves a resume point at the last drained phase
+    boundary (when --resume-dir is set) and exits 75 (EX_TEMPFAIL) —
+    rerunning the same command continues from the last completed
+    phase. Exit 0 = SLO pass, 1 = SLO fail."""
+    from consul_tpu.gameday import GamedayConfig, run_gameday
+    from consul_tpu.runtime.policy import SignalTrap
+
+    cfg = GamedayConfig(
+        n=args.n, seed=args.seed, view_degree=args.view_degree,
+        watchers=args.watchers, watch_queue=args.watch_queue,
+        ratio=args.ratio, read_batch=args.read_batch,
+        raft_groups=args.raft_groups, raft_peers=args.raft_peers,
+        dcn_islands=args.dcn_islands, frontend=args.frontend,
+        warmup_ticks=args.warmup_ticks,
+        ticks_per_round=args.ticks_per_round,
+        steady_rounds=args.steady_rounds,
+        fault_rounds=args.fault_rounds, heal_rounds=args.heal_rounds,
+        drain_rounds=args.drain_rounds,
+        partition_frac=args.partition_frac, churn_frac=args.churn_frac,
+        swarm_procs=args.swarm_procs, swarm_requests=args.swarm_requests,
+        resume_dir=args.resume_dir)
+    say = (lambda rec: print(json.dumps(rec), file=sys.stderr)) \
+        if args.verbose else None
+    with SignalTrap() as trap:
+        verdict = run_gameday(cfg, trap=trap, emit=say)
+    print(json.dumps(verdict))
+    if trap.fired is not None:
+        return 75
+    return 0 if verdict.get("pass") else 1
+
+
 def cmd_run(args) -> int:
     """Advance a plain local simulation under the resilient harness
     (no fault schedule — ``chaos`` is the faulted variant) and print
@@ -1490,6 +1531,54 @@ def build_parser() -> argparse.ArgumentParser:
     add_obs_flags(ch)
     add_raft_flags(ch)
 
+    gd = sub.add_parser(
+        "gameday",
+        help="run the federated game-day soak (composed chaos + live "
+             "traffic + watchers + DCN leg) and print the SLO verdict")
+    gd.add_argument("--n", type=int, default=4096)
+    gd.add_argument("--seed", type=int, default=0)
+    gd.add_argument("--view-degree", type=int, default=16)
+    gd.add_argument("--watchers", type=int, default=1024,
+                    help="registered watchers on the reduction tree")
+    gd.add_argument("--watch-queue", type=int, default=8,
+                    help="per-watcher bounded delivery queue")
+    gd.add_argument("--ratio", default="90:9:1", metavar="R:W:WATCH",
+                    help="read:write:watch traffic mix per round")
+    gd.add_argument("--read-batch", type=int, default=256)
+    gd.add_argument("--raft-groups", type=int, default=4)
+    gd.add_argument("--raft-peers", type=int, default=3)
+    gd.add_argument("--dcn-islands", type=int, default=2,
+                    help="DCN federation islands for the WAN leg "
+                         "(0 skips the leg)")
+    gd.add_argument("--frontend", choices=("threaded", "async"),
+                    default="threaded",
+                    help="host frontend the traffic goes through: the "
+                         "lock-based threaded path or the one-event-"
+                         "loop async frontend (serving/frontend.py)")
+    gd.add_argument("--warmup-ticks", type=int, default=64)
+    gd.add_argument("--ticks-per-round", type=int, default=32)
+    gd.add_argument("--steady-rounds", type=int, default=4)
+    gd.add_argument("--fault-rounds", type=int, default=6)
+    gd.add_argument("--heal-rounds", type=int, default=4)
+    gd.add_argument("--drain-rounds", type=int, default=4)
+    gd.add_argument("--partition-frac", type=float, default=0.25,
+                    help="fraction of nodes on the cut side of the "
+                         "composed partition")
+    gd.add_argument("--churn-frac", type=float, default=0.05,
+                    help="fraction of nodes in the churn wave")
+    gd.add_argument("--swarm-procs", type=int, default=0,
+                    help="HTTP client swarm processes hammering the "
+                         "async frontend's socket listener (0 = off; "
+                         "needs --frontend async)")
+    gd.add_argument("--swarm-requests", type=int, default=64,
+                    help="requests per swarm process")
+    gd.add_argument("--resume-dir", default=None, metavar="DIR",
+                    help="preemption resume directory: SIGTERM saves "
+                         "at the last drained phase boundary and exits "
+                         "75; rerunning continues from there")
+    gd.add_argument("--verbose", action="store_true",
+                    help="stream per-phase progress JSON to stderr")
+
     pw = sub.add_parser(
         "prewarm",
         help="AOT-compile chunk programs into the persistent compile "
@@ -1837,6 +1926,8 @@ def main(argv=None) -> int:
         return cmd_agent(args)
     if args.cmd == "chaos":
         return cmd_chaos(args)
+    if args.cmd == "gameday":
+        return cmd_gameday(args)
     if args.cmd == "run":
         return cmd_run(args)
     if args.cmd == "prewarm":

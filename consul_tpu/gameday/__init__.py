@@ -1,0 +1,21 @@
+"""Game-day plane: the federated soak harness with an SLO gate.
+
+``harness.py`` runs the full stack at once — composed chaos riding
+the compiled schedule, sustained mixed traffic through either host
+frontend, a DCN federation leg, O(1k+) watchers through the
+reduction tree — phased warmup -> steady -> fault -> heal -> drain,
+with preemption-safe resume at drained phase boundaries. ``slo.py``
+turns the measurements into the single pass/fail verdict (and holds
+the golden regression thresholds as data); ``swarm.py`` is the
+multi-process HTTP client swarm for the async frontend's socket
+surface.
+"""
+
+from consul_tpu.gameday.harness import (GamedayConfig, PHASES,
+                                        run_gameday)
+from consul_tpu.gameday.slo import SloThresholds, evaluate, load_goldens
+
+__all__ = [
+    "GamedayConfig", "PHASES", "SloThresholds", "evaluate",
+    "load_goldens", "run_gameday",
+]

@@ -1,0 +1,91 @@
+"""Golden SLO measurement points: the worst-case regression alarm.
+
+Two fixed, fast, deterministic probes whose results are stored as
+data (``slo_goldens.json``) and re-measured by a tier-1 test
+(``tests/test_slo_goldens.py``):
+
+- **topology**: the ``worst_case`` heal-time argmax over the standard
+  partition scenario grid at a fixed (n, degree, S) point — the
+  number ROADMAP's topology lab optimizes; a future PR that slows
+  worst-case heal beyond the stored tolerance fails fast, in tier-1,
+  not in a multi-hour soak.
+- **raft**: commit-visibility latency (ticks, chunk-quantized — the
+  bench raft ladder's probe) for proposed writes on a small armed
+  sim; the regression alarm for the quorum-commit path the game-day
+  lost-writes gate depends on.
+
+Both probes reuse the exact code paths the slow tiers measure
+(``chaos/sweep.run_sweep`` + ``worst_case``, ``RaftPlane.propose`` +
+the chunk pump), just at regression-test scale.
+"""
+
+from __future__ import annotations
+
+
+def measure_topology(n: int = 256, degree: int = 8, scenarios: int = 4,
+                     settle: int = 96, chunk: int = 32,
+                     form_ticks: int = 64, seed: int = 0) -> dict:
+    """Worst-case heal time over the standard partition grid at one
+    fixed sweep point. Deterministic for a fixed config."""
+    from consul_tpu.chaos import sweep as sweep_mod
+    from consul_tpu.config import SimConfig
+    from consul_tpu.models.cluster import Simulation
+
+    sim = Simulation(SimConfig(n=n, view_degree=degree), seed=seed)
+    sim.run(form_ticks, chunk=chunk, with_metrics=False)
+    results = sweep_mod.run_sweep(
+        sim, sweep_mod.scenario_grid(n, scenarios),
+        chunk=chunk, settle=settle)
+    wi = sweep_mod.worst_case(results)
+    worst = results[wi]["slo"]
+    return {
+        "n": n, "degree": degree, "scenarios": scenarios,
+        "settle": settle, "chunk": chunk, "seed": seed,
+        "worst_index": wi,
+        "time_to_heal": int(worst["time_to_heal"]),
+        "false_positive_deaths": int(worst["false_positive_deaths"]),
+        "time_to_first_suspect": int(worst["time_to_first_suspect"]),
+    }
+
+
+def measure_raft_commit(n: int = 256, groups: int = 4, peers: int = 3,
+                        window: int = 64, probes: int = 6,
+                        rchunk: int = 8, seed: int = 0) -> dict:
+    """Commit-visibility latency in ticks for proposed writes (the
+    bench raft ladder's probe at regression scale): propose one
+    entry, step the sim in ``rchunk``-tick chunks until the quorum
+    commit point releases the ticket. Quantizes to ``rchunk``."""
+    from consul_tpu.config import RaftConfig, SimConfig
+    from consul_tpu.models.cluster import Simulation
+
+    sim = Simulation(SimConfig(n=n, view_degree=8), seed=seed)
+    plane = sim.set_raft(RaftConfig(groups=groups, peers=peers,
+                                    window=window))
+    # Form + first elections (also warms the raft-carrying program).
+    sim.run(4 * rchunk, chunk=rchunk, with_metrics=False)
+    lat = []
+    for i in range(probes):
+        tk = plane.propose([("kv_put", f"golden/raft/{i}", b"v")])
+        ticks = 0
+        while not tk.done.is_set() and ticks < 32 * rchunk:
+            sim.run(rchunk, chunk=rchunk, with_metrics=False)
+            ticks += rchunk
+        lat.append(ticks)
+    lat.sort()
+    return {
+        "n": n, "groups": groups, "peers": peers, "window": window,
+        "probes": probes, "rchunk": rchunk, "seed": seed,
+        "commit_ticks_p50": int(lat[len(lat) // 2]),
+        "commit_ticks_p99": int(lat[-1]),
+        "all_committed": all(x < 32 * rchunk for x in lat),
+    }
+
+
+if __name__ == "__main__":
+    # Re-measure both probes at their default (golden) configs; paste
+    # the values into slo_goldens.json when a deliberate protocol
+    # change moves them.
+    import json
+
+    print(json.dumps({"topology": measure_topology(),
+                      "raft": measure_raft_commit()}, indent=2))

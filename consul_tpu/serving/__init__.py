@@ -23,6 +23,7 @@ from consul_tpu.ops.serving import (MODE_CATALOG, MODE_DIST, MODE_HEALTH,
 from consul_tpu.serving.batcher import (QueryBatcher, QueryResult,
                                         ServingClosedError,
                                         ServingOverloadError)
+from consul_tpu.serving.frontend import AsyncFrontend
 from consul_tpu.serving.plane import NearestResult, ServingPlane
 from consul_tpu.serving.watch import Watcher, WatchEvent, WatchPlane
 from consul_tpu.serving.writes import (KeyTable, WriteBatcher,
@@ -30,7 +31,8 @@ from consul_tpu.serving.writes import (KeyTable, WriteBatcher,
 
 __all__ = [
     "MODE_CATALOG", "MODE_DIST", "MODE_HEALTH", "MODE_NEAREST", "MODE_NOOP",
-    "KeyTable", "NearestResult", "QueryBatcher", "QueryResult",
+    "AsyncFrontend", "KeyTable", "NearestResult", "QueryBatcher",
+    "QueryResult",
     "ServingClosedError", "ServingOverloadError", "ServingPlane",
     "Snapshot", "Watcher", "WatchEvent", "WatchPlane", "WriteBatcher",
     "WriteResult",

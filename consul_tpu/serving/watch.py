@@ -113,6 +113,10 @@ class WatchPlane:
         # flip, advanced by on_flip under _index_cond.
         self.apply_index = 0
         self._index_cond = threading.Condition()
+        # Index listeners (the async frontend's wake seam): called with
+        # the new apply index AFTER the condition broadcast, outside
+        # every plane lock, so a listener may re-enter the plane.
+        self._index_listeners: list = []
         # Plain-int counters mirroring the sink emissions.
         self.watchers = 0
         self.deltas = 0
@@ -249,6 +253,21 @@ class WatchPlane:
             if index > self.apply_index:
                 self.apply_index = index
             self._index_cond.notify_all()
+            listeners = list(self._index_listeners)
+        for fn in listeners:
+            fn(self.apply_index)
+
+    def add_index_listener(self, fn) -> None:
+        """Register ``fn(apply_index)`` to fire after every flip's
+        index advance (threaded waiters keep using :meth:`wait_index`;
+        the async frontend parks futures here instead of threads)."""
+        with self._index_cond:
+            self._index_listeners.append(fn)
+
+    def remove_index_listener(self, fn) -> None:
+        with self._index_cond:
+            if fn in self._index_listeners:
+                self._index_listeners.remove(fn)
 
     # ------------------------------------------------------------------
     # Blocking-query primitive (the ?index= contract)
@@ -283,6 +302,9 @@ class WatchPlane:
                 w.cond.notify_all()
         with self._index_cond:
             self._index_cond.notify_all()
+            listeners = list(self._index_listeners)
+        for fn in listeners:
+            fn(self.apply_index)
 
     # ------------------------------------------------------------------
     # Stats

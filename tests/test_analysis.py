@@ -765,6 +765,108 @@ class TestTH112:
 
 
 # ----------------------------------------------------------------------
+# TH113: unbounded thread spawn in the host serving tiers
+# ----------------------------------------------------------------------
+
+SERVE = "consul_tpu/serving/fake3.py"
+
+
+class TestTH113:
+    def test_fire_and_forget_spawn_fires(self):
+        rep = _lint({SERVE: """
+            import threading
+
+            def handle(conn):
+                threading.Thread(target=serve, args=(conn,),
+                                 daemon=True).start()
+        """})
+        assert _rules(rep) == ["TH113"]
+        assert rep.findings[0].symbol == "handle"
+
+    def test_unjoined_handle_fires(self):
+        rep = _lint({SERVE: """
+            import threading
+
+            class Loop:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+        """})
+        assert _rules(rep) == ["TH113"]
+        assert rep.findings[0].symbol == "Loop.start"
+
+    def test_joined_handle_is_silent(self):
+        # Boundedness is a module property: spawned in start(),
+        # joined in close() — the frontend's own shape.
+        rep = _lint({SERVE: """
+            import threading
+
+            class Loop:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def close(self):
+                    self._t.join(5.0)
+        """})
+        assert rep.clean
+
+    def test_join_drained_container_is_silent(self):
+        rep = _lint({SERVE: """
+            import threading
+
+            class Pool:
+                def spawn(self):
+                    self._threads.append(
+                        threading.Thread(target=self._run))
+
+                def drain(self):
+                    for t in self._threads:
+                        t.join()
+        """})
+        assert rep.clean
+
+    def test_undrained_container_fires(self):
+        rep = _lint({SERVE: """
+            import threading
+
+            def fan_out(work):
+                pool = []
+                for w in work:
+                    pool.append(threading.Thread(target=w))
+        """})
+        assert _rules(rep) == ["TH113"]
+
+    def test_outside_serving_tiers_is_silent(self):
+        # The agent tier keeps the reference per-probe daemon threads;
+        # TH113 is scoped to serving/ server/ gameday/ only.
+        rep = _lint({HOST: """
+            import threading
+
+            def probe():
+                threading.Thread(target=run, daemon=True).start()
+        """})
+        assert rep.clean
+
+    def test_allowlist_suppresses_intentional_site(self):
+        al = parse_allowlist("""
+            [[allow]]
+            rule = "TH113"
+            path = "consul_tpu/serving/fake3.py"
+            symbol = "accept"
+            reason = "per-connection handler exits with its socket"
+        """)
+        rep = _lint({SERVE: """
+            import threading
+
+            def accept(conn):
+                threading.Thread(target=serve, args=(conn,),
+                                 daemon=True).start()
+        """}, al)
+        assert rep.clean and len(rep.suppressed) == 1
+
+
+# ----------------------------------------------------------------------
 # callgraph: reachability across modules and hand-off shapes
 # ----------------------------------------------------------------------
 
@@ -973,6 +1075,7 @@ class TestPackageGate:
     def test_every_rule_id_is_documented(self):
         assert set(analysis.RULES) == {
             "TH101", "TH102", "TH103", "TH104", "TH105", "TH106",
-            "TH107", "TH108", "TH109", "TH110", "TH111", "TH112"}
+            "TH107", "TH108", "TH109", "TH110", "TH111", "TH112",
+            "TH113"}
         for rid, rationale in analysis.RULES.items():
             assert rationale.strip(), rid

@@ -1,0 +1,131 @@
+"""Preemption-safe bench parent (bench.py, jax-free helpers): a child
+killed mid-soak by SIGTERM / EX_TEMPFAIL is a *preempted* run whose
+completed phases are resume state, not a crash whose output is debris.
+
+Two seams under test:
+
+- ``_child_status``: exit-code → status mapping (75 and -SIGTERM are
+  "preempted"; anything else nonzero is an rc= crash marker).
+- ``_maybe_replay``: when the live TPU window died, phases the live
+  chip attempt COMPLETED before dying override the stale replayed
+  copies (stamped into ``live_phases``) — but only when the live
+  primary really is the chip; CPU-floor measurements must never
+  masquerade inside a TPU-labeled artifact.
+"""
+
+import signal
+
+import bench
+
+
+class TestChildStatus:
+    def test_clean_exit_is_ok(self):
+        assert bench._child_status("ok", 0) == "ok"
+        assert bench._child_status("ok", None) == "ok"
+
+    def test_preemption_codes(self):
+        """EX_TEMPFAIL (the SignalTrap child's deliberate exit) and a
+        raw SIGTERM kill both read as preempted-resumable."""
+        assert bench._child_status("ok", 75) == "preempted"
+        assert bench._child_status("ok", -signal.SIGTERM) == "preempted"
+
+    def test_crash_keeps_its_code(self):
+        assert bench._child_status("ok", 1) == "rc=1"
+        assert bench._child_status("ok", -signal.SIGKILL) == (
+            f"rc={-signal.SIGKILL}")
+
+    def test_watchdog_status_wins(self):
+        """A watchdog verdict (timeout, init_hang) is already more
+        specific than the exit code it caused."""
+        assert bench._child_status("init_hang", 75) == "init_hang"
+        assert bench._child_status("timeout", -signal.SIGTERM) == "timeout"
+
+
+def _saved_artifact():
+    """A minimal committed TPU session artifact: one completed phase
+    (raft), one phase absent entirely (gameday)."""
+    return {
+        "device": "TPU v5e-8",
+        "value": 1234.5,
+        "raft": {"phase": "raft", "groups": 64, "status": "ok"},
+        "backends": {"tpu": {"status": "ok"}},
+    }
+
+
+def _live_result(device, **phases):
+    """The live round's primary result after its window died."""
+    out = {
+        "device": device,
+        "value": None,
+        "cpu_fallback": True,
+        "total_wall_s": 99.0,
+        "backends": {
+            "tpu_attempt": {"status": "preempted"},
+            "cpu": {"status": "ok"},
+        },
+    }
+    out.update(phases)
+    return out
+
+
+class TestReplayKeepsLivePhases:
+    def _patch(self, monkeypatch, saved):
+        monkeypatch.setattr(
+            bench, "_latest_tpu_session",
+            lambda: (saved, "/x/BENCH_TPU_SESSION_LATEST.json", None))
+
+    def test_live_chip_phase_overrides_stale_copy(self, monkeypatch):
+        """A phase the chip child completed before preemption beats
+        the replayed artifact's copy AND the absent-key stamp."""
+        self._patch(monkeypatch, _saved_artifact())
+        gd = {"phase": "gameday", "pass": True, "lost_writes": 0}
+        merged = bench._maybe_replay(
+            _live_result("tpu v5e-8 x1", gameday=gd))
+        assert merged["gameday"] is gd
+        assert "live_phases" in merged and \
+            merged["live_phases"] == ["gameday"]
+        # Phases only the replayed artifact has survive as-is.
+        assert merged["raft"]["groups"] == 64
+        # The replay provenance is still stamped on the whole artifact.
+        assert merged["stale"] is True
+        assert merged["replay_reason"] == "preempted"
+
+    def test_cpu_floor_never_masquerades_as_chip(self, monkeypatch):
+        """When the primary fell back to the CPU child, its phases are
+        NOT folded into the TPU-labeled replay — the gameday slot gets
+        the stale/not_run stamp instead of a CPU measurement."""
+        self._patch(monkeypatch, _saved_artifact())
+        gd = {"phase": "gameday", "pass": True}
+        merged = bench._maybe_replay(
+            _live_result("cpu interpreter x8", gameday=gd))
+        assert merged.get("gameday") is not gd
+        assert merged["gameday"]["status"] == "not_run"
+        assert merged["gameday"]["stale"] is True
+        assert "live_phases" not in merged
+
+    def test_not_run_live_phase_does_not_override(self, monkeypatch):
+        """A live phase that never ran (explicit not_run marker) must
+        not clobber a real measurement from the replayed artifact."""
+        self._patch(monkeypatch, _saved_artifact())
+        merged = bench._maybe_replay(_live_result(
+            "tpu v5e-8 x1",
+            raft={"status": "not_run", "reason": "deadline"}))
+        assert merged["raft"]["groups"] == 64
+        assert "live_phases" not in merged
+
+    def test_absent_keys_stamped_not_run_stale(self, monkeypatch):
+        """Every stable phase key absent from an old artifact gets an
+        explicit not_run+stale stamp — never a bare null."""
+        self._patch(monkeypatch, _saved_artifact())
+        merged = bench._maybe_replay(_live_result("cpu x8"))
+        for k in bench._PHASE_KEYS:
+            assert isinstance(merged[k], dict), k
+            if k != "raft":
+                assert merged[k]["status"] == "not_run", k
+                assert merged[k]["stale"] is True, k
+
+    def test_no_saved_artifact_is_identity(self, monkeypatch):
+        monkeypatch.setattr(bench, "_latest_tpu_session",
+                            lambda: (None, None, None))
+        live = _live_result("cpu x8")
+        assert bench._maybe_replay(live) is live

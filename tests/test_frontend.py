@@ -1,0 +1,279 @@
+"""Async/selector frontend (serving/frontend.py): one event loop on
+one owned thread multiplexing reads, writes, and blocking queries in
+front of the same QueryBatcher / WriteBatcher / WatchPlane the
+threaded path uses.
+
+The parity contract (COVERAGE.md game-day section): for the same
+workload the async frontend returns byte-identical results to the
+threaded path — same kernels, same admission policy, same blocking-
+query floor — while parking blocking queries as loop timers instead
+of threads (strictly fewer live threads under concurrent waiters).
+"""
+
+import concurrent.futures
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from consul_tpu.config import SimConfig
+from consul_tpu.models.cluster import Simulation
+from consul_tpu.ops import deltas as deltas_mod
+from consul_tpu.ops import serving as kernels
+from consul_tpu.serving import AsyncFrontend, ServingPlane
+from consul_tpu.serving.frontend import ServingClosedError
+from consul_tpu.serving.writes import ServingOverloadError
+
+
+def _stack(n=256, seed=3, **write_kw):
+    sim = Simulation(SimConfig(n=n, view_degree=16), seed=seed)
+    plane = ServingPlane(k=8, buckets=(64,), num_services=4)
+    sim.attach_serving(plane, writes=True, kv_slots=64, **write_kw)
+    sim.run(64, chunk=32, with_metrics=False)
+    return sim, plane
+
+
+def _queries(rng_n, count, seed=7):
+    import random
+
+    rng = random.Random(seed)
+    return [(kernels.MODE_NEAREST, rng.randrange(rng_n), -1)
+            for _ in range(count)]
+
+
+class TestParity:
+    def test_read_results_identical_to_threaded(self):
+        """The same read batch through both frontends yields identical
+        QueryResults — the async loop runs the SAME bucketed kernel."""
+        sim, plane = _stack()
+        qs = _queries(256, 32)
+        threaded = plane.batcher.execute(qs)
+
+        fe = AsyncFrontend(plane).start()
+        try:
+            futs = [fe.submit_read(m, s, a) for m, s, a in qs]
+            async_res = [f.result(30.0) for f in futs]
+        finally:
+            fe.close()
+
+        assert len(async_res) == len(threaded)
+        for t, a in zip(threaded, async_res):
+            np.testing.assert_array_equal(t.ids, a.ids)
+            np.testing.assert_array_equal(t.rtts, a.rtts)
+            assert t.count == a.count
+
+    def test_write_results_identical_to_threaded(self):
+        """The same write batch against two identically-seeded stacks
+        produces identical WriteResults and identical KV readback."""
+        ops = [(deltas_mod.OP_REGISTER, i, i % 4) for i in range(8)]
+
+        sim_t, plane_t = _stack(seed=5)
+        kslot_t = plane_t.keys.slot_for("parity/k", create=True)
+        threaded = plane_t.writes.execute(
+            ops + [(deltas_mod.OP_KV_PUT, kslot_t, 42)])
+
+        sim_a, plane_a = _stack(seed=5)
+        fe = AsyncFrontend(plane_a).start()
+        try:
+            futs = [fe.submit_write(o, t, a) for o, t, a in ops]
+            futs.append(fe.kv_put("parity/k", 42))
+            async_res = [f.result(30.0) for f in futs]
+        finally:
+            fe.close()
+
+        assert async_res == threaded
+        assert all(r.applied for r in async_res)
+        # KV readback needs the write-carrying flip published.
+        for s in (sim_t, sim_a):
+            s.run(8, chunk=8, with_metrics=False)
+            s.publish_serving()
+        row_t = plane_t.kv_get("parity/k")
+        row_a = plane_a.kv_get("parity/k")
+        assert row_t is not None and row_a is not None
+        assert row_t["Value"] == row_a["Value"]
+        assert row_t["ModifyIndex"] == row_a["ModifyIndex"]
+
+    def test_wait_index_floor_contract(self):
+        """Same floor as WatchPlane.wait_index: never below min_index,
+        never below 1, immediate when already satisfied."""
+        sim, plane = _stack()
+        # Advance the apply index past zero with one write-carrying
+        # flip, so min_index=0 is already satisfied in both paths.
+        plane.writes.execute([(deltas_mod.OP_REGISTER, 0, 1)])
+        sim.run(8, chunk=8, with_metrics=False)
+        sim.publish_serving()
+        assert int(plane.apply_index) >= 1
+        fe = AsyncFrontend(plane).start()
+        try:
+            # Already satisfied: resolves without the full wait.
+            t0 = time.perf_counter()
+            idx = fe.wait_index(0, 5.0).result(30.0)
+            assert time.perf_counter() - t0 < 2.0
+            assert idx == plane.watch.wait_index(0, 0.0)
+            # Unsatisfiable: parks as a loop timer, then returns the
+            # floor (min_index), exactly like the threaded waiter.
+            want = int(plane.apply_index) + 10**6
+            assert fe.wait_index(want, 0.05).result(30.0) == \
+                plane.watch.wait_index(want, 0.0)
+        finally:
+            fe.close()
+
+    def test_wait_index_wakes_on_publish(self):
+        """A parked blocking query wakes when a flip advances the
+        apply index past its floor — via the WatchPlane index-listener
+        seam, not by burning its full wait."""
+        sim, plane = _stack()
+        fe = AsyncFrontend(plane).start()
+        try:
+            seen = int(plane.apply_index)
+            fut = fe.wait_index(seen, 10.0)
+            time.sleep(0.05)
+            assert not fut.done()
+            plane.writes.execute([(deltas_mod.OP_REGISTER, 1, 2)])
+            sim.run(8, chunk=8, with_metrics=False)
+            t0 = time.perf_counter()
+            sim.publish_serving()
+            idx = fut.result(5.0)
+            assert time.perf_counter() - t0 < 5.0
+            assert idx > seen
+        finally:
+            fe.close()
+
+
+class TestThreadDiscipline:
+    def test_blocking_queries_park_on_one_thread(self):
+        """N concurrent blocking queries: the threaded path parks N
+        live threads; the async frontend parks N loop timers on its
+        ONE owned thread — strictly fewer live threads."""
+        sim, plane = _stack()
+        n_waiters = 16
+        unreachable = int(plane.apply_index) + 10**6
+
+        # Threaded: each concurrent blocking query is a parked thread.
+        before = threading.active_count()
+        threads = [
+            threading.Thread(
+                target=plane.watch.wait_index, args=(unreachable, 0.8))
+            for _ in range(n_waiters)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)  # let every waiter park
+        threaded_live = threading.active_count() - before
+        for t in threads:
+            t.join()
+        assert threaded_live >= n_waiters
+
+        # Async: the same concurrency is N futures on one loop thread.
+        before = threading.active_count()
+        fe = AsyncFrontend(plane).start()
+        try:
+            futs = [fe.wait_index(unreachable, 0.3)
+                    for _ in range(n_waiters)]
+            time.sleep(0.1)
+            async_live = threading.active_count() - before
+            assert fe.owned_threads() == 1
+            assert async_live < threaded_live
+            floors = {f.result(30.0) for f in futs}
+            assert floors == {unreachable}  # same floor contract
+        finally:
+            fe.close()
+
+    def test_close_discipline(self):
+        """close() joins the owned thread, later submits raise, and a
+        second close is a no-op."""
+        sim, plane = _stack()
+        fe = AsyncFrontend(plane).start()
+        assert fe.owned_threads() == 1
+        fe.close()
+        assert fe.owned_threads() == 0
+        assert fe.closed
+        with pytest.raises(ServingClosedError):
+            fe.submit_read(kernels.MODE_NEAREST, 0)
+        fe.close()  # idempotent
+
+
+class TestAdmissionParity:
+    def test_reject_policy_surfaces_on_future(self):
+        """Overflow under policy=reject raises ServingOverloadError on
+        the overflowing FUTURE (no synchronous raise point on the
+        loop), mirroring WriteBatcher.submit's bound and counter."""
+        sim, plane = _stack(max_pending=4, policy="reject")
+        fe = AsyncFrontend(plane, max_wait_s=0.5).start()
+        try:
+            futs = [fe.submit_write(deltas_mod.OP_REGISTER, i, 0)
+                    for i in range(6)]
+            outcomes = []
+            for f in futs:
+                try:
+                    outcomes.append(f.result(30.0).status)
+                except ServingOverloadError:
+                    outcomes.append("rejected")
+            assert outcomes.count("rejected") == 2
+            assert outcomes.count("applied") == 4
+            assert plane.writes.rejected == 2
+        finally:
+            fe.close()
+
+    def test_shed_oldest_policy_resolves_shed_result(self):
+        """Overflow under policy=shed_oldest drops the OLDEST pending
+        write: its future resolves to WriteResult(status='shed') — the
+        same visible outcome the threaded batcher gives."""
+        sim, plane = _stack(max_pending=4, policy="shed_oldest")
+        fe = AsyncFrontend(plane, max_wait_s=0.5).start()
+        try:
+            futs = [fe.submit_write(deltas_mod.OP_REGISTER, i, 0)
+                    for i in range(6)]
+            results = [f.result(30.0) for f in futs]
+            statuses = [r.status for r in results]
+            # The two oldest were shed to admit the two newest.
+            assert statuses[:2] == ["shed", "shed"]
+            assert statuses[2:] == ["applied"] * 4
+            assert plane.writes.shed == 2
+        finally:
+            fe.close()
+
+
+class TestHttpSurface:
+    def test_http_listener_serves_and_blocks(self):
+        """serve_http binds a real socket on the SAME loop: agent
+        self, KV PUT/GET round-trip with X-Consul-Index, and a short
+        blocking query that rides ?index= + ?wait=."""
+        import http.client
+        import json
+
+        sim, plane = _stack()
+        fe = AsyncFrontend(plane).start()
+        try:
+            host, port = fe.serve_http("127.0.0.1", 0)
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+
+            conn.request("GET", "/v1/agent/self")
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            assert resp.status == 200
+            assert body["Config"]["NodeName"] == "serving-frontend"
+
+            conn.request("PUT", "/v1/kv/http/smoke", body="7")
+            assert conn.getresponse().read() == b"true"
+            # The PUT becomes readable at the next published flip.
+            sim.run(8, chunk=8, with_metrics=False)
+            sim.publish_serving()
+            conn.request("GET", "/v1/kv/http/smoke")
+            resp = conn.getresponse()
+            rows = json.loads(resp.read())
+            idx = int(resp.getheader("X-Consul-Index"))
+            assert rows[0]["Key"] == "http/smoke"
+            assert idx >= 1
+
+            # Blocking query at the current index: times out at the
+            # short ?wait= and re-serves with the index header intact.
+            conn.request("GET", f"/v1/kv/http/smoke?index={idx}&wait=60ms")
+            resp = conn.getresponse()
+            resp.read()
+            assert int(resp.getheader("X-Consul-Index")) >= idx
+            conn.close()
+            assert fe.stats()["frontend_http"] >= 4
+        finally:
+            fe.close()

@@ -1,0 +1,193 @@
+"""Game-day soak harness (consul_tpu/gameday): the SLO verdict
+contract at smoke scale, preemption/resume at phase boundaries, the
+pure SLO gate, and the CPU-scale acceptance soak (slow tier).
+
+The acceptance criteria this file pins (ISSUE: million-user game day):
+``lost_writes == 0`` via X-Consul-Index continuity across a leader
+kill, bounded ``max_time_to_heal_ticks``, the full composed
+Partition+ChurnWave+RaftKill timeline on the compiled schedule, and
+the async frontend serving the same workload with strictly fewer
+threads than one-thread-per-blocking-query would need.
+"""
+
+import json
+import os
+
+import pytest
+
+from consul_tpu.gameday import (GamedayConfig, PHASES, SloThresholds,
+                                evaluate, load_goldens, run_gameday)
+
+
+def _tiny(**kw):
+    base = dict(n=128, view_degree=8, watchers=32, watch_queue=8,
+                kv_slots=256, read_batch=64, warmup_ticks=32,
+                ticks_per_round=16, steady_rounds=1, fault_rounds=2,
+                heal_rounds=1, drain_rounds=2, dcn_islands=0)
+    base.update(kw)
+    return GamedayConfig(**base)
+
+
+class _TrapAfter:
+    """SignalTrap stand-in that fires once a named phase completes —
+    deterministic preemption at a phase boundary, no real signal."""
+
+    def __init__(self, phase: str):
+        self.fired = None
+        self._phase = phase
+
+    def note(self, rec: dict) -> None:
+        if rec.get("gameday") == self._phase:
+            self.fired = 15
+
+
+class TestSloGate:
+    """slo.evaluate is pure host code: gate logic without a soak."""
+
+    def test_pass_within_thresholds(self):
+        v = evaluate({"p99_read_ms": 1.0, "p99_write_ms": 2.0,
+                      "p99_watch_ms": 3.0, "lost_writes": 0,
+                      "max_time_to_heal_ticks": 100,
+                      "watch_delivery_lag": 0, "shed": 5,
+                      "rejected": 0})
+        assert v["pass"] is True and v["violations"] == []
+
+    def test_lost_write_is_a_violation(self):
+        v = evaluate({"p99_read_ms": 1.0, "p99_write_ms": 1.0,
+                      "p99_watch_ms": 1.0, "lost_writes": 1,
+                      "max_time_to_heal_ticks": 10,
+                      "watch_delivery_lag": 0, "shed": 0,
+                      "rejected": 0})
+        assert v["pass"] is False
+        assert any("lost_writes" in s for s in v["violations"])
+
+    def test_unmeasured_gated_key_fails(self):
+        """A gated quantity that was never measured is a violation —
+        'we didn't measure it' must never read as 'it passed'. Both an
+        absent key and an explicit None fail the gate."""
+        base = {"p99_read_ms": 1.0, "p99_write_ms": 1.0,
+                "p99_watch_ms": 1.0, "max_time_to_heal_ticks": 10,
+                "watch_delivery_lag": 0, "shed": 0, "rejected": 0}
+        v = evaluate(base)  # lost_writes absent entirely
+        assert v["pass"] is False
+        assert any("not measured" in s for s in v["violations"])
+        v2 = evaluate(dict(base, lost_writes=None))
+        assert v2["pass"] is False
+        assert any("lost_writes" in s for s in v2["violations"])
+
+    def test_none_limit_reports_without_gating(self):
+        """max_shed=None (the default) reports shed without failing."""
+        measured = {"p99_read_ms": 1.0, "p99_write_ms": 1.0,
+                    "p99_watch_ms": 1.0, "lost_writes": 0,
+                    "max_time_to_heal_ticks": 10,
+                    "watch_delivery_lag": 0, "shed": 10**6,
+                    "rejected": 10**6}
+        assert evaluate(measured)["pass"] is True
+        assert evaluate(measured,
+                        SloThresholds(max_shed=0))["pass"] is False
+
+    def test_goldens_load(self):
+        g = load_goldens()
+        assert g["topology"]["max_time_to_heal"] > 0
+        assert g["raft"]["max_commit_ticks_p99"] > 0
+
+
+class TestGamedaySmoke:
+    def test_threaded_verdict_contract(self):
+        """One tiny full soak: every phase runs, the verdict passes,
+        and the write-continuity audit holds (lost_writes == 0 across
+        the composed Partition+ChurnWave+RaftKill window)."""
+        v = run_gameday(_tiny())
+        assert v["pass"] is True, v["violations"]
+        assert v["phases"] == list(PHASES)
+        assert v["drained"] is True
+        assert v["lost_writes"] == 0
+        assert v["ledger"]["written"] > 0
+        assert v["ledger"]["acked"] == v["ledger"]["written"]
+        assert v["ledger"]["readback_misses"] == 0
+        assert v["ledger"]["index_regressions"] == 0
+        # The composed chaos actually ran and healed within bounds.
+        assert v["chaos"] is not None
+        assert 0 <= v["chaos"]["time_to_heal"] <= 4096
+        # Watch plane: every registered watcher saw flips.
+        assert v["watchers"] >= 32
+        assert v["flips"] > 0 and v["deliveries"] > 0
+        assert v["watch_delivery_lag"] == 0
+        # Raft tier was armed and committed the client entries.
+        assert v["raft"] is not None
+        assert sum(v["raft"]["committed_clients"]) >= v["ledger"]["acked"]
+        # JSON-stable: the whole verdict must serialize (bench _emit).
+        json.dumps(v)
+
+    def test_preempt_and_resume(self, tmp_path):
+        """SIGTERM after the steady phase: partial failing verdict with
+        resume state on disk; the rerun continues from the boundary —
+        never re-running warmup/steady — and passes. A completed soak
+        retires its manifest so the NEXT run starts fresh."""
+        rd = str(tmp_path / "gd")
+        trap = _TrapAfter("steady")
+        v1 = run_gameday(_tiny(resume_dir=rd), trap=trap,
+                         emit=trap.note)
+        assert v1["preempted"] is True
+        assert v1["pass"] is False
+        assert v1["phases"] == ["warmup", "steady"]
+        assert any("preempted" in s for s in v1["violations"])
+        manifest = os.path.join(rd, "gameday_manifest.json")
+        assert os.path.exists(manifest)
+
+        v2 = run_gameday(_tiny(resume_dir=rd))
+        assert v2["pass"] is True, v2["violations"]
+        assert v2["phases"] == list(PHASES)
+        assert v2["lost_writes"] == 0
+        # Ledger writes acked before the preemption stayed acked and
+        # readable after the restore (the write-state checkpoint).
+        assert v2["ledger"]["acked"] == v2["ledger"]["written"] > 0
+        assert not os.path.exists(manifest)
+
+    def test_resume_ident_mismatch_starts_fresh(self, tmp_path):
+        """A manifest saved under a different config shape must not be
+        resumed — the rerun starts from zero instead of restoring
+        checkpoints with foreign shapes."""
+        rd = str(tmp_path / "gd")
+        trap = _TrapAfter("warmup")
+        run_gameday(_tiny(resume_dir=rd), trap=trap, emit=trap.note)
+        assert os.path.exists(os.path.join(rd, "gameday_manifest.json"))
+        v = run_gameday(_tiny(n=64, view_degree=8, watchers=8,
+                              resume_dir=rd))
+        assert v["phases"] == list(PHASES)
+        assert v["pass"] is True, v["violations"]
+
+
+@pytest.mark.slow
+class TestGamedayAcceptance:
+    def test_cpu_scale_soak(self):
+        """The ISSUE acceptance soak: n>=4096, >=2 DC islands, >=1k
+        watchers, the composed Partition+ChurnWave+RaftKill timeline —
+        SLO verdict with lost_writes == 0 and bounded heal time."""
+        cfg = GamedayConfig(n=4096, watchers=1024, dcn_islands=2,
+                            steady_rounds=2, fault_rounds=4,
+                            heal_rounds=2, drain_rounds=3)
+        v = run_gameday(cfg)
+        assert v["pass"] is True, v["violations"]
+        assert v["phases"] == list(PHASES)
+        assert v["lost_writes"] == 0
+        assert v["watchers"] >= 1024
+        assert v["chaos"] is not None
+        assert 0 <= v["chaos"]["time_to_heal"] <= 4096
+        assert v["dcn"] is not None and v["dcn"]["converged"]
+
+    def test_async_frontend_same_workload_fewer_threads(self):
+        """Async-frontend parity at soak scale: the same tiny workload
+        through the async driver passes the same gate, audits the same
+        ledger, and the event loop owns exactly ONE thread."""
+        vt = run_gameday(_tiny())
+        va = run_gameday(_tiny(frontend="async"))
+        assert va["pass"] is True, va["violations"]
+        assert va["frontend"] == "async"
+        assert va["ledger"]["written"] == vt["ledger"]["written"]
+        assert va["ledger"]["acked"] == vt["ledger"]["acked"]
+        assert va["lost_writes"] == vt["lost_writes"] == 0
+        # One owned loop thread multiplexes what the threaded model
+        # would park one-thread-per-blocking-query for.
+        assert va["frontend_threads"] == 1
+        assert vt["frontend_threads"] == 0

@@ -1,0 +1,57 @@
+"""Worst-case SLO regression alarm (gameday/goldens.py + the
+checked-in ``slo_goldens.json``): re-measure the two golden probes at
+their stored configs and fail fast when a PR degrades either past its
+tolerance — in tier-1, not in a multi-hour soak.
+
+- topology: ``worst_case`` heal-time argmax over the standard
+  partition scenario grid at a fixed (n, degree, S) point.
+- raft: commit-visibility p99 (ticks, chunk-quantized) for proposed
+  writes — the quorum-commit path the game-day lost-writes gate
+  rides.
+
+The goldens are DATA: a deliberate protocol change re-measures and
+re-commits ``slo_goldens.json`` (python -m consul_tpu.gameday.goldens
+prints fresh values); this test only guards against silent drift.
+"""
+
+from consul_tpu.gameday import load_goldens
+from consul_tpu.gameday.goldens import (measure_raft_commit,
+                                        measure_topology)
+
+
+def _cfg(golden: dict, keys: tuple) -> dict:
+    return {k: golden[k] for k in keys}
+
+
+class TestGoldenTopology:
+    def test_worst_case_heal_within_tolerance(self):
+        g = load_goldens()["topology"]
+        m = measure_topology(**_cfg(g, ("n", "degree", "scenarios",
+                                        "settle", "chunk", "seed")))
+        assert m["time_to_heal"] <= g["max_time_to_heal"], (
+            f"worst-case heal regressed: {m['time_to_heal']} ticks > "
+            f"tolerance {g['max_time_to_heal']} (golden "
+            f"{g['time_to_heal']}); if deliberate, re-measure and "
+            f"update consul_tpu/gameday/slo_goldens.json")
+        assert m["false_positive_deaths"] <= \
+            g["max_false_positive_deaths"]
+        assert m["time_to_first_suspect"] <= \
+            g["max_time_to_first_suspect"]
+        # Healed at all: the sweep's settle window was long enough.
+        assert m["time_to_heal"] >= 0
+
+
+class TestGoldenRaftCommit:
+    def test_commit_visibility_within_tolerance(self):
+        g = load_goldens()["raft"]
+        m = measure_raft_commit(**_cfg(g, ("n", "groups", "peers",
+                                           "window", "probes",
+                                           "rchunk", "seed")))
+        assert m["all_committed"], (
+            "golden raft probe failed to commit — the quorum-commit "
+            "path the game-day lost-writes gate depends on is broken")
+        assert m["commit_ticks_p99"] <= g["max_commit_ticks_p99"], (
+            f"commit visibility regressed: p99 {m['commit_ticks_p99']} "
+            f"ticks > tolerance {g['max_commit_ticks_p99']} (golden "
+            f"{g['commit_ticks_p99']}); if deliberate, re-measure and "
+            f"update consul_tpu/gameday/slo_goldens.json")
