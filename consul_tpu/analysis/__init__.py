@@ -40,18 +40,40 @@ TH106       mutable default argument anywhere in the package
 TH107       module-level mutable state read inside traced code
 TH108       host-tier retry loop with a bare constant ``time.sleep``
             and no bound/backoff anywhere in the package
+TH109       data-dependent scatter in traced code
+TH110       sharding-less device placement in a mesh-handling path
+TH111       hand-widened packed state field in traced code
+TH112       wall-clock subtraction used as a duration
+TH113       unbounded thread spawn in host serving/gameday tiers
+TH114       inconsistently guarded attribute write (guarded-by
+            inference over per-class lock inventories)
+TH115       lock-order cycle / non-reentrant re-acquire (static
+            inter-procedural acquired-while-holding graph)
+TH116       ``Condition.wait()`` outside a while-predicate loop
+TH117       blocking call (device transfer, socket/file I/O,
+            no-timeout ``Queue.get``, subprocess) under a held lock
 ==========  ==========================================================
+
+TH114-TH117 are the host-tier concurrency rules
+(:mod:`~consul_tpu.analysis.concurrency`); their runtime twin is the
+:class:`~consul_tpu.analysis.ledger.LockLedger` — a monkeypatch-free
+``threading`` shim (same idiom as CompileLedger) that traces real
+acquisitions at test time, asserts the observed order graph acyclic,
+and drives a seeded interleaving fuzzer.
 """
 
 from consul_tpu.analysis.allowlist import (Allowlist, AllowlistError,
                                            load_allowlist)
 from consul_tpu.analysis.engine import (Finding, LintReport,
                                         default_allowlist_path,
-                                        lint_package, lint_sources)
+                                        lint_package, lint_sources,
+                                        package_lock_graph)
+from consul_tpu.analysis.ledger import LockLedger, LockLedgerError
 from consul_tpu.analysis.rules import RULES
 
 __all__ = [
-    "Allowlist", "AllowlistError", "Finding", "LintReport", "RULES",
+    "Allowlist", "AllowlistError", "Finding", "LintReport",
+    "LockLedger", "LockLedgerError", "RULES",
     "default_allowlist_path", "lint_package", "lint_sources",
-    "load_allowlist",
+    "load_allowlist", "package_lock_graph",
 ]

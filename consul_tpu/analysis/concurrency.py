@@ -1,0 +1,684 @@
+"""Host-tier lock-discipline pass (TH114-TH117).
+
+The reference Consul leans on ``go test -race``; this rebuild's host
+tier is ~33 ``threading`` lock sites with no race tooling. This pass
+rides the engine's :class:`~consul_tpu.analysis.engine.ModuleIndex`
+and closes that gap statically:
+
+- **Per-class lock inventory**: attributes assigned
+  ``threading.Lock()`` / ``RLock()`` / ``Condition()`` (or the
+  :mod:`consul_tpu.analysis.ledger` factory equivalents), plus
+  module-level and function-local locks.  A ``Condition(self._lock)``
+  is recorded as an *alias* of the lock it wraps, so holding either
+  counts as holding both.
+
+- **TH114 — guarded-by inference**: for every lock-owning class, each
+  write to a plain ``self.attr`` is classified by the set of class
+  locks held (lexically, plus the guard a private method *inherits*
+  when every internal call site holds the same lock).  An attribute
+  written both under a lock and without one is inconsistently guarded;
+  an unguarded read-modify-write (``self.x += 1``,
+  ``self.xs.append(...)``) in a class that owns a Lock/RLock is a lost
+  update waiting for a second thread.  ``__init__``/``__new__`` are
+  exempt (no concurrent publication yet).
+
+- **TH115 — lock-ordering cycles**: a global digraph of "acquired B
+  while holding A" edges, collected lexically from nested ``with``
+  blocks and inter-procedurally through call summaries (a call made
+  under a lock contributes every lock the callee may acquire).  Any
+  cycle is a potential deadlock; nesting a non-reentrant lock inside
+  itself is reported directly.
+
+- **TH116 — Condition.wait without a predicate loop**: ``cond.wait()``
+  must sit inside a ``while`` that re-checks its predicate (spurious
+  wakeups, stolen wakeups); ``wait_for`` carries its own loop and is
+  always fine.
+
+- **TH117 — blocking call under a lock**: device transfers
+  (``jax.device_get``/``device_put``/``jnp.*``/``block_until_ready``),
+  socket and file I/O, zero-timeout ``Queue.get()``, ``time.sleep``
+  and ``subprocess`` executed while any lock is held serialize every
+  other acquirer behind host-side latency.
+
+Documented narrowings (COVERAGE.md "Concurrency analysis"): writes
+through subscripts (``self.d[k] = v``) and attribute chains
+(``self.a.b += 1``) are not tracked; cross-object lock identity is
+only unified when the attribute name is a package-unique lock
+(``write_lock``); generator-based ``with store.transaction():`` holds
+are invisible; lock-*ish* names (containing ``lock``/``mutex``/
+``cond``) that cannot be resolved participate in held-ness (TH117)
+but never in order edges (TH115).  The dynamic
+:class:`~consul_tpu.analysis.ledger.LockLedger` covers the runtime
+side of the same contracts.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+# Calls that *create* a lock. Values: lock kind.
+LOCK_FACTORIES = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+    "consul_tpu.analysis.ledger.make_lock": "lock",
+    "consul_tpu.analysis.ledger.make_rlock": "rlock",
+    "consul_tpu.analysis.ledger.make_condition": "condition",
+    "consul_tpu.analysis.guards.make_lock": "lock",
+    "consul_tpu.analysis.guards.make_rlock": "rlock",
+    "consul_tpu.analysis.guards.make_condition": "condition",
+}
+
+# Container-mutating method names treated as writes to the receiver.
+MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "remove",
+    "pop", "popleft", "popitem", "clear", "update", "add", "discard",
+    "setdefault", "rotate", "sort", "reverse",
+})
+
+# Blocking calls by resolved dotted fqname.
+BLOCKING_FQ = frozenset({
+    "jax.device_get", "jax.device_put", "jax.block_until_ready",
+    "time.sleep", "socket.create_connection", "socket.create_server",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+})
+# Resolved-prefix blocking families: any jnp constructor/transfer.
+BLOCKING_FQ_PREFIXES = ("jax.numpy.",)
+# Blocking calls by bare attribute name (socket methods, device sync).
+BLOCKING_ATTRS = frozenset({
+    "sendall", "recv", "recv_into", "recvfrom", "accept",
+    "block_until_ready",
+})
+
+_LOCKISH = ("lock", "mutex", "cond")
+
+
+def _is_lockish(name: str) -> bool:
+    low = name.lower()
+    return any(tok in low for tok in _LOCKISH)
+
+
+def _receiver_attr(node):
+    """('self'|'cls', attr) for a plain ``self.X`` / ``cls.X``."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id in ("self", "cls"):
+        return node.value.id, node.attr
+    return None
+
+
+def _dotted_tail(node) -> Optional[str]:
+    """Last segment of a dotted Name/Attribute chain, else None."""
+    while isinstance(node, ast.Attribute):
+        tail = node.attr
+        node = node.value
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if isinstance(node, ast.Name):
+                return tail
+            continue
+        return None
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, modname: str, qual: str, node: ast.ClassDef):
+        self.modname = modname
+        self.qual = qual                  # dotted, e.g. "Outer.Inner"
+        self.node = node
+        self.locks: dict = {}             # attr -> kind
+        self.aliases: dict = {}           # cond attr -> wrapped lock attr
+        self.methods: dict = {}           # name -> FunctionDef node
+
+    def key_of(self, attr: str) -> str:
+        return f"{self.modname}.{self.qual}.{self.canonical(attr)}"
+
+    def canonical(self, attr: str) -> str:
+        seen = set()
+        while attr in self.aliases and attr not in seen:
+            seen.add(attr)
+            attr = self.aliases[attr]
+        return attr
+
+
+class _FnInfo:
+    def __init__(self, mod, qual: str, node, cls: Optional[_ClassInfo]):
+        self.mod = mod
+        self.qual = qual                  # module-local dotted qualname
+        self.fq = f"{mod.modname}.{qual}"
+        self.node = node
+        self.cls = cls
+        self.local_locks: dict = {}       # local/param name -> kind
+        self.acquired: set = set()        # canonical keys taken lexically
+        self.edges: list = []             # (held_key, taken_key, node)
+        self.self_deadlocks: list = []    # (key, node)
+        self.writes: list = []            # (attr, heldset, node, kind)
+        self.calls: list = []             # (target, heldkeys, node)
+        self.self_calls: list = []        # (name, class_locks_held, node)
+        self.blockers: list = []          # (desc, node, heldkeys)
+        self.waits: list = []             # (node, has_loop, is_wait_for)
+
+
+class _Pass:
+    """Whole-package state: inventories then per-function walks."""
+
+    def __init__(self, modules):
+        self.modules = modules
+        self.classes: dict = {}           # (modname, qual) -> _ClassInfo
+        self.module_locks: dict = {}      # modname -> {name: kind}
+        self.cond_attr_names: set = set()  # all condition attr names
+        self.attr_owners: dict = {}       # lock attr name -> [class keys]
+        self.infos: dict = {}             # fq -> _FnInfo
+        self.findings: list = []
+
+    # -- pass 1: inventory ----------------------------------------------
+    def inventory(self):
+        for mod in self.modules:
+            locks = {}
+            for stmt in mod.tree.body:
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    kind = self._factory_kind(mod, stmt.value, None)
+                    if kind:
+                        locks[stmt.targets[0].id] = kind
+            self.module_locks[mod.modname] = locks
+            self._collect_classes(mod, mod.tree, prefix="")
+        for cls in self.classes.values():
+            for attr, kind in cls.locks.items():
+                if kind == "condition":
+                    self.cond_attr_names.add(attr)
+                base = cls.canonical(attr)
+                self.attr_owners.setdefault(attr, []).append(
+                    (f"{cls.modname}.{cls.qual}.{base}", cls.locks[base]))
+
+    def _factory_kind(self, mod, value, fn_node) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        fq = mod.resolve(value.func, fn_node)
+        return LOCK_FACTORIES.get(fq) if fq else None
+
+    def _collect_classes(self, mod, tree, prefix: str):
+        for node in ast.iter_child_nodes(tree):
+            if isinstance(node, ast.ClassDef):
+                qual = f"{prefix}{node.name}"
+                cls = _ClassInfo(mod.modname, qual, node)
+                self.classes[(mod.modname, qual)] = cls
+                for stmt in node.body:
+                    # class-body locks (the CompileLedger idiom)
+                    if isinstance(stmt, ast.Assign) \
+                            and len(stmt.targets) == 1 \
+                            and isinstance(stmt.targets[0], ast.Name):
+                        kind = self._factory_kind(mod, stmt.value, None)
+                        if kind:
+                            cls.locks[stmt.targets[0].id] = kind
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        cls.methods[stmt.name] = stmt
+                        self._scan_lock_attrs(mod, cls, stmt)
+                self._collect_classes(mod, node, prefix=qual + ".")
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested classes inside functions: out of scope
+            else:
+                self._collect_classes(mod, node, prefix=prefix)
+
+    def _scan_lock_attrs(self, mod, cls: _ClassInfo, meth):
+        for node in ast.walk(meth):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            recv = _receiver_attr(node.targets[0])
+            if recv is None:
+                continue
+            kind = self._factory_kind(mod, node.value, meth)
+            if not kind:
+                continue
+            cls.locks[recv[1]] = kind
+            if kind == "condition" and isinstance(node.value, ast.Call):
+                args = list(node.value.args)
+                for kw in node.value.keywords:
+                    if kw.arg == "lock":
+                        args.append(kw.value)
+                for arg in args:
+                    wrapped = _receiver_attr(arg)
+                    if wrapped is not None:
+                        cls.aliases[recv[1]] = wrapped[1]
+                        break
+
+    # -- pass 2: function walks -----------------------------------------
+    def walk_functions(self):
+        fn_to_class: dict = {}
+        for cls in self.classes.values():
+            for meth in cls.methods.values():
+                fn_to_class[id(meth)] = cls
+        for mod in self.modules:
+            for qual, fn in mod.functions.items():
+                cls = fn_to_class.get(id(fn))
+                info = _FnInfo(mod, qual, fn, cls)
+                self.infos[info.fq] = info
+                self._collect_local_locks(mod, info)
+                for stmt in fn.body:
+                    self._walk(info, stmt, held=(), loops=())
+
+    def _collect_local_locks(self, mod, info: _FnInfo):
+        args = info.node.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            if _is_lockish(a.arg):
+                info.local_locks[a.arg] = "lock"
+        for stmt in info.node.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                kind = self._factory_kind(mod, stmt.value, info.node)
+                if kind:
+                    info.local_locks[stmt.targets[0].id] = kind
+
+    def _lock_key(self, info: _FnInfo, expr):
+        """(key, kind) for a with-subject, (None, None) if not a lock.
+        key '?' marks an unresolvable lock-ish expression: it counts as
+        held (TH117) but contributes no order edges (TH115)."""
+        recv = _receiver_attr(expr)
+        if recv is not None and info.cls is not None \
+                and recv[1] in info.cls.locks:
+            base = info.cls.canonical(recv[1])
+            return info.cls.key_of(recv[1]), info.cls.locks[base]
+        if isinstance(expr, ast.Name):
+            if expr.id in info.local_locks:
+                return (f"{info.mod.modname}.{info.qual}.{expr.id}",
+                        info.local_locks[expr.id])
+            fq = info.mod.resolve(expr, info.node)
+            if fq:
+                modname, _, name = fq.rpartition(".")
+                if name in self.module_locks.get(modname, {}):
+                    return fq, self.module_locks[modname][name]
+        tail = _dotted_tail(expr)
+        if tail is None:
+            return None, None
+        # a package-unique lock attribute unifies cross-object holds
+        # (self.plane.write_lock in writes.py IS ServingPlane.write_lock)
+        owners = self.attr_owners.get(tail, ())
+        if len(owners) == 1 and recv is None:
+            return owners[0]
+        if _is_lockish(tail):
+            return "?", "lock"
+        return None, None
+
+    def _class_locks_held(self, info: _FnInfo, held) -> frozenset:
+        if info.cls is None:
+            return frozenset()
+        prefix = f"{info.cls.modname}.{info.cls.qual}."
+        return frozenset(k[len(prefix):] for k in held
+                         if k != "?" and k.startswith(prefix)
+                         and k[len(prefix):] in info.cls.locks)
+
+    def _walk(self, info: _FnInfo, node, held, loops):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return  # nested defs are walked as their own units
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            taken = []
+            for item in node.items:
+                key, kind = self._lock_key(info, item.context_expr)
+                if key is None:
+                    continue
+                if key != "?":
+                    if key in held and kind == "lock":
+                        info.self_deadlocks.append((key, item.context_expr))
+                    for h in held:
+                        if h != "?" and h != key:
+                            info.edges.append((h, key, item.context_expr))
+                    info.acquired.add(key)
+                taken.append(key)
+            inner = held + tuple(taken)
+            for item in node.items:
+                self._walk(info, item.context_expr, held, loops)
+            for stmt in node.body:
+                self._walk(info, stmt, inner, loops)
+            return
+        if isinstance(node, ast.While):
+            self._walk(info, node.test, held, loops)
+            for stmt in node.body + node.orelse:
+                self._walk(info, stmt, held, loops + (node,))
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            kind = "rmw" if isinstance(node, ast.AugAssign) else "assign"
+            for t in targets:
+                elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                for el in elts:
+                    recv = _receiver_attr(el)
+                    if recv is not None:
+                        info.writes.append(
+                            (recv[1], self._class_locks_held(info, held),
+                             el, kind))
+            self._walk(info, node.value, held, loops)
+            return
+        if isinstance(node, ast.Call):
+            self._visit_call(info, node, held, loops)
+        for child in ast.iter_child_nodes(node):
+            self._walk(info, child, held, loops)
+
+    def _visit_call(self, info: _FnInfo, node: ast.Call, held, loops):
+        func = node.func
+        # self.attr.mutator(...) is a write to self.attr
+        if isinstance(func, ast.Attribute) and func.attr in MUTATORS:
+            recv = _receiver_attr(func.value)
+            if recv is not None and info.cls is not None \
+                    and recv[1] not in info.cls.locks:
+                info.writes.append(
+                    (recv[1], self._class_locks_held(info, held),
+                     node, "mutate"))
+        # Condition.wait discipline
+        if isinstance(func, ast.Attribute) \
+                and func.attr in ("wait", "wait_for") \
+                and self._is_condition_recv(info, func.value):
+            info.waits.append((node, bool(loops),
+                               func.attr == "wait_for"))
+        # blocking-call census
+        desc = self._blocking_desc(info, node)
+        if desc is not None and held:
+            info.blockers.append((desc, node, held))
+        elif desc is not None:
+            info.blockers.append((desc, node, ()))
+        # call-graph edges for summaries (self.m() — NOT self.attr.m())
+        if isinstance(func, ast.Attribute):
+            recv = _receiver_attr(func)
+            if recv is not None and info.cls is not None \
+                    and func.attr in info.cls.methods:
+                info.self_calls.append(
+                    (func.attr, self._class_locks_held(info, held), node))
+                info.calls.append(
+                    (f"{info.cls.modname}.{info.cls.qual}.{func.attr}",
+                     held, node))
+                return
+        fq = info.mod.resolve(func, info.node)
+        if fq:
+            info.calls.append((fq, held, node))
+
+    def _is_condition_recv(self, info: _FnInfo, recv) -> bool:
+        r = _receiver_attr(recv)
+        if r is not None:
+            if info.cls is not None and r[1] in info.cls.locks:
+                return info.cls.locks[info.cls.canonical(r[1])] == \
+                    "condition"
+            return r[1] in self.cond_attr_names
+        if isinstance(recv, ast.Name):
+            if recv.id in info.local_locks:
+                return info.local_locks[recv.id] == "condition"
+            return False
+        tail = _dotted_tail(recv)
+        return tail is not None and tail in self.cond_attr_names
+
+    def _blocking_desc(self, info: _FnInfo, node: ast.Call
+                       ) -> Optional[str]:
+        func = node.func
+        if isinstance(func, ast.Name) and func.id == "open" \
+                and info.mod.resolve(func, info.node) is None:
+            return "open()"
+        fq = info.mod.resolve(func, info.node)
+        if fq:
+            if fq in BLOCKING_FQ:
+                return fq
+            if any(fq.startswith(p) for p in BLOCKING_FQ_PREFIXES):
+                return fq
+        if isinstance(func, ast.Attribute):
+            if func.attr in BLOCKING_ATTRS:
+                return f".{func.attr}()"
+            # queue-style blocking get: zero args, no timeout
+            if func.attr == "get" and not node.args and not node.keywords:
+                return ".get() with no timeout"
+        return None
+
+    # -- analyses -------------------------------------------------------
+    def _finding(self, info: _FnInfo, node, rule: str, message: str):
+        from consul_tpu.analysis.engine import Finding
+
+        self.findings.append(Finding(
+            rule=rule, path=info.mod.relpath,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            symbol=info.qual, message=message))
+
+    def run_th114(self):
+        by_class: dict = {}
+        for info in self.infos.values():
+            if info.cls is not None:
+                by_class.setdefault(id(info.cls.node), []).append(info)
+        for infos in by_class.values():
+            self._th114_class(infos[0].cls, infos)
+
+    def _th114_class(self, cls: _ClassInfo, infos):
+        if not cls.locks:
+            return
+        has_real_lock = any(k in ("lock", "rlock")
+                            for k in cls.locks.values())
+        guard = self._inherited_guards(cls, infos)
+        writes: dict = {}   # attr -> [(eff_guard, node, kind, info)]
+        for info in infos:
+            name = info.qual.rsplit(".", 1)[-1]
+            if name in ("__init__", "__new__"):
+                continue
+            inherited = guard.get(name, frozenset())
+            for attr, held, node, kind in info.writes:
+                if attr in cls.locks:
+                    continue
+                eff = frozenset(cls.canonical(a) for a in held) | inherited
+                writes.setdefault(attr, []).append((eff, node, kind, info))
+        for attr, ws in sorted(writes.items()):
+            guarded = sorted({lk for eff, *_ in ws for lk in eff})
+            unguarded = [(node, kind, info) for eff, node, kind, info in ws
+                         if not eff]
+            flagged = set()
+            if guarded and unguarded:
+                for node, kind, info in unguarded:
+                    flagged.add(id(node))
+                    self._finding(
+                        info, node, "TH114",
+                        f"attribute 'self.{attr}' is written under "
+                        f"'self.{guarded[0]}' elsewhere in "
+                        f"{cls.qual} but written here with no lock "
+                        "held — inconsistently guarded state")
+            if not has_real_lock:
+                continue
+            lock_names = sorted(a for a, k in cls.locks.items()
+                                if k in ("lock", "rlock"))
+            for node, kind, info in unguarded:
+                if kind in ("rmw", "mutate") and id(node) not in flagged:
+                    self._finding(
+                        info, node, "TH114",
+                        f"unguarded read-modify-write of 'self.{attr}' "
+                        f"in {cls.qual}, which guards its state with "
+                        f"'self.{lock_names[0]}' — a concurrent writer "
+                        "loses updates; hold the lock (or allowlist a "
+                        "documented single-writer seam)")
+
+    def _inherited_guards(self, cls: _ClassInfo, infos) -> dict:
+        """method name -> lock set every internal call site holds.
+        Public methods (and dunders) anchor at the empty set; private
+        methods start at the full lock set and shrink to the greatest
+        fixpoint over their observed call sites."""
+        sites: dict = {}
+        for info in infos:
+            caller = info.qual.rsplit(".", 1)[-1]
+            for name, held, _node in info.self_calls:
+                sites.setdefault(name, []).append(
+                    (caller, frozenset(cls.canonical(a) for a in held)))
+        all_locks = frozenset(cls.canonical(a) for a in cls.locks)
+        guard = {}
+        for name in cls.methods:
+            private = name.startswith("_") and not (
+                name.startswith("__") and name.endswith("__"))
+            guard[name] = all_locks if (private and sites.get(name)) \
+                else frozenset()
+        for _ in range(len(cls.methods) + 2):
+            changed = False
+            for name, slist in sites.items():
+                if name not in guard or not guard[name]:
+                    continue
+                new = None
+                for caller, held in slist:
+                    eff = held | guard.get(caller, frozenset())
+                    new = eff if new is None else (new & eff)
+                new = new or frozenset()
+                if new != guard[name]:
+                    guard[name] = new
+                    changed = True
+            if not changed:
+                break
+        return guard
+
+    def _summaries(self):
+        """Fixpoint (acquires, blocking) closure over the call graph."""
+        acquires = {fq: set(i.acquired) for fq, i in self.infos.items()}
+        blocking = {fq: {d for d, _n, _h in i.blockers}
+                    for fq, i in self.infos.items()}
+        for _ in range(64):
+            changed = False
+            for fq, info in self.infos.items():
+                for target, _held, _node in info.calls:
+                    if target == fq or target not in self.infos:
+                        continue
+                    if not acquires[target] <= acquires[fq]:
+                        acquires[fq] |= acquires[target]
+                        changed = True
+                    if not blocking[target] <= blocking[fq]:
+                        blocking[fq] |= blocking[target]
+                        changed = True
+            if not changed:
+                break
+        return acquires, blocking
+
+    def run_th115_th117(self):
+        acquires, blocking = self._summaries()
+        edges: dict = {}   # (src, dst) -> (info, node)
+        lock_kind = {}
+        for cls in self.classes.values():
+            for attr, kind in cls.locks.items():
+                lock_kind[cls.key_of(attr)] = cls.locks[cls.canonical(attr)]
+        for modname, locks in self.module_locks.items():
+            for name, kind in locks.items():
+                lock_kind[f"{modname}.{name}"] = kind
+        for info in self.infos.values():
+            for key, node in info.self_deadlocks:
+                self._finding(
+                    info, node, "TH115",
+                    f"'{key}' is re-acquired while already held — a "
+                    "non-reentrant Lock self-deadlocks here")
+            for src, dst, node in info.edges:
+                edges.setdefault((src, dst), (info, node))
+            # interprocedural: a call made under a lock drags in every
+            # lock (and blocker) the callee may reach
+            for target, held, node in info.calls:
+                if not held or target not in self.infos:
+                    continue
+                real = [h for h in held if h != "?"]
+                for h in real:
+                    for k in acquires.get(target, ()):
+                        if k == h:
+                            if lock_kind.get(k) == "lock":
+                                self._finding(
+                                    info, node, "TH115",
+                                    f"call into '{target}' while holding "
+                                    f"'{h}', which it re-acquires — a "
+                                    "non-reentrant Lock self-deadlocks")
+                            continue
+                        edges.setdefault((h, k), (info, node))
+                blocked = blocking.get(target, ())
+                if blocked:
+                    self._finding(
+                        info, node, "TH117",
+                        f"call into '{target}' while holding "
+                        f"{_fmt_locks(held)} — it performs blocking work "
+                        f"({sorted(blocked)[0]}); move the call outside "
+                        "the critical section")
+            for desc, node, held in info.blockers:
+                if held:
+                    self._finding(
+                        info, node, "TH117",
+                        f"blocking call {desc} while holding "
+                        f"{_fmt_locks(held)} — every other acquirer "
+                        "serializes behind it; hoist it out of the "
+                        "critical section")
+        self._cycles(edges)
+        self._edge_list = sorted(
+            (src, dst, i.mod.relpath, getattr(n, "lineno", 0))
+            for (src, dst), (i, n) in edges.items())
+
+    def _cycles(self, edges: dict):
+        adj: dict = {}
+        for (src, dst), _site in edges.items():
+            adj.setdefault(src, set()).add(dst)
+        seen_cycles = set()
+        for start in sorted(adj):
+            path, on_path = [], set()
+
+            def dfs(node):
+                if node in on_path:
+                    cyc = tuple(path[path.index(node):] + [node])
+                    key = frozenset(cyc)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        info, site = edges[(cyc[0], cyc[1])]
+                        self._finding(
+                            info, site, "TH115",
+                            "potential deadlock: lock-order cycle "
+                            + " -> ".join(f"'{c}'" for c in cyc)
+                            + " — two threads taking these locks in "
+                            "opposite orders block forever")
+                    return
+                if node in path_seen:
+                    return
+                path_seen.add(node)
+                path.append(node)
+                on_path.add(node)
+                for nxt in sorted(adj.get(node, ())):
+                    dfs(nxt)
+                path.pop()
+                on_path.discard(node)
+
+            path_seen: set = set()
+            dfs(start)
+
+    def run_th116(self):
+        for info in self.infos.values():
+            for node, has_loop, is_wait_for in info.waits:
+                if is_wait_for or has_loop:
+                    continue
+                self._finding(
+                    info, node, "TH116",
+                    "Condition.wait() outside a while-predicate loop — "
+                    "spurious and stolen wakeups make a bare wait "
+                    "return with the predicate still false; use "
+                    "'while not pred: cond.wait(...)' or wait_for()")
+
+
+def _fmt_locks(held) -> str:
+    real = sorted(h for h in held if h != "?")
+    if real:
+        return "'" + "', '".join(real) + "'"
+    return "a lock"
+
+
+def run_concurrency(modules) -> list:
+    """All TH114-TH117 findings for a set of ModuleIndexes."""
+    p = _Pass(modules)
+    p.inventory()
+    p.walk_functions()
+    p.run_th114()
+    p.run_th116()
+    p.run_th115_th117()
+    return p.findings
+
+
+def lock_order_edges(modules) -> list:
+    """The inferred lock-ordering graph: sorted
+    ``(src_lock, dst_lock, path, line)`` tuples, where ``dst`` was
+    acquired while ``src`` was held. ``consul-tpu lint --verbose``
+    prints these as dot-ish text so TH115 findings are explainable."""
+    p = _Pass(modules)
+    p.inventory()
+    p.walk_functions()
+    p.run_th114()
+    p.run_th116()
+    p.run_th115_th117()
+    return p._edge_list

@@ -17,7 +17,7 @@ import os
 from typing import Iterable, Optional
 
 from consul_tpu.analysis import allowlist as allowlist_mod
-from consul_tpu.analysis import callgraph, rules
+from consul_tpu.analysis import callgraph, concurrency, rules
 
 # Directories (relative to the package) whose modules form the device
 # tier: code in them is presumed to build or run inside compiled
@@ -258,10 +258,8 @@ def _iter_py_files(paths: Iterable[str], root: str):
                         yield os.path.join(dirpath, fn)
 
 
-def lint_sources(sources: dict, allowlist=None) -> LintReport:
-    """Lint in-memory sources: {repo-relative path: source text}.
-    The unit tests drive this; ``lint_package`` is the on-disk
-    wrapper. ``allowlist`` is an :class:`Allowlist` or None."""
+def _build_modules(sources: dict):
+    """Parse sources into ModuleIndexes; syntax errors become TH000."""
     modules = []
     findings = []
     for relpath in sorted(sources):
@@ -275,11 +273,32 @@ def lint_sources(sources: dict, allowlist=None) -> LintReport:
                 message=f"syntax error: {e.msg}"))
             continue
         modules.append(ModuleIndex(relpath, src, tree))
+    return modules, findings
+
+
+def _read_sources(paths, root: Optional[str]):
+    if root is None:
+        root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    sources = {}
+    for full in _iter_py_files(paths, root):
+        rel = os.path.relpath(full, root).replace(os.sep, "/")
+        with open(full, "r", encoding="utf-8") as f:
+            sources[rel] = f.read()
+    return sources
+
+
+def lint_sources(sources: dict, allowlist=None) -> LintReport:
+    """Lint in-memory sources: {repo-relative path: source text}.
+    The unit tests drive this; ``lint_package`` is the on-disk
+    wrapper. ``allowlist`` is an :class:`Allowlist` or None."""
+    modules, findings = _build_modules(sources)
 
     traced = callgraph.traced_functions(modules)
     for mod in modules:
         findings.extend(rules.run_rules(mod, traced.get(mod.modname,
                                                         frozenset())))
+    findings.extend(concurrency.run_concurrency(modules))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
 
     if allowlist is None:
@@ -302,17 +321,18 @@ def lint_package(paths=(PACKAGE,), root: Optional[str] = None,
     """Lint on-disk trees. ``paths`` are files or directories relative
     to ``root`` (default: the repo root inferred as the parent of this
     package). The checked-in allowlist applies unless disabled."""
-    if root is None:
-        root = os.path.dirname(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    sources = {}
-    for full in _iter_py_files(paths, root):
-        rel = os.path.relpath(full, root).replace(os.sep, "/")
-        with open(full, "r", encoding="utf-8") as f:
-            sources[rel] = f.read()
+    sources = _read_sources(paths, root)
     allowlist = None
     if use_allowlist:
         path = allowlist_path or default_allowlist_path()
         if os.path.exists(path):
             allowlist = allowlist_mod.load_allowlist(path)
     return lint_sources(sources, allowlist)
+
+
+def package_lock_graph(paths=(PACKAGE,), root: Optional[str] = None):
+    """The inferred lock-ordering graph for on-disk trees: sorted
+    ``(src_lock, dst_lock, path, line)`` tuples (``consul-tpu lint
+    --verbose`` renders these as dot-ish text)."""
+    modules, _ = _build_modules(_read_sources(paths, root))
+    return concurrency.lock_order_edges(modules)

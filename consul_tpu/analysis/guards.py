@@ -22,6 +22,14 @@ Two teeth, both monkeypatch-free:
   which is exactly the tier discipline the lint rules prescribe: all
   boundary crossings are spelled out, at the chunk boundary.
 
+The third tooth lives in :mod:`consul_tpu.analysis.ledger` and is
+re-exported here: :class:`LockLedger`, the lock-discipline twin of
+CompileLedger — host modules build their locks through
+``make_lock``/``make_rlock``/``make_condition`` and get plain
+``threading`` primitives in production, traced shims under a test
+ledger. It stays in its own jax-free module so the host tier can
+import the factories without dragging jax in.
+
 This module needs jax and is therefore *not* imported by the static
 lint layer (``consul_tpu.analysis`` stays importable without jax).
 """
@@ -32,6 +40,10 @@ import contextlib
 import threading
 
 import jax
+
+from consul_tpu.analysis.ledger import (  # noqa: F401 (re-export)
+    LockLedger, LockLedgerError, blocking, make_condition, make_lock,
+    make_rlock)
 
 # The monitoring event XLA's compile path records once per executable
 # actually compiled (jax 0.4.x: pxla/dispatch both route through it).

@@ -1,0 +1,307 @@
+"""LockLedger: the dynamic half of the lock-discipline pass.
+
+The static rules (TH114-TH117 in :mod:`consul_tpu.analysis.concurrency`)
+reason about code; this module watches the locks actually taken at test
+time. It is deliberately monkeypatch-free, mirroring the CompileLedger
+idiom in :mod:`consul_tpu.analysis.guards`: production modules build
+their locks through :func:`make_lock` / :func:`make_rlock` /
+:func:`make_condition`, which return *plain* ``threading`` primitives
+unless a :class:`LockLedger` is installed — zero overhead outside
+tests, full acquisition tracing inside them.
+
+While installed, the ledger records, per acquisition: lock name, thread,
+and the stack of ledger locks that thread already holds. From those it
+maintains the observed lock-order graph ("B taken while A held") and
+checks it for cycles *as edges appear*, so an AB/BA inversion is caught
+on the first run that exercises both sides — no actual deadlock needed.
+:meth:`blocking` brackets known-slow work (device transfers, socket
+I/O) and records a violation if any ledger lock is held across it — the
+runtime twin of TH117.
+
+``fuzz(seed)`` arms a seeded-schedule perturber: each blocking acquire
+first sleeps a deterministic pseudo-random sliver (up to ~250us drawn
+from ``random.Random(seed)``), widening race windows so seeded runs
+explore different interleavings while staying reproducible.
+
+This module must stay importable without jax (the static lint layer
+imports nothing from here at runtime, but tests and host modules do).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import random
+
+
+class LockLedgerError(AssertionError):
+    """A lock-discipline violation observed at runtime."""
+
+
+class _Held(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+class LockLedger:
+    """Records real lock acquisitions and asserts discipline.
+
+    Usage (see the ``lock_ledger`` fixture in tests/conftest.py)::
+
+        ledger = LockLedger()
+        with ledger:              # or ledger.install() / .uninstall()
+            ledger.fuzz(seed=3)   # optional schedule perturbation
+            ... exercise code built on make_lock()/make_condition() ...
+        ledger.assert_clean()
+    """
+
+    _active = None
+    _active_guard = threading.Lock()
+
+    def __init__(self):
+        self._guard = threading.Lock()
+        self._held = _Held()
+        self.acquisitions = []   # (lock_name, thread_name, held_tuple)
+        self.edges = {}          # (src, dst) -> first (thread, heldrepr)
+        self.violations = []     # human-readable strings
+        self._rng = None
+        self._max_jitter_s = 0.0
+
+    # -- install / uninstall -------------------------------------------
+    def install(self):
+        cls = type(self)
+        with cls._active_guard:
+            if cls._active is not None and cls._active is not self:
+                raise LockLedgerError("another LockLedger is installed")
+            cls._active = self
+        return self
+
+    def uninstall(self):
+        cls = type(self)
+        with cls._active_guard:
+            if cls._active is self:
+                cls._active = None
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # -- seeded-schedule fuzzing ---------------------------------------
+    def fuzz(self, seed, max_jitter_us=250):
+        """Arm deterministic acquisition jitter drawn from ``seed``."""
+        self._rng = random.Random(seed)
+        self._max_jitter_s = max_jitter_us / 1e6
+        return self
+
+    # -- hooks called by the shim primitives ---------------------------
+    def _before_acquire(self, name, blocking_acquire):
+        held = list(self._held.stack)
+        if self._rng is not None and blocking_acquire:
+            time.sleep(self._rng.random() * self._max_jitter_s)
+        if not blocking_acquire or name in held:
+            # try-locks add no order constraint; reentrant re-acquires
+            # (RLock) add no new edge either
+            return
+        new_edges = [(h, name) for h in held
+                     if h != name and (h, name) not in self.edges]
+        if not new_edges:
+            return
+        with self._guard:
+            for edge in new_edges:
+                if edge not in self.edges:
+                    self.edges[edge] = (
+                        threading.current_thread().name, tuple(held))
+                    cyc = self._find_cycle_locked(edge[0])
+                    if cyc:
+                        self.violations.append(
+                            "lock-order cycle observed: "
+                            + " -> ".join(repr(c) for c in cyc))
+
+    def _after_acquire(self, name, acquired):
+        if not acquired:
+            return
+        self._held.stack.append(name)
+        with self._guard:
+            self.acquisitions.append(
+                (name, threading.current_thread().name,
+                 tuple(self._held.stack[:-1])))
+
+    def _after_release(self, name):
+        stack = self._held.stack
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                return
+
+    # -- TH117 runtime twin --------------------------------------------
+    def blocking(self, what):
+        """Context manager flagging ``what`` if entered under a lock."""
+        return _BlockingRegion(self, what)
+
+    # -- inspection / assertions ---------------------------------------
+    def order_edges(self):
+        """Sorted observed (held_lock, then_acquired) pairs."""
+        with self._guard:
+            return sorted(self.edges)
+
+    def _find_cycle_locked(self, start):
+        adj = {}
+        for src, dst in self.edges:
+            adj.setdefault(src, []).append(dst)
+        path, on_path = [], set()
+
+        def dfs(node):
+            if node in on_path:
+                return path[path.index(node):] + [node]
+            if node in visited:
+                return None
+            visited.add(node)
+            path.append(node)
+            on_path.add(node)
+            for nxt in sorted(adj.get(node, ())):
+                found = dfs(nxt)
+                if found:
+                    return found
+            path.pop()
+            on_path.discard(node)
+            return None
+
+        visited = set()
+        return dfs(start)
+
+    def find_cycle(self):
+        with self._guard:
+            for src, _dst in sorted(self.edges):
+                cyc = self._find_cycle_locked(src)
+                if cyc:
+                    return cyc
+        return None
+
+    def assert_acyclic(self):
+        cyc = self.find_cycle()
+        if cyc:
+            raise LockLedgerError(
+                "observed lock-order graph has a cycle: "
+                + " -> ".join(repr(c) for c in cyc))
+
+    def assert_clean(self):
+        """No violations, acyclic order graph, nothing still held."""
+        if self.violations:
+            raise LockLedgerError(
+                "%d lock-discipline violation(s):\n  " % len(self.violations)
+                + "\n  ".join(self.violations))
+        self.assert_acyclic()
+        if self._held.stack:
+            raise LockLedgerError(
+                "locks still held at ledger teardown: %r"
+                % (self._held.stack,))
+
+
+class _BlockingRegion:
+    def __init__(self, ledger, what):
+        self.ledger = ledger
+        self.what = what
+
+    def __enter__(self):
+        held = list(self.ledger._held.stack)
+        if held:
+            with self.ledger._guard:
+                self.ledger.violations.append(
+                    "blocking region %r entered while holding %r"
+                    % (self.what, held))
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _LedgerLock:
+    """threading.Lock/RLock shim reporting to the installed ledger."""
+
+    def __init__(self, name, inner):
+        self.name = name
+        self._inner = inner
+
+    def acquire(self, blocking=True, timeout=-1):
+        ledger = LockLedger._active
+        if ledger is not None:
+            ledger._before_acquire(self.name, blocking and timeout == -1)
+        got = self._inner.acquire(blocking, timeout)
+        if ledger is not None:
+            ledger._after_acquire(self.name, got)
+        return got
+
+    def release(self):
+        self._inner.release()
+        ledger = LockLedger._active
+        if ledger is not None:
+            ledger._after_release(self.name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # threading.Condition duck-types its lock through these three; by
+    # NOT defining _release_save/_acquire_restore/_is_owned we force
+    # Condition onto its acquire/release fallbacks, which route through
+    # the shim above — so waits stay visible to the ledger.
+    def locked(self):
+        return self._inner.locked() if hasattr(self._inner, "locked") \
+            else False
+
+    def __repr__(self):
+        return "<LedgerLock %s %r>" % (self.name, self._inner)
+
+
+def make_lock(name):
+    """A ``threading.Lock`` — wrapped if a LockLedger is installed."""
+    if LockLedger._active is None:
+        return threading.Lock()
+    return _LedgerLock(name, threading.Lock())
+
+
+def make_rlock(name):
+    """A ``threading.RLock`` — wrapped if a LockLedger is installed."""
+    if LockLedger._active is None:
+        return threading.RLock()
+    return _LedgerLock(name, threading.RLock())
+
+
+def make_condition(name, lock=None):
+    """A ``threading.Condition`` over ``lock`` (shim-aware).
+
+    When a ledger is active and no lock is given, the condition is
+    built over a fresh ledger lock so waits/notifies are traced.
+    """
+    if LockLedger._active is None:
+        return threading.Condition(lock)
+    if lock is None:
+        lock = _LedgerLock(name, threading.Lock())
+    return threading.Condition(lock)
+
+
+def blocking(what):
+    """Mark a known-blocking region (device transfer, socket I/O).
+
+    No-op unless a LockLedger is installed; under one, entering the
+    region with any ledger lock held records a TH117-shaped violation.
+    """
+    ledger = LockLedger._active
+    if ledger is None:
+        return _NullRegion()
+    return ledger.blocking(what)
+
+
+class _NullRegion:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

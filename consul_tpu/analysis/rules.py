@@ -68,6 +68,29 @@ RULES = {
              "a handle that is join()ed, drain it through a joined "
              "container, or hand the work to the event-loop frontend "
              "(intentional sites are allowlisted with their bound)",
+    # TH114-TH117 are the host-tier lock-discipline rules; they run as
+    # a whole-package pass in analysis/concurrency.py (they need the
+    # cross-module lock graph), not through run_rules below.
+    "TH114": "inconsistently guarded attribute write — an attribute "
+             "written under `with self._lock` elsewhere (or a "
+             "read-modify-write in a lock-owning class) is written "
+             "here with no lock held; two threads interleaving the "
+             "read and the write lose updates (single-writer seams "
+             "are allowlisted with their external bound)",
+    "TH115": "lock-order cycle / non-reentrant re-acquire — the "
+             "static acquired-while-holding graph (nested `with` "
+             "blocks plus calls made under a lock) contains a cycle, "
+             "so two threads taking the locks in opposite orders "
+             "deadlock; or a plain Lock is re-acquired while held",
+    "TH116": "Condition.wait() outside a while-predicate loop — "
+             "spurious and stolen wakeups make a bare wait() return "
+             "with the predicate still false; re-check in a while "
+             "loop or use wait_for()",
+    "TH117": "blocking call under a lock — jax.device_get/device_put/"
+             "jnp.*, socket/file I/O, no-timeout Queue.get, "
+             "time.sleep or subprocess executed while a lock is held "
+             "serializes every other acquirer behind host latency "
+             "(measured, externally bounded sites are allowlisted)",
 }
 
 # TH101: int()/float()/bool() arguments considered static (config

@@ -1907,6 +1907,12 @@ def cmd_lint(args) -> int:
     if args.verbose:
         for f, entry in report.suppressed:
             print(f"allowed: {f.format()}  [{entry.reason}]")
+        edges = analysis.package_lock_graph(
+            paths=tuple(args.paths) if args.paths else ("consul_tpu",))
+        if edges:
+            print("lock-order graph (dst acquired while src held):")
+            for src, dst, path, line in edges:
+                print(f'  "{src}" -> "{dst}"  // {path}:{line}')
     for entry in report.unused_entries:
         print(f"unused allowlist entry: {entry.rule} {entry.path}"
               f"{' ' + entry.symbol if entry.symbol else ''} — remove "

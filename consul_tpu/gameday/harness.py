@@ -269,10 +269,13 @@ def _restore_resume(cfg: GamedayConfig, sim, plane, man: dict) -> None:
 
     sim.state = ckpt_mod.restore(
         os.path.join(cfg.resume_dir, "gameday_state.ckpt"), sim.state)
+    # restore() reads the file and materializes device arrays — do the
+    # blocking work outside write_lock, swap the reference under it
+    restored = ckpt_mod.restore(
+        os.path.join(cfg.resume_dir, "gameday_writes.ckpt"),
+        plane.write_state)
     with plane.write_lock:
-        plane.write_state = ckpt_mod.restore(
-            os.path.join(cfg.resume_dir, "gameday_writes.ckpt"),
-            plane.write_state)
+        plane.write_state = restored
     for key in man.get("keys", []):
         plane.keys.slot_for(key, create=True)
     sim.publish_serving()

@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from consul_tpu.analysis import ledger
 from consul_tpu.config import RaftConfig
 from consul_tpu.obs import trace as obs_trace
 from consul_tpu.ops import raft_ops
@@ -99,7 +100,7 @@ class RaftPlane:
         self.state = raft_ops.init(rcfg, init_key_of(sim))
         self.counters = {f: 0 for f in raft_ops.FIELDS}
         self._pending_vecs: list = []
-        self._lock = threading.Lock()
+        self._lock = ledger.make_lock("RaftPlane._lock")
         self._tickets = [deque() for _ in range(rcfg.groups)]
         self._next_seq = [0] * rcfg.groups
         self._rr = 0
@@ -135,11 +136,17 @@ class RaftPlane:
         proposal intents folded in (one eager [R] add — no traced
         scatter, one executable per shape)."""
         with self._lock:
-            if self._bumps.any():
-                self.state = self.state._replace(
-                    next_seq=self.state.next_seq + jnp.asarray(self._bumps))
+            bumps = self._bumps.copy() if self._bumps.any() else None
+            if bumps is not None:
                 self._bumps[:] = 0
-            return self.state
+        # the jnp.asarray transfer happens outside the lock: proposers
+        # must not serialize behind a device round-trip. take_state is
+        # only called from the single chunk-driver thread, so the
+        # unlocked state swap has exactly one writer.
+        if bumps is not None:
+            self.state = self.state._replace(
+                next_seq=self.state.next_seq + jnp.asarray(bumps))
+        return self.state
 
     def stage(self, batcher, ops: Sequence[tuple]) -> list:
         """WriteBatcher gate: turn an apply-now batch into a proposal.
@@ -216,12 +223,16 @@ class RaftPlane:
     def absorb(self, rcnt) -> None:
         """Queue one chunk's RaftCounters pytree for a lazy batched
         flush (no device sync on the hot path)."""
-        self._pending_vecs.append(raft_ops.counters_stack(rcnt))
+        vec = raft_ops.counters_stack(rcnt)
+        with self._lock:
+            self._pending_vecs.append(vec)
 
     def flush_counters(self) -> None:
-        if not self._pending_vecs:
-            return
-        vecs, self._pending_vecs = self._pending_vecs, []
+        with self._lock:
+            if not self._pending_vecs:
+                return
+            vecs, self._pending_vecs = self._pending_vecs, []
+        # device_get of the queued vectors stays outside the lock
         vals = np.sum(np.stack(jax.device_get(vecs)), axis=0)
         deltas = {f: int(v) for f, v in zip(raft_ops.FIELDS, vals)}
         sink = getattr(self.sim, "sink", None)

@@ -98,15 +98,18 @@ class Transport:
         self.queues[msg.dst].append(msg)
 
     def partition(self, a: str, b: str):
-        self.cut.add((a, b))
-        self.cut.add((b, a))
+        # chaos controls race the pump/propose threads; RLock is cheap
+        with self.lock:
+            self.cut.add((a, b))
+            self.cut.add((b, a))
 
     def heal(self, a: Optional[str] = None, b: Optional[str] = None):
-        if a is None:
-            self.cut.clear()
-        else:
-            self.cut.discard((a, b))
-            self.cut.discard((b, a))
+        with self.lock:
+            if a is None:
+                self.cut.clear()
+            else:
+                self.cut.discard((a, b))
+                self.cut.discard((b, a))
 
     def pump(self):
         """Deliver every queued message (messages sent during delivery

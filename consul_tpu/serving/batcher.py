@@ -26,6 +26,7 @@ from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
 
+from consul_tpu.analysis import ledger
 from consul_tpu.obs import trace as obs_trace
 from consul_tpu.ops import serving as kernels
 
@@ -83,7 +84,7 @@ class QueryBatcher:
         self.buckets = tuple(sorted(int(b) for b in buckets))
         self.max_batch = self.buckets[-1]
         self.max_wait_s = float(max_wait_s)
-        self._lock = threading.Lock()
+        self._lock = ledger.make_lock("QueryBatcher._lock")
         self._pending: list[_Waiter] = []
         self._closed = False
         # Plain-int counters mirror the sink emissions so stats() works
@@ -133,12 +134,16 @@ class QueryBatcher:
         ids, rtts, count, tick = kernel(snap, dm, ds, da)
         h_ids, h_rtts, h_count, h_tick = jax.device_get(
             (ids, rtts, count, tick))
-        self.latencies_s.append(time.perf_counter() - t0)
 
         pad = bucket - b
-        self.batches += 1
-        self.queries += b
-        self.padded_slots += pad
+        # execute() runs on caller threads concurrently with pump();
+        # the telemetry counters need the lock (TH114) — taken after
+        # the device_get so transfers never sit in the critical section
+        with self._lock:
+            self.latencies_s.append(time.perf_counter() - t0)
+            self.batches += 1
+            self.queries += b
+            self.padded_slots += pad
         sink = getattr(self.plane, "sink", None)
         if sink is not None:
             sink.incr_counter("sim.serving.batches", 1)

@@ -24,11 +24,11 @@ Two sources can feed a plane (one per instance, never both):
 from __future__ import annotations
 
 import math
-import threading
 from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
 
+from consul_tpu.analysis import ledger
 from consul_tpu.ops import serving as kernels
 from consul_tpu.serving.batcher import QueryBatcher, QueryResult
 
@@ -74,7 +74,7 @@ class ServingPlane:
         # write-state) pair captured AT the current flip (what readers
         # and the watch diff see), and the host-side key table.
         self.write_state = None
-        self.write_lock = threading.Lock()
+        self.write_lock = ledger.make_lock("ServingPlane.write_lock")
         self.writes = None   # WriteBatcher
         self.watch = None    # WatchPlane
         self.keys = None     # KeyTable
@@ -295,7 +295,7 @@ class ServingPlane:
 
         slot = self.keys.slot_for(key, create=True)
         if slot < 0:
-            self.writes.rejected += 1
+            self.writes.count_rejected()
             if self.sink is not None:
                 self.sink.incr_counter("sim.serving.rejected", 1)
             raise ServingOverloadError(
@@ -442,16 +442,20 @@ class ServingPlane:
                 height[i] = c.get("height", 0.0)
                 adj[i] = c.get("adjustment", 0.0)
                 known[i] = True
-        self._names = names
-        self._name_idx = {name: i for i, name in enumerate(names)}
-        self._host_fp = fp
-        self._host_d = d
-        self._host_usable = usable
-        self._host_version += 1
+        # concurrent publishers bump the version under write_lock; the
+        # device_put below uses the captured value outside it (TH117)
+        with self.write_lock:
+            self._names = names
+            self._name_idx = {name: i for i, name in enumerate(names)}
+            self._host_fp = fp
+            self._host_d = d
+            self._host_usable = usable
+            self._host_version += 1
+            version = self._host_version
         dv, dh, da_, dk, dl, ds, dt = jax.device_put(
             (vec, height, adj, known, live,
              np.zeros(n_pad, dtype=np.int32),
-             np.int32(self._host_version)))
+             np.int32(version)))
         self._source = "host"
         self._flip(kernels.Snapshot(vec=dv, height=dh, adjustment=da_,
                                     known=dk, live=dl, service=ds,
@@ -582,7 +586,8 @@ class ServingPlane:
         return val
 
     def note_cache_hit(self) -> None:
-        self.cache_hits += 1
+        with self.write_lock:
+            self.cache_hits += 1
         if self.sink is not None:
             self.sink.incr_counter("sim.serving.cache_hits", 1)
 

@@ -28,10 +28,10 @@ watchers and delivered deltas count into ``sim.serving.watchers`` /
 
 from __future__ import annotations
 
-import threading
 from collections import deque
 from typing import NamedTuple, Optional
 
+from consul_tpu.analysis import ledger
 from consul_tpu.obs import trace as obs_trace
 from consul_tpu.ops import deltas
 from consul_tpu.serving.batcher import ServingClosedError
@@ -69,7 +69,7 @@ class Watcher:
         self.queue: deque[WatchEvent] = deque(maxlen=max_queue)
         self.dropped = 0
         self.index = 0          # last delivered apply index
-        self.cond = threading.Condition()
+        self.cond = ledger.make_condition("Watcher.cond")
         self.closed = False
 
     def _offer(self, ev: WatchEvent) -> bool:
@@ -102,7 +102,7 @@ class WatchPlane:
         self.plane = plane
         self.k = int(k)
         self.max_queue = int(max_queue)
-        self._lock = threading.Lock()
+        self._lock = ledger.make_lock("WatchPlane._lock")
         # Two-level reduction tree: kind -> key -> watcher group. The
         # per-branch counts let dispatch skip whole kinds with zero
         # registrations without touching their keys.
@@ -112,7 +112,7 @@ class WatchPlane:
         # Blocking-query index plumbing: the apply index of the CURRENT
         # flip, advanced by on_flip under _index_cond.
         self.apply_index = 0
-        self._index_cond = threading.Condition()
+        self._index_cond = ledger.make_condition("WatchPlane._index_cond")
         # Index listeners (the async frontend's wake seam): called with
         # the new apply index AFTER the condition broadcast, outside
         # every plane lock, so a listener may re-enter the plane.
@@ -174,14 +174,18 @@ class WatchPlane:
         frame = deltas.diff_kernel_for(self.k)(
             prev_snap, prev_ws, cur_snap, cur_ws)
         h = jax.device_get(frame)
-        self.flips += 1
         index = int(h.apply_index)
         tick = int(h.tick)
         n_nodes = int(h.n_node_changes)
         n_kv = int(h.n_kv_changes)
         truncated = n_nodes > self.k or n_kv > self.k
-        if truncated:
-            self.truncated_frames += 1
+        # on_flip runs on whichever thread triggered the publish, and
+        # register/stats read the counters from others — share _lock
+        # (TH114); the device_get above stays outside it
+        with self._lock:
+            self.flips += 1
+            if truncated:
+                self.truncated_frames += 1
 
         # Level 1 of the tree: aggregate changed rows into (kind, key)
         # branches — one event per branch regardless of row count.
@@ -233,8 +237,9 @@ class WatchPlane:
                 else:
                     delivered += 1
                     shed += 1
-        self.deltas += delivered
-        self.shed += shed
+        with self._lock:
+            self.deltas += delivered
+            self.shed += shed
         sink = getattr(self.plane, "sink", None)
         if sink is not None:
             if delivered:

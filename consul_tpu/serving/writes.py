@@ -35,6 +35,7 @@ from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
 
+from consul_tpu.analysis import ledger
 from consul_tpu.obs import trace as obs_trace
 from consul_tpu.ops import deltas
 from consul_tpu.serving.batcher import (ServingClosedError,
@@ -63,7 +64,7 @@ class KeyTable:
         self.slots = int(slots)
         self._by_key: dict[str, int] = {}
         self._by_slot: list[str] = []
-        self._lock = threading.Lock()
+        self._lock = ledger.make_lock("KeyTable._lock")
 
     def slot_for(self, key: str, create: bool = False) -> int:
         with self._lock:
@@ -113,7 +114,7 @@ class WriteBatcher:
         self.max_wait_s = float(max_wait_s)
         self.max_pending = int(max_pending)
         self.policy = policy
-        self._lock = threading.Lock()
+        self._lock = ledger.make_lock("WriteBatcher._lock")
         self._pending: list[_WriteWaiter] = []
         self._closed = False
         # Plain-int counters mirror the sink emissions (stats() without
@@ -182,14 +183,18 @@ class WriteBatcher:
             new_ws, applied, idx = deltas.apply_writes(ws, batch)
             self.plane.write_state = new_ws
         h_applied, h_idx = jax.device_get((applied, idx))
-        self.latencies_s.append(time.perf_counter() - t0)
 
         n_applied = int(h_applied[:b].sum())
         pad = bucket - b
-        self.writes += n_applied
-        self.rejected += b - n_applied
-        self.write_batches += 1
-        self.padded_slots += pad
+        # _apply_batch runs from caller threads AND the raft commit
+        # pump; the counters share self._lock with submit()'s admission
+        # bookkeeping (TH114). The device_get above stays outside it.
+        with self._lock:
+            self.latencies_s.append(time.perf_counter() - t0)
+            self.writes += n_applied
+            self.rejected += b - n_applied
+            self.write_batches += 1
+            self.padded_slots += pad
         sink = getattr(self.plane, "sink", None)
         if sink is not None:
             sink.incr_counter("sim.serving.write_batches", 1)
@@ -207,6 +212,12 @@ class WriteBatcher:
                             status="applied" if h_applied[j]
                             else "rejected")
                 for j in range(b)]
+
+    def count_rejected(self, n: int = 1) -> None:
+        """Record ``n`` rejections decided outside the batcher (e.g.
+        the plane's CAS admission check) under the counter lock."""
+        with self._lock:
+            self.rejected += n
 
     # ------------------------------------------------------------------
     # Concurrent submit/fan-out path with admission control

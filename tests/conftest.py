@@ -63,6 +63,32 @@ def expect_serf():
     return expect
 
 
+@pytest.fixture
+def lock_ledger():
+    """The lock-discipline twin of ``compile_ledger``
+    (consul_tpu/analysis/ledger.py).
+
+    Installing the ledger makes every lock subsequently built through
+    ``ledger.make_lock``/``make_rlock``/``make_condition`` (all the
+    serving-tier and raft-plane locks) a traced shim: acquisition
+    orders are recorded, the observed order graph is checked for
+    cycles as edges appear, and ``fuzz(seed)`` arms deterministic
+    acquisition jitter to widen race windows. Construct the objects
+    under test INSIDE the fixture's scope — locks built before the
+    ledger installs are plain ``threading`` primitives and invisible.
+    Teardown asserts the run was clean (no violations, acyclic order
+    graph, nothing still held)."""
+    from consul_tpu.analysis.ledger import LockLedger
+
+    ledger = LockLedger()
+    ledger.install()
+    try:
+        yield ledger
+        ledger.assert_clean()
+    finally:
+        ledger.uninstall()
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

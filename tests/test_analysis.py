@@ -867,6 +867,595 @@ class TestTH113:
 
 
 # ----------------------------------------------------------------------
+# TH114: guarded-by inference — inconsistently guarded writes
+# ----------------------------------------------------------------------
+
+class TestTH114:
+    def test_mixed_guarded_unguarded_write_fires(self):
+        rep = _lint({SERVE: """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+
+                def reset(self):
+                    self.n = 0
+        """})
+        assert _rules(rep) == ["TH114"]
+        assert rep.findings[0].symbol == "Counter.reset"
+        assert "'self._lock'" in rep.findings[0].message
+
+    def test_unguarded_rmw_in_lock_owning_class_fires(self):
+        # The batcher-counter shape: the class owns a Lock but the
+        # telemetry deque is mutated bare.
+        rep = _lint({SERVE: """
+            import threading
+
+            class Batcher:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.latencies = []
+
+                def record(self, dt):
+                    self.latencies.append(dt)
+        """})
+        assert _rules(rep) == ["TH114"]
+        assert rep.findings[0].symbol == "Batcher.record"
+
+    def test_all_writes_guarded_is_silent(self):
+        rep = _lint({SERVE: """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+
+                def reset(self):
+                    with self._lock:
+                        self.n = 0
+        """})
+        assert rep.clean
+
+    def test_init_writes_are_exempt(self):
+        # __init__ publishes nothing concurrently; bare assigns there
+        # must not count as the "unguarded" side.
+        rep = _lint({SERVE: """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+                    self.items = []
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+        """})
+        assert rep.clean
+
+    def test_private_method_inherits_caller_guard(self):
+        # _inc is only ever reached under the lock — its bare RMW is
+        # effectively guarded (the fixpoint inheritance contract).
+        rep = _lint({SERVE: """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._inc()
+
+                def _inc(self):
+                    self.n += 1
+        """})
+        assert rep.clean
+
+    def test_one_bare_call_site_breaks_inheritance(self):
+        rep = _lint({SERVE: """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._inc()
+
+                def sneak(self):
+                    self._inc()
+
+                def _inc(self):
+                    self.n += 1
+        """})
+        assert _rules(rep) == ["TH114"]
+        assert rep.findings[0].symbol == "C._inc"
+
+    def test_condition_alias_counts_as_the_same_guard(self):
+        # Condition(self._lock) wraps the SAME lock: writes under
+        # either are consistently guarded (the state_store shape).
+        rep = _lint({SERVE: """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cond = threading.Condition(self._lock)
+                    self.index = 0
+
+                def commit(self):
+                    with self._lock:
+                        self.index += 1
+
+                def stamp(self):
+                    with self._cond:
+                        self.index += 1
+        """})
+        assert rep.clean
+
+    def test_condition_only_class_rmw_is_silent(self):
+        # Evented-handoff classes (agent tick loop) own only a
+        # Condition; bare RMWs there are single-writer by design and
+        # the lost-update rule does not apply.
+        rep = _lint({SERVE: """
+            import threading
+
+            class Pump:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.ticks = 0
+
+                def tick(self):
+                    self.ticks += 1
+        """})
+        assert rep.clean
+
+    def test_allowlist_suppresses_documented_single_writer(self):
+        al = parse_allowlist("""
+            [[allow]]
+            rule = "TH114"
+            path = "consul_tpu/serving/fake3.py"
+            symbol = "Batcher.record"
+            reason = "single-writer pump thread; bounded by close()"
+        """)
+        rep = _lint({SERVE: """
+            import threading
+
+            class Batcher:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.latencies = []
+
+                def record(self, dt):
+                    self.latencies.append(dt)
+        """}, al)
+        assert rep.clean and len(rep.suppressed) == 1
+
+
+# ----------------------------------------------------------------------
+# TH115: lock-ordering cycles and non-reentrant re-acquires
+# ----------------------------------------------------------------------
+
+class TestTH115:
+    def test_ab_ba_inversion_fires(self):
+        rep = _lint({SERVE: """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def ab(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def ba(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """})
+        assert _rules(rep) == ["TH115"]
+        assert "cycle" in rep.findings[0].message
+
+    def test_consistent_order_is_silent(self):
+        rep = _lint({SERVE: """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """})
+        assert rep.clean
+
+    def test_nested_reacquire_of_plain_lock_fires(self):
+        rep = _lint({SERVE: """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def boom(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+        """})
+        assert _rules(rep) == ["TH115"]
+        assert "re-acquired" in rep.findings[0].message
+
+    def test_rlock_reacquire_is_silent(self):
+        rep = _lint({SERVE: """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def fine(self):
+                    with self._lock:
+                        self._inner()
+
+                def _inner(self):
+                    with self._lock:
+                        pass
+        """})
+        assert rep.clean
+
+    def test_interprocedural_cycle_fires(self):
+        # m1 holds _a and calls into a helper that takes _b; m2 nests
+        # them the other way — the cycle only exists through the call
+        # summary.
+        rep = _lint({SERVE: """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def m1(self):
+                    with self._a:
+                        self._takeb()
+
+                def _takeb(self):
+                    with self._b:
+                        pass
+
+                def m2(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """})
+        assert "TH115" in _rules(rep)
+
+    def test_interprocedural_self_deadlock_fires(self):
+        rep = _lint({SERVE: """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        self._inner()
+
+                def _inner(self):
+                    with self._lock:
+                        pass
+        """})
+        assert _rules(rep) == ["TH115"]
+        assert rep.findings[0].symbol == "C.outer"
+
+
+# ----------------------------------------------------------------------
+# TH116: Condition.wait without a predicate loop
+# ----------------------------------------------------------------------
+
+class TestTH116:
+    def test_bare_wait_fires(self):
+        rep = _lint({SERVE: """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.ready = False
+
+                def block(self):
+                    with self._cond:
+                        self._cond.wait(1.0)
+        """})
+        assert _rules(rep) == ["TH116"]
+        assert rep.findings[0].symbol == "W.block"
+
+    def test_while_predicate_wait_is_silent(self):
+        rep = _lint({SERVE: """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.ready = False
+
+                def block(self):
+                    with self._cond:
+                        while not self.ready:
+                            self._cond.wait(1.0)
+        """})
+        assert rep.clean
+
+    def test_wait_for_is_always_silent(self):
+        rep = _lint({SERVE: """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.ready = False
+
+                def block(self):
+                    with self._cond:
+                        self._cond.wait_for(lambda: self.ready, 1.0)
+        """})
+        assert rep.clean
+
+    def test_while_true_loop_is_accepted(self):
+        rep = _lint({SERVE: """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.queue = []
+
+                def next(self):
+                    with self._cond:
+                        while True:
+                            if self.queue:
+                                return self.queue.pop(0)
+                            self._cond.wait()
+        """})
+        assert rep.clean
+
+    def test_event_wait_is_not_a_condition(self):
+        rep = _lint({SERVE: """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._stop = threading.Event()
+
+                def run(self):
+                    self._stop.wait(0.2)
+        """})
+        assert rep.clean
+
+    def test_cross_object_condition_attr_fires(self):
+        # e.changed is known condition-typed from Entry's inventory;
+        # a bare wait through another object's handle still fires.
+        rep = _lint({SERVE: """
+            import threading
+
+            class Entry:
+                def __init__(self):
+                    self.changed = threading.Condition()
+
+            class Reader:
+                def block(self, e):
+                    with e.changed:
+                        e.changed.wait(1.0)
+        """})
+        assert _rules(rep) == ["TH116"]
+
+
+# ----------------------------------------------------------------------
+# TH117: blocking calls under a held lock
+# ----------------------------------------------------------------------
+
+class TestTH117:
+    def test_device_get_under_lock_fires(self):
+        rep = _lint({SERVE: """
+            import threading
+            import jax
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.state = None
+
+                def snap(self):
+                    with self._lock:
+                        return jax.device_get(self.state)
+        """})
+        assert _rules(rep) == ["TH117"]
+        assert "jax.device_get" in rep.findings[0].message
+
+    def test_device_get_outside_critical_section_is_silent(self):
+        rep = _lint({SERVE: """
+            import threading
+            import jax
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.state = None
+
+                def snap(self):
+                    with self._lock:
+                        ref = self.state
+                    return jax.device_get(ref)
+        """})
+        assert rep.clean
+
+    def test_sleep_under_lock_fires(self):
+        rep = _lint({SERVE: """
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def nap(self):
+                    with self._lock:
+                        time.sleep(0.1)
+        """})
+        assert _rules(rep) == ["TH117"]
+
+    def test_no_timeout_queue_get_under_lock_fires(self):
+        rep = _lint({SERVE: """
+            import queue
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.q = queue.Queue()
+
+                def drain(self):
+                    with self._lock:
+                        return self.q.get()
+        """})
+        assert _rules(rep) == ["TH117"]
+
+    def test_bounded_queue_get_is_silent(self):
+        rep = _lint({SERVE: """
+            import queue
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.q = queue.Queue()
+
+                def drain(self):
+                    with self._lock:
+                        return self.q.get(timeout=0.5)
+        """})
+        assert rep.clean
+
+    def test_subprocess_under_module_lock_fires(self):
+        rep = _lint({SERVE: """
+            import subprocess
+            import threading
+
+            _lock = threading.Lock()
+
+            def build():
+                with _lock:
+                    subprocess.run(["make"])
+        """})
+        assert _rules(rep) == ["TH117"]
+
+    def test_interprocedural_blocking_callee_fires(self):
+        rep = _lint({SERVE: """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def send(self, sock, data):
+                    with self._lock:
+                        self._push(sock, data)
+
+                def _push(self, sock, data):
+                    sock.sendall(data)
+        """})
+        assert _rules(rep) == ["TH117"]
+        assert rep.findings[0].symbol == "C.send"
+
+    def test_allowlist_suppresses_with_reason(self):
+        al = parse_allowlist("""
+            [[allow]]
+            rule = "TH117"
+            path = "consul_tpu/serving/fake3.py"
+            symbol = "C.nap"
+            reason = "bounded by frame size; the lock IS the serializer"
+        """)
+        rep = _lint({SERVE: """
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def nap(self):
+                    with self._lock:
+                        time.sleep(0.1)
+        """}, al)
+        assert rep.clean and len(rep.suppressed) == 1
+
+
+# ----------------------------------------------------------------------
+# the lock-ordering graph export (consul-tpu lint --verbose)
+# ----------------------------------------------------------------------
+
+class TestLockOrderGraph:
+    def test_package_graph_lists_real_edges(self):
+        edges = analysis.package_lock_graph()
+        # the RPC wire's inflight-table-under-connection-lock nesting
+        # is a real, stable edge of the tree
+        assert any("rpc_wire" in path for _s, _d, path, _l in edges)
+        for src, dst, path, line in edges:
+            assert src != dst and line > 0
+
+    def test_graph_is_acyclic_package_wide(self):
+        # the package-clean gate implies no TH115 findings; the
+        # exported edge list must agree with itself
+        edges = analysis.package_lock_graph()
+        adj = {}
+        for src, dst, _p, _l in edges:
+            adj.setdefault(src, set()).add(dst)
+
+        seen, on_path = set(), set()
+
+        def dfs(n):
+            on_path.add(n)
+            seen.add(n)
+            for nxt in adj.get(n, ()):
+                assert nxt not in on_path, f"cycle through {nxt}"
+                if nxt not in seen:
+                    dfs(nxt)
+            on_path.discard(n)
+
+        for n in list(adj):
+            if n not in seen:
+                dfs(n)
+
+
+# ----------------------------------------------------------------------
 # callgraph: reachability across modules and hand-off shapes
 # ----------------------------------------------------------------------
 
@@ -1076,6 +1665,6 @@ class TestPackageGate:
         assert set(analysis.RULES) == {
             "TH101", "TH102", "TH103", "TH104", "TH105", "TH106",
             "TH107", "TH108", "TH109", "TH110", "TH111", "TH112",
-            "TH113"}
+            "TH113", "TH114", "TH115", "TH116", "TH117"}
         for rid, rationale in analysis.RULES.items():
             assert rationale.strip(), rid

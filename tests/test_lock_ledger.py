@@ -1,0 +1,330 @@
+"""LockLedger (consul_tpu/analysis/ledger.py): the dynamic half of the
+lock-discipline pass.
+
+The centerpiece is the static/dynamic equivalence pair the ISSUE pins:
+two toy classes — a lock-order inversion and an inconsistently guarded
+counter — whose *source* trips TH115/TH114 through ``lint_sources`` and
+whose *execution* trips the LockLedger (order-graph cycle; demonstrated
+lost update that the guarded twin does not exhibit). Both halves catch
+the same bug shape from opposite ends.
+
+Plus the ledger mechanics: shim factories degrade to plain ``threading``
+primitives when no ledger is installed, acquisition/order-edge
+recording, ``blocking()`` under a held lock, the seeded interleaving
+fuzzer's determinism, and the conftest ``lock_ledger`` fixture contract.
+"""
+
+import inspect
+import textwrap
+import threading
+import time
+
+import pytest
+
+from consul_tpu import analysis
+from consul_tpu.analysis import ledger as ledger_mod
+from consul_tpu.analysis.ledger import (LockLedger, LockLedgerError,
+                                        blocking, make_condition,
+                                        make_lock, make_rlock)
+from consul_tpu.analysis import ledger
+
+
+# ----------------------------------------------------------------------
+# The seeded toy fixtures: one deadlock shape, one race shape. These
+# classes are BOTH executed under the ledger and linted as source (the
+# same text, via inspect.getsource), so the two halves of the pass are
+# provably looking at the same bug.
+# ----------------------------------------------------------------------
+
+class ToyLockInversion:
+    """ab() takes _a then _b; ba() takes _b then _a — the classic
+    deadlock-by-inversion. Statically: TH115 cycle. Dynamically: the
+    ledger sees both edges and flags the cycle on the first run that
+    exercises both sides, no actual deadlock needed."""
+
+    def __init__(self):
+        self._a = ledger.make_lock("ToyLockInversion._a")
+        self._b = ledger.make_lock("ToyLockInversion._b")
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                return "ab"
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                return "ba"
+
+
+class ToyRacyCounter:
+    """``hits`` is guarded in tally() but read-modify-written bare in
+    bump() — TH114 statically, a lost update dynamically (bump's
+    read-sleep-write widens the window so the race is deterministic
+    under a thread barrier)."""
+
+    def __init__(self):
+        self._lock = ledger.make_lock("ToyRacyCounter._lock")
+        self.hits = 0
+
+    def bump(self):
+        v = self.hits
+        time.sleep(0.002)
+        self.hits = v + 1
+
+    def tally(self):
+        with self._lock:
+            self.hits += 1
+            return self.hits
+
+
+class ToyGuardedCounter:
+    """The repaired twin of ToyRacyCounter: same read-sleep-write, but
+    under the lock — no lost updates, and clean under the ledger."""
+
+    def __init__(self):
+        self._lock = ledger.make_lock("ToyGuardedCounter._lock")
+        self.hits = 0
+
+    def bump(self):
+        with self._lock:
+            v = self.hits
+            time.sleep(0.002)
+            self.hits = v + 1
+
+
+def _toy_source() -> str:
+    return ("from consul_tpu.analysis import ledger\nimport time\n\n\n"
+            + textwrap.dedent(inspect.getsource(ToyLockInversion))
+            + "\n\n"
+            + textwrap.dedent(inspect.getsource(ToyRacyCounter)))
+
+
+def _race(counter, n_threads: int = 8) -> int:
+    """Run n bump()s through a barrier so every thread reads before
+    any writes; returns the final count."""
+    barrier = threading.Barrier(n_threads)
+
+    def worker():
+        barrier.wait()
+        counter.bump()
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return counter.hits
+
+
+# ----------------------------------------------------------------------
+# Static half: the toy source trips TH115 and TH114 through the lint.
+# ----------------------------------------------------------------------
+
+class TestToyFixturesStatic:
+    def test_inversion_source_trips_th115(self):
+        rep = analysis.lint_sources(
+            {"consul_tpu/serving/fake_toys.py": _toy_source()})
+        th115 = [f for f in rep.findings if f.rule == "TH115"]
+        assert th115, [f.format() for f in rep.findings]
+        assert any("cycle" in f.message for f in th115)
+        assert any("ToyLockInversion._a" in f.message
+                   or "ToyLockInversion._b" in f.message for f in th115)
+
+    def test_racy_counter_source_trips_th114(self):
+        rep = analysis.lint_sources(
+            {"consul_tpu/serving/fake_toys.py": _toy_source()})
+        th114 = [f for f in rep.findings if f.rule == "TH114"]
+        assert th114, [f.format() for f in rep.findings]
+        assert any(f.symbol == "ToyRacyCounter.bump" for f in th114)
+
+    def test_ledger_factories_resolve_as_lock_factories(self):
+        # the static inventory must treat ledger.make_lock exactly like
+        # threading.Lock — otherwise production's shim seam would make
+        # every guarded class invisible to TH114-TH117
+        rep = analysis.lint_sources({"consul_tpu/serving/fk.py": (
+            "from consul_tpu.analysis import ledger\n\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = ledger.make_lock('C._lock')\n"
+            "        self.n = 0\n\n"
+            "    def guarded(self):\n"
+            "        with self._lock:\n"
+            "            self.n += 1\n\n"
+            "    def bare(self):\n"
+            "        self.n = 0\n")})
+        assert [f.rule for f in rep.findings] == ["TH114"]
+
+
+# ----------------------------------------------------------------------
+# Dynamic half: the SAME toys trip the ledger at run time.
+# ----------------------------------------------------------------------
+
+class TestToyFixturesDynamic:
+    def test_inversion_trips_ledger_cycle(self):
+        led = LockLedger()
+        with led:
+            toy = ToyLockInversion()
+            toy.ab()
+            toy.ba()
+        assert led.violations and "cycle" in led.violations[0]
+        with pytest.raises(LockLedgerError, match="cycle"):
+            led.assert_acyclic()
+        with pytest.raises(LockLedgerError):
+            led.assert_clean()
+        # the observed edges name the same locks the static finding did
+        edges = led.order_edges()
+        assert ("ToyLockInversion._a", "ToyLockInversion._b") in edges
+        assert ("ToyLockInversion._b", "ToyLockInversion._a") in edges
+
+    def test_consistent_order_stays_clean(self):
+        led = LockLedger()
+        with led:
+            toy = ToyLockInversion()
+            toy.ab()
+            toy.ab()
+        led.assert_clean()
+        assert led.order_edges() == [
+            ("ToyLockInversion._a", "ToyLockInversion._b")]
+
+    def test_racy_counter_loses_updates(self):
+        # every thread reads hits==0 before any write lands: the racy
+        # counter MUST lose updates; the guarded twin must not.
+        led = LockLedger()
+        with led:
+            racy = ToyRacyCounter()
+            lost = _race(racy)
+            fixed = ToyGuardedCounter()
+            kept = _race(fixed)
+        led.assert_clean()  # a data race is not a lock-order violation
+        assert lost < 8, "barrier race unexpectedly serialized"
+        assert kept == 8
+
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_fuzzed_schedules_keep_the_guarded_twin_clean(self, seed):
+        led = LockLedger().fuzz(seed)
+        with led:
+            fixed = ToyGuardedCounter()
+            assert _race(fixed) == 8
+        led.assert_clean()
+
+
+# ----------------------------------------------------------------------
+# Ledger mechanics
+# ----------------------------------------------------------------------
+
+class TestLedgerMechanics:
+    def test_factories_are_plain_primitives_without_ledger(self):
+        assert LockLedger._active is None
+        lock = make_lock("x")
+        rlock = make_rlock("y")
+        cond = make_condition("z")
+        assert type(lock) is type(threading.Lock())
+        assert type(rlock) is type(threading.RLock())
+        assert isinstance(cond, threading.Condition)
+        with lock, rlock, cond:
+            pass
+
+    def test_installed_ledger_records_acquisitions(self):
+        led = LockLedger()
+        with led:
+            lock = make_lock("rec")
+            with lock:
+                pass
+        assert [a[0] for a in led.acquisitions] == ["rec"]
+        led.assert_clean()
+
+    def test_double_install_refuses(self):
+        a, b = LockLedger(), LockLedger()
+        with a:
+            with pytest.raises(LockLedgerError, match="installed"):
+                b.install()
+        b.install()
+        b.uninstall()
+
+    def test_blocking_region_under_lock_is_a_violation(self):
+        led = LockLedger()
+        with led:
+            lock = make_lock("held")
+            with lock:
+                with blocking("jax.device_get"):
+                    pass
+        with pytest.raises(LockLedgerError, match="device_get"):
+            led.assert_clean()
+
+    def test_blocking_region_outside_lock_is_clean(self):
+        led = LockLedger()
+        with led:
+            lock = make_lock("held")
+            with lock:
+                pass
+            with blocking("jax.device_get"):
+                pass
+        led.assert_clean()
+
+    def test_blocking_is_noop_without_ledger(self):
+        with blocking("anything"):
+            pass
+
+    def test_rlock_reentry_adds_no_edge(self):
+        led = LockLedger()
+        with led:
+            r = make_rlock("re")
+            with r:
+                with r:
+                    pass
+        assert led.order_edges() == []
+        led.assert_clean()
+
+    def test_condition_wait_routes_through_shim(self):
+        # Condition over a ledger lock: wait() releases and re-acquires
+        # through the shim, so the held stack stays balanced.
+        led = LockLedger()
+        with led:
+            cond = make_condition("cv")
+            fired = []
+
+            def waiter():
+                with cond:
+                    while not fired:
+                        cond.wait(1.0)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            time.sleep(0.02)
+            with cond:
+                fired.append(True)
+                cond.notify_all()
+            t.join()
+        led.assert_clean()
+
+    def test_held_at_teardown_is_dirty(self):
+        led = LockLedger()
+        with led:
+            lock = make_lock("leak")
+            lock.acquire()
+            with pytest.raises(LockLedgerError, match="still held"):
+                led.assert_clean()
+            lock.release()
+        led.assert_clean()
+
+    def test_fuzz_is_deterministic_per_seed(self):
+        # same seed => same jitter draws => identical recorded schedule
+        def run(seed):
+            led = LockLedger().fuzz(seed)
+            with led:
+                lock = make_lock("d")
+                for _ in range(4):
+                    with lock:
+                        pass
+            return led.acquisitions
+
+        assert run(7) == run(7)
+
+    def test_fixture_contract(self, lock_ledger):
+        # the conftest fixture installs before the test body runs, so
+        # locks built here are shims; teardown asserts clean.
+        lock = ledger_mod.make_lock("fixture-lock")
+        with lock:
+            pass
+        assert [a[0] for a in lock_ledger.acquisitions] == ["fixture-lock"]
