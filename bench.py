@@ -332,11 +332,120 @@ def child(platform: str, deadline: float):
                 })
             except Exception:
                 peaks.append({"device": str(d), "memory_stats": None})
+        # Kernel traffic contract (ops/pallas_gossip.py): the fused
+        # pallas tick's HBM bytes/tick/node — one packed read + one
+        # packed write + world — must stay within a small constant of
+        # the packed at-rest footprint. Regression-asserted here so a
+        # state field landing outside the codec (silently re-dense-ing
+        # the tick's HBM traffic) fails the bench, not just a TPU A/B.
+        from consul_tpu.models import layout as layout_mod
+        from consul_tpu.models import state as sim_state
+        from consul_tpu.ops import pallas_gossip
+        from consul_tpu.ops import topology as topo_mod
+
+        k0 = jax.random.PRNGKey(0)
+        st_aval, w_aval = jax.eval_shape(
+            lambda kk: (layout_mod.pack_state(sim_state.init(cfg_mem, kk)),
+                        topo_mod.make_world(cfg_mem, kk)), k0)
+        traffic = pallas_gossip.tick_hbm_bytes_per_node(
+            st_aval, w_aval, None, n=n)
+        at_rest = layouts["packed"]["swim"]["bytes_per_node"]
+        traffic_bound = 3.0
+        assert traffic <= traffic_bound * at_rest, (
+            f"pallas tick HBM traffic {traffic:.1f} B/tick/node exceeds "
+            f"{traffic_bound}x the packed at-rest footprint {at_rest:.1f} "
+            "B/node — some per-tick state is bypassing the packed codec")
         _emit({"phase": "memory", "n": n, "view_degree": view_degree,
                "layouts": layouts, "device_peaks": peaks,
+               "kernel_traffic": {
+                   "packed_hbm_bytes_per_tick_per_node": round(traffic, 2),
+                   "at_rest_bytes_per_node": at_rest,
+                   "bound": traffic_bound,
+               },
                "wall_s": round(time.monotonic() - t_mem, 2)})
     except Exception as e:
         _emit({"phase": "error", "where": "memory", "error": repr(e)[:500]})
+
+    # Pallas kernel A/B (ops/pallas_gossip.py): rounds/s/chip for the
+    # fused packed-native tick versus the XLA scan body at the same
+    # (n, packed) signature, plus the measured HBM bytes/tick/node each
+    # engine moves (pallas: pure packed bytes; xla: the dense working
+    # set it unpacks to). TPU-only by default — interpret-mode pallas
+    # on CPU is an evaluator, not a perf measurement — BENCH_KERNEL=1
+    # forces it (tiny-n smoke), BENCH_KERNEL=0 skips even on TPU.
+    try:
+        want_kernel = os.environ.get("BENCH_KERNEL", "auto")
+        on_tpu = jax.default_backend() == "tpu"
+        if want_kernel != "0" and (on_tpu or want_kernel == "1") \
+                and left() > 120:
+            from consul_tpu.models import layout as layout_mod
+            from consul_tpu.models import state as sim_state
+            from consul_tpu.ops import pallas_gossip
+            from consul_tpu.ops import topology as topo_mod
+
+            kern_ns = [int(x) for x in os.environ.get(
+                "BENCH_KERNEL_NS", "65536,1048576").split(",") if x]
+            if not on_tpu:  # forced CPU smoke: keep the shapes tiny
+                kern_ns = [int(x) for x in os.environ.get(
+                    "BENCH_KERNEL_NS", "1024").split(",") if x]
+            kchunk = int(os.environ.get("BENCH_KERNEL_CHUNK", str(chunk)))
+            kreps = int(os.environ.get("BENCH_KERNEL_REPS", "2"))
+            entries = []
+            for kn in kern_ns:
+                if left() < 90:
+                    break
+                row = {"n": kn}
+                kcfg = SimConfig(
+                    n=kn, view_degree=clamp_view_degree(kn, view_degree))
+                k0 = jax.random.PRNGKey(0)
+                pst, wav = jax.eval_shape(
+                    lambda kk: (layout_mod.pack_state(
+                        sim_state.init(kcfg, kk)),
+                        topo_mod.make_world(kcfg, kk)), k0)
+                dst = jax.eval_shape(
+                    lambda kk: sim_state.init(kcfg, kk), k0)
+                row["hbm_bytes_per_tick_per_node"] = {
+                    "pallas": round(pallas_gossip.tick_hbm_bytes_per_node(
+                        pst, wav, None, n=kn), 2),
+                    # The XLA scan body unpacks to the dense working set
+                    # in HBM every tick: dense read+write + world.
+                    "xla": round(pallas_gossip.tick_hbm_bytes_per_node(
+                        dst, wav, None, n=kn), 2),
+                }
+                for eng in ("xla", "pallas"):
+                    t_build = time.monotonic()
+                    ksim = Simulation(
+                        kcfg, seed=0, layout="packed", kernel=eng,
+                        mesh=pmesh.default_mesh(
+                            kn, device_count=bench_devices or None,
+                            n_dc=n_dc))
+                    ksim.run(kchunk, chunk=kchunk,
+                             with_metrics=False)  # warm+compile
+                    jax.block_until_ready(ksim.state)
+                    t1 = time.monotonic()
+                    ksim.run(kchunk * kreps, chunk=kchunk,
+                             with_metrics=False)
+                    jax.block_until_ready(ksim.state)
+                    wall = time.monotonic() - t1
+                    row[eng] = {
+                        "rounds_per_s": round(kchunk * kreps / wall, 2),
+                        "wall_s": round(wall, 2),
+                        "compile_s": round(t1 - t_build, 1),
+                    }
+                    del ksim
+                row["speedup"] = round(
+                    row["pallas"]["rounds_per_s"] /
+                    max(row["xla"]["rounds_per_s"], 1e-9), 3)
+                entries.append(row)
+            _emit({"phase": "kernel", "chunk": kchunk,
+                   "interpret": not on_tpu, "entries": entries,
+                   "wall_s": round(sum(r[e]["wall_s"] for r in entries
+                                       for e in ("xla", "pallas")), 2),
+                   "compile_s": round(sum(r[e]["compile_s"]
+                                          for r in entries
+                                          for e in ("xla", "pallas")), 1)})
+    except Exception as e:
+        _emit({"phase": "error", "where": "kernel", "error": repr(e)[:500]})
 
     # Chaos SLO probe: a short partition-heal scenario through the
     # compiled fault-schedule plane (consul_tpu/chaos) on a small
@@ -1247,7 +1356,7 @@ def _save_tpu_session(result):
 _PHASE_KEYS = ("northstar_1m", "northstar_1m_serf", "compile_cache",
                "elasticity", "memory", "serving", "serving_mixed",
                "scaling_strong", "scaling_weak", "topology", "trace",
-               "raft", "gameday")
+               "raft", "gameday", "kernel")
 
 
 def _phase_or_not_run(phases, name, reason, pick=None):
@@ -1510,6 +1619,16 @@ def main():
         "memory": _phase_or_not_run(
             primary["phases"], "memory",
             "skipped: time budget exhausted or planner errored"),
+        # Pallas kernel A/B (ops/pallas_gossip.py): per-n entries of
+        # {xla, pallas} rounds/s/chip + HBM bytes/tick/node per engine
+        # and the speedup ratio. The item-1 TPU campaign reads this key
+        # to A/B the fused tick against the 765.6 rounds/s/chip
+        # headline without further code changes.
+        "kernel": _phase_or_not_run(
+            primary["phases"], "kernel",
+            "needs a TPU chip (interpret-mode pallas on CPU is an "
+            "evaluator, not a measurement; BENCH_KERNEL=1 forces a "
+            "tiny-n smoke)"),
         # Serving-plane read throughput (consul_tpu/serving): batched
         # NearestN straight from the simulation tensors —
         # queries_per_sec_per_chip, p50/p99 batch latency, padding

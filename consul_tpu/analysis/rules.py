@@ -91,6 +91,13 @@ RULES = {
              "time.sleep or subprocess executed while a lock is held "
              "serializes every other acquirer behind host latency "
              "(measured, externally bounded sites are allowlisted)",
+    "TH118": "Pallas interpret mode hardcoded on — a truthy-literal "
+             "interpret= reaching pl.pallas_call (directly or through "
+             "a kernel builder), or an interpret parameter DEFAULTING "
+             "truthy, ships the Python interpreter twin to TPU: a "
+             "silent ~100x perf cliff with no error. Thread "
+             "pallas_gossip.default_interpret() instead; the one "
+             "marked test/debug entry point is allowlisted",
 }
 
 # TH101: int()/float()/bool() arguments considered static (config
@@ -166,6 +173,7 @@ def run_rules(mod, traced_ids) -> list:
     v.visit(mod.tree)
     if mod.relpath.startswith(_TH113_PREFIXES):
         v.findings.extend(_run_th113(mod))
+    v.findings.extend(_run_th118(mod))
     return v.findings
 
 
@@ -251,6 +259,113 @@ def _run_th113(mod) -> list:
                     "serving load this grows the thread count without "
                     "limit: join the handle, drain it through a joined "
                     "container, or use the async frontend's event loop"))
+    return findings
+
+
+# TH118: the Pallas kernel launch, and the prefix marking calls that
+# forward an interpret= kwarg down to one (the repo's kernel builders).
+_TH118_PALLAS_CALL = "jax.experimental.pallas.pallas_call"
+_TH118_INTERNAL_PREFIX = "consul_tpu."
+
+
+def _run_th118(mod) -> list:
+    """Truthy-literal ``interpret=`` reaching a Pallas kernel launch.
+
+    ``pl.pallas_call(..., interpret=True)`` runs the kernel body under
+    the Python interpret evaluator — correct everywhere, needed on CPU,
+    and a silent ~100x perf cliff if it ships to TPU (no error, no
+    warning, just a Mosaic kernel that never compiles). Three shapes
+    fire:
+
+    1. A call resolving to ``jax.experimental.pallas.pallas_call``
+       with a truthy-literal ``interpret=``.
+    2. A call resolving into ``consul_tpu.*`` (the kernel builders,
+       which forward ``interpret`` verbatim into the launch) with a
+       truthy-literal ``interpret=``.
+    3. A function definition whose ``interpret`` parameter DEFAULTS
+       truthy — every caller who forgets the kwarg ships the
+       interpreter.
+
+    Non-literal values stay quiet by construction: threading
+    ``pallas_gossip.default_interpret()`` (the backend probe) is
+    exactly the sanctioned idiom. The one sanctioned truthy literal —
+    the marked test/debug entry ``interpret_tick`` — is carried by the
+    allowlist, not by the rule."""
+    from consul_tpu.analysis.engine import Finding
+
+    parents: dict = {}
+    for p in ast.walk(mod.tree):
+        for c in ast.iter_child_nodes(p):
+            parents[c] = p
+
+    def _symbol(node) -> str:
+        names = []
+        cur = node
+        while cur in parents:
+            cur = parents[cur]
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                names.append(cur.name)
+        return ".".join(reversed(names))
+
+    def _truthy_literal(node) -> bool:
+        return isinstance(node, ast.Constant) and bool(node.value)
+
+    findings = []
+
+    def _emit(node, message):
+        # A def-shaped finding anchors to the function itself: its own
+        # name IS the allowlistable symbol.
+        sym = _symbol(node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sym = f"{sym}.{node.name}" if sym else node.name
+        findings.append(Finding(
+            rule="TH118", path=mod.relpath,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            symbol=sym, message=message))
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            kw = next((k for k in node.keywords
+                       if k.arg == "interpret"), None)
+            if kw is None or not _truthy_literal(kw.value):
+                continue
+            fq = mod.resolve(node.func, None)
+            if fq == _TH118_PALLAS_CALL:
+                _emit(node, "pl.pallas_call(..., interpret=True) — the "
+                            "interpret evaluator hardcoded into the "
+                            "launch ships a ~100x perf cliff to TPU; "
+                            "thread pallas_gossip.default_interpret()")
+            elif fq is not None \
+                    and fq.startswith(_TH118_INTERNAL_PREFIX):
+                _emit(node, f"interpret=True forwarded into {fq} — a "
+                            "kernel built here runs interpreted on "
+                            "every backend, TPU included; thread "
+                            "pallas_gossip.default_interpret() (test/"
+                            "debug entries are allowlisted by symbol)")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            pos = a.posonlyargs + a.args
+            for arg, default in zip(pos[len(pos) - len(a.defaults):],
+                                    a.defaults):
+                if arg.arg == "interpret" and _truthy_literal(default):
+                    _emit(node, f"def {node.name}(... interpret="
+                                "True ...) — an interpret parameter "
+                                "defaulting truthy ships the evaluator "
+                                "to every caller who forgets the "
+                                "kwarg; default False (or "
+                                "default_interpret()) and make tests "
+                                "opt in explicitly")
+            for arg, default in zip(a.kwonlyargs, a.kw_defaults):
+                if arg.arg == "interpret" and default is not None \
+                        and _truthy_literal(default):
+                    _emit(node, f"def {node.name}(*, interpret=True) "
+                                "— an interpret parameter defaulting "
+                                "truthy ships the evaluator to every "
+                                "caller who forgets the kwarg; "
+                                "default False (or default_interpret()"
+                                ") and make tests opt in explicitly")
     return findings
 
 

@@ -776,7 +776,22 @@ def _build_sim(args):
     kind = "serf" if args.serf else "swim"
     mesh = _mesh_from_args(args, args.n)
     plan = _plan_from_args(args, cfg, kind, mesh)
+    kernel = getattr(args, "kernel", "xla") or "xla"
+    if kernel != "xla":
+        from consul_tpu.ops import pallas_gossip
+
+        try:
+            pallas_gossip.validate_kernel(
+                kernel, plan.layout if plan else "dense")
+        except ValueError as e:
+            print(f"--kernel: {e}", file=sys.stderr)
+            raise SystemExit(2)
     if plan is not None and plan.streamed:
+        if kernel != "xla":
+            print("--kernel: cohort-streamed runs drive the XLA scan "
+                  "body; drop --kernel or shrink n under the budget",
+                  file=sys.stderr)
+            raise SystemExit(2)
         if int(getattr(args, "lens", 0) or 0):
             print("--lens: the node lens needs a resident population; "
                   "cohort-streamed runs cannot record it",
@@ -793,7 +808,7 @@ def _build_sim(args):
         return sim, plan
     cls = SerfSimulation if args.serf else Simulation
     sim = cls(cfg, seed=args.seed, mesh=mesh,
-              layout=plan.layout if plan else "dense")
+              layout=plan.layout if plan else "dense", kernel=kernel)
     lens_n = int(getattr(args, "lens", 0) or 0)
     if lens_n:
         if mesh is not None:
@@ -1157,7 +1172,7 @@ def cmd_prewarm(args) -> int:
         layout=args.layout, family=args.family,
         family_param=args.family_param, sweep=args.sweep,
         sweep_chunk=args.sweep_chunk, raft_groups=args.raft_groups,
-        raft_peers=args.raft_peers,
+        raft_peers=args.raft_peers, kernel=args.kernel,
     )
     print(json.dumps(summary))
     return 0
@@ -1346,6 +1361,12 @@ def build_parser() -> argparse.ArgumentParser:
                              "the device, or e.g. '2GB'/'512MiB'); "
                              "populations beyond it stream as node "
                              "cohorts through one device")
+        sp.add_argument("--kernel", choices=("xla", "pallas"),
+                        default="xla",
+                        help="tick execution engine: xla (scan body, "
+                             "default) or pallas (packed-native fused "
+                             "tick, ops/pallas_gossip.py; requires "
+                             "--layout packed)")
 
     def add_family_flags(sp):
         # Topology-lab knobs (consul_tpu/topo): which view-graph family
@@ -1622,6 +1643,11 @@ def build_parser() -> argparse.ArgumentParser:
                     default="dense",
                     help="state layout the warmed programs bind "
                          "(part of the program identity)")
+    pw.add_argument("--kernel", choices=("xla", "pallas"),
+                    default="xla",
+                    help="tick engine the warmed programs bind (pallas "
+                         "needs --layout packed; part of the program "
+                         "identity like --layout)")
     pw.add_argument("--compile-cache", default=None, metavar="DIR",
                     help="persistent cache directory (or "
                          "CONSUL_TPU_COMPILE_CACHE)")

@@ -113,7 +113,7 @@ def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
                   step_fn=swim.step_counted, swim_of=lambda st: st,
                   chaos_key=None, sentinel: bool = False, mesh=None,
                   layout: str = layout_mod.DENSE, lens: tuple = (),
-                  clock_of=None, raft=None):
+                  clock_of=None, raft=None, kernel: str = "xla"):
     """One compiled chunk program. ``step_fn`` is the per-tick counted
     step (bare SWIM or the full serf stack) returning
     (state, GossipCounters); ``swim_of`` projects the SWIM plane out of
@@ -168,10 +168,18 @@ def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
     ``((state, RaftState), (GossipCounters, RaftCounters))`` and the
     runner takes/returns the state PAIR in the donated slot. None
     follows the sentinel/lens DCE contract — byte-for-byte the
-    pre-raft program, zero extra executables."""
+    pre-raft program, zero extra executables.
+
+    ``kernel`` selects the tick execution engine: ``"xla"`` is the
+    scan body above, byte-for-byte the pre-kernel program (the DCE
+    pin); ``"pallas"`` replaces the unpack→step→repack triple with one
+    packed-native Pallas call per tick (ops/pallas_gossip.py) so the
+    per-tick HBM traffic is pure packed bytes. Requires
+    ``layout="packed"``; the raft tick, counter accumulation, and the
+    (unpacked-once-per-chunk) metrics tail stay outside the kernel."""
     memo = (cfg, _topo_key(topo), chunk, with_metrics, step_fn, swim_of,
             chaos_key, sentinel, pmesh.mesh_key(mesh), layout, lens,
-            clock_of, raft)
+            clock_of, raft, kernel)
     hit = _RUNNER_CACHE.get(memo)
     if hit is not None:
         return hit
@@ -186,27 +194,48 @@ def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
             cfg, topo, mesh, chunk, with_metrics,
             step_fn=step_fn, swim_of=swim_of,
             chaos=chaos_key is not None, sentinel=sentinel, layout=layout,
-            raft=raft,
+            raft=raft, kernel=kernel,
         )
         _RUNNER_CACHE[memo] = jitted
         return jitted
 
     packed = layout == layout_mod.PACKED
+    use_pallas = kernel == "pallas"
+    if use_pallas:
+        from consul_tpu.ops import pallas_gossip
+
+        pallas_gossip.validate_kernel(kernel, layout)
+        if lens:
+            raise ValueError(
+                "the node lens snapshots the dense working set mid-body; "
+                "--kernel pallas keeps the tick VMEM-resident — clear the "
+                "lens (set_lens(0)) before selecting it")
+        ptick = pallas_gossip.make_tick_kernel(
+            cfg, topo, step_fn=step_fn, sentinel=sentinel,
+            interpret=pallas_gossip.default_interpret())
+    else:
+        ptick = None
 
     def body(world, sched, carry, tick_key):
         if raft is not None:
             (state, rst), (cnt, rcnt) = carry
         else:
             state, cnt = carry
-        if packed:
-            state = layout_mod.unpack_state(state)
-        if raft is not None:
-            # The raft tick is keyed on the PRE-step tick (the same t
-            # this tick_key was folded from) so chaos windows and the
-            # draw ladder line up with the oracle's step(t).
-            t_pre = swim_of(state).t
-        state, c = step_fn(cfg, topo, world, state, tick_key, sched,
-                           sentinel=sentinel)
+        if use_pallas:
+            if raft is not None:
+                # PRE-step tick, read straight off the packed t leaf.
+                t_pre = layout_mod.tick_of(state)
+            state, c = ptick(world, sched, state, tick_key)
+        else:
+            if packed:
+                state = layout_mod.unpack_state(state)
+            if raft is not None:
+                # The raft tick is keyed on the PRE-step tick (the same
+                # t this tick_key was folded from) so chaos windows and
+                # the draw ladder line up with the oracle's step(t).
+                t_pre = swim_of(state).t
+            state, c = step_fn(cfg, topo, world, state, tick_key, sched,
+                               sentinel=sentinel)
         cnt = counters_mod.add(cnt, c)
         if raft is not None:
             from consul_tpu.ops import raft_ops
@@ -233,7 +262,12 @@ def _chunk_runner(cfg: SimConfig, topo, chunk: int, with_metrics: bool,
             row = None
         if not with_metrics:
             return carry_out, (row if lens else ())
-        sw = swim_of(state)
+        # Pallas tick returns packed state: the metrics tail unpacks a
+        # transient dense view (metrics runs are not the perf path; the
+        # Vivaldi reads see one extra bf16 round-trip, inside the
+        # layout-parity tolerance).
+        sw = swim_of(layout_mod.unpack_state(state) if use_pallas
+                     else state)
         h = metrics.health(cfg, topo, sw)
         rmse = metrics.vivaldi_rmse(
             cfg, world, sw, jax.random.fold_in(tick_key, 1), samples=2048
@@ -289,6 +323,12 @@ class Simulation:
     # buys the beyond-HBM tier. Chosen per run (the MemoryBudget
     # planner picks it for the CLI); joins the runner memo key.
     layout: str = layout_mod.DENSE
+    # Tick execution engine (ops/pallas_gossip.py): "xla" is the scan
+    # body every prior compile-ledger pin counts, byte-for-byte;
+    # "pallas" fuses unpack→exchange→repack into one packed-native
+    # kernel per tick (requires layout="packed"). Joins the runner
+    # memo key like layout/sentinel.
+    kernel: str = "xla"
 
     # Driver hooks (SerfSimulation overrides these).
     _step_fn = staticmethod(swim.step_counted)
@@ -302,6 +342,10 @@ class Simulation:
 
     def __post_init__(self):
         layout_mod.validate(self.cfg, self.layout)
+        if self.kernel != "xla":
+            from consul_tpu.ops import pallas_gossip
+
+            pallas_gossip.validate_kernel(self.kernel, self.layout)
         key = jax.random.PRNGKey(self.seed)
         kw, kn, ks, kb = jax.random.split(key, 4)
         self.world = topology.make_world(self.cfg, kw)
@@ -519,6 +563,21 @@ class Simulation:
             self.sentinel = on
             self._runners = {}
 
+    def set_kernel(self, kernel: str):
+        """Select the tick execution engine for subsequent runs:
+        ``"xla"`` (the default scan body) or ``"pallas"`` (the
+        packed-native fused kernel, ops/pallas_gossip.py — requires
+        ``layout="packed"``). Toggling follows the set_sentinel DCE
+        contract: ``"xla"`` is the pre-kernel program byte-for-byte,
+        and the process-wide _RUNNER_CACHE memoizes both programs so
+        flipping back and forth never recompiles."""
+        from consul_tpu.ops import pallas_gossip
+
+        pallas_gossip.validate_kernel(kernel, self.layout)
+        if kernel != self.kernel:
+            self.kernel = kernel
+            self._runners = {}
+
     def set_lens(self, sample) -> tuple:
         """Arm (or clear, with ``0``/empty) the on-device node lens for
         subsequent runs: ``sample`` is either an int count (evenly
@@ -628,7 +687,7 @@ class Simulation:
                 chaos_key=chaos_mod.static_key_of(self.chaos),
                 sentinel=self.sentinel, mesh=self.mesh, layout=self.layout,
                 lens=self._lens_ids, clock_of=type(self)._clock_of,
-                raft=self._raft_cfg,
+                raft=self._raft_cfg, kernel=self.kernel,
             )
 
             def bound(state, base_key, _j=jitted, _w=self.world,

@@ -83,7 +83,11 @@ def origin_dtype(n: int):
     """Narrowest signed dtype holding every origin row id (plus the -1
     empty marker) for an ``n``-node cluster — the packed ``ev_origin``
     storage dtype. Widened to int32 at every transport/arithmetic
-    boundary (parallel/collective.roll_many only moves 32-bit lanes)."""
+    boundary: parallel/collective.roll_many carries 32-bit in-flight
+    lanes in both kernel modes, while the bytes that cross HBM per tick
+    under ``--kernel pallas`` stay this narrow at-rest width (the
+    exchange traces inside the packed-native kernel body,
+    ops/pallas_gossip.py)."""
     return jnp.int16 if n <= 32767 else jnp.int32
 
 
@@ -501,7 +505,10 @@ def step_counted(cfg: SimConfig, topo, world: World, s: SerfState, key,
     # Static argmax peeling instead of lax.top_k (sort-lowered on TPU)
     # — pe is tiny and the peel is pure compare-select; selection is
     # identical to top_k's (max value, lowest index on ties). The
-    # narrow queue dtypes widen here: roll_many moves 32-bit lanes.
+    # narrow queue dtypes widen here: roll_many's in-flight lanes are
+    # 32-bit in both kernel modes (HBM traffic under --kernel pallas is
+    # the packed at-rest bytes — the widening lives in VMEM only; see
+    # parallel/collective.roll_many and ops/pallas_gossip.py).
     pe = cfg.serf.piggyback_events
     e_slots = cfg.serf.event_queue_slots
     slots_i = jnp.arange(e_slots, dtype=jnp.int32)
@@ -743,7 +750,14 @@ def _query_response_tally(cfg: SimConfig, topo, s: SerfState, active,
                 n),
         )
 
-    if coll.sharded():
+    if coll.sharded() or coll.in_kernel():
+        # Sharded: collectives can't sit inside data-dependent control
+        # flow. Kernel body: Mosaic can't branch around a pytree
+        # operand. Both run the tally unconditionally — with no open
+        # query anywhere every landed mask is false (an open-slot key
+        # is always > 0, a closed slot 0, so no delivered wkey can
+        # match), the scatter adds zeros, and the result is
+        # bit-identical to the cond's pass-through branch.
         return tally(s)
     return jax.lax.cond(jnp.any(s.q_open_key > 0), tally, lambda s: s, s)
 
@@ -764,7 +778,12 @@ def _fused_event_post(cfg: SimConfig, topo, s: SerfState, active, key,
     Returns (state, (queued[] i32, retransmits[] i32, drops[] i32)) —
     the idle branch returns zeros of the same structure so both cond
     branches match."""
-    if coll.sharded():
+    if coll.sharded() or coll.in_kernel():
+        # Unconditional body in both cases (collectives under sharding,
+        # no pytree-operand branching under Mosaic); an idle plane's
+        # masks are all false so the body IS the pass-through — the
+        # sharded==single-device parity suite already pins exactly this
+        # equivalence, and the kernel parity suite re-pins it.
         return _fused_event_post_body(
             cfg, topo, s, active, key, ex_legs, ex_n_sends, m_tx, order,
             m_valid, sched, terms)

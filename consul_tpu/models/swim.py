@@ -511,14 +511,23 @@ def step_counted(cfg: SimConfig, topo: Topology, world: World, state: SimState,
     # Global reshuffle when the slowest cursor wraps (approximates the
     # per-wrap shuffle of state.go:492-513).
     wrapped = ptr >= k_deg
-    perm = jax.lax.cond(
-        coll.any_rows(wrapped),
-        lambda p: jnp.argsort(
-            coll.uniform_rows(keys[6], n, (k_deg,)), axis=1
-        ).astype(jnp.int32),
-        lambda p: p,
-        state.probe_perm,
-    )
+    if coll.in_kernel():
+        # Kernel-callable core: no cond (Mosaic can't branch around a
+        # pytree operand) and no argsort (sort-lowered). The draw and
+        # the unconditional argmin peel produce exactly the cond's
+        # taken-branch permutation; rows that did not wrap keep their
+        # old perm through the same where-mask below, so the result is
+        # bit-identical in both the wrapped and idle cases.
+        perm = _argsort_peel(coll.uniform_rows(keys[6], n, (k_deg,)))
+    else:
+        perm = jax.lax.cond(
+            coll.any_rows(wrapped),
+            lambda p: jnp.argsort(
+                coll.uniform_rows(keys[6], n, (k_deg,)), axis=1
+            ).astype(jnp.int32),
+            lambda p: p,
+            state.probe_perm,
+        )
     probe_perm = jnp.where(wrapped[:, None], perm, state.probe_perm)
     # A successful ack is first-hand evidence from the target itself:
     # join (target_incarnation, ALIVE) at the target's column. This is
@@ -845,6 +854,39 @@ def _vivaldi_observe(cfg, state: SimState, ok, peer_col, rtt,
     return state._replace(viv=new_viv, lat_buf=lat_buf, lat_cnt=lat_cnt)
 
 
+def _top_k_peel(x, p: int):
+    """Static argmax peel equal to ``jax.lax.top_k(x, p)`` on integer
+    input — per pass, (max value, lowest index on ties), which is
+    exactly top_k's tie order. The kernel-callable core
+    (ops/pallas_gossip.py) uses this because Mosaic has no sort
+    lowering; the XLA path keeps ``lax.top_k`` so its executable is
+    byte-for-byte the pre-kernel one."""
+    cols = jnp.arange(x.shape[-1], dtype=jnp.int32)
+    floor = jnp.iinfo(x.dtype).min
+    vals, idxs, work = [], [], x
+    for _ in range(p):
+        vals.append(jnp.max(work, axis=-1))
+        best = jnp.argmax(work, axis=-1).astype(jnp.int32)
+        idxs.append(best)
+        work = jnp.where(cols == best[..., None], floor, work)
+    return jnp.stack(vals, axis=-1), jnp.stack(idxs, axis=-1)
+
+
+def _argsort_peel(u):
+    """Static argmin peel equal to stable ascending
+    ``jnp.argsort(u, axis=-1)`` including ties (argmin returns the
+    first index of the minimum; masking with +inf peels in the same
+    order a stable sort emits). Kernel-callable-core twin of the
+    probe-order reshuffle's argsort — see :func:`_top_k_peel`."""
+    cols = jnp.arange(u.shape[-1], dtype=jnp.int32)
+    out, work = [], u
+    for _ in range(u.shape[-1]):
+        best = jnp.argmin(work, axis=-1).astype(jnp.int32)
+        out.append(best)
+        work = jnp.where(cols == best[..., None], jnp.inf, work)
+    return jnp.stack(out, axis=-1)
+
+
 def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit,
                   sched=None, terms=None, extra_tx=None):
     """Fan-out + receiver-side delivery + lattice merge + confirmations
@@ -890,7 +932,12 @@ def _gossip_phase(cfg, topo: Topology, state: SimState, active, key, tx_limit,
 
     # Sender-side selection: top-P entries by remaining budget.
     budget = jnp.where(active[:, None], state.tx_left, 0)
-    top_tx, scol = jax.lax.top_k(budget, p)          # [N, P]
+    if coll.in_kernel():
+        # Kernel-callable core: argmax peel, bit-identical to top_k
+        # (max value, lowest index on ties) — Mosaic has no sort.
+        top_tx, scol = _top_k_peel(budget, p)        # [N, P]
+    else:
+        top_tx, scol = jax.lax.top_k(budget, p)      # [N, P]
     svalid = top_tx > 0
     skey = _take_cols(state.view_key, scol)
     sbits = _take_cols(state.susp_seen, scol)

@@ -1,0 +1,184 @@
+"""Pallas packed-native gossip kernel: one tick, VMEM-resident.
+
+Since PR 11 the state at rest is packed (176 B/node at K=8, 296 at
+K=16) but the XLA scan body unpacks to the dense f32/i32 working set in
+HBM before every tick and repacks after — the 2.66x byte cut is a
+capacity win only, while per-tick HBM traffic stays dense. This module
+fuses the whole tick into one ``pl.pallas_call``: the PackedSimState
+tiles load into VMEM, the layout codec (models/layout.py pack/unpack —
+purely elementwise, so it inlines into the kernel as register math)
+widens them in-register, the SWIM probe/ack/suspicion update and the
+``roll_many`` circulant payload exchange (including the serf
+``extra_tx`` top-k piggyback peel) run on the VMEM-resident working
+set, and the state repacks before the VMEM→HBM writeback. HBM bytes
+per tick are pure packed bytes — the memory-bound inner loop the
+hand-fused-kernel literature targets (fluid-flow stencils, distributed
+linear algebra) has exactly this shape.
+
+Kernel-callable core: the step bodies consult
+``parallel/collective.in_kernel()`` at the four sites whose XLA idiom
+Mosaic cannot lower — ``lax.top_k``/``argsort`` become static
+argmax/argmin peels (bit-identical selection, models/swim.py), and the
+serf busy-gate/tally ``lax.cond``s run their bodies unconditionally
+(bit-identical by the masks' idle-false property, models/serf.py).
+Off-kernel the step programs are byte-for-byte untouched — the
+``--kernel`` compile-ledger pin counts exactly that.
+
+Tiling scheme: the circulant exchange reads rows at random per-tick
+displacements, so a halo-tiled grid would need O(n) halos — the kernel
+instead keeps the whole (packed) population resident in one VMEM block
+(no grid). Packed residency is what buys the headroom: ~16 MB/core
+of VMEM holds ~90k packed nodes at K=8 versus ~34k dense. Populations
+beyond that cap need an exchange-split multi-block kernel — an item-1
+remainder, like Mosaic input/output aliasing of the state tiles.
+
+Sharded: shard_map calls the kernel once per shard; under
+``interpret=True`` the collectives the step emits (ppermute ladders,
+all-gathers) trace into the kernel jaxpr and resolve against the
+enclosing shard_map axis, so sharded == single-device parity is pinned
+on CPU. Real-TPU Mosaic cannot host ICI collectives inside a kernel —
+the multi-chip lowering splits the kernel at the three mid-tick
+exchange barriers (item-1 remainder); single-chip TPU lowering needs no
+split.
+
+Interpret discipline (lint rule TH118): ``interpret=True`` silently
+shipping to TPU is a 100x perf cliff. Production entry points thread
+:func:`default_interpret` (False on TPU); the one sanctioned truthy
+literal is :func:`interpret_tick`, the explicitly-marked test/debug
+entry the golden-parity suite drives, allowlisted by symbol.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.config import SimConfig
+from consul_tpu.models import counters as counters_mod
+from consul_tpu.models import layout as layout_mod
+from consul_tpu.models import swim
+from consul_tpu.parallel import collective as coll
+
+XLA = "xla"
+PALLAS = "pallas"
+KERNELS = (XLA, PALLAS)
+
+
+def validate_kernel(kernel: str, layout: str) -> None:
+    """Reject invalid --kernel selections, host-side and static."""
+    if kernel not in KERNELS:
+        raise ValueError(
+            f"unknown kernel {kernel!r}; expected one of {KERNELS}")
+    if kernel == PALLAS and layout != layout_mod.PACKED:
+        raise ValueError(
+            "--kernel pallas is packed-native (the kernel's contract is "
+            "packed HBM bytes per tick); run it with --layout packed")
+
+
+def default_interpret() -> bool:
+    """Kernel evaluation mode for the current backend: False on TPU
+    (Mosaic compiles the kernel), True elsewhere (the interpreter twin
+    — Mosaic only lowers on TPU, and CPU tier-1 pins golden parity in
+    exactly this mode). Production callers must thread THIS value, not
+    a literal — interpret mode silently shipping to TPU is the TH118
+    100x perf cliff."""
+    return jax.default_backend() != "tpu"
+
+
+def make_tick_kernel(cfg: SimConfig, topo, *, step_fn=swim.step_counted,
+                     sentinel: bool = False, interpret: bool = False):
+    """Build ``tick(world, sched, packed_state, tick_key) ->
+    (packed_state, GossipCounters)`` executing one gossip tick as a
+    single Pallas kernel over the packed state.
+
+    ``packed_state`` is a PackedSimState (or a SerfState whose SWIM
+    plane is packed); the returned state has identical structure —
+    pack∘unpack is shape/dtype-preserving, which is also how the kernel
+    declares its output block shapes without an abstract trace. The
+    counters cross the kernel boundary as one stacked
+    [len(FIELDS)] i32 vector (models/counters.py wire order) and
+    unstack outside. ``sched`` may be None (the schedule-free program;
+    None is an empty pytree so the kernel simply has no schedule
+    operands)."""
+    from jax.experimental import pallas as pl  # deferred: jax-optional
+
+    def _tick_math(world, sched, packed, tick_key):
+        state = layout_mod.unpack_state(packed)
+        with coll.kernel_body():
+            state, c = step_fn(cfg, topo, world, state, tick_key, sched,
+                               sentinel=sentinel)
+        return layout_mod.pack_state(state), counters_mod.stack(c)
+
+    def tick(world, sched, packed, tick_key):
+        args = (world, sched, packed, tick_key)
+        flat_args, _ = jax.tree.flatten(args)
+        # Trace the tick once to a closed jaxpr: the kernel body then
+        # replays it primitive-by-primitive, and every captured device
+        # array (topology tables, module constants) surfaces in
+        # ``consts`` — Pallas kernels cannot close over arrays, so the
+        # consts ride in as explicit VMEM inputs alongside the state.
+        cj, out_shape = jax.make_jaxpr(_tick_math, return_shape=True)(*args)
+        consts = [jnp.asarray(c) for c in cj.consts]
+        flat_out, out_tree = jax.tree.flatten(out_shape)
+        flat_in = flat_args + consts
+        n_args = len(flat_args)
+        # Zero-size leaves (e.g. a chaos schedule's empty fault-type
+        # slots) cannot be Pallas blocks; they are contentless, so they
+        # stay outside the call and rematerialize in-body.
+        in_live = [l for l in flat_in if l.size > 0]
+        out_live = [o for o in flat_out if o.size > 0]
+        n_live = len(in_live)
+
+        def kernel(*refs):
+            it = iter(refs[:n_live])
+            ins = [next(it)[...] if l.size > 0
+                   else jnp.zeros(l.shape, l.dtype) for l in flat_in]
+            outs = jax.core.eval_jaxpr(cj.jaxpr, ins[n_args:],
+                                       *ins[:n_args])
+            ot = iter(refs[n_live:])
+            for o, leaf in zip(flat_out, outs):
+                if o.size > 0:
+                    next(ot)[...] = leaf
+
+        outs = pl.pallas_call(
+            kernel,
+            out_shape=[jax.ShapeDtypeStruct(o.shape, o.dtype)
+                       for o in out_live],
+            interpret=interpret,
+        )(*in_live)
+        it = iter(outs)
+        full = [next(it) if o.size > 0 else jnp.zeros(o.shape, o.dtype)
+                for o in flat_out]
+        out_state, cv = jax.tree.unflatten(out_tree, full)
+        return out_state, counters_mod.unstack(cv)
+
+    return tick
+
+
+def interpret_tick(cfg: SimConfig, topo, *, step_fn=swim.step_counted,
+                   sentinel: bool = False):
+    """The explicitly-marked test/debug entry point: the
+    ``interpret=True`` twin the CPU golden-parity suite
+    (tests/test_pallas_gossip.py) drives directly. Never a production
+    path — the TH118 allowlist carries exactly this symbol."""
+    return make_tick_kernel(cfg, topo, step_fn=step_fn, sentinel=sentinel,
+                            interpret=True)
+
+
+def tick_hbm_bytes_per_node(state, world=None, sched=None,
+                            n: Optional[int] = None) -> float:
+    """The kernel's HBM-traffic contract, in bytes/tick/node: one read
+    of the (packed) state + world (+ schedule) and one write of the
+    state — everything else is VMEM-resident. Abstract values welcome
+    (pairs with jax.eval_shape); ``n`` defaults to the state's leading
+    node-axis length. bench.py's memory phase asserts this stays within
+    a small constant of the at-rest bytes/node."""
+    if n is None:
+        n = max(int(leaf.shape[0]) for leaf in jax.tree.leaves(state)
+                if getattr(leaf, "ndim", 0) >= 1)
+    total = sum(layout_mod.np_size_bytes(leaf)
+                for tree in (state, state, world, sched)
+                for leaf in jax.tree.leaves(tree))
+    return total / float(n)

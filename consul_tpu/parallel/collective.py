@@ -71,6 +71,36 @@ def sharded() -> bool:
     return _CTX.get() is not None
 
 
+_KERNEL: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "consul_tpu_kernel_body", default=False
+)
+
+
+def in_kernel() -> bool:
+    """True while tracing inside the Pallas gossip kernel body
+    (ops/pallas_gossip.py) — for trace-time choices between the XLA
+    formulation and the kernel-callable core (no ``lax.cond`` around
+    pytree operands, no sort-lowered primitives: Mosaic has neither).
+    Off this path the step programs are byte-for-byte untouched, which
+    is what the ``--kernel`` compile-ledger pin counts."""
+    return _KERNEL.get()
+
+
+@contextlib.contextmanager
+def kernel_body():
+    """Declare that step code traced inside this context is being
+    inlined into the Pallas gossip kernel. Composes with
+    :func:`node_axis`: a sharded kernel body traces its collectives
+    (ppermute/all-gather) straight into the kernel jaxpr, which the
+    interpret-mode evaluator resolves against the enclosing shard_map
+    axis (tests/test_pallas_gossip.py pins sharded == single-device)."""
+    tok = _KERNEL.set(True)
+    try:
+        yield
+    finally:
+        _KERNEL.reset(tok)
+
+
 @contextlib.contextmanager
 def node_axis(axis_name: str, n_shards: int, n_global: int):
     """Declare that per-node arrays inside this context are shard_map
@@ -192,7 +222,17 @@ def roll_many(arrays, shift):
     ~10% single-chip throughput at >=262k nodes). Sharded: the arrays
     pack into one uint32 payload so the whole exchange is a single
     ppermute per hop, then unpack. Supports bool/int32/uint32 leaves of
-    rank 1 or 2; int32 round-trips by bit-pattern (negatives survive)."""
+    rank 1 or 2; int32 round-trips by bit-pattern (negatives survive).
+
+    Transport-width contract note: the HBM-resident state is what the
+    ``--kernel`` flag narrows, not this wire format. Under the XLA path
+    the exchange moves 32-bit lanes between dense working-set buffers;
+    under the Pallas packed-native path (ops/pallas_gossip.py) the same
+    ``roll_many`` calls trace *inside* the kernel body, where the
+    working set was unpacked in-register from PackedSimState tiles — so
+    the bytes that cross HBM per tick are the packed at-rest bytes
+    (bench.py memory phase asserts the ratio), while the in-flight
+    lanes here stay 32-bit in both modes."""
     # Packing goes through astype(uint32), which is a VALUE conversion:
     # float dtypes would be silently rounded and 64-bit ints truncated,
     # but only on the sharded path — a divergence invisible single-chip.

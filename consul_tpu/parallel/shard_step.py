@@ -195,7 +195,8 @@ def make_sharded_chunk_runner(cfg: SimConfig, topo: Topology, mesh: Mesh,
                               chunk: int, with_metrics: bool, *,
                               step_fn, swim_of,
                               chaos: bool = False, sentinel: bool = False,
-                              layout: str = "dense", raft=None):
+                              layout: str = "dense", raft=None,
+                              kernel: str = "xla"):
     """The multi-chip analogue of models/cluster.py ``_chunk_runner``:
     one jitted program per (cfg, topo content, chunk, metrics, step,
     chaos shape, sentinel, MESH) signature with the same call convention
@@ -249,6 +250,21 @@ def make_sharded_chunk_runner(cfg: SimConfig, topo: Topology, mesh: Mesh,
     axis, n_shards = node_axes(mesh)
     if cfg.n % n_shards != 0:
         raise ValueError(f"n={cfg.n} must divide over {n_shards} shards")
+    use_pallas = kernel == "pallas"
+    if use_pallas:
+        # shard_map calls the kernel once per shard; the step's
+        # collectives trace INTO the kernel jaxpr and the interpret-
+        # mode evaluator resolves them against the enclosing mesh axis
+        # (tests/test_pallas_gossip.py pins sharded == single-device).
+        # Real-TPU Mosaic cannot host ICI collectives inside a kernel —
+        # the multi-chip lowering splits at the three mid-tick exchange
+        # barriers (ROADMAP item-1 remainder).
+        from consul_tpu.ops import pallas_gossip
+
+        pallas_gossip.validate_kernel(kernel, layout)
+        ptick = pallas_gossip.make_tick_kernel(
+            cfg, topo, step_fn=step_fn, sentinel=sentinel,
+            interpret=pallas_gossip.default_interpret())
 
     world_spec = World(pos=P(axis, None), height=P(axis))
     cnt_specs = jax.tree.map(lambda _: P(), counters_mod.zeros())
@@ -276,18 +292,26 @@ def make_sharded_chunk_runner(cfg: SimConfig, topo: Topology, mesh: Mesh,
                 (state, rst), (cnt, rcnt) = carry
             else:
                 state, cnt = carry
-            if packed:
-                state = layout_mod.unpack_state(state)
-            if raft is not None:
-                # Keyed on the PRE-step tick — the t this tick_key was
-                # folded from — matching the single-device runner and
-                # the lockstep oracle's step(t).
-                t_pre = swim_of(state).t
-            with coll.node_axis(axis, n_shards, cfg.n):
-                state, c = step_fn(cfg, topo, world_l, state, tick_key,
-                                   sched_l, sentinel=sentinel)
-            if packed:
-                state = layout_mod.pack_state(state)
+            if use_pallas:
+                if raft is not None:
+                    # PRE-step tick, straight off the packed t leaf.
+                    t_pre = layout_mod.tick_of(state)
+                with coll.node_axis(axis, n_shards, cfg.n):
+                    state, c = ptick(world_l, sched_l, state, tick_key)
+            else:
+                if packed:
+                    state = layout_mod.unpack_state(state)
+                if raft is not None:
+                    # Keyed on the PRE-step tick — the t this tick_key
+                    # was folded from — matching the single-device
+                    # runner and the lockstep oracle's step(t).
+                    t_pre = swim_of(state).t
+                with coll.node_axis(axis, n_shards, cfg.n):
+                    state, c = step_fn(cfg, topo, world_l, state,
+                                       tick_key, sched_l,
+                                       sentinel=sentinel)
+                if packed:
+                    state = layout_mod.pack_state(state)
             cnt = counters_mod.add(cnt, c)
             if raft is not None:
                 rst, rc = raft_ops.tick(raft, rst, t_pre, tick_key,

@@ -69,6 +69,7 @@ def prewarm_simulation(sim, chunk: int, with_metrics: bool) -> None:
         sentinel=sim.sentinel, mesh=sim.mesh,
         layout=getattr(sim, "layout", "dense"),
         raft=raft_cfg,
+        kernel=getattr(sim, "kernel", "xla"),
     )
     state_aval = (_abstract(sim.state) if raft_cfg is None
                   else (_abstract(sim.state), _abstract(sim.raft.state)))
@@ -93,7 +94,7 @@ def prewarm(ns: Sequence[int], kinds: Sequence[str] = ("swim",),
             layout: str = "dense", family: str = "circulant",
             family_param: float = 0.0, sweep: int = 0,
             sweep_chunk: int = 32, raft_groups: int = 0,
-            raft_peers: int = 5) -> dict:
+            raft_peers: int = 5, kernel: str = "xla") -> dict:
     """Compile every (n, kind, chunk, mesh-shape, chaos-shape, layout)
     signature into the persistent compile cache and return a JSON-ready
     summary: the signatures compiled, cache hit/miss movement, and wall
@@ -112,6 +113,10 @@ def prewarm(ns: Sequence[int], kinds: Sequence[str] = ("swim",),
     of the same shape. ``raft_groups=R`` (with ``raft_peers``) arms the
     batched raft tier before compiling, warming the raft-carrying
     program a ``consul-tpu run --raft-groups R`` binds.
+    ``kernel="pallas"`` warms the Pallas packed-native tick program
+    (ops/pallas_gossip.py) instead of the XLA scan body — a different
+    executable, so the flag is part of the signature key exactly like
+    ``layout``.
     """
     from consul_tpu import chaos as chaos_api
     from consul_tpu.config import SimConfig, clamp_view_degree
@@ -137,7 +142,7 @@ def prewarm(ns: Sequence[int], kinds: Sequence[str] = ("swim",),
             cfg = SimConfig(n=n, view_degree=clamp_view_degree(n, view_degree),
                             topo_family=family, topo_param=family_param)
             sim = classes[kind](cfg, seed=seed, sentinel=sentinel, mesh=m,
-                                layout=layout)
+                                layout=layout, kernel=kernel)
             if raft_groups > 0:
                 sim.set_raft(raft_groups, peers=raft_peers)
             schedules = [None]
@@ -156,6 +161,7 @@ def prewarm(ns: Sequence[int], kinds: Sequence[str] = ("swim",),
                             "with_metrics": bool(with_metrics),
                             "chaos": sched is not None,
                             "layout": layout,
+                            "kernel": kernel,
                             "family": family,
                             "raft_groups": int(raft_groups),
                             "wall_s": round(time.perf_counter() - t0, 3),

@@ -867,6 +867,89 @@ class TestTH113:
 
 
 # ----------------------------------------------------------------------
+# TH118: Pallas interpret mode hardcoded on
+# ----------------------------------------------------------------------
+
+class TestTH118:
+    def test_interpret_true_on_pallas_call_fires(self):
+        rep = _lint({DEV2: """
+            from jax.experimental import pallas as pl
+
+            def launch(kernel, out_shape, x):
+                return pl.pallas_call(kernel, out_shape=out_shape,
+                                      interpret=True)(x)
+        """})
+        assert _rules(rep) == ["TH118"]
+        assert rep.findings[0].symbol == "launch"
+
+    def test_interpret_false_and_threaded_value_are_silent(self):
+        # interpret=False and a non-literal (the default_interpret()
+        # backend probe threaded through) are both the sanctioned
+        # idiom — the rule only chases truthy LITERALS.
+        rep = _lint({DEV2: """
+            from jax.experimental import pallas as pl
+
+            def launch(kernel, out_shape, x, interpret):
+                return pl.pallas_call(kernel, out_shape=out_shape,
+                                      interpret=interpret)(x)
+
+            def launch_compiled(kernel, out_shape, x):
+                return pl.pallas_call(kernel, out_shape=out_shape,
+                                      interpret=False)(x)
+        """})
+        assert rep.clean
+
+    def test_interpret_default_truthy_on_def_fires(self):
+        rep = _lint({DEV2: """
+            def make_kernel(cfg, *, interpret=True):
+                return cfg
+        """})
+        assert _rules(rep) == ["TH118"]
+        assert rep.findings[0].symbol == "make_kernel"
+
+    def test_interpret_true_into_internal_builder_fires(self):
+        # Forwarding the literal into a consul_tpu kernel builder is
+        # the same cliff one call further from the launch.
+        rep = _lint({DEV2: """
+            from consul_tpu.ops import pallas_gossip
+
+            def production_runner(cfg, topo):
+                return pallas_gossip.make_tick_kernel(
+                    cfg, topo, interpret=True)
+        """})
+        assert _rules(rep) == ["TH118"]
+        assert rep.findings[0].symbol == "production_runner"
+
+    def test_external_callee_with_interpret_kwarg_is_silent(self):
+        # interpret= on a non-pallas, non-consul_tpu callee is someone
+        # else's API, not a kernel launch.
+        rep = _lint({DEV2: """
+            import somelib
+
+            def run(x):
+                return somelib.evaluate(x, interpret=True)
+        """})
+        assert rep.clean
+
+    def test_allowlist_carries_the_marked_debug_entry(self):
+        al = parse_allowlist("""
+            [[allow]]
+            rule = "TH118"
+            path = "consul_tpu/ops/fake2.py"
+            symbol = "interpret_twin"
+            reason = "marked test/debug entry for the parity suite"
+        """)
+        rep = _lint({DEV2: """
+            from consul_tpu.ops import pallas_gossip
+
+            def interpret_twin(cfg, topo):
+                return pallas_gossip.make_tick_kernel(
+                    cfg, topo, interpret=True)
+        """}, al)
+        assert rep.clean and len(rep.suppressed) == 1
+
+
+# ----------------------------------------------------------------------
 # TH114: guarded-by inference — inconsistently guarded writes
 # ----------------------------------------------------------------------
 
@@ -1665,6 +1748,6 @@ class TestPackageGate:
         assert set(analysis.RULES) == {
             "TH101", "TH102", "TH103", "TH104", "TH105", "TH106",
             "TH107", "TH108", "TH109", "TH110", "TH111", "TH112",
-            "TH113", "TH114", "TH115", "TH116", "TH117"}
+            "TH113", "TH114", "TH115", "TH116", "TH117", "TH118"}
         for rid, rationale in analysis.RULES.items():
             assert rationale.strip(), rid

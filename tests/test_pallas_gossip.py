@@ -1,0 +1,422 @@
+"""Pallas packed-native gossip kernel (ops/pallas_gossip.py).
+
+The interpret-mode twin is the kernel's CPU truth: ``interpret=True``
+replays the exact jaxpr the Mosaic lowering would execute, so parity
+pinned here is parity the TPU campaign inherits. The contracts:
+
+  - **single-device bit-identity** — unpack -> step -> repack through
+    the kernel produces the same PackedSimState, leaf for leaf, as the
+    XLA scan body at the same seed (the kernel-callable core's peels
+    and unconditional tallies are bit-identical rewrites, not
+    approximations), counters included; chaos and sentinel on/off;
+  - **serf reference parity** — the kernel's delivered-event sets,
+    Lamport floors and coverage match ``serf.step_reference_counted``
+    (the preserved pre-fusion golden reference), piggyback peel
+    included;
+  - **driver-level golden parity at 4096** (slow tier) — the
+    dense-layout Simulation is the reference every prior PR pinned
+    against; the pallas twin's discrete plane is bit-identical, the
+    Vivaldi plane within the PR-11 packed tolerances, SLO counters
+    equal, chaos on and off, sharded == single-device;
+  - **DCE discipline** — kernel off IS the pre-PR program: a warmed
+    xla sim stays at zero builds, toggling pallas on costs exactly one
+    build, toggling back re-binds the memoized xla executable at zero;
+  - **prewarm signature** — ``prewarm(..., kernel="pallas")`` then a
+    pallas run records zero net backend compiles (subprocess, the
+    PR-10 idiom: persistent-cache state is process-global).
+"""
+
+import functools
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from consul_tpu import chaos
+from consul_tpu.config import SimConfig
+from consul_tpu.models import layout
+from consul_tpu.models import serf
+from consul_tpu.models import state as sim_state
+from consul_tpu.models import swim
+from consul_tpu.models.cluster import SLO_KEYS, SerfSimulation, Simulation
+from consul_tpu.ops import pallas_gossip, topology
+from consul_tpu.parallel import mesh as pmesh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N = 4096
+SEED = 3
+TICKS = 48
+CHUNK = 16
+
+# The PR-11 packed tolerances (tests/test_layout_parity.py): the float
+# plane rounds through bf16/fp8 each repack, the discrete plane is
+# exact. The pallas twin runs the SAME codec, so it inherits the same
+# envelope against the dense reference.
+DISCRETE = (
+    "t", "alive_truth", "left", "leaving", "external", "own_inc",
+    "own_tx", "awareness", "probe_perm", "probe_ptr", "next_probe_tick",
+    "pending_col", "pending_fail_tick", "pending_nack_miss", "view_key",
+    "susp_start", "susp_seen", "tx_left", "lat_cnt",
+)
+VIV_RTOL = 3e-2
+VIV_ATOL = 2e-3
+LAT_ATOL = 2e-2
+
+
+def _assert_trees_equal(a, b, context: str):
+    for (pa, x), (_, y) in zip(
+            jax.tree_util.tree_flatten_with_path(a)[0],
+            jax.tree_util.tree_flatten_with_path(b)[0]):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y),
+            err_msg=f"{context}{jax.tree_util.keystr(pa)}")
+
+
+def _setup(n, seed=SEED, view_degree=16, kind="swim"):
+    cfg = SimConfig(n=n, view_degree=view_degree)
+    kw, kt, ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    world = topology.make_world(cfg, kw)
+    topo = topology.make_topology(cfg, kt)
+    init = serf.init if kind == "serf" else sim_state.init
+    return cfg, world, topo, init(cfg, ks)
+
+
+# ----------------------------------------------------------------------
+# Single-device bit-identity: the kernel vs the XLA step, leaf for leaf
+# ----------------------------------------------------------------------
+
+class TestKernelBitIdentity:
+    def _drive(self, cfg, topo, world, st0, sched, step_fn, *, sentinel,
+               ticks):
+        tick = jax.jit(pallas_gossip.interpret_tick(
+            cfg, topo, step_fn=step_fn, sentinel=sentinel))
+
+        # The packed scan body rounds the state through the codec every
+        # tick; the reference must take the same rounding to be the
+        # kernel's bit-identity twin. Jitted like the kernel tick so
+        # both sides run compiled float arithmetic.
+        @jax.jit
+        def ref_tick(world, sched, ks, k):
+            s, c = step_fn(cfg, topo, world, ks, k, sched,
+                           sentinel=sentinel)
+            return layout.unpack_state(layout.pack_state(s)), c
+
+        ks = st0
+        kp = layout.pack_state(st0)
+        base = jax.random.PRNGKey(17)
+        kc = xc = None
+        for t in range(ticks):
+            k = jax.random.fold_in(base, t)
+            ks, xc = ref_tick(world, sched, ks, k)
+            kp, kc = tick(world, sched, kp, k)
+        return layout.pack_state(ks), kp, xc, kc
+
+    def test_swim_state_and_counters_bit_identical(self):
+        cfg, world, topo, st0 = _setup(256)
+        ref, got, xc, kc = self._drive(cfg, topo, world, st0, None,
+                                       swim.step_counted, sentinel=False,
+                                       ticks=8)
+        _assert_trees_equal(ref, got, "swim")
+        _assert_trees_equal(xc, kc, "counters")
+
+    def test_chaos_and_sentinel_bit_identical(self):
+        cfg, world, topo, st0 = _setup(256)
+        # The drop counter is a per-tick value, so keep the partition
+        # live through the final tick for the faults-really-bit check.
+        sched = chaos.compile_schedule(cfg.n, [
+            chaos.Partition(start=2, stop=8, side_a=slice(0, 80))])
+        ref, got, xc, kc = self._drive(cfg, topo, world, st0, sched,
+                                       swim.step_counted, sentinel=True,
+                                       ticks=8)
+        _assert_trees_equal(ref, got, "swim+chaos")
+        _assert_trees_equal(xc, kc, "counters+chaos")
+        assert int(kc.chaos_msgs_dropped) > 0  # the faults really bit
+
+    def test_serf_piggyback_bit_identical(self):
+        cfg, world, topo, st0 = _setup(256, kind="serf")
+        mask = np.zeros(cfg.n, dtype=bool)
+        mask[3] = True
+        st0 = serf.user_event(cfg, st0, mask, 5)
+        ref, got, xc, kc = self._drive(cfg, topo, world, st0, None,
+                                       serf.step_counted, sentinel=False,
+                                       ticks=10)
+        _assert_trees_equal(ref, got, "serf")
+        _assert_trees_equal(xc, kc, "serf counters")
+        # The piggybacked event actually crossed the exchange.
+        assert int(np.asarray(
+            layout.unpack_state(got).ev_delivered).sum()) > 1
+
+
+# ----------------------------------------------------------------------
+# Serf reference parity: the preserved pre-fusion golden step
+# ----------------------------------------------------------------------
+
+class TestSerfReferenceParity:
+    def test_delivered_sets_match_step_reference(self):
+        cfg, world, topo, st0 = _setup(256, kind="serf")
+        fired = []
+        su = st0
+        for row, name in ((3, 5), (40, 6)):
+            mask = np.zeros(cfg.n, dtype=bool)
+            mask[row] = True
+            fired.append(
+                (serf.make_event_key(su.event_clock[row], name), row))
+            su = serf.user_event(cfg, su, mask, name)
+        tick = jax.jit(pallas_gossip.interpret_tick(
+            cfg, topo, step_fn=serf.step_counted))
+        rstep = jax.jit(functools.partial(
+            serf.step_reference_counted, cfg, topo, world))
+        kp = layout.pack_state(su)
+        base = jax.random.PRNGKey(17)
+        for t in range(24):
+            k = jax.random.fold_in(base, t)
+            su, _ = rstep(su, k)
+            kp, _ = tick(world, None, kp, k)
+        ks = layout.unpack_state(kp)
+        # The fused-vs-legacy contract, now through the kernel: same
+        # delivered-event sets, Lamport floors, full coverage.
+        np.testing.assert_array_equal(np.asarray(ks.ev_delivered),
+                                      np.asarray(su.ev_delivered))
+        for field in ("event_clock", "ev_floor", "q_floor"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ks, field)),
+                np.asarray(getattr(su, field)), err_msg=field)
+        for key_, origin in fired:
+            assert float(serf.event_coverage(cfg, ks, key_, origin)) == 1.0
+            assert float(serf.event_coverage(cfg, su, key_, origin)) == 1.0
+
+
+# ----------------------------------------------------------------------
+# Sharded == single-device through the driver seam
+# ----------------------------------------------------------------------
+
+class TestShardedParity:
+    def test_sharded_kernel_matches_single_device(self):
+        def drive(mesh):
+            sim = Simulation(SimConfig(n=512, view_degree=16), seed=SEED,
+                             mesh=mesh, layout="packed", kernel="pallas")
+            sim.run(12, chunk=4, with_metrics=False)
+            return sim
+
+        ref = drive(None)
+        got = drive(pmesh.make_mesh(jax.devices()[:8]))
+        _assert_trees_equal(jax.device_get(ref.state),
+                            jax.device_get(got.state), "sharded state")
+        assert ref.counters == got.counters
+
+
+# ----------------------------------------------------------------------
+# Flag validation and the lens exclusion
+# ----------------------------------------------------------------------
+
+class TestKernelValidation:
+    def test_unknown_kernel_rejected(self):
+        with pytest.raises(ValueError, match="unknown kernel"):
+            pallas_gossip.validate_kernel("mosaic", "packed")
+
+    def test_pallas_requires_packed_layout(self):
+        with pytest.raises(ValueError, match="packed"):
+            Simulation(SimConfig(n=64, view_degree=8), kernel="pallas")
+
+    def test_set_kernel_validates_against_layout(self):
+        sim = Simulation(SimConfig(n=64, view_degree=8))
+        with pytest.raises(ValueError, match="packed"):
+            sim.set_kernel("pallas")
+
+    def test_lens_and_pallas_are_exclusive(self):
+        sim = Simulation(SimConfig(n=64, view_degree=8), layout="packed",
+                         kernel="pallas")
+        sim.set_lens(4)
+        with pytest.raises(ValueError, match="lens"):
+            sim.run(4, chunk=2, with_metrics=False)
+
+    def test_traffic_contract_packed_vs_dense(self):
+        cfg = SimConfig(n=1024, view_degree=16)
+        k0 = jax.random.PRNGKey(0)
+        pst, dst, wav = jax.eval_shape(
+            lambda k: (layout.pack_state(sim_state.init(cfg, k)),
+                       sim_state.init(cfg, k),
+                       topology.make_world(cfg, k)), k0)
+        packed = pallas_gossip.tick_hbm_bytes_per_node(pst, wav, None)
+        dense = pallas_gossip.tick_hbm_bytes_per_node(dst, wav, None)
+        # The kernel's whole point: per-tick HBM bytes are pure packed
+        # bytes, not the dense working set the scan body round-trips.
+        assert packed < 0.5 * dense
+        at_rest = sum(layout.np_size_bytes(leaf)
+                      for leaf in jax.tree.leaves(pst)) / cfg.n
+        assert packed <= 3.0 * at_rest  # the bench memory-phase bound
+
+
+# ----------------------------------------------------------------------
+# DCE discipline: the compile-ledger pin across kernel toggles
+# ----------------------------------------------------------------------
+
+class TestCompileLedgerPin:
+    def test_kernel_toggle_costs_exactly_one_build(self, compile_ledger):
+        sim = Simulation(SimConfig(n=160, view_degree=8), seed=1,
+                         layout="packed")
+        sim.run(10, chunk=5, with_metrics=False)  # warm the xla program
+        with compile_ledger.expect(
+                0, "kernel off must BE the pre-PR executable"):
+            sim.run(10, chunk=5, with_metrics=False)
+        sim.set_kernel("pallas")
+        with compile_ledger.expect(
+                1, "kernel on is one new program, built once"):
+            sim.run(10, chunk=5, with_metrics=False)
+        with compile_ledger.expect(
+                0, "pallas steady state must hold the memo"):
+            sim.run(10, chunk=5, with_metrics=False)
+        sim.set_kernel("xla")
+        with compile_ledger.expect(
+                0, "toggling back must re-bind the memoized xla "
+                   "executable, not rebuild it"):
+            sim.run(10, chunk=5, with_metrics=False)
+
+
+# ----------------------------------------------------------------------
+# Prewarm: the pallas program joins the AOT signature (PR-10 idiom)
+# ----------------------------------------------------------------------
+
+_PREWARM_CHILD = """
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8")
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_threefry_partitionable", True)
+from consul_tpu.analysis.guards import CompileLedger
+from consul_tpu.config import SimConfig
+from consul_tpu.models.cluster import Simulation
+from consul_tpu.parallel import mesh as pmesh
+from consul_tpu.utils import prewarm as prewarm_mod
+
+led = CompileLedger()
+summary = prewarm_mod.prewarm(ns=[64], kinds=("swim",), chunks=(16,),
+                              metrics_modes=(False,), cache_dir={cache!r},
+                              layout="packed", kernel="pallas")
+mesh = pmesh.default_mesh(64)
+sim = Simulation(SimConfig(n=64, view_degree=16), seed=0, mesh=mesh,
+                 layout="packed", kernel="pallas")
+start = led.total
+sim.run(32, chunk=16, with_metrics=False)
+jax.block_until_ready(sim.state)
+print(json.dumps({{
+    "signature_kernels": [s["kernel"] for s in summary["signatures"]],
+    "cache": summary["cache"],
+    "built_in_run": led.total - start,
+}}))
+"""
+
+
+class TestPrewarmPallas:
+    def test_prewarmed_pallas_run_records_zero_net_compiles(
+            self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-c", _PREWARM_CHILD.format(
+                repo=REPO, cache=str(tmp_path / "cc"))],
+            capture_output=True, text=True, timeout=420)
+        assert out.returncode == 0, out.stderr[-2000:]
+        got = json.loads(out.stdout.strip().splitlines()[-1])
+        assert got["signature_kernels"] == ["pallas"]
+        assert got["cache"]["enabled"] and got["cache"]["misses"] >= 1
+        assert got["built_in_run"] == 0
+
+
+# ----------------------------------------------------------------------
+# Driver-level golden parity at 4096: dense reference vs pallas twin
+# ----------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _pair(with_chaos: bool):
+    """One (dense reference, pallas twin) per scenario — same seed,
+    same verbs; the 4096-node runs execute once, shared below."""
+    cfg = SimConfig(n=N, view_degree=16)
+    sims = [Simulation(cfg, seed=SEED, layout=lay, kernel=kern)
+            for lay, kern in ((layout.DENSE, "xla"),
+                              (layout.PACKED, "pallas"))]
+    for sim in sims:
+        sim.kill(np.arange(N) == 7)
+        if with_chaos:
+            sim.run_scenario(
+                [chaos.Partition(start=2, stop=18,
+                                 side_a=slice(0, N // 4))],
+                ticks=TICKS, chunk=CHUNK)
+        else:
+            sim.run(TICKS, chunk=CHUNK, with_metrics=False)
+    return sims
+
+
+@pytest.mark.slow
+class TestGoldenParity4096:
+    @pytest.mark.parametrize("with_chaos", [False, True])
+    def test_discrete_plane_bit_identical(self, with_chaos):
+        dense, pallas = _pair(with_chaos)
+        ds, ps = dense.swim_state, pallas.swim_state
+        for field in DISCRETE:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ds, field)),
+                np.asarray(getattr(ps, field)), err_msg=field)
+
+    @pytest.mark.parametrize("with_chaos", [False, True])
+    def test_vivaldi_plane_within_packed_tolerance(self, with_chaos):
+        dense, pallas = _pair(with_chaos)
+        ds, ps = dense.swim_state, pallas.swim_state
+        for field in ("vec", "height", "error", "adjustment",
+                      "adj_samples"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(ps.viv, field)),
+                np.asarray(getattr(ds.viv, field)),
+                rtol=VIV_RTOL,
+                atol=VIV_ATOL if field != "adj_samples" else LAT_ATOL,
+                err_msg=f"viv.{field}")
+        np.testing.assert_allclose(np.asarray(ps.lat_buf),
+                                   np.asarray(ds.lat_buf),
+                                   atol=LAT_ATOL, err_msg="lat_buf")
+
+    @pytest.mark.parametrize("with_chaos", [False, True])
+    def test_slo_counters_equal(self, with_chaos):
+        dense, pallas = _pair(with_chaos)
+        assert ({f: dense.counters[f] for f in SLO_KEYS}
+                == {f: pallas.counters[f] for f in SLO_KEYS})
+
+    def test_serf_delivered_sets_equal(self):
+        cfg = SimConfig(n=N, view_degree=16)
+        sims = [SerfSimulation(cfg, seed=SEED, layout=lay, kernel=kern)
+                for lay, kern in ((layout.DENSE, "xla"),
+                                  (layout.PACKED, "pallas"))]
+        mask = np.zeros(N, dtype=bool)
+        mask[5] = True
+        for sim in sims:
+            sim.run(16, chunk=CHUNK, with_metrics=False)
+            sim.user_event(mask, 7)
+            sim.run(TICKS - 16, chunk=CHUNK, with_metrics=False)
+        dense, pallas = sims
+        np.testing.assert_array_equal(
+            np.asarray(dense.state.ev_delivered),
+            np.asarray(pallas.state.ev_delivered))
+        for field in ("event_clock", "ev_floor", "q_floor"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(dense.state, field)),
+                np.asarray(getattr(pallas.state, field)), err_msg=field)
+        assert dense.counters["serf_intents_queued"] > 0
+
+    def test_sharded_equals_single_device(self):
+        def drive(mesh):
+            sim = Simulation(SimConfig(n=N, view_degree=16), seed=SEED,
+                             mesh=mesh, layout="packed", kernel="pallas")
+            sim.run(TICKS, chunk=CHUNK, with_metrics=False)
+            return sim
+
+        ref = drive(None)
+        got = drive(pmesh.make_mesh(jax.devices()[:8]))
+        _assert_trees_equal(jax.device_get(ref.state),
+                            jax.device_get(got.state), "sharded")
+        assert ref.counters == got.counters
